@@ -1,0 +1,78 @@
+"""paddle_tpu — a TPU-native deep learning framework.
+
+Brand-new JAX/XLA/Pallas/pjit architecture with the capabilities of the
+reference (PaddlePaddle ~2.5-dev at /root/reference): eager define-by-run
+tensors + autograd, jit trace-to-XLA, hybrid-parallel training over device
+meshes, AMP, recompute, sharded checkpointing, profiling, and a serving path.
+See SURVEY.md for the layer-by-layer mapping.
+"""
+from __future__ import annotations
+
+import os as _os
+
+# Persistent XLA compilation cache (reference pays per-op dispatch at runtime;
+# we pay XLA compiles — amortize them across runs; SURVEY.md §7 hard parts).
+import jax as _jax
+
+from .framework import flags as _flags
+
+if _flags.flag_value("use_persistent_compilation_cache"):
+    try:
+        _cache_dir = _flags.flag_value("compilation_cache_dir")
+        _os.makedirs(_cache_dir, exist_ok=True)
+        _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
+from .core.tensor import Tensor, Parameter  # noqa: F401,E402
+from .tensor import *  # noqa: F401,F403,E402  (creation/math/... API)
+from .tensor import to_tensor  # noqa: F401,E402
+from .framework import seed, set_flags, get_flags  # noqa: F401,E402
+from .framework import get_rng_state, set_rng_state  # noqa: F401,E402
+from .framework.dtype import (  # noqa: F401,E402
+    bool, uint8, int8, int16, int32, int64, float16, bfloat16, float32,
+    float64, complex64, complex128)
+from .autograd import no_grad, enable_grad, set_grad_enabled, grad  # noqa: F401,E402
+from .autograd import is_grad_enabled  # noqa: F401,E402
+
+from . import autograd  # noqa: F401,E402
+from . import device  # noqa: F401,E402
+from . import framework  # noqa: F401,E402
+from . import nn  # noqa: F401,E402
+from . import optimizer  # noqa: F401,E402
+from . import amp  # noqa: F401,E402
+from . import jit  # noqa: F401,E402
+from . import distributed  # noqa: F401,E402
+from . import io as _io_mod  # noqa: F401,E402
+from .io import save, load  # noqa: F401,E402
+from .device import (  # noqa: F401,E402
+    set_device, get_device, is_compiled_with_cuda, is_compiled_with_tpu)
+
+# default dtype management (paddle.set_default_dtype)
+_default_dtype = "float32"
+
+
+def set_default_dtype(d):
+    global _default_dtype
+    from .framework.dtype import convert_dtype
+    _default_dtype = str(convert_dtype(d))
+
+
+def get_default_dtype():
+    return _default_dtype
+
+
+def in_dynamic_mode():
+    """Parity: paddle.in_dynamic_mode — eager unless inside a jit trace."""
+    import jax.core as jcore
+    try:
+        return not isinstance(jcore.get_aval(0), jcore.Tracer)
+    except Exception:
+        return True
+
+
+disable_static = lambda: None  # noqa: E731 — eager is the only mode
+enable_static = lambda: None  # noqa: E731
+
+__version__ = "0.1.0"

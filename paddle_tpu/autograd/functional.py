@@ -1,0 +1,86 @@
+"""Functional autograd transforms.
+
+Parity: python/paddle/autograd (jacobian/hessian) and incubate forward-mode
+(incubate/autograd/__init__.py:15 forward_grad). TPU-first: these ARE jax
+transforms — no primitive-op rewrite system (reference paddle/fluid/prim/) is
+needed because jax.grad/jvp/vjp compose natively.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .tape import no_grad
+
+
+def _unwrap(x):
+    if isinstance(x, Tensor):
+        return x.value
+    if isinstance(x, (list, tuple)):
+        return type(x)(_unwrap(v) for v in x)
+    return x
+
+
+def _wrap(x):
+    if isinstance(x, (list, tuple)):
+        return type(x)(_wrap(v) for v in x)
+    return Tensor(x) if not isinstance(x, Tensor) else x
+
+
+def _functionalize(func):
+    """Lift a Tensor->Tensor python function to raw-array pure function."""
+    def raw_fn(*raw_args):
+        with no_grad():
+            out = func(*[_wrap(a) for a in raw_args])
+        return _unwrap(out)
+    return raw_fn
+
+
+def vjp(func, xs, v=None):
+    """paddle.autograd.vjp parity — but implemented by jax.vjp directly."""
+    xs_t = xs if isinstance(xs, (list, tuple)) else [xs]
+    raw = [_unwrap(x) for x in xs_t]
+    out, vjp_fn = jax.vjp(_functionalize(func), *raw)
+    if v is None:
+        v_raw = jnp.ones_like(out)
+    else:
+        v_raw = _unwrap(v)
+    grads = vjp_fn(v_raw)
+    grads = [_wrap(g) for g in grads]
+    return _wrap(out), grads if len(grads) > 1 else grads[0]
+
+
+def jvp(func, xs, v=None):
+    xs_t = xs if isinstance(xs, (list, tuple)) else [xs]
+    raw = [_unwrap(x) for x in xs_t]
+    if v is None:
+        tangents = [jnp.ones_like(r) for r in raw]
+    else:
+        v_t = v if isinstance(v, (list, tuple)) else [v]
+        tangents = [_unwrap(t) for t in v_t]
+    out, tangent_out = jax.jvp(_functionalize(func), tuple(raw), tuple(tangents))
+    return _wrap(out), _wrap(tangent_out)
+
+
+def jacobian(func, xs, batch_axis=None):
+    xs_t = xs if isinstance(xs, (list, tuple)) else [xs]
+    raw = [_unwrap(x) for x in xs_t]
+    jac = jax.jacrev(_functionalize(func), argnums=tuple(range(len(raw))))(*raw)
+    jac = [_wrap(j) for j in (jac if isinstance(jac, tuple) else (jac,))]
+    return jac if len(jac) > 1 else jac[0]
+
+
+def hessian(func, xs, batch_axis=None):
+    xs_t = xs if isinstance(xs, (list, tuple)) else [xs]
+    raw = [_unwrap(x) for x in xs_t]
+    h = jax.hessian(_functionalize(func), argnums=tuple(range(len(raw))))(*raw)
+    if len(raw) == 1:
+        hh = h[0] if isinstance(h, tuple) else h
+        return _wrap(hh[0] if isinstance(hh, tuple) else hh)
+    return _wrap(h)
+
+
+def forward_grad(func, xs, v=None):
+    """incubate.autograd.forward_grad parity (forward-mode AD)."""
+    return jvp(func, xs, v)[1]

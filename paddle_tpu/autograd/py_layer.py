@@ -1,0 +1,86 @@
+"""Custom autograd functions.
+
+Parity: paddle PyLayer (paddle/fluid/eager/pylayer/, python/paddle/autograd/
+py_layer.py): user defines static forward/backward; forward runs eagerly, a
+Node recording the user backward is placed on the tape.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .tape import Node, is_grad_enabled, no_grad
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = []
+        self.non_differentiable = set()
+
+    def save_for_backward(self, *tensors):
+        self._saved = [t.detach() if isinstance(t, Tensor) else t
+                       for t in tensors]
+
+    def saved_tensor(self):
+        return tuple(self._saved)
+
+    saved_tensors = saved_tensor
+
+    def mark_non_differentiable(self, *tensors):
+        for t in tensors:
+            self.non_differentiable.add(id(t))
+
+
+class PyLayerMeta(type):
+    def __call__(cls, *args, **kwargs):
+        raise RuntimeError("Call StaticMethod PyLayer.apply instead of "
+                           "instantiating it.")
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        with no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(out, (tuple, list))
+        outs = list(out) if multi else [out]
+
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)
+                         and not a.stop_gradient]
+        if not is_grad_enabled() or not tensor_inputs:
+            return out
+
+        def vjp_fn(cts):
+            cts_t = cts if isinstance(cts, tuple) else (cts,)
+            with no_grad():
+                gin = cls.backward(ctx, *[Tensor(c) for c in cts_t])
+            gin_t = gin if isinstance(gin, (tuple, list)) else (gin,)
+            raws = []
+            for g in gin_t:
+                if g is None:
+                    continue
+                raws.append(g.value if isinstance(g, Tensor) else jnp.asarray(g))
+            return tuple(raws)
+
+        avals = [(tuple(t.shape), t.dtype) for t in outs]
+        node = Node(vjp_fn, tensor_inputs, len(outs), avals, name=cls.__name__)
+        for i, t in enumerate(outs):
+            if id(t) in ctx.non_differentiable:
+                continue
+            t._node = node
+            t._out_index = i
+            t.stop_gradient = False
+        return out
+
+
+# Legacy alias used by some reference code paths.
+LegacyPyLayer = PyLayer

@@ -1,0 +1,334 @@
+"""Define-by-run autograd over jax.vjp.
+
+Reference parity: the eager autograd runtime (paddle/fluid/eager/) —
+GradNodeBase (grad_node_info.h:168), egr::Backward (backward.cc:380),
+GeneralGrad for paddle.grad (backward.cc:102), GradNodeAccumulation for
+leaves, TensorWrapper saved tensors. TPU-first design: instead of codegen'd
+per-op GradNode classes, every traced-forward op records ONE `Node` holding
+the `jax.vjp` residual closure — XLA computes the actual gradient kernels, so
+no per-op backward implementations exist anywhere in this framework.
+
+The graph is held by output tensors referencing their creating Node (which
+references input tensors), exactly like the reference's autograd meta — no
+global tape list, so memory is reclaimed when user tensors die.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtypes
+
+
+class _GradState(threading.local):
+    def __init__(self):
+        self.enabled = True
+
+
+_grad_state = _GradState()
+
+
+def is_grad_enabled() -> bool:
+    return _grad_state.enabled
+
+
+class no_grad:
+    """Context manager & decorator disabling autograd recording.
+
+    Parity: paddle.no_grad (python/paddle/fluid/dygraph/base.py).
+    """
+
+    def __enter__(self):
+        self._prev = _grad_state.enabled
+        _grad_state.enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _grad_state.enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with no_grad():
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self._prev = _grad_state.enabled
+        _grad_state.enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        _grad_state.enabled = self._prev
+        return False
+
+
+class set_grad_enabled:
+    def __init__(self, mode: bool):
+        self._mode = bool(mode)
+
+    def __enter__(self):
+        self._prev = _grad_state.enabled
+        _grad_state.enabled = self._mode
+        return self
+
+    def __exit__(self, *exc):
+        _grad_state.enabled = self._prev
+        return False
+
+
+class Node:
+    """One recorded op: inputs needing grad + the vjp closure.
+
+    Parity: GradNodeBase (paddle/fluid/eager/grad_node_info.h:168); the
+    residuals captured inside `vjp_fn` play the role of TensorWrapper
+    (tensor_wrapper.h) saved tensors.
+    """
+
+    __slots__ = ("vjp_fn", "inputs", "n_outputs", "out_avals", "name")
+
+    def __init__(self, vjp_fn, inputs, n_outputs, out_avals, name=""):
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs          # List[Tensor] (the differentiable ones)
+        self.n_outputs = n_outputs
+        self.out_avals = out_avals    # [(shape, dtype)] for zero-cotangent synth
+        self.name = name
+
+
+def _is_diff_value(v) -> bool:
+    return hasattr(v, "dtype") and dtypes.is_inexact(v.dtype)
+
+
+def apply(fn, *inputs, _op_name: str = "", **kwargs):
+    """Execute `fn(*raw_inputs, **kwargs)` and record a grad Node if needed.
+
+    `inputs` may be Tensors or raw values; kwargs are static. Returns raw
+    output(s) of fn wrapped into Tensor(s) with autograd metadata.
+    """
+    from ..core.tensor import Tensor, _wrap_single
+
+    raw = [x.value if isinstance(x, Tensor) else x for x in inputs]
+    diff_idx = []
+    if _grad_state.enabled:
+        for i, x in enumerate(inputs):
+            if isinstance(x, Tensor) and not x.stop_gradient and _is_diff_value(x.value):
+                diff_idx.append(i)
+
+    if not diff_idx:
+        out = fn(*raw, **kwargs)
+        return _wrap_outputs(out, None)
+
+    def closed(*diff_args):
+        full = list(raw)
+        for j, i in enumerate(diff_idx):
+            full[i] = diff_args[j]
+        return fn(*full, **kwargs)
+
+    out, vjp_fn = jax.vjp(closed, *[raw[i] for i in diff_idx])
+    multi = isinstance(out, (tuple, list))
+    outs = list(out) if multi else [out]
+    avals = [(getattr(o, "shape", ()), getattr(o, "dtype", None)) for o in outs]
+    node = Node(vjp_fn, [inputs[i] for i in diff_idx], len(outs), avals,
+                name=_op_name or getattr(fn, "__name__", "op"))
+    return _wrap_outputs(out, node)
+
+
+def _wrap_outputs(out, node):
+    from ..core.tensor import Tensor
+
+    if isinstance(out, (tuple, list)):
+        wrapped = []
+        for i, o in enumerate(out):
+            t = Tensor(o, stop_gradient=(node is None))
+            if node is not None:
+                t._node = node
+                t._out_index = i
+            wrapped.append(t)
+        return type(out)(wrapped)
+    t = Tensor(out, stop_gradient=(node is None))
+    if node is not None:
+        t._node = node
+        t._out_index = 0
+    return t
+
+
+def _zeros_like_aval(aval):
+    shape, dt = aval
+    if dt is not None and not dtypes.is_inexact(dt):
+        return np.zeros(shape, dtype=jax.dtypes.float0)
+    return jnp.zeros(shape, dtype=dt)
+
+
+def _topo_order(root_nodes: Sequence[Node]) -> List[Node]:
+    """Postorder DFS over the node DAG; reversed gives a valid backward order."""
+    order: List[Node] = []
+    seen = set()
+    stack = [(n, False) for n in root_nodes]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for t in node.inputs:
+            if t._node is not None and id(t._node) not in seen:
+                stack.append((t._node, False))
+    return order
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """Run reverse accumulation from `tensors`, writing leaf `.grad`.
+
+    Parity: egr::Backward (paddle/fluid/eager/backward.cc:380).
+    """
+    from ..core.tensor import Tensor
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+
+    # node-id -> list of output cotangents (lazily created)
+    pending = {}
+    roots = []
+    with no_grad():
+        for t, g in zip(tensors, grad_tensors):
+            if g is None:
+                if t.value.size != 1:
+                    raise RuntimeError(
+                        "backward() on a non-scalar tensor requires an explicit "
+                        "grad tensor (matches reference backward.cc seed-with-ones "
+                        "semantics for scalars).")
+                g_val = jnp.ones_like(t.value)
+            else:
+                g_val = g.value if isinstance(g, Tensor) else jnp.asarray(g)
+            if t._node is None:
+                if not t.stop_gradient:
+                    t._accumulate_grad(g_val)
+                continue
+            roots.append(t._node)
+            slot = pending.setdefault(id(t._node), [None] * t._node.n_outputs)
+            slot[t._out_index] = g_val if slot[t._out_index] is None \
+                else slot[t._out_index] + g_val
+
+        if not roots:
+            return
+
+        order = _topo_order(roots)
+        for node in reversed(order):
+            cts = pending.pop(id(node), None)
+            if cts is None:
+                continue
+            full_cts = [c if c is not None else _zeros_like_aval(a)
+                        for c, a in zip(cts, node.out_avals)]
+            ct_arg = tuple(full_cts) if node.n_outputs > 1 else full_cts[0]
+            in_cts = node.vjp_fn(ct_arg)
+            for t, ct in zip(node.inputs, in_cts):
+                if isinstance(ct, np.ndarray) and ct.dtype == jax.dtypes.float0:
+                    continue
+                if t._node is None:
+                    if not t.stop_gradient:
+                        t._accumulate_grad(ct)
+                else:
+                    slot = pending.setdefault(id(t._node),
+                                              [None] * t._node.n_outputs)
+                    i = t._out_index
+                    slot[i] = ct if slot[i] is None else slot[i] + ct
+                    if t._retain_grads:
+                        t._accumulate_grad(ct)
+            if not retain_graph:
+                node.vjp_fn = None  # free residuals eagerly
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, allow_unused=False):
+    """Functional gradient: returns grads of outputs w.r.t. inputs.
+
+    Parity: paddle.grad via GeneralGrad (paddle/fluid/eager/backward.cc:102).
+    Implemented by a private accumulation pass that does not touch `.grad`.
+    """
+    from ..core.tensor import Tensor
+
+    if isinstance(outputs, Tensor):
+        outputs = [outputs]
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif isinstance(grad_outputs, Tensor):
+        grad_outputs = [grad_outputs]
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True (higher-order eager grad) is not supported yet; "
+            "use paddle_tpu.incubate.autograd functional transforms instead.")
+
+    retain = True if retain_graph is None else retain_graph
+    input_ids = {id(t): i for i, t in enumerate(inputs)}
+    results: List[Optional[Any]] = [None] * len(inputs)
+
+    pending = {}
+    roots = []
+    with no_grad():
+        for t, g in zip(outputs, grad_outputs):
+            g_val = (jnp.ones_like(t.value) if g is None
+                     else (g.value if isinstance(g, Tensor) else jnp.asarray(g)))
+            if id(t) in input_ids:
+                i = input_ids[id(t)]
+                results[i] = g_val if results[i] is None else results[i] + g_val
+            if t._node is None:
+                continue
+            roots.append(t._node)
+            slot = pending.setdefault(id(t._node), [None] * t._node.n_outputs)
+            slot[t._out_index] = g_val if slot[t._out_index] is None \
+                else slot[t._out_index] + g_val
+
+        if roots:
+            order = _topo_order(roots)
+            for node in reversed(order):
+                cts = pending.pop(id(node), None)
+                if cts is None:
+                    continue
+                full_cts = [c if c is not None else _zeros_like_aval(a)
+                            for c, a in zip(cts, node.out_avals)]
+                ct_arg = tuple(full_cts) if node.n_outputs > 1 else full_cts[0]
+                in_cts = node.vjp_fn(ct_arg)
+                for t, ct in zip(node.inputs, in_cts):
+                    if isinstance(ct, np.ndarray) and ct.dtype == jax.dtypes.float0:
+                        continue
+                    if id(t) in input_ids:
+                        i = input_ids[id(t)]
+                        results[i] = ct if results[i] is None else results[i] + ct
+                    if t._node is not None:
+                        slot = pending.setdefault(id(t._node),
+                                                  [None] * t._node.n_outputs)
+                        j = t._out_index
+                        slot[j] = ct if slot[j] is None else slot[j] + ct
+                if not retain:
+                    node.vjp_fn = None
+
+    out = []
+    for i, r in enumerate(results):
+        if r is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    f"input {i} is unreachable from outputs "
+                    "(pass allow_unused=True to get None).")
+            out.append(None)
+        else:
+            out.append(Tensor(r, stop_gradient=True))
+    return out

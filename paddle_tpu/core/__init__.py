@@ -1,0 +1,1 @@
+from .tensor import Tensor, Parameter, to_tensor  # noqa: F401

@@ -1,0 +1,96 @@
+"""Device API.
+
+Parity: python/paddle/device/ (set_device/get_device, cuda streams API).
+TPU-first: devices are PJRT devices; streams/events are XLA's concern — the
+API surface is kept for compatibility and maps onto jax device placement and
+`block_until_ready` synchronization. Memory stats parity
+(paddle.device.cuda.max_memory_allocated ← paddle/fluid/memory/stats.h:100)
+comes from PJRT memory_stats.
+"""
+from __future__ import annotations
+
+import jax
+
+_current = None
+
+
+def get_all_devices():
+    return jax.devices()
+
+
+def set_device(device):
+    """Accepts 'tpu', 'tpu:0', 'cpu', 'gpu:0' style strings."""
+    global _current
+    if isinstance(device, str):
+        parts = device.split(":")
+        kind = {"gpu": "tpu", "xpu": "tpu"}.get(parts[0], parts[0])
+        idx = int(parts[1]) if len(parts) > 1 else 0
+        try:
+            devs = jax.devices(kind)
+        except RuntimeError:
+            devs = jax.devices()
+        _current = devs[min(idx, len(devs) - 1)]
+    else:
+        _current = device
+    return _current
+
+
+def get_device():
+    d = _current or jax.devices()[0]
+    return f"{d.platform}:{getattr(d, 'id', 0)}"
+
+
+def current_device():
+    return _current or jax.devices()[0]
+
+
+def device_count():
+    return jax.device_count()
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_tpu():
+    return True
+
+
+def is_compiled_with_custom_device(name="tpu"):
+    return name in ("tpu", "axon")
+
+
+def synchronize(device=None):
+    """Block until all queued device work completes (jax dispatch is async)."""
+    for d in jax.live_arrays():
+        d.block_until_ready()
+
+
+def max_memory_allocated(device=None):
+    d = device if device is not None else current_device()
+    try:
+        stats = d.memory_stats()
+        return stats.get("peak_bytes_in_use", 0)
+    except Exception:
+        return 0
+
+
+def memory_allocated(device=None):
+    d = device if device is not None else current_device()
+    try:
+        stats = d.memory_stats()
+        return stats.get("bytes_in_use", 0)
+    except Exception:
+        return 0
+
+
+def empty_cache():
+    pass

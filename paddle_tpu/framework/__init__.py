@@ -1,0 +1,4 @@
+from . import dtype, flags, random  # noqa: F401
+from .flags import set_flags, get_flags, define_flag, flag_value  # noqa: F401
+from .random import seed, default_generator, rng_guard  # noqa: F401
+from .random import get_rng_state, set_rng_state  # noqa: F401

@@ -1,0 +1,70 @@
+"""Dtype system.
+
+Reference parity: paddle's VarType dtypes (paddle/phi/common/data_type.h) —
+here dtypes ARE numpy/jax dtypes; we expose paddle-style names and a
+`convert_dtype` normalizer. TPU-first: bfloat16 is a first-class citizen.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype objects (jnp dtypes are numpy-compatible dtypes).
+bool = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_ALIASES = {
+    "bool": bool, "uint8": uint8, "int8": int8, "int16": int16,
+    "int32": int32, "int64": int64, "float16": float16,
+    "bfloat16": bfloat16, "float32": float32, "float64": float64,
+    "complex64": complex64, "complex128": complex128,
+    "fp16": float16, "bf16": bfloat16, "fp32": float32, "fp64": float64,
+}
+
+
+# With jax_enable_x64 off (the TPU-idiomatic default), 64-bit types quietly
+# narrow — map them eagerly so no op emits truncation warnings. int64-indexed
+# APIs keep their names; payloads are int32 (what the hardware wants anyway).
+_X64_NARROW = {np.dtype(np.int64): np.dtype(np.int32),
+               np.dtype(np.uint64): np.dtype(np.uint32),
+               np.dtype(np.float64): np.dtype(np.float32),
+               np.dtype(np.complex128): np.dtype(np.complex64)}
+
+
+def convert_dtype(dtype):
+    """Normalize a user-provided dtype (str, np.dtype, jnp dtype) to np.dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype not in _ALIASES:
+            raise TypeError(f"Unsupported dtype string: {dtype!r}")
+        dt = np.dtype(_ALIASES[dtype])
+    else:
+        dt = np.dtype(dtype)
+    import jax
+    if not jax.config.jax_enable_x64:
+        dt = _X64_NARROW.get(dt, dt)
+    return dt
+
+
+def is_floating_point(dtype):
+    return jnp.issubdtype(np.dtype(dtype), jnp.floating)
+
+
+def is_integer(dtype):
+    return jnp.issubdtype(np.dtype(dtype), jnp.integer)
+
+
+def is_inexact(dtype):
+    """Float or complex — i.e. differentiable."""
+    return jnp.issubdtype(np.dtype(dtype), jnp.inexact)

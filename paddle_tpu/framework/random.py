@@ -1,0 +1,95 @@
+"""RNG: global Generator over jax PRNG keys.
+
+Reference parity: phi RNG Generator (paddle/phi/core/generator.h) and
+paddle.seed. TPU-first design: state is a jax PRNG key; `next_key()` is a
+split-and-advance. Under `jax.jit` tracing, mutating global state would bake
+constants into the compiled program, so jit'd code must install a traced key
+via `rng_guard(key)` — the train-step builder (paddle_tpu.jit) does this,
+folding in the step counter so every step gets fresh randomness while staying
+a pure function. Model-parallel RNG (reference RNGStatesTracker,
+fleet/layers/mpu/random.py) maps to `fold_in` on mesh axis indices.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import numpy as np
+
+
+class Generator:
+    """Holds a PRNG key; next_key() splits off a fresh subkey."""
+
+    def __init__(self, seed: int = 0):
+        self._key = jax.random.key(seed)
+        self._seed = seed
+
+    def manual_seed(self, seed: int):
+        self._key = jax.random.key(seed)
+        self._seed = seed
+        return self
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def set_key(self, key):
+        self._key = key
+
+    def next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def fold_in(self, data: int):
+        """Deterministically derive a key without advancing state."""
+        return jax.random.fold_in(self._key, data)
+
+
+class _RngState(threading.local):
+    def __init__(self):
+        self.generator = Generator(0)
+        # Stack of override generators installed by rng_guard (trace-safe).
+        self.stack = []
+
+
+_state = _RngState()
+
+
+def default_generator() -> Generator:
+    if _state.stack:
+        return _state.stack[-1]
+    return _state.generator
+
+
+def seed(s: int) -> Generator:
+    """paddle.seed parity — reseed the global generator."""
+    return _state.generator.manual_seed(int(s))
+
+
+def next_key():
+    return default_generator().next_key()
+
+
+@contextlib.contextmanager
+def rng_guard(key):
+    """Install a fresh Generator seeded from `key` (may be a tracer).
+
+    All random ops inside the context draw from it. This is how jit'd train
+    steps thread randomness functionally.
+    """
+    gen = Generator.__new__(Generator)
+    gen._key = key
+    gen._seed = -1
+    _state.stack.append(gen)
+    try:
+        yield gen
+    finally:
+        _state.stack.pop()
+
+
+def get_rng_state():
+    return default_generator()._key
+
+
+def set_rng_state(key):
+    default_generator().set_key(key)

@@ -1,0 +1,87 @@
+"""paddle.tensor-equivalent namespace + Tensor method patching.
+
+Parity: python/paddle/tensor/__init__.py and the math-op-patch
+(paddle/fluid/pybind/eager_math_op_patch.cc) that attaches every tensor API
+function as a Tensor method/operator.
+"""
+from __future__ import annotations
+
+from ..core.tensor import Tensor, Parameter, to_tensor  # noqa: F401
+
+from . import attribute, creation, einsum, linalg, logic, manipulation  # noqa: F401
+from . import math, random, search, stat  # noqa: F401
+
+from .attribute import *  # noqa: F401,F403
+from .creation import *  # noqa: F401,F403
+from .einsum import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+
+
+def _patch_methods():
+    """Attach API functions as Tensor methods (math_op_patch parity)."""
+    import types
+
+    modules = [attribute, creation, einsum, linalg, logic, manipulation,
+               math, random, search, stat]
+    skip = {"to_tensor", "zeros", "ones", "full", "arange", "linspace",
+            "logspace", "eye", "empty", "meshgrid", "rand", "randn",
+            "randint", "uniform", "normal", "randperm", "assign", "einsum",
+            "shape", "broadcast_tensors", "tril_indices", "triu_indices"}
+    for mod in modules:
+        for name in getattr(mod, "__all__", []):
+            if name in skip or hasattr(Tensor, name):
+                continue
+            fn = getattr(mod, name)
+            if isinstance(fn, types.FunctionType):
+                setattr(Tensor, name, fn)
+
+    # Method-only conveniences
+    Tensor.add_n = staticmethod(math.add_n)
+
+    # ---- operator dunders ----
+    def _coerce_other(self, other):
+        return other
+
+    Tensor.__add__ = lambda s, o: math.add(s, _coerce_other(s, o))
+    Tensor.__radd__ = lambda s, o: math.add(s, o)
+    Tensor.__sub__ = lambda s, o: math.subtract(s, o)
+    Tensor.__rsub__ = lambda s, o: math.subtract(to_tensor(o) if not isinstance(o, Tensor) else o, s)
+    Tensor.__mul__ = lambda s, o: math.multiply(s, o)
+    Tensor.__rmul__ = lambda s, o: math.multiply(s, o)
+    Tensor.__truediv__ = lambda s, o: math.divide(s, o)
+    Tensor.__rtruediv__ = lambda s, o: math.divide(to_tensor(o) if not isinstance(o, Tensor) else o, s)
+    Tensor.__floordiv__ = lambda s, o: math.floor_divide(s, o)
+    Tensor.__rfloordiv__ = lambda s, o: math.floor_divide(to_tensor(o), s)
+    Tensor.__mod__ = lambda s, o: math.remainder(s, o)
+    Tensor.__rmod__ = lambda s, o: math.remainder(to_tensor(o), s)
+    Tensor.__pow__ = lambda s, o: math.pow(s, o)
+    Tensor.__rpow__ = lambda s, o: math.pow(to_tensor(o), s)
+    Tensor.__matmul__ = lambda s, o: math.matmul(s, o)
+    Tensor.__rmatmul__ = lambda s, o: math.matmul(to_tensor(o), s)
+    Tensor.__neg__ = lambda s: math.neg(s)
+    Tensor.__abs__ = lambda s: math.abs(s)
+    Tensor.__pos__ = lambda s: s
+    Tensor.__invert__ = lambda s: (logic.logical_not(s) if s.dtype == bool
+                                   else logic.bitwise_not(s))
+    Tensor.__eq__ = lambda s, o: logic.equal(s, o)
+    Tensor.__ne__ = lambda s, o: logic.not_equal(s, o)
+    Tensor.__lt__ = lambda s, o: logic.less_than(s, o)
+    Tensor.__le__ = lambda s, o: logic.less_equal(s, o)
+    Tensor.__gt__ = lambda s, o: logic.greater_than(s, o)
+    Tensor.__ge__ = lambda s, o: logic.greater_equal(s, o)
+    Tensor.__and__ = lambda s, o: (logic.logical_and(s, o) if s.dtype == bool
+                                   else logic.bitwise_and(s, o))
+    Tensor.__or__ = lambda s, o: (logic.logical_or(s, o) if s.dtype == bool
+                                  else logic.bitwise_or(s, o))
+    Tensor.__xor__ = lambda s, o: (logic.logical_xor(s, o) if s.dtype == bool
+                                   else logic.bitwise_xor(s, o))
+
+
+_patch_methods()
+del _patch_methods

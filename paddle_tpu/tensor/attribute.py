@@ -1,0 +1,32 @@
+"""Tensor attribute queries. Parity: python/paddle/tensor/attribute.py."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..framework import dtype as dtypes
+
+__all__ = ["shape", "rank", "is_floating_point", "is_integer", "is_complex",
+           "real", "imag"]
+
+from .math import real, imag  # noqa: F401
+
+
+def shape(input):
+    return Tensor(jnp.asarray(input.shape, dtype=jnp.int32))
+
+
+def rank(input):
+    return Tensor(jnp.asarray(input.ndim, dtype=jnp.int32))
+
+
+def is_floating_point(x):
+    return dtypes.is_floating_point(x.dtype)
+
+
+def is_integer(x):
+    return dtypes.is_integer(x.dtype)
+
+
+def is_complex(x):
+    return jnp.issubdtype(x.dtype, jnp.complexfloating)

@@ -1,0 +1,168 @@
+"""Tensor creation ops.
+
+Parity: python/paddle/tensor/creation.py. All constructors produce device
+arrays via jnp; dtype default is float32 (paddle default dtype).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd.tape import apply
+from ..core.tensor import Tensor, to_tensor  # re-export to_tensor
+from ..framework.dtype import convert_dtype
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "arange", "linspace", "logspace",
+    "eye", "empty", "zeros_like", "ones_like", "full_like", "empty_like",
+    "tril", "triu", "diag", "diagflat", "meshgrid", "assign", "clone",
+    "tril_indices", "triu_indices", "complex", "polar",
+]
+
+
+def _dt(dtype, default="float32"):
+    return convert_dtype(dtype) if dtype is not None else convert_dtype(default)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), dtype=_dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), dtype=_dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if dtype is None:
+        val = fill_value
+        if isinstance(val, bool):
+            dt = np.dtype(np.bool_)
+        elif isinstance(val, int):
+            dt = convert_dtype("int64")
+        else:
+            dt = np.dtype(np.float32)
+    else:
+        dt = convert_dtype(dtype)
+    return Tensor(jnp.full(_shape(shape), fill_value, dtype=dt))
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape.value))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = ("int64" if all(isinstance(v, (int, np.integer))
+                                for v in (start, end, step)) else "float32")
+    return Tensor(jnp.arange(start, end, step, dtype=convert_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor(jnp.linspace(float(start), float(stop), int(num),
+                               dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(float(start), float(stop), int(num),
+                               base=base, dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(int(num_rows),
+                          None if num_columns is None else int(num_columns),
+                          dtype=_dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return Tensor(jnp.zeros_like(x.value, dtype=convert_dtype(dtype)))
+
+
+def ones_like(x, dtype=None, name=None):
+    return Tensor(jnp.ones_like(x.value, dtype=convert_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return Tensor(jnp.full_like(x.value, fill_value, dtype=convert_dtype(dtype)))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def tril(x, diagonal=0, name=None):
+    return apply(jnp.tril, x, k=int(diagonal), _op_name="tril")
+
+
+def triu(x, diagonal=0, name=None):
+    return apply(jnp.triu, x, k=int(diagonal), _op_name="triu")
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    if x.ndim == 1 and padding_value != 0:
+        def f(v):
+            n = v.shape[0] + abs(offset)
+            out = jnp.full((n, n), padding_value, dtype=v.dtype)
+            idx = jnp.arange(v.shape[0])
+            r = idx if offset >= 0 else idx - offset
+            c = idx + offset if offset >= 0 else idx
+            return out.at[r, c].set(v)
+        return apply(f, x, _op_name="diag")
+    return apply(jnp.diag, x, k=int(offset), _op_name="diag")
+
+
+def diagflat(x, offset=0, name=None):
+    return apply(lambda v: jnp.diagflat(v, k=int(offset)), x, _op_name="diagflat")
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    outs = jnp.meshgrid(*[a.value for a in args], indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def assign(x, output=None):
+    src = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+    if output is None:
+        return Tensor(src)
+    output.set_value(src)
+    return output
+
+
+def clone(x, name=None):
+    return x.clone()
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=convert_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = np.triu_indices(row, offset, col if col is not None else row)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=convert_dtype(dtype)))
+
+
+def complex(real, imag, name=None):
+    return apply(jax_complex, real, imag, _op_name="complex")
+
+
+def jax_complex(r, i):
+    return r + 1j * i
+
+
+def polar(abs_, angle, name=None):
+    return apply(lambda a, t: a * jnp.exp(1j * t), abs_, angle, _op_name="polar")

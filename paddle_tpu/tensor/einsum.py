@@ -1,0 +1,14 @@
+"""Einsum. Parity: python/paddle/tensor/einsum.py — delegated to jnp.einsum
+(XLA contracts on the MXU; no custom planner needed)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..autograd.tape import apply
+
+__all__ = ["einsum"]
+
+
+def einsum(equation, *operands):
+    return apply(lambda *vs: jnp.einsum(equation, *vs), *operands,
+                 _op_name="einsum")

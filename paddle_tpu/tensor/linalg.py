@@ -1,0 +1,231 @@
+"""Linear algebra. Parity: python/paddle/tensor/linalg.py — matmuls hit the
+MXU directly; decompositions lower to XLA's linalg custom calls."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd.tape import apply
+from ..core.tensor import Tensor
+from .math import matmul  # re-export
+from .manipulation import t, transpose  # noqa: F401
+
+__all__ = [
+    "matmul", "dot", "bmm", "mv", "norm", "dist", "cross", "cholesky",
+    "matrix_power", "qr", "svd", "pinv", "solve", "triangular_solve",
+    "cholesky_solve", "eig", "eigh", "eigvals", "eigvalsh", "det", "slogdet",
+    "inverse", "matrix_rank", "multi_dot", "cond", "cov", "corrcoef", "lstsq",
+    "lu", "householder_product", "matrix_exp", "vecdot", "vector_norm",
+    "matrix_norm",
+]
+
+
+def dot(x, y, name=None):
+    def f(a, b):
+        if a.ndim == 1:
+            return jnp.dot(a, b)
+        return jnp.sum(a * b, axis=-1)
+    return apply(f, x, y, _op_name="dot")
+
+
+def vecdot(x, y, axis=-1, name=None):
+    return apply(lambda a, b: jnp.sum(a * b, axis=axis), x, y, _op_name="vecdot")
+
+
+def bmm(x, y, name=None):
+    return apply(jnp.matmul, x, y, _op_name="bmm")
+
+
+def mv(x, vec, name=None):
+    return apply(jnp.matmul, x, vec, _op_name="mv")
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    def f(v):
+        if p is None or p == "fro":
+            if axis is None:
+                return jnp.sqrt(jnp.sum(jnp.square(jnp.abs(v))))
+            return jnp.linalg.norm(v, ord=None, axis=_ax(axis), keepdims=keepdim)
+        if p == "nuc":
+            return jnp.linalg.norm(v, ord="nuc", axis=_ax(axis), keepdims=keepdim)
+        if axis is None:
+            return jnp.linalg.norm(v.reshape(-1), ord=p, keepdims=keepdim)
+        return jnp.linalg.norm(v, ord=p, axis=_ax(axis), keepdims=keepdim)
+    return apply(f, x, _op_name="norm")
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    def f(v):
+        if axis is None:
+            v = v.reshape(-1)
+            return jnp.linalg.norm(v, ord=p, keepdims=keepdim)
+        return jnp.linalg.norm(v, ord=p, axis=_ax(axis), keepdims=keepdim)
+    return apply(f, x, _op_name="vector_norm")
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return apply(lambda v: jnp.linalg.norm(v, ord=p, axis=tuple(axis),
+                                           keepdims=keepdim), x,
+                 _op_name="matrix_norm")
+
+
+def _ax(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def dist(x, y, p=2, name=None):
+    return apply(lambda a, b: jnp.linalg.norm((a - b).reshape(-1), ord=p),
+                 x, y, _op_name="dist")
+
+
+def cross(x, y, axis=9, name=None):
+    ax = axis if axis != 9 else None
+    def f(a, b):
+        if ax is None:
+            for i, d in enumerate(a.shape):
+                if d == 3:
+                    return jnp.cross(a, b, axis=i)
+            return jnp.cross(a, b)
+        return jnp.cross(a, b, axis=ax)
+    return apply(f, x, y, _op_name="cross")
+
+
+def cholesky(x, upper=False, name=None):
+    def f(v):
+        l = jnp.linalg.cholesky(v)
+        return jnp.swapaxes(l, -1, -2) if upper else l
+    return apply(f, x, _op_name="cholesky")
+
+
+def matrix_power(x, n, name=None):
+    return apply(lambda v: jnp.linalg.matrix_power(v, int(n)), x,
+                 _op_name="matrix_power")
+
+
+def matrix_exp(x, name=None):
+    return apply(jax.scipy.linalg.expm, x, _op_name="matrix_exp")
+
+
+def qr(x, mode="reduced", name=None):
+    q, r = jnp.linalg.qr(x.value, mode=mode)
+    return Tensor(q), Tensor(r)
+
+
+def svd(x, full_matrices=False, name=None):
+    u, s, vh = jnp.linalg.svd(x.value, full_matrices=full_matrices)
+    return Tensor(u), Tensor(s), Tensor(jnp.swapaxes(vh, -1, -2).conj())
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply(lambda v: jnp.linalg.pinv(v, rtol=rcond, hermitian=hermitian),
+                 x, _op_name="pinv")
+
+
+def solve(x, y, name=None):
+    return apply(lambda a, b: jnp.linalg.solve(a, b), x, y, _op_name="solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    def f(a, b):
+        a2 = jnp.swapaxes(a, -1, -2) if transpose else a
+        return jax.scipy.linalg.solve_triangular(
+            a2, b, lower=not (upper != transpose),
+            unit_diagonal=unitriangular)
+    return apply(f, x, y, _op_name="triangular_solve")
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def f(b, l):
+        return jax.scipy.linalg.cho_solve((l, not upper), b)
+    return apply(f, x, y, _op_name="cholesky_solve")
+
+
+def eig(x, name=None):
+    w, v = np.linalg.eig(np.asarray(x.value))
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigh(x, UPLO="L", name=None):
+    w, v = jnp.linalg.eigh(x.value, UPLO=UPLO)
+    return Tensor(w), Tensor(v)
+
+
+def eigvals(x, name=None):
+    return Tensor(jnp.asarray(np.linalg.eigvals(np.asarray(x.value))))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply(lambda v: jnp.linalg.eigvalsh(v, UPLO=UPLO), x,
+                 _op_name="eigvalsh")
+
+
+def det(x, name=None):
+    return apply(jnp.linalg.det, x, _op_name="det")
+
+
+def slogdet(x, name=None):
+    sign, logdet = jnp.linalg.slogdet(x.value)
+    return Tensor(jnp.stack([sign, logdet]))
+
+
+def inverse(x, name=None):
+    return apply(jnp.linalg.inv, x, _op_name="inverse")
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return Tensor(jnp.linalg.matrix_rank(x.value, rtol=tol))
+
+
+def multi_dot(x, name=None):
+    return apply(lambda *vs: jnp.linalg.multi_dot(vs), *x, _op_name="multi_dot")
+
+
+def cond(x, p=None, name=None):
+    return Tensor(jnp.linalg.cond(x.value, p=p))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return apply(lambda v: jnp.cov(
+        v, rowvar=rowvar, ddof=1 if ddof else 0,
+        fweights=None if fweights is None else fweights.value,
+        aweights=None if aweights is None else aweights.value), x,
+        _op_name="cov")
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply(lambda v: jnp.corrcoef(v, rowvar=rowvar), x,
+                 _op_name="corrcoef")
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x.value, y.value, rcond=rcond)
+    return Tensor(sol), Tensor(res), Tensor(rank), Tensor(sv)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    lu_f, piv = jax.scipy.linalg.lu_factor(x.value)
+    outs = (Tensor(lu_f), Tensor(piv.astype(jnp.int32) + 1))
+    if get_infos:
+        return outs + (Tensor(jnp.zeros((), dtype=jnp.int32)),)
+    return outs
+
+
+def householder_product(x, tau, name=None):
+    def f(a, t_):
+        m, n = a.shape[-2], a.shape[-1]
+        q = jnp.eye(m, dtype=a.dtype)
+        q = jnp.broadcast_to(q, a.shape[:-2] + (m, m)).copy() if a.ndim > 2 else q
+        def apply_one(i, qacc):
+            v = jnp.where(jnp.arange(m) > i, a[..., i], jnp.where(jnp.arange(m) == i, 1.0, 0.0))
+            h = jnp.eye(m, dtype=a.dtype) - t_[..., i] * jnp.outer(v, v)
+            return qacc @ h
+        for i in range(n):
+            q = apply_one(i, q)
+        return q[..., :, :n]
+    return apply(f, x, tau, _op_name="householder_product")

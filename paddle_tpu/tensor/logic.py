@@ -1,0 +1,84 @@
+"""Comparison & logical ops. Parity: python/paddle/tensor/logic.py."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..autograd.tape import apply
+from ..core.tensor import Tensor
+
+__all__ = [
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "equal_all", "allclose", "isclose", "logical_and",
+    "logical_or", "logical_not", "logical_xor", "bitwise_and", "bitwise_or",
+    "bitwise_not", "bitwise_xor", "is_empty", "is_tensor", "all", "any",
+]
+
+
+def _cmp(fn, name):
+    def op(x, y, name=None):
+        return apply(fn, x, y, _op_name=name)
+    op.__name__ = name
+    return op
+
+
+equal = _cmp(jnp.equal, "equal")
+not_equal = _cmp(jnp.not_equal, "not_equal")
+greater_than = _cmp(jnp.greater, "greater_than")
+greater_equal = _cmp(jnp.greater_equal, "greater_equal")
+less_than = _cmp(jnp.less, "less_than")
+less_equal = _cmp(jnp.less_equal, "less_equal")
+logical_and = _cmp(jnp.logical_and, "logical_and")
+logical_or = _cmp(jnp.logical_or, "logical_or")
+logical_xor = _cmp(jnp.logical_xor, "logical_xor")
+bitwise_and = _cmp(jnp.bitwise_and, "bitwise_and")
+bitwise_or = _cmp(jnp.bitwise_or, "bitwise_or")
+bitwise_xor = _cmp(jnp.bitwise_xor, "bitwise_xor")
+
+
+def logical_not(x, name=None):
+    return apply(jnp.logical_not, x, _op_name="logical_not")
+
+
+def bitwise_not(x, name=None):
+    return apply(jnp.bitwise_not, x, _op_name="bitwise_not")
+
+
+def equal_all(x, y, name=None):
+    return Tensor(jnp.array_equal(x.value, y.value))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return Tensor(jnp.allclose(x.value, y.value, rtol=rtol, atol=atol,
+                               equal_nan=equal_nan))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply(lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol,
+                                          equal_nan=equal_nan), x, y,
+                 _op_name="isclose")
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(x.size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.all(v, axis=_axis(axis), keepdims=keepdim), x,
+                 _op_name="all")
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.any(v, axis=_axis(axis), keepdims=keepdim), x,
+                 _op_name="any")

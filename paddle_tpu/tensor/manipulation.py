@@ -1,0 +1,456 @@
+"""Shape/layout manipulation ops.
+
+Parity: python/paddle/tensor/manipulation.py. Static-shape ops map 1:1 onto
+jnp; dynamic-shape ops (masked_select, nonzero, unique) are eager-only — they
+raise under jit tracing, matching XLA's static-shape compilation model.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd.tape import apply
+from ..core.tensor import Tensor
+from ..framework.dtype import convert_dtype
+
+__all__ = [
+    "reshape", "reshape_", "transpose", "concat", "stack", "split", "chunk",
+    "squeeze", "squeeze_", "unsqueeze", "unsqueeze_", "flatten", "gather",
+    "gather_nd", "scatter", "scatter_nd_add", "slice", "tile", "expand",
+    "expand_as", "broadcast_to", "broadcast_tensors", "flip", "rot90", "roll",
+    "index_select", "take_along_axis", "put_along_axis", "repeat_interleave",
+    "unbind", "unstack", "numel", "cast", "crop", "strided_slice", "moveaxis",
+    "masked_select", "masked_fill", "unique", "unique_consecutive", "nonzero",
+    "as_real", "as_complex", "view", "view_as", "atleast_1d", "atleast_2d",
+    "atleast_3d", "tensordot", "shard_index", "index_add", "index_put",
+    "tolist", "diagonal", "tensor_split", "dsplit", "hsplit", "vsplit",
+    "unfold", "pad",
+]
+
+
+def _shape_arg(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in np.asarray(shape.value))
+    return tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def reshape(x, shape, name=None):
+    s = _shape_arg(shape)
+    return apply(lambda v: jnp.reshape(v, s), x, _op_name="reshape")
+
+
+def reshape_(x, shape, name=None):
+    return x._replace_(reshape(x, shape))
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return x.astype(shape_or_dtype)
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def transpose(x, perm, name=None):
+    p = tuple(int(i) for i in perm)
+    return apply(lambda v: jnp.transpose(v, p), x, _op_name="transpose")
+
+
+def t(x, name=None):
+    if x.ndim < 2:
+        return x.clone()
+    return transpose(x, [1, 0])
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply(lambda v: jnp.moveaxis(v, source, destination), x,
+                 _op_name="moveaxis")
+
+
+def concat(x, axis=0, name=None):
+    axis = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return apply(lambda *vs: jnp.concatenate(vs, axis=axis), *x,
+                 _op_name="concat")
+
+
+def stack(x, axis=0, name=None):
+    return apply(lambda *vs: jnp.stack(vs, axis=int(axis)), *x,
+                 _op_name="stack")
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    axis = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        n = num_or_sections
+        sizes = [dim // n] * n
+    else:
+        sizes = [int(s) for s in num_or_sections]
+        n_unknown = sum(1 for s in sizes if s < 0)
+        if n_unknown:
+            known = sum(s for s in sizes if s >= 0)
+            sizes = [s if s >= 0 else dim - known for s in sizes]
+    offsets = np.cumsum([0] + sizes[:-1])
+    outs = []
+    for off, sz in zip(offsets, sizes):
+        outs.append(apply(
+            lambda v, o=int(off), s=int(sz): jax.lax.slice_in_dim(v, o, o + s, axis=axis),
+            x, _op_name="split"))
+    return outs
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, int(chunks), axis)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    vs = jnp.array_split(x.value, num_or_indices, axis=int(axis))
+    return [Tensor(v) for v in vs]
+
+
+def dsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def hsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=1 if x.ndim > 1 else 0)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def _norm_axes(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return (int(axis),)
+
+
+def squeeze(x, axis=None, name=None):
+    ax = _norm_axes(axis)
+    if ax is not None:
+        ax = tuple(a for a in ax if x.shape[a] == 1)
+        if not ax:
+            return x.clone()
+    return apply(lambda v: jnp.squeeze(v, axis=ax), x, _op_name="squeeze")
+
+
+def squeeze_(x, axis=None, name=None):
+    return x._replace_(squeeze(x, axis))
+
+
+def unsqueeze(x, axis, name=None):
+    ax = _norm_axes(axis)
+    return apply(lambda v: jnp.expand_dims(v, ax), x, _op_name="unsqueeze")
+
+
+def unsqueeze_(x, axis, name=None):
+    return x._replace_(unsqueeze(x, axis))
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    nd = x.ndim
+    s = start_axis % nd if nd else 0
+    e = stop_axis % nd if nd else 0
+    shape = x.shape
+    new_shape = shape[:s] + [int(np.prod(shape[s:e + 1]) or 1)] + shape[e + 1:]
+    return reshape(x, new_shape)
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(x.size, dtype=convert_dtype("int64")))
+
+
+def cast(x, dtype):
+    return x.astype(dtype)
+
+
+def gather(x, index, axis=0, name=None):
+    axis = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return apply(lambda v, i: jnp.take(v, i.reshape(-1) if i.ndim > 1 else i,
+                                       axis=axis), x, index, _op_name="gather")
+
+
+def gather_nd(x, index, name=None):
+    def f(v, idx):
+        idx_tup = tuple(jnp.moveaxis(idx, -1, 0))
+        return v[idx_tup]
+    return apply(f, x, index, _op_name="gather_nd")
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def f(v, i, u):
+        i = i.reshape(-1)
+        if overwrite:
+            return v.at[i].set(u)
+        z = v.at[i].set(jnp.zeros_like(u))
+        return z.at[i].add(u)
+    return apply(f, x, index, updates, _op_name="scatter")
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def f(v, idx, u):
+        idx_tup = tuple(jnp.moveaxis(idx, -1, 0))
+        return v.at[idx_tup].add(u)
+    return apply(f, x, index, updates, _op_name="scatter_nd_add")
+
+
+def index_add(x, index, axis, value, name=None):
+    def f(v, i, u):
+        sl = [jnp.s_[:]] * v.ndim
+        sl[axis] = i
+        return v.at[tuple(sl)].add(u)
+    return apply(f, x, index, value, _op_name="index_add")
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    def f(v, u, *idx):
+        if accumulate:
+            return v.at[tuple(idx)].add(u)
+        return v.at[tuple(idx)].set(u)
+    return apply(f, x, value, *indices, _op_name="index_put")
+
+
+def slice(x, axes, starts, ends, name=None):
+    sl = [jnp.s_[:]] * x.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        s = int(s.item()) if isinstance(s, Tensor) else int(s)
+        e = int(e.item()) if isinstance(e, Tensor) else int(e)
+        sl[int(ax)] = jnp.s_[s:e]
+    sl = tuple(sl)
+    return apply(lambda v: v[sl], x, _op_name="slice")
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    sl = [jnp.s_[:]] * x.ndim
+    for ax, s, e, st in zip(axes, starts, ends, strides):
+        sl[int(ax)] = jnp.s_[int(s):int(e):int(st)]
+    sl = tuple(sl)
+    return apply(lambda v: v[sl], x, _op_name="strided_slice")
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    shape = _shape_arg(shape)
+    offsets = [0] * x.ndim if offsets is None else [int(o) for o in offsets]
+    sl = tuple(jnp.s_[o:o + s] for o, s in zip(offsets, shape))
+    return apply(lambda v: v[sl], x, _op_name="crop")
+
+
+def tile(x, repeat_times, name=None):
+    r = _shape_arg(repeat_times)
+    return apply(lambda v: jnp.tile(v, r), x, _op_name="tile")
+
+
+def expand(x, shape, name=None):
+    s = _shape_arg(shape)
+    cur = x.shape
+    full = list(s)
+    offset = len(full) - len(cur)
+    for i, c in enumerate(cur):
+        if full[offset + i] == -1:
+            full[offset + i] = c
+    return apply(lambda v: jnp.broadcast_to(v, tuple(full)), x,
+                 _op_name="expand")
+
+
+def expand_as(x, y, name=None):
+    return expand(x, y.shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    vs = jnp.broadcast_arrays(*[t.value for t in inputs])
+    return [Tensor(v) for v in vs]
+
+
+def flip(x, axis, name=None):
+    ax = _norm_axes(axis)
+    return apply(lambda v: jnp.flip(v, axis=ax), x, _op_name="flip")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply(lambda v: jnp.rot90(v, k=k, axes=tuple(axes)), x,
+                 _op_name="rot90")
+
+
+def roll(x, shifts, axis=None, name=None):
+    return apply(lambda v: jnp.roll(v, shifts, axis=axis), x, _op_name="roll")
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply(lambda v, i: jnp.take(v, i, axis=int(axis)), x, index,
+                 _op_name="index_select")
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return apply(lambda v, i: jnp.take_along_axis(v, i, axis=int(axis)),
+                 arr, indices, _op_name="take_along_axis")
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    def f(v, i, u):
+        u = jnp.broadcast_to(u, i.shape).astype(v.dtype)
+        if reduce == "add":
+            return jnp.put_along_axis(v, i, u, axis=int(axis), inplace=False, mode="add") \
+                if hasattr(jnp, "put_along_axis") else _put(v, i, u, "add")
+        return _put(v, i, u, "set")
+    def _put(v, i, u, mode):
+        idx = [jnp.broadcast_to(
+            jnp.arange(v.shape[d]).reshape([-1 if dd == d else 1
+                                            for dd in range(v.ndim)]), i.shape)
+            for d in range(v.ndim)]
+        idx[int(axis)] = i
+        return v.at[tuple(idx)].add(u) if mode == "add" else v.at[tuple(idx)].set(u)
+    return apply(f, arr, indices, values, _op_name="put_along_axis")
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    r = repeats.value if isinstance(repeats, Tensor) else repeats
+    def f(v):
+        if axis is None:
+            v = v.reshape(-1)
+            return jnp.repeat(v, r)
+        return jnp.repeat(v, r, axis=int(axis))
+    return apply(f, x, _op_name="repeat_interleave")
+
+
+def unbind(x, axis=0, name=None):
+    n = x.shape[int(axis)]
+    return [squeeze(s, axis=int(axis)) for s in split(x, n, axis=int(axis))]
+
+
+unstack = unbind
+
+
+def masked_select(x, mask, name=None):
+    # Dynamic output shape: eager-only (XLA requires static shapes under jit).
+    v = np.asarray(x.value)
+    m = np.asarray(mask.value)
+    return Tensor(jnp.asarray(v[np.broadcast_to(m, v.shape)]))
+
+
+def masked_fill(x, mask, value, name=None):
+    val = value.value if isinstance(value, Tensor) else value
+    return apply(lambda v, m: jnp.where(m, jnp.asarray(val, dtype=v.dtype), v),
+                 x, mask, _op_name="masked_fill")
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    v = np.asarray(x.value)
+    res = np.unique(v, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    outs = [Tensor(jnp.asarray(res[0]))]
+    i = 1
+    dt = convert_dtype(dtype)
+    if return_index:
+        outs.append(Tensor(jnp.asarray(res[i].astype(dt)))); i += 1
+    if return_inverse:
+        outs.append(Tensor(jnp.asarray(res[i].astype(dt)))); i += 1
+    if return_counts:
+        outs.append(Tensor(jnp.asarray(res[i].astype(dt)))); i += 1
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    v = np.asarray(x.value).reshape(-1) if axis is None else np.asarray(x.value)
+    keep = np.ones(v.shape[0], dtype=bool)
+    keep[1:] = v[1:] != v[:-1] if v.ndim == 1 else np.any(v[1:] != v[:-1], axis=tuple(range(1, v.ndim)))
+    out = Tensor(jnp.asarray(v[keep]))
+    if not (return_inverse or return_counts):
+        return out
+    outs = [out]
+    grp = np.cumsum(keep) - 1
+    if return_inverse:
+        outs.append(Tensor(jnp.asarray(grp.astype(convert_dtype(dtype)))))
+    if return_counts:
+        outs.append(Tensor(jnp.asarray(np.bincount(grp).astype(convert_dtype(dtype)))))
+    return tuple(outs)
+
+
+def nonzero(x, as_tuple=False):
+    v = np.asarray(x.value)
+    idx = np.nonzero(v)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i.astype(convert_dtype("int64")))) for i in idx)
+    return Tensor(jnp.asarray(np.stack(idx, axis=1).astype(convert_dtype("int64"))))
+
+
+def as_real(x, name=None):
+    return apply(lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1), x,
+                 _op_name="as_real")
+
+
+def as_complex(x, name=None):
+    return apply(lambda v: v[..., 0] + 1j * v[..., 1], x, _op_name="as_complex")
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [Tensor(jnp.atleast_1d(t.value)) for t in inputs]
+    return outs if len(outs) > 1 else outs[0]
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [Tensor(jnp.atleast_2d(t.value)) for t in inputs]
+    return outs if len(outs) > 1 else outs[0]
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [Tensor(jnp.atleast_3d(t.value)) for t in inputs]
+    return outs if len(outs) > 1 else outs[0]
+
+
+def tensordot(x, y, axes=2, name=None):
+    return apply(lambda a, b: jnp.tensordot(a, b, axes=axes), x, y,
+                 _op_name="tensordot")
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda v: jnp.diagonal(v, offset=offset, axis1=axis1,
+                                        axis2=axis2), x, _op_name="diagonal")
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    size = index_num // nshards
+    def f(v):
+        shard = v // size
+        return jnp.where(shard == shard_id, v % size, ignore_value)
+    return apply(f, input, _op_name="shard_index")
+
+
+def unfold(x, axis, size, step, name=None):
+    dim = x.shape[int(axis)]
+    n = (dim - size) // step + 1
+    def f(v):
+        idx = jnp.arange(n)[:, None] * step + jnp.arange(size)[None, :]
+        moved = jnp.moveaxis(v, int(axis), 0)
+        out = moved[idx]  # (n, size, ...)
+        out = jnp.moveaxis(out, 0, int(axis))
+        return jnp.moveaxis(out, 1 if int(axis) != 0 else 1, -1) if False else out
+    # paddle returns windows appended as the last dim
+    def g(v):
+        moved = jnp.moveaxis(v, int(axis), -1)
+        idx = jnp.arange(n)[:, None] * step + jnp.arange(size)[None, :]
+        win = moved[..., idx]                      # (..., n, size)
+        return jnp.moveaxis(win, -2, int(axis))
+    return apply(g, x, _op_name="unfold")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    from ..nn import functional as F
+    return F.pad(x, pad, mode=mode, value=value, data_format=data_format)
+
+
+def tolist(x):
+    return x.tolist()

@@ -1,0 +1,306 @@
+"""Elementwise & reduction math.
+
+Parity: python/paddle/tensor/math.py (dygraph path dispatches to _C_ops.*;
+here every op is a jnp/lax lambda recorded on the autograd tape and compiled
+by XLA — the fusion the reference gets from fusion passes falls out of XLA).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd.tape import apply
+from ..core.tensor import Tensor
+from ..framework.dtype import convert_dtype
+
+__all__ = [
+    "add", "subtract", "multiply", "divide", "floor_divide", "remainder",
+    "mod", "pow", "matmul", "scale", "neg", "abs", "sign", "reciprocal",
+    "square", "sqrt", "rsqrt", "exp", "expm1", "log", "log2", "log10",
+    "log1p", "sin", "cos", "tan", "asin", "acos", "atan", "atan2", "sinh",
+    "cosh", "tanh", "asinh", "acosh", "atanh", "floor", "ceil", "round",
+    "trunc", "frac", "clip", "maximum", "minimum", "fmax", "fmin", "erf",
+    "erfinv", "sum", "nansum", "mean", "nanmean", "prod", "max", "min",
+    "amax", "amin", "cumsum", "cumprod", "cummax", "cummin", "logsumexp",
+    "logcumsumexp", "isnan", "isinf", "isfinite", "add_n", "stanh",
+    "multiply_", "add_", "subtract_", "scale_", "clip_", "lerp", "rad2deg",
+    "deg2rad", "gcd", "lcm", "diff", "angle", "conj", "real", "imag",
+    "digamma", "lgamma", "multigammaln", "neg_", "inner", "outer", "heaviside",
+    "count_nonzero", "logaddexp", "log_normalize", "sgn", "nextafter", "ldexp",
+    "trace",
+]
+
+
+def _raw(x):
+    return x.value if isinstance(x, Tensor) else x
+
+
+def _binop(fn, name):
+    def op(x, y, name=None):
+        return apply(fn, x, y, _op_name=name)
+    op.__name__ = name
+    return op
+
+
+def _unop(fn, name):
+    def op(x, name=None):
+        return apply(fn, x, _op_name=name)
+    op.__name__ = name
+    return op
+
+
+add = _binop(jnp.add, "add")
+subtract = _binop(jnp.subtract, "subtract")
+multiply = _binop(jnp.multiply, "multiply")
+divide = _binop(jnp.true_divide, "divide")
+floor_divide = _binop(jnp.floor_divide, "floor_divide")
+remainder = _binop(jnp.remainder, "remainder")
+mod = remainder
+maximum = _binop(jnp.maximum, "maximum")
+minimum = _binop(jnp.minimum, "minimum")
+fmax = _binop(jnp.fmax, "fmax")
+fmin = _binop(jnp.fmin, "fmin")
+atan2 = _binop(jnp.arctan2, "atan2")
+logaddexp = _binop(jnp.logaddexp, "logaddexp")
+heaviside = _binop(jnp.heaviside, "heaviside")
+nextafter = _binop(jnp.nextafter, "nextafter")
+gcd = _binop(jnp.gcd, "gcd")
+lcm = _binop(jnp.lcm, "lcm")
+
+neg = _unop(jnp.negative, "neg")
+abs = _unop(jnp.abs, "abs")
+sign = _unop(jnp.sign, "sign")
+sgn = sign
+reciprocal = _unop(jnp.reciprocal, "reciprocal")
+square = _unop(jnp.square, "square")
+sqrt = _unop(jnp.sqrt, "sqrt")
+rsqrt = _unop(lambda x: jax.lax.rsqrt(x), "rsqrt")
+exp = _unop(jnp.exp, "exp")
+expm1 = _unop(jnp.expm1, "expm1")
+log = _unop(jnp.log, "log")
+log2 = _unop(jnp.log2, "log2")
+log10 = _unop(jnp.log10, "log10")
+log1p = _unop(jnp.log1p, "log1p")
+sin = _unop(jnp.sin, "sin")
+cos = _unop(jnp.cos, "cos")
+tan = _unop(jnp.tan, "tan")
+asin = _unop(jnp.arcsin, "asin")
+acos = _unop(jnp.arccos, "acos")
+atan = _unop(jnp.arctan, "atan")
+sinh = _unop(jnp.sinh, "sinh")
+cosh = _unop(jnp.cosh, "cosh")
+tanh = _unop(jnp.tanh, "tanh")
+asinh = _unop(jnp.arcsinh, "asinh")
+acosh = _unop(jnp.arccosh, "acosh")
+atanh = _unop(jnp.arctanh, "atanh")
+floor = _unop(jnp.floor, "floor")
+ceil = _unop(jnp.ceil, "ceil")
+round = _unop(jnp.round, "round")
+trunc = _unop(jnp.trunc, "trunc")
+frac = _unop(lambda x: x - jnp.trunc(x), "frac")
+erf = _unop(jax.scipy.special.erf, "erf")
+erfinv = _unop(jax.scipy.special.erfinv, "erfinv")
+isnan = _unop(jnp.isnan, "isnan")
+isinf = _unop(jnp.isinf, "isinf")
+isfinite = _unop(jnp.isfinite, "isfinite")
+digamma = _unop(jax.scipy.special.digamma, "digamma")
+lgamma = _unop(jax.scipy.special.gammaln, "lgamma")
+angle = _unop(jnp.angle, "angle")
+conj = _unop(jnp.conj, "conj")
+real = _unop(jnp.real, "real")
+imag = _unop(jnp.imag, "imag")
+rad2deg = _unop(jnp.rad2deg, "rad2deg")
+deg2rad = _unop(jnp.deg2rad, "deg2rad")
+
+
+def multigammaln(x, p, name=None):
+    return apply(lambda v: jax.scipy.special.multigammaln(v, p), x,
+                 _op_name="multigammaln")
+
+
+def pow(x, y, name=None):
+    return apply(jnp.power, x, y, _op_name="pow")
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return apply(f, x, y, _op_name="matmul")
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    def f(v, s):
+        return v * s + bias if bias_after_scale else (v + bias) * s
+    out = apply(f, x, scale, _op_name="scale")
+    if act is not None:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply(lambda v: scale_b * jnp.tanh(scale_a * v), x, _op_name="stanh")
+
+
+def clip(x, min=None, max=None, name=None):
+    return apply(lambda v: jnp.clip(v, _raw(min), _raw(max)), x, _op_name="clip")
+
+
+def lerp(x, y, weight, name=None):
+    return apply(lambda a, b, w: a + w * (b - a), x, y, weight, _op_name="lerp")
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        a = np.asarray(axis.value)
+        return tuple(int(v) for v in np.atleast_1d(a))
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _reduce(fn, name):
+    def op(x, axis=None, keepdim=False, name=None):
+        return apply(lambda v: fn(v, axis=_axis(axis), keepdims=keepdim), x,
+                     _op_name=name)
+    op.__name__ = name
+    return op
+
+
+sum_ = _reduce(jnp.sum, "sum")
+nansum = _reduce(jnp.nansum, "nansum")
+nanmean = _reduce(jnp.nanmean, "nanmean")
+prod = _reduce(jnp.prod, "prod")
+amax = _reduce(jnp.max, "amax")
+amin = _reduce(jnp.min, "amin")
+max = _reduce(jnp.max, "max")
+min = _reduce(jnp.min, "min")
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    dt = convert_dtype(dtype)
+    return apply(lambda v: jnp.sum(v, axis=_axis(axis), keepdims=keepdim,
+                                   dtype=dt), x, _op_name="sum")
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.mean(v, axis=_axis(axis), keepdims=keepdim), x,
+                 _op_name="mean")
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.count_nonzero(v, axis=_axis(axis),
+                                             keepdims=keepdim), x,
+                 _op_name="count_nonzero")
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    dt = convert_dtype(dtype)
+    def f(v):
+        if axis is None:
+            return jnp.cumsum(v.reshape(-1), dtype=dt)
+        return jnp.cumsum(v, axis=int(axis), dtype=dt)
+    return apply(f, x, _op_name="cumsum")
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    dt = convert_dtype(dtype)
+    def f(v):
+        if dim is None:
+            return jnp.cumprod(v.reshape(-1), dtype=dt)
+        return jnp.cumprod(v, axis=int(dim), dtype=dt)
+    return apply(f, x, _op_name="cumprod")
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    ax = -1 if axis is None else int(axis)
+    v = jax.lax.associative_scan(jnp.maximum, x.value, axis=ax)
+    idx = jnp.argmax(jnp.cumsum((x.value == v).astype(jnp.int32), axis=ax) *
+                     (x.value == v), axis=ax)
+    return apply(lambda t: jax.lax.associative_scan(jnp.maximum, t, axis=ax),
+                 x, _op_name="cummax"), Tensor(idx.astype(convert_dtype(dtype)))
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    ax = -1 if axis is None else int(axis)
+    idx = jnp.argmin(x.value, axis=ax)
+    return apply(lambda t: jax.lax.associative_scan(jnp.minimum, t, axis=ax),
+                 x, _op_name="cummin"), Tensor(idx.astype(convert_dtype(dtype)))
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jax.scipy.special.logsumexp(
+        v, axis=_axis(axis), keepdims=keepdim), x, _op_name="logsumexp")
+
+
+def logcumsumexp(x, axis=None, name=None):
+    ax = -1 if axis is None else int(axis)
+    def f(v):
+        if axis is None:
+            v = v.reshape(-1)
+        return jax.lax.cumlogsumexp(v, axis=ax)
+    return apply(f, x, _op_name="logcumsumexp")
+
+
+def log_normalize(x, axis=-1, name=None):
+    return apply(lambda v: v - jax.scipy.special.logsumexp(
+        v, axis=axis, keepdims=True), x, _op_name="log_normalize")
+
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        return inputs
+    def f(*vs):
+        out = vs[0]
+        for v in vs[1:]:
+            out = out + v
+        return out
+    return apply(f, *inputs, _op_name="add_n")
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    return apply(lambda v: jnp.diff(v, n=n, axis=axis,
+                                    prepend=_raw(prepend) if prepend is not None else None,
+                                    append=_raw(append) if append is not None else None),
+                 x, _op_name="diff")
+
+
+def inner(x, y, name=None):
+    return apply(jnp.inner, x, y, _op_name="inner")
+
+
+def outer(x, y, name=None):
+    return apply(lambda a, b: jnp.outer(a, b), x, y, _op_name="outer")
+
+
+def ldexp(x, y, name=None):
+    return apply(lambda a, b: a * jnp.power(2.0, b).astype(a.dtype), x, y,
+                 _op_name="ldexp")
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda v: jnp.trace(v, offset=offset, axis1=axis1, axis2=axis2),
+                 x, _op_name="trace")
+
+
+# ---- in-place variants (Tensor method parity: add_, scale_, ...) ----
+def _inplace(fn):
+    def op(x, *args, **kwargs):
+        out = fn(x, *args, **kwargs)
+        return x._replace_(out)
+    return op
+
+
+add_ = _inplace(add)
+subtract_ = _inplace(subtract)
+multiply_ = _inplace(multiply)
+scale_ = _inplace(scale)
+clip_ = _inplace(clip)
+neg_ = _inplace(neg)

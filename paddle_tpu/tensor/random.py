@@ -1,0 +1,172 @@
+"""Random sampling ops.
+
+Parity: python/paddle/tensor/random.py. All draw keys from the active
+framework Generator (paddle_tpu/framework/random.py) — trace-safe when the
+jit train-step builder installs a traced key via rng_guard.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..framework.dtype import convert_dtype
+from ..framework.random import next_key
+
+__all__ = [
+    "rand", "randn", "randint", "randint_like", "uniform", "normal",
+    "standard_normal", "randperm", "multinomial", "bernoulli", "poisson",
+    "exponential_", "uniform_", "normal_", "gumbel_softmax", "binomial",
+    "standard_gamma", "cauchy_", "geometric_",
+]
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        import numpy as np
+        return tuple(int(v) for v in np.asarray(shape.value))
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def _dt(dtype, default="float32"):
+    return convert_dtype(dtype) if dtype is not None else convert_dtype(default)
+
+
+def rand(shape, dtype=None, name=None):
+    return Tensor(jax.random.uniform(next_key(), _shape(shape), dtype=_dt(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(next_key(), _shape(shape), dtype=_dt(dtype)))
+
+
+standard_normal = randn
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(next_key(), _shape(shape), int(low),
+                                     int(high), dtype=_dt(dtype, "int64")))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    dt = convert_dtype(dtype) if dtype is not None else x.dtype
+    return Tensor(jax.random.randint(next_key(), tuple(x.shape), int(low),
+                                     int(high)).astype(dt))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.key(seed) if seed else next_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), dtype=_dt(dtype),
+                                     minval=float(min), maxval=float(max)))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean.value if isinstance(mean, Tensor) else mean
+        s = std.value if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return Tensor(m + s * jax.random.normal(next_key(), shp))
+    shp = _shape(shape if shape is not None else (1,))
+    return Tensor(float(mean) + float(std) * jax.random.normal(next_key(), shp))
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(next_key(), int(n)).astype(_dt(dtype, "int64")))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    v = x.value
+    logits = jnp.log(jnp.maximum(v, 1e-30))
+    if replacement:
+        out = jax.random.categorical(next_key(), logits, axis=-1,
+                                     shape=(num_samples,) + v.shape[:-1])
+        out = jnp.moveaxis(out, 0, -1)
+    else:
+        # Gumbel top-k for sampling without replacement.
+        g = jax.random.gumbel(next_key(), v.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(out.astype(_i64()))
+
+
+def bernoulli(x, name=None):
+    return Tensor(jax.random.bernoulli(next_key(), x.value).astype(x.dtype))
+
+
+def poisson(x, name=None):
+    return Tensor(jax.random.poisson(next_key(), x.value).astype(x.dtype))
+
+
+def binomial(count, prob, name=None):
+    c = count.value if isinstance(count, Tensor) else count
+    p = prob.value if isinstance(prob, Tensor) else prob
+    return Tensor(jax.random.binomial(next_key(), c, p).astype(_i64()))
+
+
+def standard_gamma(x, name=None):
+    return Tensor(jax.random.gamma(next_key(), x.value).astype(x.dtype))
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ..autograd.tape import apply
+    g = jax.random.gumbel(next_key(), tuple(x.shape), dtype=x.dtype)
+    def f(v):
+        y = jax.nn.softmax((v + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False) \
+                if hasattr(jnp, "put_along_axis") else \
+                y_hard.at[_oh_idx(y, idx, axis)].set(1.0)
+            y = jax.lax.stop_gradient(y_hard - y) + y
+        return y
+    return apply(f, x, _op_name="gumbel_softmax")
+
+
+def _oh_idx(y, idx, axis):
+    grids = [jnp.broadcast_to(
+        jnp.arange(y.shape[d]).reshape([-1 if dd == d else 1 for dd in range(y.ndim)]),
+        idx.shape) for d in range(y.ndim)]
+    grids[axis] = idx
+    return tuple(grids)
+
+
+# in-place samplers (Tensor method parity)
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    x.value = jax.random.uniform(next_key(), tuple(x.shape), dtype=x.dtype,
+                                 minval=float(min), maxval=float(max))
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x.value = (float(mean) + float(std) *
+               jax.random.normal(next_key(), tuple(x.shape), dtype=x.dtype))
+    return x
+
+
+def exponential_(x, lam=1.0, name=None):
+    x.value = (jax.random.exponential(next_key(), tuple(x.shape),
+                                      dtype=x.dtype) / float(lam))
+    return x
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    x.value = (loc + scale * jax.random.cauchy(next_key(), tuple(x.shape),
+                                               dtype=x.dtype))
+    return x
+
+
+def geometric_(x, probs, name=None):
+    p = probs.value if isinstance(probs, Tensor) else probs
+    u = jax.random.uniform(next_key(), tuple(x.shape), dtype=jnp.float32)
+    x.value = (jnp.ceil(jnp.log1p(-u) / jnp.log1p(-p))).astype(x.dtype)
+    return x
+
+
+def _i64():
+    return convert_dtype("int64")

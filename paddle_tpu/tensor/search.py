@@ -1,0 +1,132 @@
+"""Search/sort ops. Parity: python/paddle/tensor/search.py."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd.tape import apply
+from ..core.tensor import Tensor
+from ..framework.dtype import convert_dtype
+
+__all__ = [
+    "argmax", "argmin", "argsort", "sort", "topk", "where", "index_sample",
+    "searchsorted", "kthvalue", "mode", "bucketize",
+]
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    dt = convert_dtype(dtype)
+    def f(v):
+        out = jnp.argmax(v if axis is not None else v.reshape(-1),
+                         axis=axis if axis is None else int(axis))
+        if keepdim and axis is not None:
+            out = jnp.expand_dims(out, int(axis))
+        return out.astype(dt)
+    return Tensor(f(x.value))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    dt = convert_dtype(dtype)
+    def f(v):
+        out = jnp.argmin(v if axis is not None else v.reshape(-1),
+                         axis=axis if axis is None else int(axis))
+        if keepdim and axis is not None:
+            out = jnp.expand_dims(out, int(axis))
+        return out.astype(dt)
+    return Tensor(f(x.value))
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    v = x.value
+    idx = jnp.argsort(-v if descending else v, axis=int(axis), stable=stable)
+    return Tensor(idx.astype(_i64()))
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def f(v):
+        out = jnp.sort(v, axis=int(axis), stable=stable)
+        return jnp.flip(out, axis=int(axis)) if descending else out
+    return apply(f, x, _op_name="sort")
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    k = int(k.item()) if isinstance(k, Tensor) else int(k)
+    ax = int(axis)
+    def vals(v):
+        v2 = jnp.moveaxis(v, ax, -1)
+        out, _ = jax.lax.top_k(v2 if largest else -v2, k)
+        out = out if largest else -out
+        return jnp.moveaxis(out, -1, ax)
+    def idxs(v):
+        v2 = jnp.moveaxis(v, ax, -1)
+        _, i = jax.lax.top_k(v2 if largest else -v2, k)
+        return jnp.moveaxis(i, -1, ax).astype(_i64())
+    return apply(vals, x, _op_name="topk"), Tensor(idxs(x.value))
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        from .manipulation import nonzero
+        return nonzero(condition, as_tuple=True)
+    def f(c, a, b):
+        return jnp.where(c, a, b)
+    return apply(f, condition, x, y, _op_name="where")
+
+
+def index_sample(x, index, name=None):
+    return apply(lambda v, i: jnp.take_along_axis(v, i, axis=1), x, index,
+                 _op_name="index_sample")
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+    def f(seq, v):
+        if seq.ndim == 1:
+            out = jnp.searchsorted(seq, v, side=side)
+        else:
+            out = jax.vmap(lambda s, q: jnp.searchsorted(s, q, side=side))(
+                seq.reshape(-1, seq.shape[-1]), v.reshape(-1, v.shape[-1]))
+            out = out.reshape(v.shape)
+        return out.astype(jnp.int32 if out_int32 else _i64())
+    return Tensor(f(sorted_sequence.value, values.value))
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    ax = int(axis)
+    def valf(v):
+        s = jnp.sort(v, axis=ax)
+        out = jnp.take(s, k - 1, axis=ax)
+        return jnp.expand_dims(out, ax) if keepdim else out
+    idx = jnp.take(jnp.argsort(x.value, axis=ax), k - 1, axis=ax)
+    if keepdim:
+        idx = jnp.expand_dims(idx, ax)
+    return apply(valf, x, _op_name="kthvalue"), Tensor(idx.astype(_i64()))
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    ax = int(axis)
+    v = np.asarray(x.value)
+    moved = np.moveaxis(v, ax, -1).reshape(-1, v.shape[ax])
+    modes, counts = [], []
+    for row in moved:
+        vals, cnts = np.unique(row, return_counts=True)
+        # ties resolve to the largest value (paddle semantics)
+        best = cnts.max()
+        modes.append(vals[cnts == best].max())
+        counts.append(best)
+    out_shape = list(np.moveaxis(v, ax, -1).shape[:-1])
+    m = np.asarray(modes, dtype=v.dtype).reshape(out_shape)
+    c = np.asarray(counts, dtype=np.int64).reshape(out_shape)
+    if keepdim:
+        m = np.expand_dims(m, ax)
+        c = np.expand_dims(c, ax)
+    return Tensor(jnp.asarray(m)), Tensor(jnp.asarray(c))
+
+
+def _i64():
+    return convert_dtype("int64")

@@ -1,0 +1,88 @@
+"""Statistics ops. Parity: python/paddle/tensor/stat.py."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..autograd.tape import apply
+from ..core.tensor import Tensor
+
+__all__ = ["mean", "std", "var", "median", "nanmedian", "quantile",
+           "nanquantile", "histogram", "histogramdd", "bincount", "numel"]
+
+from .math import mean  # noqa: F401  (canonical home is math)
+from .manipulation import numel  # noqa: F401
+
+
+def _ax(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply(lambda v: jnp.std(v, axis=_ax(axis), ddof=1 if unbiased else 0,
+                                   keepdims=keepdim), x, _op_name="std")
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply(lambda v: jnp.var(v, axis=_ax(axis), ddof=1 if unbiased else 0,
+                                   keepdims=keepdim), x, _op_name="var")
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    def f(v):
+        if mode == "avg":
+            return jnp.median(v, axis=_ax(axis), keepdims=keepdim)
+        # 'min' mode: lower of the two middle values
+        ax = -1 if axis is None else int(axis)
+        v2 = v.reshape(-1) if axis is None else v
+        s = jnp.sort(v2, axis=ax)
+        n = s.shape[ax]
+        out = jnp.take(s, (n - 1) // 2, axis=ax)
+        return jnp.expand_dims(out, ax) if keepdim and axis is not None else out
+    return apply(f, x, _op_name="median")
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    return apply(lambda v: jnp.nanmedian(v, axis=_ax(axis), keepdims=keepdim),
+                 x, _op_name="nanmedian")
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    qv = q.value if isinstance(q, Tensor) else jnp.asarray(q)
+    return apply(lambda v: jnp.quantile(v, qv, axis=_ax(axis), keepdims=keepdim,
+                                        method=interpolation), x,
+                 _op_name="quantile")
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    qv = q.value if isinstance(q, Tensor) else jnp.asarray(q)
+    return apply(lambda v: jnp.nanquantile(v, qv, axis=_ax(axis),
+                                           keepdims=keepdim,
+                                           method=interpolation), x,
+                 _op_name="nanquantile")
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None):
+    v = input.value
+    rng = None if (min == 0 and max == 0) else (float(min), float(max))
+    h, _ = jnp.histogram(v.reshape(-1), bins=int(bins), range=rng,
+                         weights=None if weight is None else weight.value.reshape(-1),
+                         density=density)
+    return Tensor(h if density or weight is not None else h.astype(convert_dtype("int64")))
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    h, edges = jnp.histogramdd(x.value, bins=bins, range=ranges, density=density,
+                               weights=None if weights is None else weights.value)
+    return Tensor(h), [Tensor(e) for e in edges]
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    v = x.value.reshape(-1)
+    w = None if weights is None else weights.value.reshape(-1)
+    n = int(jnp.max(v)) + 1 if v.size else 0
+    out = jnp.bincount(v, weights=w, length=max(int(minlength), n))
+    return Tensor(out)

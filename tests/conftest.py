@@ -1,0 +1,39 @@
+"""Test env bootstrap.
+
+Tests run on 8 virtual CPU devices so sharding/collective tests exercise the
+same XLA code path as real chips without hardware (SURVEY.md §4: the
+reference spawns real processes per card; virtual host devices replace that).
+
+jax is pre-imported at interpreter startup in this image, so setting
+JAX_PLATFORMS/XLA_FLAGS via os.environ in conftest is too late — if the env
+is not already correct, re-run pytest in a child process with the right env
+(after releasing pytest's fd capture so output flows through).
+"""
+import os
+import subprocess
+import sys
+
+_WANT = "--xla_force_host_platform_device_count=8"
+
+
+def _env_ok():
+    return (os.environ.get("_PADDLE_TPU_TEST_REEXEC") == "1"
+            or (os.environ.get("JAX_PLATFORMS") == "cpu"
+                and _WANT in os.environ.get("XLA_FLAGS", "")))
+
+
+def pytest_configure(config):
+    if _env_ok():
+        return
+    env = dict(os.environ)
+    env["_PADDLE_TPU_TEST_REEXEC"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " + _WANT).strip()
+    # Exact fp32 matmuls for numeric checks (prod keeps fast MXU default).
+    env.setdefault("JAX_DEFAULT_MATMUL_PRECISION", "highest")
+    capman = config.pluginmanager.getplugin("capturemanager")
+    if capman is not None:
+        capman.stop_global_capturing()
+    ret = subprocess.call([sys.executable, "-m", "pytest"] + sys.argv[1:],
+                          env=env)
+    os._exit(ret)

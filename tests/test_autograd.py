@@ -1,0 +1,154 @@
+"""Autograd tape tests (parity: eager backward semantics,
+paddle/fluid/eager/backward.cc + test patterns from unittests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_backward_simple():
+    x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_backward_chain_and_accumulate():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    a = x * 2
+    b = a + x          # x used twice -> grads accumulate
+    loss = b.sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 3.0])
+    # second backward accumulates into .grad (paddle semantics)
+    loss2 = (x * x).sum()
+    loss2.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0, 7.0])
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([2.0], stop_gradient=True)
+    loss = (x * y).sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    assert y.grad is None
+
+
+def test_detach():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x * 2
+    z = y.detach() * x
+    z.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])  # only direct path
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 5
+    assert y.stop_gradient
+    assert y._node is None
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x * x
+    (gx,) = paddle.grad(y, x)
+    np.testing.assert_allclose(gx.numpy(), [12.0])
+    assert x.grad is None  # paddle.grad must not pollute .grad
+
+
+def test_grad_unused_input():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    z = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    with pytest.raises(RuntimeError):
+        paddle.grad(y, [z])
+    gx, gz = paddle.grad(y, [x, z], allow_unused=True)
+    assert gz is None
+    np.testing.assert_allclose(gx.numpy(), [2.0])
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3),
+                         stop_gradient=False)
+    parts = paddle.split(x, 3, axis=1)
+    loss = parts[0].sum() * 3 + parts[2].sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(),
+                               [[3, 0, 1], [3, 0, 1]])
+
+
+def test_backward_nonscalar_requires_grad_tensor():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2
+    with pytest.raises(RuntimeError):
+        y.backward()
+    y.backward(paddle.to_tensor([1.0, 0.5]))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 1.0])
+
+
+def test_retain_graph():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0])
+
+
+def test_clear_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).sum().backward()
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_functional_jacobian_hessian():
+    def f(x):
+        return (x * x).sum()
+
+    x = paddle.to_tensor([1.0, 2.0, 3.0])
+    jac = paddle.autograd.jacobian(f, x)
+    np.testing.assert_allclose(jac.numpy(), [2.0, 4.0, 6.0])
+    hes = paddle.autograd.hessian(f, x)
+    np.testing.assert_allclose(hes.numpy(), 2 * np.eye(3), atol=1e-6)
+
+
+def test_vjp_jvp():
+    def f(x):
+        return x * x
+
+    x = paddle.to_tensor([3.0])
+    out, g = paddle.autograd.vjp(f, x)
+    np.testing.assert_allclose(g.numpy(), [6.0])
+    out, tang = paddle.autograd.jvp(f, x)
+    np.testing.assert_allclose(tang.numpy(), [6.0])
+
+
+def test_pylayer():
+    class Double(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, gy):
+            return gy * 2
+
+    x = paddle.to_tensor([1.5], stop_gradient=False)
+    y = Double.apply(x)
+    y.sum().backward()
+    np.testing.assert_allclose(y.numpy(), [3.0])
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_higher_path_through_graph():
+    # diamond dependency
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    a = x * 2
+    b = x * 3
+    loss = (a * b).sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [12.0])

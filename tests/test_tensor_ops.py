@@ -1,0 +1,152 @@
+"""Core tensor op tests, OpTest style (reference op_test.py pattern)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+from op_test import OpTest
+
+rng = np.random.default_rng(0)
+
+
+class TestMatmul(OpTest):
+    def setup_method(self, m):
+        self.op = paddle.matmul
+        self.inputs = {"x": rng.standard_normal((3, 4), dtype=np.float32),
+                       "y": rng.standard_normal((4, 5), dtype=np.float32)}
+        self.ref = lambda x, y: x @ y
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestAddBroadcast(OpTest):
+    def setup_method(self, m):
+        self.op = paddle.add
+        self.inputs = {"x": rng.standard_normal((2, 3, 4), dtype=np.float32),
+                       "y": rng.standard_normal((4,), dtype=np.float32)}
+        self.ref = lambda x, y: x + y
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestSoftmaxLike(OpTest):
+    def setup_method(self, m):
+        self.op = lambda x: paddle.exp(x) / paddle.exp(x).sum(axis=-1, keepdim=True)
+        self.inputs = {"x": rng.standard_normal((5, 7), dtype=np.float32)}
+        self.ref = lambda x: np.exp(x) / np.exp(x).sum(-1, keepdims=True)
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestReduce(OpTest):
+    def setup_method(self, m):
+        self.op = paddle.mean
+        self.attrs = {"axis": 1, "keepdim": True}
+        self.inputs = {"x": rng.standard_normal((3, 5, 2), dtype=np.float32)}
+        self.ref = lambda x, axis, keepdim: np.mean(x, axis=axis, keepdims=keepdim)
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+def test_creation():
+    assert paddle.zeros([2, 3]).shape == [2, 3]
+    assert paddle.ones([4]).numpy().sum() == 4
+    assert paddle.full([2], 7).numpy().tolist() == [7, 7]
+    assert paddle.arange(5).numpy().tolist() == [0, 1, 2, 3, 4]
+    assert paddle.eye(3).numpy().trace() == 3
+    t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert t.shape == [2, 2]
+    assert str(t.dtype) == "float32"
+
+
+def test_manipulation():
+    x = paddle.to_tensor(rng.standard_normal((2, 3, 4), dtype=np.float32))
+    assert paddle.reshape(x, [6, 4]).shape == [6, 4]
+    assert paddle.transpose(x, [2, 0, 1]).shape == [4, 2, 3]
+    assert paddle.concat([x, x], axis=0).shape == [4, 3, 4]
+    assert paddle.stack([x, x], axis=0).shape == [2, 2, 3, 4]
+    parts = paddle.split(x, 3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == [2, 1, 4]
+    assert paddle.squeeze(paddle.unsqueeze(x, 0), 0).shape == x.shape
+    assert paddle.flatten(x, 1, 2).shape == [2, 12]
+    assert x.T.shape == [4, 3, 2]
+
+
+def test_indexing_and_setitem():
+    x = paddle.zeros([4, 4])
+    x[1, 2] = 5.0
+    assert x.numpy()[1, 2] == 5.0
+    y = x[1]
+    assert y.shape == [4]
+    x[0] = paddle.ones([4])
+    assert x.numpy()[0].sum() == 4
+
+
+def test_logic_search():
+    x = paddle.to_tensor([3.0, 1.0, 2.0])
+    assert paddle.argmax(x).item() == 0
+    assert paddle.argsort(x).numpy().tolist() == [1, 2, 0]
+    v, i = paddle.topk(x, 2)
+    assert v.numpy().tolist() == [3.0, 2.0]
+    assert i.numpy().tolist() == [0, 2]
+    assert bool(paddle.allclose(x, x).item())
+    w = paddle.where(x > 1.5, x, paddle.zeros_like(x))
+    assert w.numpy().tolist() == [3.0, 0.0, 2.0]
+
+
+def test_einsum():
+    a = rng.standard_normal((3, 4), dtype=np.float32)
+    b = rng.standard_normal((4, 5), dtype=np.float32)
+    out = paddle.einsum("ij,jk->ik", paddle.to_tensor(a), paddle.to_tensor(b))
+    np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5)
+
+
+def test_linalg():
+    a = rng.standard_normal((4, 4), dtype=np.float32)
+    spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+    t = paddle.to_tensor(spd)
+    c = paddle.linalg.cholesky(t)
+    np.testing.assert_allclose((c @ c.T).numpy(), spd, rtol=1e-4, atol=1e-4)
+    inv = paddle.linalg.inverse(t)
+    np.testing.assert_allclose((t @ inv).numpy(), np.eye(4), atol=1e-4)
+
+
+def test_inplace_ops():
+    x = paddle.ones([3])
+    x.add_(paddle.ones([3]))
+    assert x.numpy().tolist() == [2.0, 2.0, 2.0]
+    x.scale_(2.0)
+    assert x.numpy().tolist() == [4.0, 4.0, 4.0]
+
+
+def test_random_reproducible():
+    paddle.seed(42)
+    a = paddle.rand([3, 3])
+    paddle.seed(42)
+    b = paddle.rand([3, 3])
+    np.testing.assert_array_equal(a.numpy(), b.numpy())
+    assert paddle.randint(0, 10, [20]).numpy().max() < 10
+    p = paddle.randperm(16)
+    assert sorted(p.numpy().tolist()) == list(range(16))
+
+
+def test_dtype_cast():
+    x = paddle.to_tensor([1.5, 2.5])
+    assert str(x.astype("int32").dtype) == "int32"
+    assert str(x.astype(paddle.bfloat16).dtype) == "bfloat16"
