@@ -234,6 +234,11 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
             cts = pending.pop(id(node), None)
             if cts is None:
                 continue
+            if node.vjp_fn is None:
+                raise RuntimeError(
+                    f"Trying to backward through node {node.name!r} a second "
+                    "time: the graph was freed. Pass retain_graph=True to the "
+                    "first backward() to keep it.")
             full_cts = [c if c is not None else _zeros_like_aval(a)
                         for c, a in zip(cts, node.out_avals)]
             ct_arg = tuple(full_cts) if node.n_outputs > 1 else full_cts[0]
@@ -303,6 +308,11 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
                 cts = pending.pop(id(node), None)
                 if cts is None:
                     continue
+                if node.vjp_fn is None:
+                    raise RuntimeError(
+                        f"Trying to differentiate through node {node.name!r} "
+                        "whose graph was freed by a prior backward(); pass "
+                        "retain_graph=True there.")
                 full_cts = [c if c is not None else _zeros_like_aval(a)
                             for c, a in zip(cts, node.out_avals)]
                 ct_arg = tuple(full_cts) if node.n_outputs > 1 else full_cts[0]

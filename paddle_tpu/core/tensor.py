@@ -279,7 +279,7 @@ class Parameter(Tensor):
     Parity: paddle Parameter / EagerParamBase (fluid/framework.py).
     """
     __slots__ = ("trainable", "optimize_attr", "regularizer", "is_distributed",
-                 "sharding_axes")
+                 "sharding_axes", "need_clip")
 
     def __init__(self, value, trainable=True, name=None):
         super().__init__(value, stop_gradient=not trainable, name=name)
@@ -287,6 +287,7 @@ class Parameter(Tensor):
         self.optimize_attr = {"learning_rate": 1.0}
         self.regularizer = None
         self.is_distributed = False
+        self.need_clip = True
         self.persistable = True
         # PartitionSpec-style annotation consumed by the pjit path
         # (role of dist_attr in reference auto_parallel).

@@ -1,0 +1,90 @@
+"""Gradient clipping.
+
+Parity: python/paddle/nn/clip.py (ClipGradByGlobalNorm etc.) incl. the
+hybrid-parallel-aware global norm semantics used by HybridParallelOptimizer
+(reference hybrid_parallel_optimizer.py:181) — under pjit the global norm is
+computed on sharded grads and XLA inserts the cross-device reductions.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["ClipGradByGlobalNorm", "ClipGradByNorm", "ClipGradByValue",
+           "clip_grads_raw"]
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+    def clip_raw(self, grads):
+        """Pure function on a list of raw jax arrays (jit path)."""
+        raise NotImplementedError
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm=1.0, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        # honor ParamAttr(need_clip=False): excluded from the norm AND unclipped
+        subject = [(i, g.value) for i, (p, g) in enumerate(params_grads)
+                   if getattr(p, "need_clip", True)]
+        if not subject:
+            return params_grads
+        clipped = self.clip_raw([g for _, g in subject])
+        out = list(params_grads)
+        for (i, _), c in zip(subject, clipped):
+            out[i] = (params_grads[i][0], Tensor(c))
+        return out
+
+    def clip_raw(self, grads):
+        leaves = jax.tree_util.tree_leaves(grads)
+        gn = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                          for g in leaves))
+        scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(gn, 1e-12))
+        return jax.tree_util.tree_map(
+            lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), grads)
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm=1.0):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            n = jnp.linalg.norm(g.value.reshape(-1))
+            scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(n, 1e-12))
+            out.append((p, Tensor(g.value * scale)))
+        return out
+
+    def clip_raw(self, grads):
+        def clip_one(g):
+            n = jnp.linalg.norm(g.reshape(-1))
+            return g * jnp.minimum(1.0, self.clip_norm / jnp.maximum(n, 1e-12))
+        return jax.tree_util.tree_map(clip_one, grads)
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def __call__(self, params_grads):
+        return [(p, Tensor(jnp.clip(g.value, self.min, self.max)))
+                for p, g in params_grads]
+
+    def clip_raw(self, grads):
+        return jax.tree_util.tree_map(
+            lambda g: jnp.clip(g, self.min, self.max), grads)
+
+
+def clip_grads_raw(grads, clip):
+    if clip is None:
+        return grads
+    return clip.clip_raw(grads)

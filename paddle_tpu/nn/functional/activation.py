@@ -1,0 +1,188 @@
+"""Activation functionals.
+
+Parity: python/paddle/nn/functional/activation.py. Pure jax.nn/jnp maps —
+XLA fuses these into surrounding matmuls (the role of the reference's fused
+ops / fusion passes).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...autograd.tape import apply
+
+__all__ = [
+    "relu", "relu_", "relu6", "gelu", "silu", "swish", "sigmoid", "tanh",
+    "softmax", "log_softmax", "leaky_relu", "elu", "selu", "celu",
+    "hardswish", "hardsigmoid", "hardtanh", "hardshrink", "softshrink",
+    "tanhshrink", "softplus", "softsign", "mish", "glu", "prelu", "rrelu",
+    "thresholded_relu", "log_sigmoid", "maxout", "swiglu",
+]
+
+
+def relu(x, name=None):
+    return apply(jax.nn.relu, x, _op_name="relu")
+
+
+def relu_(x, name=None):
+    return x._replace_(relu(x))
+
+
+def relu6(x, name=None):
+    return apply(jax.nn.relu6, x, _op_name="relu6")
+
+
+def gelu(x, approximate=False, name=None):
+    return apply(lambda v: jax.nn.gelu(v, approximate=approximate), x,
+                 _op_name="gelu")
+
+
+def silu(x, name=None):
+    return apply(jax.nn.silu, x, _op_name="silu")
+
+
+def swish(x, name=None):
+    return silu(x)
+
+
+def sigmoid(x, name=None):
+    return apply(jax.nn.sigmoid, x, _op_name="sigmoid")
+
+
+def tanh(x, name=None):
+    return apply(jnp.tanh, x, _op_name="tanh")
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    def f(v):
+        if dtype is not None:
+            from ...framework.dtype import convert_dtype
+            v = v.astype(convert_dtype(dtype))
+        return jax.nn.softmax(v, axis=axis)
+    return apply(f, x, _op_name="softmax")
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    def f(v):
+        if dtype is not None:
+            from ...framework.dtype import convert_dtype
+            v = v.astype(convert_dtype(dtype))
+        return jax.nn.log_softmax(v, axis=axis)
+    return apply(f, x, _op_name="log_softmax")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply(lambda v: jax.nn.leaky_relu(v, negative_slope=negative_slope),
+                 x, _op_name="leaky_relu")
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply(lambda v: jax.nn.elu(v, alpha=alpha), x, _op_name="elu")
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    # clip the untaken branch input so its (discarded) gradient can't overflow
+    # to inf and poison the vjp (0*inf=nan — the where-grad trap).
+    return apply(lambda v: scale * jnp.where(
+        v > 0, v, alpha * jnp.expm1(jnp.minimum(v, 0.0))), x, _op_name="selu")
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply(lambda v: jax.nn.celu(v, alpha=alpha), x, _op_name="celu")
+
+
+def hardswish(x, name=None):
+    return apply(jax.nn.hard_swish, x, _op_name="hardswish")
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply(lambda v: jnp.clip(slope * v + offset, 0.0, 1.0), x,
+                 _op_name="hardsigmoid")
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply(lambda v: jnp.clip(v, min, max), x, _op_name="hardtanh")
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply(lambda v: jnp.where(jnp.abs(v) > threshold, v, 0.0), x,
+                 _op_name="hardshrink")
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply(lambda v: jnp.where(v > threshold, v - threshold,
+                                     jnp.where(v < -threshold, v + threshold,
+                                               0.0)), x, _op_name="softshrink")
+
+
+def tanhshrink(x, name=None):
+    return apply(lambda v: v - jnp.tanh(v), x, _op_name="tanhshrink")
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    # clamp the exp argument in the untaken branch (where-grad trap)
+    return apply(lambda v: jnp.where(
+        beta * v > threshold, v,
+        jnp.log1p(jnp.exp(jnp.minimum(beta * v, threshold))) / beta), x,
+        _op_name="softplus")
+
+
+def softsign(x, name=None):
+    return apply(jax.nn.soft_sign, x, _op_name="softsign")
+
+
+def mish(x, name=None):
+    return apply(lambda v: v * jnp.tanh(jax.nn.softplus(v)), x,
+                 _op_name="mish")
+
+
+def log_sigmoid(x, name=None):
+    return apply(jax.nn.log_sigmoid, x, _op_name="log_sigmoid")
+
+
+def glu(x, axis=-1, name=None):
+    return apply(lambda v: jax.nn.glu(v, axis=axis), x, _op_name="glu")
+
+
+def swiglu(x, y=None, name=None):
+    if y is None:
+        def f(v):
+            a, b = jnp.split(v, 2, axis=-1)
+            return jax.nn.silu(a) * b
+        return apply(f, x, _op_name="swiglu")
+    return apply(lambda a, b: jax.nn.silu(a) * b, x, y, _op_name="swiglu")
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def f(v, w):
+        if w.size == 1:
+            return jnp.where(v >= 0, v, w.reshape(()) * v)
+        shape = [1] * v.ndim
+        ch_axis = 1 if data_format == "NCHW" else v.ndim - 1
+        shape[ch_axis] = w.size
+        return jnp.where(v >= 0, v, w.reshape(shape) * v)
+    return apply(f, x, weight, _op_name="prelu")
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False, name=None):
+    if training:
+        import jax.random as jr
+        from ...framework.random import next_key
+        a = jr.uniform(next_key(), tuple(x.shape), minval=lower, maxval=upper)
+        return apply(lambda v: jnp.where(v >= 0, v, a * v), x, _op_name="rrelu")
+    mid = (lower + upper) / 2.0
+    return apply(lambda v: jnp.where(v >= 0, v, mid * v), x, _op_name="rrelu")
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply(lambda v: jnp.where(v > threshold, v, value), x,
+                 _op_name="thresholded_relu")
+
+
+def maxout(x, groups, axis=1, name=None):
+    def f(v):
+        ax = axis % v.ndim
+        c = v.shape[ax]
+        shp = v.shape[:ax] + (c // groups, groups) + v.shape[ax + 1:]
+        return jnp.max(v.reshape(shp), axis=ax + 1)
+    return apply(f, x, _op_name="maxout")

@@ -1,0 +1,280 @@
+"""Common functionals: linear, dropout, embedding, pad, one_hot, interpolate.
+
+Parity: python/paddle/nn/functional/common.py + input.py.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...autograd.tape import apply
+from ...core.tensor import Tensor
+from ...framework.dtype import convert_dtype
+from ...framework.random import next_key
+
+__all__ = [
+    "linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
+    "embedding", "one_hot", "pad", "interpolate", "upsample",
+    "cosine_similarity", "pixel_shuffle", "pixel_unshuffle", "channel_shuffle",
+    "label_smooth", "unfold", "fold", "bilinear", "normalize",
+]
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W (+ b). Weight layout (in, out) — paddle convention
+    (python/paddle/nn/functional/common.py linear)."""
+    if bias is None:
+        return apply(lambda v, w: jnp.matmul(v, w), x, weight,
+                     _op_name="linear")
+    return apply(lambda v, w, b: jnp.matmul(v, w) + b, x, weight, bias,
+                 _op_name="linear")
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if not training or p == 0.0:
+        return x.clone() if isinstance(x, Tensor) else x
+    key = next_key()
+    def f(v):
+        if axis is None:
+            shape = v.shape
+        else:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            shape = tuple(v.shape[i] if i in [a % v.ndim for a in axes] else 1
+                          for i in range(v.ndim))
+        keep = jax.random.bernoulli(key, 1.0 - p, shape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, v / (1.0 - p), jnp.zeros_like(v))
+        return jnp.where(keep, v, jnp.zeros_like(v))
+    return apply(f, x, _op_name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ch = 1 if data_format == "NCHW" else 3
+    return dropout(x, p=p, axis=[0, ch], training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    ch = 1 if data_format == "NCDHW" else 4
+    return dropout(x, p=p, axis=[0, ch], training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x.clone()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    a = (1.0 / ((1 - p) * (1 + p * alpha_p ** 2))) ** 0.5
+    b = -a * alpha_p * p
+    key = next_key()
+    def f(v):
+        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+        return a * jnp.where(keep, v, alpha_p) + b
+    return apply(f, x, _op_name="alpha_dropout")
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    def f(w, idx):
+        out = jnp.take(w, idx, axis=0)
+        if padding_idx is not None:
+            mask = (idx == padding_idx)[..., None]
+            out = jnp.where(mask, jnp.zeros_like(out), out)
+        return out
+    return apply(f, weight, x.value if isinstance(x, Tensor) else x,
+                 _op_name="embedding")
+
+
+def one_hot(x, num_classes, name=None):
+    idx = x.value if isinstance(x, Tensor) else x
+    return Tensor(jax.nn.one_hot(idx, num_classes, dtype=jnp.float32))
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    if isinstance(pad, Tensor):
+        import numpy as np
+        pad = [int(v) for v in np.asarray(pad.value)]
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        # full-rank paddle layout: per-dim (before, after), low dims first
+        cfg = [(int(pad[2 * i]), int(pad[2 * i + 1])) for i in range(nd)]
+    else:
+        # partial spec applies to trailing spatial dims (paddle NCHW/NCL/NCDHW)
+        n_spatial = len(pad) // 2
+        cfg = [(0, 0)] * nd
+        if data_format.endswith("C"):  # NLC/NHWC/NDHWC: spatial before channel
+            spatial_axes = list(range(1, 1 + n_spatial))
+        else:
+            spatial_axes = list(range(nd - n_spatial, nd))
+        # paddle pad order: last-dim pairs first for partial specs
+        for j, ax in enumerate(reversed(spatial_axes)):
+            cfg[ax] = (int(pad[2 * j]), int(pad[2 * j + 1]))
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+    def f(v):
+        if jmode == "constant":
+            return jnp.pad(v, cfg, mode="constant", constant_values=value)
+        return jnp.pad(v, cfg, mode=jmode)
+    return apply(f, x, _op_name="pad")
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    v = x.value
+    cf = data_format.upper().startswith("NC")
+    spatial = v.shape[2:] if cf else v.shape[1:-1]
+    if size is not None:
+        if isinstance(size, Tensor):
+            import numpy as np
+            size = [int(s) for s in np.asarray(size.value)]
+        out_sp = tuple(int(s) for s in (size if isinstance(size, (list, tuple))
+                                        else [size]))
+    else:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+            else [scale_factor] * len(spatial)
+        out_sp = tuple(int(round(s * f)) for s, f in zip(spatial, sf))
+    jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+             "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+    def f(vv):
+        if cf:
+            out_shape = vv.shape[:2] + out_sp
+        else:
+            out_shape = (vv.shape[0],) + out_sp + (vv.shape[-1],)
+        return jax.image.resize(vv, out_shape, method=jmode)
+    return apply(f, x, _op_name="interpolate")
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def f(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+    return apply(f, x1, x2, _op_name="cosine_similarity")
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def f(v):
+        n = jnp.linalg.norm(v, ord=p, axis=axis, keepdims=True)
+        return v / jnp.maximum(n, epsilon)
+    return apply(f, x, _op_name="normalize")
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = int(upscale_factor)
+    def f(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c // (r * r), r, r, h, w)
+            v = v.transpose(0, 1, 4, 2, 5, 3)
+            return v.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h, w, r, r, c // (r * r))
+        v = v.transpose(0, 1, 3, 2, 4, 5)
+        return v.reshape(n, h * r, w * r, c // (r * r))
+    return apply(f, x, _op_name="pixel_shuffle")
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = int(downscale_factor)
+    def f(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c, h // r, r, w // r, r)
+            v = v.transpose(0, 1, 3, 5, 2, 4)
+            return v.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h // r, r, w // r, r, c)
+        v = v.transpose(0, 1, 3, 2, 4, 5)
+        return v.reshape(n, h // r, w // r, c * r * r)
+    return apply(f, x, _op_name="pixel_unshuffle")
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    g = int(groups)
+    def f(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            return v.reshape(n, g, c // g, h, w).transpose(0, 2, 1, 3, 4) \
+                .reshape(n, c, h, w)
+        n, h, w, c = v.shape
+        return v.reshape(n, h, w, g, c // g).transpose(0, 1, 2, 4, 3) \
+            .reshape(n, h, w, c)
+    return apply(f, x, _op_name="channel_shuffle")
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def f(l):
+        k = l.shape[-1]
+        if prior_dist is not None:
+            pd = prior_dist.value if isinstance(prior_dist, Tensor) else prior_dist
+            return (1 - epsilon) * l + epsilon * pd
+        return (1 - epsilon) * l + epsilon / k
+    return apply(f, label, _op_name="label_smooth")
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (paddle F.unfold): NCHW -> (N, C*kh*kw, L)."""
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    ph, pw = _pair(paddings)
+    dh, dw = _pair(dilations)
+    def f(v):
+        n, c, h, w = v.shape
+        v = jnp.pad(v, [(0, 0), (0, 0), (ph, ph), (pw, pw)])
+        oh = (h + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+        ow = (w + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+        patches = jax.lax.conv_general_dilated_patches(
+            v, (kh, kw), (sh, sw), "VALID", rhs_dilation=(dh, dw),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        return patches.reshape(n, c * kh * kw, oh * ow)
+    return apply(f, x, _op_name="unfold")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    """col2im inverse of unfold."""
+    oh, ow = _pair(output_sizes)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    ph, pw = _pair(paddings)
+    dh, dw = _pair(dilations)
+    def f(v):
+        n, ckk, l = v.shape
+        c = ckk // (kh * kw)
+        hh = (oh + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+        ww = (ow + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+        v = v.reshape(n, c, kh, kw, hh, ww)
+        out = jnp.zeros((n, c, oh + 2 * ph, ow + 2 * pw), dtype=v.dtype)
+        for i in range(kh):
+            for j in range(kw):
+                hi = i * dh
+                wj = j * dw
+                out = out.at[:, :, hi:hi + hh * sh:sh, wj:wj + ww * sw:sw].add(
+                    v[:, :, i, j])
+        return out[:, :, ph:ph + oh, pw:pw + ow]
+    return apply(f, x, _op_name="fold")
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def f(a, b, w, *bias_arg):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bias_arg:
+            out = out + bias_arg[0]
+        return out
+    if bias is None:
+        return apply(f, x1, x2, weight, _op_name="bilinear")
+    return apply(f, x1, x2, weight, bias, _op_name="bilinear")
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return int(v[0]), int(v[1])
+    return int(v), int(v)

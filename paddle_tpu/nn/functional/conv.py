@@ -1,0 +1,164 @@
+"""Convolutions via lax.conv_general_dilated (XLA lowers to MXU).
+
+Parity: python/paddle/nn/functional/conv.py — NCHW default layout, paddle
+weight layout (out_c, in_c/groups, *k). The reference dispatches to cuDNN
+with autotuned algos (phi/kernels/autotune); XLA's conv emitter + autotuner
+subsumes that.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...autograd.tape import apply
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose",
+           "conv2d_transpose", "conv3d_transpose"]
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == n:
+            return tuple(int(x) for x in v)
+        return tuple(int(v[0]) for _ in range(n))
+    return tuple(int(v) for _ in range(n))
+
+
+def _padding(padding, n, stride=None, dilation=None, ksize=None):
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, (list, tuple)) and len(padding) == n and \
+            isinstance(padding[0], (list, tuple)):
+        return [tuple(p) for p in padding]
+    if isinstance(padding, (list, tuple)) and len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1]))
+                for i in range(n)]
+    p = _tuple(padding, n)
+    return [(pi, pi) for pi in p]
+
+
+def _dn(n, channel_last):
+    if n == 1:
+        return ("NWC", "OIW", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if n == 2:
+        return (("NHWC", "OIHW", "NHWC") if channel_last
+                else ("NCHW", "OIHW", "NCHW"))
+    return (("NDHWC", "OIDHW", "NDHWC") if channel_last
+            else ("NCDHW", "OIDHW", "NCDHW"))
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n, data_format):
+    channel_last = data_format.endswith("C") and data_format != "NCHW"
+    s = _tuple(stride, n)
+    d = _tuple(dilation, n)
+    pad = _padding(padding, n)
+    dn = _dn(n, channel_last)
+
+    def f(v, w, *b):
+        out = jax.lax.conv_general_dilated(
+            v, w, window_strides=s, padding=pad, rhs_dilation=d,
+            dimension_numbers=dn, feature_group_count=groups)
+        if b:
+            shape = [1] * out.ndim
+            shape[-1 if channel_last else 1] = -1
+            out = out + b[0].reshape(shape)
+        return out
+
+    if bias is None:
+        return apply(f, x, weight, _op_name=f"conv{n}d")
+    return apply(f, x, weight, bias, _op_name=f"conv{n}d")
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1,
+                 "NLC" if data_format == "NLC" else "NCW")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
+                 data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3,
+                 data_format)
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, groups,
+                    dilation, n, data_format, output_size):
+    channel_last = data_format.endswith("C") and data_format != "NCHW"
+    s = _tuple(stride, n)
+    d = _tuple(dilation, n)
+    op = _tuple(output_padding, n)
+    dn = _dn(n, channel_last)
+    if isinstance(padding, str):
+        raise NotImplementedError("string padding for conv_transpose")
+    p = _padding(padding, n)
+
+    def f(v, w, *b):
+        # paddle transpose-conv weight layout: (in_c, out_c/groups, *k)
+        k = w.shape[2:]
+        # transposed conv = lhs-dilated conv with flipped kernel.
+        opi = list(op)
+        if output_size is not None:
+            tgt = output_size if isinstance(output_size, (list, tuple)) \
+                else [output_size] * n
+            in_sp = (v.shape[1:1 + n] if channel_last else v.shape[2:2 + n])
+            for i in range(n):
+                base = ((in_sp[i] - 1) * s[i] - p[i][0] - p[i][1]
+                        + d[i] * (k[i] - 1) + 1)
+                extra = int(tgt[i]) - base
+                if not (0 <= extra < s[i] + max(0, d[i] * (k[i] - 1) - 1) + 1):
+                    raise ValueError(
+                        f"output_size[{i}]={tgt[i]} unreachable: base output "
+                        f"{base}, stride {s[i]}")
+                opi[i] = extra
+        pad_t = [(d[i] * (k[i] - 1) - p[i][0],
+                  d[i] * (k[i] - 1) - p[i][1] + opi[i]) for i in range(n)]
+        w_flip = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+        if groups > 1:
+            ic = w.shape[0]
+            w_flip = w_flip.reshape((groups, ic // groups) + w.shape[1:])
+            w_flip = jnp.swapaxes(w_flip, 1, 2)
+            w_flip = w_flip.reshape((w.shape[1] * groups, ic // groups) + k)
+        else:
+            w_flip = jnp.swapaxes(w_flip, 0, 1)
+        out = jax.lax.conv_general_dilated(
+            v, w_flip, window_strides=(1,) * n, padding=pad_t,
+            lhs_dilation=s, rhs_dilation=d, dimension_numbers=dn,
+            feature_group_count=groups)
+        if b:
+            shape = [1] * out.ndim
+            shape[-1 if channel_last else 1] = -1
+            out = out + b[0].reshape(shape)
+        return out
+
+    if bias is None:
+        return apply(f, x, weight, _op_name=f"conv{n}d_transpose")
+    return apply(f, x, weight, bias, _op_name=f"conv{n}d_transpose")
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           groups, dilation, 1,
+                           "NLC" if data_format == "NLC" else "NCW",
+                           output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           groups, dilation, 2, data_format, output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           groups, dilation, 3, data_format, output_size)

@@ -1,0 +1,162 @@
+"""Attention functionals.
+
+Parity: python/paddle/nn/functional/flash_attention.py:20,121 (FlashAttention2
+integration) + scaled_dot_product_attention. TPU-first: on TPU the fused path
+is the Pallas flash-attention kernel (jax.experimental.pallas.ops.tpu) —
+the TPU analog of the reference's dlopened flashattn library
+(paddle/phi/backends/dynload/flashattn.h); elsewhere it falls back to XLA's
+fused attention (jax.nn.dot_product_attention).
+
+Layout note: paddle flash_attention uses (batch, seqlen, nheads, head_dim).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...autograd.tape import apply
+from ...core.tensor import Tensor
+
+__all__ = ["flash_attention", "scaled_dot_product_attention",
+           "flash_attn_unpadded", "sdp_kernel"]
+
+
+def _on_tpu():
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def _pallas_flash(q, k, v, causal, scale):
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        flash_attention as pallas_flash)
+    # pallas kernel expects (b, h, s, d)
+    qh = jnp.swapaxes(q, 1, 2)
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    out = pallas_flash(qh, kh, vh, causal=causal, sm_scale=scale)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def _xla_attention(q, k, v, bias, mask, causal, scale, dropout=0.0,
+                   dropout_key=None):
+    # q,k,v: (b, s, h, d) — jax.nn.dot_product_attention's native layout.
+    if dropout > 0.0 and dropout_key is not None:
+        # explicit attention (XLA fuses it) so probs can be dropped
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        if bias is not None:
+            logits = logits + bias
+        if mask is not None:
+            logits = jnp.where(mask, logits, jnp.asarray(-1e30, logits.dtype))
+        if causal:
+            s_q, s_k = q.shape[1], k.shape[1]
+            cm = jnp.tril(jnp.ones((s_q, s_k), dtype=bool), s_k - s_q)
+            logits = jnp.where(cm, logits, jnp.asarray(-1e30, logits.dtype))
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout), 0.0)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype), v)
+    return jax.nn.dot_product_attention(
+        q, k, v, bias=bias,
+        mask=mask, is_causal=causal, scale=scale)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, rng_name="",
+                    training=True, name=None):
+    """q/k/v: (batch, seq, heads, head_dim). Returns (out, softmax_lse-like
+    placeholder) matching paddle's (result, softmax) tuple shape."""
+    d = query.shape[-1]
+    scale = 1.0 / (d ** 0.5)
+    drop = dropout if training else 0.0
+    dkey = None
+    if drop > 0.0:
+        from ...framework.random import next_key
+        dkey = next_key()
+
+    def f(q, k, v):
+        use_pallas = (_on_tpu() and q.shape[1] >= 128 and d % 128 == 0
+                      and drop == 0.0)
+        if use_pallas:
+            try:
+                return _pallas_flash(q, k, v, causal, scale)
+            except Exception:
+                pass
+        return _xla_attention(q, k, v, None, None, causal, scale, drop, dkey)
+
+    out = apply(f, query, key, value, _op_name="flash_attention")
+    if return_softmax:
+        return out, None
+    return out, None
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
+                        causal=False, return_softmax=False, name=None):
+    """Varlen API parity — implemented by segment-mask attention."""
+    def f(q, k, v, cq, ck):
+        # q: (total_q, h, d) ragged; build batch via segment ids
+        seg_q = jnp.cumsum(
+            jnp.zeros(q.shape[0], jnp.int32).at[cq[1:-1]].add(1))
+        seg_k = jnp.cumsum(
+            jnp.zeros(k.shape[0], jnp.int32).at[ck[1:-1]].add(1))
+        logits = jnp.einsum("qhd,khd->hqk", q, k) * scale
+        mask = seg_q[:, None] == seg_k[None, :]
+        if causal:
+            pos_q = jnp.arange(q.shape[0]) - jnp.take(cq, seg_q)
+            pos_k = jnp.arange(k.shape[0]) - jnp.take(ck, seg_k)
+            mask = mask & (pos_q[:, None] >= pos_k[None, :])
+        logits = jnp.where(mask[None], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1)
+        return jnp.einsum("hqk,khd->qhd", probs, v)
+    out = apply(f, query, key, value, cu_seqlens_q, cu_seqlens_k,
+                _op_name="flash_attn_unpadded")
+    return out, None
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """Parity: paddle scaled_dot_product_attention ((b, s, h, d) layout)."""
+    d = query.shape[-1]
+    scale = 1.0 / (d ** 0.5)
+    drop = dropout_p if training else 0.0
+    dkey = None
+    if drop > 0.0:
+        from ...framework.random import next_key
+        dkey = next_key()
+
+    if attn_mask is None:
+        def f(q, k, v):
+            use_pallas = (_on_tpu() and q.shape[1] >= 128 and d % 128 == 0
+                          and drop == 0.0)
+            if use_pallas:
+                try:
+                    return _pallas_flash(q, k, v, is_causal, scale)
+                except Exception:
+                    pass
+            return _xla_attention(q, k, v, None, None, is_causal, scale,
+                                  drop, dkey)
+        return apply(f, query, key, value, _op_name="sdpa")
+
+    def fm(q, k, v, m):
+        if m.dtype == jnp.bool_:
+            return _xla_attention(q, k, v, None, m, is_causal, scale,
+                                  drop, dkey)
+        return _xla_attention(q, k, v, m, None, is_causal, scale, drop, dkey)
+    return apply(fm, query, key, value, attn_mask, _op_name="sdpa")
+
+
+class sdp_kernel:
+    """Context manager parity for kernel selection hints (no-op: XLA/Pallas
+    dispatch is automatic)."""
+
+    def __init__(self, **kwargs):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False

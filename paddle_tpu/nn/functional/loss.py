@@ -1,0 +1,304 @@
+"""Loss functionals.
+
+Parity: python/paddle/nn/functional/loss.py (+ softmax_with_cross_entropy —
+the TP-sharded variant lives in ..distributed.parallel_cross_entropy,
+matching reference c_softmax_with_cross_entropy_op).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...autograd.tape import apply
+from ...core.tensor import Tensor
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "mse_loss", "l1_loss", "nll_loss",
+    "kl_div", "smooth_l1_loss", "margin_ranking_loss", "hinge_embedding_loss",
+    "cosine_embedding_loss", "triplet_margin_loss", "log_loss", "square_error_cost",
+    "sigmoid_focal_loss", "ctc_loss", "poisson_nll_loss", "multi_label_soft_margin_loss",
+    "soft_margin_loss", "gaussian_nll_loss",
+]
+
+
+def _reduce(v, reduction):
+    if reduction == "mean":
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    return v
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    """Parity: paddle.nn.functional.cross_entropy. Computes in fp32 for
+    stability regardless of input dtype (bf16-safe)."""
+    lbl = label.value if isinstance(label, Tensor) else jnp.asarray(label)
+
+    def f(logits, *w):
+        lg = logits.astype(jnp.float32)
+        logp = jax.nn.log_softmax(lg, axis=axis) if use_softmax else jnp.log(
+            jnp.maximum(lg, 1e-30))
+        if soft_label or (lbl.ndim == logp.ndim and lbl.shape == logp.shape
+                          and jnp.issubdtype(lbl.dtype, jnp.floating)):
+            tgt = lbl.astype(jnp.float32)
+            if label_smoothing > 0:
+                k = logp.shape[axis]
+                tgt = (1 - label_smoothing) * tgt + label_smoothing / k
+            per = -jnp.sum(tgt * logp, axis=axis)
+        else:
+            idx = lbl
+            if idx.ndim == logp.ndim:
+                idx = jnp.squeeze(idx, axis=axis)
+            idx_c = jnp.clip(idx, 0, logp.shape[axis] - 1)
+            per = -jnp.take_along_axis(
+                logp, idx_c[..., None].astype(jnp.int32), axis=axis)[..., 0]
+            if label_smoothing > 0:
+                k = logp.shape[axis]
+                smooth = -jnp.mean(logp, axis=axis)
+                per = (1 - label_smoothing) * per + label_smoothing * smooth
+            mask = (idx != ignore_index)
+            per = jnp.where(mask, per, 0.0)
+            if w:
+                wt = jnp.take(w[0], idx_c, axis=0)
+                per = per * wt
+            if reduction == "mean":
+                denom = (jnp.maximum(jnp.sum(jnp.take(w[0], idx_c, axis=0)
+                                             * mask), 1e-12)
+                         if w else jnp.maximum(jnp.sum(mask), 1))
+                return jnp.sum(per) / denom
+        return _reduce(per, reduction)
+
+    args = [input] + ([weight] if weight is not None else [])
+    return apply(f, *args, _op_name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none",
+                         axis=axis)
+    from .activation import softmax as softmax_fn
+    from ...tensor.manipulation import unsqueeze
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        return loss, softmax_fn(logits, axis=axis)
+    return loss
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    def f(p, t, *w):
+        per = -(t * jnp.log(jnp.maximum(p, 1e-12))
+                + (1 - t) * jnp.log(jnp.maximum(1 - p, 1e-12)))
+        if w:
+            per = per * w[0]
+        return _reduce(per, reduction)
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply(f, *args, _op_name="binary_cross_entropy")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    def f(lg, t, *rest):
+        lg32 = lg.astype(jnp.float32)
+        t32 = t.astype(jnp.float32)
+        maxv = jnp.maximum(-lg32, 0.0)
+        per = (1 - t32) * lg32 + maxv + jnp.log(
+            jnp.exp(-maxv) + jnp.exp(-lg32 - maxv))
+        i = 0
+        if pos_weight is not None:
+            pw = rest[i]; i += 1
+            log_w = (pw - 1) * t32 + 1
+            per = per * log_w
+        if weight is not None:
+            per = per * rest[i]
+        return _reduce(per, reduction)
+    args = [logit, label]
+    if pos_weight is not None:
+        args.append(pos_weight)
+    if weight is not None:
+        args.append(weight)
+    return apply(f, *args, _op_name="bce_with_logits")
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply(lambda a, b: _reduce(jnp.square(a - b), reduction),
+                 input, label, _op_name="mse_loss")
+
+
+def square_error_cost(input, label):
+    return apply(lambda a, b: jnp.square(a - b), input, label,
+                 _op_name="square_error_cost")
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply(lambda a, b: _reduce(jnp.abs(a - b), reduction),
+                 input, label, _op_name="l1_loss")
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    lbl = label.value if isinstance(label, Tensor) else jnp.asarray(label)
+
+    def f(logp, *w):
+        idx_c = jnp.clip(lbl, 0, logp.shape[1] - 1).astype(jnp.int32)
+        per = -jnp.take_along_axis(logp, idx_c[:, None], axis=1)[:, 0]
+        mask = lbl != ignore_index
+        per = jnp.where(mask, per, 0.0)
+        if w:
+            wt = jnp.take(w[0], idx_c, axis=0) * mask
+            if reduction == "mean":
+                return jnp.sum(per * jnp.take(w[0], idx_c, axis=0)) / \
+                    jnp.maximum(jnp.sum(wt), 1e-12)
+            per = per * jnp.take(w[0], idx_c, axis=0)
+        elif reduction == "mean":
+            return jnp.sum(per) / jnp.maximum(jnp.sum(mask), 1)
+        return _reduce(per, reduction)
+
+    args = [input] + ([weight] if weight is not None else [])
+    return apply(f, *args, _op_name="nll_loss")
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def f(lp, t):
+        if log_target:
+            per = jnp.exp(t) * (t - lp)
+        else:
+            per = t * (jnp.log(jnp.maximum(t, 1e-12)) - lp)
+        if reduction == "batchmean":
+            return jnp.sum(per) / lp.shape[0]
+        return _reduce(per, reduction)
+    return apply(f, input, label, _op_name="kl_div")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        per = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        return _reduce(per, reduction)
+    return apply(f, input, label, _op_name="smooth_l1_loss")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    return apply(lambda a, b, t: _reduce(
+        jnp.maximum(-t * (a - b) + margin, 0.0), reduction),
+        input, other, label, _op_name="margin_ranking_loss")
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    return apply(lambda a, t: _reduce(
+        jnp.where(t == 1, a, jnp.maximum(margin - a, 0.0)), reduction),
+        input, label, _op_name="hinge_embedding_loss")
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean",
+                          name=None):
+    def f(a, b, t):
+        cos = jnp.sum(a * b, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        per = jnp.where(t == 1, 1 - cos, jnp.maximum(cos - margin, 0.0))
+        return _reduce(per, reduction)
+    return apply(f, input1, input2, label, _op_name="cosine_embedding_loss")
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def f(a, pos, neg):
+        dp = jnp.linalg.norm(a - pos + epsilon, ord=p, axis=-1)
+        dn = jnp.linalg.norm(a - neg + epsilon, ord=p, axis=-1)
+        if swap:
+            dn2 = jnp.linalg.norm(pos - neg + epsilon, ord=p, axis=-1)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+    return apply(f, input, positive, negative, _op_name="triplet_margin_loss")
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return apply(lambda p, t: -t * jnp.log(p + epsilon)
+                 - (1 - t) * jnp.log(1 - p + epsilon),
+                 input, label, _op_name="log_loss")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def f(lg, t, *nrm):
+        p = jax.nn.sigmoid(lg)
+        ce = jnp.maximum(lg, 0) - lg * t + jnp.log1p(jnp.exp(-jnp.abs(lg)))
+        p_t = p * t + (1 - p) * (1 - t)
+        a_t = alpha * t + (1 - alpha) * (1 - t)
+        per = a_t * jnp.power(1 - p_t, gamma) * ce
+        if nrm:
+            per = per / nrm[0]
+        return _reduce(per, reduction)
+    args = [logit, label] + ([normalizer] if normalizer is not None else [])
+    return apply(f, *args, _op_name="sigmoid_focal_loss")
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    return apply(lambda a, t: _reduce(jnp.log1p(jnp.exp(-t * a)), reduction),
+                 input, label, _op_name="soft_margin_loss")
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    def f(a, t, *w):
+        per = -(t * jax.nn.log_sigmoid(a) + (1 - t) * jax.nn.log_sigmoid(-a))
+        per = jnp.mean(per, axis=-1)
+        if w:
+            per = per * w[0]
+        return _reduce(per, reduction)
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply(f, *args, _op_name="multi_label_soft_margin_loss")
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    def f(a, t):
+        if log_input:
+            per = jnp.exp(a) - t * a
+        else:
+            per = a - t * jnp.log(a + epsilon)
+        if full:
+            stirling = t * jnp.log(t + epsilon) - t + 0.5 * jnp.log(
+                2 * jnp.pi * (t + epsilon))
+            per = per + jnp.where(t > 1, stirling, 0.0)
+        return _reduce(per, reduction)
+    return apply(f, input, label, _op_name="poisson_nll_loss")
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    def f(mu, t, var):
+        var = jnp.maximum(var, epsilon)
+        per = 0.5 * (jnp.log(var) + jnp.square(mu - t) / var)
+        if full:
+            per = per + 0.5 * jnp.log(jnp.asarray(2 * jnp.pi))
+        return _reduce(per, reduction)
+    return apply(f, input, label, variance, _op_name="gaussian_nll_loss")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via dynamic-program in lax.scan (reference: warpctc op)."""
+    lp = log_probs.value if isinstance(log_probs, Tensor) else log_probs
+    # paddle layout: (T, B, C)
+    def f(logits):
+        import optax
+        t_, b_, c_ = logits.shape
+        lgb = jnp.transpose(logits, (1, 0, 2))  # (B,T,C)
+        lbl = labels.value if isinstance(labels, Tensor) else labels
+        pad_mask = jnp.arange(t_)[None, :] >= jnp.asarray(
+            input_lengths.value if isinstance(input_lengths, Tensor)
+            else input_lengths)[:, None]
+        lbl_mask = jnp.arange(lbl.shape[1])[None, :] >= jnp.asarray(
+            label_lengths.value if isinstance(label_lengths, Tensor)
+            else label_lengths)[:, None]
+        per = optax.ctc_loss(lgb, pad_mask, lbl, lbl_mask, blank_id=blank)
+        return _reduce(per, reduction)
+    return apply(f, log_probs, _op_name="ctc_loss")

@@ -1,0 +1,197 @@
+"""Normalization functionals.
+
+Parity: python/paddle/nn/functional/norm.py. batch_norm takes running mean/
+var buffers and (in training) returns updated statistics via the layer
+(functional purity: stats update handled by caller — BatchNorm layer).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...autograd.tape import apply
+from ...core.tensor import Tensor
+
+__all__ = ["batch_norm", "layer_norm", "group_norm", "instance_norm",
+           "rms_norm", "local_response_norm"]
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW", use_global_stats=None, name=None):
+    channel_last = data_format in ("NLC", "NHWC", "NDHWC")
+    use_batch_stats = training and not (use_global_stats is True)
+
+    def stats_axes(v):
+        ch_ax = v.ndim - 1 if channel_last else 1
+        return tuple(i for i in range(v.ndim) if i != ch_ax), ch_ax
+
+    has_w = weight is not None
+    has_b = bias is not None
+
+    def f(v, rm, rv, *wb):
+        axes, ch_ax = stats_axes(v)
+        shape = [1] * v.ndim
+        shape[ch_ax] = -1
+        if use_batch_stats:
+            mean = jnp.mean(v, axis=axes)
+            var = jnp.var(v, axis=axes)
+        else:
+            mean, var = rm, rv
+        out = (v - mean.reshape(shape)) / jnp.sqrt(var.reshape(shape) + epsilon)
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if has_b:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    args = [x, running_mean, running_var]
+    if has_w:
+        args.append(weight)
+    if has_b:
+        args.append(bias)
+    out = apply(f, *args, _op_name="batch_norm")
+
+    if use_batch_stats:
+        # update running stats out-of-graph (buffer update, no grad)
+        v = x.value
+        axes, ch_ax = ((tuple(i for i in range(v.ndim) if i != v.ndim - 1),
+                        v.ndim - 1) if channel_last
+                       else (tuple(i for i in range(v.ndim) if i != 1), 1))
+        m = jnp.mean(v, axis=axes)
+        n = v.size // v.shape[ch_ax]
+        var_unbiased = jnp.var(v, axis=axes) * (n / max(n - 1, 1))
+        running_mean.value = (momentum * running_mean.value
+                              + (1 - momentum) * m).astype(running_mean.value.dtype)
+        running_var.value = (momentum * running_var.value
+                             + (1 - momentum) * var_unbiased).astype(running_var.value.dtype)
+    return out
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_axes = len(normalized_shape)
+    has_w = weight is not None
+    has_b = bias is not None
+
+    def f(v, *wb):
+        axes = tuple(range(v.ndim - n_axes, v.ndim))
+        mean = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.var(v, axis=axes, keepdims=True)
+        out = (v - mean) / jnp.sqrt(var + epsilon)
+        i = 0
+        if has_w:
+            out = out * wb[i]
+            i += 1
+        if has_b:
+            out = out + wb[i]
+        return out
+
+    args = [x]
+    if has_w:
+        args.append(weight)
+    if has_b:
+        args.append(bias)
+    return apply(f, *args, _op_name="layer_norm")
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, axis=-1, name=None):
+    """RMSNorm (LLaMA-family) — not in the reference snapshot; first-class
+    here because decoder LLMs are the north-star workload."""
+    def f(v, *w):
+        ms = jnp.mean(jnp.square(v.astype(jnp.float32)), axis=axis,
+                      keepdims=True)
+        out = (v.astype(jnp.float32) * jax.lax.rsqrt(ms + epsilon)).astype(v.dtype)
+        if w:
+            out = out * w[0]
+        return out
+    if weight is None:
+        return apply(f, x, _op_name="rms_norm")
+    return apply(f, x, weight, _op_name="rms_norm")
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    channel_last = data_format in ("NLC", "NHWC", "NDHWC")
+
+    has_w = weight is not None
+    has_b = bias is not None
+
+    def f(v, *wb):
+        if channel_last:
+            v = jnp.moveaxis(v, -1, 1)
+        n, c = v.shape[:2]
+        g = int(num_groups)
+        grouped = v.reshape((n, g, c // g) + v.shape[2:])
+        axes = tuple(range(2, grouped.ndim))
+        mean = jnp.mean(grouped, axis=axes, keepdims=True)
+        var = jnp.var(grouped, axis=axes, keepdims=True)
+        out = ((grouped - mean) / jnp.sqrt(var + epsilon)).reshape(v.shape)
+        shape = [1, c] + [1] * (v.ndim - 2)
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if has_b:
+            out = out + wb[i].reshape(shape)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    args = [x]
+    if has_w:
+        args.append(weight)
+    if has_b:
+        args.append(bias)
+    return apply(f, *args, _op_name="group_norm")
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW", name=None):
+    has_w = weight is not None
+    has_b = bias is not None
+
+    def f(v, *wb):
+        axes = tuple(range(2, v.ndim))
+        mean = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.var(v, axis=axes, keepdims=True)
+        out = (v - mean) / jnp.sqrt(var + eps)
+        shape = [1, v.shape[1]] + [1] * (v.ndim - 2)
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if has_b:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    args = [x]
+    if has_w:
+        args.append(weight)
+    if has_b:
+        args.append(bias)
+    return apply(f, *args, _op_name="instance_norm")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def f(v):
+        ch_ax = 1 if data_format.startswith("NC") else v.ndim - 1
+        sq = jnp.square(v)
+        half = size // 2
+        pads = [(0, 0)] * v.ndim
+        pads[ch_ax] = (half, size - half - 1)
+        sq = jnp.pad(sq, pads)
+        import jax as _jax
+        dims = [1] * v.ndim
+        dims[ch_ax] = size
+        strides = [1] * v.ndim
+        acc = _jax.lax.reduce_window(sq, 0.0, _jax.lax.add, tuple(dims),
+                                     tuple(strides), "VALID")
+        return v / jnp.power(k + alpha * acc, beta)
+    return apply(f, x, _op_name="local_response_norm")

@@ -1,0 +1,168 @@
+"""Pooling via lax.reduce_window.
+
+Parity: python/paddle/nn/functional/pooling.py (NCHW default).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...autograd.tape import apply
+
+__all__ = ["avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d",
+           "max_pool2d", "max_pool3d", "adaptive_avg_pool1d",
+           "adaptive_avg_pool2d", "adaptive_avg_pool3d", "adaptive_max_pool1d",
+           "adaptive_max_pool2d", "adaptive_max_pool3d"]
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == n:
+            return tuple(int(x) for x in v)
+        return tuple(int(v[0]) for _ in range(n))
+    return tuple(int(v) for _ in range(n))
+
+
+def _pool(x, kind, kernel, stride, padding, n, ceil_mode, exclusive,
+          data_format):
+    channel_last = data_format in ("NLC", "NHWC", "NDHWC")
+    k = _tuple(kernel, n)
+    s = _tuple(stride if stride is not None else kernel, n)
+    p = _tuple(padding, n)
+    spatial_axes = (list(range(1, 1 + n)) if channel_last
+                    else list(range(2, 2 + n)))
+    if channel_last:
+        dims = (1,) + k + (1,)
+        strides = (1,) + s + (1,)
+    else:
+        dims = (1, 1) + k
+        strides = (1, 1) + s
+
+    def _pads(v):
+        # ceil_mode: extend high-side padding so the last partial window
+        # is produced (paddle ceil_mode semantics).
+        extras = []
+        for i, ax in enumerate(spatial_axes):
+            inp = v.shape[ax]
+            if ceil_mode:
+                out = -(-(inp + 2 * p[i] - k[i]) // s[i]) + 1
+            else:
+                out = (inp + 2 * p[i] - k[i]) // s[i] + 1
+            extra = max(0, (out - 1) * s[i] + k[i] - (inp + 2 * p[i]))
+            extras.append(extra)
+        pads = [(0, 0)] * v.ndim
+        for i, ax in enumerate(spatial_axes):
+            pads[ax] = (p[i], p[i] + extras[i])
+        return pads, any(e > 0 for e in extras)
+
+    def f(v):
+        pads, has_extra = _pads(v)
+        if kind == "max":
+            init = -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating) \
+                else jnp.iinfo(v.dtype).min
+            return jax.lax.reduce_window(v, init, jax.lax.max, dims, strides,
+                                         pads)
+        summed = jax.lax.reduce_window(v, 0.0, jax.lax.add, dims, strides,
+                                       pads)
+        if exclusive and (any(pi > 0 for pi in p) or has_extra):
+            ones = jnp.ones_like(v)
+            counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims,
+                                           strides, pads)
+            return summed / counts
+        return summed / float(np.prod(k))
+
+    return apply(f, x, _op_name=f"{kind}_pool{n}d")
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, "avg", kernel_size, stride, padding, 1, ceil_mode,
+                 exclusive, data_format)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool(x, "avg", kernel_size, stride, padding, 2, ceil_mode,
+                 exclusive, data_format)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(x, "avg", kernel_size, stride, padding, 3, ceil_mode,
+                 exclusive, data_format)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, "max", kernel_size, stride, padding, 1, ceil_mode, True,
+                 data_format)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _pool(x, "max", kernel_size, stride, padding, 2, ceil_mode, True,
+                 data_format)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, "max", kernel_size, stride, padding, 3, ceil_mode, True,
+                 data_format)
+
+
+def _adaptive(x, output_size, n, kind, data_format):
+    out = _tuple(output_size, n)
+    channel_last = data_format in ("NLC", "NHWC", "NDHWC")
+
+    def f(v):
+        spatial = v.shape[1:1 + n] if channel_last else v.shape[2:2 + n]
+        res = v
+        for i, (inp, o) in enumerate(zip(spatial, out)):
+            ax = (1 + i) if channel_last else (2 + i)
+            if inp % o == 0:
+                k = inp // o
+                shape = res.shape[:ax] + (o, k) + res.shape[ax + 1:]
+                res = res.reshape(shape)
+                res = (jnp.max(res, axis=ax + 1) if kind == "max"
+                       else jnp.mean(res, axis=ax + 1))
+            else:
+                # general case: per-output-bin reduction
+                starts = [int(np.floor(j * inp / o)) for j in range(o)]
+                ends = [int(np.ceil((j + 1) * inp / o)) for j in range(o)]
+                slices = []
+                for st, en in zip(starts, ends):
+                    sl = jax.lax.slice_in_dim(res, st, en, axis=ax)
+                    slices.append(jnp.max(sl, axis=ax) if kind == "max"
+                                  else jnp.mean(sl, axis=ax))
+                res = jnp.stack(slices, axis=ax)
+        return res
+
+    return apply(f, x, _op_name=f"adaptive_{kind}_pool{n}d")
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive(x, output_size, 1, "avg", "NCL")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive(x, output_size, 2, "avg", data_format)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive(x, output_size, 3, "avg", data_format)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 1, "max", "NCL")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 2, "max", "NCHW")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 3, "max", "NCDHW")

@@ -1,0 +1,357 @@
+"""Recurrent layers via lax.scan.
+
+Parity: python/paddle/nn/layer/rnn.py (SimpleRNN/LSTM/GRU, cells, RNN
+wrapper). TPU-first: the time loop is a lax.scan — one compiled loop, not a
+per-step python loop (the reference's cudnn RNN kernels play this role).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...autograd.tape import apply
+from ...core.tensor import Tensor
+from .. import initializer as I
+from ..layer_base import Layer
+
+__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "SimpleRNN",
+           "LSTM", "GRU", "BiRNN"]
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype="float32",
+                           init_value=0.0):
+        b = batch_ref.shape[0]
+        from ...tensor.creation import full
+        return full([b, self.hidden_size], init_value, dtype)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        init = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [hidden_size], attr=bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [hidden_size], attr=bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def f(x, h, wi, wh, bi, bh):
+            return act(x @ wi.T + bi + h @ wh.T + bh)
+
+        h = apply(f, inputs, states, self.weight_ih, self.weight_hh,
+                  self.bias_ih, self.bias_hh, _op_name="simple_rnn_cell")
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 proj_size=0, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [4 * hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [4 * hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [4 * hidden_size], attr=bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [4 * hidden_size], attr=bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            from ...tensor.creation import zeros
+            b = inputs.shape[0]
+            states = (zeros([b, self.hidden_size]), zeros([b, self.hidden_size]))
+        h, c = states
+
+        def f(x, hh, cc, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + hh @ wh.T + bh
+            i, fgt, g, o = jnp.split(gates, 4, axis=-1)
+            i = jax.nn.sigmoid(i)
+            fgt = jax.nn.sigmoid(fgt)
+            g = jnp.tanh(g)
+            o = jax.nn.sigmoid(o)
+            nc = fgt * cc + i * g
+            nh = o * jnp.tanh(nc)
+            return nh, nc
+
+        nh, nc = apply(f, inputs, h, c, self.weight_ih, self.weight_hh,
+                       self.bias_ih, self.bias_hh, _op_name="lstm_cell")
+        return nh, (nh, nc)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [3 * hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [3 * hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [3 * hidden_size], attr=bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [3 * hidden_size], attr=bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def f(x, h, wi, wh, bi, bh):
+            xg = x @ wi.T + bi
+            hg = h @ wh.T + bh
+            xr, xz, xn = jnp.split(xg, 3, axis=-1)
+            hr, hz, hn = jnp.split(hg, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            return (1 - z) * n + z * h
+
+        nh = apply(f, inputs, states, self.weight_ih, self.weight_hh,
+                   self.bias_ih, self.bias_hh, _op_name="gru_cell")
+        return nh, nh
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class RNN(Layer):
+    """Runs a cell over time with lax.scan (paddle.nn.RNN parity)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        cell = self.cell
+        is_lstm = isinstance(cell, LSTMCell)
+        builtin = isinstance(cell, (LSTMCell, GRUCell, SimpleRNNCell))
+        if not builtin:
+            return self._generic_loop(inputs, initial_states, sequence_length)
+        # fast path: one lax.scan over time; weights are scan-invariant args
+        params = [cell.weight_ih, cell.weight_hh, cell.bias_ih, cell.bias_hh]
+
+        if initial_states is None:
+            from ...tensor.creation import zeros
+            b = inputs.shape[0] if not self.time_major else inputs.shape[1]
+            if is_lstm:
+                initial_states = (zeros([b, cell.hidden_size]),
+                                  zeros([b, cell.hidden_size]))
+            else:
+                initial_states = zeros([b, cell.hidden_size])
+
+        time_major = self.time_major
+        reverse = self.is_reverse
+        act = getattr(cell, "activation", None)
+        is_gru = isinstance(cell, GRUCell)
+        seq_len = (None if sequence_length is None else
+                   (sequence_length.value if hasattr(sequence_length, "value")
+                    else jnp.asarray(sequence_length)))
+
+        def step_raw(carry, xt, wi, wh, bi, bh):
+            x, t = xt
+            if is_lstm:
+                h, c = carry
+                gates = x @ wi.T + bi + h @ wh.T + bh
+                i, fgt, g, o = jnp.split(gates, 4, axis=-1)
+                nc = jax.nn.sigmoid(fgt) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+                nh = jax.nn.sigmoid(o) * jnp.tanh(nc)
+                new = (nh, nc)
+            elif is_gru:
+                h = carry
+                xg = x @ wi.T + bi
+                hg = h @ wh.T + bh
+                xr, xz, xn = jnp.split(xg, 3, axis=-1)
+                hr, hz, hn = jnp.split(hg, 3, axis=-1)
+                r = jax.nn.sigmoid(xr + hr)
+                z = jax.nn.sigmoid(xz + hz)
+                n = jnp.tanh(xn + r * hn)
+                new = (1 - z) * n + z * h
+            else:
+                h = carry
+                a = jnp.tanh if act == "tanh" else jax.nn.relu
+                new = a(x @ wi.T + bi + h @ wh.T + bh)
+            if seq_len is not None:
+                # freeze state & zero output past each sequence's length
+                valid = (t < seq_len)[:, None]
+                if is_lstm:
+                    new = (jnp.where(valid, new[0], carry[0]),
+                           jnp.where(valid, new[1], carry[1]))
+                    out = jnp.where(valid, new[0], 0.0)
+                    return new, out
+                new = jnp.where(valid, new, carry)
+                return new, jnp.where(valid, new, 0.0)
+            return new, (new[0] if is_lstm else new)
+
+        def f(x, init0, *rest):
+            if is_lstm:
+                init1, wi, wh, bi, bh = rest
+                init = (init0, init1)
+            else:
+                wi, wh, bi, bh = rest
+                init = init0
+            xs = x if time_major else jnp.swapaxes(x, 0, 1)
+            ts = jnp.arange(xs.shape[0])
+            carry, ys = jax.lax.scan(
+                lambda c, xt: step_raw(c, xt, wi, wh, bi, bh), init, (xs, ts),
+                reverse=reverse)
+            out = ys if time_major else jnp.swapaxes(ys, 0, 1)
+            if is_lstm:
+                return out, carry[0], carry[1]
+            return out, carry
+
+        if is_lstm:
+            out, h, c = apply(f, inputs, initial_states[0], initial_states[1],
+                              *params, _op_name="rnn_scan")
+            return out, (h, c)
+        out, h = apply(f, inputs, initial_states, *params, _op_name="rnn_scan")
+        return out, h
+
+    def _generic_loop(self, inputs, initial_states, sequence_length):
+        """Custom cells: drive cell.forward per step (paddle dygraph RNN
+        semantics — python time loop)."""
+        from ...tensor.manipulation import stack, unbind
+        steps = unbind(inputs, axis=0 if self.time_major else 1)
+        if self.is_reverse:
+            steps = steps[::-1]
+        states = initial_states
+        outs = []
+        for x_t in steps:
+            out, states = self.cell(x_t, states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        out = stack(outs, axis=0 if self.time_major else 1)
+        return out, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        states_fw, states_bw = (initial_states if initial_states is not None
+                                else (None, None))
+        out_fw, st_fw = self.rnn_fw(inputs, states_fw)
+        out_bw, st_bw = self.rnn_bw(inputs, states_bw)
+        from ...tensor.manipulation import concat
+        return concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 **kwargs):
+        super().__init__()
+        self.mode = mode
+        self.num_layers = num_layers
+        self.direction = direction
+        self.time_major = time_major
+        self.dropout = dropout
+        self.hidden_size = hidden_size
+        bidir = direction in ("bidirect", "bidirectional")
+        self.num_directions = 2 if bidir else 1
+
+        def make_cell(isize):
+            if mode == "LSTM":
+                return LSTMCell(isize, hidden_size)
+            if mode == "GRU":
+                return GRUCell(isize, hidden_size)
+            return SimpleRNNCell(isize, hidden_size,
+                                 kwargs.get("activation", "tanh"))
+
+        from .container import LayerList
+        self.rnns = LayerList()
+        for i in range(num_layers):
+            isize = input_size if i == 0 else hidden_size * self.num_directions
+            if bidir:
+                self.rnns.append(BiRNN(make_cell(isize), make_cell(isize),
+                                       time_major))
+            else:
+                self.rnns.append(RNN(make_cell(isize),
+                                     direction == "backward", time_major))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        out = inputs
+        final_states = []
+        for i, rnn in enumerate(self.rnns):
+            st = None if initial_states is None else initial_states[i]
+            out, state = rnn(out, st)
+            final_states.append(state)
+            if self.dropout > 0 and i < self.num_layers - 1:
+                from .. import functional as F
+                out = F.dropout(out, self.dropout, training=self.training)
+        return out, final_states
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kwargs):
+        super().__init__("RNN", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, activation=activation)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout)

@@ -1,0 +1,45 @@
+"""nn.utils parity (parameters_to_vector etc.)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["parameters_to_vector", "vector_to_parameters", "clip_grad_norm_",
+           "clip_grad_value_"]
+
+
+def parameters_to_vector(parameters, name=None):
+    return Tensor(jnp.concatenate([p.value.reshape(-1) for p in parameters]))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    v = vec.value
+    for p in parameters:
+        n = int(np.prod(p.shape)) if p.shape else 1
+        p.set_value(v[offset:offset + n].reshape(p.value.shape))
+        offset += n
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    params = [p for p in parameters if p._grad is not None]
+    if not params:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(p._grad)) for p in params]))
+    else:
+        total = jnp.power(sum(jnp.sum(jnp.power(jnp.abs(p._grad), norm_type))
+                              for p in params), 1.0 / norm_type)
+    scale = jnp.minimum(1.0, max_norm / (total + 1e-6))
+    for p in params:
+        p._grad = p._grad * scale
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    for p in parameters:
+        if p._grad is not None:
+            p._grad = jnp.clip(p._grad, -clip_value, clip_value)

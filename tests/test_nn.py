@@ -1,0 +1,248 @@
+"""nn layer tests (parity patterns: reference unittests for nn layers)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def setup_module(m):
+    paddle.seed(2024)
+
+
+def test_linear_grads_match_manual():
+    lin = nn.Linear(4, 3)
+    x = paddle.randn([5, 4])
+    y = lin(x)
+    loss = y.sum()
+    loss.backward()
+    np.testing.assert_allclose(lin.bias.grad.numpy(), np.full(3, 5.0),
+                               rtol=1e-5)
+    np.testing.assert_allclose(lin.weight.grad.numpy(),
+                               np.tile(x.numpy().sum(0)[:, None], (1, 3)),
+                               rtol=1e-5)
+
+
+def test_conv2d_matches_numpy():
+    conv = nn.Conv2D(1, 1, 3, padding=0, bias_attr=False)
+    w = np.arange(9, dtype=np.float32).reshape(1, 1, 3, 3)
+    conv.weight.set_value(w)
+    x = np.arange(25, dtype=np.float32).reshape(1, 1, 5, 5)
+    out = conv(paddle.to_tensor(x))
+    # direct correlation
+    ref = np.zeros((3, 3), dtype=np.float32)
+    for i in range(3):
+        for j in range(3):
+            ref[i, j] = (x[0, 0, i:i + 3, j:j + 3] * w[0, 0]).sum()
+    np.testing.assert_allclose(out.numpy()[0, 0], ref, rtol=1e-5)
+
+
+def test_conv2d_groups_and_stride():
+    conv = nn.Conv2D(4, 8, 3, stride=2, padding=1, groups=2)
+    out = conv(paddle.randn([2, 4, 8, 8]))
+    assert out.shape == [2, 8, 4, 4]
+
+
+def test_conv_transpose_shape():
+    deconv = nn.Conv2DTranspose(4, 2, 3, stride=2, padding=1)
+    out = deconv(paddle.randn([1, 4, 5, 5]))
+    assert out.shape == [1, 2, 9, 9]
+
+
+def test_batchnorm_stats_update():
+    bn = nn.BatchNorm1D(4, momentum=0.5, data_format="NCL")
+    x = paddle.randn([8, 4, 6]) * 3 + 1
+    bn(x)
+    assert not np.allclose(bn._mean.numpy(), 0.0)
+    bn.eval()
+    m = bn._mean.numpy().copy()
+    bn(x)
+    np.testing.assert_array_equal(bn._mean.numpy(), m)  # frozen in eval
+
+
+def test_layernorm_normalizes():
+    ln = nn.LayerNorm(16)
+    x = paddle.randn([4, 16]) * 5 + 3
+    y = ln(x).numpy()
+    np.testing.assert_allclose(y.mean(-1), 0, atol=1e-5)
+    np.testing.assert_allclose(y.std(-1), 1, atol=2e-2)
+
+
+def test_groupnorm_instance_rms():
+    gn = nn.GroupNorm(2, 4)
+    assert gn(paddle.randn([2, 4, 5, 5])).shape == [2, 4, 5, 5]
+    inorm = nn.InstanceNorm2D(4)
+    assert inorm(paddle.randn([2, 4, 5, 5])).shape == [2, 4, 5, 5]
+    rms = nn.RMSNorm(8)
+    y = rms(paddle.randn([3, 8]))
+    assert y.shape == [3, 8]
+
+
+def test_embedding_padding_idx():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    idx = paddle.to_tensor(np.array([[0, 1], [2, 0]]))
+    out = emb(idx)
+    np.testing.assert_allclose(out.numpy()[0, 0], np.zeros(4))
+    np.testing.assert_allclose(out.numpy()[1, 1], np.zeros(4))
+    out.sum().backward()
+    assert emb.weight.grad is not None
+
+
+def test_dropout_train_eval():
+    d = nn.Dropout(0.5)
+    x = paddle.ones([1000])
+    y = d(x)
+    kept = float((y.numpy() != 0).mean())
+    assert 0.3 < kept < 0.7
+    np.testing.assert_allclose(y.numpy()[y.numpy() != 0], 2.0)  # upscale
+    d.eval()
+    np.testing.assert_array_equal(d(x).numpy(), x.numpy())
+
+
+def test_pooling():
+    x = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    mp = nn.MaxPool2D(2)
+    np.testing.assert_allclose(mp(x).numpy()[0, 0], [[5, 7], [13, 15]])
+    ap = nn.AvgPool2D(2)
+    np.testing.assert_allclose(ap(x).numpy()[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+    aap = nn.AdaptiveAvgPool2D(1)
+    np.testing.assert_allclose(aap(x).numpy()[0, 0], [[7.5]])
+
+
+def test_mha_self_attention_shapes_and_cache():
+    mha = nn.MultiHeadAttention(16, 4)
+    q = paddle.randn([2, 6, 16])
+    out = mha(q)
+    assert out.shape == [2, 6, 16]
+    # causal mask via bool mask
+    mask = paddle.tril(paddle.ones([6, 6], dtype="bool"))
+    out2 = mha(q, attn_mask=paddle.reshape(mask, [1, 1, 6, 6]))
+    assert out2.shape == [2, 6, 16]
+    # incremental cache decode
+    cache = mha.gen_cache(q)
+    step = paddle.randn([2, 1, 16])
+    o, cache = mha(step, step, step, None, cache)
+    assert o.shape == [2, 1, 16]
+    assert cache.k.shape[1] == 1
+    o, cache = mha(step, step, step, None, cache)
+    assert cache.k.shape[1] == 2
+
+
+def test_transformer_full():
+    model = nn.Transformer(d_model=16, nhead=2, num_encoder_layers=2,
+                           num_decoder_layers=2, dim_feedforward=32)
+    src = paddle.randn([2, 5, 16])
+    tgt = paddle.randn([2, 4, 16])
+    out = model(src, tgt)
+    assert out.shape == [2, 4, 16]
+    out.mean().backward()
+    grads = [p.grad is not None for p in model.parameters()]
+    assert all(grads)
+
+
+def test_rnn_variants():
+    for cls, states in [(nn.SimpleRNN, 1), (nn.GRU, 1), (nn.LSTM, 2)]:
+        rnn = cls(4, 8, num_layers=1)
+        out, st = rnn(paddle.randn([2, 5, 4]))
+        assert out.shape == [2, 5, 8]
+    birnn = nn.LSTM(4, 8, direction="bidirectional")
+    out, _ = birnn(paddle.randn([2, 5, 4]))
+    assert out.shape == [2, 5, 16]
+
+
+def test_lstm_grad_flows():
+    lstm = nn.LSTM(4, 8)
+    x = paddle.randn([2, 5, 4])
+    x.stop_gradient = False
+    out, _ = lstm(x)
+    out.mean().backward()
+    assert x.grad is not None
+    for p in lstm.parameters():
+        assert p.grad is not None
+
+
+def test_losses():
+    logits = paddle.randn([8, 5])
+    labels = paddle.to_tensor(np.random.randint(0, 5, (8,)))
+    ce = nn.CrossEntropyLoss()
+    l = ce(logits, labels)
+    assert l.shape == []
+    # soft label
+    soft = paddle.nn.functional.softmax(paddle.randn([8, 5]))
+    l2 = F.cross_entropy(logits, soft, soft_label=True)
+    # ignore index
+    labels2 = labels.clone()
+    labels2[0] = -100
+    l3 = F.cross_entropy(logits, labels2)
+    assert np.isfinite(float(l3))
+    # mse/l1/bce
+    a, b = paddle.randn([4]), paddle.randn([4])
+    np.testing.assert_allclose(float(F.mse_loss(a, b)),
+                               ((a.numpy() - b.numpy()) ** 2).mean(), rtol=1e-5)
+    p = paddle.nn.functional.sigmoid(paddle.randn([4]))
+    t = paddle.to_tensor(np.array([0., 1., 1., 0.], dtype=np.float32))
+    bce = F.binary_cross_entropy(p, t)
+    bcel = F.binary_cross_entropy_with_logits(paddle.randn([4]), t)
+    assert np.isfinite(float(bce)) and np.isfinite(float(bcel))
+    kl = F.kl_div(paddle.nn.functional.log_softmax(paddle.randn([3, 4])),
+                  paddle.nn.functional.softmax(paddle.randn([3, 4])))
+    assert np.isfinite(float(kl))
+
+
+def test_clip_grad_by_global_norm():
+    lin = nn.Linear(4, 4)
+    x = paddle.randn([2, 4]) * 100
+    lin(x).sum().backward()
+    clip = nn.ClipGradByGlobalNorm(1.0)
+    pg = [(p, p.grad) for p in lin.parameters()]
+    clipped = clip(pg)
+    total = np.sqrt(sum((g.numpy() ** 2).sum() for _, g in clipped))
+    np.testing.assert_allclose(total, 1.0, rtol=1e-4)
+
+
+def test_containers():
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    ll.append(nn.Linear(2, 2))
+    assert len(ll) == 4 and len(list(ll.parameters())) == 8
+    ld = nn.LayerDict({"a": nn.Linear(2, 2)})
+    ld["b"] = nn.Linear(2, 2)
+    assert "a" in ld and len(ld) == 2
+    pl = nn.ParameterList([paddle.Parameter(paddle.randn([2]).value)
+                           for _ in range(2)])
+    assert len(list(pl.parameters())) == 2
+
+
+def test_hooks():
+    lin = nn.Linear(2, 2)
+    calls = []
+    h = lin.register_forward_post_hook(
+        lambda layer, inp, out: calls.append(1))
+    lin(paddle.randn([1, 2]))
+    assert calls == [1]
+    h.remove()
+    lin(paddle.randn([1, 2]))
+    assert calls == [1]
+
+
+def test_sdpa_matches_reference():
+    b, s, h, d = 2, 8, 2, 4
+    q = paddle.randn([b, s, h, d])
+    k = paddle.randn([b, s, h, d])
+    v = paddle.randn([b, s, h, d])
+    out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+    # numpy reference
+    qn, kn, vn = (t.numpy().transpose(0, 2, 1, 3) for t in (q, k, v))
+    logits = qn @ kn.transpose(0, 1, 3, 2) / np.sqrt(d)
+    mask = np.tril(np.ones((s, s), dtype=bool))
+    logits = np.where(mask, logits, -1e30)
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = (p @ vn).transpose(0, 2, 1, 3)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=2e-4, atol=2e-5)
+
+
+def test_flash_attention_api():
+    q = paddle.randn([2, 16, 2, 8])
+    out, _ = F.flash_attention(q, q, q, causal=True)
+    assert out.shape == [2, 16, 2, 8]
