@@ -174,6 +174,10 @@ class Tensor:
     def set_value(self, v):
         if isinstance(v, Tensor):
             v = v.value
+        if isinstance(v, jax.Array):
+            # copy: the fused optimizer step donates param buffers, so this
+            # tensor must not alias a buffer owned by another Tensor
+            v = jnp.copy(v)
         self.value = jnp.asarray(v, dtype=self.value.dtype).reshape(self.value.shape)
         return self
 

@@ -1,0 +1,515 @@
+"""Optimizers.
+
+Parity: python/paddle/optimizer/ (optimizer.py, adam.py, adamw.py, momentum.py,
+lamb.py, rmsprop.py, adagrad.py, adadelta.py, adamax.py, sgd.py). TPU-first
+design: every optimizer is a PURE update rule (`_init_slots` / `_rule`) over
+raw jax arrays, and the eager `step()` runs ONE fused jitted program over the
+whole parameter pytree with buffer donation — the analog of the reference's
+fused_adam / multi-tensor kernels (paddle/fluid/operators/optimizers/), but
+compiled by XLA instead of hand-written CUDA. The same pure rule powers the
+functional API (`init`/`apply_gradients`) used inside pjit training steps.
+
+Master weights (multi_precision) follow the reference semantics: fp16/bf16
+params keep an fp32 master copy in the slot dict; updates happen in fp32 and
+are cast back (reference: optimizer.py _create_master_weight).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Parameter, Tensor
+from ..nn.clip import ClipGradBase
+from .lr import LRScheduler
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax",
+           "Adagrad", "Adadelta", "RMSProp", "Lamb"]
+
+
+def _is_low_precision(dt) -> bool:
+    return dt in (jnp.float16, jnp.bfloat16) or str(dt) in ("float16", "bfloat16")
+
+
+class L2Decay:
+    """Parity: paddle.regularizer.L2Decay — coupled weight decay (adds
+    coeff*p to the gradient)."""
+
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+
+class _ParamMeta(NamedTuple):
+    """Static (hashable) per-param attributes baked into the fused trace."""
+    wd: float          # weight-decay coefficient for this param
+    wd_is_l1: bool
+    decay: bool        # AdamW apply_decay_param_fun verdict
+    lr_scale: float    # ParamAttr learning_rate * AdamW lr_ratio
+    need_clip: bool
+
+
+class Optimizer:
+    """Base optimizer. Parity: paddle.optimizer.Optimizer."""
+
+    # subclasses override
+    _decoupled_wd = False   # AdamW-style p *= (1 - lr*coeff)
+    _wd_in_rule = False     # Lamb-style: rule consumes meta.wd itself
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        if parameters is None:
+            raise ValueError(
+                "parameters is required in eager mode (pass "
+                "model.parameters()).")
+        self._parameter_list: List[Parameter] = list(parameters)
+        self._learning_rate = learning_rate  # float or LRScheduler
+        if isinstance(weight_decay, (L2Decay, L1Decay)):
+            self._wd_coeff = weight_decay.coeff
+            self._wd_is_l1 = isinstance(weight_decay, L1Decay)
+        else:
+            self._wd_coeff = float(weight_decay) if weight_decay else 0.0
+            self._wd_is_l1 = False
+        self._grad_clip: Optional[ClipGradBase] = grad_clip
+        self._multi_precision = multi_precision
+        self._name = name
+        # slot-name -> param-name -> raw array (mirrors reference accumulators)
+        self._accumulators: Dict[str, Dict[str, Any]] = {}
+        self._step_count = 0
+        self._fused_step_fn = None
+        self._fused_key = None
+
+    # ---- rule interface (override in subclasses) ----
+    def _init_slots(self, p) -> Dict[str, Any]:
+        """Return initial slot arrays for one (fp32) param value."""
+        return {}
+
+    def _rule(self, p, g, slots, lr, t, meta: _ParamMeta):
+        """Pure update: fp32 param, fp32 grad, slots, scalar lr, step t.
+
+        Returns (new_p, new_slots).
+        """
+        raise NotImplementedError
+
+    def _param_meta(self, p, name=None) -> _ParamMeta:
+        """Resolve static decay/clip/lr attributes for one param.
+
+        `p` is a Parameter in the eager path, or None (name-only) in the
+        functional path. Per-param ParamAttr(regularizer=...) overrides the
+        optimizer-level weight_decay, matching reference
+        optimizer.py _create_regularization_of_grad.
+        """
+        name = name if name is not None else getattr(p, "name", "")
+        reg = getattr(p, "regularizer", None)
+        if reg is not None:
+            wd, is_l1 = reg.coeff, isinstance(reg, L1Decay)
+        else:
+            wd, is_l1 = self._wd_coeff, self._wd_is_l1
+        lr_scale = 1.0
+        if p is not None:
+            lr_scale = float(p.optimize_attr.get("learning_rate", 1.0))
+        need_clip = getattr(p, "need_clip", True) if p is not None else True
+        return _ParamMeta(wd=wd, wd_is_l1=is_l1, decay=True,
+                          lr_scale=lr_scale, need_clip=need_clip)
+
+    # ---- lr ----
+    def get_lr(self):
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError(
+                "cannot set_lr when the learning rate is a scheduler")
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    @property
+    def _param_groups(self):
+        return self._parameter_list
+
+    # ---- eager step ----
+    def step(self):
+        params = [p for p in self._parameter_list
+                  if p._grad is not None and not p.stop_gradient]
+        if not params:
+            return
+        self._step_count += 1
+        # params are donated to the fused update; if a grad aliases its param
+        # buffer (e.g. grad-of-0.5||p||^2 set to p itself) copy it first
+        grads = [p._grad + 0 if p._grad is p.value else p._grad
+                 for p in params]
+        slots = [self._ensure_slots(p) for p in params]
+        lr = jnp.asarray(self.get_lr(), dtype=jnp.float32)
+        t = jnp.asarray(self._step_count, dtype=jnp.float32)
+
+        key = (tuple(id(p) for p in params),
+               tuple((p.value.shape, str(p.value.dtype)) for p in params))
+        if self._fused_step_fn is None or self._fused_key != key:
+            self._fused_key = key
+            metas = tuple(self._param_meta(p) for p in params)
+            self._fused_step_fn = jax.jit(
+                functools.partial(self._fused_update, metas=metas),
+                donate_argnums=(0, 2))
+        new_vals, new_slots = self._fused_step_fn(
+            [p.value for p in params], grads, slots, lr, t)
+        for p, v, s in zip(params, new_vals, new_slots):
+            p.value = v
+            for k, arr in s.items():
+                self._accumulators[k][p.name] = arr
+
+    def _fused_update(self, values, grads, slots, lr, t, *, metas):
+        grads = [g.astype(jnp.float32) for g in grads]
+        if self._grad_clip is not None:
+            idx = [i for i, m in enumerate(metas) if m.need_clip]
+            if idx:
+                clipped = self._grad_clip.clip_raw([grads[i] for i in idx])
+                for i, c in zip(idx, clipped):
+                    grads[i] = c
+        new_vals, new_slots = [], []
+        for v, g, s, meta in zip(values, grads, slots, metas):
+            lp = _is_low_precision(v.dtype)
+            master = s.get("master")
+            p32 = master if master is not None else v.astype(jnp.float32)
+            lr_eff = lr * meta.lr_scale
+            if meta.wd and not self._wd_in_rule:
+                if self._decoupled_wd:
+                    if meta.decay:
+                        p32 = p32 * (1.0 - lr_eff * meta.wd)
+                else:
+                    g = g + (meta.wd * jnp.sign(p32) if meta.wd_is_l1
+                             else meta.wd * p32)
+            new_p, ns = self._rule(p32, g, s, lr_eff, t, meta)
+            if master is not None:
+                ns = dict(ns)
+                ns["master"] = new_p
+                new_vals.append(new_p.astype(v.dtype))
+            else:
+                new_vals.append(new_p.astype(v.dtype) if lp else new_p)
+            new_slots.append(ns)
+        return new_vals, new_slots
+
+    def _ensure_slots(self, p) -> Dict[str, Any]:
+        first = not any(p.name in d for d in self._accumulators.values())
+        if first:
+            init = self._init_slots(p.value.astype(jnp.float32))
+            if self._multi_precision and _is_low_precision(p.value.dtype):
+                init["master"] = p.value.astype(jnp.float32)
+            for k, arr in init.items():
+                self._accumulators.setdefault(k, {})[p.name] = arr
+        return {k: d[p.name] for k, d in self._accumulators.items()
+                if p.name in d}
+
+    # ---- paddle API surface ----
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in self._parameter_list]
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._parameter_list:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def state_dict(self):
+        state = {}
+        for slot, d in self._accumulators.items():
+            for pname, arr in d.items():
+                state[f"{pname}_{slot}"] = Tensor(arr)
+        state["@step"] = self._step_count
+        if isinstance(self._learning_rate, LRScheduler):
+            state["LR_Scheduler"] = self._learning_rate.state_dict()
+        return state
+
+    def set_state_dict(self, state):
+        self._step_count = int(state.get("@step", 0))
+        if "LR_Scheduler" in state and isinstance(self._learning_rate,
+                                                  LRScheduler):
+            self._learning_rate.set_state_dict(dict(state["LR_Scheduler"]))
+        names = {p.name for p in self._parameter_list}
+        for key, val in state.items():
+            if key in ("@step", "LR_Scheduler"):
+                continue
+            # longest-prefix match so param 'w' cannot swallow 'w_ho_moment1'
+            match = max((n for n in names if key.startswith(n + "_")),
+                        key=len, default=None)
+            if match is not None:
+                slot = key[len(match) + 1:]
+                arr = val.value if isinstance(val, Tensor) else jnp.asarray(val)
+                # copy: step() donates slot buffers; restored state must not
+                # alias arrays still owned by another optimizer instance
+                self._accumulators.setdefault(slot, {})[match] = jnp.copy(arr)
+
+    # ---- functional API (for jit/pjit training steps) ----
+    def init(self, params_tree):
+        """Pure: params pytree (raw arrays) -> opt-state pytree."""
+        def one(v):
+            s = self._init_slots(jnp.asarray(v, jnp.float32))
+            if self._multi_precision and _is_low_precision(jnp.asarray(v).dtype):
+                s["master"] = jnp.asarray(v, jnp.float32)
+            return s
+        return jax.tree_util.tree_map(one, params_tree)
+
+    def apply_gradients(self, params_tree, grads_tree, state_tree, lr=None,
+                        step=1):
+        """Pure fused update over pytrees — call inside jit/pjit.
+
+        Param names for decay masks come from the pytree key paths (e.g.
+        dict keys 'linear.weight'), so apply_decay_param_fun and per-name
+        rules work here too.
+        """
+        lr = jnp.asarray(self.get_lr() if lr is None else lr, jnp.float32)
+        t = jnp.asarray(step, jnp.float32)
+        flat, treedef = jax.tree_util.tree_flatten_with_path(params_tree)
+        paths = [p for p, _ in flat]
+        leaves_p = [v for _, v in flat]
+        names = [".".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                          for k in path) for path in paths]
+        leaves_g = treedef.flatten_up_to(grads_tree)
+        leaves_s = treedef.flatten_up_to(state_tree)
+        metas = tuple(self._param_meta(None, name=n) for n in names)
+        new_p, new_s = self._fused_update(
+            list(leaves_p), list(leaves_g), list(leaves_s), lr, t, metas=metas)
+        return (jax.tree_util.tree_unflatten(treedef, new_p),
+                jax.tree_util.tree_unflatten(treedef, new_s))
+
+
+class SGD(Optimizer):
+    """Parity: paddle.optimizer.SGD (sgd.py)."""
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+
+    def _init_slots(self, p):
+        return {}
+
+    def _rule(self, p, g, slots, lr, t, meta):
+        return p - lr * g, {}
+
+
+class Momentum(Optimizer):
+    """Parity: paddle.optimizer.Momentum (momentum.py)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._momentum = float(momentum)
+        self._nesterov = use_nesterov
+
+    def _init_slots(self, p):
+        return {"velocity": jnp.zeros_like(p)}
+
+    def _rule(self, p, g, slots, lr, t, meta):
+        v = self._momentum * slots["velocity"] + g
+        if self._nesterov:
+            new_p = p - lr * (g + self._momentum * v)
+        else:
+            new_p = p - lr * v
+        return new_p, {"velocity": v}
+
+
+class Adam(Optimizer):
+    """Parity: paddle.optimizer.Adam (adam.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._beta1 = float(beta1)
+        self._beta2 = float(beta2)
+        self._epsilon = float(epsilon)
+
+    def _init_slots(self, p):
+        return {"moment1": jnp.zeros_like(p), "moment2": jnp.zeros_like(p)}
+
+    def _rule(self, p, g, slots, lr, t, meta):
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * slots["moment1"] + (1 - b1) * g
+        v = b2 * slots["moment2"] + (1 - b2) * g * g
+        lr_t = lr * jnp.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        new_p = p - lr_t * m / (jnp.sqrt(v) + self._epsilon)
+        return new_p, {"moment1": m, "moment2": v}
+
+
+class AdamW(Adam):
+    """Parity: paddle.optimizer.AdamW (adamw.py) — decoupled weight decay,
+    apply_decay_param_fun mask, per-param lr_ratio."""
+
+    _decoupled_wd = True
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None, **kw):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision,
+                         name)
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio  # callable(param) -> float (adamw.py:428)
+
+    def _param_meta(self, p, name=None):
+        meta = super()._param_meta(p, name=name)
+        nm = name if name is not None else getattr(p, "name", "")
+        decay = True
+        if self._apply_decay_param_fun is not None:
+            decay = bool(self._apply_decay_param_fun(nm))
+        lr_scale = meta.lr_scale
+        if self._lr_ratio is not None and p is not None:
+            lr_scale *= float(self._lr_ratio(p))
+        return meta._replace(decay=decay, lr_scale=lr_scale)
+
+
+class Adamax(Optimizer):
+    """Parity: paddle.optimizer.Adamax (adamax.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _init_slots(self, p):
+        return {"moment": jnp.zeros_like(p), "inf_norm": jnp.zeros_like(p)}
+
+    def _rule(self, p, g, slots, lr, t, meta):
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * slots["moment"] + (1 - b1) * g
+        u = jnp.maximum(b2 * slots["inf_norm"], jnp.abs(g))
+        new_p = p - (lr / (1 - b1 ** t)) * m / (u + self._epsilon)
+        return new_p, {"moment": m, "inf_norm": u}
+
+
+class Adagrad(Optimizer):
+    """Parity: paddle.optimizer.Adagrad (adagrad.py)."""
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _init_slots(self, p):
+        return {"moment": jnp.full_like(p, self._init_acc)}
+
+    def _rule(self, p, g, slots, lr, t, meta):
+        acc = slots["moment"] + g * g
+        new_p = p - lr * g / (jnp.sqrt(acc) + self._epsilon)
+        return new_p, {"moment": acc}
+
+
+class Adadelta(Optimizer):
+    """Parity: paddle.optimizer.Adadelta (adadelta.py)."""
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _init_slots(self, p):
+        return {"avg_squared_grad": jnp.zeros_like(p),
+                "avg_squared_update": jnp.zeros_like(p)}
+
+    def _rule(self, p, g, slots, lr, t, meta):
+        rho, eps = self._rho, self._epsilon
+        sq = rho * slots["avg_squared_grad"] + (1 - rho) * g * g
+        upd = g * jnp.sqrt(slots["avg_squared_update"] + eps) / jnp.sqrt(sq + eps)
+        sq_u = rho * slots["avg_squared_update"] + (1 - rho) * upd * upd
+        return p - lr * upd, {"avg_squared_grad": sq,
+                              "avg_squared_update": sq_u}
+
+
+class RMSProp(Optimizer):
+    """Parity: paddle.optimizer.RMSProp (rmsprop.py)."""
+
+    def __init__(self, learning_rate=0.001, rho=0.95, epsilon=1e-6,
+                 momentum=0.0, centered=False, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _init_slots(self, p):
+        s = {"mean_square": jnp.zeros_like(p), "momentum": jnp.zeros_like(p)}
+        if self._centered:
+            s["mean_grad"] = jnp.zeros_like(p)
+        return s
+
+    def _rule(self, p, g, slots, lr, t, meta):
+        rho, eps = self._rho, self._epsilon
+        ms = rho * slots["mean_square"] + (1 - rho) * g * g
+        out = {"mean_square": ms}
+        if self._centered:
+            mg = rho * slots["mean_grad"] + (1 - rho) * g
+            denom = ms - mg * mg + eps
+            out["mean_grad"] = mg
+        else:
+            denom = ms + eps
+        mom = self._momentum * slots["momentum"] + lr * g / jnp.sqrt(denom)
+        out["momentum"] = mom
+        return p - mom, out
+
+
+class Lamb(Optimizer):
+    """Parity: paddle.optimizer.Lamb (lamb.py) — layerwise trust ratio;
+    exclude_from_weight_decay_fn zeroes decay per-param (lamb.py:223)."""
+
+    _wd_in_rule = True
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._lamb_wd = float(lamb_weight_decay)
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _param_meta(self, p, name=None):
+        meta = super()._param_meta(p, name=name)
+        wd = self._lamb_wd
+        if self._exclude_fn is not None and p is not None \
+                and self._exclude_fn(p):
+            wd = 0.0
+        return meta._replace(wd=wd)
+
+    def _init_slots(self, p):
+        return {"moment1": jnp.zeros_like(p), "moment2": jnp.zeros_like(p)}
+
+    def _rule(self, p, g, slots, lr, t, meta):
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * slots["moment1"] + (1 - b1) * g
+        v = b2 * slots["moment2"] + (1 - b2) * g * g
+        m_hat = m / (1 - b1 ** t)
+        v_hat = v / (1 - b2 ** t)
+        r = m_hat / (jnp.sqrt(v_hat) + self._epsilon) + meta.wd * p
+        p_norm = jnp.linalg.norm(p.reshape(-1))
+        r_norm = jnp.linalg.norm(r.reshape(-1))
+        trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+        return p - lr * trust * r, {"moment1": m, "moment2": v}

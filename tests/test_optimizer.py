@@ -1,0 +1,362 @@
+"""Optimizer tests.
+
+Mirrors the reference test style (test/legacy_test/test_adam_op.py etc.):
+each optimizer's fused update is checked against a plain numpy
+re-implementation of the same rule, plus convergence + state_dict tests.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+
+def _make_param(shape=(4, 3), seed=0):
+    rng = np.random.RandomState(seed)
+    p = paddle.Parameter(rng.randn(*shape).astype(np.float32))
+    g = rng.randn(*shape).astype(np.float32)
+    p._grad = paddle.to_tensor(g).value
+    return p, g
+
+
+def _run_steps(opt_cls, np_rule, steps=3, **kw):
+    rng = np.random.RandomState(0)
+    p0 = rng.randn(4, 3).astype(np.float32)
+    p = paddle.Parameter(p0.copy())
+    opt = opt_cls(learning_rate=0.1, parameters=[p], **kw)
+    ref_p = p0.copy()
+    state = {}
+    for t in range(1, steps + 1):
+        g = rng.randn(4, 3).astype(np.float32)
+        p._grad = paddle.to_tensor(g).value
+        opt.step()
+        ref_p, state = np_rule(ref_p, g, state, 0.1, t)
+    # fp32 on-device vs float64 numpy scalar math → ~1e-4 relative
+    np.testing.assert_allclose(p.numpy(), ref_p, rtol=5e-4, atol=5e-5)
+
+
+def test_sgd():
+    def rule(p, g, s, lr, t):
+        return p - lr * g, s
+    _run_steps(optimizer.SGD, rule)
+
+
+def test_momentum():
+    def rule(p, g, s, lr, t):
+        v = s.get("v", np.zeros_like(p))
+        v = 0.9 * v + g
+        return p - lr * v, {"v": v}
+    _run_steps(optimizer.Momentum, rule, momentum=0.9)
+
+
+def test_momentum_nesterov():
+    def rule(p, g, s, lr, t):
+        v = s.get("v", np.zeros_like(p))
+        v = 0.9 * v + g
+        return p - lr * (g + 0.9 * v), {"v": v}
+    _run_steps(optimizer.Momentum, rule, momentum=0.9, use_nesterov=True)
+
+
+def test_adam():
+    def rule(p, g, s, lr, t):
+        m = s.get("m", np.zeros_like(p))
+        v = s.get("v", np.zeros_like(p))
+        m = 0.9 * m + 0.1 * g
+        v = 0.999 * v + 0.001 * g * g
+        lr_t = lr * np.sqrt(1 - 0.999 ** t) / (1 - 0.9 ** t)
+        return p - lr_t * m / (np.sqrt(v) + 1e-8), {"m": m, "v": v}
+    _run_steps(optimizer.Adam, rule)
+
+
+def test_adamw_decoupled_decay():
+    wd = 0.01
+
+    def rule(p, g, s, lr, t):
+        p = p * (1 - lr * wd)
+        m = s.get("m", np.zeros_like(p))
+        v = s.get("v", np.zeros_like(p))
+        m = 0.9 * m + 0.1 * g
+        v = 0.999 * v + 0.001 * g * g
+        lr_t = lr * np.sqrt(1 - 0.999 ** t) / (1 - 0.9 ** t)
+        return p - lr_t * m / (np.sqrt(v) + 1e-8), {"m": m, "v": v}
+    _run_steps(optimizer.AdamW, rule, weight_decay=wd)
+
+
+def test_adagrad():
+    def rule(p, g, s, lr, t):
+        acc = s.get("acc", np.zeros_like(p)) + g * g
+        return p - lr * g / (np.sqrt(acc) + 1e-6), {"acc": acc}
+    _run_steps(optimizer.Adagrad, rule)
+
+
+def test_rmsprop():
+    def rule(p, g, s, lr, t):
+        ms = s.get("ms", np.zeros_like(p))
+        mom = s.get("mom", np.zeros_like(p))
+        ms = 0.95 * ms + 0.05 * g * g
+        mom = 0.0 * mom + lr * g / np.sqrt(ms + 1e-6)
+        return p - mom, {"ms": ms, "mom": mom}
+    _run_steps(optimizer.RMSProp, rule)
+
+
+def test_adamax():
+    def rule(p, g, s, lr, t):
+        m = s.get("m", np.zeros_like(p))
+        u = s.get("u", np.zeros_like(p))
+        m = 0.9 * m + 0.1 * g
+        u = np.maximum(0.999 * u, np.abs(g))
+        return p - (lr / (1 - 0.9 ** t)) * m / (u + 1e-8), {"m": m, "u": u}
+    _run_steps(optimizer.Adamax, rule)
+
+
+def test_adadelta():
+    def rule(p, g, s, lr, t):
+        rho, eps = 0.95, 1e-6
+        sq = s.get("sq", np.zeros_like(p))
+        du = s.get("du", np.zeros_like(p))
+        sq = rho * sq + (1 - rho) * g * g
+        upd = g * np.sqrt(du + eps) / np.sqrt(sq + eps)
+        du = rho * du + (1 - rho) * upd * upd
+        return p - lr * upd, {"sq": sq, "du": du}
+    _run_steps(optimizer.Adadelta, rule)
+
+
+def test_coupled_weight_decay():
+    wd = 0.1
+
+    def rule(p, g, s, lr, t):
+        return p - lr * (g + wd * p), s
+    _run_steps(optimizer.SGD, rule, weight_decay=wd)
+
+
+def test_lamb_runs_and_converges():
+    p = paddle.Parameter(np.ones((8,), np.float32) * 5)
+    opt = optimizer.Lamb(learning_rate=0.1, parameters=[p],
+                         lamb_weight_decay=0.0)
+    for _ in range(50):
+        # grad of 0.5*||p||^2
+        p._grad = p.value
+        opt.step()
+    assert np.abs(p.numpy()).max() < 5.0
+
+
+def test_training_convergence_linear_regression():
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 4).astype(np.float32)
+    w_true = np.array([[1.], [-2.], [3.], [0.5]], np.float32)
+    y = x @ w_true
+
+    lin = nn.Linear(4, 1)
+    opt = optimizer.Adam(learning_rate=0.1, parameters=lin.parameters())
+    xt, yt = paddle.to_tensor(x), paddle.to_tensor(y)
+    for _ in range(200):
+        loss = ((lin(xt) - yt) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    np.testing.assert_allclose(lin.weight.numpy(), w_true, atol=0.05)
+
+
+def test_grad_clip_global_norm():
+    p, g = _make_param()
+    clip = nn.ClipGradByGlobalNorm(clip_norm=0.001)
+    opt = optimizer.SGD(learning_rate=1.0, parameters=[p], grad_clip=clip)
+    before = p.numpy().copy()
+    opt.step()
+    delta = np.linalg.norm(p.numpy() - before)
+    assert delta <= 0.001 + 1e-5
+
+
+def test_state_dict_roundtrip():
+    p, g = _make_param()
+    opt = optimizer.Adam(learning_rate=0.01, parameters=[p])
+    opt.step()
+    sd = opt.state_dict()
+
+    p2 = paddle.Parameter(p.numpy())
+    p2.name = p.name
+    opt2 = optimizer.Adam(learning_rate=0.01, parameters=[p2])
+    opt2.set_state_dict(sd)
+    assert opt2._step_count == 1
+    m1 = opt._accumulators["moment1"][p.name]
+    m2 = opt2._accumulators["moment1"][p.name]
+    np.testing.assert_allclose(np.asarray(m1), np.asarray(m2))
+
+
+def test_multi_precision_master_weights():
+    rng = np.random.RandomState(0)
+    p = paddle.Parameter(rng.randn(16).astype(np.float32))
+    p.value = p.value.astype("bfloat16")
+    opt = optimizer.Adam(learning_rate=1e-3, parameters=[p],
+                         multi_precision=True)
+    p._grad = paddle.to_tensor(rng.randn(16).astype(np.float32)).value
+    opt.step()
+    assert "master" in opt._accumulators
+    master = opt._accumulators["master"][p.name]
+    assert str(master.dtype) == "float32"
+    assert str(p.value.dtype) == "bfloat16"
+
+
+def test_lr_scheduler_feeds_optimizer():
+    p, _ = _make_param()
+    sched = optimizer.lr.StepDecay(learning_rate=0.1, step_size=2, gamma=0.5)
+    opt = optimizer.SGD(learning_rate=sched, parameters=[p])
+    assert opt.get_lr() == pytest.approx(0.1)
+    sched.step()
+    sched.step()
+    assert opt.get_lr() == pytest.approx(0.05)
+
+
+def test_schedulers_values():
+    lr = optimizer.lr
+    s = lr.PiecewiseDecay(boundaries=[2, 4], values=[1.0, 0.5, 0.1])
+    vals = []
+    for _ in range(5):
+        vals.append(s())
+        s.step()
+    assert vals == [1.0, 1.0, 0.5, 0.5, 0.1]
+
+    s = lr.ExponentialDecay(learning_rate=1.0, gamma=0.5)
+    s.step()
+    assert s() == pytest.approx(0.5)
+
+    s = lr.CosineAnnealingDecay(learning_rate=1.0, T_max=10)
+    assert s() == pytest.approx(1.0)
+    s.step(10)
+    assert s() == pytest.approx(0.0, abs=1e-6)
+
+    s = lr.LinearWarmup(learning_rate=1.0, warmup_steps=4, start_lr=0.0,
+                        end_lr=1.0)
+    assert s() == pytest.approx(0.0)
+    s.step()
+    assert s() == pytest.approx(0.25)
+    s.step(4)
+    assert s() == pytest.approx(1.0)
+
+    s = lr.NoamDecay(d_model=512, warmup_steps=10, learning_rate=1.0)
+    s.step(5)
+    expect = (512 ** -0.5) * min(5 ** -0.5, 5 * 10 ** -1.5)
+    assert s() == pytest.approx(expect)
+
+    s = lr.ReduceOnPlateau(learning_rate=1.0, patience=1, factor=0.5)
+    s.step(metrics=1.0)
+    s.step(metrics=1.0)
+    s.step(metrics=1.0)
+    assert s() == pytest.approx(0.5)
+
+
+def test_minimize_api():
+    p = paddle.Parameter(np.ones((3,), np.float32))
+    opt = optimizer.SGD(learning_rate=0.5, parameters=[p])
+    loss = (paddle.Tensor(p.value, stop_gradient=True) * 0).sum()  # dummy
+    x = p * p
+    l2 = x.sum()
+    opt.minimize(l2)
+    np.testing.assert_allclose(p.numpy(), 1 - 0.5 * 2, rtol=1e-6)
+
+
+def test_adamw_apply_decay_param_fun():
+    rng = np.random.RandomState(0)
+    v = rng.randn(4).astype(np.float32)
+    g = np.zeros(4, np.float32)  # zero grad isolates the decay term
+    p_decay = paddle.Parameter(v.copy())
+    p_skip = paddle.Parameter(v.copy())
+    names = {p_decay.name}
+    opt = optimizer.AdamW(learning_rate=0.1, weight_decay=0.5,
+                          parameters=[p_decay, p_skip],
+                          apply_decay_param_fun=lambda n: n in names)
+    p_decay._grad = paddle.to_tensor(g).value
+    p_skip._grad = paddle.to_tensor(g).value
+    opt.step()
+    np.testing.assert_allclose(p_decay.numpy(), v * (1 - 0.1 * 0.5), rtol=1e-6)
+    np.testing.assert_allclose(p_skip.numpy(), v, rtol=1e-6)
+
+
+def test_adamw_lr_ratio():
+    v = np.ones(4, np.float32)
+    g = np.ones(4, np.float32)
+    p_full = paddle.Parameter(v.copy())
+    p_tenth = paddle.Parameter(v.copy())
+    tenth_id = id(p_tenth)
+    opt = optimizer.AdamW(learning_rate=0.1, weight_decay=0.0,
+                          parameters=[p_full, p_tenth],
+                          lr_ratio=lambda p: 0.1 if id(p) == tenth_id else 1.0)
+    p_full._grad = paddle.to_tensor(g).value
+    p_tenth._grad = paddle.to_tensor(g).value
+    opt.step()
+    d_full = 1.0 - p_full.numpy()[0]
+    d_tenth = 1.0 - p_tenth.numpy()[0]
+    np.testing.assert_allclose(d_tenth, d_full * 0.1, rtol=1e-4)
+
+
+def test_lamb_exclude_from_weight_decay():
+    v = np.ones(4, np.float32) * 2
+    p_in = paddle.Parameter(v.copy())
+    p_out = paddle.Parameter(v.copy())
+    out_id = id(p_out)
+    opt = optimizer.Lamb(learning_rate=0.1, lamb_weight_decay=0.5,
+                         parameters=[p_in, p_out],
+                         exclude_from_weight_decay_fn=lambda p: id(p) == out_id)
+    z = np.zeros(4, np.float32)
+    p_in._grad = paddle.to_tensor(z).value
+    p_out._grad = paddle.to_tensor(z).value
+    opt.step()
+    # excluded param sees zero update (zero grad, no decay); included decays
+    np.testing.assert_allclose(p_out.numpy(), v, rtol=1e-6)
+    assert p_in.numpy()[0] < 2.0
+
+
+def test_per_param_regularizer_overrides():
+    v = np.ones(4, np.float32)
+    g = np.zeros(4, np.float32)
+    p = paddle.Parameter(v.copy())
+    p.regularizer = optimizer.L2Decay(0.5)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=[p], weight_decay=0.0)
+    p._grad = paddle.to_tensor(g).value
+    opt.step()
+    # coupled decay: p -= lr * coeff * p
+    np.testing.assert_allclose(p.numpy(), v - 0.1 * 0.5 * v, rtol=1e-6)
+
+
+def test_state_dict_prefix_names_no_collision():
+    pa = paddle.Parameter(np.ones(2, np.float32))
+    pb = paddle.Parameter(np.ones(3, np.float32))
+    pa.name, pb.name = "w", "w_ho"
+    opt = optimizer.Adam(learning_rate=0.01, parameters=[pa, pb])
+    pa._grad = paddle.to_tensor(np.ones(2, np.float32)).value
+    pb._grad = paddle.to_tensor(np.ones(3, np.float32)).value
+    opt.step()
+    sd = opt.state_dict()
+
+    qa = paddle.Parameter(np.ones(2, np.float32))
+    qb = paddle.Parameter(np.ones(3, np.float32))
+    qa.name, qb.name = "w", "w_ho"
+    opt2 = optimizer.Adam(learning_rate=0.01, parameters=[qa, qb])
+    opt2.set_state_dict(sd)
+    assert opt2._accumulators["moment1"]["w"].shape == (2,)
+    assert opt2._accumulators["moment1"]["w_ho"].shape == (3,)
+
+
+def test_functional_apply_gradients_named_tree():
+    params = {"linear.weight": np.ones((2, 2), np.float32),
+              "norm.bias": np.ones((2,), np.float32)}
+    grads = {k: np.zeros_like(v) for k, v in params.items()}
+    opt = optimizer.AdamW(learning_rate=0.1, weight_decay=0.5,
+                          parameters=[paddle.Parameter(np.zeros(1))],
+                          apply_decay_param_fun=lambda n: "bias" not in n)
+    state = opt.init(params)
+    new_p, _ = opt.apply_gradients(params, grads, state)
+    np.testing.assert_allclose(np.asarray(new_p["norm.bias"]),
+                               params["norm.bias"], rtol=1e-6)
+    assert np.asarray(new_p["linear.weight"])[0, 0] < 1.0
+
+
+def test_reduce_on_plateau_cooldown_suppresses():
+    s = optimizer.lr.ReduceOnPlateau(learning_rate=1.0, patience=0,
+                                     factor=0.5, cooldown=3)
+    s.step(metrics=1.0)   # best=1.0
+    s.step(metrics=2.0)   # bad -> reduce, cooldown starts
+    assert s() == pytest.approx(0.5)
+    s.step(metrics=2.0)   # cooling down: no further reduce
+    s.step(metrics=2.0)
+    assert s() == pytest.approx(0.5)
