@@ -95,14 +95,18 @@ class Node:
     (tensor_wrapper.h) saved tensors.
     """
 
-    __slots__ = ("vjp_fn", "inputs", "n_outputs", "out_avals", "name")
+    __slots__ = ("vjp_fn", "inputs", "n_outputs", "out_avals", "name", "multi")
 
-    def __init__(self, vjp_fn, inputs, n_outputs, out_avals, name=""):
+    def __init__(self, vjp_fn, inputs, n_outputs, out_avals, name="",
+                 multi=None):
         self.vjp_fn = vjp_fn
         self.inputs = inputs          # List[Tensor] (the differentiable ones)
         self.n_outputs = n_outputs
         self.out_avals = out_avals    # [(shape, dtype)] for zero-cotangent synth
         self.name = name
+        # whether fn returned a tuple/list (the vjp cotangent must mirror the
+        # primal output structure exactly, even for 1-element tuples)
+        self.multi = (n_outputs > 1) if multi is None else multi
 
 
 def _is_diff_value(v) -> bool:
@@ -139,7 +143,7 @@ def apply(fn, *inputs, _op_name: str = "", **kwargs):
     outs = list(out) if multi else [out]
     avals = [(getattr(o, "shape", ()), getattr(o, "dtype", None)) for o in outs]
     node = Node(vjp_fn, [inputs[i] for i in diff_idx], len(outs), avals,
-                name=_op_name or getattr(fn, "__name__", "op"))
+                name=_op_name or getattr(fn, "__name__", "op"), multi=multi)
     return _wrap_outputs(out, node)
 
 
@@ -241,7 +245,7 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
                     "first backward() to keep it.")
             full_cts = [c if c is not None else _zeros_like_aval(a)
                         for c, a in zip(cts, node.out_avals)]
-            ct_arg = tuple(full_cts) if node.n_outputs > 1 else full_cts[0]
+            ct_arg = tuple(full_cts) if node.multi else full_cts[0]
             in_cts = node.vjp_fn(ct_arg)
             for t, ct in zip(node.inputs, in_cts):
                 if isinstance(ct, np.ndarray) and ct.dtype == jax.dtypes.float0:
@@ -315,7 +319,7 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
                         "retain_graph=True there.")
                 full_cts = [c if c is not None else _zeros_like_aval(a)
                             for c, a in zip(cts, node.out_avals)]
-                ct_arg = tuple(full_cts) if node.n_outputs > 1 else full_cts[0]
+                ct_arg = tuple(full_cts) if node.multi else full_cts[0]
                 in_cts = node.vjp_fn(ct_arg)
                 for t, ct in zip(node.inputs, in_cts):
                     if isinstance(ct, np.ndarray) and ct.dtype == jax.dtypes.float0:
