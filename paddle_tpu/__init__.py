@@ -48,6 +48,7 @@ from . import io as _io_mod  # noqa: F401,E402
 from .io import save, load  # noqa: F401,E402
 from .device import (  # noqa: F401,E402
     set_device, get_device, is_compiled_with_cuda, is_compiled_with_tpu)
+from .distributed.parallel import DataParallel  # noqa: E402  (paddle.DataParallel parity)
 
 # default dtype management (paddle.set_default_dtype)
 _default_dtype = "float32"

@@ -1,1 +1,10 @@
+"""paddle.amp parity (SURVEY.md §2.8 AMP row): O1/O2 autocast over the tape
+dispatch point, GradScaler dynamic loss scaling, O2 decorate with fp32
+master weights. TPU default amp dtype is bfloat16 (native MXU)."""
+from .auto_cast import (amp_guard, auto_cast, black_list, decorate,
+                        is_bfloat16_supported, is_float16_supported,
+                        white_list)
+from .grad_scaler import GradScaler
 
+__all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler", "white_list",
+           "black_list", "is_bfloat16_supported", "is_float16_supported"]

@@ -1,0 +1,178 @@
+"""Automatic mixed precision.
+
+Parity: python/paddle/amp/auto_cast.py + fluid/dygraph/amp/auto_cast.py:296
+(`amp_guard`), white/black op lists from static/amp/fp16_lists.py, O2
+decoration (`amp_decorate`). TPU-first: bfloat16 is the native MXU dtype, so
+it is the default amp dtype (reference defaults to float16 for CUDA tensor
+cores). The cast hook lives at the tape's single op-dispatch point
+(autograd.tape.apply) — the analog of the generated *_ad_func AMP blocks
+(paddle/fluid/eager/auto_code_generator/generator/eager_gen.py AMP section,
+amp_utils.h) but one hook instead of per-op codegen.
+
+Levels: O1 casts whitelisted-op float inputs down and blacklisted-op inputs
+up; O2 casts everything except the blacklist down (params stay low-precision
+via `decorate`; optimizers keep fp32 master weights via multi_precision).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Iterable, Optional, Set
+
+import jax.numpy as jnp
+
+from ..framework import dtype as dtypes
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "white_list", "black_list",
+           "is_bfloat16_supported", "is_float16_supported"]
+
+# ops whose fp32 inputs are cast DOWN under O1 (MXU-bound ops; reference
+# fp16_lists.py white_list: conv2d/matmul/einsum/mul/...)
+WHITE_LIST: Set[str] = {
+    "matmul", "bmm", "mv", "dot", "einsum", "linear",
+    "conv1d", "conv2d", "conv3d",
+    "conv1d_transpose", "conv2d_transpose", "conv3d_transpose",
+    "flash_attention", "flash_attn_unpadded", "bilinear", "addmm",
+}
+
+# ops forced to fp32 under O1/O2 (numerically sensitive reductions/exp/log;
+# reference fp16_lists.py black_list)
+BLACK_LIST: Set[str] = {
+    "exp", "log", "log2", "log10", "log1p", "mean", "sum", "prod",
+    "softmax", "log_softmax", "cross_entropy", "binary_cross_entropy",
+    "bce_with_logits", "nll_loss", "kl_div", "softmax_with_cross_entropy",
+    "cosine_similarity", "norm", "var", "std", "renorm", "logsumexp",
+    "cumsum", "cumprod", "erfinv", "pow", "square", "sigmoid_focal_loss",
+    "margin_cross_entropy", "ctc_loss", "mse_loss", "smooth_l1_loss",
+}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.level = "O1"
+        self.dtype = jnp.bfloat16
+        self.white: Set[str] = WHITE_LIST
+        self.black: Set[str] = BLACK_LIST
+
+
+_amp_state = _AmpState()
+
+
+def amp_state():
+    return _amp_state
+
+
+def _cast_value(v, dt):
+    if hasattr(v, "dtype") and dtypes.is_inexact(v.dtype) and v.dtype != dt \
+            and v.dtype not in (jnp.float64,):
+        return v.astype(dt)
+    return v
+
+
+def maybe_cast_inputs(op_name: str, raw_values: list) -> list:
+    """Called from tape.apply on every eager op when AMP is active."""
+    st = _amp_state
+    if not st.enabled or not op_name:
+        return raw_values
+    if op_name in st.black:
+        return [_cast_value(v, jnp.float32) for v in raw_values]
+    if st.level == "O2" or op_name in st.white:
+        return [_cast_value(v, st.dtype) for v in raw_values]
+    return raw_values
+
+
+class auto_cast:
+    """Context manager enabling AMP. Parity: paddle.amp.auto_cast /
+    amp_guard (fluid/dygraph/amp/auto_cast.py:296)."""
+
+    def __init__(self, enable=True, custom_white_list: Optional[Iterable] = None,
+                 custom_black_list: Optional[Iterable] = None, level="O1",
+                 dtype="bfloat16"):
+        if level not in ("O0", "O1", "O2"):
+            raise ValueError(f"level must be O0/O1/O2, got {level}")
+        self._enable = enable and level != "O0"
+        self._level = level
+        self._dtype = dtypes.convert_dtype(dtype)
+        self._white = set(WHITE_LIST) | set(custom_white_list or ())
+        self._black = (set(BLACK_LIST) | set(custom_black_list or ())) \
+            - set(custom_white_list or ())
+
+    def __enter__(self):
+        st = _amp_state
+        # stack, not a single slot: the same instance is re-entered when
+        # used as a decorator on recursive/nested functions
+        if not hasattr(self, "_saved_stack"):
+            self._saved_stack = []
+        self._saved_stack.append(
+            (st.enabled, st.level, st.dtype, st.white, st.black))
+        st.enabled = self._enable
+        st.level = self._level
+        st.dtype = self._dtype
+        st.white = self._white
+        st.black = self._black
+        return self
+
+    def __exit__(self, *exc):
+        st = _amp_state
+        (st.enabled, st.level, st.dtype, st.white,
+         st.black) = self._saved_stack.pop()
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with self:
+                return fn(*args, **kwargs)
+        return wrapper
+
+
+amp_guard = auto_cast
+
+
+def white_list():
+    return set(_amp_state.white)
+
+
+def black_list():
+    return set(_amp_state.black)
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2 decoration: cast model params to the amp dtype and switch the
+    optimizer to fp32 master weights.
+
+    Parity: paddle.amp.decorate (fluid/dygraph/amp/auto_cast.py
+    amp_decorate); master weights follow the reference's multi_precision
+    optimizer path.
+    """
+    if level not in ("O1", "O2"):
+        raise ValueError("decorate level must be O1 or O2")
+    single_model = not isinstance(models, (list, tuple))
+    single_opt = optimizers is not None and \
+        not isinstance(optimizers, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    opt_list = [] if optimizers is None else (
+        [optimizers] if single_opt else list(optimizers))
+
+    if level == "O2":
+        dt = dtypes.convert_dtype(dtype)
+        for m in model_list:
+            m.astype(str(dt))
+        for opt in opt_list:
+            if master_weight is not False:
+                opt._multi_precision = True
+    if optimizers is None:
+        return models if single_model else model_list
+    return ((models if single_model else model_list),
+            (optimizers if single_opt else opt_list))
+
+
+def is_bfloat16_supported(device=None):
+    return True  # every TPU generation computes natively in bfloat16
+
+
+def is_float16_supported(device=None):
+    return True

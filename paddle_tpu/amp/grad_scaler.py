@@ -1,0 +1,142 @@
+"""Dynamic loss scaling.
+
+Parity: paddle.amp.GradScaler (python/paddle/amp/grad_scaler.py:152 `scale`,
+:189 `minimize`) and the update_loss_scaling/check_finite_and_unscale ops
+(paddle/fluid/operators/amp/). TPU-first: the unscale + global finite check
+runs as ONE jitted program over the whole grad pytree (the reference launches
+a CUDA kernel per tensor); scaling state lives in plain python scalars, so
+the update logic is ordinary control flow.
+
+Note: on TPU the default amp dtype is bfloat16, whose exponent range equals
+fp32 — loss scaling is then unnecessary and `enable=False` is typical; the
+full fp16 semantics are kept for parity and for fp16 inference parts.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["GradScaler"]
+
+
+@jax.jit
+def _unscale_and_check(grads, inv_scale):
+    new = jax.tree_util.tree_map(
+        lambda g: (g.astype(jnp.float32) * inv_scale), grads)
+    finite = jnp.array(True)
+    for g in jax.tree_util.tree_leaves(new):
+        finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(g)))
+    return new, finite
+
+
+class GradScaler:
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 16,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale_value = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n_nan_or_inf = decr_every_n_nan_or_inf
+        self._use_dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._unscaled = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._use_dynamic
+
+    def get_loss_scaling(self):
+        return self._scale_value
+
+    # -- API ------------------------------------------------------------
+    def scale(self, loss: Tensor) -> Tensor:
+        """Multiply the loss by the current scale (recorded on the tape so
+        backward produces scaled grads)."""
+        if not self._enable:
+            return loss
+        return loss * self._scale_value
+
+    def unscale_(self, optimizer):
+        """Divide the optimizer's param grads by the scale; set found_inf.
+        Parity: GradScaler._unscale (grad_scaler.py)."""
+        if not self._enable or self._unscaled:
+            return
+        params = [p for p in optimizer._parameter_list if p._grad is not None]
+        if params:
+            grads = [p._grad for p in params]
+            inv = jnp.float32(1.0 / self._scale_value)
+            new_grads, finite = _unscale_and_check(grads, inv)
+            self._found_inf = not bool(finite)
+            for p, g in zip(params, new_grads):
+                p._grad = g.astype(p.value.dtype) \
+                    if not _needs_f32_grad(p) else g
+        else:
+            self._found_inf = False
+        self._unscaled = True
+
+    def step(self, optimizer):
+        """unscale + conditional optimizer.step(). Parity: scaler.step."""
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+
+    def update(self):
+        """Adjust the scale after a step. Parity: update_loss_scaling op
+        semantics (operators/amp/update_loss_scaling_op.h)."""
+        if not (self._enable and self._use_dynamic):
+            self._unscaled = False
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every_n_nan_or_inf:
+                self._scale_value = max(self._scale_value * self._decr_ratio,
+                                        1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every_n_steps:
+                self._scale_value *= self._incr_ratio
+                self._good_steps = 0
+        self._unscaled = False
+        self._found_inf = False
+
+    def minimize(self, optimizer, scaled_loss):
+        """Parity: scaler.minimize(optimizer, scaled) — the classic eager
+        loop: scaled.backward() then scaler.minimize(opt, scaled)."""
+        self.step(optimizer)
+        self.update()
+
+    # -- state ----------------------------------------------------------
+    def state_dict(self):
+        return {"scale": self._scale_value,
+                "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "incr_every_n_steps": self._incr_every_n_steps,
+                "decr_every_n_nan_or_inf": self._decr_every_n_nan_or_inf,
+                "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps,
+                "use_dynamic_loss_scaling": self._use_dynamic}
+
+    def load_state_dict(self, state):
+        self._scale_value = float(state.get("scale", self._scale_value))
+        self._good_steps = state.get("good_steps", 0)
+        self._bad_steps = state.get("bad_steps", 0)
+
+
+def _needs_f32_grad(p):
+    return str(p.value.dtype) == "float32"

@@ -31,6 +31,9 @@ class _GradState(threading.local):
 
 _grad_state = _GradState()
 
+# lazily-bound amp module (circular-import-safe, cached off the hot path)
+_amp = None
+
 
 def is_grad_enabled() -> bool:
     return _grad_state.enabled
@@ -122,6 +125,14 @@ def apply(fn, *inputs, _op_name: str = "", **kwargs):
     from ..core.tensor import Tensor, _wrap_single
 
     raw = [x.value if isinstance(x, Tensor) else x for x in inputs]
+    # AMP hook: the single dispatch point replacing the reference's per-op
+    # generated *_ad_func AMP casts (eager_gen.py AMP section)
+    global _amp
+    if _amp is None:
+        from ..amp.auto_cast import _amp_state, maybe_cast_inputs
+        _amp = (_amp_state, maybe_cast_inputs)
+    if _amp[0].enabled:
+        raw = _amp[1](_op_name, raw)
     diff_idx = []
     if _grad_state.enabled:
         for i, x in enumerate(inputs):

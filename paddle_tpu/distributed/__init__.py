@@ -1,1 +1,39 @@
+"""paddle.distributed parity over JAX device meshes (SURVEY.md §2.6, §5.8).
 
+The reference's stack — TCPStore rendezvous, ProcessGroupNCCL, 161
+collective ops, fleet topology/strategies — maps here to: the JAX runtime's
+pod formation, ONE global `jax.sharding.Mesh` with named axes
+(dp/sharding/pp/mp/sp/ep), eager collectives as jitted shard_map
+mini-programs, and parallelism expressed as shardings compiled by GSPMD
+(ParallelTrainStep).
+"""
+from . import fleet  # noqa: F401
+from .collective import (Group, ReduceOp, all_gather, all_gather_object,
+                         all_reduce, alltoall, alltoall_single, barrier,
+                         broadcast, get_group, irecv, isend, new_group,
+                         recv, reduce, reduce_scatter, scatter, send,
+                         stream)
+from .env import ParallelEnv, get_rank, get_world_size
+from .mesh import (Mesh, PartitionSpec, get_mesh, init_mesh, mesh_axis_size,
+                   named_sharding, set_mesh)
+from .parallel import DataParallel, init_parallel_env, is_initialized, \
+    shard_batch
+from .parallel_step import ParallelTrainStep, param_sharding, shard_params
+from .strategy import DistributedStrategy
+from .topology import (CommunicateTopology, HybridCommunicateGroup,
+                       get_hybrid_communicate_group,
+                       set_hybrid_communicate_group)
+
+__all__ = [
+    "init_parallel_env", "is_initialized", "get_rank", "get_world_size",
+    "ParallelEnv", "DataParallel", "shard_batch",
+    "Mesh", "PartitionSpec", "init_mesh", "get_mesh", "set_mesh",
+    "mesh_axis_size", "named_sharding",
+    "ReduceOp", "Group", "new_group", "get_group", "all_reduce",
+    "all_gather", "all_gather_object", "broadcast", "reduce", "scatter",
+    "reduce_scatter", "alltoall", "alltoall_single", "barrier", "send",
+    "recv", "isend", "irecv", "stream",
+    "DistributedStrategy", "CommunicateTopology", "HybridCommunicateGroup",
+    "get_hybrid_communicate_group", "set_hybrid_communicate_group",
+    "ParallelTrainStep", "param_sharding", "shard_params", "fleet",
+]

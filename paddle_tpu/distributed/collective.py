@@ -1,0 +1,341 @@
+"""Eager collective communication API.
+
+Parity: paddle.distributed.{all_reduce,all_gather,broadcast,reduce,scatter,
+reduce_scatter,alltoall,barrier,send,recv} (python/paddle/distributed/
+communication/*.py) and the ProcessGroup API surface
+(paddle/fluid/distributed/collective/process_group.h:53). TPU-native
+realization (SURVEY.md §5.8 item (a)): there is no NCCL call — each
+collective is a tiny jitted `shard_map` program whose HLO collective XLA
+schedules over ICI/DCN.
+
+Distributed-tensor convention: in the reference each of N processes holds a
+local tensor of shape S; here ONE controller holds the global stacked array
+of shape [N, *S], sharded along dim 0 over the group's mesh axis — slice i
+is "rank i's tensor". Every collective below maps the reference's per-rank
+semantics onto that stacked array (e.g. all_reduce makes every slice equal
+to the elementwise reduction, exactly what each rank observes after the
+reference op). This doubles as the backend-agnostic simulated ProcessGroup
+the reference lacks for unit tests (SURVEY.md §4).
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from . import mesh as mesh_mod
+
+__all__ = ["ReduceOp", "Group", "new_group", "get_group", "all_reduce",
+           "all_gather", "all_gather_object", "broadcast", "reduce",
+           "scatter", "reduce_scatter", "alltoall", "alltoall_single",
+           "barrier", "send", "recv", "isend", "irecv", "stream"]
+
+
+class ReduceOp:
+    """Parity: paddle.distributed.ReduceOp."""
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+def _pprod(x, axis_name):
+    """Product reduction via log-magnitude psum with sign/zero tracking
+    (log alone NaNs on negatives and -infs on zeros)."""
+    mag = jnp.exp(lax.psum(jnp.log(jnp.abs(x)), axis_name))
+    neg_parity = lax.psum((x < 0).astype(jnp.int32), axis_name) % 2
+    sign = jnp.where(neg_parity == 1, -1.0, 1.0).astype(x.dtype)
+    any_zero = lax.psum((x == 0).astype(jnp.int32), axis_name) > 0
+    return jnp.where(any_zero, jnp.zeros_like(x), sign * mag)
+
+
+_REDUCERS = {
+    ReduceOp.SUM: lax.psum,
+    ReduceOp.MAX: lax.pmax,
+    ReduceOp.MIN: lax.pmin,
+    ReduceOp.PROD: lambda x, axis_name: _pprod(x, axis_name),
+    ReduceOp.AVG: lambda x, axis_name: lax.pmean(x, axis_name),
+}
+
+
+class Group:
+    """A communication group = one named mesh axis.
+
+    Parity: paddle.distributed.collective.Group; where the reference builds
+    an NCCL ring per group (new_group, collective.py:185), here a group
+    names the mesh axis its collectives psum/ppermute over.
+    """
+
+    def __init__(self, axis: str, mesh=None, gid: int = 0):
+        self.axis = axis
+        self._mesh = mesh
+        self.id = gid
+
+    @property
+    def mesh(self):
+        return self._mesh or mesh_mod.get_mesh()
+
+    @property
+    def nranks(self) -> int:
+        return int(self.mesh.shape.get(self.axis, 1))
+
+    world_size = nranks
+
+    @property
+    def rank(self) -> int:
+        return 0  # single controller drives all shards
+
+    @property
+    def ranks(self) -> List[int]:
+        return list(range(self.nranks))
+
+    def get_group_rank(self, rank):
+        return rank if 0 <= rank < self.nranks else -1
+
+    def __repr__(self):
+        return f"Group(axis={self.axis!r}, nranks={self.nranks})"
+
+
+_groups = {}
+_next_gid = [1]
+
+
+def new_group(ranks: Optional[Sequence[int]] = None, backend=None,
+              axis: Optional[str] = None) -> Group:
+    """Create a group. TPU-native: groups are mesh axes; `axis` selects one
+    ("dp", "mp", ...). `ranks` is accepted for API parity and must be
+    either None (whole default axis) or a prefix-check of that axis."""
+    mesh = mesh_mod.get_mesh()
+    if axis is None:
+        axis = mesh.axis_names[0]
+    g = Group(axis, gid=_next_gid[0])
+    _next_gid[0] += 1
+    _groups[g.id] = g
+    return g
+
+
+def get_group(gid: int = 0) -> Optional[Group]:
+    return _groups.get(gid)
+
+
+def _default_group() -> Group:
+    mesh = mesh_mod.get_mesh()
+    return Group(mesh.axis_names[0])
+
+
+def _raw(t):
+    return t.value if isinstance(t, Tensor) else jnp.asarray(t)
+
+
+def _stacked_specs(group: Group, x):
+    """Input [N, *S] sharded over the group axis on dim 0."""
+    mesh = group.mesh
+    n = group.nranks
+    if x.shape[0] != n:
+        raise ValueError(
+            f"stacked distributed tensor must have leading dim == group "
+            f"size {n}, got shape {tuple(x.shape)} (see module docstring)")
+    return mesh, P(group.axis), n
+
+
+@functools.lru_cache(maxsize=256)
+def _collective_program(kind: str, axis: str, mesh, op: str):
+    """Build+cache one jitted shard_map mini-program per (op, axis, mesh)."""
+    spec = P(axis)
+
+    if kind == "all_reduce":
+        def body(x):
+            r = _REDUCERS[op](x, axis)
+            return jnp.broadcast_to(r, x.shape)
+        out_spec = spec
+    elif kind == "all_gather":
+        def body(x):
+            return lax.all_gather(x, axis, axis=0, tiled=True)
+        out_spec = P()  # replicated result
+    elif kind == "reduce_scatter":
+        def body(x):
+            # local shard [1, N*k, ...] -> rank's block [1, k, ...]
+            return lax.psum_scatter(x[0], axis, scatter_dimension=0,
+                                    tiled=True)[None]
+        out_spec = spec
+    elif kind == "alltoall":
+        def body(x):
+            return lax.all_to_all(x, axis, split_axis=0, concat_axis=0,
+                                  tiled=True)
+        out_spec = spec
+    else:
+        raise ValueError(kind)
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec,), out_specs=out_spec)
+    return jax.jit(fn)
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
+               sync_op=True):
+    """Every rank-slice becomes the elementwise reduction over the group.
+    Parity: paddle.distributed.all_reduce."""
+    group = group or _default_group()
+    x = _raw(tensor)
+    mesh, spec, n = _stacked_specs(group, x)
+    prog = _collective_program("all_reduce", group.axis, mesh, op)
+    out = prog(jax.device_put(x, NamedSharding(mesh, P(group.axis))))
+    if isinstance(tensor, Tensor):
+        tensor.value = out
+        return tensor
+    return Tensor(out)
+
+
+def all_gather(tensor_list: Optional[List], tensor, group=None, sync_op=True):
+    """tensor_list receives every rank's slice (replicated).
+    Parity: paddle.distributed.all_gather."""
+    group = group or _default_group()
+    x = _raw(tensor)
+    mesh, _, n = _stacked_specs(group, x)
+    # replicate the stack: XLA emits one all-gather over the axis
+    out = jax.jit(lambda a: a,
+                  out_shardings=NamedSharding(mesh, P()))(x)
+    slices = [Tensor(out[i]) for i in range(n)]
+    if tensor_list is not None:
+        tensor_list.extend(slices)
+    return slices
+
+
+def all_gather_object(object_list: List, obj, group=None):
+    """Single-controller: every rank's python object is already here."""
+    group = group or _default_group()
+    object_list.extend([obj] * group.nranks)
+    return object_list
+
+
+def broadcast(tensor, src: int = 0, group=None, sync_op=True):
+    """Every slice becomes slice `src`. Parity: paddle.distributed.broadcast."""
+    group = group or _default_group()
+    x = _raw(tensor)
+    mesh, _, n = _stacked_specs(group, x)
+    out = jax.jit(
+        lambda a: jnp.broadcast_to(a[src], a.shape),
+        out_shardings=NamedSharding(mesh, P(group.axis)))(x)
+    if isinstance(tensor, Tensor):
+        tensor.value = out
+        return tensor
+    return Tensor(out)
+
+
+def reduce(tensor, dst: int = 0, op=ReduceOp.SUM, group=None, sync_op=True):
+    """Slice `dst` gets the reduction; other slices keep their values.
+    Parity: paddle.distributed.reduce."""
+    group = group or _default_group()
+    x = _raw(tensor)
+    mesh, _, n = _stacked_specs(group, x)
+    red = _collective_program("all_reduce", group.axis, mesh, op)(
+        jax.device_put(x, NamedSharding(mesh, P(group.axis))))
+    out = jnp.where(
+        (jnp.arange(n) == dst).reshape((n,) + (1,) * (x.ndim - 1)), red, x)
+    if isinstance(tensor, Tensor):
+        tensor.value = out
+        return tensor
+    return Tensor(out)
+
+
+def scatter(tensor, tensor_list=None, src: int = 0, group=None, sync_op=True):
+    """Rank i receives tensor_list[i] (from rank src's list).
+    Parity: paddle.distributed.scatter — the output stacked array is simply
+    the stacked tensor_list sharded over the axis."""
+    group = group or _default_group()
+    n = group.nranks
+    if tensor_list is None:
+        raise ValueError("scatter requires tensor_list on src")
+    stack = jnp.stack([_raw(t) for t in tensor_list])
+    mesh = group.mesh
+    out = jax.device_put(stack, NamedSharding(mesh, P(group.axis)))
+    if isinstance(tensor, Tensor):
+        tensor.value = out
+        return tensor
+    return Tensor(out)
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
+                   group=None, sync_op=True):
+    """Input [N, N*K, ...] stacked: rank i gets sum over ranks of block i.
+    Parity: paddle.distributed.reduce_scatter; HLO reduce-scatter via
+    lax.psum_scatter."""
+    group = group or _default_group()
+    x = _raw(tensor_or_tensor_list) if not isinstance(
+        tensor_or_tensor_list, (list, tuple)) else jnp.stack(
+        [jnp.concatenate([_raw(t) for t in tensor_or_tensor_list])])
+    mesh, _, n = _stacked_specs(group, x)
+    prog = _collective_program("reduce_scatter", group.axis, mesh, op)
+    out = prog(jax.device_put(x, NamedSharding(mesh, P(group.axis))))
+    if isinstance(tensor, Tensor):
+        tensor.value = out
+        return tensor
+    return Tensor(out)
+
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    """Rank i sends in_list[j] to rank j. Stacked: global [N(src), N(dst),
+    *S] transposes its first two dims via HLO all-to-all.
+    Parity: paddle.distributed.alltoall."""
+    group = group or _default_group()
+    n = group.nranks
+    if isinstance(in_tensor_list, (list, tuple)):
+        x = jnp.stack([_raw(t) for t in in_tensor_list])
+    else:
+        x = _raw(in_tensor_list)
+    # x: [N_src, N_dst, *S] sharded on dim0 -> transpose first two dims
+    mesh = group.mesh
+    flat = x.reshape((n * x.shape[1],) + x.shape[2:])
+    prog = _collective_program("alltoall", group.axis, mesh, ReduceOp.SUM)
+    outf = prog(jax.device_put(flat, NamedSharding(mesh, P(group.axis))))
+    out = outf.reshape(x.shape)
+    slices = [Tensor(out[i]) for i in range(n)]
+    if out_tensor_list is not None:
+        out_tensor_list.extend(slices)
+    return slices
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    group = group or _default_group()
+    x = _raw(in_tensor)
+    mesh, _, n = _stacked_specs(group, x)
+    prog = _collective_program("alltoall", group.axis, mesh, ReduceOp.SUM)
+    out = prog(jax.device_put(x, NamedSharding(mesh, P(group.axis))))
+    if isinstance(out_tensor, Tensor):
+        out_tensor.value = out
+        return out_tensor
+    return Tensor(out)
+
+
+def barrier(group=None):
+    """Single-controller: device work is ordered by data dependencies; a
+    barrier is a host sync. Parity: paddle.distributed.barrier."""
+    (jax.device_put(jnp.zeros(()))).block_until_ready()
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "point-to-point send/recv between ranks has no eager analog under a "
+        "single controller; use ppermute inside compiled programs "
+        "(paddle_tpu.distributed.pipeline) or DCN RPC (future work)")
+
+
+recv = isend = irecv = send
+
+
+class stream:
+    """Parity shim for paddle.distributed.stream.* — collectives already
+    run on XLA-managed streams; these aliases keep reference code running."""
+    all_reduce = staticmethod(all_reduce)
+    all_gather = staticmethod(all_gather)
+    broadcast = staticmethod(broadcast)
+    reduce = staticmethod(reduce)
+    scatter = staticmethod(scatter)
+    reduce_scatter = staticmethod(reduce_scatter)
+    alltoall = staticmethod(alltoall)

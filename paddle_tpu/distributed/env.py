@@ -1,0 +1,76 @@
+"""Process/device environment.
+
+Parity: ParallelEnv (python/paddle/distributed/parallel.py:663) which reads
+PADDLE_TRAINER_* env vars. TPU-native: JAX's multi-controller runtime
+already knows process index/count and the device topology
+(jax.process_index / jax.devices), so env vars are only a fallback for the
+launcher; the "world" is the set of chips, and one process drives all chips
+local to its host (reference: one process per GPU).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+__all__ = ["ParallelEnv", "get_rank", "get_world_size"]
+
+
+class ParallelEnv:
+    """Parity: paddle.distributed.ParallelEnv (parallel.py:663)."""
+
+    def __init__(self):
+        self._device_id = int(os.environ.get("FLAGS_selected_devices", 0))
+
+    @property
+    def rank(self) -> int:
+        return int(os.environ.get("PADDLE_TRAINER_ID", jax.process_index()))
+
+    @property
+    def world_size(self) -> int:
+        n = os.environ.get("PADDLE_TRAINERS_NUM")
+        return int(n) if n else jax.process_count()
+
+    @property
+    def device_id(self) -> int:
+        return self._device_id
+
+    @property
+    def local_device_count(self) -> int:
+        return jax.local_device_count()
+
+    @property
+    def global_device_count(self) -> int:
+        return jax.device_count()
+
+    @property
+    def current_endpoint(self) -> str:
+        eps = self.trainer_endpoints
+        r = self.rank
+        return eps[r] if r < len(eps) else ""
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else []
+
+    @property
+    def nrings(self) -> int:
+        return int(os.environ.get("FLAGS_nccl_nrings", 1))
+
+
+def get_rank(group=None) -> int:
+    """Process rank (parity: paddle.distributed.get_rank)."""
+    return ParallelEnv().rank
+
+
+def get_world_size(group=None) -> int:
+    """Number of processes (parity: paddle.distributed.get_world_size).
+
+    Note: in the reference world == #GPUs because each process drives one
+    card; here a process drives all its local chips, so data parallelism
+    degree is usually `jax.device_count()`, not world_size.
+    """
+    if group is not None and hasattr(group, "nranks"):
+        return group.nranks
+    return ParallelEnv().world_size

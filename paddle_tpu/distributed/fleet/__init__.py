@@ -1,0 +1,96 @@
+"""fleet: the high-level distributed-training facade.
+
+Parity: paddle.distributed.fleet (python/paddle/distributed/fleet/fleet.py:168
+init, model.py:126-165 distributed_model dispatch,
+dygraph_optimizer/hybrid_parallel_optimizer.py:226). The reference wires
+NCCL groups + wrapper classes per parallel mode; here `init` installs the
+Mesh/HybridCommunicateGroup and the wrappers annotate shardings that the
+ParallelTrainStep compiles into one program.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ...nn.layer_base import Layer
+from ..parallel import DataParallel
+from ..strategy import DistributedStrategy
+from ..topology import (HybridCommunicateGroup,
+                        get_hybrid_communicate_group,
+                        set_hybrid_communicate_group)
+
+__all__ = ["init", "DistributedStrategy", "distributed_model",
+           "distributed_optimizer", "get_hybrid_communicate_group",
+           "worker_num", "worker_index", "is_first_worker"]
+
+_fleet_strategy: Optional[DistributedStrategy] = None
+
+
+def init(role_maker=None, is_collective: bool = True,
+         strategy: Optional[DistributedStrategy] = None):
+    """Parity: fleet.init (fleet.py:168)."""
+    global _fleet_strategy
+    strategy = strategy or DistributedStrategy()
+    _fleet_strategy = strategy
+    hcg = HybridCommunicateGroup(degrees=strategy.to_degrees())
+    set_hybrid_communicate_group(hcg)
+    return hcg
+
+
+def get_strategy() -> Optional[DistributedStrategy]:
+    return _fleet_strategy
+
+
+def distributed_model(model: Layer) -> Layer:
+    """Parity: fleet.distributed_model (fleet/model.py:126-165): dispatch
+    on the parallel mode. TP layers (meta_parallel.mp_layers) already carry
+    their sharding annotations; pure-DP models get the DataParallel input
+    shard; PP models must already be PipelineLayer."""
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        from .. import mesh as mesh_mod
+        existing = mesh_mod.get_mesh(create_default=False)
+        if existing is not None:
+            # respect a mesh the user installed via init_parallel_env/
+            # init_mesh: derive degrees from it instead of clobbering it
+            # with the default all-1 strategy
+            hcg = HybridCommunicateGroup(degrees=dict(existing.shape))
+        else:
+            init()
+            hcg = get_hybrid_communicate_group()
+        set_hybrid_communicate_group(hcg)
+    if hcg.get_pipe_parallel_world_size() > 1:
+        from ..meta_parallel.pipeline_parallel import PipelineParallel
+        from ..meta_parallel.pp_layers import PipelineLayer
+        if not isinstance(model, PipelineLayer):
+            raise TypeError(
+                "pipeline parallel requires the model to be a PipelineLayer "
+                "(reference: meta_parallel/parallel_layers/pp_layers.py:208)")
+        return PipelineParallel(model, hcg)
+    if hcg.get_model_parallel_world_size() > 1:
+        from ..meta_parallel import TensorParallel
+        return TensorParallel(model, hcg)
+    if hcg.get_data_parallel_world_size() > 1:
+        return DataParallel(model)
+    return model
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """Parity: fleet.distributed_optimizer -> HybridParallelOptimizer
+    (hybrid_parallel_optimizer.py:226). The TPU-native optimizer already
+    runs inside the sharded program; grad sync/clip follow the shardings,
+    so the optimizer passes through unchanged."""
+    return optimizer
+
+
+def worker_num() -> int:
+    from ..env import get_world_size
+    return get_world_size()
+
+
+def worker_index() -> int:
+    from ..env import get_rank
+    return get_rank()
+
+
+def is_first_worker() -> bool:
+    return worker_index() == 0

@@ -1,0 +1,103 @@
+"""Global device mesh management.
+
+The reference builds its 4-D parallel topology as process groups over NCCL
+rings (CommunicateTopology, python/paddle/distributed/fleet/base/topology.py:54).
+TPU-native: ONE `jax.sharding.Mesh` whose named axes ("dp", "sharding",
+"pp", "mp", "sp", "ep") carry every parallelism dimension; XLA lowers
+shardings over these axes to ICI/DCN collectives (SURVEY.md §5.8). The mesh
+axis order places the most communication-intensive axis ("mp") innermost so
+it maps onto the fastest ICI neighbours via mesh_utils'
+create_device_mesh.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["init_mesh", "get_mesh", "set_mesh", "mesh_axis_size",
+           "named_sharding", "PartitionSpec", "Mesh"]
+
+_global_mesh: Optional[Mesh] = None
+
+# canonical axis order: outermost (slowest links, DCN-friendly) first,
+# innermost (tightest ICI coupling) last
+AXIS_ORDER = ("pp", "dp", "sharding", "ep", "sp", "mp")
+
+
+def init_mesh(degrees: Optional[Dict[str, int]] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Create and install the global mesh.
+
+    degrees: e.g. {"dp": 2, "mp": 4}; axes with degree 1 are kept so
+    PartitionSpecs can always name them. Missing degree is inferred to
+    fill the device count (at most one -1/None).
+    """
+    global _global_mesh
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    degrees = dict(degrees or {})
+    for ax in list(degrees):
+        if ax not in AXIS_ORDER:
+            raise ValueError(f"unknown mesh axis {ax!r}; valid: {AXIS_ORDER}")
+    # infer one unspecified degree
+    unspecified = [ax for ax, d in degrees.items() if d in (-1, None)]
+    known = int(np.prod([d for d in degrees.values() if d not in (-1, None)]))
+    if len(unspecified) > 1:
+        raise ValueError("at most one axis degree may be -1")
+    if unspecified:
+        if n % known:
+            raise ValueError(f"{n} devices not divisible by {known}")
+        degrees[unspecified[0]] = n // known
+    elif not degrees:
+        degrees = {"dp": n}
+    total = int(np.prod(list(degrees.values())))
+    if total < n:
+        # sub-mesh on the leading devices (reference: new_group over a
+        # subset of ranks)
+        devices = devices[:total]
+    elif total != n:
+        raise ValueError(f"mesh degrees {degrees} use {total} devices, "
+                         f"have {n}")
+    axes = [ax for ax in AXIS_ORDER if ax in degrees]
+    shape = [degrees[ax] for ax in axes]
+    dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    _global_mesh = Mesh(dev_array, tuple(axes))
+    return _global_mesh
+
+
+def set_mesh(mesh: Mesh):
+    global _global_mesh
+    _global_mesh = mesh
+
+
+def get_mesh(create_default: bool = True) -> Optional[Mesh]:
+    global _global_mesh
+    if _global_mesh is None and create_default:
+        init_mesh()
+    return _global_mesh
+
+
+def mesh_axis_size(axis: str) -> int:
+    mesh = get_mesh()
+    return mesh.shape.get(axis, 1) if mesh else 1
+
+
+def named_sharding(*spec, mesh: Optional[Mesh] = None) -> NamedSharding:
+    """PartitionSpec entries -> NamedSharding on the global mesh, dropping
+    axis names the mesh doesn't have (degree-1 axes elided by the user)."""
+    m = mesh or get_mesh()
+
+    def keep(entry):
+        if entry is None:
+            return None
+        if isinstance(entry, (tuple, list)):
+            kept = tuple(a for a in entry if a in m.shape)
+            return kept if kept else None
+        return entry if entry in m.shape else None
+
+    return NamedSharding(m, PartitionSpec(*(keep(s) for s in spec)))

@@ -1,0 +1,58 @@
+"""meta_parallel: hybrid-parallel model wrappers + TP layer library.
+
+Parity: python/paddle/distributed/fleet/meta_parallel/ — TensorParallel
+(meta_parallel/tensor_parallel.py), PipelineParallel
+(pipeline_parallel.py:117), the mpu layer library, RNG tracker.
+"""
+from __future__ import annotations
+
+from ...nn.layer_base import Layer
+from ..parallel import shard_batch
+from ..parallel_step import shard_params
+from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,
+                        RowParallelLinear, VocabParallelEmbedding)
+from .random import (RNGStatesTracker, get_rng_state_tracker,
+                     model_parallel_random_seed)
+
+__all__ = ["TensorParallel", "ColumnParallelLinear", "RowParallelLinear",
+           "VocabParallelEmbedding", "ParallelCrossEntropy",
+           "RNGStatesTracker", "get_rng_state_tracker",
+           "model_parallel_random_seed"]
+
+
+class TensorParallel(Layer):
+    """Parity: fleet/meta_parallel/tensor_parallel.py — the reference
+    broadcasts params across the mp group at wrap time
+    (hybrid_parallel_util.py:183); here wrapping lays the annotated params
+    out on the mesh (shard_params) and shards the input batch over dp."""
+
+    def __init__(self, layers: Layer, hcg=None, strategy=None):
+        super().__init__()
+        self._layers = shard_params(layers)
+        self._hcg = hcg
+
+    def forward(self, *inputs, **kwargs):
+        from ...core.tensor import Tensor
+        inputs = tuple(shard_batch(x) if isinstance(x, Tensor) else x
+                       for x in inputs)
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+
+# pp_layers/pipeline_parallel import lazily so TP-only users don't pay
+# for the pipeline machinery
+def __getattr__(name):
+    if name in ("PipelineLayer", "LayerDesc", "SharedLayerDesc",
+                "PipelineParallel"):
+        from . import pp_layers, pipeline_parallel
+        mapping = {"PipelineLayer": pp_layers.PipelineLayer,
+                   "LayerDesc": pp_layers.LayerDesc,
+                   "SharedLayerDesc": pp_layers.SharedLayerDesc,
+                   "PipelineParallel": pipeline_parallel.PipelineParallel}
+        return mapping[name]
+    raise AttributeError(name)

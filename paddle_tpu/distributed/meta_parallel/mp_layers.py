@@ -1,0 +1,162 @@
+"""Tensor-parallel (model-parallel) layer library.
+
+Parity: python/paddle/distributed/fleet/layers/mpu/mp_layers.py —
+VocabParallelEmbedding (:35), ColumnParallelLinear (:173),
+RowParallelLinear (:343), ParallelCrossEntropy (:524) — and the comm
+primitives mpu/mp_ops.py (_c_identity :27, _c_concat :83, _c_split :145,
+_mp_allreduce :211).
+
+TPU-native: NO explicit collective calls. Each layer sets
+`Parameter.sharding_axes` (the role of dist_attr); when the model runs
+under `ParallelTrainStep`/`shard_params`, GSPMD partitions the matmuls and
+inserts exactly the all-reduce/all-gather the reference codes by hand —
+laid out over the innermost (fastest-ICI) "mp" axis by the mesh builder.
+Forward math is identical to the serial layers, so eager single-device
+use (and numeric tests against nn.Linear) need no special casing.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor
+from ...nn import functional as F
+from ...nn import initializer as I
+from ...nn.layer_base import Layer
+from .. import mesh as mesh_mod
+
+__all__ = ["VocabParallelEmbedding", "ColumnParallelLinear",
+           "RowParallelLinear", "ParallelCrossEntropy"]
+
+
+def _mp_size():
+    return mesh_mod.mesh_axis_size("mp")
+
+
+def _constrain(t: Tensor, *spec) -> Tensor:
+    """Sharding constraint inside traced programs; no-op in eager mode on
+    one device or when the mesh lacks the axis."""
+    mesh = mesh_mod.get_mesh(create_default=False)
+    if mesh is None:
+        return t
+    from ...autograd.tape import apply
+    sharding = mesh_mod.named_sharding(*spec, mesh=mesh)
+
+    def f(x):
+        if isinstance(x, jax.core.Tracer):
+            return lax.with_sharding_constraint(x, sharding)
+        return jax.device_put(x, sharding)
+
+    return apply(f, t, _op_name="sharding_constraint")
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocab dim sharded over "mp".
+
+    Parity: mp_layers.py:35 — reference masks out-of-range ids and
+    allreduces partial lookups; GSPMD derives the same comm from the
+    (mp, None) weight layout.
+    """
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.weight.sharding_axes = ("mp", None)
+        self.weight.is_distributed = _mp_size() > 1
+
+    def forward(self, x):
+        return F.embedding(x, self.weight)
+
+
+class ColumnParallelLinear(Layer):
+    """Linear with the OUTPUT dim sharded over "mp" (weight (in, out) ->
+    (None, "mp")). Parity: mp_layers.py:173.
+
+    gather_output=True constrains the result back to replicated (the
+    reference's _c_concat); False leaves it sharded for a following
+    RowParallelLinear — the Megatron pairing with one allreduce per block.
+    """
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.weight.sharding_axes = (None, "mp")
+        self.weight.is_distributed = _mp_size() > 1
+        has_bias = True if has_bias is None else has_bias
+        self.bias = self.create_parameter(
+            [out_features], attr=None, is_bias=True) if has_bias else None
+        if self.bias is not None:
+            self.bias.sharding_axes = ("mp",)
+
+    def forward(self, x):
+        y = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            y = _constrain(y, *([None] * (y.ndim - 1) + [None]))
+        else:
+            y = _constrain(y, *([None] * (y.ndim - 1) + ["mp"]))
+        return y
+
+
+class RowParallelLinear(Layer):
+    """Linear with the INPUT dim sharded over "mp" (weight ("mp", None)).
+    Parity: mp_layers.py:343 — the reference allreduces the partial
+    products (_mp_allreduce); GSPMD emits that psum when the output is
+    constrained replicated. Bias is added after the reduction, as in the
+    reference."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.weight.sharding_axes = ("mp", None)
+        self.weight.is_distributed = _mp_size() > 1
+        self.bias = self.create_parameter(
+            [out_features], attr=None, is_bias=True) if has_bias else None
+
+    def forward(self, x):
+        if not self.input_is_parallel:
+            x = _constrain(x, *([None] * (x.ndim - 1) + ["mp"]))
+        y = F.linear(x, self.weight, None)
+        y = _constrain(y, *([None] * y.ndim))
+        if self.bias is not None:
+            y = y + self.bias
+        return y
+
+
+class ParallelCrossEntropy(Layer):
+    """Softmax cross-entropy over mp-sharded logits.
+
+    Parity: mp_layers.py:524 / c_softmax_with_cross_entropy_op.cu — the
+    reference's two-allreduce (max, sumexp) kernel; XLA partitions the
+    same reductions from the sharded-logits layout.
+    """
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, logits, label):
+        logits = _constrain(
+            logits, *([None] * (logits.ndim - 1) + ["mp"]))
+        return F.cross_entropy(logits, label, reduction="none",
+                               ignore_index=self.ignore_index)

@@ -1,0 +1,76 @@
+"""Model-parallel RNG state tracking.
+
+Parity: RNGStatesTracker (python/paddle/distributed/fleet/layers/mpu/
+random.py) — the reference snapshots/restores CUDA RNG states so dropout
+inside TP regions differs per mp rank while everything else matches.
+TPU-native: JAX keys are values, so a "state" is a key derived by
+fold_in(name); inside sharded programs per-shard divergence comes from
+folding in the axis index (jax.lax.axis_index under shard_map) — no global
+state juggling.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from ...framework import random as fwrandom
+
+__all__ = ["RNGStatesTracker", "get_rng_state_tracker",
+           "model_parallel_random_seed"]
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name, seed):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        self.seeds_.add(seed)
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.states_[name] = jax.random.key(seed)
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = dict(states)
+
+    @contextlib.contextmanager
+    def rng_state(self, name=MODEL_PARALLEL_RNG):
+        """Run the block under the tracked key (dropout etc. draw from it);
+        the consumed key is folded forward, mirroring the reference's
+        save/advance/restore of cuda states."""
+        if name not in self.states_:
+            raise ValueError(f"state {name} not added")
+        saved = fwrandom.get_rng_state()
+        fwrandom.set_rng_state(self.states_[name])
+        try:
+            yield
+        finally:
+            self.states_[name] = fwrandom.get_rng_state()
+            fwrandom.set_rng_state(saved)
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _tracker
+
+
+def model_parallel_random_seed(seed: int = 2023):
+    """Parity: mpu/random.py model_parallel_random_seed — distinct streams
+    for global vs model-parallel randomness."""
+    _tracker.reset()
+    fwrandom.seed(seed)
+    _tracker.add(MODEL_PARALLEL_RNG, seed + 1024)

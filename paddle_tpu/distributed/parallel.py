@@ -1,0 +1,98 @@
+"""init_parallel_env + DataParallel.
+
+Parity: python/paddle/distributed/parallel.py (init_parallel_env :1092,
+DataParallel :202). TPU-native data parallelism needs NO gradient reducer:
+the input batch is sharded over the mesh "dp" axis; every eager op (and any
+jitted program) then runs SPMD under GSPMD, and the batch-mean loss already
+implies the cross-device psum of gradients the reference's EagerReducer
+(paddle/fluid/distributed/collective/reducer.cc:774 MarkVarReady,
+FusedAllReduceSchedule) performs by hand with bucketed NCCL all-reduces.
+XLA's all-reduce combiner plays the role of bucketing.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+from . import mesh as mesh_mod
+from .env import ParallelEnv, get_rank, get_world_size
+
+__all__ = ["init_parallel_env", "DataParallel", "shard_batch",
+           "is_initialized"]
+
+_initialized = False
+
+
+def init_parallel_env(degrees=None):
+    """Initialize the global mesh (parity: init_parallel_env,
+    parallel.py:1092 — there it boots TCPStore + NCCL comms; here the JAX
+    runtime already formed the pod, so this just installs the mesh)."""
+    global _initialized
+    mesh_mod.init_mesh(degrees)
+    _initialized = True
+    return ParallelEnv()
+
+
+def is_initialized():
+    return _initialized
+
+
+def shard_batch(t, axis: str = "dp", dim: int = 0):
+    """Place a batch tensor sharded along `dim` over mesh axis `axis` —
+    the act that turns everything downstream SPMD."""
+    mesh = mesh_mod.get_mesh()
+    if mesh is None or axis not in mesh.shape or mesh.shape[axis] == 1:
+        return t if isinstance(t, Tensor) else Tensor(t)
+    spec = [None] * (t.ndim if hasattr(t, "ndim") else len(t.shape))
+    spec[dim] = axis
+    raw = t.value if isinstance(t, Tensor) else t
+    out = jax.device_put(raw, NamedSharding(mesh, P(*spec)))
+    if isinstance(t, Tensor):
+        t.value = out
+        return t
+    return Tensor(out)
+
+
+class DataParallel(Layer):
+    """Parity: paddle.DataParallel (parallel.py:202).
+
+    Wraps a Layer; forward shards positional tensor inputs' batch dim over
+    the "dp" axis. find_unused_parameters/no_sync exist for API parity —
+    with compiler-inserted collectives there is no reducer to disable:
+    gradient communication happens exactly where the (traced or eager)
+    program demands it.
+    """
+
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *inputs, **kwargs):
+        inputs = tuple(shard_batch(x) if isinstance(x, Tensor) else x
+                       for x in inputs)
+        return self._layers(*inputs, **kwargs)
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        """Parity: DataParallel.no_sync — a no-op: without an eager
+        reducer there is nothing to postpone; gradient accumulation
+        composes naturally."""
+        yield
+
+    def scale_loss(self, loss):
+        return loss  # reference scales by world_size only for its reducer
+
+    # delegate the Layer surface to the wrapped module
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)

@@ -1,0 +1,105 @@
+"""DistributedStrategy: typed configuration for distributed training.
+
+Parity: paddle.distributed.fleet.DistributedStrategy
+(python/paddle/distributed/fleet/base/distributed_strategy.py over the
+protobuf paddle/fluid/framework/distributed_strategy.proto:365). The
+reference serializes ~90 options through protobuf; here a plain dataclass
+tree (SURVEY.md §5.6 recommends exactly this) with the same field names the
+fleet API reads: hybrid_configs degrees, amp/recompute/sharding toggles and
+their sub-config dicts.
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+__all__ = ["DistributedStrategy"]
+
+
+@dataclass
+class HybridConfig:
+    dp_degree: int = 1
+    mp_degree: int = 1
+    pp_degree: int = 1
+    sharding_degree: int = 1
+    sep_degree: int = 1
+    ep_degree: int = 1
+
+
+@dataclass
+class AmpConfig:
+    init_loss_scaling: float = 2.0 ** 16
+    incr_every_n_steps: int = 2000
+    decr_every_n_nan_or_inf: int = 1
+    incr_ratio: float = 2.0
+    decr_ratio: float = 0.5
+    use_dynamic_loss_scaling: bool = True
+    custom_white_list: List[str] = field(default_factory=list)
+    custom_black_list: List[str] = field(default_factory=list)
+    use_pure_fp16: bool = False          # O2
+    use_bf16: bool = True                # TPU-native default dtype
+
+
+@dataclass
+class RecomputeConfig:
+    checkpoints: List[str] = field(default_factory=list)
+    enable_offload: bool = False
+
+
+@dataclass
+class ShardingConfig:
+    sharding_degree: int = 1
+    stage: int = 1                       # ZeRO stage 1/2/3
+    offload: bool = False
+
+
+@dataclass
+class PipelineConfig:
+    accumulate_steps: int = 1
+    micro_batch_size: int = 1
+    schedule_mode: str = "1F1B"          # or "F-then-B", "interleave"
+    num_virtual_stages: int = 1
+
+
+@dataclass
+class DistributedStrategy:
+    """Parity: fleet.DistributedStrategy (base/distributed_strategy.py)."""
+
+    amp: bool = False
+    amp_configs: AmpConfig = field(default_factory=AmpConfig)
+    recompute: bool = False
+    recompute_configs: RecomputeConfig = field(default_factory=RecomputeConfig)
+    sharding: bool = False
+    sharding_configs: ShardingConfig = field(default_factory=ShardingConfig)
+    pipeline: bool = False
+    pipeline_configs: PipelineConfig = field(default_factory=PipelineConfig)
+    hybrid_configs: HybridConfig = field(default_factory=HybridConfig)
+    gradient_merge: bool = False
+    gradient_merge_configs: Dict[str, Any] = field(
+        default_factory=lambda: {"k_steps": 1, "avg": True})
+    lamb: bool = False
+    lars: bool = False
+    dgc: bool = False
+    find_unused_parameters: bool = False
+    fuse_all_reduce_ops: bool = True     # XLA's all-reduce combiner does this
+    fuse_grad_size_in_MB: int = 32
+
+    def __setattr__(self, name, value):
+        # accept dicts for *_configs fields like the reference API does
+        # (strategy.hybrid_configs = {"dp_degree": 2, ...})
+        if name.endswith("_configs") and isinstance(value, dict):
+            current = getattr(self, name, None)
+            if current is not None and dataclasses.is_dataclass(current):
+                for k, v in value.items():
+                    if hasattr(current, k):
+                        setattr(current, k, v)
+                    # unknown keys ignored, matching reference leniency
+                return
+        object.__setattr__(self, name, value)
+
+    def to_degrees(self) -> Dict[str, int]:
+        h = self.hybrid_configs
+        return {"dp": h.dp_degree, "mp": h.mp_degree, "pp": h.pp_degree,
+                "sharding": h.sharding_degree, "sp": h.sep_degree,
+                "ep": h.ep_degree}

@@ -1,0 +1,156 @@
+"""Hybrid-parallel topology over the device mesh.
+
+Parity: CommunicateTopology / HybridCommunicateGroup
+(python/paddle/distributed/fleet/base/topology.py:54,140) — the 4-D
+dp/pp/sharding/mp (+sep) rank bookkeeping that every fleet strategy hangs
+off. TPU-native: the "groups" are mesh axes of ONE jax.sharding.Mesh
+(SURVEY.md §2.6 hybrid row); instead of building NCCL rings per axis
+(topology.py:291), HCG hands out `Group(axis)` handles whose collectives
+compile to HLO. Degrees of 1 keep their axis name so PartitionSpecs are
+uniform across configurations.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from . import mesh as mesh_mod
+from .collective import Group
+
+__all__ = ["CommunicateTopology", "HybridCommunicateGroup",
+           "get_hybrid_communicate_group", "set_hybrid_communicate_group"]
+
+
+class CommunicateTopology:
+    """Parity: fleet/base/topology.py:54 — axis-name/degree bookkeeping."""
+
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding",
+                                           "sep", "model"),
+                 dims=(1, 1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    def world_size(self):
+        n = 1
+        for d in self._dims:
+            n *= d
+        return n
+
+    get_dim_size = get_dim
+
+
+# paddle axis name -> mesh axis name
+_MESH_AXIS = {"data": "dp", "model": "mp", "pipe": "pp",
+              "sharding": "sharding", "sep": "sp", "expert": "ep"}
+
+
+class HybridCommunicateGroup:
+    """Parity: HybridCommunicateGroup (fleet/base/topology.py:140).
+
+    Exposes the same *_parallel_rank/world_size/group surface the fleet
+    layers consume, realized on mesh axes. Per-shard "ranks" are not a
+    process property under one controller — rank accessors return 0 and
+    the degree accessors are the meaningful quantities consumed by the
+    pjit-based strategies.
+    """
+
+    def __init__(self, topology: Optional[CommunicateTopology] = None,
+                 degrees: Optional[Dict[str, int]] = None):
+        if degrees is None:
+            topo = topology or CommunicateTopology()
+            degrees = {name: topo.get_dim(name)
+                       for name in topo.get_hybrid_group_names()}
+        # normalize to mesh axis names
+        self._degrees = {_MESH_AXIS.get(k, k): int(v)
+                         for k, v in degrees.items()}
+        self._topo = topology
+        mesh_axes = {ax: d for ax, d in self._degrees.items()}
+        self.mesh = mesh_mod.init_mesh(mesh_axes)
+
+    # -- degrees ---------------------------------------------------------
+    def get_data_parallel_world_size(self):
+        return self._degrees.get("dp", 1)
+
+    def get_model_parallel_world_size(self):
+        return self._degrees.get("mp", 1)
+
+    def get_pipe_parallel_world_size(self):
+        return self._degrees.get("pp", 1)
+
+    def get_sharding_parallel_world_size(self):
+        return self._degrees.get("sharding", 1)
+
+    def get_sep_parallel_world_size(self):
+        return self._degrees.get("sp", 1)
+
+    def get_expert_parallel_world_size(self):
+        return self._degrees.get("ep", 1)
+
+    # -- ranks (single controller: always 0; kept for API parity) --------
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def get_sharding_parallel_rank(self):
+        return 0
+
+    def get_global_rank(self):
+        from .env import get_rank
+        return get_rank()
+
+    # -- groups ----------------------------------------------------------
+    def get_data_parallel_group(self) -> Group:
+        return Group("dp", self.mesh)
+
+    def get_model_parallel_group(self) -> Group:
+        return Group("mp", self.mesh)
+
+    def get_pipe_parallel_group(self) -> Group:
+        return Group("pp", self.mesh)
+
+    def get_sharding_parallel_group(self) -> Group:
+        return Group("sharding", self.mesh)
+
+    def get_sep_parallel_group(self) -> Group:
+        return Group("sp", self.mesh)
+
+    def get_expert_parallel_group(self) -> Group:
+        return Group("ep", self.mesh)
+
+    def get_check_parallel_group(self):
+        # found_inf check group (reference: topology.py check group spans
+        # mp+pp+sharding); with global arrays the check is already global
+        return Group(self.mesh.axis_names[0], self.mesh)
+
+    # -- convenience -----------------------------------------------------
+    @property
+    def degrees(self) -> Dict[str, int]:
+        return dict(self._degrees)
+
+    def topology(self):
+        return self._topo
+
+    def __repr__(self):
+        return f"HybridCommunicateGroup({self._degrees})"
+
+
+_hcg: Optional[HybridCommunicateGroup] = None
+
+
+def set_hybrid_communicate_group(hcg: HybridCommunicateGroup):
+    global _hcg
+    _hcg = hcg
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _hcg

@@ -1,2 +1,14 @@
-def save(*a, **k): raise NotImplementedError
-def load(*a, **k): raise NotImplementedError
+"""paddle.save/load + paddle.io data pipeline (SURVEY.md §2.8 DataLoader
+row, §5.4 checkpointing)."""
+from .dataloader import (BatchSampler, ChainDataset, ConcatDataset,
+                         DataLoader, Dataset, DistributedBatchSampler,
+                         IterableDataset, RandomSampler, Sampler,
+                         SequenceSampler, Subset, TensorDataset,
+                         default_collate_fn, get_worker_info, random_split)
+from .state import load, save
+
+__all__ = ["save", "load", "Dataset", "IterableDataset", "TensorDataset",
+           "ConcatDataset", "ChainDataset", "Subset", "random_split",
+           "Sampler", "SequenceSampler", "RandomSampler", "BatchSampler",
+           "DistributedBatchSampler", "DataLoader", "default_collate_fn",
+           "get_worker_info"]

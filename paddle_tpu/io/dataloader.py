@@ -1,0 +1,396 @@
+"""Host-side data pipeline: Dataset / Sampler / DataLoader.
+
+Parity: python/paddle/fluid/dataloader/ (dataset.py, batch_sampler.py,
+dataloader_iter.py, worker.py) + python/paddle/fluid/reader.py:311
+(DataLoader). TPU-first design: the device never blocks on input — batches
+are collated on host by a thread pool (numpy work releases the GIL) and
+moved to device ahead of use by a bounded prefetch queue, playing the role
+of the reference's multiprocess workers + pin-memory thread + C++
+buffered_reader (operators/reader/buffered_reader.cc). Shared-memory IPC
+is unnecessary: threads share the address space.
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Iterable, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["Dataset", "IterableDataset", "TensorDataset", "ConcatDataset",
+           "ChainDataset", "Subset", "random_split", "Sampler",
+           "SequenceSampler", "RandomSampler", "BatchSampler",
+           "DistributedBatchSampler", "DataLoader", "default_collate_fn",
+           "get_worker_info"]
+
+
+# ---------------------------------------------------------------------------
+# datasets (parity: fluid/dataloader/dataset.py)
+# ---------------------------------------------------------------------------
+
+class Dataset:
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+
+class IterableDataset(Dataset):
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __getitem__(self, idx):
+        raise RuntimeError("IterableDataset is not indexable")
+
+    def __len__(self):
+        raise RuntimeError("IterableDataset has no len()")
+
+
+class TensorDataset(Dataset):
+    def __init__(self, tensors: Sequence):
+        lens = {t.shape[0] for t in tensors}
+        if len(lens) > 1:
+            raise ValueError("tensors must share dim 0")
+        self.tensors = tensors
+
+    def __getitem__(self, idx):
+        return tuple(np.asarray(t.value if isinstance(t, Tensor) else t)[idx]
+                     for t in self.tensors)
+
+    def __len__(self):
+        t = self.tensors[0]
+        return int((t.value if isinstance(t, Tensor) else t).shape[0])
+
+
+class ConcatDataset(Dataset):
+    def __init__(self, datasets: Iterable[Dataset]):
+        self.datasets = list(datasets)
+        self.cumulative_sizes = list(
+            itertools.accumulate(len(d) for d in self.datasets))
+
+    def __len__(self):
+        return self.cumulative_sizes[-1]
+
+    def __getitem__(self, idx):
+        if idx < 0:
+            idx += len(self)
+        for i, end in enumerate(self.cumulative_sizes):
+            if idx < end:
+                start = 0 if i == 0 else self.cumulative_sizes[i - 1]
+                return self.datasets[i][idx - start]
+        raise IndexError(idx)
+
+
+class ChainDataset(IterableDataset):
+    def __init__(self, datasets: Iterable[IterableDataset]):
+        self.datasets = list(datasets)
+
+    def __iter__(self):
+        for d in self.datasets:
+            yield from d
+
+
+class Subset(Dataset):
+    def __init__(self, dataset, indices):
+        self.dataset = dataset
+        self.indices = list(indices)
+
+    def __getitem__(self, idx):
+        return self.dataset[self.indices[idx]]
+
+    def __len__(self):
+        return len(self.indices)
+
+
+def random_split(dataset, lengths, generator=None):
+    if sum(lengths) != len(dataset):
+        raise ValueError("sum of lengths must equal dataset length")
+    rng = np.random.default_rng(generator)
+    perm = rng.permutation(len(dataset))
+    out, off = [], 0
+    for n in lengths:
+        out.append(Subset(dataset, perm[off:off + n].tolist()))
+        off += n
+    return out
+
+
+# ---------------------------------------------------------------------------
+# samplers (parity: fluid/dataloader/sampler.py, batch_sampler.py)
+# ---------------------------------------------------------------------------
+
+class Sampler:
+    def __init__(self, data_source=None):
+        self.data_source = data_source
+
+    def __iter__(self):
+        raise NotImplementedError
+
+
+class SequenceSampler(Sampler):
+    def __iter__(self):
+        return iter(range(len(self.data_source)))
+
+    def __len__(self):
+        return len(self.data_source)
+
+
+class RandomSampler(Sampler):
+    def __init__(self, data_source, replacement=False, num_samples=None,
+                 generator=None):
+        super().__init__(data_source)
+        self.replacement = replacement
+        self._num_samples = num_samples
+        self.generator = generator
+
+    @property
+    def num_samples(self):
+        return self._num_samples or len(self.data_source)
+
+    def __iter__(self):
+        n = len(self.data_source)
+        rng = np.random.default_rng(self.generator)
+        if self.replacement:
+            return iter(rng.integers(0, n, size=self.num_samples).tolist())
+        return iter(rng.permutation(n)[:self.num_samples].tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class BatchSampler(Sampler):
+    """Parity: paddle.io.BatchSampler (dataloader/batch_sampler.py)."""
+
+    def __init__(self, dataset=None, sampler=None, shuffle=False,
+                 batch_size=1, drop_last=False):
+        if sampler is None:
+            sampler = RandomSampler(dataset) if shuffle \
+                else SequenceSampler(dataset)
+        self.sampler = sampler
+        self.batch_size = int(batch_size)
+        self.drop_last = drop_last
+        self.shuffle = shuffle
+
+    def __iter__(self):
+        batch = []
+        for idx in self.sampler:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        n = len(self.sampler)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+
+class DistributedBatchSampler(BatchSampler):
+    """Rank-sharded batches for data parallelism.
+
+    Parity: paddle.io.DistributedBatchSampler
+    (dataloader/batch_sampler.py DistributedBatchSampler): pads to a
+    multiple of nranks so every rank sees the same number of batches, with
+    epoch-seeded shuffling via set_epoch.
+    """
+
+    def __init__(self, dataset, batch_size, num_replicas=None, rank=None,
+                 shuffle=False, drop_last=False):
+        self.dataset = dataset
+        self.batch_size = int(batch_size)
+        if num_replicas is None or rank is None:
+            from ..distributed import get_world_size, get_rank
+            num_replicas = num_replicas or get_world_size()
+            rank = get_rank() if rank is None else rank
+        self.nranks = num_replicas
+        self.local_rank = rank
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.epoch = 0
+        n = len(dataset)
+        self.num_samples = (n // self.nranks) if drop_last \
+            else -(-n // self.nranks)
+        self.total_size = self.num_samples * self.nranks
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+
+    def __iter__(self):
+        n = len(self.dataset)
+        indices = np.arange(n)
+        if self.shuffle:
+            rng = np.random.default_rng(self.epoch)
+            indices = rng.permutation(n)
+        indices = indices.tolist()
+        if not self.drop_last and len(indices) < self.total_size:
+            indices += indices[: self.total_size - len(indices)]
+        indices = indices[: self.total_size]
+        local = indices[self.local_rank:self.total_size:self.nranks]
+        batch = []
+        for idx in local:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        if self.drop_last:
+            return self.num_samples // self.batch_size
+        return -(-self.num_samples // self.batch_size)
+
+
+# ---------------------------------------------------------------------------
+# collate + loader (parity: dataloader/collate.py, dataloader_iter.py)
+# ---------------------------------------------------------------------------
+
+def default_collate_fn(batch: List[Any]):
+    """Stack samples into device Tensors (reference: default_collate_fn in
+    fluid/dataloader/collate.py)."""
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        return Tensor(np.stack([np.asarray(s.value) for s in batch]))
+    if isinstance(sample, np.ndarray):
+        return Tensor(np.stack(batch))
+    if isinstance(sample, (int, np.integer)):
+        return Tensor(np.asarray(batch, dtype=np.int64))
+    if isinstance(sample, (float, np.floating)):
+        return Tensor(np.asarray(batch, dtype=np.float32))
+    if isinstance(sample, (str, bytes)):
+        return list(batch)
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([d[k] for d in batch]) for k in sample}
+    if isinstance(sample, (tuple, list)):
+        return type(sample)(default_collate_fn(list(items))
+                            for items in zip(*batch))
+    raise TypeError(f"cannot collate {type(sample)}")
+
+
+class _WorkerInfo:
+    def __init__(self, id, num_workers, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+_worker_info = threading.local()
+
+
+def get_worker_info():
+    return getattr(_worker_info, "info", None)
+
+
+class DataLoader:
+    """Parity: paddle.io.DataLoader (fluid/reader.py:311).
+
+    num_workers>0 runs batch fetch+collate on a thread pool with a bounded
+    prefetch queue (role of multiprocess workers + buffered_reader in the
+    reference; threads suffice because collate is numpy, which releases
+    the GIL).
+    """
+
+    def __init__(self, dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None,
+                 persistent_workers=False):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = int(num_workers)
+        self.prefetch_factor = max(2, int(prefetch_factor))
+        self.worker_init_fn = worker_init_fn
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if self._iterable_mode:
+            if batch_sampler is not None:
+                raise ValueError("batch_sampler is invalid for IterableDataset")
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            if batch_size is None:
+                raise ValueError("batch_size or batch_sampler required")
+            self.batch_sampler = BatchSampler(dataset, shuffle=shuffle,
+                                              batch_size=batch_size,
+                                              drop_last=drop_last)
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("IterableDataset DataLoader has no len()")
+        return len(self.batch_sampler)
+
+    # -- iteration -------------------------------------------------------
+    def _batches(self):
+        if self._iterable_mode:
+            it = iter(self.dataset)
+            while True:
+                chunk = list(itertools.islice(it, self.batch_size))
+                if not chunk:
+                    return
+                if len(chunk) < self.batch_size and self.drop_last:
+                    return
+                yield self.collate_fn(chunk)
+        else:
+            for indices in self.batch_sampler:
+                yield self.collate_fn([self.dataset[i] for i in indices])
+
+    def __iter__(self):
+        if self.num_workers <= 0:
+            yield from self._batches()
+            return
+        # threaded prefetch: submit index-batches to the pool, yield in order
+        if self._iterable_mode:
+            # iterable datasets are sequential by nature; single prefetch thread
+            q: "queue.Queue" = queue.Queue(maxsize=self.prefetch_factor)
+            DONE = object()
+
+            def feeder():
+                info = _WorkerInfo(0, 1, self.dataset)
+                _worker_info.info = info
+                try:
+                    if self.worker_init_fn:
+                        self.worker_init_fn(0)
+                    for b in self._batches():
+                        q.put(b)
+                    q.put(DONE)
+                except BaseException as e:  # propagate to the consumer
+                    q.put(e)
+
+            t = threading.Thread(target=feeder, daemon=True)
+            t.start()
+            while True:
+                b = q.get()
+                if b is DONE:
+                    return
+                if isinstance(b, BaseException):
+                    raise b
+                yield b
+        else:
+            dataset, collate = self.dataset, self.collate_fn
+
+            def fetch(indices):
+                return collate([dataset[i] for i in indices])
+
+            with ThreadPoolExecutor(self.num_workers) as pool:
+                pending = []
+                it = iter(self.batch_sampler)
+                depth = self.num_workers * self.prefetch_factor
+                for indices in itertools.islice(it, depth):
+                    pending.append(pool.submit(fetch, indices))
+                while pending:
+                    fut = pending.pop(0)
+                    nxt = next(it, None)
+                    if nxt is not None:
+                        pending.append(pool.submit(fetch, nxt))
+                    yield fut.result()

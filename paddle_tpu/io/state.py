@@ -1,0 +1,75 @@
+"""Object serialization: paddle.save / paddle.load.
+
+Parity: python/paddle/framework/io.py:656 (save), :898 (load) — pickled
+nested containers of tensors/state_dicts. Tensors are stored as numpy
+arrays + a type tag; loading rebuilds Tensors (or numpy with
+return_numpy=True, matching the reference flag). Layer state_dicts,
+optimizer state_dicts, LR scheduler state and plain python objects all pass
+through unchanged.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+from ..core.tensor import Parameter, Tensor
+
+__all__ = ["save", "load"]
+
+_TENSOR_TAG = "__paddle_tpu_tensor__"
+
+
+def _pack(obj: Any) -> Any:
+    if isinstance(obj, Tensor):
+        return {_TENSOR_TAG: "Parameter" if isinstance(obj, Parameter)
+                else "Tensor",
+                "data": np.asarray(obj.value),
+                "name": obj.name,
+                "stop_gradient": obj.stop_gradient}
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj: Any, return_numpy: bool) -> Any:
+    if isinstance(obj, dict):
+        if _TENSOR_TAG in obj:
+            if return_numpy:
+                return obj["data"]
+            cls = Parameter if obj[_TENSOR_TAG] == "Parameter" else Tensor
+            if cls is Parameter:
+                t = Parameter(obj["data"], name=obj["name"])
+                t.stop_gradient = obj["stop_gradient"]
+            else:
+                t = Tensor(obj["data"], stop_gradient=obj["stop_gradient"],
+                           name=obj["name"])
+            return t
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    """Parity: paddle.save (framework/io.py:656)."""
+    if not isinstance(path, (str, os.PathLike)):
+        raise TypeError("save to memory/BytesIO is supported via file-like "
+                        "objects only through pickle; pass a str path")
+    path = os.fspath(path)
+    dirname = os.path.dirname(path)
+    if dirname:
+        os.makedirs(dirname, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    """Parity: paddle.load (framework/io.py:898)."""
+    with open(os.fspath(path), "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy)

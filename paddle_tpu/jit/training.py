@@ -19,6 +19,7 @@ import jax.numpy as jnp
 
 from ..autograd.tape import no_grad
 from ..core.tensor import Tensor
+from ..framework import random as _rng
 from .functional import functional_call, load_state, raw_state, _wrap
 
 __all__ = ["TrainStep"]
@@ -71,15 +72,19 @@ class TrainStep:
         model, loss_fn, optimizer = self.model, self.loss_fn, self.optimizer
         n_in = self.n_inputs
 
-        def step_fn(params, buffers, opt_state, lr, step_no, *batch):
+        def step_fn(params, buffers, opt_state, lr, step_no, rng_key, *batch):
             inputs, labels = batch[:n_in], batch[n_in:]
 
             def loss_of(p):
-                out, new_bufs = functional_call(model, p, buffers, *inputs,
-                                                training=True)
-                with no_grad():
-                    loss_t = loss_fn(_wrap(out),
-                                     *[_wrap(l) for l in labels])
+                # thread the per-step key functionally: dropout etc. draw
+                # fresh randomness each step instead of a baked trace-time
+                # constant (framework.random rng_guard contract)
+                with _rng.rng_guard(rng_key):
+                    out, new_bufs = functional_call(model, p, buffers,
+                                                    *inputs, training=True)
+                    with no_grad():
+                        loss_t = loss_fn(_wrap(out),
+                                         *[_wrap(l) for l in labels])
                 loss_v = loss_t.value if isinstance(loss_t, Tensor) else loss_t
                 return loss_v, new_bufs
 
@@ -99,9 +104,10 @@ class TrainStep:
         self.step_count += 1
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         step_no = jnp.asarray(self.step_count, jnp.float32)
+        rng_key = _rng.default_generator().fold_in(self.step_count)
         raw_batch = _raw_tuple(batch)
         loss, self.params, self.buffers, self.opt_state = self._jitted(
-            self.params, self.buffers, self.opt_state, lr, step_no,
+            self.params, self.buffers, self.opt_state, lr, step_no, rng_key,
             *raw_batch)
         lr_sched = getattr(self.optimizer, "_learning_rate", None)
         if hasattr(lr_sched, "step"):

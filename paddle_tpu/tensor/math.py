@@ -36,17 +36,18 @@ def _raw(x):
     return x.value if isinstance(x, Tensor) else x
 
 
-def _binop(fn, name):
+def _binop(fn, opname):
+    # NB: the paddle-API `name=` kwarg must not shadow the op name
     def op(x, y, name=None):
-        return apply(fn, x, y, _op_name=name)
-    op.__name__ = name
+        return apply(fn, x, y, _op_name=opname)
+    op.__name__ = opname
     return op
 
 
-def _unop(fn, name):
+def _unop(fn, opname):
     def op(x, name=None):
-        return apply(fn, x, _op_name=name)
-    op.__name__ = name
+        return apply(fn, x, _op_name=opname)
+    op.__name__ = opname
     return op
 
 

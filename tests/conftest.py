@@ -19,7 +19,8 @@ _WANT = "--xla_force_host_platform_device_count=8"
 def _env_ok():
     return (os.environ.get("_PADDLE_TPU_TEST_REEXEC") == "1"
             or (os.environ.get("JAX_PLATFORMS") == "cpu"
-                and _WANT in os.environ.get("XLA_FLAGS", "")))
+                and _WANT in os.environ.get("XLA_FLAGS", "")
+                and not os.environ.get("PALLAS_AXON_POOL_IPS")))
 
 
 def pytest_configure(config):
@@ -29,6 +30,10 @@ def pytest_configure(config):
     env["_PADDLE_TPU_TEST_REEXEC"] = "1"
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " + _WANT).strip()
+    # the axon sitecustomize registers the TPU backend whenever this var is
+    # set, overriding JAX_PLATFORMS=cpu — tests must run on the virtual
+    # 8-device CPU mesh
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     # Exact fp32 matmuls for numeric checks (prod keeps fast MXU default).
     env.setdefault("JAX_DEFAULT_MATMUL_PRECISION", "highest")
     capman = config.pluginmanager.getplugin("capturemanager")

@@ -1,0 +1,113 @@
+"""AMP + io tests (reference style: test_amp_*.py, test_paddle_save_load)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_auto_cast_o1_white_black():
+    x = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+    m = nn.Linear(8, 8)
+    with paddle.amp.auto_cast(level="O1"):
+        y = m(x)
+        assert str(y.dtype) == "bfloat16"
+        s = paddle.nn.functional.softmax(y)
+        # blacklisted op computes in fp32
+        assert str(s.dtype) == "float32"
+    y2 = m(x)
+    assert str(y2.dtype) == "float32"
+
+
+def test_auto_cast_o2():
+    x = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+    with paddle.amp.auto_cast(level="O2"):
+        y = x + x   # even non-white ops cast under O2
+        assert str(y.dtype) == "bfloat16"
+
+
+def test_grad_scaler_skips_on_inf():
+    m = nn.Linear(4, 4)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=8.0,
+                                   decr_every_n_nan_or_inf=1)
+    w0 = m.weight.numpy().copy()
+    x = paddle.to_tensor(np.full((2, 4), np.inf, "float32"))
+    loss = paddle.mean(m(x))
+    scaler.scale(loss).backward()
+    scaler.step(opt)
+    scaler.update()
+    # inf grads -> step skipped, scale halved
+    np.testing.assert_array_equal(m.weight.numpy(), w0)
+    assert scaler.get_loss_scaling() == 4.0
+
+    m.clear_gradients()
+    x = paddle.to_tensor(np.random.randn(2, 4).astype("float32"))
+    loss = paddle.mean(m(x))
+    scaler.scale(loss).backward()
+    scaler.step(opt)
+    scaler.update()
+    assert not np.allclose(m.weight.numpy(), w0)
+
+
+def test_grad_scaler_unscales_correctly():
+    m = nn.Linear(4, 1)
+    opt = paddle.optimizer.SGD(learning_rate=0.0, parameters=m.parameters())
+    x = paddle.to_tensor(np.random.randn(8, 4).astype("float32"))
+    # unscaled reference grad
+    loss = paddle.mean(m(x))
+    loss.backward()
+    ref = m.weight.grad.numpy().copy()
+    m.clear_gradients()
+    scaler = paddle.amp.GradScaler(init_loss_scaling=128.0)
+    scaled = scaler.scale(paddle.mean(m(x)))
+    scaled.backward()
+    scaler.unscale_(opt)
+    np.testing.assert_allclose(m.weight.grad.numpy(), ref, rtol=1e-5)
+
+
+def test_save_load_state_dict(tmp_path):
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    p = str(tmp_path / "model.pdparams")
+    paddle.save(m.state_dict(), p)
+    m2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    m2.set_state_dict(paddle.load(p))
+    x = paddle.to_tensor(np.random.randn(3, 4).astype("float32"))
+    np.testing.assert_allclose(m(x).numpy(), m2(x).numpy(), rtol=1e-6)
+
+
+def test_save_load_optimizer_state(tmp_path):
+    m = nn.Linear(4, 4)
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=m.parameters())
+    x = paddle.to_tensor(np.random.randn(2, 4).astype("float32"))
+    paddle.mean(m(x)).backward()
+    opt.step()
+    p = str(tmp_path / "opt.pdopt")
+    paddle.save(opt.state_dict(), p)
+    state = paddle.load(p)
+    opt2 = paddle.optimizer.Adam(learning_rate=0.01,
+                                 parameters=m.parameters())
+    opt2.set_state_dict(state)
+    assert opt2.state_dict()["@step"] == opt.state_dict()["@step"]
+
+
+def test_load_return_numpy(tmp_path):
+    p = str(tmp_path / "t.pdtensor")
+    t = paddle.to_tensor(np.arange(6, dtype="float32").reshape(2, 3))
+    paddle.save({"t": t}, p)
+    out = paddle.load(p, return_numpy=True)
+    assert isinstance(out["t"], np.ndarray)
+    np.testing.assert_array_equal(out["t"], t.numpy())
+
+
+def test_auto_cast_decorator_keeps_custom_lists():
+    @paddle.amp.auto_cast(custom_white_list=["softmax"], level="O1")
+    def f(x):
+        return F.softmax(x)
+
+    x = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+    out = f(x)
+    # softmax moved to the white list -> computed in bf16
+    assert str(out.dtype) == "bfloat16"

@@ -1,0 +1,88 @@
+"""DataLoader/Dataset tests (reference style: test_dataloader_*.py)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.io import (BatchSampler, DataLoader, Dataset,
+                           DistributedBatchSampler, IterableDataset,
+                           TensorDataset, random_split)
+
+
+class SquareDataset(Dataset):
+    def __init__(self, n):
+        self.n = n
+
+    def __getitem__(self, i):
+        x = np.full((3,), i, dtype="float32")
+        return x, np.int64(i % 2)
+
+    def __len__(self):
+        return self.n
+
+
+class CountStream(IterableDataset):
+    def __init__(self, n):
+        self.n = n
+
+    def __iter__(self):
+        for i in range(self.n):
+            yield np.full((2,), i, dtype="float32")
+
+
+def test_map_dataset_loader():
+    ds = SquareDataset(10)
+    loader = DataLoader(ds, batch_size=4, shuffle=False, drop_last=False)
+    batches = list(loader)
+    assert len(batches) == 3
+    x, y = batches[0]
+    assert x.shape == [4, 3] and y.shape == [4]
+    np.testing.assert_array_equal(x.numpy()[:, 0], [0, 1, 2, 3])
+
+
+def test_loader_workers_match_serial():
+    ds = SquareDataset(23)
+    serial = [x.numpy() for x, _ in DataLoader(ds, batch_size=5)]
+    threaded = [x.numpy() for x, _ in DataLoader(ds, batch_size=5,
+                                                 num_workers=3)]
+    assert len(serial) == len(threaded)
+    for a, b in zip(serial, threaded):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_iterable_dataset():
+    loader = DataLoader(CountStream(7), batch_size=3, drop_last=True)
+    batches = list(loader)
+    assert len(batches) == 2
+    assert batches[0].shape == [3, 2]
+
+
+def test_batch_sampler_drop_last():
+    ds = SquareDataset(10)
+    bs = BatchSampler(ds, batch_size=4, drop_last=True)
+    assert len(bs) == 2
+    assert all(len(b) == 4 for b in bs)
+
+
+def test_distributed_batch_sampler_covers_all():
+    ds = SquareDataset(11)
+    seen = []
+    for rank in range(4):
+        s = DistributedBatchSampler(ds, batch_size=2, num_replicas=4,
+                                    rank=rank)
+        for batch in s:
+            seen.extend(batch)
+    # padded to multiple of 4: every index appears at least once
+    assert set(range(11)) <= set(seen)
+    # each rank sees the same number of batches
+    lens = [len(list(DistributedBatchSampler(ds, batch_size=2,
+                                             num_replicas=4, rank=r)))
+            for r in range(4)]
+    assert len(set(lens)) == 1
+
+
+def test_tensor_dataset_and_split():
+    xs = paddle.to_tensor(np.random.randn(10, 4).astype("float32"))
+    ys = paddle.to_tensor(np.arange(10, dtype="int64"))
+    ds = TensorDataset([xs, ys])
+    assert len(ds) == 10
+    a, b = random_split(ds, [7, 3])
+    assert len(a) == 7 and len(b) == 3

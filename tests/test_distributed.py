@@ -1,0 +1,226 @@
+"""Distributed tests on the 8-device virtual CPU mesh.
+
+Replaces the reference's subprocess-per-rank harness (test_collective_base.py
+TestDistBase:144 spawning trainers) with global-array collectives — the
+backend-agnostic simulated ProcessGroup SURVEY.md §4 calls for. Numeric
+checks mirror the reference's collective op tests.
+"""
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.meta_parallel import (ColumnParallelLinear,
+                                                  RowParallelLinear,
+                                                  VocabParallelEmbedding)
+
+
+@pytest.fixture(autouse=True)
+def fresh_mesh():
+    dist.set_mesh(None)
+    yield
+    dist.set_mesh(None)
+
+
+def _stack(n, shape, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.randn(n, *shape).astype("float32")
+
+
+def test_mesh_init_degrees():
+    mesh = dist.init_mesh({"dp": 2, "mp": 4})
+    assert mesh.shape["dp"] == 2 and mesh.shape["mp"] == 4
+    mesh = dist.init_mesh({"dp": -1, "mp": 2})
+    assert mesh.shape["dp"] == 4
+
+
+def test_all_reduce_sum():
+    dist.init_mesh({"dp": 8})
+    x = _stack(8, (4, 3))
+    t = paddle.to_tensor(x)
+    dist.all_reduce(t)
+    expect = np.broadcast_to(x.sum(0, keepdims=True), x.shape)
+    np.testing.assert_allclose(t.numpy(), expect, rtol=1e-5)
+
+
+def test_all_reduce_max_on_group_axis():
+    dist.init_mesh({"dp": 2, "mp": 4})
+    g = dist.new_group(axis="mp")
+    x = _stack(4, (5,))
+    t = paddle.to_tensor(x)
+    dist.all_reduce(t, op=dist.ReduceOp.MAX, group=g)
+    np.testing.assert_allclose(
+        t.numpy(), np.broadcast_to(x.max(0, keepdims=True), x.shape),
+        rtol=1e-6)
+
+
+def test_all_gather():
+    dist.init_mesh({"dp": 8})
+    x = _stack(8, (2, 2))
+    out = []
+    dist.all_gather(out, paddle.to_tensor(x))
+    assert len(out) == 8
+    for i in range(8):
+        np.testing.assert_allclose(out[i].numpy(), x[i], rtol=1e-6)
+
+
+def test_broadcast():
+    dist.init_mesh({"dp": 8})
+    x = _stack(8, (3,))
+    t = paddle.to_tensor(x)
+    dist.broadcast(t, src=3)
+    np.testing.assert_allclose(
+        t.numpy(), np.broadcast_to(x[3], x.shape), rtol=1e-6)
+
+
+def test_reduce_scatter():
+    dist.init_mesh({"dp": 4})
+    x = _stack(4, (8, 2))  # each "rank" holds [8,2]; scatter into 4 blocks
+    out = paddle.to_tensor(np.zeros((4, 2, 2), "float32"))
+    dist.reduce_scatter(out, paddle.to_tensor(x))
+    # rank i's result = sum over ranks of block i (rows 2i..2i+2)
+    blocks = x.reshape(4, 4, 2, 2).sum(0)  # [dst_block, 2, 2]
+    np.testing.assert_allclose(out.numpy(), blocks, rtol=1e-5)
+
+
+def test_alltoall():
+    dist.init_mesh({"dp": 4})
+    x = _stack(4, (4, 3))  # [src, dst, *S]
+    out = []
+    dist.alltoall(out, paddle.to_tensor(x))
+    got = np.stack([o.numpy() for o in out])
+    np.testing.assert_allclose(got, x.transpose(1, 0, 2), rtol=1e-6)
+
+
+def test_dp_training_matches_single_device():
+    """SPMD data parallelism must be numerically invisible (reference:
+    test_parallel_dygraph_* loss-parity pattern)."""
+    rng = np.random.RandomState(0)
+    x_np = rng.randn(16, 8).astype("float32")
+    y_np = rng.randn(16, 2).astype("float32")
+
+    def build():
+        paddle.seed(42)
+        m = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 2))
+        opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                        parameters=m.parameters())
+        return m, opt
+
+    # single device
+    dist.init_mesh({"dp": 1})
+    m1, o1 = build()
+    s1 = dist.ParallelTrainStep(m1, lambda o, y: F.mse_loss(o, y), o1)
+    # 8-way dp
+    dist.init_mesh({"dp": 8})
+    m2, o2 = build()
+    s2 = dist.ParallelTrainStep(m2, lambda o, y: F.mse_loss(o, y), o2)
+
+    for _ in range(5):
+        l1 = float(s1(paddle.to_tensor(x_np), paddle.to_tensor(y_np)))
+        l2 = float(s2(paddle.to_tensor(x_np), paddle.to_tensor(y_np)))
+        np.testing.assert_allclose(l1, l2, rtol=2e-4)
+
+
+def test_tp_layers_match_serial_and_shard():
+    """Column/Row pair must equal a dense 2-layer MLP (reference:
+    hybrid_parallel_mp_layers.py parity test)."""
+    fleet.init(strategy=_mp_strategy(4))
+    paddle.seed(0)
+    col = ColumnParallelLinear(8, 16, gather_output=False)
+    row = RowParallelLinear(16, 4, input_is_parallel=True)
+    x = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+
+    ref = x.numpy() @ col.weight.numpy() + col.bias.numpy()
+    ref = ref @ row.weight.numpy() + row.bias.numpy()
+
+    class Block(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.col, self.row = col, row
+
+        def forward(self, v):
+            return self.row(self.col(v))
+
+    blk = Block()
+    dist.shard_params(blk)
+    # weight physically sharded over mp
+    shard_spec = col.weight.value.sharding.spec
+    assert "mp" in str(shard_spec)
+    out = blk(x)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_tp_training_step_runs_sharded():
+    fleet.init(strategy=_mp_strategy(2, dp=4))
+
+    class TPNet(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.emb = VocabParallelEmbedding(32, 16)
+            self.col = ColumnParallelLinear(16, 32, gather_output=False)
+            self.row = RowParallelLinear(32, 16, input_is_parallel=True)
+            self.head = nn.Linear(16, 32)
+
+        def forward(self, ids):
+            h = self.emb(ids)
+            h = F.relu(self.col(h))
+            h = self.row(h)
+            return self.head(h)
+
+    paddle.seed(1)
+    m = TPNet()
+    opt = paddle.optimizer.AdamW(learning_rate=0.01,
+                                 parameters=m.parameters())
+    step = dist.ParallelTrainStep(
+        m, lambda o, y: paddle.mean(F.cross_entropy(
+            paddle.reshape(o, [-1, 32]), paddle.reshape(y, [-1]))), opt)
+    ids = paddle.to_tensor(np.random.randint(0, 32, (8, 6)).astype("int64"))
+    losses = [float(step(ids, ids)) for _ in range(8)]
+    assert losses[-1] < losses[0]
+
+
+def test_zero_shards_optimizer_state():
+    dist.init_mesh({"dp": 8})
+    paddle.seed(0)
+    m = nn.Linear(16, 16)
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=m.parameters())
+    step = dist.ParallelTrainStep(m, lambda o, y: F.mse_loss(o, y), opt,
+                                  zero_stage=1)
+    x = paddle.to_tensor(np.random.randn(8, 16).astype("float32"))
+    step(x, x)
+    # moment slots must be laid out sharded over dp
+    slot = step.opt_state["weight"]["moment1"]
+    assert "dp" in str(slot.sharding.spec)
+
+
+def test_data_parallel_wrapper():
+    dist.init_mesh({"dp": 8})
+    m = dist.DataParallel(nn.Linear(4, 4))
+    x = paddle.to_tensor(np.random.randn(8, 4).astype("float32"))
+    y = m(x)
+    assert y.shape == [8, 4]
+    with m.no_sync():
+        pass
+
+
+def _mp_strategy(mp, dp=None):
+    s = dist.DistributedStrategy()
+    s.hybrid_configs = {"mp_degree": mp,
+                        "dp_degree": dp if dp else 8 // mp}
+    return s
+
+
+def test_all_reduce_prod_with_negatives_and_zeros():
+    dist.init_mesh({"dp": 4})
+    x = np.array([[-2.0, 3.0], [1.0, -1.0], [2.0, 0.0], [1.5, 2.0]],
+                 dtype="float32").reshape(4, 2)
+    t = paddle.to_tensor(x)
+    dist.all_reduce(t, op=dist.ReduceOp.PROD)
+    expect = np.broadcast_to(np.prod(x, axis=0), x.shape)
+    np.testing.assert_allclose(t.numpy(), expect, rtol=1e-5)
