@@ -19,6 +19,10 @@ from .mesh import (Mesh, PartitionSpec, get_mesh, init_mesh, mesh_axis_size,
 from .parallel import DataParallel, init_parallel_env, is_initialized, \
     shard_batch
 from .parallel_step import ParallelTrainStep, param_sharding, shard_params
+from .moe import GShardGate, MoELayer, NaiveGate, SwitchGate
+from .recompute import recompute, recompute_sequential
+from .sequence_parallel import (ring_attention, shard_sequence,
+                                ulysses_attention)
 from .strategy import DistributedStrategy
 from .topology import (CommunicateTopology, HybridCommunicateGroup,
                        get_hybrid_communicate_group,
@@ -36,4 +40,7 @@ __all__ = [
     "DistributedStrategy", "CommunicateTopology", "HybridCommunicateGroup",
     "get_hybrid_communicate_group", "set_hybrid_communicate_group",
     "ParallelTrainStep", "param_sharding", "shard_params", "fleet",
+    "MoELayer", "SwitchGate", "GShardGate", "NaiveGate",
+    "recompute", "recompute_sequential",
+    "ring_attention", "ulysses_attention", "shard_sequence",
 ]

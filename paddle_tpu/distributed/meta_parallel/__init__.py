@@ -17,7 +17,8 @@ from .random import (RNGStatesTracker, get_rng_state_tracker,
 __all__ = ["TensorParallel", "ColumnParallelLinear", "RowParallelLinear",
            "VocabParallelEmbedding", "ParallelCrossEntropy",
            "RNGStatesTracker", "get_rng_state_tracker",
-           "model_parallel_random_seed"]
+           "model_parallel_random_seed", "PipelineLayer", "LayerDesc",
+           "SharedLayerDesc", "PipelineParallel"]
 
 
 class TensorParallel(Layer):

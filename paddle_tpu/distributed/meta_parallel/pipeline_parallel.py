@@ -1,0 +1,217 @@
+"""Pipeline-parallel execution: the microbatch schedule as one compiled
+program.
+
+Parity: PipelineParallel.forward_backward_pipeline / train_batch
+(python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py:117,228)
+and the p2p layer (pp_utils/p2p_communication.py:298 _p2p_helper,
+SendRecvMeta:53). The reference runs a Python-driven 1F1B loop issuing NCCL
+p2p per microbatch; here the WHOLE schedule is a `lax.scan` over pipeline
+ticks inside `shard_map` (manual over the "pp" axis only — mp/dp stay
+GSPMD-auto, so TP layers inside blocks still work): activations rotate
+around the pp ring with a single `ppermute` per tick, and XLA overlaps the
+collective-permute with the next tick's compute. No shape/dtype handshake
+is needed — shapes are static in the program. Reverse-mode AD of the scan +
+ppermute yields the backward pipeline automatically (the transpose of
+ppermute is the reverse rotation), where the reference hand-codes
+send/recv of grads.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ...autograd import tape as _tape
+from ...core.tensor import Tensor
+from ...jit.functional import functional_call
+from ...nn.layer_base import Layer
+from .. import mesh as mesh_mod
+
+__all__ = ["pipeline_apply", "PipelineParallel"]
+
+
+def _apply_block(template: Layer, params: Dict[str, jax.Array], h):
+    out, _ = functional_call(template, params, {}, Tensor(h))
+    if isinstance(out, (tuple, list)):
+        out = out[0]
+    return out
+
+
+def pipeline_apply(template: Layer, stacked: Dict[str, "Tensor"], x,
+                   num_stages: int, num_micro: int = None,
+                   recompute: bool = False):
+    """Run x through L stacked blocks pipelined over the "pp" axis.
+
+    stacked: dict name -> Parameter of shape [L, ...] (dim 0 sharded "pp").
+    x: Tensor [B, ...]; B must divide into num_micro microbatches.
+    """
+    names = list(stacked)
+    mesh = mesh_mod.get_mesh(create_default=False)
+    pp = mesh.shape.get("pp", 1) if mesh is not None else 1
+    if pp > 1 and num_stages != pp:
+        raise ValueError(
+            f"PipelineLayer was built with num_stages={num_stages} but the "
+            f"mesh 'pp' axis has {pp} devices — the schedule runs one stage "
+            f"per pp shard, so they must match")
+
+    block_of = _apply_block
+    if recompute:
+        block_of = jax.checkpoint(
+            lambda params, h: _apply_block(template, params, h))
+
+    if pp <= 1:
+        # no pipeline axis: plain scan over the stacked blocks
+        cache = getattr(template, "_pp_prog_cache", None)
+        if cache is None:
+            cache = {}
+            object.__setattr__(template, "_pp_prog_cache", cache)
+        key = (None, tuple(names), 1, 0, bool(recompute))
+        fn = cache.get(key)
+        if fn is None:
+            def fn(*flat):
+                params = dict(zip(names, flat[:-1]))
+                h = flat[-1]
+
+                def step(carry, bparams):
+                    if recompute:
+                        nxt = block_of(bparams, carry)
+                    else:
+                        nxt = _apply_block(template, bparams, carry)
+                    return nxt, None
+
+                out, _ = lax.scan(step, h, params)
+                return out
+
+            cache[key] = fn
+        return _tape.apply(fn, *[stacked[n] for n in names], x,
+                           _op_name="pipeline_scan")
+
+    M = num_micro or pp
+    L = stacked[names[0]].shape[0]
+    if L % pp:
+        raise ValueError(f"{L} pipelined blocks not divisible by pp={pp}")
+
+    # one jitted program per (layer, mesh, schedule) — rebuilding the
+    # closure each call would defeat jax.jit's cache (collective.py
+    # _collective_program pattern)
+    cache = getattr(template, "_pp_prog_cache", None)
+    if cache is None:
+        cache = {}
+        object.__setattr__(template, "_pp_prog_cache", cache)
+    cache_key = (mesh, tuple(names), pp, M, bool(recompute))
+    cached = cache.get(cache_key)
+    if cached is not None:
+        return _tape.apply(cached, *[stacked[n] for n in names], x,
+                           _op_name="pipeline")
+
+    def fn(*flat):
+        params = dict(zip(names, flat[:-1]))
+        h = flat[-1]
+        B = h.shape[0]
+        if B % M:
+            raise ValueError(f"batch {B} not divisible by {M} microbatches")
+        mb = B // M
+        x_mb = h.reshape((M, mb) + h.shape[1:])
+
+        def stage_fn(local_params, xs):
+            idx = lax.axis_index("pp")
+            T = M + pp - 1
+            state0 = jnp.zeros_like(xs[0])
+            outs0 = jnp.zeros_like(xs)
+
+            def tick(carry, t):
+                state, outs = carry
+                # stage 0 ingests microbatch t; others take the rotated
+                # activation (role of recv_forward, p2p_communication.py)
+                inp = jnp.where(idx == 0,
+                                x_mb_local(xs, t, M), state)
+
+                def step(c, bp):
+                    if recompute:
+                        return block_of(bp, c), None
+                    return _apply_block(template, bp, c), None
+
+                out, _ = lax.scan(step, inp, local_params)
+                # last stage records finished microbatch t-(pp-1)
+                done = t - (pp - 1)
+                rec = outs.at[jnp.clip(done, 0, M - 1)].set(out)
+                outs = jnp.where((idx == pp - 1) & (done >= 0), rec, outs)
+                # rotate the ring (role of send_forward/recv_forward)
+                nxt = lax.ppermute(out, "pp",
+                                   [(i, (i + 1) % pp) for i in range(pp)])
+                return (nxt, outs), None
+
+            (_, outs), _ = lax.scan(tick, (state0, outs0), jnp.arange(T))
+            # results live on the last stage; replicate over the ring
+            outs = jnp.where(idx == pp - 1, outs, jnp.zeros_like(outs))
+            return lax.psum(outs, "pp")
+
+        def x_mb_local(xs, t, M_):
+            return xs[jnp.clip(t, 0, M_ - 1)]
+
+        smapped = jax.shard_map(
+            stage_fn,
+            mesh=mesh_mod.get_mesh(),
+            in_specs=(jax.tree_util.tree_map(lambda _: P("pp"), params),
+                      P()),
+            out_specs=P(),
+            axis_names={"pp"},
+            check_vma=False)
+        out_mb = smapped(params, x_mb)
+        return out_mb.reshape((B,) + out_mb.shape[2:])
+
+    # partial-manual shard_map (manual pp, auto dp/mp/...) is only legal
+    # under jit; nested jit is inlined when already tracing
+    jitted = jax.jit(fn)
+    cache[cache_key] = jitted
+    return _tape.apply(jitted, *[stacked[n] for n in names], x,
+                       _op_name="pipeline")
+
+
+class PipelineParallel(Layer):
+    """Parity: PipelineParallel (meta_parallel/pipeline_parallel.py).
+
+    Thin wrapper: the schedule lives inside the compiled program, so
+    train_batch is ordinary forward+loss+backward over the full batch —
+    microbatching happens inside pipeline_apply.
+    """
+
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__()
+        from .pp_layers import PipelineLayer
+        if not isinstance(layers, PipelineLayer):
+            raise TypeError("PipelineParallel requires a PipelineLayer")
+        self._layers = layers
+        self._hcg = hcg
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        """Parity: train_batch (pipeline_parallel.py:228)."""
+        x, y = data
+        loss_fn = self._layers._loss_fn
+        if loss_fn is None:
+            raise ValueError("PipelineLayer needs loss_fn for train_batch")
+        out = self.forward(x)
+        loss = loss_fn(out, y)
+        if scaler is not None:
+            scaler.scale(loss).backward()
+            scaler.step(optimizer)
+            scaler.update()
+        else:
+            loss.backward()
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return loss
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)

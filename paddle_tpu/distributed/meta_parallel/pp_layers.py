@@ -1,0 +1,197 @@
+"""Pipeline-parallel model description.
+
+Parity: PipelineLayer / LayerDesc / SharedLayerDesc
+(python/paddle/distributed/fleet/meta_parallel/parallel_layers/pp_layers.py:
+208, 292, 76). The reference assigns layer segments to ranks and moves
+activations with NCCL p2p; TPU-native design (SURVEY.md §7 hard-parts):
+
+- the repeated (homogeneous) blocks' parameters are STACKED along a leading
+  layer dim sharded over the "pp" mesh axis — each pp group holds a
+  contiguous run of blocks;
+- prologue (embedding...) and epilogue (final norm, head) run on all
+  devices under their own (tp/replicated) shardings;
+- the microbatch schedule is a `lax.scan` over pipeline ticks inside
+  `shard_map`, rotating activations around the pp ring with `ppermute`
+  (pipeline_parallel.py) — the whole 1F1B-analog lives INSIDE one compiled
+  program, where the reference drives it from Python
+  (pipeline_parallel.py:117 forward_backward_pipeline).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Parameter, Tensor
+from ...nn.layer_base import Layer
+from .. import mesh as mesh_mod
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer"]
+
+
+class LayerDesc:
+    """Deferred layer constructor. Parity: pp_layers.py LayerDesc."""
+
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_func, Layer):
+            raise TypeError("LayerDesc expects a Layer subclass")
+
+    def build_layer(self) -> Layer:
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_func.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """Parity: pp_layers.py:76 — layers sharing parameters across stages
+    (tied embeddings). TPU-native: sharing is trivial — both call sites
+    read the same Parameter; no cross-stage allreduce of the shared grad
+    is needed because the parameter lives once in the global program."""
+
+    def __init__(self, key, layer_func, forward_func=None, shared_weight_attr
+                 ="weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+def _param_treedef(layer: Layer):
+    names = sorted(n for n, _ in layer.named_parameters())
+    shapes = tuple((n, tuple(dict(layer.named_parameters())[n].shape))
+                   for n in names)
+    return shapes
+
+
+class PipelineLayer(Layer):
+    """Parity: pp_layers.py:208.
+
+    The longest homogeneous run of layers (identical parameter structure,
+    e.g. the transformer blocks) forms the pipelined body; layers before
+    it are the prologue, after it the epilogue. Body block parameters are
+    re-registered as stacked Parameters with sharding ("pp", *axes).
+    """
+
+    def __init__(self, layers: Sequence, num_stages: Optional[int] = None,
+                 topology=None, loss_fn=None, seg_method="uniform",
+                 recompute_interval=0, **kwargs):
+        super().__init__()
+        self._loss_fn = loss_fn
+        self.recompute_interval = recompute_interval
+        if num_stages is None:
+            num_stages = mesh_mod.mesh_axis_size("pp")
+        self.num_stages = num_stages
+
+        built: List[Layer] = []
+        shared: Dict[str, Layer] = {}
+        for d in layers:
+            if isinstance(d, SharedLayerDesc):
+                l = d.build_layer()
+                if d.layer_name in shared:
+                    # tie: later call sites read the first layer's weight
+                    # (reference pp_layers.py:76 shared-weight semantics)
+                    first = shared[d.layer_name]
+                    setattr(l, d.shared_weight_attr,
+                            getattr(first, d.shared_weight_attr))
+                else:
+                    shared[d.layer_name] = l
+                built.append(l)
+            elif isinstance(d, LayerDesc):
+                built.append(d.build_layer())
+            elif isinstance(d, Layer):
+                built.append(d)
+            else:
+                raise TypeError(f"invalid pipeline entry {d!r}")
+
+        lo, hi = self._find_body(built)
+        if (hi - lo) % max(num_stages, 1):
+            raise ValueError(
+                f"pipelined body has {hi - lo} blocks, not divisible by "
+                f"num_stages={num_stages}")
+        self._prologue = built[:lo]
+        self._body_blocks = built[lo:hi]
+        self._epilogue = built[hi:]
+        for i, l in enumerate(self._prologue):
+            self.add_sublayer(f"pre_{i}", l)
+        for i, l in enumerate(self._epilogue):
+            self.add_sublayer(f"post_{i}", l)
+
+        # template for functional application of one block — set via
+        # object.__setattr__ so it is NOT registered as a sublayer (its
+        # unstacked params must not shadow the stacked Parameters)
+        object.__setattr__(self, "_template",
+                           self._body_blocks[0] if self._body_blocks
+                           else None)
+        self._stack_params()
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _find_body(built: List[Layer]):
+        """Longest run of layers with identical param structure."""
+        n = len(built)
+        best = (0, 0)
+        i = 0
+        while i < n:
+            j = i + 1
+            sig = _param_treedef(built[i])
+            while j < n and _param_treedef(built[j]) == sig and sig:
+                j += 1
+            if j - i > best[1] - best[0]:
+                best = (i, j)
+            i = j if j > i + 1 else i + 1
+        return best
+
+    def _stack_params(self):
+        """Stack per-block params into [L, ...] Parameters sharded over
+        pp (plus any per-block annotation, e.g. mp from TP sublayers)."""
+        self._stacked: Dict[str, Parameter] = {}
+        if not self._body_blocks:
+            return
+        if list(self._template.named_buffers()):
+            raise NotImplementedError(
+                "pipelined body blocks with buffers (e.g. BatchNorm running "
+                "stats) are not supported: buffers are not stacked across "
+                "blocks — use LayerNorm, or keep buffered layers in the "
+                "prologue/epilogue")
+        names = [n for n, _ in self._template.named_parameters()]
+        for name in names:
+            per_block = [dict(b.named_parameters())[name]
+                         for b in self._body_blocks]
+            stacked = jnp.stack([p.value for p in per_block])
+            sp = Parameter(stacked, name=f"blocks.{name}")
+            inner = per_block[0].sharding_axes
+            sp.sharding_axes = ("pp",) + tuple(
+                inner if inner is not None
+                else [None] * (stacked.ndim - 1))
+            self._stacked[name] = sp
+            self.add_parameter(f"blocks__{name.replace('.', '__')}", sp)
+
+    # ------------------------------------------------------------------
+    def forward(self, x, *args):
+        from .pipeline_parallel import pipeline_apply
+        for l in self._prologue:
+            x = l(x)
+        if self._body_blocks:
+            x = pipeline_apply(self._template, self._stacked, x,
+                               self.num_stages,
+                               recompute=self.recompute_interval > 0)
+        for l in self._epilogue:
+            x = l(x)
+        return x
+
+    # introspection parity
+    def get_stage_from_index(self, idx):
+        per = len(self._body_blocks) // max(self.num_stages, 1)
+        return min(idx // max(per, 1), self.num_stages - 1)
+
+    @property
+    def parameters_desc(self):
+        return {"prologue": len(self._prologue),
+                "body": len(self._body_blocks),
+                "epilogue": len(self._epilogue),
+                "stages": self.num_stages}

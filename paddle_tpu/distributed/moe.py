@@ -1,0 +1,174 @@
+"""Mixture-of-Experts with expert parallelism.
+
+Parity: MoELayer (python/paddle/incubate/distributed/models/moe/
+moe_layer.py:261) + gates (moe/gate/{naive,gshard,switch}_gate.py) +
+the global_scatter/global_gather all-to-all routing ops
+(paddle/fluid/operators/collective/global_scatter_op.cc). TPU-native
+(GShard formulation): expert FFN weights are STACKED [E, ...] with dim 0
+sharded over the "ep" mesh axis; token routing is two einsums against a
+dispatch mask — when the E dim is sharded, GSPMD lowers exactly the
+all-to-all pair the reference implements as explicit collective ops.
+Capacity-bounded top-1 (Switch) and top-2 (GShard) gates with the standard
+load-balancing auxiliary loss.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd import tape as _tape
+from ..core.tensor import Parameter, Tensor
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer_base import Layer
+from . import mesh as mesh_mod
+
+__all__ = ["MoELayer", "SwitchGate", "GShardGate", "NaiveGate"]
+
+
+class _BaseGate(Layer):
+    def __init__(self, d_model, num_experts):
+        super().__init__()
+        self.d_model = d_model
+        self.num_experts = num_experts
+        self.weight = self.create_parameter(
+            [d_model, num_experts], default_initializer=I.XavierUniform())
+
+
+class SwitchGate(_BaseGate):
+    """Top-1 routing (Switch Transformer). Parity: moe/gate/switch_gate.py."""
+    top_k = 1
+
+
+class GShardGate(_BaseGate):
+    """Top-2 routing. Parity: moe/gate/gshard_gate.py."""
+    top_k = 2
+
+
+NaiveGate = GShardGate  # reference NaiveGate is top-2 without noise
+
+
+def _gating(logits, top_k: int, capacity: int):
+    """Build dispatch/combine tensors (GShard einsum formulation).
+
+    logits: [T, E]. Returns dispatch [T, E, C] (0/1), combine [T, E, C]
+    (weights), aux_loss (load balancing, Shazeer et al.).
+    """
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    # aux loss: E * sum_e(mean_t(gate_prob_e) * mean_t(is_top1_e))
+    top1 = jnp.argmax(probs, axis=-1)
+    me = probs.mean(axis=0)
+    ce = jnp.mean(jax.nn.one_hot(top1, E, dtype=jnp.float32), axis=0)
+    aux = jnp.sum(me * ce) * E
+
+    dispatch = jnp.zeros((T, E, capacity), jnp.float32)
+    combine = jnp.zeros((T, E, capacity), jnp.float32)
+    residual_probs = probs
+    # slots already taken per expert by earlier rounds — round-k positions
+    # must be offset past them or 1st/2nd-choice tokens collide in a slot
+    taken = jnp.zeros((E,), jnp.float32)
+    gate_sum = jnp.zeros((T,), jnp.float32)  # sum of CHOSEN gate probs
+    for k in range(top_k):
+        idx = jnp.argmax(residual_probs, axis=-1)              # [T]
+        gate_k = jnp.take_along_axis(residual_probs, idx[:, None],
+                                     axis=-1)[:, 0]            # [T]
+        gate_sum = gate_sum + gate_k
+        mask = jax.nn.one_hot(idx, E, dtype=jnp.float32)       # [T, E]
+        # position of each token within its expert's queue
+        pos = ((jnp.cumsum(mask, axis=0) - 1.0) + taken[None, :]) * mask
+        keep = (pos < capacity) * mask
+        pos_c = jax.nn.one_hot(
+            (pos * keep).astype(jnp.int32), capacity,
+            dtype=jnp.float32) * keep[..., None]               # [T, E, C]
+        dispatch = dispatch + pos_c
+        combine = combine + gate_k[:, None, None] * pos_c
+        taken = taken + keep.sum(axis=0)
+        residual_probs = residual_probs * (1.0 - mask)
+
+    if top_k > 1:
+        # normalize over the chosen gates (GShard g_i/(g1+g2)); dividing by
+        # surviving weights instead would zero the router's task gradient
+        combine = combine / jnp.maximum(gate_sum, 1e-9)[:, None, None]
+    # top_k == 1 (Switch): scale by the raw gate prob so the router learns
+    # from the task loss
+    combine = combine * dispatch
+    return dispatch, combine, aux
+
+
+class MoELayer(Layer):
+    """Parity: MoELayer (moe_layer.py:261).
+
+    experts: FFN experts constructed internally (d_model -> d_hidden ->
+    d_model, GELU), weights stacked over the expert dim and annotated for
+    the "ep" mesh axis. `capacity_factor` bounds tokens per expert
+    (reference: capacity in gate impls).
+    """
+
+    def __init__(self, d_model, d_hidden, num_experts, gate="gshard",
+                 top_k=None, capacity_factor=1.25, group=None,
+                 recompute_interval=0, name=None):
+        super().__init__()
+        self.d_model = d_model
+        self.d_hidden = d_hidden
+        self.num_experts = num_experts
+        self.capacity_factor = float(capacity_factor)
+        if isinstance(gate, str):
+            gate = {"gshard": GShardGate, "naive": GShardGate,
+                    "switch": SwitchGate}[gate](d_model, num_experts)
+        self.gate = gate
+        self.top_k = top_k or getattr(gate, "top_k", 2)
+
+        def expert_param(shape):
+            p = Parameter(I.XavierUniform()(shape, "float32"))
+            p.sharding_axes = ("ep",) + (None,) * (len(shape) - 1)
+            return p
+
+        self.w_in = self.add_parameter(
+            "w_in", expert_param([num_experts, d_model, d_hidden]))
+        self.b_in = self.add_parameter(
+            "b_in", expert_param([num_experts, d_hidden]))
+        self.w_out = self.add_parameter(
+            "w_out", expert_param([num_experts, d_hidden, d_model]))
+        self.b_out = self.add_parameter(
+            "b_out", expert_param([num_experts, d_model]))
+        self._l_aux = None
+
+    @property
+    def l_aux(self) -> Optional[Tensor]:
+        """Load-balancing aux loss of the last forward (reference exposes
+        gate loss for the trainer to add)."""
+        return self._l_aux
+
+    def forward(self, x):
+        """x: [.., S, d_model] (any leading dims)."""
+        lead = x.shape[:-1]
+        T = 1
+        for d in lead:
+            T *= int(d)
+        E = self.num_experts
+        C = max(int(self.capacity_factor * self.top_k * T / E), 1)
+
+        def fn(xv, gw, wi, bi, wo, bo):
+            flat = xv.reshape((T, self.d_model))
+            logits = flat @ gw.astype(flat.dtype)
+            dispatch, combine, aux = _gating(logits, self.top_k, C)
+            dispatch = dispatch.astype(flat.dtype)
+            combine = combine.astype(flat.dtype)
+            # route: [T,E,C],[T,d] -> [E,C,d]  (GSPMD: all-to-all over ep)
+            expert_in = jnp.einsum("tec,td->ecd", dispatch, flat)
+            h = jax.nn.gelu(
+                jnp.einsum("ecd,edh->ech", expert_in, wi) + bi[:, None, :])
+            out_e = jnp.einsum("ech,ehd->ecd", h, wo) + bo[:, None, :]
+            # un-route: [T,E,C],[E,C,d] -> [T,d]
+            out = jnp.einsum("tec,ecd->td", combine, out_e)
+            return out.reshape(xv.shape), aux
+
+        out, aux = _tape.apply(fn, x, self.gate.weight, self.w_in,
+                               self.b_in, self.w_out, self.b_out,
+                               _op_name="moe")
+        self._l_aux = aux
+        return out

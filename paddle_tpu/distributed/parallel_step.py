@@ -116,8 +116,12 @@ class ParallelTrainStep:
         shardings = param_sharding(model, self.mesh)
         params, buffers = raw_state(model)
         self.param_shardings = {n: shardings[n] for n in params}
-        # params live sharded (mp) but replicated across dp/sharding
-        self.params = {n: jax.device_put(v, self.param_shardings[n])
+        # params live sharded (mp) but replicated across dp/sharding.
+        # jnp.copy first: device_put with an already-matching sharding
+        # returns the SAME buffer, and step() donates these — without the
+        # copy the model's own arrays would be deleted
+        self.params = {n: jax.device_put(jnp.copy(v),
+                                         self.param_shardings[n])
                        for n, v in params.items()}
         self.buffers = {n: jnp.copy(v) for n, v in buffers.items()}
         opt_state = optimizer.init(self.params)

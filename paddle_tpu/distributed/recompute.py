@@ -1,0 +1,94 @@
+"""Activation recomputation (gradient checkpointing).
+
+Parity: python/paddle/distributed/fleet/recompute/recompute.py:69
+(RecomputeFunction PyLayer — saves inputs + RNG state, re-runs forward in
+backward) and recompute_hybrid.py (mp-sharded saved activations).
+TPU-native: `jax.checkpoint` IS this mechanism — XLA rematerializes the
+forward inside the backward, RNG is already functional (keys are values,
+nothing to snapshot), and under hybrid parallel the rematerialized
+activations inherit their sharding constraints, subsuming the reference's
+_split_activation/_merge_activation partitioning (recompute_hybrid.py:31,55).
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+
+from ..autograd import tape as _tape
+from ..core.tensor import Tensor
+from ..jit.functional import functional_call, raw_state, _wrap
+from ..nn.layer_base import Layer
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+def recompute(function, *args, **kwargs):
+    """Parity: paddle.distributed.fleet.utils.recompute.
+
+    `function` is a Layer (or a Layer's __call__); its forward is re-run
+    during backward instead of saving activations. Extra kwargs
+    (use_reentrant, preserve_rng_state) are accepted for API parity —
+    rematerialization on XLA is always "non-reentrant" and RNG-correct.
+    """
+    kwargs.pop("use_reentrant", None)
+    kwargs.pop("preserve_rng_state", None)
+    layer = function
+    if not isinstance(layer, Layer):
+        layer = getattr(function, "__self__", None)
+        if not isinstance(layer, Layer):
+            raise TypeError(
+                "recompute requires a Layer (parameters must be visible to "
+                "the remat boundary); wrap plain functions in a Layer")
+
+    params, buffers = raw_state(layer)
+    pnames = list(params)
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+    other_mask = [isinstance(a, Tensor) for a in args]
+    # kwarg Tensors must also cross the remat boundary as tape inputs or
+    # their gradients are silently dropped
+    kw_tensor_keys = [k for k, v in kwargs.items() if isinstance(v, Tensor)]
+    kw_tensors = [kwargs[k] for k in kw_tensor_keys]
+    static_kwargs = {k: v for k, v in kwargs.items()
+                     if k not in kw_tensor_keys}
+
+    @jax.checkpoint
+    def rematted(flat_params, *arr_args):
+        p = dict(zip(pnames, flat_params))
+        n_kw = len(kw_tensor_keys)
+        pos_arrs = arr_args[:len(arr_args) - n_kw]
+        kw_arrs = arr_args[len(arr_args) - n_kw:]
+        rebuilt, it = [], iter(pos_arrs)
+        for a, is_t in zip(args, other_mask):
+            rebuilt.append(next(it) if is_t else a)
+        kw = dict(static_kwargs)
+        kw.update({k: Tensor(v) for k, v in zip(kw_tensor_keys, kw_arrs)})
+        out, _ = functional_call(layer, p, buffers, *rebuilt,
+                                 training=layer.training, **kw)
+        return out
+
+    param_tensors = [dict(layer.named_parameters())[n] for n in pnames]
+
+    def fn(*flat):
+        return rematted(list(flat[:len(pnames)]), *flat[len(pnames):])
+
+    return _tape.apply(fn, *param_tensors, *tensor_args, *kw_tensors,
+                       _op_name="recompute")
+
+
+def recompute_sequential(ctx, functions, *args):
+    """Parity: paddle.incubate.distributed.fleet.recompute_sequential —
+    checkpoint every segment of a Sequential."""
+    segments = int(ctx.get("segments", 1)) if isinstance(ctx, dict) else 1
+    layers = list(functions)
+    n = len(layers)
+    per = max(n // max(segments, 1), 1)
+    out = args
+    i = 0
+    while i < n:
+        seg = layers[i:i + per]
+        import paddle_tpu.nn as nn
+        block = seg[0] if len(seg) == 1 else nn.Sequential(*seg)
+        out = (recompute(block, *out),)
+        i += per
+    return out[0]

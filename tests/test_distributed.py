@@ -224,3 +224,14 @@ def test_all_reduce_prod_with_negatives_and_zeros():
     dist.all_reduce(t, op=dist.ReduceOp.PROD)
     expect = np.broadcast_to(np.prod(x, axis=0), x.shape)
     np.testing.assert_allclose(t.numpy(), expect, rtol=1e-5)
+
+
+def test_parallel_step_keeps_model_arrays_alive():
+    dist.init_mesh({"dp": 8})
+    m = nn.Linear(4, 4)
+    opt = paddle.optimizer.SGD(learning_rate=0.01,
+                               parameters=m.parameters())
+    step = dist.ParallelTrainStep(m, lambda o, y: F.mse_loss(o, y), opt)
+    x = paddle.to_tensor(np.random.randn(8, 4).astype("float32"))
+    step(x, x)
+    m(x).numpy()   # must not raise "Array has been deleted"

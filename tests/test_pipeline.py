@@ -1,0 +1,123 @@
+"""Pipeline-parallel tests (reference: hybrid_parallel_pp_transformer.py /
+test_parallel_dygraph_pipeline_parallel.py — forward parity + convergence)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.meta_parallel import (LayerDesc, PipelineLayer,
+                                                  PipelineParallel)
+
+
+@pytest.fixture(autouse=True)
+def fresh_mesh():
+    dist.set_mesh(None)
+    yield
+    dist.set_mesh(None)
+
+
+class Block(nn.Layer):
+    def __init__(self, d):
+        super().__init__()
+        self.fc = nn.Linear(d, d)
+
+    def forward(self, x):
+        return x + F.tanh(self.fc(x))
+
+
+def _build_pipeline(d=8, nblocks=4, num_stages=1):
+    paddle.seed(7)
+    return PipelineLayer(
+        layers=[LayerDesc(nn.Linear, d, d)]
+        + [LayerDesc(Block, d) for _ in range(nblocks)]
+        + [LayerDesc(nn.Linear, d, d)],
+        num_stages=num_stages,
+        loss_fn=lambda o, y: F.mse_loss(o, y))
+
+
+def _sequential_ref(model, x_np):
+    """Recompute the pipeline model's math with plain numpy."""
+    h = x_np @ model.pre_0.weight.numpy() + model.pre_0.bias.numpy()
+    sd = model.state_dict()
+    w = sd["blocks__fc__weight"].numpy()   # [L, d, d]
+    b = sd["blocks__fc__bias"].numpy()     # [L, d]
+    for i in range(w.shape[0]):
+        h = h + np.tanh(h @ w[i] + b[i])
+    return h @ model.post_0.weight.numpy() + model.post_0.bias.numpy()
+
+
+def test_pipeline_layer_structure():
+    dist.init_mesh({"pp": 4})
+    m = _build_pipeline(num_stages=4)
+    desc = m.parameters_desc
+    assert desc == {"prologue": 1, "body": 4, "epilogue": 1, "stages": 4}
+    names = {n for n, _ in m.named_parameters()}
+    assert "blocks__fc__weight" in names
+
+
+def test_pipeline_forward_matches_sequential_pp1():
+    dist.init_mesh({"pp": 1})
+    m = _build_pipeline(num_stages=1)
+    x = np.random.randn(8, 8).astype("float32")
+    out = m(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(out, _sequential_ref(m, x), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_pipeline_forward_matches_sequential_pp4():
+    dist.init_mesh({"pp": 4})
+    m = _build_pipeline(num_stages=4)
+    x = np.random.randn(8, 8).astype("float32")
+    out = m(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(out, _sequential_ref(m, x), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_pipeline_backward_grads_flow():
+    dist.init_mesh({"pp": 4})
+    m = _build_pipeline(num_stages=4)
+    x = paddle.to_tensor(np.random.randn(8, 8).astype("float32"))
+    loss = paddle.mean(m(x))
+    loss.backward()
+    for n, p in m.named_parameters():
+        assert p.grad is not None, n
+        assert float(paddle.abs(p.grad).sum()) > 0 or "bias" in n, n
+
+
+def test_pipeline_training_converges_vs_single():
+    rng = np.random.RandomState(0)
+    x_np = rng.randn(16, 8).astype("float32")
+    y_np = rng.randn(16, 8).astype("float32")
+
+    def run(pp):
+        dist.set_mesh(None)
+        dist.init_mesh({"pp": pp})
+        m = _build_pipeline(num_stages=pp)
+        opt = paddle.optimizer.Adam(learning_rate=0.02,
+                                    parameters=m.parameters())
+        step = dist.ParallelTrainStep(m, lambda o, y: F.mse_loss(o, y), opt)
+        return [float(step(paddle.to_tensor(x_np), paddle.to_tensor(y_np)))
+                for _ in range(6)]
+
+    l1 = run(1)
+    l4 = run(4)
+    np.testing.assert_allclose(l1, l4, rtol=3e-3)
+    assert l4[-1] < l4[0]
+
+
+def test_pipeline_parallel_train_batch():
+    s = dist.DistributedStrategy()
+    s.hybrid_configs = {"pp_degree": 4, "dp_degree": 2}
+    fleet.init(strategy=s)
+    m = _build_pipeline(num_stages=4)
+    model = fleet.distributed_model(m)
+    assert isinstance(model, PipelineParallel)
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=m.parameters())
+    x = paddle.to_tensor(np.random.randn(8, 8).astype("float32"))
+    y = paddle.to_tensor(np.random.randn(8, 8).astype("float32"))
+    losses = [float(model.train_batch((x, y), opt)) for _ in range(5)]
+    assert losses[-1] < losses[0]
