@@ -1,0 +1,99 @@
+"""Driver benchmark: GPT pretraining step throughput on one TPU chip.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: GPT-125M-class causal-LM training tokens/sec/chip — the single-chip
+proxy for BASELINE.json's "GPT tokens/sec/chip" target (the reference
+publishes no absolute numbers, BASELINE.json "published": {}; vs_baseline
+is reported against the first recorded value of this same benchmark, 1.0
+when none exists yet).
+
+The whole step (forward, loss, backward, AdamW update, bf16 compute with
+fp32 master weights) is one donated XLA program (jit.TrainStep).
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F  # noqa: F401 (warm import)
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    # single-chip friendly config (125M-class, bf16 params)
+    seq, batch = 1024, 8
+    on_cpu = os.environ.get("JAX_PLATFORMS", "") == "cpu"
+    if on_cpu:  # keep the CPU smoke run quick
+        seq, batch = 128, 2
+        cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
+                        num_heads=4, max_seq_len=seq)
+    else:
+        cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                        num_heads=12, max_seq_len=seq)
+
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    model.bfloat16()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4, multi_precision=True,
+                                 parameters=model.parameters())
+    step = TrainStep(model, GPTForCausalLM.loss_fn, opt)
+
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, seq)).astype("int64"))
+
+    # warmup (compile + 2 steady steps)
+    for _ in range(3):
+        loss = step(ids, ids)
+    float(loss)
+
+    iters = 5 if on_cpu else 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(ids, ids)
+    float(loss)  # sync
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * iters / dt
+
+    prev_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BENCH_baseline.json")
+    vs = 1.0
+    if on_cpu:
+        # CPU smoke config is not comparable to the chip benchmark
+        print(json.dumps({
+            "metric": "gpt125m_train_tokens_per_sec_chip",
+            "value": round(tokens_per_sec, 2),
+            "unit": "tokens/s/chip",
+            "vs_baseline": 1.0,
+        }))
+        return
+    try:
+        with open(prev_path) as f:
+            prev = json.load(f)
+        if prev.get("value"):
+            vs = tokens_per_sec / float(prev["value"])
+    except (OSError, ValueError):
+        # first run establishes the baseline
+        try:
+            with open(prev_path, "w") as f:
+                json.dump({"metric": "gpt125m_train_tokens_per_sec_chip",
+                           "value": tokens_per_sec}, f)
+        except OSError:
+            pass
+
+    print(json.dumps({
+        "metric": "gpt125m_train_tokens_per_sec_chip",
+        "value": round(tokens_per_sec, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(vs, 4),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -49,6 +49,11 @@ from .io import save, load  # noqa: F401,E402
 from .device import (  # noqa: F401,E402
     set_device, get_device, is_compiled_with_cuda, is_compiled_with_tpu)
 from .distributed.parallel import DataParallel  # noqa: E402  (paddle.DataParallel parity)
+from . import metric  # noqa: E402
+from . import vision  # noqa: E402
+from . import models  # noqa: E402
+from . import hapi  # noqa: E402
+from .hapi import Model  # noqa: E402  (paddle.Model parity)
 
 # default dtype management (paddle.set_default_dtype)
 _default_dtype = "float32"

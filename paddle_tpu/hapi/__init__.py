@@ -1,0 +1,6 @@
+"""hapi: the high-level Model.fit API (SURVEY.md §2.8 hapi row)."""
+from .model import Model
+from .callbacks import Callback, EarlyStopping, LRScheduler, ProgBarLogger
+
+__all__ = ["Model", "Callback", "ProgBarLogger", "EarlyStopping",
+           "LRScheduler"]

@@ -1,0 +1,152 @@
+"""hapi callbacks. Parity: python/paddle/hapi/callbacks.py (Callback
+protocol, ProgBarLogger, EarlyStopping, LRScheduler)."""
+from __future__ import annotations
+
+import sys
+import time
+
+__all__ = ["Callback", "ProgBarLogger", "EarlyStopping", "LRScheduler",
+           "config_callbacks"]
+
+
+class Callback:
+    def set_params(self, params):
+        self.params = params
+
+    def set_model(self, model):
+        self.model = model
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_train_batch_begin(self, step, logs=None):
+        pass
+
+    def on_train_batch_end(self, step, logs=None):
+        pass
+
+    def on_eval_begin(self, logs=None):
+        pass
+
+    def on_eval_end(self, logs=None):
+        pass
+
+    def on_eval_batch_end(self, step, logs=None):
+        pass
+
+
+class ProgBarLogger(Callback):
+    """Parity: hapi ProgBarLogger — per-epoch line logging."""
+
+    def __init__(self, log_freq=10, verbose=1):
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+        self._start = time.time()
+        if self.verbose:
+            steps = (logs or {}).get("steps")
+            print(f"Epoch {epoch + 1}: {steps or '?'} steps", file=sys.stderr)
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.verbose and step % self.log_freq == 0:
+            items = ", ".join(f"{k}: {v:.4f}" if isinstance(v, float)
+                              else f"{k}: {v}"
+                              for k, v in (logs or {}).items())
+            print(f"  step {step}: {items}", file=sys.stderr)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            dur = time.time() - self._start
+            items = ", ".join(f"{k}: {v:.4f}" if isinstance(v, float)
+                              else f"{k}: {v}"
+                              for k, v in (logs or {}).items())
+            print(f"Epoch {epoch + 1} done ({dur:.1f}s) {items}",
+                  file=sys.stderr)
+
+
+class EarlyStopping(Callback):
+    """Parity: hapi EarlyStopping."""
+
+    def __init__(self, monitor="loss", mode="min", patience=0,
+                 min_delta=0, baseline=None, save_best_model=True):
+        self.monitor = monitor
+        self.patience = patience
+        self.min_delta = abs(min_delta)
+        self.wait = 0
+        self.stopped_epoch = 0
+        self.best = baseline
+        self.mode = mode
+        self.save_best_model = save_best_model
+        self.stop_training = False
+
+    def _better(self, cur):
+        if self.best is None:
+            return True
+        if self.mode == "min":
+            return cur < self.best - self.min_delta
+        return cur > self.best + self.min_delta
+
+    def on_eval_end(self, logs=None):
+        cur = (logs or {}).get(self.monitor)
+        if cur is None:
+            return
+        if self._better(cur):
+            self.best = cur
+            self.wait = 0
+            save_dir = getattr(self.model, "_save_dir", None)
+            if self.save_best_model and save_dir:
+                import os
+                self.model.save(os.path.join(save_dir, "best_model"))
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.stop_training = True
+
+
+class LRScheduler(Callback):
+    """Steps the optimizer's LRScheduler each batch/epoch.
+    Parity: hapi LRSchedulerCallback."""
+
+    def __init__(self, by_step=True, by_epoch=False):
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def _sched(self):
+        # TrainStep already steps the scheduler after every fused step
+        # (jit/training.py) — stepping here too would double-advance it
+        if getattr(self.model, "_train_step", None) is not None:
+            return None
+        opt = getattr(self.model, "_optimizer", None)
+        lr = getattr(opt, "_learning_rate", None)
+        return lr if hasattr(lr, "step") else None
+
+    def on_train_batch_end(self, step, logs=None):
+        s = self._sched()
+        if self.by_step and s is not None:
+            s.step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        s = self._sched()
+        if self.by_epoch and s is not None:
+            s.step()
+
+
+def config_callbacks(callbacks, model, verbose=1, metrics=None,
+                     log_freq=10):
+    cbs = list(callbacks or [])
+    if not any(isinstance(c, ProgBarLogger) for c in cbs):
+        cbs.insert(0, ProgBarLogger(log_freq=log_freq, verbose=verbose))
+    for c in cbs:
+        c.set_model(model)
+    return cbs

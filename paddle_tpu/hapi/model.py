@@ -1,0 +1,265 @@
+"""Model: the high-level train/eval/predict API.
+
+Parity: paddle.Model (python/paddle/hapi/model.py — fit :1045, evaluate
+:1740, predict :1991, prepare, save/load, summary). The reference keeps two
+adapters (dygraph :771 / static graph :285); here there is one path: every
+train step runs through the fused jit TrainStep (forward+loss+backward+
+update in one XLA program), eval/predict through a jitted inference
+function — the static-graph speed with the dygraph API.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..io.state import load as _load, save as _save
+from ..jit.training import TrainStep
+from ..metric import Metric
+from ..nn.layer_base import Layer
+from .callbacks import EarlyStopping, config_callbacks
+
+__all__ = ["Model"]
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class Model:
+    """Parity: paddle.Model(network, inputs=None, labels=None)."""
+
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._train_step: Optional[TrainStep] = None
+        self.stop_training = False
+
+    # -- setup -----------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _as_list(metrics)
+        self._train_step = None
+        return self
+
+    # -- helpers ---------------------------------------------------------
+    def _split_batch(self, data):
+        """DataLoader yields (x, y) / (x,) / dict; normalize to lists."""
+        if isinstance(data, dict):
+            data = tuple(data.values())
+        if isinstance(data, (list, tuple)):
+            if len(data) >= 2:
+                return list(data[:-1]), [data[-1]]
+            return list(data), []
+        return [data], []
+
+    def _loss_value(self, outputs, labels):
+        loss = self._loss(outputs, *labels) if labels else \
+            self._loss(outputs)
+        return loss
+
+    def _ensure_train_step(self, n_inputs):
+        if self._train_step is None:
+            if self._optimizer is None or self._loss is None:
+                raise RuntimeError("call prepare(optimizer, loss) first")
+            self._train_step = TrainStep(
+                self.network, lambda out, *ys: self._loss_value(out, ys),
+                self._optimizer, n_inputs=n_inputs)
+        return self._train_step
+
+    # -- train -----------------------------------------------------------
+    def train_batch(self, inputs, labels=None):
+        """Parity: Model.train_batch."""
+        inputs = _as_list(inputs)
+        labels = _as_list(labels)
+        step = self._ensure_train_step(len(inputs))
+        loss = step(*inputs, *labels)
+        return [float(loss)]
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=1, drop_last=False, shuffle=True, num_workers=0,
+            callbacks=None, accumulate_grad_batches=1, num_iters=None):
+        """Parity: Model.fit (hapi/model.py:1045). train_data may be a
+        DataLoader or a Dataset (a loader is built with batch_size)."""
+        from ..io.dataloader import DataLoader, Dataset
+        if accumulate_grad_batches != 1:
+            raise NotImplementedError(
+                "accumulate_grad_batches > 1 is not supported yet — raise "
+                "batch_size (the fused step is memory-lean) or use "
+                "gradient_merge in DistributedStrategy")
+        loader = train_data
+        if isinstance(train_data, Dataset):
+            loader = DataLoader(train_data, batch_size=batch_size,
+                                shuffle=shuffle, drop_last=drop_last,
+                                num_workers=num_workers)
+        self._save_dir = save_dir
+        cbs = config_callbacks(callbacks, self, verbose, log_freq=log_freq)
+        self.stop_training = False
+        for cb in cbs:
+            cb.on_train_begin()
+        it_count = 0
+        for epoch in range(epochs):
+            try:
+                steps = len(loader)
+            except TypeError:
+                steps = None
+            for cb in cbs:
+                cb.on_epoch_begin(epoch, {"steps": steps})
+            logs = {}
+            for step_i, data in enumerate(loader):
+                for cb in cbs:
+                    cb.on_train_batch_begin(step_i)
+                x, y = self._split_batch(data)
+                (loss,) = self.train_batch(x, y)
+                logs = {"loss": loss}
+                for cb in cbs:
+                    cb.on_train_batch_end(step_i, logs)
+                it_count += 1
+                if num_iters is not None and it_count >= num_iters:
+                    break
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_data, batch_size=batch_size,
+                                          verbose=0,
+                                          num_workers=num_workers)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+                for cb in cbs:
+                    cb.on_eval_end(eval_logs)
+            for cb in cbs:
+                cb.on_epoch_end(epoch, logs)
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(os.path.join(save_dir, str(epoch)))
+            if any(getattr(cb, "stop_training", False) for cb in cbs) or \
+                    self.stop_training:
+                break
+            if num_iters is not None and it_count >= num_iters:
+                break
+        for cb in cbs:
+            cb.on_train_end()
+        return self
+
+    # -- eval / predict --------------------------------------------------
+    def _sync(self):
+        if self._train_step is not None:
+            self._train_step.sync_to_model()
+
+    def _forward_eval(self, inputs, labels=None):
+        was_training = self.network.training
+        self.network.eval()
+        try:
+            out = self.network(*_as_list(inputs))
+            labels = _as_list(labels)
+            loss = self._loss_value(out, labels) \
+                if (self._loss is not None and labels) else None
+            return out, (float(loss) if loss is not None else None)
+        finally:
+            if was_training:
+                self.network.train()
+
+    def eval_batch(self, inputs, labels=None):
+        self._sync()
+        return self._forward_eval(inputs, labels)
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=1,
+                 num_workers=0, callbacks=None, num_samples=None):
+        """Parity: Model.evaluate (hapi/model.py:1740)."""
+        from ..io.dataloader import DataLoader, Dataset
+        loader = eval_data
+        if isinstance(eval_data, Dataset):
+            loader = DataLoader(eval_data, batch_size=batch_size,
+                                num_workers=num_workers)
+        for m in self._metrics:
+            m.reset()
+        self._sync()   # once per evaluate, not per batch
+        losses = []
+        for data in loader:
+            x, y = self._split_batch(data)
+            out, loss = self._forward_eval(x, y)
+            if loss is not None:
+                losses.append(loss)
+            for m in self._metrics:
+                if hasattr(m, "compute"):
+                    m.update(*m.compute(out, *y))
+                else:
+                    m.update(out, *y)
+        logs = {}
+        if losses:
+            logs["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            names = m.name()
+            vals = m.accumulate()
+            if isinstance(names, (list, tuple)):
+                vals = vals if isinstance(vals, (list, tuple)) else [vals]
+                logs.update(dict(zip(names, vals)))
+            else:
+                logs[names] = vals
+        return logs
+
+    def predict_batch(self, inputs):
+        self._sync()
+        out, _ = self._forward_eval(inputs)
+        return out
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        """Parity: Model.predict (hapi/model.py:1991)."""
+        from ..io.dataloader import DataLoader, Dataset
+        loader = test_data
+        if isinstance(test_data, Dataset):
+            loader = DataLoader(test_data, batch_size=batch_size,
+                                num_workers=num_workers)
+        self._sync()   # once per predict, not per batch
+        outs = []
+        for data in loader:
+            x, _ = self._split_batch(data)
+            out, _ = self._forward_eval(x)
+            outs.append(out)
+        if stack_outputs:
+            if outs and isinstance(outs[0], (tuple, list)):
+                return [Tensor(np.concatenate([o[i].numpy() for o in outs]))
+                        for i in range(len(outs[0]))]
+            return [Tensor(np.concatenate([o.numpy() for o in outs]))]
+        return outs
+
+    # -- io --------------------------------------------------------------
+    def save(self, path, training=True):
+        """Parity: Model.save — writes <path>.pdparams (+ .pdopt)."""
+        self._sync()
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        state = _load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(opt_path):
+            self._optimizer.set_state_dict(_load(opt_path))
+        self._train_step = None
+        return self
+
+    def parameters(self, *a, **k):
+        return self.network.parameters(*a, **k)
+
+    def summary(self, input_size=None, dtype=None):
+        n_params = sum(int(np.prod(p.shape))
+                       for p in self.network.parameters())
+        lines = [f"{self.network.__class__.__name__}: "
+                 f"{n_params:,} parameters"]
+        for name, sub in self.network.named_sublayers():
+            cnt = sum(int(np.prod(p.shape))
+                      for p in sub._parameters.values() if p is not None)
+            if cnt:
+                lines.append(f"  {name}: {cnt:,}")
+        s = "\n".join(lines)
+        print(s)
+        return {"total_params": n_params}

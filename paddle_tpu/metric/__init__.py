@@ -1,0 +1,112 @@
+"""paddle.metric parity (python/paddle/metric/metrics.py): streaming
+metrics consumed by hapi Model.fit."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall"]
+
+
+def _np(x):
+    return x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+
+
+class Metric:
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        return self.__class__.__name__.lower()
+
+
+class Accuracy(Metric):
+    """Parity: paddle.metric.Accuracy (top-k)."""
+
+    def __init__(self, topk=(1,), name="acc"):
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        self.correct = np.zeros(len(self.topk))
+        self.total = 0
+
+    def compute(self, pred, label):
+        pred = _np(pred)
+        label = _np(label).reshape(-1)
+        order = np.argsort(-pred, axis=-1)
+        return order, label
+
+    def update(self, correct, label=None):
+        if label is not None:  # called with (pred_order, label)
+            order, label = correct, label
+            for i, k in enumerate(self.topk):
+                self.correct[i] += (order[..., :k] ==
+                                    label[:, None]).any(-1).sum()
+            self.total += label.shape[0]
+        return self.accumulate()
+
+    def accumulate(self):
+        acc = self.correct / max(self.total, 1)
+        return acc[0] if len(self.topk) == 1 else list(acc)
+
+    def name(self):
+        if len(self.topk) == 1:
+            return [self._name]
+        return [f"{self._name}_top{k}" for k in self.topk]
+
+
+class Precision(Metric):
+    """Binary precision. Parity: paddle.metric.Precision."""
+
+    def __init__(self, name="precision"):
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        p = (_np(preds).reshape(-1) > 0.5)
+        l = _np(labels).reshape(-1).astype(bool)
+        self.tp += int((p & l).sum())
+        self.fp += int((p & ~l).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return [self._name]
+
+
+class Recall(Metric):
+    def __init__(self, name="recall"):
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        p = (_np(preds).reshape(-1) > 0.5)
+        l = _np(labels).reshape(-1).astype(bool)
+        self.tp += int((p & l).sum())
+        self.fn += int((~p & l).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return [self._name]

@@ -1,0 +1,9 @@
+"""Flagship model zoo (BASELINE.json configs: GPT-3 family pretraining,
+LLaMA-style hybrid parallel; vision models live in paddle_tpu.vision)."""
+from .gpt import (GPTConfig, GPTForCausalLM, GPTModel,
+                  GPTPipelineForCausalLM, gpt_tiny, gpt_125m, gpt_1p3b,
+                  gpt_6p7b)
+
+__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM",
+           "GPTPipelineForCausalLM", "gpt_tiny", "gpt_125m", "gpt_1p3b",
+           "gpt_6p7b"]

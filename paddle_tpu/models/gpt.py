@@ -1,0 +1,270 @@
+"""GPT decoder family — the flagship pretraining model.
+
+Capability parity: the reference trains GPT-3-scale models through Fleet
+hybrid parallelism (SURVEY.md §3.4 north-star path; model code lives in
+PaddleNLP, driven by the fleet TP layers mpu/mp_layers.py and
+PipelineLayer). This is a TPU-first implementation of the same model
+family, wired for every mesh axis at once:
+
+- mp: qkv/mlp-in are ColumnParallelLinear, out-proj/mlp-out are
+  RowParallelLinear, embeddings are VocabParallel (one GSPMD allreduce per
+  block pair, Megatron layout over the innermost ICI axis);
+- sp: attention dispatches to ring_attention when the "sp" axis is real
+  (exceeds the reference — it has no sequence parallelism, §5.7);
+- pp: GPTPipelineForCausalLM arranges the same blocks as a PipelineLayer
+  (stacked params, in-program microbatch ring schedule);
+- dp/sharding: batch sharding + ZeRO slot sharding come from
+  ParallelTrainStep, orthogonal to the model.
+
+All matmul-heavy compute is bfloat16-friendly (use amp.auto_cast or
+Layer.bfloat16()); attention/log-softmax accumulate in fp32.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from .. import tensor as T
+from ..core.tensor import Tensor
+from ..distributed import mesh as mesh_mod
+from ..distributed.meta_parallel import (ColumnParallelLinear, LayerDesc,
+                                         PipelineLayer, RowParallelLinear,
+                                         VocabParallelEmbedding)
+from ..distributed.sequence_parallel import ring_attention
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer_base import Layer
+from ..nn import Dropout, Embedding, LayerNorm, Linear
+
+__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM",
+           "GPTPipelineForCausalLM", "gpt_tiny", "gpt_125m", "gpt_1p3b",
+           "gpt_6p7b"]
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    max_seq_len: int = 1024
+    ffn_mult: int = 4
+    dropout: float = 0.0
+    tie_embeddings: bool = True
+    use_moe: bool = False
+    moe_experts: int = 8
+    initializer_range: float = 0.02
+
+
+def gpt_tiny(**kw):
+    return GPTConfig(vocab_size=256, hidden_size=64, num_layers=4,
+                     num_heads=4, max_seq_len=128, **kw)
+
+
+def gpt_125m(**kw):
+    return GPTConfig(hidden_size=768, num_layers=12, num_heads=12, **kw)
+
+
+def gpt_1p3b(**kw):
+    return GPTConfig(hidden_size=2048, num_layers=24, num_heads=16,
+                     max_seq_len=2048, **kw)
+
+
+def gpt_6p7b(**kw):
+    return GPTConfig(hidden_size=4096, num_layers=32, num_heads=32,
+                     max_seq_len=2048, **kw)
+
+
+def _sp_active() -> bool:
+    mesh = mesh_mod.get_mesh(create_default=False)
+    return mesh is not None and mesh.shape.get("sp", 1) > 1
+
+
+class GPTAttention(Layer):
+    """Causal self-attention, TP-sharded heads, sp-aware dispatch."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        h, nh = cfg.hidden_size, cfg.num_heads
+        if h % nh:
+            raise ValueError("hidden_size % num_heads != 0")
+        self.num_heads = nh
+        self.head_dim = h // nh
+        init = I.Normal(0.0, cfg.initializer_range)
+        self.qkv = ColumnParallelLinear(h, 3 * h, weight_attr=init,
+                                        gather_output=False)
+        self.out_proj = RowParallelLinear(h, h, weight_attr=init,
+                                          input_is_parallel=True)
+        self.dropout = Dropout(cfg.dropout)
+
+    def forward(self, x):
+        B, S, H = x.shape
+        qkv = self.qkv(x)                       # [B, S, 3H] (mp-sharded)
+        qkv = T.reshape(qkv, [B, S, 3, self.num_heads, self.head_dim])
+        q = T.squeeze(T.slice(qkv, [2], [0], [1]), 2)
+        k = T.squeeze(T.slice(qkv, [2], [1], [2]), 2)
+        v = T.squeeze(T.slice(qkv, [2], [2], [3]), 2)
+        if _sp_active():
+            ctx = ring_attention(q, k, v, causal=True)
+        else:
+            ctx, _ = F.flash_attention(q, k, v, causal=True,
+                                       training=self.training)
+        ctx = T.reshape(ctx, [B, S, H])
+        return self.dropout(self.out_proj(ctx))
+
+
+class GPTMLP(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        init = I.Normal(0.0, cfg.initializer_range)
+        self.fc_in = ColumnParallelLinear(h, cfg.ffn_mult * h,
+                                          weight_attr=init,
+                                          gather_output=False)
+        self.fc_out = RowParallelLinear(cfg.ffn_mult * h, h,
+                                        weight_attr=init,
+                                        input_is_parallel=True)
+        self.dropout = Dropout(cfg.dropout)
+
+    def forward(self, x):
+        return self.dropout(self.fc_out(F.gelu(self.fc_in(x))))
+
+
+class GPTBlock(Layer):
+    """Pre-LN transformer block (the unit the pipeline stacks)."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.ln_1 = LayerNorm(cfg.hidden_size)
+        self.attn = GPTAttention(cfg)
+        self.ln_2 = LayerNorm(cfg.hidden_size)
+        if cfg.use_moe:
+            from ..distributed.moe import MoELayer
+            self.mlp = MoELayer(cfg.hidden_size,
+                                cfg.ffn_mult * cfg.hidden_size,
+                                cfg.moe_experts)
+        else:
+            self.mlp = GPTMLP(cfg)
+
+    def forward(self, x):
+        x = x + self.attn(self.ln_1(x))
+        x = x + self.mlp(self.ln_2(x))
+        return x
+
+
+class GPTEmbeddings(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        init = I.Normal(0.0, cfg.initializer_range)
+        self.word_embeddings = VocabParallelEmbedding(
+            cfg.vocab_size, cfg.hidden_size, weight_attr=init)
+        self.position_embeddings = Embedding(
+            cfg.max_seq_len, cfg.hidden_size, weight_attr=init)
+        self.dropout = Dropout(cfg.dropout)
+
+    def forward(self, ids):
+        S = ids.shape[-1]
+        pos = T.arange(0, S, dtype="int64")
+        x = self.word_embeddings(ids) + self.position_embeddings(pos)
+        return self.dropout(x)
+
+
+class GPTModel(Layer):
+    """Decoder stack without head. Parity role: GPTModel in the reference
+    ecosystem driven through fleet (SURVEY.md §3.4)."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embeddings = GPTEmbeddings(cfg)
+        self.blocks = []
+        for i in range(cfg.num_layers):
+            blk = GPTBlock(cfg)
+            self.add_sublayer(f"block_{i}", blk)
+            self.blocks.append(blk)
+        self.ln_f = LayerNorm(cfg.hidden_size)
+
+    def forward(self, ids):
+        x = self.embeddings(ids)
+        for blk in self.blocks:
+            x = blk(x)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(Layer):
+    """LM head on top; loss = causal LM cross-entropy.
+
+    lm head is tied to the (vocab-parallel) embedding when
+    cfg.tie_embeddings — the sharded logits matmul then feeds the
+    ParallelCrossEntropy-style fp32 softmax inside F.cross_entropy.
+    """
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.gpt = GPTModel(cfg)
+        if not cfg.tie_embeddings:
+            self.lm_head = Linear(cfg.hidden_size, cfg.vocab_size,
+                                  weight_attr=I.Normal(
+                                      0.0, cfg.initializer_range),
+                                  bias_attr=False)
+
+    def forward(self, ids):
+        x = self.gpt(ids)
+        if self.cfg.tie_embeddings:
+            w = self.gpt.embeddings.word_embeddings.weight
+            return T.matmul(x, T.transpose(w, [1, 0]))
+        return self.lm_head(x)
+
+    @staticmethod
+    def loss_fn(logits, labels):
+        V = logits.shape[-1]
+        return T.mean(F.cross_entropy(T.reshape(logits, [-1, V]),
+                                      T.reshape(labels, [-1])))
+
+
+class _EmbedStage(Layer):
+    def __init__(self, cfg):
+        super().__init__()
+        self.emb = GPTEmbeddings(cfg)
+
+    def forward(self, ids):
+        return self.emb(ids)
+
+
+class _HeadStage(Layer):
+    def __init__(self, cfg):
+        super().__init__()
+        self.ln_f = LayerNorm(cfg.hidden_size)
+        self.head = Linear(cfg.hidden_size, cfg.vocab_size,
+                           weight_attr=I.Normal(0.0, cfg.initializer_range),
+                           bias_attr=False)
+
+    def forward(self, x):
+        return self.head(self.ln_f(x))
+
+
+class GPTPipelineForCausalLM(PipelineLayer):
+    """The same GPT arranged for pipeline parallelism.
+
+    Parity: PipelineLayer GPT arrangements in the reference test suite
+    (unittests/collective/fleet/hybrid_parallel_pp_transformer.py). Blocks
+    stack over the pp axis; embeddings/head run as prologue/epilogue (so
+    tying across stages is not used here — reference PP GPT uses
+    SharedLayerDesc; with one global program the head stays a separate
+    Linear for homogeneity).
+    """
+
+    def __init__(self, cfg: GPTConfig, num_stages: Optional[int] = None,
+                 recompute_interval: int = 0):
+        self.cfg = cfg
+        super().__init__(
+            layers=[LayerDesc(_EmbedStage, cfg)]
+            + [LayerDesc(GPTBlock, cfg) for _ in range(cfg.num_layers)]
+            + [LayerDesc(_HeadStage, cfg)],
+            num_stages=num_stages,
+            loss_fn=GPTForCausalLM.loss_fn,
+            recompute_interval=recompute_interval)

@@ -1,0 +1,5 @@
+"""paddle.vision parity (SURVEY.md §2.8 vision row): model zoo +
+transforms + datasets scaffolding."""
+from . import models, transforms  # noqa: F401
+
+__all__ = ["models", "transforms"]

@@ -1,0 +1,5 @@
+from .resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,
+                     resnet152, wide_resnet50_2, resnext50_32x4d)
+
+__all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
+           "resnet152", "wide_resnet50_2", "resnext50_32x4d"]

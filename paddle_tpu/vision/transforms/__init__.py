@@ -1,0 +1,114 @@
+"""paddle.vision.transforms parity — numpy/host-side image transforms
+(the reference's transforms operate on PIL/numpy before the device;
+SURVEY.md §2.8 vision row). Minimal functional core; Compose pipelines
+plug into paddle_tpu.io.DataLoader workers.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["Compose", "Normalize", "Resize", "CenterCrop", "RandomCrop",
+           "RandomHorizontalFlip", "ToTensor", "Transpose"]
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def __call__(self, x):
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+
+class Normalize:
+    def __init__(self, mean, std, data_format="CHW", **kw):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def __call__(self, x):
+        x = np.asarray(x, np.float32)
+        shape = (-1, 1, 1) if self.data_format == "CHW" else (1, 1, -1)
+        return (x - self.mean.reshape(shape)) / self.std.reshape(shape)
+
+
+def _resize_np(img, size):
+    """Nearest-neighbor host resize (HWC uint8/float)."""
+    h, w = img.shape[:2]
+    if isinstance(size, int):
+        if h < w:
+            nh, nw = size, int(w * size / h)
+        else:
+            nh, nw = int(h * size / w), size
+    else:
+        nh, nw = size
+    ys = (np.arange(nh) * (h / nh)).astype(np.int64).clip(0, h - 1)
+    xs = (np.arange(nw) * (w / nw)).astype(np.int64).clip(0, w - 1)
+    return img[ys][:, xs]
+
+
+class Resize:
+    def __init__(self, size, interpolation="nearest", **kw):
+        self.size = size
+
+    def __call__(self, img):
+        return _resize_np(np.asarray(img), self.size)
+
+
+class CenterCrop:
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        h, w = img.shape[:2]
+        th, tw = self.size
+        i, j = max((h - th) // 2, 0), max((w - tw) // 2, 0)
+        return img[i:i + th, j:j + tw]
+
+
+class RandomCrop:
+    def __init__(self, size, **kw):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        h, w = img.shape[:2]
+        th, tw = self.size
+        i = np.random.randint(0, max(h - th, 0) + 1)
+        j = np.random.randint(0, max(w - tw, 0) + 1)
+        return img[i:i + th, j:j + tw]
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            return np.asarray(img)[:, ::-1].copy()
+        return np.asarray(img)
+
+
+class ToTensor:
+    """HWC uint8 -> CHW float32 in [0,1]."""
+
+    def __init__(self, data_format="CHW", **kw):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        x = np.asarray(img, np.float32) / 255.0
+        if x.ndim == 2:
+            x = x[:, :, None]
+        if self.data_format == "CHW":
+            x = x.transpose(2, 0, 1)
+        return x
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def __call__(self, img):
+        return np.asarray(img).transpose(self.order)
