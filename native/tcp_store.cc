@@ -1,0 +1,353 @@
+// TCPStore: key-value rendezvous over raw TCP sockets.
+//
+// Native parity: paddle/phi/core/distributed/store/tcp_store.{h,cc} and
+// socket.cpp in the reference — the bootstrap KV store every multi-host
+// job forms its world through (SURVEY.md §2.6 rendezvous row). The TPU
+// runtime forms the ICI world itself; this store carries the DCN-level
+// coordination the reference does over it: rank registration, coordinator
+// address exchange, barriers, elastic heartbeats.
+//
+// Design: one master holds an in-memory map guarded by a mutex+condvar;
+// one detached thread per client connection; blocking GET/WAIT with
+// deadline. C ABI (no C++ types cross the boundary) consumed from Python
+// via ctypes — the reference binds through pybind
+// (paddle/fluid/pybind/communication.cc); ctypes avoids a build-time
+// dependency on pybind11 headers.
+//
+// Wire format (little-endian):
+//   request:  u8 cmd | u32 klen | key bytes | payload
+//   SET(0):   payload = u32 vlen | value bytes        reply: u8 1
+//   GET(1):   payload = i64 timeout_ms                reply: i32 vlen|bytes
+//             (vlen = -1 on timeout)
+//   ADD(2):   payload = i64 delta                     reply: i64 new_value
+//   WAIT(3):  payload = i64 timeout_ms                reply: u8 (1 ok/0 to)
+//   DEL(4):   no payload                              reply: u8 1
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+enum Cmd : uint8_t { kSet = 0, kGet = 1, kAdd = 2, kWait = 3, kDel = 4 };
+
+bool read_full(int fd, void* buf, size_t n) {
+  auto* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  const auto* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+class MasterDaemon {
+ public:
+  explicit MasterDaemon(int listen_fd) : listen_fd_(listen_fd) {
+    accept_thread_ = std::thread([this] { AcceptLoop(); });
+  }
+
+  ~MasterDaemon() { Stop(); }
+
+  void Stop() {
+    bool expected = false;
+    if (!stopping_.compare_exchange_strong(expected, true)) return;
+    ::shutdown(listen_fd_, SHUT_RDWR);
+    ::close(listen_fd_);
+    cv_.notify_all();
+    {
+      // unblock Serve threads parked in recv()
+      std::lock_guard<std::mutex> g(conn_mu_);
+      for (int fd : conn_fds_) ::shutdown(fd, SHUT_RDWR);
+    }
+    if (accept_thread_.joinable()) accept_thread_.join();
+    std::lock_guard<std::mutex> g(conn_mu_);
+    for (auto& t : conn_threads_)
+      if (t.joinable()) t.join();
+  }
+
+ private:
+  void AcceptLoop() {
+    while (!stopping_) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) break;
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::lock_guard<std::mutex> g(conn_mu_);
+      conn_fds_.push_back(fd);
+      conn_threads_.emplace_back([this, fd] { Serve(fd); });
+    }
+  }
+
+  void Serve(int fd) {
+    while (!stopping_) {
+      uint8_t cmd;
+      uint32_t klen;
+      if (!read_full(fd, &cmd, 1) || !read_full(fd, &klen, 4)) break;
+      if (klen > (1u << 20)) break;  // sanity cap on key length
+      std::string key(klen, '\0');
+      if (!read_full(fd, key.data(), klen)) break;
+      bool ok = true;
+      switch (cmd) {
+        case kSet: {
+          uint32_t vlen;
+          if (!read_full(fd, &vlen, 4) || vlen > (1u << 30)) { ok = false; break; }
+          std::string val(vlen, '\0');
+          if (!read_full(fd, val.data(), vlen)) { ok = false; break; }
+          {
+            std::lock_guard<std::mutex> g(mu_);
+            map_[key] = std::move(val);
+          }
+          cv_.notify_all();
+          uint8_t r = 1;
+          ok = write_full(fd, &r, 1);
+          break;
+        }
+        case kGet: {
+          int64_t timeout_ms;
+          if (!read_full(fd, &timeout_ms, 8)) { ok = false; break; }
+          std::string val;
+          if (WaitFor(key, timeout_ms, &val)) {
+            int32_t vlen = static_cast<int32_t>(val.size());
+            ok = write_full(fd, &vlen, 4) &&
+                 write_full(fd, val.data(), val.size());
+          } else {
+            int32_t vlen = -1;
+            ok = write_full(fd, &vlen, 4);
+          }
+          break;
+        }
+        case kAdd: {
+          int64_t delta;
+          if (!read_full(fd, &delta, 8)) { ok = false; break; }
+          int64_t result;
+          {
+            std::lock_guard<std::mutex> g(mu_);
+            int64_t cur = 0;
+            auto it = map_.find(key);
+            if (it != map_.end() && it->second.size() == 8)
+              std::memcpy(&cur, it->second.data(), 8);
+            result = cur + delta;
+            std::string v(8, '\0');
+            std::memcpy(v.data(), &result, 8);
+            map_[key] = std::move(v);
+          }
+          cv_.notify_all();
+          ok = write_full(fd, &result, 8);
+          break;
+        }
+        case kWait: {
+          int64_t timeout_ms;
+          if (!read_full(fd, &timeout_ms, 8)) { ok = false; break; }
+          std::string ignored;
+          uint8_t r = WaitFor(key, timeout_ms, &ignored) ? 1 : 0;
+          ok = write_full(fd, &r, 1);
+          break;
+        }
+        case kDel: {
+          {
+            std::lock_guard<std::mutex> g(mu_);
+            map_.erase(key);
+          }
+          uint8_t r = 1;
+          ok = write_full(fd, &r, 1);
+          break;
+        }
+        default:
+          ok = false;
+      }
+      if (!ok) break;
+    }
+    ::close(fd);
+  }
+
+  bool WaitFor(const std::string& key, int64_t timeout_ms, std::string* out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto ready = [&] { return stopping_ || map_.count(key) > 0; };
+    if (timeout_ms < 0) {
+      cv_.wait(lk, ready);
+    } else if (!cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                             ready)) {
+      return false;
+    }
+    if (stopping_ || !map_.count(key)) return false;
+    *out = map_[key];
+    return true;
+  }
+
+  int listen_fd_;
+  std::atomic<bool> stopping_{false};
+  std::thread accept_thread_;
+  std::mutex conn_mu_;
+  std::vector<std::thread> conn_threads_;
+  std::vector<int> conn_fds_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::map<std::string, std::string> map_;
+};
+
+struct Client {
+  int fd;
+};
+
+}  // namespace
+
+extern "C" {
+
+// ---- master ----------------------------------------------------------
+// Returns an opaque handle (nullptr on failure). Binds 0.0.0.0:port;
+// port==0 picks a free port, readable via pts_master_port.
+void* pts_master_start(int port, int* out_port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      ::listen(fd, 128) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  socklen_t len = sizeof(addr);
+  ::getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len);
+  if (out_port) *out_port = ntohs(addr.sin_port);
+  return new MasterDaemon(fd);
+}
+
+void pts_master_stop(void* handle) {
+  delete static_cast<MasterDaemon*>(handle);
+}
+
+// ---- client ----------------------------------------------------------
+void* pts_client_connect(const char* host, int port, int timeout_ms) {
+  addrinfo hints{}, *res = nullptr;
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  char portstr[16];
+  std::snprintf(portstr, sizeof(portstr), "%d", port);
+  if (::getaddrinfo(host, portstr, &hints, &res) != 0 || !res)
+    return nullptr;
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  int fd = -1;
+  // retry until the master comes up (reference tcp_store connect loop)
+  while (std::chrono::steady_clock::now() < deadline) {
+    fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) break;
+    if (::connect(fd, res->ai_addr, res->ai_addrlen) == 0) break;
+    ::close(fd);
+    fd = -1;
+    std::this_thread::sleep_for(std::chrono::milliseconds(100));
+  }
+  ::freeaddrinfo(res);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return new Client{fd};
+}
+
+void pts_client_close(void* c) {
+  auto* cl = static_cast<Client*>(c);
+  if (cl) {
+    ::close(cl->fd);
+    delete cl;
+  }
+}
+
+static bool send_header(int fd, uint8_t cmd, const char* key, uint32_t klen) {
+  return write_full(fd, &cmd, 1) && write_full(fd, &klen, 4) &&
+         write_full(fd, key, klen);
+}
+
+int pts_set(void* c, const char* key, uint32_t klen, const char* val,
+            uint32_t vlen) {
+  int fd = static_cast<Client*>(c)->fd;
+  if (!send_header(fd, kSet, key, klen) || !write_full(fd, &vlen, 4) ||
+      !write_full(fd, val, vlen))
+    return -1;
+  uint8_t r;
+  return read_full(fd, &r, 1) && r == 1 ? 0 : -1;
+}
+
+// Returns value length (>=0) with *out malloc'd (caller frees via
+// pts_buf_free), -1 on timeout, -2 on socket error.
+int64_t pts_get(void* c, const char* key, uint32_t klen, int64_t timeout_ms,
+                char** out) {
+  int fd = static_cast<Client*>(c)->fd;
+  if (!send_header(fd, kGet, key, klen) ||
+      !write_full(fd, &timeout_ms, 8))
+    return -2;
+  int32_t vlen;
+  if (!read_full(fd, &vlen, 4)) return -2;
+  if (vlen < 0) return -1;
+  char* buf = static_cast<char*>(std::malloc(static_cast<size_t>(vlen)));
+  if (vlen > 0 && !read_full(fd, buf, static_cast<size_t>(vlen))) {
+    std::free(buf);
+    return -2;
+  }
+  *out = buf;
+  return vlen;
+}
+
+int64_t pts_add(void* c, const char* key, uint32_t klen, int64_t delta,
+                int* err) {
+  int fd = static_cast<Client*>(c)->fd;
+  int64_t result = 0;
+  if (!send_header(fd, kAdd, key, klen) || !write_full(fd, &delta, 8) ||
+      !read_full(fd, &result, 8)) {
+    if (err) *err = -1;
+    return 0;
+  }
+  if (err) *err = 0;
+  return result;
+}
+
+int pts_wait(void* c, const char* key, uint32_t klen, int64_t timeout_ms) {
+  int fd = static_cast<Client*>(c)->fd;
+  if (!send_header(fd, kWait, key, klen) ||
+      !write_full(fd, &timeout_ms, 8))
+    return -2;
+  uint8_t r;
+  if (!read_full(fd, &r, 1)) return -2;
+  return r == 1 ? 0 : -1;
+}
+
+int pts_del(void* c, const char* key, uint32_t klen) {
+  int fd = static_cast<Client*>(c)->fd;
+  if (!send_header(fd, kDel, key, klen)) return -1;
+  uint8_t r;
+  return read_full(fd, &r, 1) && r == 1 ? 0 : -1;
+}
+
+void pts_buf_free(char* p) { std::free(p); }
+
+}  // extern "C"

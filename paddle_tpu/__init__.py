@@ -53,6 +53,9 @@ from . import metric  # noqa: E402
 from . import vision  # noqa: E402
 from . import models  # noqa: E402
 from . import hapi  # noqa: E402
+from . import profiler  # noqa: E402
+from . import inference  # noqa: E402
+from . import static  # noqa: E402
 from .hapi import Model  # noqa: E402  (paddle.Model parity)
 
 # default dtype management (paddle.set_default_dtype)

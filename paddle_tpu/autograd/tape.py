@@ -33,6 +33,8 @@ _grad_state = _GradState()
 
 # lazily-bound amp module (circular-import-safe, cached off the hot path)
 _amp = None
+# lazily-bound (flags module, nan/inf checker) pair
+_nan_check = None
 
 
 def is_grad_enabled() -> bool:
@@ -139,8 +141,16 @@ def apply(fn, *inputs, _op_name: str = "", **kwargs):
             if isinstance(x, Tensor) and not x.stop_gradient and _is_diff_value(x.value):
                 diff_idx.append(i)
 
+    global _nan_check
+    if _nan_check is None:
+        from ..framework import flags as _flags_mod
+        from ..framework.nan_inf import maybe_check_outputs
+        _nan_check = (_flags_mod, maybe_check_outputs)
+
     if not diff_idx:
         out = fn(*raw, **kwargs)
+        if _nan_check[0].flag_value("check_nan_inf"):
+            _nan_check[1](out, _op_name)
         return _wrap_outputs(out, None)
 
     def closed(*diff_args):
@@ -150,6 +160,8 @@ def apply(fn, *inputs, _op_name: str = "", **kwargs):
         return fn(*full, **kwargs)
 
     out, vjp_fn = jax.vjp(closed, *[raw[i] for i in diff_idx])
+    if _nan_check[0].flag_value("check_nan_inf"):
+        _nan_check[1](out, _op_name)
     multi = isinstance(out, (tuple, list))
     outs = list(out) if multi else [out]
     avals = [(getattr(o, "shape", ()), getattr(o, "dtype", None)) for o in outs]

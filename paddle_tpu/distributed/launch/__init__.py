@@ -1,0 +1,16 @@
+"""Distributed launcher (`fleetrun` equivalent).
+
+Parity: python -m paddle.distributed.launch / fleetrun (setup.py:1568 ->
+launch/main.py -> CollectiveController.build_pod,
+launch/controllers/collective.py:21,32): craft per-rank envs, spawn local
+trainer processes, watch and tear down on failure (controllers/watcher.py);
+master KV via HTTP/ETCD (controllers/master.py).
+
+TPU-native shape (SURVEY.md §2.6 launcher row): ONE process per host
+drives all local chips (the reference spawns one per GPU), the master KV
+is the native TCPStore (store.py), and the spawned process's JAX runtime
+forms the ICI/DCN world from the envs written here.
+"""
+from .main import ElasticManager, launch, main
+
+__all__ = ["launch", "main", "ElasticManager"]

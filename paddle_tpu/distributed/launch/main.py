@@ -1,0 +1,193 @@
+"""Launcher implementation (see package docstring for the reference map)."""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import List, Optional
+
+from ..store import TCPStore
+
+__all__ = ["launch", "main", "ElasticManager"]
+
+
+def _parse_master(master: str):
+    host, _, port = master.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+def launch(script: str, script_args: List[str], *, nnodes: int = 1,
+           node_rank: int = 0, master: str = "127.0.0.1:37777",
+           nproc_per_node: int = 1, log_dir: Optional[str] = None,
+           envs: Optional[dict] = None, max_restarts: int = 0) -> int:
+    """Spawn trainers on this host and watch them.
+
+    Parity: CollectiveController.build_pod (controllers/collective.py:32)
+    + watcher loop. Returns the first non-zero child exit code (0 if all
+    succeed)."""
+    host, port = _parse_master(master)
+    is_master = node_rank == 0
+    store = TCPStore(host, port, is_master=is_master,
+                     world_size=nnodes, timeout=300.0)
+
+    # rendezvous: every node posts its rank; rank 0's port is authoritative
+    store.set(f"__launch/node/{node_rank}", str(os.getpid()))
+    store.barrier("launch", nnodes)
+
+    world_size = nnodes * nproc_per_node
+    procs: List[subprocess.Popen] = []
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+
+    def spawn(local_rank: int) -> subprocess.Popen:
+        rank = node_rank * nproc_per_node + local_rank
+        env = dict(os.environ)
+        env.update(envs or {})
+        env.update({
+            # reference env contract (PaddleCloudRoleMaker reads these)
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(world_size),
+            "PADDLE_MASTER": master,
+            "PADDLE_LOCAL_RANK": str(local_rank),
+            "PADDLE_NNODES": str(nnodes),
+            "PADDLE_NODE_RANK": str(node_rank),
+            # JAX multi-host formation consumes the same master
+            "JAX_COORDINATOR_ADDRESS": master,
+            "JAX_NUM_PROCESSES": str(world_size),
+            "JAX_PROCESS_ID": str(rank),
+        })
+        stdout = stderr = None
+        if log_dir:
+            stdout = open(os.path.join(log_dir, f"rank_{rank}.log"), "ab")
+            stderr = subprocess.STDOUT
+        return subprocess.Popen([sys.executable, script] + list(script_args),
+                                env=env, stdout=stdout, stderr=stderr)
+
+    for lr in range(nproc_per_node):
+        procs.append(spawn(lr))
+
+    # watcher (parity: controllers/watcher.py): first failure tears down
+    # the pod; restarts up to max_restarts
+    restarts = 0
+    exit_code = 0
+    try:
+        while procs:
+            alive = []
+            for p in procs:
+                ret = p.poll()
+                if ret is None:
+                    alive.append(p)
+                elif ret != 0:
+                    if restarts < max_restarts:
+                        restarts += 1
+                        idx = procs.index(p)
+                        alive.append(spawn(idx % nproc_per_node))
+                    else:
+                        exit_code = ret
+                        for q in procs:
+                            if q.poll() is None:
+                                q.terminate()
+                        return exit_code
+            procs = alive
+            if procs:
+                time.sleep(0.2)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        store.close()
+    return exit_code
+
+
+class ElasticManager:
+    """Elastic membership over the TCPStore.
+
+    Parity: ElasticManager (python/paddle/distributed/fleet/elastic/
+    manager.py:126) — there etcd holds node leases and watches trigger
+    rescale (:254,321) with `_match` deciding if the world fits min/max np
+    (:422). Here the TCPStore holds heartbeat keys; `watch()` reports
+    JOIN/LEAVE, and the launcher relaunches with regenerated ranks.
+    """
+
+    HEARTBEAT_SEC = 2.0
+    TTL_SEC = 6.0
+
+    def __init__(self, store: TCPStore, node_id: str, np_range=(1, None)):
+        self.store = store
+        self.node_id = node_id
+        self.np_min, self.np_max = np_range
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def register(self):
+        self._beat()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def _beat(self):
+        self.store.set(f"__elastic/{self.node_id}", str(time.time()))
+
+    def _loop(self):
+        while not self._stop.wait(self.HEARTBEAT_SEC):
+            self._beat()
+
+    def alive_nodes(self, candidates) -> List[str]:
+        now = time.time()
+        alive = []
+        for node in candidates:
+            try:
+                ts = float(self.store.get(f"__elastic/{node}"))
+                if now - ts <= self.TTL_SEC:
+                    alive.append(node)
+            except (TimeoutError, RuntimeError, ValueError):
+                pass
+        return alive
+
+    def match(self, candidates) -> bool:
+        """Parity: ElasticManager._match (:422) — does the live world fit
+        the allowed np range?"""
+        n = len(self.alive_nodes(candidates))
+        if n < self.np_min:
+            return False
+        if self.np_max is not None and n > self.np_max:
+            return False
+        return True
+
+    def exit(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        try:
+            self.store.delete_key(f"__elastic/{self.node_id}")
+        except Exception:
+            pass
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """CLI entry: python -m paddle_tpu.distributed.launch [opts] script.py
+    [script args...]"""
+    ap = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="fleetrun-equivalent multi-host launcher")
+    ap.add_argument("--nnodes", type=int, default=1)
+    ap.add_argument("--node_rank", type=int,
+                    default=int(os.environ.get("PADDLE_NODE_RANK", 0)))
+    ap.add_argument("--master", default=os.environ.get(
+        "PADDLE_MASTER", "127.0.0.1:37777"))
+    ap.add_argument("--nproc_per_node", type=int, default=1,
+                    help="processes per host (default 1: one process "
+                         "drives all local TPU chips)")
+    ap.add_argument("--log_dir", default=None)
+    ap.add_argument("--max_restarts", type=int, default=0)
+    ap.add_argument("script")
+    ap.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+    return launch(args.script, args.script_args, nnodes=args.nnodes,
+                  node_rank=args.node_rank, master=args.master,
+                  nproc_per_node=args.nproc_per_node,
+                  log_dir=args.log_dir, max_restarts=args.max_restarts)

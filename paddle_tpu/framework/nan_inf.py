@@ -1,0 +1,74 @@
+"""nan/inf debugging (SURVEY.md §5.2).
+
+Reference: FLAGS_check_nan_inf triggers per-op output scans —
+CheckVarHasNanOrInf (paddle/fluid/framework/details/nan_inf_utils_detail.cc:
+177), eager hook (paddle/fluid/eager/nan_inf_utils.cc), with
+check_nan_inf_level controlling abort-vs-log. TPU-native: the eager hook
+scans concrete op outputs at the tape's single dispatch point; for compiled
+programs the same flag flips `jax_debug_nans`, XLA's whole-program
+equivalent (re-runs the failing op un-jitted to locate it).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import flags
+
+__all__ = ["check_numerics", "enable_nan_inf_check",
+           "disable_nan_inf_check"]
+
+
+def enable_nan_inf_check(level: int = 0):
+    """Parity: FLAGS_check_nan_inf=1 (+ level). jax_debug_nans (which
+    raises) only arms at level 0 — level>=1 is log-only."""
+    flags.set_flags({"check_nan_inf": True, "check_nan_inf_level": level})
+    try:
+        jax.config.update("jax_debug_nans", level == 0)
+    except Exception:
+        pass
+
+
+def disable_nan_inf_check():
+    flags.set_flags({"check_nan_inf": False})
+    try:
+        jax.config.update("jax_debug_nans", False)
+    except Exception:
+        pass
+
+
+def check_numerics(value, op_name: str = ""):
+    """Scan one op output; raise (level 0) or warn (level>=1) on nan/inf.
+    Tracers pass through untouched — jitted programs are covered by
+    jax_debug_nans."""
+    if isinstance(value, jax.core.Tracer) or not hasattr(value, "dtype"):
+        return value
+    if not jnp.issubdtype(value.dtype, jnp.floating):
+        return value
+    finite = bool(jnp.all(jnp.isfinite(value)))
+    if finite:
+        return value
+    arr = np.asarray(value)
+    n_nan = int(np.isnan(arr).sum())
+    n_inf = int(np.isinf(arr).sum())
+    msg = (f"Operator {op_name or '<unknown>'} output contains "
+           f"{n_nan} nan / {n_inf} inf values "
+           f"(shape {tuple(arr.shape)}, dtype {arr.dtype}). "
+           f"[FLAGS_check_nan_inf] reference: nan_inf_utils_detail.cc:177")
+    if flags.flag_value("check_nan_inf_level") >= 1:
+        import logging
+        logging.getLogger("paddle_tpu").warning(msg)
+        return value
+    raise FloatingPointError(msg)
+
+
+def maybe_check_outputs(outs, op_name: str):
+    """Called from the tape when FLAGS_check_nan_inf is on."""
+    if isinstance(outs, (tuple, list)):
+        for o in outs:
+            check_numerics(o, op_name)
+    else:
+        check_numerics(outs, op_name)
+    return outs

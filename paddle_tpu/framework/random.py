@@ -47,9 +47,17 @@ class Generator:
 
 class _RngState(threading.local):
     def __init__(self):
-        self.generator = Generator(0)
+        # created on first use: constructing a key initializes the JAX
+        # backend, which importers (e.g. the launcher parent process)
+        # must not trigger
+        self.generator = None
         # Stack of override generators installed by rng_guard (trace-safe).
         self.stack = []
+
+    def get(self) -> Generator:
+        if self.generator is None:
+            self.generator = Generator(0)
+        return self.generator
 
 
 _state = _RngState()
@@ -58,12 +66,12 @@ _state = _RngState()
 def default_generator() -> Generator:
     if _state.stack:
         return _state.stack[-1]
-    return _state.generator
+    return _state.get()
 
 
 def seed(s: int) -> Generator:
     """paddle.seed parity — reseed the global generator."""
-    return _state.generator.manual_seed(int(s))
+    return _state.get().manual_seed(int(s))
 
 
 def next_key():

@@ -123,9 +123,10 @@ class LRScheduler(Callback):
         self.by_epoch = by_epoch
 
     def _sched(self):
-        # TrainStep already steps the scheduler after every fused step
-        # (jit/training.py) — stepping here too would double-advance it
-        if getattr(self.model, "_train_step", None) is not None:
+        # TrainStep auto-steps the scheduler unless this callback took
+        # ownership (Model.fit flips auto_lr_step off when it sees us)
+        ts = getattr(self.model, "_train_step", None)
+        if ts is not None and getattr(ts, "auto_lr_step", True):
             return None
         opt = getattr(self.model, "_optimizer", None)
         lr = getattr(opt, "_learning_rate", None)

@@ -35,10 +35,16 @@ class Model:
 
     def __init__(self, network: Layer, inputs=None, labels=None):
         self.network = network
+        # declared specs (parity: paddle.Model(inputs=..., labels=...));
+        # when given, their lengths drive the batch split instead of the
+        # last-element-is-label heuristic
+        self._input_specs = _as_list(inputs) or None
+        self._label_specs = _as_list(labels) or None
         self._optimizer = None
         self._loss = None
         self._metrics: List[Metric] = []
         self._train_step: Optional[TrainStep] = None
+        self._auto_lr_step = True
         self.stop_training = False
 
     # -- setup -----------------------------------------------------------
@@ -52,13 +58,21 @@ class Model:
 
     # -- helpers ---------------------------------------------------------
     def _split_batch(self, data):
-        """DataLoader yields (x, y) / (x,) / dict; normalize to lists."""
+        """DataLoader yields (x.., y..) / (x,) / dict; normalize to lists.
+        Declared inputs/labels specs override the default split (last
+        element = single label)."""
         if isinstance(data, dict):
             data = tuple(data.values())
         if isinstance(data, (list, tuple)):
+            data = list(data)
+            if self._input_specs is not None:
+                n_in = len(self._input_specs)
+                n_lb = len(self._label_specs) if self._label_specs else \
+                    len(data) - n_in
+                return data[:n_in], data[n_in:n_in + n_lb]
             if len(data) >= 2:
-                return list(data[:-1]), [data[-1]]
-            return list(data), []
+                return data[:-1], [data[-1]]
+            return data, []
         return [data], []
 
     def _loss_value(self, outputs, labels):
@@ -73,6 +87,7 @@ class Model:
             self._train_step = TrainStep(
                 self.network, lambda out, *ys: self._loss_value(out, ys),
                 self._optimizer, n_inputs=n_inputs)
+            self._train_step.auto_lr_step = self._auto_lr_step
         return self._train_step
 
     # -- train -----------------------------------------------------------
@@ -103,6 +118,13 @@ class Model:
                                 num_workers=num_workers)
         self._save_dir = save_dir
         cbs = config_callbacks(callbacks, self, verbose, log_freq=log_freq)
+        # a user-supplied LRScheduler callback takes over schedule
+        # stepping; recomputed each fit() so dropping the callback later
+        # hands stepping back to TrainStep
+        from .callbacks import LRScheduler as _LRCb
+        self._auto_lr_step = not any(isinstance(c, _LRCb) for c in cbs)
+        if self._train_step is not None:
+            self._train_step.auto_lr_step = self._auto_lr_step
         self.stop_training = False
         for cb in cbs:
             cb.on_train_begin()
@@ -168,6 +190,13 @@ class Model:
         self._sync()
         return self._forward_eval(inputs, labels)
 
+    def _infer_fn(self):
+        """Jitted inference over the training step's device-resident state
+        (no per-op dispatch, no sync copy); eager fallback otherwise."""
+        if self._train_step is not None:
+            return self._train_step.eval_fn()
+        return None
+
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=1,
                  num_workers=0, callbacks=None, num_samples=None):
         """Parity: Model.evaluate (hapi/model.py:1740)."""
@@ -178,21 +207,41 @@ class Model:
                                 num_workers=num_workers)
         for m in self._metrics:
             m.reset()
-        self._sync()   # once per evaluate, not per batch
-        losses = []
-        for data in loader:
+        cbs = list(callbacks or [])
+        for cb in cbs:
+            cb.set_model(self)
+            cb.on_eval_begin()
+        infer = self._infer_fn()
+        if infer is None:
+            self._sync()
+        losses, weights = [], []
+        seen = 0
+        for step_i, data in enumerate(loader):
             x, y = self._split_batch(data)
-            out, loss = self._forward_eval(x, y)
+            if infer is not None:
+                out = infer(*x)
+                with_loss = self._loss is not None and y
+                loss = float(self._loss_value(out, y)) if with_loss \
+                    else None
+            else:
+                out, loss = self._forward_eval(x, y)
+            n = int(x[0].shape[0]) if hasattr(x[0], "shape") else 1
+            seen += n
             if loss is not None:
                 losses.append(loss)
+                weights.append(n)
             for m in self._metrics:
                 if hasattr(m, "compute"):
                     m.update(*m.compute(out, *y))
                 else:
                     m.update(out, *y)
+            for cb in cbs:
+                cb.on_eval_batch_end(step_i, {"loss": loss})
+            if num_samples is not None and seen >= num_samples:
+                break
         logs = {}
         if losses:
-            logs["loss"] = float(np.mean(losses))
+            logs["loss"] = float(np.average(losses, weights=weights))
         for m in self._metrics:
             names = m.name()
             vals = m.accumulate()
@@ -201,6 +250,13 @@ class Model:
                 logs.update(dict(zip(names, vals)))
             else:
                 logs[names] = vals
+        for cb in cbs:
+            cb.on_eval_end(logs)
+        if verbose:
+            import sys
+            print("Eval " + ", ".join(f"{k}: {v:.4f}"
+                                      for k, v in logs.items()),
+                  file=sys.stderr)
         return logs
 
     def predict_batch(self, inputs):
@@ -216,11 +272,16 @@ class Model:
         if isinstance(test_data, Dataset):
             loader = DataLoader(test_data, batch_size=batch_size,
                                 num_workers=num_workers)
-        self._sync()   # once per predict, not per batch
+        infer = self._infer_fn()
+        if infer is None:
+            self._sync()
         outs = []
         for data in loader:
             x, _ = self._split_batch(data)
-            out, _ = self._forward_eval(x)
+            if infer is not None:
+                out = infer(*x)
+            else:
+                out, _ = self._forward_eval(x)
             outs.append(out)
         if stack_outputs:
             if outs and isinstance(outs[0], (tuple, list)):

@@ -1,0 +1,18 @@
+"""Paddle Inference parity: the deployment/serving path (SURVEY.md §2.7).
+
+Reference: paddle_infer::CreatePredictor over AnalysisPredictor
+(paddle/fluid/inference/api/analysis_predictor.cc:274 Init,
+:555 PrepareProgram, :573 OptimizeInferenceProgram, :632 PrepareExecutor)
+with AnalysisConfig (api/analysis_config.cc) and zero-copy IO handles.
+
+TPU-native: the "optimized program" is a serialized StableHLO module
+(produced by paddle.jit.save / static.save_inference_model); "analysis +
+TRT subgraphs" collapse into XLA compilation at load (AOT — first run
+pays no trace). The Config/Predictor/Tensor-handle API surface matches the
+reference so serving code ports directly.
+"""
+from .predictor import (Config, PlaceType, Predictor, Tensor,
+                        create_predictor)
+
+__all__ = ["Config", "Predictor", "create_predictor", "Tensor",
+           "PlaceType"]

@@ -1,0 +1,203 @@
+"""Predictor implementation (see package docstring for the reference map)."""
+from __future__ import annotations
+
+import os
+from enum import Enum
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax
+
+__all__ = ["Config", "Predictor", "create_predictor", "Tensor", "PlaceType"]
+
+
+class PlaceType(Enum):
+    CPU = 0
+    GPU = 1
+    TPU = 2
+    XPU = 3
+
+
+class Config:
+    """Parity: paddle_infer.Config (api/analysis_config.cc) — the knobs
+    that exist map onto XLA; GPU/TRT/MKLDNN toggles are accepted and
+    recorded so ported serving code runs unchanged."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        if prog_file and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[: -len(".pdmodel")]
+        self._model_prefix = prog_file
+        self._params_file = params_file
+        self._device = "tpu"
+        self._device_id = 0
+        self._memory_pool_mb = 0
+        self._enable_profile = False
+        self._glog_info = True
+        self._flags: Dict[str, object] = {}
+
+    # -- model location (reference: SetModel/SetProgFile/SetParamsFile) --
+    def set_model(self, prog_file: str, params_file: Optional[str] = None):
+        if prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[: -len(".pdmodel")]
+        self._model_prefix = prog_file
+        self._params_file = params_file
+
+    def prog_file(self):
+        return (self._model_prefix or "") + ".pdmodel"
+
+    def params_file(self):
+        return self._params_file or (self._model_prefix or "") + ".pdiparams"
+
+    def model_dir(self):
+        return os.path.dirname(self._model_prefix or "")
+
+    # -- device ----------------------------------------------------------
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        # accepted for parity; execution targets the available backend
+        self._memory_pool_mb = memory_pool_init_size_mb
+        self._device_id = device_id
+
+    def enable_tpu(self, device_id: int = 0):
+        self._device = "tpu"
+        self._device_id = device_id
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def use_gpu(self):
+        return False
+
+    # -- accepted no-op toggles (XLA subsumes them) ----------------------
+    def enable_tensorrt_engine(self, *a, **k):
+        pass
+
+    def enable_mkldnn(self):
+        pass
+
+    def switch_ir_optim(self, x=True):
+        pass
+
+    def enable_memory_optim(self):
+        pass
+
+    def switch_use_feed_fetch_ops(self, x=False):
+        pass
+
+    def switch_specify_input_names(self, x=True):
+        pass
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+    def enable_profile(self):
+        self._enable_profile = True
+
+    def disable_glog_info(self):
+        self._glog_info = False
+
+    def summary(self) -> str:
+        return (f"Config(model={self._model_prefix!r}, "
+                f"device={self._device}:{self._device_id})")
+
+
+class Tensor:
+    """Zero-copy style IO handle.
+
+    Parity: paddle_infer.Tensor (ZeroCopyTensor) — copy_from_cpu/
+    copy_to_cpu naming kept; on TPU "copy" is a device_put/device_get.
+    """
+
+    def __init__(self, name: str, owner: "Predictor"):
+        self.name = name
+        self._owner = owner
+        self._value: Optional[jax.Array] = None
+
+    def reshape(self, shape):
+        pass  # shapes flow from the copied array
+
+    def copy_from_cpu(self, data: np.ndarray):
+        self._value = jax.device_put(np.ascontiguousarray(data))
+
+    def copy_to_cpu(self) -> np.ndarray:
+        out = self._owner._outputs.get(self.name)
+        if out is None:
+            raise RuntimeError("run() has not produced this output yet")
+        return np.asarray(out)
+
+    def shape(self):
+        v = self._owner._outputs.get(self.name, self._value)
+        return list(v.shape) if v is not None else []
+
+
+class Predictor:
+    """Parity: paddle_infer.Predictor (AnalysisPredictor).
+
+    Load = deserialize StableHLO + params, AOT-compile per input shape
+    (cached). run() executes the compiled program; get_output_handle
+    exposes results.
+    """
+
+    def __init__(self, config: Config):
+        from ..jit.api import TranslatedLayer
+        import pickle
+
+        self.config = config
+        with open(config.prog_file(), "rb") as f:
+            self._exported = jax.export.deserialize(f.read())
+        with open(config.params_file(), "rb") as f:
+            meta = pickle.load(f)
+        self._state = {n: jax.device_put(v)
+                       for n, v in meta["state"].items()}
+        n_inputs = max(len(self._exported.in_avals) - 1, 1) \
+            if not meta.get("input_spec") else len(meta["input_spec"])
+        self._input_names = [f"x{i}" for i in range(n_inputs)]
+        self._inputs: Dict[str, Tensor] = {
+            n: Tensor(n, self) for n in self._input_names}
+        self._outputs: Dict[str, jax.Array] = {}
+        self._output_names: List[str] = []
+
+    # -- handles ---------------------------------------------------------
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_input_handle(self, name: str) -> Tensor:
+        return self._inputs[name]
+
+    def get_output_names(self) -> List[str]:
+        return list(self._output_names) or ["out0"]
+
+    def get_output_handle(self, name: str) -> Tensor:
+        t = Tensor(name, self)
+        return t
+
+    # -- execution -------------------------------------------------------
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        """Parity: Predictor.run() (ZeroCopyRun)."""
+        if inputs is not None:
+            for name, arr in zip(self._input_names, inputs):
+                self._inputs[name].copy_from_cpu(np.asarray(arr))
+        args = []
+        for name in self._input_names:
+            v = self._inputs[name]._value
+            if v is None:
+                raise RuntimeError(
+                    f"input {name!r} not set; use get_input_handle("
+                    f"{name!r}).copy_from_cpu(...)")
+            args.append(v)
+        out = self._exported.call(self._state, *args)
+        leaves = jax.tree_util.tree_leaves(out)
+        self._output_names = [f"out{i}" for i in range(len(leaves))]
+        self._outputs = dict(zip(self._output_names, leaves))
+        if inputs is not None:
+            return [np.asarray(l) for l in leaves]
+        return True
+
+    def clear_intermediate_tensor(self):
+        self._outputs.clear()
+
+
+def create_predictor(config: Config) -> Predictor:
+    """Parity: paddle_infer.create_predictor."""
+    return Predictor(config)

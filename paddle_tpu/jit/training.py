@@ -65,6 +65,9 @@ class TrainStep:
         self.buffers = jax.tree_util.tree_map(jnp.copy, buffers)
         self.opt_state = optimizer.init(params)
         self.step_count = 0
+        # set False when an external driver (hapi LRScheduler callback)
+        # owns scheduler stepping
+        self.auto_lr_step = True
         self._jitted = None
 
     # ------------------------------------------------------------------
@@ -109,9 +112,10 @@ class TrainStep:
         loss, self.params, self.buffers, self.opt_state = self._jitted(
             self.params, self.buffers, self.opt_state, lr, step_no, rng_key,
             *raw_batch)
-        lr_sched = getattr(self.optimizer, "_learning_rate", None)
-        if hasattr(lr_sched, "step"):
-            lr_sched.step()
+        if self.auto_lr_step:
+            lr_sched = getattr(self.optimizer, "_learning_rate", None)
+            if hasattr(lr_sched, "step"):
+                lr_sched.step()
         return Tensor(loss)
 
     # ------------------------------------------------------------------

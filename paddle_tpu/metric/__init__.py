@@ -46,12 +46,19 @@ class Accuracy(Metric):
         return order, label
 
     def update(self, correct, label=None):
-        if label is not None:  # called with (pred_order, label)
-            order, label = correct, label
-            for i, k in enumerate(self.topk):
-                self.correct[i] += (order[..., :k] ==
-                                    label[:, None]).any(-1).sum()
-            self.total += label.shape[0]
+        if label is None:
+            # paddle convention: update(compute(pred, label)) with one arg
+            if isinstance(correct, tuple) and len(correct) == 2:
+                correct, label = correct
+            else:
+                raise ValueError(
+                    "Accuracy.update expects (order, label) — pass "
+                    "*compute(pred, label) or the tuple it returns")
+        order = correct
+        for i, k in enumerate(self.topk):
+            self.correct[i] += (order[..., :k] ==
+                                label[:, None]).any(-1).sum()
+        self.total += label.shape[0]
         return self.accumulate()
 
     def accumulate(self):

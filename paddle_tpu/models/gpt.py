@@ -21,14 +21,10 @@ Layer.bfloat16()); attention/log-softmax accumulate in fp32.
 """
 from __future__ import annotations
 
-import math
 from dataclasses import dataclass
 from typing import Optional
 
-import numpy as np
-
 from .. import tensor as T
-from ..core.tensor import Tensor
 from ..distributed import mesh as mesh_mod
 from ..distributed.meta_parallel import (ColumnParallelLinear, LayerDesc,
                                          PipelineLayer, RowParallelLinear,
@@ -167,6 +163,10 @@ class GPTEmbeddings(Layer):
 
     def forward(self, ids):
         S = ids.shape[-1]
+        max_len = self.position_embeddings.num_embeddings
+        if S > max_len:
+            raise ValueError(
+                f"sequence length {S} exceeds max_seq_len {max_len}")
         pos = T.arange(0, S, dtype="int64")
         x = self.word_embeddings(ids) + self.position_embeddings(pos)
         return self.dropout(x)
@@ -221,9 +221,14 @@ class GPTForCausalLM(Layer):
 
     @staticmethod
     def loss_fn(logits, labels):
+        """Next-token prediction: logits at position i predict labels[i+1]
+        (callers pass labels=input_ids; the shift happens here)."""
         V = logits.shape[-1]
-        return T.mean(F.cross_entropy(T.reshape(logits, [-1, V]),
-                                      T.reshape(labels, [-1])))
+        shifted_logits = T.slice(logits, [1], [0], [logits.shape[1] - 1])
+        shifted_labels = T.slice(labels, [1], [1], [labels.shape[1]])
+        return T.mean(F.cross_entropy(
+            T.reshape(shifted_logits, [-1, V]),
+            T.reshape(shifted_labels, [-1])))
 
 
 class _EmbedStage(Layer):

@@ -1,0 +1,23 @@
+"""paddle.profiler parity (SURVEY.md §5.1).
+
+Reference: two-generation profiler — RecordEvent RAII host scopes feeding a
+lock-free HostEventRecorder (platform/profiler/host_event_recorder.h),
+CUPTI device tracing (cuda_tracer.cc:61), merged trees exported as chrome
+tracing JSON (chrometracing_logger.cc), python Profiler with scheduler
+states (python/paddle/profiler/profiler.py:344,79) and summary tables
+(profiler_statistic.py).
+
+TPU-native: device-side tracing is the XLA/TPU profiler (jax.profiler →
+xplane, viewable in TensorBoard/XProf); host-side RecordEvent maps to
+jax.profiler.TraceAnnotation so host scopes land in the SAME xplane
+timeline. A lightweight host recorder additionally captures events for
+chrome-trace export and summary() without TensorBoard.
+"""
+from .profiler import (Profiler, ProfilerState, ProfilerTarget,
+                       RecordEvent, export_chrome_tracing, load_profiler_result,
+                       make_scheduler)
+from .timer import benchmark
+
+__all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
+           "make_scheduler", "export_chrome_tracing",
+           "load_profiler_result", "benchmark"]

@@ -1,0 +1,71 @@
+"""Throughput benchmark hooks. Parity: python/paddle/profiler/timer.py
+(Benchmark/`benchmark()` — reader/step cost and ips summary)."""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+__all__ = ["benchmark", "Benchmark"]
+
+
+class _Event:
+    def __init__(self):
+        self.reader_cost = 0.0
+        self.batch_cost = 0.0
+        self.total_samples = 0
+        self.steps = 0
+
+
+class Benchmark:
+    """Parity: profiler/timer.py Benchmark — before_reader/after_reader/
+    after_step hooks accumulating reader/step cost and ips."""
+
+    def __init__(self):
+        self._event = _Event()
+        self._reader_t0: Optional[float] = None
+        self._step_t0: Optional[float] = None
+        self.enabled = False
+
+    def begin(self):
+        self.enabled = True
+        self._event = _Event()
+        self._step_t0 = time.perf_counter()
+
+    def before_reader(self):
+        self._reader_t0 = time.perf_counter()
+
+    def after_reader(self):
+        if self._reader_t0 is not None:
+            self._event.reader_cost += time.perf_counter() - self._reader_t0
+
+    def after_step(self, num_samples: int = 1):
+        now = time.perf_counter()
+        if self._step_t0 is not None:
+            self._event.batch_cost += now - self._step_t0
+        self._step_t0 = now
+        self._event.total_samples += num_samples
+        self._event.steps += 1
+
+    def end(self):
+        self.enabled = False
+
+    # -- report ----------------------------------------------------------
+    @property
+    def ips(self) -> float:
+        e = self._event
+        return e.total_samples / e.batch_cost if e.batch_cost else 0.0
+
+    def report(self) -> dict:
+        e = self._event
+        steps = max(e.steps, 1)
+        return {"reader_cost": e.reader_cost / steps,
+                "batch_cost": e.batch_cost / steps,
+                "ips": self.ips, "steps": e.steps}
+
+
+_benchmark = Benchmark()
+
+
+def benchmark() -> Benchmark:
+    """Parity: paddle.profiler.utils.benchmark()."""
+    return _benchmark

@@ -1,0 +1,134 @@
+"""paddle.static parity shim (SURVEY.md §2.8 static API row).
+
+The reference's static-graph stack (Program/Block/Operator protobuf IR +
+StandaloneExecutor, SURVEY.md L3/L5) does not exist here by design: "static
+graph" IS the traced XLA program (SURVEY.md §7 design stance — one runtime,
+not four). This module keeps the API names ported code reaches for:
+
+- InputSpec — shared with paddle.jit.
+- save_inference_model / load_inference_model — the deployment artifact
+  (StableHLO + params), same files paddle_tpu.inference.Predictor loads
+  (reference: python/paddle/static/io.py).
+- default_main_program/Program/Executor — thin objects for code that only
+  touches them ceremonially (guard scopes, exe.run over a to_static'd
+  callable); anything deeper raises with guidance to paddle.jit.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from ..core.tensor import Tensor
+from ..jit.api import InputSpec, TranslatedLayer
+from ..jit.api import load as _jit_load
+from ..jit.api import save as _jit_save
+from ..nn.layer_base import Layer
+
+__all__ = ["InputSpec", "save_inference_model", "load_inference_model",
+           "Program", "Executor", "default_main_program",
+           "default_startup_program", "program_guard", "data"]
+
+
+def save_inference_model(path_prefix: str, feed_vars, fetch_vars=None,
+                         executor=None, program=None, **kwargs):
+    """Parity: paddle.static.save_inference_model (static/io.py).
+
+    TPU-native signature: `feed_vars` is the Layer to export (or a list of
+    InputSpec when `program` carries the layer); `fetch_vars` may be the
+    input_spec list. Writes <path>.pdmodel + <path>.pdiparams.
+    """
+    if isinstance(feed_vars, Layer):
+        layer, input_spec = feed_vars, fetch_vars
+    elif isinstance(program, Layer):
+        layer, input_spec = program, feed_vars
+    else:
+        raise TypeError(
+            "save_inference_model here exports a Layer traced to StableHLO:"
+            " pass save_inference_model(path, layer, [InputSpec(...)]) — "
+            "there is no ProgramDesc IR in this framework (jit tracing "
+            "replaces it; see paddle_tpu.jit.save)")
+    _jit_save(layer, path_prefix, input_spec=input_spec)
+    return path_prefix
+
+
+def load_inference_model(path_prefix: str, executor=None, **kwargs):
+    """Parity: paddle.static.load_inference_model — returns the loaded
+    program (a TranslatedLayer callable)."""
+    return _jit_load(path_prefix)
+
+
+class Program:
+    """Ceremonial Program object (reference: framework.py Program). The
+    traced-program runtime has no mutable graph to expose."""
+
+    def __init__(self):
+        self._callable = None
+
+    def global_block(self):
+        raise NotImplementedError(
+            "Program.global_block: there is no op-level IR — build models "
+            "as Layers and compile with paddle.jit.to_static")
+
+    def clone(self, for_test=False):
+        return self
+
+
+_main_program = Program()
+_startup_program = Program()
+
+
+def default_main_program() -> Program:
+    return _main_program
+
+
+def default_startup_program() -> Program:
+    return _startup_program
+
+
+class program_guard:
+    """Ceremonial context manager (static-graph code often wraps model
+    construction in it; construction here is ordinary eager python)."""
+
+    def __init__(self, main_program=None, startup_program=None):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def data(name: str, shape, dtype="float32", lod_level=0) -> InputSpec:
+    """Parity: paddle.static.data — returns an InputSpec usable with
+    jit.save/to_static input_spec."""
+    return InputSpec(shape, dtype=dtype, name=name)
+
+
+class Executor:
+    """Parity shim: paddle.static.Executor (fluid/executor.py:921).
+
+    run() executes a compiled callable (TranslatedLayer or a to_static'd
+    Layer) over a feed dict — covering the exe.run(program, feed, fetch)
+    pattern for inference-style code. Training-style Program mutation has
+    no analog; use paddle.jit.TrainStep.
+    """
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True):
+        import numpy as np
+        if not callable(program):
+            raise TypeError(
+                "Executor.run needs a callable program (TranslatedLayer "
+                "from load_inference_model, or a @to_static Layer)")
+        feed = feed or {}
+        args = [v for v in feed.values()]
+        out = program(*[Tensor(a) if not isinstance(a, Tensor) else a
+                        for a in args])
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        if return_numpy:
+            return [o.numpy() if isinstance(o, Tensor) else np.asarray(o)
+                    for o in outs]
+        return list(outs)

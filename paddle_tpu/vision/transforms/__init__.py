@@ -33,27 +33,60 @@ class Normalize:
         return (x - self.mean.reshape(shape)) / self.std.reshape(shape)
 
 
-def _resize_np(img, size):
-    """Nearest-neighbor host resize (HWC uint8/float)."""
+def _target_hw(img, size):
     h, w = img.shape[:2]
     if isinstance(size, int):
         if h < w:
-            nh, nw = size, int(w * size / h)
-        else:
-            nh, nw = int(h * size / w), size
-    else:
-        nh, nw = size
+            return size, int(w * size / h)
+        return int(h * size / w), size
+    return size
+
+
+def _resize_nearest(img, nh, nw):
+    h, w = img.shape[:2]
     ys = (np.arange(nh) * (h / nh)).astype(np.int64).clip(0, h - 1)
     xs = (np.arange(nw) * (w / nw)).astype(np.int64).clip(0, w - 1)
     return img[ys][:, xs]
 
 
+def _resize_bilinear(img, nh, nw):
+    h, w = img.shape[:2]
+    arr = img.astype(np.float32)
+    ys = (np.arange(nh) + 0.5) * (h / nh) - 0.5
+    xs = (np.arange(nw) + 0.5) * (w / nw) - 0.5
+    y0 = np.clip(np.floor(ys).astype(np.int64), 0, h - 1)
+    x0 = np.clip(np.floor(xs).astype(np.int64), 0, w - 1)
+    y1 = np.clip(y0 + 1, 0, h - 1)
+    x1 = np.clip(x0 + 1, 0, w - 1)
+    wy = np.clip(ys - y0, 0.0, 1.0)[:, None]
+    wx = np.clip(xs - x0, 0.0, 1.0)[None, :]
+    if arr.ndim == 3:
+        wy = wy[..., None]
+        wx = wx[..., None]
+    top = arr[y0][:, x0] * (1 - wx) + arr[y0][:, x1] * wx
+    bot = arr[y1][:, x0] * (1 - wx) + arr[y1][:, x1] * wx
+    out = top * (1 - wy) + bot * wy
+    return out.astype(img.dtype) if img.dtype == np.float32 else \
+        np.round(out).astype(img.dtype)
+
+
 class Resize:
-    def __init__(self, size, interpolation="nearest", **kw):
+    """Parity: transforms.Resize; nearest + bilinear host kernels."""
+
+    def __init__(self, size, interpolation="bilinear", **kw):
         self.size = size
+        if interpolation not in ("nearest", "bilinear"):
+            raise ValueError(
+                f"unsupported interpolation {interpolation!r}: this host "
+                "resize implements 'nearest' and 'bilinear'")
+        self.interpolation = interpolation
 
     def __call__(self, img):
-        return _resize_np(np.asarray(img), self.size)
+        img = np.asarray(img)
+        nh, nw = _target_hw(img, self.size)
+        if self.interpolation == "nearest":
+            return _resize_nearest(img, nh, nw)
+        return _resize_bilinear(img, nh, nw)
 
 
 class CenterCrop:
@@ -64,7 +97,10 @@ class CenterCrop:
         img = np.asarray(img)
         h, w = img.shape[:2]
         th, tw = self.size
-        i, j = max((h - th) // 2, 0), max((w - tw) // 2, 0)
+        if h < th or w < tw:
+            raise ValueError(
+                f"CenterCrop size ({th},{tw}) larger than image ({h},{w})")
+        i, j = (h - th) // 2, (w - tw) // 2
         return img[i:i + th, j:j + tw]
 
 
@@ -76,8 +112,11 @@ class RandomCrop:
         img = np.asarray(img)
         h, w = img.shape[:2]
         th, tw = self.size
-        i = np.random.randint(0, max(h - th, 0) + 1)
-        j = np.random.randint(0, max(w - tw, 0) + 1)
+        if h < th or w < tw:
+            raise ValueError(
+                f"RandomCrop size ({th},{tw}) larger than image ({h},{w})")
+        i = np.random.randint(0, h - th + 1)
+        j = np.random.randint(0, w - tw + 1)
         return img[i:i + th, j:j + tw]
 
 
@@ -92,13 +131,16 @@ class RandomHorizontalFlip:
 
 
 class ToTensor:
-    """HWC uint8 -> CHW float32 in [0,1]."""
+    """HWC uint8 -> CHW float32 in [0,1] (floats pass through unscaled,
+    matching the reference's uint8-only scaling)."""
 
     def __init__(self, data_format="CHW", **kw):
         self.data_format = data_format
 
     def __call__(self, img):
-        x = np.asarray(img, np.float32) / 255.0
+        arr = np.asarray(img)
+        x = arr.astype(np.float32) / 255.0 if arr.dtype == np.uint8 \
+            else arr.astype(np.float32)
         if x.ndim == 2:
             x = x[:, :, None]
         if self.data_format == "CHW":

@@ -1,0 +1,70 @@
+"""Serving-path tests (reference: test_analysis_predictor / inference api
+tests): save -> Config -> create_predictor -> zero-copy IO -> run."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.inference import Config, create_predictor
+
+
+@pytest.fixture
+def saved_model(tmp_path):
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.Linear(16, 4))
+    m.eval()
+    path = str(tmp_path / "serve" / "model")
+    paddle.jit.save(m, path, input_spec=[paddle.jit.InputSpec([None, 8])])
+    x = np.random.randn(4, 8).astype("float32")
+    return path, x, m(paddle.to_tensor(x)).numpy()
+
+
+def test_predictor_zero_copy_roundtrip(saved_model):
+    path, x, ref = saved_model
+    cfg = Config(path + ".pdmodel")
+    pred = create_predictor(cfg)
+    names = pred.get_input_names()
+    assert len(names) == 1
+    h = pred.get_input_handle(names[0])
+    h.copy_from_cpu(x)
+    pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0])
+    np.testing.assert_allclose(out.copy_to_cpu(), ref, rtol=1e-5,
+                               atol=1e-6)
+    # dynamic batch via symbolic export
+    x2 = np.random.randn(9, 8).astype("float32")
+    h.copy_from_cpu(x2)
+    pred.run()
+    assert pred.get_output_handle("out0").copy_to_cpu().shape == (9, 4)
+
+
+def test_predictor_run_with_inputs_list(saved_model):
+    path, x, ref = saved_model
+    pred = create_predictor(Config(path))
+    outs = pred.run([x])
+    np.testing.assert_allclose(outs[0], ref, rtol=1e-5, atol=1e-6)
+
+
+def test_static_save_load_inference_model(tmp_path):
+    import paddle_tpu.static as static
+
+    paddle.seed(1)
+    m = nn.Linear(4, 2)
+    m.eval()
+    prefix = str(tmp_path / "static_model")
+    static.save_inference_model(prefix, m,
+                                [static.InputSpec([None, 4])])
+    prog = static.load_inference_model(prefix)
+    exe = static.Executor()
+    x = np.random.randn(3, 4).astype("float32")
+    (out,) = exe.run(prog, feed={"x": x})
+    np.testing.assert_allclose(out, m(paddle.to_tensor(x)).numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_static_data_and_program_guard():
+    import paddle_tpu.static as static
+    spec = static.data("img", [None, 3, 32, 32], "float32")
+    assert spec.shape == [None, 3, 32, 32]
+    with static.program_guard(static.default_main_program()):
+        pass

@@ -1,0 +1,99 @@
+"""Profiler + nan/inf debugging tests (reference style:
+test_profiler.py / check_nan_inf_base.py)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.profiler import (Profiler, ProfilerState, RecordEvent,
+                                 benchmark, make_scheduler)
+
+
+def test_record_event_and_summary(tmp_path):
+    prof = Profiler(timer_only=True)
+    prof.start()
+    with RecordEvent("forward"):
+        x = paddle.to_tensor(np.random.randn(64, 64).astype("float32"))
+        (paddle.matmul(x, x)).numpy()
+    with RecordEvent("forward"):
+        paddle.matmul(x, x).numpy()
+    with RecordEvent("optimizer"):
+        pass
+    prof.step()
+    prof.step()
+    table = prof.summary()
+    assert "forward" in table and "optimizer" in table
+    path = str(tmp_path / "trace.json")
+    prof.export(path)
+    prof.stop()
+    data = json.load(open(path))
+    names = {e["name"] for e in data["traceEvents"]}
+    assert {"forward", "optimizer"} <= names
+
+
+def test_make_scheduler_states():
+    sched = make_scheduler(closed=1, ready=1, record=2, repeat=1)
+    states = [sched(i) for i in range(5)]
+    assert states[0] == ProfilerState.CLOSED
+    assert states[1] == ProfilerState.READY
+    assert states[2] == ProfilerState.RECORD
+    assert states[3] == ProfilerState.RECORD_AND_RETURN
+    assert states[4] == ProfilerState.CLOSED
+
+
+def test_scheduled_profiler_cycles(tmp_path):
+    out_dir = str(tmp_path / "chrome")
+    from paddle_tpu.profiler import export_chrome_tracing
+    prof = Profiler(scheduler=make_scheduler(closed=1, ready=0, record=2,
+                                             repeat=1),
+                    on_trace_ready=export_chrome_tracing(out_dir),
+                    timer_only=True)
+    prof.start()
+    for _ in range(4):
+        with RecordEvent("step"):
+            pass
+        prof.step()
+    prof.stop()
+    assert os.path.isdir(out_dir) and os.listdir(out_dir)
+
+
+def test_benchmark_timer():
+    bm = benchmark()
+    bm.begin()
+    bm.before_reader()
+    bm.after_reader()
+    bm.after_step(num_samples=32)
+    bm.after_step(num_samples=32)
+    rep = bm.report()
+    bm.end()
+    assert rep["steps"] == 2 and rep["ips"] > 0
+
+
+def test_check_nan_inf_raises():
+    from paddle_tpu.framework.nan_inf import (disable_nan_inf_check,
+                                              enable_nan_inf_check)
+    x = paddle.to_tensor(np.array([1.0, 0.0], "float32"))
+    enable_nan_inf_check()
+    try:
+        with pytest.raises(FloatingPointError, match="log"):
+            paddle.log(paddle.to_tensor(np.array([-1.0], "float32")))
+        # clean op passes
+        paddle.add(x, x)
+    finally:
+        disable_nan_inf_check()
+    # disabled: no error
+    paddle.log(paddle.to_tensor(np.array([-1.0], "float32")))
+
+
+def test_check_nan_inf_log_level():
+    from paddle_tpu.framework.nan_inf import (disable_nan_inf_check,
+                                              enable_nan_inf_check)
+    enable_nan_inf_check(level=1)
+    try:
+        out = paddle.log(paddle.to_tensor(np.array([-1.0], "float32")))
+        assert np.isnan(out.numpy()).all()
+    finally:
+        disable_nan_inf_check()

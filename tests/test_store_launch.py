@@ -1,0 +1,104 @@
+"""Native TCPStore + launcher tests (reference: test_tcp_store.cc,
+test_launch_coverage.py)."""
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.distributed.launch import ElasticManager, launch
+from paddle_tpu.distributed.store import TCPStore, build_native_store
+
+
+def test_native_store_builds():
+    assert build_native_store() is not None
+
+
+def test_store_set_get_add_wait():
+    master = TCPStore("127.0.0.1", 0, is_master=True, timeout=5)
+    c = TCPStore("127.0.0.1", master.port, timeout=5)
+    c.set("k", b"v1")
+    assert master.get("k") == b"v1"
+    assert c.add("n", 2) == 2
+    assert master.add("n", 40) == 42
+
+    def later():
+        time.sleep(0.2)
+        master.set("slow", b"done")
+
+    threading.Thread(target=later).start()
+    assert c.get("slow") == b"done"
+    c.close()
+    master.close()
+
+
+def test_store_timeout():
+    master = TCPStore("127.0.0.1", 0, is_master=True, timeout=5)
+    c = TCPStore("127.0.0.1", master.port, timeout=0.3)
+    with pytest.raises(TimeoutError):
+        c.get("missing")
+    c.close()
+    master.close()
+
+
+def test_store_barrier_two_clients():
+    master = TCPStore("127.0.0.1", 0, is_master=True, timeout=10)
+    results = []
+
+    def participant():
+        c = TCPStore("127.0.0.1", master.port, timeout=10)
+        c.barrier("b0", 2)
+        results.append(1)
+        c.close()
+
+    ts = [threading.Thread(target=participant) for _ in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=10)
+    assert results == [1, 1]
+    master.close()
+
+
+def test_launch_spawns_with_envs(tmp_path):
+    script = tmp_path / "train.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys
+        print(os.environ["PADDLE_TRAINER_ID"],
+              os.environ["PADDLE_TRAINERS_NUM"],
+              os.environ["JAX_PROCESS_ID"])
+    """))
+    log_dir = str(tmp_path / "logs")
+    ret = launch(str(script), [], nnodes=1, node_rank=0,
+                 master="127.0.0.1:0" if False else "127.0.0.1:38211",
+                 nproc_per_node=2, log_dir=log_dir)
+    assert ret == 0
+    logs = sorted(os.listdir(log_dir))
+    assert logs == ["rank_0.log", "rank_1.log"]
+    body0 = open(os.path.join(log_dir, "rank_0.log")).read()
+    assert body0.strip().startswith("0 2 0")
+
+
+def test_launch_propagates_failure(tmp_path):
+    script = tmp_path / "boom.py"
+    script.write_text("import sys; sys.exit(3)\n")
+    ret = launch(str(script), [], nnodes=1, node_rank=0,
+                 master="127.0.0.1:38212", nproc_per_node=1)
+    assert ret == 3
+
+
+def test_elastic_manager_membership():
+    master = TCPStore("127.0.0.1", 0, is_master=True, timeout=5)
+    a = ElasticManager(master, "node_a", np_range=(1, 2)).register()
+    b_store = TCPStore("127.0.0.1", master.port, timeout=5)
+    b = ElasticManager(b_store, "node_b", np_range=(1, 2)).register()
+    assert set(a.alive_nodes(["node_a", "node_b"])) == {"node_a", "node_b"}
+    assert a.match(["node_a", "node_b"])
+    b.exit()
+    assert a.alive_nodes(["node_a", "node_b"]) == ["node_a"]
+    a.exit()
+    b_store.close()
+    master.close()
