@@ -56,6 +56,8 @@ from . import hapi  # noqa: E402
 from . import profiler  # noqa: E402
 from . import inference  # noqa: E402
 from . import static  # noqa: E402
+from . import distribution  # noqa: E402
+from . import sparse  # noqa: E402
 from .hapi import Model  # noqa: E402  (paddle.Model parity)
 
 # default dtype management (paddle.set_default_dtype)

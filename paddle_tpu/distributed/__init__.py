@@ -23,6 +23,8 @@ from .moe import GShardGate, MoELayer, NaiveGate, SwitchGate
 from .recompute import recompute, recompute_sequential
 from .sequence_parallel import (ring_attention, shard_sequence,
                                 ulysses_attention)
+from .checkpoint import load_state_dict, save_state_dict
+from .store import TCPStore
 from .strategy import DistributedStrategy
 from .topology import (CommunicateTopology, HybridCommunicateGroup,
                        get_hybrid_communicate_group,
@@ -42,5 +44,6 @@ __all__ = [
     "ParallelTrainStep", "param_sharding", "shard_params", "fleet",
     "MoELayer", "SwitchGate", "GShardGate", "NaiveGate",
     "recompute", "recompute_sequential",
+    "save_state_dict", "load_state_dict", "TCPStore",
     "ring_attention", "ulysses_attention", "shard_sequence",
 ]

@@ -1,0 +1,28 @@
+"""paddle.distribution parity (SURVEY.md §2.8 distributions row).
+
+Reference: python/paddle/distribution/ — Distribution base
+(distribution.py), Normal/Uniform/Categorical/Beta/Dirichlet/Laplace/
+LogNormal/Gumbel/Multinomial/Exponential family, Independent/
+TransformedDistribution wrappers, transform library (transform.py) and the
+@register_kl double-dispatch divergence registry (kl.py).
+
+TPU-native: densities/samples are jnp compositions recorded on the autograd
+tape (rsample is differentiable via reparameterization where the reference
+supports it); sampling draws keys from the global functional RNG, so the
+same code works eagerly and inside jitted programs.
+"""
+from .distributions import (Bernoulli, Beta, Categorical, Dirichlet,
+                            Distribution, Exponential, Gamma, Geometric,
+                            Gumbel, Independent, Laplace, LogNormal,
+                            Multinomial, Normal, TransformedDistribution,
+                            Uniform)
+from .kl import kl_divergence, register_kl
+from .transform import (AbsTransform, AffineTransform, ExpTransform,
+                        PowerTransform, SigmoidTransform, Transform)
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+           "Beta", "Dirichlet", "Exponential", "Gamma", "Geometric",
+           "Gumbel", "Laplace", "LogNormal", "Multinomial", "Independent",
+           "TransformedDistribution", "kl_divergence", "register_kl",
+           "Transform", "AffineTransform", "ExpTransform", "AbsTransform",
+           "PowerTransform", "SigmoidTransform"]

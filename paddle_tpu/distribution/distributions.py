@@ -1,0 +1,633 @@
+"""Distribution classes (see package docstring for the reference map)."""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import jax.scipy.special as jsp
+
+from ..autograd.tape import apply
+from ..core.tensor import Tensor
+from ..framework.random import next_key
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+           "Beta", "Dirichlet", "Exponential", "Gamma", "Geometric",
+           "Gumbel", "Laplace", "LogNormal", "Multinomial", "Independent",
+           "TransformedDistribution"]
+
+
+def _raw(x):
+    """Normalize a distribution parameter, KEEPING Tensors so gradients
+    flow through log_prob/rsample back to learnable parameters."""
+    if isinstance(x, Tensor):
+        return x
+    return jnp.asarray(x, jnp.float32) if not hasattr(x, "dtype") \
+        else jnp.asarray(x)
+
+
+def _v(x):
+    """Raw array view of a (possibly Tensor) parameter."""
+    return x.value if isinstance(x, Tensor) else x
+
+
+def _t(fn, *args, name=""):
+    return apply(fn, *args, _op_name=name)
+
+
+def _shape(sample_shape, batch_shape, event_shape=()):
+    return tuple(sample_shape) + tuple(batch_shape) + tuple(event_shape)
+
+
+class Distribution:
+    """Parity: paddle.distribution.Distribution (distribution.py)."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        out = self.rsample(shape)
+        return out.detach() if isinstance(out, Tensor) else out
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        p = self.log_prob(value)
+        return _t(jnp.exp, p, name="exp")
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    """Parity: paddle.distribution.Normal (normal.py)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _raw(loc)
+        self.scale = _raw(scale)
+        super().__init__(jnp.broadcast_shapes(_v(self.loc).shape,
+                                              _v(self.scale).shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(_v(self.loc), self.batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(_v(self.scale) ** 2,
+                                       self.batch_shape))
+
+    @property
+    def stddev(self):
+        return Tensor(jnp.broadcast_to(_v(self.scale), self.batch_shape))
+
+    def rsample(self, shape=()):
+        key = next_key()
+        shp = _shape(shape, self.batch_shape)
+        eps = jax.random.normal(key, shp, jnp.float32)
+        return _t(lambda l, s: l + s * eps, self.loc, self.scale,
+                  name="normal_rsample")
+
+    def log_prob(self, value):
+        def f(v, l, s):
+            var = s ** 2
+            return -((v - l) ** 2) / (2 * var) - jnp.log(s) \
+                - 0.5 * math.log(2 * math.pi)
+        return _t(f, value, self.loc, self.scale, name="normal_log_prob")
+
+    def entropy(self):
+        def f(s):
+            return jnp.broadcast_to(
+                0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s),
+                self.batch_shape)
+        return _t(f, self.scale, name="normal_entropy")
+
+    def probs(self, value):
+        return self.prob(value)
+
+
+class LogNormal(Distribution):
+    """Parity: lognormal.py."""
+
+    def __init__(self, loc, scale):
+        self.loc = _raw(loc)
+        self.scale = _raw(scale)
+        self._base = Normal(loc, scale)
+        super().__init__(self._base.batch_shape)
+
+    @property
+    def mean(self):
+        return Tensor(jnp.exp(_v(self.loc) + _v(self.scale) ** 2 / 2))
+
+    @property
+    def variance(self):
+        s2 = _v(self.scale) ** 2
+        return Tensor((jnp.exp(s2) - 1) * jnp.exp(2 * _v(self.loc) + s2))
+
+    def rsample(self, shape=()):
+        z = self._base.rsample(shape)
+        return _t(jnp.exp, z, name="exp")
+
+    def log_prob(self, value):
+        def f(v, l, s):
+            logv = jnp.log(v)
+            return -((logv - l) ** 2) / (2 * s ** 2) - jnp.log(s * v) \
+                - 0.5 * math.log(2 * math.pi)
+        return _t(f, value, self.loc, self.scale, name="lognormal_log_prob")
+
+    def entropy(self):
+        return _t(lambda l, s: l + 0.5 + 0.5 * math.log(2 * math.pi)
+                  + jnp.log(s), self.loc, self.scale,
+                  name="lognormal_entropy")
+
+
+class Uniform(Distribution):
+    """Parity: uniform.py."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _raw(low)
+        self.high = _raw(high)
+        super().__init__(jnp.broadcast_shapes(_v(self.low).shape,
+                                              _v(self.high).shape))
+
+    @property
+    def mean(self):
+        return Tensor((_v(self.low) + _v(self.high)) / 2)
+
+    @property
+    def variance(self):
+        return Tensor((_v(self.high) - _v(self.low)) ** 2 / 12)
+
+    def rsample(self, shape=()):
+        key = next_key()
+        shp = _shape(shape, self.batch_shape)
+        u = jax.random.uniform(key, shp, jnp.float32)
+        return _t(lambda lo, hi: lo + (hi - lo) * u, self.low, self.high,
+                  name="uniform_rsample")
+
+    def log_prob(self, value):
+        def f(v, lo, hi):
+            inside = (v >= lo) & (v < hi)
+            return jnp.where(inside, -jnp.log(hi - lo), -jnp.inf)
+        return _t(f, value, self.low, self.high, name="uniform_log_prob")
+
+    def entropy(self):
+        return _t(lambda lo, hi: jnp.log(hi - lo), self.low, self.high,
+                  name="uniform_entropy")
+
+
+class Categorical(Distribution):
+    """Parity: categorical.py — constructed from logits."""
+
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is None and probs is None:
+            raise ValueError("need logits or probs")
+        if logits is not None:
+            self.logits = _raw(logits)
+        else:
+            self.logits = _t(lambda q: jnp.log(jnp.clip(q, 1e-38)),
+                             _raw(probs), name="log")
+        super().__init__(_v(self.logits).shape[:-1])
+        self.n_cats = _v(self.logits).shape[-1]
+
+    @property
+    def probs_value(self):
+        return jax.nn.softmax(_v(self.logits), -1)
+
+    def probs(self, value=None):
+        p = self.probs_value
+        if value is None:
+            return Tensor(p)
+        idx = _v(_raw(value)).astype(jnp.int32)
+        return Tensor(jnp.take_along_axis(p, idx[..., None], -1)[..., 0])
+
+    def sample(self, shape=()):
+        key = next_key()
+        out = jax.random.categorical(
+            key, _v(self.logits), axis=-1,
+            shape=tuple(shape) + self.batch_shape)
+        return Tensor(out)
+
+    def log_prob(self, value):
+        def f(lg):
+            logp = jax.nn.log_softmax(lg, -1)
+            idx = _v(_raw(value)).astype(jnp.int32)
+            return jnp.take_along_axis(logp, idx[..., None], -1)[..., 0]
+        return _t(f, self.logits, name="categorical_log_prob")
+
+    def entropy(self):
+        def f(lg):
+            logp = jax.nn.log_softmax(lg, -1)
+            return -(jnp.exp(logp) * logp).sum(-1)
+        return _t(f, self.logits, name="categorical_entropy")
+
+
+class Bernoulli(Distribution):
+    """Parity: bernoulli (paddle 2.5 adds it)."""
+
+    def __init__(self, probs=None, logits=None):
+        if probs is not None:
+            self.p = _t(lambda q: jnp.clip(q, 1e-7, 1 - 1e-7),
+                        _raw(probs), name="clip")
+        else:
+            self.p = _t(jax.nn.sigmoid, _raw(logits), name="sigmoid")
+        super().__init__(_v(self.p).shape)
+
+    @property
+    def mean(self):
+        return Tensor(_v(self.p))
+
+    @property
+    def variance(self):
+        p = _v(self.p)
+        return Tensor(p * (1 - p))
+
+    def sample(self, shape=()):
+        key = next_key()
+        shp = _shape(shape, self.batch_shape)
+        return Tensor(jax.random.bernoulli(key, _v(self.p), shp)
+                      .astype(jnp.float32))
+
+    def log_prob(self, value):
+        def f(v, p):
+            return v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+        return _t(f, value, self.p, name="bernoulli_log_prob")
+
+    def entropy(self):
+        return _t(lambda p: -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)),
+                  self.p, name="bernoulli_entropy")
+
+
+class Geometric(Distribution):
+    def __init__(self, probs):
+        self.p = _t(lambda q: jnp.clip(q, 1e-7, 1 - 1e-7), _raw(probs),
+                    name="clip")
+        super().__init__(_v(self.p).shape)
+
+    @property
+    def mean(self):
+        # failures-before-first-success support {0,1,...} (matches
+        # sample() and log_prob())
+        p = _v(self.p)
+        return Tensor((1.0 - p) / p)
+
+    def sample(self, shape=()):
+        key = next_key()
+        shp = _shape(shape, self.batch_shape)
+        u = jax.random.uniform(key, shp)
+        return Tensor(jnp.floor(jnp.log(u) / jnp.log1p(-_v(self.p))))
+
+    def log_prob(self, value):
+        return _t(lambda v, p: v * jnp.log1p(-p) + jnp.log(p),
+                  value, self.p, name="geometric_log_prob")
+
+
+class Exponential(Distribution):
+    def __init__(self, rate):
+        self.rate = _raw(rate)
+        super().__init__(_v(self.rate).shape)
+
+    @property
+    def mean(self):
+        return Tensor(1.0 / _v(self.rate))
+
+    @property
+    def variance(self):
+        return Tensor(_v(self.rate) ** -2)
+
+    def rsample(self, shape=()):
+        key = next_key()
+        shp = _shape(shape, self.batch_shape)
+        u = jax.random.exponential(key, shp, jnp.float32)
+        return _t(lambda r: u / r, self.rate, name="exponential_rsample")
+
+    def log_prob(self, value):
+        return _t(lambda v, r: jnp.log(r) - r * v, value, self.rate,
+                  name="exponential_log_prob")
+
+    def entropy(self):
+        return _t(lambda r: 1.0 - jnp.log(r), self.rate,
+                  name="exponential_entropy")
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate):
+        self.concentration = _raw(concentration)
+        self.rate = _raw(rate)
+        super().__init__(jnp.broadcast_shapes(_v(self.concentration).shape,
+                                              _v(self.rate).shape))
+
+    @property
+    def mean(self):
+        return Tensor(_v(self.concentration) / _v(self.rate))
+
+    @property
+    def variance(self):
+        return Tensor(_v(self.concentration) / _v(self.rate) ** 2)
+
+    def rsample(self, shape=()):
+        key = next_key()
+        shp = _shape(shape, self.batch_shape)
+
+        def f(a, r):
+            # jax.random.gamma has implicit-reparam gradients wrt a
+            return jax.random.gamma(key, jnp.broadcast_to(a, shp)) / r
+
+        return _t(f, self.concentration, self.rate, name="gamma_rsample")
+
+    def log_prob(self, value):
+        def f(v, a, r):
+            return a * jnp.log(r) + (a - 1) * jnp.log(v) - r * v \
+                - jsp.gammaln(a)
+        return _t(f, value, self.concentration, self.rate,
+                  name="gamma_log_prob")
+
+    def entropy(self):
+        def f(a, r):
+            return a - jnp.log(r) + jsp.gammaln(a) \
+                + (1 - a) * jsp.digamma(a)
+        return _t(f, self.concentration, self.rate, name="gamma_entropy")
+
+
+class Beta(Distribution):
+    """Parity: beta.py."""
+
+    def __init__(self, alpha, beta):
+        self.alpha = _raw(alpha)
+        self.beta = _raw(beta)
+        super().__init__(jnp.broadcast_shapes(_v(self.alpha).shape,
+                                              _v(self.beta).shape))
+
+    @property
+    def mean(self):
+        return Tensor(_v(self.alpha) / (_v(self.alpha) + _v(self.beta)))
+
+    @property
+    def variance(self):
+        a, b = _v(self.alpha), _v(self.beta)
+        t = a + b
+        return Tensor(a * b / (t ** 2 * (t + 1)))
+
+    def rsample(self, shape=()):
+        key = next_key()
+        shp = _shape(shape, self.batch_shape)
+
+        def f(a, b):
+            return jax.random.beta(key, jnp.broadcast_to(a, shp),
+                                   jnp.broadcast_to(b, shp))
+
+        return _t(f, self.alpha, self.beta, name="beta_rsample")
+
+    def log_prob(self, value):
+        def f(v, a, b):
+            return (a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v) \
+                - (jsp.gammaln(a) + jsp.gammaln(b) - jsp.gammaln(a + b))
+        return _t(f, value, self.alpha, self.beta, name="beta_log_prob")
+
+    def entropy(self):
+        def f(a, b):
+            total = a + b
+            return jsp.gammaln(a) + jsp.gammaln(b) - jsp.gammaln(total) \
+                - (a - 1) * jsp.digamma(a) - (b - 1) * jsp.digamma(b) \
+                + (total - 2) * jsp.digamma(total)
+        return _t(f, self.alpha, self.beta, name="beta_entropy")
+
+
+class Dirichlet(Distribution):
+    """Parity: dirichlet.py."""
+
+    def __init__(self, concentration):
+        self.concentration = _raw(concentration)
+        super().__init__(_v(self.concentration).shape[:-1],
+                         _v(self.concentration).shape[-1:])
+
+    @property
+    def mean(self):
+        c = _v(self.concentration)
+        return Tensor(c / c.sum(-1, keepdims=True))
+
+    def rsample(self, shape=()):
+        key = next_key()
+        shp = _shape(shape, self.batch_shape, self.event_shape)
+
+        def f(c):
+            return jax.random.dirichlet(key, jnp.broadcast_to(c, shp))
+
+        return _t(f, self.concentration, name="dirichlet_rsample")
+
+    def log_prob(self, value):
+        def f(v, a):
+            return ((a - 1) * jnp.log(v)).sum(-1) \
+                + jsp.gammaln(a.sum(-1)) - jsp.gammaln(a).sum(-1)
+        return _t(f, value, self.concentration, name="dirichlet_log_prob")
+
+    def entropy(self):
+        def f(a):
+            a0 = a.sum(-1)
+            k = a.shape[-1]
+            return jsp.gammaln(a).sum(-1) - jsp.gammaln(a0) \
+                + (a0 - k) * jsp.digamma(a0) \
+                - ((a - 1) * jsp.digamma(a)).sum(-1)
+        return _t(f, self.concentration, name="dirichlet_entropy")
+
+
+class Laplace(Distribution):
+    """Parity: laplace.py."""
+
+    def __init__(self, loc, scale):
+        self.loc = _raw(loc)
+        self.scale = _raw(scale)
+        super().__init__(jnp.broadcast_shapes(_v(self.loc).shape,
+                                              _v(self.scale).shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(_v(self.loc), self.batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(2 * _v(self.scale) ** 2)
+
+    def rsample(self, shape=()):
+        key = next_key()
+        shp = _shape(shape, self.batch_shape)
+        u = jax.random.uniform(key, shp, minval=-0.5 + 1e-7,
+                               maxval=0.5 - 1e-7)
+        return _t(lambda l, s: l - s * jnp.sign(u)
+                  * jnp.log1p(-2 * jnp.abs(u)), self.loc, self.scale,
+                  name="laplace_rsample")
+
+    def log_prob(self, value):
+        return _t(lambda v, l, s: -jnp.abs(v - l) / s - jnp.log(2 * s),
+                  value, self.loc, self.scale, name="laplace_log_prob")
+
+    def entropy(self):
+        return _t(lambda s: 1 + jnp.log(2 * s), self.scale,
+                  name="laplace_entropy")
+
+
+class Gumbel(Distribution):
+    """Parity: gumbel.py."""
+
+    def __init__(self, loc, scale):
+        self.loc = _raw(loc)
+        self.scale = _raw(scale)
+        super().__init__(jnp.broadcast_shapes(_v(self.loc).shape,
+                                              _v(self.scale).shape))
+
+    @property
+    def mean(self):
+        return Tensor(_v(self.loc) + _v(self.scale) * 0.57721566490153286)
+
+    @property
+    def variance(self):
+        return Tensor((math.pi ** 2 / 6) * _v(self.scale) ** 2)
+
+    def rsample(self, shape=()):
+        key = next_key()
+        shp = _shape(shape, self.batch_shape)
+        g = jax.random.gumbel(key, shp, jnp.float32)
+        return _t(lambda l, s: l + s * g, self.loc, self.scale,
+                  name="gumbel_rsample")
+
+    def log_prob(self, value):
+        def f(v, l, s):
+            z = (v - l) / s
+            return -(z + jnp.exp(-z)) - jnp.log(s)
+        return _t(f, value, self.loc, self.scale, name="gumbel_log_prob")
+
+    def entropy(self):
+        return _t(lambda s: jnp.log(s) + 1.57721566490153286, self.scale,
+                  name="gumbel_entropy")
+
+
+class Multinomial(Distribution):
+    """Parity: multinomial.py."""
+
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        self.p = _t(lambda q: q / q.sum(-1, keepdims=True), _raw(probs),
+                    name="normalize")
+        super().__init__(_v(self.p).shape[:-1], _v(self.p).shape[-1:])
+
+    @property
+    def mean(self):
+        return Tensor(self.total_count * _v(self.p))
+
+    @property
+    def variance(self):
+        p = _v(self.p)
+        return Tensor(self.total_count * p * (1 - p))
+
+    def sample(self, shape=()):
+        key = next_key()
+        logits = jnp.log(jnp.clip(_v(self.p), 1e-38))
+        draws = jax.random.categorical(
+            key, logits, axis=-1,
+            shape=(self.total_count,) + tuple(shape) + self.batch_shape)
+        k = _v(self.p).shape[-1]
+        one_hot = jax.nn.one_hot(draws, k)
+        return Tensor(one_hot.sum(0))
+
+    def log_prob(self, value):
+        def f(v, p):
+            return jsp.gammaln(v.sum(-1) + 1) - jsp.gammaln(v + 1).sum(-1) \
+                + (v * jnp.log(p)).sum(-1)
+        return _t(f, value, self.p, name="multinomial_log_prob")
+
+
+class Independent(Distribution):
+    """Parity: independent.py — reinterprets batch dims as event dims."""
+
+    def __init__(self, base: Distribution, reinterpreted_batch_rank: int):
+        self.base = base
+        self.rank = reinterpreted_batch_rank
+        bs = base.batch_shape
+        super().__init__(bs[: len(bs) - self.rank],
+                         bs[len(bs) - self.rank:] + base.event_shape)
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        axes = tuple(range(-self.rank, 0)) if self.rank else ()
+        if not axes:
+            return lp
+        return _t(lambda x: x.sum(axes), lp, name="independent_sum")
+
+    def entropy(self):
+        e = self.base.entropy()
+        axes = tuple(range(-self.rank, 0)) if self.rank else ()
+        if not axes:
+            return e
+        return _t(lambda x: x.sum(axes), e, name="independent_sum")
+
+
+class TransformedDistribution(Distribution):
+    """Parity: transformed_distribution.py."""
+
+    def __init__(self, base: Distribution, transforms: Sequence):
+        self.base = base
+        self.transforms = list(transforms)
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def sample(self, shape=()):
+        x = self.rsample(shape)
+        return x.detach() if isinstance(x, Tensor) else x
+
+    def log_prob(self, value):
+        lp = None
+        y = value
+        for t in reversed(self.transforms):
+            x = t.inverse(y)
+            term = t.forward_log_det_jacobian(x)
+            lp = term if lp is None else _t(jnp.add, lp, term, name="add")
+            y = x
+        base_lp = self.base.log_prob(y)
+        if lp is None:
+            return base_lp
+        return _t(jnp.subtract, base_lp, lp, name="subtract")

@@ -1,0 +1,105 @@
+"""Bijective transforms. Parity: python/paddle/distribution/transform.py
+(Transform base with forward/inverse/forward_log_det_jacobian, Affine/Exp/
+Sigmoid/Power/Abs)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..autograd.tape import apply
+from ..core.tensor import Tensor
+
+__all__ = ["Transform", "AffineTransform", "ExpTransform",
+           "SigmoidTransform", "PowerTransform", "AbsTransform"]
+
+
+def _t(fn, *args, name=""):
+    return apply(fn, *args, _op_name=name)
+
+
+class Transform:
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+    def inverse_log_det_jacobian(self, y):
+        return _t(jnp.negative, self.forward_log_det_jacobian(
+            self.inverse(y)), name="neg")
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class AffineTransform(Transform):
+    """y = loc + scale * x."""
+
+    def __init__(self, loc, scale):
+        self.loc = loc.value if isinstance(loc, Tensor) else jnp.asarray(loc)
+        self.scale = scale.value if isinstance(scale, Tensor) \
+            else jnp.asarray(scale)
+
+    def forward(self, x):
+        return _t(lambda v: self.loc + self.scale * v, x, name="affine_fwd")
+
+    def inverse(self, y):
+        return _t(lambda v: (v - self.loc) / self.scale, y,
+                  name="affine_inv")
+
+    def forward_log_det_jacobian(self, x):
+        return _t(lambda v: jnp.broadcast_to(
+            jnp.log(jnp.abs(self.scale)), v.shape), x, name="affine_ldj")
+
+
+class ExpTransform(Transform):
+    def forward(self, x):
+        return _t(jnp.exp, x, name="exp")
+
+    def inverse(self, y):
+        return _t(jnp.log, y, name="log")
+
+    def forward_log_det_jacobian(self, x):
+        return _t(lambda v: v, x, name="identity")
+
+
+class SigmoidTransform(Transform):
+    def forward(self, x):
+        return _t(lambda v: 1 / (1 + jnp.exp(-v)), x, name="sigmoid")
+
+    def inverse(self, y):
+        return _t(lambda v: jnp.log(v) - jnp.log1p(-v), y, name="logit")
+
+    def forward_log_det_jacobian(self, x):
+        return _t(lambda v: -jnp.logaddexp(0.0, v)
+                  - jnp.logaddexp(0.0, -v), x, name="sigmoid_ldj")
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = power.value if isinstance(power, Tensor) \
+            else jnp.asarray(power, jnp.float32)
+
+    def forward(self, x):
+        return _t(lambda v: v ** self.power, x, name="power_fwd")
+
+    def inverse(self, y):
+        return _t(lambda v: v ** (1.0 / self.power), y, name="power_inv")
+
+    def forward_log_det_jacobian(self, x):
+        return _t(lambda v: jnp.log(jnp.abs(self.power
+                                            * v ** (self.power - 1))),
+                  x, name="power_ldj")
+
+
+class AbsTransform(Transform):
+    def forward(self, x):
+        return _t(jnp.abs, x, name="abs")
+
+    def inverse(self, y):
+        return y  # one branch of the preimage (paddle returns positive)
+
+    def forward_log_det_jacobian(self, x):
+        return _t(jnp.zeros_like, x, name="zeros_like")

@@ -1,0 +1,196 @@
+"""paddle.sparse parity (SURVEY.md §2.8 sparse row).
+
+Reference: python/paddle/sparse/ over phi sparse kernels — SparseCooTensor/
+SparseCsrTensor (paddle/phi/core/sparse_coo_tensor.h, sparse_csr_tensor.h),
+creation ops, elementwise/matmul, sparse nn. TPU-native: the payload is
+jax.experimental.sparse BCOO (XLA-lowered COO); CSR views convert through
+COO. Dense<->sparse round trips, values/indices accessors, add/matmul/
+relu and a masked variant match the reference API names.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+           "SparseCsrTensor", "is_sparse", "add", "matmul", "masked_matmul",
+           "relu", "to_dense", "to_sparse_coo"]
+
+
+class SparseCooTensor(Tensor):
+    """COO tensor; `.value` holds a BCOO (parity:
+    phi::SparseCooTensor)."""
+
+    def __init__(self, bcoo, stop_gradient=True):
+        # bypass Tensor.__init__: BCOO is not a jax.Array and must not go
+        # through jnp.asarray; fields are set directly (__slots__ layout)
+        self.value = bcoo
+        self.stop_gradient = stop_gradient
+        self.name = "sparse_coo"
+        self._grad = None
+        self._node = None
+        self._out_index = 0
+        self._retain_grads = False
+        self.persistable = False
+
+    # -- accessors (reference: sparse_coo_tensor.h) ---------------------
+    def indices(self) -> Tensor:
+        return Tensor(self.value.indices.T)   # paddle layout [ndim, nnz]
+
+    def values(self) -> Tensor:
+        return Tensor(self.value.data)
+
+    def nnz(self) -> int:
+        return int(self.value.nse)
+
+    @property
+    def shape(self):
+        return list(self.value.shape)
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self.value.todense())
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.value.dtype})")
+
+
+class SparseCsrTensor(SparseCooTensor):
+    """CSR view (parity: phi::SparseCsrTensor). Stored as BCOO internally
+    (XLA has no native CSR); crows/cols are derived on access."""
+
+    def __init__(self, bcoo, crows=None, cols=None, stop_gradient=True):
+        super().__init__(bcoo, stop_gradient)
+        self.name = "sparse_csr"
+        self._crows = crows
+        self._cols = cols
+
+    def crows(self) -> Tensor:
+        if self._crows is None:
+            rows = np.asarray(self.value.indices[:, 0])
+            n_rows = self.value.shape[0]
+            counts = np.bincount(rows, minlength=n_rows)
+            self._crows = jnp.asarray(
+                np.concatenate([[0], np.cumsum(counts)]).astype(np.int64))
+        return Tensor(self._crows)
+
+    def cols(self) -> Tensor:
+        if self._cols is None:
+            self._cols = jnp.asarray(
+                np.asarray(self.value.indices[:, 1]).astype(np.int64))
+        return Tensor(self._cols)
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+
+def _raw(x):
+    return x.value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      stop_gradient=True):
+    """Parity: paddle.sparse.sparse_coo_tensor(indices [ndim, nnz],
+    values [nnz], shape)."""
+    idx = np.asarray(_raw(indices)).T          # BCOO wants [nnz, ndim]
+    vals = _raw(values)
+    if dtype is not None:
+        from ..framework.dtype import convert_dtype
+        vals = vals.astype(convert_dtype(dtype))
+    if shape is None:
+        shape = tuple(int(idx[:, d].max()) + 1 for d in range(idx.shape[1]))
+    bcoo = jsparse.BCOO((vals, jnp.asarray(idx)), shape=tuple(shape))
+    return SparseCooTensor(bcoo, stop_gradient=stop_gradient)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      stop_gradient=True):
+    """Parity: paddle.sparse.sparse_csr_tensor."""
+    crows_np = np.asarray(_raw(crows))
+    cols_np = np.asarray(_raw(cols))
+    vals = _raw(values)
+    if dtype is not None:
+        from ..framework.dtype import convert_dtype
+        vals = vals.astype(convert_dtype(dtype))
+    rows = np.repeat(np.arange(len(crows_np) - 1),
+                     np.diff(crows_np).astype(np.int64))
+    idx = jnp.asarray(np.stack([rows, cols_np], axis=1))
+    bcoo = jsparse.BCOO((vals, idx), shape=tuple(shape))
+    return SparseCsrTensor(bcoo, crows=jnp.asarray(crows_np),
+                           cols=jnp.asarray(cols_np),
+                           stop_gradient=stop_gradient)
+
+
+def is_sparse(x) -> bool:
+    return isinstance(x, SparseCooTensor)
+
+
+def to_dense(x) -> Tensor:
+    return x.to_dense() if is_sparse(x) else x
+
+
+def to_sparse_coo(x, sparse_dim=None) -> SparseCooTensor:
+    """Parity: Tensor.to_sparse_coo."""
+    dense = _raw(x)
+    return SparseCooTensor(jsparse.BCOO.fromdense(dense))
+
+
+def add(x, y):
+    """Sparse+sparse or sparse+dense elementwise add."""
+    if is_sparse(x) and is_sparse(y):
+        # O(nnz): concatenate coordinates and merge duplicates — never
+        # densify (the operands may be astronomically larger than nnz)
+        merged = jsparse.BCOO(
+            (jnp.concatenate([x.value.data, y.value.data]),
+             jnp.concatenate([x.value.indices, y.value.indices])),
+            shape=x.value.shape).sum_duplicates()
+        return SparseCooTensor(merged)
+    if is_sparse(x):
+        return Tensor(x.value.todense() + _raw(y))
+    return Tensor(_raw(x) + y.value.todense())
+
+
+def matmul(x, y):
+    """Sparse @ dense via BCOO dot (XLA lowers to gather/scatter matmul).
+    Parity: paddle.sparse.matmul."""
+    if is_sparse(x):
+        out = x.value @ _raw(y)
+        return Tensor(out)
+    if is_sparse(y):
+        return Tensor(_raw(x) @ y.value.todense())
+    return Tensor(_raw(x) @ _raw(y))
+
+
+def masked_matmul(x, y, mask: SparseCooTensor):
+    """Dense@dense sampled at mask's sparsity (parity:
+    paddle.sparse.masked_matmul)."""
+    dense = _raw(x) @ _raw(y)
+    idx = mask.value.indices
+    vals = dense[idx[:, 0], idx[:, 1]]
+    return SparseCooTensor(jsparse.BCOO((vals, idx), shape=dense.shape))
+
+
+def relu(x):
+    """Parity: paddle.sparse.nn.functional.relu — applies to stored
+    values only."""
+    if is_sparse(x):
+        b = x.value
+        return SparseCooTensor(jsparse.BCOO((jnp.maximum(b.data, 0),
+                                             b.indices), shape=b.shape))
+    return Tensor(jnp.maximum(_raw(x), 0))

@@ -1,0 +1,210 @@
+"""Distribution + sparse + sharded-checkpoint tests (reference:
+test_distribution_*.py numeric checks vs scipy-derived closed forms,
+test_sparse_*.py, dist ckpt converter tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distribution import (Beta, Categorical, Dirichlet,
+                                     ExpTransform, Gamma, Independent,
+                                     Laplace, LogNormal, Normal,
+                                     TransformedDistribution, Uniform,
+                                     kl_divergence, register_kl)
+
+
+def test_normal_moments_and_logprob():
+    n = Normal(1.0, 2.0)
+    assert float(n.mean) == 1.0 and float(n.variance) == 4.0
+    # N(1,2) at x=1: log(1/(2*sqrt(2pi)))
+    lp = float(n.log_prob(paddle.to_tensor(1.0)))
+    np.testing.assert_allclose(lp, -np.log(2 * np.sqrt(2 * np.pi)),
+                               rtol=1e-5)
+    paddle.seed(0)
+    s = n.sample([20000])
+    np.testing.assert_allclose(s.numpy().mean(), 1.0, atol=0.06)
+    np.testing.assert_allclose(s.numpy().std(), 2.0, atol=0.06)
+
+
+def test_normal_rsample_differentiable():
+    loc = paddle.to_tensor(np.float32(0.5), stop_gradient=False)
+    n = Normal(loc, 1.0)
+    paddle.seed(0)
+    s = n.rsample([64])
+    paddle.mean(s).backward()
+    np.testing.assert_allclose(loc.grad.numpy(), 1.0, rtol=1e-5)
+
+
+def test_kl_normal_closed_form():
+    p, q = Normal(0.0, 1.0), Normal(1.0, 2.0)
+    kl = float(kl_divergence(p, q))
+    expect = np.log(2.0) + (1 + 1) / (2 * 4) - 0.5
+    np.testing.assert_allclose(kl, expect, rtol=1e-5)
+    # sanity: KL(p, p) == 0
+    np.testing.assert_allclose(float(kl_divergence(p, p)), 0.0, atol=1e-7)
+
+
+def test_categorical_entropy_and_kl():
+    logits = np.log(np.array([0.2, 0.3, 0.5], "float32"))
+    c = Categorical(logits=logits)
+    ent = float(c.entropy())
+    expect = -(0.2 * np.log(0.2) + 0.3 * np.log(0.3) + 0.5 * np.log(0.5))
+    np.testing.assert_allclose(ent, expect, rtol=1e-5)
+    c2 = Categorical(probs=np.array([1 / 3] * 3, "float32"))
+    assert float(kl_divergence(c, c2)) > 0
+
+
+def test_beta_dirichlet_gamma_laplace():
+    b = Beta(2.0, 3.0)
+    np.testing.assert_allclose(float(b.mean), 0.4, rtol=1e-6)
+    d = Dirichlet(np.array([1.0, 2.0, 3.0], "float32"))
+    np.testing.assert_allclose(d.mean.numpy(), [1 / 6, 2 / 6, 3 / 6],
+                               rtol=1e-5)
+    g = Gamma(2.0, 4.0)
+    np.testing.assert_allclose(float(g.mean), 0.5, rtol=1e-6)
+    l = Laplace(0.0, 1.0)
+    lp = float(l.log_prob(paddle.to_tensor(0.0)))
+    np.testing.assert_allclose(lp, -np.log(2.0), rtol=1e-5)
+    assert float(kl_divergence(l, Laplace(0.0, 1.0))) == pytest.approx(
+        0.0, abs=1e-6)
+
+
+def test_lognormal_and_transformed_agree():
+    paddle.seed(0)
+    ln = LogNormal(0.3, 0.4)
+    td = TransformedDistribution(Normal(0.3, 0.4), [ExpTransform()])
+    x = paddle.to_tensor(np.array([0.5, 1.0, 2.0], "float32"))
+    np.testing.assert_allclose(ln.log_prob(x).numpy(),
+                               td.log_prob(x).numpy(), rtol=1e-5)
+
+
+def test_independent_sums_event_dims():
+    base = Normal(np.zeros((4, 3), "float32"), np.ones((4, 3), "float32"))
+    ind = Independent(base, 1)
+    assert ind.batch_shape == (4,) and ind.event_shape == (3,)
+    x = paddle.to_tensor(np.zeros((4, 3), "float32"))
+    np.testing.assert_allclose(ind.log_prob(x).numpy(),
+                               base.log_prob(x).numpy().sum(-1), rtol=1e-6)
+
+
+def test_register_kl_custom():
+    class MyDist(Normal):
+        pass
+
+    @register_kl(MyDist, MyDist)
+    def _kl_my(p, q):
+        return paddle.to_tensor(42.0)
+
+    assert float(kl_divergence(MyDist(0.0, 1.0), MyDist(0.0, 1.0))) == 42.0
+    with pytest.raises(NotImplementedError):
+        kl_divergence(Uniform(0, 1), Normal(0.0, 1.0))
+
+
+# ---------------------------------------------------------------------------
+# sparse
+# ---------------------------------------------------------------------------
+
+def test_sparse_coo_roundtrip():
+    import paddle_tpu.sparse as sparse
+
+    idx = np.array([[0, 1, 2], [1, 2, 0]], "int64")
+    vals = np.array([1.0, 2.0, 3.0], "float32")
+    s = sparse.sparse_coo_tensor(idx, vals, shape=[3, 3])
+    assert s.nnz() == 3 and s.shape == [3, 3]
+    dense = s.to_dense().numpy()
+    expect = np.zeros((3, 3), "float32")
+    expect[0, 1], expect[1, 2], expect[2, 0] = 1, 2, 3
+    np.testing.assert_array_equal(dense, expect)
+    # back to sparse
+    s2 = sparse.to_sparse_coo(paddle.to_tensor(expect))
+    np.testing.assert_array_equal(s2.to_dense().numpy(), expect)
+
+
+def test_sparse_csr_and_ops():
+    import paddle_tpu.sparse as sparse
+
+    crows = np.array([0, 1, 3, 3], "int64")
+    cols = np.array([1, 0, 2], "int64")
+    vals = np.array([4.0, -1.0, 2.0], "float32")
+    s = sparse.sparse_csr_tensor(crows, cols, vals, [3, 3])
+    np.testing.assert_array_equal(s.crows().numpy(), crows)
+    np.testing.assert_array_equal(s.cols().numpy(), cols)
+    dense = s.to_dense().numpy()
+    assert dense[0, 1] == 4.0 and dense[1, 0] == -1.0 and dense[1, 2] == 2.0
+
+    r = sparse.relu(s)
+    assert r.to_dense().numpy().min() >= 0
+
+    y = np.random.randn(3, 2).astype("float32")
+    out = sparse.matmul(s, y)
+    np.testing.assert_allclose(out.numpy(), dense @ y, rtol=1e-5)
+
+
+def test_sparse_masked_matmul():
+    import paddle_tpu.sparse as sparse
+
+    rng = np.random.RandomState(0)
+    a = rng.randn(4, 5).astype("float32")
+    b = rng.randn(5, 4).astype("float32")
+    mask = sparse.to_sparse_coo(paddle.to_tensor(
+        (rng.rand(4, 4) > 0.5).astype("float32")))
+    out = sparse.masked_matmul(a, b, mask)
+    dense = a @ b
+    got = out.to_dense().numpy()
+    mask_np = mask.to_dense().numpy() != 0
+    np.testing.assert_allclose(got[mask_np], dense[mask_np], rtol=1e-5)
+    assert (got[~mask_np] == 0).all()
+
+
+# ---------------------------------------------------------------------------
+# sharded checkpoint with re-shard on load
+# ---------------------------------------------------------------------------
+
+def test_sharded_checkpoint_reshard(tmp_path):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    dist.set_mesh(None)
+    mesh8 = dist.init_mesh({"dp": 8})
+    x = jax.device_put(np.arange(64, dtype=np.float32).reshape(8, 8),
+                       NamedSharding(mesh8, P("dp")))
+    state = {"w": x, "step": np.int64(7)}
+    path = str(tmp_path / "ckpt")
+    dist.save_state_dict(state, path)
+
+    # restore onto a DIFFERENT topology: dp2 x mp4, sharded on dim 1
+    dist.set_mesh(None)
+    mesh24 = dist.init_mesh({"dp": 2, "mp": 4})
+    target = {"w": jax.device_put(np.zeros((8, 8), np.float32),
+                                  NamedSharding(mesh24, P(None, "mp"))),
+              "step": np.int64(0)}
+    restored = dist.load_state_dict(path, target=target)
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.asarray(x))
+    assert "mp" in str(restored["w"].sharding.spec)
+    assert int(restored["step"]) == 7
+    dist.set_mesh(None)
+
+
+def test_probs_param_and_beta_rsample_differentiable():
+    from paddle_tpu.distribution import Bernoulli, Beta
+
+    p = paddle.to_tensor(np.float32(0.3), stop_gradient=False)
+    b = Bernoulli(probs=p)
+    b.log_prob(paddle.to_tensor(1.0)).backward()
+    np.testing.assert_allclose(p.grad.numpy(), 1.0 / 0.3, rtol=1e-4)
+
+    a = paddle.to_tensor(np.float32(2.0), stop_gradient=False)
+    paddle.seed(0)
+    s = Beta(a, 3.0).rsample([16])
+    paddle.mean(s).backward()
+    assert a.grad is not None and np.isfinite(a.grad.numpy()).all()
+
+
+def test_geometric_mean_matches_samples():
+    from paddle_tpu.distribution import Geometric
+
+    g = Geometric(0.5)
+    paddle.seed(0)
+    s = g.sample([40000])
+    np.testing.assert_allclose(s.numpy().mean(), float(g.mean), atol=0.05)
