@@ -1,0 +1,14 @@
+import time, jax, jax.numpy as jnp
+from paddle_tpu.nn.functional.loss import _fused_softmax_ce
+N,V = 8184, 50304
+key = jax.random.PRNGKey(0)
+lg = jax.random.normal(key,(N,V),jnp.bfloat16)
+idx = jax.random.randint(jax.random.PRNGKey(1),(N,),0,V)
+t0=time.perf_counter()
+f = jax.jit(jax.grad(lambda lg: _fused_softmax_ce(lg, idx).mean()))
+g = f(lg); jax.block_until_ready(g)
+print("CE fwd+bwd compile+run", time.perf_counter()-t0, "s")
+t0=time.perf_counter()
+for _ in range(10): g=f(lg)
+jax.block_until_ready(g)
+print("CE f+b steady %.2f ms" % ((time.perf_counter()-t0)/10*1e3))

@@ -1,0 +1,23 @@
+import sys, time, glob, gzip, json, os
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.models import GPTConfig, GPTForCausalLM
+import jax
+
+batch, seq = 8, 1024
+cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                num_heads=12, max_seq_len=seq)
+paddle.seed(0)
+model = GPTForCausalLM(cfg); model.bfloat16()
+opt = paddle.optimizer.AdamW(learning_rate=1e-4, multi_precision=True,
+                             parameters=model.parameters())
+step = TrainStep(model, GPTForCausalLM.loss_fn, opt)
+rng = np.random.RandomState(0)
+ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (batch, seq)).astype("int64"))
+for _ in range(3): loss = step(ids, ids)
+float(loss)
+with jax.profiler.trace("/tmp/jaxtrace"):
+    for _ in range(3): loss = step(ids, ids)
+    float(loss)
+print("trace done")

@@ -1,0 +1,42 @@
+import time, functools
+import jax, jax.numpy as jnp
+from jax.experimental.pallas.ops.tpu.splash_attention import (
+    splash_attention_kernel as sk, splash_attention_mask as sm)
+
+B,S,H,D = 8,1024,12,64
+key = jax.random.PRNGKey(0)
+ks = jax.random.split(key,3)
+q = jax.random.normal(ks[0],(B,H,S,D),jnp.bfloat16)
+k = jax.random.normal(ks[1],(B,H,S,D),jnp.bfloat16)
+v = jax.random.normal(ks[2],(B,H,S,D),jnp.bfloat16)
+scale = 1.0/D**0.5
+
+mask = sm.MultiHeadMask([sm.CausalMask((S,S)) for _ in range(H)])
+kernel = sk.make_splash_mha(mask=mask, head_shards=1, q_seq_shards=1)
+kernel_b = jax.vmap(kernel)
+
+def splash_loss(q,k,v):
+    o = kernel_b(q*scale, k, v)
+    return o.astype(jnp.float32).sum()
+
+def xla_loss(q,k,v):
+    qt,kt,vt = [jnp.swapaxes(x,1,2) for x in (q,k,v)]
+    return jax.nn.dot_product_attention(qt,kt,vt,is_causal=True,scale=scale).astype(jnp.float32).sum()
+
+# numeric check vs xla
+o_s = jax.jit(lambda q,k,v: kernel_b(q*scale,k,v))(q,k,v)
+qt,kt,vt = [jnp.swapaxes(x,1,2) for x in (q,k,v)]
+o_x = jnp.swapaxes(jax.nn.dot_product_attention(qt,kt,vt,is_causal=True,scale=scale),1,2)
+print("splash vs xla fwd max diff:", float(jnp.abs(o_s.astype(jnp.float32)-o_x.astype(jnp.float32)).max()))
+
+def bench(fn,*args,iters=100):
+    o=fn(*args); jax.block_until_ready(o)
+    t0=time.perf_counter()
+    for _ in range(iters): o=fn(*args)
+    jax.block_until_ready(o)
+    return (time.perf_counter()-t0)/iters*1e6
+
+sg = jax.jit(jax.grad(splash_loss, argnums=(0,1,2)))
+xg = jax.jit(jax.grad(xla_loss, argnums=(0,1,2)))
+print("splash f+b %8.1f us" % bench(sg,q,k,v))
+print("xla    f+b %8.1f us" % bench(xg,q,k,v))

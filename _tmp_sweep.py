@@ -1,0 +1,23 @@
+import sys, time
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+batch = int(sys.argv[1]); seq = 1024; iters = 12
+cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                num_heads=12, max_seq_len=seq)
+paddle.seed(0)
+model = GPTForCausalLM(cfg); model.bfloat16()
+opt = paddle.optimizer.AdamW(learning_rate=1e-4, multi_precision=True,
+                             parameters=model.parameters())
+step = TrainStep(model, GPTForCausalLM.loss_fn, opt)
+rng = np.random.RandomState(0)
+ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (batch, seq)).astype("int64"))
+for _ in range(3): loss = step(ids, ids)
+l0 = float(loss)
+t0 = time.perf_counter()
+for _ in range(iters): loss = step(ids, ids)
+float(loss)
+dt = time.perf_counter() - t0
+print(f"RESULT batch={batch}: {batch*seq*iters/dt:,.0f} tok/s ({dt/iters*1e3:.1f} ms/step) loss@3={l0:.3f}")

@@ -283,6 +283,14 @@ void pts_client_close(void* c) {
   }
 }
 
+// Abort any blocking call on this connection without freeing it: shutdown
+// wakes a thread parked in recv with EOF, after which the caller can take
+// the connection lock and pts_client_close safely.
+void pts_client_shutdown(void* c) {
+  auto* cl = static_cast<Client*>(c);
+  if (cl) ::shutdown(cl->fd, SHUT_RDWR);
+}
+
 static bool send_header(int fd, uint8_t cmd, const char* key, uint32_t klen) {
   return write_full(fd, &cmd, 1) && write_full(fd, &klen, 4) &&
          write_full(fd, key, klen);

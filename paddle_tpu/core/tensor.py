@@ -18,6 +18,32 @@ from ..framework import dtype as dtypes
 from ..framework.dtype import convert_dtype
 
 
+def _resolve_device(spec: str):
+    """Map a place string ("cpu", "tpu", "tpu:1", "gpu:0") to a jax Device,
+    or None when the string is not a device spec. Unknown indices raise."""
+    name, _, idx = spec.partition(":")
+    name = name.lower()
+    alias = {"gpu": "tpu", "xpu": "tpu", "axon": "tpu"}
+    if name not in ("cpu", "tpu", "gpu", "xpu", "axon"):
+        return None
+    for plat in ([name] if name == "cpu" else
+                 [alias.get(name, name), name, "axon"]):
+        try:
+            devs = jax.devices(plat)
+        except RuntimeError:
+            continue
+        if devs:
+            if idx:
+                i = int(idx)
+                if i >= len(devs):
+                    raise ValueError(
+                        f"device index {i} out of range for '{plat}' "
+                        f"({len(devs)} devices)")
+                return devs[i]
+            return devs[0]
+    raise ValueError(f"no devices available for place '{spec}'")
+
+
 class Tensor:
     __slots__ = ("value", "stop_gradient", "name", "_grad", "_node",
                  "_out_index", "_retain_grads", "persistable", "__weakref__")
@@ -144,11 +170,22 @@ class Tensor:
 
     def to(self, *args, **kwargs):
         # device moves are PJRT placements; dtype moves are casts
+        out = self
         for a in list(args) + list(kwargs.values()):
             if isinstance(a, (str, np.dtype)) and str(a) in (
-                    "float32", "float16", "bfloat16", "float64", "int32", "int64"):
-                return self.astype(a)
-        return self
+                    "float32", "float16", "bfloat16", "float64",
+                    "int32", "int64"):
+                out = out.astype(a)
+            elif isinstance(a, str):
+                dev = _resolve_device(a)
+                if dev is not None:
+                    moved = jax.device_put(out.value, dev)
+                    t = Tensor(moved, stop_gradient=out.stop_gradient)
+                    # keep the autograd chain: a device move is identity
+                    # for gradients
+                    t._node, t._out_index = out._node, out._out_index
+                    out = t
+        return out
 
     def cpu(self):
         return Tensor(np.asarray(self.value), stop_gradient=self.stop_gradient)

@@ -246,7 +246,12 @@ def reduce(tensor, dst: int = 0, op=ReduceOp.SUM, group=None, sync_op=True):
 def scatter(tensor, tensor_list=None, src: int = 0, group=None, sync_op=True):
     """Rank i receives tensor_list[i] (from rank src's list).
     Parity: paddle.distributed.scatter — the output stacked array is simply
-    the stacked tensor_list sharded over the axis."""
+    the stacked tensor_list sharded over the axis.
+
+    `src` semantics under a single controller: every rank sees the same
+    tensor_list (there is one process), so whose list is scattered is
+    determined by the caller — `src` is accepted for API parity and does
+    not change the result."""
     group = group or _default_group()
     n = group.nranks
     if tensor_list is None:
@@ -302,6 +307,14 @@ def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
 
 def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
                     out_split_sizes=None, group=None, sync_op=True):
+    for sizes in (in_split_sizes, out_split_sizes):
+        # explicitly even splits are fine (common parity callers pass
+        # them); genuinely uneven splits would be silently mis-split, so
+        # refuse those until ragged all-to-all lands
+        if sizes is not None and len(set(sizes)) > 1:
+            raise NotImplementedError(
+                "alltoall_single with uneven in/out_split_sizes is not "
+                "supported yet; only equal splits are")
     group = group or _default_group()
     x = _raw(in_tensor)
     mesh, _, n = _stacked_specs(group, x)

@@ -39,7 +39,10 @@ def launch(script: str, script_args: List[str], *, nnodes: int = 1,
     store.barrier("launch", nnodes)
 
     world_size = nnodes * nproc_per_node
-    procs: List[subprocess.Popen] = []
+    # (local_rank, proc) pairs: a restarted trainer must inherit the failed
+    # process's own rank — deriving it from list position goes wrong as soon
+    # as an earlier proc exits cleanly or a replacement is appended
+    procs: List[tuple] = []
     if log_dir:
         os.makedirs(log_dir, exist_ok=True)
 
@@ -68,7 +71,7 @@ def launch(script: str, script_args: List[str], *, nnodes: int = 1,
                                 env=env, stdout=stdout, stderr=stderr)
 
     for lr in range(nproc_per_node):
-        procs.append(spawn(lr))
+        procs.append((lr, spawn(lr)))
 
     # watcher (parity: controllers/watcher.py): first failure tears down
     # the pod; restarts up to max_restarts
@@ -77,18 +80,22 @@ def launch(script: str, script_args: List[str], *, nnodes: int = 1,
     try:
         while procs:
             alive = []
-            for p in procs:
+            for lr, p in procs:
                 ret = p.poll()
                 if ret is None:
-                    alive.append(p)
+                    alive.append((lr, p))
                 elif ret != 0:
                     if restarts < max_restarts:
                         restarts += 1
-                        idx = procs.index(p)
-                        alive.append(spawn(idx % nproc_per_node))
+                        alive.append((lr, spawn(lr)))
                     else:
                         exit_code = ret
-                        for q in procs:
+                        # tear down everything still running — including
+                        # replacements spawned earlier in this same poll
+                        # cycle (they are only in `alive`)
+                        procs = alive + [pp for pp in procs
+                                         if pp not in alive]
+                        for _, q in procs:
                             if q.poll() is None:
                                 q.terminate()
                         return exit_code
@@ -96,7 +103,7 @@ def launch(script: str, script_args: List[str], *, nnodes: int = 1,
             if procs:
                 time.sleep(0.2)
     finally:
-        for p in procs:
+        for _, p in procs:
             if p.poll() is None:
                 p.terminate()
         store.close()

@@ -136,6 +136,10 @@ class MoELayer(Layer):
         self.b_out = self.add_parameter(
             "b_out", expert_param([num_experts, d_model]))
         self._l_aux = None
+        # Switch-Transformer coefficient; the weighted aux loss is added to
+        # the training objective by TrainStep/ParallelTrainStep via
+        # framework.aux_loss
+        self.aux_loss_weight = 0.01
 
     @property
     def l_aux(self) -> Optional[Tensor]:
@@ -170,5 +174,11 @@ class MoELayer(Layer):
         out, aux = _tape.apply(fn, x, self.gate.weight, self.w_in,
                                self.b_in, self.w_out, self.b_out,
                                _op_name="moe")
+        # report to the active training engine (weighted); _l_aux is kept
+        # for eager inspection but holds a tracer when forward runs under
+        # jit — use the aux_loss_scope value in that case
+        from ..framework.aux_loss import add_aux_loss
+        add_aux_loss(self.aux_loss_weight * (
+            aux.value if hasattr(aux, "value") else aux))
         self._l_aux = aux
         return out

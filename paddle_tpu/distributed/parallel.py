@@ -82,9 +82,18 @@ class DataParallel(Layer):
 
     @contextlib.contextmanager
     def no_sync(self):
-        """Parity: DataParallel.no_sync — a no-op: without an eager
-        reducer there is nothing to postpone; gradient accumulation
-        composes naturally."""
+        """Parity: DataParallel.no_sync (reference parallel.py:202).
+
+        Semantically a no-op here, and that is exact, not a shortcut:
+        the reference defers the grad allreduce during accumulation and
+        reduces the summed grads once at the end; allreduce is linear, so
+        sum-then-reduce equals reduce-then-sum. Under GSPMD each
+        backward's grads are already globally reduced where the math
+        demands it, and accumulating those equals the reference's
+        deferred result. The communication-deferral *performance* path is
+        TrainStep/ParallelTrainStep(accumulate_steps=k), where the whole
+        cadence compiles into two programs and XLA schedules the reduce
+        once per update."""
         yield
 
     def scale_loss(self, loss):

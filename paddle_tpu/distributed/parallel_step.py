@@ -76,18 +76,24 @@ def shard_params(model, mesh=None):
     return model
 
 
-def _zero_slot_spec(leaf, mesh, axis: str) -> P:
-    """ZeRO layout for one optimizer-slot leaf: shard the first dim
-    divisible by the axis size; scalars/indivisible stay replicated."""
+def _zero_spec(shape, mesh, axis: str, base: Optional[P] = None) -> P:
+    """ZeRO layout for one leaf: add `axis` on the first dim that is
+    divisible by the axis size and not already sharded by `base` (the
+    parameter's mp layout). Composing instead of overriding matters: a
+    zero spec that conflicts with the mp layout forces GSPMD into a
+    replicate-then-repartition ("involuntary full rematerialization")
+    on every grad reduce. Scalars/indivisible leaves stay at `base`."""
     n = mesh.shape.get(axis, 1)
+    base_spec = list(base) if base is not None else []
+    base_spec += [None] * (len(shape) - len(base_spec))
     if n <= 1:
-        return P()
-    for d, size in enumerate(leaf.shape):
-        if size % n == 0 and size >= n:
-            spec = [None] * leaf.ndim
+        return P(*base_spec)
+    for d, size in enumerate(shape):
+        if base_spec[d] is None and size % n == 0 and size >= n:
+            spec = list(base_spec)
             spec[d] = axis
             return P(*spec)
-    return P()
+    return P(*base_spec)
 
 
 class ParallelTrainStep:
@@ -101,7 +107,7 @@ class ParallelTrainStep:
 
     def __init__(self, model, loss_fn, optimizer, n_inputs: int = 1,
                  zero_stage: int = 0, batch_specs=None, mesh=None,
-                 remat: bool = False):
+                 remat: bool = False, accumulate_steps: int = 1):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -110,13 +116,38 @@ class ParallelTrainStep:
         self.remat = remat
         self.mesh = mesh or mesh_mod.get_mesh()
         self.batch_specs = batch_specs
+        if accumulate_steps < 1:
+            raise ValueError("accumulate_steps must be >= 1")
+        self.accumulate_steps = accumulate_steps
         self.step_count = 0
+        self.update_count = 0
         self._jitted = None
+        self._jitted_acc = None
 
         shardings = param_sharding(model, self.mesh)
         params, buffers = raw_state(model)
-        self.param_shardings = {n: shardings[n] for n in params}
-        # params live sharded (mp) but replicated across dp/sharding.
+        base_specs = {n: shardings[n].spec for n in params}
+        ax = "sharding" if self.mesh.shape.get("sharding", 1) > 1 else "dp"
+        self._zero_axis = ax if zero_stage >= 1 else None
+
+        # ZeRO stages (reference: GroupSharded stage1/2/3,
+        # group_sharded_optimizer_stage2.py:53, group_sharded_stage3.py:59):
+        #   1: optimizer slots (incl. master weights) sharded over `ax`
+        #   2: + gradients reduce-scattered into the same layout
+        #   3: + parameters themselves sharded (param memory / N); GSPMD
+        #      all-gathers each weight at its use site in forward — the
+        #      in-program equivalent of stage3's forward all-gather hooks
+        #      (group_sharded_stage3.py:194) — and keeps the updated param
+        #      sharded on output.
+        if zero_stage >= 3:
+            self.param_shardings = {
+                n: NamedSharding(self.mesh,
+                                 _zero_spec(v.shape, self.mesh, ax,
+                                            base_specs[n]))
+                for n, v in params.items()}
+        else:
+            self.param_shardings = {n: shardings[n] for n in params}
+        # params live sharded (mp; + zero axis at stage 3).
         # jnp.copy first: device_put with an already-matching sharding
         # returns the SAME buffer, and step() donates these — without the
         # copy the model's own arrays would be deleted
@@ -126,23 +157,35 @@ class ParallelTrainStep:
         self.buffers = {n: jnp.copy(v) for n, v in buffers.items()}
         opt_state = optimizer.init(self.params)
         if zero_stage >= 1:
-            ax = "sharding" if self.mesh.shape.get("sharding", 1) > 1 else "dp"
-            self.opt_shardings = jax.tree_util.tree_map(
-                lambda leaf: NamedSharding(self.mesh,
-                                           _zero_slot_spec(leaf, self.mesh,
-                                                           ax)),
-                opt_state)
+            def slot_spec(pname, leaf):
+                # slots follow their parameter's mp+zero layout when shapes
+                # line up (momentum/variance/master copies); scalar slots
+                # stay replicated
+                base = (base_specs[pname]
+                        if leaf.shape == params[pname].shape else None)
+                return NamedSharding(
+                    self.mesh, _zero_spec(leaf.shape, self.mesh, ax, base))
+            self.opt_shardings = {
+                n: jax.tree_util.tree_map(
+                    lambda leaf, n=n: slot_spec(n, leaf), slots)
+                for n, slots in opt_state.items()}
             self.grad_shardings = {
                 n: NamedSharding(self.mesh,
-                                 _zero_slot_spec(v, self.mesh, ax))
-                for n, v in self.params.items()}
-            self._zero_axis = ax
+                                 _zero_spec(v.shape, self.mesh, ax,
+                                            base_specs[n]))
+                for n, v in params.items()}
         else:
             self.opt_shardings = jax.tree_util.tree_map(
                 lambda leaf: NamedSharding(self.mesh, P()), opt_state)
-            self._zero_axis = None
         self.opt_state = jax.tree_util.tree_map(
             lambda v, s: jax.device_put(v, s), opt_state, self.opt_shardings)
+        self.acc_grads = None
+        if accumulate_steps > 1:
+            acc_sh = (self.grad_shardings if zero_stage >= 2
+                      else self.param_shardings)
+            self.acc_grad_shardings = acc_sh
+            self.acc_grads = {n: jax.device_put(jnp.zeros_like(v), acc_sh[n])
+                              for n, v in self.params.items()}
 
     # ------------------------------------------------------------------
     def _batch_sharding(self, raw_batch):
@@ -165,47 +208,100 @@ class ParallelTrainStep:
     def _build(self, raw_batch):
         model, loss_fn, optimizer = self.model, self.loss_fn, self.optimizer
         n_in = self.n_inputs
-        zero = self.zero_stage >= 1
-        grad_shardings = self.grad_shardings if zero else None
+        # stage >= 2: gradients reduce-scattered into the ZeRO layout
+        # (stage 1 shards only the optimizer state, reference stage1/2 split)
+        zero_grads = self.zero_stage >= 2
+        grad_shardings = self.grad_shardings if self.zero_stage >= 1 else None
         remat = self.remat
 
-        def step_fn(params, buffers, opt_state, lr, step_no, rng_key, *batch):
+        def fwd_bwd(params, buffers, lr, step_no, rng_key, *batch):
             inputs, labels = batch[:n_in], batch[n_in:]
 
             def loss_of(p):
-                with _rng.rng_guard(rng_key):
+                from ..framework.aux_loss import aux_loss_scope, total
+                with _rng.rng_guard(rng_key), aux_loss_scope() as auxes:
                     out, new_bufs = functional_call(model, p, buffers,
                                                     *inputs, training=True)
                     with no_grad():
                         loss_t = loss_fn(_wrap(out),
                                          *[_wrap(l) for l in labels])
                 loss_v = loss_t.value if isinstance(loss_t, Tensor) else loss_t
+                if auxes:  # MoE load-balancing etc., already weighted
+                    loss_v = loss_v + total(auxes)
                 return loss_v, new_bufs
 
             if remat:
                 loss_of = jax.checkpoint(loss_of)
             (loss, new_bufs), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(params)
-            if zero:
+            if zero_grads:
                 # constrain grads to the ZeRO layout: XLA fuses the grad
                 # psum into a reduce-scatter feeding the sharded update
                 grads = {n: lax.with_sharding_constraint(
                     g, grad_shardings[n]) for n, g in grads.items()}
-            new_params, new_opt = optimizer.apply_gradients(
-                params, grads, opt_state, lr=lr, step=step_no)
-            return loss, new_params, new_bufs, new_opt
+            return loss, new_bufs, grads
 
         in_batch = self._batch_sharding(raw_batch)
         buf_shardings = {n: NamedSharding(self.mesh, P())
                          for n in self.buffers}
-        self._jitted = jax.jit(
-            step_fn,
+        scalar_sh = NamedSharding(self.mesh, P())
+        k = self.accumulate_steps
+
+        if k == 1:
+            def full_step(params, buffers, opt_state, lr, step_no, rng_key,
+                          *batch):
+                loss, new_bufs, grads = fwd_bwd(params, buffers, lr, step_no,
+                                                rng_key, *batch)
+                new_params, new_opt = optimizer.apply_gradients(
+                    params, grads, opt_state, lr=lr, step=step_no)
+                return loss, new_params, new_bufs, new_opt
+
+            self._jitted = jax.jit(
+                full_step,
+                in_shardings=(self.param_shardings, buf_shardings,
+                              self.opt_shardings, None, None, None)
+                + in_batch,
+                out_shardings=(scalar_sh, self.param_shardings,
+                               buf_shardings, self.opt_shardings),
+                donate_argnums=(0, 1, 2))
+            return
+
+        # gradient merge (reference: gradient_merge_optimizer.py): the host
+        # knows the cadence, so two programs — accumulate-only and apply
+        acc_sh = self.acc_grad_shardings
+
+        def acc_step(params, buffers, opt_state, acc, lr, step_no, rng_key,
+                     *batch):
+            loss, new_bufs, grads = fwd_bwd(params, buffers, lr, step_no,
+                                            rng_key, *batch)
+            new_acc = {n: acc[n] + grads[n] for n in acc}
+            return loss, new_bufs, new_acc
+
+        def apply_step(params, buffers, opt_state, acc, lr, step_no, rng_key,
+                       *batch):
+            loss, new_bufs, grads = fwd_bwd(params, buffers, lr, step_no,
+                                            rng_key, *batch)
+            mean = {n: (acc[n] + grads[n]) / k for n in acc}
+            new_params, new_opt = optimizer.apply_gradients(
+                params, mean, opt_state, lr=lr, step=step_no)
+            zeros = {n: jnp.zeros_like(v) for n, v in acc.items()}
+            return loss, new_params, new_bufs, new_opt, zeros
+
+        self._jitted_acc = jax.jit(
+            acc_step,
             in_shardings=(self.param_shardings, buf_shardings,
-                          self.opt_shardings, None, None, None) + in_batch,
-            out_shardings=(NamedSharding(self.mesh, P()),
-                           self.param_shardings, buf_shardings,
-                           self.opt_shardings),
-            donate_argnums=(0, 1, 2))
+                          self.opt_shardings, acc_sh, None, None, None)
+            + in_batch,
+            out_shardings=(scalar_sh, buf_shardings, acc_sh),
+            donate_argnums=(1, 3))
+        self._jitted = jax.jit(
+            apply_step,
+            in_shardings=(self.param_shardings, buf_shardings,
+                          self.opt_shardings, acc_sh, None, None, None)
+            + in_batch,
+            out_shardings=(scalar_sh, self.param_shardings, buf_shardings,
+                           self.opt_shardings, acc_sh),
+            donate_argnums=(0, 1, 2, 3))
 
     # ------------------------------------------------------------------
     def __call__(self, *batch) -> Tensor:
@@ -214,11 +310,25 @@ class ParallelTrainStep:
             self._build(raw_batch)
         self.step_count += 1
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
-        step_no = jnp.asarray(self.step_count, jnp.float32)
         rng_key = _rng.default_generator().fold_in(self.step_count)
-        loss, self.params, self.buffers, self.opt_state = self._jitted(
-            self.params, self.buffers, self.opt_state, lr, step_no, rng_key,
-            *raw_batch)
+        k = self.accumulate_steps
+        if k > 1 and self.step_count % k != 0:
+            step_no = jnp.asarray(self.update_count + 1, jnp.float32)
+            loss, self.buffers, self.acc_grads = self._jitted_acc(
+                self.params, self.buffers, self.opt_state, self.acc_grads,
+                lr, step_no, rng_key, *raw_batch)
+            return Tensor(loss)
+        self.update_count += 1
+        step_no = jnp.asarray(self.update_count, jnp.float32)
+        if k > 1:
+            (loss, self.params, self.buffers, self.opt_state,
+             self.acc_grads) = self._jitted(
+                self.params, self.buffers, self.opt_state, self.acc_grads,
+                lr, step_no, rng_key, *raw_batch)
+        else:
+            loss, self.params, self.buffers, self.opt_state = self._jitted(
+                self.params, self.buffers, self.opt_state, lr, step_no,
+                rng_key, *raw_batch)
         lr_sched = getattr(self.optimizer, "_learning_rate", None)
         if hasattr(lr_sched, "step"):
             lr_sched.step()

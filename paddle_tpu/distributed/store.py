@@ -65,6 +65,7 @@ def _load_lib():
         lib.pts_client_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
                                            ctypes.c_int]
         lib.pts_client_close.argtypes = [ctypes.c_void_p]
+        lib.pts_client_shutdown.argtypes = [ctypes.c_void_p]
         lib.pts_set.restype = ctypes.c_int
         lib.pts_set.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                 ctypes.c_uint32, ctypes.c_char_p,
@@ -168,6 +169,11 @@ class TCPStore:
             raise RuntimeError(
                 f"TCPStore connect to {host}:{self.port} failed")
 
+    def _conn(self):
+        if self._client is None:
+            raise RuntimeError("TCPStore is closed")
+        return self._client
+
     # -- API (paddle Store surface: store.h:24) -------------------------
     def set(self, key: str, value) -> None:
         if self._py is not None:
@@ -175,7 +181,7 @@ class TCPStore:
         v = _to_bytes(value)
         k = key.encode()
         with self._io_lock:
-            ok = self._lib.pts_set(self._client, k, len(k), v, len(v))
+            ok = self._lib.pts_set(self._conn(), k, len(k), v, len(v))
         if ok != 0:
             raise RuntimeError("TCPStore.set failed")
 
@@ -185,7 +191,7 @@ class TCPStore:
         k = key.encode()
         out = ctypes.POINTER(ctypes.c_char)()
         with self._io_lock:
-            n = self._lib.pts_get(self._client, k, len(k),
+            n = self._lib.pts_get(self._conn(), k, len(k),
                                   int(self.timeout * 1000),
                                   ctypes.byref(out))
         if n == -1:
@@ -203,7 +209,7 @@ class TCPStore:
         k = key.encode()
         err = ctypes.c_int(0)
         with self._io_lock:
-            val = self._lib.pts_add(self._client, k, len(k), amount,
+            val = self._lib.pts_add(self._conn(), k, len(k), amount,
                                     ctypes.byref(err))
         if err.value != 0:
             raise RuntimeError("TCPStore.add failed")
@@ -214,7 +220,7 @@ class TCPStore:
             return self._py.wait(key, self.timeout)
         k = key.encode()
         with self._io_lock:
-            r = self._lib.pts_wait(self._client, k, len(k),
+            r = self._lib.pts_wait(self._conn(), k, len(k),
                                    int(self.timeout * 1000))
         if r == -1:
             raise TimeoutError(f"TCPStore.wait({key!r}) timed out")
@@ -226,7 +232,7 @@ class TCPStore:
             return self._py.delete(key)
         k = key.encode()
         with self._io_lock:
-            self._lib.pts_del(self._client, k, len(k))
+            self._lib.pts_del(self._conn(), k, len(k))
 
     # -- helpers ---------------------------------------------------------
     def barrier(self, name: str, world_size: int) -> None:
@@ -237,12 +243,27 @@ class TCPStore:
         self.wait(f"__barrier/{name}/done")
 
     def close(self):
-        if self._client is not None:
-            self._lib.pts_client_close(self._client)
-            self._client = None
-        if self._master_handle is not None:
-            self._lib.pts_master_stop(self._master_handle)
-            self._master_handle = None
+        if self._py is not None:
+            return
+        # Ordered shutdown: briefly wait for an in-flight request to finish
+        # (the server may apply a set and wake a blocked getter before
+        # acking the setter — closing mid-request fails that call
+        # spuriously). If another thread is parked in a long get/wait,
+        # shutdown(2) the socket to abort it instead of blocking close for
+        # the full store timeout, then take the lock and free.
+        if not self._io_lock.acquire(timeout=0.5):
+            if self._client is not None:
+                self._lib.pts_client_shutdown(self._client)
+            self._io_lock.acquire()
+        try:
+            if self._client is not None:
+                self._lib.pts_client_close(self._client)
+                self._client = None
+            if self._master_handle is not None:
+                self._lib.pts_master_stop(self._master_handle)
+                self._master_handle = None
+        finally:
+            self._io_lock.release()
 
     def __del__(self):
         try:

@@ -1,0 +1,42 @@
+"""Auxiliary-loss collection (MoE load-balancing etc.).
+
+In the reference the MoE gate's balance loss is surfaced on the layer and
+the trainer is expected to add it to the objective
+(python/paddle/incubate/distributed/models/moe/moe_layer.py — gate loss).
+With whole-step jit tracing a layer attribute would capture a tracer, so
+layers instead report aux losses into the active scope at trace time and
+the training engines (jit.TrainStep / distributed.ParallelTrainStep) add
+the collected sum to the loss inside the compiled program.
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import List
+
+_STACK: List[list] = []
+
+
+@contextmanager
+def aux_loss_scope():
+    """Collect aux losses reported by layers during forward. Yields the
+    (mutable) list; entries are raw jnp scalars, already weighted."""
+    bucket: list = []
+    _STACK.append(bucket)
+    try:
+        yield bucket
+    finally:
+        _STACK.pop()
+
+
+def add_aux_loss(value) -> None:
+    """Report a (weighted) scalar aux loss from inside a layer forward.
+    No-op when no scope is active (pure-inference callers)."""
+    if _STACK:
+        _STACK[-1].append(value)
+
+
+def total(bucket) -> float:
+    s = 0.0
+    for v in bucket:
+        s = s + v
+    return s

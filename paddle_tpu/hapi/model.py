@@ -45,6 +45,7 @@ class Model:
         self._metrics: List[Metric] = []
         self._train_step: Optional[TrainStep] = None
         self._auto_lr_step = True
+        self._accumulate = 1
         self.stop_training = False
 
     # -- setup -----------------------------------------------------------
@@ -86,7 +87,8 @@ class Model:
                 raise RuntimeError("call prepare(optimizer, loss) first")
             self._train_step = TrainStep(
                 self.network, lambda out, *ys: self._loss_value(out, ys),
-                self._optimizer, n_inputs=n_inputs)
+                self._optimizer, n_inputs=n_inputs,
+                accumulate_steps=self._accumulate)
             self._train_step.auto_lr_step = self._auto_lr_step
         return self._train_step
 
@@ -106,11 +108,14 @@ class Model:
         """Parity: Model.fit (hapi/model.py:1045). train_data may be a
         DataLoader or a Dataset (a loader is built with batch_size)."""
         from ..io.dataloader import DataLoader, Dataset
-        if accumulate_grad_batches != 1:
-            raise NotImplementedError(
-                "accumulate_grad_batches > 1 is not supported yet — raise "
-                "batch_size (the fused step is memory-lean) or use "
-                "gradient_merge in DistributedStrategy")
+        if accumulate_grad_batches != self._accumulate:
+            # gradient merge happens inside the compiled step
+            # (jit.TrainStep accumulate_steps); changing it needs a rebuild
+            # — sync trained state back into the network first, the live
+            # step owns the only up-to-date copy
+            self._sync()
+            self._accumulate = accumulate_grad_batches
+            self._train_step = None
         loader = train_data
         if isinstance(train_data, Dataset):
             loader = DataLoader(train_data, batch_size=batch_size,

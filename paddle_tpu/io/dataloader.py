@@ -231,7 +231,11 @@ class DistributedBatchSampler(BatchSampler):
             indices = rng.permutation(n)
         indices = indices.tolist()
         if not self.drop_last and len(indices) < self.total_size:
-            indices += indices[: self.total_size - len(indices)]
+            # repeat the whole list as many times as needed: a single
+            # slice-append under-pads when total_size > 2*len(dataset)
+            # (more ranks than samples)
+            reps = self.total_size // len(indices) + 1
+            indices = (indices * reps)[: self.total_size]
         indices = indices[: self.total_size]
         local = indices[self.local_rank:self.total_size:self.nranks]
         batch = []

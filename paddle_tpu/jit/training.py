@@ -53,11 +53,14 @@ class TrainStep:
     """
 
     def __init__(self, model, loss_fn: Callable, optimizer,
-                 n_inputs: int = 1):
+                 n_inputs: int = 1, accumulate_steps: int = 1):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.n_inputs = n_inputs
+        if accumulate_steps < 1:
+            raise ValueError("accumulate_steps must be >= 1")
+        self.accumulate_steps = accumulate_steps
         params, buffers = raw_state(model)
         # copy: step() donates these buffers; the model's own tensors must
         # stay valid for eager use (same aliasing rule as Optimizer.set_state)
@@ -65,10 +68,20 @@ class TrainStep:
         self.buffers = jax.tree_util.tree_map(jnp.copy, buffers)
         self.opt_state = optimizer.init(params)
         self.step_count = 0
+        self.update_count = 0
+        # gradient-merge accumulator (reference:
+        # meta_optimizers/gradient_merge_optimizer.py — k micro-steps of
+        # summed grads, averaged at the update). Device state so the whole
+        # cadence stays inside donated XLA programs.
+        self.acc_grads = None
+        if accumulate_steps > 1:
+            self.acc_grads = jax.tree_util.tree_map(
+                lambda p: jnp.zeros_like(p), params)
         # set False when an external driver (hapi LRScheduler callback)
         # owns scheduler stepping
         self.auto_lr_step = True
         self._jitted = None
+        self._jitted_acc = None
 
     # ------------------------------------------------------------------
     def _build(self):
@@ -82,23 +95,59 @@ class TrainStep:
                 # thread the per-step key functionally: dropout etc. draw
                 # fresh randomness each step instead of a baked trace-time
                 # constant (framework.random rng_guard contract)
-                with _rng.rng_guard(rng_key):
+                from ..framework.aux_loss import aux_loss_scope, total
+                with _rng.rng_guard(rng_key), aux_loss_scope() as auxes:
                     out, new_bufs = functional_call(model, p, buffers,
                                                     *inputs, training=True)
                     with no_grad():
                         loss_t = loss_fn(_wrap(out),
                                          *[_wrap(l) for l in labels])
                 loss_v = loss_t.value if isinstance(loss_t, Tensor) else loss_t
+                if auxes:  # MoE load-balancing etc., already weighted
+                    loss_v = loss_v + total(auxes)
                 return loss_v, new_bufs
 
             (loss, new_bufs), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(params)
-            new_params, new_opt = optimizer.apply_gradients(
-                params, grads, opt_state, lr=lr, step=step_no)
-            return loss, new_params, new_bufs, new_opt
+            return loss, new_bufs, grads
 
-        # donate params/buffers/opt-state: they update in place in HBM
-        self._jitted = jax.jit(step_fn, donate_argnums=(0, 1, 2))
+        k = self.accumulate_steps
+
+        if k == 1:
+            def full_step(params, buffers, opt_state, lr, step_no, rng_key,
+                          *batch):
+                loss, new_bufs, grads = step_fn(params, buffers, opt_state,
+                                                lr, step_no, rng_key, *batch)
+                new_params, new_opt = optimizer.apply_gradients(
+                    params, grads, opt_state, lr=lr, step=step_no)
+                return loss, new_params, new_bufs, new_opt
+
+            # donate params/buffers/opt-state: they update in place in HBM
+            self._jitted = jax.jit(full_step, donate_argnums=(0, 1, 2))
+            return
+
+        # gradient merge: two programs — the host knows the cadence
+        # (call_count % k), so no in-program branch is needed
+        def acc_step(params, buffers, opt_state, acc, lr, step_no, rng_key,
+                     *batch):
+            loss, new_bufs, grads = step_fn(params, buffers, opt_state,
+                                            lr, step_no, rng_key, *batch)
+            new_acc = jax.tree_util.tree_map(jnp.add, acc, grads)
+            return loss, new_bufs, new_acc
+
+        def apply_step(params, buffers, opt_state, acc, lr, step_no, rng_key,
+                       *batch):
+            loss, new_bufs, grads = step_fn(params, buffers, opt_state,
+                                            lr, step_no, rng_key, *batch)
+            mean = jax.tree_util.tree_map(
+                lambda a, g: (a + g) / k, acc, grads)
+            new_params, new_opt = optimizer.apply_gradients(
+                params, mean, opt_state, lr=lr, step=step_no)
+            zeros = jax.tree_util.tree_map(jnp.zeros_like, acc)
+            return loss, new_params, new_bufs, new_opt, zeros
+
+        self._jitted_acc = jax.jit(acc_step, donate_argnums=(1, 3))
+        self._jitted = jax.jit(apply_step, donate_argnums=(0, 1, 2, 3))
 
     # ------------------------------------------------------------------
     def __call__(self, *batch) -> Tensor:
@@ -106,12 +155,27 @@ class TrainStep:
             self._build()
         self.step_count += 1
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
-        step_no = jnp.asarray(self.step_count, jnp.float32)
         rng_key = _rng.default_generator().fold_in(self.step_count)
         raw_batch = _raw_tuple(batch)
-        loss, self.params, self.buffers, self.opt_state = self._jitted(
-            self.params, self.buffers, self.opt_state, lr, step_no, rng_key,
-            *raw_batch)
+        k = self.accumulate_steps
+        if k > 1 and self.step_count % k != 0:
+            # micro-step: accumulate grads, no parameter update
+            step_no = jnp.asarray(self.update_count + 1, jnp.float32)
+            loss, self.buffers, self.acc_grads = self._jitted_acc(
+                self.params, self.buffers, self.opt_state, self.acc_grads,
+                lr, step_no, rng_key, *raw_batch)
+            return Tensor(loss)
+        self.update_count += 1
+        step_no = jnp.asarray(self.update_count, jnp.float32)
+        if k > 1:
+            (loss, self.params, self.buffers, self.opt_state,
+             self.acc_grads) = self._jitted(
+                self.params, self.buffers, self.opt_state, self.acc_grads,
+                lr, step_no, rng_key, *raw_batch)
+        else:
+            loss, self.params, self.buffers, self.opt_state = self._jitted(
+                self.params, self.buffers, self.opt_state, lr, step_no,
+                rng_key, *raw_batch)
         if self.auto_lr_step:
             lr_sched = getattr(self.optimizer, "_learning_rate", None)
             if hasattr(lr_sched, "step"):

@@ -30,6 +30,43 @@ def _reduce(v, reduction):
     return v
 
 
+@jax.custom_vjp
+def _fused_softmax_ce(lg, idx):
+    """Hard-label softmax cross-entropy over the last axis without ever
+    materializing log_softmax: per = logsumexp(lg) - lg[idx].
+
+    The role of the reference's fused softmax-CE kernels
+    (paddle/phi/kernels/gpu/cross_entropy_kernel.cu): the naive
+    composition materializes two fp32 [N, vocab] arrays (profiled at
+    ~10ms/step on the GPT-125M bench); here forward is two streaming
+    reductions and backward is one fused elementwise pass.
+    """
+    per, _ = _fused_softmax_ce_fwd(lg, idx)
+    return per
+
+
+def _fused_softmax_ce_fwd(lg, idx):
+    m = jax.lax.stop_gradient(jnp.max(lg, axis=-1))
+    mf = m.astype(jnp.float32)
+    # convert+sub+exp fuse into the reduce: one pass over lg, no fp32 copy
+    s = jnp.sum(jnp.exp(lg.astype(jnp.float32) - mf[..., None]), axis=-1)
+    gold = jnp.take_along_axis(lg, idx[..., None], axis=-1)[..., 0]
+    per = jnp.log(s) + mf - gold.astype(jnp.float32)
+    return per, (lg, idx, mf, s)
+
+
+def _fused_softmax_ce_bwd(res, g):
+    lg, idx, mf, s = res
+    p = jnp.exp(lg.astype(jnp.float32) - mf[..., None]) / s[..., None]
+    onehot = (jnp.arange(lg.shape[-1], dtype=idx.dtype)
+              == idx[..., None])
+    dlg = (p - onehot.astype(jnp.float32)) * g[..., None].astype(jnp.float32)
+    return dlg.astype(lg.dtype), None
+
+
+_fused_softmax_ce.defvjp(_fused_softmax_ce_fwd, _fused_softmax_ce_bwd)
+
+
 def cross_entropy(input, label, weight=None, ignore_index=-100,
                   reduction="mean", soft_label=False, axis=-1,
                   use_softmax=True, label_smoothing=0.0, name=None):
@@ -38,6 +75,21 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
     lbl = label.value if isinstance(label, Tensor) else jnp.asarray(label)
 
     def f(logits, *w):
+        if (use_softmax and not soft_label and not w
+                and label_smoothing == 0.0
+                and axis in (-1, logits.ndim - 1)
+                and not (lbl.ndim == logits.ndim and lbl.shape == logits.shape
+                         and jnp.issubdtype(lbl.dtype, jnp.floating))):
+            idx = lbl
+            if idx.ndim == logits.ndim:
+                idx = jnp.squeeze(idx, axis=-1)
+            idx_c = jnp.clip(idx, 0, logits.shape[-1] - 1).astype(jnp.int32)
+            per = _fused_softmax_ce(logits, idx_c)
+            mask = (idx != ignore_index)
+            per = jnp.where(mask, per, 0.0)
+            if reduction == "mean":
+                return jnp.sum(per) / jnp.maximum(jnp.sum(mask), 1)
+            return _reduce(per, reduction)
         lg = logits.astype(jnp.float32)
         logp = jax.nn.log_softmax(lg, axis=axis) if use_softmax else jnp.log(
             jnp.maximum(lg, 1e-30))

@@ -29,8 +29,10 @@ def test_store_set_get_add_wait():
         time.sleep(0.2)
         master.set("slow", b"done")
 
-    threading.Thread(target=later).start()
+    t = threading.Thread(target=later)
+    t.start()
     assert c.get("slow") == b"done"
+    t.join(timeout=5)
     c.close()
     master.close()
 
