@@ -2,18 +2,37 @@
 program.
 
 Parity: PipelineParallel.forward_backward_pipeline / train_batch
-(python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py:117,228)
-and the p2p layer (pp_utils/p2p_communication.py:298 _p2p_helper,
-SendRecvMeta:53). The reference runs a Python-driven 1F1B loop issuing NCCL
-p2p per microbatch; here the WHOLE schedule is a `lax.scan` over pipeline
-ticks inside `shard_map` (manual over the "pp" axis only — mp/dp stay
-GSPMD-auto, so TP layers inside blocks still work): activations rotate
-around the pp ring with a single `ppermute` per tick, and XLA overlaps the
-collective-permute with the next tick's compute. No shape/dtype handshake
-is needed — shapes are static in the program. Reverse-mode AD of the scan +
-ppermute yields the backward pipeline automatically (the transpose of
-ppermute is the reverse rotation), where the reference hand-codes
-send/recv of grads.
+(python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py:117,228),
+PipelineParallelWithInterleave (:461) and the p2p layer
+(pp_utils/p2p_communication.py:298 _p2p_helper, SendRecvMeta:53). The
+reference runs a Python-driven 1F1B loop issuing NCCL p2p per microbatch;
+here the WHOLE schedule is a `lax.scan` over pipeline ticks inside
+`shard_map` (manual over the "pp" axis only — mp/dp stay GSPMD-auto, so TP
+layers inside blocks still work): activations rotate around the pp ring
+with a single `ppermute` per tick, and XLA overlaps the collective-permute
+with the next tick's compute. No shape/dtype handshake is needed — shapes
+are static in the program. Reverse-mode AD of the scan + ppermute yields
+the backward pipeline automatically (the transpose of ppermute is the
+reverse rotation), where the reference hand-codes send/recv of grads.
+
+Memory shape vs the reference's 1F1B (:117): 1F1B's point is to bound live
+activations by the number of in-flight microbatches instead of all M. In
+this in-program design the scan saves one carry (one activation) per tick
+— O(M + pp) microbatch activations per stage — and `recompute=True`
+checkpoints each tick so block-internal residuals are recomputed in the
+backward pipeline, which is the same activation-recompute choice
+large-scale 1F1B deployments make. The earlier design carried the [M, ...]
+output buffer through the scan, which made AD save O(M) buffers per tick
+(O(M^2 + M*pp) total) — collecting per-tick outputs through the scan's
+stacked ys instead is the actual memory fix, asserted by
+tests/test_pipeline.py::test_pipeline_memory_shape.
+
+Interleaved virtual stages (reference :461): with interleave=v, block
+chunk c lives on stage c % pp (round-robin placement, v chunks per stage)
+and the ring runs v passes; the pass-(r) outputs hop once from the last
+stage to stage 0 to start pass r+1. Placement is encoded in the stacking
+order (pp_layers.py), so each pass reads a static slice of the local
+parameter shard — no dynamic gather.
 """
 from __future__ import annotations
 
@@ -34,18 +53,40 @@ __all__ = ["pipeline_apply", "PipelineParallel"]
 
 
 def _apply_block(template: Layer, params: Dict[str, jax.Array], h):
-    out, _ = functional_call(template, params, {}, Tensor(h))
+    # Open a local aux-loss scope: values reported here (e.g. MoE balance
+    # loss) are lax.scan-body tracers that must not escape to the training
+    # engine's outer scope — they would be invalid there
+    # (UnexpectedTracerError). Known limitation: aux losses inside a
+    # pipelined body are dropped; put MoE blocks in a non-pipelined model
+    # (GPTForCausalLM use_moe) to train with load balancing.
+    from ...framework.aux_loss import aux_loss_scope
+    with aux_loss_scope():
+        out, _ = functional_call(template, params, {}, Tensor(h))
     if isinstance(out, (tuple, list)):
         out = out[0]
     return out
 
 
+def interleave_perm(num_blocks: int, num_stages: int, interleave: int):
+    """Stacking order for interleaved placement: position p of the stacked
+    dim holds logical block perm[p]; stage s's contiguous shard holds
+    chunks [s, pp + s, 2*pp + s, ...] in round order."""
+    per_chunk = num_blocks // (num_stages * interleave)
+    perm = []
+    for s in range(num_stages):
+        for r in range(interleave):
+            c = r * num_stages + s
+            perm.extend(range(c * per_chunk, (c + 1) * per_chunk))
+    return perm
+
+
 def pipeline_apply(template: Layer, stacked: Dict[str, "Tensor"], x,
                    num_stages: int, num_micro: int = None,
-                   recompute: bool = False):
+                   interleave: int = 1, recompute: bool = False):
     """Run x through L stacked blocks pipelined over the "pp" axis.
 
-    stacked: dict name -> Parameter of shape [L, ...] (dim 0 sharded "pp").
+    stacked: dict name -> Parameter of shape [L, ...] (dim 0 sharded "pp",
+    rows in interleave_perm order when interleave > 1).
     x: Tensor [B, ...]; B must divide into num_micro microbatches.
     """
     names = list(stacked)
@@ -56,31 +97,42 @@ def pipeline_apply(template: Layer, stacked: Dict[str, "Tensor"], x,
             f"PipelineLayer was built with num_stages={num_stages} but the "
             f"mesh 'pp' axis has {pp} devices — the schedule runs one stage "
             f"per pp shard, so they must match")
+    L = stacked[names[0]].shape[0]
+    v = max(int(interleave), 1)
 
-    block_of = _apply_block
-    if recompute:
-        block_of = jax.checkpoint(
-            lambda params, h: _apply_block(template, params, h))
+    # one jitted program per (layer, mesh, schedule) — rebuilding the
+    # closure each call would defeat jax.jit's cache (collective.py
+    # _collective_program pattern)
+    cache = getattr(template, "_pp_prog_cache", None)
+    if cache is None:
+        cache = {}
+        object.__setattr__(template, "_pp_prog_cache", cache)
 
     if pp <= 1:
-        # no pipeline axis: plain scan over the stacked blocks
-        cache = getattr(template, "_pp_prog_cache", None)
-        if cache is None:
-            cache = {}
-            object.__setattr__(template, "_pp_prog_cache", cache)
-        key = (None, tuple(names), 1, 0, bool(recompute))
+        # no pipeline axis: plain scan over the blocks in logical order
+        key = (None, tuple(names), 1, 0, v, bool(recompute))
         fn = cache.get(key)
         if fn is None:
+            perm = interleave_perm(L, num_stages, v) if v > 1 else None
+            inv = None
+            if perm is not None:
+                inv = [0] * L
+                for pos, logical in enumerate(perm):
+                    inv[logical] = pos
+                inv = jnp.asarray(inv)
+
             def fn(*flat):
                 params = dict(zip(names, flat[:-1]))
                 h = flat[-1]
+                if inv is not None:  # undo interleaved stacking order
+                    params = {n: jnp.take(a, inv, axis=0)
+                              for n, a in params.items()}
 
                 def step(carry, bparams):
+                    body = lambda bp, c: _apply_block(template, bp, c)
                     if recompute:
-                        nxt = block_of(bparams, carry)
-                    else:
-                        nxt = _apply_block(template, bparams, carry)
-                    return nxt, None
+                        body = jax.checkpoint(body)
+                    return body(bparams, carry), None
 
                 out, _ = lax.scan(step, h, params)
                 return out
@@ -90,18 +142,12 @@ def pipeline_apply(template: Layer, stacked: Dict[str, "Tensor"], x,
                            _op_name="pipeline_scan")
 
     M = num_micro or pp
-    L = stacked[names[0]].shape[0]
-    if L % pp:
-        raise ValueError(f"{L} pipelined blocks not divisible by pp={pp}")
+    if L % (pp * v):
+        raise ValueError(f"{L} pipelined blocks not divisible by "
+                         f"pp*interleave={pp}*{v}")
+    per_chunk = L // (pp * v)
 
-    # one jitted program per (layer, mesh, schedule) — rebuilding the
-    # closure each call would defeat jax.jit's cache (collective.py
-    # _collective_program pattern)
-    cache = getattr(template, "_pp_prog_cache", None)
-    if cache is None:
-        cache = {}
-        object.__setattr__(template, "_pp_prog_cache", cache)
-    cache_key = (mesh, tuple(names), pp, M, bool(recompute))
+    cache_key = (mesh, tuple(names), pp, M, v, bool(recompute))
     cached = cache.get(cache_key)
     if cached is not None:
         return _tape.apply(cached, *[stacked[n] for n in names], x,
@@ -116,51 +162,61 @@ def pipeline_apply(template: Layer, stacked: Dict[str, "Tensor"], x,
         mb = B // M
         x_mb = h.reshape((M, mb) + h.shape[1:])
 
-        def stage_fn(local_params, xs):
-            idx = lax.axis_index("pp")
+        def chunk_apply(chunk_params, inp):
+            def step(c, bp):
+                return _apply_block(template, bp, c), None
+            out, _ = lax.scan(step, inp, chunk_params)
+            return out
+
+        if recompute:
+            chunk_apply = jax.checkpoint(chunk_apply)
+
+        def one_pass(local_chunk, xs, idx):
+            """Fill-drain ring over M microbatches for one chunk round.
+            xs: [M, mb, ...] input buffer (read by stage 0 only).
+            Returns [M, mb, ...] outputs, valid on the last stage."""
             T = M + pp - 1
             state0 = jnp.zeros_like(xs[0])
-            outs0 = jnp.zeros_like(xs)
 
-            def tick(carry, t):
-                state, outs = carry
+            def tick(state, t):
                 # stage 0 ingests microbatch t; others take the rotated
                 # activation (role of recv_forward, p2p_communication.py)
-                inp = jnp.where(idx == 0,
-                                x_mb_local(xs, t, M), state)
-
-                def step(c, bp):
-                    if recompute:
-                        return block_of(bp, c), None
-                    return _apply_block(template, bp, c), None
-
-                out, _ = lax.scan(step, inp, local_params)
-                # last stage records finished microbatch t-(pp-1)
-                done = t - (pp - 1)
-                rec = outs.at[jnp.clip(done, 0, M - 1)].set(out)
-                outs = jnp.where((idx == pp - 1) & (done >= 0), rec, outs)
+                inp = jnp.where(idx == 0, xs[jnp.clip(t, 0, M - 1)], state)
+                out = chunk_apply(local_chunk, inp)
                 # rotate the ring (role of send_forward/recv_forward)
                 nxt = lax.ppermute(out, "pp",
                                    [(i, (i + 1) % pp) for i in range(pp)])
-                return (nxt, outs), None
+                return nxt, out
 
-            (_, outs), _ = lax.scan(tick, (state0, outs0), jnp.arange(T))
-            # results live on the last stage; replicate over the ring
-            outs = jnp.where(idx == pp - 1, outs, jnp.zeros_like(outs))
-            return lax.psum(outs, "pp")
+            _, ys = lax.scan(tick, state0, jnp.arange(T))
+            # the last stage finishes microbatch m at tick m + pp - 1
+            return ys[pp - 1:]
 
-        def x_mb_local(xs, t, M_):
-            return xs[jnp.clip(t, 0, M_ - 1)]
+        def stage_fn(local_params, xs):
+            idx = lax.axis_index("pp")
+            buf = xs
+            for r in range(v):  # interleave: one ring pass per chunk round
+                chunk = {n: a[r * per_chunk:(r + 1) * per_chunk]
+                         for n, a in local_params.items()}
+                buf = one_pass(chunk, buf, idx)
+                if r < v - 1:
+                    # pass outputs hop last-stage -> stage 0 (single link)
+                    buf = lax.ppermute(buf, "pp", [(pp - 1, 0)])
+            # expose only the last stage's (valid) buffer: out spec "pp"
+            # makes the caller's slice of shard pp-1 the result — no
+            # zero-fill + psum broadcast
+            return buf[None]
 
         smapped = jax.shard_map(
             stage_fn,
             mesh=mesh_mod.get_mesh(),
             in_specs=(jax.tree_util.tree_map(lambda _: P("pp"), params),
                       P()),
-            out_specs=P(),
+            out_specs=P("pp"),
             axis_names={"pp"},
             check_vma=False)
-        out_mb = smapped(params, x_mb)
+        out_all = smapped(params, x_mb)      # [pp, M, mb, ...]
+        out_mb = out_all[pp - 1]             # last stage's buffer
         return out_mb.reshape((B,) + out_mb.shape[2:])
 
     # partial-manual shard_map (manual pp, auto dp/mp/...) is only legal

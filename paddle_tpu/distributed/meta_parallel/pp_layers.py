@@ -79,13 +79,19 @@ class PipelineLayer(Layer):
 
     def __init__(self, layers: Sequence, num_stages: Optional[int] = None,
                  topology=None, loss_fn=None, seg_method="uniform",
-                 recompute_interval=0, **kwargs):
+                 recompute_interval=0, num_micro: Optional[int] = None,
+                 interleave: int = 1, **kwargs):
         super().__init__()
         self._loss_fn = loss_fn
         self.recompute_interval = recompute_interval
         if num_stages is None:
             num_stages = mesh_mod.mesh_axis_size("pp")
         self.num_stages = num_stages
+        # first-class schedule knobs (reference: accumulate_steps for the
+        # microbatch count; PipelineParallelWithInterleave :461 for
+        # virtual stages — there v model chunks per rank)
+        self.num_micro = num_micro
+        self.interleave = max(int(interleave), 1)
 
         built: List[Layer] = []
         shared: Dict[str, Layer] = {}
@@ -109,10 +115,10 @@ class PipelineLayer(Layer):
                 raise TypeError(f"invalid pipeline entry {d!r}")
 
         lo, hi = self._find_body(built)
-        if (hi - lo) % max(num_stages, 1):
+        if (hi - lo) % max(num_stages * self.interleave, 1):
             raise ValueError(
                 f"pipelined body has {hi - lo} blocks, not divisible by "
-                f"num_stages={num_stages}")
+                f"num_stages*interleave={num_stages}*{self.interleave}")
         self._prologue = built[:lo]
         self._body_blocks = built[lo:hi]
         self._epilogue = built[hi:]
@@ -158,10 +164,18 @@ class PipelineLayer(Layer):
                 "stats) are not supported: buffers are not stacked across "
                 "blocks — use LayerNorm, or keep buffered layers in the "
                 "prologue/epilogue")
+        blocks = self._body_blocks
+        if self.interleave > 1:
+            # interleaved placement lives in the stacking order: stage s's
+            # contiguous pp-shard holds chunks [s, pp+s, ...]
+            from .pipeline_parallel import interleave_perm
+            perm = interleave_perm(len(blocks), self.num_stages,
+                                   self.interleave)
+            blocks = [blocks[i] for i in perm]
         names = [n for n, _ in self._template.named_parameters()]
         for name in names:
             per_block = [dict(b.named_parameters())[name]
-                         for b in self._body_blocks]
+                         for b in blocks]
             stacked = jnp.stack([p.value for p in per_block])
             sp = Parameter(stacked, name=f"blocks.{name}")
             inner = per_block[0].sharding_axes
@@ -178,7 +192,8 @@ class PipelineLayer(Layer):
             x = l(x)
         if self._body_blocks:
             x = pipeline_apply(self._template, self._stacked, x,
-                               self.num_stages,
+                               self.num_stages, num_micro=self.num_micro,
+                               interleave=self.interleave,
                                recompute=self.recompute_interval > 0)
         for l in self._epilogue:
             x = l(x)
@@ -186,8 +201,12 @@ class PipelineLayer(Layer):
 
     # introspection parity
     def get_stage_from_index(self, idx):
-        per = len(self._body_blocks) // max(self.num_stages, 1)
-        return min(idx // max(per, 1), self.num_stages - 1)
+        """Stage owning body block idx (interleaved: chunk c -> c % pp,
+        reference PipelineParallelWithInterleave placement)."""
+        chunks = max(self.num_stages * self.interleave, 1)
+        per = max(len(self._body_blocks) // chunks, 1)
+        chunk = min(idx // per, chunks - 1)
+        return chunk % self.num_stages
 
     @property
     def parameters_desc(self):

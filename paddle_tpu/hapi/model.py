@@ -46,6 +46,7 @@ class Model:
         self._train_step: Optional[TrainStep] = None
         self._auto_lr_step = True
         self._accumulate = 1
+        self._carried_opt = None
         self.stop_training = False
 
     # -- setup -----------------------------------------------------------
@@ -90,6 +91,14 @@ class Model:
                 self._optimizer, n_inputs=n_inputs,
                 accumulate_steps=self._accumulate)
             self._train_step.auto_lr_step = self._auto_lr_step
+            if self._carried_opt is not None:
+                import jax as _jax
+                import jax.numpy as _jnp
+                state, updates = self._carried_opt
+                self._train_step.opt_state = _jax.tree_util.tree_map(
+                    _jnp.copy, state)
+                self._train_step.update_count = updates
+                self._carried_opt = None
         return self._train_step
 
     # -- train -----------------------------------------------------------
@@ -111,9 +120,13 @@ class Model:
         if accumulate_grad_batches != self._accumulate:
             # gradient merge happens inside the compiled step
             # (jit.TrainStep accumulate_steps); changing it needs a rebuild
-            # — sync trained state back into the network first, the live
-            # step owns the only up-to-date copy
-            self._sync()
+            # — sync trained params back and carry the optimizer state over
+            # so Adam moments / step numbering survive the rebuild
+            if self._train_step is not None:
+                self._train_step.flush_accumulation()
+                self._sync()
+                self._carried_opt = (self._train_step.opt_state,
+                                     self._train_step.update_count)
             self._accumulate = accumulate_grad_batches
             self._train_step = None
         loader = train_data
@@ -169,6 +182,10 @@ class Model:
                 break
             if num_iters is not None and it_count >= num_iters:
                 break
+        if self._train_step is not None:
+            # apply a trailing partial accumulation window so its grads
+            # are not silently carried into a later fit/evaluate
+            self._train_step.flush_accumulation()
         for cb in cbs:
             cb.on_train_end()
         return self

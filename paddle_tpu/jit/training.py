@@ -183,6 +183,34 @@ class TrainStep:
         return Tensor(loss)
 
     # ------------------------------------------------------------------
+    def flush_accumulation(self):
+        """Apply any pending partial accumulation (mean over the
+        micro-steps seen so far). No-op when the cadence is aligned.
+        Reference: gradient_merge applies on the k-th step; a trailing
+        partial window at the end of an epoch must not leak into the
+        next run."""
+        k = self.accumulate_steps
+        r = self.step_count % k
+        if k == 1 or r == 0 or self.acc_grads is None:
+            return
+        self.update_count += 1
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        step_no = jnp.asarray(self.update_count, jnp.float32)
+        optimizer = self.optimizer
+
+        def apply_only(params, opt_state, acc, lr, step_no):
+            mean = jax.tree_util.tree_map(lambda a: a / r, acc)
+            new_p, new_o = optimizer.apply_gradients(
+                params, mean, opt_state, lr=lr, step=step_no)
+            zeros = jax.tree_util.tree_map(jnp.zeros_like, acc)
+            return new_p, new_o, zeros
+
+        self.params, self.opt_state, self.acc_grads = jax.jit(
+            apply_only, donate_argnums=(0, 1, 2))(
+            self.params, self.opt_state, self.acc_grads, lr, step_no)
+        # realign the cadence so the next call starts a fresh window
+        self.step_count += k - r
+
     def sync_to_model(self):
         """Copy the device-resident state back into the Layer's tensors
         (do this before state_dict/save/eval)."""

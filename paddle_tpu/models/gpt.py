@@ -269,7 +269,8 @@ class GPTPipelineForCausalLM(PipelineLayer):
     """
 
     def __init__(self, cfg: GPTConfig, num_stages: Optional[int] = None,
-                 recompute_interval: int = 0):
+                 recompute_interval: int = 0,
+                 num_micro: Optional[int] = None, interleave: int = 1):
         self.cfg = cfg
         super().__init__(
             layers=[LayerDesc(_EmbedStage, cfg)]
@@ -277,4 +278,5 @@ class GPTPipelineForCausalLM(PipelineLayer):
             + [LayerDesc(_HeadStage, cfg)],
             num_stages=num_stages,
             loss_fn=GPTForCausalLM.loss_fn,
-            recompute_interval=recompute_interval)
+            recompute_interval=recompute_interval,
+            num_micro=num_micro, interleave=interleave)

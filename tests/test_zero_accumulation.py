@@ -157,3 +157,42 @@ def test_hapi_accumulate_grad_batches():
               accumulate_grad_batches=2)
     assert model._train_step.accumulate_steps == 2
     assert model._train_step.update_count == 4  # 8 batches / k=2
+
+
+def test_flush_partial_accumulation_and_opt_state_carryover():
+    """Trailing partial windows apply at fit end; switching
+    accumulate_grad_batches keeps Adam moments (no silent reset)."""
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.io.dataloader import Dataset
+
+    class DS(Dataset):
+        def __init__(self, n):
+            rng = np.random.RandomState(0)
+            self.x = rng.randn(n, 8).astype("float32")
+            self.y = rng.randn(n, 4).astype("float32")
+
+        def __len__(self):
+            return len(self.x)
+
+        def __getitem__(self, i):
+            return self.x[i], self.y[i]
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    model = Model(net)
+    model.prepare(paddle.optimizer.Adam(learning_rate=0.01,
+                                        parameters=net.parameters()),
+                  loss=lambda o, y: F.mse_loss(o, y))
+    # 9 batches, k=2 -> 4 full updates + 1 trailing flush
+    model.fit(DS(72), batch_size=8, epochs=1, verbose=0,
+              accumulate_grad_batches=2)
+    assert model._train_step.update_count == 5
+    assert float(np.abs(np.asarray(
+        model._train_step.acc_grads["0.weight"])).max()) == 0.0
+
+    m1_before = np.asarray(model._train_step.opt_state["0.weight"]["moment1"])
+    assert np.abs(m1_before).max() > 0
+    # switching k must carry optimizer state into the rebuilt step
+    model.fit(DS(32), batch_size=8, epochs=1, verbose=0,
+              accumulate_grad_batches=1)
+    assert model._train_step.update_count >= 6
