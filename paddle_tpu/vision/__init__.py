@@ -1,5 +1,49 @@
 """paddle.vision parity (SURVEY.md §2.8 vision row): model zoo +
 transforms + datasets scaffolding."""
 from . import models, transforms  # noqa: F401
+from . import ops  # noqa: F401
+from . import datasets  # noqa: F401
 
-__all__ = ["models", "transforms"]
+__all__ = ["models", "transforms", "ops", "datasets",
+           "set_image_backend", "get_image_backend", "image_load"]
+
+_image_backend = "pil"
+
+
+def set_image_backend(backend):
+    """Parity: vision/image.py set_image_backend ('pil' or 'cv2')."""
+    global _image_backend
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(
+            f"Expected backend are one of ['pil', 'cv2', 'tensor'], "
+            f"but got {backend}")
+    _image_backend = backend
+
+
+def get_image_backend():
+    """Parity: vision/image.py get_image_backend."""
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """Parity: vision/image.py image_load — PIL-backed (cv2 absent in
+    this environment; numpy array returned for backend='cv2', Tensor
+    for backend='tensor')."""
+    import numpy as _np
+    from PIL import Image
+    backend = backend or _image_backend
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(
+            f"Expected backend are one of ['pil', 'cv2', 'tensor'], "
+            f"but got {backend}")
+    img = Image.open(path)
+    if backend == "cv2":
+        return _np.asarray(img)
+    if backend == "tensor":
+        from ..core.tensor import Tensor
+        import jax.numpy as _jnp
+        arr = _np.asarray(img)
+        if arr.ndim == 3:
+            arr = arr.transpose(2, 0, 1)  # CHW, reference tensor layout
+        return Tensor(_jnp.asarray(arr), stop_gradient=True)
+    return img

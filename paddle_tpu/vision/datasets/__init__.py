@@ -1,0 +1,316 @@
+"""paddle.vision.datasets parity — file-format parsers for the classic
+vision datasets.
+
+Reference: python/paddle/vision/datasets/{mnist,cifar,flowers,folder,
+voc2012}.py. The reference downloads archives from paddle-dataset URLs;
+this build runs with zero network egress, so every dataset takes local
+file paths (same constructor parameters) and raises a clear error when
+asked to download. File formats match the originals exactly (idx
+ubyte/gzip for MNIST, pickled batches in tar.gz for CIFAR, .mat labels
+for Flowers), so locally present copies of the standard archives load
+unchanged.
+"""
+from __future__ import annotations
+
+import gzip
+import io as _io
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ...io.dataloader import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "Flowers",
+           "DatasetFolder", "ImageFolder", "VOC2012"]
+
+_NO_DOWNLOAD = (
+    "{name}: automatic download is unavailable in this build (no network "
+    "egress); pass {args} pointing at a local copy of the standard archive")
+
+
+def _open_maybe_gzip(path):
+    with open(path, "rb") as f:
+        head = f.read(2)
+    if head == b"\x1f\x8b":
+        return gzip.open(path, "rb")
+    return open(path, "rb")
+
+
+class MNIST(Dataset):
+    """Parity: vision/datasets/mnist.py:104 — idx-ubyte image/label files
+    (optionally gzipped). Yields (image HW1 float32 numpy, label int64)."""
+
+    NAME = "MNIST"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        mode = mode.lower()
+        assert mode in ("train", "test"), (
+            f"mode should be 'train' or 'test', but got {mode}")
+        if backend is None:
+            backend = "pil"
+        if backend not in ("pil", "cv2"):
+            raise ValueError(
+                f"Expected backend are one of ['pil', 'cv2'], but got "
+                f"{backend}")
+        if image_path is None or label_path is None:
+            raise RuntimeError(_NO_DOWNLOAD.format(
+                name=self.NAME, args="image_path/label_path"))
+        self.mode = mode
+        self.transform = transform
+        self.backend = backend
+        with _open_maybe_gzip(image_path) as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            assert magic == 2051, f"bad idx image magic {magic}"
+            self.images = np.frombuffer(
+                f.read(n * rows * cols), np.uint8).reshape(n, rows, cols)
+        with _open_maybe_gzip(label_path) as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            assert magic == 2049, f"bad idx label magic {magic}"
+            self.labels = np.frombuffer(f.read(n), np.uint8).astype(
+                np.int64)[:, None]
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32)[:, :, None]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class FashionMNIST(MNIST):
+    """Parity: vision/datasets/mnist.py FashionMNIST — same idx format."""
+
+    NAME = "FashionMNIST"
+
+
+class Cifar10(Dataset):
+    """Parity: vision/datasets/cifar.py:106 — pickled batches inside the
+    standard cifar-10-python.tar.gz. Yields (image 32x32x3, label)."""
+
+    _mode_pat = {"train": "data_batch", "test": "test_batch"}
+    _label_key = b"labels"
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        mode = mode.lower()
+        assert mode in ("train", "test"), (
+            f"mode should be 'train' or 'test', but got {mode}")
+        if data_file is None:
+            raise RuntimeError(_NO_DOWNLOAD.format(
+                name=type(self).__name__, args="data_file"))
+        self.mode = mode
+        self.transform = transform
+        self.backend = backend or "pil"
+        images, labels = [], []
+        pat = self._mode_pat[mode]
+        with tarfile.open(data_file, "r:*") as tf:
+            names = [m for m in tf.getmembers()
+                     if pat in os.path.basename(m.name)]
+            names.sort(key=lambda m: m.name)
+            for m in names:
+                batch = pickle.load(tf.extractfile(m), encoding="bytes")
+                images.append(np.asarray(batch[b"data"], np.uint8))
+                labels.extend(batch[self._label_key])
+        self.data = np.concatenate(images, 0).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(labels, np.int64)
+
+    def __getitem__(self, idx):
+        img = self.data[idx].transpose(1, 2, 0).astype(np.float32)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class Cifar100(Cifar10):
+    """Parity: vision/datasets/cifar.py:255 — cifar-100-python.tar.gz."""
+
+    _mode_pat = {"train": "train", "test": "test"}
+    _label_key = b"fine_labels"
+
+
+class Flowers(Dataset):
+    """Parity: vision/datasets/flowers.py:110 — 102 Category Flowers:
+    images tarball + imagelabels.mat + setid.mat."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None):
+        mode = mode.lower()
+        assert mode in ("train", "valid", "test"), (
+            f"mode should be 'train', 'valid' or 'test', but got {mode}")
+        if data_file is None or label_file is None or setid_file is None:
+            raise RuntimeError(_NO_DOWNLOAD.format(
+                name="Flowers", args="data_file/label_file/setid_file"))
+        import scipy.io
+        self.transform = transform
+        self.backend = backend or "pil"
+        labels = scipy.io.loadmat(label_file)["labels"].ravel()
+        setid = scipy.io.loadmat(setid_file)
+        key = {"train": "trnid", "valid": "valid", "test": "tstid"}[mode]
+        self.indexes = setid[key].ravel()
+        self.labels = labels
+        self._tar = tarfile.open(data_file, "r:*")
+        self._members = {os.path.basename(m.name): m
+                         for m in self._tar.getmembers() if m.isfile()}
+
+    def __getitem__(self, idx):
+        from PIL import Image
+        img_id = int(self.indexes[idx])
+        name = "image_%05d.jpg" % img_id
+        data = self._tar.extractfile(self._members[name]).read()
+        img = Image.open(_io.BytesIO(data)).convert("RGB")
+        img = np.asarray(img, np.float32)
+        if self.transform is not None:
+            img = self.transform(img)
+        label = np.asarray([self.labels[img_id - 1]], np.int64)
+        return img, label
+
+    def __len__(self):
+        return len(self.indexes)
+
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",
+                  ".tiff", ".webp")
+
+
+def _default_loader(path):
+    from PIL import Image
+    with open(path, "rb") as f:
+        return Image.open(f).convert("RGB")
+
+
+class DatasetFolder(Dataset):
+    """Parity: vision/datasets/folder.py:203 — class-per-subdirectory
+    layout; samples are (image, class_index)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        if extensions is None and is_valid_file is None:
+            extensions = IMG_EXTENSIONS
+        classes = sorted(d.name for d in os.scandir(root) if d.is_dir())
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        if is_valid_file is None:
+            def is_valid_file(p):
+                return p.lower().endswith(tuple(extensions))
+        samples = []
+        for c in classes:
+            d = os.path.join(root, c)
+            for base, _, files in sorted(os.walk(d)):
+                for fn in sorted(files):
+                    p = os.path.join(base, fn)
+                    if is_valid_file(p):
+                        samples.append((p, self.class_to_idx[c]))
+        if not samples:
+            raise RuntimeError(
+                f"Found 0 files in subfolders of: {root}\nSupported "
+                f"extensions are: {','.join(extensions or ())}")
+        self.samples = samples
+        self.targets = [s[1] for s in samples]
+        self.loader = loader or _default_loader
+        self.extensions = extensions
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        img = np.asarray(img, np.float32)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Parity: vision/datasets/folder.py:426 — flat folder of images,
+    samples are just images (no labels)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        if extensions is None and is_valid_file is None:
+            extensions = IMG_EXTENSIONS
+        if is_valid_file is None:
+            def is_valid_file(p):
+                return p.lower().endswith(tuple(extensions))
+        samples = []
+        for base, _, files in sorted(os.walk(root)):
+            for fn in sorted(files):
+                p = os.path.join(base, fn)
+                if is_valid_file(p):
+                    samples.append(p)
+        if not samples:
+            raise RuntimeError(
+                f"Found 0 files in subfolders of: {root}\nSupported "
+                f"extensions are: {','.join(extensions or ())}")
+        self.samples = samples
+        self.loader = loader or _default_loader
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        img = np.asarray(img, np.float32)
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class VOC2012(Dataset):
+    """Parity: vision/datasets/voc2012.py:106 — segmentation pairs from a
+    local VOCtrainval tar. Yields (image, label-mask) numpy arrays."""
+
+    _base = "VOCdevkit/VOC2012"
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        mode = mode.lower()
+        assert mode in ("train", "valid", "test"), (
+            f"mode should be 'train', 'valid' or 'test', but got {mode}")
+        if data_file is None:
+            raise RuntimeError(_NO_DOWNLOAD.format(
+                name="VOC2012", args="data_file"))
+        self.transform = transform
+        self.backend = backend or "pil"
+        self._tar = tarfile.open(data_file, "r:*")
+        names = {m.name: m for m in self._tar.getmembers()}
+        # reference voc2012.py:36 MODE_FLAG_MAP:
+        # train → trainval, test → train, valid → val
+        setname = {"train": "trainval.txt", "valid": "val.txt",
+                   "test": "train.txt"}[mode]
+        listpath = f"{self._base}/ImageSets/Segmentation/{setname}"
+        ids = self._tar.extractfile(names[listpath]).read().decode() \
+            .split()
+        self._pairs = [
+            (f"{self._base}/JPEGImages/{i}.jpg",
+             f"{self._base}/SegmentationClass/{i}.png") for i in ids]
+        self._members = names
+
+    def __getitem__(self, idx):
+        from PIL import Image
+        ip, lp = self._pairs[idx]
+        img = Image.open(_io.BytesIO(
+            self._tar.extractfile(self._members[ip]).read())).convert("RGB")
+        lbl = Image.open(_io.BytesIO(
+            self._tar.extractfile(self._members[lp]).read()))
+        img = np.asarray(img, np.float32)
+        lbl = np.asarray(lbl, np.int64)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, lbl
+
+    def __len__(self):
+        return len(self._pairs)

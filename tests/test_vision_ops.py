@@ -1,0 +1,449 @@
+"""Vision detection ops: numeric checks against naive numpy references
+(the OpTest pattern, SURVEY.md §4) + dataset file-format parsers."""
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import datasets as D
+from paddle_tpu.vision import ops as V
+
+
+def _iou(a, b):
+    ix1, iy1 = max(a[0], b[0]), max(a[1], b[1])
+    ix2, iy2 = min(a[2], b[2]), min(a[3], b[3])
+    inter = max(ix2 - ix1, 0) * max(iy2 - iy1, 0)
+    ua = (a[2] - a[0]) * (a[3] - a[1]) + (b[2] - b[0]) * (b[3] - b[1]) - inter
+    return inter / max(ua, 1e-10)
+
+
+def _naive_nms(boxes, thresh):
+    keep = []
+    for i in range(len(boxes)):
+        if all(_iou(boxes[i], boxes[j]) <= thresh for j in keep):
+            keep.append(i)
+    return keep
+
+
+class TestNMS:
+    def test_plain_matches_naive(self):
+        rng = np.random.RandomState(0)
+        xy = rng.rand(64, 2).astype(np.float32)
+        wh = rng.rand(64, 2).astype(np.float32) * 0.5 + 0.05
+        boxes = np.concatenate([xy, xy + wh], 1)
+        got = V.nms(paddle.to_tensor(boxes), 0.3).numpy()
+        np.testing.assert_array_equal(got, _naive_nms(boxes, 0.3))
+
+    def test_scores_sorts_first(self):
+        boxes = np.array([[0, 0, 1, 1], [0.05, 0, 1.05, 1], [3, 3, 4, 4]],
+                         np.float32)
+        scores = np.array([0.5, 0.9, 0.7], np.float32)
+        got = V.nms(paddle.to_tensor(boxes), 0.5,
+                    paddle.to_tensor(scores)).numpy()
+        # box1 (highest) suppresses box0; order is by score
+        np.testing.assert_array_equal(got, [1, 2])
+
+    def test_categories(self):
+        boxes = np.array([[0, 0, 1, 1], [0.02, 0, 1.02, 1],
+                          [0, 0, 1, 1], [5, 5, 6, 6]], np.float32)
+        scores = np.array([0.9, 0.8, 0.95, 0.3], np.float32)
+        cats = np.array([0, 0, 1, 1], np.int64)
+        got = V.nms(paddle.to_tensor(boxes), 0.5, paddle.to_tensor(scores),
+                    paddle.to_tensor(cats), [0, 1]).numpy()
+        # per-category: cat0 keeps 0 (suppresses 1), cat1 keeps 2 and 3
+        np.testing.assert_array_equal(sorted(got), [0, 2, 3])
+        assert got[0] == 2  # sorted by score overall
+
+    def test_top_k(self):
+        boxes = np.array([[0, 0, 1, 1], [2, 2, 3, 3], [5, 5, 6, 6]],
+                         np.float32)
+        scores = np.array([0.9, 0.8, 0.7], np.float32)
+        cats = np.zeros(3, np.int64)
+        got = V.nms(paddle.to_tensor(boxes), 0.5, paddle.to_tensor(scores),
+                    paddle.to_tensor(cats), [0], top_k=2).numpy()
+        np.testing.assert_array_equal(got, [0, 1])
+
+
+class TestRoIAlign:
+    def _naive(self, feat, boxes, bidx, ph, pw, scale, ratio, aligned):
+        R = len(boxes)
+        C, H, W = feat.shape[1:]
+        out = np.zeros((R, C, ph, pw), np.float32)
+
+        def sample(b, c, y, x):
+            if y < -1 or y > H or x < -1 or x > W:
+                return 0.0
+            y = min(max(y, 0), H - 1)
+            x = min(max(x, 0), W - 1)
+            y0, x0 = int(np.floor(y)), int(np.floor(x))
+            y1, x1 = min(y0 + 1, H - 1), min(x0 + 1, W - 1)
+            ly, lx = y - y0, x - x0
+            return (feat[b, c, y0, x0] * (1 - ly) * (1 - lx)
+                    + feat[b, c, y0, x1] * (1 - ly) * lx
+                    + feat[b, c, y1, x0] * ly * (1 - lx)
+                    + feat[b, c, y1, x1] * ly * lx)
+
+        off = 0.5 if aligned else 0.0
+        for r, bx in enumerate(boxes):
+            x1 = bx[0] * scale - off
+            y1 = bx[1] * scale - off
+            if aligned:
+                w = max(bx[2] * scale - off - x1, 1e-10)
+                h = max(bx[3] * scale - off - y1, 1e-10)
+            else:
+                w = max(bx[2] * scale - x1, 1.0)
+                h = max(bx[3] * scale - y1, 1.0)
+            bh, bw = h / ph, w / pw
+            nh = ratio if ratio > 0 else int(np.ceil(h / ph))
+            nw = ratio if ratio > 0 else int(np.ceil(w / pw))
+            nh, nw = max(nh, 1), max(nw, 1)
+            for c in range(C):
+                for i in range(ph):
+                    for j in range(pw):
+                        acc = 0.0
+                        for iy in range(nh):
+                            for ix in range(nw):
+                                yy = y1 + (i + (iy + 0.5) / nh) * bh
+                                xx = x1 + (j + (ix + 0.5) / nw) * bw
+                                acc += sample(bidx[r], c, yy, xx)
+                        out[r, c, i, j] = acc / (nh * nw)
+        return out
+
+    @pytest.mark.parametrize("ratio,aligned", [(2, True), (-1, True),
+                                               (2, False)])
+    def test_matches_naive(self, ratio, aligned):
+        rng = np.random.RandomState(1)
+        feat = rng.randn(2, 3, 12, 12).astype(np.float32)
+        boxes = np.array([[1, 1, 8, 8], [0, 2, 11, 10], [3, 3, 5, 9]],
+                         np.float32)
+        bn = np.array([2, 1], np.int32)
+        got = V.roi_align(paddle.to_tensor(feat), paddle.to_tensor(boxes),
+                          paddle.to_tensor(bn), (4, 4), spatial_scale=0.5,
+                          sampling_ratio=ratio, aligned=aligned).numpy()
+        want = self._naive(feat, boxes, [0, 0, 1], 4, 4, 0.5, ratio,
+                           aligned)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_grad_flows(self):
+        feat = paddle.to_tensor(
+            np.random.RandomState(0).randn(1, 2, 8, 8).astype(np.float32))
+        feat.stop_gradient = False
+        boxes = paddle.to_tensor(np.array([[1, 1, 6, 6]], np.float32))
+        bn = paddle.to_tensor(np.array([1], np.int32))
+        out = V.roi_align(feat, boxes, bn, 2, sampling_ratio=2)
+        out.sum().backward()
+        assert feat.grad is not None
+        assert float(np.abs(feat.grad.numpy()).sum()) > 0
+
+
+class TestRoIPool:
+    def test_matches_naive(self):
+        rng = np.random.RandomState(2)
+        feat = rng.randn(1, 2, 10, 10).astype(np.float32)
+        boxes = np.array([[0, 0, 6, 6], [2, 2, 9, 9]], np.float32)
+        bn = np.array([2], np.int32)
+        ph = pw = 3
+        got = V.roi_pool(paddle.to_tensor(feat), paddle.to_tensor(boxes),
+                         paddle.to_tensor(bn), ph, 1.0).numpy()
+        for r, bx in enumerate(boxes):
+            x1, y1, x2, y2 = np.round(bx).astype(int)
+            h = max(y2 - y1 + 1, 1)
+            w = max(x2 - x1 + 1, 1)
+            for c in range(2):
+                for i in range(ph):
+                    ys = y1 + int(np.floor(i * h / ph))
+                    ye = y1 + int(np.ceil((i + 1) * h / ph))
+                    for j in range(pw):
+                        xs = x1 + int(np.floor(j * w / pw))
+                        xe = x1 + int(np.ceil((j + 1) * w / pw))
+                        want = feat[0, c,
+                                    max(ys, 0):min(ye, 10),
+                                    max(xs, 0):min(xe, 10)].max()
+                        np.testing.assert_allclose(got[r, c, i, j], want,
+                                                   rtol=1e-5)
+
+
+class TestPSRoIPool:
+    def test_shape_and_range(self):
+        rng = np.random.RandomState(3)
+        ph = pw = 2
+        out_c = 3
+        feat = rng.randn(1, out_c * ph * pw, 8, 8).astype(np.float32)
+        boxes = np.array([[0, 0, 4, 4], [2, 2, 7, 7]], np.float32)
+        bn = np.array([2], np.int32)
+        got = V.psroi_pool(paddle.to_tensor(feat), paddle.to_tensor(boxes),
+                           paddle.to_tensor(bn), ph, 1.0).numpy()
+        assert got.shape == (2, out_c, ph, pw)
+        # averages of the input are bounded by input range
+        assert got.max() <= feat.max() + 1e-5
+        assert got.min() >= feat.min() - 1e-5
+
+
+class TestDeformConv:
+    def test_zero_offset_equals_conv(self):
+        import paddle_tpu.nn.functional as F
+        rng = np.random.RandomState(4)
+        x = rng.randn(2, 4, 9, 9).astype(np.float32)
+        w = (rng.randn(6, 4, 3, 3) * 0.1).astype(np.float32)
+        off = np.zeros((2, 2 * 9, 7, 7), np.float32)
+        got = V.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                              paddle.to_tensor(w)).numpy()
+        want = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w)).numpy()
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+    def test_integer_offset_shifts(self):
+        # a +1 x-offset on every tap equals convolving the shifted image
+        import paddle_tpu.nn.functional as F
+        rng = np.random.RandomState(5)
+        x = rng.randn(1, 1, 8, 8).astype(np.float32)
+        w = (rng.randn(1, 1, 3, 3) * 0.3).astype(np.float32)
+        off = np.zeros((1, 2 * 9, 6, 6), np.float32)
+        off[:, 1::2] = 1.0  # dx = +1 on every tap
+        got = V.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                              paddle.to_tensor(w)).numpy()
+        xs = np.zeros_like(x)
+        xs[..., :-1] = x[..., 1:]  # shift left (sample at x+1)
+        want = F.conv2d(paddle.to_tensor(xs), paddle.to_tensor(w)).numpy()
+        # interior matches; boundary columns differ (zero pad vs shift)
+        np.testing.assert_allclose(got[..., :-1], want[..., :-1],
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_mask_and_layer(self):
+        rng = np.random.RandomState(6)
+        x = paddle.to_tensor(rng.randn(1, 4, 6, 6).astype(np.float32))
+        layer = V.DeformConv2D(4, 8, 3, padding=1)
+        off = paddle.to_tensor(
+            (rng.randn(1, 18, 6, 6) * 0.1).astype(np.float32))
+        mask = paddle.to_tensor(
+            np.ones((1, 9, 6, 6), np.float32) * 0.5)
+        full = layer(x, off).numpy()
+        half = layer(x, off, mask).numpy()
+        b = layer.bias.numpy().reshape(1, -1, 1, 1)
+        np.testing.assert_allclose(half - b, (full - b) * 0.5,
+                                   rtol=1e-3, atol=1e-4)
+
+
+class TestYoloBox:
+    def test_decode_shapes_and_values(self):
+        rng = np.random.RandomState(7)
+        N, na, cls, H, W = 2, 3, 4, 5, 5
+        x = rng.randn(N, na * (5 + cls), H, W).astype(np.float32)
+        img = np.tile(np.asarray([[320, 320]], np.int32), (N, 1))
+        boxes, scores = V.yolo_box(paddle.to_tensor(x),
+                                   paddle.to_tensor(img),
+                                   [10, 13, 16, 30, 33, 23], cls,
+                                   conf_thresh=0.0, downsample_ratio=32)
+        assert boxes.shape == [N, na * H * W, 4]
+        assert scores.shape == [N, na * H * W, cls]
+        b = boxes.numpy()
+        assert (b[..., 2] >= b[..., 0] - 1e-3).all()
+        assert b.min() >= -1e-3 and b.max() <= 320  # clipped
+
+    def test_conf_thresh_zeroes(self):
+        x = np.full((1, 1 * 6, 2, 2), -5.0, np.float32)  # low conf
+        img = np.asarray([[64, 64]], np.int32)
+        boxes, scores = V.yolo_box(paddle.to_tensor(x),
+                                   paddle.to_tensor(img), [10, 13], 1,
+                                   conf_thresh=0.5, downsample_ratio=32)
+        assert np.abs(scores.numpy()).sum() == 0
+
+
+class TestBoxCoder:
+    def test_encode_decode_roundtrip(self):
+        rng = np.random.RandomState(8)
+        priors = np.array([[0, 0, 10, 10], [5, 5, 20, 20]], np.float32)
+        var = [0.1, 0.1, 0.2, 0.2]
+        targets = np.array([[1, 1, 8, 8], [6, 6, 18, 19]], np.float32)
+        enc = V.box_coder(paddle.to_tensor(priors), var,
+                          paddle.to_tensor(targets),
+                          code_type="encode_center_size")
+        assert enc.shape == [2, 2, 4]
+        dec = V.box_coder(paddle.to_tensor(priors), var, enc,
+                          code_type="decode_center_size", axis=0)
+        d = dec.numpy()
+        # the diagonal (target i vs prior i) must reconstruct target i
+        for i in range(2):
+            np.testing.assert_allclose(d[i, i], targets[i], rtol=1e-4,
+                                       atol=1e-3)
+
+
+class TestPriorMatrixFPN:
+    def test_prior_box(self):
+        feat = paddle.to_tensor(np.zeros((1, 8, 4, 4), np.float32))
+        img = paddle.to_tensor(np.zeros((1, 3, 32, 32), np.float32))
+        boxes, var = V.prior_box(feat, img, min_sizes=[8.0],
+                                 max_sizes=[16.0], aspect_ratios=[2.0],
+                                 flip=True, clip=True)
+        assert boxes.shape == [4, 4, 4, 4]  # 1 + 1(max) + 2 ar
+        b = boxes.numpy()
+        assert b.min() >= 0 and b.max() <= 1
+
+    def test_matrix_nms(self):
+        boxes = np.array([[[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5],
+                           [20, 20, 30, 30]]], np.float32)
+        scores = np.array([[[0.9, 0.85, 0.6]]], np.float32)
+        out, n = V.matrix_nms(paddle.to_tensor(boxes),
+                              paddle.to_tensor(scores),
+                              score_threshold=0.1, post_threshold=0.0,
+                              nms_top_k=10, keep_top_k=10,
+                              background_label=-1)
+        o = out.numpy()
+        assert int(n.numpy()[0]) == 3
+        # highest score survives undecayed
+        assert abs(o[0, 1] - 0.9) < 1e-6
+        # heavily-overlapped second box is decayed
+        decayed = o[np.argsort(o[:, 5])][0]
+        assert o[:, 1].min() < 0.85
+
+    def test_matrix_nms_gaussian(self):
+        # reference decay: exp((max_iou^2 - iou^2) * sigma)
+        boxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11],
+                           [20, 20, 30, 30]]], np.float32)
+        scores = np.array([[[0.9, 0.8, 0.6]]], np.float32)
+        out, n = V.matrix_nms(paddle.to_tensor(boxes),
+                              paddle.to_tensor(scores),
+                              score_threshold=0.1, post_threshold=0.0,
+                              nms_top_k=10, keep_top_k=10,
+                              use_gaussian=True, gaussian_sigma=2.0,
+                              background_label=-1)
+        o = out.numpy()
+        iou = 81.0 / (100 + 100 - 81)
+        want = 0.8 * np.exp((0.0 - iou ** 2) * 2.0)  # 0.317 < 0.6
+        got = sorted(o[:, 1])[0]  # smallest score = decayed 2nd box
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_roi_pool_outside_image_is_zero(self):
+        feat = np.ones((1, 1, 8, 8), np.float32) * 5.0
+        boxes = np.array([[-6, -6, -2, -2]], np.float32)
+        bn = np.array([1], np.int32)
+        got = V.roi_pool(paddle.to_tensor(feat), paddle.to_tensor(boxes),
+                         paddle.to_tensor(bn), 2, 1.0).numpy()
+        np.testing.assert_array_equal(got, 0.0)
+
+    def test_distribute_fpn(self):
+        rois = np.array([[0, 0, 10, 10], [0, 0, 100, 100],
+                         [0, 0, 224, 224], [0, 0, 500, 500]], np.float32)
+        multi, restore = V.distribute_fpn_proposals(
+            paddle.to_tensor(rois), 2, 5, 4, 224)
+        assert len(multi) == 4
+        total = sum(m.shape[0] for m in multi)
+        assert total == 4
+        r = restore.numpy().ravel()
+        np.testing.assert_array_equal(sorted(r), [0, 1, 2, 3])
+
+    def test_generate_proposals(self):
+        rng = np.random.RandomState(9)
+        H = W = 4
+        A = 2
+        scores = rng.rand(1, A, H, W).astype(np.float32)
+        deltas = (rng.randn(1, 4 * A, H, W) * 0.1).astype(np.float32)
+        anchors = rng.rand(H * W * A, 4).astype(np.float32)
+        anchors[:, 2:] = anchors[:, :2] + 4 + rng.rand(H * W * A, 2) * 8
+        var = np.ones((H * W * A, 4), np.float32)
+        rois, rscores, n = V.generate_proposals(
+            paddle.to_tensor(scores), paddle.to_tensor(deltas),
+            paddle.to_tensor(np.asarray([[32., 32.]], np.float32)),
+            paddle.to_tensor(anchors), paddle.to_tensor(var),
+            pre_nms_top_n=16, post_nms_top_n=8, nms_thresh=0.7,
+            min_size=1.0, return_rois_num=True)
+        assert rois.shape[1] == 4
+        assert rois.shape[0] == int(n.numpy()[0]) <= 8
+
+
+class TestYoloLoss:
+    def test_loss_decreases_towards_target(self):
+        # loss with correct predictions should be far below random ones
+        rng = np.random.RandomState(10)
+        N, na, cls, H, W = 1, 3, 2, 4, 4
+        anchors = [10, 13, 16, 30, 33, 23]
+        gtb = np.array([[[0.4, 0.4, 0.2, 0.3]]], np.float32)  # cx cy w h
+        gtl = np.array([[1]], np.int64)
+        x_rand = rng.randn(N, na * (5 + cls), H, W).astype(np.float32)
+        loss_r = float(V.yolo_loss(
+            paddle.to_tensor(x_rand), paddle.to_tensor(gtb),
+            paddle.to_tensor(gtl), anchors, [0, 1, 2], cls, 0.7, 32,
+            use_label_smooth=False).numpy()[0])
+        assert np.isfinite(loss_r) and loss_r > 0
+
+    def test_grad_flows(self):
+        rng = np.random.RandomState(11)
+        x = paddle.to_tensor(
+            rng.randn(1, 3 * 7, 4, 4).astype(np.float32))
+        x.stop_gradient = False
+        gtb = paddle.to_tensor(np.array([[[0.5, 0.5, 0.3, 0.3]]],
+                                        np.float32))
+        gtl = paddle.to_tensor(np.array([[0]], np.int64))
+        loss = V.yolo_loss(x, gtb, gtl, [10, 13, 16, 30, 33, 23],
+                           [0, 1, 2], 2, 0.7, 32)
+        loss.sum().backward()
+        assert float(np.abs(x.grad.numpy()).sum()) > 0
+
+
+class TestDatasets:
+    def _write_mnist(self, tmp, n=32):
+        rng = np.random.RandomState(0)
+        imgs = rng.randint(0, 256, (n, 28, 28)).astype(np.uint8)
+        lbls = rng.randint(0, 10, (n,)).astype(np.uint8)
+        ip = os.path.join(tmp, "images.gz")
+        lp = os.path.join(tmp, "labels.gz")
+        with gzip.open(ip, "wb") as f:
+            f.write(struct.pack(">IIII", 2051, n, 28, 28))
+            f.write(imgs.tobytes())
+        with gzip.open(lp, "wb") as f:
+            f.write(struct.pack(">II", 2049, n))
+            f.write(lbls.tobytes())
+        return ip, lp, imgs, lbls
+
+    def test_mnist(self, tmp_path):
+        ip, lp, imgs, lbls = self._write_mnist(str(tmp_path))
+        ds = D.MNIST(image_path=ip, label_path=lp, mode="train")
+        assert len(ds) == 32
+        img, lbl = ds[3]
+        assert img.shape == (28, 28, 1)
+        np.testing.assert_allclose(img[..., 0], imgs[3])
+        assert int(lbl[0]) == int(lbls[3])
+
+    def test_cifar10(self, tmp_path):
+        rng = np.random.RandomState(1)
+        data = rng.randint(0, 256, (20, 3072)).astype(np.uint8)
+        labels = rng.randint(0, 10, (20,)).tolist()
+        tar_path = str(tmp_path / "cifar-10-python.tar.gz")
+        inner = {b"data": data, b"labels": labels}
+        blob = pickle.dumps(inner)
+        with tarfile.open(tar_path, "w:gz") as tf:
+            info = tarfile.TarInfo("cifar-10-batches-py/data_batch_1")
+            info.size = len(blob)
+            import io as _io
+            tf.addfile(info, _io.BytesIO(blob))
+        ds = D.Cifar10(data_file=tar_path, mode="train")
+        assert len(ds) == 20
+        img, lbl = ds[5]
+        assert img.shape == (32, 32, 3)
+        assert int(lbl) == labels[5]
+
+    def test_folder(self, tmp_path):
+        from PIL import Image
+        for cls in ("cat", "dog"):
+            d = tmp_path / cls
+            d.mkdir()
+            for i in range(3):
+                Image.fromarray(
+                    np.zeros((4, 4, 3), np.uint8)).save(d / f"{i}.png")
+        ds = D.DatasetFolder(str(tmp_path))
+        assert len(ds) == 6
+        assert ds.classes == ["cat", "dog"]
+        img, target = ds[0]
+        assert img.shape == (4, 4, 3) and target == 0
+        flat = D.ImageFolder(str(tmp_path))
+        assert len(flat) == 6
+
+    def test_no_download_raises(self):
+        with pytest.raises(RuntimeError, match="no network egress"):
+            D.MNIST()
+        with pytest.raises(RuntimeError, match="no network egress"):
+            D.Cifar10()
