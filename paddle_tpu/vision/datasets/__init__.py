@@ -18,6 +18,7 @@ import os
 import pickle
 import struct
 import tarfile
+import threading
 
 import numpy as np
 
@@ -158,6 +159,9 @@ class Flowers(Dataset):
         self.indexes = setid[key].ravel()
         self.labels = labels
         self._tar = tarfile.open(data_file, "r:*")
+        # TarFile shares one seekable fileobj — serialize reads so the
+        # thread-pool DataLoader (num_workers>0) can't interleave them
+        self._tar_lock = threading.Lock()
         self._members = {os.path.basename(m.name): m
                          for m in self._tar.getmembers() if m.isfile()}
 
@@ -165,7 +169,8 @@ class Flowers(Dataset):
         from PIL import Image
         img_id = int(self.indexes[idx])
         name = "image_%05d.jpg" % img_id
-        data = self._tar.extractfile(self._members[name]).read()
+        with self._tar_lock:
+            data = self._tar.extractfile(self._members[name]).read()
         img = Image.open(_io.BytesIO(data)).convert("RGB")
         img = np.asarray(img, np.float32)
         if self.transform is not None:
@@ -286,6 +291,7 @@ class VOC2012(Dataset):
         self.transform = transform
         self.backend = backend or "pil"
         self._tar = tarfile.open(data_file, "r:*")
+        self._tar_lock = threading.Lock()  # see Flowers note
         names = {m.name: m for m in self._tar.getmembers()}
         # reference voc2012.py:36 MODE_FLAG_MAP:
         # train → trainval, test → train, valid → val
@@ -302,10 +308,11 @@ class VOC2012(Dataset):
     def __getitem__(self, idx):
         from PIL import Image
         ip, lp = self._pairs[idx]
-        img = Image.open(_io.BytesIO(
-            self._tar.extractfile(self._members[ip]).read())).convert("RGB")
-        lbl = Image.open(_io.BytesIO(
-            self._tar.extractfile(self._members[lp]).read()))
+        with self._tar_lock:
+            img_bytes = self._tar.extractfile(self._members[ip]).read()
+            lbl_bytes = self._tar.extractfile(self._members[lp]).read()
+        img = Image.open(_io.BytesIO(img_bytes)).convert("RGB")
+        lbl = Image.open(_io.BytesIO(lbl_bytes))
         img = np.asarray(img, np.float32)
         lbl = np.asarray(lbl, np.int64)
         if self.transform is not None:

@@ -1,0 +1,100 @@
+"""GoogLeNet (Inception v1). Parity: python/paddle/vision/models/
+googlenet.py — returns (out, aux1, aux2) like the reference.
+"""
+from __future__ import annotations
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+__all__ = ["GoogLeNet", "googlenet"]
+
+
+class Inception(nn.Layer):
+    def __init__(self, in_c, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.b1 = nn.Sequential(nn.Conv2D(in_c, c1, 1), nn.ReLU())
+        self.b3 = nn.Sequential(
+            nn.Conv2D(in_c, c3r, 1), nn.ReLU(),
+            nn.Conv2D(c3r, c3, 3, padding=1), nn.ReLU())
+        self.b5 = nn.Sequential(
+            nn.Conv2D(in_c, c5r, 1), nn.ReLU(),
+            nn.Conv2D(c5r, c5, 5, padding=2), nn.ReLU())
+        self.bp = nn.Sequential(
+            nn.MaxPool2D(3, stride=1, padding=1),
+            nn.Conv2D(in_c, proj, 1), nn.ReLU())
+
+    def forward(self, x):
+        return paddle.concat(
+            [self.b1(x), self.b3(x), self.b5(x), self.bp(x)], axis=1)
+
+
+class _AuxHead(nn.Layer):
+    def __init__(self, in_c, num_classes):
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D(4)
+        self.conv = nn.Conv2D(in_c, 128, 1)
+        self.relu = nn.ReLU()
+        self.fc1 = nn.Linear(128 * 4 * 4, 1024)
+        self.drop = nn.Dropout(0.7)
+        self.fc2 = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.relu(self.conv(self.pool(x)))
+        x = self.relu(self.fc1(x.flatten(1)))
+        return self.fc2(self.drop(x))
+
+
+class GoogLeNet(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, 64, 7, stride=2, padding=3), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, padding=1),
+            nn.Conv2D(64, 64, 1), nn.ReLU(),
+            nn.Conv2D(64, 192, 3, padding=1), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        self.ince3a = Inception(192, 64, 96, 128, 16, 32, 32)
+        self.ince3b = Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.ince4a = Inception(480, 192, 96, 208, 16, 48, 64)
+        self.ince4b = Inception(512, 160, 112, 224, 24, 64, 64)
+        self.ince4c = Inception(512, 128, 128, 256, 24, 64, 64)
+        self.ince4d = Inception(512, 112, 144, 288, 32, 64, 64)
+        self.ince4e = Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.ince5a = Inception(832, 256, 160, 320, 32, 128, 128)
+        self.ince5b = Inception(832, 384, 192, 384, 48, 128, 128)
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.drop = nn.Dropout(0.4)
+            self.fc = nn.Linear(1024, num_classes)
+            self.aux1 = _AuxHead(512, num_classes)
+            self.aux2 = _AuxHead(528, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.ince3b(self.ince3a(x))
+        x = self.pool3(x)
+        x = self.ince4a(x)
+        aux1 = self.aux1(x) if self.num_classes > 0 else None
+        x = self.ince4c(self.ince4b(x))
+        x = self.ince4d(x)
+        aux2 = self.aux2(x) if self.num_classes > 0 else None
+        x = self.ince4e(x)
+        x = self.pool4(x)
+        x = self.ince5b(self.ince5a(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.drop(x.flatten(1))
+            x = self.fc(x)
+            return x, aux1, aux2
+        return x
+
+
+def googlenet(pretrained=False, **kwargs):
+    assert not pretrained, "pretrained weights unavailable (no egress)"
+    return GoogLeNet(**kwargs)

@@ -1,0 +1,154 @@
+"""InceptionV3. Parity: python/paddle/vision/models/inceptionv3.py
+(stem + InceptionA/B/C/D/E stacks, 299x299 input).
+"""
+from __future__ import annotations
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+__all__ = ["InceptionV3", "inception_v3"]
+
+
+def _bn_conv(in_c, out_c, k, stride=1, padding=0):
+    return nn.Sequential(
+        nn.Conv2D(in_c, out_c, k, stride=stride, padding=padding,
+                  bias_attr=False),
+        nn.BatchNorm2D(out_c), nn.ReLU())
+
+
+class InceptionA(nn.Layer):
+    def __init__(self, in_c, pool_features):
+        super().__init__()
+        self.b1 = _bn_conv(in_c, 64, 1)
+        self.b5 = nn.Sequential(_bn_conv(in_c, 48, 1),
+                                _bn_conv(48, 64, 5, padding=2))
+        self.b3 = nn.Sequential(_bn_conv(in_c, 64, 1),
+                                _bn_conv(64, 96, 3, padding=1),
+                                _bn_conv(96, 96, 3, padding=1))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _bn_conv(in_c, pool_features, 1))
+
+    def forward(self, x):
+        return paddle.concat(
+            [self.b1(x), self.b5(x), self.b3(x), self.bp(x)], axis=1)
+
+
+class InceptionB(nn.Layer):
+    """Grid reduction 35->17."""
+
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = _bn_conv(in_c, 384, 3, stride=2)
+        self.b3d = nn.Sequential(_bn_conv(in_c, 64, 1),
+                                 _bn_conv(64, 96, 3, padding=1),
+                                 _bn_conv(96, 96, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return paddle.concat([self.b3(x), self.b3d(x), self.pool(x)],
+                             axis=1)
+
+
+class InceptionC(nn.Layer):
+    def __init__(self, in_c, c7):
+        super().__init__()
+        self.b1 = _bn_conv(in_c, 192, 1)
+        self.b7 = nn.Sequential(
+            _bn_conv(in_c, c7, 1),
+            _bn_conv(c7, c7, (1, 7), padding=(0, 3)),
+            _bn_conv(c7, 192, (7, 1), padding=(3, 0)))
+        self.b7d = nn.Sequential(
+            _bn_conv(in_c, c7, 1),
+            _bn_conv(c7, c7, (7, 1), padding=(3, 0)),
+            _bn_conv(c7, c7, (1, 7), padding=(0, 3)),
+            _bn_conv(c7, c7, (7, 1), padding=(3, 0)),
+            _bn_conv(c7, 192, (1, 7), padding=(0, 3)))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _bn_conv(in_c, 192, 1))
+
+    def forward(self, x):
+        return paddle.concat(
+            [self.b1(x), self.b7(x), self.b7d(x), self.bp(x)], axis=1)
+
+
+class InceptionD(nn.Layer):
+    """Grid reduction 17->8."""
+
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = nn.Sequential(_bn_conv(in_c, 192, 1),
+                                _bn_conv(192, 320, 3, stride=2))
+        self.b7 = nn.Sequential(
+            _bn_conv(in_c, 192, 1),
+            _bn_conv(192, 192, (1, 7), padding=(0, 3)),
+            _bn_conv(192, 192, (7, 1), padding=(3, 0)),
+            _bn_conv(192, 192, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return paddle.concat([self.b3(x), self.b7(x), self.pool(x)],
+                             axis=1)
+
+
+class InceptionE(nn.Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b1 = _bn_conv(in_c, 320, 1)
+        self.b3_stem = _bn_conv(in_c, 384, 1)
+        self.b3_a = _bn_conv(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = _bn_conv(384, 384, (3, 1), padding=(1, 0))
+        self.b3d_stem = nn.Sequential(_bn_conv(in_c, 448, 1),
+                                      _bn_conv(448, 384, 3, padding=1))
+        self.b3d_a = _bn_conv(384, 384, (1, 3), padding=(0, 1))
+        self.b3d_b = _bn_conv(384, 384, (3, 1), padding=(1, 0))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _bn_conv(in_c, 192, 1))
+
+    def forward(self, x):
+        s = self.b3_stem(x)
+        b3 = paddle.concat([self.b3_a(s), self.b3_b(s)], axis=1)
+        d = self.b3d_stem(x)
+        b3d = paddle.concat([self.b3d_a(d), self.b3d_b(d)], axis=1)
+        return paddle.concat([self.b1(x), b3, b3d, self.bp(x)], axis=1)
+
+
+class InceptionV3(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _bn_conv(3, 32, 3, stride=2),
+            _bn_conv(32, 32, 3),
+            _bn_conv(32, 64, 3, padding=1),
+            nn.MaxPool2D(3, stride=2),
+            _bn_conv(64, 80, 1),
+            _bn_conv(80, 192, 3),
+            nn.MaxPool2D(3, stride=2))
+        self.blocks = nn.Sequential(
+            InceptionA(192, 32), InceptionA(256, 64), InceptionA(288, 64),
+            InceptionB(288),
+            InceptionC(768, 128), InceptionC(768, 160),
+            InceptionC(768, 160), InceptionC(768, 192),
+            InceptionD(768),
+            InceptionE(1280), InceptionE(2048))
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.5)
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.blocks(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.dropout(x.flatten(1))
+            x = self.fc(x)
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    assert not pretrained, "pretrained weights unavailable (no egress)"
+    return InceptionV3(**kwargs)

@@ -1,0 +1,69 @@
+"""MobileNetV1. Parity: python/paddle/vision/models/mobilenetv1.py
+(13 depthwise-separable blocks, width multiplier `scale`).
+"""
+from __future__ import annotations
+
+import paddle_tpu.nn as nn
+
+__all__ = ["MobileNetV1", "mobilenet_v1"]
+
+
+class DepthwiseSeparable(nn.Layer):
+    def __init__(self, in_c, out1, out2, num_groups, stride, scale):
+        super().__init__()
+        self.dw = nn.Sequential(
+            nn.Conv2D(in_c, int(out1 * scale), 3, stride=stride, padding=1,
+                      groups=int(num_groups * scale), bias_attr=False),
+            nn.BatchNorm2D(int(out1 * scale)),
+            nn.ReLU())
+        self.pw = nn.Sequential(
+            nn.Conv2D(int(out1 * scale), int(out2 * scale), 1,
+                      bias_attr=False),
+            nn.BatchNorm2D(int(out2 * scale)),
+            nn.ReLU())
+
+    def forward(self, x):
+        return self.pw(self.dw(x))
+
+
+class MobileNetV1(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.scale = scale
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.conv1 = nn.Sequential(
+            nn.Conv2D(3, int(32 * scale), 3, stride=2, padding=1,
+                      bias_attr=False),
+            nn.BatchNorm2D(int(32 * scale)),
+            nn.ReLU())
+        cfg = [  # in, out1, out2, groups, stride
+            (32, 32, 64, 32, 1), (64, 64, 128, 64, 2),
+            (128, 128, 128, 128, 1), (128, 128, 256, 128, 2),
+            (256, 256, 256, 256, 1), (256, 256, 512, 256, 2),
+            (512, 512, 512, 512, 1), (512, 512, 512, 512, 1),
+            (512, 512, 512, 512, 1), (512, 512, 512, 512, 1),
+            (512, 512, 512, 512, 1), (512, 512, 1024, 512, 2),
+            (1024, 1024, 1024, 1024, 1)]
+        blocks = [DepthwiseSeparable(int(i * scale), o1, o2, g, s, scale)
+                  for i, o1, o2, g, s in cfg]
+        self.blocks = nn.Sequential(*blocks)
+        if with_pool:
+            self.pool2d_avg = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(int(1024 * scale), num_classes)
+
+    def forward(self, x):
+        x = self.conv1(x)
+        x = self.blocks(x)
+        if self.with_pool:
+            x = self.pool2d_avg(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.fc(x)
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    assert not pretrained, "pretrained weights unavailable (no egress)"
+    return MobileNetV1(scale=scale, **kwargs)

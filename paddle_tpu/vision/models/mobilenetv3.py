@@ -1,0 +1,133 @@
+"""MobileNetV3 Small/Large. Parity: python/paddle/vision/models/
+mobilenetv3.py (SE-augmented inverted residuals, hardswish stem/head).
+"""
+from __future__ import annotations
+
+import paddle_tpu.nn as nn
+
+from .mobilenetv2 import _make_divisible
+
+__all__ = ["MobileNetV3Small", "MobileNetV3Large", "mobilenet_v3_small",
+           "mobilenet_v3_large"]
+
+
+class SqueezeExcitation(nn.Layer):
+    def __init__(self, c, squeeze_c):
+        super().__init__()
+        self.avgpool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(c, squeeze_c, 1)
+        self.relu = nn.ReLU()
+        self.fc2 = nn.Conv2D(squeeze_c, c, 1)
+        self.hsig = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.avgpool(x)
+        s = self.relu(self.fc1(s))
+        s = self.hsig(self.fc2(s))
+        return x * s
+
+
+class InvertedResidualV3(nn.Layer):
+    def __init__(self, inp, exp, out, k, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and inp == out
+        Act = nn.Hardswish if act == "HS" else nn.ReLU
+        layers = []
+        if exp != inp:
+            layers += [nn.Conv2D(inp, exp, 1, bias_attr=False),
+                       nn.BatchNorm2D(exp), Act()]
+        layers += [nn.Conv2D(exp, exp, k, stride=stride,
+                             padding=(k - 1) // 2, groups=exp,
+                             bias_attr=False),
+                   nn.BatchNorm2D(exp), Act()]
+        if use_se:
+            layers.append(SqueezeExcitation(exp, _make_divisible(exp // 4)))
+        layers += [nn.Conv2D(exp, out, 1, bias_attr=False),
+                   nn.BatchNorm2D(out)]
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        if self.use_res:
+            out = x + out
+        return out
+
+
+class _MobileNetV3(nn.Layer):
+    def __init__(self, cfg, last_exp, last_channel, scale=1.0,
+                 num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        in_c = _make_divisible(16 * scale)
+        layers = [nn.Sequential(
+            nn.Conv2D(3, in_c, 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(in_c), nn.Hardswish())]
+        for k, exp, out, se, act, s in cfg:
+            exp_c = _make_divisible(exp * scale)
+            out_c = _make_divisible(out * scale)
+            layers.append(InvertedResidualV3(in_c, exp_c, out_c, k, s, se,
+                                             act))
+            in_c = out_c
+        exp_c = _make_divisible(last_exp * scale)
+        layers.append(nn.Sequential(
+            nn.Conv2D(in_c, exp_c, 1, bias_attr=False),
+            nn.BatchNorm2D(exp_c), nn.Hardswish()))
+        self.features = nn.Sequential(*layers)
+        self.last_conv_c = exp_c
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(exp_c, last_channel), nn.Hardswish(),
+                nn.Dropout(0.2), nn.Linear(last_channel, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.classifier(x)
+        return x
+
+
+class MobileNetV3Small(_MobileNetV3):
+    # k, exp, out, SE, act, stride
+    _cfg = [
+        (3, 16, 16, True, "RE", 2), (3, 72, 24, False, "RE", 2),
+        (3, 88, 24, False, "RE", 1), (5, 96, 40, True, "HS", 2),
+        (5, 240, 40, True, "HS", 1), (5, 240, 40, True, "HS", 1),
+        (5, 120, 48, True, "HS", 1), (5, 144, 48, True, "HS", 1),
+        (5, 288, 96, True, "HS", 2), (5, 576, 96, True, "HS", 1),
+        (5, 576, 96, True, "HS", 1)]
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(self._cfg, 576, 1024, scale, num_classes,
+                         with_pool)
+
+
+class MobileNetV3Large(_MobileNetV3):
+    _cfg = [
+        (3, 16, 16, False, "RE", 1), (3, 64, 24, False, "RE", 2),
+        (3, 72, 24, False, "RE", 1), (5, 72, 40, True, "RE", 2),
+        (5, 120, 40, True, "RE", 1), (5, 120, 40, True, "RE", 1),
+        (3, 240, 80, False, "HS", 2), (3, 200, 80, False, "HS", 1),
+        (3, 184, 80, False, "HS", 1), (3, 184, 80, False, "HS", 1),
+        (3, 480, 112, True, "HS", 1), (3, 672, 112, True, "HS", 1),
+        (5, 672, 160, True, "HS", 2), (5, 960, 160, True, "HS", 1),
+        (5, 960, 160, True, "HS", 1)]
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(self._cfg, 960, 1280, scale, num_classes,
+                         with_pool)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    assert not pretrained, "pretrained weights unavailable (no egress)"
+    return MobileNetV3Small(scale=scale, **kwargs)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    assert not pretrained, "pretrained weights unavailable (no egress)"
+    return MobileNetV3Large(scale=scale, **kwargs)

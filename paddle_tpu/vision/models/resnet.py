@@ -13,7 +13,9 @@ import paddle_tpu.nn.functional as F
 from paddle_tpu.nn.layer_base import Layer
 
 __all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
-           "resnet152", "wide_resnet50_2", "resnext50_32x4d"]
+           "resnet152", "wide_resnet50_2", "wide_resnet101_2",
+           "resnext50_32x4d", "resnext50_64x4d", "resnext101_32x4d",
+           "resnext101_64x4d", "resnext152_32x4d", "resnext152_64x4d"]
 
 
 class BasicBlock(Layer):
@@ -155,3 +157,32 @@ def wide_resnet50_2(pretrained=False, **kwargs):
 def resnext50_32x4d(pretrained=False, **kwargs):
     return ResNet(BottleneckBlock, 50, groups=32, width_per_group=4,
                   **kwargs)
+
+
+def resnext50_64x4d(pretrained=False, **kwargs):
+    return ResNet(BottleneckBlock, 50, groups=64, width_per_group=4,
+                  **kwargs)
+
+
+def resnext101_32x4d(pretrained=False, **kwargs):
+    return ResNet(BottleneckBlock, 101, groups=32, width_per_group=4,
+                  **kwargs)
+
+
+def resnext101_64x4d(pretrained=False, **kwargs):
+    return ResNet(BottleneckBlock, 101, groups=64, width_per_group=4,
+                  **kwargs)
+
+
+def resnext152_32x4d(pretrained=False, **kwargs):
+    return ResNet(BottleneckBlock, 152, groups=32, width_per_group=4,
+                  **kwargs)
+
+
+def resnext152_64x4d(pretrained=False, **kwargs):
+    return ResNet(BottleneckBlock, 152, groups=64, width_per_group=4,
+                  **kwargs)
+
+
+def wide_resnet101_2(pretrained=False, **kwargs):
+    return ResNet(BottleneckBlock, 101, width_per_group=128, **kwargs)

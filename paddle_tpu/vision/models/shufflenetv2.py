@@ -1,0 +1,141 @@
+"""ShuffleNetV2. Parity: python/paddle/vision/models/shufflenetv2.py
+(channel-shuffle units; width variants x0_25..x2_0 and a swish variant).
+Uses nn.ChannelShuffle (one reshape-transpose, XLA-fused).
+"""
+from __future__ import annotations
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+           "shufflenet_v2_x0_5", "shufflenet_v2_x1_0",
+           "shufflenet_v2_x1_5", "shufflenet_v2_x2_0",
+           "shufflenet_v2_swish"]
+
+
+def _conv_bn_act(in_c, out_c, k, stride, groups=1, act="relu"):
+    layers = [nn.Conv2D(in_c, out_c, k, stride=stride,
+                        padding=(k - 1) // 2, groups=groups,
+                        bias_attr=False),
+              nn.BatchNorm2D(out_c)]
+    if act == "relu":
+        layers.append(nn.ReLU())
+    elif act == "swish":
+        layers.append(nn.Swish())
+    return nn.Sequential(*layers)
+
+
+class InvertedResidualUnit(nn.Layer):
+    """stride-1 unit: split channels, transform one half, shuffle."""
+
+    def __init__(self, c, act):
+        super().__init__()
+        half = c // 2
+        self.branch = nn.Sequential(
+            _conv_bn_act(half, half, 1, 1, act=act),
+            _conv_bn_act(half, half, 3, 1, groups=half, act="none"),
+            _conv_bn_act(half, half, 1, 1, act=act))
+        self.shuffle = nn.ChannelShuffle(2)
+
+    def forward(self, x):
+        c = x.shape[1] // 2
+        x1 = x[:, :c]
+        x2 = x[:, c:]
+        out = paddle.concat([x1, self.branch(x2)], axis=1)
+        return self.shuffle(out)
+
+
+class InvertedResidualDS(nn.Layer):
+    """stride-2 (downsample) unit: both branches transformed."""
+
+    def __init__(self, in_c, out_c, act):
+        super().__init__()
+        half = out_c // 2
+        self.branch1 = nn.Sequential(
+            _conv_bn_act(in_c, in_c, 3, 2, groups=in_c, act="none"),
+            _conv_bn_act(in_c, half, 1, 1, act=act))
+        self.branch2 = nn.Sequential(
+            _conv_bn_act(in_c, half, 1, 1, act=act),
+            _conv_bn_act(half, half, 3, 2, groups=half, act="none"),
+            _conv_bn_act(half, half, 1, 1, act=act))
+        self.shuffle = nn.ChannelShuffle(2)
+
+    def forward(self, x):
+        out = paddle.concat([self.branch1(x), self.branch2(x)], axis=1)
+        return self.shuffle(out)
+
+
+class ShuffleNetV2(nn.Layer):
+    _stage_repeats = (4, 8, 4)
+
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        channels = {
+            0.25: (24, 24, 48, 96, 512), 0.33: (24, 32, 64, 128, 512),
+            0.5: (24, 48, 96, 192, 1024), 1.0: (24, 116, 232, 464, 1024),
+            1.5: (24, 176, 352, 704, 1024), 2.0: (24, 244, 488, 976, 2048),
+        }[scale]
+        self.conv1 = _conv_bn_act(3, channels[0], 3, 2, act=act)
+        self.max_pool = nn.MaxPool2D(3, stride=2, padding=1)
+        stages = []
+        in_c = channels[0]
+        for si, reps in enumerate(self._stage_repeats):
+            out_c = channels[si + 1]
+            stages.append(InvertedResidualDS(in_c, out_c, act))
+            for _ in range(reps - 1):
+                stages.append(InvertedResidualUnit(out_c, act))
+            in_c = out_c
+        self.stages = nn.Sequential(*stages)
+        self.conv_last = _conv_bn_act(in_c, channels[-1], 1, 1, act=act)
+        if with_pool:
+            self.pool2d_avg = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(channels[-1], num_classes)
+
+    def forward(self, x):
+        x = self.conv1(x)
+        x = self.max_pool(x)
+        x = self.stages(x)
+        x = self.conv_last(x)
+        if self.with_pool:
+            x = self.pool2d_avg(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.fc(x)
+        return x
+
+
+def _shufflenet(scale, act, pretrained, **kwargs):
+    assert not pretrained, "pretrained weights unavailable (no egress)"
+    return ShuffleNetV2(scale=scale, act=act, **kwargs)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return _shufflenet(0.25, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return _shufflenet(0.33, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return _shufflenet(0.5, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return _shufflenet(1.0, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return _shufflenet(1.5, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return _shufflenet(2.0, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return _shufflenet(1.0, "swish", pretrained, **kwargs)

@@ -180,7 +180,7 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
         res.append(Tensor(jnp.asarray(np.asarray(nums, np.int32)),
                           stop_gradient=True))
     if return_index:
-        res.append(Tensor(jnp.asarray(np.asarray(idxs)),
+        res.append(Tensor(jnp.asarray(np.asarray(idxs, np.int32)),
                           stop_gradient=True))
     return tuple(res) if len(res) > 1 else res[0]
 
@@ -290,8 +290,9 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
     xs_np = np.round(bx * spatial_scale).astype(np.int64)
     rh = np.maximum(xs_np[:, 3] - xs_np[:, 1] + 1, 1)
     rw = np.maximum(xs_np[:, 2] - xs_np[:, 0] + 1, 1)
-    wh = int(np.max(np.ceil(rh / ph), initial=1)) + 1
-    ww = int(np.max(np.ceil(rw / pw), initial=1)) + 1
+    # bin extent = ceil((i+1)h/ph) - floor(i*h/ph) <= h/ph + 2
+    wh = int(np.max(np.ceil(rh / ph), initial=1)) + 2
+    ww = int(np.max(np.ceil(rw / pw), initial=1)) + 2
 
     def f(feat, b):
         H, W = feat.shape[2], feat.shape[3]
@@ -346,11 +347,15 @@ def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
     assert C % (ph * pw) == 0, (
         "psroi_pool: input channels must be divisible by pooled h*w")
     out_c = C // (ph * pw)
-    # static max window from concrete boxes
-    rh = np.maximum((bx[:, 3] - bx[:, 1]) * spatial_scale, 0.1)
-    rw = np.maximum((bx[:, 2] - bx[:, 0]) * spatial_scale, 0.1)
-    wh = int(np.max(np.ceil(rh / ph), initial=1)) + 1
-    ww = int(np.max(np.ceil(rw / pw), initial=1)) + 1
+    # static max window from concrete boxes, mirroring f()'s rounded
+    # start/end math exactly: bin extent = ceil(start+(i+1)*bin) -
+    # floor(start+i*bin) <= bin + 2
+    rh = np.maximum(np.round(bx[:, 3] + 1.0) * spatial_scale
+                    - np.round(bx[:, 1]) * spatial_scale, 0.1)
+    rw = np.maximum(np.round(bx[:, 2] + 1.0) * spatial_scale
+                    - np.round(bx[:, 0]) * spatial_scale, 0.1)
+    wh = int(np.max(np.ceil(rh / ph), initial=1)) + 2
+    ww = int(np.max(np.ceil(rw / pw), initial=1)) + 2
 
     def f(feat, b):
         H, W = feat.shape[2], feat.shape[3]
@@ -623,10 +628,10 @@ def _yolo_loss_impl(x, gt_box, gt_label, an, amask, class_num,
         slot_c = jnp.clip(slot, 0, na - 1)
         a = scale_x_y
         bsh = -0.5 * (scale_x_y - 1.0)
-        # gather predictions at responsible cells: (N, B, ...)
+        # gather raw logits at responsible cells: (N, B)
         nb = jnp.arange(N)[:, None]
-        px = jax.nn.sigmoid(p[nb, slot_c, 0, gj, gi]) * a + bsh
-        py = jax.nn.sigmoid(p[nb, slot_c, 1, gj, gi]) * a + bsh
+        lx = p[nb, slot_c, 0, gj, gi]
+        ly = p[nb, slot_c, 1, gj, gi]
         pw = p[nb, slot_c, 2, gj, gi]
         ph = p[nb, slot_c, 3, gj, gi]
         tx = gx * W - gi
@@ -637,11 +642,13 @@ def _yolo_loss_impl(x, gt_box, gt_label, an, amask, class_num,
                      / jnp.maximum(aw_m[slot_c], 1e-9))
         th = jnp.log(jnp.maximum(gh * in_h, 1e-9)
                      / jnp.maximum(ah_m[slot_c], 1e-9))
-        scale = 2.0 - gw * gh
-        w = resp.astype(xv.dtype) * scale
+        # reference CalcBoxLoss (yolo_loss_kernel.cc:109): sigmoid-CE on
+        # x/y logits, L1 on w/h, scaled by (2 - w*h) * score
+        score = resp.astype(xv.dtype)
         if gts is not None:
-            w = w * gts
-        loss_xy = (((px - tx) ** 2 + (py - ty) ** 2) * w).sum(-1)
+            score = score * gts
+        w = score * (2.0 - gw * gh)
+        loss_xy = ((bce(lx, tx) + bce(ly, ty)) * w).sum(-1)
         loss_wh = ((jnp.abs(pw - tw) + jnp.abs(ph - th)) * w).sum(-1)
         # objectness: target 1 at responsible cells; ignore where best
         # pred-gt IoU > ignore_thresh
@@ -682,14 +689,16 @@ def _yolo_loss_impl(x, gt_box, gt_label, an, amask, class_num,
         else:
             tgt_obj = tobj
         loss_obj = (bce(pobj, tgt_obj) * objw).sum((1, 2, 3))
-        # classification at responsible cells
+        # classification at responsible cells. Reference CalcLabelLoss
+        # (yolo_loss_kernel.cc:117): smoothing pos=1-sw, neg=sw with
+        # sw=min(1/C, 1/40) (:215-217); weighted by score only (no box
+        # scale).
         pc = p[nb, slot_c, :, gj, gi][:, :, 5:]
-        eps = 1.0 / class_num if use_label_smooth else 0.0
+        sw = min(1.0 / class_num, 1.0 / 40) if use_label_smooth else 0.0
         onehot = jax.nn.one_hot(gtl.astype(jnp.int32), class_num,
                                 dtype=xv.dtype)
-        tcls = onehot * (1 - eps) + eps / class_num if use_label_smooth \
-            else onehot
-        loss_cls = (bce(pc, tcls).sum(-1) * w).sum(-1)
+        tcls = onehot * (1.0 - sw) + (1 - onehot) * sw
+        loss_cls = (bce(pc, tcls).sum(-1) * score).sum(-1)
         return loss_xy + loss_wh + loss_obj + loss_cls
 
     args = [x, gt_box, gt_label]
@@ -828,16 +837,17 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
     lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
     n_lvl = max_level - min_level + 1
     multi_rois, restore, nums = [], np.zeros(len(rois), np.int64), []
-    pos = 0
     order = []
+    splits = None
+    if rois_num is not None:
+        rn = _np(rois_num).astype(np.int64)
+        splits = np.split(np.arange(len(rois)), np.cumsum(rn)[:-1])
     for li in range(n_lvl):
         sel = np.nonzero(lvl == min_level + li)[0]
         order.append(sel)
         multi_rois.append(Tensor(jnp.asarray(rois[sel]),
                                  stop_gradient=True))
-        if rois_num is not None:
-            rn = _np(rois_num).astype(np.int64)
-            splits = np.split(np.arange(len(rois)), np.cumsum(rn)[:-1])
+        if splits is not None:
             nums.append(Tensor(jnp.asarray(np.asarray(
                 [int(np.sum(lvl[s] == min_level + li)) for s in splits],
                 np.int32)), stop_gradient=True))
@@ -946,15 +956,21 @@ class PSRoIPool(_RoILayerBase):
     _fn = staticmethod(psroi_pool)
 
 
+_DEFAULT = object()  # sentinel: "use the default layer class"
+
+
 def ConvNormActivation(in_channels, out_channels, kernel_size=3, stride=1,
-                       padding=None, groups=1, norm_layer=None,
-                       activation_layer=None, dilation=1, bias=None):
+                       padding=None, groups=1, norm_layer=_DEFAULT,
+                       activation_layer=_DEFAULT, dilation=1, bias=None):
     """Parity: vision/ops.py:1796 — Conv2D + Norm + Activation block used
-    across the model zoo. Returns an nn.Sequential."""
+    across the model zoo. Returns an nn.Sequential. Passing
+    norm_layer=None / activation_layer=None disables that stage (and a
+    missing norm implies a biased conv), matching the reference defaults
+    of BatchNorm2D / ReLU."""
     from .. import nn
-    if norm_layer is None:
+    if norm_layer is _DEFAULT:
         norm_layer = nn.BatchNorm2D
-    if activation_layer is None:
+    if activation_layer is _DEFAULT:
         activation_layer = nn.ReLU
     if padding is None:
         padding = (kernel_size - 1) // 2 * dilation

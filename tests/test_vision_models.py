@@ -1,0 +1,129 @@
+"""Model zoo forward-shape tests (small inputs) + the LeNet-on-MNIST
+Model.fit e2e smoke (the reference's test/book/test_recognize_digits.py
+pattern)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import models as M
+
+
+def _img(n=1, c=3, hw=64):
+    rng = np.random.RandomState(0)
+    return paddle.to_tensor(rng.randn(n, c, hw, hw).astype(np.float32))
+
+
+class TestModelZoo:
+    @pytest.mark.parametrize("ctor,kw,hw", [
+        (M.alexnet, {}, 224),
+        (M.vgg11, {}, 64),
+        (M.squeezenet1_0, {}, 64),
+        (M.squeezenet1_1, {}, 64),
+        (M.mobilenet_v1, {"scale": 0.25}, 64),
+        (M.mobilenet_v2, {"scale": 0.25}, 64),
+        (M.mobilenet_v3_small, {"scale": 1.0}, 64),
+        (M.mobilenet_v3_large, {"scale": 1.0}, 64),
+        (M.shufflenet_v2_x0_25, {}, 64),
+        (M.shufflenet_v2_swish, {}, 64),
+        (M.densenet121, {}, 64),
+        (M.resnext50_32x4d, {}, 64),
+        (M.wide_resnet101_2, {}, 64),
+    ])
+    def test_forward_shape(self, ctor, kw, hw):
+        model = ctor(num_classes=7, **kw)
+        model.eval()
+        out = model(_img(2, 3, hw))
+        assert out.shape == [2, 7]
+
+    def test_vgg_batch_norm(self):
+        model = M.vgg11(batch_norm=True, num_classes=5)
+        model.eval()
+        assert model(_img(1, 3, 64)).shape == [1, 5]
+
+    def test_googlenet_aux_heads(self):
+        model = M.googlenet(num_classes=6)
+        model.eval()
+        out, aux1, aux2 = model(_img(1, 3, 64))
+        assert out.shape == [1, 6]
+        assert aux1.shape == [1, 6] and aux2.shape == [1, 6]
+
+    def test_inception_v3(self):
+        model = M.inception_v3(num_classes=4)
+        model.eval()
+        out = model(_img(1, 3, 299))
+        assert out.shape == [1, 4]
+
+    def test_lenet_shape(self):
+        model = M.LeNet()
+        out = model(paddle.to_tensor(
+            np.random.RandomState(1).randn(3, 1, 28, 28).astype(
+                np.float32)))
+        assert out.shape == [3, 10]
+
+    def test_with_pool_false_num_classes_0(self):
+        model = M.mobilenet_v2(scale=0.25, num_classes=0, with_pool=False)
+        model.eval()
+        out = model(_img(1, 3, 64))
+        assert len(out.shape) == 4  # raw feature map
+
+    def test_pretrained_raises(self):
+        with pytest.raises(AssertionError):
+            M.alexnet(pretrained=True)
+
+    def test_conv_norm_activation_disable(self):
+        from paddle_tpu.vision.ops import ConvNormActivation
+        import paddle_tpu.nn as nn
+        blk = ConvNormActivation(3, 4, norm_layer=None,
+                                 activation_layer=None)
+        subs = list(blk.children())
+        assert len(subs) == 1  # conv only
+        assert subs[0].bias is not None  # no norm → biased conv
+        blk2 = ConvNormActivation(3, 4)
+        kinds = [type(m).__name__.lower() for m in blk2.children()]
+        assert kinds == ["conv2d", "batchnorm2d", "relu"]
+
+
+class TestLeNetBook:
+    """Reference book-test pattern: train a few iters, assert the loss
+    drops and accuracy beats chance (test/book/test_recognize_digits.py)."""
+
+    def test_lenet_mnist_fit(self, tmp_path):
+        import gzip
+        import struct
+
+        from paddle_tpu.hapi import Model
+        from paddle_tpu.metric import Accuracy
+        from paddle_tpu.vision.datasets import MNIST
+
+        # synthetic MNIST whose label is recoverable from the image: digit
+        # k gets a bright kxk top-left block plus noise
+        rng = np.random.RandomState(0)
+        n = 256
+        lbls = rng.randint(0, 10, (n,)).astype(np.uint8)
+        imgs = (rng.rand(n, 28, 28) * 40).astype(np.uint8)
+        for i, k in enumerate(lbls):
+            imgs[i, :k + 2, :k + 2] = 250
+        ip = str(tmp_path / "img.gz")
+        lp = str(tmp_path / "lbl.gz")
+        with gzip.open(ip, "wb") as f:
+            f.write(struct.pack(">IIII", 2051, n, 28, 28))
+            f.write(imgs.tobytes())
+        with gzip.open(lp, "wb") as f:
+            f.write(struct.pack(">II", 2049, n))
+            f.write(lbls.tobytes())
+
+        def normalize(x):
+            return ((x / 255.0) - 0.5).astype(np.float32).transpose(2, 0, 1)
+
+        ds = MNIST(image_path=ip, label_path=lp, transform=normalize)
+        paddle.seed(1)
+        net = M.LeNet()
+        model = Model(net)
+        opt = paddle.optimizer.Adam(learning_rate=2e-3,
+                                    parameters=net.parameters())
+        model.prepare(opt, paddle.nn.CrossEntropyLoss(), Accuracy())
+        h0 = model.evaluate(ds, batch_size=64, verbose=0)
+        model.fit(ds, epochs=4, batch_size=64, verbose=0)
+        h1 = model.evaluate(ds, batch_size=64, verbose=0)
+        assert h1["loss"] < h0["loss"]
+        assert h1["acc"] > 0.3  # well above 0.1 chance

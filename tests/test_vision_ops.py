@@ -169,6 +169,20 @@ class TestRoIPool:
 
 
 class TestPSRoIPool:
+    def test_matches_naive(self):
+        # fractional box whose rounded bin ends extend past the raw
+        # extent (regression: window must cover the rounded bounds)
+        rng = np.random.RandomState(13)
+        ph = pw = 1
+        feat = rng.randn(1, ph * pw, 8, 8).astype(np.float32)
+        boxes = np.array([[2.5, 2.5, 4.5, 4.5]], np.float32)
+        bn = np.array([1], np.int32)
+        got = V.psroi_pool(paddle.to_tensor(feat), paddle.to_tensor(boxes),
+                           paddle.to_tensor(bn), 1, 1.0).numpy()
+        # reference math: y1=round(2.5)=2, y2=round(5.5)=6 → rows 2..5
+        want = feat[0, 0, 2:6, 2:6].mean()
+        np.testing.assert_allclose(got[0, 0, 0, 0], want, rtol=1e-5)
+
     def test_shape_and_range(self):
         rng = np.random.RandomState(3)
         ph = pw = 2
