@@ -51,6 +51,7 @@ from .device import (  # noqa: F401,E402
 from .distributed.parallel import DataParallel  # noqa: E402  (paddle.DataParallel parity)
 from . import metric  # noqa: E402
 from . import vision  # noqa: E402
+from . import quantization  # noqa: E402
 from . import models  # noqa: E402
 from . import hapi  # noqa: E402
 from . import profiler  # noqa: E402

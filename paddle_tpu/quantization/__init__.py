@@ -1,0 +1,21 @@
+"""paddle.quantization parity (SURVEY.md §2.8 quantization row;
+reference: python/paddle/quantization/). QAT inserts differentiable
+fake-quant (STE) into Linear/Conv layers; PTQ calibrates observers and
+freezes scales for the inference path.
+"""
+from .base import (BaseObserver, BaseQuanter, ObserveWrapper,
+                   fake_quant_dequant)
+from .config import QuantConfig, SingleLayerConfig
+from .factory import ObserverFactory, QuanterFactory
+from .qat import QAT
+from .ptq import PTQ
+from . import observers
+from . import quanters
+from .quanted_layers import QuantedConv2D, QuantedLinear
+
+__all__ = [
+    "QuantConfig", "SingleLayerConfig", "BaseObserver", "BaseQuanter",
+    "ObserveWrapper", "ObserverFactory", "QuanterFactory", "QAT", "PTQ",
+    "observers", "quanters", "QuantedConv2D", "QuantedLinear",
+    "fake_quant_dequant",
+]

@@ -1,0 +1,106 @@
+"""Quantization core: fake-quant op with straight-through estimator,
+BaseObserver/BaseQuanter, ObserveWrapper.
+
+Parity: python/paddle/quantization/{base_observer.py, base_quanter.py,
+wrapper.py}. The reference implements fake-quant as CUDA kernels
+(fake_quantize_op); here it is a jnp composition whose gradient is the
+straight-through estimator expressed as `x + stop_gradient(qdq(x) - x)` —
+no custom VJP needed, and XLA folds the whole thing into the surrounding
+matmul's prologue.
+"""
+from __future__ import annotations
+
+import abc
+
+import jax
+import jax.numpy as jnp
+
+import numpy as np
+
+from ..autograd.tape import apply
+from ..nn.layer_base import Layer
+
+__all__ = ["BaseObserver", "BaseQuanter", "ObserveWrapper",
+           "fake_quant_dequant"]
+
+
+def _qdq_value(x, scale, bit_length, channel_axis=None):
+    """Quantize-dequantize: round(x / scale * bound) clipped, back-scaled."""
+    bound = float(2 ** (bit_length - 1) - 1)
+    s = jnp.maximum(scale, 1e-9)
+    if channel_axis is not None:
+        shape = [1] * x.ndim
+        shape[channel_axis] = -1
+        s = s.reshape(shape)
+    q = jnp.clip(jnp.round(x / s * bound), -bound, bound)
+    return q / bound * s
+
+
+def fake_quant_dequant(x, scale, bit_length=8, channel_axis=None):
+    """Differentiable fake quantization (STE gradient = identity within
+    the clip range semantics collapse to plain identity, the standard
+    QAT choice; reference: fake_quantize_dequantize kernels)."""
+
+    def f(xv, sv):
+        qdq = _qdq_value(xv, sv, bit_length, channel_axis)
+        return xv + jax.lax.stop_gradient(qdq - xv)
+
+    return apply(f, x, scale, _op_name="fake_quant_dequant")
+
+
+class BaseObserver(Layer, metaclass=abc.ABCMeta):
+    """Parity: quantization/base_observer.py — a Layer that watches
+    tensors flowing through it and accumulates calibration statistics."""
+
+    def __init__(self):
+        super().__init__()
+
+    @abc.abstractmethod
+    def forward(self, x):
+        ...
+
+    @abc.abstractmethod
+    def scales(self):
+        ...
+
+    @abc.abstractmethod
+    def zero_points(self):
+        ...
+
+    def bit_length(self):
+        return 8
+
+    def quant_axis(self):
+        return -1
+
+
+class BaseQuanter(BaseObserver, metaclass=abc.ABCMeta):
+    """Parity: quantization/base_quanter.py — an observer that also
+    fake-quantizes what it observes (QAT)."""
+
+
+class ObserveWrapper(Layer):
+    """Parity: quantization/wrapper.py:20 — pairs an observer/quanter
+    with an observed layer."""
+
+    def __init__(self, observer, observed, observe_input=True):
+        super().__init__()
+        self._observer = observer
+        self._observed = observed
+        self._observe_input = observe_input
+
+    def forward(self, *inputs, **kwargs):
+        if self._observe_input:
+            out = self._observer(*inputs, **kwargs)
+            return self._observed(out, **kwargs)
+        out = self._observed(*inputs, **kwargs)
+        return self._observer(out, **kwargs)
+
+
+def abs_max_scale(x, channel_axis=None):
+    """Host-side absmax over all axes except channel_axis."""
+    arr = np.asarray(x.value if hasattr(x, "value") else x)
+    if channel_axis is None:
+        return float(np.max(np.abs(arr), initial=1e-9))
+    axes = tuple(i for i in range(arr.ndim) if i != channel_axis)
+    return np.maximum(np.abs(arr).max(axis=axes), 1e-9)

@@ -1,0 +1,96 @@
+"""QuantConfig. Parity: python/paddle/quantization/config.py:60 — maps
+layers to (activation, weight) quanter factories via global, per-type,
+per-name and per-instance rules, plus the QAT layer mapping."""
+from __future__ import annotations
+
+from ..nn.layer_base import Layer
+
+__all__ = ["QuantConfig", "SingleLayerConfig"]
+
+
+class SingleLayerConfig:
+    def __init__(self, activation, weight):
+        self.activation = activation
+        self.weight = weight
+
+    def __repr__(self):
+        return f"activation: {self.activation}\nweight: {self.weight}"
+
+
+class QuantConfig:
+    def __init__(self, activation=None, weight=None):
+        self._global = SingleLayerConfig(activation, weight)
+        self._layer_configs = []      # (layer_instance, cfg)
+        self._name_configs = []       # (full_name, cfg)
+        self._type_configs = []       # (type, cfg)
+        self._qat_layer_mapping = dict(_default_qat_mapping())
+        self._customized_leaves = []
+
+    # ---- rule registration (reference config.py add_* methods) ----
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        cfg = SingleLayerConfig(activation, weight)
+        for l in layers:
+            self._layer_configs.append((l, cfg))
+
+    def add_name_config(self, layer_name, activation=None, weight=None):
+        names = (layer_name if isinstance(layer_name, (list, tuple))
+                 else [layer_name])
+        cfg = SingleLayerConfig(activation, weight)
+        for n in names:
+            self._name_configs.append((n, cfg))
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = (layer_type if isinstance(layer_type, (list, tuple))
+                 else [layer_type])
+        cfg = SingleLayerConfig(activation, weight)
+        for t in types:
+            self._type_configs.append((t, cfg))
+
+    def add_qat_layer_mapping(self, source, target):
+        self._qat_layer_mapping[source] = target
+
+    def add_customized_leaf(self, layer_type):
+        self._customized_leaves.append(layer_type)
+
+    @property
+    def qat_layer_mappings(self):
+        return self._qat_layer_mapping
+
+    @property
+    def default_qat_layer_mapping(self):
+        return dict(_default_qat_mapping())
+
+    # ---- resolution ----
+    def _get_config_by_layer(self, layer, full_name=""):
+        for inst, cfg in self._layer_configs:
+            if layer is inst:
+                return cfg
+        for name, cfg in self._name_configs:
+            if full_name == name:
+                return cfg
+        for t, cfg in self._type_configs:
+            if type(layer) is t:
+                return cfg
+        if type(layer) in self._qat_layer_mapping and (
+                self._global.activation or self._global.weight):
+            return self._global
+        return None
+
+    def _is_quantifiable(self, layer):
+        return type(layer) in self._qat_layer_mapping
+
+    def _instance(self, factory, layer=None):
+        if factory is None:
+            return None
+        if hasattr(factory, "instance"):
+            return factory.instance(layer)
+        if isinstance(factory, type) and issubclass(factory, Layer):
+            return factory()
+        return factory
+
+
+def _default_qat_mapping():
+    from .. import nn
+    from .quanted_layers import (QuantedConv2D, QuantedLinear)
+    return {nn.Linear: QuantedLinear, nn.Conv2D: QuantedConv2D}

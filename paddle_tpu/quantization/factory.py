@@ -1,0 +1,19 @@
+"""Quanter/observer factories. Parity: python/paddle/quantization/
+factory.py (QuanterFactory binds constructor args so QuantConfig can
+instantiate one per layer)."""
+from __future__ import annotations
+
+__all__ = ["QuanterFactory", "ObserverFactory"]
+
+
+class ObserverFactory:
+    def __init__(self, cls, **kwargs):
+        self._cls = cls
+        self._kwargs = kwargs
+
+    def instance(self, layer=None):
+        return self._cls(layer, **self._kwargs)
+
+
+class QuanterFactory(ObserverFactory):
+    pass

@@ -1,0 +1,117 @@
+"""Observers. Parity: python/paddle/quantization/observers/abs_max.py
+(AbsmaxObserver) plus the imperative PTQ observer set (KL/hist live in
+python/paddle/quantization/imperative/ptq_quantizer.py): absmax,
+moving-average absmax, percentile/histogram.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .base import BaseObserver
+from .factory import ObserverFactory
+
+__all__ = ["AbsmaxObserver", "MovingAverageAbsmaxObserver",
+           "HistObserver", "AbsmaxObserverLayer"]
+
+
+class AbsmaxObserverLayer(BaseObserver):
+    """Running max(|x|) over every batch seen (reference
+    observers/abs_max.py AbsmaxObserverLayer)."""
+
+    def __init__(self, layer=None, quant_bits=8):
+        super().__init__()
+        self._quant_bits = quant_bits
+        self._max = 1e-9
+
+    def forward(self, x):
+        self._max = max(self._max,
+                        float(jnp.max(jnp.abs(x.value
+                                              if isinstance(x, Tensor)
+                                              else x))))
+        return x
+
+    def scales(self):
+        return self._max
+
+    def zero_points(self):
+        return 0
+
+    def bit_length(self):
+        return self._quant_bits
+
+
+class MovingAverageAbsmaxObserverLayer(BaseObserver):
+    """EMA of per-batch absmax (imperative/ptq_quantizer.py
+    AbsmaxQuantizer variants)."""
+
+    def __init__(self, layer=None, moving_rate=0.9, quant_bits=8):
+        super().__init__()
+        self._rate = moving_rate
+        self._quant_bits = quant_bits
+        self._state = None
+
+    def forward(self, x):
+        cur = float(jnp.max(jnp.abs(x.value if isinstance(x, Tensor)
+                                    else x)))
+        self._state = cur if self._state is None else (
+            self._rate * self._state + (1 - self._rate) * cur)
+        return x
+
+    def scales(self):
+        return self._state or 1e-9
+
+    def zero_points(self):
+        return 0
+
+    def bit_length(self):
+        return self._quant_bits
+
+
+class HistObserverLayer(BaseObserver):
+    """Histogram/percentile observer: scale at the given percentile of
+    |x| (imperative HistQuantizer)."""
+
+    def __init__(self, layer=None, percent=0.999, bins=2048, quant_bits=8):
+        super().__init__()
+        self._percent = percent
+        self._bins = bins
+        self._quant_bits = quant_bits
+        self._samples = []
+
+    def forward(self, x):
+        arr = np.abs(np.asarray(x.value if isinstance(x, Tensor) else x))
+        # store a bounded histogram instead of raw samples
+        self._samples.append(arr.ravel())
+        if len(self._samples) > 64:
+            self._samples = [np.concatenate(self._samples)]
+        return x
+
+    def scales(self):
+        if not self._samples:
+            return 1e-9
+        allv = np.concatenate(self._samples)
+        return float(max(np.quantile(allv, self._percent), 1e-9))
+
+    def zero_points(self):
+        return 0
+
+    def bit_length(self):
+        return self._quant_bits
+
+
+def AbsmaxObserver(quant_bits=8):
+    """Factory, reference observers/abs_max.py AbsmaxObserver."""
+    return ObserverFactory(AbsmaxObserverLayer, quant_bits=quant_bits)
+
+
+def MovingAverageAbsmaxObserver(moving_rate=0.9, quant_bits=8):
+    return ObserverFactory(MovingAverageAbsmaxObserverLayer,
+                           moving_rate=moving_rate, quant_bits=quant_bits)
+
+
+def HistObserver(percent=0.999, bins=2048, quant_bits=8):
+    return ObserverFactory(HistObserverLayer, percent=percent, bins=bins,
+                           quant_bits=quant_bits)

@@ -1,0 +1,89 @@
+"""PTQ. Parity: python/paddle/quantization/ptq.py:24 — wrap quantifiable
+layers with input observers, run calibration batches, then convert:
+freeze observed scales into static fake-quant on weights+activations and
+export a scales dict the inference predictor can consume."""
+from __future__ import annotations
+
+import copy
+
+import numpy as np
+
+from ..nn.layer_base import Layer
+from .base import ObserveWrapper, abs_max_scale, fake_quant_dequant
+from .config import QuantConfig
+from .qat import Quantization
+
+__all__ = ["PTQ"]
+
+
+class _StaticQDQ(Layer):
+    """Frozen activation fake-quant inserted by PTQ.convert."""
+
+    def __init__(self, scale, bits=8):
+        super().__init__()
+        self._scale = float(scale)
+        self._bits = bits
+
+    def forward(self, x):
+        import jax.numpy as jnp
+        return fake_quant_dequant(
+            x, jnp.asarray(self._scale), bit_length=self._bits)
+
+    def extra_repr(self):
+        return f"scale={self._scale:.6g}, bits={self._bits}"
+
+
+class PTQ(Quantization):
+    def __init__(self, config: QuantConfig):
+        super().__init__(config)
+
+    def quantize(self, model: Layer, inplace=False):
+        _model = model if inplace else copy.deepcopy(model)
+        _model.eval()
+        self._insert_observers(_model, prefix="")
+        return _model
+
+    def _insert_observers(self, layer, prefix):
+        cfg = self._config
+        for name, child in list(layer._sub_layers.items()):
+            full = f"{prefix}{name}"
+            lc = cfg._get_config_by_layer(child, full)
+            if lc is not None and cfg._is_quantifiable(child) \
+                    and lc.activation is not None:
+                obs = cfg._instance(lc.activation, child)
+                layer._sub_layers[name] = ObserveWrapper(obs, child,
+                                                         observe_input=True)
+            else:
+                self._insert_observers(child, prefix=f"{full}.")
+
+    def convert(self, model: Layer, inplace=False):
+        """Replace each ObserveWrapper with [static qdq → layer] whose
+        scale is the observer's calibration result; weights get absmax
+        fake-quant applied in place. Returns (model, scales_dict)."""
+        _model = model if inplace else copy.deepcopy(model)
+        scales = {}
+        self._freeze(_model, prefix="", scales=scales)
+        return _model, scales
+
+    def _freeze(self, layer, prefix, scales):
+        from .. import nn
+        for name, child in list(layer._sub_layers.items()):
+            full = f"{prefix}{name}"
+            if isinstance(child, ObserveWrapper):
+                obs = child._observer
+                observed = child._observed
+                act_scale = float(np.max(obs.scales()))
+                scales[f"{full}.activation"] = act_scale
+                w = getattr(observed, "weight", None)
+                if w is not None:
+                    w_scale = abs_max_scale(w)
+                    scales[f"{full}.weight"] = w_scale
+                    import jax.numpy as jnp
+                    with_no_grad = fake_quant_dequant(
+                        w, jnp.asarray(w_scale, w.value.dtype),
+                        bit_length=obs.bit_length())
+                    w.value = with_no_grad.value
+                layer._sub_layers[name] = nn.Sequential(
+                    _StaticQDQ(act_scale, obs.bit_length()), observed)
+            else:
+                self._freeze(child, prefix=f"{full}.", scales=scales)

@@ -1,0 +1,65 @@
+"""QAT. Parity: python/paddle/quantization/qat.py:23 — walk the model,
+swap quantifiable layers for Quanted* twins (weight fake-quant) and hang
+activation quanters in front of them."""
+from __future__ import annotations
+
+import copy
+
+from ..nn.layer_base import Layer
+from .base import ObserveWrapper
+from .config import QuantConfig, SingleLayerConfig
+
+__all__ = ["QAT"]
+
+
+class Quantization:
+    """Parity: quantization/quantize.py Quantization base."""
+
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def convert(self, model, inplace=False):
+        """Strip observers/quanters, leaving plain layers whose weights
+        carry the trained values (scales retrievable via ptq/qat state)."""
+        _model = model if inplace else copy.deepcopy(model)
+        _strip(_model)
+        return _model
+
+
+def _strip(layer: Layer):
+    for name, child in list(layer._sub_layers.items()):
+        if isinstance(child, ObserveWrapper):
+            layer._sub_layers[name] = child._observed
+            child = child._observed
+        src = getattr(child, "_source", None)
+        if src is not None:
+            layer._sub_layers[name] = src
+            child = src
+        _strip(child)
+
+
+class QAT(Quantization):
+    def __init__(self, config: QuantConfig):
+        super().__init__(config)
+
+    def quantize(self, model: Layer, inplace=False):
+        assert model.training, (
+            "Quantization-Aware Training should work on training models. "
+            "Please set training mode by model.train().")
+        _model = model if inplace else copy.deepcopy(model)
+        self._convert(_model, prefix="")
+        return _model
+
+    def _convert(self, layer: Layer, prefix):
+        cfg = self._config
+        for name, child in list(layer._sub_layers.items()):
+            full = f"{prefix}{name}"
+            lc = cfg._get_config_by_layer(child, full)
+            if lc is not None and cfg._is_quantifiable(child):
+                target = cfg.qat_layer_mappings[type(child)]
+                resolved = SingleLayerConfig(
+                    cfg._instance(lc.activation, child),
+                    cfg._instance(lc.weight, child))
+                layer._sub_layers[name] = target(child, resolved)
+            else:
+                self._convert(child, prefix=f"{full}.")

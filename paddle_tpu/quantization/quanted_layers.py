@@ -1,0 +1,55 @@
+"""QAT layer replacements. Parity role: python/paddle/nn/quant/qat/
+(QuantedLinear / QuantedConv2D built by QAT._convert_to_quant_layers).
+Each keeps the ORIGINAL Parameter objects (training state, optimizer
+slots and sharding metadata stay valid) and fake-quantizes weight and
+input on the fly — XLA fuses the qdq into the matmul/conv prologue.
+"""
+from __future__ import annotations
+
+import paddle_tpu.nn.functional as F
+
+from ..nn.layer_base import Layer
+
+__all__ = ["QuantedLinear", "QuantedConv2D"]
+
+
+class _QuantedBase(Layer):
+    def __init__(self, source, q_config):
+        super().__init__()
+        self._source = source
+        self.weight = source.weight
+        self.bias = getattr(source, "bias", None)
+        self.weight_quanter = None
+        self.activation_quanter = None
+        if q_config.weight is not None:
+            self.weight_quanter = q_config.weight \
+                if isinstance(q_config.weight, Layer) else None
+        if q_config.activation is not None:
+            self.activation_quanter = q_config.activation \
+                if isinstance(q_config.activation, Layer) else None
+
+    def _q(self, x, quanter):
+        return x if quanter is None else quanter(x)
+
+
+class QuantedLinear(_QuantedBase):
+    def forward(self, x):
+        x = self._q(x, self.activation_quanter)
+        w = self._q(self.weight, self.weight_quanter)
+        return F.linear(x, w, self.bias)
+
+
+class QuantedConv2D(_QuantedBase):
+    def __init__(self, source, q_config):
+        super().__init__(source, q_config)
+        self._stride = source.stride
+        self._padding = source.padding
+        self._dilation = source.dilation
+        self._groups = source.groups
+        self._data_format = getattr(source, "data_format", "NCHW") or "NCHW"
+
+    def forward(self, x):
+        x = self._q(x, self.activation_quanter)
+        w = self._q(self.weight, self.weight_quanter)
+        return F.conv2d(x, w, self.bias, self._stride, self._padding,
+                        self._dilation, self._groups, self._data_format)

@@ -1,0 +1,85 @@
+"""Quanters (fake-quant layers for QAT). Parity: python/paddle/
+quantization/quanters/abs_max.py (FakeQuanterWithAbsMaxObserver: EMA
+absmax state + fake quant-dequant with STE gradient).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .base import BaseQuanter, fake_quant_dequant
+from .factory import QuanterFactory
+
+__all__ = ["FakeQuanterWithAbsMaxObserver",
+           "FakeQuanterWithAbsMaxObserverLayer",
+           "FakeQuanterChannelWiseAbsMaxObserver"]
+
+
+class FakeQuanterWithAbsMaxObserverLayer(BaseQuanter):
+    def __init__(self, layer=None, moving_rate=0.9, bit_length=8,
+                 dtype="float32"):
+        super().__init__()
+        self._rate = moving_rate
+        self._bits = bit_length
+        self._scale = None
+
+    def forward(self, x):
+        v = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+        cur = float(jnp.max(jnp.abs(v)))
+        if self.training:
+            self._scale = cur if self._scale is None else (
+                self._rate * self._scale + (1 - self._rate) * cur)
+        scale = self._scale if self._scale is not None else max(cur, 1e-9)
+        return fake_quant_dequant(x, jnp.asarray(scale, v.dtype),
+                                  bit_length=self._bits)
+
+    def scales(self):
+        return self._scale or 1e-9
+
+    def zero_points(self):
+        return 0
+
+    def bit_length(self):
+        return self._bits
+
+
+class FakeQuanterChannelWiseAbsMaxObserverLayer(BaseQuanter):
+    """Per-channel weight quanter (reference quant_axis 0 for conv
+    weights / 1 for row-major linear weights)."""
+
+    def __init__(self, layer=None, bit_length=8, quant_axis=0,
+                 dtype="float32"):
+        super().__init__()
+        self._bits = bit_length
+        self._axis = quant_axis
+        self._scale = None
+
+    def forward(self, x):
+        v = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+        axes = tuple(i for i in range(v.ndim) if i != self._axis)
+        cur = jnp.maximum(jnp.max(jnp.abs(v), axis=axes), 1e-9)
+        self._scale = cur
+        return fake_quant_dequant(x, cur, bit_length=self._bits,
+                                  channel_axis=self._axis)
+
+    def scales(self):
+        return self._scale
+
+    def zero_points(self):
+        return 0
+
+    def bit_length(self):
+        return self._bits
+
+    def quant_axis(self):
+        return self._axis
+
+
+def FakeQuanterWithAbsMaxObserver(moving_rate=0.9, bit_length=8, **kw):
+    return QuanterFactory(FakeQuanterWithAbsMaxObserverLayer,
+                          moving_rate=moving_rate, bit_length=bit_length)
+
+
+def FakeQuanterChannelWiseAbsMaxObserver(bit_length=8, quant_axis=0, **kw):
+    return QuanterFactory(FakeQuanterChannelWiseAbsMaxObserverLayer,
+                          bit_length=bit_length, quant_axis=quant_axis)

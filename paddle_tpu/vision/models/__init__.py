@@ -1,5 +1,5 @@
 """Model zoo. Parity: python/paddle/vision/models/__init__.py — same
-13 families / 52 exported symbols."""
+13 families / 51 exported symbols."""
 from .resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,
                      resnet152, wide_resnet50_2, wide_resnet101_2,
                      resnext50_32x4d, resnext50_64x4d, resnext101_32x4d,

@@ -131,58 +131,71 @@ class ResNet(Layer):
 
 
 def resnet18(pretrained=False, **kwargs):
+    assert not pretrained, "pretrained weights unavailable (no egress)"
     return ResNet(BasicBlock, 18, **kwargs)
 
 
 def resnet34(pretrained=False, **kwargs):
+    assert not pretrained, "pretrained weights unavailable (no egress)"
     return ResNet(BasicBlock, 34, **kwargs)
 
 
 def resnet50(pretrained=False, **kwargs):
+    assert not pretrained, "pretrained weights unavailable (no egress)"
     return ResNet(BottleneckBlock, 50, **kwargs)
 
 
 def resnet101(pretrained=False, **kwargs):
+    assert not pretrained, "pretrained weights unavailable (no egress)"
     return ResNet(BottleneckBlock, 101, **kwargs)
 
 
 def resnet152(pretrained=False, **kwargs):
+    assert not pretrained, "pretrained weights unavailable (no egress)"
     return ResNet(BottleneckBlock, 152, **kwargs)
 
 
 def wide_resnet50_2(pretrained=False, **kwargs):
+    assert not pretrained, "pretrained weights unavailable (no egress)"
     return ResNet(BottleneckBlock, 50, width_per_group=128, **kwargs)
 
 
 def resnext50_32x4d(pretrained=False, **kwargs):
+    assert not pretrained, "pretrained weights unavailable (no egress)"
     return ResNet(BottleneckBlock, 50, groups=32, width_per_group=4,
                   **kwargs)
 
 
 def resnext50_64x4d(pretrained=False, **kwargs):
+    assert not pretrained, "pretrained weights unavailable (no egress)"
     return ResNet(BottleneckBlock, 50, groups=64, width_per_group=4,
                   **kwargs)
 
 
 def resnext101_32x4d(pretrained=False, **kwargs):
+    assert not pretrained, "pretrained weights unavailable (no egress)"
     return ResNet(BottleneckBlock, 101, groups=32, width_per_group=4,
                   **kwargs)
 
 
 def resnext101_64x4d(pretrained=False, **kwargs):
+    assert not pretrained, "pretrained weights unavailable (no egress)"
     return ResNet(BottleneckBlock, 101, groups=64, width_per_group=4,
                   **kwargs)
 
 
 def resnext152_32x4d(pretrained=False, **kwargs):
+    assert not pretrained, "pretrained weights unavailable (no egress)"
     return ResNet(BottleneckBlock, 152, groups=32, width_per_group=4,
                   **kwargs)
 
 
 def resnext152_64x4d(pretrained=False, **kwargs):
+    assert not pretrained, "pretrained weights unavailable (no egress)"
     return ResNet(BottleneckBlock, 152, groups=64, width_per_group=4,
                   **kwargs)
 
 
 def wide_resnet101_2(pretrained=False, **kwargs):
+    assert not pretrained, "pretrained weights unavailable (no egress)"
     return ResNet(BottleneckBlock, 101, width_per_group=128, **kwargs)

@@ -73,6 +73,11 @@ class ShuffleNetV2(nn.Layer):
         super().__init__()
         self.num_classes = num_classes
         self.with_pool = with_pool
+        supported = (0.25, 0.33, 0.5, 1.0, 1.5, 2.0)
+        if scale not in supported:
+            raise NotImplementedError(
+                f"scale {scale} is not supported; choose one of "
+                f"{supported}")
         channels = {
             0.25: (24, 24, 48, 96, 512), 0.33: (24, 32, 64, 128, 512),
             0.5: (24, 48, 96, 192, 1024), 1.0: (24, 116, 232, 464, 1024),

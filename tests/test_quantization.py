@@ -1,0 +1,162 @@
+"""Quantization framework: QAT fake-quant training + PTQ calibration
+(reference test pattern: test/quantization/test_quant.py family)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.quantization import (PTQ, QAT, QuantConfig,
+                                     fake_quant_dequant)
+from paddle_tpu.quantization.observers import AbsmaxObserver
+from paddle_tpu.quantization.quanters import (
+    FakeQuanterChannelWiseAbsMaxObserver, FakeQuanterWithAbsMaxObserver)
+
+
+def _mlp():
+    paddle.seed(0)
+    return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+
+
+def _lenet():
+    from paddle_tpu.vision.models import LeNet
+    paddle.seed(0)
+    return LeNet()
+
+
+class TestFakeQuant:
+    def test_qdq_rounds_to_grid(self):
+        x = paddle.to_tensor(np.linspace(-1, 1, 11).astype(np.float32))
+        scale = paddle.to_tensor(np.float32(1.0))
+        out = fake_quant_dequant(x, scale, bit_length=8).numpy()
+        # every output is k/127 for integer k
+        k = out * 127
+        np.testing.assert_allclose(k, np.round(k), atol=1e-4)
+
+    def test_ste_gradient_identity(self):
+        x = paddle.to_tensor(np.array([0.3, -0.7, 0.9], np.float32))
+        x.stop_gradient = False
+        out = fake_quant_dequant(
+            x, paddle.to_tensor(np.float32(1.0)))
+        out.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), 1.0, atol=1e-6)
+
+    def test_channelwise(self):
+        rng = np.random.RandomState(0)
+        w = paddle.to_tensor(rng.randn(4, 3).astype(np.float32) *
+                             np.array([[1], [10], [100], [1000]],
+                                      np.float32))
+        scales = paddle.to_tensor(
+            np.abs(w.numpy()).max(1).astype(np.float32))
+        out = fake_quant_dequant(w, scales, channel_axis=0).numpy()
+        # each row's error bounded by its own scale / 254
+        err = np.abs(out - w.numpy()).max(1)
+        assert (err <= scales.numpy() / 254 + 1e-6).all()
+
+
+class TestQAT:
+    def test_quantize_replaces_layers(self):
+        q = QuantConfig(
+            activation=FakeQuanterWithAbsMaxObserver(moving_rate=0.9),
+            weight=FakeQuanterWithAbsMaxObserver(moving_rate=0.9))
+        model = _mlp()
+        qat = QAT(q)
+        qmodel = qat.quantize(model)
+        names = [type(m).__name__ for m in qmodel.children()]
+        assert names.count("QuantedLinear") == 2
+        # original model untouched (inplace=False)
+        assert [type(m).__name__ for m in model.children()].count(
+            "Linear") == 2
+
+    def test_qat_trains_close_to_fp32(self):
+        rng = np.random.RandomState(1)
+        X = rng.randn(128, 8).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.int64)
+        xs, ys = paddle.to_tensor(X), paddle.to_tensor(y)
+        loss_fn = nn.CrossEntropyLoss()
+
+        def train(model):
+            opt = paddle.optimizer.Adam(learning_rate=5e-3,
+                                        parameters=model.parameters())
+            for _ in range(40):
+                loss = loss_fn(model(xs), ys)
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+            return float(loss)
+
+        fp32 = _mlp()
+        l32 = train(fp32)
+        q = QuantConfig(
+            activation=FakeQuanterWithAbsMaxObserver(),
+            weight=FakeQuanterChannelWiseAbsMaxObserver(quant_axis=1))
+        qmodel = QAT(q).quantize(_mlp())
+        lq = train(qmodel)
+        assert lq < l32 + 0.1, (l32, lq)
+
+    def test_qat_lenet_forward_and_convert(self):
+        q = QuantConfig(activation=FakeQuanterWithAbsMaxObserver(),
+                        weight=FakeQuanterWithAbsMaxObserver())
+        qat = QAT(q)
+        net = _lenet()
+        qnet = qat.quantize(net)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 1, 28, 28).astype(
+                np.float32))
+        out_q = qnet(x)
+        assert out_q.shape == [2, 10]
+        # convert strips quanters back to plain layers
+        plain = qat.convert(qnet)
+        out_p = plain(x)
+        assert out_p.shape == [2, 10]
+        kinds = [type(m).__name__ for m in plain.features.children()]
+        assert "QuantedConv2D" not in kinds
+
+    def test_qat_requires_training_mode(self):
+        import pytest
+        q = QuantConfig(activation=FakeQuanterWithAbsMaxObserver(),
+                        weight=FakeQuanterWithAbsMaxObserver())
+        model = _mlp()
+        model.eval()
+        with pytest.raises(AssertionError):
+            QAT(q).quantize(model)
+
+
+class TestPTQ:
+    def test_calibrate_and_convert(self):
+        rng = np.random.RandomState(2)
+        model = _mlp()
+        model.eval()
+        x = paddle.to_tensor(rng.randn(64, 8).astype(np.float32))
+        ref = model(x).numpy()
+
+        q = QuantConfig(activation=AbsmaxObserver(), weight=None)
+        ptq = PTQ(q)
+        cal = ptq.quantize(model)
+        for _ in range(4):
+            cal(x)
+        conv, scales = ptq.convert(cal)
+        # scales exported for both linears (activation + weight)
+        act_keys = [k for k in scales if k.endswith("activation")]
+        w_keys = [k for k in scales if k.endswith("weight")]
+        assert len(act_keys) == 2 and len(w_keys) == 2
+        assert scales[act_keys[0]] > 0
+        out = conv(x).numpy()
+        # int8 quantization error is small relative to output range
+        denom = np.abs(ref).max()
+        assert np.abs(out - ref).max() / denom < 0.1
+
+    def test_observer_sees_running_max(self):
+        q = QuantConfig(activation=AbsmaxObserver(), weight=None)
+        ptq = PTQ(q)
+        model = _mlp()
+        model.eval()
+        cal = ptq.quantize(model)
+        a = np.zeros((4, 8), np.float32)
+        a[0, 0] = 3.0
+        cal(paddle.to_tensor(a))
+        b = np.zeros((4, 8), np.float32)
+        b[0, 0] = 7.0
+        cal(paddle.to_tensor(b))
+        _, scales = ptq.convert(cal)
+        first_act = [v for k, v in scales.items()
+                     if k.endswith("activation")][0]
+        np.testing.assert_allclose(first_act, 7.0, rtol=1e-5)
