@@ -30,7 +30,12 @@ from .topology import (CommunicateTopology, HybridCommunicateGroup,
                        get_hybrid_communicate_group,
                        set_hybrid_communicate_group)
 
+from . import auto_parallel  # noqa: E402
+from .auto_parallel import (ProcessMesh, shard_tensor,  # noqa: E402
+                            shard_op, Engine)
+
 __all__ = [
+    "auto_parallel", "ProcessMesh", "shard_tensor", "shard_op", "Engine",
     "init_parallel_env", "is_initialized", "get_rank", "get_world_size",
     "ParallelEnv", "DataParallel", "shard_batch",
     "Mesh", "PartitionSpec", "init_mesh", "get_mesh", "set_mesh",

@@ -1,0 +1,13 @@
+"""Semi-auto parallel user API. Parity: python/paddle/distributed/
+auto_parallel/ — ProcessMesh + shard_tensor annotations + Engine facade.
+The reference's completion/partitioner/resharder pipeline is subsumed by
+GSPMD (SURVEY.md §2.6 auto-parallel row)."""
+from .process_mesh import (ProcessMesh, get_current_process_mesh,
+                           set_current_process_mesh,
+                           reset_current_process_mesh)
+from .interface import shard_tensor, shard_op
+from .engine import Engine
+
+__all__ = ["ProcessMesh", "shard_tensor", "shard_op", "Engine",
+           "get_current_process_mesh", "set_current_process_mesh",
+           "reset_current_process_mesh"]

@@ -1,0 +1,210 @@
+"""Engine — the semi-auto parallel training facade.
+
+Parity: python/paddle/distributed/auto_parallel/engine.py:55
+(Engine(model, loss, optimizer, metrics, cluster, strategy) with
+prepare/fit/evaluate/predict). In the reference, Engine drives the
+completion→partition→reshard static-graph pipeline; here it drives
+ParallelTrainStep: ProcessMesh + shard_tensor annotations give every
+parameter its layout, one jitted SPMD program per mode does the rest.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ...core.tensor import Tensor
+from ..parallel_step import ParallelTrainStep
+from .process_mesh import ProcessMesh, get_current_process_mesh
+
+__all__ = ["Engine"]
+
+
+class Engine:
+    def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
+                 cluster=None, strategy=None, process_mesh=None):
+        self._model = model
+        self._loss = loss
+        self._optimizer = optimizer
+        ms = metrics or []
+        self._metrics = ms if isinstance(ms, (list, tuple)) else [ms]
+        self._strategy = strategy
+        self._process_mesh = process_mesh
+        self._train_step = None
+        self._eval_forward = None
+        self._n_inputs = 1
+        self._history = None
+
+    # ------------------------------------------------------------------
+    def _mesh(self):
+        pm = self._process_mesh or get_current_process_mesh()
+        if pm is not None:
+            return pm.to_jax_mesh() if isinstance(pm, ProcessMesh) else pm
+        from .. import mesh as mesh_mod
+        return mesh_mod.get_mesh()
+
+    def prepare(self, inputs_spec=None, labels_spec=None, mode="train"):
+        """Build the compiled step for `mode` (reference engine.py:810).
+        inputs_spec sets the model-arg count for batch splitting; shapes
+        are taken from the first batch (jit caches per shape)."""
+        if inputs_spec is not None:
+            specs = inputs_spec if isinstance(inputs_spec, (list, tuple)) \
+                else [inputs_spec]
+            self._n_inputs = len(specs)
+        if mode == "train":
+            assert self._loss is not None and self._optimizer is not None, (
+                "Engine.prepare(mode='train') needs loss and optimizer")
+            zero_stage = 0
+            accumulate = 1
+            remat = False
+            st = self._strategy
+            if st is not None:
+                sh = getattr(st, "sharding_configs", None)
+                if getattr(st, "sharding", False) and sh is not None:
+                    zero_stage = sh.stage
+                pp = getattr(st, "pipeline_configs", None)
+                if pp is not None:
+                    accumulate = max(1, pp.accumulate_steps)
+                remat = bool(getattr(st, "recompute", False))
+            self._train_step = ParallelTrainStep(
+                self._model, self._loss, self._optimizer,
+                n_inputs=self._n_inputs, mesh=self._mesh(),
+                zero_stage=zero_stage, remat=remat,
+                accumulate_steps=accumulate)
+        self._mode = mode
+        return self
+
+    def _forward(self):
+        """Eval/predict forward: the train step's params when training was
+        prepared, else a jitted forward over the annotated layout (no
+        optimizer required — reference Engine supports inference-only)."""
+        if self._train_step is not None:
+            return self._train_step.eval_fn()
+        if self._eval_forward is None:
+            import jax
+            from ...jit.functional import (functional_call, raw_state,
+                                           _wrap)
+            from ..parallel_step import shard_params
+            from ...jit.training import _raw_tuple
+            model = self._model
+            shard_params(model, self._mesh())
+            params, buffers = raw_state(model)
+
+            @jax.jit
+            def infer(p, b, *inputs):
+                out, _ = functional_call(model, p, b, *inputs,
+                                         training=False)
+                return out
+
+            def run(*inputs):
+                return _wrap(infer(params, buffers, *_raw_tuple(inputs)))
+
+            self._eval_forward = run
+        return self._eval_forward
+
+    # ------------------------------------------------------------------
+    def _loader(self, data, batch_size, shuffle=False):
+        from ...io.dataloader import DataLoader, Dataset
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              drop_last=True)
+        return data
+
+    def fit(self, train_data, valid_data=None, epochs=1, batch_size=1,
+            steps_per_epoch=None, log_freq=10, verbose=1, callbacks=None):
+        """Parity: engine.py fit — train over the mesh, return history."""
+        if self._train_step is None:
+            self.prepare(mode="train")
+        loader = self._loader(train_data, batch_size, shuffle=True)
+        history = {"loss": []}
+        for ep in range(epochs):
+            losses = []
+            for step_i, batch in enumerate(loader):
+                xs, ys = self._split(batch)
+                loss = self._train_step(*xs, *ys)
+                losses.append(float(loss))
+                if steps_per_epoch and step_i + 1 >= steps_per_epoch:
+                    break
+            ep_loss = float(np.mean(losses)) if losses else float("nan")
+            history["loss"].append(ep_loss)
+            if verbose:
+                print(f"Epoch {ep + 1}/{epochs} - loss: {ep_loss:.4f}")
+            if valid_data is not None:
+                ev = self.evaluate(valid_data, batch_size=batch_size,
+                                   verbose=0)
+                for k, v in ev.items():
+                    history.setdefault(f"val_{k}", []).append(v)
+        self._history = history
+        return history
+
+    def evaluate(self, valid_data, batch_size=1, steps=None, log_freq=10,
+                 verbose=1):
+        loader = self._loader(valid_data, batch_size)
+        losses = []
+        for m in self._metrics:
+            m.reset()
+        fwd = self._forward()
+        for step_i, batch in enumerate(loader):
+            xs, ys = self._split(batch)
+            out = fwd(*xs)
+            loss = float(self._loss(out, *ys)) if self._loss else 0.0
+            losses.append(loss)
+            for m in self._metrics:
+                if hasattr(m, "compute"):
+                    m.update(*m.compute(out, *ys))
+                else:
+                    m.update(out, *ys)
+            if steps and step_i + 1 >= steps:
+                break
+        logs = {"loss": float(np.mean(losses)) if losses else float("nan")}
+        for m in self._metrics:
+            logs[m.name() if not isinstance(m.name(), (list, tuple))
+                 else m.name()[0]] = m.accumulate()
+        if verbose:
+            print(" - ".join(f"{k}: {v}" for k, v in logs.items()))
+        return logs
+
+    def predict(self, test_data, batch_size=1, steps=None, verbose=0):
+        loader = self._loader(test_data, batch_size)
+        fwd = self._forward()
+        outs = []
+        for step_i, batch in enumerate(loader):
+            xs, _ = self._split(batch, allow_no_label=True)
+            out = fwd(*xs)
+            outs.append(np.asarray(out.value if isinstance(out, Tensor)
+                                   else out))
+            if steps and step_i + 1 >= steps:
+                break
+        return outs
+
+    # ------------------------------------------------------------------
+    def save(self, path, training=True):
+        from ... import io as io_mod
+        if self._train_step is not None:
+            self._train_step.sync_to_model()
+        io_mod.save(self._model.state_dict(), path + ".pdparams")
+
+    def load(self, path):
+        from ... import io as io_mod
+        state = io_mod.load(path + ".pdparams")
+        self._model.set_state_dict(state)
+        if self._train_step is not None:
+            # rebuild the compiled step over the refreshed weights
+            self._train_step = None
+            self.prepare(mode="train")
+
+    # ------------------------------------------------------------------
+    def _split(self, batch, allow_no_label=False):
+        n = self._n_inputs
+        items = list(batch) if isinstance(batch, (list, tuple)) else [batch]
+        items = [x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+                 for x in items]
+        if allow_no_label and len(items) <= n:
+            return items, []
+        return items[:n], items[n:]
+
+    @property
+    def main_program(self):  # static-graph surface intentionally absent
+        raise NotImplementedError(
+            "Engine compiles to XLA programs; there is no Program object. "
+            "Use prepare()/fit() directly.")

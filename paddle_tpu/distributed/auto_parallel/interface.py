@@ -1,0 +1,108 @@
+"""shard_tensor / shard_op — semi-auto annotations.
+
+Parity: python/paddle/distributed/auto_parallel/interface.py:28
+(shard_tensor(x, process_mesh, shard_spec)). TPU-native semantics: the
+annotation IS the physical layout — the tensor is device_put with the
+NamedSharding derived from the spec, and Parameters additionally record
+`sharding_axes` so ParallelTrainStep/Engine keep the layout through
+training (GSPMD replaces the reference's completion+reshard passes).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor
+from .process_mesh import ProcessMesh, get_current_process_mesh
+
+__all__ = ["shard_tensor", "shard_op"]
+
+
+def _to_partition_spec(shard_spec, ndim) -> P:
+    if shard_spec is None:
+        return P()
+    assert len(shard_spec) == ndim, (
+        f"shard_spec {shard_spec} length must equal tensor ndim {ndim}")
+    return P(*shard_spec)
+
+
+def shard_tensor(x, process_mesh: Optional[ProcessMesh] = None,
+                 shard_spec: Optional[Sequence] = None):
+    """Annotate + physically place `x` on the mesh.
+
+    shard_spec[i] names the mesh dim that splits tensor dim i (None =
+    replicated on that dim) — exactly the reference contract.
+    """
+    pm = process_mesh or get_current_process_mesh()
+    assert pm is not None, (
+        "shard_tensor requires a process_mesh (pass one or enter a "
+        "`with ProcessMesh(...)` scope)")
+    assert isinstance(pm, ProcessMesh), (
+        f"process_mesh must be a ProcessMesh, got {type(pm)}")
+    if shard_spec is not None:
+        for ax in shard_spec:
+            assert ax is None or ax in pm.dim_names, (
+                f"shard_spec axis {ax!r} not in mesh dims {pm.dim_names}")
+    mesh = pm.to_jax_mesh()
+    if isinstance(x, Tensor):
+        spec = _to_partition_spec(shard_spec, len(x.shape))
+        x.value = jax.device_put(x.value, NamedSharding(mesh, spec))
+        # record for the training engine — Parameter.sharding_axes is the
+        # repo's dist_attr equivalent (plain Tensors are slot-restricted
+        # and carry the layout on .value.sharding itself)
+        if hasattr(type(x), "sharding_axes"):
+            x.sharding_axes = tuple(shard_spec) if shard_spec is not None \
+                else None
+        return x
+    arr = jax.numpy.asarray(x)
+    spec = _to_partition_spec(shard_spec, arr.ndim)
+    return Tensor(jax.device_put(arr, NamedSharding(mesh, spec)))
+
+
+def shard_op(op, process_mesh: Optional[ProcessMesh] = None,
+             in_shard_specs=None, out_shard_specs=None):
+    """Parity: interface.py shard_op — wrap a callable so its outputs are
+    constrained to the given shardings (inputs are annotated eagerly).
+    Under jit this lowers to `lax.with_sharding_constraint`."""
+    pm = process_mesh or get_current_process_mesh()
+    assert pm is not None, "shard_op requires a process_mesh"
+    mesh = pm.to_jax_mesh()
+
+    def wrapped(*args, **kwargs):
+        if in_shard_specs is not None:
+            assert len(in_shard_specs) == len(args), (
+                f"in_shard_specs has {len(in_shard_specs)} entries for "
+                f"{len(args)} args")
+            args = tuple(
+                shard_tensor(a, pm, s) if isinstance(a, Tensor) and
+                s is not None else a
+                for a, s in zip(args, in_shard_specs))
+        out = op(*args, **kwargs)
+        if out_shard_specs is None:
+            return out
+        def constrain(t, s):
+            if s is None or not isinstance(t, Tensor):
+                return t
+            spec = _to_partition_spec(s, len(t.shape))
+            t.value = jax.lax.with_sharding_constraint(
+                t.value, NamedSharding(mesh, spec)) \
+                if _in_trace(t.value) else \
+                jax.device_put(t.value, NamedSharding(mesh, spec))
+            return t
+        if isinstance(out, (list, tuple)):
+            assert len(out_shard_specs) == len(out), (
+                f"out_shard_specs has {len(out_shard_specs)} entries for "
+                f"{len(out)} outputs")
+            return type(out)(constrain(t, s) for t, s in
+                             zip(out, out_shard_specs))
+        return constrain(out, out_shard_specs[0]
+                         if isinstance(out_shard_specs, (list, tuple))
+                         else out_shard_specs)
+
+    return wrapped
+
+
+def _in_trace(v) -> bool:
+    return isinstance(v, jax.core.Tracer)

@@ -1,0 +1,138 @@
+"""ProcessMesh — the semi-auto parallel topology object.
+
+Parity: python/paddle/distributed/auto_parallel/process_mesh.py:45
+(ProcessMesh(mesh, dim_names) + the current-process-mesh context stack).
+TPU-native: a ProcessMesh is a thin named view over jax devices that
+lowers to a `jax.sharding.Mesh`; GSPMD plays the role of the reference's
+completion/partitioner/resharder pipeline.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+__all__ = ["ProcessMesh", "get_current_process_mesh",
+           "set_current_process_mesh", "reset_current_process_mesh"]
+
+_mesh_stack: List["ProcessMesh"] = []
+
+
+class ProcessMesh:
+    def __init__(self, mesh=None, dim_names: Optional[Sequence[str]] = None,
+                 shape=None, process_ids=None):
+        if mesh is None and shape is not None and process_ids is not None:
+            mesh = np.asarray(process_ids).reshape(shape)
+        if mesh is None:
+            raise ValueError("the mesh must not be None")
+        self._mesh = np.asarray(mesh)
+        if self._mesh.ndim == 0:
+            self._mesh = self._mesh.reshape(1)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(self._mesh.ndim)]
+        assert len(dim_names) == self._mesh.ndim, (
+            f"dim_names {dim_names} does not match mesh ndim "
+            f"{self._mesh.ndim}")
+        assert len(set(dim_names)) == len(dim_names), (
+            "dim_names must be unique")
+        self._dim_names = list(dim_names)
+        ids = self._mesh.ravel().tolist()
+        assert len(set(ids)) == len(ids), "process ids must be unique"
+        self._process_ids = ids
+        self._jax_mesh = None
+
+    # ---- reference surface ----
+    @property
+    def mesh(self):
+        return self._mesh
+
+    @property
+    def shape(self):
+        return list(self._mesh.shape)
+
+    @property
+    def ndim(self):
+        return self._mesh.ndim
+
+    @property
+    def dim_names(self):
+        return self._dim_names
+
+    @property
+    def process_ids(self):
+        return self._process_ids
+
+    @property
+    def processes(self):  # older alias
+        return self._process_ids
+
+    def __getitem__(self, idx):
+        # track which mesh dims the index consumes so the surviving dims
+        # keep their own names
+        idx_t = idx if isinstance(idx, tuple) else (idx,)
+        names = []
+        for d, name in enumerate(self._dim_names):
+            if d >= len(idx_t) or isinstance(idx_t[d], slice):
+                names.append(name)
+        sub = self._mesh[idx]
+        if np.ndim(sub) == 0:
+            sub = np.asarray([sub])
+            names = [self._dim_names[-1]]
+        return ProcessMesh(sub, names[:np.ndim(sub)])
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and self.shape == other.shape
+                and self._process_ids == other._process_ids)
+
+    def __ne__(self, other):
+        return not self == other
+
+    def __hash__(self):
+        return hash((tuple(self.shape), tuple(self._process_ids)))
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={self.shape}, "
+                f"process_ids={self._process_ids}, "
+                f"dim_names={self._dim_names})")
+
+    # ---- context manager (reference: with ProcessMesh(...)) ----
+    def __enter__(self):
+        set_current_process_mesh(self)
+        return self
+
+    def __exit__(self, *exc):
+        reset_current_process_mesh()
+
+    # ---- TPU lowering ----
+    def to_jax_mesh(self) -> Mesh:
+        """Lower to a jax Mesh over the named dims; process ids index
+        into jax.devices()."""
+        if self._jax_mesh is None:
+            devs = jax.devices()
+            arr = np.empty(self._mesh.shape, dtype=object)
+            for idx in np.ndindex(self._mesh.shape):
+                pid = int(self._mesh[idx])
+                if not 0 <= pid < len(devs):
+                    raise ValueError(
+                        f"process id {pid} out of range: only "
+                        f"{len(devs)} devices are available")
+                arr[idx] = devs[pid]
+            self._jax_mesh = Mesh(arr, tuple(self._dim_names))
+        return self._jax_mesh
+
+
+def get_current_process_mesh() -> Optional[ProcessMesh]:
+    return _mesh_stack[-1] if _mesh_stack else None
+
+
+def set_current_process_mesh(mesh: ProcessMesh):
+    _mesh_stack.append(mesh)
+
+
+def reset_current_process_mesh():
+    if _mesh_stack:
+        _mesh_stack.pop()

@@ -1,0 +1,257 @@
+"""Semi-auto parallel API: ProcessMesh, shard_tensor, Engine on the
+virtual 8-device CPU mesh (conftest bootstraps it). VERDICT item 8:
+dp x mp training without touching Parameter.sharding_axes directly."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import Engine, ProcessMesh, shard_tensor
+from paddle_tpu.distributed.auto_parallel.process_mesh import (
+    get_current_process_mesh)
+
+
+class TestProcessMesh:
+    def test_construct(self):
+        pm = ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]],
+                         dim_names=["x", "y"])
+        assert pm.shape == [2, 4]
+        assert pm.ndim == 2
+        assert pm.process_ids == list(range(8))
+        assert pm.dim_names == ["x", "y"]
+
+    def test_context_manager(self):
+        pm = ProcessMesh([0, 1], dim_names=["x"])
+        assert get_current_process_mesh() is None
+        with pm:
+            assert get_current_process_mesh() is pm
+        assert get_current_process_mesh() is None
+
+    def test_getitem(self):
+        pm = ProcessMesh([[0, 1], [2, 3]], dim_names=["x", "y"])
+        row = pm[0]
+        assert row.process_ids == [0, 1]
+        assert row.shape == [2]
+
+    def test_eq(self):
+        a = ProcessMesh([[0, 1], [2, 3]], dim_names=["x", "y"])
+        b = ProcessMesh([[0, 1], [2, 3]], dim_names=["x", "y"])
+        c = ProcessMesh([0, 1, 2, 3], dim_names=["x"])
+        assert a == b and a != c
+
+    def test_to_jax_mesh(self):
+        import jax
+        pm = ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]],
+                         dim_names=["dp", "mp"])
+        mesh = pm.to_jax_mesh()
+        assert mesh.shape == {"dp": 2, "mp": 4}
+        assert mesh.devices.shape == (2, 4)
+
+    def test_validation(self):
+        with pytest.raises(AssertionError):
+            ProcessMesh([[0, 1]], dim_names=["x"])  # ndim mismatch
+        with pytest.raises(AssertionError):
+            ProcessMesh([0, 0], dim_names=["x"])  # dup ids
+
+    def test_getitem_keeps_surviving_dim_names(self):
+        pm = ProcessMesh([[0, 1], [2, 3]], dim_names=["x", "y"])
+        col = pm[slice(None), 0]
+        assert col.process_ids == [0, 2]
+        assert col.dim_names == ["x"]
+        row = pm[1]
+        assert row.dim_names == ["y"]
+
+    def test_hashable(self):
+        a = ProcessMesh([[0, 1], [2, 3]], dim_names=["x", "y"])
+        b = ProcessMesh([[0, 1], [2, 3]], dim_names=["x", "y"])
+        assert len({a, b}) == 1
+
+    def test_out_of_range_process_id(self):
+        pm = ProcessMesh([0, 99], dim_names=["x"])
+        with pytest.raises(ValueError, match="out of range"):
+            pm.to_jax_mesh()
+
+
+class TestShardTensor:
+    def test_places_on_mesh(self):
+        pm = ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]],
+                         dim_names=["x", "y"])
+        t = paddle.to_tensor(np.zeros((8, 12), np.float32))
+        out = shard_tensor(t, pm, ["x", "y"])
+        shard_shape = out.value.sharding.shard_shape(out.value.shape)
+        assert shard_shape == (4, 3)
+
+    def test_parameter_records_axes(self):
+        pm = ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]],
+                         dim_names=["dp", "mp"])
+        lin = nn.Linear(8, 8)
+        shard_tensor(lin.weight, pm, [None, "mp"])
+        assert lin.weight.sharding_axes == (None, "mp")
+
+    def test_replicated_when_spec_none(self):
+        pm = ProcessMesh([0, 1], dim_names=["x"])
+        t = paddle.to_tensor(np.zeros((4, 4), np.float32))
+        out = shard_tensor(t, pm)
+        assert out.value.sharding.shard_shape(out.value.shape) == (4, 4)
+
+    def test_current_mesh_used(self):
+        t = paddle.to_tensor(np.zeros((8,), np.float32))
+        with ProcessMesh([0, 1, 2, 3], dim_names=["x"]):
+            out = shard_tensor(t, shard_spec=["x"])
+        assert out.value.sharding.shard_shape(out.value.shape) == (2,)
+
+    def test_requires_mesh(self):
+        t = paddle.to_tensor(np.zeros((4,), np.float32))
+        with pytest.raises(AssertionError):
+            shard_tensor(t, shard_spec=[None])
+
+    def test_bad_axis_name(self):
+        pm = ProcessMesh([0, 1], dim_names=["x"])
+        t = paddle.to_tensor(np.zeros((4,), np.float32))
+        with pytest.raises(AssertionError):
+            shard_tensor(t, pm, ["nope"])
+
+
+class TestEngine:
+    def _data(self, n=64, d=16):
+        rng = np.random.RandomState(0)
+        X = rng.randn(n, d).astype(np.float32)
+        w = rng.randn(d).astype(np.float32)
+        y = (X @ w > 0).astype(np.int64)
+        return X, y
+
+    def test_engine_dp_mp_fit(self):
+        """dp x mp training through Engine: user annotates weights with
+        shard_tensor only (no Parameter.sharding_axes)."""
+        from paddle_tpu.io.dataloader import Dataset
+
+        pm = ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]],
+                         dim_names=["dp", "mp"])
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                              nn.Linear(32, 2))
+        # column-parallel first weight, row-parallel second (Megatron
+        # pattern) via the user-facing annotation only
+        shard_tensor(model[0].weight, pm, [None, "mp"])
+        shard_tensor(model[2].weight, pm, ["mp", None])
+
+        X, y = self._data()
+
+        class DS(Dataset):
+            def __getitem__(self, i):
+                return X[i], y[i]
+
+            def __len__(self):
+                return len(X)
+
+        engine = Engine(model=model, loss=nn.CrossEntropyLoss(),
+                        optimizer=paddle.optimizer.Adam(
+                            learning_rate=5e-3,
+                            parameters=model.parameters()),
+                        process_mesh=pm)
+        hist = engine.fit(DS(), epochs=3, batch_size=16, verbose=0)
+        assert hist["loss"][-1] < hist["loss"][0]
+        # parameters kept their mp sharding through training
+        p0 = engine._train_step.params["0.weight"]
+        assert p0.sharding.shard_shape(p0.shape)[1] == 32 // 4
+
+    def test_engine_evaluate_predict(self):
+        from paddle_tpu.io.dataloader import Dataset
+        from paddle_tpu.metric import Accuracy
+
+        pm = ProcessMesh([[i] for i in range(8)], dim_names=["dp", "mp"])
+        paddle.seed(1)
+        model = nn.Sequential(nn.Linear(16, 8), nn.ReLU(),
+                              nn.Linear(8, 2))
+        X, y = self._data(n=32)
+
+        class DS(Dataset):
+            def __getitem__(self, i):
+                return X[i], y[i]
+
+            def __len__(self):
+                return len(X)
+
+        engine = Engine(model=model, loss=nn.CrossEntropyLoss(),
+                        optimizer=paddle.optimizer.SGD(
+                            learning_rate=1e-2,
+                            parameters=model.parameters()),
+                        metrics=Accuracy(), process_mesh=pm)
+        engine.fit(DS(), epochs=1, batch_size=8, verbose=0)
+        logs = engine.evaluate(DS(), batch_size=8, verbose=0)
+        assert "loss" in logs and "acc" in logs
+        preds = engine.predict(DS(), batch_size=8)
+        assert preds[0].shape == (8, 2)
+
+    def test_engine_save_load(self, tmp_path):
+        pm = ProcessMesh(list(range(8)), dim_names=["dp"])
+        paddle.seed(2)
+        model = nn.Sequential(nn.Linear(4, 4))
+        engine = Engine(model=model, loss=nn.MSELoss(),
+                        optimizer=paddle.optimizer.SGD(
+                            learning_rate=0.1,
+                            parameters=model.parameters()),
+                        process_mesh=pm)
+        engine.prepare(mode="train")
+        path = str(tmp_path / "ckpt")
+        engine.save(path)
+        w_before = model[0].weight.numpy().copy()
+        # perturb then load back
+        model[0].weight.value = model[0].weight.value + 1.0
+        engine.load(path)
+        np.testing.assert_allclose(model[0].weight.numpy(), w_before,
+                                   rtol=1e-6)
+
+    def test_eval_only_engine(self):
+        """Reference supports inference-only Engines (no optimizer)."""
+        from paddle_tpu.io.dataloader import Dataset
+
+        pm = ProcessMesh(list(range(8)), dim_names=["dp"])
+        paddle.seed(4)
+        model = nn.Sequential(nn.Linear(16, 2))
+        X, y = self._data(n=16)
+
+        class DS(Dataset):
+            def __getitem__(self, i):
+                return X[i], y[i]
+
+            def __len__(self):
+                return len(X)
+
+        engine = Engine(model=model, loss=nn.CrossEntropyLoss(),
+                        process_mesh=pm)
+        logs = engine.evaluate(DS(), batch_size=8, verbose=0)
+        assert np.isfinite(logs["loss"])
+        preds = engine.predict(DS(), batch_size=8)
+        assert preds[0].shape == (8, 2)
+
+    def test_zero3_via_strategy(self):
+        from paddle_tpu.distributed import DistributedStrategy
+        from paddle_tpu.io.dataloader import Dataset
+
+        pm = ProcessMesh(list(range(8)), dim_names=["sharding"])
+        paddle.seed(3)
+        model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                              nn.Linear(32, 2))
+        st = DistributedStrategy()
+        st.sharding = True
+        st.sharding_configs.stage = 3
+        X, y = self._data(n=32)
+
+        class DS(Dataset):
+            def __getitem__(self, i):
+                return X[i], y[i]
+
+            def __len__(self):
+                return len(X)
+
+        engine = Engine(model=model, loss=nn.CrossEntropyLoss(),
+                        optimizer=paddle.optimizer.Adam(
+                            learning_rate=5e-3,
+                            parameters=model.parameters()),
+                        strategy=st, process_mesh=pm)
+        hist = engine.fit(DS(), epochs=2, batch_size=32, verbose=0)
+        assert hist["loss"][-1] < hist["loss"][0] + 1e-6
+        # ZeRO-3: params sharded over the axis
+        p = engine._train_step.params["0.weight"]
+        assert p.sharding.shard_shape(p.shape) != tuple(p.shape)
