@@ -52,6 +52,9 @@ from .distributed.parallel import DataParallel  # noqa: E402  (paddle.DataParall
 from . import metric  # noqa: E402
 from . import vision  # noqa: E402
 from . import quantization  # noqa: E402
+from . import geometric  # noqa: E402
+from . import text  # noqa: E402
+from . import audio  # noqa: E402
 from . import models  # noqa: E402
 from . import hapi  # noqa: E402
 from . import profiler  # noqa: E402

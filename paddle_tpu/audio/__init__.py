@@ -1,0 +1,10 @@
+"""paddle.audio parity (SURVEY.md §2.8 audio row; reference:
+python/paddle/audio/ — features, functional, backends, datasets)."""
+from . import backends
+from . import features
+from . import functional
+from . import datasets
+from .backends import load, save, info
+
+__all__ = ["backends", "features", "functional", "datasets", "load",
+           "save", "info"]

@@ -1,0 +1,96 @@
+"""paddle.audio.backends parity — wave-format IO.
+
+Reference: python/paddle/audio/backends/wave_backend.py (load/save/info
+over the stdlib wave module; the reference's optional paddleaudio soxr
+backends are out of scope with zero egress).
+"""
+from __future__ import annotations
+
+import wave as _wave
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["load", "save", "info", "list_available_backends",
+           "get_current_backend", "set_backend", "AudioInfo"]
+
+
+class AudioInfo:
+    """Parity: backends/backend.py AudioInfo."""
+
+    def __init__(self, sample_rate, num_samples, num_channels,
+                 bits_per_sample, encoding="PCM_S"):
+        self.sample_rate = sample_rate
+        self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+
+def info(filepath: str) -> AudioInfo:
+    """Parity: wave_backend.py info."""
+    with _wave.open(filepath, "rb") as f:
+        return AudioInfo(f.getframerate(), f.getnframes(),
+                         f.getnchannels(), f.getsampwidth() * 8)
+
+
+def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True, channels_first: bool = True):
+    """Parity: wave_backend.py load → (Tensor, sample_rate)."""
+    with _wave.open(filepath, "rb") as f:
+        sr = f.getframerate()
+        nch = f.getnchannels()
+        width = f.getsampwidth()
+        f.setpos(frame_offset)
+        n = f.getnframes() - frame_offset if num_frames < 0 else num_frames
+        raw = f.readframes(n)
+    dtype = {1: np.uint8, 2: np.int16, 4: np.int32}[width]
+    data = np.frombuffer(raw, dtype=dtype).reshape(-1, nch)
+    if width == 1:
+        data = data.astype(np.int16) - 128  # 8-bit wav is unsigned
+        scale = 1 << 7
+    else:
+        scale = 1 << (8 * width - 1)
+    if normalize:
+        out = data.astype(np.float32) / scale
+    else:
+        out = data
+    if channels_first:
+        out = out.T
+    import jax.numpy as jnp
+    return Tensor(jnp.asarray(out), stop_gradient=True), sr
+
+
+def save(filepath: str, src, sample_rate: int,
+         channels_first: bool = True, encoding: str = "PCM_16",
+         bits_per_sample: int = 16):
+    """Parity: wave_backend.py save — float [-1,1] → PCM16 wav."""
+    arr = np.asarray(src.value if isinstance(src, Tensor) else src)
+    if arr.ndim == 1:
+        arr = arr[None, :] if channels_first else arr[:, None]
+    if channels_first:
+        arr = arr.T  # (frames, channels)
+    if arr.dtype.kind == "f":
+        arr = np.clip(arr, -1.0, 1.0)
+        arr = (arr * 32767.0).astype(np.int16)
+    with _wave.open(filepath, "wb") as f:
+        f.setnchannels(arr.shape[1])
+        f.setsampwidth(2)
+        f.setframerate(int(sample_rate))
+        f.writeframes(arr.astype("<i2").tobytes())
+
+
+def list_available_backends():
+    return ["wave_backend"]
+
+
+def get_current_backend():
+    return "wave_backend"
+
+
+def set_backend(backend_name: str):
+    if backend_name != "wave_backend":
+        raise NotImplementedError(
+            "only the wave backend is available in this build "
+            "(paddleaudio backends need external packages)")

@@ -1,0 +1,111 @@
+"""paddle.audio.datasets parity — TESS / ESC-50 parsers (reference:
+python/paddle/audio/datasets/{tess,esc50}.py). Zero-egress: local
+archive/directory paths only; features computed with this package's
+own feature layers.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..io.dataloader import Dataset
+from . import backends
+
+__all__ = ["TESS", "ESC50"]
+
+_NO_DOWNLOAD = (
+    "{name}: automatic download is unavailable in this build (no network "
+    "egress); pass data_dir pointing at a local extracted copy")
+
+
+class _WavFolderDataset(Dataset):
+    feat_defaults = {"raw": {}, "melspectrogram": {"n_mels": 64},
+                     "mfcc": {"n_mfcc": 40}}
+
+    def __init__(self, files, labels, sample_rate, feat_type="raw",
+                 archive=None, **kwargs):
+        assert feat_type in self.feat_defaults, (
+            f"feat_type should be one of {list(self.feat_defaults)}, "
+            f"but got {feat_type}")
+        self.files = files
+        self.labels = labels
+        self.feat_type = feat_type
+        self.feat_config = dict(self.feat_defaults[feat_type], **kwargs)
+        self.sample_rate = sample_rate
+        self._extractor = None
+
+    def _features(self, wav):
+        if self.feat_type == "raw":
+            return wav
+        if self._extractor is None:
+            from .features import MFCC, MelSpectrogram
+            cls = MelSpectrogram if self.feat_type == "melspectrogram" \
+                else MFCC
+            self._extractor = cls(sr=self.sample_rate, **self.feat_config)
+        return self._extractor(wav)
+
+    def __getitem__(self, idx):
+        wav, _ = backends.load(self.files[idx])
+        feats = self._features(wav)
+        return feats.numpy()[0] if hasattr(feats, "numpy") else feats, \
+            np.asarray([self.labels[idx]], np.int64)
+
+    def __len__(self):
+        return len(self.files)
+
+
+class TESS(_WavFolderDataset):
+    """Toronto Emotional Speech Set: <speaker>_<word>_<emotion>.wav."""
+
+    emotions = ["angry", "disgust", "fear", "happy", "neutral", "ps",
+                "sad"]
+
+    def __init__(self, data_dir=None, mode="train", n_folds=5,
+                 split=1, feat_type="raw", download=True, **kwargs):
+        if data_dir is None:
+            raise RuntimeError(_NO_DOWNLOAD.format(name="TESS"))
+        files, labels = [], []
+        for base, _, names in sorted(os.walk(data_dir)):
+            for n in sorted(names):
+                if not n.lower().endswith(".wav"):
+                    continue
+                emo = n.rsplit("_", 1)[-1][:-4].lower()
+                if emo not in self.emotions:
+                    continue
+                files.append(os.path.join(base, n))
+                labels.append(self.emotions.index(emo))
+        # deterministic fold split (reference: hash by index)
+        keep_f, keep_l = [], []
+        for i, (f, l) in enumerate(zip(files, labels)):
+            fold = i % n_folds + 1
+            in_test = fold == split
+            if (mode == "train") != in_test:
+                continue
+            keep_f.append(f)
+            keep_l.append(l)
+        super().__init__(keep_f, keep_l, 24414, feat_type, **kwargs)
+
+
+class ESC50(_WavFolderDataset):
+    """ESC-50 environmental sounds: '<fold>-<src>-<take>-<target>.wav'."""
+
+    def __init__(self, data_dir=None, mode="train", split=1,
+                 feat_type="raw", download=True, **kwargs):
+        if data_dir is None:
+            raise RuntimeError(_NO_DOWNLOAD.format(name="ESC50"))
+        files, labels = [], []
+        for base, _, names in sorted(os.walk(data_dir)):
+            for n in sorted(names):
+                if not n.lower().endswith(".wav"):
+                    continue
+                parts = n[:-4].split("-")
+                if len(parts) != 4:
+                    continue
+                fold, target = int(parts[0]), int(parts[3])
+                in_test = fold == split
+                if (mode == "train") != in_test:
+                    continue
+                files.append(os.path.join(base, n))
+                labels.append(target)
+        super().__init__(files, labels, 44100, feat_type, **kwargs)

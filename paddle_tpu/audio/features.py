@@ -1,0 +1,149 @@
+"""paddle.audio.features parity — Spectrogram / MelSpectrogram /
+LogMelSpectrogram / MFCC layers.
+
+Reference: python/paddle/audio/features/layers.py:24,106,206,309. The
+STFT is framing (gather) + window (elementwise) + rfft — jnp ops XLA
+fuses; frames are batched so the rfft runs as one batched kernel.
+"""
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax.numpy as jnp
+
+from ..autograd.tape import apply
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+from . import functional as AF
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+def _stft_mag(x, window, n_fft, hop, win_length, center, pad_mode, power):
+    if win_length < n_fft:  # center window inside the fft buffer
+        pad = (n_fft - win_length) // 2
+        window = jnp.pad(window, (pad, n_fft - win_length - pad))
+    if center:
+        pad = n_fft // 2
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(pad, pad)],
+                    mode=pad_mode)
+    n_frames = 1 + (x.shape[-1] - n_fft) // hop
+    idx = (jnp.arange(n_frames)[:, None] * hop
+           + jnp.arange(n_fft)[None, :])
+    frames = x[..., idx] * window  # (..., n_frames, n_fft)
+    spec = jnp.fft.rfft(frames, axis=-1)
+    mag = jnp.abs(spec) ** power
+    # paddle layout: (..., freq, time)
+    return jnp.swapaxes(mag, -1, -2)
+
+
+class Spectrogram(Layer):
+    """Parity: features/layers.py:24."""
+
+    def __init__(self, n_fft: int = 512, hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", dtype: str = "float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.win_length = win_length or n_fft
+        self.hop_length = hop_length or self.win_length // 4
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.fft_window = AF.get_window(window, self.win_length,
+                                        dtype=dtype)
+
+    def forward(self, x):
+        win = self.fft_window.value
+
+        def f(v):
+            return _stft_mag(v, win, self.n_fft, self.hop_length,
+                             self.win_length, self.center, self.pad_mode,
+                             self.power)
+
+        return apply(f, x, _op_name="spectrogram")
+
+
+class MelSpectrogram(Layer):
+    """Parity: features/layers.py:106."""
+
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm: Union[str, float] = "slaney",
+                 dtype: str = "float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                        window, power, center, pad_mode,
+                                        dtype)
+        self.n_mels = n_mels
+        self.fbank_matrix = AF.compute_fbank_matrix(
+            sr, n_fft, n_mels, f_min, f_max, htk, norm, dtype)
+
+    def forward(self, x):
+        spect = self._spectrogram(x)
+        fb = self.fbank_matrix.value
+
+        def f(s):
+            return jnp.einsum("mf,...ft->...mt", fb, s)
+
+        return apply(f, spect, _op_name="mel_spectrogram")
+
+
+class LogMelSpectrogram(Layer):
+    """Parity: features/layers.py:206."""
+
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm: Union[str, float] = "slaney",
+                 ref_value: float = 1.0, amin: float = 1e-10,
+                 top_db: Optional[float] = None, dtype: str = "float32"):
+        super().__init__()
+        self._melspectrogram = MelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, dtype)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        mel = self._melspectrogram(x)
+        return AF.power_to_db(mel, self.ref_value, self.amin, self.top_db)
+
+
+class MFCC(Layer):
+    """Parity: features/layers.py:309."""
+
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40,
+                 n_fft: int = 512, hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm: Union[str, float] = "slaney",
+                 ref_value: float = 1.0, amin: float = 1e-10,
+                 top_db: Optional[float] = None, dtype: str = "float32"):
+        super().__init__()
+        assert n_mfcc <= n_mels, "n_mfcc cannot be larger than n_mels"
+        self._log_melspectrogram = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, ref_value, amin,
+            top_db, dtype)
+        self.dct_matrix = AF.create_dct(n_mfcc, n_mels, dtype=dtype)
+
+    def forward(self, x):
+        logmel = self._log_melspectrogram(x)
+        dct = self.dct_matrix.value
+
+        def f(s):
+            return jnp.einsum("mk,...mt->...kt", dct, s)
+
+        return apply(f, logmel, _op_name="mfcc")

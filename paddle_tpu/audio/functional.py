@@ -1,0 +1,198 @@
+"""paddle.audio.functional parity — mel/DCT/window math.
+
+Reference: python/paddle/audio/functional/functional.py (hz_to_mel:22,
+mel_to_hz:78, mel_frequencies:123, fft_frequencies:163,
+compute_fbank_matrix:186, power_to_db:259, create_dct:303) and
+functional/window.py (get_window). Pure jnp compositions (slaney-scale
+mel math, same as librosa's convention the reference follows).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Union
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "power_to_db", "create_dct",
+           "get_window"]
+
+
+def _val(x):
+    return x.value if isinstance(x, Tensor) else x
+
+
+def hz_to_mel(freq, htk: bool = False):
+    """Parity: functional.py:22."""
+    f = _val(freq)
+    scalar = not hasattr(f, "ndim")
+    if htk:
+        out = 2595.0 * (math.log10(1.0 + f / 700.0) if scalar
+                        else jnp.log10(1.0 + f / 700.0))
+        return out if scalar else Tensor(out, stop_gradient=True)
+    f_sp = 200.0 / 3
+    min_log_hz = 1000.0
+    min_log_mel = min_log_hz / f_sp
+    logstep = math.log(6.4) / 27.0
+    if scalar:
+        mel = f / f_sp
+        if f >= min_log_hz:
+            mel = min_log_mel + math.log(f / min_log_hz) / logstep
+        return mel
+    mel = jnp.where(f >= min_log_hz,
+                    min_log_mel + jnp.log(jnp.maximum(f, 1e-10)
+                                          / min_log_hz) / logstep,
+                    f / f_sp)
+    return Tensor(mel, stop_gradient=True)
+
+
+def mel_to_hz(mel, htk: bool = False):
+    """Parity: functional.py:78."""
+    m = _val(mel)
+    scalar = not hasattr(m, "ndim")
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+        return out if scalar else Tensor(out, stop_gradient=True)
+    f_sp = 200.0 / 3
+    min_log_hz = 1000.0
+    min_log_mel = min_log_hz / f_sp
+    logstep = math.log(6.4) / 27.0
+    if scalar:
+        if m >= min_log_mel:
+            return min_log_hz * math.exp(logstep * (m - min_log_mel))
+        return f_sp * m
+    hz = jnp.where(m >= min_log_mel,
+                   min_log_hz * jnp.exp(logstep * (m - min_log_mel)),
+                   f_sp * m)
+    return Tensor(hz, stop_gradient=True)
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0,
+                    f_max: float = 11025.0, htk: bool = False,
+                    dtype: str = "float32"):
+    """Parity: functional.py:123."""
+    lo = hz_to_mel(f_min, htk)
+    hi = hz_to_mel(f_max, htk)
+    mels = jnp.linspace(lo, hi, n_mels).astype(dtype)
+    return mel_to_hz(Tensor(mels, stop_gradient=True), htk)
+
+
+def fft_frequencies(sr: int, n_fft: int, dtype: str = "float32"):
+    """Parity: functional.py:163."""
+    return Tensor(jnp.linspace(0, sr / 2, 1 + n_fft // 2).astype(dtype),
+                  stop_gradient=True)
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0,
+                         f_max: Optional[float] = None, htk: bool = False,
+                         norm: Union[str, float] = "slaney",
+                         dtype: str = "float32"):
+    """Parity: functional.py:186 — (n_mels, n_fft//2+1) triangular
+    filter bank."""
+    if f_max is None:
+        f_max = float(sr) / 2
+    fftfreqs = fft_frequencies(sr, n_fft).value
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk).value
+    fdiff = jnp.diff(mel_f)
+    ramps = mel_f[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1][:, None]
+    upper = ramps[2:] / fdiff[1:][:, None]
+    weights = jnp.maximum(0, jnp.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights = weights * enorm[:, None]
+    elif isinstance(norm, (int, float)):
+        weights = weights / jnp.maximum(
+            jnp.linalg.norm(weights, ord=norm, axis=-1, keepdims=True),
+            1e-10)
+    return Tensor(weights.astype(dtype), stop_gradient=True)
+
+
+def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db: Optional[float] = 80.0):
+    """Parity: functional.py:259 — 10*log10 with amin floor + top_db
+    clamp."""
+    x = _val(spect)
+    if amin <= 0:
+        raise ValueError("amin must be strictly positive")
+    if ref_value <= 0:
+        raise ValueError("ref_value must be strictly positive")
+    log_spec = 10.0 * jnp.log10(jnp.maximum(amin, x))
+    log_spec = log_spec - 10.0 * math.log10(max(ref_value, amin))
+    if top_db is not None:
+        if top_db < 0:
+            raise ValueError("top_db must be non-negative")
+        log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
+    return Tensor(log_spec, stop_gradient=True) \
+        if isinstance(spect, Tensor) else log_spec
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm: Optional[str] = "ortho",
+               dtype: str = "float32"):
+    """Parity: functional.py:303 — DCT-II basis (n_mels, n_mfcc)."""
+    n = jnp.arange(n_mels, dtype=jnp.float32)
+    k = jnp.arange(n_mfcc, dtype=jnp.float32)
+    dct = jnp.cos(math.pi / n_mels * (n[:, None] + 0.5) * k[None, :])
+    if norm is None:
+        dct = dct * 2.0
+    else:
+        assert norm == "ortho", f"unsupported norm {norm}"
+        dct = dct * jnp.where(k == 0, math.sqrt(1.0 / (4 * n_mels)),
+                              math.sqrt(1.0 / (2 * n_mels)))[None, :] * 2.0
+    return Tensor(dct.astype(dtype), stop_gradient=True)
+
+
+def get_window(window: Union[str, tuple], win_length: int,
+               fftbins: bool = True, dtype: str = "float32"):
+    """Parity: functional/window.py get_window — the common window set
+    (numpy-computed, cached on device)."""
+    if isinstance(window, tuple):
+        name, *args = window
+    else:
+        name, args = window, []
+    n = win_length
+    # periodic (fftbins) windows sample n+1 symmetric points, drop last
+    m = n + 1 if fftbins else n
+    t = np.arange(m)
+    if name == "hann":
+        w = 0.5 - 0.5 * np.cos(2 * np.pi * t / (m - 1))
+    elif name == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * np.pi * t / (m - 1))
+    elif name == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * np.pi * t / (m - 1))
+             + 0.08 * np.cos(4 * np.pi * t / (m - 1)))
+    elif name == "bohman":
+        x = np.abs(2 * t / (m - 1) - 1)
+        w = (1 - x) * np.cos(np.pi * x) + np.sin(np.pi * x) / np.pi
+    elif name == "triang":
+        w = 1 - np.abs(2 * t / (m - 1) - 1)
+    elif name == "cosine":
+        w = np.sin(np.pi * (t + 0.5) / m)
+    elif name == "tukey":
+        alpha = args[0] if args else 0.5
+        w = np.ones(m)
+        edge = int(alpha * (m - 1) / 2)
+        if edge > 0:
+            ramp = 0.5 * (1 + np.cos(np.pi * (
+                2 * t[:edge + 1] / (alpha * (m - 1)) - 1)))
+            w[:edge + 1] = ramp
+            w[-(edge + 1):] = ramp[::-1]
+    elif name == "gaussian":
+        std = args[0] if args else 7.0
+        w = np.exp(-0.5 * ((t - (m - 1) / 2) / std) ** 2)
+    elif name == "exponential":
+        tau = args[0] if args else 1.0
+        w = np.exp(-np.abs(t - (m - 1) / 2) / tau)
+    elif name == "kaiser":
+        beta = args[0] if args else 14.0
+        w = np.kaiser(m, beta)
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    if fftbins:
+        w = w[:-1]
+    return Tensor(jnp.asarray(w.astype(dtype)), stop_gradient=True)

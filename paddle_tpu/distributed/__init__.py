@@ -31,11 +31,14 @@ from .topology import (CommunicateTopology, HybridCommunicateGroup,
                        set_hybrid_communicate_group)
 
 from . import auto_parallel  # noqa: E402
+from .spawn import spawn  # noqa: E402
+from .metric import DistributedAuc, global_auc  # noqa: E402
 from .auto_parallel import (ProcessMesh, shard_tensor,  # noqa: E402
                             shard_op, Engine)
 
 __all__ = [
     "auto_parallel", "ProcessMesh", "shard_tensor", "shard_op", "Engine",
+    "spawn", "DistributedAuc", "global_auc",
     "init_parallel_env", "is_initialized", "get_rank", "get_world_size",
     "ParallelEnv", "DataParallel", "shard_batch",
     "Mesh", "PartitionSpec", "init_mesh", "get_mesh", "set_mesh",

@@ -32,6 +32,7 @@ class Engine:
         self._process_mesh = process_mesh
         self._train_step = None
         self._eval_forward = None
+        self._trained_forward = None
         self._n_inputs = 1
         self._history = None
 
@@ -71,15 +72,20 @@ class Engine:
                 n_inputs=self._n_inputs, mesh=self._mesh(),
                 zero_stage=zero_stage, remat=remat,
                 accumulate_steps=accumulate)
+            self._trained_forward = None
         self._mode = mode
         return self
 
     def _forward(self):
         """Eval/predict forward: the train step's params when training was
         prepared, else a jitted forward over the annotated layout (no
-        optimizer required — reference Engine supports inference-only)."""
+        optimizer required — reference Engine supports inference-only).
+        Cached either way: eval_fn() builds a fresh jit wrapper whose
+        cache would be discarded on every call."""
         if self._train_step is not None:
-            return self._train_step.eval_fn()
+            if self._trained_forward is None:
+                self._trained_forward = self._train_step.eval_fn()
+            return self._trained_forward
         if self._eval_forward is None:
             import jax
             from ...jit.functional import (functional_call, raw_state,
@@ -103,11 +109,11 @@ class Engine:
         return self._eval_forward
 
     # ------------------------------------------------------------------
-    def _loader(self, data, batch_size, shuffle=False):
+    def _loader(self, data, batch_size, shuffle=False, drop_last=False):
         from ...io.dataloader import DataLoader, Dataset
         if isinstance(data, Dataset):
             return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
-                              drop_last=True)
+                              drop_last=drop_last)
         return data
 
     def fit(self, train_data, valid_data=None, epochs=1, batch_size=1,
@@ -115,7 +121,10 @@ class Engine:
         """Parity: engine.py fit — train over the mesh, return history."""
         if self._train_step is None:
             self.prepare(mode="train")
-        loader = self._loader(train_data, batch_size, shuffle=True)
+        # drop_last only for training: the compiled step wants one batch
+        # shape; eval/predict below keep every sample
+        loader = self._loader(train_data, batch_size, shuffle=True,
+                              drop_last=True)
         history = {"loss": []}
         for ep in range(epochs):
             losses = []
@@ -188,8 +197,10 @@ class Engine:
         from ... import io as io_mod
         state = io_mod.load(path + ".pdparams")
         self._model.set_state_dict(state)
+        # drop every compiled closure that captured the old weights
+        self._eval_forward = None
+        self._trained_forward = None
         if self._train_step is not None:
-            # rebuild the compiled step over the refreshed weights
             self._train_step = None
             self.prepare(mode="train")
 

@@ -1,0 +1,49 @@
+"""Distributed metrics. Parity: python/paddle/distributed/metric/
+metrics.py (exact global AUC via all-reduced confusion buckets; C++
+side paddle/fluid/framework/fleet/metrics.cc).
+
+TPU-native: the per-rank Auc histograms are summed with one eager
+all_reduce over the dp axis — exact, not an average of per-rank AUCs.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..metric import Auc
+
+__all__ = ["DistributedAuc", "global_auc"]
+
+
+def _allreduce_hist(hist: np.ndarray) -> np.ndarray:
+    from . import collective, env
+    from .parallel import is_initialized
+    if not is_initialized() or env.get_world_size() <= 1:
+        return hist
+    # histograms are integer COUNTS: gather as objects and sum in
+    # float64 so buckets beyond 2^24 stay exact (a float32 all_reduce
+    # would round them)
+    gathered = []
+    collective.all_gather_object(gathered, hist.astype(np.float64))
+    return np.sum(np.asarray(gathered, np.float64), axis=0)
+
+
+class DistributedAuc(Auc):
+    """Auc whose accumulate() first all-reduces the bucket histograms
+    across ranks (reference print_auc path)."""
+
+    def accumulate(self):
+        local_pos, local_neg = self._stat_pos, self._stat_neg
+        try:
+            self._stat_pos = _allreduce_hist(local_pos)
+            self._stat_neg = _allreduce_hist(local_neg)
+            return super().accumulate()
+        finally:
+            self._stat_pos, self._stat_neg = local_pos, local_neg
+
+
+def global_auc(stat_pos, stat_neg):
+    """Functional form: AUC from already-collected per-rank histograms."""
+    m = Auc(num_thresholds=len(np.asarray(stat_pos)) - 1)
+    m._stat_pos = _allreduce_hist(np.asarray(stat_pos, np.float64))
+    m._stat_neg = _allreduce_hist(np.asarray(stat_neg, np.float64))
+    return m.accumulate()

@@ -1,0 +1,81 @@
+"""paddle.distributed.spawn parity.
+
+Reference: python/paddle/distributed/spawn.py:472 — start nprocs
+trainer processes running `func(*args)` with per-rank env wiring, then
+optionally join. Uses the multiprocessing 'spawn' start method so each
+child gets a fresh interpreter (mandatory: jax/XLA state cannot be
+forked). Env contract matches the launcher (launch/main.py:53-64).
+"""
+from __future__ import annotations
+
+import multiprocessing
+import os
+import socket
+from typing import Optional, Sequence
+
+__all__ = ["spawn"]
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _worker(func, args, rank, nprocs, master, backend, envs):
+    os.environ.update({
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(nprocs),
+        "PADDLE_MASTER": master,
+        "PADDLE_LOCAL_RANK": str(rank),
+        "PADDLE_NNODES": "1",
+        "PADDLE_NODE_RANK": "0",
+        "JAX_COORDINATOR_ADDRESS": master,
+        "JAX_NUM_PROCESSES": str(nprocs),
+        "JAX_PROCESS_ID": str(rank),
+    })
+    if envs:
+        os.environ.update({k: str(v) for k, v in envs.items()})
+    func(*args)
+
+
+class SpawnContext:
+    """Returned when join=False (reference MultiprocessContext role)."""
+
+    def __init__(self, procs):
+        self.processes = procs
+
+    def join(self, timeout=None):
+        for p in self.processes:
+            p.join(timeout)
+        bad = [p for p in self.processes if p.exitcode not in (0, None)]
+        if bad:
+            raise RuntimeError(
+                f"{len(bad)} spawned trainer(s) failed with exit codes "
+                f"{[p.exitcode for p in bad]}")
+        return all(p.exitcode is not None for p in self.processes)
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False,
+          backend: Optional[str] = None, master: Optional[str] = None,
+          envs: Optional[dict] = None, **options):
+    """Parity: distributed/spawn.py:472. nprocs=-1 uses the local
+    device/CPU count heuristic (reference picks visible GPUs)."""
+    if nprocs <= 0:
+        env_n = os.environ.get("PADDLE_TRAINERS_NUM")
+        nprocs = int(env_n) if env_n else max(1, min(
+            8, multiprocessing.cpu_count() // 2))
+    master = master or f"127.0.0.1:{_free_port()}"
+    ctx = multiprocessing.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_worker,
+                        args=(func, tuple(args), rank, nprocs, master,
+                              backend, envs or {}),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    context = SpawnContext(procs)
+    if join:
+        context.join()
+    return context

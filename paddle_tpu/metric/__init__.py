@@ -6,7 +6,7 @@ import numpy as np
 
 from ..core.tensor import Tensor
 
-__all__ = ["Metric", "Accuracy", "Precision", "Recall"]
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc"]
 
 
 def _np(x):
@@ -117,3 +117,51 @@ class Recall(Metric):
 
     def name(self):
         return [self._name]
+
+
+class Auc(Metric):
+    """Parity: metric/metrics.py:601 — streaming binary-classification
+    AUC from threshold-bucketed positive/negative histograms. The
+    reference loops rows in Python; here the bucket update is one
+    vectorized np.bincount pass.
+    """
+
+    def __init__(self, curve="ROC", num_thresholds=4095, name="auc",
+                 *args, **kwargs):
+        self._curve = curve
+        self._num_thresholds = num_thresholds
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = _np(preds)
+        labels = _np(labels).reshape(-1).astype(bool)
+        scores = preds[:, 1] if preds.ndim == 2 else preds.reshape(-1)
+        bins = (scores * self._num_thresholds).astype(np.int64)
+        bins = np.clip(bins, 0, self._num_thresholds)
+        n = self._num_thresholds + 1
+        self._stat_pos += np.bincount(bins[labels], minlength=n)
+        self._stat_neg += np.bincount(bins[~labels], minlength=n)
+
+    def accumulate(self):
+        # sweep thresholds high->low accumulating the ROC integral by
+        # trapezoids (same recurrence as the reference :731-755)
+        pos = self._stat_pos[::-1]
+        neg = self._stat_neg[::-1]
+        tot_pos = np.cumsum(pos)
+        tot_neg = np.cumsum(neg)
+        tp_prev = np.concatenate([[0.0], tot_pos[:-1]])
+        tn_prev = np.concatenate([[0.0], tot_neg[:-1]])
+        auc = np.sum(np.abs(tot_neg - tn_prev) * (tot_pos + tp_prev)
+                     / 2.0)
+        if tot_pos[-1] > 0.0 and tot_neg[-1] > 0.0:
+            return float(auc / tot_pos[-1] / tot_neg[-1])
+        return 0.0
+
+    def reset(self):
+        n = self._num_thresholds + 1
+        self._stat_pos = np.zeros(n)
+        self._stat_neg = np.zeros(n)
+
+    def name(self):
+        return self._name

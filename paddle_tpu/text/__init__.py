@@ -1,0 +1,11 @@
+"""paddle.text parity — viterbi decoding + classic NLP dataset parsers.
+
+Reference: python/paddle/text/ (viterbi_decode.py:25, datasets/). The
+reference's viterbi_decode is a CUDA kernel; here it is a `lax.scan`
+over time with per-sequence length masking — one compiled program,
+batch-parallel on the VPU.
+"""
+from . import datasets  # noqa: F401
+from .viterbi_decode import ViterbiDecoder, viterbi_decode
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "datasets"]

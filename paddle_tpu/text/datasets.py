@@ -1,0 +1,247 @@
+"""paddle.text.datasets parity — file-format parsers for the classic
+NLP datasets (reference: python/paddle/text/datasets/). Zero-egress
+build: each takes a local path to the standard archive and raises a
+clear error when asked to download.
+"""
+from __future__ import annotations
+
+import io as _io
+import os
+import re
+import tarfile
+
+import numpy as np
+
+from ..io.dataloader import Dataset
+
+__all__ = ["UCIHousing", "Imdb", "Imikolov", "Movielens"]
+
+_NO_DOWNLOAD = (
+    "{name}: automatic download is unavailable in this build (no network "
+    "egress); pass data_file pointing at a local copy of the standard "
+    "archive")
+
+
+class UCIHousing(Dataset):
+    """Parity: text/datasets/uci_housing.py — 13 features + price,
+    whitespace-separated; feature-normalized like the reference."""
+
+    def __init__(self, data_file=None, mode="train", download=True):
+        mode = mode.lower()
+        assert mode in ("train", "test"), (
+            f"mode should be 'train' or 'test', but got {mode}")
+        if data_file is None:
+            raise RuntimeError(_NO_DOWNLOAD.format(name="UCIHousing"))
+        raw = np.loadtxt(data_file).astype(np.float32)
+        # normalize features by column min/max/avg (reference recipe)
+        feats = raw[:, :-1]
+        mx, mn, avg = feats.max(0), feats.min(0), feats.mean(0)
+        denom = np.where(mx - mn == 0, 1, mx - mn)
+        raw[:, :-1] = (feats - avg) / denom
+        n_train = int(len(raw) * 0.8)
+        self.data = raw[:n_train] if mode == "train" else raw[n_train:]
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return row[:-1], row[-1:]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Imdb(Dataset):
+    """Parity: text/datasets/imdb.py — aclImdb tar; builds a frequency
+    word dict and yields (int64 token ids, label)."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=True):
+        mode = mode.lower()
+        assert mode in ("train", "test"), (
+            f"mode should be 'train' or 'test', but got {mode}")
+        if data_file is None:
+            raise RuntimeError(_NO_DOWNLOAD.format(name="Imdb"))
+        self.mode = mode
+        self._tar = tarfile.open(data_file, "r:*")
+        members = self._tar.getmembers()
+        self.word_idx = self._build_dict(members, cutoff)
+        self.docs, self.labels = [], []
+        pat = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+        unk = self.word_idx["<unk>"]
+        tok = re.compile(r"[A-Za-z]+")
+        for m in members:
+            match = pat.match(m.name)
+            if not match:
+                continue
+            text = self._tar.extractfile(m).read().decode(
+                "utf-8", "ignore").lower()
+            ids = np.asarray([self.word_idx.get(w, unk)
+                              for w in tok.findall(text)], np.int64)
+            self.docs.append(ids)
+            self.labels.append(0 if match.group(1) == "pos" else 1)
+
+    def _build_dict(self, members, cutoff):
+        from collections import Counter
+        freq = Counter()
+        tok = re.compile(r"[A-Za-z]+")
+        pat = re.compile(r"aclImdb/(train|test)/(pos|neg)/.*\.txt$")
+        for m in members:
+            if not pat.match(m.name):
+                continue
+            text = self._tar.extractfile(m).read().decode(
+                "utf-8", "ignore").lower()
+            freq.update(tok.findall(text))
+        words = [w for w, c in freq.items() if c >= min(
+            cutoff, max((c for c in freq.values()), default=1))]
+        if not words:
+            words = list(freq)
+        word_idx = {w: i for i, w in enumerate(sorted(words))}
+        word_idx["<unk>"] = len(word_idx)
+        return word_idx
+
+    def __getitem__(self, idx):
+        return self.docs[idx], np.asarray([self.labels[idx]], np.int64)
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """Parity: text/datasets/imikolov.py — PTB language-model n-grams
+    from the simple-examples tarball."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, download=True):
+        mode = mode.lower()
+        assert mode in ("train", "test"), (
+            f"mode should be 'train' or 'test', but got {mode}")
+        assert data_type.upper() in ("NGRAM", "SEQ")
+        if data_file is None:
+            raise RuntimeError(_NO_DOWNLOAD.format(name="Imikolov"))
+        self._tar = tarfile.open(data_file, "r:*")
+        names = {os.path.basename(m.name): m
+                 for m in self._tar.getmembers() if m.isfile()}
+        train_txt = self._read(names, "ptb.train.txt")
+        self.word_idx = self._build_dict(train_txt, min_word_freq)
+        text = train_txt if mode == "train" else self._read(
+            names, "ptb.valid.txt")
+        self.data = self._to_samples(text, data_type.upper(), window_size)
+
+    def _read(self, names, fname):
+        for k, m in names.items():
+            if k == fname:
+                return self._tar.extractfile(m).read().decode().split("\n")
+        raise FileNotFoundError(fname)
+
+    def _build_dict(self, lines, min_freq):
+        from collections import Counter
+        freq = Counter(w for line in lines for w in line.split())
+        freq.pop("<unk>", None)
+        words = sorted(w for w, c in freq.items() if c >= min_freq)
+        wi = {w: i for i, w in enumerate(words)}
+        wi["<unk>"] = len(wi)
+        wi["<s>"] = len(wi)
+        wi["<e>"] = len(wi)
+        return wi
+
+    def _to_samples(self, lines, dtype, n):
+        unk = self.word_idx["<unk>"]
+        out = []
+        for line in lines:
+            if not line.strip():
+                continue
+            ids = [self.word_idx["<s>"]] + [
+                self.word_idx.get(w, unk) for w in line.split()] + [
+                self.word_idx["<e>"]]
+            if dtype == "NGRAM":
+                for i in range(n, len(ids) + 1):
+                    out.append(np.asarray(ids[i - n:i], np.int64))
+            else:
+                out.append((np.asarray(ids[:-1], np.int64),
+                            np.asarray(ids[1:], np.int64)))
+        return out
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Movielens(Dataset):
+    """Parity: text/datasets/movielens.py — ml-1m ratings; yields
+    (user_id, gender, age, job, movie_id, categories-multihot, title
+    ids, rating)."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=True):
+        mode = mode.lower()
+        assert mode in ("train", "test"), (
+            f"mode should be 'train' or 'test', but got {mode}")
+        if data_file is None:
+            raise RuntimeError(_NO_DOWNLOAD.format(name="Movielens"))
+        import zipfile
+        rng = np.random.RandomState(rand_seed)
+        users, movies = {}, {}
+        ratings = []
+        opener = (zipfile.ZipFile(data_file)
+                  if data_file.endswith(".zip")
+                  else tarfile.open(data_file, "r:*"))
+
+        def read(name_end):
+            if isinstance(opener, zipfile.ZipFile):
+                for n in opener.namelist():
+                    if n.endswith(name_end):
+                        return opener.read(n).decode("latin1").split("\n")
+            else:
+                for m in opener.getmembers():
+                    if m.name.endswith(name_end):
+                        return opener.extractfile(m).read().decode(
+                            "latin1").split("\n")
+            raise FileNotFoundError(name_end)
+
+        for line in read("users.dat"):
+            if not line.strip():
+                continue
+            uid, gender, age, job, _ = line.split("::")
+            users[int(uid)] = (0 if gender == "M" else 1, int(age),
+                               int(job))
+        cats, titles = {}, {}
+        for line in read("movies.dat"):
+            if not line.strip():
+                continue
+            mid, title, genres = line.split("::")
+            for g in genres.split("|"):
+                cats.setdefault(g, len(cats))
+            for w in title.split():
+                titles.setdefault(w, len(titles))
+            movies[int(mid)] = (genres.split("|"), title.split())
+        self._n_cats = len(cats)
+        for line in read("ratings.dat"):
+            if not line.strip():
+                continue
+            uid, mid, rating, _ = line.split("::")
+            uid, mid = int(uid), int(mid)
+            if uid not in users or mid not in movies:
+                continue
+            g, t = movies[mid]
+            multihot = np.zeros(len(cats), np.int64)
+            for gg in g:
+                multihot[cats[gg]] = 1
+            ratings.append((
+                np.asarray([uid], np.int64),
+                np.asarray([users[uid][0]], np.int64),
+                np.asarray([users[uid][1]], np.int64),
+                np.asarray([users[uid][2]], np.int64),
+                np.asarray([mid], np.int64),
+                multihot,
+                np.asarray([titles[w] for w in t], np.int64),
+                np.asarray([float(rating)], np.float32)))
+        mask = rng.rand(len(ratings)) < test_ratio
+        self.data = [r for r, m in zip(ratings, mask)
+                     if (m if mode == "test" else not m)]
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)

@@ -1,0 +1,103 @@
+"""Viterbi decoding. Parity: python/paddle/text/viterbi_decode.py:25
+(viterbi_decode) and :101 (ViterbiDecoder layer).
+
+include_bos_eos_tag=True treats the LAST row/column of the transition
+matrix as the start tag and the second-to-last as the stop tag (the
+reference's convention): the start row is added at t=0 and the stop
+column at each sequence's final step.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..autograd.tape import apply
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def _decode(pot, trans, lengths, include_tag):
+    B, S, T = pot.shape
+    lengths = lengths.astype(jnp.int32)
+    alpha = pot[:, 0]
+    if include_tag:
+        alpha = alpha + trans[-1][None, :]
+    # stop contribution for length-1 sequences
+    stop = trans[:, -2][None, :] if include_tag else jnp.zeros((1, T),
+                                                               pot.dtype)
+    alpha = jnp.where((lengths == 1)[:, None], alpha + stop, alpha)
+
+    def step(carry, t):
+        alpha = carry
+        # scores[b, j, k] = alpha[b, j] + trans[j, k]
+        scores = alpha[:, :, None] + trans[None]
+        best_prev = jnp.argmax(scores, axis=1)            # (B, T)
+        new_alpha = jnp.max(scores, axis=1) + pot[:, t]
+        is_last = (t == lengths - 1)[:, None]
+        new_alpha = jnp.where(is_last, new_alpha + stop, new_alpha)
+        active = (t < lengths)[:, None]
+        alpha = jnp.where(active, new_alpha, alpha)
+        bp = jnp.where(active, best_prev,
+                       jnp.broadcast_to(jnp.arange(T)[None], (B, T)))
+        return alpha, bp
+
+    alpha, bps = lax.scan(step, alpha, jnp.arange(1, S))
+    scores = jnp.max(alpha, axis=1)
+    last_tag = jnp.argmax(alpha, axis=1)
+
+    # backtrack from each sequence's end through the backpointers
+    def back(carry, bp_t):
+        tag, t = carry
+        # bp_t corresponds to timestep t+1; only follow when t+1 < length
+        prev = jnp.take_along_axis(bp_t, tag[:, None], 1)[:, 0]
+        follow = (t + 1) <= (lengths - 1)
+        new_tag = jnp.where(follow, prev, tag)
+        return (new_tag, t - 1), new_tag
+
+    if S > 1:
+        # reverse scan: rev_tags[i] = tag at step i (bps[i] maps step
+        # i+1 tags to their best step-i predecessor; frozen steps carry
+        # identity backpointers so short sequences stay fixed)
+        (_, _), rev_tags = lax.scan(
+            back, (last_tag, jnp.full((), S - 2)), bps, reverse=True)
+        path = jnp.concatenate(
+            [jnp.moveaxis(rev_tags, 0, 1), last_tag[:, None]],
+            axis=1).astype(jnp.int32)
+    else:
+        path = last_tag[:, None].astype(jnp.int32)
+    mask = jnp.arange(S)[None, :] < lengths[:, None]
+    path = jnp.where(mask, path, 0)
+    return scores, path
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    def f(pot, trans, lens):
+        return _decode(pot, trans, lens, include_bos_eos_tag)
+
+    scores, path = apply(f, potentials, transition_params, lengths,
+                         _op_name="viterbi_decode")
+    # reference trims the path to max(lengths)
+    lens = lengths.value if isinstance(lengths, Tensor) \
+        else jnp.asarray(lengths)
+    max_len = int(jax.device_get(jnp.max(lens)))
+    path = Tensor(path.value[:, :max_len], stop_gradient=True)
+    return scores, path
+
+
+class ViterbiDecoder(Layer):
+    """Parity: text/viterbi_decode.py:101."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -1,0 +1,247 @@
+"""Long-tail parity batch (VERDICT item 9): geometric message passing,
+Auc metric, viterbi decode, audio features, text datasets, spawn."""
+import gzip
+import os
+import struct
+import wave
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestGeometric:
+    def test_segment_ops(self):
+        import paddle_tpu.geometric as G
+        data = paddle.to_tensor(
+            np.array([[1., 2., 3.], [3., 2., 1.], [4., 5., 6.]],
+                     np.float32))
+        ids = paddle.to_tensor(np.array([0, 0, 1], np.int64))
+        np.testing.assert_allclose(
+            G.segment_sum(data, ids).numpy(),
+            [[4, 4, 4], [4, 5, 6]])
+        np.testing.assert_allclose(
+            G.segment_mean(data, ids).numpy(),
+            [[2, 2, 2], [4, 5, 6]])
+        np.testing.assert_allclose(
+            G.segment_min(data, ids).numpy(),
+            [[1, 2, 1], [4, 5, 6]])
+        np.testing.assert_allclose(
+            G.segment_max(data, ids).numpy(),
+            [[3, 2, 3], [4, 5, 6]])
+
+    def test_segment_empty_segment_zero(self):
+        import paddle_tpu.geometric as G
+        data = paddle.to_tensor(np.array([[1.0], [2.0]], np.float32))
+        ids = paddle.to_tensor(np.array([0, 2], np.int64))
+        out = G.segment_max(data, ids).numpy()
+        assert out[1, 0] == 0  # empty segment -> 0, not -inf
+
+    def test_send_u_recv_reference_example(self):
+        import paddle_tpu.geometric as G
+        x = paddle.to_tensor(np.array([[0, 2, 3], [1, 4, 5], [2, 6, 7]],
+                                      np.float32))
+        src = paddle.to_tensor(np.array([0, 1, 2, 0], np.int64))
+        dst = paddle.to_tensor(np.array([1, 2, 1, 0], np.int64))
+        out = G.send_u_recv(x, src, dst, "sum").numpy()
+        np.testing.assert_allclose(out, [[0, 2, 3], [2, 8, 10],
+                                         [1, 4, 5]])
+
+    def test_send_ue_recv_and_uv(self):
+        import paddle_tpu.geometric as G
+        x = paddle.to_tensor(np.array([[0, 2, 3], [1, 4, 5], [2, 6, 7]],
+                                      np.float32))
+        y = paddle.to_tensor(np.array([1., 1., 1., 1.], np.float32))
+        src = paddle.to_tensor(np.array([0, 1, 2, 0], np.int64))
+        dst = paddle.to_tensor(np.array([1, 2, 1, 0], np.int64))
+        out = G.send_ue_recv(x, y, src, dst, "add", "sum").numpy()
+        np.testing.assert_allclose(out, [[1, 3, 4], [4, 10, 12],
+                                         [2, 5, 6]])
+        uv = G.send_uv(x, x, src, dst, "add").numpy()
+        np.testing.assert_allclose(uv[0], x.numpy()[0] + x.numpy()[1])
+
+    def test_send_u_recv_grad(self):
+        import paddle_tpu.geometric as G
+        x = paddle.to_tensor(np.ones((3, 2), np.float32))
+        x.stop_gradient = False
+        src = paddle.to_tensor(np.array([0, 1], np.int64))
+        dst = paddle.to_tensor(np.array([1, 1], np.int64))
+        G.send_u_recv(x, src, dst).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(),
+                                   [[1, 1], [1, 1], [0, 0]])
+
+    def test_out_size(self):
+        import paddle_tpu.geometric as G
+        x = paddle.to_tensor(np.ones((3, 2), np.float32))
+        src = paddle.to_tensor(np.array([0], np.int64))
+        dst = paddle.to_tensor(np.array([0], np.int64))
+        assert G.send_u_recv(x, src, dst, out_size=5).shape == [5, 2]
+
+
+class TestAuc:
+    def test_matches_manual(self):
+        from paddle_tpu.metric import Auc
+        rng = np.random.RandomState(0)
+        y = rng.randint(0, 2, 1000)
+        s = np.clip(rng.rand(1000) * 0.6 + y * 0.3, 0, 0.999)
+        m = Auc()
+        # feed in two batches (streaming)
+        for sl in (slice(0, 500), slice(500, 1000)):
+            m.update(np.stack([1 - s[sl], s[sl]], 1), y[sl].reshape(-1, 1))
+        # exact AUC via rank statistic
+        order = np.argsort(s)
+        ranks = np.empty(len(s))
+        ranks[order] = np.arange(1, len(s) + 1)
+        n_pos, n_neg = y.sum(), (1 - y).sum()
+        exact = (ranks[y == 1].sum() - n_pos * (n_pos + 1) / 2) \
+            / (n_pos * n_neg)
+        assert abs(m.accumulate() - exact) < 2e-3
+
+    def test_all_one_class_is_zero(self):
+        from paddle_tpu.metric import Auc
+        m = Auc()
+        m.update(np.array([[0.3, 0.7]]), np.array([[1]]))
+        assert m.accumulate() == 0.0
+
+    def test_reset(self):
+        from paddle_tpu.metric import Auc
+        m = Auc()
+        m.update(np.array([[0.3, 0.7], [0.6, 0.4]]),
+                 np.array([[1], [0]]))
+        assert m.accumulate() > 0
+        m.reset()
+        assert m.accumulate() == 0.0
+
+    def test_distributed_auc_single_process(self):
+        from paddle_tpu.distributed import DistributedAuc
+        m = DistributedAuc()
+        m.update(np.array([[0.2, 0.8], [0.9, 0.1]]), np.array([[1], [0]]))
+        assert m.accumulate() == 1.0
+
+
+class TestViterbi:
+    def test_layer(self):
+        from paddle_tpu.text import ViterbiDecoder
+        rng = np.random.RandomState(3)
+        trans = paddle.to_tensor(rng.randn(4, 4).astype(np.float32))
+        dec = ViterbiDecoder(trans, include_bos_eos_tag=False)
+        pot = paddle.to_tensor(rng.randn(2, 6, 4).astype(np.float32))
+        lens = paddle.to_tensor(np.array([6, 4], np.int64))
+        scores, path = dec(pot, lens)
+        assert scores.shape == [2]
+        assert path.shape == [2, 6]
+        assert (path.numpy()[1, 4:] == 0).all()  # masked beyond length
+
+    def test_greedy_on_diag_dominant(self):
+        # with zero transitions the viterbi path is the per-step argmax
+        from paddle_tpu.text import viterbi_decode
+        rng = np.random.RandomState(4)
+        pot = rng.randn(2, 5, 3).astype(np.float32)
+        scores, path = viterbi_decode(
+            paddle.to_tensor(pot),
+            paddle.to_tensor(np.zeros((3, 3), np.float32)),
+            paddle.to_tensor(np.array([5, 5], np.int64)), False)
+        np.testing.assert_array_equal(path.numpy(), pot.argmax(-1))
+        np.testing.assert_allclose(scores.numpy(), pot.max(-1).sum(-1),
+                                   rtol=1e-5)
+
+
+class TestAudio:
+    def test_mel_roundtrip(self):
+        from paddle_tpu.audio import functional as AF
+        for htk in (False, True):
+            hz = AF.mel_to_hz(AF.hz_to_mel(440.0, htk), htk)
+            assert abs(hz - 440.0) < 1e-3
+
+    def test_fbank_shape_and_norm(self):
+        from paddle_tpu.audio import functional as AF
+        fb = AF.compute_fbank_matrix(16000, 512, n_mels=40).numpy()
+        assert fb.shape == (40, 257)
+        assert fb.min() >= 0
+        assert (fb.sum(1) > 0).all()
+
+    def test_spectrogram_parseval(self):
+        from paddle_tpu.audio.features import Spectrogram
+        rng = np.random.RandomState(5)
+        wav = paddle.to_tensor(rng.randn(1, 4000).astype(np.float32))
+        spec = Spectrogram(n_fft=256, hop_length=128)(wav)
+        assert spec.shape == [1, 129, 4000 // 128 + 1]
+        assert float(spec.numpy().min()) >= 0
+
+    def test_mfcc_shapes(self):
+        from paddle_tpu.audio.features import (LogMelSpectrogram,
+                                               MelSpectrogram, MFCC)
+        rng = np.random.RandomState(6)
+        wav = paddle.to_tensor(rng.randn(2, 8000).astype(np.float32))
+        mel = MelSpectrogram(sr=16000, n_fft=512, n_mels=32)(wav)
+        assert mel.shape[0:2] == [2, 32]
+        logmel = LogMelSpectrogram(sr=16000, n_fft=512, n_mels=32)(wav)
+        assert logmel.shape == mel.shape
+        mfcc = MFCC(sr=16000, n_mfcc=13, n_fft=512, n_mels=32)(wav)
+        assert mfcc.shape[0:2] == [2, 13]
+
+    def test_wav_io_roundtrip(self, tmp_path):
+        import paddle_tpu.audio as A
+        rng = np.random.RandomState(7)
+        wav = (rng.rand(1, 1600).astype(np.float32) - 0.5) * 0.9
+        path = str(tmp_path / "t.wav")
+        A.save(path, paddle.to_tensor(wav), 16000)
+        meta = A.info(path)
+        assert meta.sample_rate == 16000
+        assert meta.num_samples == 1600
+        back, sr = A.load(path)
+        assert sr == 16000
+        np.testing.assert_allclose(back.numpy(), wav, atol=1e-3)
+
+
+class TestTextDatasets:
+    def test_uci_housing(self, tmp_path):
+        rng = np.random.RandomState(8)
+        raw = rng.rand(50, 14).astype(np.float32)
+        path = str(tmp_path / "housing.data")
+        np.savetxt(path, raw)
+        from paddle_tpu.text.datasets import UCIHousing
+        tr = UCIHousing(data_file=path, mode="train")
+        te = UCIHousing(data_file=path, mode="test")
+        assert len(tr) == 40 and len(te) == 10
+        x, y = tr[0]
+        assert x.shape == (13,) and y.shape == (1,)
+
+    def test_no_download_raises(self):
+        from paddle_tpu.text.datasets import Imdb, UCIHousing
+        with pytest.raises(RuntimeError, match="no network egress"):
+            UCIHousing()
+        with pytest.raises(RuntimeError, match="no network egress"):
+            Imdb()
+
+
+class TestSpawn:
+    def test_spawn_runs_and_sets_env(self, tmp_path):
+        from paddle_tpu.distributed import spawn
+        out = str(tmp_path)
+        ctx = spawn(_spawn_probe, args=(out,), nprocs=2, join=True)
+        assert all(p.exitcode == 0 for p in ctx.processes)
+        got = sorted(os.listdir(out))
+        assert got == ["rank0.txt", "rank1.txt"]
+        for i, fn in enumerate(got):
+            rank, world = open(os.path.join(out, fn)).read().split(",")
+            assert int(rank) == i and int(world) == 2
+
+    def test_spawn_propagates_failure(self):
+        from paddle_tpu.distributed import spawn
+        with pytest.raises(RuntimeError, match="failed"):
+            spawn(_spawn_fail, nprocs=2, join=True)
+
+
+def _spawn_probe(out_dir):
+    # runs in a fresh interpreter: no jax import needed
+    import os
+    rank = os.environ["PADDLE_TRAINER_ID"]
+    world = os.environ["PADDLE_TRAINERS_NUM"]
+    with open(os.path.join(out_dir, f"rank{rank}.txt"), "w") as f:
+        f.write(f"{rank},{world}")
+
+
+def _spawn_fail():
+    raise SystemExit(3)
