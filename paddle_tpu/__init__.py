@@ -55,6 +55,7 @@ from . import quantization  # noqa: E402
 from . import geometric  # noqa: E402
 from . import text  # noqa: E402
 from . import audio  # noqa: E402
+from . import incubate  # noqa: E402
 from . import models  # noqa: E402
 from . import hapi  # noqa: E402
 from . import profiler  # noqa: E402

@@ -80,7 +80,7 @@ class TESS(_WavFolderDataset):
         for i, (f, l) in enumerate(zip(files, labels)):
             fold = i % n_folds + 1
             in_test = fold == split
-            if (mode == "train") != in_test:
+            if (mode == "train") == in_test:
                 continue
             keep_f.append(f)
             keep_l.append(l)
@@ -104,7 +104,7 @@ class ESC50(_WavFolderDataset):
                     continue
                 fold, target = int(parts[0]), int(parts[3])
                 in_test = fold == split
-                if (mode == "train") != in_test:
+                if (mode == "train") == in_test:
                     continue
                 files.append(os.path.join(base, n))
                 labels.append(target)

@@ -28,24 +28,11 @@ def _num_segments(segment_ids, explicit=None):
 
 
 def _segment(op):
-    fns = {"sum": jax.ops.segment_sum, "min": jax.ops.segment_min,
-           "max": jax.ops.segment_max}
-
     def run(data, segment_ids, name=None):
         n = _num_segments(segment_ids)
 
         def f(d, ids):
-            if op == "mean":
-                s = jax.ops.segment_sum(d, ids, num_segments=n)
-                cnt = jax.ops.segment_sum(jnp.ones_like(ids, d.dtype), ids,
-                                          num_segments=n)
-                shape = (-1,) + (1,) * (d.ndim - 1)
-                return s / jnp.maximum(cnt, 1).reshape(shape)
-            out = fns[op](d, ids, num_segments=n)
-            if op in ("min", "max"):
-                # empty segments: reference returns 0, jax returns +/-inf
-                out = jnp.where(jnp.isfinite(out), out, 0)
-            return out
+            return _reduce(d, ids, op, n)
 
         return apply(f, data, segment_ids, _op_name=f"segment_{op}")
 
@@ -71,12 +58,17 @@ def _reduce(gathered, dst, reduce_op, n):
             jnp.ones_like(dst, gathered.dtype), dst, num_segments=n)
         shape = (-1,) + (1,) * (gathered.ndim - 1)
         return s / jnp.maximum(cnt, 1).reshape(shape)
-    if reduce_op == "min":
-        out = jax.ops.segment_min(gathered, dst, num_segments=n)
-        return jnp.where(jnp.isfinite(out), out, 0)
-    if reduce_op == "max":
-        out = jax.ops.segment_max(gathered, dst, num_segments=n)
-        return jnp.where(jnp.isfinite(out), out, 0)
+    if reduce_op in ("min", "max"):
+        fn = jax.ops.segment_min if reduce_op == "min" \
+            else jax.ops.segment_max
+        out = fn(gathered, dst, num_segments=n)
+        # empty segments: reference returns 0; jax fills +/-inf (float)
+        # or the iinfo sentinel (int)
+        if jnp.issubdtype(gathered.dtype, jnp.floating):
+            return jnp.where(jnp.isfinite(out), out, 0)
+        info = jnp.iinfo(gathered.dtype)
+        sentinel = info.max if reduce_op == "min" else info.min
+        return jnp.where(out == sentinel, 0, out)
     raise ValueError(
         f"reduce_op should be sum/mean/min/max, but got {reduce_op}")
 

@@ -1,0 +1,24 @@
+"""paddle.incubate parity (SURVEY.md §2.8 incubate row): ASP 2:4
+sparsity, autotune config, and the MoE models re-export (the MoE
+implementation itself lives in distributed/moe.py)."""
+from . import asp
+from . import autotune
+
+
+class _MoENamespace:
+    """paddle.incubate.distributed.models.moe path parity."""
+
+    def __getattr__(self, name):
+        from ..distributed import moe
+        return getattr(moe, name)
+
+
+class _DistributedNamespace:
+    class models:
+        pass
+
+
+distributed = _DistributedNamespace()
+distributed.models.moe = _MoENamespace()
+
+__all__ = ["asp", "autotune", "distributed"]

@@ -1,0 +1,200 @@
+"""ASP (Automatic SParsity) — 2:4 structured sparsity utilities.
+
+Parity: python/paddle/incubate/asp/ (utils.py mask algorithms:
+get_mask_1d:179, get_mask_2d_greedy:313, check_mask_1d:135,
+calculate_density:81; asp.py prune_model:302, decorate:216).
+
+TPU note: the reference targets Ampere sparse tensor cores; the TPU MXU
+has no 2:4 hardware path, so here ASP is a *pruning* facility — masks
+are computed the same way, applied to weights, and re-applied after
+each optimizer step by the decorated optimizer so pruned weights stay
+zero through training.
+"""
+from __future__ import annotations
+
+from enum import Enum
+from typing import Dict
+
+import numpy as np
+
+__all__ = ["calculate_density", "check_mask_1d", "get_mask_1d",
+           "check_mask_2d", "get_mask_2d_greedy", "create_mask",
+           "check_sparsity", "MaskAlgo", "CheckMethod", "prune_model",
+           "decorate", "set_excluded_layers", "reset_excluded_layers"]
+
+
+class MaskAlgo(Enum):
+    MASK_1D = "get_mask_1d"
+    MASK_2D_GREEDY = "get_mask_2d_greedy"
+    MASK_2D_BEST = "get_mask_2d_greedy"  # best-pattern search ≈ greedy here
+
+
+class CheckMethod(Enum):
+    CHECK_1D = "check_mask_1d"
+    CHECK_2D = "check_mask_2d"
+
+    @staticmethod
+    def get_checking_method(mask_algo):
+        if mask_algo == MaskAlgo.MASK_1D:
+            return CheckMethod.CHECK_1D
+        return CheckMethod.CHECK_2D
+
+
+def calculate_density(x) -> float:
+    """Parity: asp/utils.py:81 — nnz / size."""
+    arr = np.asarray(x.value if hasattr(x, "value") else x)
+    return float(np.count_nonzero(arr)) / max(arr.size, 1)
+
+
+def _pad_cols(mat, m):
+    pad = (-mat.shape[1]) % m
+    if pad:
+        mat = np.concatenate([mat, np.zeros((mat.shape[0], pad),
+                                            mat.dtype)], 1)
+    return mat, pad
+
+
+def get_mask_1d(mat, n, m):
+    """Parity: asp/utils.py:179 — keep the n largest |values| of every m
+    consecutive elements along rows. Vectorized via argpartition."""
+    mat = np.asarray(mat)
+    shape = mat.shape
+    flat = mat.reshape(-1, shape[-1])
+    padded, pad = _pad_cols(flat, m)
+    g = padded.reshape(padded.shape[0], -1, m)
+    order = np.argsort(-np.abs(g), axis=-1)
+    mask = np.zeros_like(g, dtype=bool)
+    np.put_along_axis(mask, order[..., :n], True, axis=-1)
+    mask = mask.reshape(padded.shape)
+    if pad:
+        mask = mask[:, :-pad]
+    return mask.reshape(shape).astype(mat.dtype)
+
+
+def check_mask_1d(mat, n, m) -> bool:
+    """Parity: asp/utils.py:135 — every m-group has at most n nonzeros."""
+    mat = np.asarray(mat)
+    flat = mat.reshape(-1, mat.shape[-1])
+    padded, _ = _pad_cols(flat, m)
+    g = padded.reshape(padded.shape[0], -1, m)
+    return bool((np.count_nonzero(g, axis=-1) <= n).all())
+
+
+def get_mask_2d_greedy(mat, n, m):
+    """Parity: asp/utils.py:313 — n:m constraint on both rows and
+    columns of each m x m block, greedy by magnitude."""
+    mat = np.asarray(mat)
+    h, w = mat.shape
+    ph, pw = (-h) % m, (-w) % m
+    padded = np.zeros((h + ph, w + pw), mat.dtype)
+    padded[:h, :w] = mat
+    mask = np.zeros_like(padded, dtype=bool)
+    for bi in range(0, padded.shape[0], m):
+        for bj in range(0, padded.shape[1], m):
+            blk = np.abs(padded[bi:bi + m, bj:bj + m])
+            order = np.argsort(-blk.ravel())
+            rows = np.zeros(m, np.int64)
+            cols = np.zeros(m, np.int64)
+            for flat_idx in order:
+                r, c = divmod(int(flat_idx), m)
+                if rows[r] < n and cols[c] < n:
+                    mask[bi + r, bj + c] = True
+                    rows[r] += 1
+                    cols[c] += 1
+    return mask[:h, :w].astype(mat.dtype)
+
+
+def check_mask_2d(mat, n, m) -> bool:
+    """Parity: asp/utils.py:262."""
+    mat = np.asarray(mat)
+    h, w = mat.shape
+    for bi in range(0, h, m):
+        for bj in range(0, w, m):
+            blk = mat[bi:bi + m, bj:bj + m]
+            if (np.count_nonzero(blk, axis=0) > n).any() or \
+                    (np.count_nonzero(blk, axis=1) > n).any():
+                return False
+    return True
+
+
+def create_mask(tensor, func_name=MaskAlgo.MASK_1D, n=2, m=4):
+    """Parity: asp/utils.py create_mask — mask for a 2D-reshaped view."""
+    arr = np.asarray(tensor.value if hasattr(tensor, "value") else tensor)
+    shape = arr.shape
+    mat = arr.reshape(shape[0], -1) if arr.ndim > 1 else arr.reshape(1, -1)
+    if func_name in (MaskAlgo.MASK_2D_GREEDY, MaskAlgo.MASK_2D_BEST):
+        mask = get_mask_2d_greedy(mat, n, m)
+    else:
+        mask = get_mask_1d(mat, n, m)
+    return mask.reshape(shape)
+
+
+def check_sparsity(tensor, func_name=CheckMethod.CHECK_1D, n=2, m=4):
+    """Parity: asp/utils.py check_sparsity."""
+    arr = np.asarray(tensor.value if hasattr(tensor, "value") else tensor)
+    mat = arr.reshape(arr.shape[0], -1) if arr.ndim > 1 \
+        else arr.reshape(1, -1)
+    if func_name == CheckMethod.CHECK_2D:
+        return check_mask_2d(mat, n, m)
+    return check_mask_1d(mat, n, m)
+
+
+# ---------------------------------------------------------------------------
+# model-level API
+# ---------------------------------------------------------------------------
+
+_excluded: set = set()
+_masks: Dict[int, tuple] = {}  # id(param) -> (param, mask ndarray)
+
+
+def set_excluded_layers(param_names, main_program=None):
+    """Parity: asp.py:40."""
+    _excluded.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    """Parity: asp.py:127."""
+    _excluded.clear()
+
+
+def _supported(p):
+    return len(p.shape) in (2, 4) and min(p.shape) >= 4
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Parity: asp.py:302 — mask every supported weight in place and
+    remember the mask so a decorated optimizer keeps it applied."""
+    import jax.numpy as jnp
+    algo = {"mask_1d": MaskAlgo.MASK_1D,
+            "mask_2d_greedy": MaskAlgo.MASK_2D_GREEDY,
+            "mask_2d_best": MaskAlgo.MASK_2D_BEST}[mask_algo]
+    out = {}
+    for name, p in model.named_parameters():
+        if name in _excluded or not _supported(p):
+            continue
+        mask = create_mask(p, algo, n, m).astype(np.float32)
+        p.value = p.value * jnp.asarray(mask, p.value.dtype)
+        if with_mask:
+            _masks[id(p)] = (p, mask)
+        out[name] = mask
+    return out
+
+
+def decorate(optimizer):
+    """Parity: asp.py:216 — after each step, re-apply the masks recorded
+    by prune_model so pruned weights stay exactly zero."""
+
+    class OptimizerWithSparsityGuarantee:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def __getattr__(self, item):
+            return getattr(self._inner, item)
+
+        def step(self):
+            import jax.numpy as jnp
+            self._inner.step()
+            for p, mask in _masks.values():
+                p.value = p.value * jnp.asarray(mask, p.value.dtype)
+
+    return OptimizerWithSparsityGuarantee(optimizer)

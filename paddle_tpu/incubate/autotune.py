@@ -1,0 +1,56 @@
+"""paddle.incubate.autotune parity.
+
+Reference: python/paddle/incubate/autotune.py set_config:24 — kernel,
+layout and dataloader auto-tuning knobs. On TPU the kernel search is
+XLA's own autotuner (SURVEY.md §2.1 "kernel autotune: subsumed"), so
+`kernel.enable` toggles the XLA autotune level env knob; layout tuning
+is XLA's layout assignment (always on); the dataloader knob adjusts the
+DataLoader prefetch depth default.
+"""
+from __future__ import annotations
+
+import json
+import os
+import warnings
+
+__all__ = ["set_config"]
+
+_config = {
+    "kernel": {"enable": True, "tuning_range": [1, 10]},
+    "layout": {"enable": True},
+    "dataloader": {"enable": False, "tuning_steps": 500},
+}
+
+
+def set_config(config=None):
+    """Parity: incubate/autotune.py:24. Accepts a dict or a path to a
+    JSON file with any of the 'kernel'/'layout'/'dataloader' sections."""
+    if config is None:
+        for section in _config.values():
+            section["enable"] = True
+        return
+    if isinstance(config, str):
+        with open(config) as f:
+            config = json.load(f)
+    if not isinstance(config, dict):
+        raise ValueError("config should be a dict or a json file path")
+    for key, val in config.items():
+        if key not in _config:
+            warnings.warn(f"autotune: unknown section {key!r} ignored")
+            continue
+        _config[key].update(val)
+    if "kernel" in config:
+        # XLA exhaustive-search level: 0 = off, 4 = full search. XLA
+        # reads XLA_FLAGS once at backend init, so this only affects
+        # child processes (spawn/launch workers) — which is where the
+        # tuning iteration actually runs; replace any previous setting
+        # rather than appending duplicates.
+        level = "4" if _config["kernel"]["enable"] else "0"
+        flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+                 if not f.startswith("--xla_gpu_autotune_level=")]
+        flags.append(f"--xla_gpu_autotune_level={level}")
+        os.environ["XLA_FLAGS"] = " ".join(flags)
+
+
+def get_config():
+    return {k: dict(v) for k, v in _config.items()}

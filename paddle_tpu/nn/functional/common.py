@@ -17,6 +17,7 @@ __all__ = [
     "embedding", "one_hot", "pad", "interpolate", "upsample",
     "cosine_similarity", "pixel_shuffle", "pixel_unshuffle", "channel_shuffle",
     "label_smooth", "unfold", "fold", "bilinear", "normalize",
+    "pairwise_distance",
 ]
 
 
@@ -278,3 +279,22 @@ def _pair(v):
     if isinstance(v, (list, tuple)):
         return int(v[0]), int(v[1])
     return int(v), int(v)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """Parity: nn/functional/distance.py pairwise_distance — p-norm of
+    (x - y + epsilon) along the last dim."""
+
+    def f(a, b):
+        d = a - b + epsilon
+        if p == float("inf"):
+            out = jnp.max(jnp.abs(d), axis=-1, keepdims=keepdim)
+        elif p == 0:
+            out = jnp.sum((d != 0).astype(d.dtype), axis=-1,
+                          keepdims=keepdim)
+        else:
+            out = jnp.sum(jnp.abs(d) ** p, axis=-1,
+                          keepdims=keepdim) ** (1.0 / p)
+        return out
+
+    return apply(f, x, y, _op_name="pairwise_distance")

@@ -18,7 +18,8 @@ __all__ = [
     "kl_div", "smooth_l1_loss", "margin_ranking_loss", "hinge_embedding_loss",
     "cosine_embedding_loss", "triplet_margin_loss", "log_loss", "square_error_cost",
     "sigmoid_focal_loss", "ctc_loss", "poisson_nll_loss", "multi_label_soft_margin_loss",
-    "soft_margin_loss", "gaussian_nll_loss",
+    "soft_margin_loss", "gaussian_nll_loss", "multi_margin_loss",
+    "triplet_margin_with_distance_loss", "hsigmoid_loss", "rnnt_loss",
 ]
 
 
@@ -354,3 +355,167 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
         per = optax.ctc_loss(lgb, pad_mask, lbl, lbl_mask, blank_id=blank)
         return _reduce(per, reduction)
     return apply(f, log_probs, _op_name="ctc_loss")
+
+
+def multi_margin_loss(input, label, p: int = 1, margin: float = 1.0,
+                      weight=None, reduction="mean", name=None):
+    """Parity: nn/functional/loss.py multi_margin_loss — per-sample
+    mean_j!=y max(0, margin - x_y + x_j)^p, optionally class-weighted."""
+
+    def f(x, y, *w):
+        C = x.shape[1]
+        xy = jnp.take_along_axis(x, y[:, None].astype(jnp.int32), 1)
+        hinge = jnp.maximum(0.0, margin - xy + x)
+        if p != 1:
+            hinge = hinge ** p
+        if w:
+            hinge = hinge * w[0][y.astype(jnp.int32)][:, None]
+        onehot = jax.nn.one_hot(y.astype(jnp.int32), C, dtype=x.dtype)
+        per = (hinge * (1 - onehot)).sum(1) / C
+        return _reduce(per, reduction)
+
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply(f, *args, _op_name="multi_margin_loss")
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None,
+                                      margin: float = 1.0, swap=False,
+                                      reduction="mean", name=None):
+    """Parity: nn/functional/loss.py triplet_margin_with_distance_loss."""
+    if distance_function is None:
+        from .common import pairwise_distance
+
+        def distance_function(a, b):
+            return pairwise_distance(a, b)
+    d_pos = distance_function(input, positive)
+    d_neg = distance_function(input, negative)
+    if swap:
+        d_pn = distance_function(positive, negative)
+        d_neg = _t_min(d_neg, d_pn)
+
+    def f(dp, dn):
+        return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
+
+    return apply(f, d_pos, d_neg,
+                 _op_name="triplet_margin_with_distance_loss")
+
+
+def _t_min(a, b):
+    def f(x, y):
+        return jnp.minimum(x, y)
+    return apply(f, a, b, _op_name="minimum")
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Parity: nn/functional/loss.py:892 hsigmoid_loss. Default tree is
+    the word2vec heap layout the reference's SimpleCode implements
+    (node = ((num_classes + c) >> (d+1)) - 1, bit = ((num_classes + c)
+    >> d) & 1): per-sample loss = sum over the path of BCE-with-logits.
+    Custom trees come in via path_table/path_code (host arrays)."""
+    import numpy as _np
+    from ...core.tensor import Tensor as _T
+
+    lbl = _np.asarray(label.value if isinstance(label, _T) else label)
+    lbl = lbl.reshape(-1).astype(_np.int64)
+    if path_table is not None:
+        table = _np.asarray(path_table.value if isinstance(path_table, _T)
+                            else path_table)[lbl]
+        code = _np.asarray(path_code.value if isinstance(path_code, _T)
+                           else path_code)[lbl]
+        valid = table >= 0
+        table = _np.where(valid, table, 0)
+    else:
+        codes = lbl + num_classes
+        depth = int(_np.max([int(c).bit_length() for c in codes])) - 1
+        table = _np.zeros((len(lbl), depth), _np.int64)
+        code = _np.zeros((len(lbl), depth), _np.float32)
+        valid = _np.zeros((len(lbl), depth), bool)
+        for i, c in enumerate(codes):
+            d = 0
+            while c > 1:
+                table[i, d] = (c >> 1) - 1
+                code[i, d] = c & 1
+                valid[i, d] = True
+                c >>= 1
+                d += 1
+
+    def f(x, w, *b):
+        wt = w[table]                          # (N, D, feat)
+        logits = jnp.einsum("nf,ndf->nd", x, wt)
+        if b:
+            logits = logits + b[0].reshape(-1)[table]
+        codej = jnp.asarray(code, x.dtype)
+        validj = jnp.asarray(valid, x.dtype)
+        bce = jnp.maximum(logits, 0) - logits * codej \
+            + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+        return (bce * validj).sum(-1, keepdims=True)
+
+    args = [input, weight] + ([bias] if bias is not None else [])
+    return apply(f, *args, _op_name="hsigmoid_loss")
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """Parity: nn/functional/loss.py rnnt_loss (RNA/RNN-T transducer).
+
+    input: (B, T, U, D) joint-network logits with U = max_label_len + 1;
+    forward-variable DP in log space via nested lax.scan (T outer, U
+    inner prefix recurrence) — one compiled program, batch-parallel.
+    """
+
+    def f(x, y, t_len, u_len):
+        B, T, U, D = x.shape
+        lp = jax.nn.log_softmax(x, -1)
+        blank_lp = lp[..., blank]                        # (B, T, U)
+        yi = y.astype(jnp.int32)
+        emit_lp = jnp.take_along_axis(
+            lp[:, :, :-1, :], jnp.broadcast_to(
+                yi[:, None, :, None], (B, T, U - 1, 1)), -1)[..., 0]
+        if fastemit_lambda:
+            # FastEmit (arXiv 2010.11148) as warp-transducer implements
+            # it: scale the EMISSION gradient by (1 + lambda) while
+            # leaving the loss value unchanged — the identity
+            # e' = (1+l)e - stop_grad(l*e) has value e, gradient (1+l).
+            # Applied before the -inf pad (the identity is nan at -inf).
+            emit_lp = (1.0 + fastemit_lambda) * emit_lp \
+                - jax.lax.stop_gradient(fastemit_lambda * emit_lp)
+        emit_lp = jnp.pad(emit_lp, ((0, 0), (0, 0), (0, 1)),
+                          constant_values=-jnp.inf)      # (B, T, U)
+        neg_inf = jnp.asarray(-jnp.inf, x.dtype)
+
+        def t_scan(alpha_prev, t):
+            # horizontal (blank) moves from row t-1
+            from_blank = jnp.where(
+                t == 0,
+                jnp.where(jnp.arange(U) == 0, 0.0, neg_inf)[None, :],
+                alpha_prev + blank_lp[:, jnp.maximum(t - 1, 0), :])
+            # vertical (emit) moves within row t, left-to-right
+            em_row = emit_lp[:, t, :]
+
+            def inner(carry, u):
+                cur = jnp.where(
+                    u == 0, from_blank[:, 0],
+                    jnp.logaddexp(from_blank[:, u],
+                                  carry + em_row[:, jnp.maximum(u - 1, 0)]))
+                return cur, cur
+
+            _, rows = jax.lax.scan(inner, jnp.full((B,), neg_inf, x.dtype),
+                                   jnp.arange(U))
+            alpha = jnp.moveaxis(rows, 0, 1)             # (B, U)
+            return alpha, alpha
+
+        _, alphas = jax.lax.scan(t_scan, jnp.full((B, U), neg_inf, x.dtype),
+                                 jnp.arange(T))
+        alphas = jnp.moveaxis(alphas, 0, 1)              # (B, T, U)
+        bt = jnp.arange(B)
+        t_last = t_len.astype(jnp.int32) - 1
+        u_last = u_len.astype(jnp.int32)                 # U-1 per sample
+        ll = alphas[bt, t_last, u_last] + blank_lp[bt, t_last, u_last]
+        per = -ll
+        return _reduce(per, reduction)
+
+    return apply(f, input, label, input_lengths, label_lengths,
+                 _op_name="rnnt_loss")

@@ -14,7 +14,8 @@ from ...autograd.tape import apply
 __all__ = ["avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d",
            "max_pool2d", "max_pool3d", "adaptive_avg_pool1d",
            "adaptive_avg_pool2d", "adaptive_avg_pool3d", "adaptive_max_pool1d",
-           "adaptive_max_pool2d", "adaptive_max_pool3d"]
+           "adaptive_max_pool2d", "adaptive_max_pool3d", "max_unpool1d",
+           "max_unpool2d", "max_unpool3d"]
 
 
 def _tuple(v, n):
@@ -98,18 +99,33 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCL", name=None):
+    if return_mask:
+        assert data_format == "NCL", (
+            "return_mask requires channel-first layout")
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 1,
+                                   ceil_mode)
     return _pool(x, "max", kernel_size, stride, padding, 1, ceil_mode, True,
                  data_format)
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
+    if return_mask:
+        assert data_format == "NCHW", (
+            "return_mask requires channel-first layout")
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 2,
+                                   ceil_mode)
     return _pool(x, "max", kernel_size, stride, padding, 2, ceil_mode, True,
                  data_format)
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
+    if return_mask:
+        assert data_format == "NCDHW", (
+            "return_mask requires channel-first layout")
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 3,
+                                   ceil_mode)
     return _pool(x, "max", kernel_size, stride, padding, 3, ceil_mode, True,
                  data_format)
 
@@ -166,3 +182,99 @@ def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
 
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
     return _adaptive(x, output_size, 3, "max", "NCDHW")
+
+
+def _ravel(coords, spatial):
+    """coords (..., n) integer multi-index -> flat index over `spatial`."""
+    flat = coords[..., 0]
+    for i in range(1, len(spatial)):
+        flat = flat * spatial[i] + coords[..., i]
+    return flat
+
+
+def _max_pool_with_mask(x, kernel, stride, padding, n, ceil_mode):
+    """Max pool returning (out, mask) where mask holds the flat spatial
+    argmax index per window (paddle return_mask contract, consumed by
+    max_unpool). Patch-gather formulation: reduce_window cannot carry
+    indices, one gather + argmax can."""
+    k = _tuple(kernel, n)
+    s = _tuple(stride if stride is not None else kernel, n)
+    p = _tuple(padding, n)
+
+    def f(v):
+        N, C = v.shape[:2]
+        sp = v.shape[2:]
+        if ceil_mode:
+            out_sp = tuple(-(-(sp[i] + 2 * p[i] - k[i]) // s[i]) + 1
+                           for i in range(n))
+        else:
+            out_sp = tuple((sp[i] + 2 * p[i] - k[i]) // s[i] + 1
+                           for i in range(n))
+        grids = jnp.meshgrid(*[jnp.arange(o) for o in out_sp],
+                             indexing="ij")
+        out_grid = jnp.stack(grids, -1)                     # (*out_sp, n)
+        offs = jnp.stack(jnp.meshgrid(*[jnp.arange(ki) for ki in k],
+                                      indexing="ij"), -1).reshape(-1, n)
+        s_arr = jnp.asarray(s)
+        p_arr = jnp.asarray(p)
+        sp_arr = jnp.asarray(sp)
+        coords = out_grid[..., None, :] * s_arr - p_arr + offs  # (*o,K,n)
+        valid = ((coords >= 0) & (coords < sp_arr)).all(-1)
+        flat = _ravel(jnp.clip(coords, 0, sp_arr - 1), sp)     # (*o, K)
+        patches = v.reshape(N, C, -1)[:, :, flat]           # (N,C,*o,K)
+        neg = (-jnp.inf if jnp.issubdtype(v.dtype, jnp.floating)
+               else jnp.iinfo(v.dtype).min)
+        patches = jnp.where(valid, patches, neg)
+        out = patches.max(-1)
+        arg = patches.argmax(-1)                            # (N,C,*o)
+        mask = jnp.take_along_axis(
+            jnp.broadcast_to(flat, patches.shape), arg[..., None],
+            -1)[..., 0]
+        return out, mask.astype(jnp.int32)
+
+    return apply(f, x, _op_name=f"max_pool{n}d_with_mask")
+
+
+def _max_unpool(x, indices, kernel, stride, padding, n, output_size):
+    """Scatter pooled values back to their argmax positions (paddle
+    max_unpoolNd; reference nn/functional/pooling.py max_unpool2d)."""
+    k = _tuple(kernel, n)
+    s = _tuple(stride if stride is not None else kernel, n)
+    p = _tuple(padding, n)
+
+    def f(v, idx):
+        N, C = v.shape[:2]
+        in_sp = v.shape[2:]
+        if output_size is not None:
+            out_sp = tuple(int(o) for o in output_size[-n:])
+        else:
+            out_sp = tuple((in_sp[i] - 1) * s[i] - 2 * p[i] + k[i]
+                           for i in range(n))
+        total = int(np.prod(out_sp))
+        flat_v = v.reshape(N, C, -1)
+        flat_i = idx.reshape(N, C, -1).astype(jnp.int32)
+        bb = jnp.arange(N)[:, None, None]
+        cc = jnp.arange(C)[None, :, None]
+        out = jnp.zeros((N, C, total), v.dtype)
+        out = out.at[bb, cc, flat_i].set(flat_v)
+        return out.reshape((N, C) + out_sp)
+
+    return apply(f, x, indices, _op_name=f"max_unpool{n}d")
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, 1,
+                       output_size)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, 2,
+                       output_size)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, 3,
+                       output_size)

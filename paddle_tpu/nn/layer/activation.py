@@ -10,7 +10,7 @@ __all__ = ["ReLU", "ReLU6", "GELU", "Sigmoid", "Tanh", "Softmax",
            "Hardsigmoid", "Hardtanh", "Hardshrink", "Softshrink",
            "Tanhshrink", "Softplus", "Softsign", "Mish", "GLU", "PReLU",
            "RReLU", "ThresholdedReLU", "LogSigmoid", "Maxout", "Silu",
-           "Swish"]
+           "Swish", "Softmax2D"]
 
 
 def _simple(fn_name, **fixed):
@@ -198,3 +198,16 @@ class Maxout(Layer):
 
     def forward(self, x):
         return F.maxout(x, self.groups, self.axis)
+
+
+class Softmax2D(Layer):
+    """Parity: nn/layer/activation.py Softmax2D — softmax over the
+    channel dim of NCHW/CHW inputs."""
+
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        assert len(x.shape) in (3, 4), (
+            f"Softmax2D requires a 3D or 4D tensor, got {len(x.shape)}D")
+        return F.softmax(x, axis=-3)

@@ -12,7 +12,7 @@ __all__ = ["Linear", "Embedding", "Dropout", "Dropout2D", "Dropout3D",
            "AlphaDropout", "Flatten", "Identity", "Upsample", "UpsamplingBilinear2D",
            "UpsamplingNearest2D", "Pad1D", "Pad2D", "Pad3D", "ZeroPad2D",
            "CosineSimilarity", "Bilinear", "PixelShuffle", "PixelUnshuffle",
-           "ChannelShuffle", "Unfold", "Fold", "LinearLowPrecision"]
+           "ChannelShuffle", "Unfold", "Fold", "LinearLowPrecision", "PairwiseDistance"]
 
 
 class Linear(Layer):
@@ -268,3 +268,17 @@ class Fold(Layer):
 
     def forward(self, x):
         return F.fold(x, self.output_sizes, *self.args)
+
+
+class PairwiseDistance(Layer):
+    """Parity: nn/layer/distance.py PairwiseDistance."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p = p
+        self.epsilon = epsilon
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, self.p, self.epsilon,
+                                   self.keepdim)

@@ -7,7 +7,7 @@ from ..layer_base import Layer
 __all__ = ["AvgPool1D", "AvgPool2D", "AvgPool3D", "MaxPool1D", "MaxPool2D",
            "MaxPool3D", "AdaptiveAvgPool1D", "AdaptiveAvgPool2D",
            "AdaptiveAvgPool3D", "AdaptiveMaxPool1D", "AdaptiveMaxPool2D",
-           "AdaptiveMaxPool3D"]
+           "AdaptiveMaxPool3D", "MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D"]
 
 
 class _Pool(Layer):
@@ -116,3 +116,36 @@ class AdaptiveMaxPool3D(_AdaptivePool):
     def __init__(self, output_size, return_mask=False, name=None):
         super().__init__("adaptive_max_pool3d", output_size,
                          return_mask=return_mask)
+
+
+class _MaxUnPoolNd(Layer):
+    _n = None
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format=None, output_size=None, name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        fn = {1: F.max_unpool1d, 2: F.max_unpool2d,
+              3: F.max_unpool3d}[type(self)._n]
+        return fn(x, indices, self.kernel_size, self.stride, self.padding,
+                  output_size=self.output_size)
+
+
+class MaxUnPool1D(_MaxUnPoolNd):
+    """Parity: nn/layer/pooling.py MaxUnPool1D."""
+    _n = 1
+
+
+class MaxUnPool2D(_MaxUnPoolNd):
+    """Parity: nn/layer/pooling.py:1204 MaxUnPool2D."""
+    _n = 2
+
+
+class MaxUnPool3D(_MaxUnPoolNd):
+    """Parity: nn/layer/pooling.py MaxUnPool3D."""
+    _n = 3

@@ -17,7 +17,7 @@ from .. import initializer as I
 from ..layer_base import Layer
 
 __all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "SimpleRNN",
-           "LSTM", "GRU", "BiRNN"]
+           "LSTM", "GRU", "BiRNN", "RNNCellBase"]
 
 
 class RNNCellBase(Layer):

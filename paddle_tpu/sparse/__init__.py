@@ -19,7 +19,7 @@ from jax.experimental import sparse as jsparse
 
 from ..core.tensor import Tensor
 
-__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+__all__ = ["nn", "sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
            "SparseCsrTensor", "is_sparse", "add", "matmul", "masked_matmul",
            "relu", "to_dense", "to_sparse_coo"]
 
@@ -45,6 +45,11 @@ class SparseCooTensor(Tensor):
         return Tensor(self.value.indices.T)   # paddle layout [ndim, nnz]
 
     def values(self) -> Tensor:
+        # sparse.nn layers attach the autograd-linked values Tensor so
+        # gradients flow through sparse pipelines
+        vt = getattr(self, "_values_tensor", None)
+        if vt is not None:
+            return vt
         return Tensor(self.value.data)
 
     def nnz(self) -> int:
@@ -188,9 +193,17 @@ def masked_matmul(x, y, mask: SparseCooTensor):
 
 def relu(x):
     """Parity: paddle.sparse.nn.functional.relu — applies to stored
-    values only."""
+    values only (autograd threads through the values Tensor)."""
     if is_sparse(x):
+        from ..autograd.tape import apply as _apply
         b = x.value
-        return SparseCooTensor(jsparse.BCOO((jnp.maximum(b.data, 0),
-                                             b.indices), shape=b.shape))
+        out_vals = _apply(lambda v: jnp.maximum(v, 0), x.values(),
+                          _op_name="sparse_relu")
+        st = SparseCooTensor(jsparse.BCOO((out_vals.value, b.indices),
+                                          shape=b.shape))
+        st._values_tensor = out_vals
+        return st
     return Tensor(jnp.maximum(_raw(x), 0))
+
+
+from . import nn  # noqa: E402,F401  (sparse layer library)

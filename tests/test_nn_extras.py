@@ -1,0 +1,254 @@
+"""nn layer long-tail closure + sparse.nn + incubate ASP (task: close
+the SURVEY §2.8 nn/sparse/incubate gaps)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+class TestMaxUnpool:
+    def test_pool_mask_roundtrip_2d(self):
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(2, 3, 8, 8).astype(np.float32))
+        out, mask = F.max_pool2d(x, 2, 2, return_mask=True)
+        assert out.shape == [2, 3, 4, 4]
+        assert mask.shape == [2, 3, 4, 4]
+        # unpool restores max values at their argmax locations
+        up = F.max_unpool2d(out, mask, 2, 2)
+        assert up.shape == [2, 3, 8, 8]
+        u = up.numpy()
+        np.testing.assert_allclose(np.sort(u[u != 0]),
+                                   np.sort(out.numpy().ravel()))
+        # layer form
+        up2 = nn.MaxUnPool2D(2, 2)(out, mask)
+        np.testing.assert_allclose(up2.numpy(), u)
+
+    def test_mask_matches_argmax(self):
+        x = np.zeros((1, 1, 4, 4), np.float32)
+        x[0, 0, 1, 2] = 5.0  # max of the top-right 2x2 window
+        out, mask = F.max_pool2d(paddle.to_tensor(x), 2, 2,
+                                 return_mask=True)
+        assert int(mask.numpy()[0, 0, 0, 1]) == 1 * 4 + 2
+        assert float(out.numpy()[0, 0, 0, 1]) == 5.0
+
+    def test_unpool_1d_3d(self):
+        rng = np.random.RandomState(1)
+        x1 = paddle.to_tensor(rng.randn(1, 2, 8).astype(np.float32))
+        o, m = F.max_pool1d(x1, 2, 2, return_mask=True)
+        assert F.max_unpool1d(o, m, 2, 2).shape == [1, 2, 8]
+        x3 = paddle.to_tensor(rng.randn(1, 2, 4, 4, 4).astype(np.float32))
+        o, m = F.max_pool3d(x3, 2, 2, return_mask=True)
+        assert F.max_unpool3d(o, m, 2, 2).shape == [1, 2, 4, 4, 4]
+
+    def test_grad_flows_through_unpool(self):
+        rng = np.random.RandomState(2)
+        x = paddle.to_tensor(rng.randn(1, 1, 4, 4).astype(np.float32))
+        x.stop_gradient = False
+        o, m = F.max_pool2d(x, 2, 2, return_mask=True)
+        F.max_unpool2d(o, m, 2, 2).sum().backward()
+        g = x.grad.numpy()
+        assert (g.sum() == 4.0) and ((g == 0) | (g == 1)).all()
+
+
+class TestNewLosses:
+    def test_multi_margin(self):
+        x = paddle.to_tensor(np.array([[0.1, 0.9, 0.3]], np.float32))
+        y = paddle.to_tensor(np.array([1], np.int64))
+        loss = F.multi_margin_loss(x, y, reduction="none").numpy()
+        want = (max(0, 1 - 0.9 + 0.1) + max(0, 1 - 0.9 + 0.3)) / 3
+        np.testing.assert_allclose(loss[0], want, rtol=1e-5)
+        l2 = nn.MultiMarginLoss()(x, y)
+        np.testing.assert_allclose(float(l2), want, rtol=1e-5)
+
+    def test_pairwise_distance(self):
+        a = paddle.to_tensor(np.array([[3.0, 0.0]], np.float32))
+        b = paddle.to_tensor(np.array([[0.0, 4.0]], np.float32))
+        d = nn.PairwiseDistance()(a, b)
+        np.testing.assert_allclose(d.numpy(), [5.0], rtol=1e-4)
+
+    def test_triplet_with_distance(self):
+        rng = np.random.RandomState(3)
+        a = paddle.to_tensor(rng.randn(4, 8).astype(np.float32))
+        pos = paddle.to_tensor(
+            (a.numpy() + 0.01 * rng.randn(4, 8)).astype(np.float32))
+        neg = paddle.to_tensor(rng.randn(4, 8).astype(np.float32) * 5)
+        loss = nn.TripletMarginWithDistanceLoss(margin=0.5)(a, pos, neg)
+        assert float(loss) >= 0
+
+    def test_softmax2d(self):
+        rng = np.random.RandomState(4)
+        x = paddle.to_tensor(rng.randn(2, 3, 4, 4).astype(np.float32))
+        out = nn.Softmax2D()(x).numpy()
+        np.testing.assert_allclose(out.sum(1), 1.0, rtol=1e-5)
+
+    def test_hsigmoid(self):
+        paddle.seed(0)
+        rng = np.random.RandomState(5)
+        layer = nn.HSigmoidLoss(feature_size=8, num_classes=6)
+        x = paddle.to_tensor(rng.randn(4, 8).astype(np.float32))
+        y = paddle.to_tensor(np.array([0, 2, 4, 5], np.int64))
+        loss = layer(x, y)
+        assert loss.shape == [4, 1]
+        assert np.isfinite(loss.numpy()).all()
+        loss.sum().backward()
+        assert layer.weight.grad is not None
+
+    def test_rnnt_loss_trivial(self):
+        # single-label, T=2: brute-force the two alignments
+        V = 3
+        logits = np.random.RandomState(6).randn(1, 2, 2, V).astype(
+            np.float32)
+        label = np.array([[2]], np.int64)
+        loss = F.rnnt_loss(paddle.to_tensor(logits),
+                           paddle.to_tensor(label),
+                           paddle.to_tensor(np.array([2], np.int64)),
+                           paddle.to_tensor(np.array([1], np.int64)),
+                           reduction="none")
+        import scipy.special as sp
+        lp = sp.log_softmax(logits[0], -1)
+        # alignments: (emit@t0,blank,blank) path structure over (T=2,U=2)
+        a1 = lp[0, 0, 2] + lp[0, 1, 0] + lp[1, 1, 0]   # emit then blanks
+        a2 = lp[0, 0, 0] + lp[1, 0, 2] + lp[1, 1, 0]   # blank emit blank
+        want = -np.logaddexp(a1, a2)
+        np.testing.assert_allclose(loss.numpy(), [want], rtol=1e-4)
+
+    def test_rnnt_layer_batch(self):
+        rng = np.random.RandomState(7)
+        B, T, U, V = 3, 5, 4, 6
+        logits = paddle.to_tensor(rng.randn(B, T, U, V).astype(np.float32))
+        labels = paddle.to_tensor(
+            rng.randint(1, V, (B, U - 1)).astype(np.int64))
+        tl = paddle.to_tensor(np.array([5, 4, 3], np.int64))
+        ul = paddle.to_tensor(np.array([3, 2, 1], np.int64))
+        loss = nn.RNNTLoss(reduction="none")(logits, labels, tl, ul)
+        assert loss.shape == [B]
+        assert (loss.numpy() > 0).all() and np.isfinite(loss.numpy()).all()
+
+
+class TestSparseNN:
+    def _point_cloud(self, n=12, spatial=(6, 6, 6), c=4, seed=0):
+        rng = np.random.RandomState(seed)
+        # unique coordinates
+        coords = set()
+        while len(coords) < n:
+            coords.add((0, rng.randint(spatial[0]),
+                        rng.randint(spatial[1]), rng.randint(spatial[2])))
+        coords = np.asarray(sorted(coords), np.int64)
+        vals = rng.randn(n, c).astype(np.float32)
+        import paddle_tpu.sparse as sparse
+        st = sparse.sparse_coo_tensor(
+            paddle.to_tensor(coords.T), paddle.to_tensor(vals),
+            (1, *spatial, c))
+        return st, coords, vals
+
+    def test_subm_conv_identity_kernel(self):
+        import paddle_tpu.sparse.nn as snn
+        st, coords, vals = self._point_cloud()
+        conv = snn.SubmConv3D(4, 4, 3, padding=1, bias_attr=False)
+        # identity kernel: center tap = I, rest 0
+        w = np.zeros((3, 3, 3, 4, 4), np.float32)
+        w[1, 1, 1] = np.eye(4)
+        conv.weight.value = paddle.to_tensor(w).value
+        out = conv(st)
+        assert out.nnz() == st.nnz()
+        np.testing.assert_allclose(out.values().numpy(), vals, rtol=1e-5)
+
+    def test_subm_conv_matches_dense(self):
+        import paddle_tpu.sparse.nn as snn
+        st, coords, vals = self._point_cloud()
+        conv = snn.SubmConv3D(4, 6, 3, padding=1)
+        out = conv(st)
+        # dense reference: conv3d then evaluate at input coords only
+        dense = np.zeros((1, 6, 6, 6, 4), np.float32)
+        for co, v in zip(coords, vals):
+            dense[0, co[1], co[2], co[3]] = v
+        w = conv.weight.numpy()
+        b = conv.bias.numpy()
+        padded = np.pad(dense, ((0, 0), (1, 1), (1, 1), (1, 1), (0, 0)))
+        got = out.values().numpy()
+        for row, co in enumerate(out.value.indices):
+            z, y, x = int(co[1]), int(co[2]), int(co[3])
+            patch = padded[0, z:z + 3, y:y + 3, x:x + 3]     # (3,3,3,C)
+            # submanifold: only taps landing on occupied inputs count
+            occ = (np.abs(patch).sum(-1, keepdims=True) > 0)
+            want = np.einsum("zyxc,zyxco->o", patch * occ, w) + b
+            np.testing.assert_allclose(got[row], want, rtol=1e-4,
+                                       atol=1e-5)
+
+    def test_strided_conv_downsamples(self):
+        import paddle_tpu.sparse.nn as snn
+        st, coords, vals = self._point_cloud()
+        conv = snn.Conv3D(4, 5, kernel_size=2, stride=2)
+        out = conv(st)
+        assert out.shape == [1, 3, 3, 3, 5]
+        assert out.nnz() >= 1
+
+    def test_batchnorm_relu(self):
+        import paddle_tpu.sparse.nn as snn
+        st, _, vals = self._point_cloud(seed=1)
+        bn = snn.BatchNorm(4)
+        out = bn(st)
+        v = out.values().numpy()
+        np.testing.assert_allclose(v.mean(0), 0.0, atol=1e-5)
+        np.testing.assert_allclose(v.std(0), 1.0, atol=1e-2)
+        r = snn.ReLU()(out)
+        assert (r.values().numpy() >= 0).all()
+
+
+class TestASP:
+    def test_mask_1d(self):
+        from paddle_tpu.incubate import asp
+        rng = np.random.RandomState(0)
+        w = rng.randn(8, 16).astype(np.float32)
+        mask = asp.get_mask_1d(w, 2, 4)
+        assert asp.check_mask_1d(w * mask, 2, 4)
+        assert abs(asp.calculate_density(w * mask) - 0.5) < 1e-6
+        # kept entries are the two largest per group
+        g = np.abs(w.reshape(8, 4, 4))
+        kept = (mask.reshape(8, 4, 4) > 0)
+        for i in range(8):
+            for j in range(4):
+                top2 = set(np.argsort(-g[i, j])[:2])
+                assert set(np.nonzero(kept[i, j])[0]) == top2
+
+    def test_mask_2d(self):
+        from paddle_tpu.incubate import asp
+        rng = np.random.RandomState(1)
+        w = rng.randn(8, 8).astype(np.float32)
+        mask = asp.get_mask_2d_greedy(w, 2, 4)
+        assert asp.check_mask_2d(w * mask, 2, 4)
+
+    def test_prune_and_decorate(self):
+        from paddle_tpu.incubate import asp
+        paddle.seed(2)
+        model = nn.Sequential(nn.Linear(16, 16), nn.ReLU(),
+                              nn.Linear(16, 4))
+        masks = asp.prune_model(model, mask_algo="mask_1d")
+        assert len(masks) == 2
+        for name, p in model.named_parameters():
+            if name in masks:
+                assert asp.check_sparsity(p, asp.CheckMethod.CHECK_1D)
+        opt = asp.decorate(paddle.optimizer.Adam(
+            learning_rate=1e-2, parameters=model.parameters()))
+        rng = np.random.RandomState(2)
+        x = paddle.to_tensor(rng.randn(8, 16).astype(np.float32))
+        y = paddle.to_tensor(rng.randint(0, 4, (8,)).astype(np.int64))
+        lf = nn.CrossEntropyLoss()
+        for _ in range(3):
+            loss = lf(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        # sparsity preserved through training
+        for name, p in model.named_parameters():
+            if name in masks:
+                assert asp.check_sparsity(p, asp.CheckMethod.CHECK_1D)
+
+    def test_autotune_config(self):
+        from paddle_tpu.incubate import autotune
+        autotune.set_config({"kernel": {"enable": True},
+                             "dataloader": {"enable": True}})
+        cfg = autotune.get_config()
+        assert cfg["dataloader"]["enable"]
