@@ -56,6 +56,9 @@ from . import geometric  # noqa: E402
 from . import text  # noqa: E402
 from . import audio  # noqa: E402
 from . import incubate  # noqa: E402
+from . import utils  # noqa: E402
+from .framework import custom_op  # noqa: E402
+from .framework.custom_op import ops  # noqa: E402  (custom-op namespace)
 from . import models  # noqa: E402
 from . import hapi  # noqa: E402
 from . import profiler  # noqa: E402

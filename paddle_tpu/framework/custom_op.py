@@ -1,0 +1,87 @@
+"""Custom-op registration — the TPU analog of the reference's
+custom-operator path.
+
+Reference: paddle/fluid/framework/custom_operator.cc + paddle/extension.h
+(out-of-tree ops registered at runtime) and phi/capi (C-ABI kernels).
+On TPU the "kernel" is either (a) a jnp/Pallas-composed Python function
+— registered here with an optional custom backward and dispatched
+through the same tape as every built-in op — or (b) a host C function
+loaded by utils.cpp_extension and bridged via jax.pure_callback.
+
+Registered ops appear under `paddle_tpu.ops.<name>` (the reference
+exposes custom ops the same way via the generated python module).
+"""
+from __future__ import annotations
+
+import functools
+import sys
+import types
+from typing import Callable, Dict, Optional
+
+import jax
+
+from ..autograd.tape import apply
+
+__all__ = ["register", "get_op", "ops"]
+
+_registry: Dict[str, Callable] = {}
+
+ops = types.ModuleType("paddle_tpu.ops")
+ops.__doc__ = "Dynamically registered custom ops (framework/custom_op.py)."
+ops.__package__ = "paddle_tpu"
+# make `import paddle_tpu.ops` / `from paddle_tpu.ops import x` work
+sys.modules["paddle_tpu.ops"] = ops
+
+
+def register(name: str, forward: Optional[Callable] = None,
+             backward: Optional[Callable] = None):
+    """Register a custom op. Usable directly or as a decorator:
+
+        @custom_op.register("my_gelu", backward=my_gelu_grad)
+        def my_gelu(x): ...
+
+    forward operates on raw jax arrays (it may call a Pallas kernel);
+    backward, if given, receives (saved_inputs, cotangents) in the
+    jax.custom_vjp convention: bwd(res, g) -> tuple of input cotangents.
+    Without a backward, jax differentiates through the forward.
+    """
+
+    def _do_register(fwd):
+        def _with_vjp(base):
+            wrapped = jax.custom_vjp(base)
+
+            def fwd_rule(*args):
+                return base(*args), args
+
+            wrapped.defvjp(fwd_rule, backward)
+            return wrapped
+
+        plain = _with_vjp(fwd) if backward is not None else fwd
+
+        def op(*tensors, **kwargs):
+            if backward is not None and kwargs:
+                # static kwargs must be closed over BEFORE custom_vjp —
+                # custom_vjp resolves kwargs positionally, which would
+                # add them to the residuals/cotangent contract
+                return apply(_with_vjp(functools.partial(fwd, **kwargs)),
+                             *tensors, _op_name=name)
+            return apply(plain, *tensors, _op_name=name, **kwargs)
+
+        op.__name__ = name
+        op.__doc__ = fwd.__doc__
+        _registry[name] = op
+        setattr(ops, name, op)
+        return op
+
+    if forward is not None:
+        return _do_register(forward)
+    return _do_register
+
+
+def get_op(name: str) -> Callable:
+    """Parity: the reference's OpInfoMap lookup for custom ops."""
+    if name not in _registry:
+        raise KeyError(
+            f"custom op {name!r} is not registered; known: "
+            f"{sorted(_registry)}")
+    return _registry[name]

@@ -1,0 +1,73 @@
+"""paddle.utils parity — cpp_extension (out-of-tree native ops),
+unique_name, deprecated helpers (reference: python/paddle/utils/)."""
+from __future__ import annotations
+
+import functools
+import warnings
+
+from . import cpp_extension  # noqa: F401
+
+__all__ = ["cpp_extension", "unique_name", "deprecated", "try_import",
+           "run_check"]
+
+
+class _UniqueNameGenerator:
+    def __init__(self):
+        self._ids = {}
+
+    def __call__(self, prefix: str) -> str:
+        i = self._ids.get(prefix, 0)
+        self._ids[prefix] = i + 1
+        return f"{prefix}_{i}"
+
+
+_generator = _UniqueNameGenerator()
+
+
+class unique_name:
+    """Parity: paddle.utils.unique_name.generate."""
+
+    @staticmethod
+    def generate(prefix: str) -> str:
+        return _generator(prefix)
+
+
+def deprecated(update_to="", since="", reason=""):
+    """Parity: paddle.utils.deprecated decorator."""
+
+    def wrap(fn):
+        @functools.wraps(fn)
+        def inner(*args, **kwargs):
+            warnings.warn(
+                f"{fn.__name__} is deprecated since {since}"
+                + (f", use {update_to} instead" if update_to else "")
+                + (f" ({reason})" if reason else ""),
+                DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+
+        return inner
+
+    return wrap
+
+
+def try_import(module_name: str):
+    """Parity: paddle.utils.try_import."""
+    import importlib
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(
+            f"Failed to import {module_name!r}; it is an optional "
+            f"dependency of this feature") from e
+
+
+def run_check():
+    """Parity: paddle.utils.run_check — one tiny computation on the
+    attached device."""
+    import jax
+    import jax.numpy as jnp
+    x = jnp.ones((128, 128))
+    y = (x @ x).sum()
+    dev = jax.devices()[0]
+    print(f"PaddleTPU works! device={dev.device_kind} "
+          f"platform={dev.platform} result={float(y)}")

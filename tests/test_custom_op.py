@@ -1,0 +1,189 @@
+"""Custom-op extension paths (VERDICT missing item 12): Python/Pallas
+registration (framework/custom_op) and out-of-tree C++ via the C-ABI
+(utils/cpp_extension, reference custom_operator.cc / phi capi)."""
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework import custom_op
+
+
+class TestRegisteredOp:
+    def test_register_and_dispatch(self):
+        import jax.numpy as jnp
+
+        @custom_op.register("cube_plus_one")
+        def cube_plus_one(x):
+            return x ** 3 + 1
+
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        out = paddle.ops.cube_plus_one(x)
+        np.testing.assert_allclose(out.numpy(), [2.0, 9.0])
+        # autodiff through the registered forward (no custom vjp)
+        x.stop_gradient = False
+        paddle.ops.cube_plus_one(x).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), 3 * x.numpy() ** 2)
+
+    def test_custom_vjp(self):
+        import jax.numpy as jnp
+
+        def bwd(res, g):
+            (x,) = res
+            return (jnp.full_like(x, 7.0) * g,)  # deliberately wrong math
+
+        @custom_op.register("odd_grad", backward=bwd)
+        def odd_grad(x):
+            return 2.0 * x
+
+        x = paddle.to_tensor(np.ones(3, np.float32))
+        x.stop_gradient = False
+        paddle.ops.odd_grad(x).sum().backward()
+        # custom vjp wins over the analytic d(2x)/dx = 2
+        np.testing.assert_allclose(x.grad.numpy(), 7.0)
+
+    def test_get_op_unknown_raises(self):
+        with pytest.raises(KeyError, match="not registered"):
+            custom_op.get_op("no_such_op")
+
+
+_SRC = textwrap.dedent("""
+    extern "C" void axpy2(const float* const* ins,
+                          const long long* const* shapes,
+                          const int* ndims, int n_ins, float* out) {
+      long long n = 1;
+      for (int d = 0; d < ndims[0]; ++d) n *= shapes[0][d];
+      for (long long i = 0; i < n; ++i)
+        out[i] = 2.0f * ins[0][i] + ins[1][i];
+    }
+    extern "C" void axpy2_grad(const float* const* ins,
+                               const long long* const* shapes,
+                               const int* ndims, int n_ins,
+                               float* const* grad_outs) {
+      long long n = 1;
+      for (int d = 0; d < ndims[0]; ++d) n *= shapes[0][d];
+      const float* ct = ins[n_ins - 1];
+      for (long long i = 0; i < n; ++i) {
+        grad_outs[0][i] = 2.0f * ct[i];
+        grad_outs[1][i] = ct[i];
+      }
+    }
+    extern "C" void sum_all(const float* const* ins,
+                            const long long* const* shapes,
+                            const int* ndims, int n_ins, float* out) {
+      long long n = 1;
+      for (int d = 0; d < ndims[0]; ++d) n *= shapes[0][d];
+      out[0] = 0.0f;
+      for (long long i = 0; i < n; ++i) out[0] += ins[0][i];
+    }
+""")
+
+
+@pytest.fixture(scope="module")
+def ext(tmp_path_factory):
+    from paddle_tpu.utils import cpp_extension
+    d = tmp_path_factory.mktemp("ext")
+    src = d / "ops.cc"
+    src.write_text(_SRC)
+    return cpp_extension.load("testext", [str(src)])
+
+
+class TestCppExtension:
+    def test_forward(self, ext):
+        rng = np.random.RandomState(0)
+        a = paddle.to_tensor(rng.randn(4, 5).astype(np.float32))
+        b = paddle.to_tensor(rng.randn(4, 5).astype(np.float32))
+        out = ext.axpy2(a, b)
+        np.testing.assert_allclose(out.numpy(), 2 * a.numpy() + b.numpy(),
+                                   rtol=1e-6)
+
+    def test_gradient_via_c_abi(self, ext):
+        a = paddle.to_tensor(np.ones((3,), np.float32))
+        b = paddle.to_tensor(np.ones((3,), np.float32))
+        a.stop_gradient = False
+        b.stop_gradient = False
+        ext.axpy2(a, b).sum().backward()
+        np.testing.assert_allclose(a.grad.numpy(), 2.0)
+        np.testing.assert_allclose(b.grad.numpy(), 1.0)
+
+    def test_custom_out_shape(self, ext):
+        x = paddle.to_tensor(np.arange(6, dtype=np.float32))
+        out = ext.call("sum_all", x, out_shape=(1,))
+        np.testing.assert_allclose(out.numpy(), [15.0])
+
+    def test_works_under_jit(self, ext):
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(u, v):
+            t = ext.axpy2(paddle.to_tensor(u), paddle.to_tensor(v))
+            return t.value + 1
+
+        u = jnp.ones((2, 2))
+        v = jnp.ones((2, 2))
+        np.testing.assert_allclose(np.asarray(f(u, v)), 4.0)
+
+    def test_build_cache_reused(self, ext, tmp_path):
+        from paddle_tpu.utils import cpp_extension
+        src = tmp_path / "ops2.cc"
+        src.write_text(_SRC)
+        e2 = cpp_extension.load("testext", [str(src)])
+        # same content hash → the exact same cached artifact
+        assert e2._path == ext._path
+
+    def test_ops_importable_module(self):
+        import importlib
+        mod = importlib.import_module("paddle_tpu.ops")
+        import paddle_tpu
+        assert mod is paddle_tpu.ops
+
+    def test_kwargs_with_custom_vjp(self):
+        import jax.numpy as jnp
+
+        def bwd(res, g):
+            (x,) = res
+            return (jnp.zeros_like(x) + 5.0 * g,)
+
+        @custom_op.register("scaled_tanh", backward=bwd)
+        def scaled_tanh(x, scale=1.0):
+            return jnp.tanh(x) * scale
+
+        x = paddle.to_tensor(np.zeros(2, np.float32))
+        x.stop_gradient = False
+        out = paddle.ops.scaled_tanh(x, scale=3.0)
+        np.testing.assert_allclose(out.numpy(), 0.0)
+        out.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), 5.0)
+
+    def test_missing_symbol_raises(self, ext):
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        with pytest.raises(AttributeError, match="no symbol"):
+            ext.call("nope", x)
+
+    def test_compile_error_surfaces(self, tmp_path):
+        from paddle_tpu.utils import cpp_extension
+        bad = tmp_path / "bad.cc"
+        bad.write_text("this is not C++")
+        with pytest.raises(RuntimeError, match="build failed"):
+            cpp_extension.load("badext", [str(bad)])
+
+
+class TestUtils:
+    def test_unique_name(self):
+        from paddle_tpu.utils import unique_name
+        a = unique_name.generate("fc")
+        b = unique_name.generate("fc")
+        assert a != b and a.startswith("fc")
+
+    def test_deprecated_warns(self):
+        from paddle_tpu.utils import deprecated
+
+        @deprecated(update_to="new_fn", since="2.0")
+        def old_fn():
+            return 42
+
+        with pytest.warns(DeprecationWarning):
+            assert old_fn() == 42
