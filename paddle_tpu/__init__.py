@@ -26,6 +26,9 @@ if _flags.flag_value("use_persistent_compilation_cache"):
         pass
 
 from .core.tensor import Tensor, Parameter  # noqa: F401,E402
+from .core.tensor_types import (  # noqa: F401,E402
+    TensorArray, SelectedRows, StringTensor, create_array, array_write,
+    array_read, array_length)
 from .tensor import *  # noqa: F401,F403,E402  (creation/math/... API)
 from .tensor import to_tensor  # noqa: F401,E402
 from .framework import seed, set_flags, get_flags  # noqa: F401,E402

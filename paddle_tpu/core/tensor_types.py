@@ -1,0 +1,181 @@
+"""Auxiliary tensor types: TensorArray, SelectedRows, StringTensor.
+
+Parity: phi/core (SURVEY §2.1 row 1) — paddle/phi/core/tensor_array.h,
+selected_rows.h, string_tensor.h; python surface
+python/paddle/tensor/array.py:24,73,141,222.
+
+TPU note: TensorArray is an eager list (inside jit, variable-length
+accumulation is a lax.scan carry — the dynamic-graph TensorArray only
+exists at the Python level, exactly like the reference's dygraph mode).
+SelectedRows is the sparse-gradient representation (rows + value block);
+StringTensor is a host-side object array for tokenizer-style pipelines.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .tensor import Tensor
+
+__all__ = ["TensorArray", "SelectedRows", "StringTensor", "create_array",
+           "array_write", "array_read", "array_length"]
+
+
+class TensorArray(list):
+    """Parity: phi::TensorArray — a dynamic list of Tensors with the
+    reference's write/read semantics (sparse writes pad with None)."""
+
+    def __init__(self, dtype="float32", initialized_list=None):
+        super().__init__()
+        self.dtype = dtype
+        if initialized_list is not None:
+            for item in initialized_list:
+                if not isinstance(item, Tensor):
+                    raise TypeError(
+                        "All values in `initialized_list` should be "
+                        f"Tensor, but got {type(item)}")
+                self.append(item)
+
+    def write(self, i: int, x: Tensor):
+        i = int(i.value) if isinstance(i, Tensor) else int(i)
+        if i < len(self):
+            self[i] = x
+        else:
+            while len(self) < i:
+                self.append(None)
+            self.append(x)
+        return self
+
+    def read(self, i) -> Tensor:
+        i = int(i.value) if isinstance(i, Tensor) else int(i)
+        return self[i]
+
+    def length(self) -> int:
+        return len(self)
+
+    def stack(self, axis=0) -> Tensor:
+        from ..autograd.tape import apply
+        ts = [t for t in self if t is not None]
+        return apply(lambda *vs: jnp.stack(vs, axis=axis), *ts,
+                     _op_name="tensor_array_stack")
+
+    def concat(self, axis=0) -> Tensor:
+        from ..autograd.tape import apply
+        ts = [t for t in self if t is not None]
+        return apply(lambda *vs: jnp.concatenate(vs, axis=axis), *ts,
+                     _op_name="tensor_array_concat")
+
+
+def create_array(dtype, initialized_list=None) -> TensorArray:
+    """Parity: tensor/creation.py create_array."""
+    return TensorArray(dtype, initialized_list)
+
+
+def array_write(x, i, array: Optional[TensorArray] = None) -> TensorArray:
+    """Parity: tensor/array.py:141."""
+    if array is None:
+        array = TensorArray()
+    array.write(i, x)
+    return array
+
+
+def array_read(array: TensorArray, i) -> Tensor:
+    """Parity: tensor/array.py:73."""
+    return array.read(i)
+
+
+def array_length(array: TensorArray) -> int:
+    """Parity: tensor/array.py:24."""
+    return array.length()
+
+
+class SelectedRows:
+    """Parity: phi::SelectedRows (selected_rows.h) — the sparse gradient
+    representation: a value block holding only `rows` of a height-row
+    tensor. The reference's embedding backward produces these; here the
+    tape produces dense grads (XLA scatters efficiently), but the type
+    is provided for API/code parity and conversion."""
+
+    def __init__(self, rows: Sequence[int] = (), height: int = 0,
+                 value: Optional[Tensor] = None):
+        self._rows = list(int(r) for r in rows)
+        self._height = int(height)
+        self._value = value
+
+    @property
+    def rows(self) -> List[int]:
+        return self._rows
+
+    @property
+    def height(self) -> int:
+        return self._height
+
+    def get_tensor(self) -> Optional[Tensor]:
+        return self._value
+
+    def set_height(self, h: int):
+        self._height = int(h)
+
+    def set_rows(self, rows):
+        self._rows = list(int(r) for r in rows)
+
+    def sync_index(self):
+        pass  # PJRT-resident; nothing to sync
+
+    def to_dense(self) -> Tensor:
+        assert self._value is not None, "SelectedRows has no value"
+        v = self._value.value
+        out = jnp.zeros((self._height,) + tuple(v.shape[1:]), v.dtype)
+        if self._rows:
+            out = out.at[jnp.asarray(self._rows)].add(v)
+        return Tensor(out)
+
+    @staticmethod
+    def from_dense(dense: Tensor, rows: Sequence[int]) -> "SelectedRows":
+        rows = list(rows)
+        if not rows:  # legitimate empty sparse gradient
+            return SelectedRows([], dense.shape[0],
+                                Tensor(dense.value[:0]))
+        idx = jnp.asarray(rows, dtype=jnp.int32)
+        return SelectedRows(rows, dense.shape[0],
+                            Tensor(dense.value[idx]))
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self._height}, "
+                f"rows={self._rows[:8]}{'...' if len(self._rows) > 8 else ''})")
+
+
+class StringTensor:
+    """Parity: phi::StringTensor (string_tensor.h) — host-side ndarray
+    of python strings feeding tokenizer-style pipelines (the reference's
+    strings kernels run on CPU too)."""
+
+    def __init__(self, data, name: str = ""):
+        self._data = np.asarray(data, dtype=object)
+        self.name = name
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    def numpy(self) -> np.ndarray:
+        return self._data
+
+    def __getitem__(self, idx):
+        out = self._data[idx]
+        return out if isinstance(out, str) else StringTensor(out)
+
+    def __len__(self):
+        return len(self._data)
+
+    def lower(self) -> "StringTensor":
+        return StringTensor(np.char.lower(self._data.astype(str)))
+
+    def upper(self) -> "StringTensor":
+        return StringTensor(np.char.upper(self._data.astype(str)))
+
+    def __repr__(self):
+        return f"StringTensor(shape={self.shape}, data={self._data!r})"

@@ -57,14 +57,21 @@ def register(name: str, forward: Optional[Callable] = None,
             return wrapped
 
         plain = _with_vjp(fwd) if backward is not None else fwd
+        kw_cache: Dict[tuple, Callable] = {}
 
         def op(*tensors, **kwargs):
             if backward is not None and kwargs:
                 # static kwargs must be closed over BEFORE custom_vjp —
                 # custom_vjp resolves kwargs positionally, which would
-                # add them to the residuals/cotangent contract
-                return apply(_with_vjp(functools.partial(fwd, **kwargs)),
-                             *tensors, _op_name=name)
+                # add them to the residuals/cotangent contract. Memoized
+                # per kwargs so repeated calls reuse one wrapper (and
+                # its jit caches).
+                key = tuple(sorted(kwargs.items()))
+                fn = kw_cache.get(key)
+                if fn is None:
+                    fn = kw_cache[key] = _with_vjp(
+                        functools.partial(fwd, **kwargs))
+                return apply(fn, *tensors, _op_name=name)
             return apply(plain, *tensors, _op_name=name, **kwargs)
 
         op.__name__ = name

@@ -257,26 +257,33 @@ class DistributedBatchSampler(BatchSampler):
 # collate + loader (parity: dataloader/collate.py, dataloader_iter.py)
 # ---------------------------------------------------------------------------
 
-def default_collate_fn(batch: List[Any]):
-    """Stack samples into device Tensors (reference: default_collate_fn in
-    fluid/dataloader/collate.py)."""
+def _collate(batch: List[Any], wrap):
+    """Shared collate core; `wrap` turns each stacked numpy leaf into the
+    output leaf type (Tensor for the main process, identity for forked
+    workers)."""
     sample = batch[0]
     if isinstance(sample, Tensor):
-        return Tensor(np.stack([np.asarray(s.value) for s in batch]))
+        return wrap(np.stack([np.asarray(s.value) for s in batch]))
     if isinstance(sample, np.ndarray):
-        return Tensor(np.stack(batch))
+        return wrap(np.stack(batch))
     if isinstance(sample, (int, np.integer)):
-        return Tensor(np.asarray(batch, dtype=np.int64))
+        return wrap(np.asarray(batch, dtype=np.int64))
     if isinstance(sample, (float, np.floating)):
-        return Tensor(np.asarray(batch, dtype=np.float32))
+        return wrap(np.asarray(batch, dtype=np.float32))
     if isinstance(sample, (str, bytes)):
         return list(batch)
     if isinstance(sample, dict):
-        return {k: default_collate_fn([d[k] for d in batch]) for k in sample}
+        return {k: _collate([d[k] for d in batch], wrap) for k in sample}
     if isinstance(sample, (tuple, list)):
-        return type(sample)(default_collate_fn(list(items))
+        return type(sample)(_collate(list(items), wrap)
                             for items in zip(*batch))
     raise TypeError(f"cannot collate {type(sample)}")
+
+
+def default_collate_fn(batch: List[Any]):
+    """Stack samples into device Tensors (reference: default_collate_fn in
+    fluid/dataloader/collate.py)."""
+    return _collate(batch, Tensor)
 
 
 class _WorkerInfo:
@@ -298,8 +305,12 @@ class DataLoader:
 
     num_workers>0 runs batch fetch+collate on a thread pool with a bounded
     prefetch queue (role of multiprocess workers + buffered_reader in the
-    reference; threads suffice because collate is numpy, which releases
-    the GIL).
+    reference; threads suffice for numpy/PIL work, which releases the
+    GIL). worker_mode="process" opts into the reference's forked-worker
+    model (fluid/dataloader/dataloader_iter.py + worker.py): samples are
+    fetched and numpy-collated in child processes and tensorized in the
+    parent. Use thread mode for datasets holding shared file handles
+    (tar-backed): forked children share the file offset.
     """
 
     def __init__(self, dataset, feed_list=None, places=None,
@@ -307,12 +318,23 @@ class DataLoader:
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
-                 persistent_workers=False):
+                 persistent_workers=False, worker_mode="thread"):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = int(num_workers)
         self.prefetch_factor = max(2, int(prefetch_factor))
         self.worker_init_fn = worker_init_fn
+        if worker_mode not in ("thread", "process"):
+            raise ValueError(
+                f"worker_mode must be 'thread' or 'process', got "
+                f"{worker_mode!r}")
+        if worker_mode == "process" and isinstance(dataset,
+                                                   IterableDataset):
+            raise ValueError(
+                "worker_mode='process' does not support IterableDataset "
+                "(sequential by nature); use the default thread mode")
+        self.worker_mode = worker_mode
+        self.timeout = timeout
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             if batch_sampler is not None:
@@ -380,6 +402,8 @@ class DataLoader:
                 if isinstance(b, BaseException):
                     raise b
                 yield b
+        elif self.worker_mode == "process":
+            yield from self._iter_multiprocess()
         else:
             dataset, collate = self.dataset, self.collate_fn
 
@@ -398,3 +422,158 @@ class DataLoader:
                     if nxt is not None:
                         pending.append(pool.submit(fetch, nxt))
                     yield fut.result()
+
+    def _iter_multiprocess(self):
+        import multiprocessing as mp
+        ctx = mp.get_context("fork")
+        index_q = ctx.Queue()
+        result_q = ctx.Queue()
+        user_collate = None if self.collate_fn is default_collate_fn \
+            else self.collate_fn
+        procs = [ctx.Process(
+            target=_mp_worker_loop,
+            args=(self.dataset, index_q, result_q, w, self.num_workers,
+                  self.worker_init_fn, user_collate), daemon=True)
+            for w in range(self.num_workers)]
+        for p in procs:
+            p.start()
+        guard = _MultiprocessGuard(procs, index_q)
+        try:
+            it = enumerate(iter(self.batch_sampler))
+            depth = self.num_workers * self.prefetch_factor
+            in_flight = 0
+            for _ in range(depth):
+                nxt = next(it, None)
+                if nxt is None:
+                    break
+                index_q.put(nxt)
+                in_flight += 1
+            reorder = {}
+            next_id = 0
+            deadline = self.timeout or None
+            import queue as _queue
+            import time as _time
+            while in_flight:
+                while next_id in reorder:
+                    data = reorder.pop(next_id)
+                    next_id += 1
+                    yield _tensorize(data)
+                # poll in 1s slices so dead workers are noticed even
+                # with no timeout set
+                start = _time.monotonic()
+                while True:
+                    try:
+                        batch_id, data, err = result_q.get(timeout=1.0)
+                        break
+                    except _queue.Empty:
+                        if deadline and _time.monotonic() - start > \
+                                deadline:
+                            raise RuntimeError(
+                                f"DataLoader timed out after "
+                                f"{self.timeout}s waiting for a worker "
+                                f"batch")
+                        if not any(p.is_alive() for p in procs):
+                            raise RuntimeError(
+                                "all DataLoader workers exited "
+                                "unexpectedly (see worker stderr)")
+                if batch_id == -1:
+                    raise RuntimeError(err)
+                in_flight -= 1
+                if err is not None:
+                    raise RuntimeError(
+                        f"DataLoader worker failed on batch {batch_id}: "
+                        f"{err}")
+                nxt = next(it, None)
+                if nxt is not None:
+                    index_q.put(nxt)
+                    in_flight += 1
+                reorder[batch_id] = data
+            while next_id in reorder:
+                data = reorder.pop(next_id)
+                next_id += 1
+                yield _tensorize(data)
+        finally:
+            guard.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# multiprocess workers (reference: fluid/dataloader/dataloader_iter.py,
+# worker.py — forked fetchers + shared result queue)
+# ---------------------------------------------------------------------------
+
+def _collate_numpy(batch):
+    """Worker-side collate: numpy only. jax device arrays must not be
+    touched in forked children (JAX is fork-unsafe), so Tensor samples
+    are rejected with a clear fix-it message."""
+    def check(b):
+        for smp in b:
+            if isinstance(smp, Tensor):
+                raise TypeError(
+                    "worker_mode='process' datasets must return numpy "
+                    "arrays, not Tensors (jax arrays cannot be used in "
+                    "forked workers); return numpy from __getitem__ or "
+                    "use worker_mode='thread'")
+    check(batch)
+    return _collate(batch, lambda x: x)
+
+
+def _tensorize(obj):
+    if isinstance(obj, np.ndarray):
+        return Tensor(obj)
+    if isinstance(obj, dict):
+        return {k: _tensorize(v) for k, v in obj.items()}
+    if isinstance(obj, (tuple, list)):
+        return type(obj)(_tensorize(v) for v in obj)
+    return obj
+
+
+def _mp_worker_loop(dataset, index_q, result_q, worker_id, num_workers,
+                    init_fn, collate_fn):
+    """Runs in the forked child. Exits with os._exit so inherited jax/
+    atexit state is never touched."""
+    import os as _os
+    try:
+        try:
+            _worker_info.info = _WorkerInfo(worker_id, num_workers,
+                                            dataset)
+            if init_fn:
+                init_fn(worker_id)
+        except Exception as e:  # setup failure must reach the parent
+            import traceback
+            result_q.put((-1, None, f"worker {worker_id} init failed: "
+                          f"{e}\n{traceback.format_exc()}"))
+            return
+        while True:
+            item = index_q.get()
+            if item is None:
+                break
+            batch_id, indices = item
+            try:
+                samples = [dataset[i] for i in indices]
+                data = (collate_fn(samples) if collate_fn is not None
+                        else _collate_numpy(samples))
+                result_q.put((batch_id, data, None))
+            except Exception as e:  # propagate per-batch errors
+                import traceback
+                result_q.put((batch_id, None,
+                              f"{e}\n{traceback.format_exc()}"))
+    finally:
+        result_q.cancel_join_thread()
+        _os._exit(0)
+
+
+class _MultiprocessGuard:
+    def __init__(self, procs, index_q):
+        self.procs = procs
+        self.index_q = index_q
+
+    def shutdown(self):
+        for _ in self.procs:
+            try:
+                self.index_q.put_nowait(None)
+            except Exception:
+                pass
+        for p in self.procs:
+            p.join(timeout=2)
+            if p.is_alive():
+                p.terminate()

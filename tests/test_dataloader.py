@@ -86,3 +86,58 @@ def test_tensor_dataset_and_split():
     assert len(ds) == 10
     a, b = random_split(ds, [7, 3])
     assert len(a) == 7 and len(b) == 3
+
+
+class TestMultiprocessWorkers:
+    """worker_mode='process': forked fetch + numpy collate in children
+    (reference dataloader_iter.py multiprocess path)."""
+
+    def _ds(self, n=32):
+        import numpy as np
+        from paddle_tpu.io.dataloader import Dataset
+
+        class DS(Dataset):
+            def __getitem__(self, i):
+                return (np.full((3,), i, np.float32),
+                        np.asarray([i % 2], np.int64))
+
+            def __len__(self):
+                return n
+
+        return DS()
+
+    def test_order_and_content(self):
+        import numpy as np
+        from paddle_tpu.io.dataloader import DataLoader
+        dl = DataLoader(self._ds(), batch_size=4, num_workers=2,
+                        worker_mode="process")
+        batches = list(dl)
+        assert len(batches) == 8
+        for bi, (x, y) in enumerate(batches):
+            np.testing.assert_allclose(x.numpy()[:, 0],
+                                       np.arange(bi * 4, bi * 4 + 4))
+
+    def test_worker_error_propagates(self):
+        import pytest
+        from paddle_tpu.io.dataloader import DataLoader, Dataset
+
+        class Bad(Dataset):
+            def __getitem__(self, i):
+                if i == 5:
+                    raise ValueError("boom at 5")
+                import numpy as np
+                return np.zeros(2, np.float32)
+
+            def __len__(self):
+                return 8
+
+        dl = DataLoader(Bad(), batch_size=2, num_workers=2,
+                        worker_mode="process")
+        with pytest.raises(RuntimeError, match="boom at 5"):
+            list(dl)
+
+    def test_invalid_mode_raises(self):
+        import pytest
+        from paddle_tpu.io.dataloader import DataLoader
+        with pytest.raises(ValueError, match="worker_mode"):
+            DataLoader(self._ds(), batch_size=2, worker_mode="fiber")

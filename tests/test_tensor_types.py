@@ -1,0 +1,67 @@
+"""TensorArray / SelectedRows / StringTensor (phi/core aux tensor types,
+SURVEY §2.1)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestTensorArray:
+    def test_write_read_length(self):
+        arr = paddle.create_array("float32")
+        x0 = paddle.to_tensor(np.ones(2, np.float32))
+        paddle.array_write(x0, 0, arr)
+        paddle.array_write(x0 * 2, paddle.to_tensor(np.int64(1)), arr)
+        assert paddle.array_length(arr) == 2
+        np.testing.assert_allclose(paddle.array_read(arr, 1).numpy(), 2.0)
+
+    def test_sparse_write_pads(self):
+        arr = paddle.create_array("float32")
+        paddle.array_write(paddle.to_tensor(np.ones(2, np.float32)), 3,
+                           arr)
+        assert paddle.array_length(arr) == 4
+        assert arr[0] is None
+
+    def test_initialized_list_type_check(self):
+        with pytest.raises(TypeError, match="should be Tensor"):
+            paddle.create_array("float32", [1, 2, 3])
+
+    def test_stack_concat_grad(self):
+        xs = [paddle.to_tensor(np.full((3,), i, np.float32))
+              for i in range(4)]
+        for x in xs:
+            x.stop_gradient = False
+        arr = paddle.TensorArray(initialized_list=xs)
+        s = arr.stack()
+        assert s.shape == [4, 3]
+        c = arr.concat()
+        assert c.shape == [12]
+        s.sum().backward()
+        np.testing.assert_allclose(xs[0].grad.numpy(), 1.0)
+
+
+class TestSelectedRows:
+    def test_roundtrip(self):
+        dense = paddle.to_tensor(
+            np.arange(12).reshape(4, 3).astype(np.float32))
+        sr = paddle.SelectedRows.from_dense(dense, [1, 2])
+        assert sr.height == 4 and sr.rows == [1, 2]
+        out = sr.to_dense().numpy()
+        np.testing.assert_allclose(out[1:3], dense.numpy()[1:3])
+        assert out[0].sum() == 0 and out[3].sum() == 0
+
+    def test_duplicate_rows_accumulate(self):
+        import jax.numpy as jnp
+        from paddle_tpu.core.tensor import Tensor
+        sr = paddle.SelectedRows([0, 0], 2,
+                                 Tensor(jnp.ones((2, 2))))
+        np.testing.assert_allclose(sr.to_dense().numpy()[0], 2.0)
+
+
+class TestStringTensor:
+    def test_basic(self):
+        st = paddle.StringTensor(["Alpha", "beta"])
+        assert st.shape == [2]
+        assert st[0] == "Alpha"
+        assert st.upper().numpy()[1] == "BETA"
+        assert len(st) == 2
