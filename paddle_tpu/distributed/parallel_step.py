@@ -88,7 +88,8 @@ def _zero_spec(shape, mesh, axis: str, base: Optional[P] = None) -> P:
     base_spec += [None] * (len(shape) - len(base_spec))
     if n <= 1:
         return P(*base_spec)
-    for d, size in enumerate(shape):
+    for d in reversed(range(len(shape))):
+        size = shape[d]
         if base_spec[d] is None and size % n == 0 and size >= n:
             spec = list(base_spec)
             spec[d] = axis
