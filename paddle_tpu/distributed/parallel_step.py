@@ -77,9 +77,12 @@ def shard_params(model, mesh=None):
 
 
 def _zero_spec(shape, mesh, axis: str, base: Optional[P] = None) -> P:
-    """ZeRO layout for one leaf: add `axis` on the first dim that is
+    """ZeRO layout for one leaf: add `axis` on the LAST dim that is
     divisible by the axis size and not already sharded by `base` (the
-    parameter's mp layout). Composing instead of overriding matters: a
+    parameter's mp layout). Last-dim placement composes with typical mp
+    layouts without forcing GSPMD replicate-then-repartition resharding
+    (first-dim placement triggered "involuntary full rematerialization"
+    on pipeline-stacked embedding grads). Composing instead of overriding matters: a
     zero spec that conflicts with the mp layout forces GSPMD into a
     replicate-then-repartition ("involuntary full rematerialization")
     on every grad reduce. Scalars/indivisible leaves stay at `base`."""
