@@ -70,6 +70,16 @@ from . import static  # noqa: E402
 from . import distribution  # noqa: E402
 from . import sparse  # noqa: E402
 from .hapi import Model  # noqa: E402  (paddle.Model parity)
+from .hapi import callbacks  # noqa: E402  (paddle.callbacks parity)
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Parity: paddle.summary (hapi/model_summary.py:29) — returns
+    {'total_params', 'trainable_params'}. input_size/dtypes/input are
+    accepted for API parity; parameter counting needs neither since
+    layers are eagerly materialized."""
+    from .hapi import Model as _M
+    return _M(net).summary(input_size=input_size)
 
 # default dtype management (paddle.set_default_dtype)
 _default_dtype = "float32"

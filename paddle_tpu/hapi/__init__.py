@@ -1,6 +1,9 @@
 """hapi: the high-level Model.fit API (SURVEY.md §2.8 hapi row)."""
 from .model import Model
-from .callbacks import Callback, EarlyStopping, LRScheduler, ProgBarLogger
+from .callbacks import (Callback, EarlyStopping, LRScheduler,
+                        ModelCheckpoint, ProgBarLogger, ReduceLROnPlateau,
+                        VisualDL)
 
 __all__ = ["Model", "Callback", "ProgBarLogger", "EarlyStopping",
-           "LRScheduler"]
+           "LRScheduler", "ModelCheckpoint", "ReduceLROnPlateau",
+           "VisualDL"]

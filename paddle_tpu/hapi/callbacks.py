@@ -6,6 +6,7 @@ import sys
 import time
 
 __all__ = ["Callback", "ProgBarLogger", "EarlyStopping", "LRScheduler",
+           "ModelCheckpoint", "ReduceLROnPlateau", "VisualDL",
            "config_callbacks"]
 
 
@@ -151,3 +152,133 @@ def config_callbacks(callbacks, model, verbose=1, metrics=None,
     for c in cbs:
         c.set_model(model)
     return cbs
+
+
+class ModelCheckpoint(Callback):
+    """Parity: hapi/callbacks.py:550 — save model+optimizer state every
+    save_freq epochs as save_dir/{epoch}.pdparams/.pdopt plus
+    save_dir/final.* at train end (Model.save's flat prefix layout).
+    Model.fit(save_dir=...) delegates to this callback, so the two
+    entry points share one phase convention: epochs 0, save_freq,
+    2*save_freq, ..."""
+
+    def __init__(self, save_freq=1, save_dir=None):
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def _save(self, tag):
+        import os
+        if self.save_dir is None:
+            return
+        path = os.path.join(self.save_dir, str(tag))
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self.model.save(path)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.save_dir and epoch % self.save_freq == 0:
+            self._save(epoch)
+
+    def on_train_end(self, logs=None):
+        self._save("final")
+
+
+class ReduceLROnPlateau(Callback):
+    """Parity: hapi/callbacks.py:1172 — scale the LR by `factor` when
+    `monitor` stops improving for `patience` epochs."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10,
+                 verbose=1, mode="auto", min_delta=1e-4, cooldown=0,
+                 min_lr=0.0):
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = min_delta
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        if mode not in ("auto", "min", "max"):
+            mode = "auto"
+        self.mode = mode
+        self._reset()
+
+    def _reset(self):
+        import numpy as np
+        if self.mode == "max" or (self.mode == "auto"
+                                  and "acc" in self.monitor):
+            self.monitor_op = lambda a, b: a > b + self.min_delta
+            self.best = -np.inf
+        else:
+            self.monitor_op = lambda a, b: a < b - self.min_delta
+            self.best = np.inf
+        self.wait = 0
+        self.cooldown_counter = 0
+
+    def on_epoch_end(self, epoch, logs=None):
+        logs = logs or {}
+        cur = logs.get(self.monitor)
+        if cur is None:
+            return
+        if isinstance(cur, (list, tuple)):
+            cur = cur[0]
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+        if self.monitor_op(cur, self.best):
+            self.best = cur
+            self.wait = 0
+        elif self.cooldown_counter <= 0:
+            self.wait += 1
+            if self.wait >= self.patience:
+                opt = getattr(self.model, "_optimizer", None)
+                if opt is not None:
+                    import warnings
+                    if hasattr(getattr(opt, "_learning_rate", None),
+                               "step"):
+                        # scheduler-driven LR: set_lr would raise
+                        # (reference callback warns and skips too)
+                        warnings.warn(
+                            "ReduceLROnPlateau cannot reduce an LR that "
+                            "is driven by an LRScheduler; skipping")
+                        return
+                    old = float(opt.get_lr())
+                    new = max(old * self.factor, self.min_lr)
+                    if old - new > 1e-12:
+                        opt.set_lr(new)
+                        if self.verbose:
+                            print(f"Epoch {epoch}: ReduceLROnPlateau "
+                                  f"reducing learning rate to {new}.")
+                self.cooldown_counter = self.cooldown
+                self.wait = 0
+
+
+class VisualDL(Callback):
+    """Parity: hapi/callbacks.py:883 — metric scalars to a log dir. The
+    VisualDL package is unavailable here; scalars are appended to a
+    plain JSONL file the same dashboards can ingest."""
+
+    def __init__(self, log_dir="./log"):
+        self.log_dir = log_dir
+        self._step = 0
+
+    def _write(self, tag, logs):
+        import json
+        import os
+        os.makedirs(self.log_dir, exist_ok=True)
+        path = os.path.join(self.log_dir, "scalars.jsonl")
+        record = {"tag": tag, "step": self._step}
+        for k, v in (logs or {}).items():
+            if isinstance(v, (list, tuple)) and v:
+                v = v[0]
+            if isinstance(v, (int, float)):
+                record[k] = float(v)
+        with open(path, "a") as f:
+            f.write(json.dumps(record) + "\n")
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._write("train", logs)
+
+    def on_eval_end(self, logs=None):
+        self._write("eval", logs)

@@ -136,6 +136,13 @@ class Model:
                                 num_workers=num_workers)
         self._save_dir = save_dir
         cbs = config_callbacks(callbacks, self, verbose, log_freq=log_freq)
+        if save_dir:
+            from .callbacks import ModelCheckpoint
+            if not any(isinstance(c, ModelCheckpoint) for c in cbs):
+                ck = ModelCheckpoint(save_freq=save_freq,
+                                     save_dir=save_dir)
+                ck.set_model(self)
+                cbs.append(ck)
         # a user-supplied LRScheduler callback takes over schedule
         # stepping; recomputed each fit() so dropping the callback later
         # hands stepping back to TrainStep
@@ -175,8 +182,6 @@ class Model:
                     cb.on_eval_end(eval_logs)
             for cb in cbs:
                 cb.on_epoch_end(epoch, logs)
-            if save_dir and (epoch + 1) % save_freq == 0:
-                self.save(os.path.join(save_dir, str(epoch)))
             if any(getattr(cb, "stop_training", False) for cb in cbs) or \
                     self.stop_training:
                 break
@@ -345,4 +350,7 @@ class Model:
                 lines.append(f"  {name}: {cnt:,}")
         s = "\n".join(lines)
         print(s)
-        return {"total_params": n_params}
+        trainable = sum(
+            int(np.prod(p.shape)) for p in self.network.parameters()
+            if getattr(p, "trainable", True) and not p.stop_gradient)
+        return {"total_params": n_params, "trainable_params": trainable}
