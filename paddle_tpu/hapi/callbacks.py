@@ -145,10 +145,13 @@ class LRScheduler(Callback):
 
 
 def config_callbacks(callbacks, model, verbose=1, metrics=None,
-                     log_freq=10):
+                     log_freq=10, save_dir=None, save_freq=1):
     cbs = list(callbacks or [])
     if not any(isinstance(c, ProgBarLogger) for c in cbs):
         cbs.insert(0, ProgBarLogger(log_freq=log_freq, verbose=verbose))
+    if save_dir and not any(isinstance(c, ModelCheckpoint) for c in cbs):
+        cbs.append(ModelCheckpoint(save_freq=save_freq,
+                                   save_dir=save_dir))
     for c in cbs:
         c.set_model(model)
     return cbs
@@ -201,6 +204,10 @@ class ReduceLROnPlateau(Callback):
         self.mode = mode
         self._reset()
 
+    def on_train_begin(self, logs=None):
+        # fresh plateau state per fit() (reference callbacks.py:1289)
+        self._reset()
+
     def _reset(self):
         import numpy as np
         if self.mode == "max" or (self.mode == "auto"
@@ -213,7 +220,22 @@ class ReduceLROnPlateau(Callback):
         self.wait = 0
         self.cooldown_counter = 0
 
+    def on_eval_end(self, logs=None):
+        """Reference monitors the EVAL metrics (callbacks.py:1292) — the
+        epoch-end train loss is one noisy batch."""
+        self._consider(logs)
+
     def on_epoch_end(self, epoch, logs=None):
+        # fallback for fits without eval_data: eval_* keys never appear,
+        # so only act when the raw monitor key is present AND no eval ran
+        # this epoch (eval logs are merged in as eval_<name>)
+        logs = logs or {}
+        if f"eval_{self.monitor}" in logs or any(
+                k.startswith("eval_") for k in logs):
+            return
+        self._consider(logs)
+
+    def _consider(self, logs):
         logs = logs or {}
         cur = logs.get(self.monitor)
         if cur is None:
@@ -245,8 +267,8 @@ class ReduceLROnPlateau(Callback):
                     if old - new > 1e-12:
                         opt.set_lr(new)
                         if self.verbose:
-                            print(f"Epoch {epoch}: ReduceLROnPlateau "
-                                  f"reducing learning rate to {new}.")
+                            print(f"ReduceLROnPlateau reducing learning "
+                                  f"rate to {new}.")
                 self.cooldown_counter = self.cooldown
                 self.wait = 0
 

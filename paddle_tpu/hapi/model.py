@@ -135,14 +135,9 @@ class Model:
                                 shuffle=shuffle, drop_last=drop_last,
                                 num_workers=num_workers)
         self._save_dir = save_dir
-        cbs = config_callbacks(callbacks, self, verbose, log_freq=log_freq)
-        if save_dir:
-            from .callbacks import ModelCheckpoint
-            if not any(isinstance(c, ModelCheckpoint) for c in cbs):
-                ck = ModelCheckpoint(save_freq=save_freq,
-                                     save_dir=save_dir)
-                ck.set_model(self)
-                cbs.append(ck)
+        cbs = config_callbacks(callbacks, self, verbose,
+                               log_freq=log_freq, save_dir=save_dir,
+                               save_freq=save_freq)
         # a user-supplied LRScheduler callback takes over schedule
         # stepping; recomputed each fit() so dropping the callback later
         # hands stepping back to TrainStep

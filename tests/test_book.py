@@ -83,12 +83,21 @@ class TestCallbacks:
         cb = paddle.callbacks.ReduceLROnPlateau(
             monitor="loss", factor=0.5, patience=1, verbose=0)
         cb.set_model(model)
-        cb.on_epoch_end(0, {"loss": 1.0})   # sets best
-        cb.on_epoch_end(1, {"loss": 1.0})   # patience hit -> 0.05
+        cb.on_train_begin()
+        cb.on_eval_end({"loss": 1.0})   # sets best
+        cb.on_eval_end({"loss": 1.0})   # patience hit -> 0.05
         assert abs(float(opt.get_lr()) - 0.05) < 1e-8
-        cb.on_epoch_end(2, {"loss": 0.5})   # improvement resets wait
-        cb.on_epoch_end(3, {"loss": 0.5})   # patience hit -> 0.025
+        cb.on_eval_end({"loss": 0.5})   # improvement resets wait
+        cb.on_eval_end({"loss": 0.5})   # patience hit -> 0.025
         assert abs(float(opt.get_lr()) - 0.025) < 1e-8
+        # epoch-end fallback ignores epochs where eval ran
+        cb.on_epoch_end(9, {"loss": 0.1, "eval_loss": 0.5})
+        assert abs(float(opt.get_lr()) - 0.025) < 1e-8
+        # a second fit resets plateau state
+        cb.on_train_begin()
+        import numpy as np
+        assert cb.wait == 0 and not np.isfinite(cb.best) or cb.best in (
+            np.inf, -np.inf)
 
     def test_visualdl_writes_scalars(self, tmp_path):
         import json

@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""CI runner: pytest with flaky quarantine, retries, and optional
+trace-based line coverage.
+
+Parity role: the reference's test tooling (tools/get_quick_disable_lt.py
+flaky quarantine, tools/coverage/, paddle_build.sh test stage).
+
+Usage:
+    python tools/ci.py                 # full suite minus quarantine
+    python tools/ci.py --coverage      # + stdlib-trace line coverage
+    python tools/ci.py --retries 2     # re-run failures up to 2x
+
+Quarantined tests live in tools/flaky_quarantine.txt (one pytest nodeid
+or substring per line, '#' comments). They are deselected from the main
+run and executed afterwards in best-effort mode (failures reported but
+non-fatal), the same policy as the reference's disabled-list.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+QUARANTINE = os.path.join(ROOT, "tools", "flaky_quarantine.txt")
+
+
+def _quarantine():
+    if not os.path.exists(QUARANTINE):
+        return []
+    out = []
+    for line in open(QUARANTINE):
+        line = line.split("#", 1)[0].strip()
+        if line:
+            out.append(line)
+    return out
+
+
+def _run_pytest(extra, env=None):
+    cmd = [sys.executable, "-m", "pytest", "tests/", "-q"] + extra
+    return subprocess.run(cmd, cwd=ROOT, env=env).returncode
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--coverage", action="store_true")
+    ap.add_argument("--retries", type=int, default=0)
+    ap.add_argument("-k", default=None)
+    args = ap.parse_args()
+
+    quarantined = _quarantine()
+    extra = []
+    if args.k:
+        extra += ["-k", args.k]
+    deselect = []
+    for q in quarantined:
+        deselect += ["--deselect", q]
+
+    env = dict(os.environ)
+    if args.coverage:
+        # stdlib trace-based coverage (no external deps in this image)
+        env["PADDLE_TPU_COVERAGE"] = "1"
+        extra += ["-p", "no:cacheprovider"]
+
+    rc = _run_pytest(extra + deselect, env)
+    attempt = 0
+    while rc != 0 and attempt < args.retries:
+        attempt += 1
+        print(f"\n=== retry {attempt}/{args.retries} (failed tests only) ===")
+        rc = _run_pytest(extra + deselect + ["--last-failed"], env)
+
+    if quarantined:
+        print(f"\n=== quarantined tests (best-effort, non-fatal) ===")
+        select = []
+        for q in quarantined:
+            select += [q] if "::" in q or q.endswith(".py") else \
+                ["-k", q]
+        qrc = _run_pytest(select, env)
+        if qrc != 0:
+            print("quarantined tests still failing (non-fatal)")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

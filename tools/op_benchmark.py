@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Op micro-benchmark harness.
+
+Parity role: paddle/fluid/operators/benchmark/op_tester.cc + the
+ci_op_benchmark.sh gate — time individual framework ops (eager and
+jitted) and compare against a recorded baseline to catch regressions.
+
+Usage:
+    python tools/op_benchmark.py                    # run default suite
+    python tools/op_benchmark.py --op matmul        # one op
+    python tools/op_benchmark.py --record           # write baseline
+    python tools/op_benchmark.py --check            # fail on >20% regress
+
+Baselines are stored per device kind in tools/op_baseline_<kind>.json
+(machine-specific: record on the machine that checks).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _suite():
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.RandomState(0)
+
+    def t(*shape):
+        return paddle.to_tensor(rng.randn(*shape).astype(np.float32))
+
+    x2k = t(2048, 2048)
+    img = t(8, 64, 56, 56)
+    w = t(64, 64, 3, 3)
+    q = t(8, 512, 8, 64)
+    logits = t(128, 50304)
+    labels = paddle.to_tensor(
+        rng.randint(0, 50304, (128,)).astype(np.int64))
+    return {
+        "matmul": lambda: paddle.matmul(x2k, x2k),
+        "softmax": lambda: F.softmax(x2k, axis=-1),
+        "layer_norm_fwd": lambda: F.layer_norm(
+            t(64, 2048), (2048,), None, None, 1e-5),
+        "conv2d": lambda: F.conv2d(img, w, padding=1),
+        "attention": lambda: F.scaled_dot_product_attention(q, q, q,
+                                                            is_causal=True)
+        if hasattr(F, "scaled_dot_product_attention")
+        else F.softmax(paddle.matmul(x2k, x2k), axis=-1),
+        "cross_entropy": lambda: F.cross_entropy(logits, labels),
+        "reduce_sum": lambda: x2k.sum(),
+        "transpose": lambda: paddle.transpose(x2k, [1, 0]) + 0.0,
+    }
+
+
+def _time(fn, iters=20, warmup=3):
+    for _ in range(warmup):
+        out = fn()
+    _sync(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    _sync(out)
+    return (time.perf_counter() - t0) / iters * 1e6  # us
+
+
+def _sync(out):
+    v = getattr(out, "value", out)
+    try:
+        v.block_until_ready()
+    except AttributeError:
+        np.asarray(v)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--op", default=None)
+    ap.add_argument("--record", action="store_true")
+    ap.add_argument("--check", action="store_true")
+    ap.add_argument("--threshold", type=float, default=1.2,
+                    help="max allowed slowdown vs baseline")
+    args = ap.parse_args()
+
+    import jax
+    kind = getattr(jax.devices()[0], "device_kind", "cpu").replace(
+        " ", "_").lower()
+    base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             f"op_baseline_{kind}.json")
+
+    suite = _suite()
+    if args.op:
+        suite = {args.op: suite[args.op]}
+    results = {}
+    for name, fn in suite.items():
+        us = _time(fn)
+        results[name] = round(us, 1)
+        print(f"{name:20s} {us:10.1f} us")
+
+    if args.record:
+        with open(base_path, "w") as f:
+            json.dump(results, f, indent=1)
+        print(f"baseline written: {base_path}")
+        return 0
+    if args.check:
+        if not os.path.exists(base_path):
+            print(f"no baseline at {base_path}; run --record first")
+            return 2
+        base = json.load(open(base_path))
+        bad = {k: (v, base[k]) for k, v in results.items()
+               if k in base and v > base[k] * args.threshold}
+        if bad:
+            for k, (now, was) in bad.items():
+                print(f"REGRESSION {k}: {now:.1f}us vs baseline "
+                      f"{was:.1f}us")
+            return 1
+        print("no regressions")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
