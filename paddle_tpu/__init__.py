@@ -33,6 +33,10 @@ from .tensor import *  # noqa: F401,F403,E402  (creation/math/... API)
 from .tensor import to_tensor  # noqa: F401,E402
 from .framework import seed, set_flags, get_flags  # noqa: F401,E402
 from .framework import get_rng_state, set_rng_state  # noqa: F401,E402
+# cuda-named aliases (reference exposes them top-level; one RNG here)
+get_cuda_rng_state = get_rng_state
+set_cuda_rng_state = set_rng_state
+from .framework.dtype import dtype  # noqa: E402  (paddle.dtype parity)
 from .framework.dtype import (  # noqa: F401,E402
     bool, uint8, int8, int16, int32, int64, float16, bfloat16, float32,
     float64, complex64, complex128)
@@ -43,6 +47,7 @@ from . import autograd  # noqa: F401,E402
 from . import device  # noqa: F401,E402
 from . import framework  # noqa: F401,E402
 from . import nn  # noqa: F401,E402
+from .nn import ParamAttr  # noqa: E402  (paddle.ParamAttr parity)
 from . import optimizer  # noqa: F401,E402
 from . import amp  # noqa: F401,E402
 from . import jit  # noqa: F401,E402

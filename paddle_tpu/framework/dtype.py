@@ -68,3 +68,11 @@ def is_integer(dtype):
 def is_inexact(dtype):
     """Float or complex — i.e. differentiable."""
     return jnp.issubdtype(np.dtype(dtype), jnp.inexact)
+
+
+class dtype:
+    """Parity: paddle.dtype — a callable dtype constructor/normalizer
+    (paddle.dtype('float32') == the canonical dtype object)."""
+
+    def __new__(cls, d):
+        return convert_dtype(d)

@@ -207,6 +207,7 @@ class ReduceLROnPlateau(Callback):
     def on_train_begin(self, logs=None):
         # fresh plateau state per fit() (reference callbacks.py:1289)
         self._reset()
+        self._saw_eval = False
 
     def _reset(self):
         import numpy as np
@@ -223,17 +224,16 @@ class ReduceLROnPlateau(Callback):
     def on_eval_end(self, logs=None):
         """Reference monitors the EVAL metrics (callbacks.py:1292) — the
         epoch-end train loss is one noisy batch."""
+        self._saw_eval = True
         self._consider(logs)
 
     def on_epoch_end(self, epoch, logs=None):
-        # fallback for fits without eval_data: eval_* keys never appear,
-        # so only act when the raw monitor key is present AND no eval ran
-        # this epoch (eval logs are merged in as eval_<name>)
-        logs = logs or {}
-        if f"eval_{self.monitor}" in logs or any(
-                k.startswith("eval_") for k in logs):
+        # fallback ONLY for fits with no eval at all: once any eval ran
+        # this fit, the plateau series is eval-only (mixing one-batch
+        # train losses with eval losses corrupts best/wait)
+        if getattr(self, "_saw_eval", False):
             return
-        self._consider(logs)
+        self._consider(logs or {})
 
     def _consider(self, logs):
         logs = logs or {}

@@ -25,7 +25,7 @@ __all__ = [
     "as_real", "as_complex", "view", "view_as", "atleast_1d", "atleast_2d",
     "atleast_3d", "tensordot", "shard_index", "index_add", "index_put",
     "tolist", "diagonal", "tensor_split", "dsplit", "hsplit", "vsplit",
-    "unfold", "pad",
+    "unfold", "pad", "t",
 ]
 
 
@@ -60,6 +60,10 @@ def transpose(x, perm, name=None):
 
 
 def t(x, name=None):
+    if x.ndim > 2:
+        raise ValueError(
+            f"paddle.t only supports a tensor whose dimension is <= 2, "
+            f"but got {x.ndim}")
     if x.ndim < 2:
         return x.clone()
     return transpose(x, [1, 0])

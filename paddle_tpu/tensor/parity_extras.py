@@ -1,0 +1,382 @@
+"""Top-level API closure — the last ~30 symbols of the reference's
+python/paddle/__init__.py __all__ not covered elsewhere: small tensor
+ops (addmm/kron/logit/nan_to_num/...), dtype info (finfo/iinfo), place
+shims, printing options, and the flops counter.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd.tape import apply
+from ..core.tensor import Tensor
+
+__all__ = [
+    "addmm", "batch", "broadcast_shape", "check_shape", "create_parameter",
+    "disable_signal_handler", "finfo", "floor_mod", "flops", "frexp",
+    "increment", "kron", "logit", "mm", "multiplex", "nan_to_num",
+    "renorm", "reverse", "scatter_", "scatter_nd", "set_printoptions",
+    "take", "tanh_", "CPUPlace", "CUDAPlace", "CUDAPinnedPlace",
+    "NPUPlace", "LazyGuard",
+]
+
+
+def _v(x):
+    return x.value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+# ---------------------------------------------------------------------------
+# math
+# ---------------------------------------------------------------------------
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """Parity: tensor/math.py addmm — beta*input + alpha*(x @ y)."""
+    return apply(lambda i, a, b: beta * i + alpha * (a @ b), input, x, y,
+                 _op_name="addmm")
+
+
+def mm(input, mat2, name=None):
+    """Parity: tensor/math.py mm (matmul without broadcast)."""
+    return apply(lambda a, b: a @ b, input, mat2, _op_name="mm")
+
+
+def floor_mod(x, y, name=None):
+    """Parity alias: floor_mod == mod/remainder."""
+    from .math import mod
+    return mod(x, y)
+
+
+def frexp(x, name=None):
+    """Parity: tensor/math.py frexp — (mantissa, exponent) with
+    mantissa in [0.5, 1)."""
+
+    def f(v):
+        m, e = jnp.frexp(v)
+        return m, e.astype(jnp.int32)
+
+    return apply(f, x, _op_name="frexp")
+
+
+def kron(x, y, name=None):
+    """Parity: tensor/math.py kron."""
+    return apply(jnp.kron, x, y, _op_name="kron")
+
+
+def logit(x, eps=None, name=None):
+    """Parity: tensor/math.py logit — log(p/(1-p)); out-of-range -> nan
+    unless eps clamps."""
+
+    def f(v):
+        if eps is not None:
+            v = jnp.clip(v, eps, 1.0 - eps)
+        else:
+            v = jnp.where((v < 0) | (v > 1), jnp.nan, v)
+        return jnp.log(v / (1.0 - v))
+
+    return apply(f, x, _op_name="logit")
+
+
+def multiplex(inputs, index, name=None):
+    """Parity: tensor/math.py multiplex — row i of the output comes from
+    inputs[index[i]] row i."""
+
+    def f(idx, *ins):
+        stacked = jnp.stack(ins, 0)           # (K, B, ...)
+        rows = jnp.arange(stacked.shape[1])
+        return stacked[idx.reshape(-1).astype(jnp.int32), rows]
+
+    return apply(f, index, *inputs, _op_name="multiplex")
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    """Parity: tensor/math.py nan_to_num."""
+    return apply(lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf,
+                                          neginf=neginf),
+                 x, _op_name="nan_to_num")
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Parity: tensor/math.py renorm — rescale slices along `axis` whose
+    p-norm exceeds max_norm down to exactly max_norm."""
+
+    def f(v):
+        axes = tuple(i for i in range(v.ndim) if i != axis)
+        norms = jnp.sum(jnp.abs(v) ** p, axis=axes,
+                        keepdims=True) ** (1.0 / p)
+        scale = jnp.where(norms > max_norm,
+                          max_norm / jnp.maximum(norms, 1e-12), 1.0)
+        return v * scale
+
+    return apply(f, x, _op_name="renorm")
+
+
+def take(x, index, mode="raise", name=None):
+    """Parity: tensor/math.py take — flat-index gather with raise/wrap/
+    clip bounds modes."""
+
+    def f(v, idx):
+        flat = v.reshape(-1)
+        i = idx.astype(jnp.int64)
+        n = flat.shape[0]
+        if mode == "wrap":
+            i = ((i % n) + n) % n
+        else:  # raise (jit cannot raise: clamp like reference kernels)
+            i = jnp.clip(i, -n, n - 1)
+            i = jnp.where(i < 0, i + n, i)
+        if mode == "clip":
+            i = jnp.clip(idx.astype(jnp.int64), 0, n - 1)
+        return flat[i]
+
+    return apply(f, x, index, _op_name="take")
+
+
+def increment(x, value=1.0, name=None):
+    """Parity: tensor/math.py increment — in-place add on a size-1
+    tensor."""
+    assert int(np.prod(x.shape)) == 1, "increment expects a 1-element tensor"
+    x.value = x.value + value
+    return x
+
+
+def tanh_(x, name=None):
+    """Parity: inplace tanh."""
+    x.value = jnp.tanh(x.value)
+    return x
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    """Parity: inplace scatter (tensor/manipulation.py scatter_)."""
+    from .manipulation import scatter
+    out = scatter(x, index, updates, overwrite)
+    x.value = out.value
+    return x
+
+
+def scatter_nd(index, updates, shape, name=None):
+    """Parity: tensor/manipulation.py scatter_nd — scatter-add updates
+    into zeros(shape) at multi-dim indices."""
+
+    def f(idx, upd):
+        out = jnp.zeros(tuple(shape), upd.dtype)
+        ii = tuple(jnp.moveaxis(idx, -1, 0).astype(jnp.int32))
+        return out.at[ii].add(upd)
+
+    return apply(f, index, updates, _op_name="scatter_nd")
+
+
+def reverse(x, axis, name=None):
+    """Parity alias of flip (reverse was the fluid-era name)."""
+    from .manipulation import flip
+    return flip(x, axis)
+
+
+def broadcast_shape(x_shape, y_shape):
+    """Parity: tensor/manipulation.py broadcast_shape (pure shape math)."""
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+# ---------------------------------------------------------------------------
+# dtype info / printing / misc
+# ---------------------------------------------------------------------------
+
+class finfo:
+    """Parity: paddle.finfo."""
+
+    def __init__(self, dtype):
+        from ..framework.dtype import convert_dtype
+        info = jnp.finfo(convert_dtype(dtype))
+        self.dtype = str(info.dtype)
+        self.bits = info.bits
+        self.eps = float(info.eps)
+        self.min = float(info.min)
+        self.max = float(info.max)
+        self.tiny = float(info.tiny)
+        self.smallest_normal = float(info.tiny)
+        self.resolution = float(info.resolution)
+
+
+class iinfo:
+    """Parity: paddle.iinfo."""
+
+    def __init__(self, dtype):
+        from ..framework.dtype import convert_dtype
+        info = jnp.iinfo(convert_dtype(dtype))
+        self.dtype = str(np.dtype(info.dtype))
+        self.bits = info.bits
+        self.min = int(info.min)
+        self.max = int(info.max)
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Parity: paddle.set_printoptions — applies to numpy rendering of
+    tensors (jax delegates repr to numpy)."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+def check_shape(shape):
+    """Parity: the reference's shape checker for creation APIs."""
+    for s in shape:
+        if not isinstance(s, (int, np.integer)) or int(s) < -1:
+            raise ValueError(f"invalid dimension {s!r} in shape {shape}")
+    return list(int(s) for s in shape)
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Parity: paddle.create_parameter — a free-standing Parameter."""
+    from ..core.tensor import Parameter
+    from ..nn import initializer as I
+    init = default_initializer or (
+        I.Constant(0.0) if is_bias else I.XavierNormal())
+    return Parameter(init(list(shape), dtype), name=name)
+
+
+def disable_signal_handler():
+    """Parity: paddle.disable_signal_handler — the reference unhooks its
+    C++ signal handlers; this build never installs any, so no-op."""
+
+
+class LazyGuard:
+    """Parity: paddle.LazyGuard — the reference defers parameter
+    materialization; initialization here is already lazy at the XLA
+    level (arrays materialize on first use), so this is a documented
+    no-op context."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Parity: paddle.batch — wrap a sample reader into a batch reader
+    (legacy reader protocol)."""
+
+    def batch_reader():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batch_reader
+
+
+# ---------------------------------------------------------------------------
+# places (PJRT subsumes placement; these are API shims that map onto the
+# single device namespace — reference: paddle/phi/common/place.h)
+# ---------------------------------------------------------------------------
+
+class CPUPlace:
+    def __repr__(self):
+        return "Place(cpu)"
+
+    def __eq__(self, other):
+        return isinstance(other, CPUPlace)
+
+
+class CUDAPlace:
+    def __init__(self, device_id=0):
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"Place(gpu:{self.device_id})"
+
+    def __eq__(self, other):
+        return isinstance(other, CUDAPlace) and \
+            other.device_id == self.device_id
+
+
+class CUDAPinnedPlace:
+    def __repr__(self):
+        return "Place(gpu_pinned)"
+
+
+class NPUPlace:
+    def __init__(self, device_id=0):
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"Place(npu:{self.device_id})"
+
+
+# ---------------------------------------------------------------------------
+# flops counter
+# ---------------------------------------------------------------------------
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Parity: paddle.flops (hapi/dynamic_flops.py) — run one forward
+    with per-layer hooks, count multiply-accumulates for the common
+    layer types."""
+    from ..nn.layer_base import Layer
+
+    counts = {}
+
+    def count(layer, name, x, y):
+        cls = type(layer).__name__.lower()
+        n = 0
+        out_elems = int(np.prod(y.shape)) if hasattr(y, "shape") else 0
+        if custom_ops and type(layer) in custom_ops:
+            n = int(custom_ops[type(layer)](layer, x, y))
+        elif "linear" in cls:
+            n = int(np.prod(layer.weight.shape)) * \
+                (out_elems // layer.weight.shape[-1])
+        elif "conv" in cls and hasattr(layer, "weight"):
+            k = int(np.prod(layer.weight.shape[1:]))
+            n = out_elems * k
+        elif "norm" in cls:
+            n = 2 * int(np.prod(x.shape)) if hasattr(x, "shape") else 0
+        if n:
+            counts[name] = counts.get(name, 0) + n
+
+    handles = []
+    for name, sub in net.named_sublayers():
+        if isinstance(sub, Layer) and not sub._sub_layers:
+            def make_hook(nm):
+                def hook(layer, inputs, output):
+                    xi = inputs[0] if isinstance(inputs, (tuple, list)) \
+                        else inputs
+                    count(layer, nm, xi, output)
+                return hook
+            if hasattr(sub, "register_forward_post_hook"):
+                handles.append(sub.register_forward_post_hook(
+                    make_hook(name)))
+
+    from ..framework import seed as _seed
+    x = Tensor(jnp.zeros(tuple(input_size), jnp.float32))
+    was_training = net.training
+    net.eval()
+    try:
+        net(x)
+    finally:
+        if was_training:
+            net.train()
+        for h in handles:
+            try:
+                h.remove()
+            except Exception:
+                pass
+    total = sum(counts.values())
+    if print_detail:
+        for k, v in sorted(counts.items()):
+            print(f"{k:40s} {v:,}")
+        print(f"Total FLOPs: {total:,}")
+    return total

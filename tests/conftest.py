@@ -42,3 +42,68 @@ def pytest_configure(config):
     ret = subprocess.call([sys.executable, "-m", "pytest"] + sys.argv[1:],
                           env=env)
     os._exit(ret)
+
+
+# ---------------------------------------------------------------------------
+# optional line coverage (tools/ci.py --coverage): stdlib sys.monitoring,
+# restricted to paddle_tpu/ — the reference's tools/coverage/ role without
+# external packages.
+# ---------------------------------------------------------------------------
+
+_COV_TOOL = 3          # sys.monitoring tool id reserved for coverage
+_cov_hits = {}
+
+
+def _cov_enabled():
+    return os.environ.get("PADDLE_TPU_COVERAGE") and _env_ok()
+
+
+def pytest_sessionstart(session):
+    if not _cov_enabled():
+        return
+    pkg = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "paddle_tpu")
+    mon = sys.monitoring
+    mon.use_tool_id(_COV_TOOL, "paddle_tpu_cov")
+
+    def on_line(code, line):
+        fn = code.co_filename
+        if fn.startswith(pkg):
+            _cov_hits.setdefault(fn, set()).add(line)
+            return None
+        return mon.DISABLE  # stop monitoring this location
+
+    mon.register_callback(_COV_TOOL, mon.events.LINE, on_line)
+    mon.set_events(_COV_TOOL, mon.events.LINE)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if not _cov_enabled() or not _cov_hits:
+        return
+    import ast
+    sys.monitoring.set_events(_COV_TOOL, 0)
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rows = []
+    tot_hit = tot_all = 0
+    for fn in sorted(_cov_hits):
+        try:
+            tree = ast.parse(open(fn).read())
+        except (OSError, SyntaxError):
+            continue
+        execable = {n.lineno for n in ast.walk(tree)
+                    if isinstance(n, ast.stmt)}
+        hit = len(_cov_hits[fn] & execable) or len(_cov_hits[fn])
+        total = max(len(execable), hit)
+        tot_hit += hit
+        tot_all += total
+        rel = os.path.relpath(fn, root)
+        rows.append(f"{rel:60s} {hit:5d}/{total:<5d} "
+                    f"{100.0 * hit / total:5.1f}%")
+    report = os.path.join(root, "tools", "coverage_report.txt")
+    with open(report, "w") as f:
+        f.write("\n".join(rows))
+        if tot_all:
+            f.write(f"\n\nTOTAL {tot_hit}/{tot_all} "
+                    f"({100.0 * tot_hit / tot_all:.1f}%)\n")
+    print(f"\ncoverage report: {report} "
+          f"({100.0 * tot_hit / max(tot_all, 1):.1f}% of touched files)")

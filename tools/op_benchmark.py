@@ -104,8 +104,12 @@ def main():
         print(f"{name:20s} {us:10.1f} us")
 
     if args.record:
+        merged = {}
+        if os.path.exists(base_path):
+            merged = json.load(open(base_path))
+        merged.update(results)  # --op records merge into the full set
         with open(base_path, "w") as f:
-            json.dump(results, f, indent=1)
+            json.dump(merged, f, indent=1)
         print(f"baseline written: {base_path}")
         return 0
     if args.check:
