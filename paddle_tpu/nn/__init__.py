@@ -11,6 +11,7 @@ from .layer.pooling import *  # noqa: F401,F403
 from .layer.transformer import *  # noqa: F401,F403
 from .layer.rnn import *  # noqa: F401,F403
 from .layer.loss import *  # noqa: F401,F403
+from .layer.seq_decode import BeamSearchDecoder, dynamic_decode  # noqa: F401
 
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
 from .utils import parameters_to_vector, vector_to_parameters  # noqa: F401

@@ -6,3 +6,4 @@ from .pooling import *  # noqa: F401,F403
 from .norm import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
 from .flash_attention import *  # noqa: F401,F403
+from .extras import *  # noqa: F401,F403

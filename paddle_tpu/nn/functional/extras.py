@@ -1,0 +1,345 @@
+"""Functional API closure — the remaining nn.functional symbols of the
+reference surface (python/paddle/nn/functional/__init__.py): spatial
+transformer ops (affine_grid/grid_sample), sequence utilities
+(sequence_mask/gather_tree), sampling (gumbel_softmax,
+class_center_sample), margin softmax, small losses and inplace aliases.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...autograd.tape import apply
+from ...core.tensor import Tensor
+from ...framework import random as _rng
+
+__all__ = ["affine_grid", "grid_sample", "diag_embed", "dice_loss",
+           "npair_loss", "elu_", "softmax_", "tanh_", "gather_tree",
+           "gumbel_softmax", "margin_cross_entropy", "sequence_mask",
+           "class_center_sample", "sparse_attention", "temporal_shift",
+           "zeropad2d"]
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """Parity: nn/functional/vision.py affine_grid — sampling grid from
+    a batch of 2x3 (2D) or 3x4 (3D) affine matrices."""
+    shape = [int(s.item()) if isinstance(s, Tensor) else int(s)
+             for s in (out_shape.value if isinstance(out_shape, Tensor)
+                       else out_shape)]
+
+    def lin(n):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, n)
+        step = 2.0 / n
+        return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, n)
+
+    def f(th):
+        if len(shape) == 4:  # (N, C, H, W) -> grid (N, H, W, 2)
+            _, _, H, W = shape
+            ys, xs = jnp.meshgrid(lin(H), lin(W), indexing="ij")
+            base = jnp.stack([xs, ys, jnp.ones_like(xs)], -1)  # (H,W,3)
+            return jnp.einsum("hwk,nik->nhwi", base, th)
+        _, _, D, H, W = shape  # 3D: grid (N, D, H, W, 3)
+        zs, ys, xs = jnp.meshgrid(lin(D), lin(H), lin(W), indexing="ij")
+        base = jnp.stack([xs, ys, zs, jnp.ones_like(xs)], -1)
+        return jnp.einsum("dhwk,nik->ndhwi", base, th)
+
+    return apply(f, theta, _op_name="affine_grid")
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Parity: nn/functional/vision.py grid_sample — sample NCHW input
+    at normalized grid locations (N, Ho, Wo, 2)."""
+
+    def f(v, g):
+        N, C, H, W = v.shape
+
+        def unnorm(coord, size):
+            if align_corners:
+                return (coord + 1.0) / 2.0 * (size - 1)
+            return ((coord + 1.0) * size - 1.0) / 2.0
+
+        gx = unnorm(g[..., 0], W)
+        gy = unnorm(g[..., 1], H)
+        if padding_mode == "border":
+            gx = jnp.clip(gx, 0, W - 1)
+            gy = jnp.clip(gy, 0, H - 1)
+        elif padding_mode == "reflection":
+            def reflect(c, size):
+                if align_corners:
+                    span = 2 * (size - 1)
+                    c = jnp.abs(c) % jnp.maximum(span, 1)
+                    return jnp.where(c > size - 1, span - c, c)
+                span = 2 * size
+                c = (c + 0.5) % span
+                c = jnp.where(c > size, span - c, c) - 0.5
+                return jnp.clip(c, 0, size - 1)
+            gx = reflect(gx, W)
+            gy = reflect(gy, H)
+
+        def sample(yy, xx):
+            # (N, Ho, Wo) int coords -> (N, C, Ho, Wo) values with
+            # zero padding outside
+            valid = (yy >= 0) & (yy < H) & (xx >= 0) & (xx < W)
+            yc = jnp.clip(yy, 0, H - 1)
+            xc = jnp.clip(xx, 0, W - 1)
+            b = jnp.arange(N)[:, None, None]
+            out = v[b[:, None], jnp.arange(C)[None, :, None, None],
+                    yc[:, None], xc[:, None]]
+            return out * valid[:, None].astype(v.dtype)
+
+        if mode == "nearest":
+            return sample(jnp.round(gy).astype(jnp.int32),
+                          jnp.round(gx).astype(jnp.int32))
+        x0 = jnp.floor(gx)
+        y0 = jnp.floor(gy)
+        wx = gx - x0
+        wy = gy - y0
+        x0i = x0.astype(jnp.int32)
+        y0i = y0.astype(jnp.int32)
+        out = (sample(y0i, x0i) * ((1 - wy) * (1 - wx))[:, None]
+               + sample(y0i, x0i + 1) * ((1 - wy) * wx)[:, None]
+               + sample(y0i + 1, x0i) * (wy * (1 - wx))[:, None]
+               + sample(y0i + 1, x0i + 1) * (wy * wx)[:, None])
+        return out
+
+    return apply(f, x, grid, _op_name="grid_sample")
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    """Parity: nn/functional/extension.py diag_embed."""
+
+    def f(v):
+        n = v.shape[-1] + abs(offset)
+        out_shape = v.shape[:-1] + (n, n)
+        out = jnp.zeros(out_shape, v.dtype)
+        idx = jnp.arange(v.shape[-1])
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        out = out.at[..., r, c].set(v)
+        # move the two new axes to dim1/dim2
+        nd = out.ndim
+        d1 = dim1 % nd
+        d2 = dim2 % nd
+        perm = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+        order = sorted([(d1, nd - 2), (d2, nd - 1)])
+        for pos, src in order:
+            perm.insert(pos, src)
+        return jnp.transpose(out, perm)
+
+    return apply(f, input, _op_name="diag_embed")
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """Parity: nn/functional/loss.py dice_loss — 1 - 2|X∩Y|/(|X|+|Y|)
+    per batch row, averaged."""
+
+    def f(x, y):
+        yh = jax.nn.one_hot(y[..., 0].astype(jnp.int32), x.shape[-1],
+                            dtype=x.dtype)
+        reduce_dims = tuple(range(1, x.ndim))
+        inter = jnp.sum(x * yh, axis=reduce_dims)
+        union = jnp.sum(x, axis=reduce_dims) + jnp.sum(yh,
+                                                       axis=reduce_dims)
+        return jnp.mean(1.0 - (2.0 * inter + epsilon) / (union + epsilon))
+
+    return apply(f, input, label, _op_name="dice_loss")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """Parity: nn/functional/loss.py npair_loss (Sohn 2016)."""
+
+    def f(a, p, y):
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, -1))
+                        + jnp.mean(jnp.sum(p * p, -1))) * 0.25
+        sim = a @ p.T                                   # (B, B)
+        same = (y.reshape(-1, 1) == y.reshape(1, -1)).astype(a.dtype)
+        tgt = same / jnp.maximum(same.sum(-1, keepdims=True), 1)
+        logp = jax.nn.log_softmax(sim, -1)
+        ce = -jnp.mean(jnp.sum(tgt * logp, -1))
+        return ce + reg
+
+    return apply(f, anchor, positive, labels, _op_name="npair_loss")
+
+
+def elu_(x, alpha=1.0, name=None):
+    from .activation import elu
+    x.value = elu(x, alpha).value
+    return x
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    from .activation import softmax
+    x.value = softmax(x, axis=axis).value
+    return x
+
+
+def tanh_(x, name=None):
+    x.value = jnp.tanh(x.value)
+    return x
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """Parity: nn/functional/extension.py sequence_mask — lengths ->
+    [.., maxlen] 0/1 mask."""
+    from ...framework.dtype import convert_dtype
+    xv = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+    m = int(maxlen) if maxlen is not None else int(jax.device_get(
+        jnp.max(xv)))
+
+    def f(lens):
+        return (jnp.arange(m) < lens[..., None]).astype(
+            convert_dtype(dtype))
+
+    return apply(f, x, _op_name="sequence_mask")
+
+
+def gather_tree(ids, parents, name=None):
+    """Parity: nn/functional/extension.py gather_tree — back-trace beam
+    parents so every step holds the full surviving path. ids/parents:
+    (max_time, batch, beam)."""
+
+    def f(idv, par):
+        T = idv.shape[0]
+
+        def step(nxt_beam, t):
+            # nxt_beam: (batch, beam) beam index at step t+1
+            cur = jnp.take_along_axis(par[t], nxt_beam, axis=-1)
+            tok = jnp.take_along_axis(idv[t], nxt_beam, axis=-1)
+            return cur, tok
+
+        last = jnp.broadcast_to(jnp.arange(idv.shape[2]),
+                                idv.shape[1:])
+        _, toks = jax.lax.scan(step, last, jnp.arange(T), reverse=True)
+        return toks
+
+    return apply(f, ids, parents, _op_name="gather_tree")
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    """Parity: nn/functional/activation.py gumbel_softmax — one
+    implementation for paddle.gumbel_softmax and F.gumbel_softmax."""
+    from ...tensor.random import gumbel_softmax as _gs
+    return _gs(x, temperature=temperature, hard=hard, axis=axis)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean",
+                         name=None):
+    """Parity: nn/functional/loss.py margin_cross_entropy (ArcFace
+    combined margin: cos(m1*theta + m2) - m3, scaled)."""
+
+    def f(lg, y):
+        yi = y.reshape(-1).astype(jnp.int32)
+        cos = jnp.clip(lg, -1.0, 1.0)
+        theta = jnp.arccos(cos)
+        tgt = jnp.cos(margin1 * theta + margin2) - margin3
+        onehot = jax.nn.one_hot(yi, lg.shape[-1], dtype=lg.dtype)
+        adj = jnp.where(onehot > 0, tgt, cos) * scale
+        logp = jax.nn.log_softmax(adj, -1)
+        per = -jnp.take_along_axis(logp, yi[:, None], -1)[:, 0]
+        sm = jax.nn.softmax(adj, -1)
+        if reduction == "mean":
+            loss = jnp.mean(per)
+        elif reduction == "sum":
+            loss = jnp.sum(per)
+        else:
+            loss = per[:, None]
+        return (loss, sm) if return_softmax else loss
+
+    return apply(f, logits, label, _op_name="margin_cross_entropy")
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Parity: nn/functional/common.py class_center_sample (PartialFC):
+    keep all positive class centers + uniformly sampled negatives;
+    remap labels into the sampled index space. Host-side sampling
+    (data-dependent sizes), single-rank semantics."""
+    lbl = np.asarray(label.value if isinstance(label, Tensor) else label)
+    pos = np.unique(lbl)
+    n_extra = max(0, num_samples - len(pos))
+    rest = np.setdiff1d(np.arange(num_classes), pos)
+    import jax as _jax
+    seed = int(_jax.random.randint(_rng.next_key(), (), 0, 2 ** 31 - 1))
+    rng = np.random.RandomState(seed)
+    extra = rng.choice(rest, size=min(n_extra, len(rest)), replace=False) \
+        if n_extra and len(rest) else np.empty(0, np.int64)
+    sampled = np.sort(np.concatenate([pos, extra]).astype(np.int64))
+    remap = {c: i for i, c in enumerate(sampled)}
+    new_lbl = np.asarray([remap[c] for c in lbl], np.int64)
+    return (Tensor(jnp.asarray(new_lbl), stop_gradient=True),
+            Tensor(jnp.asarray(sampled), stop_gradient=True))
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """Parity: nn/functional/sparse_attention.py — attention restricted
+    to a per-row CSR sparsity pattern. The reference is a CUDA kernel;
+    here the pattern lowers to a dense additive mask (exact semantics;
+    the XLA fusion keeps it one kernel — a Pallas block-sparse kernel is
+    the optimization path for long sequences)."""
+    offs = np.asarray(sparse_csr_offset.value
+                      if isinstance(sparse_csr_offset, Tensor)
+                      else sparse_csr_offset)
+    cols = np.asarray(sparse_csr_columns.value
+                      if isinstance(sparse_csr_columns, Tensor)
+                      else sparse_csr_columns)
+
+    def build_mask(S):
+        m = np.zeros((offs.shape[0], offs.shape[1], S, S), bool)
+        for b in range(offs.shape[0]):
+            for h in range(offs.shape[1]):
+                o = offs[b, h]
+                c = cols[b, h]
+                for r in range(S):
+                    m[b, h, r, c[o[r]:o[r + 1]]] = True
+        return m
+
+    def f(q, k, v):
+        S, d = q.shape[2], q.shape[3]
+        mask = jnp.asarray(build_mask(S))
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(
+            jnp.asarray(d, q.dtype))
+        logits = jnp.where(mask, logits, jnp.asarray(-1e30, q.dtype))
+        probs = jax.nn.softmax(logits, -1)
+        return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+    return apply(f, query, key, value, _op_name="sparse_attention")
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """Parity: nn/functional/extension.py temporal_shift (TSM)."""
+
+    def f(v):
+        NT, C, H, W = v.shape
+        N = NT // seg_num
+        r = v.reshape(N, seg_num, C, H, W)
+        c1 = int(C * shift_ratio)
+        c2 = int(C * 2 * shift_ratio)
+        fwd = jnp.pad(r[:, 1:, :c1], ((0, 0), (0, 1), (0, 0), (0, 0),
+                                      (0, 0)))
+        bwd = jnp.pad(r[:, :-1, c1:c2], ((0, 0), (1, 0), (0, 0), (0, 0),
+                                         (0, 0)))
+        keep = r[:, :, c2:]
+        return jnp.concatenate([fwd, bwd, keep], 2).reshape(NT, C, H, W)
+
+    return apply(f, x, _op_name="temporal_shift")
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    """Parity: nn/functional/common.py zeropad2d."""
+    l, r, t, b = (padding if isinstance(padding, (list, tuple))
+                  else (padding,) * 4)
+
+    def f(v):
+        if data_format == "NCHW":
+            return jnp.pad(v, ((0, 0), (0, 0), (t, b), (l, r)))
+        return jnp.pad(v, ((0, 0), (t, b), (l, r), (0, 0)))
+
+    return apply(f, x, _op_name="zeropad2d")

@@ -1,0 +1,139 @@
+"""Seq2seq decoding: BeamSearchDecoder + dynamic_decode.
+
+Parity: python/paddle/nn/decode.py (BeamSearchDecoder, dynamic_decode).
+Eager host-driven loop (the reference's dygraph path is a Python while
+loop too); each step's cell/beam math is device compute, and the beam
+bookkeeping (topk over beam*vocab, parent gather) is vectorized jnp.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+
+__all__ = ["BeamSearchDecoder", "dynamic_decode"]
+
+
+def _v(x):
+    return x.value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class BeamSearchDecoder:
+    """Parity: nn/decode.py BeamSearchDecoder.
+
+    cell: an RNN cell `(inputs, states) -> (outputs, new_states)` whose
+    outputs feed `output_fn` (projection to vocab logits).
+    embedding_fn maps token ids -> embeddings for the next step.
+    """
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # -- helpers (reference exposes these as static utilities) ----------
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """(batch, ...) -> (batch*beam, ...) by repeating each row."""
+        v = _v(x)
+        tiled = jnp.repeat(v[:, None], beam_size, axis=1)
+        return Tensor(tiled.reshape((-1,) + v.shape[1:]))
+
+    def _merge(self, v):
+        return v.reshape((-1,) + v.shape[2:])
+
+    def _split(self, v, batch):
+        return v.reshape((batch, self.beam_size) + v.shape[1:])
+
+    def initialize(self, initial_cell_states):
+        states = jax.tree_util.tree_map(
+            lambda s: self._merge(jnp.repeat(_v(s)[:, None],
+                                             self.beam_size, axis=1)),
+            initial_cell_states)
+        batch = _v(jax.tree_util.tree_leaves(initial_cell_states)[0]
+                   ).shape[0]
+        ids = jnp.full((batch, self.beam_size), self.start_token,
+                       jnp.int32)
+        # only beam 0 live at t=0 so the first topk doesn't pick
+        # duplicate start beams
+        log_probs = jnp.tile(
+            jnp.asarray([0.0] + [-1e9] * (self.beam_size - 1),
+                        jnp.float32)[None], (batch, 1))
+        finished = jnp.zeros((batch, self.beam_size), bool)
+        return ids, states, log_probs, finished
+
+    def step(self, inputs, states, log_probs, finished):
+        out, new_states = self.cell(inputs, states)
+        logits = self.output_fn(out) if self.output_fn else out
+        logits = _v(logits)
+        batch = logits.shape[0] // self.beam_size
+        V = logits.shape[-1]
+        step_lp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+        step_lp = step_lp.reshape(batch, self.beam_size, V)
+        fin = finished.reshape(batch, self.beam_size)
+        # finished beams only extend with end_token at 0 cost
+        mask = jnp.full((V,), -1e9).at[self.end_token].set(0.0)
+        step_lp = jnp.where(fin[..., None], mask[None, None], step_lp)
+        total = log_probs[..., None] + step_lp             # (B, K, V)
+        flat = total.reshape(batch, -1)
+        new_lp, flat_idx = jax.lax.top_k(flat, self.beam_size)
+        parent = flat_idx // V                             # (B, K)
+        token = flat_idx % V
+        new_fin = jnp.take_along_axis(fin, parent, 1) | \
+            (token == self.end_token)
+        gathered = jax.tree_util.tree_map(
+            lambda s: self._merge(jnp.take_along_axis(
+                self._split(s, batch),
+                parent.reshape(parent.shape + (1,) * (s.ndim - 1))
+                .astype(jnp.int32), 1)),
+            new_states)
+        return token, parent, gathered, new_lp, new_fin
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """Parity: nn/decode.py dynamic_decode — run the decoder until every
+    beam finishes or max_step_num; returns (ids, log_probs[, lengths])
+    with ids (batch, beam, time) (time-major when requested)."""
+    assert max_step_num is not None, "max_step_num is required"
+    ids, states, log_probs, finished = decoder.initialize(inits)
+    batch, K = ids.shape
+    tokens_t = []
+    parents_t = []
+    lengths = jnp.zeros((batch, K), jnp.int32)
+    cur_tokens = ids[:, :]
+    for t in range(int(max_step_num)):
+        inp_ids = Tensor(cur_tokens.reshape(-1))
+        inputs = decoder.embedding_fn(inp_ids) if decoder.embedding_fn \
+            else inp_ids
+        token, parent, states, log_probs, finished = decoder.step(
+            inputs, states, log_probs, finished)
+        tokens_t.append(token)
+        parents_t.append(parent)
+        # lengths follow beam LINEAGES, not slots: gather by parent
+        # before extending
+        lengths = jnp.take_along_axis(lengths, parent, 1) \
+            + (~finished).astype(jnp.int32)
+        cur_tokens = token
+        if bool(jax.device_get(jnp.all(finished))):
+            break
+    # back-trace beam ancestry so each beam holds its own full path
+    from ..functional.extras import gather_tree
+    ids_arr = jnp.stack(tokens_t, 0)       # (T, B, K)
+    par_arr = jnp.stack(parents_t, 0)
+    full = _v(gather_tree(Tensor(ids_arr), Tensor(par_arr)))  # (T, B, K)
+    out = full if output_time_major else jnp.transpose(full, (1, 2, 0))
+    res = (Tensor(out), Tensor(log_probs))
+    if return_length:
+        res = res + (Tensor(lengths),)
+    return res

