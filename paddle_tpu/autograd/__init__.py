@@ -2,4 +2,4 @@
 from .tape import (backward, grad, no_grad, enable_grad, set_grad_enabled,  # noqa: F401
                    is_grad_enabled)
 from .functional import jacobian, hessian, vjp, jvp  # noqa: F401
-from .py_layer import PyLayer, PyLayerContext  # noqa: F401
+from .py_layer import PyLayer, PyLayerContext, saved_tensors_hooks  # noqa: F401

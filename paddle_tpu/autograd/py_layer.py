@@ -18,10 +18,22 @@ class PyLayerContext:
         self.non_differentiable = set()
 
     def save_for_backward(self, *tensors):
+        hooks = saved_tensors_hooks._active
+        if hooks:
+            h = hooks[-1]
+            tensors = tuple(h.pack_hook(t) for t in tensors)
+            self._packed = True
+            self._pack_ctx = h
         self._saved = [t.detach() if isinstance(t, Tensor) else t
                        for t in tensors]
 
     def saved_tensor(self):
+        if getattr(self, "_packed", False):
+            # unpack with the SAME hook pair that packed (a different
+            # hook context may be active at backward time)
+            h = getattr(self, "_pack_ctx", None)
+            if h is not None:
+                return tuple(h.unpack_hook(t) for t in self._saved)
         return tuple(self._saved)
 
     saved_tensors = saved_tensor
@@ -84,3 +96,30 @@ class PyLayer(metaclass=PyLayerMeta):
 
 # Legacy alias used by some reference code paths.
 LegacyPyLayer = PyLayer
+
+
+class saved_tensors_hooks:
+    """Parity: autograd/saved_tensors_hooks — pack/unpack hooks applied
+    to tensors saved by PyLayerContext.save_for_backward while the
+    context is active.
+
+    Scope note (TPU design): the functional tape computes VJPs through
+    jax closures whose residuals live inside the compiled program, so
+    hooks apply to the explicit PyLayer save path (the reference's main
+    use case: CPU offload / quantize saved activations). For tape-wide
+    memory savings use recompute/`remat` — the TPU-native equivalent.
+    """
+
+    _active = []
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        saved_tensors_hooks._active.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        saved_tensors_hooks._active.pop()
+        return False

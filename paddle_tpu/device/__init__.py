@@ -94,3 +94,145 @@ def memory_allocated(device=None):
 
 def empty_cache():
     pass
+
+
+# ---------------------------------------------------------------------------
+# stream/event + exotic-place API shims. PJRT owns scheduling: programs
+# run in submission order on the device's single logical stream, so the
+# Stream/Event surface maps to synchronization points (reference:
+# python/paddle/device/__init__.py Stream/Event over CUDA streams).
+# ---------------------------------------------------------------------------
+
+class Stream:
+    """Parity: paddle.device.Stream — PJRT exposes one logical stream
+    per device; wait/synchronize map to device synchronization."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def wait_event(self, event):
+        synchronize()
+
+    def wait_stream(self, stream):
+        synchronize()
+
+    def record_event(self, event=None):
+        ev = event or Event()
+        ev.record(self)
+        return ev
+
+    def synchronize(self):
+        synchronize()
+
+
+class Event:
+    """Parity: paddle.device.Event."""
+
+    def __init__(self, device=None, enable_timing=False, blocking=False,
+                 interprocess=False):
+        self._recorded = False
+
+    def record(self, stream=None):
+        self._recorded = True
+
+    def query(self):
+        return True  # submission-order execution: past work is done
+
+    def synchronize(self):
+        synchronize()
+
+
+_current_stream = Stream()
+
+
+def current_stream(device=None):
+    """Parity: device.current_stream."""
+    return _current_stream
+
+
+def set_stream(stream):
+    """Parity: device.set_stream."""
+    global _current_stream
+    prev = _current_stream
+    _current_stream = stream
+    return prev
+
+
+class stream_guard:
+    """Parity: device.stream_guard context manager."""
+
+    def __init__(self, stream):
+        self.stream = stream
+
+    def __enter__(self):
+        self._prev = set_stream(self.stream)
+        return self.stream
+
+    def __exit__(self, *exc):
+        set_stream(self._prev)
+        return False
+
+
+class XPUPlace:
+    def __init__(self, device_id=0):
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"Place(xpu:{self.device_id})"
+
+
+class IPUPlace:
+    def __repr__(self):
+        return "Place(ipu)"
+
+
+class MLUPlace:
+    def __init__(self, device_id=0):
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"Place(mlu:{self.device_id})"
+
+
+def get_cudnn_version():
+    """Parity: device.get_cudnn_version — no CUDA runtime here."""
+    return None
+
+
+def is_compiled_with_cinn():
+    return False
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_mlu():
+    return False
+
+
+def is_compiled_with_npu():
+    return False
+
+
+def get_all_device_type():
+    """Parity: device.get_all_device_type."""
+    import jax
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_all_custom_device_type():
+    import jax
+    return sorted({d.platform for d in jax.devices()
+                   if d.platform not in ("cpu", "gpu", "tpu")})
+
+
+def get_available_device():
+    import jax
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    import jax
+    return [f"{d.platform}:{d.id}" for d in jax.devices()
+            if d.platform not in ("cpu", "gpu", "tpu")]

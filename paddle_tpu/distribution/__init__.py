@@ -15,7 +15,7 @@ from .distributions import (Bernoulli, Beta, Categorical, Dirichlet,
                             Distribution, Exponential, Gamma, Geometric,
                             Gumbel, Independent, Laplace, LogNormal,
                             Multinomial, Normal, TransformedDistribution,
-                            Uniform)
+                            Uniform, ExponentialFamily)
 from .kl import kl_divergence, register_kl
 from .transform import (AbsTransform, AffineTransform, ExpTransform,
                         PowerTransform, SigmoidTransform, Transform)
@@ -25,4 +25,4 @@ __all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
            "Gumbel", "Laplace", "LogNormal", "Multinomial", "Independent",
            "TransformedDistribution", "kl_divergence", "register_kl",
            "Transform", "AffineTransform", "ExpTransform", "AbsTransform",
-           "PowerTransform", "SigmoidTransform"]
+           "PowerTransform", "SigmoidTransform", "ExponentialFamily"]

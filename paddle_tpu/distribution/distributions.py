@@ -631,3 +631,42 @@ class TransformedDistribution(Distribution):
         if lp is None:
             return base_lp
         return _t(jnp.subtract, base_lp, lp, name="subtract")
+
+
+class ExponentialFamily(Distribution):
+    """Parity: distribution/exponential_family.py — base class for
+    natural-parameter families; entropy via the Bregman/log-normalizer
+    identity computed with jax autodiff (the reference uses the same
+    trick with paddle.grad)."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        raise NotImplementedError
+
+    def entropy(self):
+        """H = F(eta) - <eta, grad F(eta)> + E[carrier measure]."""
+        import jax
+        import jax.numpy as jnp
+        nat = [p.value if hasattr(p, "value") else jnp.asarray(p)
+               for p in self._natural_parameters]
+
+        def F(*etas):
+            out = self._log_normalizer(*etas)
+            return jnp.sum(out), out
+
+        grads, value = jax.grad(F, argnums=tuple(range(len(nat))),
+                                has_aux=True)(*nat)
+        ent = value - sum(jnp.sum(e * g, axis=tuple(
+            range(value.ndim, e.ndim))) if e.ndim > value.ndim
+            else e * g for e, g in zip(nat, grads))
+        # Bregman identity: H = -E[carrier] + F(eta) - <eta, grad F>
+        ent = ent - self._mean_carrier_measure
+        from ..core.tensor import Tensor
+        return Tensor(ent)

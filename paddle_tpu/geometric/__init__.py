@@ -16,7 +16,8 @@ from ..autograd.tape import apply
 from ..core.tensor import Tensor
 
 __all__ = ["segment_sum", "segment_mean", "segment_min", "segment_max",
-           "send_u_recv", "send_ue_recv", "send_uv", "reindex_graph"]
+           "send_u_recv", "send_ue_recv", "send_uv", "reindex_graph",
+           "sample_neighbors", "reindex_heter_graph"]
 
 
 def _num_segments(segment_ids, explicit=None):
@@ -147,3 +148,71 @@ def reindex_graph(x, neighbors, count, name=None):
             Tensor(jnp.asarray(dst), stop_gradient=True),
             Tensor(jnp.asarray(np.asarray(out_nodes)),
                    stop_gradient=True))
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                     eids=None, return_eids=False, perm_buffer=None,
+                     name=None):
+    """Parity: geometric/sampling/neighbors.py sample_neighbors — for
+    each input node, sample up to sample_size neighbors from the CSC
+    graph (row, colptr). Host-side (data-dependent output size)."""
+    import numpy as np
+    r = np.asarray(row.value if isinstance(row, Tensor) else row)
+    cp = np.asarray(colptr.value if isinstance(colptr, Tensor) else colptr)
+    nodes = np.asarray(input_nodes.value
+                       if isinstance(input_nodes, Tensor) else input_nodes)
+    ev = np.asarray(eids.value if isinstance(eids, Tensor) else eids) \
+        if eids is not None else None
+    out_nb, out_cnt, out_eids = [], [], []
+    rng = np.random.RandomState(0 if perm_buffer is not None else None)
+    for n in nodes.reshape(-1):
+        lo, hi = int(cp[n]), int(cp[n + 1])
+        deg = hi - lo
+        if sample_size < 0 or deg <= sample_size:
+            sel = np.arange(lo, hi)
+        else:
+            sel = lo + rng.choice(deg, size=sample_size, replace=False)
+        out_nb.append(r[sel])
+        out_cnt.append(len(sel))
+        if ev is not None:
+            out_eids.append(ev[sel])
+    nb = Tensor(jnp.asarray(np.concatenate(out_nb) if out_nb
+                            else np.empty(0, r.dtype)), stop_gradient=True)
+    cnt = Tensor(jnp.asarray(np.asarray(out_cnt, np.int32)),
+                 stop_gradient=True)
+    if return_eids:
+        assert ev is not None, "return_eids requires eids"
+        return nb, cnt, Tensor(jnp.asarray(np.concatenate(out_eids)),
+                               stop_gradient=True)
+    return nb, cnt
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Parity: geometric/reindex.py reindex_heter_graph — reindex a
+    heterogeneous neighborhood (list of per-edge-type neighbor arrays)
+    into one contiguous id space shared across types; returns
+    CONCATENATED (reindex_src, reindex_dst, out_nodes) like the
+    reference."""
+    import numpy as np
+    xs = np.asarray(x.value if isinstance(x, Tensor) else x)
+    uniq = {int(v): i for i, v in enumerate(xs)}
+    out_nodes = list(xs)
+    src_all, dst_all = [], []
+    for nb, cnt in zip(neighbors, count):
+        nbv = np.asarray(nb.value if isinstance(nb, Tensor) else nb)
+        cv = np.asarray(cnt.value if isinstance(cnt, Tensor) else cnt)
+        re_nb = np.empty_like(nbv)
+        for i, v in enumerate(nbv):
+            v = int(v)
+            if v not in uniq:
+                uniq[v] = len(out_nodes)
+                out_nodes.append(v)
+            re_nb[i] = uniq[v]
+        src_all.append(re_nb)
+        dst_all.append(np.repeat(np.arange(len(cv)), cv))
+    src = np.concatenate(src_all) if src_all else np.empty(0, np.int64)
+    dst = np.concatenate(dst_all) if dst_all else np.empty(0, np.int64)
+    return (Tensor(jnp.asarray(src), stop_gradient=True),
+            Tensor(jnp.asarray(dst), stop_gradient=True),
+            Tensor(jnp.asarray(np.asarray(out_nodes)), stop_gradient=True))

@@ -21,4 +21,160 @@ class _DistributedNamespace:
 distributed = _DistributedNamespace()
 distributed.models.moe = _MoENamespace()
 
-__all__ = ["asp", "autotune", "distributed"]
+__all__ = ["asp", "autotune", "distributed", "LookAhead", "ModelAverage",
+           "graph_khop_sampler", "graph_reindex", "graph_sample_neighbors",
+           "graph_send_recv", "identity_loss", "segment_max",
+           "segment_mean", "segment_min", "segment_sum",
+           "softmax_mask_fuse", "softmax_mask_fuse_upper_triangle"]
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))
+
+
+# ---------------------------------------------------------------------------
+# incubate long tail (reference: python/paddle/incubate/__init__.py):
+# graph ops (aliases of the geometric implementations, which is also
+# what the reference's incubate versions became), fused softmax masks,
+# identity_loss, and the LookAhead / ModelAverage optimizer wrappers.
+# ---------------------------------------------------------------------------
+
+def __getattr__(name):
+    out = _resolve(name)
+    globals()[name] = out  # cache: stable identity for mock/caching
+    return out
+
+
+def _resolve(name):
+    if name in ("segment_sum", "segment_mean", "segment_min",
+                "segment_max"):
+        from .. import geometric
+        return getattr(geometric, name)
+    if name == "graph_send_recv":
+        from ..geometric import send_u_recv
+
+        def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                            out_size=None, name=None):
+            return send_u_recv(x, src_index, dst_index, pool_type,
+                               out_size)
+
+        return graph_send_recv
+    if name == "graph_reindex":
+        from ..geometric import reindex_graph
+
+        def graph_reindex(x, neighbors, count, value_buffer=None,
+                          index_buffer=None, flag_buffer_hashtable=False,
+                          name=None):
+            return reindex_graph(x, neighbors, count)
+
+        return graph_reindex
+    if name == "graph_sample_neighbors":
+        from ..geometric import sample_neighbors
+
+        def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                                   perm_buffer=None, sample_size=-1,
+                                   return_eids=False,
+                                   flag_perm_buffer=False, name=None):
+            return sample_neighbors(row, colptr, input_nodes,
+                                    sample_size, eids, return_eids,
+                                    perm_buffer)
+
+        return graph_sample_neighbors
+    if name == "graph_khop_sampler":
+        from ..geometric import sample_neighbors
+
+        def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                               sorted_eids=None, return_eids=False,
+                               name=None):
+            import numpy as np
+            import jax.numpy as jnp
+            from ..core.tensor import Tensor
+            nodes = input_nodes
+            all_nb, all_cnt, centers = [], [], []
+            for sz in sample_sizes:
+                nb, cnt = sample_neighbors(row, colptr, nodes, sz)
+                centers.append(np.asarray(
+                    nodes.value if isinstance(nodes, Tensor) else nodes
+                ).reshape(-1))
+                all_nb.append(np.asarray(nb.value))
+                all_cnt.append(np.asarray(cnt.value))
+                nodes = nb
+            # one shared id space; edge dst = reindexed id of the CENTER
+            # node each sampled neighbor belongs to (not its position)
+            base = np.asarray(input_nodes.value
+                              if isinstance(input_nodes, Tensor)
+                              else input_nodes).reshape(-1)
+            uniq = {int(v): i for i, v in enumerate(base)}
+            out_nodes = list(base)
+
+            def rid(v):
+                v = int(v)
+                if v not in uniq:
+                    uniq[v] = len(out_nodes)
+                    out_nodes.append(v)
+                return uniq[v]
+
+            src, dst = [], []
+            for ctr, nb, cnt in zip(centers, all_nb, all_cnt):
+                ctr_ids = [rid(c) for c in ctr]
+                pos = 0
+                for ci, k in zip(ctr_ids, cnt):
+                    for v in nb[pos:pos + int(k)]:
+                        src.append(rid(v))
+                        dst.append(ci)
+                    pos += int(k)
+            cnt_cat = np.concatenate(all_cnt) if all_cnt else \
+                np.empty(0, np.int32)
+            return (Tensor(jnp.asarray(np.asarray(src, np.int64))),
+                    Tensor(jnp.asarray(np.asarray(dst, np.int64))),
+                    Tensor(jnp.asarray(np.asarray(out_nodes))),
+                    Tensor(jnp.asarray(cnt_cat)))
+
+        return graph_khop_sampler
+    if name == "identity_loss":
+        def identity_loss(x, reduction="none"):
+            """Parity: incubate identity_loss (IPU loss anchor)."""
+            import jax.numpy as jnp
+            from ..autograd.tape import apply
+            red = {0: "sum", 1: "mean", 2: "none"}.get(reduction,
+                                                       reduction)
+            def f(v):
+                if red == "mean":
+                    return jnp.mean(v)
+                if red == "sum":
+                    return jnp.sum(v)
+                return v
+            return apply(f, x, _op_name="identity_loss")
+
+        return identity_loss
+    if name == "softmax_mask_fuse":
+        def softmax_mask_fuse(x, mask, name=None):
+            """Parity: incubate softmax_mask_fuse — softmax(x + mask);
+            XLA fuses (the reference's point was avoiding a CUDA
+            roundtrip)."""
+            import jax
+            from ..autograd.tape import apply
+            return apply(lambda v, m: jax.nn.softmax(v + m, -1), x, mask,
+                         _op_name="softmax_mask_fuse")
+
+        return softmax_mask_fuse
+    if name == "softmax_mask_fuse_upper_triangle":
+        def softmax_mask_fuse_upper_triangle(x):
+            """Parity: causal-masked softmax."""
+            import jax
+            import jax.numpy as jnp
+            from ..autograd.tape import apply
+
+            def f(v):
+                s = v.shape[-1]
+                cm = jnp.tril(jnp.ones((v.shape[-2], s), bool))
+                return jax.nn.softmax(
+                    jnp.where(cm, v, jnp.asarray(-1e30, v.dtype)), -1)
+
+            return apply(f, x, _op_name="softmax_mask_fuse_upper_triangle")
+
+        return softmax_mask_fuse_upper_triangle
+    if name in ("LookAhead", "ModelAverage"):
+        from . import optimizer as _opt
+        return getattr(_opt, name)
+    raise AttributeError(f"module 'paddle_tpu.incubate' has no attribute {name!r}")

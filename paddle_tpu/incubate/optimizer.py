@@ -1,0 +1,107 @@
+"""incubate optimizers. Parity: python/paddle/incubate/optimizer/
+{lookahead.py, modelaverage.py} — wrappers over an inner optimizer."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead:
+    """Parity: incubate/optimizer/lookahead.py — every k inner steps,
+    pull the fast weights toward slow weights: slow += alpha*(fast-slow),
+    fast = slow."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        assert inner_optimizer is not None
+        assert 0.0 <= alpha <= 1.0
+        assert k >= 1 and isinstance(k, int)
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._step = 0
+        self._slow = {}
+        self._params = list(
+            getattr(inner_optimizer, "_parameter_list", []) or [])
+
+    def __getattr__(self, item):
+        return getattr(self.inner_optimizer, item)
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step += 1
+        if self._step % self.k:
+            return
+        for p in self._params:
+            slow = self._slow.get(id(p))
+            if slow is None:
+                # jnp.copy: the fused optimizer step donates parameter
+                # buffers, which would invalidate a retained reference
+                slow = jnp.copy(p.value)
+            else:
+                slow = slow + self.alpha * (p.value - slow)
+            self._slow[id(p)] = slow
+            p.value = jnp.copy(slow)
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def minimize(self, loss):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+
+class ModelAverage:
+    """Parity: incubate/optimizer/modelaverage.py — maintain a running
+    average of parameters; apply()/restore() swap it in and out."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self.rate = average_window_rate
+        self.min_w = min_average_window
+        self.max_w = max_average_window
+        self._params = list(parameters or [])
+        self._sum = {}
+        self._cnt = 0
+        self._backup = {}
+
+    def step(self):
+        """Accumulate the current parameter values."""
+        self._cnt += 1
+        for p in self._params:
+            acc = self._sum.get(id(p))
+            self._sum[id(p)] = jnp.copy(p.value) if acc is None \
+                else acc + p.value
+        # bounded window: restart accumulation when it grows too long
+        if self._cnt > self.max_w and \
+                self._cnt > self.min_w / max(self.rate, 1e-12):
+            self._sum = {id(p): jnp.copy(p.value) for p in self._params}
+            self._cnt = 1
+
+    def apply(self, executor=None, need_restore=True):
+        outer = self
+
+        class _Ctx:
+            def __enter__(ctx):
+                for p in outer._params:
+                    outer._backup[id(p)] = p.value
+                    if id(p) in outer._sum and outer._cnt:
+                        p.value = outer._sum[id(p)] / outer._cnt
+                return ctx
+
+            def __exit__(ctx, *exc):
+                if need_restore:
+                    outer.restore()
+                return False
+
+        return _Ctx()
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p.value = self._backup.pop(id(p))
+
+    def minimize(self, loss):
+        self.step()

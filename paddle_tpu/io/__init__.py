@@ -4,11 +4,11 @@ from .dataloader import (BatchSampler, ChainDataset, ConcatDataset,
                          DataLoader, Dataset, DistributedBatchSampler,
                          IterableDataset, RandomSampler, Sampler,
                          SequenceSampler, Subset, TensorDataset,
-                         default_collate_fn, get_worker_info, random_split)
+                         default_collate_fn, get_worker_info, random_split, ComposeDataset, WeightedRandomSampler)
 from .state import load, save
 
 __all__ = ["save", "load", "Dataset", "IterableDataset", "TensorDataset",
            "ConcatDataset", "ChainDataset", "Subset", "random_split",
            "Sampler", "SequenceSampler", "RandomSampler", "BatchSampler",
            "DistributedBatchSampler", "DataLoader", "default_collate_fn",
-           "get_worker_info"]
+           "get_worker_info", "ComposeDataset", "WeightedRandomSampler"]

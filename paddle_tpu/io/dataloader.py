@@ -577,3 +577,56 @@ class _MultiprocessGuard:
             p.join(timeout=2)
             if p.is_alive():
                 p.terminate()
+
+
+class ComposeDataset(Dataset):
+    """Parity: io ComposeDataset — zip several map-style datasets; each
+    sample concatenates the fields of every child's sample."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        assert self.datasets, "datasets must not be empty"
+        n = len(self.datasets[0])
+        for d in self.datasets[1:]:
+            assert len(d) == n, (
+                "all datasets in ComposeDataset must have the same length")
+
+    def __len__(self):
+        return len(self.datasets[0])
+
+    def __getitem__(self, idx):
+        out = []
+        for d in self.datasets:
+            sample = d[idx]
+            if isinstance(sample, (tuple, list)):
+                out.extend(sample)
+            else:
+                out.append(sample)
+        return tuple(out)
+
+
+class WeightedRandomSampler(Sampler):
+    """Parity: io WeightedRandomSampler — sample indices proportional to
+    weights, with or without replacement."""
+
+    def __init__(self, weights, num_samples, replacement=True):
+        self.weights = np.asarray(
+            weights.value if isinstance(weights, Tensor) else weights,
+            np.float64)
+        assert (self.weights >= 0).all(), "weights must be non-negative"
+        assert num_samples > 0, "num_samples must be positive"
+        if not replacement and num_samples > len(self.weights):
+            raise ValueError(
+                "num_samples cannot exceed len(weights) when "
+                "replacement=False")
+        self.num_samples = int(num_samples)
+        self.replacement = replacement
+
+    def __iter__(self):
+        p = self.weights / self.weights.sum()
+        idx = np.random.choice(len(p), size=self.num_samples,
+                               replace=self.replacement, p=p)
+        return iter(idx.tolist())
+
+    def __len__(self):
+        return self.num_samples

@@ -5,10 +5,12 @@ program; `TrainStep` fuses forward+backward+update; `functional_call` is the
 Layer->pure-function bridge everything (including pjit sharding) builds on.
 """
 from .api import (InputSpec, StaticFunction, TranslatedLayer, ignore_module,
-                  load, not_to_static, save, to_static)
+                  load, not_to_static, save, to_static, enable_to_static,
+                  set_verbosity, set_code_level)
 from .functional import functional_call, load_state, raw_state
 from .training import TrainStep
 
 __all__ = ["to_static", "not_to_static", "ignore_module", "InputSpec",
            "StaticFunction", "save", "load", "TranslatedLayer",
-           "functional_call", "raw_state", "load_state", "TrainStep"]
+           "functional_call", "raw_state", "load_state", "TrainStep",
+           "enable_to_static", "set_verbosity", "set_code_level"]

@@ -172,6 +172,9 @@ class StaticFunction:
     def __call__(self, *args, **kwargs):
         if self._tracing:
             return self._fn(*args, **kwargs)
+        if not _to_static_enabled:
+            # enable_to_static(False): run the original eager function
+            return self._fn(*args, **kwargs)
         arrays, statics, is_dyn, treedef = self._split_args(args, kwargs)
         if self._is_layer:
             layer = self._layer
@@ -364,3 +367,30 @@ def load(path, **config) -> TranslatedLayer:
     with open(path + ".pdiparams", "rb") as f:
         meta = pickle.load(f)
     return TranslatedLayer(exported, meta["state"])
+
+
+_to_static_enabled = True
+
+
+def enable_to_static(enable: bool = True):
+    """Parity: jit/api.py enable_to_static — globally toggle whether
+    @to_static functions actually compile (False = run eagerly)."""
+    global _to_static_enabled
+    _to_static_enabled = bool(enable)
+
+
+def set_verbosity(level: int = 0, also_to_stdout: bool = False):
+    """Parity: jit dy2static logging verbosity (trace-based compilation
+    here has one log channel)."""
+    import logging
+    logging.getLogger("paddle_tpu.jit").setLevel(
+        logging.DEBUG if level else logging.WARNING)
+
+
+def set_code_level(level: int = 100, also_to_stdout: bool = False):
+    """Parity: jit set_code_level — the reference dumps transformed AST
+    code; trace-based jit has no rewritten source, so this toggles HLO
+    text logging instead."""
+    import logging
+    logging.getLogger("paddle_tpu.jit.hlo").setLevel(
+        logging.DEBUG if level else logging.WARNING)

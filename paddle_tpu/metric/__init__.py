@@ -6,7 +6,7 @@ import numpy as np
 
 from ..core.tensor import Tensor
 
-__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc"]
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc", "accuracy"]
 
 
 def _np(x):
@@ -165,3 +165,18 @@ class Auc(Metric):
 
     def name(self):
         return self._name
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Parity: metric/metrics.py accuracy functional — top-k accuracy of
+    `input` (probabilities/logits, (N, C)) against integer labels."""
+    from ..autograd.tape import apply
+    import jax.numpy as jnp
+    import jax
+
+    def f(x, y):
+        topk = jax.lax.top_k(x, k)[1]
+        hit = (topk == y.reshape(-1, 1).astype(topk.dtype)).any(-1)
+        return jnp.mean(hit.astype(jnp.float32), keepdims=True)
+
+    return apply(f, input, label, _op_name="accuracy")

@@ -18,6 +18,43 @@ from .profiler import (Profiler, ProfilerState, ProfilerTarget,
                        make_scheduler)
 from .timer import benchmark
 
-__all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
+__all__ = ["SortedKeys", "SummaryView", "export_protobuf",
+           "Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
            "make_scheduler", "export_chrome_tracing",
            "load_profiler_result", "benchmark"]
+
+
+class SortedKeys:
+    """Parity: profiler SortedKeys — summary sort orders."""
+    CPUTotal = "cpu_total"
+    CPUAvg = "cpu_avg"
+    CPUMax = "cpu_max"
+    CPUMin = "cpu_min"
+    GPUTotal = "device_total"
+    GPUAvg = "device_avg"
+    GPUMax = "device_max"
+    GPUMin = "device_min"
+
+
+class SummaryView:
+    """Parity: profiler SummaryView — which summary tables to print."""
+    DeviceView = "device"
+    OverView = "overview"
+    ModelView = "model"
+    DistributedView = "distributed"
+    KernelView = "kernel"
+    OperatorView = "operator"
+    MemoryView = "memory"
+    MemoryManipulationView = "memory_manipulation"
+    UDFView = "udf"
+
+
+def export_protobuf(dir_name: str = "./profiler_log"):
+    """Parity: profiler export_protobuf — return a callback exporting
+    the collected trace. The XLA profiler already writes protobuf
+    xplane files; this points the session's output there."""
+
+    def handle(prof):
+        prof.export(dir_name)
+
+    return handle

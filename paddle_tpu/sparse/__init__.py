@@ -21,7 +21,7 @@ from ..core.tensor import Tensor
 
 __all__ = ["nn", "sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
            "SparseCsrTensor", "is_sparse", "add", "matmul", "masked_matmul",
-           "relu", "to_dense", "to_sparse_coo"]
+           "relu", "to_dense", "to_sparse_coo", "sin", "sinh", "tan", "tanh", "asin", "asinh", "atan", "atanh", "sqrt", "square", "log1p", "abs", "expm1", "neg", "deg2rad", "rad2deg", "pow", "cast", "subtract", "multiply", "divide", "mv", "addmm", "reshape", "transpose", "coalesce", "is_same_shape"]
 
 
 class SparseCooTensor(Tensor):
@@ -207,3 +207,151 @@ def relu(x):
 
 
 from . import nn  # noqa: E402,F401  (sparse layer library)
+
+
+# ---------------------------------------------------------------------------
+# elementwise / unary / linalg surface (reference: python/paddle/sparse/
+# unary.py, binary.py, multiary.py — phi sparse kernels). Unary ops that
+# preserve zero (sin, sqrt of 0, ...) act on stored values only;
+# value-pair binary ops align coordinates through the O(nnz) merge in
+# `add`.
+# ---------------------------------------------------------------------------
+
+def _unary(fn, name, int_to_float=False):
+    def op(x, *args, **kwargs):
+        if is_sparse(x):
+            from ..autograd.tape import apply as _apply
+            b = x.value
+            vals = x.values()
+            out_vals = _apply(lambda v: fn(v, *args, **kwargs), vals,
+                              _op_name=f"sparse_{name}")
+            st = SparseCooTensor(jsparse.BCOO((out_vals.value, b.indices),
+                                              shape=b.shape))
+            st._values_tensor = out_vals
+            return st
+        return Tensor(fn(_raw(x), *args, **kwargs))
+
+    op.__name__ = name
+    op.__doc__ = f"Parity: paddle.sparse.{name} (values-only, zero-preserving)."
+    return op
+
+
+sin = _unary(jnp.sin, "sin")
+sinh = _unary(jnp.sinh, "sinh")
+tan = _unary(jnp.tan, "tan")
+tanh = _unary(jnp.tanh, "tanh")
+asin = _unary(jnp.arcsin, "asin")
+asinh = _unary(jnp.arcsinh, "asinh")
+atan = _unary(jnp.arctan, "atan")
+atanh = _unary(jnp.arctanh, "atanh")
+sqrt = _unary(jnp.sqrt, "sqrt")
+square = _unary(jnp.square, "square")
+log1p = _unary(jnp.log1p, "log1p")
+abs = _unary(jnp.abs, "abs")
+expm1 = _unary(jnp.expm1, "expm1")
+neg = _unary(jnp.negative, "neg")
+deg2rad = _unary(jnp.deg2rad, "deg2rad")
+rad2deg = _unary(jnp.rad2deg, "rad2deg")
+
+
+def pow(x, factor, name=None):
+    """Parity: paddle.sparse.pow."""
+    return _unary(lambda v: jnp.power(v, factor), "pow")(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    """Parity: paddle.sparse.cast."""
+    from ..framework.dtype import convert_dtype
+    b = x.value
+    idx = b.indices
+    vals = b.data
+    if index_dtype is not None:
+        idx = idx.astype(convert_dtype(index_dtype))
+    if value_dtype is not None:
+        vals = vals.astype(convert_dtype(value_dtype))
+    return SparseCooTensor(jsparse.BCOO((vals, idx), shape=b.shape))
+
+
+def subtract(x, y, name=None):
+    """Parity: paddle.sparse.subtract."""
+    return add(x, neg(y))
+
+
+def multiply(x, y, name=None):
+    """Parity: paddle.sparse.multiply — elementwise; scalar or matching
+    sparse pattern."""
+    if not is_sparse(y):
+        return _unary(lambda v: v * _raw(y), "multiply")(x)
+    # same-coordinate fast path; general intersection via dense fallback
+    import numpy as np
+    if np.array_equal(np.asarray(x.value.indices),
+                      np.asarray(y.value.indices)):
+        b = x.value
+        return SparseCooTensor(jsparse.BCOO(
+            (b.data * y.value.data, b.indices), shape=b.shape))
+    return to_sparse_coo(Tensor(x.value.todense() * y.value.todense()))
+
+
+def divide(x, y, name=None):
+    """Parity: paddle.sparse.divide."""
+    if not is_sparse(y):
+        return _unary(lambda v: v / _raw(y), "divide")(x)
+    import numpy as np
+    if np.array_equal(np.asarray(x.value.indices),
+                      np.asarray(y.value.indices)):
+        b = x.value
+        return SparseCooTensor(jsparse.BCOO(
+            (b.data / y.value.data, b.indices), shape=b.shape))
+    return Tensor(x.value.todense() / y.value.todense())
+
+
+def mv(x, vec, name=None):
+    """Parity: paddle.sparse.mv — sparse matrix x dense vector."""
+    return matmul(x, vec)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """Parity: paddle.sparse.addmm — beta*input + alpha*(x @ y)."""
+    prod = matmul(x, y)
+    return Tensor(beta * _raw(input) + alpha * _raw(prod))
+
+
+def reshape(x, shape, name=None):
+    """Parity: paddle.sparse.reshape — re-derive COO coords for the new
+    shape (host index math on nnz entries)."""
+    import numpy as np
+    b = x.value
+    old_shape = b.shape
+    flat = np.ravel_multi_index(
+        tuple(np.asarray(b.indices).T), old_shape)
+    new_idx = np.stack(np.unravel_index(flat, tuple(
+        int(s) for s in shape)), 1)
+    return SparseCooTensor(jsparse.BCOO(
+        (b.data, jnp.asarray(new_idx)), shape=tuple(int(s) for s in shape)))
+
+
+def transpose(x, perm, name=None):
+    """Parity: paddle.sparse.transpose."""
+    b = x.value
+    idx = b.indices[:, jnp.asarray(list(perm))]
+    shape = tuple(b.shape[p] for p in perm)
+    return SparseCooTensor(jsparse.BCOO((b.data, idx), shape=shape))
+
+
+def coalesce(x, name=None):
+    """Parity: paddle.sparse.coalesce — merge duplicate coordinates."""
+    import numpy as np
+    b = x.value
+    idx = np.asarray(b.indices)
+    flat = np.ravel_multi_index(tuple(idx.T), b.shape)
+    uniq, inv = np.unique(flat, return_inverse=True)
+    merged = jax.ops.segment_sum(b.data, jnp.asarray(inv),
+                                 num_segments=len(uniq))
+    new_idx = np.stack(np.unravel_index(uniq, b.shape), 1)
+    return SparseCooTensor(jsparse.BCOO(
+        (merged, jnp.asarray(new_idx)), shape=b.shape))
+
+
+def is_same_shape(x, y):
+    """Parity: paddle.sparse.is_same_shape."""
+    return list(x.shape) == list(y.shape)

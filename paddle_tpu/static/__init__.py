@@ -21,11 +21,12 @@ from ..core.tensor import Tensor
 from ..jit.api import InputSpec, TranslatedLayer
 from ..jit.api import load as _jit_load
 from ..jit.api import save as _jit_save
-from ..nn.layer_base import Layer
+from ..nn.layer_base import Layer, ParamAttr
 
 __all__ = ["InputSpec", "save_inference_model", "load_inference_model",
            "Program", "Executor", "default_main_program",
-           "default_startup_program", "program_guard", "data"]
+           "default_startup_program", "program_guard", "data",
+           "Variable", "BuildStrategy", "ExecutionStrategy", "CompiledProgram", "ParallelExecutor", "IpuCompiledProgram", "IpuStrategy", "ipu_shard_guard", "set_ipu_shard", "WeightNormParamAttr", "ExponentialMovingAverage", "create_parameter", "create_global_var", "accuracy", "auc", "ctr_metric_bundle", "Print", "py_func", "cpu_places", "cuda_places", "npu_places", "xpu_places", "mlu_places", "global_scope", "scope_guard", "name_scope", "device_guard", "append_backward", "gradients", "exponential_decay", "serialize_program", "deserialize_program", "serialize_persistables", "deserialize_persistables", "normalize_program", "save", "load", "load_program_state", "set_program_state", "save_to_file", "load_from_file"]
 
 
 def save_inference_model(path_prefix: str, feed_vars, fetch_vars=None,
@@ -132,3 +133,398 @@ class Executor:
             return [o.numpy() if isinstance(o, Tensor) else np.asarray(o)
                     for o in outs]
         return list(outs)
+
+
+# ---------------------------------------------------------------------------
+# static API long tail (reference: python/paddle/static/__init__.py).
+# The Program-IR machinery is collapsed into jit tracing (SURVEY §7), so
+# these fall into three groups: real dygraph-equivalent implementations
+# (EMA, create_parameter, save/load state, py_func, metrics), harmless
+# ceremony (scopes/guards/places), and Program-surgery entry points that
+# raise with guidance.
+# ---------------------------------------------------------------------------
+
+Variable = Tensor  # static Variable == Tensor in the collapsed runtime
+
+
+class BuildStrategy:
+    """Parity shim: framework BuildStrategy — XLA owns fusion/scheduling;
+    attributes are accepted and recorded."""
+
+    def __init__(self):
+        self.__dict__["_opts"] = {}
+
+    def __setattr__(self, k, v):
+        self._opts[k] = v
+
+    def __getattr__(self, k):
+        try:
+            return self.__dict__["_opts"][k]
+        except KeyError:
+            raise AttributeError(k)
+
+
+class ExecutionStrategy(BuildStrategy):
+    """Parity shim: ExecutionStrategy."""
+
+
+class CompiledProgram:
+    """Parity shim: CompiledProgram — jit compilation happens per call;
+    wraps the program/layer unchanged."""
+
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+        self.build_strategy = build_strategy
+
+
+class ParallelExecutor:
+    def __init__(self, *a, **kw):
+        raise NotImplementedError(
+            "ParallelExecutor is superseded: multi-device execution is "
+            "expressed with paddle_tpu.distributed (mesh + "
+            "ParallelTrainStep), not a graph executor")
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **kw):
+        raise NotImplementedError("IPU support is not part of this build")
+
+
+class IpuStrategy(IpuCompiledProgram):
+    pass
+
+
+def ipu_shard_guard(*a, **kw):
+    raise NotImplementedError("IPU support is not part of this build")
+
+
+def set_ipu_shard(*a, **kw):
+    raise NotImplementedError("IPU support is not part of this build")
+
+
+class WeightNormParamAttr(ParamAttr):
+    """Parity: static WeightNormParamAttr — records the weight-norm dim
+    (apply weight norm with nn.utils in the dygraph runtime)."""
+
+    def __init__(self, dim=None, **kwargs):
+        super().__init__(**kwargs)
+        self.dim = dim
+
+
+class ExponentialMovingAverage:
+    """Parity: static/ema.py ExponentialMovingAverage — shadow variables
+    with bias-corrected decay, apply/restore context."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._thres_steps = thres_steps
+        self._step = 0
+        self._shadow = {}
+        self._backup = {}
+        self._params = []
+
+    def _collect(self, parameters=None):
+        if parameters is not None:
+            self._params = list(parameters)
+        return self._params
+
+    def update(self, parameters=None):
+        import jax.numpy as jnp
+        params = self._collect(parameters)
+        assert params, ("pass `parameters` on the first update() — the "
+                        "static Program scan does not exist here")
+        self._step += 1
+        # the (1+t)/(10+t) warmup ramp only applies when thres_steps is
+        # given (reference static/ema.py); default is fixed decay
+        d = self._decay if self._thres_steps is None else min(
+            self._decay, (1.0 + self._step) / (10.0 + self._step))
+        import jax.numpy as jnp
+        for p in params:
+            pid = id(p)
+            prev = self._shadow.get(pid)
+            # jnp.copy: donated optimizer buffers must not be retained
+            self._shadow[pid] = jnp.copy(p.value) if prev is None else (
+                d * prev + (1.0 - d) * p.value)
+
+    def apply(self, executor=None, need_restore=True):
+        class _Ctx:
+            def __init__(ctx):
+                pass
+
+            def __enter__(ctx):
+                import jax.numpy as jnp
+                for p in self._params:
+                    self._backup[id(p)] = p.value
+                    if id(p) in self._shadow:
+                        p.value = jnp.copy(self._shadow[id(p)])
+                return ctx
+
+            def __exit__(ctx, *exc):
+                if need_restore:
+                    self.restore()
+                return False
+
+        return _Ctx()
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p.value = self._backup.pop(id(p))
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    from ..tensor.parity_extras import create_parameter as _cp
+    return _cp(shape, dtype, name, attr, is_bias, default_initializer)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """Parity: static create_global_var — a named persistent tensor."""
+    import jax.numpy as jnp
+    from ..framework.dtype import convert_dtype
+    t = Tensor(jnp.full(tuple(shape), value, convert_dtype(dtype)))
+    t.name = name or "global_var"
+    t.persistable = persistable
+    return t
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    from ..metric import accuracy as _acc
+    return _acc(input, label, k)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1, name=None):
+    """Parity: static auc — batch AUC of predictions vs labels."""
+    from ..metric import Auc as _Auc
+    import numpy as np
+    m = _Auc(curve=curve, num_thresholds=num_thresholds)
+    m.update(np.asarray(input.value), np.asarray(label.value))
+    import jax.numpy as jnp
+    return Tensor(jnp.asarray(m.accumulate(), jnp.float32))
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    raise NotImplementedError(
+        "ctr_metric_bundle belongs to the PS/CTR pipeline, which is "
+        "deferred in this build (SURVEY §2.6 PS row)")
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """Parity: static.Print — identity that logs the tensor."""
+    import jax
+    def cb(v):
+        print(f"{message or 'Print'}: shape={list(v.shape)} "
+              f"dtype={v.dtype}\n{v}")
+        return v
+    jax.debug.callback(lambda v: cb(v), input.value)
+    return input
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Parity: static.py_func — host python inside a traced program via
+    pure_callback."""
+    import jax
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    raw = [t.value for t in xs]
+    spec = jax.ShapeDtypeStruct(tuple(out.shape), out.value.dtype) \
+        if hasattr(out, "value") else out
+    res = jax.pure_callback(
+        lambda *vs: func(*vs), spec, *raw, vmap_method=None)
+    return Tensor(res)
+
+
+def cpu_places(device_count=None):
+    from ..tensor.parity_extras import CPUPlace
+    import os
+    n = device_count or int(os.environ.get("CPU_NUM", 1))
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    from ..tensor.parity_extras import CUDAPlace
+    ids = device_ids if device_ids is not None else [0]
+    return [CUDAPlace(i) for i in ids]
+
+
+def npu_places(device_ids=None):
+    from ..tensor.parity_extras import NPUPlace
+    ids = device_ids if device_ids is not None else [0]
+    return [NPUPlace(i) for i in ids]
+
+
+def xpu_places(device_ids=None):
+    from ..device import XPUPlace
+    ids = device_ids if device_ids is not None else [0]
+    return [XPUPlace(i) for i in ids]
+
+
+def mlu_places(device_ids=None):
+    from ..device import MLUPlace
+    ids = device_ids if device_ids is not None else [0]
+    return [MLUPlace(i) for i in ids]
+
+
+class _Scope(dict):
+    def var(self, name):
+        return self.setdefault(name, Tensor.__new__(Tensor))
+
+    def find_var(self, name):
+        return self.get(name)
+
+
+_global_scope = _Scope()
+
+
+def global_scope():
+    """Parity: static.global_scope."""
+    return _global_scope
+
+
+class scope_guard:
+    """Parity: static.scope_guard."""
+
+    def __init__(self, scope):
+        self.scope = scope
+
+    def __enter__(self):
+        global _global_scope
+        self._prev = _global_scope
+        _global_scope = self.scope
+        return self.scope
+
+    def __exit__(self, *exc):
+        global _global_scope
+        _global_scope = self._prev
+        return False
+
+
+class name_scope:
+    """Parity: static.name_scope — names traced programs for debugging
+    (jax.named_scope under jit)."""
+
+    def __init__(self, prefix=None):
+        import jax
+        self._ctx = jax.named_scope(prefix or "scope")
+
+    def __enter__(self):
+        return self._ctx.__enter__()
+
+    def __exit__(self, *exc):
+        return self._ctx.__exit__(*exc)
+
+
+class device_guard:
+    """Parity: static.device_guard — placement is PJRT's; accepted and
+    ignored with a note."""
+
+    def __init__(self, device=None):
+        self.device = device
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    raise NotImplementedError(
+        "append_backward rewrites a static Program; this runtime has no "
+        "Program IR — use loss.backward() (eager) or jax gradients "
+        "inside jit (jit.TrainStep)")
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Parity: static.gradients — eager equivalent via autograd.grad."""
+    from ..autograd import grad as _grad
+    return _grad(targets, inputs, grad_outputs=target_gradients)
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    """Parity: static exponential_decay — staircase holds the LR within
+    each decay_steps bucket (StepDecay); continuous applies the per-step
+    root of decay_rate (ExponentialDecay)."""
+    from ..optimizer import lr as _lr
+    if staircase:
+        return _lr.StepDecay(learning_rate=learning_rate,
+                             step_size=decay_steps, gamma=decay_rate)
+    return _lr.ExponentialDecay(
+        learning_rate=learning_rate,
+        gamma=decay_rate ** (1.0 / decay_steps))
+
+
+# ---- program/state serialization over the jit StableHLO path ----------
+
+def serialize_program(feed_vars, fetch_vars, program=None, **kwargs):
+    raise NotImplementedError(
+        "program serialization is the jit path here: use "
+        "paddle_tpu.jit.save / static.save_inference_model (StableHLO)")
+
+
+def deserialize_program(data):
+    raise NotImplementedError(
+        "use paddle_tpu.jit.load / static.load_inference_model")
+
+
+def serialize_persistables(feed_vars, fetch_vars, program=None, **kwargs):
+    raise NotImplementedError(
+        "use static.save / paddle_tpu.save for parameter state")
+
+
+def deserialize_persistables(program, data, executor=None):
+    raise NotImplementedError(
+        "use static.load / paddle_tpu.load for parameter state")
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    return program  # traced programs are already normalized
+
+
+def save(program_or_layer, model_path, protocol=4, **configs):
+    """Parity: static.save — persist a Layer/Program's parameter state."""
+    from .. import io as io_mod
+    target = getattr(program_or_layer, "layer", program_or_layer)
+    state = target.state_dict() if hasattr(target, "state_dict") else {}
+    io_mod.save(state, model_path + ".pdparams")
+
+
+def load(program_or_layer, model_path, executor=None, var_list=None):
+    """Parity: static.load."""
+    from .. import io as io_mod
+    state = io_mod.load(model_path + ".pdparams")
+    target = getattr(program_or_layer, "layer", program_or_layer)
+    if hasattr(target, "set_state_dict"):
+        target.set_state_dict(state)
+    return state
+
+
+def load_program_state(model_path, var_list=None):
+    """Parity: static.load_program_state."""
+    from .. import io as io_mod
+    return io_mod.load(model_path + ".pdparams")
+
+
+def set_program_state(program_or_layer, state_dict):
+    """Parity: static.set_program_state."""
+    target = getattr(program_or_layer, "layer", program_or_layer)
+    if hasattr(target, "set_state_dict"):
+        target.set_state_dict(state_dict)
+
+
+def save_to_file(path, content):
+    """Parity: static.save_to_file."""
+    with open(path, "wb") as f:
+        f.write(content if isinstance(content, bytes) else bytes(content))
+
+
+def load_from_file(path):
+    """Parity: static.load_from_file."""
+    with open(path, "rb") as f:
+        return f.read()

@@ -6,6 +6,36 @@ over time with per-sequence length masking — one compiled program,
 batch-parallel on the VPU.
 """
 from . import datasets  # noqa: F401
+from .datasets import Imdb, Imikolov, Movielens, UCIHousing  # noqa: F401
 from .viterbi_decode import ViterbiDecoder, viterbi_decode
 
-__all__ = ["viterbi_decode", "ViterbiDecoder", "datasets"]
+
+class _Undownloadable:
+    """Reference datasets whose sources are multi-file downloads the
+    zero-egress build cannot fetch; constructing raises with guidance."""
+
+    _name = ""
+
+    def __init__(self, *a, **kw):
+        raise RuntimeError(
+            f"{self._name}: automatic download is unavailable in this "
+            f"build (no network egress) and no local-file parser is "
+            f"provided yet; use UCIHousing/Imdb/Imikolov/Movielens or "
+            f"load the corpus manually")
+
+
+class Conll05st(_Undownloadable):
+    _name = "Conll05st"
+
+
+class WMT14(_Undownloadable):
+    _name = "WMT14"
+
+
+class WMT16(_Undownloadable):
+    _name = "WMT16"
+
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "datasets", "Imdb",
+           "Imikolov", "Movielens", "UCIHousing", "Conll05st", "WMT14",
+           "WMT16"]

@@ -8,7 +8,7 @@ import warnings
 from . import cpp_extension  # noqa: F401
 
 __all__ = ["cpp_extension", "unique_name", "deprecated", "try_import",
-           "run_check"]
+           "run_check", "require_version"]
 
 
 class _UniqueNameGenerator:
@@ -71,3 +71,20 @@ def run_check():
     dev = jax.devices()[0]
     print(f"PaddleTPU works! device={dev.device_kind} "
           f"platform={dev.platform} result={float(y)}")
+
+
+def require_version(min_version: str, max_version=None):
+    """Parity: paddle.utils.require_version — check the installed
+    framework version against [min_version, max_version]."""
+    from .. import __version__ as ver
+
+    def parse(v):
+        return tuple(int(p) for p in str(v).split(".")[:3] if p.isdigit())
+
+    cur = parse(ver)
+    if parse(min_version) > cur:
+        raise Exception(
+            f"installed version {ver} < required minimum {min_version}")
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(
+            f"installed version {ver} > allowed maximum {max_version}")
