@@ -1,9 +1,15 @@
 """Flagship model zoo (BASELINE.json configs: GPT-3 family pretraining,
-LLaMA-style hybrid parallel; vision models live in paddle_tpu.vision)."""
+LLaMA hybrid parallel; vision models live in paddle_tpu.vision)."""
 from .gpt import (GPTConfig, GPTForCausalLM, GPTModel,
                   GPTPipelineForCausalLM, gpt_tiny, gpt_125m, gpt_1p3b,
                   gpt_6p7b)
+from .llama import (LlamaConfig, LlamaForCausalLM, LlamaModel,
+                    LlamaPipelineForCausalLM, llama_tiny, llama_7b,
+                    llama_13b)
 
 __all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM",
            "GPTPipelineForCausalLM", "gpt_tiny", "gpt_125m", "gpt_1p3b",
-           "gpt_6p7b"]
+           "gpt_6p7b",
+           "LlamaConfig", "LlamaModel", "LlamaForCausalLM",
+           "LlamaPipelineForCausalLM", "llama_tiny", "llama_7b",
+           "llama_13b"]

@@ -1,0 +1,277 @@
+"""LLaMA decoder family — BASELINE.json config 4 (LLaMA-13B, TP+PP).
+
+Capability parity: the reference trains LLaMA-class models through Fleet
+hybrid parallelism (SURVEY.md §3.4; model code lives in PaddleNLP driven by
+mpu/mp_layers.py + PipelineLayer). TPU-first re-design on the same TP
+layer library as GPT:
+
+- mp: q/k/v/gate/up projections are ColumnParallelLinear, o/down are
+  RowParallelLinear (Megatron layout, one GSPMD allreduce per block pair);
+- GQA: num_kv_heads < num_heads supported; kv heads are broadcast to query
+  heads right before attention (XLA fuses the expand into the kernel);
+- RoPE is applied to q/k on the full (pre-sp-shard) sequence;
+- sp: ring attention dispatch when the "sp" mesh axis is real;
+- pp: LlamaPipelineForCausalLM stacks blocks over the pp axis.
+
+All matmul-heavy compute is bfloat16-friendly; norms/softmax accumulate in
+fp32 (rms_norm upcasts internally).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .. import tensor as T
+from ..autograd.tape import apply
+from ..distributed import mesh as mesh_mod
+from ..distributed.meta_parallel import (ColumnParallelLinear, LayerDesc,
+                                         PipelineLayer, RowParallelLinear,
+                                         VocabParallelEmbedding)
+from ..distributed.sequence_parallel import ring_attention
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer_base import Layer
+from ..nn import Linear, RMSNorm
+
+__all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM",
+           "LlamaPipelineForCausalLM", "llama_tiny", "llama_7b", "llama_13b"]
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: Optional[int] = None  # None -> MHA
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-6
+    initializer_range: float = 0.02
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+
+def llama_tiny(**kw):
+    return LlamaConfig(vocab_size=256, hidden_size=64, intermediate_size=176,
+                       num_layers=4, num_heads=4, num_kv_heads=2,
+                       max_seq_len=128, **kw)
+
+
+def llama_7b(**kw):
+    return LlamaConfig(hidden_size=4096, intermediate_size=11008,
+                       num_layers=32, num_heads=32, **kw)
+
+
+def llama_13b(**kw):
+    return LlamaConfig(hidden_size=5120, intermediate_size=13824,
+                       num_layers=40, num_heads=40, **kw)
+
+
+from .gpt import _sp_active
+
+
+def _rope(q, k, theta: float):
+    """Apply rotary position embedding to q/k ([B, S, H, D])."""
+    def f(qv, kv):
+        D = qv.shape[-1]
+        S = qv.shape[1]
+        half = D // 2
+        freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+        ang = jnp.arange(S, dtype=jnp.float32)[:, None] * freqs[None, :]
+        cos = jnp.cos(ang)[None, :, None, :]   # [1, S, 1, half]
+        sin = jnp.sin(ang)[None, :, None, :]
+
+        def rot(x):
+            # interleaved-pairs convention: (x0, x1) -> (x0 c - x1 s,
+            # x1 c + x0 s); computed in fp32, cast back
+            xf = x.astype(jnp.float32)
+            x0 = xf[..., 0::2]
+            x1 = xf[..., 1::2]
+            r0 = x0 * cos - x1 * sin
+            r1 = x1 * cos + x0 * sin
+            out = jnp.stack([r0, r1], axis=-1).reshape(x.shape)
+            return out.astype(x.dtype)
+
+        return rot(qv), rot(kv)
+
+    return apply(f, q, k, _op_name="rope")
+
+
+class LlamaAttention(Layer):
+    """Causal self-attention with RoPE and GQA, TP-sharded heads."""
+
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        h, nh, nkv = cfg.hidden_size, cfg.num_heads, cfg.kv_heads
+        if h % nh:
+            raise ValueError("hidden_size % num_heads != 0")
+        if nh % nkv:
+            raise ValueError("num_heads % num_kv_heads != 0")
+        self.num_heads = nh
+        self.kv_heads = nkv
+        self.head_dim = h // nh
+        self.theta = cfg.rope_theta
+        init = I.Normal(0.0, cfg.initializer_range)
+        self.q_proj = ColumnParallelLinear(h, nh * self.head_dim,
+                                           weight_attr=init, has_bias=False,
+                                           gather_output=False)
+        self.k_proj = ColumnParallelLinear(h, nkv * self.head_dim,
+                                           weight_attr=init, has_bias=False,
+                                           gather_output=False)
+        self.v_proj = ColumnParallelLinear(h, nkv * self.head_dim,
+                                           weight_attr=init, has_bias=False,
+                                           gather_output=False)
+        self.o_proj = RowParallelLinear(nh * self.head_dim, h,
+                                        weight_attr=init, has_bias=False,
+                                        input_is_parallel=True)
+
+    def forward(self, x):
+        B, S, _ = x.shape
+        hd, nh, nkv = self.head_dim, self.num_heads, self.kv_heads
+        q = T.reshape(self.q_proj(x), [B, S, nh, hd])
+        k = T.reshape(self.k_proj(x), [B, S, nkv, hd])
+        v = T.reshape(self.v_proj(x), [B, S, nkv, hd])
+        q, k = _rope(q, k, self.theta)
+        if nkv != nh:
+            rep = nh // nkv
+            k = T.repeat_interleave(k, rep, axis=2)
+            v = T.repeat_interleave(v, rep, axis=2)
+        if _sp_active():
+            ctx = ring_attention(q, k, v, causal=True)
+        else:
+            ctx, _ = F.flash_attention(q, k, v, causal=True,
+                                       training=self.training)
+        return self.o_proj(T.reshape(ctx, [B, S, nh * hd]))
+
+
+class LlamaMLP(Layer):
+    """SwiGLU: down(silu(gate(x)) * up(x))."""
+
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        h, m = cfg.hidden_size, cfg.intermediate_size
+        init = I.Normal(0.0, cfg.initializer_range)
+        self.gate_proj = ColumnParallelLinear(h, m, weight_attr=init,
+                                              has_bias=False,
+                                              gather_output=False)
+        self.up_proj = ColumnParallelLinear(h, m, weight_attr=init,
+                                            has_bias=False,
+                                            gather_output=False)
+        self.down_proj = RowParallelLinear(m, h, weight_attr=init,
+                                           has_bias=False,
+                                           input_is_parallel=True)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaBlock(Layer):
+    """Pre-RMSNorm block (the unit the pipeline stacks)."""
+
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = RMSNorm(cfg.hidden_size, cfg.rms_eps)
+        self.self_attn = LlamaAttention(cfg)
+        self.post_attention_layernorm = RMSNorm(cfg.hidden_size, cfg.rms_eps)
+        self.mlp = LlamaMLP(cfg)
+
+    def forward(self, x):
+        x = x + self.self_attn(self.input_layernorm(x))
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x
+
+
+class LlamaModel(Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embed_tokens = VocabParallelEmbedding(
+            cfg.vocab_size, cfg.hidden_size,
+            weight_attr=I.Normal(0.0, cfg.initializer_range))
+        self.blocks = []
+        for i in range(cfg.num_layers):
+            blk = LlamaBlock(cfg)
+            self.add_sublayer(f"block_{i}", blk)
+            self.blocks.append(blk)
+        self.norm = RMSNorm(cfg.hidden_size, cfg.rms_eps)
+
+    def forward(self, ids):
+        if ids.shape[-1] > self.cfg.max_seq_len:
+            raise ValueError(
+                f"sequence length {ids.shape[-1]} exceeds max_seq_len "
+                f"{self.cfg.max_seq_len}")
+        x = self.embed_tokens(ids)
+        for blk in self.blocks:
+            x = blk(x)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.llama = LlamaModel(cfg)
+        self.lm_head = Linear(cfg.hidden_size, cfg.vocab_size,
+                              weight_attr=I.Normal(
+                                  0.0, cfg.initializer_range),
+                              bias_attr=False)
+
+    def forward(self, ids):
+        return self.lm_head(self.llama(ids))
+
+    # next-token shift identical to GPT's
+    @staticmethod
+    def loss_fn(logits, labels):
+        from .gpt import GPTForCausalLM
+        return GPTForCausalLM.loss_fn(logits, labels)
+
+
+class _EmbedStage(Layer):
+    def __init__(self, cfg):
+        super().__init__()
+        self.max_seq_len = cfg.max_seq_len
+        self.embed = VocabParallelEmbedding(
+            cfg.vocab_size, cfg.hidden_size,
+            weight_attr=I.Normal(0.0, cfg.initializer_range))
+
+    def forward(self, ids):
+        if ids.shape[-1] > self.max_seq_len:
+            raise ValueError(
+                f"sequence length {ids.shape[-1]} exceeds max_seq_len "
+                f"{self.max_seq_len}")
+        return self.embed(ids)
+
+
+class _HeadStage(Layer):
+    def __init__(self, cfg):
+        super().__init__()
+        self.norm = RMSNorm(cfg.hidden_size, cfg.rms_eps)
+        self.head = Linear(cfg.hidden_size, cfg.vocab_size,
+                           weight_attr=I.Normal(0.0, cfg.initializer_range),
+                           bias_attr=False)
+
+    def forward(self, x):
+        return self.head(self.norm(x))
+
+
+class LlamaPipelineForCausalLM(PipelineLayer):
+    """LLaMA arranged for the in-program pipeline schedule (config 4)."""
+
+    def __init__(self, cfg: LlamaConfig, num_stages: Optional[int] = None,
+                 recompute_interval: int = 0,
+                 num_micro: Optional[int] = None, interleave: int = 1):
+        self.cfg = cfg
+        super().__init__(
+            layers=[LayerDesc(_EmbedStage, cfg)]
+            + [LayerDesc(LlamaBlock, cfg) for _ in range(cfg.num_layers)]
+            + [LayerDesc(_HeadStage, cfg)],
+            num_stages=num_stages,
+            loss_fn=LlamaForCausalLM.loss_fn,
+            recompute_interval=recompute_interval,
+            num_micro=num_micro, interleave=interleave)

@@ -1,0 +1,151 @@
+"""LLaMA family tests — BASELINE.json config 4 (TP+PP hybrid).
+
+Patterns from the reference suite: forward numerics vs a numpy re-derivation
+(OpTest style), single-device convergence (book-test style), and hybrid
+tp x dp / pp parallel steps on the virtual mesh
+(hybrid_parallel_mp_layers.py / hybrid_parallel_pp_transformer.py roles).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
+                               LlamaPipelineForCausalLM, llama_tiny)
+
+
+@pytest.fixture(autouse=True)
+def fresh_mesh():
+    dist.set_mesh(None)
+    yield
+    dist.set_mesh(None)
+
+
+def _np_rope(x, theta=10000.0):
+    B, S, H, D = x.shape
+    half = D // 2
+    freqs = theta ** (-np.arange(half, dtype=np.float32) / half)
+    ang = np.arange(S, dtype=np.float32)[:, None] * freqs[None, :]
+    cos, sin = np.cos(ang)[None, :, None, :], np.sin(ang)[None, :, None, :]
+    x0, x1 = x[..., 0::2], x[..., 1::2]
+    out = np.empty_like(x)
+    out[..., 0::2] = x0 * cos - x1 * sin
+    out[..., 1::2] = x1 * cos + x0 * sin
+    return out
+
+
+def _np_forward(model, ids):
+    """Re-derive LlamaForCausalLM's math in numpy."""
+    cfg = model.cfg
+    sd = {k: v.numpy().astype(np.float64) for k, v in
+          model.state_dict().items()}
+    nh, nkv = cfg.num_heads, cfg.kv_heads
+    hd = cfg.hidden_size // nh
+
+    def rms(x, w, eps=cfg.rms_eps):
+        var = np.mean(x * x, axis=-1, keepdims=True)
+        return x / np.sqrt(var + eps) * w
+
+    x = sd["llama.embed_tokens.weight"][ids]
+    B, S, _ = x.shape
+    for i in range(cfg.num_layers):
+        p = f"llama.block_{i}."
+        h = rms(x, sd[p + "input_layernorm.weight"])
+        q = (h @ sd[p + "self_attn.q_proj.weight"]).reshape(B, S, nh, hd)
+        k = (h @ sd[p + "self_attn.k_proj.weight"]).reshape(B, S, nkv, hd)
+        v = (h @ sd[p + "self_attn.v_proj.weight"]).reshape(B, S, nkv, hd)
+        q = _np_rope(q.astype(np.float32)).astype(np.float64)
+        k = _np_rope(k.astype(np.float32)).astype(np.float64)
+        rep = nh // nkv
+        k = np.repeat(k, rep, axis=2)
+        v = np.repeat(v, rep, axis=2)
+        logits = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
+        mask = np.tril(np.ones((S, S), bool))
+        logits = np.where(mask[None, None], logits, -1e30)
+        probs = np.exp(logits - logits.max(-1, keepdims=True))
+        probs /= probs.sum(-1, keepdims=True)
+        ctx = np.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, S, nh * hd)
+        x = x + ctx @ sd[p + "self_attn.o_proj.weight"]
+        h = rms(x, sd[p + "post_attention_layernorm.weight"])
+        g = h @ sd[p + "mlp.gate_proj.weight"]
+        u = h @ sd[p + "mlp.up_proj.weight"]
+        silu = g / (1.0 + np.exp(-g))
+        x = x + (silu * u) @ sd[p + "mlp.down_proj.weight"]
+    x = rms(x, sd["llama.norm.weight"])
+    return x @ sd["lm_head.weight"]
+
+
+def test_forward_matches_numpy():
+    paddle.seed(11)
+    cfg = llama_tiny()
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    ids = np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 16))
+    got = model(paddle.to_tensor(ids)).numpy()
+    want = _np_forward(model, ids)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_gqa_head_counts():
+    cfg = llama_tiny()
+    assert cfg.kv_heads == 2 and cfg.num_heads == 4
+    model = LlamaForCausalLM(cfg)
+    kw = model.llama.blocks[0].self_attn.k_proj.weight
+    qw = model.llama.blocks[0].self_attn.q_proj.weight
+    assert kw.shape[1] * 2 == qw.shape[1]
+
+
+def test_single_device_convergence():
+    paddle.seed(3)
+    cfg = llama_tiny()
+    model = LlamaForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    from paddle_tpu.jit import TrainStep
+    step = TrainStep(model, LlamaForCausalLM.loss_fn, opt)
+    ids = paddle.to_tensor(
+        np.random.RandomState(1).randint(0, cfg.vocab_size, (4, 32))
+        .astype("int64"))
+    losses = [float(step(ids, ids)) for _ in range(8)]
+    assert losses[-1] < losses[0] - 0.5, losses
+
+
+def test_tp_dp_parallel_step_matches_single():
+    ids = np.random.RandomState(2).randint(0, 256, (4, 32)).astype("int64")
+
+    def run(degrees):
+        dist.set_mesh(None)
+        if degrees:
+            dist.init_mesh(degrees)
+        paddle.seed(5)
+        model = LlamaForCausalLM(llama_tiny())
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        if degrees:
+            step = dist.ParallelTrainStep(
+                model, LlamaForCausalLM.loss_fn, opt, zero_stage=1)
+        else:
+            from paddle_tpu.jit import TrainStep
+            step = TrainStep(model, LlamaForCausalLM.loss_fn, opt)
+        x = paddle.to_tensor(ids)
+        return [float(step(x, x)) for _ in range(3)]
+
+    single = run(None)
+    hybrid = run({"dp": 2, "mp": 2})
+    np.testing.assert_allclose(single, hybrid, rtol=2e-4, atol=2e-4)
+
+
+def test_pipeline_llama_runs():
+    dist.init_mesh({"pp": 4})
+    paddle.seed(9)
+    cfg = llama_tiny()
+    model = LlamaPipelineForCausalLM(cfg, num_stages=4, num_micro=8)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    step = dist.ParallelTrainStep(model, LlamaForCausalLM.loss_fn, opt)
+    ids = paddle.to_tensor(
+        np.random.RandomState(4).randint(0, cfg.vocab_size, (8, 32))
+        .astype("int64"))
+    losses = [float(step(ids, ids)) for _ in range(4)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
