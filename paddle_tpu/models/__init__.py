@@ -6,10 +6,16 @@ from .gpt import (GPTConfig, GPTForCausalLM, GPTModel,
 from .llama import (LlamaConfig, LlamaForCausalLM, LlamaModel,
                     LlamaPipelineForCausalLM, llama_tiny, llama_7b,
                     llama_13b)
+from .bert import (BertConfig, BertModel, BertForSequenceClassification,
+                   BertForMaskedLM, ErnieModel, bert_tiny, bert_base,
+                   ernie_3_tiny, ernie_3_base)
 
 __all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM",
            "GPTPipelineForCausalLM", "gpt_tiny", "gpt_125m", "gpt_1p3b",
            "gpt_6p7b",
            "LlamaConfig", "LlamaModel", "LlamaForCausalLM",
            "LlamaPipelineForCausalLM", "llama_tiny", "llama_7b",
-           "llama_13b"]
+           "llama_13b",
+           "BertConfig", "BertModel", "BertForSequenceClassification",
+           "BertForMaskedLM", "ErnieModel", "bert_tiny", "bert_base",
+           "ernie_3_tiny", "ernie_3_base"]

@@ -1,0 +1,255 @@
+"""BERT/ERNIE encoder family — BASELINE.json config 2 (fine-tune path).
+
+Capability parity: the reference fine-tunes ERNIE-3.0/BERT-class encoders
+(model code in PaddleNLP over paddle.nn.TransformerEncoder,
+python/paddle/nn/layer/transformer.py); serving is north-star config 5's
+sibling (ERNIE-3.0 on the inference predictor). TPU-first re-design:
+
+- encoder blocks are post-LN transformer layers on the same TP layer
+  library as GPT/LLaMA (Column/RowParallelLinear, one allreduce per pair);
+- token/position/segment embeddings + pooler + task heads
+  (sequence classification, masked LM) as separate thin modules;
+- ERNIE is architecturally BERT here (relu FFN default, same heads);
+  `ErnieModel`/`ernie_3_tiny` are the named configs.
+
+Fine-tuning runs through the ordinary TrainStep/ParallelTrainStep or
+hapi Model.fit; serving through paddle_tpu.inference (AOT XLA).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from .. import tensor as T
+from ..distributed.meta_parallel import (ColumnParallelLinear,
+                                         RowParallelLinear,
+                                         VocabParallelEmbedding)
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer_base import Layer
+from ..nn import Dropout, Embedding, LayerNorm, Linear, Tanh
+
+__all__ = ["BertConfig", "BertModel", "BertForSequenceClassification",
+           "BertForMaskedLM", "ErnieModel", "bert_tiny", "bert_base",
+           "ernie_3_tiny", "ernie_3_base"]
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    hidden_act: str = "gelu"
+    dropout: float = 0.1
+    initializer_range: float = 0.02
+    layer_norm_eps: float = 1e-12
+
+
+def bert_tiny(**kw):
+    return BertConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                      num_heads=4, intermediate_size=128, max_seq_len=128,
+                      dropout=0.0, **kw)
+
+
+def bert_base(**kw):
+    return BertConfig(**kw)
+
+
+def ernie_3_tiny(**kw):
+    kw.setdefault("hidden_act", "relu")
+    return bert_tiny(**kw)
+
+
+def ernie_3_base(**kw):
+    # ERNIE-3.0-base: BERT-base geometry, relu FFN
+    kw.setdefault("hidden_act", "relu")
+    return BertConfig(**kw)
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        init = I.Normal(0.0, cfg.initializer_range)
+        self.word_embeddings = VocabParallelEmbedding(
+            cfg.vocab_size, cfg.hidden_size, weight_attr=init)
+        self.position_embeddings = Embedding(cfg.max_seq_len,
+                                             cfg.hidden_size,
+                                             weight_attr=init)
+        self.token_type_embeddings = Embedding(cfg.type_vocab_size,
+                                               cfg.hidden_size,
+                                               weight_attr=init)
+        self.layer_norm = LayerNorm(cfg.hidden_size,
+                                    epsilon=cfg.layer_norm_eps)
+        self.dropout = Dropout(cfg.dropout)
+
+    def forward(self, ids, token_type_ids=None):
+        S = ids.shape[-1]
+        if S > self.position_embeddings.num_embeddings:
+            raise ValueError(
+                f"sequence length {S} exceeds max_seq_len "
+                f"{self.position_embeddings.num_embeddings}")
+        pos = T.arange(0, S, dtype="int64")
+        x = self.word_embeddings(ids) + self.position_embeddings(pos)
+        if token_type_ids is None:
+            token_type_ids = T.zeros_like(ids)
+        x = x + self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.layer_norm(x))
+
+
+class BertSelfAttention(Layer):
+    """Bidirectional self-attention (TP-sharded heads, padding mask)."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        h, nh = cfg.hidden_size, cfg.num_heads
+        if h % nh:
+            raise ValueError("hidden_size % num_heads != 0")
+        self.num_heads = nh
+        self.head_dim = h // nh
+        init = I.Normal(0.0, cfg.initializer_range)
+        self.qkv = ColumnParallelLinear(h, 3 * h, weight_attr=init,
+                                        gather_output=False)
+        self.out_proj = RowParallelLinear(h, h, weight_attr=init,
+                                          input_is_parallel=True)
+        self.attn_dropout = cfg.dropout
+        self.dropout = Dropout(cfg.dropout)
+
+    def forward(self, x, attn_mask=None):
+        B, S, _ = x.shape
+        hd, nh = self.head_dim, self.num_heads
+        qkv = self.qkv(x)
+        H = qkv.shape[-1] // 3
+        q = T.reshape(T.slice(qkv, [2], [0], [H]), [B, S, nh, hd])
+        k = T.reshape(T.slice(qkv, [2], [H], [2 * H]), [B, S, nh, hd])
+        v = T.reshape(T.slice(qkv, [2], [2 * H], [3 * H]), [B, S, nh, hd])
+        ctx = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                             dropout_p=self.attn_dropout,
+                                             is_causal=False,
+                                             training=self.training)
+        return self.dropout(self.out_proj(T.reshape(ctx, [B, S, H])))
+
+
+class BertLayer(Layer):
+    """Post-LN transformer encoder block (original BERT arrangement)."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        init = I.Normal(0.0, cfg.initializer_range)
+        self.attn = BertSelfAttention(cfg)
+        self.ln_1 = LayerNorm(h, epsilon=cfg.layer_norm_eps)
+        self.fc_in = ColumnParallelLinear(h, cfg.intermediate_size,
+                                          weight_attr=init,
+                                          gather_output=False)
+        self.fc_out = RowParallelLinear(cfg.intermediate_size, h,
+                                        weight_attr=init,
+                                        input_is_parallel=True)
+        self.ln_2 = LayerNorm(h, epsilon=cfg.layer_norm_eps)
+        self.dropout = Dropout(cfg.dropout)
+        self.act = F.relu if cfg.hidden_act == "relu" else F.gelu
+
+    def forward(self, x, attn_mask=None):
+        x = self.ln_1(x + self.attn(x, attn_mask))
+        y = self.dropout(self.fc_out(self.act(self.fc_in(x))))
+        return self.ln_2(x + y)
+
+
+class BertPooler(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.dense = Linear(cfg.hidden_size, cfg.hidden_size,
+                            weight_attr=I.Normal(0.0,
+                                                 cfg.initializer_range))
+        self.activation = Tanh()
+
+    def forward(self, x):
+        # [CLS] token
+        first = T.squeeze(T.slice(x, [1], [0], [1]), axis=1)
+        return self.activation(self.dense(first))
+
+
+class BertModel(Layer):
+    """Encoder stack + pooler. Returns (sequence_output, pooled_output)."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embeddings = BertEmbeddings(cfg)
+        self.layers = []
+        for i in range(cfg.num_layers):
+            layer = BertLayer(cfg)
+            self.add_sublayer(f"layer_{i}", layer)
+            self.layers.append(layer)
+        self.pooler = BertPooler(cfg)
+
+    def forward(self, ids, token_type_ids=None, attention_mask=None):
+        mask = None
+        if attention_mask is not None:
+            # [B, S] 1/0 -> additive [B, 1, 1, S]
+            m = T.cast(attention_mask, "float32")
+            mask = T.reshape((m - 1.0) * 1e30,
+                             [m.shape[0], 1, 1, m.shape[1]])
+        x = self.embeddings(ids, token_type_ids)
+        for layer in self.layers:
+            x = layer(x, mask)
+        return x, self.pooler(x)
+
+
+class ErnieModel(BertModel):
+    """ERNIE-3.0-class encoder — same architecture, relu-FFN configs."""
+
+
+class BertForSequenceClassification(Layer):
+    """Fine-tune head (config 2: ERNIE-3.0/BERT-base fine-tune)."""
+
+    def __init__(self, cfg: BertConfig, num_classes: int = 2):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.dropout = Dropout(cfg.dropout)
+        self.classifier = Linear(cfg.hidden_size, num_classes,
+                                 weight_attr=I.Normal(
+                                     0.0, cfg.initializer_range))
+
+    def forward(self, ids, token_type_ids=None, attention_mask=None):
+        _, pooled = self.bert(ids, token_type_ids, attention_mask)
+        return self.classifier(self.dropout(pooled))
+
+    @staticmethod
+    def loss_fn(logits, labels):
+        return T.mean(F.cross_entropy(logits, labels))
+
+
+class BertForMaskedLM(Layer):
+    """MLM head; the decoder is weight-tied to the (vocab-parallel) input
+    embedding — the sharded-logits matmul pattern GPT uses for
+    tie_embeddings — with an untied output bias, as in reference BERT."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.transform = Linear(cfg.hidden_size, cfg.hidden_size,
+                                weight_attr=I.Normal(
+                                    0.0, cfg.initializer_range))
+        self.layer_norm = LayerNorm(cfg.hidden_size,
+                                    epsilon=cfg.layer_norm_eps)
+        self.decoder_bias = self.create_parameter([cfg.vocab_size],
+                                                  is_bias=True)
+        self.decoder_bias.sharding_axes = ("mp",)
+
+    def forward(self, ids, token_type_ids=None, attention_mask=None):
+        x, _ = self.bert(ids, token_type_ids, attention_mask)
+        x = self.layer_norm(F.gelu(self.transform(x)))
+        w = self.bert.embeddings.word_embeddings.weight
+        return T.matmul(x, T.transpose(w, [1, 0])) + self.decoder_bias
+
+    @staticmethod
+    def loss_fn(logits, labels, ignore_index: int = -100):
+        """MLM loss over positions where labels != ignore_index."""
+        V = logits.shape[-1]
+        return T.mean(F.cross_entropy(
+            T.reshape(logits, [-1, V]), T.reshape(labels, [-1]),
+            ignore_index=ignore_index))

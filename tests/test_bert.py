@@ -1,0 +1,193 @@
+"""BERT/ERNIE family tests — BASELINE.json config 2 (fine-tune e2e).
+
+Reference patterns: numeric forward check (OpTest style), fine-tune
+convergence through TrainStep and hapi Model.fit (book-test style),
+attention-mask semantics, MLM loss masking, tp x dp hybrid parity.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.models import (BertConfig, BertForMaskedLM,
+                               BertForSequenceClassification, BertModel,
+                               ErnieModel, bert_tiny, ernie_3_tiny)
+
+
+@pytest.fixture(autouse=True)
+def fresh_mesh():
+    dist.set_mesh(None)
+    yield
+    dist.set_mesh(None)
+
+
+def _np_forward(model, ids, mask=None):
+    """Re-derive BertModel's math in numpy (eval mode, no dropout)."""
+    cfg = model.cfg
+    sd = {k: v.numpy().astype(np.float64) for k, v in
+          model.state_dict().items()}
+    nh = cfg.num_heads
+    hd = cfg.hidden_size // nh
+
+    def ln(x, w, b, eps=cfg.layer_norm_eps):
+        mu = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        return (x - mu) / np.sqrt(var + eps) * w + b
+
+    B, S = ids.shape
+    x = (sd["embeddings.word_embeddings.weight"][ids]
+         + sd["embeddings.position_embeddings.weight"][np.arange(S)][None]
+         + sd["embeddings.token_type_embeddings.weight"][0][None, None])
+    x = ln(x, sd["embeddings.layer_norm.weight"],
+           sd["embeddings.layer_norm.bias"])
+    for i in range(cfg.num_layers):
+        p = f"layer_{i}."
+        qkv = x @ sd[p + "attn.qkv.weight"] + sd[p + "attn.qkv.bias"]
+        H = cfg.hidden_size
+        q = qkv[..., :H].reshape(B, S, nh, hd)
+        k = qkv[..., H:2 * H].reshape(B, S, nh, hd)
+        v = qkv[..., 2 * H:].reshape(B, S, nh, hd)
+        logits = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
+        if mask is not None:
+            logits = logits + ((mask[:, None, None, :] - 1.0) * 1e30)
+        probs = np.exp(logits - logits.max(-1, keepdims=True))
+        probs /= probs.sum(-1, keepdims=True)
+        ctx = np.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, S, H)
+        att = ctx @ sd[p + "attn.out_proj.weight"] \
+            + sd[p + "attn.out_proj.bias"]
+        x = ln(x + att, sd[p + "ln_1.weight"], sd[p + "ln_1.bias"])
+        h = x @ sd[p + "fc_in.weight"] + sd[p + "fc_in.bias"]
+        if cfg.hidden_act == "relu":
+            h = np.maximum(h, 0)
+        else:
+            from scipy.stats import norm as _n  # pragma: no cover
+            h = h * _n.cdf(h)
+        y = h @ sd[p + "fc_out.weight"] + sd[p + "fc_out.bias"]
+        x = ln(x + y, sd[p + "ln_2.weight"], sd[p + "ln_2.bias"])
+    pooled = np.tanh(x[:, 0] @ sd["pooler.dense.weight"]
+                     + sd["pooler.dense.bias"])
+    return x, pooled
+
+
+def test_forward_matches_numpy():
+    paddle.seed(21)
+    cfg = ernie_3_tiny()          # relu FFN: exact numpy re-derivation
+    model = ErnieModel(cfg)
+    model.eval()
+    ids = np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 16))
+    seq, pooled = model(paddle.to_tensor(ids))
+    want_seq, want_pooled = _np_forward(model, ids)
+    np.testing.assert_allclose(seq.numpy(), want_seq, rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(pooled.numpy(), want_pooled,
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_attention_mask_ignores_padding():
+    paddle.seed(22)
+    cfg = bert_tiny()
+    model = BertModel(cfg)
+    model.eval()
+    rng = np.random.RandomState(1)
+    ids = rng.randint(0, cfg.vocab_size, (1, 12)).astype("int64")
+    mask = np.ones((1, 12), np.int64)
+    mask[0, 8:] = 0
+    seq1, _ = model(paddle.to_tensor(ids),
+                    attention_mask=paddle.to_tensor(mask))
+    ids2 = ids.copy()
+    ids2[0, 8:] = rng.randint(0, cfg.vocab_size, 4)  # scramble padding
+    seq2, _ = model(paddle.to_tensor(ids2),
+                    attention_mask=paddle.to_tensor(mask))
+    # non-pad positions must not see the scrambled pad tokens
+    np.testing.assert_allclose(seq1.numpy()[0, :8], seq2.numpy()[0, :8],
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_finetune_convergence():
+    paddle.seed(23)
+    cfg = bert_tiny()
+    model = BertForSequenceClassification(cfg, num_classes=2)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    from paddle_tpu.jit import TrainStep
+    step = TrainStep(model, BertForSequenceClassification.loss_fn, opt)
+    rng = np.random.RandomState(2)
+    ids = rng.randint(0, cfg.vocab_size, (8, 16)).astype("int64")
+    labels = (ids[:, 0] % 2).astype("int64")   # learnable from input
+    x, y = paddle.to_tensor(ids), paddle.to_tensor(labels)
+    losses = [float(step(x, y)) for _ in range(20)]
+    assert losses[-1] < losses[0] - 0.2, losses
+
+
+def test_mlm_loss_masks_ignore_index():
+    paddle.seed(24)
+    cfg = bert_tiny()
+    model = BertForMaskedLM(cfg)
+    model.eval()
+    ids = np.random.RandomState(3).randint(
+        0, cfg.vocab_size, (2, 8)).astype("int64")
+    logits = model(paddle.to_tensor(ids))
+    labels = np.full((2, 8), -100, np.int64)
+    labels[0, 2] = ids[0, 2]
+    loss = BertForMaskedLM.loss_fn(logits, paddle.to_tensor(labels))
+    # loss over exactly one position == CE at that position
+    lg = logits.numpy()[0, 2].astype(np.float64)
+    p = np.exp(lg - lg.max())
+    p /= p.sum()
+    want = -np.log(p[ids[0, 2]])
+    np.testing.assert_allclose(float(loss), want, rtol=1e-4)
+
+
+def test_tp_dp_hybrid_matches_single():
+    ids = np.random.RandomState(4).randint(0, 512, (4, 16)).astype("int64")
+    labels = (ids[:, 0] % 2).astype("int64")
+
+    def run(degrees):
+        dist.set_mesh(None)
+        if degrees:
+            dist.init_mesh(degrees)
+        paddle.seed(25)
+        model = BertForSequenceClassification(bert_tiny(), num_classes=2)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        if degrees:
+            step = dist.ParallelTrainStep(
+                model, BertForSequenceClassification.loss_fn, opt)
+        else:
+            from paddle_tpu.jit import TrainStep
+            step = TrainStep(model,
+                             BertForSequenceClassification.loss_fn, opt)
+        x, y = paddle.to_tensor(ids), paddle.to_tensor(labels)
+        return [float(step(x, y)) for _ in range(3)]
+
+    single = run(None)
+    hybrid = run({"dp": 2, "mp": 2})
+    np.testing.assert_allclose(single, hybrid, rtol=2e-4, atol=2e-4)
+
+
+def test_hapi_model_fit_bert():
+    """Config 2's e2e shape: fine-tune through the high-level API."""
+    paddle.seed(26)
+    cfg = bert_tiny()
+    net = BertForSequenceClassification(cfg, num_classes=2)
+    model = paddle.Model(net)
+    rng = np.random.RandomState(5)
+    ids = rng.randint(0, cfg.vocab_size, (32, 16)).astype("int64")
+    labels = (ids[:, 0] % 2).astype("int64")
+
+    import paddle_tpu.nn as nn
+    model.prepare(
+        optimizer=paddle.optimizer.AdamW(
+            learning_rate=1e-3, parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss(),
+        metrics=paddle.metric.Accuracy())
+
+    class DS(paddle.io.Dataset):
+        def __len__(self):
+            return len(ids)
+
+        def __getitem__(self, i):
+            return ids[i], labels[i]
+
+    hist = model.fit(DS(), epochs=2, batch_size=8, verbose=0)
+    res = model.evaluate(DS(), batch_size=8, verbose=0)
+    assert res["acc"] >= 0.5
