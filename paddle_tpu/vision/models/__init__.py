@@ -20,6 +20,7 @@ from .densenet import (DenseNet, densenet121, densenet161, densenet169,
                        densenet201, densenet264)
 from .googlenet import GoogLeNet, googlenet
 from .inceptionv3 import InceptionV3, inception_v3
+from .ppyoloe import CSPResNet, PPYOLOE, ppyoloe_s, ppyoloe_m, ppyoloe_l
 
 __all__ = [
     "ResNet", "resnet18", "resnet34", "resnet50", "resnet101", "resnet152",
@@ -40,4 +41,4 @@ __all__ = [
     "ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
     "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
     "shufflenet_v2_x2_0", "shufflenet_v2_swish",
-]
+    "CSPResNet", "PPYOLOE", "ppyoloe_s", "ppyoloe_m", "ppyoloe_l"]

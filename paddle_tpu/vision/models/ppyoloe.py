@@ -1,0 +1,277 @@
+"""PP-YOLOE-style anchor-free detector — BASELINE.json config 5 (serving).
+
+The reference core repo ships the detection *ops* (vision/ops.py: yolo_box,
+nms, matrix_nms, ...; fused inference ops §2.4) while the PP-YOLOE model
+itself lives in the PaddleDetection suite. For the serving north star
+(PP-YOLOE on the predictor path) this module provides the model: CSPResNet
+backbone, CSP-PAN neck, and the ET-head's anchor-free decode (per-level
+cls + DFL regression, distribution→ltrb expectation, grid anchor points),
+ending in multiclass NMS from paddle_tpu.vision.ops.
+
+Inference-first design: `forward` is pure tensor compute (AOT-exportable
+through paddle_tpu.inference / jit.save); `postprocess` applies score
+threshold + NMS on host. Backbone/neck/head are trainable Layers (grads
+flow; detection-suite losses like TAL/VFL live outside core, as in the
+reference split).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu import tensor as T
+from paddle_tpu.nn.layer_base import Layer
+from ..ops import nms
+
+__all__ = ["CSPResNet", "PPYOLOE", "ppyoloe_s", "ppyoloe_m", "ppyoloe_l"]
+
+
+class ConvBNAct(Layer):
+    def __init__(self, cin, cout, k=3, stride=1, groups=1, act="swish"):
+        super().__init__()
+        self.conv = nn.Conv2D(cin, cout, k, stride=stride,
+                              padding=(k - 1) // 2, groups=groups,
+                              bias_attr=False)
+        self.bn = nn.BatchNorm2D(cout)
+        self.act = act
+
+    def forward(self, x):
+        x = self.bn(self.conv(x))
+        return F.swish(x) if self.act == "swish" else F.relu(x)
+
+
+class RepBasicBlock(Layer):
+    """CSPResNet basic block: 3x3 + 1x1 branch sum (RepVGG-style pair,
+    kept unfused — XLA folds the parallel convs), optional shortcut."""
+
+    def __init__(self, ch, shortcut=True):
+        super().__init__()
+        self.conv1 = ConvBNAct(ch, ch, 3)
+        self.conv2 = nn.Conv2D(ch, ch, 3, padding=1, bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(ch)
+        self.conv2_1x1 = nn.Conv2D(ch, ch, 1, bias_attr=False)
+        self.bn2_1x1 = nn.BatchNorm2D(ch)
+        self.shortcut = shortcut
+
+    def forward(self, x):
+        y = self.conv1(x)
+        y = F.swish(self.bn2(self.conv2(y)) + self.bn2_1x1(self.conv2_1x1(y)))
+        return x + y if self.shortcut else y
+
+
+class EffectiveSE(Layer):
+    """Effective squeeze-excitation (one fc), as in CSPResNet stages."""
+
+    def __init__(self, ch):
+        super().__init__()
+        self.fc = nn.Conv2D(ch, ch, 1)
+
+    def forward(self, x):
+        s = T.mean(x, axis=[2, 3], keepdim=True)
+        return x * F.sigmoid(self.fc(s))
+
+
+class CSPResStage(Layer):
+    def __init__(self, cin, cout, n, stride=2, use_attn=True):
+        super().__init__()
+        if cout % 2:
+            raise ValueError(
+                f"CSPResStage needs an even channel count, got {cout}; "
+                "pick a width_mult that keeps (64,128,256,512,1024)*mult "
+                "even")
+        mid = cout // 2
+        self.conv_down = ConvBNAct(cin, cout, 3, stride=stride) \
+            if stride > 1 or cin != cout else None
+        self.conv1 = ConvBNAct(cout, mid, 1)
+        self.conv2 = ConvBNAct(cout, mid, 1)
+        self.blocks = nn.Sequential(
+            *[RepBasicBlock(mid) for _ in range(n)])
+        self.attn = EffectiveSE(cout) if use_attn else None
+        self.conv3 = ConvBNAct(cout, cout, 1)
+
+    def forward(self, x):
+        if self.conv_down is not None:
+            x = self.conv_down(x)
+        y = T.concat([self.conv1(x), self.blocks(self.conv2(x))], axis=1)
+        if self.attn is not None:
+            y = self.attn(y)
+        return self.conv3(y)
+
+
+class CSPResNet(Layer):
+    """Backbone; returns C3, C4, C5 feature maps (strides 8/16/32)."""
+
+    def __init__(self, width_mult=1.0, depth_mult=1.0):
+        super().__init__()
+        ch = [round(c * width_mult) for c in (64, 128, 256, 512, 1024)]
+        n = [max(1, round(d * depth_mult)) for d in (3, 6, 6, 3)]
+        c0 = ch[0]
+        self.stem = nn.Sequential(
+            ConvBNAct(3, c0 // 2, 3, stride=2),
+            ConvBNAct(c0 // 2, c0 // 2, 3),
+            ConvBNAct(c0 // 2, c0, 3))
+        self.stage1 = CSPResStage(ch[0], ch[1], n[0])
+        self.stage2 = CSPResStage(ch[1], ch[2], n[1])
+        self.stage3 = CSPResStage(ch[2], ch[3], n[2])
+        self.stage4 = CSPResStage(ch[3], ch[4], n[3])
+        self.out_channels = ch[2:]
+
+    def forward(self, x):
+        x = self.stage1(self.stem(x))
+        c3 = self.stage2(x)
+        c4 = self.stage3(c3)
+        c5 = self.stage4(c4)
+        return c3, c4, c5
+
+
+class CSPPAN(Layer):
+    """PAN neck: top-down then bottom-up fusion with CSP stages."""
+
+    def __init__(self, in_channels, depth=1):
+        super().__init__()
+        c3, c4, c5 = in_channels
+        self.reduce5 = ConvBNAct(c5, c4, 1)
+        self.td4 = CSPResStage(c4 * 2, c4, depth, stride=1, use_attn=False)
+        self.reduce4 = ConvBNAct(c4, c3, 1)
+        self.td3 = CSPResStage(c3 * 2, c3, depth, stride=1, use_attn=False)
+        self.down3 = ConvBNAct(c3, c3, 3, stride=2)
+        # bu4 fuses down3(p3) [c3] with p4r [c3] (p4 reduced to c3)
+        self.bu4 = CSPResStage(c3 * 2, c4, depth, stride=1, use_attn=False)
+        self.down4 = ConvBNAct(c4, c4, 3, stride=2)
+        self.bu5 = CSPResStage(c4 * 2, c5, depth, stride=1, use_attn=False)
+        self.out_channels = [c3, c4, c5]
+
+    @staticmethod
+    def _upx2(x):
+        return F.interpolate(x, scale_factor=2, mode="nearest")
+
+    def forward(self, feats):
+        c3, c4, c5 = feats
+        p5 = self.reduce5(c5)
+        p4 = self.td4(T.concat([self._upx2(p5), c4], axis=1))
+        p4r = self.reduce4(p4)
+        p3 = self.td3(T.concat([self._upx2(p4r), c3], axis=1))
+        n4 = self.bu4(T.concat([self.down3(p3), p4r], axis=1))
+        n5 = self.bu5(T.concat([self.down4(n4), p5], axis=1))
+        return p3, n4, n5
+
+
+class PPYOLOEHead(Layer):
+    """Anchor-free decoupled head with DFL regression.
+
+    Per level: ESE-gated stem, then cls conv -> [B, nc, H, W] and reg conv
+    -> [B, 4*(reg_max+1), H, W]; decode turns the reg distribution into
+    ltrb distances via softmax expectation (the DFL integral), scaled by
+    the level stride around grid anchor points.
+    """
+
+    def __init__(self, in_channels, num_classes=80, reg_max=16,
+                 strides=(8, 16, 32)):
+        super().__init__()
+        self.num_classes = num_classes
+        self.reg_max = reg_max
+        self.strides = strides
+        self.stems = nn.LayerList()
+        self.cls_convs = nn.LayerList()
+        self.reg_convs = nn.LayerList()
+        self.cls_preds = nn.LayerList()
+        self.reg_preds = nn.LayerList()
+        for ch in in_channels:
+            self.stems.append(EffectiveSE(ch))
+            self.cls_convs.append(ConvBNAct(ch, ch, 3))
+            self.reg_convs.append(ConvBNAct(ch, ch, 3))
+            self.cls_preds.append(nn.Conv2D(ch, num_classes, 1))
+            self.reg_preds.append(nn.Conv2D(ch, 4 * (reg_max + 1), 1))
+
+    def forward(self, feats):
+        """Returns (scores [B, A, nc], boxes [B, A, 4] xyxy in input px)."""
+        all_scores, all_boxes = [], []
+        for i, x in enumerate(feats):
+            s = self.stems[i](x)
+            cls = self.cls_preds[i](self.cls_convs[i](s) + s)
+            reg = self.reg_preds[i](self.reg_convs[i](s))
+            B, _, H, W = cls.shape
+            nc, rm = self.num_classes, self.reg_max
+            scores = T.reshape(T.transpose(cls, [0, 2, 3, 1]),
+                               [B, H * W, nc])
+            dist = T.reshape(T.transpose(reg, [0, 2, 3, 1]),
+                             [B, H * W, 4, rm + 1])
+            # DFL expectation: softmax over bins x bin index
+            prob = F.softmax(dist, axis=-1)
+            bins = T.reshape(T.arange(0, rm + 1, dtype="float32"),
+                             [1, 1, 1, rm + 1])
+            ltrb = T.sum(prob * bins, axis=-1)       # [B, HW, 4]
+            stride = float(self.strides[i])
+            # anchor centers in input pixels
+            xs = (T.arange(0, W, dtype="float32") + 0.5) * stride
+            ys = (T.arange(0, H, dtype="float32") + 0.5) * stride
+            cx = T.reshape(T.tile(T.reshape(xs, [1, W]), [H, 1]),
+                           [1, H * W])
+            cy = T.reshape(T.tile(T.reshape(ys, [H, 1]), [1, W]),
+                           [1, H * W])
+            lt = T.slice(ltrb, [2], [0], [2]) * stride
+            rb = T.slice(ltrb, [2], [2], [4]) * stride
+            x1 = cx - T.squeeze(T.slice(lt, [2], [0], [1]), axis=2)
+            y1 = cy - T.squeeze(T.slice(lt, [2], [1], [2]), axis=2)
+            x2 = cx + T.squeeze(T.slice(rb, [2], [0], [1]), axis=2)
+            y2 = cy + T.squeeze(T.slice(rb, [2], [1], [2]), axis=2)
+            boxes = T.stack([x1, y1, x2, y2], axis=2)
+            all_scores.append(F.sigmoid(scores))
+            all_boxes.append(boxes)
+        return (T.concat(all_scores, axis=1), T.concat(all_boxes, axis=1))
+
+
+class PPYOLOE(Layer):
+    """Backbone + neck + head; forward -> (scores, boxes), both dense."""
+
+    def __init__(self, num_classes=80, width_mult=1.0, depth_mult=1.0):
+        super().__init__()
+        self.backbone = CSPResNet(width_mult, depth_mult)
+        self.neck = CSPPAN(self.backbone.out_channels,
+                           depth=max(1, round(depth_mult)))
+        self.head = PPYOLOEHead(self.neck.out_channels, num_classes)
+        self.num_classes = num_classes
+
+    def forward(self, images):
+        return self.head(self.neck(self.backbone(images)))
+
+    def postprocess(self, scores, boxes, score_threshold=0.25,
+                    iou_threshold=0.6, max_dets=100):
+        """Host-side multiclass NMS over the dense predictions.
+
+        scores: [B, A, nc]; boxes: [B, A, 4]. Returns a list (per image)
+        of dicts with 'boxes' [k, 4], 'scores' [k], 'labels' [k] numpy.
+        """
+        s = scores.numpy() if hasattr(scores, "numpy") else np.asarray(scores)
+        b = boxes.numpy() if hasattr(boxes, "numpy") else np.asarray(boxes)
+        out = []
+        for bi in range(s.shape[0]):
+            cls = s[bi].argmax(-1)
+            conf = s[bi].max(-1)
+            keep0 = conf >= score_threshold
+            if not keep0.any():
+                out.append({"boxes": np.zeros((0, 4), np.float32),
+                            "scores": np.zeros((0,), np.float32),
+                            "labels": np.zeros((0,), np.int64)})
+                continue
+            kb, ks, kc = b[bi][keep0], conf[keep0], cls[keep0]
+            kept = nms(kb, iou_threshold, scores=ks, category_idxs=kc,
+                       categories=list(range(self.num_classes)),
+                       top_k=min(max_dets, kb.shape[0]))
+            kept = kept.numpy() if hasattr(kept, "numpy") else kept
+            out.append({"boxes": kb[kept], "scores": ks[kept],
+                        "labels": kc[kept].astype(np.int64)})
+        return out
+
+
+def ppyoloe_s(num_classes=80):
+    return PPYOLOE(num_classes, width_mult=0.50, depth_mult=0.33)
+
+
+def ppyoloe_m(num_classes=80):
+    return PPYOLOE(num_classes, width_mult=0.75, depth_mult=0.67)
+
+
+def ppyoloe_l(num_classes=80):
+    return PPYOLOE(num_classes, width_mult=1.0, depth_mult=1.0)

@@ -1,0 +1,110 @@
+"""PP-YOLOE detector tests — BASELINE.json config 5 (serving path).
+
+Checks: forward shapes across levels, DFL decode geometry (uniform logits
+=> centered boxes of expectation reg_max/2 * stride), gradient flow,
+postprocess NMS output structure, and the serving export (jit.save ->
+inference predictor parity), the AnalysisPredictor-role e2e.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision.models import PPYOLOE, ppyoloe_s
+
+
+def _tiny(num_classes=4):
+    # minimal real PPYOLOE (width/depth mults below s) for test speed
+    return PPYOLOE(num_classes=num_classes, width_mult=0.25,
+                   depth_mult=0.33)
+
+
+def test_forward_shapes():
+    paddle.seed(31)
+    m = _tiny()
+    m.eval()
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(2, 3, 64, 64).astype("float32"))
+    scores, boxes = m(x)
+    # strides 8/16/32 over 64x64 input -> 8*8 + 4*4 + 2*2 = 84 anchors
+    assert scores.shape == [2, 84, 4]
+    assert boxes.shape == [2, 84, 4]
+    s = scores.numpy()
+    assert (s >= 0).all() and (s <= 1).all()
+
+
+def test_dfl_decode_geometry():
+    """Zero reg logits => uniform DFL => ltrb = reg_max/2 bins * stride."""
+    paddle.seed(32)
+    m = _tiny()
+    m.eval()
+    # force the last reg conv of every level to zero
+    for conv in m.head.reg_preds:
+        conv.weight.set_value(np.zeros(conv.weight.shape, np.float32))
+        conv.bias.set_value(np.zeros(conv.bias.shape, np.float32))
+    x = paddle.to_tensor(np.zeros((1, 3, 64, 64), np.float32))
+    _, boxes = m(x)
+    b = boxes.numpy()[0]
+    rm = m.head.reg_max
+    # first 64 anchors are stride 8: first anchor center (4, 4)
+    exp = rm / 2.0 * 8.0
+    np.testing.assert_allclose(b[0], [4 - exp, 4 - exp, 4 + exp, 4 + exp],
+                               rtol=1e-5, atol=1e-4)
+
+
+def test_gradient_flow():
+    paddle.seed(33)
+    m = _tiny(num_classes=2)
+    x = paddle.to_tensor(np.random.RandomState(1)
+                         .randn(1, 3, 64, 64).astype("float32"))
+    scores, boxes = m(x)
+    loss = paddle.mean(scores) + paddle.mean(boxes) * 1e-3
+    loss.backward()
+    g = m.backbone.stem[0].conv.weight._grad
+    assert g is not None and float((np.asarray(g) ** 2).sum()) > 0
+
+
+def test_postprocess_structure():
+    paddle.seed(34)
+    m = _tiny()
+    m.eval()
+    x = paddle.to_tensor(np.random.RandomState(2)
+                         .randn(2, 3, 64, 64).astype("float32"))
+    scores, boxes = m(x)
+    dets = m.postprocess(scores, boxes, score_threshold=0.0,
+                         iou_threshold=0.6, max_dets=10)
+    assert len(dets) == 2
+    for d in dets:
+        k = d["boxes"].shape[0]
+        assert d["scores"].shape == (k,) and d["labels"].shape == (k,)
+        assert k <= 10 * m.num_classes
+
+
+def test_serving_export_parity(tmp_path):
+    """Config 5 shape: save the compiled program, reload through the
+    inference predictor, compare against eager forward."""
+    paddle.seed(35)
+    m = _tiny()
+    m.eval()
+    x_np = np.random.RandomState(3).randn(1, 3, 64, 64).astype("float32")
+    scores, boxes = m(paddle.to_tensor(x_np))
+
+    path = os.path.join(str(tmp_path), "ppyoloe")
+    paddle.jit.save(m, path,
+                    input_spec=[paddle.jit.InputSpec([1, 3, 64, 64],
+                                                     "float32")])
+
+    from paddle_tpu.inference import Config, create_predictor
+    cfg = Config(path + ".pdmodel")
+    pred = create_predictor(cfg)
+    inp = pred.get_input_handle(pred.get_input_names()[0])
+    inp.copy_from_cpu(x_np)
+    pred.run()
+    outs = [pred.get_output_handle(n).copy_to_cpu()
+            for n in pred.get_output_names()]
+    got_scores, got_boxes = outs
+    np.testing.assert_allclose(got_scores, scores.numpy(),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(got_boxes, boxes.numpy(),
+                               rtol=1e-4, atol=1e-3)
