@@ -1,0 +1,256 @@
+"""paddle.distributed.rpc parity — minimal tensor/function RPC.
+
+Reference: python/paddle/distributed/rpc/rpc.py (init_rpc:73, rpc_sync:141,
+rpc_async:179, shutdown, get_worker_info/get_all_worker_infos over a
+brpc-based C++ agent, paddle/fluid/distributed/rpc/rpc_agent.cc). SURVEY.md
+§2.6 marks RPC "optional"; the TPU build keeps the API on a lean transport:
+rendezvous through the native TCPStore (native/tcp_store.cc) and
+length-prefixed pickle frames over raw TCP sockets between workers — the
+role brpc plays in the reference, without the service mesh.
+
+Each worker runs an accept-loop thread + executor pool; calls are
+(fn, args, kwargs) pickles executed on the callee, results (or the raised
+exception) pickled back. rpc_async returns a FutureWrapper with .wait().
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+from collections import namedtuple
+from concurrent.futures import Future, ThreadPoolExecutor
+
+from ..store import TCPStore
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_worker_info", "get_all_worker_infos",
+           "get_current_worker_info", "WorkerInfo"]
+
+WorkerInfo = namedtuple("WorkerInfo", ["name", "rank", "ip", "port"])
+
+_DEFAULT_RPC_TIMEOUT = -1
+
+_state = None
+
+
+class _RpcState:
+    def __init__(self, name, rank, world_size, store, server, infos):
+        self.name = name
+        self.rank = rank
+        self.world_size = world_size
+        self.store = store
+        self.server = server
+        self.infos = {i.name: i for i in infos}
+        self.pool = ThreadPoolExecutor(max_workers=8)
+
+
+def _recv_exact(conn, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("rpc peer closed connection")
+        buf += chunk
+    return buf
+
+
+def _send_frame(conn, payload: bytes):
+    conn.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_frame(conn) -> bytes:
+    (n,) = struct.unpack("<Q", _recv_exact(conn, 8))
+    return _recv_exact(conn, n)
+
+
+class _Server:
+    """Accept-loop + per-request execution on a thread pool."""
+
+    def __init__(self, host="0.0.0.0", port=0, request_timeout=300.0):
+        self.request_timeout = request_timeout
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind((host, port))
+        self.sock.listen(64)
+        self.port = self.sock.getsockname()[1]
+        self.pool = ThreadPoolExecutor(max_workers=8)
+        self._stop = threading.Event()
+        self.thread = threading.Thread(target=self._loop, daemon=True)
+        self.thread.start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                self.sock.settimeout(0.2)
+                conn, _ = self.sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            # a half-open peer must not pin a handler thread forever
+            conn.settimeout(self.request_timeout)
+            try:
+                self.pool.submit(self._handle, conn)
+            except RuntimeError:    # stop() shut the pool down mid-accept
+                conn.close()
+                return
+
+    def _handle(self, conn):
+        try:
+            with conn:
+                req = pickle.loads(_recv_frame(conn))
+                try:
+                    fn, args, kwargs = req
+                    result = (True, fn(*args, **kwargs))
+                except Exception as e:      # noqa: BLE001 — ship to caller
+                    result = (False, e)
+                try:
+                    payload = pickle.dumps(result)
+                except Exception as e:      # unpicklable result/exception
+                    payload = pickle.dumps(
+                        (False, RuntimeError(f"rpc result not picklable: "
+                                             f"{e}")))
+                _send_frame(conn, payload)
+        except (ConnectionError, OSError, socket.timeout):
+            pass  # caller vanished or went silent; nothing to reply to
+        except Exception:                   # malformed frame — log, don't die
+            import traceback
+            traceback.print_exc()
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self.sock.close()
+        finally:
+            self.pool.shutdown(wait=False)
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    """Start this process's RPC agent and rendezvous with peers.
+
+    Parity: rpc.py:73 — same env fallbacks (PADDLE_TRAINER_ID,
+    PADDLE_TRAINERS_NUM, PADDLE_MASTER_ENDPOINT)."""
+    global _state
+    if _state is not None:
+        raise RuntimeError("init_rpc already called; call shutdown() first")
+    rank = int(os.environ["PADDLE_TRAINER_ID"]) if rank is None else rank
+    world_size = (int(os.environ["PADDLE_TRAINERS_NUM"])
+                  if world_size is None else world_size)
+    master_endpoint = (master_endpoint if master_endpoint is not None
+                       else os.environ["PADDLE_MASTER_ENDPOINT"])
+    host, port = master_endpoint.rsplit(":", 1)
+    store = TCPStore(host, int(port), is_master=(rank == 0),
+                     world_size=world_size)
+
+    server = _Server()
+    try:
+        ip = os.environ.get("PADDLE_WORKER_IP", "127.0.0.1")
+        info = WorkerInfo(name, rank, ip, server.port)
+        store.set(f"rpc/worker/{rank}", pickle.dumps(info))
+
+        infos, seen = [], set()
+        for r in range(world_size):
+            peer = pickle.loads(store.get(f"rpc/worker/{r}"))
+            if peer.name in seen:
+                raise ValueError(
+                    f"The Worker name must be unique, but name "
+                    f"`{peer.name}` is repeated.")
+            seen.add(peer.name)
+            infos.append(peer)
+
+        _state = _RpcState(name, rank, world_size, store, server, infos)
+        store.barrier("rpc/init", world_size)
+    except BaseException:
+        server.stop()
+        store.close()
+        _state = None
+        raise
+
+
+def _require_state() -> _RpcState:
+    if _state is None:
+        raise RuntimeError("rpc is not initialized; call init_rpc first")
+    return _state
+
+
+class FutureWrapper:
+    """Parity with the C++ future: .wait() returns the result or raises."""
+
+    def __init__(self, fut: Future, timeout):
+        self._fut = fut
+        self._timeout = None if timeout is None or timeout <= 0 else timeout
+
+    def wait(self):
+        ok, payload = self._fut.result(self._timeout)
+        if not ok:
+            raise payload
+        return payload
+
+
+def _call(info: WorkerInfo, payload: bytes, timeout):
+    with socket.create_connection((info.ip, info.port),
+                                  timeout=None if not timeout or timeout <= 0
+                                  else timeout) as conn:
+        _send_frame(conn, payload)
+        return pickle.loads(_recv_frame(conn))
+
+
+def _invoke_rpc(to, fn, args, kwargs, timeout):
+    st = _require_state()
+    if to not in st.infos:
+        raise ValueError(f"unknown rpc worker {to!r}; known: "
+                         f"{sorted(st.infos)}")
+    payload = pickle.dumps((fn, args or (), kwargs or {}))
+    fut = st.pool.submit(_call, st.infos[to], payload, timeout)
+    return FutureWrapper(fut, timeout)
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=_DEFAULT_RPC_TIMEOUT):
+    """Blocking call of fn on worker `to`. Parity: rpc.py:141."""
+    return _invoke_rpc(to, fn, args, kwargs, timeout).wait()
+
+
+def rpc_async(to, fn, args=None, kwargs=None, timeout=_DEFAULT_RPC_TIMEOUT):
+    """Non-blocking variant returning FutureWrapper. Parity: rpc.py:179."""
+    return _invoke_rpc(to, fn, args, kwargs, timeout)
+
+
+def get_worker_info(name):
+    """Parity: rpc.py get_worker_info."""
+    return _require_state().infos[name]
+
+
+def get_all_worker_infos():
+    st = _require_state()
+    return sorted(st.infos.values(), key=lambda i: i.rank)
+
+
+def get_current_worker_info():
+    st = _require_state()
+    return st.infos[st.name]
+
+
+def shutdown():
+    """Graceful: barrier so no peer still needs our server, then stop.
+    Parity: rpc.py shutdown."""
+    global _state
+    if _state is None:
+        return
+    st = _state
+    try:
+        st.store.barrier("rpc/shutdown", st.world_size)
+        # master must tear the store down LAST: wait until every rank has
+        # acked past the barrier, else a peer's in-flight store op races
+        # the master's close and dies with a socket error
+        st.store.add("rpc/shutdown_ack", 1)
+        if st.rank == 0:
+            while st.store.add("rpc/shutdown_ack", 0) < st.world_size:
+                time.sleep(0.02)
+    finally:
+        st.server.stop()
+        st.pool.shutdown(wait=False)
+        st.store.close()
+        _state = None
