@@ -1,0 +1,199 @@
+// Shared-memory SPSC ring buffer: DataLoader worker -> parent transport.
+//
+// Role parity: the reference moves multiprocess-DataLoader batches through
+// shared-memory tensors + a C++ buffered reader
+// (python/paddle/fluid/dataloader/worker.py shared-mem path,
+// paddle/fluid/operators/reader/buffered_reader.cc). TPU-native build:
+// one single-producer/single-consumer byte ring per worker in POSIX shm;
+// messages are length-prefixed blobs (pickled batches). Lock-free ring
+// positions via C++ atomics on the mapped header; blocking by bounded
+// sleep-polling (no futex portability games).
+//
+// Layout: [Header][data bytes ...capacity]
+//   head: consumer position (monotonic, mod capacity for index)
+//   tail: producer position
+//   closed: either side marks; readers drain then see EOF.
+//
+// C ABI (ctypes-consumed, see paddle_tpu/io/shm_ring.py):
+//   psr_create / psr_attach / psr_write / psr_read / psr_free /
+//   psr_mark_closed / psr_close
+
+#include <atomic>
+#include <cerrno>
+#include <chrono>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct Header {
+  std::atomic<uint64_t> head;
+  std::atomic<uint64_t> tail;
+  uint64_t capacity;
+  std::atomic<uint32_t> closed;
+  uint32_t magic;
+};
+
+constexpr uint32_t kMagic = 0x70735231;  // "psR1"
+
+struct Handle {
+  Header* hdr;
+  char* data;
+  size_t mapped;
+  bool owner;
+  std::string name;
+};
+
+double now_s() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+void copy_in(Handle* h, uint64_t pos, const char* src, uint64_t len) {
+  uint64_t cap = h->hdr->capacity;
+  uint64_t off = pos % cap;
+  uint64_t first = std::min(len, cap - off);
+  memcpy(h->data + off, src, first);
+  if (len > first) memcpy(h->data, src + first, len - first);
+}
+
+void copy_out(Handle* h, uint64_t pos, char* dst, uint64_t len) {
+  uint64_t cap = h->hdr->capacity;
+  uint64_t off = pos % cap;
+  uint64_t first = std::min(len, cap - off);
+  memcpy(dst, h->data + off, first);
+  if (len > first) memcpy(dst + first, h->data, len - first);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns handle or nullptr. capacity is the data-area size in bytes.
+void* psr_create(const char* name, uint64_t capacity) {
+  shm_unlink(name);  // stale ring from a crashed run
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  size_t total = sizeof(Header) + capacity;
+  if (ftruncate(fd, (off_t)total) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* base = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED,
+                    fd, 0);
+  close(fd);
+  if (base == MAP_FAILED) {
+    shm_unlink(name);
+    return nullptr;
+  }
+  auto* hdr = new (base) Header();
+  hdr->head.store(0);
+  hdr->tail.store(0);
+  hdr->capacity = capacity;
+  hdr->closed.store(0);
+  hdr->magic = kMagic;
+  auto* h = new Handle{hdr, (char*)base + sizeof(Header), total, true,
+                       std::string(name)};
+  return h;
+}
+
+void* psr_attach(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || (size_t)st.st_size < sizeof(Header)) {
+    close(fd);
+    return nullptr;
+  }
+  void* base = mmap(nullptr, (size_t)st.st_size, PROT_READ | PROT_WRITE,
+                    MAP_SHARED, fd, 0);
+  close(fd);
+  if (base == MAP_FAILED) return nullptr;
+  auto* hdr = (Header*)base;
+  if (hdr->magic != kMagic ||
+      sizeof(Header) + hdr->capacity > (size_t)st.st_size) {
+    munmap(base, (size_t)st.st_size);
+    return nullptr;
+  }
+  auto* h = new Handle{hdr, (char*)base + sizeof(Header),
+                       (size_t)st.st_size, false, std::string(name)};
+  return h;
+}
+
+// 0 ok, -1 timeout, -2 closed, -3 message larger than ring.
+int psr_write(void* hv, const char* buf, uint64_t len, double timeout_s) {
+  auto* h = (Handle*)hv;
+  uint64_t need = len + 8;
+  if (need > h->hdr->capacity) return -3;
+  double deadline = timeout_s > 0 ? now_s() + timeout_s : 0;
+  for (;;) {
+    if (h->hdr->closed.load(std::memory_order_acquire)) return -2;
+    uint64_t head = h->hdr->head.load(std::memory_order_acquire);
+    uint64_t tail = h->hdr->tail.load(std::memory_order_relaxed);
+    if (h->hdr->capacity - (tail - head) >= need) {
+      char lenb[8];
+      uint64_t le = len;  // little-endian hosts only (x86/arm LE)
+      memcpy(lenb, &le, 8);
+      copy_in(h, tail, lenb, 8);
+      copy_in(h, tail + 8, buf, len);
+      h->hdr->tail.store(tail + need, std::memory_order_release);
+      return 0;
+    }
+    if (deadline && now_s() > deadline) return -1;
+    std::this_thread::sleep_for(std::chrono::microseconds(100));
+  }
+}
+
+// Returns message length and sets *out (caller frees via psr_free);
+// -1 timeout, -2 closed-and-drained.
+int64_t psr_read(void* hv, char** out, double timeout_s) {
+  auto* h = (Handle*)hv;
+  double deadline = timeout_s > 0 ? now_s() + timeout_s : 0;
+  for (;;) {
+    uint64_t tail = h->hdr->tail.load(std::memory_order_acquire);
+    uint64_t head = h->hdr->head.load(std::memory_order_relaxed);
+    if (tail != head) {
+      char lenb[8];
+      copy_out(h, head, lenb, 8);
+      uint64_t len;
+      memcpy(&len, lenb, 8);
+      char* buf = (char*)malloc(len ? len : 1);
+      copy_out(h, head + 8, buf, len);
+      h->hdr->head.store(head + 8 + len, std::memory_order_release);
+      *out = buf;
+      return (int64_t)len;
+    }
+    if (h->hdr->closed.load(std::memory_order_acquire)) return -2;
+    if (deadline && now_s() > deadline) return -1;
+    std::this_thread::sleep_for(std::chrono::microseconds(100));
+  }
+}
+
+void psr_free(char* p) { free(p); }
+
+void psr_mark_closed(void* hv) {
+  ((Handle*)hv)->hdr->closed.store(1, std::memory_order_release);
+}
+
+int psr_is_closed(void* hv) {
+  return (int)((Handle*)hv)->hdr->closed.load(std::memory_order_acquire);
+}
+
+void psr_close(void* hv, int unlink_shm) {
+  auto* h = (Handle*)hv;
+  if (unlink_shm) shm_unlink(h->name.c_str());
+  munmap((void*)h->hdr, h->mapped);
+  delete h;
+}
+
+}  // extern "C"

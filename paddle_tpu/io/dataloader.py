@@ -12,6 +12,7 @@ is unnecessary: threads share the address space.
 from __future__ import annotations
 
 import itertools
+import os
 import queue
 import threading
 from concurrent.futures import ThreadPoolExecutor
@@ -300,6 +301,16 @@ def get_worker_info():
     return getattr(_worker_info, "info", None)
 
 
+def _shm_capacity() -> int:
+    """Per-worker shm ring size for worker_mode="process"; a batch must
+    fit. Malformed env values fall back to the 64 MB default."""
+    try:
+        return int(os.environ.get("FLAGS_dataloader_shm_capacity",
+                                  64 << 20))
+    except ValueError:
+        return 64 << 20
+
+
 class DataLoader:
     """Parity: paddle.io.DataLoader (fluid/reader.py:311).
 
@@ -334,6 +345,7 @@ class DataLoader:
                 "worker_mode='process' does not support IterableDataset "
                 "(sequential by nature); use the default thread mode")
         self.worker_mode = worker_mode
+        self.use_shared_memory = bool(use_shared_memory)
         self.timeout = timeout
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
@@ -425,19 +437,53 @@ class DataLoader:
 
     def _iter_multiprocess(self):
         import multiprocessing as mp
+        import pickle as _pickle
         ctx = mp.get_context("fork")
         index_q = ctx.Queue()
         result_q = ctx.Queue()
+        # native shared-memory rings (native/shm_ring.cc) carry the batch
+        # payloads when available — the reference's shared-mem tensor +
+        # buffered_reader role; mp.Queue stays as the fallback transport
+        rings = None
+        if self.use_shared_memory:
+            from .shm_ring import ring_available, ShmRing
+            if ring_available():
+                base = f"/ptpu_dl_{os.getpid()}_{id(self) & 0xFFFFFF:x}"
+                try:
+                    rings = [ShmRing(f"{base}_{w}",
+                                     capacity=_shm_capacity())
+                             for w in range(self.num_workers)]
+                except RuntimeError:
+                    rings = None
         user_collate = None if self.collate_fn is default_collate_fn \
             else self.collate_fn
         procs = [ctx.Process(
             target=_mp_worker_loop,
             args=(self.dataset, index_q, result_q, w, self.num_workers,
-                  self.worker_init_fn, user_collate), daemon=True)
+                  self.worker_init_fn, user_collate,
+                  rings[w] if rings else None), daemon=True)
             for w in range(self.num_workers)]
         for p in procs:
             p.start()
-        guard = _MultiprocessGuard(procs, index_q)
+        guard = _MultiprocessGuard(procs, index_q, rings)
+
+        def get_result(timeout):
+            """Next (batch_id, data, err); raises queue.Empty on timeout."""
+            import queue as _queue
+            import time as _time
+            if rings is None:
+                return result_q.get(timeout=timeout)
+            end = _time.monotonic() + timeout
+            while True:
+                for r in rings:
+                    try:
+                        msg = r.read(timeout=0.002)
+                    except EOFError:
+                        continue  # that worker exited; liveness check below
+                    if msg is not None:
+                        return _pickle.loads(msg)
+                if _time.monotonic() >= end:
+                    raise _queue.Empty
         try:
             it = enumerate(iter(self.batch_sampler))
             depth = self.num_workers * self.prefetch_factor
@@ -463,7 +509,7 @@ class DataLoader:
                 start = _time.monotonic()
                 while True:
                     try:
-                        batch_id, data, err = result_q.get(timeout=1.0)
+                        batch_id, data, err = get_result(1.0)
                         break
                     except _queue.Empty:
                         if deadline and _time.monotonic() - start > \
@@ -528,10 +574,21 @@ def _tensorize(obj):
 
 
 def _mp_worker_loop(dataset, index_q, result_q, worker_id, num_workers,
-                    init_fn, collate_fn):
+                    init_fn, collate_fn, ring=None):
     """Runs in the forked child. Exits with os._exit so inherited jax/
-    atexit state is never touched."""
+    atexit state is never touched. With a shm ring (fork-inherited
+    mapping) results bypass the mp.Queue pipe entirely."""
     import os as _os
+    import pickle as _pickle
+
+    def send(msg):
+        if ring is not None:
+            # infinite timeout: a full ring means the parent is slow, not
+            # dead; psr_write unblocks via the closed flag at shutdown
+            ring.write(_pickle.dumps(msg, protocol=-1), timeout=0.0)
+        else:
+            result_q.put(msg)
+
     try:
         try:
             _worker_info.info = _WorkerInfo(worker_id, num_workers,
@@ -540,8 +597,8 @@ def _mp_worker_loop(dataset, index_q, result_q, worker_id, num_workers,
                 init_fn(worker_id)
         except Exception as e:  # setup failure must reach the parent
             import traceback
-            result_q.put((-1, None, f"worker {worker_id} init failed: "
-                          f"{e}\n{traceback.format_exc()}"))
+            send((-1, None, f"worker {worker_id} init failed: "
+                  f"{e}\n{traceback.format_exc()}"))
             return
         while True:
             item = index_q.get()
@@ -552,20 +609,23 @@ def _mp_worker_loop(dataset, index_q, result_q, worker_id, num_workers,
                 samples = [dataset[i] for i in indices]
                 data = (collate_fn(samples) if collate_fn is not None
                         else _collate_numpy(samples))
-                result_q.put((batch_id, data, None))
+                send((batch_id, data, None))
             except Exception as e:  # propagate per-batch errors
                 import traceback
-                result_q.put((batch_id, None,
-                              f"{e}\n{traceback.format_exc()}"))
+                send((batch_id, None,
+                      f"{e}\n{traceback.format_exc()}"))
     finally:
+        if ring is not None:
+            ring.mark_closed()
         result_q.cancel_join_thread()
         _os._exit(0)
 
 
 class _MultiprocessGuard:
-    def __init__(self, procs, index_q):
+    def __init__(self, procs, index_q, rings=None):
         self.procs = procs
         self.index_q = index_q
+        self.rings = rings
 
     def shutdown(self):
         for _ in self.procs:
@@ -573,10 +633,17 @@ class _MultiprocessGuard:
                 self.index_q.put_nowait(None)
             except Exception:
                 pass
+        if self.rings:
+            # unblock any worker stuck writing into a full ring
+            for r in self.rings:
+                r.mark_closed()
         for p in self.procs:
             p.join(timeout=2)
             if p.is_alive():
                 p.terminate()
+        if self.rings:
+            for r in self.rings:
+                r.close()
 
 
 class ComposeDataset(Dataset):

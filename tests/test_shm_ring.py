@@ -1,0 +1,102 @@
+"""Native shm-ring DataLoader transport tests (native/shm_ring.cc role:
+the reference's shared-memory tensors + buffered_reader.cc)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import DataLoader, Dataset
+from paddle_tpu.io.shm_ring import ShmRing, ring_available
+
+pytestmark = pytest.mark.skipif(not ring_available(),
+                                reason="native shm ring not built")
+
+
+def test_ring_roundtrip_and_wraparound():
+    name = f"/ptpu_t_{os.getpid()}"
+    prod = ShmRing(name, capacity=1 << 14)
+    cons = ShmRing(name, create=False)
+    try:
+        for i in range(64):
+            msg = bytes([i % 256]) * (500 + i * 7)
+            prod.write(msg, timeout=2.0)
+            assert cons.read(timeout=2.0) == msg
+        # full ring -> write timeout
+        prod.write(b"a" * 12000, timeout=2.0)
+        with pytest.raises(TimeoutError):
+            prod.write(b"b" * 8000, timeout=0.2)
+        # oversized message -> ValueError
+        with pytest.raises(ValueError):
+            prod.write(b"c" * (1 << 15), timeout=0.2)
+        # closed + drained -> EOF
+        prod.mark_closed()
+        assert cons.read(timeout=2.0) == b"a" * 12000
+        with pytest.raises(EOFError):
+            cons.read(timeout=2.0)
+    finally:
+        cons.close()
+        prod.close()
+
+
+class _NpDataset(Dataset):
+    def __init__(self, n=64):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return (np.full((16, 16), i, np.float32), np.int64(i))
+
+
+def _collect(dl):
+    xs, ys = [], []
+    for x, y in dl:
+        xs.append(x.numpy())
+        ys.append(y.numpy())
+    return np.concatenate(xs), np.concatenate(ys)
+
+
+def test_process_loader_ring_matches_queue():
+    ds = _NpDataset()
+    dl_ring = DataLoader(ds, batch_size=8, num_workers=2,
+                         worker_mode="process", use_shared_memory=True)
+    dl_q = DataLoader(ds, batch_size=8, num_workers=2,
+                      worker_mode="process", use_shared_memory=False)
+    xr, yr = _collect(dl_ring)
+    xq, yq = _collect(dl_q)
+    np.testing.assert_array_equal(xr, xq)
+    np.testing.assert_array_equal(yr, yq)
+    np.testing.assert_array_equal(np.sort(yr), np.arange(64))
+
+
+def test_process_loader_ring_error_propagates():
+    class Bad(_NpDataset):
+        def __getitem__(self, i):
+            if i == 10:
+                raise ValueError("bad sample 10")
+            return super().__getitem__(i)
+
+    dl = DataLoader(Bad(), batch_size=4, num_workers=2,
+                    worker_mode="process", use_shared_memory=True)
+    with pytest.raises(RuntimeError, match="bad sample 10"):
+        _collect(dl)
+
+
+def test_process_loader_large_batches():
+    """Batches bigger than the queue pipe would like; several ring laps."""
+    class Big(Dataset):
+        def __len__(self):
+            return 12
+
+        def __getitem__(self, i):
+            return np.full((256, 1024), i, np.float32)  # 1 MB
+
+    dl = DataLoader(Big(), batch_size=2, num_workers=2,
+                    worker_mode="process", use_shared_memory=True)
+    seen = []
+    for b in dl:
+        assert b.shape == [2, 256, 1024]
+        seen.extend(np.asarray(b.numpy()[:, 0, 0]).tolist())
+    assert sorted(seen) == list(range(12))
