@@ -1,0 +1,123 @@
+"""Autoregressive generation with static-shape KV caches.
+
+Serving-path role parity: the reference's inference transformer stack
+(fused_multi_transformer_op.cu CacheKV decode, §2.4) and the beam/sampling
+decode helpers. TPU-native design: ONE jitted prefill program + ONE jitted
+per-token decode program (shapes static, caches donated so XLA updates
+them in place in HBM); the Python loop only feeds back the sampled token.
+
+Works with any model exposing:
+  forward(ids, caches, pos) -> (logits, caches)   (cache-threaded forward)
+  new_cache(batch, max_len, dtype) -> [(k, v), ...]
+GPTForCausalLM and LlamaForCausalLM both do; `model.generate(...)`
+delegates here.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..jit.functional import functional_call, raw_state
+
+__all__ = ["generate"]
+
+
+def _select_token(logits, key, do_sample, temperature, top_k, top_p):
+    """logits [B, V] -> token [B] (greedy or filtered sampling)."""
+    if not do_sample:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)
+    if top_k:
+        kth = jnp.sort(logits, axis=-1)[:, -int(top_k)][:, None]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # smallest logit value still inside the nucleus
+        keep = cum - probs < top_p
+        cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1,
+                         keepdims=True)
+        logits = jnp.where(logits < cutoff, -1e30, logits)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+def generate(model, input_ids, max_new_tokens: int = 32,
+             do_sample: bool = False, temperature: float = 1.0,
+             top_k: int = 0, top_p: float = 1.0,
+             eos_token_id: Optional[int] = None, seed: int = 0,
+             cache_dtype: str = "bfloat16"):
+    """Generate up to `max_new_tokens` continuations of `input_ids`.
+
+    Returns an int64 numpy array [B, prompt_len + max_new_tokens]; after a
+    row hits eos_token_id it is padded with eos.
+    """
+    ids = np.asarray(input_ids.numpy() if isinstance(input_ids, Tensor)
+                     else input_ids).astype(np.int64)
+    if ids.ndim == 1:
+        ids = ids[None]
+    B, P = ids.shape
+    total = P + max_new_tokens
+    max_len = getattr(getattr(model, "cfg", None), "max_seq_len", None)
+    if max_len is not None and total > max_len:
+        # position embeddings/RoPE are undefined past max_seq_len; the
+        # OOB lookup would silently clamp, not error
+        raise ValueError(
+            f"prompt ({P}) + max_new_tokens ({max_new_tokens}) = {total} "
+            f"exceeds the model's max_seq_len {max_len}")
+    was_training = model.training
+    model.eval()
+    try:
+        params, buffers = raw_state(model)
+        caches = model.new_cache(B, total, cache_dtype)
+
+        def prefill(params, buffers, ids, caches, key):
+            (logits, caches), _ = functional_call(
+                model, params, buffers, ids, caches,
+                jnp.int32(0), training=False)
+            nxt = _select_token(logits[:, -1, :], key, do_sample,
+                                temperature, top_k, top_p)
+            return nxt, caches
+
+        def step(params, buffers, tok, caches, pos, key):
+            (logits, caches), _ = functional_call(
+                model, params, buffers, tok[:, None], caches, pos,
+                training=False)
+            nxt = _select_token(logits[:, -1, :], key, do_sample,
+                                temperature, top_k, top_p)
+            return nxt, caches
+
+        prefill_c = jax.jit(prefill, donate_argnums=(3,))
+        step_c = jax.jit(step, donate_argnums=(3,))
+
+        key = jax.random.PRNGKey(seed)
+        key, sub = jax.random.split(key)
+        tok, caches = prefill_c(params, buffers, ids, caches, sub)
+
+        out = [ids]
+        finished = np.zeros(B, bool)
+        for i in range(max_new_tokens):
+            tok_np = np.asarray(tok)
+            if eos_token_id is not None:
+                tok_np = np.where(finished, eos_token_id, tok_np)
+                finished |= tok_np == eos_token_id
+            out.append(tok_np[:, None])
+            if i + 1 == max_new_tokens or \
+                    (eos_token_id is not None and finished.all()):
+                break
+            key, sub = jax.random.split(key)
+            tok, caches = step_c(params, buffers, jnp.asarray(tok_np),
+                                 caches, jnp.int32(P + i), sub)
+        result = np.concatenate(out, axis=1)
+        if result.shape[1] < total and eos_token_id is not None:
+            pad = np.full((B, total - result.shape[1]), eos_token_id,
+                          np.int64)
+            result = np.concatenate([result, pad], axis=1)
+        return result
+    finally:
+        if was_training:
+            model.train()

@@ -24,7 +24,12 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Optional
 
+import jax
+import jax.numpy as jnp
+from jax import lax
+
 from .. import tensor as T
+from ..autograd.tape import apply
 from ..distributed import mesh as mesh_mod
 from ..distributed.meta_parallel import (ColumnParallelLinear, LayerDesc,
                                          PipelineLayer, RowParallelLinear,
@@ -79,6 +84,45 @@ def _sp_active() -> bool:
     return mesh is not None and mesh.shape.get("sp", 1) > 1
 
 
+def cached_attention(q, k, v, k_cache, v_cache, pos):
+    """Incremental attention for autoregressive decode (serving path).
+
+    Writes the S new k/v rows into the caches at [pos, pos+S) and attends
+    q (query positions pos..pos+S-1) over all cache positions <= its own.
+    The reference serves this via fused_multi_transformer_op.cu's
+    CacheKV (§2.4); TPU-native: dynamic_update_slice + masked attention
+    in one jitted step, static shapes throughout. Caches may hold fewer
+    kv heads than q heads (GQA) — they are broadcast at use.
+
+    q/k/v: [B, S, nh|nkv, hd]; caches: [B, L, nkv, hd]; pos: scalar.
+    Returns (ctx [B, S, nh, hd], k_cache', v_cache').
+    """
+    def f(q, k, v, kc, vc, pos):
+        pos = jnp.asarray(pos, jnp.int32)
+        kc = lax.dynamic_update_slice(kc, k.astype(kc.dtype),
+                                      (0, pos, 0, 0))
+        vc = lax.dynamic_update_slice(vc, v.astype(vc.dtype),
+                                      (0, pos, 0, 0))
+        nh, nkv = q.shape[2], kc.shape[2]
+        ka, va = kc, vc
+        if nkv != nh:
+            ka = jnp.repeat(ka, nh // nkv, axis=2)
+            va = jnp.repeat(va, nh // nkv, axis=2)
+        L, S, hd = ka.shape[1], q.shape[1], q.shape[-1]
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                            ka.astype(jnp.float32)) / jnp.sqrt(
+                                jnp.float32(hd))
+        mask = (jnp.arange(L)[None, :]
+                <= pos + jnp.arange(S)[:, None])        # [S, L]
+        logits = jnp.where(mask[None, None], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(va.dtype), va)
+        return ctx, kc, vc
+
+    return apply(f, q, k, v, k_cache, v_cache, pos,
+                 _op_name="cached_attention")
+
+
 class GPTAttention(Layer):
     """Causal self-attention, TP-sharded heads, sp-aware dispatch."""
 
@@ -96,18 +140,27 @@ class GPTAttention(Layer):
                                           input_is_parallel=True)
         self.dropout = Dropout(cfg.dropout)
 
-    def forward(self, x):
-        B, S, H = x.shape
+    def _qkv(self, x):
+        B, S, _ = x.shape
         qkv = self.qkv(x)                       # [B, S, 3H] (mp-sharded)
         # contiguous last-dim slices + free reshapes (the 5-D
         # reshape-then-slice forced real relayout copies, ~5ms/step on the
         # 125M bench); values identical: [3H] is laid out [q(H);k(H);v(H)]
         hd, nh = self.head_dim, self.num_heads
-        H3 = qkv.shape[-1]
-        H = H3 // 3
+        H = qkv.shape[-1] // 3
         q = T.reshape(T.slice(qkv, [2], [0], [H]), [B, S, nh, hd])
         k = T.reshape(T.slice(qkv, [2], [H], [2 * H]), [B, S, nh, hd])
         v = T.reshape(T.slice(qkv, [2], [2 * H], [3 * H]), [B, S, nh, hd])
+        return q, k, v
+
+    def forward(self, x, cache=None, pos=None):
+        B, S, H = x.shape
+        q, k, v = self._qkv(x)
+        if cache is not None:
+            ctx, kc, vc = cached_attention(q, k, v, cache[0], cache[1],
+                                           pos)
+            return self.dropout(self.out_proj(
+                T.reshape(ctx, [B, S, H]))), (kc, vc)
         if _sp_active():
             ctx = ring_attention(q, k, v, causal=True)
         else:
@@ -150,7 +203,12 @@ class GPTBlock(Layer):
         else:
             self.mlp = GPTMLP(cfg)
 
-    def forward(self, x):
+    def forward(self, x, cache=None, pos=None):
+        if cache is not None:
+            att, cache = self.attn(self.ln_1(x), cache, pos)
+            x = x + att
+            x = x + self.mlp(self.ln_2(x))
+            return x, cache
         x = x + self.attn(self.ln_1(x))
         x = x + self.mlp(self.ln_2(x))
         return x
@@ -166,14 +224,16 @@ class GPTEmbeddings(Layer):
             cfg.max_seq_len, cfg.hidden_size, weight_attr=init)
         self.dropout = Dropout(cfg.dropout)
 
-    def forward(self, ids):
+    def forward(self, ids, pos=None):
         S = ids.shape[-1]
         max_len = self.position_embeddings.num_embeddings
         if S > max_len:
             raise ValueError(
                 f"sequence length {S} exceeds max_seq_len {max_len}")
-        pos = T.arange(0, S, dtype="int64")
-        x = self.word_embeddings(ids) + self.position_embeddings(pos)
+        positions = T.arange(0, S, dtype="int64")
+        if pos is not None:                     # decode offset
+            positions = positions + T.cast(pos, "int64")
+        x = self.word_embeddings(ids) + self.position_embeddings(positions)
         return self.dropout(x)
 
 
@@ -192,7 +252,14 @@ class GPTModel(Layer):
             self.blocks.append(blk)
         self.ln_f = LayerNorm(cfg.hidden_size)
 
-    def forward(self, ids):
+    def forward(self, ids, caches=None, pos=None):
+        if caches is not None:
+            x = self.embeddings(ids, pos)
+            new_caches = []
+            for blk, c in zip(self.blocks, caches):
+                x, c = blk(x, c, pos)
+                new_caches.append(c)
+            return self.ln_f(x), new_caches
         x = self.embeddings(ids)
         for blk in self.blocks:
             x = blk(x)
@@ -217,12 +284,30 @@ class GPTForCausalLM(Layer):
                                       0.0, cfg.initializer_range),
                                   bias_attr=False)
 
-    def forward(self, ids):
-        x = self.gpt(ids)
+    def forward(self, ids, caches=None, pos=None):
+        if caches is not None:
+            x, caches = self.gpt(ids, caches, pos)
+            return self._logits(x), caches
+        return self._logits(self.gpt(ids))
+
+    def _logits(self, x):
         if self.cfg.tie_embeddings:
             w = self.gpt.embeddings.word_embeddings.weight
             return T.matmul(x, T.transpose(w, [1, 0]))
         return self.lm_head(x)
+
+    def new_cache(self, batch_size: int, max_len: int, dtype="bfloat16"):
+        """Per-layer (k, v) cache arrays [B, max_len, nh, hd] for
+        generate()."""
+        cfg = self.cfg
+        hd = cfg.hidden_size // cfg.num_heads
+        shape = (batch_size, max_len, cfg.num_heads, hd)
+        return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+                for _ in range(cfg.num_layers)]
+
+    def generate(self, input_ids, max_new_tokens=32, **kw):
+        from .generation import generate
+        return generate(self, input_ids, max_new_tokens, **kw)
 
     @staticmethod
     def loss_fn(logits, labels):

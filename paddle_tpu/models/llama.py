@@ -73,17 +73,21 @@ def llama_13b(**kw):
                        num_layers=40, num_heads=40, **kw)
 
 
-from .gpt import _sp_active
+from .gpt import _sp_active, cached_attention
 
 
-def _rope(q, k, theta: float):
-    """Apply rotary position embedding to q/k ([B, S, H, D])."""
-    def f(qv, kv):
+def _rope(q, k, theta: float, offset=None):
+    """Apply rotary position embedding to q/k ([B, S, H, D]); `offset`
+    shifts the absolute positions (decode with KV cache)."""
+    def f(qv, kv, *off):
         D = qv.shape[-1]
         S = qv.shape[1]
         half = D // 2
         freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-        ang = jnp.arange(S, dtype=jnp.float32)[:, None] * freqs[None, :]
+        pos = jnp.arange(S, dtype=jnp.float32)
+        if off:
+            pos = pos + jnp.asarray(off[0], jnp.float32)
+        ang = pos[:, None] * freqs[None, :]
         cos = jnp.cos(ang)[None, :, None, :]   # [1, S, 1, half]
         sin = jnp.sin(ang)[None, :, None, :]
 
@@ -100,6 +104,8 @@ def _rope(q, k, theta: float):
 
         return rot(qv), rot(kv)
 
+    if offset is not None:
+        return apply(f, q, k, offset, _op_name="rope")
     return apply(f, q, k, _op_name="rope")
 
 
@@ -131,13 +137,19 @@ class LlamaAttention(Layer):
                                         weight_attr=init, has_bias=False,
                                         input_is_parallel=True)
 
-    def forward(self, x):
+    def forward(self, x, cache=None, pos=None):
         B, S, _ = x.shape
         hd, nh, nkv = self.head_dim, self.num_heads, self.kv_heads
         q = T.reshape(self.q_proj(x), [B, S, nh, hd])
         k = T.reshape(self.k_proj(x), [B, S, nkv, hd])
         v = T.reshape(self.v_proj(x), [B, S, nkv, hd])
-        q, k = _rope(q, k, self.theta)
+        q, k = _rope(q, k, self.theta, offset=pos)
+        if cache is not None:
+            # caches keep nkv heads; cached_attention broadcasts for GQA
+            ctx, kc, vc = cached_attention(q, k, v, cache[0], cache[1],
+                                           pos)
+            return self.o_proj(
+                T.reshape(ctx, [B, S, nh * hd])), (kc, vc)
         if nkv != nh:
             rep = nh // nkv
             k = T.repeat_interleave(k, rep, axis=2)
@@ -181,7 +193,13 @@ class LlamaBlock(Layer):
         self.post_attention_layernorm = RMSNorm(cfg.hidden_size, cfg.rms_eps)
         self.mlp = LlamaMLP(cfg)
 
-    def forward(self, x):
+    def forward(self, x, cache=None, pos=None):
+        if cache is not None:
+            att, cache = self.self_attn(self.input_layernorm(x), cache,
+                                        pos)
+            x = x + att
+            x = x + self.mlp(self.post_attention_layernorm(x))
+            return x, cache
         x = x + self.self_attn(self.input_layernorm(x))
         x = x + self.mlp(self.post_attention_layernorm(x))
         return x
@@ -201,12 +219,18 @@ class LlamaModel(Layer):
             self.blocks.append(blk)
         self.norm = RMSNorm(cfg.hidden_size, cfg.rms_eps)
 
-    def forward(self, ids):
+    def forward(self, ids, caches=None, pos=None):
         if ids.shape[-1] > self.cfg.max_seq_len:
             raise ValueError(
                 f"sequence length {ids.shape[-1]} exceeds max_seq_len "
                 f"{self.cfg.max_seq_len}")
         x = self.embed_tokens(ids)
+        if caches is not None:
+            new_caches = []
+            for blk, c in zip(self.blocks, caches):
+                x, c = blk(x, c, pos)
+                new_caches.append(c)
+            return self.norm(x), new_caches
         for blk in self.blocks:
             x = blk(x)
         return self.norm(x)
@@ -222,8 +246,23 @@ class LlamaForCausalLM(Layer):
                                   0.0, cfg.initializer_range),
                               bias_attr=False)
 
-    def forward(self, ids):
+    def forward(self, ids, caches=None, pos=None):
+        if caches is not None:
+            x, caches = self.llama(ids, caches, pos)
+            return self.lm_head(x), caches
         return self.lm_head(self.llama(ids))
+
+    def new_cache(self, batch_size: int, max_len: int, dtype="bfloat16"):
+        """Per-layer (k, v) caches [B, max_len, n_kv_heads, hd]."""
+        cfg = self.cfg
+        hd = cfg.hidden_size // cfg.num_heads
+        shape = (batch_size, max_len, cfg.kv_heads, hd)
+        return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+                for _ in range(cfg.num_layers)]
+
+    def generate(self, input_ids, max_new_tokens=32, **kw):
+        from .generation import generate
+        return generate(self, input_ids, max_new_tokens, **kw)
 
     # next-token shift identical to GPT's
     @staticmethod
