@@ -78,7 +78,57 @@ def distributed_optimizer(optimizer, strategy=None):
     """Parity: fleet.distributed_optimizer -> HybridParallelOptimizer
     (hybrid_parallel_optimizer.py:226). The TPU-native optimizer already
     runs inside the sharded program; grad sync/clip follow the shardings,
-    so the optimizer passes through unchanged."""
+    so a plain optimizer passes through unchanged. strategy.lars / .dgc
+    swap a Momentum for its LARS / DGC variant, the role of the
+    lars_optimizer.py / dgc_optimizer.py meta-optimizers."""
+    if strategy is None:
+        return optimizer
+    from ...optimizer import (DGCMomentum, L1Decay, L2Decay, Lars,
+                              Momentum)
+    from ..strategy import DistributedStrategy
+
+    def cfg_for(field):
+        # one source of defaults: the strategy dataclass; user dicts merge
+        base = dict(getattr(DistributedStrategy(), field))
+        base.update(getattr(strategy, field, None) or {})
+        return base
+
+    def rebuild(cls, **extra):
+        # preserve the wrapped Momentum's full configuration
+        wd = None
+        if optimizer._wd_coeff:
+            wd = (L1Decay(optimizer._wd_coeff) if optimizer._wd_is_l1
+                  else L2Decay(optimizer._wd_coeff))
+        return cls(learning_rate=optimizer._learning_rate,
+                   momentum=optimizer._momentum,
+                   parameters=optimizer._parameter_list,
+                   grad_clip=optimizer._grad_clip,
+                   multi_precision=optimizer._multi_precision, **extra,
+                   **({"weight_decay": wd, "use_nesterov":
+                       optimizer._nesterov} if cls is DGCMomentum else {}))
+
+    if getattr(strategy, "lars", False):
+        if not isinstance(optimizer, Momentum):
+            raise ValueError(
+                "strategy.lars requires a Momentum optimizer, got "
+                f"{type(optimizer).__name__}")
+        cfg = cfg_for("lars_configs")
+        return rebuild(Lars,
+                       lars_coeff=cfg["lars_coeff"],
+                       lars_weight_decay=cfg["lars_weight_decay"],
+                       exclude_from_weight_decay=cfg[
+                           "exclude_from_weight_decay"],
+                       epsilon=cfg["epsilon"])
+    if getattr(strategy, "dgc", False):
+        if not isinstance(optimizer, Momentum):
+            raise ValueError(
+                "strategy.dgc requires a Momentum optimizer, got "
+                f"{type(optimizer).__name__}")
+        cfg = cfg_for("dgc_configs")
+        return rebuild(DGCMomentum,
+                       rampup_begin_step=cfg["rampup_begin_step"],
+                       rampup_step=cfg["rampup_step"],
+                       sparsity=cfg["sparsity"])
     return optimizer
 
 

@@ -80,7 +80,15 @@ class DistributedStrategy:
         default_factory=lambda: {"k_steps": 1, "avg": True})
     lamb: bool = False
     lars: bool = False
+    lars_configs: Dict[str, Any] = field(
+        default_factory=lambda: {"lars_coeff": 0.001,
+                                 "lars_weight_decay": 0.0005,
+                                 "exclude_from_weight_decay": [],
+                                 "epsilon": 0.0})
     dgc: bool = False
+    dgc_configs: Dict[str, Any] = field(
+        default_factory=lambda: {"rampup_begin_step": 0, "rampup_step": 1,
+                                 "sparsity": [0.999]})
     find_unused_parameters: bool = False
     fuse_all_reduce_ops: bool = True     # XLA's all-reduce combiner does this
     fuse_grad_size_in_MB: int = 32

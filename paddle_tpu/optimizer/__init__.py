@@ -1,9 +1,9 @@
 """paddle_tpu.optimizer — parity: python/paddle/optimizer/."""
 from . import lr  # noqa: F401
 from .optimizer import (  # noqa: F401
-    Adadelta, Adagrad, Adam, Adamax, AdamW, L1Decay, L2Decay, Lamb, Momentum,
-    Optimizer, RMSProp, SGD)
+    Adadelta, Adagrad, Adam, Adamax, AdamW, DGCMomentum, L1Decay, L2Decay,
+    Lamb, Lars, Momentum, Optimizer, RMSProp, SGD)
 
 __all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax",
-           "Adagrad", "Adadelta", "RMSProp", "Lamb", "L1Decay", "L2Decay",
-           "lr"]
+           "Adagrad", "Adadelta", "RMSProp", "Lamb", "Lars", "DGCMomentum",
+           "L1Decay", "L2Decay", "lr"]

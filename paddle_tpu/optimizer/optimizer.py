@@ -27,7 +27,7 @@ from ..nn.clip import ClipGradBase
 from .lr import LRScheduler
 
 __all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax",
-           "Adagrad", "Adadelta", "RMSProp", "Lamb"]
+           "Adagrad", "Adadelta", "RMSProp", "Lamb", "Lars", "DGCMomentum"]
 
 
 def _is_low_precision(dt) -> bool:
@@ -513,3 +513,125 @@ class Lamb(Optimizer):
         r_norm = jnp.linalg.norm(r.reshape(-1))
         trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
         return p - lr * trust * r, {"moment1": m, "moment2": v}
+
+
+class Lars(Optimizer):
+    """LARS momentum — layer-wise adaptive rate scaling.
+
+    Parity: fleet meta_optimizers/lars_optimizer.py over the
+    lars_momentum op (operators/optimizers/lars_momentum_op.cc):
+        local_lr = lr * lars_coeff * ||p|| / (||g|| + wd*||p|| + eps)
+        v        = momentum * v + local_lr * (g + wd * p)
+        p       -= v
+    Param names matching any substring in exclude_from_weight_decay use
+    wd=0 (and hence a pure-gradient trust ratio), as the reference does.
+    """
+
+    _wd_in_rule = True
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay=None, epsilon=0.0,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision, name)
+        self._momentum = float(momentum)
+        self._coeff = float(lars_coeff)
+        self._lars_wd = float(lars_weight_decay)
+        self._exclude = list(exclude_from_weight_decay or [])
+        self._epsilon = float(epsilon)
+
+    def _param_meta(self, p, name=None):
+        meta = super()._param_meta(p, name=name)
+        nm = name if name is not None else (getattr(p, "name", "") or "")
+        wd = self._lars_wd
+        if any(sub in nm for sub in self._exclude):
+            wd = 0.0
+        return meta._replace(wd=wd)
+
+    def _init_slots(self, p):
+        return {"velocity": jnp.zeros_like(p)}
+
+    def _rule(self, p, g, slots, lr, t, meta):
+        # exact lars_momentum_op formula: a zero-norm param (fresh bias)
+        # yields local_lr = 0 — no update until its weights move it
+        p_norm = jnp.linalg.norm(p.reshape(-1))
+        g_norm = jnp.linalg.norm(g.reshape(-1))
+        denom = g_norm + meta.wd * p_norm + self._epsilon
+        local_lr = jnp.where(denom > 0,
+                             lr * self._coeff * p_norm / denom, 0.0)
+        v = self._momentum * slots["velocity"] \
+            + local_lr * (g + meta.wd * p)
+        return p - v, {"velocity": v}
+
+
+class DGCMomentum(Optimizer):
+    """Deep Gradient Compression momentum.
+
+    Parity: fleet meta_optimizers/dgc_optimizer.py over the dgc ops
+    (operators/optimizers/dgc_momentum_op.cc, operators/dgc_op.cc):
+    momentum correction (u = m*u + g), residual accumulation, top-k
+    magnitude selection — only the largest (1 - sparsity) fraction of the
+    accumulated update is applied each step — and momentum factor
+    masking (velocity zeroed at the sent coordinates, as dgc_op does).
+    The `sparsity` list ramps in equal segments over `rampup_step` steps
+    after `rampup_begin_step`; before that it is plain (optionally
+    Nesterov) momentum.
+
+    TPU-native stance: DGC exists to shrink the gradient allreduce; under
+    GSPMD the grads arrive already reduced over ICI (bandwidth is the
+    compiler's problem), so this keeps the *optimizer semantics* —
+    delayed small updates — for parity and research use.
+    """
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 rampup_begin_step=0, rampup_step=1, sparsity=(0.999,),
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, multi_precision, name)
+        self._momentum = float(momentum)
+        self._nesterov = bool(use_nesterov)
+        self._rampup_begin = int(rampup_begin_step)
+        self._rampup_step = max(1, int(rampup_step))
+        self._sparsity = tuple(
+            float(s) for s in (sparsity if isinstance(sparsity,
+                                                      (tuple, list))
+                               else (sparsity,)))
+        if not all(0.0 <= s < 1.0 for s in self._sparsity):
+            raise ValueError("sparsity values must be in [0, 1)")
+
+    def _init_slots(self, p):
+        return {"velocity": jnp.zeros_like(p),
+                "residual": jnp.zeros_like(p)}
+
+    def _sparsity_at(self, t):
+        """Ramp over the sparsity list in equal segments (traced t)."""
+        levels = jnp.asarray(self._sparsity, jnp.float32)
+        n = len(self._sparsity)
+        seg = ((t - self._rampup_begin - 1) * n) // self._rampup_step
+        seg = jnp.clip(seg, 0, n - 1).astype(jnp.int32)
+        return jnp.take(levels, seg)
+
+    def _rule(self, p, g, slots, lr, t, meta):
+        m = self._momentum
+        u = m * slots["velocity"] + g
+        e = slots["residual"] + u
+        flat = jnp.abs(e).reshape(-1)
+        # dynamic quantile threshold (sparsity may ramp with t)
+        s = self._sparsity_at(t)
+        idx = jnp.clip((s * flat.size).astype(jnp.int32), 0,
+                       flat.size - 1)
+        kth = jnp.take(jnp.sort(flat), idx)
+        # kth == 0 (all-/mostly-zero residual) must not go dense: only
+        # genuinely nonzero entries are "sent"
+        mask = jnp.where(kth > 0, jnp.abs(e) >= kth,
+                         jnp.abs(e) > 0).astype(e.dtype)
+        sparse_update = e * mask
+        dense_v = (g + m * u) if self._nesterov else u
+        is_dgc = t > self._rampup_begin
+        new_p = jnp.where(is_dgc, p - lr * sparse_update, p - lr * dense_v)
+        new_e = jnp.where(is_dgc, e - sparse_update, jnp.zeros_like(e))
+        # momentum factor masking (dgc_op.cc): clear velocity where sent
+        new_u = jnp.where(is_dgc, u * (1 - mask), u)
+        return new_p, {"velocity": new_u, "residual": new_e}

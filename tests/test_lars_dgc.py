@@ -1,0 +1,177 @@
+"""LARS / DGC optimizer tests (reference roles:
+meta_optimizers/lars_optimizer.py, dgc_optimizer.py and their ops)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.strategy import DistributedStrategy
+from paddle_tpu.optimizer import DGCMomentum, Lars, Momentum
+
+
+def _model_and_data(seed=0):
+    paddle.seed(seed)
+    m = nn.Linear(8, 4)
+    rng = np.random.RandomState(seed)
+    x = paddle.to_tensor(rng.randn(16, 8).astype("float32"))
+    y = paddle.to_tensor(rng.randn(16, 4).astype("float32"))
+    return m, x, y
+
+
+def _train(m, opt, x, y, steps=5):
+    import paddle_tpu.nn.functional as F
+    losses = []
+    for _ in range(steps):
+        loss = F.mse_loss(m(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    return losses
+
+
+def test_lars_rule_matches_numpy():
+    m, x, y = _model_and_data(1)
+    opt = Lars(learning_rate=0.1, momentum=0.9, lars_coeff=0.001,
+               lars_weight_decay=0.0005, parameters=m.parameters())
+    w0 = m.weight.numpy().astype(np.float64)
+    import paddle_tpu.nn.functional as F
+    loss = F.mse_loss(m(x), y)
+    loss.backward()
+    g = np.asarray(m.weight._grad).astype(np.float64)
+    opt.step()
+    # numpy re-derivation of one LARS step (v0 = 0)
+    wd, coeff, lr = 0.0005, 0.001, 0.1
+    p_n, g_n = np.linalg.norm(w0), np.linalg.norm(g)
+    local_lr = lr * coeff * p_n / (g_n + wd * p_n)
+    v = local_lr * (g + wd * w0)
+    np.testing.assert_allclose(m.weight.numpy(), w0 - v, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_lars_exclude_list():
+    m, x, y = _model_and_data(2)
+    m.weight.name = "linear_0.w_0"
+    m.bias.name = "linear_0.bias"
+    opt = Lars(learning_rate=0.1, parameters=m.parameters(),
+               exclude_from_weight_decay=["bias"])
+    # bias gets wd=0; weight keeps lars_weight_decay
+    assert opt._param_meta(m.bias).wd == 0.0
+    assert opt._param_meta(m.weight).wd == 0.0005
+
+
+def test_lars_converges():
+    # LARS pairs with large base lr: local_lr = lr * coeff * ||p||/||g||
+    m, x, y = _model_and_data(3)
+    losses = _train(m, Lars(learning_rate=20.0, momentum=0.9,
+                            lars_coeff=0.01,
+                            parameters=m.parameters()), x, y, steps=40)
+    assert losses[-1] < losses[0], losses
+
+
+def test_dgc_before_rampup_is_momentum():
+    m1, x, y = _model_and_data(4)
+    m2, _, _ = _model_and_data(4)
+    o1 = DGCMomentum(learning_rate=0.05, momentum=0.9,
+                     parameters=m1.parameters(), rampup_begin_step=1000)
+    o2 = Momentum(learning_rate=0.05, momentum=0.9,
+                  parameters=m2.parameters())
+    l1 = _train(m1, o1, x, y, steps=5)
+    l2 = _train(m2, o2, x, y, steps=5)
+    np.testing.assert_allclose(l1, l2, rtol=1e-5)
+    np.testing.assert_allclose(m1.weight.numpy(), m2.weight.numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_dgc_sparse_phase_updates_and_residual():
+    m, x, y = _model_and_data(5)
+    opt = DGCMomentum(learning_rate=0.05, momentum=0.9,
+                      parameters=m.parameters(), rampup_begin_step=0,
+                      sparsity=[0.75])
+    w0 = m.weight.numpy().copy()
+    import paddle_tpu.nn.functional as F
+    loss = F.mse_loss(m(x), y)
+    loss.backward()
+    opt.step()
+    delta = m.weight.numpy() - w0
+    # with 75% sparsity only ~25% of entries move on the first step
+    moved = (np.abs(delta) > 0).sum()
+    assert 0 < moved <= int(np.ceil(delta.size * 0.25)) + 1, moved
+    # residual holds the unsent mass
+    resid = opt._accumulators["residual"][m.weight.name]
+    assert float(np.abs(np.asarray(resid)).sum()) > 0
+
+
+def test_dgc_converges():
+    m, x, y = _model_and_data(6)
+    losses = _train(m, DGCMomentum(learning_rate=0.1, momentum=0.9,
+                                   parameters=m.parameters(),
+                                   sparsity=[0.5]), x, y, steps=30)
+    assert losses[-1] < losses[0] * 0.5, losses
+
+
+def test_dgc_momentum_factor_masking():
+    """dgc_op semantics: velocity is zeroed at coordinates that were sent."""
+    m, x, y = _model_and_data(8)
+    opt = DGCMomentum(learning_rate=0.05, momentum=0.9,
+                      parameters=m.parameters(), rampup_begin_step=0,
+                      sparsity=[0.75])
+    import paddle_tpu.nn.functional as F
+    loss = F.mse_loss(m(x), y)
+    loss.backward()
+    w0 = m.weight.numpy().copy()
+    opt.step()
+    moved = np.abs(m.weight.numpy() - w0) > 0
+    vel = np.asarray(opt._accumulators["velocity"][m.weight.name])
+    assert (vel[moved] == 0).all()        # sent coords: velocity cleared
+    assert (np.abs(vel[~moved]) > 0).any()  # unsent keep momentum history
+
+
+def test_dgc_sparsity_ramp():
+    opt = DGCMomentum(learning_rate=0.1, momentum=0.9,
+                      parameters=nn.Linear(2, 2).parameters(),
+                      rampup_begin_step=0, rampup_step=9,
+                      sparsity=[0.3, 0.6, 0.9])
+    import jax.numpy as jnp
+    got = [float(opt._sparsity_at(jnp.int32(t))) for t in (1, 2, 3, 4, 7,
+                                                           100)]
+    assert got[0] == pytest.approx(0.3)      # first segment
+    assert got[3] == pytest.approx(0.6)      # t=4 -> seg 1
+    assert got[4] == pytest.approx(0.9)      # t=7 -> seg 2
+    assert got[5] == pytest.approx(0.9)      # clamped after ramp
+
+
+def test_fleet_strategy_preserves_momentum_config():
+    m, _, _ = _model_and_data(9)
+    from paddle_tpu.optimizer import L2Decay
+    strat = DistributedStrategy()
+    strat.dgc = True
+    opt = fleet.distributed_optimizer(
+        Momentum(learning_rate=0.1, momentum=0.8, use_nesterov=True,
+                 weight_decay=L2Decay(1e-4), parameters=m.parameters()),
+        strat)
+    assert isinstance(opt, DGCMomentum)
+    assert opt._momentum == 0.8 and opt._nesterov
+    assert opt._wd_coeff == pytest.approx(1e-4)
+
+
+def test_fleet_strategy_swaps_optimizer():
+    m, _, _ = _model_and_data(7)
+    strat = DistributedStrategy()
+    strat.lars = True
+    strat.lars_configs = {"lars_coeff": 0.002}
+    opt = fleet.distributed_optimizer(
+        Momentum(learning_rate=0.1, parameters=m.parameters()), strat)
+    assert isinstance(opt, Lars) and opt._coeff == 0.002
+
+    strat2 = DistributedStrategy()
+    strat2.dgc = True
+    opt2 = fleet.distributed_optimizer(
+        Momentum(learning_rate=0.1, parameters=m.parameters()), strat2)
+    assert isinstance(opt2, DGCMomentum)
+
+    with pytest.raises(ValueError, match="Momentum"):
+        fleet.distributed_optimizer(
+            paddle.optimizer.Adam(learning_rate=0.1,
+                                  parameters=m.parameters()), strat)
