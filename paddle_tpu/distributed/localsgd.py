@@ -25,15 +25,13 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core.tensor import Tensor
 from ..framework import random as _rng
+from ..framework.aux_loss import aux_loss_scope, total as _aux_total
 from ..jit.functional import functional_call, load_state, raw_state, _wrap
+from ..jit.training import _raw_tuple
 from ..autograd.tape import no_grad
 from . import mesh as mesh_mod
 
 __all__ = ["LocalSGDStep"]
-
-
-def _raw(x):
-    return x.value if isinstance(x, Tensor) else jnp.asarray(x)
 
 
 class LocalSGDStep:
@@ -106,13 +104,16 @@ class LocalSGDStep:
             key = jax.random.fold_in(rng_key, jax.lax.axis_index("dp"))
 
             def loss_of(pp):
-                with _rng.rng_guard(key):
+                with _rng.rng_guard(key), aux_loss_scope() as auxes:
                     out, new_b = functional_call(model, pp, b, *inputs,
                                                  training=True)
                     with no_grad():
                         lt = loss_fn(_wrap(out),
                                      *[_wrap(l) for l in labels])
-                return (lt.value if isinstance(lt, Tensor) else lt), new_b
+                lv = lt.value if isinstance(lt, Tensor) else lt
+                if auxes:   # MoE load-balancing etc., already weighted
+                    lv = lv + _aux_total(auxes)
+                return lv, new_b
 
             (loss, new_b), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(p)
@@ -146,7 +147,7 @@ class LocalSGDStep:
     def __call__(self, *batch):
         if self._local is None:
             self._build(len(batch))
-        raw = tuple(_raw(b) for b in batch)
+        raw = _raw_tuple(batch)
         lr = jnp.float32(self.optimizer.get_lr())
         self.step_count += 1
         key = _rng.default_generator().fold_in(self.step_count)
