@@ -3,6 +3,7 @@ sparsity, autotune config, and the MoE models re-export (the MoE
 implementation itself lives in distributed/moe.py)."""
 from . import asp
 from . import autotune
+from . import checkpoint
 
 
 class _MoENamespace:
@@ -21,7 +22,8 @@ class _DistributedNamespace:
 distributed = _DistributedNamespace()
 distributed.models.moe = _MoENamespace()
 
-__all__ = ["asp", "autotune", "distributed", "LookAhead", "ModelAverage",
+__all__ = ["asp", "autotune", "checkpoint", "distributed", "LookAhead",
+           "ModelAverage",
            "graph_khop_sampler", "graph_reindex", "graph_sample_neighbors",
            "graph_send_recv", "identity_loss", "segment_max",
            "segment_mean", "segment_min", "segment_sum",

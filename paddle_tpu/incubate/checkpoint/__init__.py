@@ -1,0 +1,18 @@
+"""Auto-checkpoint: epoch-boundary snapshots with resume-on-restart.
+
+Parity: python/paddle/fluid/incubate/checkpoint/auto_checkpoint.py
+(TrainEpochRange:284, AutoCheckpointChecker:72) — the piece that pairs
+with elastic recovery (SURVEY.md §5.3/§5.4): a job that is killed and
+relaunched resumes from the last completed epoch instead of epoch 0.
+
+TPU-native simplifications: snapshots go through paddle.save (pickle
+state_dict protocol, io/state.py) to a local/NFS dir instead of HDFS;
+the job identity comes from PADDLE_JOB_ID (fallback: checkpoint dir), and
+epoch bookkeeping is one small JSON sidecar. Rank-0 writes, everyone
+reads — multi-host jobs point at shared storage, exactly the reference's
+HDFS contract.
+"""
+from .auto_checkpoint import (AutoCheckpointChecker, TrainEpochRange,
+                              train_epoch_range)
+
+__all__ = ["TrainEpochRange", "train_epoch_range", "AutoCheckpointChecker"]
