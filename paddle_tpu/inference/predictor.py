@@ -150,8 +150,14 @@ class Predictor:
             meta = pickle.load(f)
         self._state = {n: jax.device_put(v)
                        for n, v in meta["state"].items()}
-        n_inputs = max(len(self._exported.in_avals) - 1, 1) \
-            if not meta.get("input_spec") else len(meta["input_spec"])
+        self._input_spec = meta.get("input_spec") or None
+        if self._input_spec:
+            n_inputs = len(self._input_spec)
+        else:
+            # in_avals is the FLATTENED arg tree: one aval per state leaf
+            # plus one per real input
+            n_inputs = max(
+                len(self._exported.in_avals) - len(meta["state"]), 1)
         self._input_names = [f"x{i}" for i in range(n_inputs)]
         self._inputs: Dict[str, Tensor] = {
             n: Tensor(n, self) for n in self._input_names}
@@ -161,6 +167,21 @@ class Predictor:
     # -- handles ---------------------------------------------------------
     def get_input_names(self) -> List[str]:
         return list(self._input_names)
+
+    def get_input_dtype(self, name: str) -> Optional[str]:
+        """Declared dtype of an input (from the saved InputSpec), or None
+        when the model was exported without specs."""
+        if name not in self._input_names:
+            raise KeyError(f"unknown input {name!r}; expected "
+                           f"{self._input_names}")
+        if self._input_spec is None:
+            return None
+        spec = self._input_spec[self._input_names.index(name)]
+        # saved form (jit.api.save): (shape_strs, dtype_str)
+        if isinstance(spec, (tuple, list)) and len(spec) == 2:
+            return str(spec[1])
+        dt = getattr(spec, "dtype", None)
+        return str(dt) if dt is not None else None
 
     def get_input_handle(self, name: str) -> Tensor:
         return self._inputs[name]

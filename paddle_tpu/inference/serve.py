@@ -1,0 +1,161 @@
+"""HTTP serving front-end over the inference predictor.
+
+Serving-path role (BASELINE.json north star: "ERNIE-3.0 served
+end-to-end"): the reference serves through AnalysisPredictor embedded in
+C++ servers or the FleetExecutor DistModel service
+(fleet_executor/dist_model.cc). TPU-native equivalent: the AOT-compiled
+predictor (inference/predictor.py) behind a threaded stdlib HTTP server —
+zero extra dependencies, JSON tensors in/out.
+
+Endpoints:
+  GET  /health    -> {"status": "ok"}
+  GET  /metadata  -> input/output names (+ dtypes/shapes once known)
+  POST /predict   -> {"inputs": {name: nested-list | {"data": ...,
+                      "dtype": "float32"}}} -> {"outputs": {name: ...}}
+
+CLI: python -m paddle_tpu.inference.serve --model m.pdmodel --port 8866
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from .predictor import Config, create_predictor
+
+__all__ = ["PredictorServer", "main"]
+
+
+class PredictorServer:
+    """Owns one predictor and an HTTP server bound to host:port.
+
+    The predictor is not thread-safe (zero-copy handles are shared
+    state), so requests serialize on a lock — concurrency comes from the
+    XLA program itself, which is where the time goes.
+    """
+
+    def __init__(self, model_path_or_config, host: str = "127.0.0.1",
+                 port: int = 8866):
+        cfg = (model_path_or_config
+               if isinstance(model_path_or_config, Config)
+               else Config(model_path_or_config))
+        self.predictor = create_predictor(cfg)
+        self._lock = threading.Lock()
+        self.httpd = ThreadingHTTPServer((host, port),
+                                         self._make_handler())
+        self.host, self.port = self.httpd.server_address[:2]
+        self._thread = None
+
+    # ------------------------------------------------------------------
+    def _metadata(self):
+        return {"inputs": self.predictor.get_input_names(),
+                "outputs": self.predictor.get_output_names()}
+
+    def _predict(self, payload):
+        inputs = payload.get("inputs")
+        if not isinstance(inputs, dict):
+            raise ValueError('body must be {"inputs": {name: tensor}}')
+        names = self.predictor.get_input_names()
+        unknown = set(inputs) - set(names)
+        if unknown:
+            raise ValueError(f"unknown input(s) {sorted(unknown)}; "
+                             f"expected {names}")
+        missing = set(names) - set(inputs)
+        if missing:
+            raise ValueError(f"missing input(s) {sorted(missing)}")
+        with self._lock:
+            for name in names:
+                v = inputs[name]
+                dtype = v.get("dtype") if isinstance(v, dict) else None
+                data = v["data"] if isinstance(v, dict) else v
+                if dtype is None:
+                    # JSON numbers arrive as int64/float64: coerce to the
+                    # model's declared input dtype when it is known
+                    dtype = self.predictor.get_input_dtype(name)
+                arr = np.asarray(data, dtype=dtype)
+                self.predictor.get_input_handle(name).copy_from_cpu(arr)
+            self.predictor.run()
+            outs = {}
+            for name in self.predictor.get_output_names():
+                a = np.asarray(
+                    self.predictor.get_output_handle(name).copy_to_cpu())
+                outs[name] = {"data": a.tolist(), "dtype": str(a.dtype),
+                              "shape": list(a.shape)}
+        return {"outputs": outs}
+
+    # ------------------------------------------------------------------
+    def _make_handler(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):        # quiet by default
+                pass
+
+            def _send(self, code, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/health":
+                    self._send(200, {"status": "ok"})
+                elif self.path == "/metadata":
+                    self._send(200, server._metadata())
+                else:
+                    self._send(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):
+                if self.path != "/predict":
+                    self._send(404, {"error": f"no route {self.path}"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    payload = json.loads(self.rfile.read(n) or b"{}")
+                    self._send(200, server._predict(payload))
+                except (ValueError, KeyError) as e:
+                    self._send(400, {"error": str(e)})
+                except Exception as e:   # noqa: BLE001 — report, keep serving
+                    self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+        return Handler
+
+    # ------------------------------------------------------------------
+    def start(self, background: bool = True):
+        if background:
+            self._thread = threading.Thread(
+                target=self.httpd.serve_forever, daemon=True)
+            self._thread.start()
+        else:
+            self.httpd.serve_forever()
+        return self
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Serve a saved paddle_tpu model over HTTP")
+    ap.add_argument("--model", required=True,
+                    help="path to the saved .pdmodel")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8866)
+    args = ap.parse_args(argv)
+    srv = PredictorServer(args.model, args.host, args.port)
+    print(f"serving {args.model} on http://{srv.host}:{srv.port}",
+          flush=True)
+    srv.start(background=False)
+
+
+if __name__ == "__main__":
+    main()

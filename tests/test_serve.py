@@ -1,0 +1,71 @@
+"""HTTP predictor-server tests (serving north star: model served
+end-to-end; reference role: DistModel service / embedded predictor)."""
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.inference.serve import PredictorServer
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.Linear(16, 4))
+    m.eval()
+    path = str(tmp_path_factory.mktemp("serve") / "model")
+    paddle.jit.save(m, path,
+                    input_spec=[paddle.jit.InputSpec([None, 8])])
+    srv = PredictorServer(path + ".pdmodel", port=0).start()
+    yield srv, m
+    srv.stop()
+
+
+def _req(srv, path, payload=None):
+    url = f"http://{srv.host}:{srv.port}{path}"
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(
+        url, data=data,
+        headers={"Content-Type": "application/json"} if data else {})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_health_and_metadata(server):
+    srv, _ = server
+    code, body = _req(srv, "/health")
+    assert code == 200 and body["status"] == "ok"
+    code, meta = _req(srv, "/metadata")
+    assert code == 200
+    assert len(meta["inputs"]) == 1 and len(meta["outputs"]) == 1
+
+
+def test_predict_matches_eager(server):
+    srv, m = server
+    x = np.random.RandomState(0).randn(3, 8).astype("float32")
+    _, meta = _req(srv, "/metadata")
+    code, body = _req(srv, "/predict", {
+        "inputs": {meta["inputs"][0]: {"data": x.tolist(),
+                                       "dtype": "float32"}}})
+    assert code == 200, body
+    out = body["outputs"][meta["outputs"][0]]
+    got = np.asarray(out["data"], dtype=out["dtype"])
+    want = m(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    assert out["shape"] == [3, 4]
+
+
+def test_predict_error_paths(server):
+    srv, _ = server
+    code, body = _req(srv, "/predict", {"inputs": {"nope": [[1.0]]}})
+    assert code == 400 and "unknown" in body["error"]
+    code, body = _req(srv, "/predict", {"bad": 1})
+    assert code == 400
+    code, body = _req(srv, "/nothing")
+    assert code == 404
