@@ -144,3 +144,83 @@ def worker_index() -> int:
 
 def is_first_worker() -> bool:
     return worker_index() == 0
+
+
+from .base import (PaddleCloudRoleMaker, Role,  # noqa: E402
+                   UserDefinedRoleMaker, UtilBase)
+from .data_generator import (DataGenerator,  # noqa: E402
+                             MultiSlotDataGenerator,
+                             MultiSlotStringDataGenerator)
+from ..topology import CommunicateTopology  # noqa: E402
+
+
+class Fleet:
+    """Object spelling of this module (reference fleet.py:Fleet — the
+    singleton `paddle.distributed.fleet` operates on). Methods delegate
+    to the role maker installed by init() (module functions are the
+    env-default fallback)."""
+
+    def __init__(self):
+        self._role_maker = None
+        self._util = None
+
+    def init(self, role_maker=None, is_collective=True, strategy=None):
+        self._role_maker = role_maker or PaddleCloudRoleMaker(
+            is_collective=is_collective)
+        self._util = UtilBase(self._role_maker)
+        return init(role_maker, is_collective, strategy)
+
+    @property
+    def util(self) -> "UtilBase":
+        if self._util is None:
+            raise RuntimeError("fleet.init() must be called before "
+                               "fleet.util")
+        return self._util
+
+    def distributed_model(self, model):
+        return distributed_model(model)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        return distributed_optimizer(optimizer,
+                                     strategy or get_strategy())
+
+    def worker_num(self):
+        return (self._role_maker.worker_num() if self._role_maker
+                else worker_num())
+
+    def worker_index(self):
+        return (self._role_maker.worker_index() if self._role_maker
+                else worker_index())
+
+    def is_first_worker(self):
+        return (self._role_maker.is_first_worker() if self._role_maker
+                else is_first_worker())
+
+    def is_worker(self):
+        return (self._role_maker.is_worker() if self._role_maker
+                else True)
+
+    def is_server(self):
+        return (self._role_maker.is_server() if self._role_maker
+                else False)
+
+    def barrier_worker(self):
+        if worker_num() > 1:
+            from .. import collective as C
+            C.barrier()
+
+    def stop_worker(self):
+        """PS lifecycle no-op on the collective path (PS stack deferred
+        per SURVEY.md §2.6)."""
+
+    init_worker = stop_worker
+    run_server = stop_worker
+    init_server = stop_worker
+
+
+fleet = Fleet()
+
+__all__ += ["Fleet", "fleet", "Role", "PaddleCloudRoleMaker",
+            "UserDefinedRoleMaker", "UtilBase", "CommunicateTopology",
+            "DataGenerator", "MultiSlotDataGenerator",
+            "MultiSlotStringDataGenerator"]

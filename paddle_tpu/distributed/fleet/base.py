@@ -1,0 +1,170 @@
+"""fleet base pieces: Role, role makers, UtilBase.
+
+Parity: python/paddle/distributed/fleet/base/role_maker.py
+(PaddleCloudRoleMaker/UserDefinedRoleMaker, Role enum) and
+base/util_factory.py (UtilBase:48 — all_reduce/barrier/all_gather/
+get_file_shard/print_on_rank). The reference binds these to Gloo/brpc
+worlds; TPU-native they sit on the env/jax process info and the eager
+collectives, with exact single-process semantics when world_size == 1.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..env import ParallelEnv
+
+__all__ = ["Role", "PaddleCloudRoleMaker", "UserDefinedRoleMaker",
+           "UtilBase"]
+
+
+class Role:
+    """Parity: role_maker.Role (WORKER=1, SERVER=2, HETER_WORKER=3)."""
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+
+
+class PaddleCloudRoleMaker:
+    """Role maker reading the launcher-provided env (the role
+    paddlecloud/fleetrun env plays in the reference, role_maker.py).
+
+    On a collective TPU job every process is a WORKER; server roles
+    belong to the deferred PS stack.
+    """
+
+    def __init__(self, is_collective: bool = True, **kwargs):
+        self._is_collective = is_collective
+        self._env = ParallelEnv()
+
+    def _worker_index(self) -> int:
+        return self._env.rank
+
+    def _worker_num(self) -> int:
+        return self._env.world_size
+
+    def _is_first_worker(self) -> bool:
+        return self._env.rank == 0
+
+    def _role(self):
+        return Role.WORKER
+
+    def _is_worker(self) -> bool:
+        return True
+
+    def _is_server(self) -> bool:
+        return False
+
+    # public spellings used throughout reference examples
+    worker_index = _worker_index
+    worker_num = _worker_num
+    is_first_worker = _is_first_worker
+    is_worker = _is_worker
+    is_server = _is_server
+
+    def _get_trainer_endpoints(self) -> List[str]:
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else []
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """Explicit ranks instead of env (role_maker.py UserDefinedRoleMaker)."""
+
+    def __init__(self, is_collective: bool = True, current_id: int = 0,
+                 worker_num: int = 1, role=Role.WORKER,
+                 worker_endpoints: Optional[Sequence[str]] = None,
+                 **kwargs):
+        super().__init__(is_collective)
+        self._current_id = int(current_id)
+        self._worker_num_val = int(worker_num)
+        self._role_val = role
+        self._endpoints = list(worker_endpoints or [])
+
+    def _worker_index(self) -> int:
+        return self._current_id
+
+    def _worker_num(self) -> int:
+        return self._worker_num_val
+
+    def _is_first_worker(self) -> bool:
+        return self._current_id == 0
+
+    def _role(self):
+        return self._role_val
+
+    def _is_worker(self) -> bool:
+        return self._role_val == Role.WORKER
+
+    def _is_server(self) -> bool:
+        return self._role_val == Role.SERVER
+
+    worker_index = _worker_index
+    worker_num = _worker_num
+    is_first_worker = _is_first_worker
+    is_worker = _is_worker
+    is_server = _is_server
+
+    def _get_trainer_endpoints(self) -> List[str]:
+        return list(self._endpoints)
+
+
+class UtilBase:
+    """Parity: util_factory.UtilBase — small cross-worker utilities."""
+
+    def __init__(self, role_maker: Optional[PaddleCloudRoleMaker] = None):
+        self.role_maker = role_maker or PaddleCloudRoleMaker()
+
+    # -- collectives over the worker world -----------------------------
+    def all_reduce(self, input, mode: str = "sum", comm_world="worker"):
+        if mode not in ("sum", "max", "min", "mean"):
+            raise ValueError(f"unsupported all_reduce mode {mode!r}")
+        n = self.role_maker.worker_num()
+        if n <= 1:
+            return np.asarray(input)
+        from .. import collective as C
+        from ...core.tensor import Tensor
+        op = {"sum": C.ReduceOp.SUM, "mean": C.ReduceOp.SUM,
+              "max": C.ReduceOp.MAX, "min": C.ReduceOp.MIN}[mode]
+        t = Tensor(np.asarray(input))
+        C.all_reduce(t, op=op)
+        out = t.numpy()
+        return out / n if mode == "mean" else out
+
+    def barrier(self, comm_world="worker"):
+        if self.role_maker.worker_num() <= 1:
+            return
+        from .. import collective as C
+        C.barrier()
+
+    def all_gather(self, input, comm_world="worker"):
+        if self.role_maker.worker_num() <= 1:
+            return [input]
+        from .. import collective as C
+        from ...core.tensor import Tensor
+        out: list = []
+        C.all_gather(out, Tensor(np.asarray(input)))
+        return [o.numpy() for o in out]
+
+    # -- sharding helpers ----------------------------------------------
+    def get_file_shard(self, files: Sequence[str]) -> List[str]:
+        """Split a file list evenly over workers (util_factory.py:230):
+        the first `remainder` workers take one extra file."""
+        if not isinstance(files, (list, tuple)):
+            raise TypeError("files should be a list of file paths")
+        idx = self.role_maker.worker_index()
+        n = self.role_maker.worker_num()
+        per, rem = divmod(len(files), n)
+        if idx < rem:
+            start = idx * (per + 1)
+            end = start + per + 1
+        else:
+            start = rem * (per + 1) + (idx - rem) * per
+            end = start + per
+        return list(files[start:end])
+
+    def print_on_rank(self, message: str, rank_id: int) -> None:
+        if self.role_maker.worker_index() == rank_id:
+            print(message, flush=True)

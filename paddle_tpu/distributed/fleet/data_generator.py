@@ -1,0 +1,129 @@
+"""fleet data generators — the MultiSlot datafeed text protocol.
+
+Parity: python/paddle/distributed/fleet/data_generator/data_generator.py
+(DataGenerator:20, MultiSlotStringDataGenerator:239,
+MultiSlotDataGenerator:~280). A user subclass overrides
+generate_sample(line) (and optionally generate_batch); run_from_stdin /
+run_from_files stream raw lines through it and emit the MultiSlot text
+format the C++ datafeed reads:
+
+    <ids_num> <id1> ... <idN>  (per slot, space-joined, one sample/line)
+
+The PS training stack that consumes this is deferred (SURVEY.md §2.6 PS
+row); the generators are kept because users run them standalone to
+produce dataset files.
+"""
+from __future__ import annotations
+
+import sys
+from typing import Iterable
+
+__all__ = ["DataGenerator", "MultiSlotDataGenerator",
+           "MultiSlotStringDataGenerator"]
+
+
+class DataGenerator:
+    def __init__(self):
+        self.batch_size_ = 32
+
+    def set_batch(self, batch_size: int):
+        self.batch_size_ = int(batch_size)
+
+    # -- user overrides -------------------------------------------------
+    def generate_sample(self, line):
+        """Return a no-arg callable yielding parsed samples
+        ([(slot_name, [feasign, ...]), ...]) for one raw line."""
+        raise NotImplementedError(
+            "generate_sample must be overridden (return a local_iter "
+            "callable, reference data_generator.py:153)")
+
+    def generate_batch(self, samples):
+        """Optional batch-level hook; defaults to yielding samples as-is."""
+        def local_iter():
+            yield from samples
+        return local_iter
+
+    # -- drivers ---------------------------------------------------------
+    def _stream(self, lines: Iterable[str], out=None):
+        out = out or sys.stdout
+        batch = []
+        for line in lines:
+            for sample in self.generate_sample(line)():
+                if sample is None:
+                    continue
+                batch.append(sample)
+                if len(batch) == self.batch_size_:
+                    for s in self.generate_batch(batch)():
+                        out.write(self._gen_str(s))
+                    batch = []
+        if batch:
+            for s in self.generate_batch(batch)():
+                out.write(self._gen_str(s))
+
+    def run_from_stdin(self):
+        self._stream(sys.stdin)
+
+    def run_from_files(self, paths):
+        for p in paths:
+            with open(p) as f:
+                self._stream(f)
+
+    def run_from_memory(self, lines=None):
+        # reference signature takes no lines (user yields from memory in
+        # generate_sample(None)); accept an iterable for convenience
+        self._stream(lines if lines is not None else [None])
+
+    def _gen_str(self, line) -> str:
+        raise NotImplementedError(
+            "use MultiSlotDataGenerator or MultiSlotStringDataGenerator")
+
+
+def _check_slots(line):
+    if isinstance(line, zip):
+        line = list(line)
+    if not isinstance(line, (list, tuple)) or not line:
+        raise ValueError(
+            "the output of generate_sample must be a non-empty list/tuple "
+            "of (slot_name, [feasign, ...]) pairs")
+    return line
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    """Feasigns already strings: fastest path (data_generator.py:239)."""
+
+    def _gen_str(self, line) -> str:
+        parts = []
+        for _name, feasigns in _check_slots(line):
+            parts.append(str(len(feasigns)))
+            parts.extend(str(f) for f in feasigns)
+        return " ".join(parts) + "\n"
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """Numeric feasigns; tracks per-slot dtype the way the reference
+    builds proto_info (uint64 unless a float appears)."""
+
+    def __init__(self):
+        super().__init__()
+        self._proto_info = None
+
+    def _gen_str(self, line) -> str:
+        line = _check_slots(line)
+        if self._proto_info is None:
+            self._proto_info = [
+                (name, "float" if any(isinstance(f, float)
+                                      for f in feas) else "uint64")
+                for name, feas in line]
+        elif len(line) != len(self._proto_info):
+            raise ValueError(
+                f"sample has {len(line)} slots but the first sample "
+                f"defined {len(self._proto_info)} — every sample must "
+                "emit the same slots in the same order")
+        parts = []
+        for i, (name, feasigns) in enumerate(line):
+            if any(isinstance(f, float) for f in feasigns) and \
+                    self._proto_info[i][1] != "float":
+                self._proto_info[i] = (name, "float")
+            parts.append(str(len(feasigns)))
+            parts.extend(str(f) for f in feasigns)
+        return " ".join(parts) + "\n"

@@ -1,0 +1,107 @@
+"""fleet base surface tests: Fleet facade, role makers, UtilBase,
+MultiSlot data generators (reference: base/role_maker.py,
+base/util_factory.py, data_generator/data_generator.py)."""
+import io
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import fleet as fleet_mod
+from paddle_tpu.distributed.fleet import (DataGenerator, Fleet,
+                                          MultiSlotDataGenerator,
+                                          MultiSlotStringDataGenerator,
+                                          PaddleCloudRoleMaker, Role,
+                                          UserDefinedRoleMaker, UtilBase)
+
+
+def test_fleet_object_mirrors_module():
+    f = Fleet()
+    f.init(is_collective=True)
+    assert f.worker_num() == fleet_mod.worker_num()
+    assert f.worker_index() == fleet_mod.worker_index()
+    assert f.is_first_worker() == fleet_mod.is_first_worker()
+    assert f.is_worker() and not f.is_server()
+    f.init_worker()    # PS lifecycle: no-ops on the collective path
+    f.stop_worker()
+    m = nn.Linear(4, 2)
+    assert f.distributed_model(m) is not None
+    opt = paddle.optimizer.Momentum(learning_rate=0.1,
+                                    parameters=m.parameters())
+    assert f.distributed_optimizer(opt) is opt
+
+
+def test_role_makers():
+    rm = PaddleCloudRoleMaker()
+    assert rm.is_worker() and not rm.is_server()
+    assert rm.worker_index() >= 0 and rm.worker_num() >= 1
+
+    u = UserDefinedRoleMaker(current_id=3, worker_num=8, role=Role.WORKER,
+                             worker_endpoints=[f"h{i}:90" for i in
+                                               range(8)])
+    assert u.worker_index() == 3
+    assert u.worker_num() == 8
+    assert not u.is_first_worker()
+    assert len(u._get_trainer_endpoints()) == 8
+
+
+def test_util_file_shard():
+    files = [f"part-{i:03d}" for i in range(10)]
+    shards = []
+    for idx in range(3):
+        util = UtilBase(UserDefinedRoleMaker(current_id=idx, worker_num=3))
+        shards.append(util.get_file_shard(files))
+    # 10 files over 3 workers: 4/3/3, disjoint, order-preserving
+    assert [len(s) for s in shards] == [4, 3, 3]
+    assert sum(shards, []) == files
+    with pytest.raises(TypeError):
+        UtilBase().get_file_shard("not-a-list")
+
+
+def test_util_single_world_collectives():
+    util = UtilBase(UserDefinedRoleMaker(current_id=0, worker_num=1))
+    out = util.all_reduce(np.arange(4.0))
+    np.testing.assert_allclose(out, np.arange(4.0))
+    assert len(util.all_gather(np.ones(2))) == 1
+    util.barrier()   # no-op, must not hang
+
+
+class _WordsGen(MultiSlotStringDataGenerator):
+    def generate_sample(self, line):
+        def local_iter():
+            w, label = line.strip().split("\t")
+            yield [("words", w.split()), ("label", [label])]
+        return local_iter
+
+
+def test_multislot_string_generator():
+    gen = _WordsGen()
+    gen.set_batch(2)
+    buf = io.StringIO()
+    gen._stream(["1926 08 17\t1\n", "5 6\t0\n"], out=buf)
+    lines = buf.getvalue().splitlines()
+    assert lines == ["3 1926 08 17 1 1", "2 5 6 1 0"]
+
+
+class _NumGen(MultiSlotDataGenerator):
+    def generate_sample(self, line):
+        def local_iter():
+            yield [("ids", [1, 2, 3]), ("score", [0.5])]
+        return local_iter
+
+
+def test_multislot_numeric_generator_tracks_dtype():
+    gen = _NumGen()
+    buf = io.StringIO()
+    gen._stream(["x"], out=buf)
+    assert buf.getvalue() == "3 1 2 3 1 0.5\n"
+    assert gen._proto_info == [("ids", "uint64"), ("score", "float")]
+
+
+def test_base_generator_requires_overrides():
+    g = DataGenerator()
+    with pytest.raises(NotImplementedError):
+        g.generate_sample("x")
+    with pytest.raises(NotImplementedError):
+        g._gen_str([("a", [1])])
