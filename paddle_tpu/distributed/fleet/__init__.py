@@ -205,9 +205,9 @@ class Fleet:
                 else False)
 
     def barrier_worker(self):
-        if worker_num() > 1:
-            from .. import collective as C
-            C.barrier()
+        # same real-world gating as UtilBase.barrier (role maker's claimed
+        # worker_num never drives a collective)
+        UtilBase(self._role_maker).barrier()
 
     def stop_worker(self):
         """PS lifecycle no-op on the collective path (PS stack deferred

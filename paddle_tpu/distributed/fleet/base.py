@@ -118,10 +118,18 @@ class UtilBase:
         self.role_maker = role_maker or PaddleCloudRoleMaker()
 
     # -- collectives over the worker world -----------------------------
+    # collectives act on the REAL communication world (ParallelEnv),
+    # never the role maker's claimed worker_num: a UserDefinedRoleMaker
+    # declaring 8 workers inside a 1-process run must not invoke (or
+    # divide by) a phantom world.
+    @staticmethod
+    def _comm_world() -> int:
+        return ParallelEnv().world_size
+
     def all_reduce(self, input, mode: str = "sum", comm_world="worker"):
         if mode not in ("sum", "max", "min", "mean"):
             raise ValueError(f"unsupported all_reduce mode {mode!r}")
-        n = self.role_maker.worker_num()
+        n = self._comm_world()
         if n <= 1:
             return np.asarray(input)
         from .. import collective as C
@@ -134,13 +142,13 @@ class UtilBase:
         return out / n if mode == "mean" else out
 
     def barrier(self, comm_world="worker"):
-        if self.role_maker.worker_num() <= 1:
+        if self._comm_world() <= 1:
             return
         from .. import collective as C
         C.barrier()
 
     def all_gather(self, input, comm_world="worker"):
-        if self.role_maker.worker_num() <= 1:
+        if self._comm_world() <= 1:
             return [input]
         from .. import collective as C
         from ...core.tensor import Tensor

@@ -119,6 +119,11 @@ class MultiSlotDataGenerator(DataGenerator):
                 f"sample has {len(line)} slots but the first sample "
                 f"defined {len(self._proto_info)} — every sample must "
                 "emit the same slots in the same order")
+        elif [n for n, _ in line] != [n for n, _ in self._proto_info]:
+            raise ValueError(
+                "the field names of the given sample do not match the "
+                f"first sample: {[n for n, _ in line]} vs "
+                f"{[n for n, _ in self._proto_info]}")
         parts = []
         for i, (name, feasigns) in enumerate(line):
             if any(isinstance(f, float) for f in feasigns) and \

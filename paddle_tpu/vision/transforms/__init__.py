@@ -11,6 +11,15 @@ __all__ = ["Compose", "Normalize", "Resize", "CenterCrop", "RandomCrop",
            "RandomHorizontalFlip", "ToTensor", "Transpose"]
 
 
+def _keyed(keys, fn, inputs):
+    """Apply fn to 'image' entries when keys are declared (BaseTransform
+    contract), else to the single input."""
+    if keys is None:
+        return fn(inputs)
+    return tuple(fn(v) if k == "image" else v
+                 for k, v in zip(keys, inputs))
+
+
 class Compose:
     def __init__(self, transforms):
         self.transforms = list(transforms)
@@ -22,15 +31,20 @@ class Compose:
 
 
 class Normalize:
-    def __init__(self, mean, std, data_format="CHW", **kw):
-        self.mean = np.asarray(mean, np.float32)
-        self.std = np.asarray(std, np.float32)
+    def __init__(self, mean, std, data_format="CHW", keys=None, **kw):
+        self.mean, self.std = mean, std
         self.data_format = data_format
+        self.keys = keys
+
+    def _apply_image(self, x):
+        from .functional import normalize
+        return normalize(x, self.mean, self.std, self.data_format)
 
     def __call__(self, x):
-        x = np.asarray(x, np.float32)
-        shape = (-1, 1, 1) if self.data_format == "CHW" else (1, 1, -1)
-        return (x - self.mean.reshape(shape)) / self.std.reshape(shape)
+        if self.keys is None:
+            return self._apply_image(x)
+        return tuple(self._apply_image(v) if k == "image" else v
+                     for k, v in zip(self.keys, x))
 
 
 def _target_hw(img, size):
@@ -73,7 +87,8 @@ def _resize_bilinear(img, nh, nw):
 class Resize:
     """Parity: transforms.Resize; nearest + bilinear host kernels."""
 
-    def __init__(self, size, interpolation="bilinear", **kw):
+    def __init__(self, size, interpolation="bilinear", keys=None, **kw):
+        self.keys = keys
         self.size = size
         if interpolation not in ("nearest", "bilinear"):
             raise ValueError(
@@ -81,19 +96,26 @@ class Resize:
                 "resize implements 'nearest' and 'bilinear'")
         self.interpolation = interpolation
 
-    def __call__(self, img):
+    def _apply_image(self, img):
         img = np.asarray(img)
         nh, nw = _target_hw(img, self.size)
         if self.interpolation == "nearest":
             return _resize_nearest(img, nh, nw)
         return _resize_bilinear(img, nh, nw)
 
+    def __call__(self, img):
+        return _keyed(self.keys, self._apply_image, img)
+
 
 class CenterCrop:
-    def __init__(self, size):
+    def __init__(self, size, keys=None):
+        self.keys = keys
         self.size = (size, size) if isinstance(size, int) else tuple(size)
 
     def __call__(self, img):
+        return _keyed(self.keys, self._apply_image, img)
+
+    def _apply_image(self, img):
         img = np.asarray(img)
         h, w = img.shape[:2]
         th, tw = self.size
@@ -105,10 +127,14 @@ class CenterCrop:
 
 
 class RandomCrop:
-    def __init__(self, size, **kw):
+    def __init__(self, size, keys=None, **kw):
+        self.keys = keys
         self.size = (size, size) if isinstance(size, int) else tuple(size)
 
     def __call__(self, img):
+        return _keyed(self.keys, self._apply_image, img)
+
+    def _apply_image(self, img):
         img = np.asarray(img)
         h, w = img.shape[:2]
         th, tw = self.size
@@ -121,10 +147,14 @@ class RandomCrop:
 
 
 class RandomHorizontalFlip:
-    def __init__(self, prob=0.5):
+    def __init__(self, prob=0.5, keys=None):
         self.prob = prob
+        self.keys = keys
 
     def __call__(self, img):
+        return _keyed(self.keys, self._apply_image, img)
+
+    def _apply_image(self, img):
         if np.random.rand() < self.prob:
             return np.asarray(img)[:, ::-1].copy()
         return np.asarray(img)
@@ -132,25 +162,320 @@ class RandomHorizontalFlip:
 
 class ToTensor:
     """HWC uint8 -> CHW float32 in [0,1] (floats pass through unscaled,
-    matching the reference's uint8-only scaling)."""
+    matching the reference's uint8-only scaling). Delegates to
+    functional.to_tensor; returns a raw numpy array for collate
+    friendliness."""
 
     def __init__(self, data_format="CHW", **kw):
         self.data_format = data_format
 
     def __call__(self, img):
-        arr = np.asarray(img)
-        x = arr.astype(np.float32) / 255.0 if arr.dtype == np.uint8 \
-            else arr.astype(np.float32)
-        if x.ndim == 2:
-            x = x[:, :, None]
-        if self.data_format == "CHW":
-            x = x.transpose(2, 0, 1)
-        return x
+        from .functional import to_tensor
+        return to_tensor(img, self.data_format).numpy()
 
 
 class Transpose:
-    def __init__(self, order=(2, 0, 1)):
+    def __init__(self, order=(2, 0, 1), keys=None):
         self.order = order
+        self.keys = keys
 
     def __call__(self, img):
-        return np.asarray(img).transpose(self.order)
+        return _keyed(self.keys,
+                      lambda im: np.asarray(im).transpose(self.order), img)
+
+
+# ---------------------------------------------------------------------------
+# full transform surface (reference: vision/transforms/transforms.py) over
+# the functional kernels in .functional
+# ---------------------------------------------------------------------------
+from . import functional  # noqa: E402
+from .functional import (adjust_brightness, adjust_contrast,  # noqa: E402
+                         adjust_hue, adjust_saturation, affine,
+                         center_crop, crop, erase, hflip, normalize, pad,
+                         perspective, resize, rotate, to_grayscale,
+                         to_tensor, vflip)
+
+__all__ += ["BaseTransform", "BrightnessTransform", "ColorJitter",
+            "ContrastTransform", "Grayscale", "HueTransform", "Pad",
+            "RandomAffine", "RandomErasing", "RandomPerspective",
+            "RandomResizedCrop", "RandomRotation", "RandomVerticalFlip",
+            "SaturationTransform", "functional",
+            "to_tensor", "normalize", "resize", "pad", "crop",
+            "center_crop", "hflip", "vflip", "rotate", "affine",
+            "perspective", "erase", "to_grayscale", "adjust_brightness",
+            "adjust_contrast", "adjust_saturation", "adjust_hue"]
+
+
+class BaseTransform:
+    """Parity: transforms.BaseTransform — subclasses implement
+    _apply_image (and optionally keys for paired targets)."""
+
+    def __init__(self, keys=None):
+        self.keys = keys
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+    def __call__(self, inputs):
+        if self.keys is None:
+            return self._apply_image(inputs)
+        outs = []
+        for key, inp in zip(self.keys, inputs):
+            outs.append(self._apply_image(inp) if key == "image" else inp)
+        return tuple(outs)
+
+
+def _jitter_range(value, name, center=1.0, bound=None):
+    """Reference _check_input (transforms.py:50): scalar v -> the range
+    [max(0, center-v), center+v]; a (min, max) pair passes through.
+    Returns None when the range collapses to the identity."""
+    if np.isscalar(value):
+        if value < 0:
+            raise ValueError(f"{name} value should be non-negative")
+        lo, hi = center - float(value), center + float(value)
+        if bound is None:
+            lo = max(0.0, lo)
+    else:
+        lo, hi = (float(v) for v in value)
+        if lo > hi:
+            raise ValueError(f"{name} range must have min <= max")
+    if bound is not None and not (bound[0] <= lo <= hi <= bound[1]):
+        raise ValueError(f"{name} values should be within {bound}")
+    if (lo, hi) == (center, center):
+        return None
+    return lo, hi
+
+
+class _JitterBase(BaseTransform):
+    _name = ""
+    _center = 1.0
+    _bound = None
+
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.rng = _jitter_range(value, self._name, self._center,
+                                 self._bound)
+
+    def _adjust(self, img, factor):
+        raise NotImplementedError
+
+    def _apply_image(self, img):
+        if self.rng is None:
+            return np.asarray(img)
+        return self._adjust(img, np.random.uniform(*self.rng))
+
+
+class BrightnessTransform(_JitterBase):
+    _name = "brightness"
+
+    def _adjust(self, img, f):
+        return adjust_brightness(img, f)
+
+
+class ContrastTransform(_JitterBase):
+    _name = "contrast"
+
+    def _adjust(self, img, f):
+        return adjust_contrast(img, f)
+
+
+class SaturationTransform(_JitterBase):
+    _name = "saturation"
+
+    def _adjust(self, img, f):
+        return adjust_saturation(img, f)
+
+
+class HueTransform(_JitterBase):
+    _name = "hue"
+    _center = 0.0
+    _bound = (-0.5, 0.5)
+
+    def _adjust(self, img, f):
+        return adjust_hue(img, f)
+
+
+class ColorJitter(BaseTransform):
+    """Parity: transforms.ColorJitter — random order of the four jitters."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        super().__init__(keys)
+        self.ts = [BrightnessTransform(brightness),
+                   ContrastTransform(contrast),
+                   SaturationTransform(saturation), HueTransform(hue)]
+
+    def _apply_image(self, img):
+        for i in np.random.permutation(len(self.ts)):
+            img = self.ts[i]._apply_image(img)
+        return img
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant",
+                 keys=None):
+        super().__init__(keys)
+        self.padding, self.fill = padding, fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        return pad(img, self.padding, self.fill, self.padding_mode)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return vflip(img) if np.random.rand() < self.prob \
+            else np.asarray(img)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="bilinear", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        if np.isscalar(degrees):
+            if degrees < 0:
+                raise ValueError("degrees must be non-negative")
+            self.degrees = (-float(degrees), float(degrees))
+        else:
+            self.degrees = tuple(degrees)
+        self.interpolation, self.expand = interpolation, expand
+        self.center, self.fill = center, fill
+
+    def _apply_image(self, img):
+        angle = np.random.uniform(*self.degrees)
+        return rotate(img, angle, self.interpolation, self.expand,
+                      self.center, self.fill)
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="bilinear", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        self.degrees = (-float(degrees), float(degrees)) \
+            if np.isscalar(degrees) else tuple(degrees)
+        self.translate, self.scale_rng = translate, scale
+        self.shear = shear
+        self.interpolation, self.fill, self.center = \
+            interpolation, fill, center
+
+    def _apply_image(self, img):
+        img = np.asarray(img)
+        h, w = img.shape[:2]
+        angle = np.random.uniform(*self.degrees)
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = np.random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = np.random.uniform(-self.translate[1], self.translate[1]) * h
+        scale = np.random.uniform(*self.scale_rng) if self.scale_rng \
+            else 1.0
+        shear = (0.0, 0.0)
+        if self.shear is not None:
+            s = self.shear
+            if np.isscalar(s):
+                shear = (np.random.uniform(-s, s), 0.0)
+            elif len(s) == 2:
+                shear = (np.random.uniform(s[0], s[1]), 0.0)
+            else:
+                shear = (np.random.uniform(s[0], s[1]),
+                         np.random.uniform(s[2], s[3]))
+        return affine(img, angle, (tx, ty), scale, shear,
+                      self.interpolation, self.fill, self.center)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="bilinear", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob, self.distortion_scale = prob, distortion_scale
+        self.interpolation, self.fill = interpolation, fill
+
+    def _apply_image(self, img):
+        img = np.asarray(img)
+        if np.random.rand() >= self.prob:
+            return img
+        h, w = img.shape[:2]
+        d = self.distortion_scale
+        dx, dy = int(d * w / 2), int(d * h / 2)
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        jitter = lambda lo, hi: int(np.random.randint(lo, hi + 1))
+        end = [(jitter(0, dx), jitter(0, dy)),
+               (w - 1 - jitter(0, dx), jitter(0, dy)),
+               (w - 1 - jitter(0, dx), h - 1 - jitter(0, dy)),
+               (jitter(0, dx), h - 1 - jitter(0, dy))]
+        return perspective(img, start, end, self.interpolation, self.fill)
+
+
+class RandomResizedCrop(BaseTransform):
+    """Parity: transforms.RandomResizedCrop — random area/ratio crop then
+    resize to `size`."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3. / 4, 4. / 3),
+                 interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale, self.ratio = scale, ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        img = np.asarray(img)
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                i = np.random.randint(0, h - ch + 1)
+                j = np.random.randint(0, w - cw + 1)
+                patch = img[i:i + ch, j:j + cw]
+                return resize(patch, self.size, self.interpolation)
+        return resize(center_crop(img, min(h, w)), self.size,
+                      self.interpolation)
+
+
+class RandomErasing(BaseTransform):
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob, self.scale, self.ratio = prob, scale, ratio
+        self.value, self.inplace = value, inplace
+
+    def _apply_image(self, img):
+        img = np.asarray(img)
+        if np.random.rand() >= self.prob:
+            return img
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.random.uniform(*self.ratio)
+            eh = int(round(np.sqrt(target / ar)))
+            ew = int(round(np.sqrt(target * ar)))
+            if eh < h and ew < w:
+                i = np.random.randint(0, h - eh + 1)
+                j = np.random.randint(0, w - ew + 1)
+                if isinstance(self.value, str):
+                    if self.value != "random":
+                        raise ValueError(
+                            f"unsupported erasing value {self.value!r}")
+                    v = np.random.standard_normal(
+                        (eh, ew) + img.shape[2:]).astype(np.float32)
+                else:
+                    v = self.value
+                return erase(img, i, j, eh, ew, v, self.inplace)
+        return img

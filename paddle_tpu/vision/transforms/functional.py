@@ -1,0 +1,307 @@
+"""paddle.vision.transforms functional API.
+
+Parity: python/paddle/vision/transforms/functional.py (+ functional_cv2 /
+functional_pil / functional_tensor backends). Host-side numpy kernels on
+HWC images (uint8 [0,255] or float [0,1]); geometric warps use
+scipy.ndimage. These run in DataLoader workers — the device only ever
+sees the collated batch (TPU-first split of work).
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["to_tensor", "normalize", "resize", "pad", "crop",
+           "center_crop", "hflip", "vflip", "rotate", "affine",
+           "perspective", "erase", "to_grayscale", "adjust_brightness",
+           "adjust_contrast", "adjust_saturation", "adjust_hue"]
+
+_GRAY = np.array([0.299, 0.587, 0.114], np.float32)
+
+
+def _np(img) -> np.ndarray:
+    from ...core.tensor import Tensor
+    if isinstance(img, Tensor):
+        return img.numpy()
+    return np.asarray(img)
+
+
+def _same_dtype(out: np.ndarray, ref: np.ndarray) -> np.ndarray:
+    if np.issubdtype(ref.dtype, np.integer):
+        return np.clip(np.round(out), 0, 255).astype(ref.dtype)
+    return out.astype(ref.dtype)
+
+
+def to_tensor(pic, data_format: str = "CHW"):
+    """HWC image -> float32 Tensor; uint8 scaled to [0, 1]."""
+    from ...core.tensor import Tensor
+    arr = _np(pic)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    out = arr.astype(np.float32)
+    if np.issubdtype(arr.dtype, np.integer):
+        out = out / 255.0
+    if data_format == "CHW":
+        out = out.transpose(2, 0, 1)
+    return Tensor(np.ascontiguousarray(out))
+
+
+def normalize(img, mean, std, data_format: str = "CHW", to_rgb=False):
+    arr = _np(img).astype(np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    shape = (-1, 1, 1) if data_format == "CHW" else (1, 1, -1)
+    return (arr - mean.reshape(shape)) / std.reshape(shape)
+
+
+def resize(img, size, interpolation: str = "bilinear"):
+    from . import _resize_bilinear, _resize_nearest, _target_hw
+    arr = _np(img)
+    nh, nw = _target_hw(arr, size)
+    if interpolation == "nearest":
+        return _resize_nearest(arr, nh, nw)
+    if interpolation == "bilinear":
+        return _resize_bilinear(arr, nh, nw)
+    raise ValueError(f"unsupported interpolation {interpolation!r}")
+
+
+def pad(img, padding, fill=0, padding_mode: str = "constant"):
+    arr = _np(img)
+    if isinstance(padding, int):
+        l = r = t = b = padding
+    elif len(padding) == 2:
+        l, t = padding
+        r, b = padding
+    else:
+        l, t, r, b = padding
+    width = [(t, b), (l, r)] + [(0, 0)] * (arr.ndim - 2)
+    if padding_mode == "constant":
+        return np.pad(arr, width, mode="constant", constant_values=fill)
+    mode = {"edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}.get(padding_mode)
+    if mode is None:
+        raise ValueError(f"unsupported padding_mode {padding_mode!r}")
+    return np.pad(arr, width, mode=mode)
+
+
+def crop(img, top: int, left: int, height: int, width: int):
+    arr = _np(img)
+    return arr[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    arr = _np(img)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    th, tw = output_size
+    h, w = arr.shape[:2]
+    return crop(arr, max(0, (h - th) // 2), max(0, (w - tw) // 2), th, tw)
+
+
+def hflip(img):
+    return _np(img)[:, ::-1].copy()
+
+
+def vflip(img):
+    return _np(img)[::-1].copy()
+
+
+def _warp(arr: np.ndarray, matrix: np.ndarray, out_hw=None, fill=0,
+          order=1) -> np.ndarray:
+    """Inverse-map warp: out[y, x] = in[M @ (x, y, 1)] via scipy."""
+    from scipy import ndimage
+    h, w = (out_hw or arr.shape[:2])
+    # scipy works in (row, col) = (y, x); build the (y,x) inverse matrix
+    m = np.array([[matrix[1, 1], matrix[1, 0], matrix[1, 2]],
+                  [matrix[0, 1], matrix[0, 0], matrix[0, 2]],
+                  [0, 0, 1]], np.float64)
+    src = arr.astype(np.float32)
+    if src.ndim == 2:
+        out = ndimage.affine_transform(src, m, output_shape=(h, w),
+                                       order=order, cval=fill)
+    else:
+        out = np.stack([ndimage.affine_transform(
+            src[:, :, c], m, output_shape=(h, w), order=order, cval=fill)
+            for c in range(src.shape[2])], axis=2)
+    return _same_dtype(out, arr)
+
+
+def _affine_inverse_matrix(center, angle, translate, scale, shear):
+    """Inverse (output->input) affine matrix in (x, y) coordinates,
+    matching the torchvision/paddle parameterization (positive angle =
+    counter-clockwise; image y points down, hence the sign flip)."""
+    rot = -math.radians(angle)
+    sx, sy = (math.radians(s) for s in shear)
+    cx, cy = center
+    tx, ty = translate
+    # forward: T(center) R S Shear T(-center) + translate; invert directly
+    a = math.cos(rot - sy) / math.cos(sy)
+    b = -math.cos(rot - sy) * math.tan(sx) / math.cos(sy) - math.sin(rot)
+    c = math.sin(rot - sy) / math.cos(sy)
+    d = -math.sin(rot - sy) * math.tan(sx) / math.cos(sy) + math.cos(rot)
+    fwd = np.array([[a * scale, b * scale, 0],
+                    [c * scale, d * scale, 0],
+                    [0, 0, 1]], np.float64)
+    fwd[0, 2] = cx + tx - fwd[0, 0] * cx - fwd[0, 1] * cy
+    fwd[1, 2] = cy + ty - fwd[1, 0] * cx - fwd[1, 1] * cy
+    return np.linalg.inv(fwd)
+
+
+def affine(img, angle, translate=(0, 0), scale=1.0, shear=(0.0, 0.0),
+           interpolation: str = "bilinear", fill=0, center=None):
+    arr = _np(img)
+    h, w = arr.shape[:2]
+    if np.isscalar(shear):
+        shear = (float(shear), 0.0)
+    center = center or ((w - 1) * 0.5, (h - 1) * 0.5)
+    inv = _affine_inverse_matrix(center, angle, translate, scale, shear)
+    order = 0 if interpolation == "nearest" else 1
+    return _warp(arr, inv, fill=fill, order=order)
+
+
+def rotate(img, angle, interpolation: str = "bilinear", expand=False,
+           center=None, fill=0):
+    arr = _np(img)
+    h, w = arr.shape[:2]
+    if expand:
+        rad = math.radians(angle)
+        nw = int(abs(w * math.cos(rad)) + abs(h * math.sin(rad)) + 0.5)
+        nh = int(abs(w * math.sin(rad)) + abs(h * math.cos(rad)) + 0.5)
+        # rotate about the input center, then re-center into the larger
+        # canvas
+        cx, cy = (w - 1) * 0.5, (h - 1) * 0.5
+        inv = _affine_inverse_matrix((cx, cy), angle, (0, 0), 1.0,
+                                     (0.0, 0.0))
+        shift = np.array([[1, 0, cx - (nw - 1) * 0.5],
+                          [0, 1, cy - (nh - 1) * 0.5],
+                          [0, 0, 1]], np.float64)
+        order = 0 if interpolation == "nearest" else 1
+        return _warp(arr, inv @ shift, out_hw=(nh, nw), fill=fill,
+                     order=order)
+    return affine(img, angle, interpolation=interpolation, fill=fill,
+                  center=center)
+
+
+def _homography(src_pts, dst_pts) -> np.ndarray:
+    """dst -> src homography from 4 point pairs (least squares)."""
+    A, b = [], []
+    for (xs, ys), (xd, yd) in zip(src_pts, dst_pts):
+        A.append([xd, yd, 1, 0, 0, 0, -xs * xd, -xs * yd])
+        b.append(xs)
+        A.append([0, 0, 0, xd, yd, 1, -ys * xd, -ys * yd])
+        b.append(ys)
+    coef, *_ = np.linalg.lstsq(np.asarray(A, np.float64),
+                               np.asarray(b, np.float64), rcond=None)
+    return np.append(coef, 1.0).reshape(3, 3)
+
+
+def perspective(img, startpoints, endpoints,
+                interpolation: str = "bilinear", fill=0):
+    """Warp so that startpoints map onto endpoints ((x, y) corners)."""
+    arr = _np(img)
+    H = _homography(startpoints, endpoints)   # output -> input
+    h, w = arr.shape[:2]
+    ys, xs = np.meshgrid(np.arange(h, dtype=np.float64),
+                         np.arange(w, dtype=np.float64), indexing="ij")
+    denom = H[2, 0] * xs + H[2, 1] * ys + H[2, 2]
+    sx = (H[0, 0] * xs + H[0, 1] * ys + H[0, 2]) / denom
+    sy = (H[1, 0] * xs + H[1, 1] * ys + H[1, 2]) / denom
+    from scipy import ndimage
+    order = 0 if interpolation == "nearest" else 1
+    src = arr.astype(np.float32)
+    # fp epsilon past the border must not fall to fill: sample with
+    # clipped coords, fill only genuinely-outside points
+    tol = 1e-6
+    inside = ((sx >= -tol) & (sx <= w - 1 + tol)
+              & (sy >= -tol) & (sy <= h - 1 + tol))
+    coords = np.stack([np.clip(sy, 0, h - 1), np.clip(sx, 0, w - 1)])
+    if src.ndim == 2:
+        out = ndimage.map_coordinates(src, coords, order=order, cval=fill)
+        out = np.where(inside, out, fill)
+    else:
+        out = np.stack([ndimage.map_coordinates(
+            src[:, :, c], coords, order=order, cval=fill)
+            for c in range(src.shape[2])], axis=2)
+        out = np.where(inside[..., None], out, fill)
+    return _same_dtype(out, arr)
+
+
+def erase(img, i: int, j: int, h: int, w: int, v, inplace: bool = False):
+    arr = _np(img)
+    out = arr if inplace else arr.copy()
+    out[i:i + h, j:j + w] = v
+    return out
+
+
+def to_grayscale(img, num_output_channels: int = 1):
+    arr = _np(img)
+    if arr.ndim == 2 or arr.shape[-1] == 1:
+        g = arr.reshape(arr.shape[:2] + (1,)).astype(np.float32)
+    else:
+        g = (arr[..., :3].astype(np.float32) @ _GRAY)[..., None]
+    g = np.repeat(g, num_output_channels, axis=-1)
+    return _same_dtype(g, arr)
+
+
+def adjust_brightness(img, brightness_factor: float):
+    arr = _np(img)
+    return _same_dtype(arr.astype(np.float32) * brightness_factor, arr)
+
+
+def adjust_contrast(img, contrast_factor: float):
+    arr = _np(img)
+    f = arr.astype(np.float32)
+    gray_mean = float(to_grayscale(f).mean())
+    return _same_dtype(gray_mean + contrast_factor * (f - gray_mean), arr)
+
+
+def adjust_saturation(img, saturation_factor: float):
+    arr = _np(img)
+    f = arr.astype(np.float32)
+    g = to_grayscale(f).astype(np.float32)
+    if g.shape[-1] != f.shape[-1]:
+        g = np.repeat(g, f.shape[-1], axis=-1)
+    return _same_dtype(g + saturation_factor * (f - g), arr)
+
+
+def adjust_hue(img, hue_factor: float):
+    """Shift hue by hue_factor in [-0.5, 0.5] turns (HSV round-trip)."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    arr = _np(img)
+    f = arr.astype(np.float32)
+    scale = 255.0 if np.issubdtype(arr.dtype, np.integer) else 1.0
+    rgb = f[..., :3] / scale
+    mx = rgb.max(-1)
+    mn = rgb.min(-1)
+    diff = mx - mn
+    r, g, b = rgb[..., 0], rgb[..., 1], rgb[..., 2]
+    hue = np.zeros_like(mx)
+    nz = diff > 0
+    rm = nz & (mx == r)
+    gm = nz & (mx == g) & ~rm
+    bm = nz & ~rm & ~gm
+    hue[rm] = ((g - b)[rm] / diff[rm]) % 6
+    hue[gm] = (b - r)[gm] / diff[gm] + 2
+    hue[bm] = (r - g)[bm] / diff[bm] + 4
+    hue = (hue / 6.0 + hue_factor) % 1.0
+    sat = np.where(mx > 0, diff / np.maximum(mx, 1e-12), 0.0)
+    # HSV -> RGB
+    hp = hue * 6.0
+    c = mx * sat
+    x = c * (1 - np.abs(hp % 2 - 1))
+    m = mx - c
+    zeros = np.zeros_like(c)
+    idx = np.floor(hp).astype(int) % 6
+    r2 = np.select([idx == 0, idx == 1, idx == 2, idx == 3, idx == 4],
+                   [c, x, zeros, zeros, x], c)
+    g2 = np.select([idx == 0, idx == 1, idx == 2, idx == 3, idx == 4],
+                   [x, c, c, x, zeros], zeros)
+    b2 = np.select([idx == 0, idx == 1, idx == 2, idx == 3, idx == 4],
+                   [zeros, zeros, x, c, c], x)
+    out = np.stack([r2 + m, g2 + m, b2 + m], axis=-1) * scale
+    if f.shape[-1] > 3:
+        out = np.concatenate([out, f[..., 3:]], axis=-1)
+    return _same_dtype(out, arr)

@@ -1,0 +1,130 @@
+"""vision.transforms tests (reference: test_transforms.py patterns —
+identity checks, involutions, numeric formulas, surface parity)."""
+import re
+
+import numpy as np
+import pytest
+
+import paddle_tpu.vision.transforms as T
+
+
+@pytest.fixture
+def img():
+    return np.random.RandomState(0).randint(
+        0, 255, (24, 32, 3)).astype(np.uint8)
+
+
+def test_surface_matches_reference():
+    ref = open("/root/reference/python/paddle/vision/transforms/"
+               "__init__.py").read()
+    names = {a or b for a, b in re.findall(
+        r"'(\w+)'|\"(\w+)\"",
+        re.search(r"__all__ = \[(.*?)\]", ref, re.S).group(1))}
+    missing = sorted(n for n in names if not hasattr(T, n))
+    assert not missing, missing
+
+
+def test_identity_geometry(img):
+    np.testing.assert_array_equal(T.rotate(img, 0.0), img)
+    np.testing.assert_array_equal(T.affine(img, 0.0), img)
+    corners = [(0, 0), (31, 0), (31, 23), (0, 23)]
+    np.testing.assert_array_equal(
+        T.perspective(img, corners, corners), img)
+
+
+def test_flips_are_involutions(img):
+    np.testing.assert_array_equal(T.hflip(T.hflip(img)), img)
+    np.testing.assert_array_equal(T.vflip(T.vflip(img)), img)
+    np.testing.assert_array_equal(T.hflip(img), img[:, ::-1])
+
+
+def test_rotate_90_exact(img):
+    sq = img[:24, :24]
+    got = T.rotate(sq, 90.0)
+    want = np.rot90(sq, 1)   # CCW, matching positive-angle convention
+    # interior agrees exactly (boundary interpolation may differ by 1px)
+    np.testing.assert_allclose(got[2:-2, 2:-2].astype(int),
+                               want[2:-2, 2:-2].astype(int), atol=1)
+
+
+def test_crop_pad_roundtrip(img):
+    padded = T.pad(img, 4, fill=7)
+    assert padded.shape == (32, 40, 3)
+    assert (padded[:4] == 7).all()
+    np.testing.assert_array_equal(T.crop(padded, 4, 4, 24, 32), img)
+    cc = T.center_crop(img, (10, 12))
+    assert cc.shape == (10, 12, 3)
+    np.testing.assert_array_equal(cc, img[7:17, 10:22])
+
+
+def test_adjustments(img):
+    np.testing.assert_array_equal(T.adjust_brightness(img, 1.0), img)
+    np.testing.assert_array_equal(T.adjust_hue(img, 0.0), img)
+    doubled = T.adjust_brightness(img, 2.0)
+    assert doubled.max() == 255 and doubled.dtype == np.uint8
+    gray = T.to_grayscale(img)
+    want = (img.astype(np.float32) @
+            np.array([0.299, 0.587, 0.114], np.float32))
+    np.testing.assert_allclose(gray[..., 0].astype(float), np.round(want),
+                               atol=1)
+    # saturation 0 == grayscale in all channels
+    desat = T.adjust_saturation(img, 0.0)
+    assert np.abs(desat[..., 0].astype(int)
+                  - desat[..., 1].astype(int)).max() <= 1
+
+
+def test_erase_and_random_erasing(img):
+    out = T.erase(img, 2, 3, 5, 6, 0)
+    assert (out[2:7, 3:9] == 0).all()
+    assert (out[:2] == img[:2]).all()
+    out2 = T.RandomErasing(prob=1.0, value=9)(img)
+    assert (out2 == 9).any()
+
+
+def test_to_tensor_and_normalize(img):
+    t = T.to_tensor(img)
+    assert t.shape == [3, 24, 32]
+    assert float(t.numpy().max()) <= 1.0
+    n = T.normalize(np.ones((3, 4, 4), np.float32), mean=[0.5] * 3,
+                    std=[0.5] * 3)
+    np.testing.assert_allclose(n, np.ones((3, 4, 4)) * 1.0)
+
+
+def test_random_transforms_shapes(img):
+    assert T.RandomRotation(30)(img).shape == img.shape
+    assert T.RandomAffine(10, translate=(0.1, 0.1), scale=(0.9, 1.1),
+                          shear=5)(img).shape == img.shape
+    assert T.RandomPerspective(prob=1.0)(img).shape == img.shape
+    assert T.RandomResizedCrop(16)(img).shape == (16, 16, 3)
+    assert T.RandomVerticalFlip(prob=1.0)(img).shape == img.shape
+    assert T.Grayscale(3)(img).shape == img.shape
+    assert T.ColorJitter(0.4, 0.4, 0.4, 0.2)(img).shape == img.shape
+    assert T.Pad(2)(img).shape == (28, 36, 3)
+
+
+def test_jitter_ranges_and_validation(img):
+    # (min, max) range form accepted, like the reference _check_input
+    assert T.BrightnessTransform((0.8, 1.2))(img).shape == img.shape
+    assert T.ColorJitter(brightness=(0.9, 1.1), hue=(-0.1, 0.1))(
+        img).shape == img.shape
+    with pytest.raises(ValueError):
+        T.BrightnessTransform(-0.5)
+    with pytest.raises(ValueError):
+        T.HueTransform(0.7)
+    with pytest.raises(ValueError):
+        T.SaturationTransform((1.2, 0.8))   # min > max
+    # value=0 == identity
+    np.testing.assert_array_equal(T.ContrastTransform(0)(img), img)
+
+
+def test_random_erasing_array_value(img):
+    out = T.RandomErasing(prob=1.0,
+                          value=np.array([1, 2, 3], np.uint8))(img)
+    assert out.shape == img.shape
+
+
+def test_paired_keys():
+    tr = T.Grayscale(keys=["image", "label"])
+    img = np.zeros((4, 4, 3), np.uint8)
+    out_img, out_label = tr((img, 7))
+    assert out_img.shape == (4, 4, 1) and out_label == 7
