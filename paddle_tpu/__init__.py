@@ -14,6 +14,13 @@ import os as _os
 # we pay XLA compiles — amortize them across runs; SURVEY.md §7 hard parts).
 import jax as _jax
 
+# Multi-host formation must precede ANY backend touch (jax.devices etc.),
+# so when the launcher declared a multi-process world via the JAX_* env
+# contract, form it now — before the imports below initialize XLA.
+from ._bootstrap import maybe_init_jax_distributed as _mijd
+
+_mijd()
+
 from .framework import flags as _flags
 
 if _flags.flag_value("use_persistent_compilation_cache"):

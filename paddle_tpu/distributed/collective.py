@@ -133,6 +133,10 @@ def _raw(t):
     return t.value if isinstance(t, Tensor) else jnp.asarray(t)
 
 
+def _multiproc() -> bool:
+    return jax.process_count() > 1
+
+
 def _stacked_specs(group: Group, x):
     """Input [N, *S] sharded over the group axis on dim 0."""
     mesh = group.mesh
@@ -142,6 +146,73 @@ def _stacked_specs(group: Group, x):
             f"stacked distributed tensor must have leading dim == group "
             f"size {n}, got shape {tuple(x.shape)} (see module docstring)")
     return mesh, P(group.axis), n
+
+
+def _local_rows(group: Group) -> int:
+    """Rows of the stacked [N, *S] array this process owns under
+    P(group.axis) — one per addressable device along the axis (a process
+    driving 4 chips of an 8-chip dp axis owns 4 rows)."""
+    sh = NamedSharding(group.mesh, P(group.axis))
+    n = group.nranks
+    spans = {s[0].indices(n)[:2]
+             for s in sh.addressable_devices_indices_map((n,)).values()}
+    return sum(stop - start for start, stop in spans)
+
+
+def _to_stacked(group: Group, x):
+    """Build the sharded stacked array [N, *S] for one collective.
+
+    Single controller: x IS the stacked array (module docstring
+    convention). Multi-process (jax.distributed world, reference
+    semantics): x is this PROCESS's contribution — [*S] when it drives
+    one device on the axis, [L, *S] when it drives L."""
+    mesh = group.mesh
+    sh = NamedSharding(mesh, P(group.axis))
+    if not _multiproc():
+        _stacked_specs(group, x)      # shape validation
+        return jax.device_put(x, sh)
+    import numpy as _np
+    local = _np.asarray(x)
+    L = _local_rows(group)
+    if L == 1:
+        data = local[None]
+    else:
+        if local.ndim == 0 or local.shape[0] != L:
+            raise ValueError(
+                f"this process drives {L} devices on axis "
+                f"{group.axis!r}: pass one row per local device, shape "
+                f"[{L}, ...]; got {tuple(local.shape)}")
+        data = local
+    return jax.make_array_from_process_local_data(
+        sh, data, (group.nranks,) + data.shape[1:])
+
+
+def _to_local(out, group: Group):
+    """Multi-process: this process's rows of a per-rank-result stacked
+    output (leading dim squeezed when it owns a single row). Single
+    controller: identity."""
+    if not _multiproc():
+        return out
+    import numpy as _np
+    rows, seen = [], set()
+    for s in sorted(out.addressable_shards,
+                    key=lambda s: s.index[0].start or 0):
+        key = (s.index[0].start, s.index[0].stop)
+        if key in seen:
+            continue   # replicas across other mesh axes
+        seen.add(key)
+        rows.append(_np.asarray(s.data))
+    arr = _np.concatenate(rows, axis=0)
+    return jnp.asarray(arr[0] if _local_rows(group) == 1 else arr)
+
+
+def _require_single_controller(opname: str):
+    if _multiproc():
+        raise NotImplementedError(
+            f"{opname} is not yet wired for the multi-process world; "
+            "multi-host currently covers all_reduce/all_gather/broadcast/"
+            "barrier — in-program collectives (ParallelTrainStep) cover "
+            "the rest")
 
 
 @functools.lru_cache(maxsize=256)
@@ -182,9 +253,8 @@ def all_reduce(tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
     Parity: paddle.distributed.all_reduce."""
     group = group or _default_group()
     x = _raw(tensor)
-    mesh, spec, n = _stacked_specs(group, x)
-    prog = _collective_program("all_reduce", group.axis, mesh, op)
-    out = prog(jax.device_put(x, NamedSharding(mesh, P(group.axis))))
+    prog = _collective_program("all_reduce", group.axis, group.mesh, op)
+    out = _to_local(prog(_to_stacked(group, x)), group)
     if isinstance(tensor, Tensor):
         tensor.value = out
         return tensor
@@ -196,10 +266,14 @@ def all_gather(tensor_list: Optional[List], tensor, group=None, sync_op=True):
     Parity: paddle.distributed.all_gather."""
     group = group or _default_group()
     x = _raw(tensor)
-    mesh, _, n = _stacked_specs(group, x)
+    mesh, n = group.mesh, group.nranks
+    stacked = _to_stacked(group, x)
     # replicate the stack: XLA emits one all-gather over the axis
     out = jax.jit(lambda a: a,
-                  out_shardings=NamedSharding(mesh, P()))(x)
+                  out_shardings=NamedSharding(mesh, P()))(stacked)
+    if _multiproc():
+        import numpy as _np
+        out = jnp.asarray(_np.asarray(out.addressable_shards[0].data))
     slices = [Tensor(out[i]) for i in range(n)]
     if tensor_list is not None:
         tensor_list.extend(slices)
@@ -208,6 +282,7 @@ def all_gather(tensor_list: Optional[List], tensor, group=None, sync_op=True):
 
 def all_gather_object(object_list: List, obj, group=None):
     """Single-controller: every rank's python object is already here."""
+    _require_single_controller("all_gather_object")
     group = group or _default_group()
     object_list.extend([obj] * group.nranks)
     return object_list
@@ -217,10 +292,12 @@ def broadcast(tensor, src: int = 0, group=None, sync_op=True):
     """Every slice becomes slice `src`. Parity: paddle.distributed.broadcast."""
     group = group or _default_group()
     x = _raw(tensor)
-    mesh, _, n = _stacked_specs(group, x)
-    out = jax.jit(
+    mesh = group.mesh
+    stacked = _to_stacked(group, x)
+    out = _to_local(jax.jit(
         lambda a: jnp.broadcast_to(a[src], a.shape),
-        out_shardings=NamedSharding(mesh, P(group.axis)))(x)
+        out_shardings=NamedSharding(mesh, P(group.axis)))(stacked),
+        group)
     if isinstance(tensor, Tensor):
         tensor.value = out
         return tensor
@@ -230,6 +307,7 @@ def broadcast(tensor, src: int = 0, group=None, sync_op=True):
 def reduce(tensor, dst: int = 0, op=ReduceOp.SUM, group=None, sync_op=True):
     """Slice `dst` gets the reduction; other slices keep their values.
     Parity: paddle.distributed.reduce."""
+    _require_single_controller("reduce")
     group = group or _default_group()
     x = _raw(tensor)
     mesh, _, n = _stacked_specs(group, x)
@@ -252,6 +330,7 @@ def scatter(tensor, tensor_list=None, src: int = 0, group=None, sync_op=True):
     tensor_list (there is one process), so whose list is scattered is
     determined by the caller — `src` is accepted for API parity and does
     not change the result."""
+    _require_single_controller("scatter")
     group = group or _default_group()
     n = group.nranks
     if tensor_list is None:
@@ -270,6 +349,7 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
     """Input [N, N*K, ...] stacked: rank i gets sum over ranks of block i.
     Parity: paddle.distributed.reduce_scatter; HLO reduce-scatter via
     lax.psum_scatter."""
+    _require_single_controller("reduce_scatter")
     group = group or _default_group()
     x = _raw(tensor_or_tensor_list) if not isinstance(
         tensor_or_tensor_list, (list, tuple)) else jnp.stack(
@@ -287,6 +367,7 @@ def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     """Rank i sends in_list[j] to rank j. Stacked: global [N(src), N(dst),
     *S] transposes its first two dims via HLO all-to-all.
     Parity: paddle.distributed.alltoall."""
+    _require_single_controller("alltoall")
     group = group or _default_group()
     n = group.nranks
     if isinstance(in_tensor_list, (list, tuple)):
@@ -315,6 +396,7 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
             raise NotImplementedError(
                 "alltoall_single with uneven in/out_split_sizes is not "
                 "supported yet; only equal splits are")
+    _require_single_controller("alltoall_single")
     group = group or _default_group()
     x = _raw(in_tensor)
     mesh, _, n = _stacked_specs(group, x)
@@ -328,7 +410,14 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
 
 def barrier(group=None):
     """Single-controller: device work is ordered by data dependencies; a
-    barrier is a host sync. Parity: paddle.distributed.barrier."""
+    barrier is a host sync. Multi-process: a real cross-process psum.
+    Parity: paddle.distributed.barrier."""
+    if _multiproc():
+        group = group or _default_group()
+        L = _local_rows(group)
+        z = jnp.zeros((L,) if L > 1 else (), jnp.float32)
+        all_reduce(Tensor(z), group=group)
+        return
     (jax.device_put(jnp.zeros(()))).block_until_ready()
 
 

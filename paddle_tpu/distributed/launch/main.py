@@ -58,8 +58,10 @@ def launch(script: str, script_args: List[str], *, nnodes: int = 1,
             "PADDLE_LOCAL_RANK": str(local_rank),
             "PADDLE_NNODES": str(nnodes),
             "PADDLE_NODE_RANK": str(node_rank),
-            # JAX multi-host formation consumes the same master
-            "JAX_COORDINATOR_ADDRESS": master,
+            # JAX multi-host formation: master's host, port offset by 1 —
+            # the TCPStore master owns the PADDLE_MASTER port itself
+            "JAX_COORDINATOR_ADDRESS":
+                f"{host}:{int(port) + 1}",
             "JAX_NUM_PROCESSES": str(world_size),
             "JAX_PROCESS_ID": str(rank),
         })

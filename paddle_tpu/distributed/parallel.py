@@ -30,9 +30,13 @@ _initialized = False
 
 def init_parallel_env(degrees=None):
     """Initialize the global mesh (parity: init_parallel_env,
-    parallel.py:1092 — there it boots TCPStore + NCCL comms; here the JAX
-    runtime already formed the pod, so this just installs the mesh)."""
+    parallel.py:1092 — there it boots TCPStore + NCCL comms; here we form
+    the JAX multi-controller world if the launcher declared one (strict:
+    a declared-but-unformable world is an error), then install the mesh
+    over the global device set)."""
     global _initialized
+    from .._bootstrap import maybe_init_jax_distributed
+    maybe_init_jax_distributed(strict=True)
     mesh_mod.init_mesh(degrees)
     _initialized = True
     return ParallelEnv()
