@@ -72,6 +72,25 @@ def test_sampling_reproducible_and_diverse():
     assert not np.array_equal(a, c)          # different seed -> differs
 
 
+def test_generate_under_mesh_bf16():
+    """Serving under an active tp x dp mesh with bf16 params."""
+    import paddle_tpu.distributed as dist
+    dist.set_mesh(None)
+    try:
+        dist.init_mesh({"mp": 4, "dp": 2})
+        paddle.seed(3)
+        model = LlamaForCausalLM(llama_tiny())
+        model.bfloat16()
+        model.eval()
+        ids = np.random.RandomState(0).randint(
+            0, 250, (2, 8)).astype("int64")
+        out = model.generate(ids, max_new_tokens=6)
+        assert out.shape == (2, 14)
+        assert (out[:, :8] == ids).all()
+    finally:
+        dist.set_mesh(None)
+
+
 def test_gqa_cache_shape():
     cfg = llama_tiny()
     model = LlamaForCausalLM(cfg)
