@@ -33,6 +33,7 @@ from .topology import (CommunicateTopology, HybridCommunicateGroup,
 from . import auto_parallel  # noqa: E402
 from . import rpc  # noqa: E402
 from .localsgd import LocalSGDStep  # noqa: E402
+from .quantized import quantized_all_reduce  # noqa: E402
 from .spawn import spawn  # noqa: E402
 from .metric import DistributedAuc, global_auc  # noqa: E402
 from .auto_parallel import (ProcessMesh, shard_tensor,  # noqa: E402
@@ -41,6 +42,7 @@ from .auto_parallel import (ProcessMesh, shard_tensor,  # noqa: E402
 __all__ = [
     "auto_parallel", "ProcessMesh", "shard_tensor", "shard_op", "Engine",
     "rpc", "spawn", "DistributedAuc", "global_auc", "LocalSGDStep",
+    "quantized_all_reduce",
     "init_parallel_env", "is_initialized", "get_rank", "get_world_size",
     "ParallelEnv", "DataParallel", "shard_batch",
     "Mesh", "PartitionSpec", "init_mesh", "get_mesh", "set_mesh",

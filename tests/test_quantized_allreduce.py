@@ -1,0 +1,71 @@
+"""EQuARX-style quantized all-reduce tests (PAPERS.md arXiv 2506.17615;
+SURVEY.md §5.8 quantized-allreduce option)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.quantized import quantized_all_reduce
+
+
+@pytest.fixture(autouse=True)
+def fresh_mesh():
+    dist.set_mesh(None)
+    yield
+    dist.set_mesh(None)
+
+
+def test_two_hop_error_bound_integers():
+    """Int payload: hop 1 (scale 1) is exact; hop 2 re-quantizes the sums
+    (scale = sum_max/127), so the total error is bounded by sum_max/254
+    per element — verify both facts."""
+    dist.init_mesh({"dp": 8})
+    rng = np.random.RandomState(0)
+    x = rng.randint(-100, 101, (8, 64)).astype(np.float32)
+    x[:, 0] = 127.0   # pin block max so hop-1 scale is exactly 1
+    got = quantized_all_reduce(paddle.to_tensor(x.copy()),
+                               block=64).numpy()
+    want = x.sum(0)
+    hop2_bound = np.abs(want).max() / 254 + 1e-5
+    assert np.abs(got[0] - want).max() <= hop2_bound
+    # every replica row identical (all-reduce semantics)
+    assert (got == got[0]).all()
+
+
+def test_error_bounded_vs_exact():
+    dist.init_mesh({"dp": 4})
+    rng = np.random.RandomState(1)
+    x = rng.randn(4, 1000).astype(np.float32)
+    exact = dist.all_reduce(paddle.to_tensor(x.copy())).numpy()
+    approx = quantized_all_reduce(paddle.to_tensor(x.copy()),
+                                  block=250).numpy()
+    # hop 1: N contributions each bounded by input block_max/254;
+    # hop 2: bounded by the REDUCED sum's block max / 254
+    n = 4
+    bound = (n * np.abs(x).max() / 254
+             + np.abs(exact).max() / 254 + 1e-5)
+    assert np.abs(approx - exact).max() <= bound, (
+        np.abs(approx - exact).max(), bound)
+    # and it is genuinely close in relative terms
+    rel = np.abs(approx - exact).max() / (np.abs(exact).max() + 1e-9)
+    assert rel < 0.05, rel
+
+
+def test_shapes_and_padding():
+    dist.init_mesh({"dp": 4})
+    rng = np.random.RandomState(2)
+    # size 77 not divisible by ranks or block: exercises padding
+    x = rng.randn(4, 7, 11).astype(np.float32)
+    got = quantized_all_reduce(paddle.to_tensor(x.copy()),
+                               block=32).numpy()
+    assert got.shape == (4, 7, 11)
+    exact = x.sum(0)
+    rel = np.abs(got[0] - exact).max() / np.abs(exact).max()
+    assert rel < 0.05
+
+
+def test_zero_blocks_stay_zero():
+    dist.init_mesh({"dp": 4})
+    x = np.zeros((4, 128), np.float32)
+    got = quantized_all_reduce(paddle.to_tensor(x)).numpy()
+    assert (got == 0).all()
