@@ -1,0 +1,10 @@
+"""fleet.utils — filesystem abstraction (+ future helpers).
+
+Parity: python/paddle/distributed/fleet/utils/ — primarily fs.py
+(FS/LocalFS/HDFSClient), the storage layer auto-checkpoint and dist-save
+write through (SURVEY.md §5.4: "epoch-boundary snapshots to HDFS keyed by
+job env").
+"""
+from .fs import FS, LocalFS, HDFSClient
+
+__all__ = ["FS", "LocalFS", "HDFSClient"]

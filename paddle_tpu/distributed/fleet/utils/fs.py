@@ -1,0 +1,207 @@
+"""Filesystem clients: LocalFS (full) + HDFSClient (hadoop-CLI backed).
+
+Parity: python/paddle/distributed/fleet/utils/fs.py (FS abstract:53,
+LocalFS:113, HDFSClient:424). The reference shells out to the `hadoop`
+CLI for HDFS; same here, gated on the binary existing — TPU pods read
+checkpoints from NFS/GCS-fuse style mounts, so LocalFS is the primary
+implementation and HDFSClient raises a clear error when no hadoop CLI is
+installed.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import List, Optional, Tuple
+
+__all__ = ["FS", "LocalFS", "HDFSClient"]
+
+
+class FS:
+    """Abstract filesystem surface (fs.py:53)."""
+
+    def ls_dir(self, fs_path) -> Tuple[List[str], List[str]]:
+        raise NotImplementedError
+
+    def is_file(self, fs_path) -> bool:
+        raise NotImplementedError
+
+    def is_dir(self, fs_path) -> bool:
+        raise NotImplementedError
+
+    def is_exist(self, fs_path) -> bool:
+        raise NotImplementedError
+
+    def upload(self, local_path, fs_path):
+        raise NotImplementedError
+
+    def download(self, fs_path, local_path):
+        raise NotImplementedError
+
+    def mkdirs(self, fs_path):
+        raise NotImplementedError
+
+    def delete(self, fs_path):
+        raise NotImplementedError
+
+    def need_upload_download(self) -> bool:
+        raise NotImplementedError
+
+    def rename(self, fs_src_path, fs_dst_path):
+        raise NotImplementedError
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False,
+           test_exists=False):
+        raise NotImplementedError
+
+    def list_dirs(self, fs_path) -> List[str]:
+        raise NotImplementedError
+
+    def touch(self, fs_path, exist_ok=True):
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    """Local filesystem client (fs.py:113)."""
+
+    def ls_dir(self, fs_path):
+        """Returns (dirs, files) directly under fs_path."""
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for name in sorted(os.listdir(fs_path)):
+            (dirs if os.path.isdir(os.path.join(fs_path, name))
+             else files).append(name)
+        return dirs, files
+
+    def is_file(self, fs_path):
+        return os.path.isfile(fs_path)
+
+    def is_dir(self, fs_path):
+        return os.path.isdir(fs_path)
+
+    def is_exist(self, fs_path):
+        return os.path.exists(fs_path)
+
+    def mkdirs(self, fs_path):
+        os.makedirs(fs_path, exist_ok=True)
+
+    def delete(self, fs_path):
+        if os.path.isdir(fs_path):
+            shutil.rmtree(fs_path)
+        elif os.path.exists(fs_path):
+            os.remove(fs_path)
+
+    def need_upload_download(self):
+        return False
+
+    def rename(self, fs_src_path, fs_dst_path):
+        os.rename(fs_src_path, fs_dst_path)
+
+    def mv(self, src_path, dst_path, overwrite=False, test_exists=False):
+        if test_exists:
+            if not self.is_exist(src_path):
+                raise FileNotFoundError(f"{src_path} is not exists")
+            if not overwrite and self.is_exist(dst_path):
+                raise FileExistsError(f"{dst_path} exists already")
+        if overwrite and self.is_exist(dst_path):
+            self.delete(dst_path)
+        shutil.move(src_path, dst_path)
+
+    def list_dirs(self, fs_path):
+        if not self.is_exist(fs_path):
+            return []
+        return sorted(n for n in os.listdir(fs_path)
+                      if os.path.isdir(os.path.join(fs_path, n)))
+
+    def touch(self, fs_path, exist_ok=True):
+        if os.path.exists(fs_path):
+            if not exist_ok:
+                raise FileExistsError(fs_path)
+            return
+        with open(fs_path, "a"):
+            pass
+
+    # the reference keeps upload/download on LocalFS as plain copies
+    def upload(self, local_path, fs_path):
+        shutil.copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        shutil.copy(fs_path, local_path)
+
+
+class HDFSClient(FS):
+    """HDFS via the hadoop CLI (fs.py:424). Requires `hadoop` on PATH —
+    raised lazily so constructing a configured client stays cheap."""
+
+    def __init__(self, hadoop_home: Optional[str] = None, configs=None,
+                 time_out=5 * 60 * 1000, sleep_inter=1000):
+        self._hadoop = (os.path.join(hadoop_home, "bin", "hadoop")
+                        if hadoop_home else shutil.which("hadoop"))
+        self._configs = configs or {}
+        self._timeout_s = time_out / 1000.0
+
+    def _run(self, *args) -> Tuple[int, str]:
+        if not self._hadoop or not os.path.exists(self._hadoop):
+            raise RuntimeError(
+                "HDFSClient needs the hadoop CLI; none found on PATH "
+                "(pass hadoop_home=...). On TPU pods prefer shared-mount "
+                "storage with LocalFS.")
+        cfg = []
+        for k, v in self._configs.items():
+            cfg += ["-D", f"{k}={v}"]
+        ret = subprocess.run([self._hadoop, "fs"] + cfg + list(args),
+                             capture_output=True, text=True,
+                             timeout=self._timeout_s)
+        return ret.returncode, ret.stdout
+
+    def is_exist(self, fs_path):
+        rc, _ = self._run("-test", "-e", fs_path)
+        return rc == 0
+
+    def is_file(self, fs_path):
+        rc, _ = self._run("-test", "-f", fs_path)
+        return rc == 0
+
+    def is_dir(self, fs_path):
+        rc, _ = self._run("-test", "-d", fs_path)
+        return rc == 0
+
+    def ls_dir(self, fs_path):
+        rc, out = self._run("-ls", fs_path)
+        dirs, files = [], []
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) < 8:
+                continue
+            name = parts[-1].rsplit("/", 1)[-1]
+            (dirs if parts[0].startswith("d") else files).append(name)
+        return dirs, files
+
+    def list_dirs(self, fs_path):
+        return self.ls_dir(fs_path)[0]
+
+    def mkdirs(self, fs_path):
+        self._run("-mkdir", "-p", fs_path)
+
+    def delete(self, fs_path):
+        self._run("-rm", "-r", "-f", fs_path)
+
+    def upload(self, local_path, fs_path):
+        self._run("-put", "-f", local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self._run("-get", fs_path, local_path)
+
+    def rename(self, fs_src_path, fs_dst_path):
+        self._run("-mv", fs_src_path, fs_dst_path)
+
+    mv = rename
+
+    def touch(self, fs_path, exist_ok=True):
+        if not exist_ok and self.is_exist(fs_path):
+            raise FileExistsError(fs_path)
+        self._run("-touchz", fs_path)
+
+    def need_upload_download(self):
+        return True

@@ -105,3 +105,28 @@ def test_base_generator_requires_overrides():
         g.generate_sample("x")
     with pytest.raises(NotImplementedError):
         g._gen_str([("a", [1])])
+
+
+def test_local_fs(tmp_path):
+    from paddle_tpu.distributed.fleet.utils import LocalFS, HDFSClient
+    fs = LocalFS()
+    root = str(tmp_path)
+    fs.mkdirs(root + "/a/b")
+    fs.touch(root + "/a/x.txt")
+    with pytest.raises(FileExistsError):
+        fs.touch(root + "/a/x.txt", exist_ok=False)
+    assert fs.is_dir(root + "/a/b") and fs.is_file(root + "/a/x.txt")
+    dirs, files = fs.ls_dir(root + "/a")
+    assert dirs == ["b"] and files == ["x.txt"]
+    assert fs.list_dirs(root + "/a") == ["b"]
+    fs.mv(root + "/a/x.txt", root + "/a/y.txt")
+    assert fs.is_exist(root + "/a/y.txt")
+    with pytest.raises(FileNotFoundError):
+        fs.mv(root + "/nope", root + "/z", test_exists=True)
+    fs.delete(root + "/a")
+    assert not fs.is_exist(root + "/a")
+    assert not fs.need_upload_download()
+    # hadoop-less HDFSClient raises an actionable error lazily
+    h = HDFSClient(hadoop_home="/nonexistent")
+    with pytest.raises(RuntimeError, match="hadoop"):
+        h.is_exist("/x")
