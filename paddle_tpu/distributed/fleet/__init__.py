@@ -146,6 +146,7 @@ def is_first_worker() -> bool:
     return worker_index() == 0
 
 
+from . import utils  # noqa: E402
 from .base import (PaddleCloudRoleMaker, Role,  # noqa: E402
                    UserDefinedRoleMaker, UtilBase)
 from .data_generator import (DataGenerator,  # noqa: E402
@@ -220,7 +221,7 @@ class Fleet:
 
 fleet = Fleet()
 
-__all__ += ["Fleet", "fleet", "Role", "PaddleCloudRoleMaker",
+__all__ += ["Fleet", "fleet", "utils", "Role", "PaddleCloudRoleMaker",
             "UserDefinedRoleMaker", "UtilBase", "CommunicateTopology",
             "DataGenerator", "MultiSlotDataGenerator",
             "MultiSlotStringDataGenerator"]

@@ -122,12 +122,20 @@ class LocalFS(FS):
         with open(fs_path, "a"):
             pass
 
-    # the reference keeps upload/download on LocalFS as plain copies
+    # the reference keeps upload/download on LocalFS as plain copies;
+    # checkpoint dirs are directories, so dispatch on isdir
+    @staticmethod
+    def _copy(src, dst):
+        if os.path.isdir(src):
+            shutil.copytree(src, dst, dirs_exist_ok=True)
+        else:
+            shutil.copy(src, dst)
+
     def upload(self, local_path, fs_path):
-        shutil.copy(local_path, fs_path)
+        self._copy(local_path, fs_path)
 
     def download(self, fs_path, local_path):
-        shutil.copy(fs_path, local_path)
+        self._copy(fs_path, local_path)
 
 
 class HDFSClient(FS):
@@ -140,6 +148,12 @@ class HDFSClient(FS):
                         if hadoop_home else shutil.which("hadoop"))
         self._configs = configs or {}
         self._timeout_s = time_out / 1000.0
+
+    def _check(self, args, rc, out):
+        if rc != 0:
+            raise RuntimeError(
+                f"hadoop fs {' '.join(args)} failed (rc={rc}): "
+                f"{out[-500:]}")
 
     def _run(self, *args) -> Tuple[int, str]:
         if not self._hadoop or not os.path.exists(self._hadoop):
@@ -171,37 +185,51 @@ class HDFSClient(FS):
         rc, out = self._run("-ls", fs_path)
         dirs, files = [], []
         for line in out.splitlines():
-            parts = line.split()
+            # 8 fixed columns; the path (which may contain spaces) is the
+            # remainder
+            parts = line.split(maxsplit=7)
             if len(parts) < 8:
                 continue
-            name = parts[-1].rsplit("/", 1)[-1]
+            name = parts[7].rsplit("/", 1)[-1]
             (dirs if parts[0].startswith("d") else files).append(name)
         return dirs, files
 
     def list_dirs(self, fs_path):
         return self.ls_dir(fs_path)[0]
 
+    def _run_checked(self, *args):
+        rc, out = self._run(*args)
+        self._check(args, rc, out)
+
     def mkdirs(self, fs_path):
-        self._run("-mkdir", "-p", fs_path)
+        self._run_checked("-mkdir", "-p", fs_path)
 
     def delete(self, fs_path):
-        self._run("-rm", "-r", "-f", fs_path)
+        self._run_checked("-rm", "-r", "-f", fs_path)
 
     def upload(self, local_path, fs_path):
-        self._run("-put", "-f", local_path, fs_path)
+        self._run_checked("-put", "-f", local_path, fs_path)
 
     def download(self, fs_path, local_path):
-        self._run("-get", fs_path, local_path)
+        self._run_checked("-get", fs_path, local_path)
 
     def rename(self, fs_src_path, fs_dst_path):
-        self._run("-mv", fs_src_path, fs_dst_path)
+        self._run_checked("-mv", fs_src_path, fs_dst_path)
 
-    mv = rename
+    def mv(self, src_path, dst_path, overwrite=False, test_exists=False):
+        if test_exists:
+            if not self.is_exist(src_path):
+                raise FileNotFoundError(f"{src_path} is not exists")
+            if not overwrite and self.is_exist(dst_path):
+                raise FileExistsError(f"{dst_path} exists already")
+        if overwrite and self.is_exist(dst_path):
+            self.delete(dst_path)
+        self.rename(src_path, dst_path)
 
     def touch(self, fs_path, exist_ok=True):
         if not exist_ok and self.is_exist(fs_path):
             raise FileExistsError(fs_path)
-        self._run("-touchz", fs_path)
+        self._run_checked("-touchz", fs_path)
 
     def need_upload_download(self):
         return True

@@ -4,6 +4,7 @@ implementation itself lives in distributed/moe.py)."""
 from . import asp
 from . import autotune
 from . import checkpoint
+from . import nn
 
 
 class _MoENamespace:
@@ -22,7 +23,7 @@ class _DistributedNamespace:
 distributed = _DistributedNamespace()
 distributed.models.moe = _MoENamespace()
 
-__all__ = ["asp", "autotune", "checkpoint", "distributed", "LookAhead",
+__all__ = ["asp", "autotune", "checkpoint", "distributed", "nn", "LookAhead",
            "ModelAverage",
            "graph_khop_sampler", "graph_reindex", "graph_sample_neighbors",
            "graph_send_recv", "identity_loss", "segment_max",

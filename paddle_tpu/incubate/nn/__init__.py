@@ -1,0 +1,22 @@
+"""paddle.incubate.nn — fused transformer building blocks.
+
+Parity: python/paddle/incubate/nn/__init__.py (FusedMultiHeadAttention,
+FusedFeedForward, FusedTransformerEncoderLayer, FusedMultiTransformer,
+FusedLinear, FusedBiasDropoutResidualLayerNorm, FusedEcMoe,
+FusedDropoutAdd) over the fused CUDA ops (operators/fused/
+fused_attention_op.cu, fused_feedforward, fused_multi_transformer_op.cu —
+SURVEY.md §2.4). TPU-native stance: "fused" is the compiler's job — these
+layers express the same math through the flash-attention dispatch and
+plain jnp compositions, and XLA fuses the elementwise chains; the API
+surface (normalize_before semantics, CacheKV decode on
+FusedMultiTransformer) is what carries over.
+"""
+from .layers import (FusedBiasDropoutResidualLayerNorm, FusedDropoutAdd,
+                     FusedEcMoe, FusedFeedForward, FusedLinear,
+                     FusedMultiHeadAttention, FusedMultiTransformer,
+                     FusedTransformerEncoderLayer)
+
+__all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedTransformerEncoderLayer", "FusedMultiTransformer",
+           "FusedLinear", "FusedBiasDropoutResidualLayerNorm",
+           "FusedEcMoe", "FusedDropoutAdd"]

@@ -1,0 +1,311 @@
+"""Fused-layer implementations — see package docstring for the parity map.
+
+Reference semantics followed exactly (fused_attention_op.cu contract):
+  normalize_before=True (pre-LN):  out = x + drop(sub(LN(x)))
+  normalize_before=False (post-LN): out = LN(x + drop(sub(x)))
+where sub is the attention or FFN block.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ... import tensor as T
+from ...autograd.tape import apply
+from ...nn import functional as F
+from ...nn import initializer as I
+from ...nn.layer_base import Layer
+from ...nn import Dropout, LayerNorm, Linear
+
+__all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedTransformerEncoderLayer", "FusedMultiTransformer",
+           "FusedLinear", "FusedBiasDropoutResidualLayerNorm",
+           "FusedEcMoe", "FusedDropoutAdd"]
+
+
+def _split_qkv(qkv, B, S, nh, hd):
+    """[B, S, 3E] fused projection -> q/k/v [B, S, nh, hd] (contiguous
+    last-dim slices, free reshapes)."""
+    E = nh * hd
+    q = T.reshape(T.slice(qkv, [2], [0], [E]), [B, S, nh, hd])
+    k = T.reshape(T.slice(qkv, [2], [E], [2 * E]), [B, S, nh, hd])
+    v = T.reshape(T.slice(qkv, [2], [2 * E], [3 * E]), [B, S, nh, hd])
+    return q, k, v
+
+
+class FusedMultiHeadAttention(Layer):
+    """Self-attention block with residual + LN fused in.
+
+    Parity: incubate/nn/layer/fused_transformer.py FusedMultiHeadAttention
+    over fused_attention_op.cu. forward(x, attn_mask=None) — mask is
+    additive [B, 1, S, S] or boolean (True = keep)."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 weight_attr=None, bias_attr=None, epsilon=1e-5,
+                 name=None):
+        super().__init__()
+        if embed_dim % num_heads:
+            raise ValueError("embed_dim must divide num_heads")
+        if need_weights:
+            raise NotImplementedError(
+                "need_weights=True is unsupported (the reference fused op "
+                "rejects it too)")
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.qkv = Linear(embed_dim, 3 * embed_dim,
+                          weight_attr=weight_attr, bias_attr=bias_attr)
+        self.out_proj = Linear(embed_dim, embed_dim,
+                               weight_attr=weight_attr,
+                               bias_attr=bias_attr)
+        self.ln = LayerNorm(embed_dim, epsilon=epsilon)
+        self.attn_dropout_rate = attn_dropout_rate
+        self.dropout = Dropout(dropout_rate)
+
+    def _attn(self, x, attn_mask):
+        B, S, E = x.shape
+        q, k, v = _split_qkv(self.qkv(x), B, S, self.num_heads,
+                             self.head_dim)
+        ctx = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask,
+            dropout_p=self.attn_dropout_rate, is_causal=False,
+            training=self.training)
+        return self.out_proj(T.reshape(ctx, [B, S, E]))
+
+    def forward(self, x, attn_mask=None, cache=None):
+        if cache is not None:
+            raise NotImplementedError(
+                "FusedMultiHeadAttention does not implement CacheKV "
+                "decode; use FusedMultiTransformer (caches=..., pos=...)")
+        if self.normalize_before:
+            return x + self.dropout(self._attn(self.ln(x), attn_mask))
+        return self.ln(x + self.dropout(self._attn(x, attn_mask)))
+
+
+class FusedFeedForward(Layer):
+    """FFN block with residual + LN fused in (fused_feedforward role)."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.fc1 = Linear(d_model, dim_feedforward,
+                          weight_attr=linear1_weight_attr,
+                          bias_attr=linear1_bias_attr)
+        self.fc2 = Linear(dim_feedforward, d_model,
+                          weight_attr=linear2_weight_attr,
+                          bias_attr=linear2_bias_attr)
+        self.ln = LayerNorm(d_model, epsilon=epsilon)
+        self.act = getattr(F, activation)
+        self.dropout = Dropout(dropout_rate)
+        self.act_dropout = Dropout(
+            dropout_rate if act_dropout_rate is None else act_dropout_rate)
+
+    def _ffn(self, x):
+        return self.fc2(self.act_dropout(self.act(self.fc1(x))))
+
+    def forward(self, x):
+        if self.normalize_before:
+            return x + self.dropout(self._ffn(self.ln(x)))
+        return self.ln(x + self.dropout(self._ffn(x)))
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """Attention + FFN blocks (fused_transformer.py
+    FusedTransformerEncoderLayer)."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=(dropout_rate if attn_dropout_rate is None
+                               else attn_dropout_rate),
+            normalize_before=normalize_before, weight_attr=weight_attr,
+            bias_attr=bias_attr)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None):
+        return self.ffn(self.fused_attn(src, src_mask))
+
+
+class FusedMultiTransformer(Layer):
+    """Inference-oriented decoder stack with CacheKV incremental decode.
+
+    Parity: fused_multi_transformer_op.cu (§2.4) / FusedMultiTransformer —
+    the serving transformer. forward(x, caches=None, pos=None): with
+    caches (list of per-layer (k, v) [B, L, nh, hd]) runs incremental
+    causal attention at position pos and returns (out, new_caches);
+    without caches runs full causal attention. Pre-LN, as the reference
+    defaults (normalize_before=True)."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward, num_layers,
+                 dropout_rate=0.0, activation="gelu", epsilon=1e-5,
+                 normalize_before=True, name=None):
+        super().__init__()
+        if not normalize_before:
+            raise NotImplementedError(
+                "FusedMultiTransformer is pre-LN only, like the "
+                "reference op")
+        if embed_dim % num_heads:
+            raise ValueError("embed_dim must divide num_heads")
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.num_layers = num_layers
+        self.layers = []
+        for i in range(num_layers):
+            blk = _FMTBlock(embed_dim, num_heads, dim_feedforward,
+                            dropout_rate, activation, epsilon)
+            self.add_sublayer(f"layer_{i}", blk)
+            self.layers.append(blk)
+
+    def new_cache(self, batch_size, max_len, dtype="float32"):
+        shape = (batch_size, max_len, self.num_heads, self.head_dim)
+        return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+                for _ in range(self.num_layers)]
+
+    def forward(self, x, caches=None, pos=None):
+        if caches is not None:
+            new_caches = []
+            for blk, c in zip(self.layers, caches):
+                x, c = blk(x, c, pos)
+                new_caches.append(c)
+            return x, new_caches
+        for blk in self.layers:
+            x = blk(x)
+        return x
+
+
+class _FMTBlock(Layer):
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate, activation, epsilon):
+        super().__init__()
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.ln1 = LayerNorm(embed_dim, epsilon=epsilon)
+        self.qkv = Linear(embed_dim, 3 * embed_dim)
+        self.out_proj = Linear(embed_dim, embed_dim)
+        self.ln2 = LayerNorm(embed_dim, epsilon=epsilon)
+        self.fc1 = Linear(embed_dim, dim_feedforward)
+        self.fc2 = Linear(dim_feedforward, embed_dim)
+        self.act = getattr(F, activation)
+        self.dropout = Dropout(dropout_rate)
+
+    def forward(self, x, cache=None, pos=None):
+        B, S, E = x.shape
+        h = self.ln1(x)
+        q, k, v = _split_qkv(self.qkv(h), B, S, self.num_heads,
+                             self.head_dim)
+        if cache is not None:
+            from ...nn.functional.flash_attention import cached_attention
+            ctx, kc, vc = cached_attention(q, k, v, cache[0], cache[1],
+                                           pos)
+            att = self.out_proj(T.reshape(ctx, [B, S, E]))
+            x = x + self.dropout(att)
+            x = x + self.dropout(
+                self.fc2(self.act(self.fc1(self.ln2(x)))))
+            return x, (kc, vc)
+        ctx, _ = F.flash_attention(q, k, v, causal=True,
+                                   training=self.training)
+        x = x + self.dropout(self.out_proj(T.reshape(ctx, [B, S, E])))
+        x = x + self.dropout(self.fc2(self.act(self.fc1(self.ln2(x)))))
+        return x
+
+
+class FusedLinear(Linear):
+    """Parity: incubate FusedLinear (fused gemm_epilogue) — on TPU the
+    bias epilogue is XLA's fusion; identical math to Linear."""
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """y = LN(residual + dropout(x + bias)) — the fused epilogue of the
+    attention op exposed standalone."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        self.bias = self.create_parameter([embed_dim], attr=bias_attr,
+                                          is_bias=True)
+        self.ln = LayerNorm(embed_dim, epsilon=epsilon,
+                            weight_attr=weight_attr)
+        self.dropout = Dropout(dropout_rate)
+
+    def forward(self, x, residual):
+        return self.ln(residual + self.dropout(x + self.bias))
+
+
+class FusedDropoutAdd(Layer):
+    """y = dropout(x) + y (incubate FusedDropoutAdd)."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.dropout = Dropout(p, mode=mode)
+
+    def forward(self, x, y):
+        return self.dropout(x) + y
+
+
+class FusedEcMoe(Layer):
+    """Expert-choice MoE (incubate FusedEcMoe): each EXPERT selects its
+    top-capacity tokens (k = S * capacity_factor / E), so load balance is
+    structural rather than auxiliary-loss-driven.
+
+    forward(x [B, S, H], gate_logits [B, S, E]) -> [B, S, H].
+    """
+
+    def __init__(self, hidden_size, inter_size, num_experts,
+                 act_type="gelu", capacity_factor=2.0, weight_attr=None,
+                 bias_attr=None):
+        super().__init__()
+        self.num_experts = num_experts
+        self.capacity_factor = float(capacity_factor)
+        init = weight_attr or I.XavierNormal()
+        self.w1 = self.create_parameter(
+            [num_experts, hidden_size, inter_size], attr=init)
+        self.b1 = self.create_parameter([num_experts, inter_size],
+                                        attr=bias_attr, is_bias=True)
+        self.w2 = self.create_parameter(
+            [num_experts, inter_size, hidden_size], attr=init)
+        self.b2 = self.create_parameter([num_experts, hidden_size],
+                                        attr=bias_attr, is_bias=True)
+        self.act = act_type
+
+    def forward(self, x, gate_logits):
+        E = self.num_experts
+        cap = self.capacity_factor
+        act = self.act
+
+        def f(xv, gl, w1, b1, w2, b2):
+            B, S, H = xv.shape
+            k = max(1, int(S * cap / E))
+            probs = jax.nn.softmax(gl.astype(jnp.float32), axis=-1)
+            # expert-choice: per (batch, expert) pick top-k tokens
+            pe = jnp.transpose(probs, (0, 2, 1))          # [B, E, S]
+            gate, idx = jax.lax.top_k(pe, k)              # [B, E, k]
+            tok = jnp.take_along_axis(
+                xv[:, None], idx[..., None], axis=2)      # [B, E, k, H]
+            h = jnp.einsum("bekh,ehi->beki", tok, w1) + b1[None, :, None]
+            h = getattr(jax.nn, act)(h)
+            out = jnp.einsum("beki,eih->bekh", h, w2) + b2[None, :, None]
+            out = out * gate[..., None].astype(out.dtype)
+            # scatter-add the expert outputs back to token positions
+            y = jnp.zeros_like(xv)
+            bidx = jnp.arange(B)[:, None, None]
+            y = y.at[bidx, idx].add(out)
+            return y
+
+        return apply(f, x, gate_logits, self.w1, self.b1, self.w2,
+                     self.b2, _op_name="fused_ec_moe")

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from ...autograd.tape import apply
 from ...core.tensor import Tensor
@@ -183,3 +184,42 @@ class sdp_kernel:
 
     def __exit__(self, *a):
         return False
+
+
+def cached_attention(q, k, v, k_cache, v_cache, pos):
+    """Incremental attention for autoregressive decode (serving path).
+
+    Writes the S new k/v rows into the caches at [pos, pos+S) and attends
+    q (query positions pos..pos+S-1) over all cache positions <= its own.
+    The reference serves this via fused_multi_transformer_op.cu's
+    CacheKV (§2.4); TPU-native: dynamic_update_slice + masked attention
+    in one jitted step, static shapes throughout. Caches may hold fewer
+    kv heads than q heads (GQA) — they are broadcast at use.
+
+    q/k/v: [B, S, nh|nkv, hd]; caches: [B, L, nkv, hd]; pos: scalar.
+    Returns (ctx [B, S, nh, hd], k_cache', v_cache').
+    """
+    def f(q, k, v, kc, vc, pos):
+        pos = jnp.asarray(pos, jnp.int32)
+        kc = lax.dynamic_update_slice(kc, k.astype(kc.dtype),
+                                      (0, pos, 0, 0))
+        vc = lax.dynamic_update_slice(vc, v.astype(vc.dtype),
+                                      (0, pos, 0, 0))
+        nh, nkv = q.shape[2], kc.shape[2]
+        ka, va = kc, vc
+        if nkv != nh:
+            ka = jnp.repeat(ka, nh // nkv, axis=2)
+            va = jnp.repeat(va, nh // nkv, axis=2)
+        L, S, hd = ka.shape[1], q.shape[1], q.shape[-1]
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                            ka.astype(jnp.float32)) / jnp.sqrt(
+                                jnp.float32(hd))
+        mask = (jnp.arange(L)[None, :]
+                <= pos + jnp.arange(S)[:, None])        # [S, L]
+        logits = jnp.where(mask[None, None], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(va.dtype), va)
+        return ctx, kc, vc
+
+    return apply(f, q, k, v, k_cache, v_cache, pos,
+                 _op_name="cached_attention")
