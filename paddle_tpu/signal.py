@@ -1,0 +1,147 @@
+"""paddle.signal — stft / istft.
+
+Parity: python/paddle/signal.py (__all__ = ['stft', 'istft']). TPU-native:
+framing is a batched gather, the FFT one batched kernel, overlap-add a
+scatter-add — all fused by XLA (the framing idiom shared with
+audio/features.py _stft_mag).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .autograd.tape import apply
+from .core.tensor import Tensor
+
+__all__ = ["stft", "istft"]
+
+
+def frame_signal(v, n_fft: int, hop: int):
+    """Strided framing: [..., T] -> [..., n_frames, n_fft] (the gather
+    idiom shared with audio/features.py)."""
+    n_frames = 1 + (v.shape[-1] - n_fft) // hop
+    idx = (hop * jnp.arange(n_frames)[:, None]
+           + jnp.arange(n_fft)[None, :])
+    return v[..., idx]
+
+
+def _check_hop(hop_length, n_fft):
+    hop = n_fft // 4 if hop_length is None else hop_length
+    if hop <= 0:
+        raise ValueError(f"hop_length must be positive, got {hop}")
+    return hop
+
+
+def _resolve_window(window, win_length, n_fft, dtype=jnp.float32):
+    if window is None:
+        w = jnp.ones((win_length,), dtype)
+    else:
+        w = window.value if isinstance(window, Tensor) else jnp.asarray(window)
+        if w.shape[-1] != win_length:
+            raise ValueError(
+                f"window length {w.shape[-1]} != win_length {win_length}")
+    if win_length < n_fft:   # center the window inside the fft buffer
+        lpad = (n_fft - win_length) // 2
+        w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+    return w
+
+
+def stft(x, n_fft, hop_length: Optional[int] = None,
+         win_length: Optional[int] = None, window=None, center: bool = True,
+         pad_mode: str = "reflect", normalized: bool = False,
+         onesided: bool = True, name=None):
+    """Short-time Fourier transform.
+
+    x: real [..., T] (complex input supported with onesided=False).
+    Returns complex [..., n_fft//2 + 1 (or n_fft), n_frames], matching
+    paddle.signal.stft's (freq, frame) ordering.
+    """
+    hop = _check_hop(hop_length, n_fft)
+    win_length = win_length or n_fft
+    if not 0 < win_length <= n_fft:
+        raise ValueError(f"win_length {win_length} not in (0, {n_fft}]")
+
+    def f(xv, *wargs):
+        w = _resolve_window(wargs[0] if wargs else None, win_length, n_fft,
+                            jnp.float32)
+        is_complex = jnp.iscomplexobj(xv)
+        if is_complex and onesided:
+            raise ValueError("onesided must be False for complex input")
+        v = xv
+        if center:
+            pads = [(0, 0)] * (v.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            v = jnp.pad(v, pads, mode=pad_mode)
+        if v.shape[-1] < n_fft:
+            raise ValueError(
+                f"input too short ({v.shape[-1]}) for n_fft {n_fft}")
+        frames = frame_signal(v, n_fft, hop) * w   # [..., n_frames, n_fft]
+        if onesided and not is_complex:
+            spec = jnp.fft.rfft(frames, axis=-1)
+        else:
+            spec = jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        # (frame, freq) -> (freq, frame)
+        return jnp.swapaxes(spec, -1, -2)
+
+    args = (x,) if window is None else (x, window)
+    return apply(f, *args, _op_name="stft")
+
+
+def istft(x, n_fft, hop_length: Optional[int] = None,
+          win_length: Optional[int] = None, window=None,
+          center: bool = True, normalized: bool = False,
+          onesided: bool = True, length: Optional[int] = None,
+          return_complex: bool = False, name=None):
+    """Inverse STFT by windowed overlap-add with window-power
+    normalization (NOLA). x: complex [..., freq, n_frames]."""
+    hop = _check_hop(hop_length, n_fft)
+    win_length = win_length or n_fft
+    if not 0 < win_length <= n_fft:
+        raise ValueError(f"win_length {win_length} not in (0, {n_fft}]")
+    if return_complex and onesided:
+        raise ValueError(
+            "return_complex=True requires onesided=False (a onesided "
+            "spectrum reconstructs a real signal)")
+
+    def f(sv, *wargs):
+        w = _resolve_window(wargs[0] if wargs else None, win_length, n_fft,
+                            jnp.float32)
+        want_freq = n_fft // 2 + 1 if onesided else n_fft
+        if sv.shape[-2] != want_freq:
+            raise ValueError(
+                f"spectrogram freq dim {sv.shape[-2]} does not match "
+                f"n_fft {n_fft} (expected {want_freq})")
+        spec = jnp.swapaxes(sv, -1, -2)      # [..., n_frames, freq]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        if onesided:
+            frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+        else:
+            frames = jnp.fft.ifft(spec, axis=-1)
+            if not return_complex:
+                frames = frames.real
+        frames = frames * w                  # synthesis window
+        n_frames = frames.shape[-2]
+        total = n_fft + hop * (n_frames - 1)
+        lead = frames.shape[:-2]
+        out = jnp.zeros(lead + (total,), frames.dtype)
+        wsum = jnp.zeros((total,), jnp.float32)
+        idx = (hop * jnp.arange(n_frames)[:, None]
+               + jnp.arange(n_fft)[None, :])
+        out = out.at[..., idx].add(frames)
+        wsum = wsum.at[idx].add(w * w)
+        out = out / jnp.where(wsum > 1e-11, wsum, 1.0)
+        if center:
+            out = out[..., n_fft // 2: total - n_fft // 2]
+        if length is not None:
+            out = out[..., :length]
+            if out.shape[-1] < length:
+                pads = [(0, 0)] * (out.ndim - 1) \
+                    + [(0, length - out.shape[-1])]
+                out = jnp.pad(out, pads)
+        return out
+
+    args = (x,) if window is None else (x, window)
+    return apply(f, *args, _op_name="istft")

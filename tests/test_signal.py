@@ -1,0 +1,72 @@
+"""paddle.signal stft/istft tests (reference: python/paddle/signal.py) —
+round-trip reconstruction + numpy reference comparisons."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.signal import istft, stft
+
+
+def _sig(n=512, seed=0):
+    rng = np.random.RandomState(seed)
+    t = np.arange(n) / n
+    return (np.sin(2 * np.pi * 13 * t) + 0.5 * rng.randn(n)).astype(
+        "float32")
+
+
+def test_stft_matches_numpy():
+    x = _sig()
+    n_fft, hop = 64, 16
+    win = np.hanning(n_fft).astype("float32")
+    got = stft(paddle.to_tensor(x), n_fft, hop_length=hop,
+               window=paddle.to_tensor(win), center=False).numpy()
+    n_frames = 1 + (len(x) - n_fft) // hop
+    want = np.stack([np.fft.rfft(x[i * hop:i * hop + n_fft] * win)
+                     for i in range(n_frames)], axis=1)
+    assert got.shape == (n_fft // 2 + 1, n_frames)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_round_trip_reconstruction():
+    x = _sig(1024)
+    n_fft, hop = 128, 32
+    win = paddle.to_tensor(np.hanning(n_fft).astype("float32"))
+    spec = stft(paddle.to_tensor(x), n_fft, hop_length=hop, window=win)
+    rec = istft(spec, n_fft, hop_length=hop, window=win,
+                length=len(x)).numpy()
+    np.testing.assert_allclose(rec, x, rtol=1e-3, atol=1e-4)
+
+
+def test_round_trip_normalized_twosided():
+    x = _sig(512, seed=3)
+    n_fft, hop = 64, 16
+    win = paddle.to_tensor(np.hanning(n_fft).astype("float32"))
+    spec = stft(paddle.to_tensor(x), n_fft, hop_length=hop, window=win,
+                normalized=True, onesided=False)
+    assert spec.shape == [n_fft, 1 + len(x) // hop]
+    rec = istft(spec, n_fft, hop_length=hop, window=win, normalized=True,
+                onesided=False, length=len(x)).numpy()
+    np.testing.assert_allclose(rec, x, rtol=1e-3, atol=1e-4)
+
+
+def test_batched_and_grad():
+    xs = np.stack([_sig(256, s) for s in range(3)])
+    win = paddle.to_tensor(np.hanning(64).astype("float32"))
+    xt = paddle.to_tensor(xs)
+    xt.stop_gradient = False
+    spec = stft(xt, 64, hop_length=32, window=win)
+    assert spec.shape == [3, 33, 1 + 256 // 32]
+    # gradient flows through |stft|^2
+    import paddle_tpu.tensor as T
+    power = T.mean(T.abs(spec) ** 2)
+    power.backward()
+    g = np.asarray(xt._grad)
+    assert g.shape == xs.shape and np.abs(g).sum() > 0
+
+
+def test_validation():
+    x = paddle.to_tensor(_sig(128))
+    with pytest.raises(ValueError, match="win_length"):
+        stft(x, 64, win_length=100)
+    with pytest.raises(ValueError, match="window length"):
+        stft(x, 64, window=paddle.to_tensor(np.ones(10, "float32")))
