@@ -71,6 +71,7 @@ from . import geometric  # noqa: E402
 from . import text  # noqa: E402
 from . import audio  # noqa: E402
 from . import signal  # noqa: E402
+from . import fft  # noqa: E402
 from . import incubate  # noqa: E402
 from . import utils  # noqa: E402
 from .framework import custom_op  # noqa: E402

@@ -20,17 +20,14 @@ __all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
 
 
 def _stft_mag(x, window, n_fft, hop, win_length, center, pad_mode, power):
-    if win_length < n_fft:  # center window inside the fft buffer
-        pad = (n_fft - win_length) // 2
-        window = jnp.pad(window, (pad, n_fft - win_length - pad))
+    from ..signal import _resolve_window
+    window = _resolve_window(window, win_length, n_fft)
     if center:
         pad = n_fft // 2
         x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(pad, pad)],
                     mode=pad_mode)
-    n_frames = 1 + (x.shape[-1] - n_fft) // hop
-    idx = (jnp.arange(n_frames)[:, None] * hop
-           + jnp.arange(n_fft)[None, :])
-    frames = x[..., idx] * window  # (..., n_frames, n_fft)
+    from ..signal import frame_signal
+    frames = frame_signal(x, n_fft, hop) * window  # (..., n_frames, n_fft)
     spec = jnp.fft.rfft(frames, axis=-1)
     mag = jnp.abs(spec) ** power
     # paddle layout: (..., freq, time)

@@ -1,0 +1,142 @@
+"""paddle.fft — discrete Fourier transform API.
+
+Parity: python/paddle/fft.py (22 functions: 1-D/2-D/N-D complex, real and
+Hermitian transforms + helpers). Each maps onto the corresponding
+jnp.fft kernel (one batched XLA FFT op); `norm` follows the same
+"backward"/"ortho"/"forward" semantics; autograd flows through the tape's
+jax.vjp like every other op.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .autograd.tape import apply
+from .core.tensor import Tensor
+
+__all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+           "fft2", "ifft2", "rfft2", "irfft2", "hfft2", "ihfft2",
+           "fftn", "ifftn", "rfftn", "irfftn", "hfftn", "ihfftn",
+           "fftfreq", "rfftfreq", "fftshift", "ifftshift"]
+
+_NORMS = ("backward", "ortho", "forward")
+
+
+def _check_norm(norm):
+    norm = norm or "backward"
+    if norm not in _NORMS:
+        raise ValueError(f"norm must be one of {_NORMS}, got {norm!r}")
+    return norm
+
+
+def _wrap1(kind):
+    fn = getattr(jnp.fft, kind)
+
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        norm = _check_norm(norm)
+        return apply(lambda v: fn(v, n=n, axis=axis, norm=norm), x,
+                     _op_name=kind)
+    op.__name__ = kind
+    op.__doc__ = f"Parity: paddle.fft.{kind} (jnp.fft.{kind} kernel)."
+    return op
+
+
+def _wrapn(kind, default_axes):
+    fn = getattr(jnp.fft, kind)
+
+    def op(x, s=None, axes=default_axes, norm="backward", name=None):
+        norm = _check_norm(norm)
+        return apply(lambda v: fn(v, s=s, axes=axes, norm=norm), x,
+                     _op_name=kind)
+    op.__name__ = kind
+    op.__doc__ = f"Parity: paddle.fft.{kind} (jnp.fft.{kind} kernel)."
+    return op
+
+
+fft = _wrap1("fft")
+ifft = _wrap1("ifft")
+rfft = _wrap1("rfft")
+irfft = _wrap1("irfft")
+hfft = _wrap1("hfft")
+ihfft = _wrap1("ihfft")
+
+fft2 = _wrapn("fft2", (-2, -1))
+ifft2 = _wrapn("ifft2", (-2, -1))
+rfft2 = _wrapn("rfft2", (-2, -1))
+irfft2 = _wrapn("irfft2", (-2, -1))
+fftn = _wrapn("fftn", None)
+ifftn = _wrapn("ifftn", None)
+rfftn = _wrapn("rfftn", None)
+irfftn = _wrapn("irfftn", None)
+
+
+def _hfft_nd(x, s, axes, norm, inverse):
+    """jnp.fft lacks hfft2/hfftn — compose per numpy's definition:
+    forward = fft over the leading axes, then hfft on the last;
+    inverse = ihfft on the last axis FIRST (it requires real input),
+    then ifft over the leading axes."""
+    axes = tuple(axes) if axes is not None else tuple(
+        range(-(x.ndim), 0))
+    s = list(s) if s is not None else [None] * len(axes)
+    last_ax, rest_ax = axes[-1], axes[:-1]
+    last_n, rest_s = s[-1], s[:-1]
+    if inverse:
+        v = jnp.fft.ihfft(x, n=last_n, axis=last_ax, norm=norm)
+        for ax, nn in zip(rest_ax, rest_s):
+            v = jnp.fft.ifft(v, n=nn, axis=ax, norm=norm)
+        return v
+    v = x
+    for ax, nn in zip(rest_ax, rest_s):
+        v = jnp.fft.fft(v, n=nn, axis=ax, norm=norm)
+    return jnp.fft.hfft(v, n=last_n, axis=last_ax, norm=norm)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    """Parity: paddle.fft.hfft2."""
+    norm = _check_norm(norm)
+    return apply(lambda v: _hfft_nd(v, s, axes, norm, False), x,
+                 _op_name="hfft2")
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    """Parity: paddle.fft.ihfft2."""
+    norm = _check_norm(norm)
+    return apply(lambda v: _hfft_nd(v, s, axes, norm, True), x,
+                 _op_name="ihfft2")
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    """Parity: paddle.fft.hfftn."""
+    norm = _check_norm(norm)
+    return apply(lambda v: _hfft_nd(v, s, axes, norm, False), x,
+                 _op_name="hfftn")
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    """Parity: paddle.fft.ihfftn."""
+    norm = _check_norm(norm)
+    return apply(lambda v: _hfft_nd(v, s, axes, norm, True), x,
+                 _op_name="ihfftn")
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    """Parity: paddle.fft.fftfreq."""
+    out = jnp.fft.fftfreq(int(n), d=float(d))
+    return Tensor(out.astype(dtype) if dtype else out)
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    """Parity: paddle.fft.rfftfreq."""
+    out = jnp.fft.rfftfreq(int(n), d=float(d))
+    return Tensor(out.astype(dtype) if dtype else out)
+
+
+def fftshift(x, axes=None, name=None):
+    """Parity: paddle.fft.fftshift."""
+    return apply(lambda v: jnp.fft.fftshift(v, axes=axes), x,
+                 _op_name="fftshift")
+
+
+def ifftshift(x, axes=None, name=None):
+    """Parity: paddle.fft.ifftshift."""
+    return apply(lambda v: jnp.fft.ifftshift(v, axes=axes), x,
+                 _op_name="ifftshift")

@@ -33,6 +33,32 @@ def _check_hop(hop_length, n_fft):
     return hop
 
 
+def _check_win_length(win_length, n_fft):
+    wl = n_fft if win_length is None else win_length
+    if not 0 < wl <= n_fft:
+        raise ValueError(f"win_length {wl} not in (0, {n_fft}]")
+    return wl
+
+
+def _check_nola(window, win_length, n_fft, hop):
+    """Reject window/hop pairs whose interior overlap-add envelope is ~0
+    (reference istft's NOLA requirement). Skipped for traced windows."""
+    import numpy as np
+    try:
+        w = np.asarray(_resolve_window(window, win_length, n_fft))
+    except Exception:
+        return  # tracer — cannot validate eagerly
+    acc = np.zeros(hop)
+    for start in range(0, len(w), hop):
+        seg = w[start:start + hop] ** 2
+        acc[:len(seg)] += seg
+    if acc.min() < 1e-11:
+        raise ValueError(
+            "window/hop combination violates NOLA (overlap-added window "
+            "power reaches zero); choose hop_length < win_length or a "
+            "window without zero-covered gaps")
+
+
 def _resolve_window(window, win_length, n_fft, dtype=jnp.float32):
     if window is None:
         w = jnp.ones((win_length,), dtype)
@@ -58,9 +84,7 @@ def stft(x, n_fft, hop_length: Optional[int] = None,
     paddle.signal.stft's (freq, frame) ordering.
     """
     hop = _check_hop(hop_length, n_fft)
-    win_length = win_length or n_fft
-    if not 0 < win_length <= n_fft:
-        raise ValueError(f"win_length {win_length} not in (0, {n_fft}]")
+    win_length = _check_win_length(win_length, n_fft)
 
     def f(xv, *wargs):
         w = _resolve_window(wargs[0] if wargs else None, win_length, n_fft,
@@ -97,9 +121,8 @@ def istft(x, n_fft, hop_length: Optional[int] = None,
     """Inverse STFT by windowed overlap-add with window-power
     normalization (NOLA). x: complex [..., freq, n_frames]."""
     hop = _check_hop(hop_length, n_fft)
-    win_length = win_length or n_fft
-    if not 0 < win_length <= n_fft:
-        raise ValueError(f"win_length {win_length} not in (0, {n_fft}]")
+    win_length = _check_win_length(win_length, n_fft)
+    _check_nola(window, win_length, n_fft, hop)
     if return_complex and onesided:
         raise ValueError(
             "return_complex=True requires onesided=False (a onesided "

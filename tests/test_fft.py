@@ -1,0 +1,91 @@
+"""paddle.fft tests (reference: python/paddle/fft.py) — numpy parity for
+every exported function + gradient flow + norm modes."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import fft as pfft
+
+
+@pytest.fixture
+def xc():
+    rng = np.random.RandomState(0)
+    return (rng.randn(4, 8) + 1j * rng.randn(4, 8)).astype(np.complex64)
+
+
+@pytest.fixture
+def xr():
+    return np.random.RandomState(1).randn(4, 8).astype(np.float32)
+
+
+def test_1d_family(xc, xr):
+    np.testing.assert_allclose(pfft.fft(paddle.to_tensor(xc)).numpy(),
+                               np.fft.fft(xc), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(pfft.ifft(paddle.to_tensor(xc)).numpy(),
+                               np.fft.ifft(xc), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(pfft.rfft(paddle.to_tensor(xr)).numpy(),
+                               np.fft.rfft(xr), rtol=1e-4, atol=1e-4)
+    spec = np.fft.rfft(xr)
+    np.testing.assert_allclose(
+        pfft.irfft(paddle.to_tensor(spec.astype(np.complex64))).numpy(),
+        np.fft.irfft(spec), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(pfft.hfft(paddle.to_tensor(xc)).numpy(),
+                               np.fft.hfft(xc), rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(pfft.ihfft(paddle.to_tensor(xr)).numpy(),
+                               np.fft.ihfft(xr), rtol=1e-4, atol=1e-4)
+
+
+def test_nd_family(xc, xr):
+    for name in ("fft2", "ifft2", "fftn", "ifftn"):
+        got = getattr(pfft, name)(paddle.to_tensor(xc)).numpy()
+        want = getattr(np.fft, name)(xc)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(pfft.rfft2(paddle.to_tensor(xr)).numpy(),
+                               np.fft.rfft2(xr), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(pfft.rfftn(paddle.to_tensor(xr)).numpy(),
+                               np.fft.rfftn(xr), rtol=1e-4, atol=1e-4)
+    spec2 = np.fft.rfft2(xr).astype(np.complex64)
+    np.testing.assert_allclose(pfft.irfft2(paddle.to_tensor(spec2)).numpy(),
+                               np.fft.irfft2(spec2), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(pfft.irfftn(paddle.to_tensor(spec2)).numpy(),
+                               np.fft.irfftn(spec2), rtol=1e-4, atol=1e-4)
+
+
+def test_hermitian_nd(xc, xr):
+    # hfft2/hfftn: Hermitian on last axis, complex on the rest (numpy def)
+    want = np.fft.fft(xc, axis=-2)
+    want = np.fft.hfft(want, axis=-1)
+    np.testing.assert_allclose(pfft.hfft2(paddle.to_tensor(xc)).numpy(),
+                               want, rtol=1e-3, atol=1e-3)
+    wantn = np.fft.ifft(np.fft.ihfft(xr, axis=-1), axis=-2)
+    np.testing.assert_allclose(pfft.ihfftn(paddle.to_tensor(xr)).numpy(),
+                               wantn, rtol=1e-4, atol=1e-4)
+
+
+def test_helpers_and_norm(xr):
+    np.testing.assert_allclose(pfft.fftfreq(8, d=0.5).numpy(),
+                               np.fft.fftfreq(8, d=0.5), rtol=1e-6)
+    np.testing.assert_allclose(pfft.rfftfreq(8).numpy(),
+                               np.fft.rfftfreq(8), rtol=1e-6)
+    np.testing.assert_allclose(
+        pfft.fftshift(paddle.to_tensor(xr)).numpy(),
+        np.fft.fftshift(xr), rtol=1e-6)
+    np.testing.assert_allclose(
+        pfft.ifftshift(paddle.to_tensor(xr)).numpy(),
+        np.fft.ifftshift(xr), rtol=1e-6)
+    np.testing.assert_allclose(
+        pfft.fft(paddle.to_tensor(xr), norm="ortho").numpy(),
+        np.fft.fft(xr, norm="ortho"), rtol=1e-4, atol=1e-4)
+    with pytest.raises(ValueError, match="norm"):
+        pfft.fft(paddle.to_tensor(xr), norm="bogus")
+
+
+def test_gradient_flow(xr):
+    t = paddle.to_tensor(xr)
+    t.stop_gradient = False
+    import paddle_tpu.tensor as T
+    power = T.mean(T.abs(pfft.rfft(t)) ** 2)
+    power.backward()
+    g = np.asarray(t._grad)
+    # Parseval: d/dx mean|rfft(x)|^2 is linear in x, nonzero
+    assert g.shape == xr.shape and np.abs(g).sum() > 0

@@ -70,3 +70,13 @@ def test_validation():
         stft(x, 64, win_length=100)
     with pytest.raises(ValueError, match="window length"):
         stft(x, 64, window=paddle.to_tensor(np.ones(10, "float32")))
+
+
+def test_nola_violation_rejected():
+    spec = stft(paddle.to_tensor(_sig(512)), 64, hop_length=16,
+                window=paddle.to_tensor(np.hanning(64).astype("float32")))
+    with pytest.raises(ValueError, match="NOLA"):
+        istft(spec, 64, hop_length=64,
+              window=paddle.to_tensor(np.hanning(64).astype("float32")))
+    with pytest.raises(ValueError, match="win_length"):
+        stft(paddle.to_tensor(_sig(128)), 64, win_length=0)
