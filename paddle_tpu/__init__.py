@@ -72,6 +72,12 @@ from . import text  # noqa: E402
 from . import audio  # noqa: E402
 from . import signal  # noqa: E402
 from . import fft  # noqa: E402
+from . import reader  # noqa: E402
+from . import regularizer  # noqa: E402
+from . import sysconfig  # noqa: E402
+from . import hub  # noqa: E402
+from . import onnx  # noqa: E402
+from . import dataset  # noqa: E402
 from . import incubate  # noqa: E402
 from . import utils  # noqa: E402
 from .framework import custom_op  # noqa: E402

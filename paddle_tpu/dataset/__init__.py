@@ -1,0 +1,12 @@
+"""paddle.dataset parity namespace (python/paddle/dataset/).
+
+The reference's legacy reader-creator datasets (mnist.train() etc.)
+download over the network; this build's datasets are the file-based
+loaders in paddle_tpu.vision/text/audio.datasets. This namespace keeps
+the classic access pattern alive by adapting those Dataset objects into
+reader creators, plus the `common` checksum/cache helpers.
+"""
+from . import common
+from .adapters import mnist, cifar
+
+__all__ = ["common", "mnist", "cifar"]

@@ -1,0 +1,39 @@
+"""Classic reader-creator spellings over the file-based datasets
+(reference: dataset/mnist.py train()/test() returning sample
+generators)."""
+from __future__ import annotations
+
+
+class _ReaderModule:
+    """mnist.train()/test() style module facade over a Dataset class."""
+
+    def __init__(self, dataset_cls_path: str, train_kw, test_kw):
+        self._path = dataset_cls_path
+        self._train_kw = train_kw
+        self._test_kw = test_kw
+
+    def _cls(self):
+        import importlib
+        mod_name, cls_name = self._path.rsplit(".", 1)
+        return getattr(importlib.import_module(mod_name), cls_name)
+
+    def _creator(self, **kw):
+        cls = self._cls()
+
+        def reader():
+            ds = cls(**kw)
+            for i in range(len(ds)):
+                yield ds[i]
+        return reader
+
+    def train(self, **kw):
+        return self._creator(**{**self._train_kw, **kw})
+
+    def test(self, **kw):
+        return self._creator(**{**self._test_kw, **kw})
+
+
+mnist = _ReaderModule("paddle_tpu.vision.datasets.MNIST",
+                      {"mode": "train"}, {"mode": "test"})
+cifar = _ReaderModule("paddle_tpu.vision.datasets.Cifar10",
+                      {"mode": "train"}, {"mode": "test"})

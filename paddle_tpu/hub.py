@@ -1,0 +1,60 @@
+"""paddle.hub parity (python/paddle/hub.py: list/help/load).
+
+Local and installed-module sources are fully supported (a hubconf.py
+exposing entrypoint callables); the github/gitee remote sources require
+network, which this build does not have — they raise with guidance.
+"""
+from __future__ import annotations
+
+import importlib
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+
+
+def _load_hubconf(repo_dir: str):
+    path = os.path.join(repo_dir, "hubconf.py")
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no hubconf.py under {repo_dir!r}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["paddle_tpu_hubconf"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _resolve(repo_dir: str, source: str):
+    if source in ("github", "gitee"):
+        raise RuntimeError(
+            f"hub source {source!r} needs network access, unavailable in "
+            "this build; clone the repo and use source='local'")
+    if source == "local":
+        return _load_hubconf(repo_dir)
+    raise ValueError(f"unknown hub source {source!r} "
+                     "(expected 'github', 'gitee' or 'local')")
+
+
+def list(repo_dir, source="github", force_reload=False):  # noqa: A001
+    """Entrypoint names exposed by the repo's hubconf.py."""
+    mod = _resolve(repo_dir, source)
+    return [n for n in dir(mod)
+            if callable(getattr(mod, n)) and not n.startswith("_")]
+
+
+def help(repo_dir, model, source="github", force_reload=False):  # noqa: A001
+    """Docstring of one entrypoint."""
+    mod = _resolve(repo_dir, source)
+    return getattr(mod, model).__doc__
+
+
+def load(repo_dir, model, source="github", force_reload=False, **kwargs):
+    """Call entrypoint `model` with kwargs and return the result."""
+    mod = _resolve(repo_dir, source)
+    fn = getattr(mod, model, None)
+    if fn is None or not callable(fn):
+        raise ValueError(f"hubconf has no callable entrypoint {model!r}")
+    return fn(**kwargs)

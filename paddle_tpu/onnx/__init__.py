@@ -1,0 +1,18 @@
+"""paddle.onnx parity surface (python/paddle/onnx/export.py).
+
+ONNX export in the reference rides paddle2onnx, which translates static
+Programs into ONNX graphs. This build's serving interchange format is
+StableHLO (`paddle.jit.save` → `inference.Predictor`/HTTP serving), the
+TPU-native equivalent; ONNX tooling is not shipped, so export() raises
+with that guidance.
+"""
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    raise NotImplementedError(
+        "ONNX export is not available in this build (no paddle2onnx). "
+        "Use paddle.jit.save(layer, path, input_spec=...) — the StableHLO "
+        "artifact serves through paddle_tpu.inference (Predictor / "
+        "`python -m paddle_tpu.inference.serve`), this framework's "
+        "deployment path.")

@@ -1,0 +1,150 @@
+"""paddle.reader — legacy reader-creator combinators.
+
+Parity: python/paddle/reader/decorator.py (cache, map_readers, buffered,
+shuffle, chain, compose, firstn, xmap_readers). A "reader" is a no-arg
+callable returning an iterable of samples; these combinators compose
+them. Kept because classic paddle data pipelines (paddle.batch(
+paddle.reader.shuffle(train(), 500), 32)) still appear in user code; new
+code should use paddle_tpu.io.DataLoader.
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import random
+import threading
+
+__all__ = ["cache", "map_readers", "buffered", "shuffle", "chain",
+           "compose", "firstn", "xmap_readers"]
+
+
+def cache(reader):
+    """Cache all samples in memory on first pass (decorator.py:45)."""
+    all_data = []
+    loaded = [False]
+
+    def new_reader():
+        if not loaded[0]:
+            fresh = list(reader())   # commit only on a complete pass
+            all_data.extend(fresh)
+            loaded[0] = True
+        return iter(all_data)
+    return new_reader
+
+
+def map_readers(func, *readers):
+    """Sample-wise func over zipped readers (decorator.py:84)."""
+    def new_reader():
+        for items in zip(*[r() for r in readers]):
+            yield func(*items)
+    return new_reader
+
+
+def shuffle(reader, buf_size):
+    """Buffered shuffle (decorator.py:125)."""
+    def new_reader():
+        buf = []
+        for s in reader():
+            buf.append(s)
+            if len(buf) >= buf_size:
+                random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            random.shuffle(buf)
+            yield from buf
+    return new_reader
+
+
+def chain(*readers):
+    """Concatenate readers (decorator.py:174)."""
+    def new_reader():
+        return itertools.chain(*[r() for r in readers])
+    return new_reader
+
+
+def compose(*readers, **kwargs):
+    """Zip readers into flat tuples (decorator.py:238).
+    check_alignment=True (default) raises if lengths differ."""
+    check_alignment = kwargs.pop("check_alignment", True)
+    if kwargs:
+        raise TypeError(f"unexpected kwargs {sorted(kwargs)}")
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def new_reader():
+        its = [iter(r()) for r in readers]
+        while True:
+            outs, stops = [], 0
+            for it in its:
+                try:
+                    outs.append(make_tuple(next(it)))
+                except StopIteration:
+                    stops += 1
+            if stops == len(its):
+                return
+            if stops:
+                if check_alignment:
+                    raise RuntimeError(
+                        "compose: readers have different lengths")
+                return
+            yield tuple(itertools.chain(*outs))
+    return new_reader
+
+
+def buffered(reader, size):
+    """Background-thread prefetch buffer (decorator.py buffered).
+    Source errors re-raise in the consumer, not silently truncate."""
+    end = object()
+
+    def new_reader():
+        q = queue.Queue(maxsize=size)
+
+        def feed():
+            try:
+                for s in reader():
+                    q.put(s)
+                q.put(end)
+            except BaseException as e:   # ship the error to the consumer
+                q.put(e)
+
+        t = threading.Thread(target=feed, daemon=True)
+        t.start()
+        while True:
+            s = q.get()
+            if s is end:
+                return
+            if isinstance(s, BaseException):
+                raise s
+            yield s
+    return new_reader
+
+
+def firstn(reader, n):
+    """First n samples (decorator.py firstn)."""
+    def new_reader():
+        return itertools.islice(reader(), n)
+    return new_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size,
+                 order=False):
+    """Parallel map over samples with a thread pool, bounded by
+    buffer_size in-flight items (decorator.py xmap_readers). Results are
+    yielded in submission order (deterministic either way here — the
+    thread pool preserves nothing else worth exposing)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    def new_reader():
+        with ThreadPoolExecutor(process_num) as pool:
+            it = iter(reader())   # ONE pass over the source
+            pending = [pool.submit(mapper, s)
+                       for s in itertools.islice(it, buffer_size)]
+            for s in it:
+                done = pending.pop(0)
+                pending.append(pool.submit(mapper, s))
+                yield done.result()
+            for f in pending:
+                yield f.result()
+    return new_reader

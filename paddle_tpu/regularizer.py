@@ -1,0 +1,6 @@
+"""paddle.regularizer parity (python/paddle/regularizer.py): L1Decay /
+L2Decay — the same objects the optimizer module defines; re-exported
+under the reference's module path."""
+from .optimizer import L1Decay, L2Decay  # noqa: F401
+
+__all__ = ["L1Decay", "L2Decay"]

@@ -1,0 +1,23 @@
+"""paddle.sysconfig parity (python/paddle/sysconfig.py): include/lib dirs
+for building extensions against the framework (here: the C sources under
+native/ consumed by utils.cpp_extension)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include() -> str:
+    """Directory of the native C++ sources (repo checkout layout; falls
+    back to the package dir when installed without them)."""
+    native = os.path.join(os.path.dirname(_ROOT), "native")
+    return native if os.path.isdir(native) else _ROOT
+
+
+def get_lib() -> str:
+    """Directory where utils.cpp_extension caches compiled libraries."""
+    from .utils.cpp_extension import get_build_directory
+    return get_build_directory()

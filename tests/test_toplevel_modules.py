@@ -1,0 +1,73 @@
+"""Top-level module parity: reader combinators, sysconfig, regularizer,
+hub (local source), onnx guidance, dataset namespace."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_reader_combinators():
+    r = paddle.reader
+    base = lambda: iter(range(10))
+    assert list(r.firstn(base, 3)()) == [0, 1, 2]
+    assert list(r.chain(base, base)()) == list(range(10)) * 2
+    assert sorted(r.shuffle(base, 4)()) == list(range(10))
+    assert list(r.map_readers(lambda a, b: a + b, base, base)()) == \
+        [2 * i for i in range(10)]
+    assert list(r.buffered(base, 2)()) == list(range(10))
+    cached = r.cache(base)
+    assert list(cached()) == list(range(10)) == list(cached())
+    composed = r.compose(base, base)
+    assert list(composed())[0] == (0, 0)
+    with pytest.raises(RuntimeError, match="lengths"):
+        list(r.compose(base, lambda: iter(range(3)))())
+    out = sorted(r.xmap_readers(lambda x: x * x, base, 2, 4)())
+    assert out == [i * i for i in range(10)]
+    assert list(r.xmap_readers(lambda x: -x, base, 2, 4, order=True)()) \
+        == [-i for i in range(10)]
+
+
+def test_sysconfig_and_regularizer():
+    inc = paddle.sysconfig.get_include()
+    assert os.path.isdir(inc) and \
+        os.path.exists(os.path.join(inc, "tcp_store.cc"))
+    assert isinstance(paddle.sysconfig.get_lib(), str)
+    from paddle_tpu.optimizer import L2Decay
+    assert paddle.regularizer.L2Decay is L2Decay
+    assert paddle.regularizer.L1Decay(0.1).coeff == 0.1
+
+
+def test_hub_local(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        "def tiny(scale=1):\n"
+        "    'build a tiny thing'\n"
+        "    import paddle_tpu.nn as nn\n"
+        "    return nn.Linear(4 * scale, 2)\n")
+    names = paddle.hub.list(str(tmp_path), source="local")
+    assert "tiny" in names
+    assert "tiny thing" in paddle.hub.help(str(tmp_path), "tiny",
+                                           source="local")
+    m = paddle.hub.load(str(tmp_path), "tiny", source="local", scale=2)
+    assert m.weight.shape == [8, 2]
+    with pytest.raises(RuntimeError, match="network"):
+        paddle.hub.load("user/repo", "tiny")
+
+
+def test_onnx_guidance():
+    import paddle_tpu.nn as nn
+    with pytest.raises(NotImplementedError, match="jit.save"):
+        paddle.onnx.export(nn.Linear(2, 2), "/tmp/x")
+
+
+def test_dataset_namespace(tmp_path):
+    assert paddle.dataset.common.md5file.__name__ == "md5file"
+    p = tmp_path / "f.bin"
+    p.write_bytes(b"hello")
+    import hashlib
+    assert paddle.dataset.common.md5file(str(p)) == \
+        hashlib.md5(b"hello").hexdigest()
+    with pytest.raises(RuntimeError, match="network"):
+        paddle.dataset.common.download("http://x/y.tar", "m", "0" * 32)
+    assert callable(paddle.dataset.mnist.train)
