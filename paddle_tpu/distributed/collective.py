@@ -210,9 +210,9 @@ def _require_single_controller(opname: str):
     if _multiproc():
         raise NotImplementedError(
             f"{opname} is not yet wired for the multi-process world; "
-            "multi-host currently covers all_reduce/all_gather/broadcast/"
-            "barrier — in-program collectives (ParallelTrainStep) cover "
-            "the rest")
+            "multi-host covers all_reduce/all_gather/broadcast/reduce/"
+            "reduce_scatter/alltoall_single/barrier — in-program "
+            "collectives (ParallelTrainStep) cover the rest")
 
 
 @functools.lru_cache(maxsize=256)
@@ -239,6 +239,19 @@ def _collective_program(kind: str, axis: str, mesh, op: str):
         def body(x):
             return lax.all_to_all(x, axis, split_axis=0, concat_axis=0,
                                   tiled=True)
+        out_spec = spec
+    elif kind == "alltoall_single":
+        n_ranks = mesh.shape[axis]
+
+        def body(x):
+            # local row [1, M, ...]: split M into N chunks, chunk j goes
+            # to rank j; received chunks concatenate back to [1, M, ...]
+            v = x[0]
+            k = v.shape[0] // n_ranks
+            vv = v.reshape((n_ranks, k) + v.shape[1:])
+            out = lax.all_to_all(vv, axis, split_axis=0, concat_axis=0,
+                                 tiled=True)
+            return out.reshape(v.shape)[None]
         out_spec = spec
     else:
         raise ValueError(kind)
@@ -307,14 +320,14 @@ def broadcast(tensor, src: int = 0, group=None, sync_op=True):
 def reduce(tensor, dst: int = 0, op=ReduceOp.SUM, group=None, sync_op=True):
     """Slice `dst` gets the reduction; other slices keep their values.
     Parity: paddle.distributed.reduce."""
-    _require_single_controller("reduce")
     group = group or _default_group()
     x = _raw(tensor)
-    mesh, _, n = _stacked_specs(group, x)
-    red = _collective_program("all_reduce", group.axis, mesh, op)(
-        jax.device_put(x, NamedSharding(mesh, P(group.axis))))
-    out = jnp.where(
-        (jnp.arange(n) == dst).reshape((n,) + (1,) * (x.ndim - 1)), red, x)
+    mesh, n = group.mesh, group.nranks
+    stacked = _to_stacked(group, x)
+    red = _collective_program("all_reduce", group.axis, mesh, op)(stacked)
+    out = _to_local(jnp.where(
+        (jnp.arange(n) == dst).reshape((n,) + (1,) * (stacked.ndim - 1)),
+        red, stacked), group)
     if isinstance(tensor, Tensor):
         tensor.value = out
         return tensor
@@ -348,15 +361,23 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
                    group=None, sync_op=True):
     """Input [N, N*K, ...] stacked: rank i gets sum over ranks of block i.
     Parity: paddle.distributed.reduce_scatter; HLO reduce-scatter via
-    lax.psum_scatter."""
-    _require_single_controller("reduce_scatter")
+    lax.psum_scatter. Multi-process: pass this rank's [N*K, ...] tensor;
+    the result is this rank's reduced [K, ...] block."""
     group = group or _default_group()
-    x = _raw(tensor_or_tensor_list) if not isinstance(
-        tensor_or_tensor_list, (list, tuple)) else jnp.stack(
-        [jnp.concatenate([_raw(t) for t in tensor_or_tensor_list])])
-    mesh, _, n = _stacked_specs(group, x)
+    if isinstance(tensor_or_tensor_list, (list, tuple)):
+        if not _multiproc():
+            raise ValueError(
+                "single-controller reduce_scatter takes the stacked "
+                "[N, N*K, ...] array (the list form is per-rank "
+                "semantics, which only exists in the multi-process "
+                "world)")
+        # multi-process: the list is THIS rank's N chunks
+        x = jnp.concatenate([_raw(t) for t in tensor_or_tensor_list])
+    else:
+        x = _raw(tensor_or_tensor_list)
+    mesh = group.mesh
     prog = _collective_program("reduce_scatter", group.axis, mesh, op)
-    out = prog(jax.device_put(x, NamedSharding(mesh, P(group.axis))))
+    out = _to_local(prog(_to_stacked(group, x)), group)
     if isinstance(tensor, Tensor):
         tensor.value = out
         return tensor
@@ -396,12 +417,20 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
             raise NotImplementedError(
                 "alltoall_single with uneven in/out_split_sizes is not "
                 "supported yet; only equal splits are")
-    _require_single_controller("alltoall_single")
     group = group or _default_group()
+    mesh, n = group.mesh, group.nranks
     x = _raw(in_tensor)
-    mesh, _, n = _stacked_specs(group, x)
-    prog = _collective_program("alltoall", group.axis, mesh, ReduceOp.SUM)
-    out = prog(jax.device_put(x, NamedSharding(mesh, P(group.axis))))
+    # the per-rank vector length: multi-process single-row passes [M],
+    # everything else (stacked or [L, M]) carries it in dim 1
+    row_len = x.shape[0] if (_multiproc() and _local_rows(group) == 1) \
+        else x.shape[1]
+    if row_len % n:
+        raise ValueError(
+            f"alltoall_single tensor length {row_len} must be divisible "
+            f"by the group size {n}")
+    prog = _collective_program("alltoall_single", group.axis, mesh,
+                               ReduceOp.SUM)
+    out = _to_local(prog(_to_stacked(group, x)), group)
     if isinstance(out_tensor, Tensor):
         out_tensor.value = out
         return out_tensor

@@ -235,3 +235,26 @@ def test_parallel_step_keeps_model_arrays_alive():
     x = paddle.to_tensor(np.random.randn(8, 4).astype("float32"))
     step(x, x)
     m(x).numpy()   # must not raise "Array has been deleted"
+
+
+def test_alltoall_single_even_split():
+    """Regression: the even-split alltoall_single path (latent shard-size
+    bug — chunk j of rank i's vector must land at position i on rank j,
+    i.e. a block transpose)."""
+    import numpy as np
+    dist.set_mesh(None)
+    dist.init_mesh({"dp": 4})
+    x = np.arange(16, dtype=np.float32).reshape(4, 4)
+    out = dist.alltoall_single(None, paddle.to_tensor(x)).numpy()
+    np.testing.assert_array_equal(out, x.T)
+    # K=2 chunks
+    x2 = np.arange(32, dtype=np.float32).reshape(4, 8)
+    out2 = dist.alltoall_single(None, paddle.to_tensor(x2)).numpy()
+    want = np.stack([np.concatenate([x2[i, 2 * j:2 * j + 2]
+                                     for i in range(4)])
+                     for j in range(4)])
+    np.testing.assert_array_equal(out2, want)
+    with pytest.raises(ValueError, match="divisible"):
+        dist.alltoall_single(None, paddle.to_tensor(
+            np.zeros((4, 6), np.float32)))
+    dist.set_mesh(None)

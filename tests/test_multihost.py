@@ -49,6 +49,26 @@ _WORKER = textwrap.dedent("""
     # real cross-process barrier
     dist.barrier()
 
+    # reduce: only dst rank sees the reduction
+    r = dist.reduce(paddle.to_tensor(
+        np.full((2,), rank + 1.0, np.float32)), dst=1)
+    want_r = 3.0 if rank == 1 else rank + 1.0
+    np.testing.assert_allclose(r.numpy(), want_r)
+
+    # reduce_scatter: my K-block of the summed [N*K] vector
+    rs = dist.reduce_scatter(
+        None, paddle.to_tensor(
+            np.arange(4, dtype=np.float32) + 10 * rank))
+    # rank contributions: [0,1,2,3] and [10,11,12,13] -> sum [10,12,14,16]
+    np.testing.assert_allclose(
+        rs.numpy(), [10.0, 12.0] if rank == 0 else [14.0, 16.0])
+
+    # alltoall_single: chunk j of my vector goes to rank j
+    a2a = dist.alltoall_single(None, paddle.to_tensor(
+        np.array([rank * 10, rank * 10 + 1], np.float32)))
+    np.testing.assert_allclose(
+        a2a.numpy(), [0.0, 10.0] if rank == 0 else [1.0, 11.0])
+
     # unported ops fail loudly, not wrongly
     try:
         dist.scatter(paddle.to_tensor(np.zeros(2, np.float32)))
