@@ -148,15 +148,21 @@ def _stacked_specs(group: Group, x):
     return mesh, P(group.axis), n
 
 
+def _spans(group: Group):
+    """Sorted (start, stop) row spans of the stacked dim this process
+    addresses under P(group.axis)."""
+    sh = NamedSharding(group.mesh, P(group.axis))
+    n = group.nranks
+    return sorted({s[0].indices(n)[:2]
+                   for s in sh.addressable_devices_indices_map(
+                       (n,)).values()})
+
+
 def _local_rows(group: Group) -> int:
     """Rows of the stacked [N, *S] array this process owns under
     P(group.axis) — one per addressable device along the axis (a process
     driving 4 chips of an 8-chip dp axis owns 4 rows)."""
-    sh = NamedSharding(group.mesh, P(group.axis))
-    n = group.nranks
-    spans = {s[0].indices(n)[:2]
-             for s in sh.addressable_devices_indices_map((n,)).values()}
-    return sum(stop - start for start, stop in spans)
+    return sum(stop - start for start, stop in _spans(group))
 
 
 def _to_stacked(group: Group, x):
@@ -206,15 +212,6 @@ def _to_local(out, group: Group):
     return jnp.asarray(arr[0] if _local_rows(group) == 1 else arr)
 
 
-def _require_single_controller(opname: str):
-    if _multiproc():
-        raise NotImplementedError(
-            f"{opname} is not yet wired for the multi-process world; "
-            "multi-host covers all_reduce/all_gather/broadcast/reduce/"
-            "reduce_scatter/alltoall_single/barrier — in-program "
-            "collectives (ParallelTrainStep) cover the rest")
-
-
 @functools.lru_cache(maxsize=256)
 def _collective_program(kind: str, axis: str, mesh, op: str):
     """Build+cache one jitted shard_map mini-program per (op, axis, mesh)."""
@@ -234,11 +231,6 @@ def _collective_program(kind: str, axis: str, mesh, op: str):
             # local shard [1, N*k, ...] -> rank's block [1, k, ...]
             return lax.psum_scatter(x[0], axis, scatter_dimension=0,
                                     tiled=True)[None]
-        out_spec = spec
-    elif kind == "alltoall":
-        def body(x):
-            return lax.all_to_all(x, axis, split_axis=0, concat_axis=0,
-                                  tiled=True)
         out_spec = spec
     elif kind == "alltoall_single":
         n_ranks = mesh.shape[axis]
@@ -294,10 +286,32 @@ def all_gather(tensor_list: Optional[List], tensor, group=None, sync_op=True):
 
 
 def all_gather_object(object_list: List, obj, group=None):
-    """Single-controller: every rank's python object is already here."""
-    _require_single_controller("all_gather_object")
+    """Single-controller: every rank's python object is already here.
+    Multi-process: objects ship as pickled uint8 payloads through two
+    all-gathers (lengths, then max-padded bytes) — the torch-style object
+    collective."""
     group = group or _default_group()
-    object_list.extend([obj] * group.nranks)
+    if not _multiproc():
+        object_list.extend([obj] * group.nranks)
+        return object_list
+    import pickle
+    import numpy as _np
+    payload = _np.frombuffer(pickle.dumps(obj), dtype=_np.uint8)
+    L = _local_rows(group)
+
+    def rows(arr):
+        # one (identical) contribution per local device-rank
+        return _np.tile(arr, (L,) + (1,) * arr.ndim) if L > 1 else arr
+
+    lengths = [int(_np.asarray(t.numpy()).reshape(-1)[0])
+               for t in all_gather(None, Tensor(jnp.asarray(
+                   rows(_np.asarray(len(payload), _np.int32)))))]
+    padded = _np.zeros(max(lengths), _np.uint8)
+    padded[:len(payload)] = payload
+    gathered = all_gather(None, Tensor(jnp.asarray(rows(padded))))
+    for g, ln in zip(gathered, lengths):
+        object_list.append(pickle.loads(
+            _np.asarray(g.numpy()).reshape(-1)[:ln].tobytes()))
     return object_list
 
 
@@ -334,23 +348,34 @@ def reduce(tensor, dst: int = 0, op=ReduceOp.SUM, group=None, sync_op=True):
     return Tensor(out)
 
 
+def _full_to_stacked(group: Group, full):
+    """Shard a full [N, *S] array every process holds identically (SPMD
+    spelling of 'rank src's list') over the group axis."""
+    mesh = group.mesh
+    sh = NamedSharding(mesh, P(group.axis))
+    if not _multiproc():
+        return jax.device_put(full, sh)
+    import numpy as _np
+    fnp = _np.asarray(full)
+    local = _np.concatenate([fnp[a:b] for a, b in _spans(group)])
+    return jax.make_array_from_process_local_data(sh, local, fnp.shape)
+
+
 def scatter(tensor, tensor_list=None, src: int = 0, group=None, sync_op=True):
     """Rank i receives tensor_list[i] (from rank src's list).
     Parity: paddle.distributed.scatter — the output stacked array is simply
     the stacked tensor_list sharded over the axis.
 
-    `src` semantics under a single controller: every rank sees the same
-    tensor_list (there is one process), so whose list is scattered is
-    determined by the caller — `src` is accepted for API parity and does
-    not change the result."""
-    _require_single_controller("scatter")
+    `src` semantics: SPMD callers pass the same tensor_list everywhere
+    (single controller trivially; multi-process by the same-program
+    convention), so whose list is scattered is determined by the caller —
+    `src` is accepted for API parity and does not change the result."""
     group = group or _default_group()
     n = group.nranks
     if tensor_list is None:
         raise ValueError("scatter requires tensor_list on src")
     stack = jnp.stack([_raw(t) for t in tensor_list])
-    mesh = group.mesh
-    out = jax.device_put(stack, NamedSharding(mesh, P(group.axis)))
+    out = _to_local(_full_to_stacked(group, stack), group)
     if isinstance(tensor, Tensor):
         tensor.value = out
         return tensor
@@ -387,21 +412,48 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
 def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     """Rank i sends in_list[j] to rank j. Stacked: global [N(src), N(dst),
     *S] transposes its first two dims via HLO all-to-all.
-    Parity: paddle.distributed.alltoall."""
-    _require_single_controller("alltoall")
+    Parity: paddle.distributed.alltoall. Multi-process: pass THIS rank's
+    list of N chunks; receive this rank's N chunks."""
     group = group or _default_group()
     n = group.nranks
     if isinstance(in_tensor_list, (list, tuple)):
         x = jnp.stack([_raw(t) for t in in_tensor_list])
     else:
         x = _raw(in_tensor_list)
-    # x: [N_src, N_dst, *S] sharded on dim0 -> transpose first two dims
     mesh = group.mesh
-    flat = x.reshape((n * x.shape[1],) + x.shape[2:])
-    prog = _collective_program("alltoall", group.axis, mesh, ReduceOp.SUM)
-    outf = prog(jax.device_put(flat, NamedSharding(mesh, P(group.axis))))
-    out = outf.reshape(x.shape)
-    slices = [Tensor(out[i]) for i in range(n)]
+    prog = _collective_program("alltoall_single", group.axis, mesh,
+                               ReduceOp.SUM)
+    if _multiproc():
+        L = _local_rows(group)
+        if L > 1:
+            if isinstance(in_tensor_list, (list, tuple)):
+                raise ValueError(
+                    f"this process drives {L} device-ranks: pass the "
+                    f"array form [L={L}, N, *S] (one chunk row per "
+                    "local rank), not a single chunk list")
+            # x: [L, N, *S] -> per-row flat [L, N*S0, ...]
+            chunk_shape = x.shape[2:]
+            flat_local = x.reshape((L, n * x.shape[2]) + x.shape[3:]) \
+                if x.ndim > 2 else x.reshape((L, n))
+            out = _to_local(prog(_to_stacked(group, flat_local)), group)
+            out = out.reshape((L, n) + chunk_shape)
+            slices = [Tensor(out[:, i]) for i in range(n)]
+        else:
+            # x: my [N_dst, *S] chunk stack -> flat row [N_dst*S0, ...]
+            chunk_shape = x.shape[1:]
+            flat_local = x.reshape((n * x.shape[1],) + x.shape[2:]) \
+                if x.ndim > 1 else x
+            out = _to_local(prog(_to_stacked(group, flat_local)), group)
+            out = out.reshape((n,) + chunk_shape)
+            slices = [Tensor(out[i]) for i in range(n)]
+    else:
+        # x: [N_src, N_dst, *S] -> rows of [N_dst*S0, ...]
+        flat = x.reshape((n, n * x.shape[2]) + x.shape[3:]) \
+            if x.ndim > 2 else x.reshape((n, n))
+        outf = prog(jax.device_put(flat,
+                                   NamedSharding(mesh, P(group.axis))))
+        out = outf.reshape(x.shape)
+        slices = [Tensor(out[i]) for i in range(n)]
     if out_tensor_list is not None:
         out_tensor_list.extend(slices)
     return slices

@@ -69,13 +69,23 @@ _WORKER = textwrap.dedent("""
     np.testing.assert_allclose(
         a2a.numpy(), [0.0, 10.0] if rank == 0 else [1.0, 11.0])
 
-    # unported ops fail loudly, not wrongly
-    try:
-        dist.scatter(paddle.to_tensor(np.zeros(2, np.float32)))
-    except NotImplementedError:
-        pass
-    else:
-        raise AssertionError("scatter should raise under multi-process")
+    # scatter: SPMD same-list convention; rank i gets list[i]
+    sc = dist.scatter(None, [paddle.to_tensor(
+        np.full((2,), float(i * 100), np.float32)) for i in range(2)])
+    np.testing.assert_allclose(sc.numpy(), rank * 100.0)
+
+    # alltoall (list form): my chunk j goes to rank j
+    outs = dist.alltoall(None, [paddle.to_tensor(
+        np.full((3,), float(rank * 10 + j), np.float32))
+        for j in range(2)])
+    got = [float(o.numpy()[0]) for o in outs]
+    assert got == [0.0 + rank, 10.0 + rank], got
+
+    # all_gather_object: real cross-process python objects
+    objs = []
+    dist.all_gather_object(objs, {"rank": rank, "tag": "x" * (rank + 1)})
+    assert [o["rank"] for o in objs] == [0, 1], objs
+    assert objs[1]["tag"] == "xx"
 
     print("MULTIHOST_OK", rank)
 """)
@@ -110,6 +120,10 @@ _WORKER_MULTIDEV = textwrap.dedent("""
     np.testing.assert_allclose(out.numpy(), 28.0)
     assert out.numpy().shape == (4, 1)
     dist.barrier()
+    # object gather under L=4 local device-ranks
+    objs = []
+    dist.all_gather_object(objs, ("proc", rank))
+    assert len(objs) == 8 and objs.count(("proc", 0)) == 4, objs
     print("MULTIDEV_OK", rank)
 """)
 
