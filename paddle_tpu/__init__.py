@@ -78,6 +78,7 @@ from . import sysconfig  # noqa: E402
 from . import hub  # noqa: E402
 from . import onnx  # noqa: E402
 from . import dataset  # noqa: E402
+from . import version  # noqa: E402
 from . import incubate  # noqa: E402
 from . import utils  # noqa: E402
 from .framework import custom_op  # noqa: E402
@@ -127,4 +128,4 @@ def in_dynamic_mode():
 disable_static = lambda: None  # noqa: E731 — eager is the only mode
 enable_static = lambda: None  # noqa: E731
 
-__version__ = "0.1.0"
+__version__ = version.full_version

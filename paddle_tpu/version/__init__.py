@@ -1,0 +1,70 @@
+"""paddle.version parity (the module setup.py write_version_py generates,
+reference setup.py:430). Fields mirror the generated contract; accelerator
+versions report the TPU runtime instead of CUDA/cuDNN (there is no CUDA
+in a TPU-native build — cuda()/cudnn() return 'False' exactly like a
+CPU-only reference wheel)."""
+from __future__ import annotations
+
+full_version = "0.1.0"
+major = "0"
+minor = "1"
+patch = "0"
+rc = "0"
+cuda_version = "False"
+cudnn_version = "False"
+xpu_version = "False"
+xpu_xccl_version = "False"
+istaged = False
+commit = "Unknown"
+with_mkl = "OFF"
+
+__all__ = ["cuda", "cudnn", "show", "xpu", "xpu_xccl", "tpu"]
+
+
+def show():
+    """Print version info (tagged: versions; untagged: commit id)."""
+    if istaged:
+        print("full_version:", full_version)
+        print("major:", major)
+        print("minor:", minor)
+        print("patch:", patch)
+        print("rc:", rc)
+    else:
+        print("commit:", commit)
+    print("cuda:", cuda_version)
+    print("cudnn:", cudnn_version)
+    print("xpu:", xpu_version)
+    print("xpu_xccl:", xpu_xccl_version)
+    print("tpu:", tpu())
+
+
+def cuda():
+    """CUDA version the package was built with ('False': not a CUDA
+    build)."""
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version
+
+
+def xpu():
+    return xpu_version
+
+
+def xpu_xccl():
+    return xpu_xccl_version
+
+
+def mkl():
+    return with_mkl
+
+
+def tpu():
+    """The TPU runtime (PJRT) platform version — the accelerator this
+    build targets."""
+    try:
+        import jax
+        return jax.__version__
+    except Exception:
+        return "Unknown"

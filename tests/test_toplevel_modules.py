@@ -71,3 +71,13 @@ def test_dataset_namespace(tmp_path):
     with pytest.raises(RuntimeError, match="network"):
         paddle.dataset.common.download("http://x/y.tar", "m", "0" * 32)
     assert callable(paddle.dataset.mnist.train)
+
+
+def test_version_module(capsys):
+    v = paddle.version
+    assert paddle.__version__ == v.full_version == "0.1.0"
+    assert v.cuda() == "False" and v.cudnn() == "False"
+    assert v.tpu() != ""
+    v.show()
+    out = capsys.readouterr().out
+    assert "cuda: False" in out and "tpu:" in out
