@@ -87,6 +87,14 @@ _WORKER = textwrap.dedent("""
     assert [o["rank"] for o in objs] == [0, 1], objs
     assert objs[1]["tag"] == "xx"
 
+    # quantized all-reduce rides the same multi-process adapters
+    from paddle_tpu.distributed.quantized import quantized_all_reduce
+    qx = np.linspace(-1, 1, 512).astype(np.float32) * (rank + 1)
+    q = quantized_all_reduce(paddle.to_tensor(qx.copy()))
+    exact = np.linspace(-1, 1, 512) * 3.0
+    rel = np.abs(q.numpy() - exact).max() / np.abs(exact).max()
+    assert rel < 0.02, rel
+
     print("MULTIHOST_OK", rank)
 """)
 
