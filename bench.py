@@ -1,25 +1,48 @@
 """Driver benchmark: GPT pretraining step throughput on one TPU chip.
 
 Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"} (plus an
-MFU estimate and step time as extra keys).
+MFU estimate and step time as extra keys) on stdout. Staged progress goes
+to stderr so a watcher can tell WHERE a run is stuck:
 
-Metric: GPT-125M-class causal-LM training tokens/sec/chip — the single-chip
-proxy for BASELINE.json's "GPT tokens/sec/chip" target (the reference
-publishes no absolute numbers, BASELINE.json "published": {}; vs_baseline
-is reported against the first recorded value of this same benchmark,
-BENCH_baseline.json, 58693 tok/s from round 1).
+    [bench] stage=probe attempt=1 ...
+    [bench] stage=backend_up device_kind=...
+    [bench] stage=compiling / compiled / measuring / done
+
+Hardening (round 3, after a wedged tunnel blacked out round 2's signal):
+  * backend availability is probed in a SUBPROCESS first, with 3
+    retry attempts of growing budget (120/240/300s + backoff; worst-case
+    ~11.5 min before giving up). A hung/unavailable tunnel produces a
+    fail-fast JSON error record (value 0, "error" key) instead of an
+    indefinite hang. Killing an init-phase probe child is safe; the
+    parent never touches the TPU until a probe succeeds.
+  * a watchdog thread enforces per-stage deadlines in the main process
+    (backend 240s, compile 900s, measure 600s). On expiry it emits the
+    JSON error record and exits, so the driver always gets a parseable
+    line.
+  * the baseline record stores device_kind; a different chip class next
+    round is flagged ("chip_mismatch") instead of silently shifting the
+    ratio.
+
+Metric: GPT-125M-class causal-LM training tokens/sec/chip — the
+single-chip proxy for BASELINE.json's "GPT tokens/sec/chip" target (the
+reference publishes no absolute numbers, BASELINE.json "published": {};
+vs_baseline is reported against the first recorded value of this same
+benchmark, BENCH_baseline.json, 58693 tok/s from round 1).
 
 The whole step (forward, loss, backward, AdamW update, bf16 compute with
-fp32 master weights) is one donated XLA program (jit.TrainStep). Batch 8
-was the measured optimum of the {8,16,32,64} sweep in round 2 (larger
-batches lose ~3% to activation pressure at seq 1024 on 16G HBM).
+fp32 master weights) is one donated XLA program (jit.TrainStep).
 """
 import json
 import os
+import subprocess
 import sys
+import threading
 import time
 
 import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_METRIC = "gpt125m_train_tokens_per_sec_chip"
 
 # bf16 peak FLOP/s per chip by device_kind substring (public specs)
 _PEAK = (("v5 lite", 197e12), ("v5e", 197e12), ("v6 lite", 918e12),
@@ -34,7 +57,93 @@ def _peak_flops(kind: str) -> float:
     return 197e12  # conservative default (v5e-class)
 
 
+def _log(msg: str) -> None:
+    sys.stderr.write("[bench] %s\n" % msg)
+    sys.stderr.flush()
+
+
+def _fail(stage: str, detail: str, code: int = 1) -> None:
+    """Emit a parseable error record on stdout and exit immediately."""
+    sys.stdout.write(json.dumps({
+        "metric": _METRIC, "value": 0, "unit": "tokens/s/chip",
+        "vs_baseline": 0,
+        "error": "%s: %s" % (stage, detail.strip()[-400:]),
+    }) + "\n")
+    sys.stdout.flush()
+    os._exit(code)
+
+
+_PROBE_SRC = (
+    "import jax, sys\n"
+    "d = jax.devices()\n"
+    "p = getattr(d[0], 'platform', '')\n"
+    "if p == 'cpu':\n"  # silent CPU fallback is NOT a live accelerator
+    "    sys.stderr.write('probe resolved to CPU backend, not a TPU')\n"
+    "    sys.exit(3)\n"
+    "sys.stdout.write(getattr(d[0], 'device_kind', 'unknown'))\n"
+)
+
+
+def _probe_backend() -> str:
+    """Check the TPU backend is reachable from a throwaway subprocess.
+
+    Returns device_kind. Three attempts with growing budgets (120/240/
+    300s — healthy device init is seconds, but a cold tunnel's first
+    contact has been observed over a minute). Killing the probe child is
+    safe: it never runs a TPU step, only backend init.
+    """
+    last = ""
+    budgets = (120, 240, 300)
+    for attempt, budget in enumerate(budgets, 1):
+        _log("stage=probe attempt=%d budget=%ds" % (attempt, budget))
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", _PROBE_SRC], capture_output=True,
+                text=True, timeout=budget)
+            if r.returncode == 0 and r.stdout.strip():
+                return r.stdout.strip()
+            last = (r.stderr or "")[-400:] or "rc=%d" % r.returncode
+        except subprocess.TimeoutExpired:
+            last = "probe subprocess hung >%ds (tunnel wedged?)" % budget
+        _log("stage=probe attempt=%d failed: %s" % (attempt, last[-160:]))
+        if attempt < len(budgets):
+            time.sleep(10 * attempt)
+    _fail("backend_unavailable", last)
+    raise AssertionError  # unreachable
+
+
+class _Watchdog:
+    """Per-stage deadline enforcement; emits error JSON on expiry."""
+
+    def __init__(self):
+        self._deadline = time.monotonic() + 240
+        self._stage = "backend_init"
+        t = threading.Thread(target=self._run, daemon=True)
+        t.start()
+
+    def stage(self, name: str, budget_s: float) -> None:
+        self._stage = name
+        self._deadline = time.monotonic() + budget_s
+        _log("stage=%s budget=%ds" % (name, budget_s))
+
+    def disarm(self) -> None:
+        self._deadline = float("inf")
+
+    def _run(self):
+        while True:
+            time.sleep(5)
+            if time.monotonic() > self._deadline:
+                _fail("watchdog_timeout",
+                      "stage '%s' exceeded its budget" % self._stage, 4)
+
+
 def main():
+    on_cpu = os.environ.get("JAX_PLATFORMS", "") == "cpu"
+    if not on_cpu:
+        kind = _probe_backend()
+        _log("stage=probe_ok device_kind=%s" % kind)
+
+    dog = _Watchdog()
     import jax
 
     import paddle_tpu as paddle
@@ -42,9 +151,12 @@ def main():
     from paddle_tpu.jit import TrainStep
     from paddle_tpu.models import GPTConfig, GPTForCausalLM
 
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", "unknown")
+    _log("stage=backend_up device_kind=%s" % kind)
+
     # single-chip friendly config (125M-class, bf16 params)
     seq, batch = 1024, 8
-    on_cpu = os.environ.get("JAX_PLATFORMS", "") == "cpu"
     if on_cpu:  # keep the CPU smoke run quick
         seq, batch = 128, 2
         cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
@@ -64,17 +176,25 @@ def main():
     ids = paddle.to_tensor(
         rng.randint(0, cfg.vocab_size, (batch, seq)).astype("int64"))
 
-    # warmup (compile + 2 steady steps)
-    for _ in range(3):
+    # warmup (compile + 2 steady steps). First axon compile of the full
+    # donated step is 1-3 min; cached recompiles are seconds.
+    dog.stage("compiling", 900)
+    loss = step(ids, ids)
+    float(loss)
+    dog.stage("warmup", 120)
+    for _ in range(2):
         loss = step(ids, ids)
     float(loss)
 
+    dog.stage("measuring", 600)
     iters = 5 if on_cpu else 20
     t0 = time.perf_counter()
     for _ in range(iters):
         loss = step(ids, ids)
     float(loss)  # sync
     dt = time.perf_counter() - t0
+    dog.disarm()
+    _log("stage=measured ms_per_step=%.1f" % (dt / iters * 1e3))
 
     tokens_per_sec = batch * seq * iters / dt
 
@@ -83,45 +203,73 @@ def main():
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     flops_per_token = 6 * n_params + 6 * cfg.num_layers * cfg.hidden_size \
         * seq
-    peak = _peak_flops(getattr(jax.devices()[0], "device_kind", ""))
+    peak = _peak_flops(kind)
     mfu = tokens_per_sec * flops_per_token / peak
 
     if on_cpu:
         # CPU smoke config is not comparable to the chip benchmark
         print(json.dumps({
-            "metric": "gpt125m_train_tokens_per_sec_chip",
+            "metric": _METRIC,
             "value": round(tokens_per_sec, 2),
             "unit": "tokens/s/chip",
             "vs_baseline": 1.0,
         }))
-        return
+        return 0
 
-    prev_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             "BENCH_baseline.json")
-    vs = 1.0
-    try:
-        with open(prev_path) as f:
-            prev = json.load(f)
-        if prev.get("value"):
-            vs = tokens_per_sec / float(prev["value"])
-    except (OSError, ValueError):
+    prev_path = os.path.join(_HERE, "BENCH_baseline.json")
+    vs, base_kind, mismatch = 1.0, None, False
+    if os.path.exists(prev_path):
+        # Never overwrite an existing baseline — a parse error must not
+        # destroy the round-1 anchor (vs_baseline would silently reset).
+        try:
+            with open(prev_path) as f:
+                prev = json.load(f)
+            if prev.get("value"):
+                vs = tokens_per_sec / float(prev["value"])
+            base_kind = prev.get("device_kind")
+            if base_kind is None:
+                # round-1 record predates the device_kind field. It was
+                # measured on the v5e axon tunnel (PALLAS_AXON_TPU_GEN at
+                # the time), so only backfill when the current chip is
+                # v5e-class too — backfilling a DIFFERENT current kind
+                # would mask exactly the mismatch this field exists to
+                # flag. Temp-file + replace so a failure can't truncate.
+                if "v5" in kind.lower():
+                    prev["device_kind"] = base_kind = kind
+                    tmp = prev_path + ".tmp"
+                    with open(tmp, "w") as f:
+                        json.dump(prev, f)
+                    os.replace(tmp, prev_path)
+                else:
+                    base_kind = "unknown (v5e-era record)"
+            mismatch = base_kind != kind
+        except (OSError, ValueError) as e:
+            _log("baseline record unreadable (%s); reporting vs_baseline=1"
+                 % e)
+    else:
         # first run establishes the baseline
         try:
             with open(prev_path, "w") as f:
-                json.dump({"metric": "gpt125m_train_tokens_per_sec_chip",
-                           "value": tokens_per_sec}, f)
+                json.dump({"metric": _METRIC, "value": tokens_per_sec,
+                           "device_kind": kind}, f)
         except OSError:
             pass
 
-    print(json.dumps({
-        "metric": "gpt125m_train_tokens_per_sec_chip",
+    rec = {
+        "metric": _METRIC,
         "value": round(tokens_per_sec, 2),
         "unit": "tokens/s/chip",
         "vs_baseline": round(vs, 4),
         "mfu_pct": round(100 * mfu, 1),
         "ms_per_step": round(dt / iters * 1e3, 1),
         "params": n_params,
-    }))
+        "device_kind": kind,
+    }
+    if mismatch:
+        rec["chip_mismatch"] = True
+        rec["baseline_device_kind"] = base_kind
+    print(json.dumps(rec))
+    return 0
 
 
 if __name__ == "__main__":

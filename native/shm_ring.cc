@@ -167,7 +167,12 @@ int64_t psr_read(void* hv, char** out, double timeout_s) {
       copy_out(h, head, lenb, 8);
       uint64_t len;
       memcpy(&len, lenb, 8);
+      // A message never exceeds what the ring can hold; a larger value
+      // means the header is corrupted — fail instead of malloc'ing a
+      // bogus size and scribbling through NULL. -3 = corrupt/oom.
+      if (len > h->hdr->capacity - 8) return -3;
       char* buf = (char*)malloc(len ? len : 1);
+      if (!buf) return -3;
       copy_out(h, head + 8, buf, len);
       h->hdr->head.store(head + 8 + len, std::memory_order_release);
       *out = buf;

@@ -109,11 +109,41 @@ _next_gid = [1]
 def new_group(ranks: Optional[Sequence[int]] = None, backend=None,
               axis: Optional[str] = None) -> Group:
     """Create a group. TPU-native: groups are mesh axes; `axis` selects one
-    ("dp", "mp", ...). `ranks` is accepted for API parity and must be
-    either None (whole default axis) or a prefix-check of that axis."""
+    ("dp", "mp", ...).
+
+    `ranks` is accepted for API parity (reference
+    python/paddle/distributed/collective.py new_group builds arbitrary
+    sub-rings). Here a group IS a mesh axis, so `ranks` must be None or
+    exactly the full span of the selected axis `[0..axis_size)`; arbitrary
+    subsets have no mesh-axis equivalent and are rejected loudly — carve
+    the mesh with another axis instead (e.g. a ("dp","mp") mesh already
+    gives every row/column as a group)."""
     mesh = mesh_mod.get_mesh()
     if axis is None:
         axis = mesh.axis_names[0]
+    if axis not in mesh.axis_names:
+        raise ValueError(
+            f"new_group(axis={axis!r}): mesh has axes {mesh.axis_names}")
+    if ranks is not None:
+        # Valid rank sets are the rows of the mesh along `axis`: global
+        # (flat) device indices varying along that axis with every other
+        # axis fixed — e.g. mesh {"dp":2,"mp":4} has mp rows [0..3] and
+        # [4..7]. Any such row maps to this Group; anything else has no
+        # mesh-axis equivalent and is rejected loudly.
+        import numpy as _vnp
+        shape = [int(mesh.shape[a]) for a in mesh.axis_names]
+        flat = _vnp.arange(int(_vnp.prod(shape))).reshape(shape)
+        ax_i = list(mesh.axis_names).index(axis)
+        rows = _vnp.moveaxis(flat, ax_i, -1).reshape(-1, shape[ax_i])
+        want = sorted(int(r) for r in ranks)
+        if not any(sorted(row.tolist()) == want for row in rows):
+            raise ValueError(
+                f"new_group(ranks={list(ranks)}) is not a row of mesh axis "
+                f"{axis!r} (valid rows: {rows.tolist()}). TPU-native groups "
+                "are mesh axes; arbitrary rank subsets are not supported — "
+                "define a mesh whose axes carve the devices the way you "
+                "need (paddle.distributed.init_mesh / "
+                "auto_parallel.ProcessMesh) and pass axis=<name>.")
     g = Group(axis, gid=_next_gid[0])
     _next_gid[0] += 1
     _groups[g.id] = g

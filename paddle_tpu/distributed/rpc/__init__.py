@@ -20,6 +20,7 @@ import socket
 import struct
 import threading
 import time
+import warnings
 from collections import namedtuple
 from concurrent.futures import Future, ThreadPoolExecutor
 
@@ -67,9 +68,16 @@ def _recv_frame(conn) -> bytes:
 
 
 class _Server:
-    """Accept-loop + per-request execution on a thread pool."""
+    """Accept-loop + per-request execution on a thread pool.
 
-    def __init__(self, host="0.0.0.0", port=0, request_timeout=300.0):
+    Requests are pickled (fn, args, kwargs) executed as-is, so the agent
+    assumes a TRUSTED cluster network (the reference's brpc agent makes
+    the same assumption). To avoid exposing that surface on every
+    interface, the server binds only the worker's declared IP
+    (PADDLE_WORKER_IP / init_rpc's rendezvous address), never 0.0.0.0.
+    """
+
+    def __init__(self, host="127.0.0.1", port=0, request_timeout=300.0):
         self.request_timeout = request_timeout
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -145,9 +153,17 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
     store = TCPStore(host, int(port), is_master=(rank == 0),
                      world_size=world_size)
 
-    server = _Server()
+    ip = os.environ.get("PADDLE_WORKER_IP", "127.0.0.1")
     try:
-        ip = os.environ.get("PADDLE_WORKER_IP", "127.0.0.1")
+        server = _Server(host=ip)
+    except OSError:
+        # Advertised IP not locally bindable (NAT/alias) — fall back to
+        # all interfaces, as the trusted-network reference agent does.
+        warnings.warn(
+            f"rpc: advertised worker IP {ip!r} is not bindable on this "
+            "host; binding 0.0.0.0 (trusted-network assumption applies)")
+        server = _Server(host="0.0.0.0")
+    try:
         info = WorkerInfo(name, rank, ip, server.port)
         store.set(f"rpc/worker/{rank}", pickle.dumps(info))
 

@@ -127,6 +127,10 @@ class ShmRing:
             return None
         if n == -2:
             raise EOFError("shm ring closed")
+        if n == -3:
+            raise RuntimeError(
+                "shm ring header corrupt or allocation failed "
+                "(length word exceeds ring capacity)")
         try:
             return ctypes.string_at(out, n)
         finally:

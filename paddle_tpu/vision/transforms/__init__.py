@@ -31,14 +31,17 @@ class Compose:
 
 
 class Normalize:
-    def __init__(self, mean, std, data_format="CHW", keys=None, **kw):
+    def __init__(self, mean, std, data_format="CHW", to_rgb=False,
+                 keys=None, **kw):
         self.mean, self.std = mean, std
         self.data_format = data_format
+        self.to_rgb = to_rgb
         self.keys = keys
 
     def _apply_image(self, x):
         from .functional import normalize
-        return normalize(x, self.mean, self.std, self.data_format)
+        return normalize(x, self.mean, self.std, self.data_format,
+                         to_rgb=self.to_rgb)
 
     def __call__(self, x):
         if self.keys is None:

@@ -49,7 +49,11 @@ def to_tensor(pic, data_format: str = "CHW"):
 
 
 def normalize(img, mean, std, data_format: str = "CHW", to_rgb=False):
+    """Reference: python/paddle/vision/transforms/functional.py normalize —
+    to_rgb flips a BGR source to RGB before normalizing (cv2 backend)."""
     arr = _np(img).astype(np.float32)
+    if to_rgb:
+        arr = arr[::-1] if data_format == "CHW" else arr[..., ::-1]
     mean = np.asarray(mean, np.float32)
     std = np.asarray(std, np.float32)
     shape = (-1, 1, 1) if data_format == "CHW" else (1, 1, -1)

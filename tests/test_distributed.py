@@ -59,6 +59,28 @@ def test_all_reduce_max_on_group_axis():
         rtol=1e-6)
 
 
+def test_new_group_ranks_axis_rows_ok():
+    dist.init_mesh({"dp": 2, "mp": 4})
+    g = dist.new_group(ranks=[0, 1, 2, 3], axis="mp")
+    assert g.nranks == 4
+    # second mp row (global ranks) is just as valid
+    g2 = dist.new_group(ranks=[4, 5, 6, 7], axis="mp")
+    assert g2.nranks == 4
+    # dp rows are strided in global rank space
+    g3 = dist.new_group(ranks=[1, 5], axis="dp")
+    assert g3.nranks == 2
+
+
+def test_new_group_rank_subset_rejected():
+    dist.init_mesh({"dp": 2, "mp": 4})
+    with pytest.raises(ValueError, match="mesh ax"):
+        dist.new_group(ranks=[0, 1], axis="mp")
+    with pytest.raises(ValueError, match="mesh ax"):
+        dist.new_group(ranks=[1, 3, 5, 7], axis="mp")
+    with pytest.raises(ValueError, match="mesh has axes"):
+        dist.new_group(axis="pd")
+
+
 def test_all_gather():
     dist.init_mesh({"dp": 8})
     x = _stack(8, (2, 2))

@@ -90,6 +90,17 @@ def test_to_tensor_and_normalize(img):
     np.testing.assert_allclose(n, np.ones((3, 4, 4)) * 1.0)
 
 
+def test_normalize_to_rgb_flips_channels():
+    bgr = np.stack([np.full((2, 2), c, np.float32) for c in (1.0, 2.0, 3.0)])
+    out = T.normalize(bgr, mean=[0.0] * 3, std=[1.0] * 3, to_rgb=True)
+    np.testing.assert_allclose(out[0], 3.0)  # R came from BGR channel 2
+    np.testing.assert_allclose(out[2], 1.0)
+    hwc = bgr.transpose(1, 2, 0)
+    out2 = T.normalize(hwc, mean=[0.0] * 3, std=[1.0] * 3,
+                       data_format="HWC", to_rgb=True)
+    np.testing.assert_allclose(out2[..., 0], 3.0)
+
+
 def test_random_transforms_shapes(img):
     assert T.RandomRotation(30)(img).shape == img.shape
     assert T.RandomAffine(10, translate=(0.1, 0.1), scale=(0.9, 1.1),
