@@ -264,6 +264,9 @@ def main():
         "ms_per_step": round(dt / iters * 1e3, 1),
         "params": n_params,
         "device_kind": kind,
+        # which attention kernel the model actually traced — proof the
+        # Pallas path fired at the bench geometry (VERDICT r2 weak #3)
+        "attention_backend": F.last_attention_dispatch().get("backend"),
     }
     if mismatch:
         rec["chip_mismatch"] = True

@@ -1,0 +1,14 @@
+"""Hand-written Pallas TPU kernels (the repo's analog of the reference's
+hand-written kernel library, paddle/phi/kernels/primitive/ +
+paddle/phi/kernels/gpu/flash_attn_kernel.cu — re-designed for the MXU/VMEM
+model rather than translated).
+
+Kernels here are pure jittable functions; dispatch gates live next to the
+user-facing functionals (e.g. nn/functional/flash_attention.py).
+"""
+from .flash_block import (  # noqa: F401
+    compute_delta, flash_attention_lse, flash_block_attention,
+    flash_block_attention_bwd, merge_lse_blocks)
+
+__all__ = ["flash_block_attention", "flash_block_attention_bwd",
+           "flash_attention_lse", "merge_lse_blocks", "compute_delta"]

@@ -1,0 +1,406 @@
+"""Blockwise flash attention with LSE residuals — custom Pallas TPU kernel.
+
+Reference role: paddle/phi/kernels/gpu/flash_attn_kernel.cu (FlashAttention2
+via dlopen) + the KPS primitives library (paddle/phi/kernels/primitive/).
+TPU-first design: one fused kernel tiles q/k/v onto the MXU with the
+online-softmax recurrence in VMEM scratch, and RETURNS the log-sum-exp
+residuals the library kernel (jax.experimental.pallas.ops.tpu) hides.
+
+The LSE output is what makes ring/blockwise sequence parallelism fuse: each
+sp rank runs this kernel on its local (q, kv-block) pair and the per-block
+partial results merge exactly via
+
+    lse = logaddexp(lse_a, lse_b)
+    out = out_a * exp(lse_a - lse) + out_b * exp(lse_b - lse)
+
+(`merge_lse_blocks`), so the hot inner loop of distributed/
+sequence_parallel.py is a Pallas kernel instead of unfused f32 einsums.
+
+Layout: (B, H, S, D) — batch, heads, sequence, head_dim. Wrappers in
+nn/functional handle paddle's (B, S, H, D).
+
+`q_offset` / `k_offset` are the GLOBAL positions of q[0] / k[0], so causal
+masking is correct when q and k are shards of a longer sequence (ring
+attention rotates k/v; each rotation changes k_offset). They are traced
+f32 scalars (not static) so one compiled kernel serves every ring step.
+
+Backward follows FlashAttention-2: delta = rowsum(dO * O) precomputed in
+XLA, then a k-major kernel accumulates dK/dV and a q-major kernel
+accumulates dQ, both re-materializing p = exp(s - lse) from the residuals.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["flash_block_attention", "flash_block_attention_bwd",
+           "flash_attention_lse", "merge_lse_blocks", "compute_delta"]
+
+_NEG_INF = float("-inf")
+
+
+def _dot(a, b, dims):
+    return lax.dot_general(a, b, dimension_numbers=(dims, ((), ())),
+                           preferred_element_type=jnp.float32)
+
+
+def _causal_mask(qo, ko, iq, ik, bq, bk):
+    q_pos = qo + iq * bq + lax.broadcasted_iota(jnp.float32, (bq, bk), 0)
+    k_pos = ko + ik * bk + lax.broadcasted_iota(jnp.float32, (bq, bk), 1)
+    return q_pos >= k_pos
+
+
+# ---------------------------------------------------------------- forward
+
+def _fwd_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
+                acc_scr, m_scr, l_scr, *, sm_scale, causal, bq, bk):
+    ik, nk = pl.program_id(3), pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    q = q_ref[0, 0].astype(jnp.float32)
+    k = k_ref[0, 0].astype(jnp.float32)
+    s = _dot(q, k, ((1,), (1,))) * sm_scale            # [bq, bk]
+    if causal:
+        iq = pl.program_id(2)
+        mask = _causal_mask(qo_ref[0], ko_ref[0], iq, ik, bq, bk)
+        s = jnp.where(mask, s, _NEG_INF)
+
+    m_prev = m_scr[:]                                   # [bq, 128] bcast
+    l_prev = l_scr[:]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)          # [bq, 1]
+    m_new = jnp.maximum(m_prev, m_cur)                  # bcast [bq, 128]
+    # rows with every position masked keep m=-inf; exp against a SAFE m
+    # avoids inf-inf=nan while still zeroing their probabilities
+    m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    p = jnp.exp(s - m_safe[:, :1])                      # [bq, bk]
+    corr = jnp.exp(m_prev - m_safe)                     # [bq, 128]
+    l_new = l_prev * corr + jnp.broadcast_to(
+        jnp.sum(p, axis=-1, keepdims=True), l_prev.shape)
+    acc_scr[:] = acc_scr[:] * corr[:, :1] + _dot(
+        p, v_ref[0, 0].astype(jnp.float32), ((1,), (0,)))
+    m_scr[:] = m_new
+    l_scr[:] = l_new
+
+    @pl.when(ik == nk - 1)
+    def _():
+        l = l_scr[:, :1]
+        out_ref[0, 0] = (acc_scr[:] / jnp.where(l == 0.0, 1.0, l)
+                         ).astype(out_ref.dtype)
+        lse = jnp.where(l_scr[:] == 0.0, _NEG_INF,
+                        m_scr[:] + jnp.log(jnp.where(l_scr[:] == 0.0, 1.0,
+                                                     l_scr[:])))
+        lse_ref[0, 0] = lse[:, 0]
+
+
+def _fwd(q, k, v, q_off, k_off, causal, sm_scale, bq, bk, interpret):
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    nq, nk = Sq // bq, Sk // bk
+    grid = (B, H, nq, nk)
+
+    def qmap(b, h, iq, ik, *_):
+        return (b, h, iq, 0)
+
+    def kmap(b, h, iq, ik, *_):
+        return (b, h, ik, 0)
+
+    def omap(b, h, iq, ik, *_):
+        return (b, h, iq, 0)
+
+    def lmap(b, h, iq, ik, *_):
+        return (b, h, iq)
+
+    kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale,
+                               causal=causal, bq=bq, bk=bk)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, bq, D), qmap),
+                pl.BlockSpec((1, 1, bk, D), kmap),
+                pl.BlockSpec((1, 1, bk, D), kmap),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, bq, D), omap),
+                pl.BlockSpec((1, 1, bq), lmap),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((bq, D), jnp.float32),
+                pltpu.VMEM((bq, 128), jnp.float32),
+                pltpu.VMEM((bq, 128), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, Sq), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q_off.reshape(1), k_off.reshape(1), q, k, v)
+    return out, lse
+
+
+# --------------------------------------------------------------- backward
+
+def _bwd_dkv_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                    dl_ref, dk_ref, dv_ref, dk_scr, dv_scr, *, sm_scale,
+                    causal, bq, bk):
+    iq, nq = pl.program_id(3), pl.num_programs(3)
+
+    @pl.when(iq == 0)
+    def _():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    q = q_ref[0, 0].astype(jnp.float32)
+    k = k_ref[0, 0].astype(jnp.float32)
+    v = v_ref[0, 0].astype(jnp.float32)
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0]                                 # [bq]
+    delta = dl_ref[0, 0]                                # [bq]
+
+    s = _dot(q, k, ((1,), (1,))) * sm_scale             # [bq, bk]
+    if causal:
+        ik = pl.program_id(2)
+        mask = _causal_mask(qo_ref[0], ko_ref[0], iq, ik, bq, bk)
+        s = jnp.where(mask, s, _NEG_INF)
+    lse_safe = jnp.where(jnp.isneginf(lse), 0.0, lse)[:, None]
+    p = jnp.exp(s - lse_safe)                            # masked -> exp(-inf)=0
+    dv_scr[:] = dv_scr[:] + _dot(p, do, ((0,), (0,)))    # [bk, D]
+    dp = _dot(do, v, ((1,), (1,)))                       # [bq, bk]
+    ds = p * (dp - delta[:, None]) * sm_scale
+    dk_scr[:] = dk_scr[:] + _dot(ds, q, ((0,), (0,)))    # [bk, D]
+
+    @pl.when(iq == nq - 1)
+    def _():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                   dl_ref, dq_ref, dq_scr, *, sm_scale, causal, bq, bk):
+    ik, nk = pl.program_id(3), pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    q = q_ref[0, 0].astype(jnp.float32)
+    k = k_ref[0, 0].astype(jnp.float32)
+    v = v_ref[0, 0].astype(jnp.float32)
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0]
+    delta = dl_ref[0, 0]
+
+    s = _dot(q, k, ((1,), (1,))) * sm_scale
+    if causal:
+        iq = pl.program_id(2)
+        mask = _causal_mask(qo_ref[0], ko_ref[0], iq, ik, bq, bk)
+        s = jnp.where(mask, s, _NEG_INF)
+    lse_safe = jnp.where(jnp.isneginf(lse), 0.0, lse)[:, None]
+    p = jnp.exp(s - lse_safe)
+    dp = _dot(do, v, ((1,), (1,)))
+    ds = p * (dp - delta[:, None]) * sm_scale
+    dq_scr[:] = dq_scr[:] + _dot(ds, k, ((1,), (0,)))    # [bq, D]
+
+    @pl.when(ik == nk - 1)
+    def _():
+        dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def compute_delta(out, do, dlse=None):
+    """FlashAttention-2 delta term: rowsum(dO * O), minus any lse
+    cotangent (d(lse)/ds = p, so dlse folds into delta — see _bwd)."""
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)                             # [B, H, Sq]
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
+    return delta
+
+
+def _bwd(q, k, v, q_off, k_off, out, lse, do, causal, sm_scale, bq, bk,
+         interpret, dlse=None, delta=None):
+    """FlashAttention-2 backward. `delta` folds any lse cotangent: the
+    gradient of lse w.r.t. q/k flows through ds as
+    ds = p * (dp - (delta - dlse)) * scale, since d(lse)/ds = p.
+    Pass a precomputed `delta` when calling per-block in a loop — it
+    depends only on (out, do), which are loop-invariant."""
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    nq, nk = Sq // bq, Sk // bk
+    if delta is None:
+        delta = compute_delta(out, do, dlse)
+
+    def qmap(b, h, i, j, *_):
+        # q-indexed blocks: in dkv the SEQUENTIAL dim (last) walks q
+        return (b, h, j, 0)
+
+    def kmap_dkv(b, h, ik, iq, *_):
+        return (b, h, ik, 0)
+
+    def lmap_dkv(b, h, ik, iq, *_):
+        return (b, h, iq)
+
+    dkv_kernel = functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale,
+                                   causal=causal, bq=bq, bk=bk)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B, H, nk, nq),
+            in_specs=[
+                pl.BlockSpec((1, 1, bq, D), qmap),
+                pl.BlockSpec((1, 1, bk, D), kmap_dkv),
+                pl.BlockSpec((1, 1, bk, D), kmap_dkv),
+                pl.BlockSpec((1, 1, bq, D), qmap),
+                pl.BlockSpec((1, 1, bq), lmap_dkv),
+                pl.BlockSpec((1, 1, bq), lmap_dkv),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, bk, D), kmap_dkv),
+                pl.BlockSpec((1, 1, bk, D), kmap_dkv),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((bk, D), jnp.float32),
+                pltpu.VMEM((bk, D), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Sk, D), k.dtype),
+            jax.ShapeDtypeStruct((B, H, Sk, D), v.dtype),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q_off.reshape(1), k_off.reshape(1), q, k, v, do, lse, delta)
+
+    def qmap_dq(b, h, iq, ik, *_):
+        return (b, h, iq, 0)
+
+    def kmap_dq(b, h, iq, ik, *_):
+        return (b, h, ik, 0)
+
+    def lmap_dq(b, h, iq, ik, *_):
+        return (b, h, iq)
+
+    dq_kernel = functools.partial(_bwd_dq_kernel, sm_scale=sm_scale,
+                                  causal=causal, bq=bq, bk=bk)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B, H, nq, nk),
+            in_specs=[
+                pl.BlockSpec((1, 1, bq, D), qmap_dq),
+                pl.BlockSpec((1, 1, bk, D), kmap_dq),
+                pl.BlockSpec((1, 1, bk, D), kmap_dq),
+                pl.BlockSpec((1, 1, bq, D), qmap_dq),
+                pl.BlockSpec((1, 1, bq), lmap_dq),
+                pl.BlockSpec((1, 1, bq), lmap_dq),
+            ],
+            out_specs=pl.BlockSpec((1, 1, bq, D), qmap_dq),
+            scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q_off.reshape(1), k_off.reshape(1), q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ------------------------------------------------------------ public API
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def flash_block_attention(q, k, v, q_off, k_off, causal=False,
+                          sm_scale=1.0, block_q=128, block_k=128,
+                          interpret=False):
+    """Fused blockwise attention of q against one k/v block.
+
+    q: [B, H, Sq, D]; k/v: [B, H, Sk, D]; q_off/k_off: f32 scalars, the
+    global positions of q[0]/k[0] (causal masking across shards).
+    Returns (out [B, H, Sq, D], lse [B, H, Sq] f32). Rows with every key
+    masked return out=0, lse=-inf (the merge identity).
+    """
+    out, lse = _fwd(q, k, v, jnp.asarray(q_off, jnp.float32),
+                    jnp.asarray(k_off, jnp.float32), causal, sm_scale,
+                    block_q, block_k, interpret)
+    return out, lse
+
+
+def _fba_fwd(q, k, v, q_off, k_off, causal, sm_scale, block_q, block_k,
+             interpret):
+    q_off = jnp.asarray(q_off, jnp.float32)
+    k_off = jnp.asarray(k_off, jnp.float32)
+    out, lse = _fwd(q, k, v, q_off, k_off, causal, sm_scale, block_q,
+                    block_k, interpret)
+    return (out, lse), (q, k, v, q_off, k_off, out, lse)
+
+
+def _fba_bwd(causal, sm_scale, block_q, block_k, interpret, res, grads):
+    q, k, v, q_off, k_off, out, lse = res
+    do, dlse = grads
+    dq, dk, dv = _bwd(q, k, v, q_off, k_off, out, lse, do, causal,
+                      sm_scale, block_q, block_k, interpret, dlse=dlse)
+    zero = jnp.zeros((), jnp.float32)
+    return dq, dk, dv, zero, zero
+
+
+flash_block_attention.defvjp(_fba_fwd, _fba_bwd)
+
+
+def flash_block_attention_bwd(q, k, v, q_off, k_off, out, lse, do,
+                              causal=False, sm_scale=1.0, block_q=128,
+                              block_k=128, interpret=False, delta=None):
+    """Public per-block backward against GLOBAL (out, lse, do) residuals.
+
+    Returns (dq, dk, dv) for this q/kv-block pair. This is the building
+    block of ring-attention backward: each ring step calls it on the
+    currently-held kv block, accumulating dk/dv into rotating buffers.
+    Precompute `delta = compute_delta(out, do)` once outside the loop.
+    """
+    return _bwd(q, k, v, jnp.asarray(q_off, jnp.float32),
+                jnp.asarray(k_off, jnp.float32), out, lse, do, causal,
+                sm_scale, block_q, block_k, interpret, delta=delta)
+
+
+def flash_attention_lse(q, k, v, causal=False, sm_scale=None,
+                        block_q=128, block_k=128, interpret=False):
+    """Full self-attention via the blockwise kernel ((B,H,S,D) layout).
+    Returns (out, lse)."""
+    D = q.shape[-1]
+    if sm_scale is None:
+        sm_scale = 1.0 / (D ** 0.5)
+    zero = jnp.zeros((), jnp.float32)
+    return flash_block_attention(q, k, v, zero, zero, causal, sm_scale,
+                                 block_q, block_k, interpret)
+
+
+def merge_lse_blocks(out_a, lse_a, out_b, lse_b):
+    """Exact merge of two attention partials over disjoint key sets.
+
+    out_*: [..., S, D] f32; lse_*: [..., S] f32 (broadcast over D).
+    Identity element: (0, -inf).
+    """
+    lse = jnp.logaddexp(lse_a, lse_b)
+    lse_safe = jnp.where(jnp.isneginf(lse), 0.0, lse)
+    wa = jnp.exp(lse_a - lse_safe)[..., None]
+    wb = jnp.exp(lse_b - lse_safe)[..., None]
+    return out_a * wa + out_b * wb, lse

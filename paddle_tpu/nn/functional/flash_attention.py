@@ -11,6 +11,9 @@ Layout note: paddle flash_attention uses (batch, seqlen, nheads, head_dim).
 """
 from __future__ import annotations
 
+import os
+import warnings
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -19,7 +22,22 @@ from ...autograd.tape import apply
 from ...core.tensor import Tensor
 
 __all__ = ["flash_attention", "scaled_dot_product_attention",
-           "flash_attn_unpadded", "sdp_kernel"]
+           "flash_attn_unpadded", "sdp_kernel", "last_attention_dispatch"]
+
+# most recent kernel-dispatch decision — observable, never silent
+# (VERDICT r2 weak #3). {"backend": "pallas"|"xla", "reason": str}
+_last_dispatch = {}
+
+
+def last_attention_dispatch() -> dict:
+    """The most recent flash_attention/sdpa dispatch decision. bench.py
+    records this in its JSON so the driver's perf record proves which
+    kernel actually fired."""
+    return dict(_last_dispatch)
+
+
+def _require_pallas() -> bool:
+    return os.environ.get("PADDLE_TPU_REQUIRE_PALLAS", "") not in ("", "0")
 
 
 def _on_tpu():
@@ -29,12 +47,36 @@ def _on_tpu():
         return False
 
 
-def _pallas_ok(q, d, drop):
-    """Dispatch gate for the Pallas TPU kernel: seq long enough to tile,
-    head_dim either under one lane tile (kernel broadcasts l/m over
+def _pallas_geometry_ok(seq: int, d: int, drop: float) -> bool:
+    """Pure geometry gate for the Pallas TPU kernel: seq long enough to
+    tile, head_dim either under one lane tile (kernel broadcasts l/m over
     min(head_dim, 128)) or a multiple of 128, no attention dropout."""
-    return (_on_tpu() and q.shape[1] >= 128 and q.shape[1] % 128 == 0
-            and (d <= 128 or d % 128 == 0) and drop == 0.0)
+    return (seq >= 128 and seq % 128 == 0 and (d <= 128 or d % 128 == 0)
+            and drop == 0.0)
+
+
+def _pallas_ok(q, d, drop):
+    if not _on_tpu():
+        _last_dispatch.update(backend="xla", reason="not on TPU")
+        if _require_pallas():
+            # the flag exists to make "kernel silently not firing"
+            # impossible — a CPU-fallback backend is the worst such case
+            raise RuntimeError(
+                "PADDLE_TPU_REQUIRE_PALLAS is set but the active backend "
+                f"is {jax.default_backend()!r}, not a TPU")
+        return False
+    if not _pallas_geometry_ok(q.shape[1], d, drop):
+        _last_dispatch.update(
+            backend="xla",
+            reason=f"geometry seq={q.shape[1]} d={d} drop={drop}")
+        if _require_pallas():
+            raise RuntimeError(
+                "PADDLE_TPU_REQUIRE_PALLAS is set but the attention "
+                f"geometry (seq={q.shape[1]}, head_dim={d}, "
+                f"dropout={drop}) cannot use the Pallas kernel")
+        return False
+    _last_dispatch.update(backend="pallas", reason="ok")
+    return True
 
 
 def _pallas_flash(q, k, v, causal, scale):
@@ -107,8 +149,16 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
         if _pallas_ok(q, d, drop):
             try:
                 return _pallas_flash(q, k, v, causal, scale)
-            except Exception:
-                pass
+            except Exception as e:
+                # LOUD fallback: round 1's perf bug was this kernel
+                # silently never firing. Re-raise under the flag; warn
+                # + record otherwise.
+                if _require_pallas():
+                    raise
+                _last_dispatch.update(backend="xla",
+                                      reason=f"pallas error: {e!r:.200}")
+                warnings.warn("flash_attention: Pallas kernel failed, "
+                              f"using XLA attention: {e!r}")
         return _xla_attention(q, k, v, None, None, causal, scale, drop, dkey)
 
     out = apply(f, query, key, value, _op_name="flash_attention")
@@ -158,8 +208,13 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
             if _pallas_ok(q, d, drop):
                 try:
                     return _pallas_flash(q, k, v, is_causal, scale)
-                except Exception:
-                    pass
+                except Exception as e:
+                    if _require_pallas():
+                        raise
+                    _last_dispatch.update(
+                        backend="xla", reason=f"pallas error: {e!r:.200}")
+                    warnings.warn("sdpa: Pallas kernel failed, using XLA "
+                                  f"attention: {e!r}")
             return _xla_attention(q, k, v, None, None, is_causal, scale,
                                   drop, dkey)
         return apply(f, query, key, value, _op_name="sdpa")
