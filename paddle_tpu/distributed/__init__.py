@@ -8,8 +8,9 @@ mini-programs, and parallelism expressed as shardings compiled by GSPMD
 (ParallelTrainStep).
 """
 from . import fleet  # noqa: F401
-from .collective import (Group, ReduceOp, all_gather, all_gather_object,
-                         all_reduce, alltoall, alltoall_single, barrier,
+from .collective import (Group, P2POp, ReduceOp, Work, all_gather,
+                         all_gather_object, all_reduce, alltoall,
+                         alltoall_single, barrier, batch_isend_irecv,
                          broadcast, get_group, irecv, isend, new_group,
                          recv, reduce, reduce_scatter, scatter, send,
                          stream)
@@ -50,7 +51,8 @@ __all__ = [
     "ReduceOp", "Group", "new_group", "get_group", "all_reduce",
     "all_gather", "all_gather_object", "broadcast", "reduce", "scatter",
     "reduce_scatter", "alltoall", "alltoall_single", "barrier", "send",
-    "recv", "isend", "irecv", "stream",
+    "recv", "isend", "irecv", "batch_isend_irecv", "P2POp", "Work",
+    "stream",
     "DistributedStrategy", "CommunicateTopology", "HybridCommunicateGroup",
     "get_hybrid_communicate_group", "set_hybrid_communicate_group",
     "ParallelTrainStep", "param_sharding", "shard_params", "fleet",

@@ -33,7 +33,8 @@ from . import mesh as mesh_mod
 __all__ = ["ReduceOp", "Group", "new_group", "get_group", "all_reduce",
            "all_gather", "all_gather_object", "broadcast", "reduce",
            "scatter", "reduce_scatter", "alltoall", "alltoall_single",
-           "barrier", "send", "recv", "isend", "irecv", "stream"]
+           "barrier", "send", "recv", "isend", "irecv",
+           "batch_isend_irecv", "P2POp", "Work", "stream"]
 
 
 class ReduceOp:
@@ -532,14 +533,201 @@ def barrier(group=None):
     (jax.device_put(jnp.zeros(()))).block_until_ready()
 
 
+def _np_host(x):
+    import numpy as _np
+    return _np.asarray(x)
+
+
+class Work:
+    """Handle returned by isend/irecv/batch_isend_irecv. XLA dispatch is
+    asynchronous, so the transfer is already in flight; wait() blocks
+    until the result (if any) is materialized.
+    Parity: paddle.distributed.communication.group.Task."""
+
+    def __init__(self, arrays=(), on_done=None):
+        self._arrays = tuple(arrays)
+        self._on_done = on_done
+        self._done = False
+
+    def wait(self):
+        for a in self._arrays:
+            a.block_until_ready()
+        if self._on_done is not None:
+            self._on_done()
+            self._on_done = None
+        self._done = True
+
+    def is_completed(self) -> bool:
+        if not self._done and all(a.is_ready() for a in self._arrays):
+            self.wait()
+        return self._done
+
+
+class P2POp:
+    """One send/recv of a batch. Parity:
+    python/paddle/distributed/communication/batch_isend_irecv.py P2POp."""
+
+    def __init__(self, op, tensor, peer, group=None):
+        if op not in (send, recv, isend, irecv):
+            raise ValueError(
+                "P2POp op must be paddle.distributed.(i)send or (i)recv")
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def _proc_device(proc: int):
+    for d in jax.devices():
+        if d.process_index == proc:
+            return d
+    raise ValueError(f"no device for process {proc}")
+
+
+@functools.lru_cache(maxsize=128)
+def _p2p_program(src: int, dst: int, shape, dtype):
+    """Jitted collective_permute mini-program over a 2-device mesh holding
+    one device of each participating process. Only the two processes call
+    it (multi-host computations run on the submesh's owners). The TPU
+    analog of the reference's ProcessGroupNCCL::Send/Recv
+    (paddle/fluid/distributed/collective/process_group_nccl.cc) /
+    send_v2_op.cc."""
+    import numpy as _np
+    mesh2 = jax.sharding.Mesh(
+        _np.array([_proc_device(src), _proc_device(dst)]), ("p2p",))
+
+    def body(x):
+        return lax.ppermute(x, "p2p", [(0, 1)])
+
+    fn = jax.shard_map(body, mesh=mesh2, in_specs=(P("p2p"),),
+                       out_specs=P("p2p"))
+    sh = NamedSharding(mesh2, P("p2p"))
+    return jax.jit(fn), sh
+
+
+def _p2p_transfer(payload, shape, dtype, src: int, dst: int):
+    """Run one src->dst transfer. Called by BOTH participating processes
+    (payload on src, None on dst). Returns the jax result array; the
+    receiver's row carries the data."""
+    import numpy as _np
+    me = jax.process_index()
+    if src == dst:
+        raise ValueError("send/recv peer must be a different rank")
+    if me not in (src, dst):
+        raise RuntimeError(
+            f"process {me} is not a participant of this {src}->{dst} "
+            "p2p transfer; only the two peer ranks may call send/recv")
+    prog, sh = _p2p_program(src, dst, tuple(shape), _np.dtype(dtype).name)
+    row = (_np.zeros(shape, dtype) if payload is None
+           else _np.asarray(payload, dtype))
+    stacked = jax.make_array_from_process_local_data(
+        sh, row[None], (2,) + tuple(shape))
+    return prog(stacked)
+
+
+def _p2p_guard(group):
+    if not _multiproc():
+        raise NotImplementedError(
+            "point-to-point send/recv between ranks has no eager analog "
+            "under a single controller; use ppermute inside compiled "
+            "programs (paddle_tpu.distributed.pipeline). In a launcher-"
+            "formed multi-process world these ARE supported.")
+    group = group or _default_group()
+    if _local_rows(group) != 1:
+        raise NotImplementedError(
+            "eager send/recv requires one device-rank per process; this "
+            "process drives several — address peers with in-program "
+            "collectives instead")
+    return group
+
+
+def _group_rank_to_proc(group: Group, rank: int) -> int:
+    """Translate a group rank to the jax process index that owns the
+    device at that position of the group's mesh axis (the reference
+    translates via group.get_group_rank, collective.py:185). Mesh axis
+    order need not equal process-index order."""
+    import numpy as _np
+    mesh = group.mesh
+    ax_i = list(mesh.axis_names).index(group.axis)
+    devs = _np.moveaxis(mesh.devices, ax_i, 0)
+    if not 0 <= rank < devs.shape[0]:
+        raise ValueError(f"peer rank {rank} out of range for axis "
+                         f"{group.axis!r} of size {devs.shape[0]}")
+    procs = {d.process_index for d in _np.atleast_1d(devs[rank]).ravel()}
+    if len(procs) != 1:
+        raise NotImplementedError(
+            f"group axis {group.axis!r} position {rank} spans several "
+            "processes; eager p2p needs a one-process-per-rank axis")
+    return procs.pop()
+
+
 def send(tensor, dst=0, group=None, sync_op=True):
-    raise NotImplementedError(
-        "point-to-point send/recv between ranks has no eager analog under a "
-        "single controller; use ppermute inside compiled programs "
-        "(paddle_tpu.distributed.pipeline) or DCN RPC (future work)")
+    """Send this rank's tensor to group rank `dst` (which must call recv).
+    Parity: python/paddle/distributed/communication/send.py."""
+    group = _p2p_guard(group)
+    x = _np_host(_raw(tensor))
+    out = _p2p_transfer(x, x.shape, x.dtype, jax.process_index(),
+                        _group_rank_to_proc(group, dst))
+    w = Work((out,))
+    if sync_op:
+        w.wait()
+        return None
+    return w
 
 
-recv = isend = irecv = send
+def recv(tensor, src=0, group=None, sync_op=True):
+    """Receive into `tensor` from group rank `src` (which must call send).
+    Fills a Tensor or numpy buffer in place; always returns the received
+    Tensor on the sync path (module convention — raw-array callers get
+    the result, never a silent drop).
+    Parity: python/paddle/distributed/communication/recv.py."""
+    group = _p2p_guard(group)
+    x = _raw(tensor)
+    out = _p2p_transfer(None, x.shape, x.dtype,
+                        _group_rank_to_proc(group, src),
+                        jax.process_index())
+    result = {}
+
+    def fill():
+        row = _np_host(out.addressable_shards[0].data)[0]
+        result["t"] = Tensor(jnp.asarray(row))
+        if isinstance(tensor, Tensor):
+            tensor.value = result["t"].value
+        else:
+            import numpy as _np
+            if isinstance(tensor, _np.ndarray):
+                _np.copyto(tensor, row)
+    w = Work((out,), on_done=fill)
+    if sync_op:
+        w.wait()
+        return tensor if isinstance(tensor, Tensor) else result["t"]
+    return w
+
+
+def isend(tensor, dst=0, group=None):
+    return send(tensor, dst=dst, group=group, sync_op=False)
+
+
+def irecv(tensor, src=0, group=None):
+    return recv(tensor, src=src, group=group, sync_op=False)
+
+
+def batch_isend_irecv(p2p_op_list):
+    """Start every transfer in the list; return their Works.
+    Both peers must list their common transfers in the same order (the
+    reference's NCCL groupStart/groupEnd contract,
+    batch_isend_irecv.py:27)."""
+    if not p2p_op_list:
+        return []
+    works = []
+    for op in p2p_op_list:
+        if op.op in (send, isend):
+            works.append(send(op.tensor, dst=op.peer, group=op.group,
+                              sync_op=False))
+        else:
+            works.append(recv(op.tensor, src=op.peer, group=op.group,
+                              sync_op=False))
+    return works
 
 
 class stream:

@@ -81,6 +81,14 @@ def test_new_group_rank_subset_rejected():
         dist.new_group(axis="pd")
 
 
+def test_p2p_raises_under_single_controller():
+    dist.init_mesh({"dp": 8})
+    t = paddle.to_tensor(np.zeros(4, "float32"))
+    for fn in (dist.send, dist.recv, dist.isend, dist.irecv):
+        with pytest.raises(NotImplementedError, match="multi-process"):
+            fn(t, 1)
+
+
 def test_all_gather():
     dist.init_mesh({"dp": 8})
     x = _stack(8, (2, 2))

@@ -105,6 +105,71 @@ def _free_port():
         return s.getsockname()[1]
 
 
+_WORKER_P2P = textwrap.dedent("""
+    import sys
+    import numpy as np
+    rank = int(sys.argv[1]); port = sys.argv[2]
+    import os
+    os.environ["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+    os.environ["JAX_NUM_PROCESSES"] = "2"
+    os.environ["JAX_PROCESS_ID"] = str(rank)
+
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+
+    env = dist.init_parallel_env({"dp": 2})
+
+    # blocking round-trip: 0 -> 1 then 1 -> 0
+    # (reference contract: communication/send.py + recv.py)
+    if rank == 0:
+        dist.send(paddle.to_tensor(np.arange(6, dtype=np.float32)), dst=1)
+        back = paddle.to_tensor(np.zeros(6, np.float32))
+        dist.recv(back, src=1)
+        np.testing.assert_allclose(back.numpy(), np.arange(6) * 2.0)
+    else:
+        buf = paddle.to_tensor(np.zeros(6, np.float32))
+        dist.recv(buf, src=0)
+        np.testing.assert_allclose(buf.numpy(), np.arange(6))
+        dist.send(paddle.to_tensor(buf.numpy() * 2.0), dst=0)
+
+    # async isend/irecv with Work handles
+    if rank == 0:
+        w = dist.isend(paddle.to_tensor(np.full((3,), 7.0, np.float32)),
+                       dst=1)
+        w.wait()
+    else:
+        buf = paddle.to_tensor(np.zeros(3, np.float32))
+        w = dist.irecv(buf, src=0)
+        w.wait()
+        assert w.is_completed()
+        np.testing.assert_allclose(buf.numpy(), 7.0)
+
+    # pp-style microbatch exchange via batch_isend_irecv: each step rank0
+    # feeds activations forward, rank1 returns grads (both directions in
+    # one batch; reference batch_isend_irecv.py:27)
+    for mb in range(3):
+        if rank == 0:
+            acts = paddle.to_tensor(
+                np.full((2, 4), float(mb), np.float32))
+            gbuf = paddle.to_tensor(np.zeros((2, 4), np.float32))
+            ops = [dist.P2POp(dist.isend, acts, 1),
+                   dist.P2POp(dist.irecv, gbuf, 1)]
+            for w in dist.batch_isend_irecv(ops): w.wait()
+            np.testing.assert_allclose(gbuf.numpy(), mb * 10.0)
+        else:
+            abuf = paddle.to_tensor(np.zeros((2, 4), np.float32))
+            ops = [dist.P2POp(dist.irecv, abuf, 0)]
+            for w in dist.batch_isend_irecv(ops): w.wait()
+            np.testing.assert_allclose(abuf.numpy(), float(mb))
+            grads = paddle.to_tensor(abuf.numpy() * 10.0)
+            for w in dist.batch_isend_irecv(
+                    [dist.P2POp(dist.isend, grads, 0)]): w.wait()
+
+    dist.barrier()
+    print("P2P_OK", rank)
+""")
+
+
 _WORKER_MULTIDEV = textwrap.dedent("""
     import sys
     import numpy as np
@@ -163,6 +228,10 @@ def _run_pair(worker, tag, devices_per_proc):
 
 def test_two_process_world_collectives():
     _run_pair(_WORKER, "MULTIHOST_OK", devices_per_proc=1)
+
+
+def test_two_process_p2p_send_recv():
+    _run_pair(_WORKER_P2P, "P2P_OK", devices_per_proc=1)
 
 
 def test_two_process_multidevice_rows():
