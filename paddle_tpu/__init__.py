@@ -23,37 +23,21 @@ _mijd()
 
 from .framework import flags as _flags
 
-def _host_fingerprint() -> str:
-    """Short id of this host's CPU feature set. XLA:CPU AOT artifacts are
-    machine-feature specific — reloading one compiled on a different host
-    warns "could lead to SIGILL" (cpu_aot_loader). Keying the cache dir by
-    the feature set makes a foreign cache invisible instead of a hazard."""
-    import hashlib as _hl
-    import platform as _pf
-    feats = ""
-    try:
-        with open("/proc/cpuinfo") as _f:
-            for _line in _f:
-                if _line.startswith(("flags", "Features")):
-                    feats = _line
-                    break
-    except OSError:
-        pass
-    raw = f"{_pf.machine()}|{feats}".encode()
-    return _hl.sha256(raw).hexdigest()[:12]
-
-
-if _flags.flag_value("use_persistent_compilation_cache"):
+# XLA:CPU AOT artifacts are machine-feature sensitive: reloading one in a
+# process whose feature probe differs (different host, or multi-device CPU
+# programs compiled with prefer-no-scatter/gather pseudo-features that
+# never appear in the host probe) logs "could lead to SIGILL"
+# (cpu_aot_loader) and genuinely can crash across hosts. CPU compiles are
+# fast; the cache's value is the TPU's minutes-long compiles — so the
+# persistent cache is skipped only when the platform explicitly names
+# cpu. Unset JAX_PLATFORMS keeps the cache: that is the normal TPU
+# deployment (jax auto-detects the chip), exactly the case the cache
+# exists to amortize.
+_plat = _os.environ.get("JAX_PLATFORMS", "").lower()
+if _flags.flag_value("use_persistent_compilation_cache") and \
+        "cpu" not in _plat:
     try:
         _cache_dir = _flags.flag_value("compilation_cache_dir")
-        # Only XLA:CPU artifacts are machine-feature sensitive (SIGILL on
-        # reload across hosts); TPU programs are keyed by the chip. Skip
-        # the fingerprint subdir only when the platform explicitly names
-        # an accelerator — an unset JAX_PLATFORMS may silently fall back
-        # to CPU, so it gets the fingerprint too (safe either way).
-        _plat = _os.environ.get("JAX_PLATFORMS", "").lower()
-        if not _plat or "cpu" in _plat:
-            _cache_dir = _os.path.join(_cache_dir, _host_fingerprint())
         _os.makedirs(_cache_dir, exist_ok=True)
         _jax.config.update("jax_compilation_cache_dir", _cache_dir)
         _jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

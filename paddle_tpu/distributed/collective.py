@@ -490,17 +490,82 @@ def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     return slices
 
 
+def _alltoall_single_uneven(out_tensor, in_tensor, in_splits, out_splits,
+                            group):
+    """Uneven-split all-to-all (reference: global_scatter/global_gather
+    semantics, paddle/fluid/operators/collective/global_scatter_op.cc —
+    variable per-expert token counts).
+
+    Padded emulation: every chunk is padded to a GLOBAL max chunk size
+    (agreed via one eager MAX all-reduce, the size-exchange round NCCL
+    uneven a2a implementations also need), moved with the even program,
+    then sliced back to the receiver's out_split_sizes."""
+    if not _multiproc():
+        raise NotImplementedError(
+            "uneven alltoall_single needs the per-rank (multi-process) "
+            "world: a single-controller stacked array cannot hold ragged "
+            "per-rank rows. Under a launcher-formed world pass THIS "
+            "rank's tensor + its in/out_split_sizes.")
+    if _local_rows(group) != 1:
+        raise NotImplementedError(
+            "uneven alltoall_single requires one device-rank per process")
+    n = group.nranks
+    if len(in_splits) != n or len(out_splits) != n:
+        raise ValueError(
+            f"split size lists must have one entry per rank ({n}); got "
+            f"in={len(in_splits)}, out={len(out_splits)}")
+    import numpy as _np
+    x = _np_host(_raw(in_tensor))
+    if x.shape[0] != sum(in_splits):
+        raise ValueError(
+            f"input length {x.shape[0]} != sum(in_split_sizes) "
+            f"{sum(in_splits)}")
+    local_max = max(list(in_splits) + list(out_splits) + [1])
+    smax = int(_np_host(all_reduce(
+        Tensor(jnp.asarray([local_max], jnp.int32)), op=ReduceOp.MAX,
+        group=group).value)[0])
+    rest = x.shape[1:]
+    padded = _np.zeros((n, smax) + rest, x.dtype)
+    off = 0
+    for j, s in enumerate(in_splits):
+        padded[j, :s] = x[off:off + s]
+        off += s
+    moved = alltoall(None, [Tensor(jnp.asarray(padded[j]))
+                            for j in range(n)], group=group)
+    out = jnp.concatenate(
+        [moved[r].value[:out_splits[r]] for r in range(n)], axis=0)
+    if isinstance(out_tensor, Tensor):
+        out_tensor.value = out
+        return out_tensor
+    return Tensor(out)
+
+
 def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
                     out_split_sizes=None, group=None, sync_op=True):
-    for sizes in (in_split_sizes, out_split_sizes):
-        # explicitly even splits are fine (common parity callers pass
-        # them); genuinely uneven splits would be silently mis-split, so
-        # refuse those until ragged all-to-all lands
-        if sizes is not None and len(set(sizes)) > 1:
-            raise NotImplementedError(
-                "alltoall_single with uneven in/out_split_sizes is not "
-                "supported yet; only equal splits are")
     group = group or _default_group()
+    uneven = any(sizes is not None and len(set(int(s) for s in sizes)) > 1
+                 for sizes in (in_split_sizes, out_split_sizes))
+    if uneven and (in_split_sizes is None or out_split_sizes is None):
+        raise ValueError(
+            "uneven alltoall_single needs BOTH in_split_sizes and "
+            "out_split_sizes (each rank must know what it receives)")
+    if _multiproc() and in_split_sizes is not None \
+            and out_split_sizes is not None:
+        # The ragged path is taken whenever split lists are passed — NOT
+        # only when this rank's own lists are uneven: peers may have
+        # uneven lists while ours happens to be uniform, and the branch
+        # must be symmetric across ranks or they would issue mismatched
+        # collective programs (different shapes + an extra size-exchange
+        # all-reduce) and hang. With uniform sizes the padded path is
+        # exact, just one all-reduce slower.
+        return _alltoall_single_uneven(
+            out_tensor, in_tensor, [int(s) for s in in_split_sizes],
+            [int(s) for s in out_split_sizes], group)
+    if uneven:
+        return _alltoall_single_uneven(   # single-controller: raises with
+            out_tensor, in_tensor,        # multi-process guidance
+            [int(s) for s in in_split_sizes],
+            [int(s) for s in out_split_sizes], group)
     mesh, n = group.mesh, group.nranks
     x = _raw(in_tensor)
     # the per-rank vector length: multi-process single-row passes [M],

@@ -53,18 +53,21 @@ __all__ = ["pipeline_apply", "PipelineParallel"]
 
 
 def _apply_block(template: Layer, params: Dict[str, jax.Array], h):
-    # Open a local aux-loss scope: values reported here (e.g. MoE balance
-    # loss) are lax.scan-body tracers that must not escape to the training
-    # engine's outer scope — they would be invalid there
-    # (UnexpectedTracerError). Known limitation: aux losses inside a
-    # pipelined body are dropped; put MoE blocks in a non-pipelined model
-    # (GPTForCausalLM use_moe) to train with load balancing.
-    from ...framework.aux_loss import aux_loss_scope
-    with aux_loss_scope():
+    """Run one body block. Returns (out, aux) where aux is the f32 sum of
+    aux losses (e.g. MoE balance loss) the block reported.
+
+    A local aux-loss scope is opened because scan-body tracers must not
+    escape to the training engine's outer scope (UnexpectedTracerError);
+    instead of dropping them (the r2 limitation), the scalar total is
+    threaded through the scan carry and returned from the pipeline
+    program, so MoE+PP trains WITH load balancing — the composition the
+    reference supports via moe_layer.py:261 under hybrid topology."""
+    from ...framework.aux_loss import aux_loss_scope, total
+    with aux_loss_scope() as bucket:
         out, _ = functional_call(template, params, {}, Tensor(h))
     if isinstance(out, (tuple, list)):
         out = out[0]
-    return out
+    return out, jnp.asarray(total(bucket), jnp.float32)
 
 
 def interleave_perm(num_blocks: int, num_stages: int, interleave: int):
@@ -129,17 +132,20 @@ def pipeline_apply(template: Layer, stacked: Dict[str, "Tensor"], x,
                               for n, a in params.items()}
 
                 def step(carry, bparams):
+                    c, aux = carry
                     body = lambda bp, c: _apply_block(template, bp, c)
                     if recompute:
                         body = jax.checkpoint(body)
-                    return body(bparams, carry), None
+                    out, a = body(bparams, c)
+                    return (out, aux + a), None
 
-                out, _ = lax.scan(step, h, params)
-                return out
+                (out, aux), _ = lax.scan(
+                    step, (h, jnp.zeros((), jnp.float32)), params)
+                return out, aux
 
             cache[key] = fn
-        return _tape.apply(fn, *[stacked[n] for n in names], x,
-                           _op_name="pipeline_scan")
+        return _finish(_tape.apply(fn, *[stacked[n] for n in names], x,
+                                   _op_name="pipeline_scan"), template)
 
     M = num_micro or pp
     if L % (pp * v):
@@ -150,8 +156,8 @@ def pipeline_apply(template: Layer, stacked: Dict[str, "Tensor"], x,
     cache_key = (mesh, tuple(names), pp, M, v, bool(recompute))
     cached = cache.get(cache_key)
     if cached is not None:
-        return _tape.apply(cached, *[stacked[n] for n in names], x,
-                           _op_name="pipeline")
+        return _finish(_tape.apply(cached, *[stacked[n] for n in names], x,
+                                   _op_name="pipeline"), template)
 
     def fn(*flat):
         params = dict(zip(names, flat[:-1]))
@@ -163,10 +169,13 @@ def pipeline_apply(template: Layer, stacked: Dict[str, "Tensor"], x,
         x_mb = h.reshape((M, mb) + h.shape[1:])
 
         def chunk_apply(chunk_params, inp):
-            def step(c, bp):
-                return _apply_block(template, bp, c), None
-            out, _ = lax.scan(step, inp, chunk_params)
-            return out
+            def step(carry, bp):
+                c, aux = carry
+                out, a = _apply_block(template, bp, c)
+                return (out, aux + a), None
+            (out, aux), _ = lax.scan(
+                step, (inp, jnp.zeros((), jnp.float32)), chunk_params)
+            return out, aux
 
         if recompute:
             chunk_apply = jax.checkpoint(chunk_apply)
@@ -174,57 +183,87 @@ def pipeline_apply(template: Layer, stacked: Dict[str, "Tensor"], x,
         def one_pass(local_chunk, xs, idx):
             """Fill-drain ring over M microbatches for one chunk round.
             xs: [M, mb, ...] input buffer (read by stage 0 only).
-            Returns [M, mb, ...] outputs, valid on the last stage."""
+            Returns ([M, mb, ...] outputs — valid on the last stage —,
+            this stage's aux-loss total over its VALID ticks)."""
             T = M + pp - 1
             state0 = jnp.zeros_like(xs[0])
 
-            def tick(state, t):
+            def tick(carry, t):
+                state, aux = carry
                 # stage 0 ingests microbatch t; others take the rotated
                 # activation (role of recv_forward, p2p_communication.py)
                 inp = jnp.where(idx == 0, xs[jnp.clip(t, 0, M - 1)], state)
-                out = chunk_apply(local_chunk, inp)
+                out, a = chunk_apply(local_chunk, inp)
+                # ramp-up/drain ticks process filler zeros; mask their aux
+                # (stage idx holds microbatch t-idx, valid iff 0<=t-idx<M)
+                valid = (t >= idx) & (t < idx + M)
+                aux = aux + jnp.where(valid, a, 0.0)
                 # rotate the ring (role of send_forward/recv_forward)
                 nxt = lax.ppermute(out, "pp",
                                    [(i, (i + 1) % pp) for i in range(pp)])
-                return nxt, out
+                return (nxt, aux), out
 
-            _, ys = lax.scan(tick, state0, jnp.arange(T))
+            (_, aux), ys = lax.scan(tick, (state0, jnp.zeros((), jnp.float32)),
+                                    jnp.arange(T))
             # the last stage finishes microbatch m at tick m + pp - 1
-            return ys[pp - 1:]
+            return ys[pp - 1:], aux
 
         def stage_fn(local_params, xs):
             idx = lax.axis_index("pp")
             buf = xs
+            aux = jnp.zeros((), jnp.float32)
             for r in range(v):  # interleave: one ring pass per chunk round
                 chunk = {n: a[r * per_chunk:(r + 1) * per_chunk]
                          for n, a in local_params.items()}
-                buf = one_pass(chunk, buf, idx)
+                buf, a = one_pass(chunk, buf, idx)
+                aux = aux + a
                 if r < v - 1:
                     # pass outputs hop last-stage -> stage 0 (single link)
                     buf = lax.ppermute(buf, "pp", [(pp - 1, 0)])
+            # every stage contributed its own blocks' aux: total them
+            aux = lax.psum(aux, "pp")
             # expose only the last stage's (valid) buffer: out spec "pp"
             # makes the caller's slice of shard pp-1 the result — no
             # zero-fill + psum broadcast
-            return buf[None]
+            return buf[None], aux
 
         smapped = jax.shard_map(
             stage_fn,
             mesh=mesh_mod.get_mesh(),
             in_specs=(jax.tree_util.tree_map(lambda _: P("pp"), params),
                       P()),
-            out_specs=P("pp"),
+            out_specs=(P("pp"), P()),
             axis_names={"pp"},
             check_vma=False)
-        out_all = smapped(params, x_mb)      # [pp, M, mb, ...]
-        out_mb = out_all[pp - 1]             # last stage's buffer
-        return out_mb.reshape((B,) + out_mb.shape[2:])
+        out_all, aux = smapped(params, x_mb)   # [pp, M, mb, ...], scalar
+        out_mb = out_all[pp - 1]               # last stage's buffer
+        # per-microbatch aux means average to match the non-pipelined
+        # full-batch magnitude
+        return out_mb.reshape((B,) + out_mb.shape[2:]), aux / M
 
     # partial-manual shard_map (manual pp, auto dp/mp/...) is only legal
     # under jit; nested jit is inlined when already tracing
     jitted = jax.jit(fn)
     cache[cache_key] = jitted
-    return _tape.apply(jitted, *[stacked[n] for n in names], x,
-                       _op_name="pipeline")
+    return _finish(_tape.apply(jitted, *[stacked[n] for n in names], x,
+                               _op_name="pipeline"), template)
+
+
+def _finish(out_and_aux, template):
+    """Unpack the pipeline program's (out, aux): report aux into the
+    active training-engine scope (a same-trace value there) and stash it
+    on the template for the eager PipelineParallel.train_batch path.
+    Under an engine jit trace the aux is a tracer — stashing it would
+    leak it into persistent Python state for a later eager call to trip
+    over (UnexpectedTracerError), so only concrete values are kept."""
+    out, aux = out_and_aux
+    from ...framework.aux_loss import add_aux_loss
+    raw = aux.value if isinstance(aux, Tensor) else aux
+    add_aux_loss(raw)
+    object.__setattr__(
+        template, "_last_pipeline_aux",
+        aux if not isinstance(raw, jax.core.Tracer) else None)
+    return out
 
 
 class PipelineParallel(Layer):
@@ -254,6 +293,13 @@ class PipelineParallel(Layer):
             raise ValueError("PipelineLayer needs loss_fn for train_batch")
         out = self.forward(x)
         loss = loss_fn(out, y)
+        # aux losses reported inside the pipelined body (MoE balance):
+        # the pipeline program returns their total as a differentiable
+        # second output, stashed by _finish for this eager path (the
+        # engines consume the aux_loss_scope report instead)
+        aux = getattr(self._layers._template, "_last_pipeline_aux", None)
+        if isinstance(aux, Tensor):
+            loss = loss + aux
         if scaler is not None:
             scaler.scale(loss).backward()
             scaler.step(optimizer)

@@ -81,6 +81,16 @@ def test_new_group_rank_subset_rejected():
         dist.new_group(axis="pd")
 
 
+def test_uneven_alltoall_single_controller_guidance():
+    dist.init_mesh({"dp": 8})
+    t = paddle.to_tensor(np.zeros((8, 8), "float32"))
+    with pytest.raises(NotImplementedError, match="multi-process"):
+        dist.alltoall_single(None, t, in_split_sizes=[1, 2, 1, 1, 1, 1, 1],
+                             out_split_sizes=[1] * 7)
+    with pytest.raises(ValueError, match="BOTH"):
+        dist.alltoall_single(None, t, in_split_sizes=[1, 2, 1, 1, 1, 1, 1])
+
+
 def test_p2p_raises_under_single_controller():
     dist.init_mesh({"dp": 8})
     t = paddle.to_tensor(np.zeros(4, "float32"))

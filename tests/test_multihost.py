@@ -165,6 +165,22 @@ _WORKER_P2P = textwrap.dedent("""
             for w in dist.batch_isend_irecv(
                     [dist.P2POp(dist.isend, grads, 0)]): w.wait()
 
+    # uneven alltoall_single (global_scatter semantics): rank0 sends
+    # sizes [1,3], rank1 sends [2,4]
+    if rank == 0:
+        xin = np.array([0, 100, 101, 102], np.float32)
+        got = dist.alltoall_single(None, paddle.to_tensor(xin),
+                                   in_split_sizes=[1, 3],
+                                   out_split_sizes=[1, 2])
+        np.testing.assert_allclose(got.numpy(), [0, 10, 11])
+    else:
+        xin = np.array([10, 11, 110, 111, 112, 113], np.float32)
+        got = dist.alltoall_single(None, paddle.to_tensor(xin),
+                                   in_split_sizes=[2, 4],
+                                   out_split_sizes=[3, 4])
+        np.testing.assert_allclose(
+            got.numpy(), [100, 101, 102, 110, 111, 112, 113])
+
     dist.barrier()
     print("P2P_OK", rank)
 """)
