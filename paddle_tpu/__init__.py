@@ -51,6 +51,7 @@ from .core.tensor_types import (  # noqa: F401,E402
 from .tensor import *  # noqa: F401,F403,E402  (creation/math/... API)
 from .tensor import to_tensor  # noqa: F401,E402
 from .framework import seed, set_flags, get_flags  # noqa: F401,E402
+from .framework.lazy_init import LazyGuard  # noqa: F401,E402
 from .framework import get_rng_state, set_rng_state  # noqa: F401,E402
 # cuda-named aliases (reference exposes them top-level; one RNG here)
 get_cuda_rng_state = get_rng_state

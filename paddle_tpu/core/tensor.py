@@ -53,7 +53,9 @@ class Tensor:
     def __init__(self, value, stop_gradient=True, name=None):
         if isinstance(value, Tensor):
             value = value.value
-        if not isinstance(value, jax.Array):
+        if not isinstance(value, (jax.Array, jax.ShapeDtypeStruct)):
+            # ShapeDtypeStruct: abstract parameter under LazyGuard
+            # (framework/lazy_init.py) — holds shape/dtype only
             value = jnp.asarray(value)
         self.value = value
         self.stop_gradient = stop_gradient

@@ -176,7 +176,13 @@ class PipelineLayer(Layer):
         for name in names:
             per_block = [dict(b.named_parameters())[name]
                          for b in blocks]
-            stacked = jnp.stack([p.value for p in per_block])
+            if isinstance(per_block[0].value, jax.ShapeDtypeStruct):
+                # abstract (LazyGuard) blocks: stack the avals
+                v0 = per_block[0].value
+                stacked = jax.ShapeDtypeStruct(
+                    (len(per_block),) + tuple(v0.shape), v0.dtype)
+            else:
+                stacked = jnp.stack([p.value for p in per_block])
             sp = Parameter(stacked, name=f"blocks.{name}")
             inner = per_block[0].sharding_axes
             sp.sharding_axes = ("pp",) + tuple(

@@ -151,15 +151,28 @@ class ParallelTrainStep:
                 for n, v in params.items()}
         else:
             self.param_shardings = {n: shardings[n] for n in params}
-        # params live sharded (mp; + zero axis at stage 3).
-        # jnp.copy first: device_put with an already-matching sharding
-        # returns the SAME buffer, and step() donates these — without the
-        # copy the model's own arrays would be deleted
-        self.params = {n: jax.device_put(jnp.copy(v),
-                                         self.param_shardings[n])
-                       for n, v in params.items()}
-        self.buffers = {n: jnp.copy(v) for n, v in buffers.items()}
-        opt_state = optimizer.init(self.params)
+        # Abstract mode (framework/lazy_init.LazyGuard): params are
+        # ShapeDtypeStruct avals — nothing is materialized; the step can
+        # only be aot_compile()d (north-star-scale validation without the
+        # memory, reference role: the fleet hybrid suites at real scale).
+        self._abstract = any(isinstance(v, jax.ShapeDtypeStruct)
+                             for v in params.values())
+        if self._abstract:
+            self.params = dict(params)
+            self.buffers = {n: (v if isinstance(v, jax.ShapeDtypeStruct)
+                                else jax.ShapeDtypeStruct(v.shape, v.dtype))
+                            for n, v in buffers.items()}
+            opt_state = jax.eval_shape(optimizer.init, self.params)
+        else:
+            # params live sharded (mp; + zero axis at stage 3).
+            # jnp.copy first: device_put with an already-matching sharding
+            # returns the SAME buffer, and step() donates these — without
+            # the copy the model's own arrays would be deleted
+            self.params = {n: jax.device_put(jnp.copy(v),
+                                             self.param_shardings[n])
+                           for n, v in params.items()}
+            self.buffers = {n: jnp.copy(v) for n, v in buffers.items()}
+            opt_state = optimizer.init(self.params)
         if zero_stage >= 1:
             def slot_spec(pname, leaf):
                 # slots follow their parameter's mp+zero layout when shapes
@@ -181,15 +194,25 @@ class ParallelTrainStep:
         else:
             self.opt_shardings = jax.tree_util.tree_map(
                 lambda leaf: NamedSharding(self.mesh, P()), opt_state)
-        self.opt_state = jax.tree_util.tree_map(
-            lambda v, s: jax.device_put(v, s), opt_state, self.opt_shardings)
+        if self._abstract:
+            self.opt_state = opt_state
+        else:
+            self.opt_state = jax.tree_util.tree_map(
+                lambda v, s: jax.device_put(v, s), opt_state,
+                self.opt_shardings)
         self.acc_grads = None
         if accumulate_steps > 1:
             acc_sh = (self.grad_shardings if zero_stage >= 2
                       else self.param_shardings)
             self.acc_grad_shardings = acc_sh
-            self.acc_grads = {n: jax.device_put(jnp.zeros_like(v), acc_sh[n])
-                              for n, v in self.params.items()}
+            if self._abstract:
+                self.acc_grads = {
+                    n: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                    for n, v in self.params.items()}
+            else:
+                self.acc_grads = {
+                    n: jax.device_put(jnp.zeros_like(v), acc_sh[n])
+                    for n, v in self.params.items()}
 
     # ------------------------------------------------------------------
     def _batch_sharding(self, raw_batch):
@@ -308,7 +331,48 @@ class ParallelTrainStep:
             donate_argnums=(0, 1, 2, 3))
 
     # ------------------------------------------------------------------
+    def aot_compile(self, *batch_avals):
+        """Lower + compile the full hybrid-parallel training step with
+        abstract inputs — no parameter bytes are ever allocated. Use with
+        a LazyGuard-constructed model to validate north-star-scale
+        configs (GPT-6.7B, LLaMA-13B) on a virtual mesh:
+
+            with paddle.LazyGuard():
+                model = LlamaForCausalLM(llama_13b())
+            step = ParallelTrainStep(model, loss_fn, opt, ...)
+            compiled = step.aot_compile(
+                jax.ShapeDtypeStruct((B, S), jnp.int32), ...)
+            compiled.memory_analysis()   # per-device HBM requirements
+
+        Returns the jax Compiled object (cost_analysis/memory_analysis).
+        Reference-scale counterpart: the fleet hybrid suites
+        (unittests/collective/fleet/hybrid_parallel_pp_transformer.py),
+        which need real GPUs; this validates the same compositions
+        compiler-side.
+        """
+        if self.accumulate_steps != 1:
+            raise NotImplementedError(
+                "aot_compile validates the accumulate_steps=1 program")
+        raw_batch = tuple(
+            b if isinstance(b, jax.ShapeDtypeStruct)
+            else jax.ShapeDtypeStruct(tuple(b.shape), b.dtype)
+            for b in batch_avals)
+        if self._jitted is None:
+            self._build(raw_batch)
+        scalar = jax.ShapeDtypeStruct((), jnp.float32)
+        key = jax.eval_shape(
+            lambda: _rng.default_generator().fold_in(1))
+        lowered = self._jitted.lower(
+            self.params, self.buffers, self.opt_state, scalar, scalar,
+            key, *raw_batch)
+        return lowered.compile()
+
     def __call__(self, *batch) -> Tensor:
+        if self._abstract:
+            raise RuntimeError(
+                "this ParallelTrainStep was built from a LazyGuard "
+                "(abstract) model — only aot_compile() is available; "
+                "construct the model outside LazyGuard to train")
         raw_batch = _raw_tuple(batch)
         if self._jitted is None:
             self._build(raw_batch)

@@ -1,0 +1,49 @@
+"""Lazy (abstract) parameter initialization.
+
+Parity: python/paddle/fluid/lazy_init.py LazyGuard — the reference defers
+parameter materialization so huge models can be described before their
+storage exists. TPU-first twist: under LazyGuard, initializers return
+`jax.ShapeDtypeStruct` avals instead of arrays, so a model of ANY size
+(GPT-6.7B, LLaMA-13B) constructs in milliseconds and can be traced,
+sharded, and AOT-compiled (`jax.jit(...).lower().compile()`) with per-
+device memory analysis — without a single parameter byte allocated.
+
+Unlike the reference (which later materializes via functional blocks),
+materialization here is jax-native: trace the same initializer program
+under jit, or load real weights into the abstract skeleton via
+set_state_dict.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["LazyGuard", "lazy_mode"]
+
+_state = threading.local()
+
+
+def lazy_mode() -> bool:
+    return getattr(_state, "lazy", False)
+
+
+class LazyGuard:
+    """Context manager: layers constructed inside hold abstract parameters
+    (`jax.ShapeDtypeStruct` in `Parameter.value`).
+
+        with paddle.LazyGuard():
+            model = LlamaForCausalLM(llama_13b())   # instant, 0 bytes
+
+    Abstract models support: named_parameters/state-dict structure,
+    sharding annotation, `functional_call` tracing, and
+    `ParallelTrainStep.aot_compile` — anything that executes real math on
+    the placeholder raises jax's TypeError for abstract values.
+    """
+
+    def __enter__(self):
+        self._prev = lazy_mode()
+        _state.lazy = True
+        return self
+
+    def __exit__(self, *exc):
+        _state.lazy = self._prev
+        return False

@@ -46,6 +46,34 @@ class Initializer:
     def __call__(self, shape, dtype="float32"):
         raise NotImplementedError
 
+    def __init_subclass__(cls, **kw):
+        # Under framework.lazy_init.LazyGuard every initializer returns an
+        # abstract aval instead of computing the init program — models of
+        # any size construct instantly for AOT compilation/validation.
+        super().__init_subclass__(**kw)
+        orig = cls.__dict__.get("__call__")
+        if orig is None:
+            return  # inherits an already-wrapped __call__ — don't rewrap
+        import functools
+        import inspect
+        try:
+            default_dtype = inspect.signature(
+                orig).parameters["dtype"].default
+        except (KeyError, ValueError):
+            default_dtype = "float32"
+
+        @functools.wraps(orig)
+        def wrapped(self, shape, *args, **kwargs):
+            from ..framework.lazy_init import lazy_mode
+            if lazy_mode():
+                dtype = kwargs.get("dtype",
+                                   args[0] if args else default_dtype)
+                return jax.ShapeDtypeStruct(
+                    tuple(int(s) for s in shape), convert_dtype(dtype))
+            return orig(self, shape, *args, **kwargs)
+
+        cls.__call__ = wrapped
+
 
 class Constant(Initializer):
     def __init__(self, value=0.0):

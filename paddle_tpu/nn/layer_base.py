@@ -231,13 +231,21 @@ class Layer:
         return self
 
     def _cast_all(self, dt, float_only=True):
+        import jax as _jax
+
         from ..framework.dtype import is_inexact
+
+        def cast(v):
+            if isinstance(v, _jax.ShapeDtypeStruct):  # abstract (LazyGuard)
+                return _jax.ShapeDtypeStruct(v.shape, dt)
+            return v.astype(dt)
+
         for p in self.parameters():
             if not float_only or is_inexact(p.value.dtype):
-                p.value = p.value.astype(dt)
+                p.value = cast(p.value)
         for _, b in self.named_buffers():
             if not float_only or is_inexact(b.value.dtype):
-                b.value = b.value.astype(dt)
+                b.value = cast(b.value)
 
     def float(self):
         return self.astype("float32")

@@ -252,17 +252,11 @@ def disable_signal_handler():
     C++ signal handlers; this build never installs any, so no-op."""
 
 
-class LazyGuard:
-    """Parity: paddle.LazyGuard — the reference defers parameter
-    materialization; initialization here is already lazy at the XLA
-    level (arrays materialize on first use), so this is a documented
-    no-op context."""
-
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *exc):
-        return False
+# Real implementation lives in framework/lazy_init.py (abstract
+# ShapeDtypeStruct parameters for AOT-scale model construction); this
+# module re-exports it so `from paddle_tpu.tensor import LazyGuard`
+# resolves to the same functional guard as the top-level name.
+from ..framework.lazy_init import LazyGuard  # noqa: E402,F401
 
 
 def batch(reader, batch_size, drop_last=False):

@@ -1,0 +1,106 @@
+"""North-star-scale AOT validation (BASELINE.md configs 3-4).
+
+GPT-6.7B (dp x sharding, ZeRO-3, remat, bf16+master) and LLaMA-13B
+(tp x pp x dp) training steps are lowered and compiled on the 8-device
+virtual mesh with LazyGuard-abstract parameters — zero bytes allocated —
+and their per-device memory demands are asserted against the v5p HBM
+budget and a recorded watermark (>10% regression fails, VERDICT r3
+item 5). Reference-scale counterpart: the fleet hybrid suites
+(unittests/collective/fleet/hybrid_parallel_pp_transformer.py), which
+need a real cluster; XLA's compiler validates the same compositions here.
+
+These are the slowest tests in the suite (~40-90s each: full-scale HLO).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.models import (GPTConfig, GPTForCausalLM,
+                               LlamaForCausalLM, LlamaPipelineForCausalLM,
+                               llama_13b)
+
+V5P_HBM = 95 * 2 ** 30          # public v5p HBM per chip
+# Recorded round-3 per-device ARGUMENT watermarks (bytes); >10%
+# regression fails. Arguments (sharded params + optimizer slots + master
+# weights) are the backend-independent memory floor — XLA:CPU's
+# temp/activation accounting does not transfer to the TPU backend
+# (its CPU buffer assignment neither fuses nor schedules like TPU), so
+# temps are informational only.
+GPT67_ARGS_RECORDED = 24_026_312_712      # dp2 x sharding4, ZeRO-3, bf16
+LLAMA13_ARGS_RECORDED = 27_350_000_000    # mp2 x pp2 x dp2, ZeRO-2, f32
+
+
+@pytest.fixture(autouse=True)
+def fresh_mesh():
+    dist.set_mesh(None)
+    yield
+    dist.set_mesh(None)
+
+
+def test_lazy_guard_abstract_params():
+    with paddle.LazyGuard():
+        m = GPTForCausalLM(GPTConfig(vocab_size=128, hidden_size=32,
+                                     num_layers=2, num_heads=4,
+                                     max_seq_len=32))
+        m.bfloat16()
+    p = next(iter(m.parameters()))
+    assert isinstance(p.value, jax.ShapeDtypeStruct)
+    assert p.dtype == jnp.bfloat16
+    # a step built from an abstract model must refuse to train
+    dist.init_mesh({"dp": 8})
+    opt = paddle.optimizer.AdamW(parameters=m.parameters())
+    step = dist.ParallelTrainStep(m, GPTForCausalLM.loss_fn, opt)
+    with pytest.raises(RuntimeError, match="LazyGuard"):
+        step(paddle.to_tensor(np.zeros((8, 32), "int64")))
+
+
+def test_gpt_6_7b_zero3_remat_aot_fits_v5p():
+    """BASELINE config 3: GPT-6.7B, dp2 x sharding4, ZeRO-3, remat,
+    bf16 params + fp32 master. Must compile and fit v5p HBM."""
+    dist.init_mesh({"dp": 2, "sharding": 4})
+    with paddle.LazyGuard():
+        model = GPTForCausalLM(GPTConfig(
+            hidden_size=4096, num_layers=32, num_heads=32,
+            max_seq_len=2048, tie_embeddings=False))
+        model.bfloat16()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4, multi_precision=True,
+                                 parameters=model.parameters())
+    step = dist.ParallelTrainStep(model, GPTForCausalLM.loss_fn, opt,
+                                  zero_stage=3, remat=True)
+    ids = jax.ShapeDtypeStruct((8, 2048), jnp.int64)
+    compiled = step.aot_compile(ids, ids)      # raises if lowering breaks
+    args = compiled.memory_analysis().argument_size_in_bytes
+    assert args < 0.9 * V5P_HBM, f"6.7B step needs {args/2**30:.1f}GiB"
+    assert args < 1.1 * GPT67_ARGS_RECORDED, (
+        f"per-device argument memory regressed: {args} vs recorded "
+        f"{GPT67_ARGS_RECORDED}")
+
+
+def test_llama_13b_tp_pp_aot_fits_v5p():
+    """BASELINE config 4: LLaMA-13B, mp2 x pp2 x dp2 hybrid, ZeRO-2.
+
+    f32 (not bf16): XLA:CPU crashes with an internal check failure
+    ("Invalid binary instruction opcode copy") compiling bf16 buffers
+    through the shard_map pipeline ppermute ring — a CPU-backend-only
+    bug; the TPU backend takes a different path. f32 numbers are the
+    conservative (2x) bound anyway.
+    """
+    dist.init_mesh({"pp": 2, "mp": 2, "dp": 2})
+    with paddle.LazyGuard():
+        model = LlamaPipelineForCausalLM(llama_13b(), num_stages=2,
+                                         num_micro=4)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    step = dist.ParallelTrainStep(model, LlamaForCausalLM.loss_fn, opt,
+                                  zero_stage=2)
+    ids = jax.ShapeDtypeStruct((8, 2048), jnp.int64)
+    compiled = step.aot_compile(ids, ids)
+    args = compiled.memory_analysis().argument_size_in_bytes
+    assert args < 0.9 * V5P_HBM, f"13B step needs {args/2**30:.1f}GiB"
+    assert args < 1.1 * LLAMA13_ARGS_RECORDED, (
+        f"per-device argument memory regressed: {args} vs recorded "
+        f"{LLAMA13_ARGS_RECORDED}")
