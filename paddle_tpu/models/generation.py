@@ -75,24 +75,49 @@ def generate(model, input_ids, max_new_tokens: int = 32,
         params, buffers = raw_state(model)
         caches = model.new_cache(B, total, cache_dtype)
 
-        def prefill(params, buffers, ids, caches, key):
-            (logits, caches), _ = functional_call(
-                model, params, buffers, ids, caches,
-                jnp.int32(0), training=False)
-            nxt = _select_token(logits[:, -1, :], key, do_sample,
-                                temperature, top_k, top_p)
-            return nxt, caches
+        # One compiled prefill + decode program per (shape, sampling)
+        # configuration, cached ON the model — a fresh jax.jit per
+        # generate() call would re-trace and re-compile every request
+        # (measured: ~1.5 s per call at GPT-tiny scale, dwarfing the
+        # actual decode), which is fatal for the serving path.
+        prog_cache = getattr(model, "_gen_prog_cache", None)
+        if prog_cache is None:
+            import collections
+            prog_cache = collections.OrderedDict()
+            object.__setattr__(model, "_gen_prog_cache", prog_cache)
+        # greedy ignores the sampling knobs — don't let them split the key
+        sampling = ((float(temperature), int(top_k), float(top_p))
+                    if do_sample else None)
+        prog_key = (B, P, total, str(cache_dtype), sampling)
+        progs = prog_cache.get(prog_key)
+        if progs is not None:
+            prog_cache.move_to_end(prog_key)
+        if progs is None:
+            def prefill(params, buffers, ids, caches, key):
+                (logits, caches), _ = functional_call(
+                    model, params, buffers, ids, caches,
+                    jnp.int32(0), training=False)
+                nxt = _select_token(logits[:, -1, :], key, do_sample,
+                                    temperature, top_k, top_p)
+                return nxt, caches
 
-        def step(params, buffers, tok, caches, pos, key):
-            (logits, caches), _ = functional_call(
-                model, params, buffers, tok[:, None], caches, pos,
-                training=False)
-            nxt = _select_token(logits[:, -1, :], key, do_sample,
-                                temperature, top_k, top_p)
-            return nxt, caches
+            def step(params, buffers, tok, caches, pos, key):
+                (logits, caches), _ = functional_call(
+                    model, params, buffers, tok[:, None], caches, pos,
+                    training=False)
+                nxt = _select_token(logits[:, -1, :], key, do_sample,
+                                    temperature, top_k, top_p)
+                return nxt, caches
 
-        prefill_c = jax.jit(prefill, donate_argnums=(3,))
-        step_c = jax.jit(step, donate_argnums=(3,))
+            progs = (jax.jit(prefill, donate_argnums=(3,)),
+                     jax.jit(step, donate_argnums=(3,)))
+            prog_cache[prog_key] = progs
+            # bounded LRU: a long-lived server with drifting prompt
+            # lengths must not pin executables forever (bucket prompt
+            # lengths server-side to hit this cache reliably)
+            while len(prog_cache) > 16:
+                prog_cache.popitem(last=False)
+        prefill_c, step_c = progs
 
         key = jax.random.PRNGKey(seed)
         key, sub = jax.random.split(key)

@@ -69,3 +69,30 @@ def test_predict_error_paths(server):
     assert code == 400
     code, body = _req(srv, "/nothing")
     assert code == 404
+
+
+def test_serving_latency_bench_smoke():
+    """The north-star serving benchmark (tools/bench_serving.py,
+    BASELINE config 5) runs end-to-end at toy scale and emits a sane
+    record: encoder p50 through the Predictor path + KV-cache decode."""
+    import json
+    import os
+    import subprocess
+    import sys
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    # single-device serving: drop the test harness's 8-device flag
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if not f.startswith("--xla_force_host_platform_device_count"))
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "bench_serving.py"),
+         "--smoke"], capture_output=True, text=True, timeout=420, env=env,
+        cwd=root)
+    assert r.returncode == 0, r.stdout + r.stderr
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "ernie3_serving_latency"
+    assert 0 < rec["p50_ms"] <= rec["p99_ms"]
+    assert rec["decode_ms_per_token"] > 0

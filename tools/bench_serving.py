@@ -1,0 +1,128 @@
+"""Serving latency benchmark — BASELINE.md north-star config 5.
+
+Measures, through the production serving path (`paddle_tpu.inference`
+Config -> create_predictor -> zero-copy run; reference:
+paddle/fluid/inference/api/analysis_predictor.cc + the model-bench CI
+tools/ci_model_benchmark.sh):
+
+  1. ERNIE-3.0-class encoder request latency: p50/p90/p99 over N
+     single-request runs (batch 1 x seq 128, classification head input).
+  2. KV-cache autoregressive decode: ms/token through models.generate
+     (greedy, cached_attention path).
+
+Run on TPU:  python tools/bench_serving.py
+CPU smoke:   env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+                 python tools/bench_serving.py --smoke
+Prints ONE BENCH-style JSON line.
+"""
+import argparse
+import json
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def _percentiles(ms):
+    a = np.asarray(sorted(ms))
+    return (float(np.percentile(a, 50)), float(np.percentile(a, 90)),
+            float(np.percentile(a, 99)))
+
+
+def bench_encoder(smoke: bool, iters: int):
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.models import ErnieModel, ernie_3_base, ernie_3_tiny
+
+    paddle.seed(0)
+    cfg = ernie_3_tiny() if smoke else ernie_3_base()
+    model = ErnieModel(cfg)
+    model.eval()
+    if not smoke:
+        model.bfloat16()
+
+    seq = 128
+    with tempfile.TemporaryDirectory() as td:
+        path = td + "/ernie"
+        paddle.jit.save(model, path, input_spec=[
+            paddle.jit.InputSpec([1, seq], dtype="int64")])
+        pred = create_predictor(Config(path + ".pdmodel"))
+        ids = np.random.RandomState(0).randint(
+            0, cfg.vocab_size, (1, seq)).astype("int64")
+        h = pred.get_input_handle(pred.get_input_names()[0])
+        out_h = None
+        lat = []
+        for i in range(iters + 3):
+            t0 = time.perf_counter()
+            h.copy_from_cpu(ids)
+            pred.run()
+            out_h = pred.get_output_handle(pred.get_output_names()[0])
+            out_h.copy_to_cpu()          # host sync = request complete
+            dt = (time.perf_counter() - t0) * 1e3
+            if i >= 3:                    # drop compile + warmup
+                lat.append(dt)
+    return _percentiles(lat)
+
+
+def bench_decode(smoke: bool, new_tokens: int):
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForCausalLM, gpt_125m, gpt_tiny
+
+    paddle.seed(0)
+    cfg = gpt_tiny() if smoke else gpt_125m()
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    if not smoke:
+        model.bfloat16()
+    prompt = paddle.to_tensor(np.random.RandomState(1).randint(
+        0, cfg.vocab_size, (1, 16)).astype("int64"))
+    # warmup with the SAME shapes: the cache length (prompt + new tokens)
+    # keys the compiled decode program, so a different token budget would
+    # compile a different program and the measurement would time XLA
+    model.generate(prompt, max_new_tokens=new_tokens)
+    model.generate(prompt, max_new_tokens=1)
+    t0 = time.perf_counter()
+    model.generate(prompt, max_new_tokens=new_tokens)
+    dt_full = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    model.generate(prompt, max_new_tokens=1)
+    dt_one = time.perf_counter() - t0
+    # subtract the prefill (the 1-token call is prefill + one select) so
+    # the number reports pure per-token DECODE cost
+    return max(dt_full - dt_one, 0.0) * 1e3 / max(new_tokens - 1, 1)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny models, few iters (CPU)")
+    ap.add_argument("--iters", type=int, default=50)
+    ap.add_argument("--tokens", type=int, default=64)
+    args = ap.parse_args()
+
+    iters = 8 if args.smoke else args.iters
+    tokens = 8 if args.smoke else args.tokens
+    p50, p90, p99 = bench_encoder(args.smoke, iters)
+    ms_tok = bench_decode(args.smoke, tokens)
+
+    import jax
+    print(json.dumps({
+        "metric": "ernie3_serving_latency",
+        "value": round(p50, 2),
+        "unit": "ms_p50_batch1_seq128",
+        "p50_ms": round(p50, 2),
+        "p90_ms": round(p90, 2),
+        "p99_ms": round(p99, 2),
+        "decode_ms_per_token": round(ms_tok, 2),
+        "iters": iters,
+        "device_kind": getattr(jax.devices()[0], "device_kind", "cpu"),
+        "smoke": bool(args.smoke),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
