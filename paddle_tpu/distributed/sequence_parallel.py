@@ -46,8 +46,9 @@ from . import mesh as mesh_mod
 __all__ = ["ring_attention", "ulysses_attention", "shard_sequence",
            "last_ring_dispatch"]
 
-# records the most recent ring_attention dispatch decision:
-# {"path": "pallas"|"xla", "reason": str, "sl": int, "d": int}
+# records the most recent ring/ulysses attention dispatch decision:
+# {"path": "pallas"|"xla"|"plain", "reason": str, "sl": int, "d": int,
+#  "op": "ring"|"ulysses"}
 _last_dispatch = {}
 
 
@@ -209,7 +210,7 @@ def ring_attention(q, k, v, causal: bool = False, scale: float = None):
     scale = scale or 1.0 / math.sqrt(D)
 
     if sp <= 1:
-        _last_dispatch.update(path="plain", sl=S, d=D,
+        _last_dispatch.update(path="plain", sl=S, d=D, op="ring",
                               reason="sp<=1: no ring, single-device sdpa")
 
         def plain(qv, kv, vv):
@@ -226,6 +227,7 @@ def ring_attention(q, k, v, causal: bool = False, scale: float = None):
     backend = jax.default_backend()
     fused = _fused_geometry_ok(sl, D)
     _last_dispatch.update(path="pallas" if fused else "xla", sl=sl, d=D,
+                          op="ring",
                           reason="geometry ok" if fused else
                           f"sl={sl} or head_dim={D} does not tile 128")
     if not fused and backend in ("tpu", "axon"):
@@ -267,9 +269,11 @@ def _ring_program(mesh, sp, scale, causal, sl, fused, interpret):
     return jax.jit(fn)
 
 
-def _ulysses_body(q, k, v, *, sp: int, scale: float, causal: bool):
+def _ulysses_body(q, k, v, *, sp: int, scale: float, causal: bool,
+                  fused: bool, interpret: bool):
     """Local shards [B, S/sp, H, D] -> a2a -> [B, S, H/sp, D] -> attention
-    -> a2a back (DeepSpeed-Ulysses)."""
+    -> a2a back (DeepSpeed-Ulysses). The local full-sequence attention
+    runs in the fused Pallas kernel when the geometry tiles 128."""
     def seq_to_head(x):
         # split heads into sp groups, all_to_all the seq<->head-group dims
         return lax.all_to_all(x, "sp", split_axis=2, concat_axis=1,
@@ -281,8 +285,16 @@ def _ulysses_body(q, k, v, *, sp: int, scale: float, causal: bool):
 
     qf, kf, vf = seq_to_head(q), seq_to_head(k), seq_to_head(v)
     S = qf.shape[1]
-    mask = jnp.tril(jnp.ones((S, S), bool))[None, None] if causal else None
-    out = _sdpa(qf, kf, vf, scale, mask)
+    if fused:
+        o, _ = _fb.flash_attention_lse(
+            jnp.swapaxes(qf, 1, 2), jnp.swapaxes(kf, 1, 2),
+            jnp.swapaxes(vf, 1, 2), causal=causal, sm_scale=scale,
+            interpret=interpret)
+        out = jnp.swapaxes(o, 1, 2)
+    else:
+        mask = (jnp.tril(jnp.ones((S, S), bool))[None, None]
+                if causal else None)
+        out = _sdpa(qf, kf, vf, scale, mask)
     return head_to_seq(out)
 
 
@@ -301,14 +313,30 @@ def ulysses_attention(q, k, v, causal: bool = False, scale: float = None):
     if H % sp:
         raise ValueError(f"num_heads {H} not divisible by sp={sp}")
 
-    prog = _ulysses_program(mesh, sp, float(scale), causal)
+    S = (q.shape[1] if hasattr(q, "shape") else q.value.shape[1])
+    backend = jax.default_backend()
+    # after the a2a the local attention runs over the FULL sequence
+    fused = _fused_geometry_ok(S, D)
+    _last_dispatch.update(path="pallas" if fused else "xla", sl=S, d=D,
+                          op="ulysses",
+                          reason="geometry ok" if fused else
+                          f"S={S} or head_dim={D} does not tile 128")
+    if not fused and backend in ("tpu", "axon"):
+        warnings.warn(
+            f"ulysses_attention: falling back to the XLA einsum body on "
+            f"TPU ({_last_dispatch['reason']}); pad seq to a multiple of "
+            "128 to use the fused Pallas kernel")
+    interpret = backend not in ("tpu", "axon")
+    prog = _ulysses_program(mesh, sp, float(scale), causal, fused,
+                            interpret)
     return _tape.apply(prog, q, k, v, _op_name="ulysses_attention")
 
 
 @functools.lru_cache(maxsize=64)
-def _ulysses_program(mesh, sp, scale, causal):
+def _ulysses_program(mesh, sp, scale, causal, fused, interpret):
     body = functools.partial(_ulysses_body, sp=sp, scale=scale,
-                             causal=causal)
+                             causal=causal, fused=fused,
+                             interpret=interpret)
 
     def fn(qv, kv, vv):
         smapped = jax.shard_map(

@@ -10,8 +10,11 @@ is not already correct, re-run pytest in a child process with the right env
 (after releasing pytest's fd capture so output flows through).
 """
 import os
+import signal
 import subprocess
 import sys
+
+import pytest
 
 _WANT = "--xla_force_host_platform_device_count=8"
 
@@ -23,7 +26,89 @@ def _env_ok():
                 and not os.environ.get("PALLAS_AXON_POOL_IPS")))
 
 
+def pytest_addoption(parser):
+    parser.addoption(
+        "--runslow", action="store_true", default=False,
+        help="include tests marked slow (north-star AOT compiles, "
+             "benchmark smokes) — tools/ci.py --full sets this")
+
+
+def pytest_collection_modifyitems(config, items):
+    if config.getoption("--runslow") or os.environ.get(
+            "PADDLE_TPU_RUN_SLOW"):
+        return
+    skip = pytest.mark.skip(
+        reason="marked slow: run with --runslow (tools/ci.py --full)")
+    for it in items:
+        if "slow" in it.keywords:
+            it.add_marker(skip)
+
+
+def _test_limit(item) -> int:
+    m = item.get_closest_marker("timeout")
+    if m is None:
+        return 300
+    if m.args:
+        return int(m.args[0])
+    return int(m.kwargs.get("seconds", 300))
+
+
+def _alarm_guard(item, phase):
+    limit = _test_limit(item)
+
+    def on_alarm(signum, frame):
+        raise TimeoutError(
+            f"{item.nodeid} {phase} exceeded the {limit}s per-test limit")
+
+    old = signal.signal(signal.SIGALRM, on_alarm)
+    signal.alarm(limit)
+    return old
+
+
+def _alarm_clear(old):
+    signal.alarm(0)
+    signal.signal(signal.SIGALRM, old)
+
+
+@pytest.hookimpl(wrapper=True)
+def pytest_runtest_setup(item):
+    """Per-test wall-clock limits cover setup, call, AND teardown
+    (reference: per-case TIMEOUT properties in the CMake test driver) —
+    one hung test or fixture must not eat the CI budget. Override with
+    @pytest.mark.timeout(seconds). SIGALRM-based, so a hang inside a
+    non-yielding C call can still block — subprocess-heavy tests also
+    carry their own communicate() timeouts."""
+    old = _alarm_guard(item, "setup")
+    try:
+        return (yield)
+    finally:
+        _alarm_clear(old)
+
+
+@pytest.hookimpl(wrapper=True)
+def pytest_runtest_call(item):
+    old = _alarm_guard(item, "call")
+    try:
+        return (yield)
+    finally:
+        _alarm_clear(old)
+
+
+@pytest.hookimpl(wrapper=True)
+def pytest_runtest_teardown(item):
+    old = _alarm_guard(item, "teardown")
+    try:
+        return (yield)
+    finally:
+        _alarm_clear(old)
+
+
 def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: heavyweight test, deselected unless --runslow")
+    config.addinivalue_line(
+        "markers", "timeout(seconds): per-test wall-clock limit "
+                   "(default 300)")
     if _env_ok():
         return
     env = dict(os.environ)

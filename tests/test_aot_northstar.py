@@ -58,6 +58,8 @@ def test_lazy_guard_abstract_params():
         step(paddle.to_tensor(np.zeros((8, 32), "int64")))
 
 
+@pytest.mark.slow
+@pytest.mark.timeout(600)
 def test_gpt_6_7b_zero3_remat_aot_fits_v5p():
     """BASELINE config 3: GPT-6.7B, dp2 x sharding4, ZeRO-3, remat,
     bf16 params + fp32 master. Must compile and fit v5p HBM."""
@@ -80,6 +82,8 @@ def test_gpt_6_7b_zero3_remat_aot_fits_v5p():
         f"{GPT67_ARGS_RECORDED}")
 
 
+@pytest.mark.slow
+@pytest.mark.timeout(600)
 def test_llama_13b_tp_pp_aot_fits_v5p():
     """BASELINE config 4: LLaMA-13B, mp2 x pp2 x dp2 hybrid, ZeRO-2.
 

@@ -69,6 +69,7 @@ def test_gpt_pipeline_variant():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_resnet_forward_and_train():
     paddle.seed(0)
     m = paddle.vision.models.resnet18(num_classes=10)
@@ -125,6 +126,7 @@ def test_metric_accuracy_topk():
     assert top1 == 0.5 and top2 == 0.5
 
 
+@pytest.mark.slow
 def test_graft_entry_contracts():
     import __graft_entry__ as g
     import jax

@@ -20,6 +20,7 @@ def _tiny(num_classes=4):
                    depth_mult=0.33)
 
 
+@pytest.mark.slow
 def test_forward_shapes():
     paddle.seed(31)
     m = _tiny()
@@ -53,6 +54,7 @@ def test_dfl_decode_geometry():
                                rtol=1e-5, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_gradient_flow():
     paddle.seed(33)
     m = _tiny(num_classes=2)

@@ -71,6 +71,7 @@ def test_predict_error_paths(server):
     assert code == 404
 
 
+@pytest.mark.slow
 def test_serving_latency_bench_smoke():
     """The north-star serving benchmark (tools/bench_serving.py,
     BASELINE config 5) runs end-to-end at toy scale and emits a sane

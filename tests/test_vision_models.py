@@ -14,20 +14,28 @@ def _img(n=1, c=3, hw=64):
 
 
 class TestModelZoo:
+    # ONE cpu core in CI: the zoo's big-CNN compiles dominate the fast
+    # profile, so two light archs stay default and the rest run under
+    # --runslow (tools/ci.py --full)
     @pytest.mark.parametrize("ctor,kw,hw", [
-        (M.alexnet, {}, 224),
-        (M.vgg11, {}, 64),
-        (M.squeezenet1_0, {}, 64),
+        pytest.param(M.alexnet, {}, 224, marks=pytest.mark.slow),
+        pytest.param(M.vgg11, {}, 64, marks=pytest.mark.slow),
+        pytest.param(M.squeezenet1_0, {}, 64, marks=pytest.mark.slow),
         (M.squeezenet1_1, {}, 64),
         (M.mobilenet_v1, {"scale": 0.25}, 64),
-        (M.mobilenet_v2, {"scale": 0.25}, 64),
-        (M.mobilenet_v3_small, {"scale": 1.0}, 64),
-        (M.mobilenet_v3_large, {"scale": 1.0}, 64),
-        (M.shufflenet_v2_x0_25, {}, 64),
-        (M.shufflenet_v2_swish, {}, 64),
-        (M.densenet121, {}, 64),
-        (M.resnext50_32x4d, {}, 64),
-        (M.wide_resnet101_2, {}, 64),
+        pytest.param(M.mobilenet_v2, {"scale": 0.25}, 64,
+                     marks=pytest.mark.slow),
+        pytest.param(M.mobilenet_v3_small, {"scale": 1.0}, 64,
+                     marks=pytest.mark.slow),
+        pytest.param(M.mobilenet_v3_large, {"scale": 1.0}, 64,
+                     marks=pytest.mark.slow),
+        pytest.param(M.shufflenet_v2_x0_25, {}, 64,
+                     marks=pytest.mark.slow),
+        pytest.param(M.shufflenet_v2_swish, {}, 64,
+                     marks=pytest.mark.slow),
+        pytest.param(M.densenet121, {}, 64, marks=pytest.mark.slow),
+        pytest.param(M.resnext50_32x4d, {}, 64, marks=pytest.mark.slow),
+        pytest.param(M.wide_resnet101_2, {}, 64, marks=pytest.mark.slow),
     ])
     def test_forward_shape(self, ctor, kw, hw):
         model = ctor(num_classes=7, **kw)
@@ -35,11 +43,13 @@ class TestModelZoo:
         out = model(_img(2, 3, hw))
         assert out.shape == [2, 7]
 
+    @pytest.mark.slow
     def test_vgg_batch_norm(self):
         model = M.vgg11(batch_norm=True, num_classes=5)
         model.eval()
         assert model(_img(1, 3, 64)).shape == [1, 5]
 
+    @pytest.mark.slow
     def test_googlenet_aux_heads(self):
         model = M.googlenet(num_classes=6)
         model.eval()
@@ -47,6 +57,7 @@ class TestModelZoo:
         assert out.shape == [1, 6]
         assert aux1.shape == [1, 6] and aux2.shape == [1, 6]
 
+    @pytest.mark.slow
     def test_inception_v3(self):
         model = M.inception_v3(num_classes=4)
         model.eval()
@@ -60,6 +71,7 @@ class TestModelZoo:
                 np.float32)))
         assert out.shape == [3, 10]
 
+    @pytest.mark.slow
     def test_with_pool_false_num_classes_0(self):
         model = M.mobilenet_v2(scale=0.25, num_classes=0, with_pool=False)
         model.eval()

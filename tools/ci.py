@@ -6,9 +6,18 @@ Parity role: the reference's test tooling (tools/get_quick_disable_lt.py
 flaky quarantine, tools/coverage/, paddle_build.sh test stage).
 
 Usage:
-    python tools/ci.py                 # full suite minus quarantine
+    python tools/ci.py                 # fast profile (slow-marked skipped)
+    python tools/ci.py --quick         # core-correctness subset (<5 min)
+    python tools/ci.py --full          # everything incl. slow marks
     python tools/ci.py --coverage      # + stdlib-trace line coverage
     python tools/ci.py --retries 2     # re-run failures up to 2x
+
+Wall-time reality: this environment has ONE cpu core (nproc=1), so the
+reference's parallel test grouping (tools/group_case_for_parallel.py)
+cannot buy anything — profiles cut WORK instead. Measured 2026-07-30:
+full 24:40, fast 12:50 warm, quick targets <5:00. Per-test wall-clock
+limits live in tests/conftest.py (default 300s, marker-overridable) so
+one hung test cannot eat the budget.
 
 Quarantined tests live in tools/flaky_quarantine.txt (one pytest nodeid
 or substring per line, '#' comments). They are deselected from the main
@@ -24,6 +33,18 @@ import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 QUARANTINE = os.path.join(ROOT, "tools", "flaky_quarantine.txt")
+
+# --quick: the core-correctness slice — tensor/autograd/nn/optimizer
+# semantics, the jit engines, collectives + hybrid parallelism, the
+# Pallas kernel, and the 2-process world. Breadth (model zoo, vision
+# ops, datasets, long tail) belongs to the fast/full profiles.
+QUICK_FILES = [
+    "tests/test_tensor_ops.py", "tests/test_autograd.py",
+    "tests/test_nn.py", "tests/test_optimizer.py", "tests/test_jit.py",
+    "tests/test_distributed.py", "tests/test_pipeline.py",
+    "tests/test_flash_kernel.py", "tests/test_multihost.py",
+    "tests/test_zero_accumulation.py", "tests/test_api_surface.py",
+]
 
 
 def _quarantine():
@@ -49,15 +70,25 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--coverage", action="store_true")
     ap.add_argument("--retries", type=int, default=0)
+    ap.add_argument("--full", action="store_true",
+                    help="include tests marked slow (north-star AOT "
+                         "compiles, benchmark smokes); the default fast "
+                         "profile skips them — this machine has ONE cpu "
+                         "core, so wall time is cut by cutting work, not "
+                         "by sharding")
+    ap.add_argument("--quick", action="store_true",
+                    help="core-correctness subset only (<5 min target)")
     ap.add_argument("-k", default=None)
     args = ap.parse_args()
+    if args.full and args.quick:
+        ap.error("--full and --quick are mutually exclusive")
 
     quarantined = _quarantine()
     # nodeids/paths use --deselect; substrings fold into one -k
     # "not a and not b" expression (pytest keeps only the last -k flag)
     node_q = [q for q in quarantined if "::" in q or q.endswith(".py")]
     substr_q = [q for q in quarantined if q not in node_q]
-    extra = []
+    extra = ["--runslow"] if args.full else []
     k_parts = []
     if args.k:
         k_parts.append(f"({args.k})")
@@ -73,13 +104,26 @@ def main():
         # trace-based coverage collected by tests/conftest.py (no
         # external deps in this image); report written at session end
         env["PADDLE_TPU_COVERAGE"] = "1"
+    # Warm persistent XLA compile cache for repeat CI runs (measured ~2x
+    # on compile-heavy files). Scoped to CI via this env var so ad-hoc
+    # pytest runs and the driver dryrun keep the no-CPU-cache default
+    # (paddle_tpu/__init__.py rationale: foreign-host AOT artifacts).
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.expanduser("~/.cache/paddle_tpu_ci_xla"))
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 
-    rc = _run_pytest(extra + deselect, env)
+    # --quick keeps its file scope through retries: an empty last-failed
+    # cache (collection error) must not balloon a retry into the full
+    # fast suite on this 1-core machine
+    target = QUICK_FILES if args.quick else []
+    rc = _run_pytest(target + extra + deselect, env,
+                     default_target=not args.quick)
     attempt = 0
     while rc != 0 and attempt < args.retries:
         attempt += 1
         print(f"\n=== retry {attempt}/{args.retries} (failed tests only) ===")
-        rc = _run_pytest(extra + deselect + ["--last-failed"], env)
+        rc = _run_pytest(target + extra + deselect + ["--last-failed"],
+                         env, default_target=not args.quick)
 
     if quarantined:
         print("\n=== quarantined tests (best-effort, non-fatal) ===")
