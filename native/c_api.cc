@@ -1,0 +1,251 @@
+// C inference API over the paddle_tpu serving path.
+//
+// Reference role: paddle/fluid/inference/capi_exp/ (PD_Config/PD_Predictor
+// C surface over AnalysisPredictor). TPU-native twist: the predictor runs
+// StableHLO artifacts through paddle_tpu.inference (PJRT underneath), so
+// this library EMBEDS CPython rather than wrapping a C++ core — a C (or
+// Go, via cgo) host calls these functions, and the heavy lifting happens
+// in the same XLA runtime the Python API uses.
+//
+// Usage from C (see tests/test_c_api.py for a full driver):
+//   PD_Predictor* p = PD_PredictorCreate("/path/model.pdmodel");
+//   const void*  ins[]    = {data};
+//   const int64_t* shapes[] = {shape};
+//   int ndims[] = {2};  int dts[] = {PD_DTYPE_FLOAT32};
+//   PD_PredictorRun(p, ins, shapes, ndims, dts, 1);
+//   int64_t oshape[8]; int ondim;
+//   PD_PredictorGetOutputShape(p, 0, oshape, &ondim, 8);
+//   PD_PredictorGetOutputData(p, 0, buf, capacity_elems);
+//   PD_PredictorDestroy(p);
+//
+// Threading: every entry point takes the GIL (PyGILState), so the library
+// works both from a plain C program (it initializes Python itself) and
+// inside a process that already hosts CPython (e.g. ctypes tests).
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void set_error(const char* where) {
+  g_last_error = where;
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      const char* u = PyUnicode_AsUTF8(s);
+      if (u) {
+        g_last_error += ": ";
+        g_last_error += u;
+      } else {
+        PyErr_Clear();
+      }
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+struct GIL {
+  PyGILState_STATE st;
+  GIL() : st(PyGILState_Ensure()) {}
+  ~GIL() { PyGILState_Release(st); }
+};
+
+void ensure_python() {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    // Py_InitializeEx leaves the GIL held by this thread; release it so
+    // GIL guards below can acquire it uniformly.
+    PyEval_SaveThread();
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+enum PD_DType { PD_DTYPE_FLOAT32 = 0, PD_DTYPE_INT64 = 1,
+                PD_DTYPE_INT32 = 2 };
+
+struct PD_Predictor {
+  PyObject* predictor;      // paddle_tpu.inference Predictor
+  PyObject* outputs;        // list[np.ndarray] from the last Run
+  PyObject* np;             // numpy module
+};
+
+const char* PD_GetLastError() { return g_last_error.c_str(); }
+
+PD_Predictor* PD_PredictorCreate(const char* model_path) {
+  ensure_python();
+  GIL gil;
+  PyObject* mod = PyImport_ImportModule("paddle_tpu.inference");
+  if (!mod) { set_error("import paddle_tpu.inference"); return nullptr; }
+  PyObject* np = PyImport_ImportModule("numpy");
+  if (!np) { set_error("import numpy"); Py_DECREF(mod); return nullptr; }
+
+  PyObject* cfg = PyObject_CallMethod(mod, "Config", "s", model_path);
+  if (!cfg) { set_error("Config"); Py_DECREF(mod); Py_DECREF(np);
+              return nullptr; }
+  PyObject* pred = PyObject_CallMethod(mod, "create_predictor", "O", cfg);
+  Py_DECREF(cfg);
+  Py_DECREF(mod);
+  if (!pred) { set_error("create_predictor"); Py_DECREF(np);
+               return nullptr; }
+  auto* h = new PD_Predictor{pred, nullptr, np};
+  return h;
+}
+
+void PD_PredictorDestroy(PD_Predictor* h) {
+  if (!h) return;
+  GIL gil;
+  Py_XDECREF(h->predictor);
+  Py_XDECREF(h->outputs);
+  Py_XDECREF(h->np);
+  delete h;
+}
+
+static int name_count(PD_Predictor* h, const char* method) {
+  GIL gil;
+  PyObject* names = PyObject_CallMethod(h->predictor, method, nullptr);
+  if (!names) { set_error(method); return -1; }
+  int n = (int)PySequence_Size(names);
+  Py_DECREF(names);
+  return n;
+}
+
+int PD_PredictorGetInputNum(PD_Predictor* h) {
+  return name_count(h, "get_input_names");
+}
+
+int PD_PredictorGetOutputNum(PD_Predictor* h) {
+  return name_count(h, "get_output_names");
+}
+
+// Copies the i-th name (inputs: is_input=1) into buf (NUL-terminated).
+int PD_PredictorGetName(PD_Predictor* h, int is_input, int i, char* buf,
+                        int capacity) {
+  GIL gil;
+  PyObject* names = PyObject_CallMethod(
+      h->predictor, is_input ? "get_input_names" : "get_output_names",
+      nullptr);
+  if (!names) { set_error("get names"); return -1; }
+  PyObject* item = PySequence_GetItem(names, i);
+  Py_DECREF(names);
+  if (!item) { set_error("name index"); return -1; }
+  const char* s = PyUnicode_AsUTF8(item);
+  if (!s) { set_error("name not utf8"); Py_DECREF(item); return -1; }
+  int n = (int)strlen(s);
+  if (n + 1 > capacity) { Py_DECREF(item); g_last_error = "buf too small";
+                          return -1; }
+  memcpy(buf, s, n + 1);
+  Py_DECREF(item);
+  return n;
+}
+
+// Run with n typed dense inputs (row-major). Returns 0 on success.
+int PD_PredictorRun(PD_Predictor* h, const void** inputs,
+                    const int64_t** shapes, const int* ndims,
+                    const int* dtypes, int n_inputs) {
+  GIL gil;
+  PyObject* arr_list = PyList_New(n_inputs);
+  if (!arr_list) { set_error("alloc"); return -1; }
+  for (int i = 0; i < n_inputs; i++) {
+    int64_t elems = 1;
+    for (int d = 0; d < ndims[i]; d++) elems *= shapes[i][d];
+    const char* dtype = dtypes[i] == PD_DTYPE_FLOAT32 ? "float32"
+                        : dtypes[i] == PD_DTYPE_INT64 ? "int64" : "int32";
+    int64_t width = dtypes[i] == PD_DTYPE_INT64 ? 8
+                    : 4;
+    // bytes -> np.frombuffer(..., dtype).reshape(shape).copy()
+    PyObject* mem = PyMemoryView_FromMemory(
+        (char*)inputs[i], elems * width, PyBUF_READ);
+    PyObject* flat = mem ? PyObject_CallMethod(h->np, "frombuffer", "Os",
+                                               mem, dtype)
+                         : nullptr;
+    Py_XDECREF(mem);
+    if (!flat) { set_error("frombuffer"); Py_DECREF(arr_list); return -1; }
+    PyObject* shape = PyTuple_New(ndims[i]);
+    for (int d = 0; d < ndims[i]; d++)
+      PyTuple_SET_ITEM(shape, d, PyLong_FromLongLong(shapes[i][d]));
+    PyObject* view = PyObject_CallMethod(flat, "reshape", "O", shape);
+    Py_DECREF(flat);
+    Py_DECREF(shape);
+    if (!view) { set_error("reshape"); Py_DECREF(arr_list); return -1; }
+    // frombuffer ALIASES the caller's memory and the predictor retains
+    // the array past this call (device_put may zero-copy it) — the
+    // caller is free to reuse its buffer after Run, so copy here.
+    PyObject* arr = PyObject_CallMethod(view, "copy", nullptr);
+    Py_DECREF(view);
+    if (!arr) { set_error("copy"); Py_DECREF(arr_list); return -1; }
+    PyList_SET_ITEM(arr_list, i, arr);  // steals
+  }
+  PyObject* outs = PyObject_CallMethod(h->predictor, "run", "O", arr_list);
+  Py_DECREF(arr_list);
+  if (!outs) { set_error("run"); return -1; }
+  Py_XDECREF(h->outputs);
+  h->outputs = outs;
+  return 0;
+}
+
+int PD_PredictorGetOutputShape(PD_Predictor* h, int i, int64_t* shape,
+                               int* ndim, int capacity) {
+  GIL gil;
+  if (!h->outputs) { g_last_error = "Run first"; return -1; }
+  PyObject* arr = PySequence_GetItem(h->outputs, i);
+  if (!arr) { set_error("output index"); return -1; }
+  PyObject* shp = PyObject_GetAttrString(arr, "shape");
+  Py_DECREF(arr);
+  if (!shp) { set_error("shape"); return -1; }
+  int n = (int)PySequence_Size(shp);
+  if (n > capacity) { Py_DECREF(shp); g_last_error = "shape buf small";
+                      return -1; }
+  for (int d = 0; d < n; d++) {
+    PyObject* it = PySequence_GetItem(shp, d);
+    shape[d] = PyLong_AsLongLong(it);
+    Py_XDECREF(it);
+  }
+  Py_DECREF(shp);
+  *ndim = n;
+  return 0;
+}
+
+// Copies output i as float32 into buf (capacity in ELEMENTS).
+// Returns the element count, -1 on error.
+int64_t PD_PredictorGetOutputData(PD_Predictor* h, int i, float* buf,
+                                  int64_t capacity) {
+  GIL gil;
+  if (!h->outputs) { g_last_error = "Run first"; return -1; }
+  PyObject* arr = PySequence_GetItem(h->outputs, i);
+  if (!arr) { set_error("output index"); return -1; }
+  // np.ascontiguousarray(arr, dtype=float32).tobytes()
+  PyObject* kw = Py_BuildValue("{s:s}", "dtype", "float32");
+  PyObject* args = PyTuple_Pack(1, arr);
+  PyObject* fn = PyObject_GetAttrString(h->np, "ascontiguousarray");
+  PyObject* carr = fn ? PyObject_Call(fn, args, kw) : nullptr;
+  Py_XDECREF(fn);
+  Py_DECREF(args);
+  Py_DECREF(kw);
+  Py_DECREF(arr);
+  if (!carr) { set_error("ascontiguousarray"); return -1; }
+  PyObject* bytes = PyObject_CallMethod(carr, "tobytes", nullptr);
+  Py_DECREF(carr);
+  if (!bytes) { set_error("tobytes"); return -1; }
+  Py_ssize_t nbytes = PyBytes_Size(bytes);
+  int64_t elems = nbytes / 4;
+  if (elems > capacity) { Py_DECREF(bytes); g_last_error = "buf small";
+                          return -1; }
+  memcpy(buf, PyBytes_AsString(bytes), nbytes);
+  Py_DECREF(bytes);
+  return elems;
+}
+
+}  // extern "C"

@@ -147,7 +147,16 @@ def pipeline_apply(template: Layer, stacked: Dict[str, "Tensor"], x,
         return _finish(_tape.apply(fn, *[stacked[n] for n in names], x,
                                    _op_name="pipeline_scan"), template)
 
-    M = num_micro or pp
+    if num_micro:
+        M = num_micro
+    else:
+        # Fill-drain bubble fraction is (pp-1)/(M+pp-1): M=pp wastes
+        # ~half the ticks, M=4*pp caps the bubble near 1/5 (the GPipe
+        # M >= 4*stages guidance) while keeping per-microbatch matmuls
+        # large. Default: the largest divisor of B up to 4*pp.
+        B0 = int(x.shape[0] if hasattr(x, "shape") else len(x))
+        want = min(B0, 4 * pp)
+        M = next((m for m in range(want, 0, -1) if B0 % m == 0), pp)
     if L % (pp * v):
         raise ValueError(f"{L} pipelined blocks not divisible by "
                          f"pp*interleave={pp}*{v}")

@@ -129,6 +129,20 @@ def test_moe_pipeline_aux_matches_unpipelined():
     np.testing.assert_allclose(aux_pp, aux_seq, rtol=0.2)
 
 
+def test_default_num_micro_shrinks_bubble():
+    """Default microbatch count follows the GPipe M≈4·pp guidance (the
+    fill-drain bubble is (pp-1)/(M+pp-1)): with batch 16 on pp=4 the
+    default must pick M=16, not M=pp=4 (43% bubble -> 16%)."""
+    dist.init_mesh({"pp": 4})
+    m = _build_pipeline(num_stages=4)
+    x = np.random.RandomState(0).randn(16, 8).astype("float32")
+    out = m(paddle.to_tensor(x))           # builds the default schedule
+    keys = list(m._template._pp_prog_cache)
+    assert any(k[3] == 16 for k in keys), keys  # M slot of the cache key
+    np.testing.assert_allclose(out.numpy(), _sequential_ref(m, x),
+                               rtol=2e-4, atol=2e-5)
+
+
 def test_pipeline_layer_structure():
     dist.init_mesh({"pp": 4})
     m = _build_pipeline(num_stages=4)
