@@ -3,8 +3,11 @@
 Serving-path role parity: the reference's inference transformer stack
 (fused_multi_transformer_op.cu CacheKV decode, §2.4) and the beam/sampling
 decode helpers. TPU-native design: ONE jitted prefill program + ONE jitted
-per-token decode program (shapes static, caches donated so XLA updates
-them in place in HBM); the Python loop only feeds back the sampled token.
+whole-decode program — the entire token loop is a `lax.scan` inside the
+compiled program (eos masking included), so generating N tokens costs a
+single host->device dispatch instead of N round-trips. Over a tunneled
+or remote chip the per-step host sync would otherwise dominate decode.
+Caches are donated so XLA updates them in place in HBM.
 
 Works with any model exposing:
   forward(ids, caches, pos) -> (logits, caches)   (cache-threaded forward)
@@ -19,6 +22,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from ..core.tensor import Tensor
 from ..jit.functional import functional_call, raw_state
@@ -61,6 +65,8 @@ def generate(model, input_ids, max_new_tokens: int = 32,
     if ids.ndim == 1:
         ids = ids[None]
     B, P = ids.shape
+    if max_new_tokens <= 0:
+        return ids
     total = P + max_new_tokens
     max_len = getattr(getattr(model, "cfg", None), "max_seq_len", None)
     if max_len is not None and total > max_len:
@@ -88,7 +94,11 @@ def generate(model, input_ids, max_new_tokens: int = 32,
         # greedy ignores the sampling knobs — don't let them split the key
         sampling = ((float(temperature), int(top_k), float(top_p))
                     if do_sample else None)
-        prog_key = (B, P, total, str(cache_dtype), sampling)
+        # total already encodes max_new_tokens (= P + new); eos is baked
+        # into the compiled scan, so it distinguishes programs too
+        prog_key = (B, P, total, str(cache_dtype), sampling,
+                    None if eos_token_id is None else int(eos_token_id))
+        eos = eos_token_id
         progs = prog_cache.get(prog_key)
         if progs is not None:
             prog_cache.move_to_end(prog_key)
@@ -101,48 +111,55 @@ def generate(model, input_ids, max_new_tokens: int = 32,
                                     temperature, top_k, top_p)
                 return nxt, caches
 
-            def step(params, buffers, tok, caches, pos, key):
-                (logits, caches), _ = functional_call(
-                    model, params, buffers, tok[:, None], caches, pos,
-                    training=False)
-                nxt = _select_token(logits[:, -1, :], key, do_sample,
-                                    temperature, top_k, top_p)
-                return nxt, caches
+            def decode_all(params, buffers, tok0, caches, key):
+                """The whole token loop as one scan: emits tok0 then
+                max_new_tokens-1 successors, eos rows frozen."""
+                fin0 = (tok0 == eos) if eos is not None \
+                    else jnp.zeros(tok0.shape, bool)
+
+                def body(carry, i):
+                    tok, caches, fin, key = carry
+                    key, sub = jax.random.split(key)
+                    (logits, caches), _ = functional_call(
+                        model, params, buffers, tok[:, None], caches,
+                        (P + i).astype(jnp.int32), training=False)
+                    nxt = _select_token(logits[:, -1, :], sub, do_sample,
+                                        temperature, top_k, top_p)
+                    if eos is not None:
+                        nxt = jnp.where(fin, eos, nxt)
+                        fin = fin | (nxt == eos)
+                    return (nxt, caches, fin, key), nxt
+
+                (_, caches, _, _), toks = lax.scan(
+                    body, (tok0, caches, fin0, key),
+                    jnp.arange(max_new_tokens - 1))
+                # [B, max_new_tokens]: the prefill token + scan
+                # emissions (int32 in-program; the host widens to int64).
+                # caches are returned solely so the donated inputs have
+                # an output to alias — callers discard them.
+                out = jnp.concatenate(
+                    [tok0[:, None], toks.T.astype(tok0.dtype)], axis=1)
+                return out, caches
 
             progs = (jax.jit(prefill, donate_argnums=(3,)),
-                     jax.jit(step, donate_argnums=(3,)))
+                     jax.jit(decode_all, donate_argnums=(3,)))
             prog_cache[prog_key] = progs
             # bounded LRU: a long-lived server with drifting prompt
             # lengths must not pin executables forever (bucket prompt
             # lengths server-side to hit this cache reliably)
             while len(prog_cache) > 16:
                 prog_cache.popitem(last=False)
-        prefill_c, step_c = progs
+        prefill_c, decode_c = progs
 
         key = jax.random.PRNGKey(seed)
         key, sub = jax.random.split(key)
         tok, caches = prefill_c(params, buffers, ids, caches, sub)
-
-        out = [ids]
-        finished = np.zeros(B, bool)
-        for i in range(max_new_tokens):
-            tok_np = np.asarray(tok)
-            if eos_token_id is not None:
-                tok_np = np.where(finished, eos_token_id, tok_np)
-                finished |= tok_np == eos_token_id
-            out.append(tok_np[:, None])
-            if i + 1 == max_new_tokens or \
-                    (eos_token_id is not None and finished.all()):
-                break
-            key, sub = jax.random.split(key)
-            tok, caches = step_c(params, buffers, jnp.asarray(tok_np),
-                                 caches, jnp.int32(P + i), sub)
-        result = np.concatenate(out, axis=1)
-        if result.shape[1] < total and eos_token_id is not None:
-            pad = np.full((B, total - result.shape[1]), eos_token_id,
-                          np.int64)
-            result = np.concatenate([result, pad], axis=1)
-        return result
+        if max_new_tokens == 1:
+            new = np.asarray(tok)[:, None]
+        else:
+            toks, _ = decode_c(params, buffers, tok, caches, key)
+            new = np.asarray(toks)
+        return np.concatenate([ids, new.astype(np.int64)], axis=1)
     finally:
         if was_training:
             model.train()
