@@ -117,8 +117,10 @@ def qr(x, mode="reduced", name=None):
 
 
 def svd(x, full_matrices=False, name=None):
+    """Reference contract (tensor/linalg.py svd docstring): returns
+    (U, S, VH) with X = U @ diag(S) @ VH — VH, not V."""
     u, s, vh = jnp.linalg.svd(x.value, full_matrices=full_matrices)
-    return Tensor(u), Tensor(s), Tensor(jnp.swapaxes(vh, -1, -2).conj())
+    return Tensor(u), Tensor(s), Tensor(vh)
 
 
 def pinv(x, rcond=1e-15, hermitian=False, name=None):

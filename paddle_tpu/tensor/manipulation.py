@@ -302,16 +302,21 @@ def take_along_axis(arr, indices, axis, broadcast=True, name=None):
 def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
     def f(v, i, u):
         u = jnp.broadcast_to(u, i.shape).astype(v.dtype)
-        if reduce == "add":
-            return jnp.put_along_axis(v, i, u, axis=int(axis), inplace=False, mode="add") \
-                if hasattr(jnp, "put_along_axis") else _put(v, i, u, "add")
-        return _put(v, i, u, "set")
+        return _put(v, i, u, "add" if reduce == "add" else "set")
+
     def _put(v, i, u, mode):
-        idx = [jnp.broadcast_to(
-            jnp.arange(v.shape[d]).reshape([-1 if dd == d else 1
-                                            for dd in range(v.ndim)]), i.shape)
-            for d in range(v.ndim)]
-        idx[int(axis)] = i
+        # numpy's _make_along_axis_idx scheme: the axis-dim index is `i`
+        # itself; every other dim uses a reshaped arange that fancy
+        # indexing broadcasts against i (so size-1 dims of i broadcast
+        # like np.put_along_axis — no explicit broadcast_to, which would
+        # reject them). jnp.put_along_axis is NOT used: its `mode` kwarg
+        # is the out-of-bounds GatherScatterMode, not an accumulate
+        # selector, so it cannot express reduce="add".
+        ax = int(axis) % v.ndim
+        idx = [i if d == ax else
+               jnp.arange(v.shape[d]).reshape([-1 if dd == d else 1
+                                               for dd in range(v.ndim)])
+               for d in range(v.ndim)]
         return v.at[tuple(idx)].add(u) if mode == "add" else v.at[tuple(idx)].set(u)
     return apply(f, arr, indices, values, _op_name="put_along_axis")
 

@@ -5,6 +5,7 @@ import jax.numpy as jnp
 
 from ..autograd.tape import apply
 from ..core.tensor import Tensor
+from ..framework.dtype import convert_dtype
 
 __all__ = ["mean", "std", "var", "median", "nanmedian", "quantile",
            "nanquantile", "histogram", "histogramdd", "bincount", "numel"]

@@ -1,0 +1,300 @@
+"""Numeric op checks for the thinnest-covered tensor modules (linalg,
+stat, search, manipulation, random) against numpy references — the
+reference's OpTest pattern (test/legacy_test/op_test.py: compare against
+a python/numpy model) applied to the long tail.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+RNG = np.random.RandomState(7)
+
+
+def T(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+# ---------------------------------------------------------------- linalg
+
+class TestLinalg:
+    A = RNG.randn(4, 4).astype("float32")
+    B = RNG.randn(4, 4).astype("float32")
+    SPD = (A @ A.T + 4 * np.eye(4)).astype("float32")
+
+    def test_solve_and_inverse(self):
+        x = paddle.linalg.solve(T(self.A), T(self.B))
+        np.testing.assert_allclose(self.A @ x.numpy(), self.B, atol=1e-4)
+        inv = paddle.linalg.inverse(T(self.A))
+        np.testing.assert_allclose(inv.numpy() @ self.A, np.eye(4),
+                                   atol=1e-4)
+
+    def test_cholesky_and_cholesky_solve(self):
+        L = paddle.linalg.cholesky(T(self.SPD)).numpy()
+        np.testing.assert_allclose(L @ L.T, self.SPD, atol=1e-4)
+        U = paddle.linalg.cholesky(T(self.SPD), upper=True).numpy()
+        np.testing.assert_allclose(U.T @ U, self.SPD, atol=1e-4)
+        y = RNG.randn(4, 2).astype("float32")
+        x = paddle.linalg.cholesky_solve(T(y), T(L)).numpy()
+        np.testing.assert_allclose(self.SPD @ x, y, atol=2e-3)
+
+    def test_qr_svd_pinv(self):
+        q, r = paddle.linalg.qr(T(self.A))
+        np.testing.assert_allclose(q.numpy() @ r.numpy(), self.A,
+                                   atol=1e-4)
+        u, s, vh = paddle.linalg.svd(T(self.A))
+        np.testing.assert_allclose(
+            (u.numpy() * s.numpy()) @ vh.numpy(), self.A, atol=1e-4)
+        p = paddle.linalg.pinv(T(self.A)).numpy()
+        np.testing.assert_allclose(self.A @ p @ self.A, self.A, atol=1e-3)
+
+    def test_eigh_det_slogdet(self):
+        w, v = paddle.linalg.eigh(T(self.SPD))
+        np.testing.assert_allclose(
+            v.numpy() @ np.diag(w.numpy()) @ v.numpy().T, self.SPD,
+            atol=1e-3)
+        det = float(paddle.linalg.det(T(self.A)).numpy())
+        np.testing.assert_allclose(det, np.linalg.det(self.A), rtol=1e-4)
+        sign, logd = paddle.linalg.slogdet(T(self.A))
+        ref_sign, ref_log = np.linalg.slogdet(self.A)
+        np.testing.assert_allclose(float(sign.numpy()), ref_sign)
+        np.testing.assert_allclose(float(logd.numpy()), ref_log,
+                                   rtol=1e-4)
+
+    def test_norms_and_cond(self):
+        x = RNG.randn(3, 5).astype("float32")
+        np.testing.assert_allclose(
+            paddle.linalg.norm(T(x)).numpy(), np.linalg.norm(x),
+            rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.linalg.norm(T(x), p=1, axis=1).numpy(),
+            np.abs(x).sum(1), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.linalg.matrix_norm(T(x), p="fro").numpy(),
+            np.linalg.norm(x, "fro"), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.linalg.cond(T(self.SPD)).numpy(),
+            np.linalg.cond(self.SPD), rtol=1e-3)
+
+    def test_matrix_power_rank_multi_dot(self):
+        np.testing.assert_allclose(
+            paddle.linalg.matrix_power(T(self.A), 3).numpy(),
+            np.linalg.matrix_power(self.A, 3), rtol=1e-3, atol=1e-3)
+        np.testing.assert_allclose(
+            paddle.linalg.matrix_power(T(self.A), -1).numpy(),
+            np.linalg.matrix_power(self.A, -1), rtol=1e-3, atol=1e-3)
+        low = np.outer(RNG.randn(4), RNG.randn(4)).astype("float32")
+        assert int(paddle.linalg.matrix_rank(T(low)).numpy()) == 1
+        mats = [RNG.randn(2, 3).astype("float32"),
+                RNG.randn(3, 4).astype("float32"),
+                RNG.randn(4, 2).astype("float32")]
+        np.testing.assert_allclose(
+            paddle.linalg.multi_dot([T(m) for m in mats]).numpy(),
+            mats[0] @ mats[1] @ mats[2], rtol=1e-4, atol=1e-4)
+
+    def test_triangular_solve_cross_cov(self):
+        up = np.triu(self.A) + 4 * np.eye(4, dtype=np.float32)
+        y = RNG.randn(4, 2).astype("float32")
+        x = paddle.linalg.triangular_solve(T(up), T(y)).numpy()
+        np.testing.assert_allclose(up @ x, y, atol=1e-3)
+        a = RNG.randn(3).astype("float32")
+        b = RNG.randn(3).astype("float32")
+        np.testing.assert_allclose(
+            paddle.cross(T(a), T(b)).numpy(), np.cross(a, b), rtol=1e-5)
+        d = RNG.randn(3, 50).astype("float32")
+        np.testing.assert_allclose(paddle.linalg.cov(T(d)).numpy(),
+                                   np.cov(d), rtol=1e-3, atol=1e-4)
+
+
+# ------------------------------------------------------------------ stat
+
+class TestStat:
+    x = RNG.randn(5, 7).astype("float32")
+
+    def test_std_var(self):
+        np.testing.assert_allclose(paddle.std(T(self.x)).numpy(),
+                                   self.x.std(ddof=1), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.var(T(self.x), axis=1, unbiased=False).numpy(),
+            self.x.var(1), rtol=1e-5)
+
+    def test_median_quantile(self):
+        np.testing.assert_allclose(paddle.median(T(self.x)).numpy(),
+                                   np.median(self.x), rtol=1e-6)
+        np.testing.assert_allclose(
+            paddle.median(T(self.x), axis=1).numpy(),
+            np.median(self.x, axis=1), rtol=1e-6)
+        np.testing.assert_allclose(
+            paddle.quantile(T(self.x), 0.3, axis=0).numpy(),
+            np.quantile(self.x, 0.3, axis=0), rtol=1e-5)
+
+    def test_nan_variants(self):
+        xn = self.x.copy()
+        xn[0, 0] = np.nan
+        np.testing.assert_allclose(paddle.nanmedian(T(xn)).numpy(),
+                                   np.nanmedian(xn), rtol=1e-6)
+        np.testing.assert_allclose(
+            paddle.nanquantile(T(xn), 0.5).numpy(),
+            np.nanquantile(xn, 0.5), rtol=1e-5)
+
+    def test_histogram_bincount(self):
+        v = (RNG.rand(100) * 10).astype("float32")
+        got = paddle.histogram(T(v), bins=10, min=0, max=10).numpy()
+        ref, _ = np.histogram(v, bins=10, range=(0, 10))
+        np.testing.assert_array_equal(got, ref)
+        iv = RNG.randint(0, 6, 50)
+        np.testing.assert_array_equal(
+            paddle.bincount(T(iv.astype("int64"))).numpy(),
+            np.bincount(iv))
+
+
+# --------------------------------------------------------------- search
+
+class TestSearch:
+    def test_sort_argsort_topk(self):
+        x = RNG.randn(4, 6).astype("float32")
+        np.testing.assert_allclose(
+            paddle.sort(T(x), axis=1, descending=True).numpy(),
+            -np.sort(-x, axis=1), rtol=1e-6)
+        np.testing.assert_array_equal(
+            paddle.argsort(T(x), axis=0).numpy(), np.argsort(x, axis=0))
+        vals, idx = paddle.topk(T(x), k=3, axis=1)
+        ref = -np.sort(-x, axis=1)[:, :3]
+        np.testing.assert_allclose(vals.numpy(), ref, rtol=1e-6)
+
+    def test_searchsorted_kthvalue_mode(self):
+        edges = np.array([1.0, 3.0, 5.0, 7.0], np.float32)
+        q = np.array([0.5, 3.0, 8.0], np.float32)
+        np.testing.assert_array_equal(
+            paddle.searchsorted(T(edges), T(q)).numpy(),
+            np.searchsorted(edges, q))
+        x = RNG.randn(3, 8).astype("float32")
+        v, i = paddle.kthvalue(T(x), k=2, axis=1)
+        np.testing.assert_allclose(v.numpy(), np.sort(x, 1)[:, 1],
+                                   rtol=1e-6)
+        m = np.array([[1, 2, 2, 3], [4, 4, 5, 4]], np.int64)
+        mv, _ = paddle.mode(T(m), axis=1)
+        np.testing.assert_array_equal(mv.numpy(), [2, 4])
+
+    def test_masked_select_index_sample(self):
+        x = RNG.randn(3, 4).astype("float32")
+        mask = x > 0
+        np.testing.assert_allclose(
+            paddle.masked_select(T(x), T(mask)).numpy(), x[mask],
+            rtol=1e-6)
+        idx = np.array([[0, 2], [1, 3], [0, 0]], np.int64)
+        got = paddle.index_sample(T(x), T(idx)).numpy()
+        np.testing.assert_allclose(
+            got, np.take_along_axis(x, idx, axis=1), rtol=1e-6)
+
+
+# ---------------------------------------------------------- manipulation
+
+class TestManipulation:
+    def test_roll_rot90_flip(self):
+        x = RNG.randn(3, 4).astype("float32")
+        np.testing.assert_allclose(
+            paddle.roll(T(x), shifts=2, axis=1).numpy(),
+            np.roll(x, 2, axis=1), rtol=1e-6)
+        np.testing.assert_allclose(paddle.rot90(T(x)).numpy(),
+                                   np.rot90(x), rtol=1e-6)
+        np.testing.assert_allclose(paddle.flip(T(x), axis=[0]).numpy(),
+                                   np.flip(x, 0), rtol=1e-6)
+
+    def test_take_along_put_along(self):
+        x = RNG.randn(3, 5).astype("float32")
+        idx = RNG.randint(0, 5, (3, 2)).astype("int64")
+        np.testing.assert_allclose(
+            paddle.take_along_axis(T(x), T(idx), axis=1).numpy(),
+            np.take_along_axis(x, idx, 1), rtol=1e-6)
+        vals = np.full((3, 2), 9.0, np.float32)
+        ref = x.copy()
+        np.put_along_axis(ref, idx, vals, axis=1)
+        got = paddle.put_along_axis(T(x), T(idx), T(vals), axis=1).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-6)
+        # reduce="add" accumulates; duplicate indices accumulate too
+        idx2 = np.array([[1, 1], [0, 2], [4, 4]], np.int64)
+        ref2 = x.copy()
+        for r in range(3):
+            for c in range(2):
+                ref2[r, idx2[r, c]] += 9.0
+        got2 = paddle.put_along_axis(T(x), T(idx2), T(vals), axis=1,
+                                     reduce="add").numpy()
+        np.testing.assert_allclose(got2, ref2, rtol=1e-6)
+        # broadcastable size-1 non-axis dim (np.put_along_axis semantics)
+        idx3 = np.array([[0, 3]], np.int64)
+        ref3 = x.copy()
+        np.put_along_axis(ref3, idx3, np.float32(7.0), axis=1)
+        got3 = paddle.put_along_axis(T(x), T(idx3), T(np.full((1, 2), 7.0,
+                                     np.float32)), axis=1).numpy()
+        np.testing.assert_allclose(got3, ref3, rtol=1e-6)
+
+    def test_repeat_interleave_tile_unique(self):
+        x = np.array([[1, 2], [3, 4]], np.float32)
+        np.testing.assert_allclose(
+            paddle.repeat_interleave(T(x), 2, axis=0).numpy(),
+            np.repeat(x, 2, axis=0), rtol=1e-6)
+        np.testing.assert_allclose(paddle.tile(T(x), [2, 3]).numpy(),
+                                   np.tile(x, (2, 3)), rtol=1e-6)
+        v = np.array([3, 1, 2, 1, 3], np.int64)
+        np.testing.assert_array_equal(paddle.unique(T(v)).numpy(),
+                                      np.unique(v))
+
+    def test_chunk_unbind_stack_splits(self):
+        x = RNG.randn(6, 4).astype("float32")
+        parts = paddle.chunk(T(x), 3, axis=0)
+        assert len(parts) == 3
+        np.testing.assert_allclose(parts[1].numpy(), x[2:4], rtol=1e-6)
+        cols = paddle.unbind(T(x), axis=1)
+        assert len(cols) == 4
+        np.testing.assert_allclose(cols[2].numpy(), x[:, 2], rtol=1e-6)
+        np.testing.assert_allclose(
+            paddle.concat([T(x[:2]), T(x[2:])], axis=0).numpy(), x,
+            rtol=1e-6)
+
+    def test_gather_scatter_nd(self):
+        x = RNG.randn(5, 3).astype("float32")
+        idx = np.array([[1], [3]], np.int64)
+        np.testing.assert_allclose(paddle.gather_nd(T(x), T(idx)).numpy(),
+                                   x[[1, 3]], rtol=1e-6)
+        upd = np.ones((2, 3), np.float32)
+        got = paddle.scatter_nd_add(T(x), T(idx), T(upd)).numpy()
+        ref = x.copy()
+        ref[[1, 3]] += 1.0
+        np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+# --------------------------------------------------------------- random
+
+class TestRandom:
+    def test_distribution_shapes_and_ranges(self):
+        paddle.seed(3)
+        u = paddle.uniform([200], min=-2.0, max=3.0).numpy()
+        assert u.min() >= -2.0 and u.max() <= 3.0
+        r = paddle.randint(0, 7, [300]).numpy()
+        # int32 under jax's default no-x64 config (paddle spells int64)
+        assert r.min() >= 0 and r.max() < 7
+        assert np.issubdtype(r.dtype, np.integer)
+        n = paddle.normal(mean=1.0, std=2.0, shape=[2000]).numpy()
+        assert abs(n.mean() - 1.0) < 0.2 and abs(n.std() - 2.0) < 0.2
+        p = paddle.randperm(50).numpy()
+        np.testing.assert_array_equal(np.sort(p), np.arange(50))
+
+    def test_multinomial_bernoulli_poisson(self):
+        paddle.seed(4)
+        probs = paddle.to_tensor(np.array([0.0, 0.7, 0.3], np.float32))
+        draws = paddle.multinomial(probs, 200, replacement=True).numpy()
+        assert 0 not in draws
+        b = paddle.bernoulli(paddle.to_tensor(
+            np.full((1000,), 0.25, np.float32))).numpy()
+        assert abs(b.mean() - 0.25) < 0.08
+        lam = paddle.to_tensor(np.full((2000,), 3.0, np.float32))
+        pois = paddle.poisson(lam).numpy()
+        assert abs(pois.mean() - 3.0) < 0.3
+
+    def test_seed_reproducibility(self):
+        paddle.seed(11)
+        a = paddle.randn([16]).numpy()
+        paddle.seed(11)
+        b = paddle.randn([16]).numpy()
+        np.testing.assert_array_equal(a, b)
