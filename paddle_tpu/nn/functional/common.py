@@ -137,7 +137,49 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
         out_sp = tuple(int(round(s * f)) for s, f in zip(spatial, sf))
     jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
              "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+    linear_like = mode in ("bilinear", "linear", "trilinear")
+    if align_corners and not linear_like and mode != "nearest":
+        raise NotImplementedError(
+            f"interpolate mode={mode!r} with align_corners=True is not "
+            "implemented (half-pixel centers only); linear/bilinear/"
+            "trilinear and nearest support corner alignment")
+
     def f(vv):
+        ax0 = 2 if cf else 1
+        if mode == "nearest":
+            # paddle/torch nearest is the ASYMMETRIC grid
+            # src = floor(dst * in/out) (align_corners: round over the
+            # corner-aligned ratio) — NOT jax.image.resize's half-pixel
+            # centers, which shift every sample
+            out = vv
+            for d, o in enumerate(out_sp):
+                n = out.shape[ax0 + d]
+                if align_corners and o > 1:
+                    # paddle rounds half AWAY from zero
+                    # (static_cast<int>(ratio*k + 0.5)), not banker's
+                    idx = jnp.floor(
+                        jnp.arange(o) * ((n - 1) / (o - 1)) + 0.5)
+                else:
+                    idx = jnp.floor(jnp.arange(o) * (n / o))
+                out = jnp.take(out, idx.astype(jnp.int32), axis=ax0 + d)
+            return out
+        if align_corners and linear_like:
+            # src = dst * (in-1)/(out-1): separable two-tap gather
+            out = vv
+            for d, o in enumerate(out_sp):
+                axis = ax0 + d
+                n = out.shape[axis]
+                pos = (jnp.arange(o) * ((n - 1) / (o - 1))
+                       if o > 1 else jnp.zeros((o,)))
+                lo = jnp.clip(jnp.floor(pos), 0, n - 1).astype(jnp.int32)
+                hi = jnp.clip(lo + 1, 0, n - 1)
+                w = (pos - lo).astype(vv.dtype)
+                shape = [1] * out.ndim
+                shape[axis] = o
+                w = w.reshape(shape)
+                out = (jnp.take(out, lo, axis=axis) * (1 - w)
+                       + jnp.take(out, hi, axis=axis) * w)
+            return out
         if cf:
             out_shape = vv.shape[:2] + out_sp
         else:

@@ -301,8 +301,18 @@ def take_along_axis(arr, indices, axis, broadcast=True, name=None):
 
 def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
     def f(v, i, u):
-        u = jnp.broadcast_to(u, i.shape).astype(v.dtype)
-        return _put(v, i, u, "add" if reduce == "add" else "set")
+        # numpy semantics: indices and values broadcast against EACH
+        # OTHER (values may be wider than size-1 index dims)
+        bshape = jnp.broadcast_shapes(i.shape, jnp.shape(u))
+        i = jnp.broadcast_to(i, bshape)
+        u = jnp.broadcast_to(u, bshape).astype(v.dtype)
+        ops = {"assign": "set", "add": "add",
+               "mul": "mul", "multiply": "mul"}
+        if reduce not in ops:
+            raise NotImplementedError(
+                f"put_along_axis reduce={reduce!r} is not supported "
+                "(assign/add/mul are)")
+        return _put(v, i, u, ops[reduce])
 
     def _put(v, i, u, mode):
         # numpy's _make_along_axis_idx scheme: the axis-dim index is `i`
@@ -317,7 +327,9 @@ def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
                jnp.arange(v.shape[d]).reshape([-1 if dd == d else 1
                                                for dd in range(v.ndim)])
                for d in range(v.ndim)]
-        return v.at[tuple(idx)].add(u) if mode == "add" else v.at[tuple(idx)].set(u)
+        ref = v.at[tuple(idx)]
+        return (ref.add(u) if mode == "add"
+                else ref.multiply(u) if mode == "mul" else ref.set(u))
     return apply(f, arr, indices, values, _op_name="put_along_axis")
 
 

@@ -1,0 +1,203 @@
+"""Numeric checks for thin-coverage nn.functional modules (common,
+activation, loss) against torch (CPU, baked into the image) or numpy
+references — the reference's OpTest convention for the functional tail.
+"""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as tF
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+RNG = np.random.RandomState(11)
+
+
+def T(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+def tt(a):
+    return torch.from_numpy(np.asarray(a))
+
+
+class TestCommon:
+    def test_pad_modes(self):
+        x = RNG.randn(1, 2, 4, 5).astype("float32")
+        for mode in ("constant", "reflect", "replicate", "circular"):
+            got = F.pad(T(x), [1, 2, 2, 1], mode=mode, value=3.0).numpy()
+            ref = tF.pad(tt(x), (1, 2, 2, 1), mode=mode,
+                         value=3.0 if mode == "constant" else 0.0).numpy()
+            np.testing.assert_allclose(got, ref, rtol=1e-6,
+                                       err_msg=mode)
+
+    def test_interpolate_modes(self):
+        x = RNG.randn(1, 3, 6, 6).astype("float32")
+        for mode, kw in (("nearest", {}), ("bilinear", {}),
+                         ("bilinear", {"align_corners": True})):
+            got = F.interpolate(T(x), size=[9, 11], mode=mode,
+                                **kw).numpy()
+            ref = tF.interpolate(tt(x), size=(9, 11), mode=mode,
+                                 **kw).numpy()
+            np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5,
+                                       err_msg=f"{mode} {kw}")
+
+    def test_unfold_fold_roundtrip(self):
+        x = RNG.randn(2, 3, 8, 8).astype("float32")
+        u = F.unfold(T(x), kernel_sizes=3, strides=1, paddings=1)
+        ref = tF.unfold(tt(x), 3, padding=1).numpy()
+        np.testing.assert_allclose(u.numpy(), ref, rtol=1e-6)
+        folded = F.fold(u, output_sizes=[8, 8], kernel_sizes=3,
+                        strides=1, paddings=1)
+        ref_f = tF.fold(tt(ref), (8, 8), 3, padding=1).numpy()
+        np.testing.assert_allclose(folded.numpy(), ref_f, rtol=1e-5)
+
+    def test_pixel_shuffle_channel_shuffle(self):
+        x = RNG.randn(1, 8, 3, 3).astype("float32")
+        np.testing.assert_allclose(
+            F.pixel_shuffle(T(x), 2).numpy(),
+            tF.pixel_shuffle(tt(x), 2).numpy(), rtol=1e-6)
+        y = F.pixel_unshuffle(F.pixel_shuffle(T(x), 2), 2)
+        np.testing.assert_allclose(y.numpy(), x, rtol=1e-6)
+        cs = F.channel_shuffle(T(x), 4).numpy()
+        ref = x.reshape(1, 4, 2, 3, 3).transpose(0, 2, 1, 3, 4).reshape(
+            1, 8, 3, 3)
+        np.testing.assert_allclose(cs, ref, rtol=1e-6)
+
+    def test_cosine_similarity_pairwise_distance_normalize(self):
+        a = RNG.randn(4, 6).astype("float32")
+        b = RNG.randn(4, 6).astype("float32")
+        np.testing.assert_allclose(
+            F.cosine_similarity(T(a), T(b), axis=1).numpy(),
+            tF.cosine_similarity(tt(a), tt(b), dim=1).numpy(),
+            rtol=1e-5)
+        np.testing.assert_allclose(
+            F.pairwise_distance(T(a), T(b)).numpy(),
+            tF.pairwise_distance(tt(a), tt(b)).numpy(), rtol=1e-5)
+        np.testing.assert_allclose(
+            F.normalize(T(a), p=2, axis=1).numpy(),
+            tF.normalize(tt(a), p=2.0, dim=1).numpy(), rtol=1e-5)
+
+    def test_bilinear_label_smooth_one_hot(self):
+        x1 = RNG.randn(3, 4).astype("float32")
+        x2 = RNG.randn(3, 5).astype("float32")
+        w = RNG.randn(6, 4, 5).astype("float32")
+        bias = RNG.randn(1, 6).astype("float32")
+        got = F.bilinear(T(x1), T(x2), T(w), T(bias)).numpy()
+        ref = tF.bilinear(tt(x1), tt(x2), tt(w),
+                          tt(bias[0])).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+        lab = np.eye(4, dtype="float32")[[0, 2]]
+        np.testing.assert_allclose(
+            F.label_smooth(T(lab), epsilon=0.2).numpy(),
+            lab * 0.8 + 0.2 / 4, rtol=1e-6)
+        oh = F.one_hot(T(np.array([1, 3], np.int64)), 5).numpy()
+        assert oh.shape == (2, 5) and oh[0, 1] == 1 and oh[1, 3] == 1
+
+
+class TestActivation:
+    x = RNG.randn(3, 7).astype("float32")
+
+    @pytest.mark.parametrize("ours,theirs", [
+        (lambda x: F.relu6(x), lambda x: tF.relu6(x)),
+        (lambda x: F.gelu(x), lambda x: tF.gelu(x)),
+        (lambda x: F.gelu(x, approximate=True),
+         lambda x: tF.gelu(x, approximate="tanh")),
+        (lambda x: F.silu(x), lambda x: tF.silu(x)),
+        (lambda x: F.elu(x, alpha=0.7), lambda x: tF.elu(x, 0.7)),
+        (lambda x: F.selu(x), lambda x: tF.selu(x)),
+        (lambda x: F.celu(x, alpha=1.3), lambda x: tF.celu(x, 1.3)),
+        (lambda x: F.hardswish(x), lambda x: tF.hardswish(x)),
+        (lambda x: F.hardtanh(x, -0.5, 0.4),
+         lambda x: tF.hardtanh(x, -0.5, 0.4)),
+        (lambda x: F.hardshrink(x, 0.3),
+         lambda x: tF.hardshrink(x, 0.3)),
+        (lambda x: F.softshrink(x, 0.3),
+         lambda x: tF.softshrink(x, 0.3)),
+        (lambda x: F.tanhshrink(x), lambda x: tF.tanhshrink(x)),
+        (lambda x: F.softplus(x, beta=2.0),
+         lambda x: tF.softplus(x, beta=2.0)),
+        (lambda x: F.softsign(x), lambda x: tF.softsign(x)),
+        (lambda x: F.mish(x), lambda x: tF.mish(x)),
+        (lambda x: F.log_sigmoid(x), lambda x: tF.logsigmoid(x)),
+        (lambda x: F.leaky_relu(x, 0.2),
+         lambda x: tF.leaky_relu(x, 0.2)),
+    ])
+    def test_matches_torch(self, ours, theirs):
+        got = ours(T(self.x)).numpy()
+        ref = theirs(tt(self.x)).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+    def test_glu_maxout_prelu_thresholded(self):
+        x = RNG.randn(2, 6).astype("float32")
+        np.testing.assert_allclose(F.glu(T(x), axis=1).numpy(),
+                                   tF.glu(tt(x), dim=1).numpy(),
+                                   rtol=1e-5)
+        x4 = RNG.randn(1, 4, 2, 2).astype("float32")
+        mo = F.maxout(T(x4), groups=2, axis=1).numpy()
+        ref = x4.reshape(1, 2, 2, 2, 2).max(2)
+        np.testing.assert_allclose(mo, ref, rtol=1e-6)
+        w = np.array([0.15], np.float32)
+        np.testing.assert_allclose(
+            F.prelu(T(x4), T(w)).numpy(),
+            tF.prelu(tt(x4), tt(w)).numpy(), rtol=1e-5)
+        thr = F.thresholded_relu(T(x), threshold=0.3).numpy()
+        np.testing.assert_allclose(thr, np.where(x > 0.3, x, 0.0),
+                                   rtol=1e-6)
+
+
+class TestLoss:
+    def test_smooth_l1_huber_kl(self):
+        a = RNG.randn(4, 3).astype("float32")
+        b = RNG.randn(4, 3).astype("float32")
+        np.testing.assert_allclose(
+            F.smooth_l1_loss(T(a), T(b)).numpy(),
+            tF.smooth_l1_loss(tt(a), tt(b)).numpy(), rtol=1e-5)
+        logp = tF.log_softmax(tt(a), dim=1).numpy()
+        q = tF.softmax(tt(b), dim=1).numpy()
+        np.testing.assert_allclose(
+            F.kl_div(T(logp), T(q), reduction="batchmean").numpy(),
+            tF.kl_div(tt(logp), tt(q), reduction="batchmean").numpy(),
+            rtol=1e-5)
+
+    def test_margin_and_cosine_losses(self):
+        a = RNG.randn(5, 4).astype("float32")
+        b = RNG.randn(5, 4).astype("float32")
+        y = np.sign(RNG.randn(5)).astype("float32")
+        np.testing.assert_allclose(
+            F.cosine_embedding_loss(T(a), T(b), T(y)).numpy(),
+            tF.cosine_embedding_loss(tt(a), tt(b), tt(y)).numpy(),
+            rtol=1e-5)
+        x1 = RNG.randn(5).astype("float32")
+        x2 = RNG.randn(5).astype("float32")
+        yy = np.ones(5, np.float32)
+        np.testing.assert_allclose(
+            F.margin_ranking_loss(T(x1), T(x2), T(yy)).numpy(),
+            tF.margin_ranking_loss(tt(x1), tt(x2), tt(yy)).numpy(),
+            rtol=1e-5)
+        anchor = RNG.randn(4, 8).astype("float32")
+        pos = RNG.randn(4, 8).astype("float32")
+        neg = RNG.randn(4, 8).astype("float32")
+        np.testing.assert_allclose(
+            F.triplet_margin_loss(T(anchor), T(pos), T(neg)).numpy(),
+            tF.triplet_margin_loss(tt(anchor), tt(pos),
+                                   tt(neg)).numpy(), rtol=1e-5)
+
+    def test_nll_poisson_soft_margin(self):
+        logits = RNG.randn(6, 5).astype("float32")
+        labels = RNG.randint(0, 5, 6).astype("int64")
+        logp = tF.log_softmax(tt(logits), dim=1).numpy()
+        np.testing.assert_allclose(
+            F.nll_loss(T(logp), T(labels)).numpy(),
+            tF.nll_loss(tt(logp), tt(labels)).numpy(), rtol=1e-5)
+        lam = np.abs(RNG.randn(8).astype("float32")) + 0.1
+        tgt = RNG.poisson(2.0, 8).astype("float32")
+        np.testing.assert_allclose(
+            F.poisson_nll_loss(T(lam), T(tgt), log_input=False).numpy(),
+            tF.poisson_nll_loss(tt(lam), tt(tgt),
+                                log_input=False).numpy(), rtol=1e-4)
+        x = RNG.randn(7).astype("float32")
+        yy = np.sign(RNG.randn(7)).astype("float32")
+        np.testing.assert_allclose(
+            F.soft_margin_loss(T(x), T(yy)).numpy(),
+            tF.soft_margin_loss(tt(x), tt(yy)).numpy(), rtol=1e-5)
