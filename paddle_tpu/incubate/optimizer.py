@@ -103,5 +103,17 @@ class ModelAverage:
             if id(p) in self._backup:
                 p.value = self._backup.pop(id(p))
 
+    def clear_grad(self, set_to_zero=True):
+        """Parity: ModelAverage extends Optimizer in the reference, so
+        trainers call its clear_grad alongside the inner optimizer's.
+        set_to_zero=True zero-fills existing grads; False releases."""
+        for p in self._params:
+            if set_to_zero and p._grad is not None:
+                p._grad = jnp.zeros_like(p._grad)
+            else:
+                p.clear_grad()
+
+    clear_gradients = clear_grad
+
     def minimize(self, loss):
         self.step()

@@ -218,8 +218,14 @@ class Optimizer:
         return None, [(p, p.grad) for p in self._parameter_list]
 
     def clear_grad(self, set_to_zero=False):
+        """set_to_zero=True keeps a zero gradient buffer (reference
+        semantics: zero-fill vs release); False releases (_grad=None)."""
+        import jax.numpy as _jnp
         for p in self._parameter_list:
-            p.clear_grad()
+            if set_to_zero and p._grad is not None:
+                p._grad = _jnp.zeros_like(p._grad)
+            else:
+                p.clear_grad()
 
     clear_gradients = clear_grad
 

@@ -1,0 +1,180 @@
+"""Numeric checks for incubate's lazy long tail, geometric message
+passing, and nn.utils — the thinnest-covered non-subprocess modules.
+Reference patterns: test_segment_ops / test_graph_send_recv /
+test_lookahead / incubate softmax_mask_fuse tests.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.incubate as incubate
+import paddle_tpu.geometric as G
+import paddle_tpu.nn as nn
+
+RNG = np.random.RandomState(5)
+
+
+def T(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestSegmentAndGraph:
+    x = RNG.randn(6, 3).astype("float32")
+    seg = np.array([0, 0, 1, 1, 1, 3], np.int64)
+
+    def test_segment_reductions(self):
+        got = incubate.segment_sum(T(self.x), T(self.seg)).numpy()
+        for s in range(4):
+            rows = self.x[self.seg == s]
+            ref = rows.sum(0) if len(rows) else 0.0
+            np.testing.assert_allclose(got[s], ref, rtol=1e-5,
+                                       atol=1e-6)
+        m = incubate.segment_mean(T(self.x), T(self.seg)).numpy()
+        np.testing.assert_allclose(m[1], self.x[2:5].mean(0), rtol=1e-5)
+        mx = incubate.segment_max(T(self.x), T(self.seg)).numpy()
+        np.testing.assert_allclose(mx[0], self.x[:2].max(0), rtol=1e-5)
+        mn = incubate.segment_min(T(self.x), T(self.seg)).numpy()
+        np.testing.assert_allclose(mn[1], self.x[2:5].min(0), rtol=1e-5)
+
+    def test_graph_send_recv_and_geometric(self):
+        # edges: src -> dst; dst accumulates src features
+        src = np.array([0, 1, 2, 2], np.int64)
+        dst = np.array([1, 2, 0, 1], np.int64)
+        feats = RNG.randn(3, 2).astype("float32")
+        got = incubate.graph_send_recv(T(feats), T(src), T(dst),
+                                       pool_type="sum").numpy()
+        ref = np.zeros_like(feats)
+        for s, d in zip(src, dst):
+            ref[d] += feats[s]
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+        # send_ue_recv applies the edge feature first
+        ef = RNG.randn(4, 2).astype("float32")
+        got2 = G.send_ue_recv(T(feats), T(ef), T(src), T(dst),
+                              message_op="add", reduce_op="sum").numpy()
+        ref2 = np.zeros_like(feats)
+        for e, (s, d) in enumerate(zip(src, dst)):
+            ref2[d] += feats[s] + ef[e]
+        np.testing.assert_allclose(got2, ref2, rtol=1e-5)
+        # send_uv: per-edge messages from both endpoints
+        got3 = G.send_uv(T(feats), T(feats), T(src), T(dst),
+                         message_op="mul").numpy()
+        np.testing.assert_allclose(got3, feats[src] * feats[dst],
+                                   rtol=1e-5)
+
+    def test_reindex_and_sampling(self):
+        nodes = np.array([10, 20], np.int64)
+        neigh = np.array([20, 30, 10, 40], np.int64)
+        count = np.array([2, 2], np.int32)
+        # contract (reference geometric/reindex.py): returns
+        # (reindex_src, reindex_dst, out_nodes)
+        re_src, re_dst, out_nodes = G.reindex_graph(
+            T(nodes), T(neigh), T(count))
+        mapping = {int(v): i for i, v in enumerate(out_nodes.numpy())}
+        assert mapping[10] == 0 and mapping[20] == 1
+        np.testing.assert_array_equal(
+            re_src.numpy(), [mapping[v] for v in neigh.tolist()])
+        np.testing.assert_array_equal(re_dst.numpy(), [0, 0, 1, 1])
+        # CSC graph: sample neighbors of node 0 (all of them)
+        row = np.array([1, 2, 0, 2], np.int64)     # neighbors
+        colptr = np.array([0, 2, 3, 4], np.int64)  # per-node spans
+        smp, cnt = incubate.graph_sample_neighbors(
+            T(row), T(colptr), T(np.array([0], np.int64)), sample_size=-1)
+        assert set(smp.numpy().tolist()) == {1, 2}
+        assert cnt.numpy().tolist() == [2]
+
+    def test_softmax_mask_fuse(self):
+        x = RNG.randn(1, 2, 4, 4).astype("float32")
+        mask = np.zeros((1, 1, 4, 4), np.float32)
+        mask[..., 2:] = -1e9
+        got = incubate.softmax_mask_fuse(T(x), T(mask)).numpy()
+        ref = x + mask
+        ref = np.exp(ref - ref.max(-1, keepdims=True))
+        ref = ref / ref.sum(-1, keepdims=True)
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-6)
+        tri = incubate.softmax_mask_fuse_upper_triangle(T(x)).numpy()
+        assert np.allclose(np.triu(tri[0, 0], 1), 0.0, atol=1e-6)
+        np.testing.assert_allclose(tri.sum(-1), 1.0, rtol=1e-5)
+
+    def test_identity_loss(self):
+        x = RNG.randn(5).astype("float32")
+        np.testing.assert_allclose(
+            incubate.identity_loss(T(x), reduction="mean").numpy(),
+            x.mean(), rtol=1e-6)
+        np.testing.assert_allclose(
+            incubate.identity_loss(T(x), reduction="sum").numpy(),
+            x.sum(), rtol=1e-6)
+
+
+class TestOptimizerWrappers:
+    def _toy(self):
+        paddle.seed(0)
+        m = nn.Linear(4, 1)
+        x = T(RNG.randn(16, 4).astype("float32"))
+        y = T(RNG.randn(16, 1).astype("float32"))
+        return m, x, y
+
+    def test_lookahead_trains(self):
+        m, x, y = self._toy()
+        base = paddle.optimizer.SGD(learning_rate=0.1,
+                                    parameters=m.parameters())
+        opt = incubate.LookAhead(base, alpha=0.5, k=3)
+        losses = []
+        for _ in range(8):
+            loss = paddle.mean((m(x) - y) ** 2)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+    def test_model_average_applies(self):
+        m, x, y = self._toy()
+        inner = paddle.optimizer.SGD(learning_rate=0.2,
+                                     parameters=m.parameters())
+        avg = incubate.ModelAverage(0.15, parameters=m.parameters(),
+                                    min_average_window=1,
+                                    max_average_window=10)
+        for _ in range(4):
+            loss = paddle.mean((m(x) - y) ** 2)
+            loss.backward()
+            inner.step()
+            avg.step()
+            inner.clear_grad()
+            avg.clear_grad()
+        w0 = m.parameters()[0]
+        before = w0.numpy().copy()
+        with avg.apply(need_restore=True):
+            averaged = w0.numpy().copy()
+        restored = w0.numpy()
+        assert not np.allclose(before, averaged)
+        np.testing.assert_allclose(restored, before, rtol=1e-6)
+
+
+class TestNNUtils:
+    def test_vector_roundtrip(self):
+        paddle.seed(1)
+        m = nn.Sequential(nn.Linear(3, 4), nn.Linear(4, 2))
+        vec = nn.utils.parameters_to_vector(m.parameters())
+        total = sum(int(np.prod(p.shape)) for p in m.parameters())
+        assert vec.shape == [total]
+        before = [p.numpy().copy() for p in m.parameters()]
+        nn.utils.vector_to_parameters(vec * 2.0, m.parameters())
+        for b, p in zip(before, m.parameters()):
+            np.testing.assert_allclose(p.numpy(), b * 2.0, rtol=1e-6)
+
+    def test_clip_grad_norm_and_value(self):
+        m = nn.Linear(3, 2)
+        loss = paddle.sum(m(T(np.ones((4, 3), np.float32))) ** 2)
+        loss.backward()
+        total = nn.utils.clip_grad_norm_(m.parameters(), max_norm=0.01)
+        assert float(total.numpy()) > 0.01   # pre-clip norm returned
+        gnorm = np.sqrt(sum(float((np.asarray(p._grad) ** 2).sum())
+                            for p in m.parameters()))
+        np.testing.assert_allclose(gnorm, 0.01, rtol=1e-4)
+        loss = paddle.sum(m(T(np.ones((4, 3), np.float32))) ** 2)
+        for p in m.parameters():
+            p.clear_grad()
+        loss.backward()
+        nn.utils.clip_grad_value_(m.parameters(), clip_value=0.005)
+        for p in m.parameters():
+            assert np.abs(np.asarray(p._grad)).max() <= 0.005 + 1e-8
