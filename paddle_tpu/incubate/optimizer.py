@@ -43,8 +43,8 @@ class LookAhead:
             self._slow[id(p)] = slow
             p.value = jnp.copy(slow)
 
-    def clear_grad(self):
-        self.inner_optimizer.clear_grad()
+    def clear_grad(self, set_to_zero=True):
+        self.inner_optimizer.clear_grad(set_to_zero=set_to_zero)
 
     def minimize(self, loss):
         loss.backward()

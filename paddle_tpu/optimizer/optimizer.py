@@ -217,13 +217,14 @@ class Optimizer:
         self.step()
         return None, [(p, p.grad) for p in self._parameter_list]
 
-    def clear_grad(self, set_to_zero=False):
-        """set_to_zero=True keeps a zero gradient buffer (reference
-        semantics: zero-fill vs release); False releases (_grad=None)."""
-        import jax.numpy as _jnp
+    def clear_grad(self, set_to_zero=True):
+        """Reference default: set_to_zero=True keeps a zero-filled
+        gradient buffer (ported code may read param.grad right after);
+        False releases the buffer (_grad=None) — the lighter choice for
+        donation-heavy loops."""
         for p in self._parameter_list:
             if set_to_zero and p._grad is not None:
-                p._grad = _jnp.zeros_like(p._grad)
+                p._grad = jnp.zeros_like(p._grad)
             else:
                 p.clear_grad()
 

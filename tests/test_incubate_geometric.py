@@ -162,6 +162,24 @@ class TestNNUtils:
         for b, p in zip(before, m.parameters()):
             np.testing.assert_allclose(p.numpy(), b * 2.0, rtol=1e-6)
 
+    def test_clear_grad_set_to_zero_semantics(self):
+        m = nn.Linear(3, 2)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=m.parameters())
+        la = incubate.LookAhead(opt, alpha=0.5, k=2)
+        loss = paddle.sum(m(T(np.ones((2, 3), np.float32))) ** 2)
+        loss.backward()
+        la.clear_grad(set_to_zero=True)   # forwards through LookAhead
+        g = m.parameters()[0]._grad
+        assert g is not None and float(np.abs(np.asarray(g)).max()) == 0.0
+        opt.clear_grad(set_to_zero=False)
+        assert m.parameters()[0]._grad is None
+        # default matches the reference: zero-fill
+        loss = paddle.sum(m(T(np.ones((2, 3), np.float32))) ** 2)
+        loss.backward()
+        opt.clear_grad()
+        assert m.parameters()[0]._grad is not None
+
     def test_clip_grad_norm_and_value(self):
         m = nn.Linear(3, 2)
         loss = paddle.sum(m(T(np.ones((4, 3), np.float32))) ** 2)

@@ -1,0 +1,120 @@
+"""Sparse COO/CSR op numerics vs dense references (reference:
+test/legacy_test/test_sparse_* suite pattern)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.sparse as sparse
+
+RNG = np.random.RandomState(9)
+
+
+def _coo(dense):
+    idx = np.stack(np.nonzero(dense))
+    vals = dense[tuple(idx)]
+    return sparse.sparse_coo_tensor(
+        paddle.to_tensor(idx.astype("int64")),
+        paddle.to_tensor(vals.astype("float32")), shape=list(dense.shape))
+
+
+def _dense_with_zeros(shape, density=0.4):
+    d = RNG.randn(*shape).astype("float32")
+    d[RNG.rand(*shape) > density] = 0.0
+    return d
+
+
+class TestSparseOps:
+    def test_coo_roundtrip_and_coalesce(self):
+        d = _dense_with_zeros((4, 5))
+        s = _coo(d)
+        np.testing.assert_allclose(s.to_dense().numpy(), d, rtol=1e-6)
+        # duplicate entries must sum on coalesce
+        idx = np.array([[0, 0, 1], [2, 2, 3]], np.int64)
+        vals = np.array([1.0, 2.0, 5.0], np.float32)
+        dup = sparse.sparse_coo_tensor(paddle.to_tensor(idx),
+                                       paddle.to_tensor(vals),
+                                       shape=[2, 4])
+        c = sparse.coalesce(dup)
+        dd = c.to_dense().numpy()
+        assert dd[0, 2] == 3.0 and dd[1, 3] == 5.0
+
+    def test_csr_roundtrip(self):
+        d = _dense_with_zeros((3, 6))
+        crows = [0]
+        cols, vals = [], []
+        for r in range(3):
+            nz = np.nonzero(d[r])[0]
+            cols += nz.tolist()
+            vals += d[r, nz].tolist()
+            crows.append(len(cols))
+        s = sparse.sparse_csr_tensor(
+            paddle.to_tensor(np.asarray(crows, np.int64)),
+            paddle.to_tensor(np.asarray(cols, np.int64)),
+            paddle.to_tensor(np.asarray(vals, np.float32)), shape=[3, 6])
+        np.testing.assert_allclose(s.to_dense().numpy(), d, rtol=1e-6)
+
+    def test_elementwise_and_unary(self):
+        a = _dense_with_zeros((4, 4))
+        b = _dense_with_zeros((4, 4))
+        np.testing.assert_allclose(
+            sparse.add(_coo(a), _coo(b)).to_dense().numpy(), a + b,
+            rtol=1e-5)
+        np.testing.assert_allclose(
+            sparse.subtract(_coo(a), _coo(b)).to_dense().numpy(), a - b,
+            rtol=1e-5)
+        np.testing.assert_allclose(
+            sparse.multiply(_coo(a), _coo(b)).to_dense().numpy(), a * b,
+            rtol=1e-5)
+        np.testing.assert_allclose(
+            sparse.relu(_coo(a)).to_dense().numpy(), np.maximum(a, 0),
+            rtol=1e-6)
+        np.testing.assert_allclose(
+            sparse.pow(_coo(a), 2).to_dense().numpy(), a ** 2, rtol=1e-5)
+
+    def test_matmul_mv_addmm(self):
+        a = _dense_with_zeros((3, 4))
+        dense = RNG.randn(4, 2).astype("float32")
+        np.testing.assert_allclose(
+            sparse.matmul(_coo(a), paddle.to_tensor(dense)).numpy(),
+            a @ dense, rtol=1e-5)
+        v = RNG.randn(4).astype("float32")
+        np.testing.assert_allclose(
+            sparse.mv(_coo(a), paddle.to_tensor(v)).numpy(), a @ v,
+            rtol=1e-5)
+        inp = RNG.randn(3, 2).astype("float32")
+        np.testing.assert_allclose(
+            sparse.addmm(paddle.to_tensor(inp), _coo(a),
+                         paddle.to_tensor(dense), beta=0.5,
+                         alpha=2.0).numpy(),
+            0.5 * inp + 2.0 * (a @ dense), rtol=1e-5)
+
+    def test_masked_matmul(self):
+        a = RNG.randn(3, 4).astype("float32")
+        b = RNG.randn(4, 3).astype("float32")
+        mask_d = _dense_with_zeros((3, 3))
+        mask = _coo(mask_d)
+        got = sparse.masked_matmul(paddle.to_tensor(a),
+                                   paddle.to_tensor(b), mask)
+        ref = np.where(mask_d != 0, a @ b, 0.0)
+        np.testing.assert_allclose(got.to_dense().numpy(), ref,
+                                   rtol=1e-5)
+
+    def test_reshape_transpose_cast(self):
+        d = _dense_with_zeros((2, 6))
+        np.testing.assert_allclose(
+            sparse.reshape(_coo(d), [3, 4]).to_dense().numpy(),
+            d.reshape(3, 4), rtol=1e-6)
+        np.testing.assert_allclose(
+            sparse.transpose(_coo(d), [1, 0]).to_dense().numpy(), d.T,
+            rtol=1e-6)
+        c = sparse.cast(_coo(d), value_dtype="float64")
+        assert sparse.is_sparse(c)
+        np.testing.assert_allclose(
+            np.asarray(c.to_dense().numpy(), np.float32), d, rtol=1e-6)
+
+    def test_to_sparse_and_shape_utils(self):
+        d = _dense_with_zeros((3, 3))
+        s = sparse.to_sparse_coo(paddle.to_tensor(d))
+        assert sparse.is_sparse(s)
+        np.testing.assert_allclose(s.to_dense().numpy(), d, rtol=1e-6)
+        assert sparse.is_same_shape(s, _coo(d))
