@@ -1,0 +1,175 @@
+"""Profile one bench training step and print the op-time breakdown.
+
+VERDICT r2 item 2 infrastructure: run the GPT bench TrainStep under the
+XLA profiler, parse the xplane trace, and report where the step time
+goes (matmul vs attention vs collectives vs elementwise) — the input to
+"attack the largest non-matmul slice".
+
+Run on TPU:  python tools/profile_step.py
+CPU smoke:   env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+                 python tools/profile_step.py --smoke
+Prints a category table + top ops, and one JSON summary line last.
+"""
+import argparse
+import collections
+import glob
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def _device_plane_breakdown(logdir):
+    """Aggregate op durations from the device lanes of the chrome trace
+    jax.profiler writes (stdlib gzip+json — no tensorboard needed).
+
+    Returns (per_op_us Counter, op_category dict, had_device bool). On a
+    CPU backend there is no device plane; the caller degrades to a
+    wall-time-only report (the tool's breakdown is for TPU runs)."""
+    import gzip
+    per_op = collections.Counter()
+    op_cat = {}
+    had_device = False
+    for path in glob.glob(os.path.join(logdir, "**", "*.trace.json.gz"),
+                          recursive=True):
+        with gzip.open(path) as f:
+            evs = json.load(f).get("traceEvents", [])
+        device_pids = {
+            e["pid"] for e in evs
+            if e.get("ph") == "M" and e.get("name") == "process_name"
+            and "/device:" in str(e.get("args", {}).get("name", ""))}
+        if not device_pids:
+            continue
+        had_device = True
+        # Only the "XLA Ops" lane holds per-op events; the "Steps" and
+        # "XLA Modules" lanes carry whole-step spans that would double
+        # every total if summed alongside.
+        op_tids = {
+            (e["pid"], e.get("tid")) for e in evs
+            if e.get("ph") == "M" and e.get("name") == "thread_name"
+            and e.get("pid") in device_pids
+            and "XLA Ops" in str(e.get("args", {}).get("name", ""))}
+        for e in evs:
+            if e.get("ph") != "X" or e.get("pid") not in device_pids:
+                continue
+            if op_tids and (e["pid"], e.get("tid")) not in op_tids:
+                continue
+            name = e.get("name", "?")
+            per_op[name] += float(e.get("dur", 0.0))     # us
+            args = e.get("args") or {}
+            cat = args.get("hlo_category") or args.get("category")
+            if cat:
+                op_cat[name] = cat
+    return per_op, op_cat, had_device
+
+
+def _category_of(name, op_cat):
+    if name in op_cat and op_cat[name]:
+        return op_cat[name]
+    n = name.lower()
+    for pat, cat in (("dot", "matmul"), ("conv", "conv"),
+                     ("all-reduce", "collective"),
+                     ("all-gather", "collective"),
+                     ("reduce-scatter", "collective"),
+                     ("collective-permute", "collective"),
+                     ("custom-call", "custom-call (pallas/lib)"),
+                     ("fusion", "fusion"), ("copy", "copy"),
+                     ("scatter", "scatter/gather"),
+                     ("gather", "scatter/gather"),
+                     ("reduce", "reduce"), ("sort", "sort")):
+        if pat in n:
+            return cat
+    return "other"
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true", help="tiny model, CPU")
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--top", type=int, default=15)
+    args = ap.parse_args()
+
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    if args.smoke:
+        seq, batch = 128, 2
+        cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
+                        num_heads=4, max_seq_len=seq)
+    else:
+        seq, batch = 1024, 8
+        cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                        num_heads=12, max_seq_len=seq)
+
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    if not args.smoke:
+        model.bfloat16()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 multi_precision=not args.smoke,
+                                 parameters=model.parameters())
+    step = TrainStep(model, GPTForCausalLM.loss_fn, opt)
+    ids = paddle.to_tensor(np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (batch, seq)).astype("int64"))
+
+    for _ in range(3):           # compile + warm
+        loss = step(ids, ids)
+    float(loss)
+
+    logdir = tempfile.mkdtemp(prefix="paddle_tpu_profile_")
+    jax.profiler.start_trace(logdir)
+    t0 = time.perf_counter()          # bare steps only: trace start/stop
+    for _ in range(args.steps):       # serialization must not pollute
+        loss = step(ids, ids)         # the wall number vs bench.py
+    float(loss)
+    wall = (time.perf_counter() - t0) / args.steps
+    jax.profiler.stop_trace()
+
+    per_op, op_cat, had_device = _device_plane_breakdown(logdir)
+    total_us = sum(per_op.values())
+    cats = collections.Counter()
+    for name, us in per_op.items():
+        cats[_category_of(name, op_cat)] += us
+
+    if had_device:
+        print(f"\n== category breakdown ({args.steps} steps, device "
+              f"planes, total {total_us/1e3:.2f} ms) ==")
+        for cat, us in cats.most_common():
+            print(f"  {cat:<28} {us/1e3:9.2f} ms  "
+                  f"{100*us/max(total_us, 1e-9):5.1f}%")
+        print(f"\n== top {args.top} ops ==")
+        for name, us in per_op.most_common(args.top):
+            print(f"  {name[:64]:<64} {us/1e3:9.2f} ms "
+                  f"[{_category_of(name, op_cat)}]")
+    else:
+        print("\n(no device plane in trace — CPU backend records host "
+              "events only; run on TPU for the op breakdown)")
+
+    biggest_non_matmul = next(
+        (c for c, _ in cats.most_common()
+         if not any(k in c.lower()
+                    for k in ("matmul", "conv", "fusion", "dot"))), "n/a")
+    print()
+    print(json.dumps({
+        "metric": "gpt_step_profile",
+        "ms_per_step_wall": round(wall * 1e3, 2),
+        "device_total_ms": round(total_us / 1e3, 2),
+        "had_device_plane": had_device,
+        "categories_ms": {c: round(us / 1e3, 2)
+                          for c, us in cats.most_common()},
+        "biggest_non_matmul_category": biggest_non_matmul,
+        "logdir": logdir,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
