@@ -15,7 +15,8 @@ _current = None
 
 
 def get_all_devices():
-    return jax.devices()
+    """Device strings ("tpu:0", ...)."""
+    return get_available_device()
 
 
 def set_device(device):

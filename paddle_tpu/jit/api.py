@@ -189,8 +189,7 @@ class StaticFunction:
             n_bufs = len(buf_items)
             param_tensors = [p for _, p in param_items]
             flat_in = param_tensors + [b for _, b in buf_items] + arrays
-            outs = _tape.apply(lambda *f: tuple(jitted(*f)), *flat_in,
-                               _op_name="jit_program")
+            outs = _apply_traced(jitted, flat_in)
             out_tree = jitted._out_tree_box["tree"]
             if n_bufs:
                 out_leaves, buf_outs = outs[:len(outs) - n_bufs], outs[-n_bufs:]
@@ -203,8 +202,7 @@ class StaticFunction:
             return _retree_tensors(out)
         else:
             jitted = self._get_jitted(statics, is_dyn, treedef, 0, 0, None)
-            outs = _tape.apply(lambda *f: tuple(jitted(*f)), *arrays,
-                               _op_name="jit_program")
+            outs = _apply_traced(jitted, arrays)
             out_tree = jitted._out_tree_box["tree"]
             out = jax.tree_util.tree_unflatten(out_tree, list(outs))
             return _retree_tensors(out)
@@ -231,6 +229,30 @@ class StaticFunction:
 
 # tree re-wrap shares functional._wrap (Tensor leaves pass through)
 _retree_tensors = _wrap
+
+
+def _apply_traced(jitted, flat_in):
+    """Run the jitted program through the tape, translating jax's
+    data-dependent-control-flow tracing errors into guidance naming the
+    combinators (the role of the reference's dy2static transformer error
+    messages, python/paddle/jit/dy2static/error.py)."""
+    try:
+        return _tape.apply(lambda *f: tuple(jitted(*f)), *flat_in,
+                           _op_name="jit_program")
+    except (jax.errors.ConcretizationTypeError,
+            jax.errors.TracerArrayConversionError,
+            jax.errors.TracerIntegerConversionError) as e:
+        kind = ("a Python `if`/`while` condition" if isinstance(
+            e, jax.errors.TracerBoolConversionError) else "a Python value")
+        raise RuntimeError(
+            "to_static: the traced function used a Tensor whose value is "
+            f"only known at run time as {kind}. A traced XLA program "
+            "cannot branch on data in Python — use the in-program "
+            "control-flow combinators instead: paddle.static.nn.cond / "
+            "while_loop / case / switch_case (they lower to lax.cond / "
+            "lax.while_loop / lax.switch). Reference parity: "
+            "python/paddle/static/nn/control_flow.py."
+        ) from e
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,

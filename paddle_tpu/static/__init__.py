@@ -22,8 +22,9 @@ from ..jit.api import InputSpec, TranslatedLayer
 from ..jit.api import load as _jit_load
 from ..jit.api import save as _jit_save
 from ..nn.layer_base import Layer, ParamAttr
+from . import nn
 
-__all__ = ["InputSpec", "save_inference_model", "load_inference_model",
+__all__ = ["nn", "InputSpec", "save_inference_model", "load_inference_model",
            "Program", "Executor", "default_main_program",
            "default_startup_program", "program_guard", "data",
            "Variable", "BuildStrategy", "ExecutionStrategy", "CompiledProgram", "ParallelExecutor", "IpuCompiledProgram", "IpuStrategy", "ipu_shard_guard", "set_ipu_shard", "WeightNormParamAttr", "ExponentialMovingAverage", "create_parameter", "create_global_var", "accuracy", "auc", "ctr_metric_bundle", "Print", "py_func", "cpu_places", "cuda_places", "npu_places", "xpu_places", "mlu_places", "global_scope", "scope_guard", "name_scope", "device_guard", "append_backward", "gradients", "exponential_decay", "serialize_program", "deserialize_program", "serialize_persistables", "deserialize_persistables", "normalize_program", "save", "load", "load_program_state", "set_program_state", "save_to_file", "load_from_file"]

@@ -1,0 +1,313 @@
+"""paddle.static.nn control-flow combinators, TPU-native.
+
+Parity: python/paddle/static/nn/control_flow.py — `cond` (:873),
+`while_loop` (:401), `case` (:564), `switch_case` (:697), `Assert` (:43),
+backed in the reference by the conditional_block/while ops
+(paddle/fluid/operators/controlflow/conditional_block_op.cc, while_op.cc).
+
+TPU-first design: there is no Program IR to splice sub-blocks into. With
+concrete (eager) values the chosen branch simply runs — the define-by-run
+tape records it, so gradients flow through whichever branch executed
+(matching the reference's dygraph fast path). Inside a traced program
+(`paddle.jit.to_static`, `TrainStep`, `jax.jit`) the predicate is an
+abstract tracer, and the combinators lower to XLA's native control flow:
+`lax.cond` / `lax.switch` for branches (reverse-differentiable) and
+`lax.while_loop` for data-dependent loops (forward-differentiable only —
+reverse through a dynamic-trip-count loop needs eager unrolling, same
+restriction XLA itself has).
+
+Branch/body callables may close over any Tensors in scope; their outputs
+must share one tree structure across branches, like the reference requires.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor
+
+__all__ = ["cond", "while_loop", "case", "switch_case", "Assert"]
+
+
+def _raw(x):
+    return x.value if isinstance(x, Tensor) else x
+
+
+def _is_tracer(x) -> bool:
+    return isinstance(_raw(x), jax.core.Tracer)
+
+
+def _is_tensor_leaf(x) -> bool:
+    return isinstance(x, Tensor)
+
+
+def _flatten(out) -> Tuple[list, Any]:
+    """Flatten a branch output into raw jax leaves + treedef."""
+    leaves, tree = jax.tree_util.tree_flatten(out, is_leaf=_is_tensor_leaf)
+    return [jnp.asarray(_raw(l)) for l in leaves], tree
+
+
+def _unflatten(tree, raw_leaves, wrap=True):
+    leaves = [Tensor(v, stop_gradient=True) if wrap else v
+              for v in raw_leaves]
+    return jax.tree_util.tree_unflatten(tree, leaves)
+
+
+def _scalar_bool(v, api: str):
+    v = jnp.asarray(_raw(v))
+    if v.size != 1:
+        raise ValueError(
+            f"The pred/condition of {api} must be a boolean tensor with "
+            f"one element (shape [] or [1]), got shape {list(v.shape)}.")
+    return v.reshape(()).astype(jnp.bool_)
+
+
+def cond(pred, true_fn: Optional[Callable] = None,
+         false_fn: Optional[Callable] = None, name: Optional[str] = None,
+         return_names=None):
+    """Run ``true_fn()`` if ``pred`` else ``false_fn()``.
+
+    Parity: paddle.static.nn.cond (static/nn/control_flow.py:873).
+    Concrete pred: executes ONE branch eagerly (dygraph semantics,
+    tape-differentiable). Tracer pred: lowers to `lax.cond`, both branches
+    traced into the program, reverse-differentiable through `jax.vjp`.
+    """
+    if true_fn is not None and not callable(true_fn):
+        raise TypeError("The true_fn in cond must be callable.")
+    if false_fn is not None and not callable(false_fn):
+        raise TypeError("The false_fn in cond must be callable.")
+    true_fn = true_fn or (lambda: None)
+    false_fn = false_fn or (lambda: None)
+
+    if not _is_tracer(pred):
+        p = bool(_scalar_bool(pred, "cond"))
+        return true_fn() if p else false_fn()
+
+    p = _scalar_bool(pred, "cond")
+    trees: List[Any] = []
+
+    def _branch(fn):
+        def run(_):
+            raw, tree = _flatten(fn())
+            trees.append(tree)
+            return tuple(raw)
+        return run
+
+    try:
+        out = lax.cond(p, _branch(true_fn), _branch(false_fn), None)
+    except TypeError as e:
+        if len(trees) == 2 and trees[0] != trees[1]:
+            raise TypeError(
+                "Incompatible return values of true_fn and false_fn in "
+                f"cond: {trees[0]} vs {trees[1]} (the two branches must "
+                "return one common structure of Tensors, reference "
+                "control_flow.py:873)") from e
+        raise
+    if len(trees) == 2 and trees[0] != trees[1]:
+        raise TypeError(
+            "Incompatible return values of true_fn and false_fn in cond: "
+            f"{trees[0]} vs {trees[1]}")
+    return _unflatten(trees[0], out)
+
+
+def while_loop(cond: Callable, body: Callable, loop_vars: Sequence,
+               is_test: bool = False, name: Optional[str] = None):
+    """``while cond(*loop_vars): loop_vars = body(*loop_vars)``.
+
+    Parity: paddle.static.nn.while_loop (static/nn/control_flow.py:401;
+    runtime op paddle/fluid/operators/controlflow/while_op.cc). Concrete
+    values: a Python loop, each iteration recorded on the tape (so
+    reverse-mode works by unrolling). Traced values: `lax.while_loop`
+    (forward-differentiable; reverse-mode through a dynamic trip count is
+    structurally impossible in one XLA program — run eagerly for that).
+    """
+    if not callable(cond):
+        raise TypeError("The cond in while_loop must be callable.")
+    if not callable(body):
+        raise TypeError("The body in while_loop must be callable.")
+    if not isinstance(loop_vars, (list, tuple)) or not loop_vars:
+        raise ValueError("loop_vars in while_loop must be a non-empty "
+                         "list/tuple.")
+    loop_vars = list(loop_vars)
+
+    first = cond(*loop_vars)
+    traced = _is_tracer(first) or any(
+        _is_tracer(l) for l in jax.tree_util.tree_leaves(
+            loop_vars, is_leaf=_is_tensor_leaf))
+
+    if not traced:
+        vals = loop_vars
+        keep = bool(jnp.asarray(_raw(first)).reshape(()))
+        while keep:
+            out = body(*vals)
+            out = list(out) if isinstance(out, (list, tuple)) else [out]
+            if len(out) != len(vals):
+                raise ValueError(
+                    f"body in while_loop returned {len(out)} values, "
+                    f"expected {len(vals)} (must match loop_vars).")
+            vals = out
+            keep = bool(jnp.asarray(_raw(cond(*vals))).reshape(()))
+        return vals
+
+    flat0, tree = _flatten(loop_vars)
+
+    def c(flat):
+        vars_ = _unflatten(tree, flat)
+        return _scalar_bool(cond(*vars_), "while_loop")
+
+    def b(flat):
+        vars_ = _unflatten(tree, flat)
+        out = body(*vars_)
+        out = list(out) if isinstance(out, (list, tuple)) else [out]
+        raw, tree2 = _flatten(out)
+        if tree2 != tree:
+            raise TypeError(
+                "body in while_loop must return the same structure as "
+                f"loop_vars: got {tree2}, expected {tree}")
+        return tuple(raw)
+
+    res = lax.while_loop(c, b, tuple(flat0))
+    return _unflatten(tree, res)
+
+
+def case(pred_fn_pairs, default: Optional[Callable] = None,
+         name: Optional[str] = None):
+    """if-elif-else chain: first fn whose pred is True runs.
+
+    Parity: paddle.static.nn.case (static/nn/control_flow.py:564) — when
+    ``default`` is None the LAST fn in ``pred_fn_pairs`` serves as the
+    default, exactly like the reference. Built as a fold of `cond`, so it
+    inherits cond's eager/traced duality.
+    """
+    if not isinstance(pred_fn_pairs, (list, tuple)):
+        raise TypeError("pred_fn_pairs in case must be a list or tuple.")
+    pairs = []
+    for item in pred_fn_pairs:
+        if not isinstance(item, tuple) or len(item) != 2:
+            raise TypeError("each element of pred_fn_pairs must be a "
+                            "(pred, fn) 2-tuple.")
+        pred, fn = item
+        if not callable(fn):
+            raise TypeError("The fn of each pred_fn_pair in case must be "
+                            "callable.")
+        pairs.append((pred, fn))
+    if not pairs:
+        raise ValueError("pred_fn_pairs in case must be non-empty.")
+    if default is None:
+        default = pairs[-1][1]
+        pairs = pairs[:-1]
+    elif not callable(default):
+        raise TypeError("The default in case must be callable.")
+
+    chain = default
+    for pred, fn in reversed(pairs):
+        def chain(p=pred, tf=fn, ff=chain):
+            return cond(p, tf, ff)
+    return chain()
+
+
+def switch_case(branch_index, branch_fns, default: Optional[Callable] = None,
+                name: Optional[str] = None):
+    """Run the fn whose key matches ``branch_index``.
+
+    Parity: paddle.static.nn.switch_case (static/nn/control_flow.py:697):
+    ``branch_fns`` is a list of callables (keys 0..n-1) or of (int, fn)
+    pairs; a missing ``default`` means the fn with the MAX key. Concrete
+    index: direct dispatch. Tracer index: one `lax.switch` (native XLA
+    multi-way branch; reverse-differentiable).
+    """
+    if not isinstance(branch_fns, (list, tuple)):
+        raise TypeError("branch_fns in switch_case must be a list or tuple.")
+    items = list(branch_fns)
+    if items and not isinstance(items[0], tuple):
+        items = list(enumerate(items))
+    keys, fns = [], []
+    for item in items:
+        if not isinstance(item, tuple) or len(item) != 2:
+            raise TypeError("each element of branch_fns must be an "
+                            "(int, callable) 2-tuple or a plain callable.")
+        k, fn = item
+        if not isinstance(k, int):
+            raise TypeError(f"branch key must be int, got {type(k)}.")
+        if k in keys:
+            raise ValueError(f"duplicate branch key {k} in switch_case.")
+        if not callable(fn):
+            raise TypeError("each branch fn in switch_case must be callable.")
+        keys.append(k)
+        fns.append(fn)
+    if not keys:
+        raise ValueError("branch_fns in switch_case must be non-empty.")
+    if default is not None and not callable(default):
+        raise TypeError("The default in switch_case must be callable.")
+    # reference semantics: a missing default means the fn with the MAX key
+    i_max = max(range(len(keys)), key=lambda i: keys[i])
+
+    idx_raw = _raw(branch_index)
+    if not _is_tracer(branch_index):
+        k = int(jnp.asarray(idx_raw).reshape(()))
+        for key, fn in zip(keys, fns):
+            if key == k:
+                return fn()
+        return default() if default is not None else fns[i_max]()
+
+    idx = jnp.asarray(idx_raw).reshape(()).astype(jnp.int32)
+    # map the user key space onto dense positions; unmatched keys fall back
+    # to the default slot (an extra branch, or the max-key branch — never
+    # traced twice)
+    branches = fns + ([default] if default is not None else [])
+    sel = jnp.int32(len(fns) if default is not None else i_max)
+    for pos, key in enumerate(keys):
+        sel = jnp.where(idx == key, jnp.int32(pos), sel)
+
+    trees: List[Any] = []
+
+    def _branch(fn):
+        def run(_):
+            raw, tree = _flatten(fn())
+            trees.append(tree)
+            return tuple(raw)
+        return run
+
+    out = lax.switch(sel, [_branch(f) for f in branches], None)
+    if any(t != trees[0] for t in trees[1:]):
+        raise TypeError(
+            "all branch fns of switch_case must return one common "
+            f"structure of Tensors, got {trees}")
+    return _unflatten(trees[0], out)
+
+
+def Assert(cond, data=None, summarize: int = 20, name: Optional[str] = None):
+    """Assert ``cond`` holds at runtime; on failure print ``data`` and raise.
+
+    Parity: paddle.static.nn.Assert (static/nn/control_flow.py:43;
+    paddle/fluid/operators/assert_op.cc). Concrete cond raises directly;
+    a traced cond checks on the host via `jax.debug.callback` when the
+    program runs.
+    """
+    vals = [jnp.asarray(_raw(d)) for d in (data or [])]
+
+    def _fail(*ds):
+        shown = []
+        for d in ds:
+            flat = jnp.ravel(d)
+            head = flat[:summarize] if summarize >= 0 else flat
+            shown.append(str(head))
+        raise ValueError(
+            "Assert failed" + (f" ({name})" if name else "") +
+            (": " + "; ".join(shown) if shown else ""))
+
+    if not _is_tracer(cond) and not any(isinstance(v, jax.core.Tracer)
+                                        for v in vals):
+        if not bool(jnp.asarray(_raw(cond)).reshape(())):
+            _fail(*vals)
+        return None
+
+    def _check(c, *ds):
+        if not bool(c):
+            _fail(*ds)
+
+    jax.debug.callback(_check, _scalar_bool(cond, "Assert"), *vals)
+    return None

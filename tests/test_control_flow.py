@@ -1,0 +1,221 @@
+"""Control-flow combinators: cond / while_loop / case / switch_case / Assert.
+
+Parity targets: python/paddle/static/nn/control_flow.py (cond :873,
+while_loop :401, case :564, switch_case :697, Assert :43) and the
+dy2static data-dependent control-flow tests
+(python/paddle/fluid/tests/unittests/dygraph_to_static/test_ifelse.py,
+test_loop.py). Eager path = one branch on the tape; traced path =
+lax.cond / lax.while_loop / lax.switch inside the XLA program.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.static import nn as snn
+
+from op_test import OpTest
+
+
+class TestCondEager:
+    def test_picks_branch(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        out = snn.cond(x.sum() < 5.0, lambda: x * 2, lambda: x - 1)
+        np.testing.assert_allclose(out.numpy(), [2.0, 4.0])
+        out = snn.cond(x.sum() > 5.0, lambda: x * 2, lambda: x - 1)
+        np.testing.assert_allclose(out.numpy(), [0.0, 1.0])
+
+    def test_python_bool_pred_and_none_branch(self):
+        x = paddle.to_tensor(3.0)
+        assert snn.cond(True, lambda: x + 1).numpy() == 4.0
+        assert snn.cond(False, lambda: x + 1) is None
+
+    def test_nested_structure(self):
+        x = paddle.to_tensor(2.0)
+        a, (b, c) = snn.cond(x < 3.0,
+                             lambda: (x, (x + 1, x + 2)),
+                             lambda: (x * 0, (x, x)))
+        assert (a.numpy(), b.numpy(), c.numpy()) == (2.0, 3.0, 4.0)
+
+    def test_grad_through_both_branches(self):
+        # grad check through the TRUE branch
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32),
+                             stop_gradient=False)
+        out = snn.cond(x.sum() < 5.0, lambda: (x * x).sum(),
+                       lambda: (3 * x).sum())
+        out.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 4.0])
+        # grad check through the FALSE branch
+        y = paddle.to_tensor(np.array([4.0, 4.0], np.float32),
+                             stop_gradient=False)
+        out = snn.cond(y.sum() < 5.0, lambda: (y * y).sum(),
+                       lambda: (3 * y).sum())
+        out.backward()
+        np.testing.assert_allclose(y.grad.numpy(), [3.0, 3.0])
+
+
+class TestCondOpTest(OpTest):
+    """OpTest-style finite-difference grad check across both branches."""
+
+    def _run(self, x):
+        return snn.cond(x.sum() < 0.0,
+                        lambda: paddle.tanh(x) * 2.0,
+                        lambda: x * x + x)
+
+    def test_true_branch(self):
+        self.inputs = {"x": -np.abs(
+            np.random.RandomState(0).randn(3, 4).astype(np.float32)) - 0.1}
+        self.op = self._run
+        self.ref = lambda x: np.tanh(x) * 2.0
+        self.check_output()
+        self.check_grad(wrt=["x"])
+
+    def test_false_branch(self):
+        self.inputs = {"x": np.abs(
+            np.random.RandomState(1).randn(3, 4).astype(np.float32)) + 0.1}
+        self.op = self._run
+        self.ref = lambda x: x * x + x
+        self.check_output()
+        self.check_grad(wrt=["x"])
+
+
+class TestCondTraced:
+    def test_lax_cond_in_to_static(self):
+        @paddle.jit.to_static
+        def f(x):
+            return snn.cond(x.sum() < 5.0, lambda: x * 2, lambda: x - 1)
+
+        lo = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        hi = paddle.to_tensor(np.array([4.0, 4.0], np.float32))
+        np.testing.assert_allclose(f(lo).numpy(), [2.0, 4.0])
+        # same compiled program, other branch at run time
+        np.testing.assert_allclose(f(hi).numpy(), [3.0, 3.0])
+
+    def test_grad_through_traced_cond(self):
+        lin = paddle.nn.Linear(4, 4)
+        layer = paddle.jit.to_static(lin)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+
+        @paddle.jit.to_static
+        def head(h):
+            return snn.cond(h.sum() > 0.0,
+                            lambda: (h * h).sum(), lambda: h.sum())
+
+        out = head(layer(x))
+        out.backward()
+        assert lin.weight.grad is not None
+        assert np.isfinite(lin.weight.grad.numpy()).all()
+
+    def test_structure_mismatch_raises(self):
+        @paddle.jit.to_static
+        def f(x):
+            return snn.cond(x.sum() < 5.0,
+                            lambda: (x, x), lambda: x)
+
+        with pytest.raises(TypeError, match="true_fn and false_fn"):
+            f(paddle.to_tensor(np.ones(2, np.float32)))
+
+
+class TestWhileLoop:
+    def test_eager_unrolled_with_grad(self):
+        x = paddle.to_tensor(np.array(1.0, np.float32), stop_gradient=False)
+        i = paddle.to_tensor(np.array(0, np.int32))
+        i_out, s_out = snn.while_loop(
+            lambda i, s: i < 3, lambda i, s: [i + 1, s * 2.0], [i, x])
+        assert int(i_out.numpy()) == 3
+        assert float(s_out.numpy()) == 8.0
+        s_out.backward()
+        np.testing.assert_allclose(x.grad.numpy(), 8.0)  # d(8x)/dx
+
+    def test_traced_data_dependent_trip_count(self):
+        # dy2static parity: a loop whose trip count depends on tensor data
+        @paddle.jit.to_static
+        def grow(s):
+            [out] = snn.while_loop(lambda v: v.sum() < 100.0,
+                                   lambda v: [v * 2.0], [s])
+            return out
+
+        r = grow(paddle.to_tensor(np.array([1.0, 1.0], np.float32)))
+        np.testing.assert_allclose(r.numpy(), [64.0, 64.0])
+        # different data, different trip count, same compiled program
+        r2 = grow(paddle.to_tensor(np.array([30.0, 30.0], np.float32)))
+        np.testing.assert_allclose(r2.numpy(), [60.0, 60.0])
+
+    def test_validation(self):
+        with pytest.raises(TypeError):
+            snn.while_loop(1, lambda: None, [paddle.to_tensor(0.0)])
+        with pytest.raises(ValueError):
+            snn.while_loop(lambda: True, lambda: None, [])
+
+
+class TestCaseSwitch:
+    def _mk(self):
+        return paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+
+    def test_case_eager(self):
+        x = self._mk()
+        out = snn.case([(x.sum() > 10.0, lambda: x * 0),
+                        (x.sum() > 1.0, lambda: x * 10)],
+                       default=lambda: x)
+        np.testing.assert_allclose(out.numpy(), [10.0, 20.0])
+        # no pred true and no default -> last fn is the default (reference)
+        out = snn.case([(x.sum() > 10.0, lambda: x * 0),
+                        (x.sum() > 20.0, lambda: x + 1)])
+        np.testing.assert_allclose(out.numpy(), [2.0, 3.0])
+
+    def test_case_traced(self):
+        @paddle.jit.to_static
+        def f(x):
+            return snn.case([(x.sum() > 10.0, lambda: x * 0),
+                             (x.sum() > 1.0, lambda: x * 10)],
+                            default=lambda: x)
+
+        np.testing.assert_allclose(f(self._mk()).numpy(), [10.0, 20.0])
+        big = paddle.to_tensor(np.array([6.0, 6.0], np.float32))
+        np.testing.assert_allclose(f(big).numpy(), [0.0, 0.0])
+
+    def test_switch_case_eager(self):
+        x = self._mk()
+        fns = [lambda: x + 1, lambda: x + 2, lambda: x + 3]
+        idx = paddle.to_tensor(np.array(1, np.int32))
+        np.testing.assert_allclose(
+            snn.switch_case(idx, fns).numpy(), [3.0, 4.0])
+        # out-of-range index -> default (= max-key fn when default=None)
+        oob = paddle.to_tensor(np.array(7, np.int32))
+        np.testing.assert_allclose(
+            snn.switch_case(oob, fns).numpy(), [4.0, 5.0])
+        # (key, fn) pairs + explicit default
+        np.testing.assert_allclose(
+            snn.switch_case(oob, [(5, lambda: x)],
+                            default=lambda: x * 0).numpy(), [0.0, 0.0])
+
+    def test_switch_case_traced(self):
+        @paddle.jit.to_static
+        def f(idx, x):
+            return snn.switch_case(
+                idx, [lambda: x + 1, lambda: x * 2], default=lambda: x * 0)
+
+        x = self._mk()
+        np.testing.assert_allclose(
+            f(paddle.to_tensor(np.array(0, np.int32)), x).numpy(), [2.0, 3.0])
+        np.testing.assert_allclose(
+            f(paddle.to_tensor(np.array(1, np.int32)), x).numpy(), [2.0, 4.0])
+        np.testing.assert_allclose(
+            f(paddle.to_tensor(np.array(9, np.int32)), x).numpy(), [0.0, 0.0])
+
+
+class TestAssertAndHook:
+    def test_assert_eager(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        snn.Assert(x.sum() > 0.0)  # passes silently
+        with pytest.raises(ValueError, match="Assert failed"):
+            snn.Assert(x.sum() < 0.0, data=[x])
+
+    def test_python_if_in_to_static_names_combinators(self):
+        @paddle.jit.to_static
+        def f(x):
+            if x.sum() > 0:  # data-dependent python branch: must be loud
+                return x * 2
+            return x
+
+        with pytest.raises(RuntimeError, match="static.nn.cond"):
+            f(paddle.to_tensor(np.ones(2, np.float32)))
