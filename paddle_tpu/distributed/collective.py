@@ -613,6 +613,7 @@ class Work:
         self._arrays = tuple(arrays)
         self._on_done = on_done
         self._done = False
+        self._result = None
 
     def wait(self):
         for a in self._arrays:
@@ -621,6 +622,15 @@ class Work:
             self._on_done()
             self._on_done = None
         self._done = True
+
+    def result(self):
+        """The received Tensor of an irecv, materializing it if needed.
+
+        Immutable jax-array receive buffers cannot be filled in place, so
+        an irecv caller that passed a raw jax array reads the data here
+        (Tensor/ndarray buffers are additionally filled in place)."""
+        self.wait()
+        return self._result
 
     def is_completed(self) -> bool:
         if not self._done and all(a.is_ready() for a in self._arrays):
@@ -751,13 +761,11 @@ def recv(tensor, src=0, group=None, sync_op=True):
     out = _p2p_transfer(None, x.shape, x.dtype,
                         _group_rank_to_proc(group, src),
                         jax.process_index())
-    result = {}
-
     def fill():
         row = _np_host(out.addressable_shards[0].data)[0]
-        result["t"] = Tensor(jnp.asarray(row))
+        w._result = Tensor(jnp.asarray(row))
         if isinstance(tensor, Tensor):
-            tensor.value = result["t"].value
+            tensor.value = w._result.value
         else:
             import numpy as _np
             if isinstance(tensor, _np.ndarray):
@@ -765,7 +773,7 @@ def recv(tensor, src=0, group=None, sync_op=True):
     w = Work((out,), on_done=fill)
     if sync_op:
         w.wait()
-        return tensor if isinstance(tensor, Tensor) else result["t"]
+        return tensor if isinstance(tensor, Tensor) else w.result()
     return w
 
 

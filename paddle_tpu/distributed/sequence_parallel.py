@@ -128,13 +128,13 @@ def _ring_fused_fwd_impl(q, k, v, sp, sl, scale, causal, bq, bk, interpret):
     idx = lax.axis_index("sp")
     B, H, _, D = q.shape
     perm = [(i, (i + 1) % sp) for i in range(sp)]
-    q_off = (idx * sl).astype(jnp.float32)
+    q_off = (idx * sl).astype(jnp.int32)
 
     def step(carry, i):
         k_blk, v_blk, acc, lse = carry
         src = (idx - i) % sp
         o_i, l_i = _fb.flash_block_attention(
-            q, k_blk, v_blk, q_off, (src * sl).astype(jnp.float32),
+            q, k_blk, v_blk, q_off, (src * sl).astype(jnp.int32),
             causal, scale, bq, bk, interpret)
         acc, lse = _fb.merge_lse_blocks(acc, lse, o_i.astype(jnp.float32),
                                         l_i)
@@ -163,14 +163,14 @@ def _ring_fused_bwd(sp, sl, scale, causal, bq, bk, interpret, res, do):
     q, k, v, out, lse = res
     idx = lax.axis_index("sp")
     perm = [(i, (i + 1) % sp) for i in range(sp)]
-    q_off = (idx * sl).astype(jnp.float32)
+    q_off = (idx * sl).astype(jnp.int32)
     delta = _fb.compute_delta(out, do)   # loop-invariant: hoisted
 
     def step(carry, i):
         k_blk, v_blk, dk_blk, dv_blk, dq = carry
         src = (idx - i) % sp
         dq_i, dk_i, dv_i = _fb.flash_block_attention_bwd(
-            q, k_blk, v_blk, q_off, (src * sl).astype(jnp.float32),
+            q, k_blk, v_blk, q_off, (src * sl).astype(jnp.int32),
             out, lse, do, causal=causal, sm_scale=scale, block_q=bq,
             block_k=bk, interpret=interpret, delta=delta)
         dq = dq + dq_i.astype(jnp.float32)

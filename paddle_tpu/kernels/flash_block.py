@@ -35,6 +35,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
@@ -51,8 +52,11 @@ def _dot(a, b, dims):
 
 
 def _causal_mask(qo, ko, iq, ik, bq, bk):
-    q_pos = qo + iq * bq + lax.broadcasted_iota(jnp.float32, (bq, bk), 0)
-    k_pos = ko + ik * bk + lax.broadcasted_iota(jnp.float32, (bq, bk), 1)
+    # int32 throughout: position compares must stay exact past 2^24
+    # (f32 iota loses integer exactness there and the causal boundary
+    # could drift by one at multi-million-token global offsets)
+    q_pos = qo + iq * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    k_pos = ko + ik * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
     return q_pos >= k_pos
 
 
@@ -334,21 +338,21 @@ def flash_block_attention(q, k, v, q_off, k_off, causal=False,
                           interpret=False):
     """Fused blockwise attention of q against one k/v block.
 
-    q: [B, H, Sq, D]; k/v: [B, H, Sk, D]; q_off/k_off: f32 scalars, the
+    q: [B, H, Sq, D]; k/v: [B, H, Sk, D]; q_off/k_off: int32 scalars, the
     global positions of q[0]/k[0] (causal masking across shards).
     Returns (out [B, H, Sq, D], lse [B, H, Sq] f32). Rows with every key
     masked return out=0, lse=-inf (the merge identity).
     """
-    out, lse = _fwd(q, k, v, jnp.asarray(q_off, jnp.float32),
-                    jnp.asarray(k_off, jnp.float32), causal, sm_scale,
+    out, lse = _fwd(q, k, v, jnp.asarray(q_off, jnp.int32),
+                    jnp.asarray(k_off, jnp.int32), causal, sm_scale,
                     block_q, block_k, interpret)
     return out, lse
 
 
 def _fba_fwd(q, k, v, q_off, k_off, causal, sm_scale, block_q, block_k,
              interpret):
-    q_off = jnp.asarray(q_off, jnp.float32)
-    k_off = jnp.asarray(k_off, jnp.float32)
+    q_off = jnp.asarray(q_off, jnp.int32)
+    k_off = jnp.asarray(k_off, jnp.int32)
     out, lse = _fwd(q, k, v, q_off, k_off, causal, sm_scale, block_q,
                     block_k, interpret)
     return (out, lse), (q, k, v, q_off, k_off, out, lse)
@@ -359,7 +363,8 @@ def _fba_bwd(causal, sm_scale, block_q, block_k, interpret, res, grads):
     do, dlse = grads
     dq, dk, dv = _bwd(q, k, v, q_off, k_off, out, lse, do, causal,
                       sm_scale, block_q, block_k, interpret, dlse=dlse)
-    zero = jnp.zeros((), jnp.float32)
+    # int32 primals take float0 cotangents under custom_vjp
+    zero = np.zeros((), jax.dtypes.float0)
     return dq, dk, dv, zero, zero
 
 
@@ -376,8 +381,8 @@ def flash_block_attention_bwd(q, k, v, q_off, k_off, out, lse, do,
     currently-held kv block, accumulating dk/dv into rotating buffers.
     Precompute `delta = compute_delta(out, do)` once outside the loop.
     """
-    return _bwd(q, k, v, jnp.asarray(q_off, jnp.float32),
-                jnp.asarray(k_off, jnp.float32), out, lse, do, causal,
+    return _bwd(q, k, v, jnp.asarray(q_off, jnp.int32),
+                jnp.asarray(k_off, jnp.int32), out, lse, do, causal,
                 sm_scale, block_q, block_k, interpret, delta=delta)
 
 

@@ -1,18 +1,13 @@
 """paddle.onnx parity surface (python/paddle/onnx/export.py).
 
-ONNX export in the reference rides paddle2onnx, which translates static
-Programs into ONNX graphs. This build's serving interchange format is
-StableHLO (`paddle.jit.save` → `inference.Predictor`/HTTP serving), the
-TPU-native equivalent; ONNX tooling is not shipped, so export() raises
-with that guidance.
+The reference rides paddle2onnx to translate static Programs into ONNX
+graphs. Here `export()` translates the Layer's traced jaxpr directly
+into ONNX-13 ModelProto bytes with a self-contained protobuf writer
+(`_proto.py`) — no onnx/paddle2onnx dependency. The primary serving
+artifact remains StableHLO (`paddle.jit.save` → inference.Predictor);
+ONNX export covers the interchange use case for Linear/Conv-family
+models, and raises naming the unmapped primitive otherwise.
 """
-__all__ = ["export"]
+from .export import OnnxExportError, export
 
-
-def export(layer, path, input_spec=None, opset_version=9, **configs):
-    raise NotImplementedError(
-        "ONNX export is not available in this build (no paddle2onnx). "
-        "Use paddle.jit.save(layer, path, input_spec=...) — the StableHLO "
-        "artifact serves through paddle_tpu.inference (Predictor / "
-        "`python -m paddle_tpu.inference.serve`), this framework's "
-        "deployment path.")
+__all__ = ["export", "OnnxExportError"]

@@ -1,0 +1,254 @@
+"""Minimal ONNX protobuf wire-format codec (no `onnx` dependency).
+
+The image ships no onnx/protobuf-python packages, so the exporter emits
+ModelProto bytes directly: protobuf wiring is varint tags + three wire
+types (varint 0, 64-bit 1, length-delimited 2, 32-bit 5). Field numbers
+follow onnx/onnx.proto3 (stable since IR version 3). The reader half is
+a generic tag walker used by the tests to round-trip and execute the
+exported graphs.
+
+Reference parity: the artifact contract of python/paddle/onnx/export.py
+(which rides paddle2onnx); here the schema subset is ModelProto /
+GraphProto / NodeProto / AttributeProto / TensorProto / ValueInfoProto.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+# TensorProto.DataType enum values (onnx.proto3)
+DT_FLOAT, DT_UINT8, DT_INT8, DT_INT32, DT_INT64 = 1, 2, 3, 6, 7
+DT_BOOL, DT_FLOAT16, DT_DOUBLE, DT_BFLOAT16 = 9, 10, 11, 16
+
+NP_TO_ONNX = {
+    np.dtype(np.float32): DT_FLOAT, np.dtype(np.float64): DT_DOUBLE,
+    np.dtype(np.int32): DT_INT32, np.dtype(np.int64): DT_INT64,
+    np.dtype(np.bool_): DT_BOOL, np.dtype(np.uint8): DT_UINT8,
+    np.dtype(np.int8): DT_INT8, np.dtype(np.float16): DT_FLOAT16,
+}
+try:  # bf16 models (this framework's standard compute dtype) must export
+    import ml_dtypes as _mld
+    NP_TO_ONNX[np.dtype(_mld.bfloat16)] = DT_BFLOAT16
+except ImportError:  # pragma: no cover
+    pass
+ONNX_TO_NP = {v: k for k, v in NP_TO_ONNX.items()}
+
+# AttributeProto.AttributeType
+AT_FLOAT, AT_INT, AT_STRING, AT_TENSOR, AT_FLOATS, AT_INTS, AT_STRINGS = \
+    1, 2, 3, 4, 6, 7, 8
+
+
+def _varint(n: int) -> bytes:
+    if n < 0:
+        n &= (1 << 64) - 1  # protobuf negative int64 -> 10-byte varint
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def f_varint(field: int, value: int) -> bytes:
+    return _tag(field, 0) + _varint(int(value))
+
+
+def f_float(field: int, value: float) -> bytes:
+    return _tag(field, 5) + struct.pack("<f", float(value))
+
+
+def f_bytes(field: int, value) -> bytes:
+    data = value.encode() if isinstance(value, str) else bytes(value)
+    return _tag(field, 2) + _varint(len(data)) + data
+
+
+def f_msg(field: int, encoded: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(encoded)) + encoded
+
+
+def tensor(name: str, arr: np.ndarray) -> bytes:
+    """TensorProto: dims=1, data_type=2, name=8, raw_data=9."""
+    arr = np.ascontiguousarray(arr)
+    if arr.dtype not in NP_TO_ONNX:
+        raise TypeError(f"onnx export: unsupported dtype {arr.dtype}")
+    out = b"".join(f_varint(1, d) for d in arr.shape)
+    out += f_varint(2, NP_TO_ONNX[arr.dtype])
+    out += f_bytes(8, name)
+    out += f_bytes(9, arr.tobytes())
+    return out
+
+
+def attribute(name: str, value) -> bytes:
+    """AttributeProto: name=1, f=2, i=3, s=4, t=5, floats=7, ints=8, type=20."""
+    out = f_bytes(1, name)
+    if isinstance(value, bool) or isinstance(value, (int, np.integer)):
+        out += f_varint(3, int(value)) + f_varint(20, AT_INT)
+    elif isinstance(value, float):
+        out += f_float(2, value) + f_varint(20, AT_FLOAT)
+    elif isinstance(value, str):
+        out += f_bytes(4, value) + f_varint(20, AT_STRING)
+    elif isinstance(value, np.ndarray):
+        out += f_msg(5, tensor("", value)) + f_varint(20, AT_TENSOR)
+    elif isinstance(value, (list, tuple)):
+        if value and isinstance(value[0], float):
+            out += b"".join(f_float(7, v) for v in value)
+            out += f_varint(20, AT_FLOATS)
+        else:
+            out += b"".join(f_varint(8, int(v)) for v in value)
+            out += f_varint(20, AT_INTS)
+    else:
+        raise TypeError(f"onnx attribute {name}: unsupported {type(value)}")
+    return out
+
+
+def node(op_type: str, inputs: List[str], outputs: List[str],
+         name: str = "", **attrs) -> bytes:
+    """NodeProto: input=1, output=2, name=3, op_type=4, attribute=5."""
+    out = b"".join(f_bytes(1, i) for i in inputs)
+    out += b"".join(f_bytes(2, o) for o in outputs)
+    if name:
+        out += f_bytes(3, name)
+    out += f_bytes(4, op_type)
+    out += b"".join(f_msg(5, attribute(k, v)) for k, v in attrs.items())
+    return out
+
+
+def value_info(name: str, dtype: np.dtype, shape) -> bytes:
+    """ValueInfoProto{name=1, type=2{tensor_type=1{elem_type=1, shape=2}}}."""
+    dims = b"".join(f_msg(1, f_varint(1, int(d))) for d in shape)
+    tt = f_varint(1, NP_TO_ONNX[np.dtype(dtype)]) + f_msg(2, dims)
+    return f_bytes(1, name) + f_msg(2, f_msg(1, tt))
+
+
+def graph(nodes: List[bytes], name: str, initializers: List[bytes],
+          inputs: List[bytes], outputs: List[bytes]) -> bytes:
+    """GraphProto: node=1, name=2, initializer=5, input=11, output=12."""
+    out = b"".join(f_msg(1, n) for n in nodes)
+    out += f_bytes(2, name)
+    out += b"".join(f_msg(5, t) for t in initializers)
+    out += b"".join(f_msg(11, v) for v in inputs)
+    out += b"".join(f_msg(12, v) for v in outputs)
+    return out
+
+
+def model(graph_bytes: bytes, opset_version: int = 13,
+          producer: str = "paddle_tpu") -> bytes:
+    """ModelProto: ir_version=1, producer_name=2, graph=7, opset_import=8."""
+    opset = f_bytes(1, "") + f_varint(2, opset_version)
+    return (f_varint(1, 8)                 # IR version 8 (onnx 1.12+)
+            + f_bytes(2, producer)
+            + f_msg(7, graph_bytes)
+            + f_msg(8, opset))
+
+
+# --------------------------------------------------------------- reader
+
+def parse(data: bytes) -> Dict[int, List[Any]]:
+    """Generic message parse: field -> list of raw values (int for varint,
+    bytes for length-delimited, float for 32-bit)."""
+    out: Dict[int, List[Any]] = {}
+    i, n = 0, len(data)
+    while i < n:
+        key, i = _read_varint(data, i)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            v, i = _read_varint(data, i)
+        elif wire == 2:
+            ln, i = _read_varint(data, i)
+            v, i = data[i:i + ln], i + ln
+        elif wire == 5:
+            v, i = struct.unpack("<f", data[i:i + 4])[0], i + 4
+        elif wire == 1:
+            v, i = struct.unpack("<d", data[i:i + 8])[0], i + 8
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        out.setdefault(field, []).append(v)
+    return out
+
+
+def _read_varint(data: bytes, i: int) -> Tuple[int, int]:
+    shift, val = 0, 0
+    while True:
+        b = data[i]
+        i += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return val, i
+        shift += 7
+
+
+def parse_tensor(data: bytes) -> Tuple[str, np.ndarray]:
+    f = parse(data)
+    dims = [int(d) for d in f.get(1, [])]
+    dt = ONNX_TO_NP[f[2][0]]
+    name = f.get(8, [b""])[0].decode()
+    raw = f.get(9, [b""])[0]
+    return name, np.frombuffer(raw, dtype=dt).reshape(dims)
+
+
+def parse_attribute(data: bytes) -> Tuple[str, Any]:
+    f = parse(data)
+    name = f[1][0].decode()
+    at = f.get(20, [0])[0]
+    if at == AT_INT:
+        return name, f[3][0] - (1 << 64) * (f[3][0] >> 63)
+    if at == AT_FLOAT:
+        return name, f[2][0]
+    if at == AT_STRING:
+        return name, f[4][0].decode()
+    if at == AT_TENSOR:
+        return name, parse_tensor(f[5][0])[1]
+    if at == AT_INTS:
+        return name, [v - (1 << 64) * (v >> 63) for v in f.get(8, [])]
+    if at == AT_FLOATS:
+        return name, list(f.get(7, []))
+    raise ValueError(f"attribute type {at} unsupported")
+
+
+def parse_node(data: bytes) -> Dict[str, Any]:
+    f = parse(data)
+    return {
+        "inputs": [b.decode() for b in f.get(1, [])],
+        "outputs": [b.decode() for b in f.get(2, [])],
+        "name": f.get(3, [b""])[0].decode(),
+        "op_type": f[4][0].decode(),
+        "attrs": dict(parse_attribute(a) for a in f.get(5, [])),
+    }
+
+
+def parse_model(data: bytes) -> Dict[str, Any]:
+    """Decode ModelProto -> {opset, graph: {nodes, initializers, inputs,
+    outputs}} for test round-trips and the numpy executor."""
+    m = parse(data)
+    g = parse(m[7][0])
+    opset = 0
+    for op in m.get(8, []):
+        opset = max(opset, parse(op).get(2, [0])[0])
+
+    def _vi(b):
+        f = parse(b)
+        name = f[1][0].decode()
+        tt = parse(parse(f[2][0])[1][0])
+        elem = tt.get(1, [0])[0]
+        dims = [parse(d).get(1, [None])[0]
+                for d in parse(tt[2][0]).get(1, [])] if 2 in tt else []
+        return {"name": name, "elem_type": elem, "dims": dims}
+
+    return {
+        "ir_version": m[1][0],
+        "opset": opset,
+        "graph": {
+            "name": g.get(2, [b""])[0].decode(),
+            "nodes": [parse_node(n) for n in g.get(1, [])],
+            "initializers": dict(parse_tensor(t) for t in g.get(5, [])),
+            "inputs": [_vi(v) for v in g.get(11, [])],
+            "outputs": [_vi(v) for v in g.get(12, [])],
+        },
+    }

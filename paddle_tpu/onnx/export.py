@@ -1,0 +1,343 @@
+"""ONNX export by translating the traced jaxpr into an ONNX graph.
+
+Parity: paddle.onnx.export (python/paddle/onnx/export.py), which rides
+paddle2onnx over the static Program. Here the "program" is the traced
+jaxpr of the Layer's functional forward — each lax primitive maps onto
+an ONNX-13 op; parameters/buffers become graph initializers; function
+calls (pjit/custom_jvp/remat) are inlined. Covers the standard
+Linear/Conv/activation/normalization vocabulary; an unmapped primitive
+raises naming itself and the StableHLO alternative
+(`paddle.jit.save`), never silently drops an op.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+from jax.extend import core as jex_core
+import numpy as np
+
+from . import _proto as P
+
+
+class OnnxExportError(NotImplementedError):
+    pass
+
+
+class _Ctx:
+    def __init__(self, opset: int):
+        self.opset = opset
+        self.nodes: List[bytes] = []
+        self.initializers: List[bytes] = []
+        self.names: Dict[Any, str] = {}   # jax Var -> onnx name
+        self.counter = 0
+
+    def fresh(self, hint: str = "t") -> str:
+        self.counter += 1
+        return f"{hint}_{self.counter}"
+
+    def const(self, arr, hint: str = "c") -> str:
+        name = self.fresh(hint)
+        self.initializers.append(P.tensor(name, np.asarray(arr)))
+        return name
+
+    def emit(self, op: str, ins: List[str], n_out: int = 1, **attrs):
+        outs = [self.fresh(op.lower()) for _ in range(n_out)]
+        self.nodes.append(P.node(op, ins, outs, **attrs))
+        return outs[0] if n_out == 1 else outs
+
+    def name_of(self, v) -> str:
+        if isinstance(v, jex_core.Literal):
+            return self.const(np.asarray(v.val), "lit")
+        return self.names[v]
+
+
+def _onnx_dt(dtype) -> int:
+    return P.NP_TO_ONNX[np.dtype(dtype)]
+
+
+_UNARY = {
+    "neg": "Neg", "exp": "Exp", "log": "Log", "tanh": "Tanh",
+    "logistic": "Sigmoid", "erf": "Erf", "sqrt": "Sqrt", "abs": "Abs",
+    "sign": "Sign", "floor": "Floor", "ceil": "Ceil", "sin": "Sin",
+    "cos": "Cos",
+}
+_BINARY = {
+    "add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div",
+    "max": "Max", "min": "Min", "pow": "Pow",
+    "eq": "Equal", "gt": "Greater", "lt": "Less",
+    "ge": "GreaterOrEqual", "le": "LessOrEqual", "and": "And", "or": "Or",
+}
+
+
+def _convert_jaxpr(jaxpr, consts, in_names: List[str], ctx: _Ctx) -> List[str]:
+    """Walk eqns, emitting ONNX nodes; returns outvar names."""
+    for cv, cval in zip(jaxpr.constvars, consts):
+        ctx.names[cv] = ctx.const(np.asarray(cval), "const")
+    for v, n in zip(jaxpr.invars, in_names):
+        ctx.names[v] = n
+
+    for eqn in jaxpr.eqns:
+        prim = eqn.primitive.name
+        ins = [ctx.name_of(v) for v in eqn.invars]
+
+        # -- call-like primitives: inline the inner jaxpr ---------------
+        sub = _subjaxpr(eqn)
+        if sub is not None:
+            inner, inner_consts, extra = sub
+            outs = _convert_jaxpr(inner, inner_consts, ins[extra:], ctx)
+            for v, n in zip(eqn.outvars, outs):
+                ctx.names[v] = n
+            continue
+
+        out = _emit_primitive(prim, eqn, ins, ctx)
+        outs = out if isinstance(out, list) else [out]
+        for v, n in zip(eqn.outvars, outs):
+            ctx.names[v] = n
+
+    return [ctx.name_of(v) for v in jaxpr.outvars]
+
+
+def _subjaxpr(eqn):
+    """(inner_jaxpr, consts, n_leading_nonjaxpr_invars) for call-like
+    primitives, else None."""
+    prim = eqn.primitive.name
+    if prim in ("pjit", "jit", "closed_call", "core_call", "remat",
+                "checkpoint", "custom_jvp_call", "custom_vjp_call",
+                "custom_vjp_call_jaxpr", "custom_jvp_call_jaxpr"):
+        for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+            cj = eqn.params.get(key)
+            if cj is None:
+                continue
+            if hasattr(cj, "jaxpr"):     # ClosedJaxpr
+                return cj.jaxpr, cj.consts, 0
+            return cj, [], 0
+    return None
+
+
+def _emit_primitive(prim: str, eqn, ins: List[str], ctx: _Ctx):
+    params = eqn.params
+    if prim in _UNARY:
+        return ctx.emit(_UNARY[prim], [ins[0]])
+    if prim in _BINARY:
+        return ctx.emit(_BINARY[prim], ins[:2])
+    if prim == "rsqrt":
+        return ctx.emit("Reciprocal", [ctx.emit("Sqrt", [ins[0]])])
+    if prim == "rem":
+        # lax.rem is C-style truncated remainder (sign of dividend);
+        # ONNX Mod needs fmod=1 for that (fmod=0 is also float-invalid)
+        return ctx.emit("Mod", ins[:2], fmod=1)
+    if prim == "integer_pow":
+        y = params["y"]
+        dt = np.dtype(eqn.invars[0].aval.dtype)
+        return ctx.emit("Pow", [ins[0], ctx.const(np.asarray(y, dt))])
+    if prim == "stop_gradient" or prim == "copy":
+        return ctx.emit("Identity", [ins[0]])
+    if prim == "convert_element_type":
+        return ctx.emit("Cast", [ins[0]], to=_onnx_dt(params["new_dtype"]))
+    if prim == "transpose":
+        return ctx.emit("Transpose", [ins[0]],
+                        perm=list(params["permutation"]))
+    if prim == "reshape":
+        if params.get("dimensions"):
+            raise OnnxExportError("reshape with dimensions (collapse+"
+                                  "permute) has no single ONNX op")
+        shape = ctx.const(np.asarray(params["new_sizes"], np.int64), "shape")
+        return ctx.emit("Reshape", [ins[0], shape])
+    if prim == "broadcast_in_dim":
+        shape = list(params["shape"])
+        bd = list(params["broadcast_dimensions"])
+        in_shape = list(eqn.invars[0].aval.shape)
+        mid = [in_shape[bd.index(d)] if d in bd else 1
+               for d in range(len(shape))]
+        x = ins[0]
+        if mid != in_shape:
+            x = ctx.emit("Reshape", [x, ctx.const(
+                np.asarray(mid, np.int64), "shape")])
+        if mid != shape:
+            x = ctx.emit("Expand", [x, ctx.const(
+                np.asarray(shape, np.int64), "shape")])
+        elif x == ins[0]:
+            x = ctx.emit("Identity", [x])
+        return x
+    if prim == "select_n":
+        if len(ins) != 3:
+            raise OnnxExportError("select_n with >2 cases")
+        # select_n(which, a, b) yields b where which else a
+        return ctx.emit("Where", [ins[0], ins[2], ins[1]])
+    if prim in ("reduce_sum", "reduce_max", "reduce_min", "reduce_prod"):
+        axes = list(params["axes"])
+        op = {"reduce_sum": "ReduceSum", "reduce_max": "ReduceMax",
+              "reduce_min": "ReduceMin", "reduce_prod": "ReduceProd"}[prim]
+        if op == "ReduceSum":  # opset 13: axes is an input
+            ax = ctx.const(np.asarray(axes, np.int64), "axes")
+            return ctx.emit(op, [ins[0], ax], keepdims=0)
+        return ctx.emit(op, [ins[0]], axes=axes, keepdims=0)
+    if prim == "dot_general":
+        return _emit_dot_general(eqn, ins, ctx)
+    if prim == "conv_general_dilated":
+        return _emit_conv(eqn, ins, ctx)
+    if prim == "concatenate":
+        return ctx.emit("Concat", ins, axis=int(params["dimension"]))
+    if prim == "squeeze":
+        shape = ctx.const(np.asarray(eqn.outvars[0].aval.shape, np.int64),
+                          "shape")
+        return ctx.emit("Reshape", [ins[0], shape])
+    if prim == "tan":
+        return ctx.emit("Tan", [ins[0]])
+    if prim == "square":
+        return ctx.emit("Mul", [ins[0], ins[0]])
+    if prim == "erfc":
+        one = ctx.const(np.asarray(1, np.dtype(eqn.invars[0].aval.dtype)))
+        return ctx.emit("Sub", [one, ctx.emit("Erf", [ins[0]])])
+    if prim == "expm1":
+        one = ctx.const(np.asarray(1, np.dtype(eqn.invars[0].aval.dtype)))
+        return ctx.emit("Sub", [ctx.emit("Exp", [ins[0]]), one])
+    if prim == "log1p":
+        one = ctx.const(np.asarray(1, np.dtype(eqn.invars[0].aval.dtype)))
+        return ctx.emit("Log", [ctx.emit("Add", [ins[0], one])])
+    if prim == "clamp":
+        # lax.clamp(min, x, max)
+        return ctx.emit("Min", [ctx.emit("Max", ins[:2]), ins[2]])
+    raise OnnxExportError(
+        f"onnx export: primitive '{prim}' has no ONNX mapping in this "
+        "exporter (covers Linear/Conv/activation/normalization graphs). "
+        "For full-fidelity deployment use the StableHLO artifact: "
+        "paddle.jit.save(layer, path, input_spec=...).")
+
+
+def _emit_dot_general(eqn, ins, ctx: _Ctx):
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    lhs, rhs = eqn.invars[0].aval, eqn.invars[1].aval
+    lr, rr = len(lhs.shape), len(rhs.shape)
+    # numpy-style matmul: batch dims leading on both sides, lhs contracts
+    # its last dim with rhs's first non-batch dim. With explicit batch
+    # dims the lhs must be exactly [batch..., M, K] — a [batch..., K] lhs
+    # would make numpy matmul broadcast-batch instead of aligning, giving
+    # a different (wrong) result shape. Without batch dims any lhs rank
+    # works (numpy treats leading lhs dims as broadcast batch).
+    nb = len(lb)
+    if (list(lb) == list(range(nb)) and list(rb) == list(range(nb))
+            and list(lc) == [lr - 1] and list(rc) == [nb]
+            and rr - nb == 2
+            and (nb == 0 or lr - nb == 2)):
+        return ctx.emit("MatMul", [ins[0], ins[1]])
+    raise OnnxExportError(
+        f"dot_general with dimension_numbers {eqn.params['dimension_numbers']}"
+        " is not a numpy-style matmul; not supported by the onnx exporter")
+
+
+def _emit_conv(eqn, ins, ctx: _Ctx):
+    p = eqn.params
+    dn = p["dimension_numbers"]
+    ndim = len(eqn.invars[0].aval.shape)
+    iota = tuple(range(ndim))
+    if not (tuple(dn.lhs_spec) == iota and tuple(dn.rhs_spec) == iota
+            and tuple(dn.out_spec) == iota):
+        raise OnnxExportError(
+            "conv_general_dilated: only NCHW/OIHW layouts map to ONNX Conv "
+            f"(got {dn})")
+    if any(d != 1 for d in p["lhs_dilation"]):
+        raise OnnxExportError("transposed convolution (lhs_dilation != 1) "
+                              "is not mapped to ONNX ConvTranspose yet")
+    pads = list(p["padding"])  # [(lo, hi), ...] per spatial dim
+    onnx_pads = [lo for lo, _ in pads] + [hi for _, hi in pads]
+    return ctx.emit(
+        "Conv", ins[:2],
+        strides=list(p["window_strides"]),
+        pads=onnx_pads,
+        dilations=list(p["rhs_dilation"]),
+        group=int(p["feature_group_count"]))
+
+
+def export(layer, path: str, input_spec=None, opset_version: int = 13,
+           **configs) -> str:
+    """Export `layer` to `<path>.onnx`. Returns the written file path.
+
+    Parity: paddle.onnx.export(layer, path, input_spec, opset_version).
+    `input_spec` is a list of InputSpec/Tensors like paddle.jit.save's.
+    """
+    from ..core.tensor import Tensor
+    from ..jit.api import InputSpec
+    from ..jit.functional import functional_call, raw_state
+    from ..nn.layer_base import Layer
+
+    if not isinstance(layer, Layer):
+        raise TypeError("paddle.onnx.export expects a Layer")
+    if input_spec is None:
+        raise ValueError("paddle.onnx.export requires input_spec")
+    if opset_version != 13:
+        # node forms emitted here are opset-13 (ReduceSum axes-as-input,
+        # GreaterOrEqual, ...); stamping another opset would produce an
+        # invalid model, so normalize with a warning (the reference
+        # default is 9)
+        import warnings
+        warnings.warn(
+            f"paddle.onnx.export: opset_version={opset_version} is not "
+            "supported; exporting opset 13 (the emitted node forms)")
+        opset_version = 13
+
+    examples, in_names = [], []
+    for i, spec in enumerate(input_spec):
+        if isinstance(spec, InputSpec):
+            examples.append(spec._example())
+            in_names.append(spec.name or f"x{i}")
+        elif isinstance(spec, Tensor):
+            examples.append(spec.value)
+            in_names.append(f"x{i}")
+        else:
+            examples.append(jnp.asarray(spec))
+            in_names.append(f"x{i}")
+
+    params, buffers = raw_state(layer)
+    merged = {**params, **buffers}
+    state_names = sorted(merged)
+    flat_state = [merged[n] for n in state_names]
+
+    was_training = layer.training
+    layer.eval()
+    try:
+        def infer(*flat):
+            state = dict(zip(state_names, flat[:len(state_names)]))
+            p = {n: state[n] for n in params}
+            b = {n: state[n] for n in buffers}
+            out, _ = functional_call(layer, p, b,
+                                     *flat[len(state_names):],
+                                     training=False)
+            leaves, _ = jax.tree_util.tree_flatten(out)
+            return [l.value if isinstance(l, Tensor) else l for l in leaves]
+
+        closed = jax.make_jaxpr(infer)(*flat_state, *examples)
+    finally:
+        if was_training:
+            layer.train()
+
+    ctx = _Ctx(opset_version)
+    for n, v in zip(state_names, flat_state):
+        ctx.initializers.append(P.tensor(n, np.asarray(v)))
+    out_names = _convert_jaxpr(closed.jaxpr, closed.consts,
+                               state_names + in_names, ctx)
+
+    graph_inputs = [P.value_info(n, np.dtype(e.dtype), e.shape)
+                    for n, e in zip(in_names, examples)]
+    graph_outputs = []
+    final_names = []
+    for i, (n, v) in enumerate(zip(out_names, closed.jaxpr.outvars)):
+        on = f"out{i}"
+        ctx.nodes.append(P.node("Identity", [n], [on]))
+        graph_outputs.append(P.value_info(on, np.dtype(v.aval.dtype),
+                                          v.aval.shape))
+        final_names.append(on)
+
+    g = P.graph(ctx.nodes, "paddle_tpu_graph", ctx.initializers,
+                graph_inputs, graph_outputs)
+    data = P.model(g, opset_version=opset_version)
+    out_path = path if path.endswith(".onnx") else path + ".onnx"
+    dirname = os.path.dirname(out_path)
+    if dirname:
+        os.makedirs(dirname, exist_ok=True)
+    with open(out_path, "wb") as f:
+        f.write(data)
+    return out_path
