@@ -83,7 +83,10 @@ def get_mesh(create_default: bool = True) -> Optional[Mesh]:
 
 
 def mesh_axis_size(axis: str) -> int:
-    mesh = get_mesh()
+    # a pure query: must NOT create the default mesh as a side effect
+    # (model construction asks for "mp"/"pp" sizes; materializing a dp
+    # mesh here would pin later traces/exports to the full device count)
+    mesh = get_mesh(create_default=False)
     return mesh.shape.get(axis, 1) if mesh else 1
 
 

@@ -39,7 +39,11 @@ def _constrain(t: Tensor, *spec) -> Tensor:
     """Sharding constraint inside traced programs; no-op in eager mode on
     one device or when the mesh lacks the axis."""
     mesh = mesh_mod.get_mesh(create_default=False)
-    if mesh is None:
+    if mesh is None or mesh.shape.get("mp", 1) == 1:
+        # TP is degenerate without a real "mp" axis: every constraint in
+        # this module (sharded OR replicated-gather) is then a no-op, and
+        # emitting it would pin the traced program to the mesh's device
+        # count — breaking single-chip export/serving of TP-built models
         return t
     from ...autograd.tape import apply
     sharding = mesh_mod.named_sharding(*spec, mesh=mesh)

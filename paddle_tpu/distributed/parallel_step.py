@@ -331,7 +331,7 @@ class ParallelTrainStep:
             donate_argnums=(0, 1, 2, 3))
 
     # ------------------------------------------------------------------
-    def aot_compile(self, *batch_avals):
+    def aot_compile(self, *batch_avals, platform: str = None):
         """Lower + compile the full hybrid-parallel training step with
         abstract inputs — no parameter bytes are ever allocated. Use with
         a LazyGuard-constructed model to validate north-star-scale
@@ -345,6 +345,12 @@ class ParallelTrainStep:
             compiled.memory_analysis()   # per-device HBM requirements
 
         Returns the jax Compiled object (cost_analysis/memory_analysis).
+        With `platform` (e.g. "tpu") the step is instead CROSS-LOWERED
+        for that backend via jax.export and the Exported is returned —
+        this validates the program's TPU lowering (dtype/collective
+        patterns the CPU backend cannot compile, e.g. bf16 through the
+        pipeline ppermute ring) on a host with no TPU attached; backend
+        code generation still happens at load time on the real target.
         Reference-scale counterpart: the fleet hybrid suites
         (unittests/collective/fleet/hybrid_parallel_pp_transformer.py),
         which need real GPUs; this validates the same compositions
@@ -362,9 +368,12 @@ class ParallelTrainStep:
         scalar = jax.ShapeDtypeStruct((), jnp.float32)
         key = jax.eval_shape(
             lambda: _rng.default_generator().fold_in(1))
-        lowered = self._jitted.lower(
-            self.params, self.buffers, self.opt_state, scalar, scalar,
-            key, *raw_batch)
+        args = (self.params, self.buffers, self.opt_state, scalar, scalar,
+                key) + raw_batch
+        if platform is not None:
+            return jax.export.export(self._jitted, platforms=[platform])(
+                *args)
+        lowered = self._jitted.lower(*args)
         return lowered.compile()
 
     def __call__(self, *batch) -> Tensor:

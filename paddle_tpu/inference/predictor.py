@@ -35,6 +35,7 @@ class Config:
         self._memory_pool_mb = 0
         self._enable_profile = False
         self._glog_info = True
+        self._int8 = False
         self._flags: Dict[str, object] = {}
 
     # -- model location (reference: SetModel/SetProgFile/SetParamsFile) --
@@ -68,6 +69,16 @@ class Config:
 
     def use_gpu(self):
         return False
+
+    def enable_int8(self):
+        """Serve the int8-lowered program (reference role: TRT int8 with
+        calibration, tensorrt_subgraph_pass.cc). XLA has no load-time
+        subgraph rewriter — the int8 conversion happens ahead of time
+        (quantization.convert_to_int8 + jit.save); this flag makes the
+        Predictor prefer a `<prefix>_int8.pdmodel` sibling artifact and
+        otherwise REQUIRE the loaded program to contain int8 dots, so a
+        silently-f32 "int8 deployment" cannot happen."""
+        self._int8 = True
 
     # -- accepted no-op toggles (XLA subsumes them) ----------------------
     def enable_tensorrt_engine(self, *a, **k):
@@ -131,6 +142,14 @@ class Tensor:
         return list(v.shape) if v is not None else []
 
 
+def _has_int8_dots(mlir: str) -> bool:
+    """True when the program contains at least one dot_general over int8
+    operands — a uint8 image input or an i8 mask cast elsewhere must NOT
+    satisfy enable_int8()'s no-silent-f32 guarantee."""
+    import re
+    return bool(re.search(r"dot_general.*xi8>", mlir))
+
+
 class Predictor:
     """Parity: paddle_infer.Predictor (AnalysisPredictor).
 
@@ -144,9 +163,36 @@ class Predictor:
         import pickle
 
         self.config = config
-        with open(config.prog_file(), "rb") as f:
+        prefix = config._model_prefix or ""
+        used_sibling = False
+        if config._int8 and os.path.exists(prefix + "_int8.pdmodel"):
+            # prefer the int8-lowered sibling artifact; its params go
+            # with it (an explicitly-set f32 params_file would feed the
+            # wrong state tree to the int8 program)
+            prefix = prefix + "_int8"
+            used_sibling = True
+        prog_file = (prefix + ".pdmodel" if used_sibling
+                     else config.prog_file())
+        with open(prog_file, "rb") as f:
             self._exported = jax.export.deserialize(f.read())
-        with open(config.params_file(), "rb") as f:
+        if config._int8 and not _has_int8_dots(
+                self._exported.mlir_module()):
+            if used_sibling:
+                raise RuntimeError(
+                    f"Config.enable_int8(): {prefix}.pdmodel was found "
+                    "and loaded but contains no int8 dots — it is not an "
+                    "int8-lowered artifact. Re-export it: PTQ calibrate "
+                    "-> convert() -> quantization.convert_to_int8(model) "
+                    "-> paddle.jit.save(model, that prefix, input_spec)")
+            raise RuntimeError(
+                "Config.enable_int8(): the loaded program has no int8 "
+                "dots and no `<prefix>_int8.pdmodel` sibling exists. "
+                "Lower it first: quantization.PTQ calibrate -> "
+                "convert() -> quantization.convert_to_int8(model) -> "
+                "paddle.jit.save(model, prefix + '_int8', input_spec)")
+        params_file = (prefix + ".pdiparams" if used_sibling
+                       else config.params_file())
+        with open(params_file, "rb") as f:
             meta = pickle.load(f)
         self._state = {n: jax.device_put(v)
                        for n, v in meta["state"].items()}

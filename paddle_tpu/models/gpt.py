@@ -55,6 +55,9 @@ class GPTConfig:
     use_moe: bool = False
     moe_experts: int = 8
     initializer_range: float = 0.02
+    # rematerialize each block's activations in backward (jax.checkpoint;
+    # parity: fleet recompute_interval=1 over the decoder stack)
+    recompute: bool = False
 
 
 def gpt_tiny(**kw):
@@ -224,8 +227,19 @@ class GPTModel(Layer):
                 new_caches.append(c)
             return self.ln_f(x), new_caches
         x = self.embeddings(ids)
-        for blk in self.blocks:
-            x = blk(x)
+        if self.cfg.recompute and self.training:
+            if self.cfg.use_moe:
+                raise NotImplementedError(
+                    "cfg.recompute with use_moe: the MoE aux-loss side "
+                    "channel would cross the jax.checkpoint boundary "
+                    "(tracer leak); use GPTPipelineForCausalLM's "
+                    "recompute_interval for MoE models")
+            from ..distributed.recompute import recompute as _rc
+            for blk in self.blocks:
+                x = _rc(blk, x)
+        else:
+            for blk in self.blocks:
+                x = blk(x)
         return self.ln_f(x)
 
 

@@ -12,10 +12,11 @@ from .ptq import PTQ
 from . import observers
 from . import quanters
 from .quanted_layers import QuantedConv2D, QuantedLinear
+from .int8_lowering import Int8Linear, convert_to_int8
 
 __all__ = [
     "QuantConfig", "SingleLayerConfig", "BaseObserver", "BaseQuanter",
     "ObserveWrapper", "ObserverFactory", "QuanterFactory", "QAT", "PTQ",
     "observers", "quanters", "QuantedConv2D", "QuantedLinear",
-    "fake_quant_dequant",
+    "fake_quant_dequant", "Int8Linear", "convert_to_int8",
 ]

@@ -1,0 +1,128 @@
+"""Int8 inference lowering: a PTQ'd model becomes a true int8-dot program.
+
+Parity role: the reference's lowered int8 execution path — TRT int8
+subgraphs built from calibration tables
+(paddle/fluid/inference/tensorrt/convert/,
+analysis/ir_passes/tensorrt_subgraph_pass.cc) and static PTQ
+(python/paddle/static/quantization/post_training_quantization.py).
+There, an f32 program is rewritten at analysis time into int8 engine
+ops. The TPU-native shape of the same feature: rewrite at the MODULE
+level — `convert_to_int8` turns each PTQ-calibrated Linear into an
+`Int8Linear` whose forward quantizes the activation with the CALIBRATED
+static scale, runs `lax.dot_general(int8, int8) -> int32` (XLA's native
+integer dot; on TPU this feeds the MXU's int8 path), and dequantizes
+with per-output-channel weight scales. `paddle.jit.save` of the
+converted model then produces a StableHLO program whose dots ARE int8 —
+the deployment artifact plays the role of the serialized TRT engine,
+and `Config.enable_int8()` selects/validates it at Predictor load.
+
+Fake-quant (QAT/PTQ simulation) keeps f32 compute everywhere; this
+module is the step that actually shrinks weight memory 4x and uses the
+integer dot.
+"""
+from __future__ import annotations
+
+import copy
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..autograd.tape import apply
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+
+__all__ = ["Int8Linear", "convert_to_int8"]
+
+
+class Int8Linear(Layer):
+    """y = dequant(quant(x) @ int8_weight) + bias.
+
+    Static (calibrated) per-tensor activation scale; per-output-channel
+    weight scales — the scale layout the reference's TRT int8 convert
+    uses for FC layers. The int8 weight is a buffer (4x smaller than
+    f32), the dot accumulates in int32, and the combined
+    act_scale * w_scale dequant rides the dot's epilogue after XLA
+    fusion.
+    """
+
+    def __init__(self, linear, act_scale: float, bits: int = 8):
+        super().__init__()
+        if bits != 8:
+            raise NotImplementedError("int8 lowering supports bits=8")
+        bound = float(2 ** (bits - 1) - 1)
+        w = np.asarray(linear.weight.value, np.float32)     # [in, out]
+        s_w = np.maximum(np.abs(w).max(axis=0), 1e-9)       # per out-chan
+        qw = np.clip(np.round(w / s_w * bound), -bound, bound)
+        self.register_buffer("qweight", Tensor(jnp.asarray(qw, jnp.int8)))
+        # scales are pre-divided by the quant bound so forward is just
+        # one multiply per side
+        self.register_buffer(
+            "w_scale", Tensor(jnp.asarray(s_w / bound, jnp.float32)))
+        self.register_buffer(
+            "act_scale",
+            Tensor(jnp.asarray(float(act_scale) / bound, jnp.float32)))
+        self.bias = getattr(linear, "bias", None)
+        self._bound = bound
+
+    def forward(self, x):
+        bound = self._bound
+
+        def f(xv, qw, ws, sa, bv=None):
+            xq = jnp.clip(jnp.round(xv.astype(jnp.float32) / sa),
+                          -bound, bound).astype(jnp.int8)
+            acc = lax.dot_general(
+                xq, qw, (((xv.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            y = acc.astype(jnp.float32) * (sa * ws)
+            if bv is not None:
+                y = y + bv
+            return y.astype(xv.dtype)
+
+        args = [x, self.qweight, self.w_scale, self.act_scale]
+        if self.bias is not None:
+            args.append(self.bias)
+        return apply(f, *args, _op_name="int8_linear")
+
+    def extra_repr(self):
+        qw = self.qweight
+        return f"in={qw.shape[0]}, out={qw.shape[1]}, int8"
+
+
+def convert_to_int8(model: Layer, inplace: bool = False) -> Layer:
+    """Lower a `PTQ.convert`-ed model to int8 dots.
+
+    PTQ.convert leaves each calibrated layer as
+    ``Sequential(_StaticQDQ(act_scale), Linear)`` with fake-quantized
+    weights; this pass replaces every such pair whose inner layer is a
+    Linear with one `Int8Linear`. Non-Linear calibrated layers (Conv2D)
+    keep their fake-quant form — numerically identical, just not
+    integer-lowered yet. The result is servable: `paddle.jit.save` it
+    and load through `Config.enable_int8()` + `create_predictor`.
+    """
+    from .. import nn
+    from .ptq import _StaticQDQ
+
+    _model = model if inplace else copy.deepcopy(model)
+    n = _replace(_model, nn, _StaticQDQ)
+    if n == 0:
+        raise ValueError(
+            "convert_to_int8: no PTQ-calibrated Linear layers found — "
+            "run PTQ(q_config).quantize(model), calibration batches, "
+            "then PTQ.convert(model) first")
+    return _model
+
+
+def _replace(layer, nn, qdq_cls) -> int:
+    n = 0
+    for name, child in list(layer._sub_layers.items()):
+        if (isinstance(child, nn.Sequential)
+                and len(child._sub_layers) == 2):
+            subs = list(child._sub_layers.values())
+            if isinstance(subs[0], qdq_cls) and isinstance(subs[1], nn.Linear):
+                layer._sub_layers[name] = Int8Linear(
+                    subs[1], act_scale=subs[0]._scale, bits=subs[0]._bits)
+                n += 1
+                continue
+        n += _replace(child, nn, qdq_cls)
+    return n

@@ -1,0 +1,119 @@
+"""Int8 inference lowering: PTQ calibration -> true int8-dot programs.
+
+Reference role: the TRT int8 path (inference/tensorrt/convert/,
+tensorrt_subgraph_pass.cc) + static PTQ
+(post_training_quantization.py). Validates (VERDICT r3 item 9):
+ * convert_to_int8 replaces calibrated Linears with int8-dot layers,
+ * int8 outputs track the fake-quant reference on a BERT encoder,
+ * the saved artifact contains int8 dots and serves through
+   Config.enable_int8() -> create_predictor,
+ * enable_int8 on an f32 artifact refuses loudly.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.inference import Config, create_predictor
+from paddle_tpu.models import BertForSequenceClassification, bert_tiny
+from paddle_tpu.quantization import (PTQ, Int8Linear, QuantConfig,
+                                     convert_to_int8)
+from paddle_tpu.quantization.observers import AbsmaxObserver
+from paddle_tpu.static import InputSpec
+
+
+def _ptq_pipeline(model, calib_batches):
+    q = QuantConfig(activation=AbsmaxObserver(), weight=None)
+    ptq = PTQ(q)
+    observed = ptq.quantize(model)
+    for b in calib_batches:
+        observed(*b)
+    fakeq, scales = ptq.convert(observed)
+    int8 = convert_to_int8(fakeq)
+    return fakeq, int8, scales
+
+
+class TestInt8Linear:
+    def test_mlp_tracks_fake_quant(self):
+        paddle.seed(0)
+        rng = np.random.RandomState(0)
+        model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                              nn.Linear(32, 8))
+        model.eval()
+        calib = [(paddle.to_tensor(
+            rng.randn(4, 16).astype(np.float32)),) for _ in range(4)]
+        fakeq, int8, scales = _ptq_pipeline(model, calib)
+        assert any(isinstance(l, Int8Linear) for l in int8.sublayers())
+        x = paddle.to_tensor(rng.randn(8, 16).astype(np.float32))
+        ref = fakeq(x).numpy()
+        got = int8(x).numpy()
+        # int8 dot vs f32 fake-quant: same quant grid on activations,
+        # per-channel (finer) grid on weights; small residual expected
+        delta = np.abs(ref - got).max()
+        assert delta < 0.05 * (np.abs(ref).max() + 1e-6), delta
+
+    def test_requires_calibration(self):
+        with pytest.raises(ValueError, match="PTQ"):
+            convert_to_int8(nn.Sequential(nn.Linear(4, 4)))
+
+
+class TestInt8Bert:
+    def _bert_pipeline(self):
+        paddle.seed(1)
+        rng = np.random.RandomState(1)
+        model = BertForSequenceClassification(bert_tiny(), num_classes=4)
+        model.eval()
+        calib = [(paddle.to_tensor(
+            rng.randint(0, model.bert.cfg.vocab_size, (2, 32))
+            .astype(np.int64)),) for _ in range(3)]
+        fakeq, int8, _ = _ptq_pipeline(model, calib)
+        ids = paddle.to_tensor(
+            rng.randint(0, model.bert.cfg.vocab_size, (4, 32))
+            .astype(np.int64))
+        return fakeq, int8, ids
+
+    def test_encoder_accuracy_delta(self):
+        fakeq, int8, ids = self._bert_pipeline()
+        ref = fakeq(ids).numpy()
+        got = int8(ids).numpy()
+        # record the delta the way the reference PTQ docs do: quantized
+        # logits must preserve ranking on the classification head
+        assert np.argmax(ref, -1).tolist() == np.argmax(got, -1).tolist()
+        rel = np.abs(ref - got).max() / (np.abs(ref).max() + 1e-6)
+        assert rel < 0.15, f"int8 BERT diverged from fake-quant: {rel:.3f}"
+
+    def test_serves_through_predictor(self, tmp_path):
+        fakeq, int8, ids = self._bert_pipeline()
+        prefix = str(tmp_path / "bert")
+        spec = [InputSpec([4, 32], "int64", "ids")]
+        paddle.jit.save(int8, prefix + "_int8", input_spec=spec)
+
+        cfg = Config(prefix)
+        cfg.enable_int8()
+        pred = create_predictor(cfg)
+        [out] = pred.run([ids.numpy()])
+        np.testing.assert_allclose(out, int8(ids).numpy(),
+                                   rtol=2e-3, atol=2e-3)
+
+    def test_enable_int8_refuses_f32_artifact(self, tmp_path):
+        paddle.seed(2)
+        model = nn.Sequential(nn.Linear(8, 8))
+        model.eval()
+        prefix = str(tmp_path / "f32model")
+        paddle.jit.save(model, prefix,
+                        input_spec=[InputSpec([2, 8], "float32", "x")])
+        cfg = Config(prefix)
+        cfg.enable_int8()
+        with pytest.raises(RuntimeError, match="convert_to_int8"):
+            create_predictor(cfg)
+
+    def test_artifact_contains_int8_dots(self, tmp_path):
+        import jax
+        fakeq, int8, ids = self._bert_pipeline()
+        prefix = str(tmp_path / "bert_int8")
+        paddle.jit.save(int8, prefix,
+                        input_spec=[InputSpec([4, 32], "int64", "ids")])
+        with open(prefix + ".pdmodel", "rb") as f:
+            exported = jax.export.deserialize(f.read())
+        mlir = exported.mlir_module()
+        assert "i8" in mlir and "dot_general" in mlir

@@ -181,3 +181,23 @@ def test_to_static_function_single_tuple_output():
     out = f(x)
     assert isinstance(out, tuple) and len(out) == 1
     np.testing.assert_allclose(out[0].numpy(), 2 * np.ones((2, 2)))
+
+
+def test_gpt_recompute_matches_plain():
+    """cfg.recompute=True (per-block jax.checkpoint, fleet recompute
+    parity) must change memory, not math: identical loss trajectory."""
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    ids = np.random.RandomState(3).randint(0, 64, (2, 16)).astype("int64")
+    losses = []
+    for rc in (False, True):
+        paddle.seed(11)
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                        num_heads=2, max_seq_len=16, recompute=rc)
+        model = GPTForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=model.parameters())
+        step = TrainStep(model, GPTForCausalLM.loss_fn, opt)
+        t = paddle.to_tensor(ids)
+        losses.append([float(step(t, t)) for _ in range(3)])
+    np.testing.assert_allclose(losses[0], losses[1], rtol=1e-5)

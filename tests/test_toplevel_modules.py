@@ -55,10 +55,18 @@ def test_hub_local(tmp_path):
         paddle.hub.load("user/repo", "tiny")
 
 
-def test_onnx_guidance():
+def test_onnx_export_real(tmp_path):
+    """onnx.export is a real exporter since round 4 (see tests/test_onnx.py
+    for deep coverage); input_spec stays mandatory like jit.save's."""
     import paddle_tpu.nn as nn
-    with pytest.raises(NotImplementedError, match="jit.save"):
-        paddle.onnx.export(nn.Linear(2, 2), "/tmp/x")
+    with pytest.raises(ValueError, match="input_spec"):
+        paddle.onnx.export(nn.Linear(2, 2), str(tmp_path / "x"))
+    out = paddle.onnx.export(
+        nn.Linear(2, 2), str(tmp_path / "x"),
+        input_spec=[paddle.static.InputSpec([1, 2], "float32")])
+    assert out.endswith(".onnx")
+    import os
+    assert os.path.getsize(out) > 0
 
 
 def test_dataset_namespace(tmp_path):
