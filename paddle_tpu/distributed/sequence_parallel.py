@@ -164,7 +164,11 @@ def _ring_fused_bwd(sp, sl, scale, causal, bq, bk, interpret, res, do):
     idx = lax.axis_index("sp")
     perm = [(i, (i + 1) % sp) for i in range(sp)]
     q_off = (idx * sl).astype(jnp.int32)
-    delta = _fb.compute_delta(out, do)   # loop-invariant: hoisted
+    # loop-invariant residuals, hoisted INCLUDING the 128-lane broadcast
+    # the Mosaic block layout needs (rank-4 passes through _bwd untouched)
+    delta = jnp.broadcast_to(
+        _fb.compute_delta(out, do)[..., None], out.shape[:3] + (128,))
+    lse = jnp.broadcast_to(lse[..., None], out.shape[:3] + (128,))
 
     def step(carry, i):
         k_blk, v_blk, dk_blk, dv_blk, dq = carry

@@ -104,7 +104,11 @@ def _fwd_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
         lse = jnp.where(l_scr[:] == 0.0, _NEG_INF,
                         m_scr[:] + jnp.log(jnp.where(l_scr[:] == 0.0, 1.0,
                                                      l_scr[:])))
-        lse_ref[0, 0] = lse[:, 0]
+        # lane-broadcast [bq, 128] store: Mosaic requires the last two
+        # block dims be (8k, 128)-tiled, so a [bq]-vector LSE output is
+        # unlowerable — same layout trick as the library TPU kernel's
+        # l/m residuals; the wrapper slices [..., 0]
+        lse_ref[0, 0] = lse
 
 
 def _fwd(q, k, v, q_off, k_off, causal, sm_scale, bq, bk, interpret):
@@ -123,7 +127,7 @@ def _fwd(q, k, v, q_off, k_off, causal, sm_scale, bq, bk, interpret):
         return (b, h, iq, 0)
 
     def lmap(b, h, iq, ik, *_):
-        return (b, h, iq)
+        return (b, h, iq, 0)
 
     kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale,
                                causal=causal, bq=bq, bk=bk)
@@ -139,7 +143,7 @@ def _fwd(q, k, v, q_off, k_off, causal, sm_scale, bq, bk, interpret):
             ],
             out_specs=[
                 pl.BlockSpec((1, 1, bq, D), omap),
-                pl.BlockSpec((1, 1, bq), lmap),
+                pl.BlockSpec((1, 1, bq, 128), lmap),
             ],
             scratch_shapes=[
                 pltpu.VMEM((bq, D), jnp.float32),
@@ -149,14 +153,14 @@ def _fwd(q, k, v, q_off, k_off, causal, sm_scale, bq, bk, interpret):
         ),
         out_shape=[
             jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
-            jax.ShapeDtypeStruct((B, H, Sq), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, Sq, 128), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
     )(q_off.reshape(1), k_off.reshape(1), q, k, v)
-    return out, lse
+    return out, lse[..., 0]
 
 
 # --------------------------------------------------------------- backward
@@ -175,19 +179,19 @@ def _bwd_dkv_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     k = k_ref[0, 0].astype(jnp.float32)
     v = v_ref[0, 0].astype(jnp.float32)
     do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0]                                 # [bq]
-    delta = dl_ref[0, 0]                                # [bq]
+    lse = lse_ref[0, 0][:, :1]                          # [bq, 1] (lane bcast)
+    delta = dl_ref[0, 0][:, :1]                         # [bq, 1]
 
     s = _dot(q, k, ((1,), (1,))) * sm_scale             # [bq, bk]
     if causal:
         ik = pl.program_id(2)
         mask = _causal_mask(qo_ref[0], ko_ref[0], iq, ik, bq, bk)
         s = jnp.where(mask, s, _NEG_INF)
-    lse_safe = jnp.where(jnp.isneginf(lse), 0.0, lse)[:, None]
+    lse_safe = jnp.where(jnp.isneginf(lse), 0.0, lse)
     p = jnp.exp(s - lse_safe)                            # masked -> exp(-inf)=0
     dv_scr[:] = dv_scr[:] + _dot(p, do, ((0,), (0,)))    # [bk, D]
     dp = _dot(do, v, ((1,), (1,)))                       # [bq, bk]
-    ds = p * (dp - delta[:, None]) * sm_scale
+    ds = p * (dp - delta) * sm_scale
     dk_scr[:] = dk_scr[:] + _dot(ds, q, ((0,), (0,)))    # [bk, D]
 
     @pl.when(iq == nq - 1)
@@ -208,18 +212,18 @@ def _bwd_dq_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     k = k_ref[0, 0].astype(jnp.float32)
     v = v_ref[0, 0].astype(jnp.float32)
     do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0]
-    delta = dl_ref[0, 0]
+    lse = lse_ref[0, 0][:, :1]                          # [bq, 1] (lane bcast)
+    delta = dl_ref[0, 0][:, :1]
 
     s = _dot(q, k, ((1,), (1,))) * sm_scale
     if causal:
         iq = pl.program_id(2)
         mask = _causal_mask(qo_ref[0], ko_ref[0], iq, ik, bq, bk)
         s = jnp.where(mask, s, _NEG_INF)
-    lse_safe = jnp.where(jnp.isneginf(lse), 0.0, lse)[:, None]
+    lse_safe = jnp.where(jnp.isneginf(lse), 0.0, lse)
     p = jnp.exp(s - lse_safe)
     dp = _dot(do, v, ((1,), (1,)))
-    ds = p * (dp - delta[:, None]) * sm_scale
+    ds = p * (dp - delta) * sm_scale
     dq_scr[:] = dq_scr[:] + _dot(ds, k, ((1,), (0,)))    # [bq, D]
 
     @pl.when(ik == nk - 1)
@@ -249,6 +253,14 @@ def _bwd(q, k, v, q_off, k_off, out, lse, do, causal, sm_scale, bq, bk,
     nq, nk = Sq // bq, Sk // bk
     if delta is None:
         delta = compute_delta(out, do, dlse)
+    # lane-broadcast the per-row residuals to [B, H, Sq, 128]: Mosaic
+    # cannot tile a rank-3 [.., bq] block (see _fwd's lse layout note).
+    # rank-4 inputs are accepted as-is so loop callers (ring backward)
+    # can hoist the broadcast out of their scan
+    if lse.ndim == 3:
+        lse = jnp.broadcast_to(lse[..., None], (B, H, Sq, 128))
+    if delta.ndim == 3:
+        delta = jnp.broadcast_to(delta[..., None], (B, H, Sq, 128))
 
     def qmap(b, h, i, j, *_):
         # q-indexed blocks: in dkv the SEQUENTIAL dim (last) walks q
@@ -258,7 +270,7 @@ def _bwd(q, k, v, q_off, k_off, out, lse, do, causal, sm_scale, bq, bk,
         return (b, h, ik, 0)
 
     def lmap_dkv(b, h, ik, iq, *_):
-        return (b, h, iq)
+        return (b, h, iq, 0)
 
     dkv_kernel = functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale,
                                    causal=causal, bq=bq, bk=bk)
@@ -272,8 +284,8 @@ def _bwd(q, k, v, q_off, k_off, out, lse, do, causal, sm_scale, bq, bk,
                 pl.BlockSpec((1, 1, bk, D), kmap_dkv),
                 pl.BlockSpec((1, 1, bk, D), kmap_dkv),
                 pl.BlockSpec((1, 1, bq, D), qmap),
-                pl.BlockSpec((1, 1, bq), lmap_dkv),
-                pl.BlockSpec((1, 1, bq), lmap_dkv),
+                pl.BlockSpec((1, 1, bq, 128), lmap_dkv),
+                pl.BlockSpec((1, 1, bq, 128), lmap_dkv),
             ],
             out_specs=[
                 pl.BlockSpec((1, 1, bk, D), kmap_dkv),
@@ -301,7 +313,7 @@ def _bwd(q, k, v, q_off, k_off, out, lse, do, causal, sm_scale, bq, bk,
         return (b, h, ik, 0)
 
     def lmap_dq(b, h, iq, ik, *_):
-        return (b, h, iq)
+        return (b, h, iq, 0)
 
     dq_kernel = functools.partial(_bwd_dq_kernel, sm_scale=sm_scale,
                                   causal=causal, bq=bq, bk=bk)
@@ -315,8 +327,8 @@ def _bwd(q, k, v, q_off, k_off, out, lse, do, causal, sm_scale, bq, bk,
                 pl.BlockSpec((1, 1, bk, D), kmap_dq),
                 pl.BlockSpec((1, 1, bk, D), kmap_dq),
                 pl.BlockSpec((1, 1, bq, D), qmap_dq),
-                pl.BlockSpec((1, 1, bq), lmap_dq),
-                pl.BlockSpec((1, 1, bq), lmap_dq),
+                pl.BlockSpec((1, 1, bq, 128), lmap_dq),
+                pl.BlockSpec((1, 1, bq, 128), lmap_dq),
             ],
             out_specs=pl.BlockSpec((1, 1, bq, D), qmap_dq),
             scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
