@@ -288,12 +288,18 @@ def ignore_module(modules):
 # save / load: StableHLO program + params (deployment artifact)
 # ---------------------------------------------------------------------------
 
-def save(layer, path, input_spec=None, **config):
+def save(layer, path, input_spec=None, platforms=None, **config):
     """Serialize `layer` (or decorated StaticFunction) for serving.
 
     Writes `<path>.pdmodel` (StableHLO bytes via jax.export) and
     `<path>.pdiparams` (pickled numpy state). Parity: paddle.jit.save
     (python/paddle/jit/api.py) producing __model__ + params.
+
+    `platforms`: jax.export lowering targets. Default: when saving on a
+    CPU host the artifact is lowered for BOTH ("cpu", "tpu") so a model
+    exported on a dev machine serves on the TPU fleet (the reference's
+    __model__ is backend-portable the same way); when saving on a TPU
+    the trace may contain Mosaic kernels, so it stays TPU-only.
     """
     from ..nn.layer_base import Layer
     if not isinstance(layer, Layer):
@@ -341,7 +347,36 @@ def save(layer, path, input_spec=None, **config):
             return out
 
         merged = {**params, **buffers}
-        exported = jax.export.export(jax.jit(infer))(merged, *examples)
+        if isinstance(platforms, str):
+            platforms = (platforms,)
+        elif platforms is not None:
+            platforms = tuple(platforms)
+            if not platforms:
+                raise ValueError(
+                    "jit.save: platforms must be None or a non-empty "
+                    "sequence of platform names ('cpu', 'tpu')")
+        defaulted = platforms is None and jax.default_backend() == "cpu"
+        if defaulted:
+            platforms = ("cpu", "tpu")
+
+        def _export(plats):
+            return jax.export.export(
+                jax.jit(infer),
+                **({"platforms": plats} if plats else {}),
+            )(merged, *examples)
+
+        try:
+            exported = _export(platforms)
+        except Exception:
+            if not defaulted:
+                raise
+            # the dual-platform default must not break models that only
+            # lower for the native backend — fall back with a warning
+            import warnings
+            warnings.warn(
+                "jit.save: TPU cross-lowering failed; artifact exported "
+                "for 'cpu' only (pass platforms=(...,) to control this)")
+            exported = _export(("cpu",))
     finally:
         if was_training:
             layer.train()

@@ -201,3 +201,16 @@ def test_gpt_recompute_matches_plain():
         t = paddle.to_tensor(ids)
         losses.append([float(step(t, t)) for _ in range(3)])
     np.testing.assert_allclose(losses[0], losses[1], rtol=1e-5)
+
+
+def test_jit_save_is_platform_portable(tmp_path):
+    """An artifact saved on the CPU host must serve on the TPU fleet:
+    jit.save lowers for both platforms (reference's __model__ is
+    backend-portable the same way)."""
+    import jax
+    m = MLP()
+    path = str(tmp_path / "portable")
+    paddle.jit.save(m, path, input_spec=[paddle.jit.InputSpec([2, 8])])
+    with open(path + ".pdmodel", "rb") as f:
+        exported = jax.export.deserialize(f.read())
+    assert set(exported.platforms) == {"cpu", "tpu"}
