@@ -175,12 +175,25 @@ def _emit_primitive(prim: str, eqn, ins: List[str], ctx: _Ctx):
             ax = ctx.const(np.asarray(axes, np.int64), "axes")
             return ctx.emit(op, [ins[0], ax], keepdims=0)
         return ctx.emit(op, [ins[0]], axes=axes, keepdims=0)
+    if prim in ("reduce_window_max", "reduce_window_sum"):
+        return _emit_pool(prim, eqn, ins, ctx)
     if prim == "dot_general":
         return _emit_dot_general(eqn, ins, ctx)
     if prim == "conv_general_dilated":
         return _emit_conv(eqn, ins, ctx)
     if prim == "concatenate":
         return ctx.emit("Concat", ins, axis=int(params["dimension"]))
+    if prim == "slice":
+        starts = list(params["start_indices"])
+        ends = list(params["limit_indices"])
+        steps = list(params["strides"] or [1] * len(starts))
+        axes = list(range(len(starts)))
+        return ctx.emit("Slice", [
+            ins[0],
+            ctx.const(np.asarray(starts, np.int64), "starts"),
+            ctx.const(np.asarray(ends, np.int64), "ends"),
+            ctx.const(np.asarray(axes, np.int64), "axes"),
+            ctx.const(np.asarray(steps, np.int64), "steps")])
     if prim == "squeeze":
         shape = ctx.const(np.asarray(eqn.outvars[0].aval.shape, np.int64),
                           "shape")
@@ -206,6 +219,39 @@ def _emit_primitive(prim: str, eqn, ins: List[str], ctx: _Ctx):
         "exporter (covers Linear/Conv/activation/normalization graphs). "
         "For full-fidelity deployment use the StableHLO artifact: "
         "paddle.jit.save(layer, path, input_spec=...).")
+
+
+def _emit_pool(prim: str, eqn, ins, ctx: _Ctx):
+    """reduce_window over NC+spatial -> ONNX MaxPool / AveragePool.
+    Sum pooling has no ONNX op: emitted as AveragePool(count_include_pad)
+    scaled by the window size — the AvgPool2D trace's trailing div then
+    reproduces the exact average."""
+    p = eqn.params
+    wd = list(p["window_dimensions"])
+    ws = list(p["window_strides"])
+    pads = list(p["padding"])
+    if (len(wd) < 3 or wd[0] != 1 or wd[1] != 1
+            or any(s != 1 for s in ws[:2])
+            or any(d != 1 for d in p["base_dilation"])
+            or any(pa != (0, 0) for pa in pads[:2])):
+        raise OnnxExportError(
+            f"{prim} with window {wd} is not an NC-leading spatial pool; "
+            "not supported by the onnx exporter")
+    spatial_pads = pads[2:]
+    onnx_pads = ([lo for lo, _ in spatial_pads]
+                 + [hi for _, hi in spatial_pads])
+    attrs = dict(kernel_shape=wd[2:], strides=ws[2:], pads=onnx_pads)
+    if prim == "reduce_window_max":
+        wdil = list(p["window_dilation"])[2:]
+        if any(d != 1 for d in wdil):
+            attrs["dilations"] = wdil
+        return ctx.emit("MaxPool", [ins[0]], **attrs)
+    if any(d != 1 for d in p["window_dilation"]):
+        raise OnnxExportError("dilated sum pooling has no ONNX mapping")
+    avg = ctx.emit("AveragePool", [ins[0]], count_include_pad=1, **attrs)
+    n = float(np.prod(wd[2:]))
+    return ctx.emit("Mul", [avg, ctx.const(
+        np.asarray(n, np.dtype(eqn.invars[0].aval.dtype)))])
 
 
 def _emit_dot_general(eqn, ins, ctx: _Ctx):

@@ -20,10 +20,12 @@ if p == "cpu":
 sys.stdout.write("device_kind=%s n=%d\n" % (getattr(d[0], "device_kind", "?"), len(d)))
 EOF
   then
-    echo "[watch] $(date -u +%FT%TZ) probe OK -> running bench.py" >> "$LOG"
-    python bench.py > /root/repo/BENCH_live.json 2>> "$LOG"
-    echo "[watch] bench rc=$? output:" >> "$LOG"
-    cat /root/repo/BENCH_live.json >> "$LOG"
+    echo "[watch] $(date -u +%FT%TZ) probe OK -> running full TPU suite" >> "$LOG"
+    if bash /root/repo/tools/tpu_suite.sh; then
+      echo "[watch] suite finished; results in tpu_results/" >> "$LOG"
+    else
+      echo "[watch] suite FAILED rc=$? (missing script or crash) — see tpu_results/suite.log" >> "$LOG"
+    fi
     exit 0
   fi
   echo "[watch] $(date -u +%FT%TZ) probe failed/hung; sleep 600" >> "$LOG"
