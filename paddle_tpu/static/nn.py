@@ -311,3 +311,441 @@ def Assert(cond, data=None, summarize: int = 20, name: Optional[str] = None):
 
     jax.debug.callback(_check, _scalar_bool(cond, "Assert"), *vals)
     return None
+
+
+# ---------------------------------------------------------------------------
+# Layer functions (reference: python/paddle/static/nn/common.py). The
+# reference's versions splice ops + parameters into the static Program via
+# LayerHelper; here each call instantiates the corresponding nn Layer and
+# registers it in a module registry so its parameters persist. Calls are
+# keyed by `name`: a named call reuses its layer (so a static-style build
+# function can run per step), an unnamed call creates a fresh layer under
+# an auto-counter name. `paddle.static.nn.build_registry()` exposes the
+# created layers (their parameters feed optimizers the way
+# Program.all_parameters does in the reference).
+# ---------------------------------------------------------------------------
+
+_BUILD_REGISTRY: dict = {}
+_AUTO_COUNT: dict = {}
+
+__all__ += ["fc", "embedding", "batch_norm", "layer_norm", "group_norm",
+            "instance_norm", "data_norm", "conv2d", "conv2d_transpose",
+            "conv3d", "conv3d_transpose", "prelu",
+            "bilinear_tensor_product", "spectral_norm", "deform_conv2d",
+            "row_conv", "nce", "sparse_embedding", "StaticRNN",
+            "build_registry", "reset_build_registry"]
+
+
+def build_registry() -> dict:
+    """name -> Layer created by the functions below (the role of
+    Program.global_block().all_parameters() for optimizer wiring)."""
+    return dict(_BUILD_REGISTRY)
+
+
+def reset_build_registry():
+    _BUILD_REGISTRY.clear()
+    _AUTO_COUNT.clear()
+
+
+def _layer(kind: str, name, factory):
+    # composite key: the same user `name` on two DIFFERENT layer
+    # functions must not collide into one layer
+    if name is None:
+        n = _AUTO_COUNT.get(kind, 0)
+        _AUTO_COUNT[kind] = n + 1
+        key = f"{kind}_{n}"
+    else:
+        key = f"{kind}/{name}"
+    layer = _BUILD_REGISTRY.get(key)
+    if layer is None:
+        layer = factory()
+        _BUILD_REGISTRY[key] = layer
+    return layer
+
+
+def _require_nchw(fmt: str, fn: str):
+    if fmt not in ("NCHW", "NCDHW", "NCL"):
+        raise NotImplementedError(
+            f"static.nn.{fn}: only channel-first layouts are wired "
+            f"(got {fmt!r}); transpose the input or use the nn Layer "
+            "classes directly")
+
+
+def _act(out, activation):
+    if activation is None:
+        return out
+    from .. import nn as _nn
+    fn = getattr(_nn.functional, activation, None)
+    if fn is None:
+        raise ValueError(f"unknown activation {activation!r}")
+    return fn(out)
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """Parity: static.nn.fc (static/nn/common.py) — flattens trailing
+    dims, multiplies, sums multiple inputs, optional activation."""
+    from .. import nn as _nn
+    from ..tensor import manipulation as _m
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    out = None
+    for i, t in enumerate(xs):
+        shape = t.shape
+        flat = 1
+        for d in shape[num_flatten_dims:]:
+            flat *= d
+        t2 = _m.reshape(t, list(shape[:num_flatten_dims]) + [flat])
+        lin = _layer("fc", f"{name}_in{i}" if name else None,
+                     lambda: _nn.Linear(flat, size,
+                                        weight_attr=weight_attr,
+                                        bias_attr=bias_attr))
+        y = lin(t2)
+        out = y if out is None else out + y
+    return _act(out, activation)
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32",
+              name=None):
+    """Parity: static.nn.embedding."""
+    from .. import nn as _nn
+    emb = _layer("embedding", name,
+                 lambda: _nn.Embedding(size[0], size[1],
+                                       padding_idx=padding_idx,
+                                       weight_attr=param_attr))
+    return emb(input)
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               in_place=False, name=None, moving_mean_name=None,
+               moving_variance_name=None, do_model_average_for_mean_and_var=True,
+               use_global_stats=False):
+    """Parity: static.nn.batch_norm — dimensionality from the input."""
+    from .. import nn as _nn
+    _require_nchw(data_layout, "batch_norm")
+    C = input.shape[1]
+    cls = {2: _nn.BatchNorm1D, 3: _nn.BatchNorm1D, 4: _nn.BatchNorm2D,
+           5: _nn.BatchNorm3D}[len(input.shape)]
+    bn = _layer("batch_norm", name,
+                lambda: cls(C, momentum=momentum, epsilon=epsilon))
+    # mode follows THIS call: a name-reused layer must not stay stuck in
+    # a previous build's is_test mode
+    if is_test or use_global_stats:
+        bn.eval()
+    else:
+        bn.train()
+    return _act(bn(input), act)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    """Parity: static.nn.layer_norm — normalizes dims from
+    begin_norm_axis to the end; scale/shift=False drop the affine
+    parameters like the reference."""
+    from .. import nn as _nn
+    shape = list(input.shape[begin_norm_axis:])
+    ln = _layer("layer_norm", name, lambda: _nn.LayerNorm(
+        shape, epsilon,
+        weight_attr=(param_attr if scale else False),
+        bias_attr=(bias_attr if shift else False)))
+    return _act(ln(input), act)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None,
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    from .. import nn as _nn
+    _require_nchw(data_layout, "group_norm")
+    gn = _layer("group_norm", name,
+                lambda: _nn.GroupNorm(groups, input.shape[1], epsilon))
+    return _act(gn(input), act)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    from .. import nn as _nn
+    C = input.shape[1]
+    cls = {3: _nn.InstanceNorm1D, 4: _nn.InstanceNorm2D,
+           5: _nn.InstanceNorm3D}[len(input.shape)]
+    inorm = _layer("instance_norm", name, lambda: cls(C, epsilon=epsilon))
+    return inorm(input)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              enable_scale_and_shift=False, name=None, **kwargs):
+    """Parity: static.nn.data_norm (common.py:431) — normalization from
+    accumulated batch statistics (batch_size/batch_sum/batch_square_sum
+    buffers), the CTR-model normalizer. Stats update eagerly in train
+    mode; is_test freezes them."""
+    import jax.numpy as jnp
+    from .. import nn as _nn
+    from ..core.tensor import Tensor
+
+    class _DataNorm(_nn.Layer):
+        def __init__(self, C):
+            super().__init__()
+            self.register_buffer("batch_size",
+                                 Tensor(jnp.full((C,), 1e4, jnp.float32)))
+            self.register_buffer("batch_sum",
+                                 Tensor(jnp.zeros((C,), jnp.float32)))
+            self.register_buffer("batch_square_sum",
+                                 Tensor(jnp.full((C,), 1e4, jnp.float32)))
+            if enable_scale_and_shift:
+                self.scale_w = self.create_parameter([C])
+                self.bias = self.create_parameter([C], is_bias=True)
+
+        def forward(self, x):
+            mean = self.batch_sum.value / self.batch_size.value
+            var = (self.batch_square_sum.value / self.batch_size.value
+                   - mean * mean)
+            y = (x.value - mean) / jnp.sqrt(var + epsilon)
+            if enable_scale_and_shift:
+                y = y * self.scale_w.value + self.bias.value
+            if self.training:
+                n = x.shape[0]
+                self.batch_size.value = self.batch_size.value + n
+                self.batch_sum.value = self.batch_sum.value + \
+                    jnp.sum(x.value, axis=0)
+                self.batch_square_sum.value = self.batch_square_sum.value \
+                    + jnp.sum(x.value * x.value, axis=0)
+            return Tensor(y)
+
+    dn = _layer("data_norm", name, lambda: _DataNorm(input.shape[-1]))
+    return _act(dn(input), act)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           use_cudnn=True, act=None, name=None, data_format="NCHW"):
+    from .. import nn as _nn
+    _require_nchw(data_format, "conv2d")
+    conv = _layer("conv2d", name,
+                  lambda: _nn.Conv2D(input.shape[1], num_filters,
+                                     filter_size, stride=stride,
+                                     padding=padding, dilation=dilation,
+                                     groups=groups,
+                                     weight_attr=param_attr,
+                                     bias_attr=bias_attr))
+    return _act(conv(input), act)
+
+
+def _deconv_filter_size(output_size, in_hw, stride, padding, dilation, n):
+    """filter_size from a requested output_size (reference
+    conv2d_transpose semantics): out = (in-1)*s - 2*p + d*(f-1) + 1,
+    solved for f."""
+    outs = (output_size if isinstance(output_size, (list, tuple))
+            else [output_size] * n)
+    ss = stride if isinstance(stride, (list, tuple)) else [stride] * n
+    ps = padding if isinstance(padding, (list, tuple)) else [padding] * n
+    ds = (dilation if isinstance(dilation, (list, tuple))
+          else [dilation] * n)
+    return [(o - (i - 1) * s + 2 * p - 1) // d + 1
+            for o, i, s, p, d in zip(outs, in_hw, ss, ps, ds)]
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCHW"):
+    from .. import nn as _nn
+    _require_nchw(data_format, "conv2d_transpose")
+    if filter_size is None:
+        if output_size is None:
+            raise ValueError("conv2d_transpose needs filter_size or "
+                             "output_size")
+        filter_size = _deconv_filter_size(output_size, input.shape[2:],
+                                          stride, padding, dilation, 2)
+    conv = _layer("conv2d_transpose", name,
+                  lambda: _nn.Conv2DTranspose(input.shape[1], num_filters,
+                                              filter_size, stride=stride,
+                                              padding=padding,
+                                              dilation=dilation,
+                                              groups=groups,
+                                              weight_attr=param_attr,
+                                              bias_attr=bias_attr))
+    return _act(conv(input), act)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           use_cudnn=True, act=None, name=None, data_format="NCDHW"):
+    from .. import nn as _nn
+    _require_nchw(data_format, "conv3d")
+    conv = _layer("conv3d", name,
+                  lambda: _nn.Conv3D(input.shape[1], num_filters,
+                                     filter_size, stride=stride,
+                                     padding=padding, dilation=dilation,
+                                     groups=groups,
+                                     weight_attr=param_attr,
+                                     bias_attr=bias_attr))
+    return _act(conv(input), act)
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCDHW"):
+    from .. import nn as _nn
+    _require_nchw(data_format, "conv3d_transpose")
+    if filter_size is None:
+        if output_size is None:
+            raise ValueError("conv3d_transpose needs filter_size or "
+                             "output_size")
+        filter_size = _deconv_filter_size(output_size, input.shape[2:],
+                                          stride, padding, dilation, 3)
+    conv = _layer("conv3d_transpose", name,
+                  lambda: _nn.Conv3DTranspose(input.shape[1], num_filters,
+                                              filter_size, stride=stride,
+                                              padding=padding,
+                                              dilation=dilation,
+                                              groups=groups,
+                                              weight_attr=param_attr,
+                                              bias_attr=bias_attr))
+    return _act(conv(input), act)
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    """Parity: static.nn.prelu — mode all|channel|element."""
+    from .. import nn as _nn
+    _require_nchw(data_format, "prelu")
+    if mode == "all":
+        num = 1
+    elif mode == "channel":
+        num = x.shape[1]
+    elif mode == "element":
+        import math
+        num = 1
+        for d in x.shape[1:]:
+            num *= d
+    else:
+        raise ValueError(f"prelu mode {mode!r} not in all|channel|element")
+    layer = _layer("prelu", name,
+                   lambda: _nn.PReLU(num_parameters=num,
+                                     weight_attr=param_attr))
+    return layer(x)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """Parity: static.nn.bilinear_tensor_product (common.py:2536)."""
+    from .. import nn as _nn
+    bl = _layer("bilinear", name,
+                lambda: _nn.Bilinear(x.shape[-1], y.shape[-1], size,
+                                     weight_attr=param_attr,
+                                     bias_attr=bias_attr))
+    return _act(bl(x, y), act)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Parity: static.nn.spectral_norm — returns the spectrally
+    normalized weight via power iteration."""
+    from ..nn.layer.norm import SpectralNorm as _SN
+    sn = _layer("spectral_norm", name,
+                lambda: _SN(list(weight.shape), dim=dim,
+                            power_iters=power_iters, epsilon=eps))
+    return sn(weight)
+
+
+def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None,
+                  name=None):
+    """Parity: static.nn.deform_conv2d — over vision.ops' jnp/lax
+    deformable conv. Weight+bias live in ONE registry entry so unnamed
+    calls get fresh parameters (auto-counter) like every other function."""
+    from .. import nn as _nn
+    from ..vision.ops import deform_conv2d as _dc
+    k = (filter_size if isinstance(filter_size, (list, tuple))
+         else (filter_size, filter_size))
+
+    class _DeformParams(_nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.weight = self.create_parameter(
+                [num_filters, x.shape[1] // groups, k[0], k[1]],
+                attr=param_attr)
+            self.bias = (None if bias_attr is False else
+                         self.create_parameter([num_filters],
+                                               attr=bias_attr,
+                                               is_bias=True))
+
+    holder = _layer("deform_conv2d", name, _DeformParams)
+    return _dc(x, offset, holder.weight, bias=holder.bias, stride=stride,
+               padding=padding, dilation=dilation,
+               deformable_groups=deformable_groups, groups=groups,
+               mask=mask)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None,
+             name=None):
+    """Parity: static.nn.row_conv (common.py:3332) — lookahead row
+    convolution for streaming models: out[t] = sum_{k=0..K}
+    W[k] * in[t+k], per feature channel."""
+    import jax.numpy as jnp
+    from ..autograd.tape import apply as _apply
+    from ..tensor.parity_extras import create_parameter
+    D = input.shape[-1]
+    K = future_context_size
+    w = _layer("row_conv", name,
+               lambda: create_parameter([K + 1, D], "float32",
+                                        attr=param_attr))
+
+    def f(xv, wv):
+        # pad K future steps on the time axis (axis=-2), then window-sum
+        pad = [(0, 0)] * xv.ndim
+        pad[-2] = (0, K)
+        xp = jnp.pad(xv, pad)
+        T = xv.shape[-2]
+        out = 0.0
+        for k in range(K + 1):
+            sl = [slice(None)] * xv.ndim
+            sl[-2] = slice(k, k + T)
+            out = out + xp[tuple(sl)] * wv[k]
+        return out
+
+    return _act(_apply(f, input, w, _op_name="row_conv"), act)
+
+
+def nce(*a, **kw):
+    raise NotImplementedError(
+        "static.nn.nce (sampled NCE loss) belongs to the deferred "
+        "PS/CTR family (SURVEY §2.6 PS row); use "
+        "F.cross_entropy/softmax_with_cross_entropy")
+
+
+def sparse_embedding(*a, **kw):
+    raise NotImplementedError(
+        "static.nn.sparse_embedding is the parameter-server sparse table "
+        "path, deferred per SURVEY §2.6; use static.nn.embedding / "
+        "nn.Embedding")
+
+
+class StaticRNN:
+    """Parity stub: static.nn.StaticRNN — the step-by-step static-graph
+    RNN builder has no Program to build into; nn.RNN / nn.LSTM / nn.GRU
+    (lax.scan-backed) are the runtime equivalents."""
+
+    def __init__(self, *a, **kw):
+        raise NotImplementedError(
+            "StaticRNN builds a static Program block; use nn.RNN/LSTM/GRU "
+            "(lax.scan over the sequence) or paddle.static.nn.while_loop")
+
+
+def _sequence_stub(op):
+    def f(*a, **kw):
+        raise NotImplementedError(
+            f"static.nn.{op}: LoD (ragged) sequence tensors are collapsed "
+            "in this runtime by design — use padded dense tensors + masks "
+            "(nn ops) or ragged alltoall in distributed code")
+    f.__name__ = op
+    return f
+
+
+for _op in ("sequence_conv", "sequence_softmax", "sequence_pool",
+            "sequence_concat", "sequence_first_step", "sequence_last_step",
+            "sequence_slice", "sequence_expand", "sequence_expand_as",
+            "sequence_pad", "sequence_unpad", "sequence_reshape",
+            "sequence_scatter", "sequence_enumerate", "sequence_reverse"):
+    globals()[_op] = _sequence_stub(_op)
+    __all__.append(_op)

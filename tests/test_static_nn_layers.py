@@ -1,0 +1,113 @@
+"""static.nn layer functions (reference: python/paddle/static/nn/common.py).
+
+Each function instantiates the matching nn Layer, registered by name in
+a build registry (the role Program parameters play in the reference) —
+named calls reuse their layer, so static-style build code trains.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.static import nn as snn
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    snn.reset_build_registry()
+    yield
+    snn.reset_build_registry()
+
+
+def _x(shape, seed=0):
+    return paddle.to_tensor(
+        np.random.RandomState(seed).randn(*shape).astype(np.float32))
+
+
+class TestShapes:
+    def test_core_layers(self):
+        x4 = _x((4, 3, 8, 8))
+        flat = _x((4, 16), 1)
+        assert snn.fc(flat, 8, activation="relu").shape == [4, 8]
+        ids = paddle.to_tensor(np.arange(8).reshape(4, 2).astype(np.int64))
+        assert snn.embedding(ids, (32, 5)).shape == [4, 2, 5]
+        assert snn.batch_norm(x4).shape == [4, 3, 8, 8]
+        assert snn.layer_norm(flat).shape == [4, 16]
+        assert snn.group_norm(x4, groups=3).shape == [4, 3, 8, 8]
+        assert snn.instance_norm(x4).shape == [4, 3, 8, 8]
+        assert snn.data_norm(flat).shape == [4, 16]
+        assert snn.conv2d(x4, 6, 3, act="relu").shape == [4, 6, 6, 6]
+        assert snn.conv2d_transpose(x4, 6, filter_size=3).shape == \
+            [4, 6, 10, 10]
+        # output_size derives the filter (reference semantics)
+        assert snn.conv2d_transpose(x4, 6, output_size=10).shape == \
+            [4, 6, 10, 10]
+        assert snn.prelu(x4, mode="channel").shape == [4, 3, 8, 8]
+        y = _x((4, 10), 3)
+        assert snn.bilinear_tensor_product(flat, y, 6).shape == [4, 6]
+        w = _x((8, 6), 4)
+        assert snn.spectral_norm(w, dim=0).shape == [8, 6]
+
+    def test_conv3d_family(self):
+        x5 = _x((2, 3, 4, 8, 8), 2)
+        assert snn.conv3d(x5, 4, 3).shape == [2, 4, 2, 6, 6]
+        assert snn.conv3d_transpose(x5, 4, filter_size=3).shape == \
+            [2, 4, 6, 10, 10]
+
+    def test_row_conv_numerics(self):
+        seq = _x((4, 10, 16), 5)
+        out = snn.row_conv(seq, 2)
+        wv = [v for k, v in snn.build_registry().items()
+              if k.startswith("row_conv")][0]
+        wnp = np.asarray(wv.value)
+        xp = np.pad(seq.numpy(), ((0, 0), (0, 2), (0, 0)))
+        want = sum(xp[:, k:k + 10] * wnp[k] for k in range(3))
+        np.testing.assert_allclose(out.numpy(), want, rtol=1e-5, atol=1e-5)
+
+
+class TestBuildSemantics:
+    def test_named_calls_reuse_and_train(self):
+        """A static-style build function called per step must reuse its
+        parameters — training through the registry works."""
+        X = np.random.RandomState(7).randn(64, 8).astype(np.float32)
+        yv = (X.sum(1) > 0).astype(np.int64)
+
+        def net(x):
+            h = snn.fc(x, 16, activation="relu", name="l1")
+            return snn.fc(h, 2, name="l2")
+
+        _ = net(paddle.to_tensor(X))  # build
+        params = [p for l in snn.build_registry().values()
+                  for p in (l.parameters() if hasattr(l, "parameters")
+                            else [l])]
+        opt = paddle.optimizer.Adam(learning_rate=0.05, parameters=params)
+        loss_fn = paddle.nn.CrossEntropyLoss()
+        for _ in range(25):
+            loss = loss_fn(net(paddle.to_tensor(X)),
+                           paddle.to_tensor(yv))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert float(loss) < 0.4, float(loss)
+
+    def test_data_norm_updates_stats_in_train_only(self):
+        x = _x((8, 4), 9)
+        out1 = snn.data_norm(x, name="dn")
+        dn = snn.build_registry()["data_norm/dn"]
+        before = np.asarray(dn.batch_size.value).copy()
+        snn.data_norm(x, name="dn")
+        assert (np.asarray(dn.batch_size.value) > before).all()
+        dn.eval()
+        frozen = np.asarray(dn.batch_size.value).copy()
+        snn.data_norm(x, name="dn")
+        np.testing.assert_array_equal(np.asarray(dn.batch_size.value),
+                                      frozen)
+
+    def test_lod_and_ps_stubs_raise(self):
+        with pytest.raises(NotImplementedError, match="LoD"):
+            snn.sequence_pool(None)
+        with pytest.raises(NotImplementedError, match="parameter-server"):
+            snn.sparse_embedding()
+        with pytest.raises(NotImplementedError):
+            snn.nce()
+        with pytest.raises(NotImplementedError, match="nn.RNN"):
+            snn.StaticRNN()
