@@ -328,14 +328,22 @@ def Print(input, first_n=-1, message=None, summarize=20,
 
 def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
     """Parity: static.py_func — host python inside a traced program via
-    pure_callback."""
+    pure_callback. `out` may be one spec/Tensor or a list of them
+    (reference supports multiple outputs; common.py py_func)."""
     import jax
     xs = x if isinstance(x, (list, tuple)) else [x]
     raw = [t.value for t in xs]
-    spec = jax.ShapeDtypeStruct(tuple(out.shape), out.value.dtype) \
-        if hasattr(out, "value") else out
+
+    def _spec(o):
+        return (jax.ShapeDtypeStruct(tuple(o.shape), o.value.dtype)
+                if hasattr(o, "value") else o)
+
+    multi = isinstance(out, (list, tuple))
+    spec = ([_spec(o) for o in out] if multi else _spec(out))
     res = jax.pure_callback(
         lambda *vs: func(*vs), spec, *raw, vmap_method=None)
+    if multi:
+        return [Tensor(r) for r in res]
     return Tensor(res)
 
 

@@ -328,7 +328,15 @@ def Assert(cond, data=None, summarize: int = 20, name: Optional[str] = None):
 _BUILD_REGISTRY: dict = {}
 _AUTO_COUNT: dict = {}
 
-__all__ += ["fc", "embedding", "batch_norm", "layer_norm", "group_norm",
+def py_func(func, x, out, backward_func=None,
+            skip_vars_in_backward_input=None):
+    """static.nn re-export of static.py_func (lazy: the package is still
+    initializing when this module loads)."""
+    from . import py_func as _pf
+    return _pf(func, x, out, backward_func, skip_vars_in_backward_input)
+
+
+__all__ += ["py_func", "fc", "embedding", "batch_norm", "layer_norm", "group_norm",
             "instance_norm", "data_norm", "conv2d", "conv2d_transpose",
             "conv3d", "conv3d_transpose", "prelu",
             "bilinear_tensor_product", "spectral_norm", "deform_conv2d",

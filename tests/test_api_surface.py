@@ -23,23 +23,25 @@ def test_top_level_all_covered():
 
 
 _NAMESPACES = ["optimizer", "distributed", "io", "jit", "amp", "autograd",
-               "metric", "static", "vision", "distribution", "sparse",
-               "device", "profiler", "geometric", "text", "audio", "utils",
-               "quantization", "incubate", "nn"]
+               "metric", "static", "static.nn", "vision", "distribution",
+               "sparse", "device", "profiler", "geometric", "text", "audio",
+               "utils", "quantization", "incubate", "nn"]
 
 
 @pytest.mark.skipif(not os.path.exists(_REF_INIT),
                     reason="reference tree not mounted")
 @pytest.mark.parametrize("ns", _NAMESPACES)
 def test_namespace_all_covered(ns):
-    path = f"/root/reference/python/paddle/{ns}/__init__.py"
+    path = f"/root/reference/python/paddle/{ns.replace('.', '/')}/__init__.py"
     if not os.path.exists(path):
         pytest.skip(f"no reference namespace {ns}")
     m = re.search(r"__all__ = \[(.*?)\]", open(path).read(), re.S)
     if not m:
         pytest.skip(f"reference {ns} has no __all__")
     ref = set(re.findall(r"'([^']+)'", m.group(1)))
-    mod = getattr(paddle, ns)
+    mod = paddle
+    for part in ns.split("."):
+        mod = getattr(mod, part)
     mine = set(dir(mod)) | set(getattr(mod, "__all__", []))
     missing = sorted(ref - mine)
     assert not missing, f"paddle.{ns} missing: {missing}"
