@@ -216,6 +216,49 @@ def main():
     _log("stage=measured ms_per_step=%.1f" % (dt / iters * 1e3))
 
     tokens_per_sec = batch * seq * iters / dt
+    # capture the DRIVER-geometry dispatch now — the sweep below re-traces
+    # at other batches and would overwrite the module-global record
+    attention_backend = F.last_attention_dispatch().get("backend")
+
+    # optional batch sweep (PADDLE_TPU_BENCH_SWEEP="16,32"): measure the
+    # same step at other batch sizes to find the throughput-optimal
+    # configuration on this chip; reported as an extra, never as the
+    # driver metric (whose geometry must stay comparable across rounds).
+    # Extras-only means extras-only: a sweep failure (OOM at 4x batch,
+    # typo'd env var) must not take down the already-measured record.
+    sweep = {}
+    sweep_batches = []
+    for s in os.environ.get("PADDLE_TPU_BENCH_SWEEP", "").split(","):
+        if not s.strip():
+            continue
+        try:
+            sweep_batches.append(int(s))
+        except ValueError:
+            _log("sweep: skipping unparseable batch %r" % s)
+    # the watchdog stays DISARMED here: its expiry path is os._exit,
+    # which would discard the record no try/except can save — and the
+    # main metric is already measured, so a hung sweep only costs time
+    for b2 in sweep_batches:
+        try:
+            ids2 = paddle.to_tensor(rng.randint(
+                0, cfg.vocab_size, (b2, seq)).astype("int64"))
+            _log("stage=sweep_compile b=%d" % b2)
+            loss = step(ids2, ids2)
+            float(loss)
+            for _ in range(2):
+                loss = step(ids2, ids2)
+            float(loss)
+            _log("stage=sweep_measure b=%d" % b2)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                loss = step(ids2, ids2)
+            float(loss)
+            dt2 = time.perf_counter() - t0
+            sweep[str(b2)] = round(b2 * seq * iters / dt2, 2)
+            _log("stage=sweep b=%d tok/s=%.0f" % (b2, sweep[str(b2)]))
+        except Exception as e:  # noqa: BLE001 — record, keep the run alive
+            sweep[str(b2)] = "error: %s" % str(e)[:120]
+            _log("stage=sweep b=%d FAILED: %s" % (b2, str(e)[:160]))
 
     # MFU estimate: 6N per token (fwd+bwd matmuls) + attention
     # 12*L*H*S (PaLM appendix B accounting, causal halved)
@@ -286,9 +329,12 @@ def main():
         "params": n_params,
         "device_kind": kind,
         # which attention kernel the model actually traced — proof the
-        # Pallas path fired at the bench geometry (VERDICT r2 weak #3)
-        "attention_backend": F.last_attention_dispatch().get("backend"),
+        # Pallas path fired at the bench geometry (VERDICT r2 weak #3);
+        # captured BEFORE the sweep re-traced at other batches
+        "attention_backend": attention_backend,
     }
+    if sweep:
+        rec["batch_sweep_tok_s"] = sweep
     if mismatch:
         rec["chip_mismatch"] = True
         rec["baseline_device_kind"] = base_kind

@@ -1,4 +1,5 @@
 """AMP + io tests (reference style: test_amp_*.py, test_paddle_save_load)."""
+import os
 import numpy as np
 import pytest
 
@@ -111,3 +112,20 @@ def test_auto_cast_decorator_keeps_custom_lists():
     out = f(x)
     # softmax moved to the white list -> computed in bf16
     assert str(out.dtype) == "bfloat16"
+
+
+def test_reference_format_pdparams_loads(tmp_path):
+    """A reference-produced .pdparams (plain pickled {name: ndarray})
+    must load and apply without conversion (MIGRATING.md contract)."""
+    import pickle
+    import paddle_tpu.nn as nn
+    ref = {"0.weight": np.random.RandomState(0).randn(4, 8).astype("float32"),
+           "0.bias": np.zeros(8, "float32")}
+    path = tmp_path / "refmt.pdparams"
+    with open(path, "wb") as f:
+        pickle.dump(ref, f, protocol=2)
+    state = paddle.load(str(path))
+    m = nn.Sequential(nn.Linear(4, 8))
+    m.set_state_dict(state)
+    np.testing.assert_allclose(m.state_dict()["0.weight"].numpy(),
+                               ref["0.weight"])

@@ -1,0 +1,154 @@
+"""LR scheduler numerics — closed-form checks for the schedulers the
+main optimizer suite does not cover (reference:
+python/paddle/optimizer/lr.py; test pattern:
+test_lr_scheduler.py's python-reference comparison)."""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.optimizer import lr
+
+
+def _series(sched, n=8, **step_kw):
+    out = []
+    for _ in range(n):
+        out.append(float(sched()))
+        sched.step(**step_kw)
+    return out
+
+
+class TestClosedForms:
+    def test_natural_exp(self):
+        s = lr.NaturalExpDecay(learning_rate=0.5, gamma=0.1)
+        got = _series(s, 5)
+        want = [0.5 * math.exp(-0.1 * k) for k in range(5)]
+        np.testing.assert_allclose(got, want, rtol=1e-12)
+
+    def test_inverse_time(self):
+        s = lr.InverseTimeDecay(learning_rate=0.5, gamma=0.5)
+        np.testing.assert_allclose(
+            _series(s, 4), [0.5 / (1 + 0.5 * k) for k in range(4)])
+
+    def test_polynomial_clip_and_cycle(self):
+        s = lr.PolynomialDecay(learning_rate=1.0, decay_steps=4,
+                               end_lr=0.1, power=2.0)
+        got = _series(s, 7)
+        want = [(1.0 - 0.1) * (1 - min(k, 4) / 4.0) ** 2 + 0.1
+                for k in range(7)]
+        np.testing.assert_allclose(got, want)
+        # cycle=True keeps decaying against a growing horizon
+        c = lr.PolynomialDecay(learning_rate=1.0, decay_steps=4,
+                               end_lr=0.1, power=1.0, cycle=True)
+        got = _series(c, 7)
+        assert got[5] > got[3] * 0.0 and got[5] != got[4]  # no flatline
+        assert min(got) >= 0.1 - 1e-12
+
+    def test_multistep(self):
+        s = lr.MultiStepDecay(learning_rate=1.0, milestones=[2, 4],
+                              gamma=0.1)
+        np.testing.assert_allclose(
+            _series(s, 6), [1.0, 1.0, 0.1, 0.1, 0.01, 0.01])
+
+    def test_lambda(self):
+        s = lr.LambdaDecay(learning_rate=0.5,
+                           lr_lambda=lambda e: 0.9 ** e)
+        np.testing.assert_allclose(
+            _series(s, 4), [0.5 * 0.9 ** k for k in range(4)])
+
+    def test_multiplicative(self):
+        s = lr.MultiplicativeDecay(learning_rate=0.5,
+                                   lr_lambda=lambda e: 0.5)
+        # multiplies the RUNNING lr each step (unlike LambdaDecay)
+        np.testing.assert_allclose(
+            _series(s, 4), [0.5, 0.25, 0.125, 0.0625])
+
+    def test_cosine_warm_restarts(self):
+        s = lr.CosineAnnealingWarmRestarts(learning_rate=1.0, T_0=4,
+                                           T_mult=1, eta_min=0.0)
+        got = _series(s, 9)
+        # restarts at t=4 and t=8: back to base_lr
+        assert got[0] == pytest.approx(1.0)
+        assert got[4] == pytest.approx(1.0)
+        assert got[8] == pytest.approx(1.0)
+        want2 = (1 + math.cos(math.pi * 2 / 4)) / 2
+        assert got[2] == pytest.approx(want2)
+        # T_mult=2 doubles the second period: restart lands at 4+8=12
+        s2 = lr.CosineAnnealingWarmRestarts(learning_rate=1.0, T_0=4,
+                                            T_mult=2, eta_min=0.0)
+        got2 = _series(s2, 13)
+        assert got2[12] == pytest.approx(1.0)
+        assert got2[8] == pytest.approx((1 + math.cos(math.pi * 4 / 8)) / 2)
+
+    def test_linear_lr(self):
+        s = lr.LinearLR(learning_rate=1.0, total_steps=4,
+                        start_factor=0.25, end_factor=1.0)
+        np.testing.assert_allclose(
+            _series(s, 6),
+            [0.25, 0.25 + 0.75 / 4, 0.25 + 2 * 0.75 / 4,
+             0.25 + 3 * 0.75 / 4, 1.0, 1.0])
+
+    def test_one_cycle(self):
+        s = lr.OneCycleLR(max_learning_rate=1.0, total_steps=10,
+                          divide_factor=4.0, end_learning_rate=0.01,
+                          phase_pct=0.3)
+        got = _series(s, 10)
+        assert got[0] == pytest.approx(0.25)        # max/divide_factor
+        peak = max(got)
+        assert peak == pytest.approx(1.0)           # reaches max_lr
+        assert got[-1] < 0.1                        # anneals toward end
+        assert np.argmax(got) <= 3                  # warmup is ~30%
+
+    def test_cyclic_modes(self):
+        s = lr.CyclicLR(base_learning_rate=0.1, max_learning_rate=1.1,
+                        step_size_up=2, step_size_down=2)
+        got = _series(s, 9)
+        np.testing.assert_allclose(
+            got, [0.1, 0.6, 1.1, 0.6, 0.1, 0.6, 1.1, 0.6, 0.1])
+        # triangular2 halves the amplitude each cycle
+        s2 = lr.CyclicLR(base_learning_rate=0.1, max_learning_rate=1.1,
+                         step_size_up=2, step_size_down=2,
+                         mode="triangular2")
+        got2 = _series(s2, 7)
+        assert got2[2] == pytest.approx(1.1)
+        assert got2[6] == pytest.approx(0.1 + (1.1 - 0.1) * 0.5)
+
+
+class TestStateDict:
+    @pytest.mark.parametrize("mk", [
+        lambda: lr.NaturalExpDecay(0.5, 0.1),
+        lambda: lr.PolynomialDecay(1.0, 4, cycle=True),
+        lambda: lr.CosineAnnealingWarmRestarts(1.0, 4, T_mult=2),
+        lambda: lr.OneCycleLR(1.0, 10),
+        lambda: lr.CyclicLR(0.1, 1.1, 2),
+        lambda: lr.MultiplicativeDecay(0.5, lambda e: 0.5),
+    ])
+    def test_roundtrip_resumes_series(self, mk):
+        a = mk()
+        for _ in range(3):
+            a.step()
+        state = a.state_dict()
+        b = mk()
+        b.set_state_dict(state)
+        for _ in range(4):
+            assert float(a()) == pytest.approx(float(b()))
+            a.step()
+            b.step()
+
+    def test_scheduler_drives_optimizer(self):
+        sched = lr.MultiStepDecay(learning_rate=0.5, milestones=[1],
+                                  gamma=0.1)
+        p = paddle.create_parameter([3], "float32")
+        opt = paddle.optimizer.SGD(learning_rate=sched, parameters=[p])
+        before = p.numpy().copy()
+        (p * paddle.to_tensor(np.ones(3, np.float32))).sum().backward()
+        opt.step()
+        d1 = before - p.numpy()           # lr 0.5 step
+        sched.step()
+        opt.clear_grad()
+        before = p.numpy().copy()
+        (p * paddle.to_tensor(np.ones(3, np.float32))).sum().backward()
+        opt.step()
+        d2 = before - p.numpy()           # lr 0.05 step
+        np.testing.assert_allclose(d2, d1 * 0.1, rtol=1e-5)

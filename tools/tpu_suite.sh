@@ -22,9 +22,11 @@ run() {  # run <name> <outfile> <cmd...>
 
 # 1. driver metric (125M) — bench.py has its own probe + stage watchdog
 run bench_125m bench_125m.json python bench.py
-# 2. prove the Pallas kernel fires at the bench geometry
+# 2. prove the Pallas kernel fires at the bench geometry, and sweep
+#    batch sizes for the throughput-optimal config (extras only)
 run bench_125m_pallas bench_125m_pallas.json \
-    env PADDLE_TPU_REQUIRE_PALLAS=1 python bench.py
+    env PADDLE_TPU_REQUIRE_PALLAS=1 PADDLE_TPU_BENCH_SWEEP=16,32 \
+    python bench.py
 # 3. north-star-scale single-chip config
 run bench_1p3b bench_1p3b.json \
     env PADDLE_TPU_BENCH_MODEL=gpt1.3b python bench.py
