@@ -203,3 +203,46 @@ class Dirac(Initializer):
             idx = (i, i % ic) + tuple(centers)
             w[idx] = 1.0
         return jnp.asarray(w)
+
+
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel (reference:
+    python/paddle/nn/initializer/Bilinear) — initializes a (transposed)
+    conv weight so the layer performs bilinear interpolation; every
+    (out, in) channel pair gets the separable triangle kernel."""
+
+    def __call__(self, shape, dtype="float32"):
+        if len(shape) < 2:
+            raise ValueError("Bilinear initializer needs a conv-like "
+                             f"weight rank >= 2, got {shape}")
+        kh, kw = (shape[-2], shape[-1]) if len(shape) >= 4 else (1, shape[-1])
+        f_h, f_w = int(np.ceil(kh / 2.0)), int(np.ceil(kw / 2.0))
+        c_h = (2 * f_h - 1 - f_h % 2) / (2.0 * f_h)
+        c_w = (2 * f_w - 1 - f_w % 2) / (2.0 * f_w)
+        ii = np.arange(kh)[:, None]
+        jj = np.arange(kw)[None, :]
+        k2d = ((1 - np.abs(ii / f_h - c_h)) *
+               (1 - np.abs(jj / f_w - c_w))).astype("float32")
+        w = np.broadcast_to(k2d, shape).copy()
+        return jnp.asarray(w, convert_dtype(dtype))
+
+
+_GLOBAL_WEIGHT_INIT = None
+_GLOBAL_BIAS_INIT = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Parity: nn.initializer.set_global_initializer — default
+    initializers for parameters created afterwards whose ParamAttr does
+    not set one (overrides layer built-in defaults, like the reference).
+    Pass (None, None) to reset."""
+    global _GLOBAL_WEIGHT_INIT, _GLOBAL_BIAS_INIT
+    _GLOBAL_WEIGHT_INIT = weight_init
+    _GLOBAL_BIAS_INIT = bias_init
+
+
+def _global_initializer(is_bias: bool):
+    return _GLOBAL_BIAS_INIT if is_bias else _GLOBAL_WEIGHT_INIT
+
+
+__all__ += ["Bilinear", "set_global_initializer"]

@@ -121,7 +121,11 @@ class Layer:
         if attr is None:
             return None
         dtype = dtype or self._dtype
-        init = attr.initializer or default_initializer or \
+        # precedence (reference set_global_initializer contract): an
+        # initializer in ParamAttr wins; otherwise a registered global
+        # default overrides the layer's built-in default
+        init = attr.initializer or I._global_initializer(is_bias) or \
+            default_initializer or \
             (I.Constant(0.0) if is_bias else I.XavierNormal())
         value = init(shape, dtype)
         p = Parameter(value, trainable=attr.trainable, name=attr.name)

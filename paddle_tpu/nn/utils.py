@@ -1,6 +1,7 @@
 """nn.utils parity (parameters_to_vector etc.)."""
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -43,3 +44,129 @@ def clip_grad_value_(parameters, clip_value):
     for p in parameters:
         if p._grad is not None:
             p._grad = jnp.clip(p._grad, -clip_value, clip_value)
+
+
+# ---------------------------------------------------------------------------
+# Reparameterizations (reference: python/paddle/nn/utils/weight_norm_hook.py,
+# spectral_norm_hook.py). The weight is re-derived from the registered
+# parameters by a forward pre-hook USING TENSOR OPS, so the tape carries
+# gradients to weight_g/weight_v (or the original weight) exactly like the
+# reference's reparameterized backward.
+# ---------------------------------------------------------------------------
+
+def _norm_except(v, dim):
+    """||v|| over every axis except `dim` (keepdims), via tape ops."""
+    import paddle_tpu as paddle
+    if dim is None:
+        return paddle.sqrt(paddle.sum(v * v))
+    axes = [i for i in range(len(v.shape)) if i != dim]
+    return paddle.sqrt(paddle.sum(v * v, axis=axes, keepdim=True))
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Parity: paddle.nn.utils.weight_norm — reparameterize `name` as
+    direction (weight_v) and magnitude (weight_g): w = g * v/||v||."""
+    from ..core.tensor import Parameter
+    w = getattr(layer, name)
+    if not isinstance(w, Parameter):
+        raise ValueError(f"{name!r} is not a Parameter of {layer}")
+    g0 = _norm_except(w, dim)
+    v = Parameter(jnp.copy(w.value))
+    g = Parameter(jnp.copy(g0.value if hasattr(g0, "value") else g0))
+    del layer._parameters[name]
+    layer.add_parameter(name + "_v", v)
+    layer.add_parameter(name + "_g", g)
+
+    def _recompute(lyr, inputs=()):
+        vv = getattr(lyr, name + "_v")
+        gg = getattr(lyr, name + "_g")
+        w_new = gg * (vv / _norm_except(vv, dim))
+        object.__setattr__(lyr, name, w_new)
+
+    handle = layer.register_forward_pre_hook(_recompute)
+    layer.__dict__.setdefault("_wn_state", {})[name] = (handle, dim)
+    _recompute(layer)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    """Parity: paddle.nn.utils.remove_weight_norm — fold g*v/||v|| back
+    into one plain Parameter."""
+    from ..core.tensor import Parameter
+    state = layer.__dict__.get("_wn_state", {})
+    if name not in state:
+        raise ValueError(f"no weight norm registered on {name!r}")
+    handle, dim = state.pop(name)
+    handle.remove()
+    v = getattr(layer, name + "_v")
+    g = getattr(layer, name + "_g")
+    w = g.value * (v.value / _norm_except(v, dim).value)
+    del layer._parameters[name + "_v"]
+    del layer._parameters[name + "_g"]
+    layer.__dict__.pop(name, None)
+    layer.add_parameter(name, Parameter(w))
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Parity: paddle.nn.utils.spectral_norm — divide the weight by its
+    largest singular value, estimated by power iteration on persistent
+    u/v buffers (updated without gradient, like the reference)."""
+    import paddle_tpu as paddle
+    from ..core.tensor import Parameter, Tensor
+
+    w = getattr(layer, name)
+    if not isinstance(w, Parameter):
+        raise ValueError(f"{name!r} is not a Parameter of {layer}")
+    if dim is None:
+        # reference spectral_norm_hook: the OUTPUT-channel axis is dim 1
+        # for Linear ((in, out) layout) and Conv*Transpose ((in, out//g,
+        # k...)); everything else normalizes over dim 0
+        cls = type(layer).__name__
+        dim = 1 if (cls == "Linear" or "Transpose" in cls) else 0
+    shape = list(w.shape)
+    h = shape[dim]
+    rng = np.random.default_rng(0)
+    del layer._parameters[name]
+    layer.add_parameter(name + "_orig", w)
+    layer.register_buffer(
+        name + "_u", Tensor(jnp.asarray(
+            rng.standard_normal(h), jnp.float32)), persistable=False)
+    layer.register_buffer(
+        name + "_v", Tensor(jnp.asarray(
+            rng.standard_normal(int(np.prod(shape)) // h), jnp.float32)),
+        persistable=False)
+
+    def _mat(wv):
+        perm = [dim] + [i for i in range(len(shape)) if i != dim]
+        return jnp.transpose(wv, perm).reshape(h, -1)
+
+    def _recompute(lyr, inputs=()):
+        w_orig = getattr(lyr, name + "_orig")
+        u = getattr(lyr, name + "_u").value
+        vv = getattr(lyr, name + "_v").value
+        mat = _mat(jax.lax.stop_gradient(w_orig.value))
+        for _ in range(n_power_iterations):
+            vv = mat.T @ u
+            vv = vv / jnp.maximum(jnp.linalg.norm(vv), eps)
+            u = mat @ vv
+            u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+        getattr(lyr, name + "_u").value = u
+        getattr(lyr, name + "_v").value = vv
+        # sigma through TAPE ops so grads reach weight_orig
+        u_t = Tensor(u)
+        v_t = Tensor(vv)
+        w_mat = paddle.reshape(
+            paddle.transpose(w_orig, [dim] + [i for i in range(len(shape))
+                                              if i != dim]), [h, -1])
+        sigma = paddle.sum(u_t * paddle.matmul(w_mat, v_t))
+        object.__setattr__(lyr, name, w_orig / sigma)
+
+    handle = layer.register_forward_pre_hook(_recompute)
+    layer.__dict__.setdefault("_sn_state", {})[name] = handle
+    _recompute(layer)
+    return layer
+
+
+__all__ += ["weight_norm", "remove_weight_norm", "spectral_norm"]

@@ -239,12 +239,19 @@ def check_shape(shape):
 
 def create_parameter(shape, dtype="float32", name=None, attr=None,
                      is_bias=False, default_initializer=None):
-    """Parity: paddle.create_parameter — a free-standing Parameter."""
+    """Parity: paddle.create_parameter — a free-standing Parameter.
+    Honors nn.initializer.set_global_initializer like the Layer path
+    (both go through LayerHelperBase in the reference)."""
     from ..core.tensor import Parameter
     from ..nn import initializer as I
-    init = default_initializer or (
-        I.Constant(0.0) if is_bias else I.XavierNormal())
-    return Parameter(init(list(shape), dtype), name=name)
+    from ..nn.layer_base import ParamAttr
+    pattr = ParamAttr._to_attr(attr)
+    attr_init = getattr(pattr, "initializer", None)
+    init = attr_init or I._global_initializer(is_bias) or \
+        default_initializer or \
+        (I.Constant(0.0) if is_bias else I.XavierNormal())
+    return Parameter(init(list(shape), dtype),
+                     name=name or getattr(pattr, "name", None))
 
 
 def disable_signal_handler():

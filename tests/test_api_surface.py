@@ -23,7 +23,7 @@ def test_top_level_all_covered():
 
 
 _NAMESPACES = ["optimizer", "distributed", "io", "jit", "amp", "autograd",
-               "metric", "static", "static.nn", "vision", "distribution",
+               "metric", "static", "static.nn", "nn.functional", "nn.initializer", "nn.utils", "vision", "distribution",
                "sparse", "device", "profiler", "geometric", "text", "audio",
                "utils", "quantization", "incubate", "nn"]
 

@@ -252,3 +252,79 @@ class TestASP:
                              "dataloader": {"enable": True}})
         cfg = autotune.get_config()
         assert cfg["dataloader"]["enable"]
+
+
+class TestReparameterizations:
+    """nn.utils weight_norm / remove_weight_norm / spectral_norm
+    (reference: nn/utils/weight_norm_hook.py, spectral_norm_hook.py)."""
+
+    def test_weight_norm_semantics_and_grads(self):
+        import paddle_tpu.nn.utils as U
+        paddle.seed(0)
+        lin = nn.Linear(4, 6)
+        w0 = lin.weight.numpy().copy()
+        U.weight_norm(lin, dim=0)
+        names = dict(lin.named_parameters())
+        assert "weight_g" in names and "weight_v" in names
+        x = paddle.to_tensor(np.random.RandomState(0).randn(2, 4)
+                             .astype(np.float32))
+        out = lin(x)
+        # initial reparam is exact: g*v/||v|| == original w
+        np.testing.assert_allclose(lin.weight.numpy(), w0, rtol=1e-5,
+                                   atol=1e-6)
+        out.sum().backward()
+        assert lin.weight_g.grad is not None
+        assert lin.weight_v.grad is not None
+        # remove folds back to a single trainable weight
+        U.remove_weight_norm(lin)
+        names = dict(lin.named_parameters())
+        assert "weight" in names and "weight_g" not in names
+        np.testing.assert_allclose(lin.weight.numpy(), w0, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_spectral_norm_divides_by_sigma(self):
+        import paddle_tpu.nn.utils as U
+        paddle.seed(1)
+        lin = nn.Linear(8, 8)
+        w0 = lin.weight.numpy().copy()
+        U.spectral_norm(lin, n_power_iterations=20)
+        x = paddle.to_tensor(np.eye(8, dtype=np.float32))
+        lin(x)  # hook recomputes
+        sigma = np.linalg.svd(w0, compute_uv=False)[0]
+        np.testing.assert_allclose(lin.weight.numpy(), w0 / sigma,
+                                   rtol=1e-3, atol=1e-4)
+        out = lin(x)
+        out.sum().backward()
+        assert lin.weight_orig.grad is not None
+
+
+class TestInitializerExtras:
+    def test_bilinear_kernel(self):
+        from paddle_tpu.nn.initializer import Bilinear
+        w = np.asarray(Bilinear()([2, 2, 4, 4]))
+        assert w.shape == (2, 2, 4, 4)
+        # separable triangle kernel, symmetric, peak at the center block
+        k = w[0, 0]
+        np.testing.assert_allclose(k, k[::-1, ::-1])
+        assert k[1:3, 1:3].min() == k.max() or k.max() == k[1, 1]
+        # deconv with this kernel interpolates a constant exactly
+        conv = nn.Conv2DTranspose(1, 1, 4, stride=2, padding=1,
+                                  weight_attr=Bilinear(), bias_attr=False)
+        x = paddle.to_tensor(np.ones((1, 1, 3, 3), np.float32))
+        y = conv(x).numpy()
+        np.testing.assert_allclose(y[0, 0, 1:-1, 1:-1], 1.0, rtol=1e-5)
+
+    def test_set_global_initializer(self):
+        from paddle_tpu.nn import initializer as I
+        I.set_global_initializer(I.Constant(0.25), I.Constant(-1.0))
+        try:
+            lin = nn.Linear(3, 3)
+            np.testing.assert_allclose(lin.weight.numpy(), 0.25)
+            np.testing.assert_allclose(lin.bias.numpy(), -1.0)
+            # explicit ParamAttr initializer still wins
+            lin2 = nn.Linear(3, 3, weight_attr=I.Constant(2.0))
+            np.testing.assert_allclose(lin2.weight.numpy(), 2.0)
+        finally:
+            I.set_global_initializer(None, None)
+        lin3 = nn.Linear(3, 3)
+        assert not np.allclose(lin3.weight.numpy(), 0.25)
