@@ -64,6 +64,7 @@ from .autograd import no_grad, enable_grad, set_grad_enabled, grad  # noqa: F401
 from .autograd import is_grad_enabled  # noqa: F401,E402
 
 from . import autograd  # noqa: F401,E402
+from . import cost_model  # noqa: F401,E402
 from . import device  # noqa: F401,E402
 from . import framework  # noqa: F401,E402
 from . import nn  # noqa: F401,E402

@@ -237,3 +237,9 @@ def get_available_custom_device():
     import jax
     return [f"{d.platform}:{d.id}" for d in jax.devices()
             if d.platform not in ("cpu", "gpu", "tpu")]
+
+
+# submodule surfaces (paddle.device.cuda / paddle.device.xpu) — imported
+# lazily at the bottom so they can re-use the functions above
+from . import cuda  # noqa: E402,F401
+from . import xpu   # noqa: E402,F401

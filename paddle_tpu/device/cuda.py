@@ -1,0 +1,72 @@
+"""paddle.device.cuda parity (reference: python/paddle/device/cuda/).
+
+Ported code calls these for memory accounting and synchronization; they
+map onto the accelerator the process actually has (the TPU via PJRT) —
+the reference semantics, minus CUDA-only concepts (capability reports
+(0, 0), properties carry PJRT device info).
+"""
+from __future__ import annotations
+
+from collections import namedtuple
+
+import jax
+
+from . import (Event, Stream, current_stream, device_count,  # noqa: F401
+               empty_cache, max_memory_allocated, memory_allocated,
+               set_stream, stream_guard, synchronize)
+
+__all__ = ["Stream", "Event", "current_stream", "synchronize",
+           "device_count", "empty_cache", "max_memory_allocated",
+           "max_memory_reserved", "memory_allocated", "memory_reserved",
+           "stream_guard", "get_device_properties", "get_device_name",
+           "get_device_capability"]
+
+_DeviceProperties = namedtuple(
+    "_gpuDeviceProperties",
+    ["name", "major", "minor", "total_memory", "multi_processor_count"])
+
+
+def _dev(device=None):
+    if device is not None and not isinstance(device, (int, str)):
+        return device
+    devs = jax.devices()
+    if isinstance(device, str):
+        # "gpu:1" / "tpu:1" style — honor the index, don't report dev 0
+        tail = device.rsplit(":", 1)[-1]
+        idx = int(tail) if tail.isdigit() else 0
+    else:
+        idx = device if isinstance(device, int) else 0
+    return devs[min(idx, len(devs) - 1)]
+
+
+def max_memory_reserved(device=None):
+    """PJRT does not split reserved vs allocated; peak in-use is the
+    closest truthful number (reference: cuda/max_memory_reserved)."""
+    return max_memory_allocated(_dev(device))
+
+
+def memory_reserved(device=None):
+    return memory_allocated(_dev(device))
+
+
+def get_device_properties(device=None):
+    d = _dev(device)
+    total = 0
+    try:
+        total = d.memory_stats().get("bytes_limit", 0)
+    except Exception:
+        pass
+    return _DeviceProperties(name=getattr(d, "device_kind", d.platform),
+                             major=0, minor=0, total_memory=total,
+                             multi_processor_count=getattr(
+                                 d, "core_count", 1) or 1)
+
+
+def get_device_name(device=None):
+    return get_device_properties(device).name
+
+
+def get_device_capability(device=None):
+    """No CUDA compute capability on TPU: (0, 0), like the reference
+    reports for unknown devices."""
+    return (0, 0)

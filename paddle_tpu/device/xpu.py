@@ -1,0 +1,4 @@
+"""paddle.device.xpu parity — synchronize maps to the active device."""
+from . import synchronize  # noqa: F401
+
+__all__ = ["synchronize"]

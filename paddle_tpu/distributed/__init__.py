@@ -32,7 +32,18 @@ from .topology import (CommunicateTopology, HybridCommunicateGroup,
                        set_hybrid_communicate_group)
 
 from . import auto_parallel  # noqa: E402
+from . import communication  # noqa: E402
+from . import io  # noqa: E402
+from . import launch  # noqa: E402
+from . import passes  # noqa: E402
 from . import rpc  # noqa: E402
+from . import sharding  # noqa: E402
+from .compat import (CountFilterEntry, InMemoryDataset,  # noqa: E402
+                     ParallelMode, ProbabilityEntry, QueueDataset,
+                     ShowClickEntry, broadcast_object_list,
+                     destroy_process_group, get_backend,
+                     gloo_barrier, gloo_init_parallel_env, gloo_release,
+                     is_available, scatter_object_list, split, wait)
 from .localsgd import LocalSGDStep  # noqa: E402
 from .quantized import quantized_all_reduce  # noqa: E402
 from .spawn import spawn  # noqa: E402
@@ -44,6 +55,12 @@ __all__ = [
     "auto_parallel", "ProcessMesh", "shard_tensor", "shard_op", "Engine",
     "rpc", "spawn", "DistributedAuc", "global_auc", "LocalSGDStep",
     "quantized_all_reduce",
+    "communication", "io", "launch", "passes", "sharding",
+    "ParallelMode", "broadcast_object_list", "scatter_object_list",
+    "destroy_process_group", "get_backend", "is_available", "wait",
+    "gloo_init_parallel_env", "gloo_barrier", "gloo_release", "split",
+    "InMemoryDataset", "QueueDataset", "CountFilterEntry",
+    "ProbabilityEntry", "ShowClickEntry",
     "init_parallel_env", "is_initialized", "get_rank", "get_world_size",
     "ParallelEnv", "DataParallel", "shard_batch",
     "Mesh", "PartitionSpec", "init_mesh", "get_mesh", "set_mesh",

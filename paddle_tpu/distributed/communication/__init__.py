@@ -1,0 +1,5 @@
+"""paddle.distributed.communication namespace (reference package of the
+same name) — the stream submodule re-exports the collectives."""
+from . import stream  # noqa: F401
+
+__all__ = ["stream"]

@@ -1,0 +1,15 @@
+"""paddle.distributed.communication.stream parity (reference:
+python/paddle/distributed/communication/stream/__init__.py).
+
+The reference's stream.* variants run collectives on a chosen CUDA
+stream; PJRT schedules programs on the device's single logical stream,
+so these are the same collectives — `use_calc_stream`/`sync_op` are
+accepted by the underlying functions for API parity.
+"""
+from ..collective import (all_gather, all_reduce, alltoall,  # noqa: F401
+                          alltoall_single, broadcast, recv, reduce,
+                          reduce_scatter, scatter, send)
+
+__all__ = ["all_gather", "all_reduce", "alltoall", "alltoall_single",
+           "broadcast", "reduce", "reduce_scatter", "recv", "scatter",
+           "send"]

@@ -1,0 +1,172 @@
+"""distributed API long tail (reference: python/paddle/distributed/
+__init__.py __all__): object collectives, process-group lifecycle,
+gloo helpers, ParallelMode, and the deferred PS dataset surface.
+
+Object collectives ride the existing tensor collectives: objects are
+pickled to uint8 payloads, padded to the world max (collectives need
+uniform shapes), and length-prefixed — the pattern the reference
+implements in communication/{broadcast,scatter}.py over NCCL byte
+tensors.
+"""
+from __future__ import annotations
+
+import pickle
+from enum import IntEnum
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from . import collective as C
+
+__all__ = ["ParallelMode", "broadcast_object_list", "scatter_object_list",
+           "destroy_process_group", "get_backend", "is_available", "wait",
+           "gloo_init_parallel_env", "gloo_barrier", "gloo_release",
+           "split", "InMemoryDataset", "QueueDataset", "CountFilterEntry",
+           "ProbabilityEntry", "ShowClickEntry"]
+
+
+class ParallelMode(IntEnum):
+    """Parity: paddle.distributed.ParallelMode."""
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+def _world_procs() -> int:
+    return jax.process_count()
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    """Parity: dist.broadcast_object_list — in place, like the reference.
+    Exactly THREE collectives regardless of list length (count header,
+    sizes vector, one concatenated payload); in a single process every
+    rank already holds the source objects."""
+    if _world_procs() <= 1:
+        return object_list
+
+    def _bcast(arr):
+        return np.asarray(C.broadcast(Tensor(jnp.asarray(arr)), src=src,
+                                      group=group).numpy())
+
+    if _my_rank(group) == src:
+        blobs = [pickle.dumps(o) for o in object_list]
+        _bcast(np.asarray([len(blobs)], np.int64))
+        _bcast(np.asarray([len(b) for b in blobs], np.int64))
+        payload = np.frombuffer(b"".join(blobs), np.uint8)
+        if payload.size:
+            _bcast(payload)
+        return object_list
+    count = int(_bcast(np.zeros(1, np.int64))[0])
+    sizes = _bcast(np.zeros(count, np.int64)).astype(np.int64)
+    total = int(sizes.sum())
+    payload = (_bcast(np.zeros(total, np.uint8)).astype(np.uint8)
+               if total else np.zeros(0, np.uint8))
+    off = 0
+    for i, n in enumerate(sizes):
+        obj = pickle.loads(payload[off:off + int(n)].tobytes())
+        off += int(n)
+        if i < len(object_list):
+            object_list[i] = obj
+        else:
+            object_list.append(obj)
+    del object_list[count:]
+    return object_list
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """Parity: dist.scatter_object_list — rank r receives
+    in_object_list[r] (broadcast + local select: identical result, and
+    the payload already transits every device under SPMD collectives)."""
+    buf = (list(in_object_list or []) if _my_rank(group) == src
+           or _world_procs() <= 1 else [])
+    broadcast_object_list(buf, src=src, group=group)
+    rank = _my_rank(group)
+    out_object_list.clear()
+    out_object_list.append(buf[rank] if rank < len(buf) else None)
+    return out_object_list
+
+
+def _my_rank(group=None):
+    g = C.get_group(group) if group is not None else None
+    if g is not None and hasattr(g, "rank"):
+        return g.rank
+    from .env import get_rank
+    return get_rank()
+
+
+def destroy_process_group(group=None):
+    """Parity: dist.destroy_process_group — drop the group registry (and
+    the global mesh when destroying the default group)."""
+    from . import mesh as mesh_mod
+    if group is None:
+        C._groups.clear()
+        mesh_mod.set_mesh(None)
+        return
+    gid = getattr(group, "id", group)
+    C._groups.pop(gid, None)
+
+
+def get_backend(group=None) -> str:
+    """Parity: dist.get_backend — the comm backend name. XLA collectives
+    over ICI/host play the NCCL/GLOO role here."""
+    return "XLA"
+
+
+def is_available() -> bool:
+    """Parity: dist.is_available."""
+    return True
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """Parity: dist.wait — block until `tensor`'s producing work is done
+    (jax dispatch is async)."""
+    v = tensor.value if isinstance(tensor, Tensor) else tensor
+    jax.block_until_ready(v)
+    return tensor
+
+
+# gloo helpers: the reference spins a CPU gloo world for barrier-style
+# coordination; here the jax.distributed world (or single process) already
+# provides it.
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    return None
+
+
+def gloo_barrier():
+    C.barrier()
+
+
+def gloo_release():
+    return None
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    raise NotImplementedError(
+        "paddle.distributed.split (imperatively sharding one layer) is "
+        "superseded by the mesh-native TP layers: use "
+        "distributed.meta_parallel ColumnParallelLinear / "
+        "RowParallelLinear / VocabParallelEmbedding, whose shardings "
+        "GSPMD compiles into the same collectives")
+
+
+def _ps_stub(name):
+    class _PS:
+        def __init__(self, *a, **kw):
+            raise NotImplementedError(
+                f"paddle.distributed.{name} belongs to the parameter-server "
+                "data pipeline, deferred per SURVEY §2.6 (out of TPU "
+                "scope); use paddle.io.DataLoader")
+    _PS.__name__ = name
+    return _PS
+
+
+InMemoryDataset = _ps_stub("InMemoryDataset")
+QueueDataset = _ps_stub("QueueDataset")
+CountFilterEntry = _ps_stub("CountFilterEntry")
+ProbabilityEntry = _ps_stub("ProbabilityEntry")
+ShowClickEntry = _ps_stub("ShowClickEntry")

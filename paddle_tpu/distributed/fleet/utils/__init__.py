@@ -6,5 +6,19 @@ write through (SURVEY.md §5.4: "epoch-boundary snapshots to HDFS keyed by
 job env").
 """
 from .fs import FS, LocalFS, HDFSClient
+from ...recompute import recompute  # noqa: F401  (fleet.utils.recompute path)
 
-__all__ = ["FS", "LocalFS", "HDFSClient"]
+
+class DistributedInfer:
+    """Parity stub: fleet.utils.DistributedInfer drives PS-table lookup
+    for distributed CTR inference — deferred with the parameter server
+    (SURVEY §2.6 PS row); dense inference serves through
+    paddle_tpu.inference."""
+
+    def __init__(self, *a, **kw):
+        raise NotImplementedError(
+            "DistributedInfer belongs to the deferred parameter-server "
+            "family; use paddle_tpu.inference (Config/create_predictor)")
+
+
+__all__ = ["FS", "LocalFS", "HDFSClient", "recompute", "DistributedInfer"]

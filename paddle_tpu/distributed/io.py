@@ -1,0 +1,22 @@
+"""paddle.distributed.io parity (reference:
+python/paddle/distributed/io.py save/load for distributed programs) —
+maps onto the sharded checkpoint module (orbax-backed)."""
+from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
+
+
+def save_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None):
+    raise NotImplementedError(
+        "save_persistables operates on a static Program; use paddle.save "
+        "(state dicts) or distributed.save_state_dict (sharded orbax)")
+
+
+def load_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None):
+    raise NotImplementedError(
+        "load_persistables operates on a static Program; use paddle.load "
+        "or distributed.load_state_dict")
+
+
+__all__ = ["save_state_dict", "load_state_dict", "save_persistables",
+           "load_persistables"]

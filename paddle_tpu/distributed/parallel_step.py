@@ -116,6 +116,10 @@ class ParallelTrainStep:
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.n_inputs = n_inputs
+        if zero_stage == 0:
+            # sharding.group_sharded_parallel records the requested ZeRO
+            # level on the optimizer (reference GroupSharded entry point)
+            zero_stage = getattr(optimizer, "_group_sharded_level", 0)
         self.zero_stage = zero_stage
         self.remat = remat
         self.mesh = mesh or mesh_mod.get_mesh()

@@ -1,0 +1,59 @@
+"""paddle.distributed.passes parity (reference:
+python/paddle/distributed/passes/__init__.py — pass_base.py PassManager).
+
+The reference rewrites static Programs through a registered pass
+pipeline (AMP/recompute/sharding passes). Here those transforms are
+ParallelTrainStep engine options and GSPMD's job, so passes resolve to
+recorded no-ops: the names are kept so ported auto-parallel configs
+construct, and `applied_passes` shows what the engine equivalent is.
+"""
+from __future__ import annotations
+
+__all__ = ["new_pass", "PassManager", "PassContext"]
+
+_ENGINE_EQUIV = {
+    "auto_parallel_amp": "ParallelTrainStep(amp_level=...)",
+    "auto_parallel_recompute": "ParallelTrainStep(remat=True)",
+    "auto_parallel_sharding": "ParallelTrainStep(zero_stage=...)",
+    "auto_parallel_gradient_merge": "accumulate_steps=...",
+}
+
+
+class Pass:
+    def __init__(self, name, attrs=None):
+        self.name = name
+        self.attrs = dict(attrs or {})
+
+    def apply(self, main_programs, startup_programs=None, context=None):
+        if context is not None:
+            context.applied_passes.append(self)
+        return main_programs
+
+    def __repr__(self):
+        equiv = _ENGINE_EQUIV.get(self.name)
+        return (f"Pass({self.name!r})" +
+                (f" -> engine option {equiv}" if equiv else ""))
+
+
+def new_pass(name, pass_attrs=None) -> Pass:
+    return Pass(name, pass_attrs)
+
+
+class PassContext:
+    def __init__(self):
+        self.applied_passes = []
+
+
+class PassManager:
+    def __init__(self, passes):
+        self._passes = list(passes)
+
+    def apply(self, main_programs, startup_programs=None):
+        ctx = PassContext()
+        for p in self._passes:
+            p.apply(main_programs, startup_programs, ctx)
+        return main_programs, startup_programs
+
+    @property
+    def names(self):
+        return [p.name for p in self._passes]

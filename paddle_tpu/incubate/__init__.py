@@ -2,28 +2,17 @@
 sparsity, autotune config, and the MoE models re-export (the MoE
 implementation itself lives in distributed/moe.py)."""
 from . import asp
+from . import autograd
 from . import autotune
 from . import checkpoint
+from . import distributed
 from . import nn
+from . import optimizer
 
 
-class _MoENamespace:
-    """paddle.incubate.distributed.models.moe path parity."""
-
-    def __getattr__(self, name):
-        from ..distributed import moe
-        return getattr(moe, name)
 
 
-class _DistributedNamespace:
-    class models:
-        pass
-
-
-distributed = _DistributedNamespace()
-distributed.models.moe = _MoENamespace()
-
-__all__ = ["asp", "autotune", "checkpoint", "distributed", "nn", "LookAhead",
+__all__ = ["asp", "autograd", "autotune", "checkpoint", "distributed", "nn", "optimizer", "LookAhead",
            "ModelAverage",
            "graph_khop_sampler", "graph_reindex", "graph_sample_neighbors",
            "graph_send_recv", "identity_loss", "segment_max",

@@ -157,8 +157,17 @@ def reset_excluded_layers(main_program=None):
     _excluded.clear()
 
 
-def _supported(p):
-    return len(p.shape) in (2, 4) and min(p.shape) >= 4
+_CUSTOM_SUPPORTED = set()
+
+
+def _supported(p, name=""):
+    if len(p.shape) not in (2, 4):
+        return False
+    # explicitly registered layers (add_supported_layer) bypass the
+    # min-dim heuristic; the n:m mask only needs the last dim to split
+    if any(key in name for key in _CUSTOM_SUPPORTED):
+        return True
+    return min(p.shape) >= 4
 
 
 def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
@@ -170,7 +179,7 @@ def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
             "mask_2d_best": MaskAlgo.MASK_2D_BEST}[mask_algo]
     out = {}
     for name, p in model.named_parameters():
-        if name in _excluded or not _supported(p):
+        if name in _excluded or not _supported(p, name):
             continue
         mask = create_mask(p, algo, n, m).astype(np.float32)
         p.value = p.value * jnp.asarray(mask, p.value.dtype)
@@ -198,3 +207,19 @@ def decorate(optimizer):
                 p.value = p.value * jnp.asarray(mask, p.value.dtype)
 
     return OptimizerWithSparsityGuarantee(optimizer)
+
+
+def add_supported_layer(layer, pruning_func=None):
+    """Parity: incubate.asp.add_supported_layer — register an extra layer
+    type (or layer-name string) whose weights prune_model should mask."""
+    key = layer if isinstance(layer, str) else getattr(
+        layer, "__name__", str(layer))
+    _CUSTOM_SUPPORTED.add(key)
+    return key
+
+
+def supported_layers():
+    return set(_CUSTOM_SUPPORTED)
+
+
+__all__ += ["add_supported_layer", "supported_layers"]

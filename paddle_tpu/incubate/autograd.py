@@ -1,0 +1,76 @@
+"""paddle.incubate.autograd parity (reference:
+python/paddle/incubate/autograd/__init__.py) — the functional transforms
+over the jax primitive AD (the role of the reference's prim/composite
+operator machinery, which this runtime subsumes: SURVEY §2.8 prim row).
+"""
+from ..autograd.functional import hessian as _hessian
+from ..autograd.functional import jacobian as _jacobian
+from ..autograd.functional import jvp, vjp  # noqa: F401
+
+
+class Jacobian:
+    """Parity: incubate.autograd.Jacobian — class wrapper whose value is
+    materialized once and indexed like the reference's lazy matrix."""
+
+    def __init__(self, func, xs, is_batched=False):
+        self._j = _jacobian(func, xs,
+                            batch_axis=0 if is_batched else None)
+
+    def __getitem__(self, idx):
+        j = self._j
+        return (j[idx] if not isinstance(j, (list, tuple))
+                else [ji[idx] for ji in j])
+
+    @property
+    def shape(self):
+        j = self._j
+        return j.shape if not isinstance(j, (list, tuple)) else \
+            [ji.shape for ji in j]
+
+
+class Hessian(Jacobian):
+    """Parity: incubate.autograd.Hessian."""
+
+    def __init__(self, func, xs, is_batched=False):
+        self._j = _hessian(func, xs,
+                           batch_axis=0 if is_batched else None)
+
+__all__ = ["vjp", "jvp", "Jacobian", "Hessian", "enable_prim",
+           "disable_prim", "forward_grad", "grad"]
+
+_prim_enabled = False
+
+
+def enable_prim():
+    """Parity: prim-mode toggle. jax always differentiates through
+    primitive rules (the end state the reference's prim mode builds
+    toward), so this only records the flag."""
+    global _prim_enabled
+    _prim_enabled = True
+
+
+def disable_prim():
+    global _prim_enabled
+    _prim_enabled = False
+
+
+def prim_enabled():
+    return _prim_enabled
+
+
+def forward_grad(outputs, inputs, grad_inputs=None):
+    """Parity note: the reference's forward_grad rewrites a static prim
+    Program; a define-by-run tape cannot replay forward-mode from output
+    tensors alone. The functional equivalent is provided instead."""
+    raise NotImplementedError(
+        "forward_grad consumes a static prim Program in the reference; "
+        "use paddle.incubate.autograd.jvp(fn, xs, v) — same derivative, "
+        "functional form")
+
+
+def grad(outputs, inputs, grad_outputs=None):
+    """Parity: incubate.autograd.grad (prim-mode reverse) — same result
+    as paddle.grad here (one AD engine)."""
+    from ..autograd import grad as _grad
+    return _grad(outputs, inputs, grad_outputs=grad_outputs,
+                 allow_unused=True)

@@ -1,0 +1,5 @@
+"""paddle.incubate.distributed namespace."""
+from . import fleet  # noqa: F401
+from . import models  # noqa: F401
+
+__all__ = ["fleet", "models"]

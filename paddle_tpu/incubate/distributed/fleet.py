@@ -1,0 +1,15 @@
+"""paddle.incubate.distributed.fleet parity — the recompute entry points
+(reference: python/paddle/incubate/distributed/fleet/__init__.py) map to
+the jax.checkpoint-backed implementations in distributed.recompute."""
+from ...distributed.recompute import (recompute_sequential)  # noqa: F401
+
+
+def recompute_hybrid(ctx, function, *args, **kwargs):
+    """Parity: recompute_hybrid(ctx, fn, ...) — mp-aware activation
+    partitioning is GSPMD's job here (rematerialized values inherit
+    their shardings), so this is recompute with the ctx accepted."""
+    from ...distributed.recompute import recompute
+    return recompute(function, *args, **kwargs)
+
+
+__all__ = ["recompute_sequential", "recompute_hybrid"]

@@ -1,0 +1,4 @@
+"""paddle.incubate.distributed.models namespace."""
+from . import moe  # noqa: F401
+
+__all__ = ["moe"]

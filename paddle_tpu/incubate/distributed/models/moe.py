@@ -1,0 +1,4 @@
+"""paddle.incubate.distributed.models.moe parity — re-export of the MoE
+implementation (gates/capacity/dispatch live in distributed/moe.py)."""
+from ....distributed.moe import *  # noqa: F401,F403
+from ....distributed.moe import __all__  # noqa: F401

@@ -16,7 +16,8 @@ from .layers import (FusedBiasDropoutResidualLayerNorm, FusedDropoutAdd,
                      FusedMultiHeadAttention, FusedMultiTransformer,
                      FusedTransformerEncoderLayer)
 
-__all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
+from . import functional  # noqa: F401
+__all__ = ["functional", "FusedMultiHeadAttention", "FusedFeedForward",
            "FusedTransformerEncoderLayer", "FusedMultiTransformer",
            "FusedLinear", "FusedBiasDropoutResidualLayerNorm",
            "FusedEcMoe", "FusedDropoutAdd"]

@@ -117,3 +117,9 @@ class ModelAverage:
 
     def minimize(self, loss):
         self.step()
+
+
+from .lbfgs import LBFGS  # noqa: E402,F401
+from . import functional  # noqa: E402,F401
+
+__all__ += ["LBFGS", "functional"]

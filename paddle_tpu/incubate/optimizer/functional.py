@@ -1,0 +1,139 @@
+"""Functional minimizers (reference:
+python/paddle/incubate/optimizer/functional/{bfgs,lbfgs}.py).
+
+Self-contained BFGS (dense inverse-Hessian update + Armijo backtracking)
+and two-loop L-BFGS over a pure objective. jax.scipy's BFGS is NOT used:
+its zoom line search fails in f32 even on 2x2 SPD quadratics (status 3,
+verified on this jax build). Both return the reference's result tuple
+ordering (is_converge, num_func_calls, x, f, g).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+
+__all__ = ["minimize_bfgs", "minimize_lbfgs"]
+
+
+def _pure(objective_func):
+    def f(x):
+        out = objective_func(Tensor(x))
+        return out.value if isinstance(out, Tensor) else out
+    return f
+
+
+def minimize_bfgs(objective_func, initial_position, max_iters=50,
+                  tolerance_grad=1e-7, tolerance_change=1e-9,
+                  initial_inverse_hessian_estimate=None, line_search_fn=None,
+                  max_line_search_iters=50, initial_step_length=1.0,
+                  dtype="float32", name=None):
+    f = _pure(objective_func)
+    grad_f = jax.grad(f)
+    x = (initial_position.value if isinstance(initial_position, Tensor)
+         else jnp.asarray(initial_position)).astype(jnp.float32)
+    n = x.size
+    H = (initial_inverse_hessian_estimate.value
+         if isinstance(initial_inverse_hessian_estimate, Tensor)
+         else initial_inverse_hessian_estimate)
+    H = jnp.eye(n, dtype=jnp.float32) if H is None else jnp.asarray(H)
+    g = grad_f(x)
+    nfev = 1
+    converged = False
+    for _ in range(max_iters):
+        if float(jnp.max(jnp.abs(g))) <= tolerance_grad:
+            converged = True
+            break
+        d = -(H @ g)
+        t = initial_step_length
+        fx = f(x)
+        gd = float(jnp.vdot(g, d))
+        accepted = False
+        for _ls in range(max_line_search_iters):
+            x_new = x + t * d
+            f_new = f(x_new)
+            nfev += 1
+            if float(f_new) <= float(fx) + 1e-4 * t * gd:
+                accepted = True
+                break
+            t *= 0.5
+        if not accepted:
+            break
+        g_new = grad_f(x_new)
+        s, y = x_new - x, g_new - g
+        sy = float(jnp.vdot(s, y))
+        if sy > 1e-10:     # curvature holds: BFGS inverse update
+            rho = 1.0 / sy
+            I = jnp.eye(n, dtype=jnp.float32)
+            V = I - rho * jnp.outer(s, y)
+            H = V @ H @ V.T + rho * jnp.outer(s, s)
+        if float(jnp.max(jnp.abs(s))) <= tolerance_change:
+            x, g = x_new, g_new
+            converged = True
+            break
+        x, g = x_new, g_new
+    return (Tensor(jnp.asarray(converged)), Tensor(jnp.asarray(nfev)),
+            Tensor(x), Tensor(f(x)), Tensor(g))
+
+
+def minimize_lbfgs(objective_func, initial_position, history_size=100,
+                   max_iters=50, tolerance_grad=1e-8,
+                   tolerance_change=1e-8, initial_inverse_hessian_estimate=None,
+                   line_search_fn=None, max_line_search_iters=50,
+                   initial_step_length=1.0, dtype="float32", name=None):
+    f = _pure(objective_func)
+    grad_f = jax.grad(f)
+    x = (initial_position.value if isinstance(initial_position, Tensor)
+         else jnp.asarray(initial_position)).astype(jnp.float32)
+    s_hist, y_hist = [], []
+    g = grad_f(x)
+    nfev = 1
+    converged = False
+    for _ in range(max_iters):
+        if float(jnp.max(jnp.abs(g))) <= tolerance_grad:
+            converged = True
+            break
+        q = g
+        alphas = []
+        for s, y in reversed(list(zip(s_hist, y_hist))):
+            rho = 1.0 / jnp.maximum(jnp.vdot(y, s), 1e-10)
+            a = rho * jnp.vdot(s, q)
+            alphas.append((rho, a, s, y))
+            q = q - a * y
+        if s_hist:
+            s, y = s_hist[-1], y_hist[-1]
+            q = q * (jnp.vdot(s, y) / jnp.maximum(jnp.vdot(y, y), 1e-10))
+        for rho, a, s, y in reversed(alphas):
+            q = q + s * (a - rho * jnp.vdot(y, q))
+        d = -q
+        # backtracking Armijo
+        t = initial_step_length
+        fx = f(x)
+        gd = float(jnp.vdot(g, d))
+        accepted = False
+        for _ls in range(max_line_search_iters):
+            x_new = x + t * d
+            f_new = f(x_new)
+            nfev += 1
+            if float(f_new) <= float(fx) + 1e-4 * t * gd:
+                accepted = True
+                break
+            t *= 0.5
+        if not accepted:
+            break
+        g_new = grad_f(x_new)
+        s, y = x_new - x, g_new - g
+        if float(jnp.vdot(s, y)) > 1e-10:
+            s_hist.append(s)
+            y_hist.append(y)
+            if len(s_hist) > history_size:
+                s_hist.pop(0)
+                y_hist.pop(0)
+        if float(jnp.max(jnp.abs(s))) <= tolerance_change:
+            x, g = x_new, g_new
+            converged = True
+            break
+        x, g = x_new, g_new
+    return (Tensor(jnp.asarray(converged)), Tensor(jnp.asarray(nfev)),
+            Tensor(x), Tensor(f(x)), Tensor(g))

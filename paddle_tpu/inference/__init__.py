@@ -11,8 +11,16 @@ TRT subgraphs" collapse into XLA compilation at load (AOT — first run
 pays no trace). The Config/Predictor/Tensor-handle API surface matches the
 reference so serving code ports directly.
 """
-from .predictor import (Config, PlaceType, Predictor, Tensor,
-                        create_predictor)
+from .predictor import (Config, DataType, PlaceType, PrecisionType,
+                        Predictor, PredictorPool, Tensor,
+                        _get_phi_kernel_name,
+                        convert_to_mixed_precision, create_predictor,
+                        get_num_bytes_of_data_type,
+                        get_trt_compile_version,
+                        get_trt_runtime_version, get_version)
 
 __all__ = ["Config", "Predictor", "create_predictor", "Tensor",
-           "PlaceType"]
+           "PlaceType", "DataType", "PrecisionType", "PredictorPool",
+           "get_version", "get_num_bytes_of_data_type",
+           "get_trt_compile_version", "get_trt_runtime_version",
+           "convert_to_mixed_precision", "_get_phi_kernel_name"]

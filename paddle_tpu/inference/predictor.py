@@ -9,7 +9,11 @@ import numpy as np
 
 import jax
 
-__all__ = ["Config", "Predictor", "create_predictor", "Tensor", "PlaceType"]
+__all__ = ["Config", "Predictor", "create_predictor", "Tensor", "PlaceType",
+           "DataType", "PrecisionType", "PredictorPool", "get_version",
+           "get_num_bytes_of_data_type", "get_trt_compile_version",
+           "get_trt_runtime_version", "convert_to_mixed_precision",
+           "_get_phi_kernel_name"]
 
 
 class PlaceType(Enum):
@@ -268,3 +272,82 @@ class Predictor:
 def create_predictor(config: Config) -> Predictor:
     """Parity: paddle_infer.create_predictor."""
     return Predictor(config)
+
+
+class DataType(Enum):
+    """Parity: paddle_infer.DataType (api/paddle_tensor.h)."""
+    FLOAT32 = 0
+    INT64 = 1
+    INT32 = 2
+    UINT8 = 3
+    INT8 = 4
+    FLOAT16 = 5
+    BFLOAT16 = 6
+    BOOL = 7
+
+
+class PrecisionType(Enum):
+    """Parity: paddle_infer.PrecisionType — kInt8 routes through the
+    int8 lowering (Config.enable_int8)."""
+    Float32 = 0
+    Half = 1
+    Int8 = 2
+    Bfloat16 = 3
+
+
+_DTYPE_BYTES = {DataType.FLOAT32: 4, DataType.INT64: 8, DataType.INT32: 4,
+                DataType.UINT8: 1, DataType.INT8: 1, DataType.FLOAT16: 2,
+                DataType.BFLOAT16: 2, DataType.BOOL: 1}
+
+
+def get_num_bytes_of_data_type(dtype: "DataType") -> int:
+    """Parity: paddle_infer.get_num_bytes_of_data_type."""
+    return _DTYPE_BYTES[DataType(dtype)]
+
+
+def get_version() -> str:
+    """Parity: paddle_infer.get_version."""
+    from ..version import full_version
+    return f"paddle_tpu inference {full_version}"
+
+
+def get_trt_compile_version():
+    """No TensorRT in a TPU build (XLA is the engine)."""
+    return (0, 0, 0)
+
+
+def get_trt_runtime_version():
+    return (0, 0, 0)
+
+
+def _get_phi_kernel_name(op_name: str) -> str:
+    """Parity: the op->phi kernel rename map; one dispatch layer here."""
+    return op_name
+
+
+def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
+                               mixed_params_file, mixed_precision=None,
+                               backend=None, keep_io_types=True,
+                               black_list=None, **kwargs):
+    raise NotImplementedError(
+        "convert_to_mixed_precision rewrites a Program's dtypes; StableHLO "
+        "programs bake dtypes at trace time — re-export instead: load the "
+        "Layer, call .bfloat16() (or .float16()), and paddle.jit.save it")
+
+
+class PredictorPool:
+    """Parity: paddle_infer.PredictorPool — N independent predictors over
+    one Config for thread-per-worker serving."""
+
+    def __init__(self, config: Config, size: int = 1):
+        if size < 1:
+            raise ValueError("PredictorPool size must be >= 1")
+        self._predictors = [Predictor(config) for _ in range(size)]
+
+    def retrive(self, idx: int) -> Predictor:   # reference spelling
+        return self._predictors[idx]
+
+    retrieve = retrive
+
+    def __len__(self):
+        return len(self._predictors)

@@ -1,5 +1,6 @@
 """paddle.nn namespace parity (python/paddle/nn/__init__.py)."""
 from . import functional  # noqa: F401
+from . import quant  # noqa: F401
 from . import initializer  # noqa: F401
 from .layer_base import Layer, ParamAttr  # noqa: F401
 from .layer.common import *  # noqa: F401,F403

@@ -1,0 +1,21 @@
+"""paddle.nn.quant parity (reference: python/paddle/nn/quant/) — Stub
+marks a quantization insertion point in a model; the QAT converter
+replaces it with the configured observer/quanter."""
+from .layer_base import Layer
+
+__all__ = ["Stub"]
+
+
+class Stub(Layer):
+    """Parity: nn.quant.Stub — identity until quantization replaces it;
+    carries an optional per-site observer config."""
+
+    def __init__(self, observer=None):
+        super().__init__()
+        self._observer_config = observer
+
+    def forward(self, x):
+        return x
+
+    def extra_repr(self):
+        return f"observer={self._observer_config}"

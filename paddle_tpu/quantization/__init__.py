@@ -6,7 +6,7 @@ freezes scales for the inference path.
 from .base import (BaseObserver, BaseQuanter, ObserveWrapper,
                    fake_quant_dequant)
 from .config import QuantConfig, SingleLayerConfig
-from .factory import ObserverFactory, QuanterFactory
+from .factory import ObserverFactory, QuanterFactory, quanter
 from .qat import QAT
 from .ptq import PTQ
 from . import observers
@@ -18,5 +18,5 @@ __all__ = [
     "QuantConfig", "SingleLayerConfig", "BaseObserver", "BaseQuanter",
     "ObserveWrapper", "ObserverFactory", "QuanterFactory", "QAT", "PTQ",
     "observers", "quanters", "QuantedConv2D", "QuantedLinear",
-    "fake_quant_dequant", "Int8Linear", "convert_to_int8",
+    "fake_quant_dequant", "Int8Linear", "convert_to_int8", "quanter",
 ]

@@ -17,3 +17,19 @@ class ObserverFactory:
 
 class QuanterFactory(ObserverFactory):
     pass
+
+
+def quanter(name):
+    """Parity: paddle.quantization.quanter — class decorator that
+    registers a quanter Layer under a factory `name` usable in
+    QuantConfig (reference: quantization/factory.py quanter)."""
+    def wrap(cls):
+        import sys
+        factory = type(name, (QuanterFactory,),
+                       {"__init__": lambda self, **kw:
+                        QuanterFactory.__init__(self, cls, **kw)})
+        mod = sys.modules[cls.__module__]
+        setattr(mod, name, factory)
+        globals()[name] = factory
+        return cls
+    return wrap

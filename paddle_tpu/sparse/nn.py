@@ -24,7 +24,8 @@ from ..nn.layer_base import Layer
 from ..nn import initializer as I
 from . import SparseCooTensor, sparse_coo_tensor
 
-__all__ = ["Conv3D", "SubmConv3D", "BatchNorm", "ReLU"]
+__all__ = ["Conv3D", "SubmConv3D", "BatchNorm", "ReLU", "LeakyReLU",
+           "ReLU6", "Softmax", "MaxPool3D", "SyncBatchNorm", "functional"]
 
 
 def _tuple3(v):
@@ -210,3 +211,81 @@ class ReLU(Layer):
     def forward(self, x: SparseCooTensor):
         from . import relu
         return relu(x)
+
+
+class LeakyReLU(Layer):
+    """Parity: sparse/nn/layer/activation.py LeakyReLU."""
+
+    def __init__(self, negative_slope=0.01, name=None):
+        super().__init__()
+        self.negative_slope = negative_slope
+
+    def forward(self, x):
+        from .nn_functional import leaky_relu
+        return leaky_relu(x, self.negative_slope)
+
+
+class ReLU6(Layer):
+    """Parity: sparse/nn/layer/activation.py ReLU6."""
+
+    def forward(self, x):
+        from .nn_functional import relu6
+        return relu6(x)
+
+
+class Softmax(Layer):
+    """Parity: sparse/nn/layer/activation.py Softmax (last axis)."""
+
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        from .nn_functional import softmax
+        return softmax(x, self.axis)
+
+
+class MaxPool3D(Layer):
+    """Parity: sparse/nn/layer/pooling.py MaxPool3D (NDHWC)."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, return_mask=False, data_format="NDHWC",
+                 name=None):
+        super().__init__()
+        if return_mask:
+            raise NotImplementedError("sparse MaxPool3D return_mask")
+        if ceil_mode:
+            raise NotImplementedError(
+                "sparse MaxPool3D ceil_mode=True (floor-mode output "
+                "shapes only; pad the input instead)")
+        if data_format != "NDHWC":
+            raise NotImplementedError("sparse MaxPool3D supports NDHWC")
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+
+    def forward(self, x):
+        from .nn_functional import max_pool3d
+        return max_pool3d(x, self.kernel_size, self.stride, self.padding)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Parity: sparse/nn/layer/norm.py SyncBatchNorm — inside one
+    compiled mesh program the batch statistics are already global
+    (GSPMD reduces them), so the sync variant IS BatchNorm here."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        """Parity: SyncBatchNorm.convert_sync_batchnorm — swap BatchNorm
+        sublayers for SyncBatchNorm in place."""
+        for name, sub in list(layer._sub_layers.items()):
+            if type(sub) is BatchNorm:
+                sbn = SyncBatchNorm.__new__(SyncBatchNorm)
+                sbn.__dict__ = sub.__dict__
+                layer._sub_layers[name] = sbn
+            else:
+                cls.convert_sync_batchnorm(sub)
+        return layer
+
+
+from . import nn_functional as functional  # noqa: E402,F401

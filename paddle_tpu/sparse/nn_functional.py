@@ -1,0 +1,204 @@
+"""paddle.sparse.nn.functional parity (reference:
+python/paddle/sparse/nn/functional/__init__.py — conv/pooling/
+activation/transformer over phi sparse kernels).
+
+Values-only ops act on the stored nnz values; conv/pooling reuse the
+gather-matmul plan in sparse/nn.py; attention computes the CSR-masked
+softmax(QK^T)V densely (batched MXU matmuls with the sparse pattern as
+mask — on TPU the dense masked form IS the fast path; the reference's
+CUDA kernel exists to exploit gpu sparse formats).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd.tape import apply
+from ..core.tensor import Tensor
+from . import SparseCooTensor, SparseCsrTensor, _raw, is_sparse
+from . import relu  # noqa: F401  (re-export, same values-only op)
+from . import sparse_coo_tensor
+
+__all__ = ["conv3d", "subm_conv3d", "max_pool3d", "relu", "relu6",
+           "leaky_relu", "softmax", "attention"]
+
+
+def _values_map(x, fn, name):
+    from jax.experimental import sparse as jsparse
+    out_vals = apply(lambda v: fn(v), x.values(), _op_name=name)
+    st = SparseCooTensor(jsparse.BCOO((out_vals.value, x.value.indices),
+                                      shape=x.value.shape))
+    st._values_tensor = out_vals
+    return st
+
+
+def relu6(x, name=None):
+    if is_sparse(x):
+        return _values_map(x, lambda v: jnp.clip(v, 0, 6), "sparse_relu6")
+    return Tensor(jnp.clip(_raw(x), 0, 6))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    if is_sparse(x):
+        return _values_map(
+            x, lambda v: jnp.where(v >= 0, v, negative_slope * v),
+            "sparse_leaky_relu")
+    v = _raw(x)
+    return Tensor(jnp.where(v >= 0, v, negative_slope * v))
+
+
+def softmax(x, axis=-1, name=None):
+    """Softmax over the stored values of each row (last axis), the
+    reference's sparse softmax semantics: zeros stay zero, nonzeros of a
+    row normalize among themselves."""
+    if axis not in (-1, len(x.shape) - 1):
+        raise ValueError("sparse softmax supports the last axis only "
+                         "(reference restriction)")
+    import jax
+    b = x.value
+    idx = np.asarray(b.indices)                     # (nnz, ndim)
+    shape = x.shape
+    # segment id = flattened index of all dims except the last
+    seg = np.zeros(idx.shape[0], np.int64)
+    mul = 1
+    for d in range(len(shape) - 2, -1, -1):
+        seg += idx[:, d] * mul
+        mul *= shape[d]
+    n_seg = int(np.prod(shape[:-1])) if len(shape) > 1 else 1
+    seg = jnp.asarray(seg)
+
+    def f(vals):
+        vmax = jax.ops.segment_max(vals, seg, num_segments=n_seg)
+        vmax = jnp.where(jnp.isneginf(vmax), 0.0, vmax)
+        e = jnp.exp(vals - vmax[seg])
+        z = jax.ops.segment_sum(e, seg, num_segments=n_seg)
+        return e / jnp.maximum(z[seg], 1e-38)
+
+    from jax.experimental import sparse as jsparse
+    out_vals = apply(f, x.values(), _op_name="sparse_softmax")
+    st = SparseCooTensor(jsparse.BCOO((out_vals.value, b.indices),
+                                      shape=b.shape))
+    st._values_tensor = out_vals
+    return st
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups=1, data_format="NDHWC", name=None):
+    """Functional sparse conv3d over the gather-matmul plan."""
+    from .nn import _sparse_conv3d
+    if dilation not in (1, (1, 1, 1)) or groups != 1:
+        raise NotImplementedError("sparse conv3d: dilation/groups != 1")
+    w = weight if isinstance(weight, Tensor) else Tensor(jnp.asarray(weight))
+    b = bias if (bias is None or isinstance(bias, Tensor)) else \
+        Tensor(jnp.asarray(bias))
+    return _sparse_conv3d(x, w, b, tuple(w.shape[:3]), stride, padding,
+                          subm=False)
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None, name=None):
+    from .nn import _sparse_conv3d
+    if dilation not in (1, (1, 1, 1)) or groups != 1:
+        raise NotImplementedError("sparse subm_conv3d: dilation/groups != 1")
+    w = weight if isinstance(weight, Tensor) else Tensor(jnp.asarray(weight))
+    b = bias if (bias is None or isinstance(bias, Tensor)) else \
+        Tensor(jnp.asarray(bias))
+    return _sparse_conv3d(x, w, b, tuple(w.shape[:3]), stride, padding,
+                          subm=True)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0,
+               data_format="NDHWC", name=None):
+    """Sparse max pooling over EXISTING points (reference semantics:
+    missing positions do not participate). The output pattern — which
+    pooling windows contain at least one point — is computed on the host
+    from the concrete indices; the max itself runs inside one tape op
+    (dense -inf scatter + reduce_window + gather), so gradients flow
+    back to the input values."""
+    import jax.lax as lax
+    if data_format != "NDHWC":
+        raise NotImplementedError("sparse max_pool3d supports NDHWC only")
+    k = (kernel_size,) * 3 if isinstance(kernel_size, int) else tuple(kernel_size)
+    s = k if stride is None else (
+        (stride,) * 3 if isinstance(stride, int) else tuple(stride))
+    p = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
+
+    b = x.value
+    idx = np.asarray(b.indices)                       # (nnz, 4) b,z,y,x
+    shape = x.shape                                   # (B, D, H, W, C)
+    out_sp = [(shape[1 + d] + 2 * p[d] - k[d]) // s[d] + 1
+              for d in range(3)]
+    # windows each point contributes to, per spatial dim
+    wins = set()
+    for row in idx:
+        ranges = []
+        for d in range(3):
+            z = int(row[1 + d])
+            lo = max(0, -(-(z + p[d] - k[d] + 1) // s[d]))
+            hi = min(out_sp[d] - 1, (z + p[d]) // s[d])
+            ranges.append(range(lo, hi + 1))
+        for wz in ranges[0]:
+            for wy in ranges[1]:
+                for wx in ranges[2]:
+                    wins.add((int(row[0]), wz, wy, wx))
+    out_coords = np.asarray(sorted(wins), np.int64)   # (n_out, 4)
+
+    scatter_idx = tuple(jnp.asarray(idx[:, i]) for i in range(4))
+    gather_idx = tuple(jnp.asarray(out_coords[:, i]) for i in range(4))
+
+    def f(vals):
+        window = (1,) + k + (1,)
+        strides = (1,) + s + (1,)
+        pads = [(0, 0)] + [(pi, pi) for pi in p] + [(0, 0)]
+        dense = jnp.full(tuple(shape), -jnp.inf, vals.dtype)
+        dense = dense.at[scatter_idx].set(vals)
+        pooled = lax.reduce_window(dense, -jnp.inf, lax.max, window,
+                                   strides, pads)
+        return pooled[gather_idx]                     # (n_out, C)
+
+    out_vals = apply(f, x.values(), _op_name="sparse_max_pool3d")
+    out_shape = (shape[0], *out_sp, shape[-1])
+    st = sparse_coo_tensor(jnp.asarray(out_coords.T), out_vals.value,
+                           out_shape)
+    st._values_tensor = out_vals
+    return st
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """Parity: sparse.nn.functional.attention — attention restricted to
+    a CSR sparsity pattern: out = softmax(mask(QK^T/sqrt(d))) V.
+    q/k/v: [B, H, S, D] dense; sparse_mask: SparseCsrTensor [B*H, S, S]
+    whose nonzero pattern marks allowed positions."""
+    q = query if isinstance(query, Tensor) else Tensor(jnp.asarray(query))
+    k = key if isinstance(key, Tensor) else Tensor(jnp.asarray(key))
+    v = value if isinstance(value, Tensor) else Tensor(jnp.asarray(value))
+    B, H, S, D = q.shape
+    mask_dense = (sparse_mask.to_dense().value != 0).reshape(B, H, S, S)
+
+    def f(qv, kv, vv, *extra):
+        s = jnp.einsum("bhqd,bhkd->bhqk", qv.astype(jnp.float32),
+                       kv.astype(jnp.float32)) / jnp.sqrt(float(D))
+        i = 0
+        m = mask_dense
+        if key_padding_mask is not None:
+            kp = extra[i]; i += 1
+            m = m & (kp[:, None, None, :] != 0)
+        if attn_mask is not None:
+            am = extra[i]; i += 1
+            m = m & (am[None, None] != 0)
+        s = jnp.where(m, s, -jnp.inf)
+        p = jnp.exp(s - jnp.max(jnp.where(m, s, -jnp.inf), -1,
+                                keepdims=True, initial=-jnp.inf))
+        p = jnp.where(m, p, 0.0)
+        z = jnp.sum(p, -1, keepdims=True)
+        p = p / jnp.maximum(z, 1e-38)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, vv.astype(jnp.float32)
+                          ).astype(qv.dtype)
+
+    extra = []
+    if key_padding_mask is not None:
+        extra.append(key_padding_mask)
+    if attn_mask is not None:
+        extra.append(attn_mask)
+    return apply(f, q, k, v, *extra, _op_name="sparse_attention")

@@ -191,3 +191,39 @@ def load(name: str, sources: Sequence[str], extra_cxx_flags=None,
     if verbose:
         print(f"[cpp_extension] {name} -> {lib}")
     return CppExtension(name, lib)
+
+
+def CUDAExtension(sources, *args, **kwargs):
+    """Parity: utils.cpp_extension.CUDAExtension — no CUDA toolchain in a
+    TPU build; .cu sources cannot compile here."""
+    raise NotImplementedError(
+        "CUDAExtension requires nvcc; this is a TPU build — write the op "
+        "as a jnp/pallas composition (framework.custom_op) or build a CPU "
+        "C++ op with CppExtension")
+
+
+def setup(name=None, ext_modules=None, **kwargs):
+    """Parity: utils.cpp_extension.setup — the setuptools ceremony
+    collapses onto `load()`. Accepts the ported patterns: an already-
+    loaded CppExtension, a {"sources": [...]} mapping, or anything with a
+    `.sources` attribute (the reference's Extension objects)."""
+    exts = ext_modules if isinstance(ext_modules, (list, tuple)) else \
+        ([ext_modules] if ext_modules is not None else [])
+    built = []
+    for i, ext in enumerate(exts):
+        if isinstance(ext, CppExtension):
+            built.append(ext)
+            continue
+        sources = (ext.get("sources") if isinstance(ext, dict)
+                   else getattr(ext, "sources", None))
+        if not sources:
+            raise TypeError(
+                "setup() expects CppExtension instances or objects with "
+                f"a 'sources' list, got {type(ext)}")
+        ext_name = (ext.get("name") if isinstance(ext, dict)
+                    else getattr(ext, "name", None)) or name or f"ext{i}"
+        built.append(load(ext_name, sources))
+    return built
+
+
+__all__ += ["CUDAExtension", "setup"]

@@ -46,6 +46,15 @@ _WORKER = textwrap.dedent("""
         np.full((3,), float(rank * 10), np.float32)), src=1)
     np.testing.assert_allclose(b.numpy(), 10.0)
 
+    # object broadcast: 3 fixed collectives carry pickled payloads
+    objs = [{"k": 41}, "hello", list(range(rank + 1))] if rank == 0 \
+        else [None, None, None]
+    dist.broadcast_object_list(objs, src=0)
+    assert objs[0] == {"k": 41} and objs[1] == "hello" and objs[2] == [0]
+    outs = []
+    dist.scatter_object_list(outs, [f"obj{r}" for r in range(2)], src=0)
+    assert outs == [f"obj{rank}"], outs
+
     # real cross-process barrier
     dist.barrier()
 

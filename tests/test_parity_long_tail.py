@@ -1,0 +1,278 @@
+"""Round-4 namespace long tail: distributed compat, sharding entry
+points, L-BFGS optimizers, sparse.nn additions, incubate.nn.functional
+fused ops, cost_model, device.cuda (references cited per module)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+
+@pytest.fixture(autouse=True)
+def fresh_mesh():
+    dist.set_mesh(None)
+    yield
+    dist.set_mesh(None)
+
+
+class TestDistributedCompat:
+    def test_object_collectives_single_process(self):
+        objs = [{"a": 1}, "hello", np.arange(3)]
+        dist.broadcast_object_list(objs, src=0)
+        assert objs[0] == {"a": 1} and objs[1] == "hello"
+        out = []
+        dist.scatter_object_list(out, [["r0"], ["r1"]], src=0)
+        assert out == [["r0"]]
+
+    def test_lifecycle_and_misc(self):
+        assert dist.is_available()
+        assert dist.get_backend() == "XLA"
+        assert dist.ParallelMode.PIPELINE_PARALLEL == 2
+        t = paddle.to_tensor(np.ones(2, np.float32))
+        assert dist.wait(t) is t
+        dist.init_mesh({"dp": 8})
+        dist.destroy_process_group()
+        from paddle_tpu.distributed.mesh import get_mesh
+        assert get_mesh(create_default=False) is None
+        with pytest.raises(NotImplementedError, match="ColumnParallel"):
+            dist.split(t, (2, 2), "linear")
+        with pytest.raises(NotImplementedError, match="parameter-server"):
+            dist.InMemoryDataset()
+
+    def test_group_sharded_parallel_sets_zero_stage(self):
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM
+        dist.init_mesh({"dp": 2, "sharding": 4})
+        model = GPTForCausalLM(GPTConfig(
+            vocab_size=64, hidden_size=32, num_layers=2, num_heads=2,
+            max_seq_len=16))
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        with pytest.raises(ValueError):
+            dist.sharding.group_sharded_parallel(model, opt, "bogus")
+        model, opt, _ = dist.sharding.group_sharded_parallel(
+            model, opt, "p_g_os")
+        step = dist.ParallelTrainStep(model, GPTForCausalLM.loss_fn, opt)
+        assert step.zero_stage == 3
+
+    def test_passes_and_stream_namespace(self):
+        pm = dist.passes.PassManager(
+            [dist.passes.new_pass("auto_parallel_recompute")])
+        mains, _ = pm.apply(["prog"])
+        assert mains == ["prog"] and pm.names == ["auto_parallel_recompute"]
+        assert dist.communication.stream.all_reduce is dist.all_reduce
+
+
+class TestOptimizerLongTail:
+    def test_lbfgs_optimizer(self):
+        from paddle_tpu.incubate.optimizer import LBFGS
+        p = paddle.create_parameter([4], "float32")
+        target = paddle.to_tensor(np.array([1., -2., 3., .5], np.float32))
+        opt = LBFGS(max_iter=30, parameters=[p], line_search_fn="armijo")
+
+        def closure():
+            opt.clear_grad()
+            loss = ((p - target) ** 2).sum()
+            loss.backward()
+            return loss
+
+        opt.step(closure)
+        np.testing.assert_allclose(p.numpy(), target.numpy(), atol=1e-3)
+
+    def test_functional_minimizers(self):
+        from paddle_tpu.incubate.optimizer.functional import (minimize_bfgs,
+                                                              minimize_lbfgs)
+        A = np.array([[3., 1.], [1., 2.]], np.float32)
+        b = np.array([1., -2.], np.float32)
+
+        def quad(x):
+            return 0.5 * (x @ paddle.to_tensor(A) @ x) - \
+                (x * paddle.to_tensor(b)).sum()
+
+        want = np.linalg.solve(A, b)
+        for fn in (minimize_bfgs, minimize_lbfgs):
+            ok, nfev, x, fx, g = fn(quad, np.zeros(2, np.float32))
+            assert bool(ok.numpy())
+            np.testing.assert_allclose(x.numpy(), want, atol=1e-4)
+
+        # Rosenbrock in f32: the Armijo BFGS must still solve it
+        def rosen(x):
+            return (1 - x[0]) ** 2 + 100 * (x[1] - x[0] ** 2) ** 2
+        ok, _, x, fx, _ = minimize_bfgs(
+            rosen, np.array([-1.2, 1.0], np.float32), max_iters=200)
+        assert float(fx.numpy()) < 1e-4
+
+
+class TestSparseAdditions:
+    def test_softmax_per_row_over_nnz(self):
+        import paddle_tpu.sparse as sp
+        import paddle_tpu.sparse.nn as snn
+        idx = np.array([[0, 0, 1, 1, 1], [0, 2, 0, 1, 3]], np.int64)
+        vals = np.array([1.0, 2.0, 0.5, -1.0, 3.0], np.float32)
+        x = sp.sparse_coo_tensor(idx, vals, (2, 4))
+        dense = snn.functional.softmax(x).to_dense().numpy()
+        e = np.exp(np.array([1.0, 2.0]) - 2.0)
+        np.testing.assert_allclose(dense[0, [0, 2]], e / e.sum(), rtol=1e-5)
+        assert dense[0, 1] == 0
+
+    def test_activations_and_pool(self):
+        import paddle_tpu.sparse as sp
+        import paddle_tpu.sparse.nn as snn
+        idx = np.array([[0, 1], [0, 1]], np.int64)
+        vals = np.array([-2.0, 8.0], np.float32)
+        x = sp.sparse_coo_tensor(idx, vals, (2, 2))
+        np.testing.assert_allclose(
+            snn.LeakyReLU(0.1)(x).values().numpy(), [-0.2, 8.0])
+        np.testing.assert_allclose(
+            snn.ReLU6()(x).values().numpy(), [0.0, 6.0])
+        coords = np.array([[0, 0, 0, 0], [0, 1, 1, 1]], np.int64).T
+        vol = sp.sparse_coo_tensor(
+            coords, np.array([[1.0], [5.0]], np.float32), (1, 4, 4, 4, 1))
+        pd = snn.MaxPool3D(2, 2)(vol).to_dense().numpy()
+        assert pd.shape == (1, 2, 2, 2, 1) and pd[0, 0, 0, 0, 0] == 5.0
+
+    def test_masked_attention(self):
+        import paddle_tpu.sparse as sp
+        import paddle_tpu.sparse.nn as snn
+        B, H, S, D = 1, 1, 4, 8
+        rng = np.random.RandomState(0)
+        q = paddle.to_tensor(rng.randn(B, H, S, D).astype(np.float32))
+        mask_dense = np.tril(np.ones((S, S), np.float32))
+        mask = sp.to_sparse_coo(paddle.to_tensor(mask_dense[None]),
+                                sparse_dim=3)
+        out = snn.functional.attention(q, q, q, mask)
+        s = (q.numpy()[0, 0] @ q.numpy()[0, 0].T) / np.sqrt(D)
+        s = np.where(mask_dense > 0, s, -np.inf)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        np.testing.assert_allclose(out.numpy()[0, 0], p @ q.numpy()[0, 0],
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_sync_batchnorm_convert(self):
+        import paddle_tpu.sparse.nn as snn
+        net = paddle.nn.Sequential()
+        net.add_sublayer("bn", snn.BatchNorm(4))
+        snn.SyncBatchNorm.convert_sync_batchnorm(net)
+        assert type(net._sub_layers["bn"]).__name__ == "SyncBatchNorm"
+
+
+class TestFusedFunctional:
+    def test_fused_mha_matches_manual(self):
+        import paddle_tpu.incubate.nn.functional as IF
+        rng = np.random.RandomState(0)
+        B, S, E, nh = 2, 4, 16, 2
+        hd = E // nh
+        x = paddle.to_tensor(rng.randn(B, S, E).astype(np.float32))
+        qkvw = paddle.to_tensor(
+            rng.randn(3, nh, hd, E).astype(np.float32) * 0.1)
+        lw = paddle.to_tensor(rng.randn(E, E).astype(np.float32) * 0.1)
+        ones = paddle.to_tensor(np.ones(E, np.float32))
+        zeros = paddle.to_tensor(np.zeros(E, np.float32))
+        out = IF.fused_multi_head_attention(
+            x, qkvw, lw, dropout_rate=0.0, attn_dropout_rate=0.0,
+            ln_scale=ones, ln_bias=zeros, training=False)
+        xe = x.numpy()
+        qkv = np.einsum("bse,tnde->tbnsd", xe, qkvw.numpy())
+        q, k, v = qkv
+        s = np.einsum("bnqd,bnkd->bnqk", q, k) / np.sqrt(hd)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ctx = np.einsum("bnqk,bnkd->bqnd", p, v).reshape(B, S, E)
+        ref = xe + ctx @ lw.numpy()
+        mu = ref.mean(-1, keepdims=True)
+        ref = (ref - mu) / np.sqrt(ref.var(-1, keepdims=True) + 1e-5)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_fused_ffn_grads_and_ec_moe(self):
+        import paddle_tpu.incubate.nn.functional as IF
+        rng = np.random.RandomState(1)
+        B, S, E = 2, 3, 8
+        x = paddle.to_tensor(rng.randn(B, S, E).astype(np.float32))
+        ones = paddle.to_tensor(np.ones(E, np.float32))
+        zeros = paddle.to_tensor(np.zeros(E, np.float32))
+        w1 = paddle.to_tensor(rng.randn(E, 16).astype(np.float32) * 0.1,
+                              stop_gradient=False)
+        w2 = paddle.to_tensor(rng.randn(16, E).astype(np.float32) * 0.1,
+                              stop_gradient=False)
+        y = IF.fused_feedforward(x, w1, w2, ln2_scale=ones, ln2_bias=zeros,
+                                 dropout1_rate=0.0, dropout2_rate=0.0,
+                                 training=False)
+        y.sum().backward()
+        assert w1.grad is not None and np.isfinite(w1.grad.numpy()).all()
+
+        e, dff = 3, 4
+        gate = paddle.to_tensor(rng.randn(B, S, e).astype(np.float32))
+        w0 = paddle.to_tensor(rng.randn(e, E, dff).astype(np.float32) * .1)
+        b0 = paddle.to_tensor(np.zeros((e, dff), np.float32))
+        w1m = paddle.to_tensor(rng.randn(e, dff, E).astype(np.float32) * .1)
+        b1m = paddle.to_tensor(np.zeros((e, E), np.float32))
+        moe = IF.fused_ec_moe(x, gate, w0, b0, w1m, b1m, "relu")
+        pg = np.exp(gate.numpy() - gate.numpy().max(-1, keepdims=True))
+        pg /= pg.sum(-1, keepdims=True)
+        h = np.maximum(np.einsum("bsd,edf->besf", x.numpy(), w0.numpy()), 0)
+        ym = np.einsum("besf,efd->besd", h, w1m.numpy())
+        np.testing.assert_allclose(
+            moe.numpy(), np.einsum("besd,bse->bsd", ym, pg),
+            rtol=1e-4, atol=1e-5)
+
+
+class TestMiscSurfaces:
+    def test_cost_model(self):
+        import jax.numpy as jnp
+        cm = paddle.cost_model.CostModel()
+        cost = cm.profile_measure(lambda x: (x @ x.T).sum(),
+                                  (jnp.ones((32, 32), jnp.float32),))
+        assert cost["flops"] > 0 and cost["measured_seconds"] > 0
+
+    def test_device_cuda_surface(self):
+        import paddle_tpu.device.cuda as cuda
+        assert cuda.device_count() >= 1
+        props = cuda.get_device_properties()
+        assert props.name and cuda.get_device_capability() == (0, 0)
+        cuda.synchronize()
+
+    def test_inference_enums_and_pool(self, tmp_path):
+        from paddle_tpu.inference import (Config, DataType, PredictorPool,
+                                          get_num_bytes_of_data_type,
+                                          get_version)
+        assert get_num_bytes_of_data_type(DataType.BFLOAT16) == 2
+        assert "paddle_tpu" in get_version()
+        import paddle_tpu.nn as nn
+        prefix = str(tmp_path / "m")
+        paddle.jit.save(nn.Linear(4, 2), prefix,
+                        input_spec=[paddle.jit.InputSpec([1, 4])])
+        pool = PredictorPool(Config(prefix), 2)
+        assert len(pool) == 2
+        [out] = pool.retrive(1).run([np.ones((1, 4), np.float32)])
+        assert out.shape == (1, 2)
+
+    def test_quanter_decorator_and_stub(self):
+        from paddle_tpu.quantization import quanter
+        from paddle_tpu.quantization.base import BaseQuanter
+        import paddle_tpu.quantization.factory as fac
+
+        @quanter("TestQF")
+        class _TQ(BaseQuanter):
+            def forward(self, x):
+                return x
+
+            def scales(self):
+                return 1.0
+
+            def zero_points(self):
+                return 0
+
+        assert hasattr(fac, "TestQF")
+        import paddle_tpu.nn.quant as q
+        t = paddle.to_tensor(np.float32(3))
+        assert float(q.Stub()(t).numpy()) == 3.0
+
+    def test_incubate_autograd(self):
+        import paddle_tpu.incubate.autograd as ia
+        x = paddle.to_tensor(np.array([1., 2.], np.float32))
+        J = ia.Jacobian(lambda v: (v * v).sum(), x)
+        np.testing.assert_allclose(np.asarray(J[:].numpy()), [2., 4.])
+        ia.enable_prim()
+        assert ia.prim_enabled()
+        ia.disable_prim()
+        with pytest.raises(NotImplementedError, match="jvp"):
+            ia.forward_grad(x, x)
