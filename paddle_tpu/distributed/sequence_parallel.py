@@ -87,7 +87,10 @@ def _ring_body(q, k, v, *, sp: int, scale: float, causal: bool, sl: int):
     def step(carry, i):
         k_blk, v_blk, acc, m, l = carry
         # after i forward rotations, this rank holds the kv block that
-        # started on rank (idx - i) mod sp
+        # started on rank (idx - i) mod sp. Rotation issued FIRST so the
+        # ICI transfer overlaps this block's einsum (latency hiding).
+        k_nxt = lax.ppermute(k_blk, "sp", perm)
+        v_nxt = lax.ppermute(v_blk, "sp", perm)
         src = (idx - i) % sp
         s = jnp.einsum("bqhd,bkhd->bhqk", q32,
                        k_blk.astype(jnp.float32)) * scale
@@ -104,9 +107,7 @@ def _ring_body(q, k, v, *, sp: int, scale: float, causal: bool, sl: int):
         l = l * corr + p.sum(axis=-1)
         acc = acc * corr.transpose(0, 2, 1)[..., None] + jnp.einsum(
             "bhqk,bkhd->bqhd", p, v_blk.astype(jnp.float32))
-        k_blk = lax.ppermute(k_blk, "sp", perm)
-        v_blk = lax.ppermute(v_blk, "sp", perm)
-        return (k_blk, v_blk, acc, m_new, l), None
+        return (k_nxt, v_nxt, acc, m_new, l), None
 
     (_, _, acc, m, l), _ = lax.scan(step, (k, v, acc0, m0, l0),
                                     jnp.arange(sp))
@@ -133,14 +134,18 @@ def _ring_fused_fwd_impl(q, k, v, sp, sl, scale, causal, bq, bk, interpret):
     def step(carry, i):
         k_blk, v_blk, acc, lse = carry
         src = (idx - i) % sp
+        # issue the NEXT block's rotation before this block's compute:
+        # the permuted values are needed only next iteration, so XLA's
+        # latency-hiding scheduler overlaps the ICI transfer with the
+        # Pallas kernel (the ring-attention comm/compute overlap)
+        k_nxt = lax.ppermute(k_blk, "sp", perm)
+        v_nxt = lax.ppermute(v_blk, "sp", perm)
         o_i, l_i = _fb.flash_block_attention(
             q, k_blk, v_blk, q_off, (src * sl).astype(jnp.int32),
             causal, scale, bq, bk, interpret)
         acc, lse = _fb.merge_lse_blocks(acc, lse, o_i.astype(jnp.float32),
                                         l_i)
-        k_blk = lax.ppermute(k_blk, "sp", perm)
-        v_blk = lax.ppermute(v_blk, "sp", perm)
-        return (k_blk, v_blk, acc, lse), None
+        return (k_nxt, v_nxt, acc, lse), None
 
     acc0 = jnp.zeros((B, H, sl, D), jnp.float32)
     lse0 = jnp.full((B, H, sl), -jnp.inf, jnp.float32)
@@ -173,18 +178,19 @@ def _ring_fused_bwd(sp, sl, scale, causal, bq, bk, interpret, res, do):
     def step(carry, i):
         k_blk, v_blk, dk_blk, dv_blk, dq = carry
         src = (idx - i) % sp
+        # k/v rotation issued before the block backward so the transfer
+        # rides under the compute; the dk/dv accumulators rotate AFTER
+        # accumulation (they carry this step's contribution)
+        k_nxt = lax.ppermute(k_blk, "sp", perm)
+        v_nxt = lax.ppermute(v_blk, "sp", perm)
         dq_i, dk_i, dv_i = _fb.flash_block_attention_bwd(
             q, k_blk, v_blk, q_off, (src * sl).astype(jnp.int32),
             out, lse, do, causal=causal, sm_scale=scale, block_q=bq,
             block_k=bk, interpret=interpret, delta=delta)
         dq = dq + dq_i.astype(jnp.float32)
-        dk_blk = dk_blk + dk_i.astype(jnp.float32)
-        dv_blk = dv_blk + dv_i.astype(jnp.float32)
-        k_blk = lax.ppermute(k_blk, "sp", perm)
-        v_blk = lax.ppermute(v_blk, "sp", perm)
-        dk_blk = lax.ppermute(dk_blk, "sp", perm)
-        dv_blk = lax.ppermute(dv_blk, "sp", perm)
-        return (k_blk, v_blk, dk_blk, dv_blk, dq), None
+        dk_blk = lax.ppermute(dk_blk + dk_i.astype(jnp.float32), "sp", perm)
+        dv_blk = lax.ppermute(dv_blk + dv_i.astype(jnp.float32), "sp", perm)
+        return (k_nxt, v_nxt, dk_blk, dv_blk, dq), None
 
     zeros = jnp.zeros(k.shape, jnp.float32)
     dq0 = jnp.zeros(q.shape, jnp.float32)
