@@ -302,8 +302,14 @@ def save(layer, path, input_spec=None, platforms=None, **config):
     the trace may contain Mosaic kernels, so it stays TPU-only.
     """
     from ..nn.layer_base import Layer
-    if not isinstance(layer, Layer):
-        raise TypeError("jit.save expects a Layer")
+    if isinstance(layer, StaticFunction):
+        # @to_static-decorated: unwrap to the Layer or plain function,
+        # inheriting the decoration-time input_spec when save's is None
+        if input_spec is None:
+            input_spec = layer._input_spec
+        layer = layer._layer if layer._is_layer else layer._fn
+    if not isinstance(layer, Layer) and not callable(layer):
+        raise TypeError("jit.save expects a Layer or a function")
     if input_spec is None:
         raise ValueError("jit.save requires input_spec (shape/dtype of inputs)")
     from ..framework.dtype import convert_dtype
@@ -335,16 +341,29 @@ def save(layer, path, input_spec=None, platforms=None, **config):
         else:
             examples.append(jnp.asarray(spec))
 
-    params, buffers = raw_state(layer)
+    is_layer = isinstance(layer, Layer)
+    if is_layer:
+        params, buffers = raw_state(layer)
+        was_training = layer.training
+        layer.eval()
+    else:
+        # plain function: no state; the program closes over nothing
+        params, buffers, was_training = {}, {}, False
     pnames, bnames = list(params), list(buffers)
-    was_training = layer.training
-    layer.eval()
     try:
-        def infer(params_and_bufs, *args):
-            p = {n: params_and_bufs[n] for n in pnames}
-            b = {n: params_and_bufs[n] for n in bnames}
-            out, _ = functional_call(layer, p, b, *args, training=False)
-            return out
+        if is_layer:
+            def infer(params_and_bufs, *args):
+                p = {n: params_and_bufs[n] for n in pnames}
+                b = {n: params_and_bufs[n] for n in bnames}
+                out, _ = functional_call(layer, p, b, *args,
+                                         training=False)
+                return out
+        else:
+            def infer(params_and_bufs, *args):
+                from .functional import _unwrap
+                with _tape.no_grad():
+                    out = layer(*[_wrap(a) for a in args])
+                return _unwrap(out)
 
         merged = {**params, **buffers}
         if isinstance(platforms, str):

@@ -214,3 +214,32 @@ def test_jit_save_is_platform_portable(tmp_path):
     with open(path + ".pdmodel", "rb") as f:
         exported = jax.export.deserialize(f.read())
     assert set(exported.platforms) == {"cpu", "tpu"}
+
+
+def test_jit_save_plain_and_decorated_function(tmp_path):
+    """jit.save accepts plain functions and @to_static functions, like
+    the reference (python/paddle/jit/api.py save of StaticFunction)."""
+    from paddle_tpu.static import InputSpec
+
+    def f(x, y):
+        return paddle.tanh(x) + y * 2
+
+    prefix = str(tmp_path / "fn")
+    paddle.jit.save(f, prefix, input_spec=[InputSpec([2, 3], "float32"),
+                                           InputSpec([2, 3], "float32")])
+    loaded = paddle.jit.load(prefix)
+    a = np.random.RandomState(0).randn(2, 3).astype(np.float32)
+    b = np.random.RandomState(1).randn(2, 3).astype(np.float32)
+    np.testing.assert_allclose(
+        loaded(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+        np.tanh(a) + b * 2, rtol=1e-6)
+
+    @to_static
+    def g(x):
+        return x * x + 1
+
+    prefix2 = str(tmp_path / "fn2")
+    paddle.jit.save(g, prefix2, input_spec=[InputSpec([4], "float32")])
+    out = paddle.jit.load(prefix2)(
+        paddle.to_tensor(np.ones(4, np.float32)))
+    np.testing.assert_allclose(out.numpy(), 2.0)
