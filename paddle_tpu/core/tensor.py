@@ -210,6 +210,19 @@ class Tensor:
         self.stop_gradient = new.stop_gradient
         return self
 
+    def _inplace_(self, fn, *args, **kwargs):
+        """Run `fn` on a SNAPSHOT of this tensor, then rebind the result
+        in place. The snapshot matters for autograd: `x._replace_(fn(x))`
+        would make the new node's recorded input be the replaced tensor
+        itself — a self-referential edge that silently drops upstream
+        gradients. The snapshot preserves the pre-update node, so
+        backward chains inplace ops exactly like their out-of-place
+        forms (reference inplace-op autograd semantics)."""
+        snap = Tensor(self.value, stop_gradient=self.stop_gradient)
+        snap._node = self._node
+        snap._out_index = self._out_index
+        return self._replace_(fn(snap, *args, **kwargs))
+
     def set_value(self, v):
         if isinstance(v, Tensor):
             v = v.value

@@ -25,7 +25,7 @@ def relu(x, name=None):
 
 
 def relu_(x, name=None):
-    return x._replace_(relu(x))
+    return x._inplace_(relu)
 
 
 def relu6(x, name=None):

@@ -21,6 +21,8 @@ from .math import *  # noqa: F401,F403
 from .random import *  # noqa: F401,F403
 from .search import *  # noqa: F401,F403
 from .stat import *  # noqa: F401,F403
+from . import parity_extras  # noqa: F401
+from .parity_extras import *  # noqa: F401,F403  (top-level closure)
 
 
 def _patch_methods():
@@ -28,11 +30,22 @@ def _patch_methods():
     import types
 
     modules = [attribute, creation, einsum, linalg, logic, manipulation,
-               math, random, search, stat]
+               math, random, search, stat, parity_extras]
     skip = {"to_tensor", "zeros", "ones", "full", "arange", "linspace",
             "logspace", "eye", "empty", "meshgrid", "rand", "randn",
             "randint", "uniform", "normal", "randperm", "assign", "einsum",
-            "shape", "broadcast_tensors", "tril_indices", "triu_indices"}
+            "shape", "tril_indices", "triu_indices",
+            # parity_extras non-tensor entries stay module-level only
+            "batch", "check_shape", "disable_signal_handler",
+            "set_printoptions", "flops", "finfo", "iinfo", "LazyGuard",
+            "CPUPlace", "CUDAPlace", "CUDAPinnedPlace", "NPUPlace",
+            # bound below as STATIC methods (their first arg is not a
+            # tensor; instance binding would eat it as self)
+            "create_parameter", "create_tensor", "broadcast_shape",
+            "broadcast_tensors"}
+    # reference binds these as Tensor methods too (tensor_method_func)
+    extra_method_names = {"broadcast_tensors", "create_parameter",
+                          "create_tensor", "broadcast_shape"}
     for mod in modules:
         for name in getattr(mod, "__all__", []):
             if name in skip or hasattr(Tensor, name):
@@ -40,6 +53,13 @@ def _patch_methods():
             fn = getattr(mod, name)
             if isinstance(fn, types.FunctionType):
                 setattr(Tensor, name, fn)
+
+    for name in extra_method_names:
+        for mod in (manipulation, creation, parity_extras):
+            fn = getattr(mod, name, None)
+            if fn is not None:
+                setattr(Tensor, name, staticmethod(fn))
+                break
 
     # Method-only conveniences
     Tensor.add_n = staticmethod(math.add_n)
@@ -85,5 +105,3 @@ def _patch_methods():
 
 _patch_methods()
 del _patch_methods
-
-from .parity_extras import *  # noqa: F401,F403,E402  (top-level closure)

@@ -41,7 +41,7 @@ def reshape(x, shape, name=None):
 
 
 def reshape_(x, shape, name=None):
-    return x._replace_(reshape(x, shape))
+    return x._inplace_(reshape, shape)
 
 
 def view(x, shape_or_dtype, name=None):
@@ -145,7 +145,7 @@ def squeeze(x, axis=None, name=None):
 
 
 def squeeze_(x, axis=None, name=None):
-    return x._replace_(squeeze(x, axis))
+    return x._inplace_(squeeze, axis)
 
 
 def unsqueeze(x, axis, name=None):
@@ -154,7 +154,7 @@ def unsqueeze(x, axis, name=None):
 
 
 def unsqueeze_(x, axis, name=None):
-    return x._replace_(unsqueeze(x, axis))
+    return x._inplace_(unsqueeze, axis)
 
 
 def flatten(x, start_axis=0, stop_axis=-1, name=None):

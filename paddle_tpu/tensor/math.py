@@ -294,8 +294,8 @@ def trace(x, offset=0, axis1=0, axis2=1, name=None):
 # ---- in-place variants (Tensor method parity: add_, scale_, ...) ----
 def _inplace(fn):
     def op(x, *args, **kwargs):
-        out = fn(x, *args, **kwargs)
-        return x._replace_(out)
+        # snapshot semantics: see Tensor._inplace_ — grads must chain
+        return x._inplace_(fn, *args, **kwargs)
     return op
 
 

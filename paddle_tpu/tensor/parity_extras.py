@@ -143,17 +143,15 @@ def increment(x, value=1.0, name=None):
 
 
 def tanh_(x, name=None):
-    """Parity: inplace tanh."""
-    x.value = jnp.tanh(x.value)
-    return x
+    """Parity: inplace tanh (grad-chaining snapshot semantics)."""
+    from .math import tanh as _tanh
+    return x._inplace_(_tanh)
 
 
 def scatter_(x, index, updates, overwrite=True, name=None):
     """Parity: inplace scatter (tensor/manipulation.py scatter_)."""
     from .manipulation import scatter
-    out = scatter(x, index, updates, overwrite)
-    x.value = out.value
-    return x
+    return x._inplace_(scatter, index, updates, overwrite)
 
 
 def scatter_nd(index, updates, shape, name=None):
@@ -382,3 +380,95 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
             print(f"{k:40s} {v:,}")
         print(f"Total FLOPs: {total:,}")
     return total
+
+
+# ---------------------------------------------------------------------------
+# inplace-variant long tail (reference: tensor_method_func entries ending
+# in '_', eager_math_op_patch.cc) — same convention as tanh_/scatter_
+# above: compute through the functional op, write back into .value.
+# ---------------------------------------------------------------------------
+
+def _make_inplace(fn, name):
+    def op(x, *args, **kwargs):
+        return x._inplace_(fn, *args, **kwargs)
+    op.__name__ = name
+    op.__doc__ = f"Parity: inplace {name} (writes back into x)."
+    return op
+
+
+def sigmoid(x, name=None):
+    """Parity: paddle.sigmoid — delegates to the numerically stable
+    nn.functional sigmoid (jax.nn.sigmoid; the naive 1/(1+exp(-v))
+    gives nan grads at large negative inputs)."""
+    from ..nn.functional import sigmoid as _fs
+    return _fs(x)
+
+
+def create_tensor(dtype="float32", name=None, persistable=False):
+    """Parity: paddle.create_tensor — an empty typed tensor."""
+    from ..framework.dtype import convert_dtype
+    t = Tensor(jnp.zeros((0,), convert_dtype(dtype)))
+    t.name = name
+    t.persistable = persistable
+    return t
+
+
+def lu_unpack(lu_data, pivots, unpack_ludata=True, unpack_pivots=True,
+              name=None):
+    """Parity: tensor/linalg.py lu_unpack — split packed LU into
+    (P, L, U); pivots are the 1-based row-swap vector paddle.lu returns."""
+    def f(lu_v, piv):
+        import jax as _jax
+        m, n = lu_v.shape[-2], lu_v.shape[-1]
+        k = min(m, n)
+        batch = lu_v.shape[:-2]
+        L = jnp.tril(lu_v[..., :, :k], -1) + jnp.eye(m, k, dtype=lu_v.dtype)
+        U = jnp.triu(lu_v[..., :k, :])
+        # 1-based swap sequence -> permutation vector e (batched): apply
+        # e[i] <-> e[piv[i]-1] in order, then P = one_hot(e).T so that
+        # A = P @ L @ U (verified against scipy's convention)
+        ar = jnp.arange(m)
+        e = jnp.broadcast_to(ar, batch + (m,))
+        for i in range(piv.shape[-1]):
+            j = piv[..., i] - 1                         # [batch]
+            ei = e[..., i]
+            ej = jnp.take_along_axis(
+                e, j[..., None].astype(jnp.int32), -1)[..., 0]
+            e = jnp.where(ar == i, ej[..., None], e)
+            e = jnp.where(ar == j[..., None], ei[..., None], e)
+        P = jnp.swapaxes(_jax.nn.one_hot(e, m, dtype=lu_v.dtype), -1, -2)
+        return P, L, U
+
+    P, L, U = apply(f, lu_data, pivots, _op_name="lu_unpack")
+    return (P if unpack_pivots else None,
+            L if unpack_ludata else None,
+            U if unpack_ludata else None)
+
+
+def _bind_inplace_tail():
+    from . import manipulation as _m
+    from . import math as _math
+    global ceil_, exp_, floor_, sqrt_, rsqrt_, round_, reciprocal_
+    global sigmoid_, erfinv_, lerp_, flatten_, put_along_axis_
+    global remainder_
+    ceil_ = _make_inplace(_math.ceil, "ceil_")
+    exp_ = _make_inplace(_math.exp, "exp_")
+    floor_ = _make_inplace(_math.floor, "floor_")
+    sqrt_ = _make_inplace(_math.sqrt, "sqrt_")
+    rsqrt_ = _make_inplace(_math.rsqrt, "rsqrt_")
+    round_ = _make_inplace(_math.round, "round_")
+    reciprocal_ = _make_inplace(_math.reciprocal, "reciprocal_")
+    sigmoid_ = _make_inplace(sigmoid, "sigmoid_")
+    erfinv_ = _make_inplace(_math.erfinv, "erfinv_")
+    lerp_ = _make_inplace(_math.lerp, "lerp_")
+    flatten_ = _make_inplace(_m.flatten, "flatten_")
+    put_along_axis_ = _make_inplace(_m.put_along_axis, "put_along_axis_")
+    remainder_ = _make_inplace(_math.remainder, "remainder_")
+
+
+_bind_inplace_tail()
+
+__all__ += ["sigmoid", "create_tensor", "lu_unpack",
+            "ceil_", "exp_", "floor_", "sqrt_", "rsqrt_", "round_",
+            "reciprocal_", "sigmoid_", "erfinv_", "lerp_", "flatten_",
+            "put_along_axis_", "remainder_"]

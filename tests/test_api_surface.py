@@ -182,3 +182,30 @@ def test_every_reference_namespace_covered():
         if missing:
             gaps.append((ns, missing))
     assert not gaps, f"namespace gaps vs reference: {gaps}"
+
+
+@pytest.mark.skipif(not os.path.exists(_REF_INIT),
+                    reason="reference tree not mounted")
+def test_tensor_method_surface_covered():
+    """Every name in the reference's tensor_method_func (the methods the
+    eager math-op patch binds onto Tensor) must exist on our Tensor."""
+    src = open("/root/reference/python/paddle/tensor/__init__.py").read()
+    m = re.search(r"tensor_method_func = \[(.*?)\]", src, re.S)
+    ref = set(re.findall(r"'([^']+)'", m.group(1)))
+    t = paddle.to_tensor([1.0])
+    missing = sorted(ref - set(dir(t)))
+    assert not missing, f"Tensor methods missing: {missing}"
+
+
+def test_inplace_tail_and_lu_unpack():
+    x = paddle.to_tensor(np.array([4.0, 9.0], np.float32))
+    assert x.sqrt_() is x
+    np.testing.assert_allclose(x.numpy(), [2.0, 3.0])
+    y = paddle.to_tensor(np.array([[0.25, 0.5]], np.float32))
+    y.reciprocal_()
+    np.testing.assert_allclose(y.numpy(), [[4.0, 2.0]])
+    A = np.random.RandomState(0).randn(5, 5).astype(np.float32)
+    lu, piv = paddle.linalg.lu(paddle.to_tensor(A))
+    P, L, U = paddle.lu_unpack(lu, piv)
+    np.testing.assert_allclose(P.numpy() @ L.numpy() @ U.numpy(), A,
+                               rtol=1e-4, atol=1e-5)

@@ -152,3 +152,23 @@ def test_higher_path_through_graph():
     loss = (a * b).sum()
     loss.backward()
     np.testing.assert_allclose(x.grad.numpy(), [12.0])
+
+
+def test_inplace_ops_chain_gradients():
+    """Inplace variants must keep the autograd chain: x._replace_(f(x))
+    was a self-referential edge that silently dropped upstream grads
+    (round-4 fix: snapshot semantics in Tensor._inplace_)."""
+    x = paddle.to_tensor(np.array([4.0], np.float32), stop_gradient=False)
+    y = x * 3.0
+    y.sqrt_()                      # y = sqrt(3x)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(),
+                               3.0 / (2 * np.sqrt(12.0)), rtol=1e-6)
+
+    z = paddle.to_tensor(np.array([-1.0, 2.0], np.float32),
+                         stop_gradient=False)
+    h = z * 2.0
+    import paddle_tpu.nn.functional as F
+    F.relu_(h)
+    h.sum().backward()
+    np.testing.assert_allclose(z.grad.numpy(), [0.0, 2.0])
