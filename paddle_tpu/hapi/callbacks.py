@@ -7,7 +7,7 @@ import time
 
 __all__ = ["Callback", "ProgBarLogger", "EarlyStopping", "LRScheduler",
            "ModelCheckpoint", "ReduceLROnPlateau", "VisualDL",
-           "config_callbacks"]
+           "config_callbacks", "WandbCallback"]
 
 
 class Callback:
@@ -304,3 +304,74 @@ class VisualDL(Callback):
 
     def on_eval_end(self, logs=None):
         self._write("eval", logs)
+
+
+class WandbCallback(Callback):
+    """Parity: hapi callbacks.WandbCallback (reference callbacks.py:999)
+    — logs metrics to Weights & Biases. Reference fidelity: the run is
+    created at construction (reusing a live wandb.run with a warning),
+    only local rank 0 writes, scalar metrics are logged without a step=
+    kwarg (wandb's own step advances monotonically), train/eval series
+    are namespaced separately with list values unwrapped. The wandb
+    client is not bundled in this image; constructing without it raises
+    with guidance, like the reference."""
+
+    def __init__(self, project=None, entity=None, name=None, dir=None,
+                 mode=None, job_type=None, **kwargs):
+        super().__init__()
+        try:
+            import wandb
+        except ImportError as e:
+            raise ImportError(
+                "WandbCallback requires the `wandb` package, which is not "
+                "installed in this environment; use local logging "
+                "(ProgBarLogger) or install wandb") from e
+        self._run = None
+        if not self._is_write():
+            return
+        if wandb.run is not None:
+            import warnings
+            warnings.warn("wandb run already in progress; reusing it")
+            self._run = wandb.run
+        else:
+            kw = dict(project=project, entity=entity, name=name, dir=dir,
+                      mode=mode, job_type=job_type, **kwargs)
+            self._run = wandb.init(**{k: v for k, v in kw.items()
+                                      if v is not None})
+
+    @staticmethod
+    def _is_write():
+        from ..distributed.env import get_rank
+        return get_rank() == 0
+
+    @staticmethod
+    def _scalars(logs, prefix):
+        out = {}
+        for k, v in (logs or {}).items():
+            if isinstance(v, (list, tuple)):
+                v = v[0] if v else None
+            if isinstance(v, (int, float)):
+                out[f"{prefix}/{k}"] = v
+        return out
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self._run is None:
+            return
+        import wandb
+        train = {k: v for k, v in self._scalars(logs, "train").items()
+                 if not k.startswith("train/eval_")}
+        if train:
+            wandb.log({**train, "epoch": epoch})
+
+    def on_eval_end(self, logs=None):
+        if self._run is None:
+            return
+        import wandb
+        ev = self._scalars(logs, "eval")
+        if ev:
+            wandb.log(ev)
+
+    def on_train_end(self, logs=None):
+        if self._run is not None:
+            self._run.finish()
+            self._run = None

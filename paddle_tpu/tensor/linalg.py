@@ -16,8 +16,8 @@ __all__ = [
     "matrix_power", "qr", "svd", "pinv", "solve", "triangular_solve",
     "cholesky_solve", "eig", "eigh", "eigvals", "eigvalsh", "det", "slogdet",
     "inverse", "matrix_rank", "multi_dot", "cond", "cov", "corrcoef", "lstsq",
-    "lu", "householder_product", "matrix_exp", "vecdot", "vector_norm",
-    "matrix_norm",
+    "lu", "lu_unpack", "householder_product", "matrix_exp", "vecdot",
+    "vector_norm", "matrix_norm", "inv",
 ]
 
 
@@ -231,3 +231,14 @@ def householder_product(x, tau, name=None):
             q = apply_one(i, q)
         return q[..., :, :n]
     return apply(f, x, tau, _op_name="householder_product")
+
+
+inv = inverse  # paddle.linalg.inv alias (reference linalg.py __all__)
+
+
+def lu_unpack(lu_data, pivots, unpack_ludata=True, unpack_pivots=True,
+              name=None):
+    """Parity: paddle.linalg.lu_unpack (re-export of the tensor-level
+    implementation; supports batched factorizations)."""
+    from .parity_extras import lu_unpack as _lu
+    return _lu(lu_data, pivots, unpack_ludata, unpack_pivots, name)
