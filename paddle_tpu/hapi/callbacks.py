@@ -308,13 +308,17 @@ class VisualDL(Callback):
 
 class WandbCallback(Callback):
     """Parity: hapi callbacks.WandbCallback (reference callbacks.py:999)
-    — logs metrics to Weights & Biases. Reference fidelity: the run is
-    created at construction (reusing a live wandb.run with a warning),
-    only local rank 0 writes, scalar metrics are logged without a step=
-    kwarg (wandb's own step advances monotonically), train/eval series
-    are namespaced separately with list values unwrapped. The wandb
-    client is not bundled in this image; constructing without it raises
-    with guidance, like the reference."""
+    — logs metrics to Weights & Biases. Reference fidelity: run created
+    at construction (reusing a live wandb.run with a warning), writes
+    gated to ONE process (global rank 0 here — the reference gates on
+    local_rank 0, i.e. one run per host; a single shared run is the
+    saner default on a TPU pod and the deviation is intentional),
+    per-batch train metrics under train/* with a train/step axis, epoch
+    summaries, eval metrics under eval/*, numpy scalars accepted, all
+    writes through the owned run handle. A bare evaluate() (no fit)
+    finishes the run when evaluation ends, like the reference. The
+    wandb client is not bundled in this image; constructing without it
+    raises with guidance."""
 
     def __init__(self, project=None, entity=None, name=None, dir=None,
                  mode=None, job_type=None, **kwargs):
@@ -327,6 +331,8 @@ class WandbCallback(Callback):
                 "installed in this environment; use local logging "
                 "(ProgBarLogger) or install wandb") from e
         self._run = None
+        self._in_fit = False
+        self._step = 0
         if not self._is_write():
             return
         if wandb.run is not None:
@@ -338,6 +344,8 @@ class WandbCallback(Callback):
                       mode=mode, job_type=job_type, **kwargs)
             self._run = wandb.init(**{k: v for k, v in kw.items()
                                       if v is not None})
+        self._run.define_metric("train/step")
+        self._run.define_metric("train/*", step_metric="train/step")
 
     @staticmethod
     def _is_write():
@@ -346,32 +354,48 @@ class WandbCallback(Callback):
 
     @staticmethod
     def _scalars(logs, prefix):
+        import numbers
         out = {}
         for k, v in (logs or {}).items():
             if isinstance(v, (list, tuple)):
                 v = v[0] if v else None
-            if isinstance(v, (int, float)):
-                out[f"{prefix}/{k}"] = v
+            if isinstance(v, numbers.Number):
+                out[f"{prefix}/{k}"] = float(v)
         return out
+
+    def on_train_begin(self, logs=None):
+        self._in_fit = True
+
+    def on_train_batch_end(self, step, logs=None):
+        if self._run is None:
+            return
+        self._step += 1
+        train = self._scalars(logs, "train")
+        if train:
+            self._run.log({**train, "train/step": self._step})
 
     def on_epoch_end(self, epoch, logs=None):
         if self._run is None:
             return
-        import wandb
         train = {k: v for k, v in self._scalars(logs, "train").items()
                  if not k.startswith("train/eval_")}
         if train:
-            wandb.log({**train, "epoch": epoch})
+            self._run.log({**train, "epoch": epoch,
+                           "train/step": self._step})
 
     def on_eval_end(self, logs=None):
         if self._run is None:
             return
-        import wandb
         ev = self._scalars(logs, "eval")
         if ev:
-            wandb.log(ev)
+            self._run.log(ev)
+        if not self._in_fit:
+            # standalone evaluate(): close the run like the reference
+            self._run.finish()
+            self._run = None
 
     def on_train_end(self, logs=None):
+        self._in_fit = False
         if self._run is not None:
             self._run.finish()
             self._run = None

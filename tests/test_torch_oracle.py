@@ -1,0 +1,137 @@
+"""Independent-oracle numerics: the math long tail vs torch-CPU.
+
+The OpTest suites validate against numpy references written alongside
+the implementations; this file cross-checks the trickier special
+functions and reductions against torch (bundled CPU build) — an oracle
+nobody in this repo wrote. Reference role: the cross-framework
+consistency tests in the reference's unittests (which compare against
+scipy/np golden values); semantics parity target is the phi kernels'.
+"""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+
+
+def _x(shape=(3, 7), seed=0, positive=False, lo=-3.0, hi=3.0):
+    rng = np.random.RandomState(seed)
+    v = rng.uniform(lo, hi, size=shape).astype(np.float32)
+    if positive:
+        v = np.abs(v) + 0.1
+    return v
+
+
+UNARY = [
+    ("erf", {}, dict()),
+    ("erfinv", dict(lo=-0.95, hi=0.95), dict()),
+    ("lgamma", dict(positive=True), dict()),
+    ("digamma", dict(positive=True), dict()),
+    ("cumprod", {}, dict(paddle_kw={"dim": 1}, torch_kw={"dim": 1})),
+    ("logcumsumexp", {}, dict(paddle_kw={"axis": 1}, torch_kw={"dim": 1})),
+    ("logsumexp", {}, dict(paddle_kw={"axis": 1}, torch_kw={"dim": 1})),
+    ("diff", {}, dict(paddle_kw={"axis": 1}, torch_kw={"dim": 1})),
+]
+
+
+@pytest.mark.parametrize("name,gen,kws", UNARY,
+                         ids=[u[0] for u in UNARY])
+def test_unary_matches_torch(name, gen, kws):
+    v = _x(**gen)
+    got = getattr(paddle, name)(paddle.to_tensor(v),
+                                **kws.get("paddle_kw", {})).numpy()
+    want = getattr(torch, name)(torch.from_numpy(v),
+                                **kws.get("torch_kw", {})).numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)
+
+
+BINARY = ["logaddexp", "heaviside", "fmax", "fmin", "nextafter"]
+
+
+@pytest.mark.parametrize("name", BINARY)
+def test_binary_matches_torch(name):
+    a, b = _x(seed=1), _x(seed=2)
+    if name == "heaviside":
+        a[0, 0] = 0.0  # exercise the at-zero branch
+    got = getattr(paddle, name)(paddle.to_tensor(a),
+                                paddle.to_tensor(b)).numpy()
+    want = getattr(torch, name)(torch.from_numpy(a),
+                                torch.from_numpy(b)).numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)
+
+
+class TestReductionsMatchTorch:
+    def test_median_even_and_odd(self):
+        for n in (7, 8):  # odd + even tails differ between frameworks
+            v = _x((3, n), seed=n)
+            got = paddle.median(paddle.to_tensor(v), axis=1).numpy()
+            want = np.median(v, axis=1).astype(np.float32)
+            # paddle's median averages the two middle values (numpy
+            # semantics), unlike torch's lower-median
+            np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_nanmedian(self):
+        v = _x((4, 9), seed=3)
+        v[v > 2.0] = np.nan
+        got = paddle.nanmedian(paddle.to_tensor(v), axis=1).numpy()
+        want = np.nanmedian(v, axis=1).astype(np.float32)
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_quantile_interpolations(self):
+        v = _x((5, 11), seed=4)
+        for q in (0.25, 0.5, 0.9):
+            got = paddle.quantile(paddle.to_tensor(v), q, axis=1).numpy()
+            want = torch.quantile(torch.from_numpy(v), q, dim=1).numpy()
+            np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_kthvalue_and_mode(self):
+        v = _x((4, 9), seed=5)
+        gv, gi = paddle.kthvalue(paddle.to_tensor(v), 3, axis=1)
+        tv, ti = torch.kthvalue(torch.from_numpy(v), 3, dim=1)
+        np.testing.assert_allclose(gv.numpy(), tv.numpy())
+        np.testing.assert_array_equal(gi.numpy(), ti.numpy())
+        iv = np.random.RandomState(6).randint(0, 3, (4, 15)).astype(
+            np.float32)
+        gv, _ = paddle.mode(paddle.to_tensor(iv), axis=1)
+        tv, _ = torch.mode(torch.from_numpy(iv), dim=1)
+        np.testing.assert_allclose(gv.numpy(), tv.numpy())
+
+    def test_bucketize_searchsorted(self):
+        edges = np.array([-1.0, 0.0, 1.0, 2.0], np.float32)
+        v = _x((3, 6), seed=7)
+        for right in (False, True):
+            got = paddle.bucketize(paddle.to_tensor(v),
+                                   paddle.to_tensor(edges),
+                                   right=right).numpy()
+            want = torch.bucketize(torch.from_numpy(v),
+                                   torch.from_numpy(edges),
+                                   right=right).numpy()
+            np.testing.assert_array_equal(got, want)
+        sv = np.sort(_x((8,), seed=8))
+        got = paddle.searchsorted(paddle.to_tensor(sv),
+                                  paddle.to_tensor(v)).numpy()
+        want = torch.searchsorted(torch.from_numpy(sv),
+                                  torch.from_numpy(v)).numpy()
+        np.testing.assert_array_equal(got, want)
+
+
+class TestLinalgMatchesTorch:
+    def test_slogdet_solve_pinv(self):
+        rng = np.random.RandomState(9)
+        A = rng.randn(4, 4).astype(np.float32) + 4 * np.eye(
+            4, dtype=np.float32)
+        b = rng.randn(4, 2).astype(np.float32)
+        sign, logdet = paddle.linalg.slogdet(paddle.to_tensor(A))
+        tsign, tlog = torch.linalg.slogdet(torch.from_numpy(A))
+        np.testing.assert_allclose(float(sign.numpy()), float(tsign))
+        np.testing.assert_allclose(float(logdet.numpy()), float(tlog),
+                                   rtol=1e-5)
+        got = paddle.linalg.solve(paddle.to_tensor(A),
+                                  paddle.to_tensor(b)).numpy()
+        want = torch.linalg.solve(torch.from_numpy(A),
+                                  torch.from_numpy(b)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+        M = rng.randn(5, 3).astype(np.float32)
+        got = paddle.linalg.pinv(paddle.to_tensor(M)).numpy()
+        want = torch.linalg.pinv(torch.from_numpy(M)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
