@@ -138,11 +138,12 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
     jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
              "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
     linear_like = mode in ("bilinear", "linear", "trilinear")
-    if align_corners and not linear_like and mode != "nearest":
+    if align_corners and not linear_like and mode not in ("nearest",
+                                                          "bicubic"):
         raise NotImplementedError(
             f"interpolate mode={mode!r} with align_corners=True is not "
             "implemented (half-pixel centers only); linear/bilinear/"
-            "trilinear and nearest support corner alignment")
+            "trilinear, bicubic and nearest support corner alignment")
 
     def f(vv):
         ax0 = 2 if cf else 1
@@ -179,6 +180,45 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
                 w = w.reshape(shape)
                 out = (jnp.take(out, lo, axis=axis) * (1 - w)
                        + jnp.take(out, hi, axis=axis) * w)
+            return out
+        if mode == "bicubic":
+            # the cubic-convolution kernel with a=-0.75 (torch/paddle's
+            # bicubic) — jax.image.resize's "cubic" is Keys a=-0.5 and
+            # diverges by ~0.2 on natural inputs. Separable 4-tap gather
+            # with border replication, half-pixel or corner-aligned grid.
+            out = vv
+            a = -0.75
+            for d, o in enumerate(out_sp):
+                axis = ax0 + d
+                n = out.shape[axis]
+                if align_corners:
+                    # o == 1 samples index 0 (torch/paddle corner grid),
+                    # NOT the half-pixel center
+                    pos = (jnp.arange(o) * ((n - 1) / (o - 1))
+                           if o > 1 else jnp.zeros((o,)))
+                else:
+                    pos = (jnp.arange(o) + 0.5) * (n / o) - 0.5
+                base = jnp.floor(pos)
+                t = pos - base
+
+                def _w(xdist):
+                    ax_ = jnp.abs(xdist)
+                    return jnp.where(
+                        ax_ <= 1,
+                        (a + 2) * ax_ ** 3 - (a + 3) * ax_ ** 2 + 1,
+                        jnp.where(ax_ < 2,
+                                  a * ax_ ** 3 - 5 * a * ax_ ** 2
+                                  + 8 * a * ax_ - 4 * a, 0.0))
+
+                acc = 0.0
+                for off in (-1, 0, 1, 2):
+                    idx = jnp.clip(base + off, 0, n - 1).astype(jnp.int32)
+                    w = _w(t - off).astype(vv.dtype)
+                    shape = [1] * out.ndim
+                    shape[axis] = o
+                    acc = acc + jnp.take(out, idx, axis=axis) * \
+                        w.reshape(shape)
+                out = acc
             return out
         if cf:
             out_shape = vv.shape[:2] + out_sp

@@ -349,10 +349,16 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
         pad_mask = jnp.arange(t_)[None, :] >= jnp.asarray(
             input_lengths.value if isinstance(input_lengths, Tensor)
             else input_lengths)[:, None]
-        lbl_mask = jnp.arange(lbl.shape[1])[None, :] >= jnp.asarray(
+        lens = jnp.asarray(
             label_lengths.value if isinstance(label_lengths, Tensor)
-            else label_lengths)[:, None]
+            else label_lengths)
+        lbl_mask = jnp.arange(lbl.shape[1])[None, :] >= lens[:, None]
         per = optax.ctc_loss(lgb, pad_mask, lbl, lbl_mask, blank_id=blank)
+        if reduction == "mean":
+            # reference contract (loss.py:1688): 'mean' divides each
+            # sample's loss by its label length, THEN averages (torch
+            # ctc_loss semantics) — not a plain mean of raw losses
+            return jnp.mean(per / jnp.maximum(lens.astype(per.dtype), 1))
         return _reduce(per, reduction)
     return apply(f, log_probs, _op_name="ctc_loss")
 

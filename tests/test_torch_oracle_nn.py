@@ -1,0 +1,186 @@
+"""Independent-oracle nn.functional checks vs torch-CPU.
+
+Convolution/pooling/interpolation/loss semantics are where frameworks
+classically diverge (padding conventions, align_corners, ceil_mode,
+reduction defaults) — each case here pins ours to torch's output on the
+same inputs. Parity target: the phi kernels the reference dispatches
+to, whose contracts match torch for this op set.
+"""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as tF
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def _x(shape, seed=0):
+    return np.random.RandomState(seed).randn(*shape).astype(np.float32)
+
+
+def _close(got, want, rtol=2e-5, atol=2e-5):
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=rtol, atol=atol)
+
+
+class TestConvPool:
+    @pytest.mark.parametrize("stride,pad,dil,groups", [
+        (1, 0, 1, 1), (2, 1, 1, 1), (1, 2, 2, 1), (1, 1, 1, 2)])
+    def test_conv2d(self, stride, pad, dil, groups):
+        x = _x((2, 4, 9, 9))
+        w = _x((6, 4 // groups, 3, 3), 1)
+        b = _x((6,), 2)
+        got = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w),
+                       paddle.to_tensor(b), stride=stride, padding=pad,
+                       dilation=dil, groups=groups).numpy()
+        want = tF.conv2d(torch.from_numpy(x), torch.from_numpy(w),
+                         torch.from_numpy(b), stride=stride, padding=pad,
+                         dilation=dil, groups=groups).numpy()
+        _close(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_conv1d_conv3d(self):
+        x1, w1 = _x((2, 3, 11)), _x((5, 3, 3), 1)
+        _close(F.conv1d(paddle.to_tensor(x1), paddle.to_tensor(w1),
+                        padding=1).numpy(),
+               tF.conv1d(torch.from_numpy(x1), torch.from_numpy(w1),
+                         padding=1).numpy(), rtol=1e-4, atol=1e-4)
+        x3, w3 = _x((1, 2, 5, 6, 7)), _x((4, 2, 2, 2, 2), 1)
+        _close(F.conv3d(paddle.to_tensor(x3), paddle.to_tensor(w3),
+                        stride=2).numpy(),
+               tF.conv3d(torch.from_numpy(x3), torch.from_numpy(w3),
+                         stride=2).numpy(), rtol=1e-4, atol=1e-4)
+
+    def test_pooling_semantics(self):
+        x = _x((2, 3, 7, 7))
+        _close(F.max_pool2d(paddle.to_tensor(x), 3, stride=2).numpy(),
+               tF.max_pool2d(torch.from_numpy(x), 3, stride=2).numpy())
+        # exclusive-vs-inclusive padding counting is the classic trap:
+        # paddle's default exclusive=True == torch count_include_pad=False
+        got = F.avg_pool2d(paddle.to_tensor(x), 3, stride=2, padding=1)
+        want = tF.avg_pool2d(torch.from_numpy(x), 3, stride=2, padding=1,
+                             count_include_pad=False)
+        _close(got.numpy(), want.numpy())
+        _close(F.adaptive_avg_pool2d(paddle.to_tensor(x), 3).numpy(),
+               tF.adaptive_avg_pool2d(torch.from_numpy(x), 3).numpy())
+
+    def test_unfold(self):
+        x = _x((2, 3, 8, 8))
+        got = F.unfold(paddle.to_tensor(x), 3, strides=2,
+                       paddings=1).numpy()
+        want = tF.unfold(torch.from_numpy(x), 3, stride=2,
+                         padding=1).numpy()
+        _close(got, want)
+
+
+class TestInterpolate:
+    @pytest.mark.parametrize("mode,align", [
+        ("nearest", None), ("bilinear", False), ("bilinear", True),
+        ("bicubic", False), ("bicubic", True)])
+    def test_upsample_2x(self, mode, align):
+        x = _x((1, 2, 5, 5))
+        kw = {} if align is None else {"align_corners": align}
+        got = F.interpolate(paddle.to_tensor(x), scale_factor=2.0,
+                            mode=mode, **kw).numpy()
+        want = tF.interpolate(torch.from_numpy(x), scale_factor=2.0,
+                              mode=mode, **kw).numpy()
+        _close(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_bicubic_align_corners_size_one(self):
+        x = _x((1, 2, 5, 5))
+        got = F.interpolate(paddle.to_tensor(x), size=[1, 1],
+                            mode="bicubic", align_corners=True).numpy()
+        want = tF.interpolate(torch.from_numpy(x), size=[1, 1],
+                              mode="bicubic", align_corners=True).numpy()
+        _close(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_grid_sample(self):
+        x = _x((1, 2, 6, 6))
+        g = np.random.RandomState(1).uniform(
+            -1, 1, (1, 4, 4, 2)).astype(np.float32)
+        for align in (False, True):
+            got = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(g),
+                                align_corners=align).numpy()
+            want = tF.grid_sample(torch.from_numpy(x),
+                                  torch.from_numpy(g),
+                                  align_corners=align).numpy()
+            _close(got, want, rtol=1e-4, atol=1e-4)
+
+
+class TestLosses:
+    def test_nll_bce_kldiv(self):
+        logits = _x((6, 5))
+        logp = tF.log_softmax(torch.from_numpy(logits), -1).numpy()
+        tgt = np.random.RandomState(2).randint(0, 5, 6).astype(np.int64)
+        _close(F.nll_loss(paddle.to_tensor(logp),
+                          paddle.to_tensor(tgt)).numpy(),
+               tF.nll_loss(torch.from_numpy(logp),
+                           torch.from_numpy(tgt)).numpy())
+        p = 1 / (1 + np.exp(-_x((4, 3), 3)))
+        y = (np.random.RandomState(4).rand(4, 3) > 0.5).astype(np.float32)
+        _close(F.binary_cross_entropy(paddle.to_tensor(p),
+                                      paddle.to_tensor(y)).numpy(),
+               tF.binary_cross_entropy(torch.from_numpy(p),
+                                       torch.from_numpy(y)).numpy(),
+               rtol=1e-4)
+        q = tF.log_softmax(torch.from_numpy(_x((4, 7), 5)), -1)
+        r = tF.softmax(torch.from_numpy(_x((4, 7), 6)), -1)
+        _close(F.kl_div(paddle.to_tensor(q.numpy()),
+                        paddle.to_tensor(r.numpy()),
+                        reduction="mean").numpy(),
+               tF.kl_div(q, r, reduction="mean").numpy(), rtol=1e-4)
+
+    def test_smooth_l1_matches_huber_delta(self):
+        a, b = _x((5, 4), 7), _x((5, 4), 8)
+        got = F.smooth_l1_loss(paddle.to_tensor(a), paddle.to_tensor(b),
+                               delta=1.0).numpy()
+        want = tF.smooth_l1_loss(torch.from_numpy(a),
+                                 torch.from_numpy(b)).numpy()
+        _close(got, want, rtol=1e-5)
+
+    def test_ctc_loss(self):
+        T, B, C, S = 12, 2, 6, 4
+        logits = _x((T, B, C), 9)
+        logp = tF.log_softmax(torch.from_numpy(logits), -1)
+        tgt = np.random.RandomState(10).randint(1, C, (B, S)).astype(
+            np.int32)
+        ilen = np.array([T, T - 2], np.int64)
+        tlen = np.array([S, S - 1], np.int64)
+        want = tF.ctc_loss(logp, torch.from_numpy(tgt.astype(np.int64)),
+                           torch.from_numpy(ilen), torch.from_numpy(tlen),
+                           blank=0, reduction="mean",
+                           zero_infinity=False).numpy()
+        got = F.ctc_loss(paddle.to_tensor(logp.numpy()),
+                         paddle.to_tensor(tgt),
+                         paddle.to_tensor(ilen.astype(np.int64)),
+                         paddle.to_tensor(tlen.astype(np.int64)),
+                         blank=0, reduction="mean").numpy()
+        _close(got, want, rtol=1e-4, atol=1e-4)
+
+
+class TestActivations:
+    @pytest.mark.parametrize("name,kw,tname", [
+        ("gelu", {}, "gelu"), ("silu", {}, "silu"), ("mish", {}, "mish"),
+        ("hardswish", {}, "hardswish"), ("softplus", {}, "softplus"),
+        ("elu", {}, "elu"), ("celu", {}, "celu"),
+        ("log_softmax", {"axis": -1}, "log_softmax")])
+    def test_matches(self, name, kw, tname):
+        x = _x((4, 9), 11)
+        got = getattr(F, name)(paddle.to_tensor(x), **kw).numpy()
+        tkw = {"dim": -1} if name == "log_softmax" else {}
+        want = getattr(tF, tname)(torch.from_numpy(x), **tkw).numpy()
+        _close(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_gelu_tanh_approx(self):
+        x = _x((4, 9), 12)
+        got = F.gelu(paddle.to_tensor(x), approximate=True).numpy()
+        want = tF.gelu(torch.from_numpy(x), approximate="tanh").numpy()
+        _close(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_glu_pixel_shuffle(self):
+        x = _x((4, 8), 13)
+        _close(F.glu(paddle.to_tensor(x), axis=-1).numpy(),
+               tF.glu(torch.from_numpy(x), dim=-1).numpy())
+        y = _x((1, 8, 3, 3), 14)
+        _close(F.pixel_shuffle(paddle.to_tensor(y), 2).numpy(),
+               tF.pixel_shuffle(torch.from_numpy(y), 2).numpy())
