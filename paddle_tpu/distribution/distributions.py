@@ -203,31 +203,37 @@ class Uniform(Distribution):
 
 
 class Categorical(Distribution):
-    """Parity: categorical.py — constructed from logits."""
+    """Parity: categorical.py — the reference class is INTERNALLY
+    INCONSISTENT and this mirrors it exactly: `probs`/`log_prob`
+    sum-normalize the weights (categorical.py:116
+    `self._prob = logits / sum(logits)`), while `sample`, `entropy` and
+    `kl_divergence` softmax them (categorical.py:165 via
+    _logits_to_probs, :214, :258). The torch-oracle suite pins both
+    halves (probs vs torch probs=, entropy/KL vs torch logits=)."""
 
     def __init__(self, logits=None, probs=None, name=None):
         if logits is None and probs is None:
             raise ValueError("need logits or probs")
-        if logits is not None:
-            self.logits = _raw(logits)
-        else:
-            self.logits = _t(lambda q: jnp.log(jnp.clip(q, 1e-38)),
-                             _raw(probs), name="log")
+        self.logits = _raw(logits if logits is not None else probs)
         super().__init__(_v(self.logits).shape[:-1])
         self.n_cats = _v(self.logits).shape[-1]
 
     @property
     def probs_value(self):
-        return jax.nn.softmax(_v(self.logits), -1)
+        w = _v(self.logits)
+        return w / jnp.sum(w, -1, keepdims=True)
 
     def probs(self, value=None):
         p = self.probs_value
         if value is None:
             return Tensor(p)
         idx = _v(_raw(value)).astype(jnp.int32)
+        if p.ndim == 1:
+            return Tensor(p[idx])
         return Tensor(jnp.take_along_axis(p, idx[..., None], -1)[..., 0])
 
     def sample(self, shape=()):
+        # softmax half of the reference split (categorical.py:165)
         key = next_key()
         out = jax.random.categorical(
             key, _v(self.logits), axis=-1,
@@ -236,12 +242,17 @@ class Categorical(Distribution):
 
     def log_prob(self, value):
         def f(lg):
-            logp = jax.nn.log_softmax(lg, -1)
+            p = lg / jnp.sum(lg, -1, keepdims=True)
+            logp = jnp.log(jnp.clip(p, 1e-38))
             idx = _v(_raw(value)).astype(jnp.int32)
+            if logp.ndim == 1:
+                # one distribution, any number of queried categories
+                return logp[idx]
             return jnp.take_along_axis(logp, idx[..., None], -1)[..., 0]
         return _t(f, self.logits, name="categorical_log_prob")
 
     def entropy(self):
+        # softmax half of the reference split (categorical.py:258)
         def f(lg):
             logp = jax.nn.log_softmax(lg, -1)
             return -(jnp.exp(logp) * logp).sum(-1)

@@ -65,6 +65,8 @@ def _kl_lognormal(p, q):
 
 @register_kl(Categorical, Categorical)
 def _kl_cat_cat(p: Categorical, q: Categorical):
+    # softmax half of the reference's Categorical split
+    # (categorical.py:214 kl_divergence over _logits_to_probs)
     def f(pl, ql):
         import jax
         lp = jax.nn.log_softmax(pl, -1)

@@ -45,6 +45,9 @@ def test_kl_normal_closed_form():
 
 
 def test_categorical_entropy_and_kl():
+    # reference split semantics: entropy/KL softmax the weights
+    # (categorical.py:258/:214) while probs/log_prob sum-normalize
+    # (categorical.py:116) — both halves asserted
     logits = np.log(np.array([0.2, 0.3, 0.5], "float32"))
     c = Categorical(logits=logits)
     ent = float(c.entropy())
@@ -52,6 +55,12 @@ def test_categorical_entropy_and_kl():
     np.testing.assert_allclose(ent, expect, rtol=1e-5)
     c2 = Categorical(probs=np.array([1 / 3] * 3, "float32"))
     assert float(kl_divergence(c, c2)) > 0
+    w = Categorical(logits=np.array([2.0, 3.0, 5.0], "float32"))
+    np.testing.assert_allclose(w.probs().numpy(), [0.2, 0.3, 0.5],
+                               rtol=1e-6)
+    np.testing.assert_allclose(
+        w.log_prob(paddle.to_tensor(np.array([2], np.int64))).numpy(),
+        [np.log(0.5)], rtol=1e-5)
 
 
 def test_beta_dirichlet_gamma_laplace():

@@ -1,0 +1,159 @@
+"""Independent-oracle checks: paddle.distribution and paddle.fft vs
+torch. log_prob/entropy/KL formulas are easy to get subtly wrong
+(Jacobian terms, parameterization conventions); torch.distributions is
+the oracle nobody here wrote. Parity target: the reference's
+python/paddle/distribution/ formulas, which match torch's."""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+from paddle_tpu.distribution import (Beta, Categorical, Dirichlet, Gamma,
+                                     Geometric, Gumbel, Laplace, LogNormal,
+                                     Normal, Uniform, kl_divergence)
+
+
+def _np(t):
+    return np.asarray(t.numpy() if hasattr(t, "numpy") else t)
+
+
+class TestLogProbEntropy:
+    def test_normal(self):
+        loc, scale = np.float32(0.3), np.float32(1.7)
+        x = np.linspace(-3, 3, 7).astype(np.float32)
+        ours = Normal(loc, scale)
+        ref = torch.distributions.Normal(torch.tensor(loc),
+                                         torch.tensor(scale))
+        np.testing.assert_allclose(
+            _np(ours.log_prob(paddle.to_tensor(x))),
+            ref.log_prob(torch.from_numpy(x)).numpy(), rtol=1e-5)
+        np.testing.assert_allclose(_np(ours.entropy()),
+                                   ref.entropy().numpy(), rtol=1e-5)
+
+    def test_laplace_lognormal_gumbel(self):
+        x = np.array([0.2, 1.5, 2.7], np.float32)
+        pairs = [
+            (Laplace(0.5, 1.2),
+             torch.distributions.Laplace(0.5, 1.2)),
+            (LogNormal(0.1, 0.8),
+             torch.distributions.LogNormal(0.1, 0.8)),
+            (Gumbel(0.3, 1.1),
+             torch.distributions.Gumbel(0.3, 1.1)),
+        ]
+        for ours, ref in pairs:
+            np.testing.assert_allclose(
+                _np(ours.log_prob(paddle.to_tensor(x))),
+                ref.log_prob(torch.from_numpy(x)).numpy(),
+                rtol=1e-5, atol=1e-6)
+
+    def test_beta_gamma_dirichlet(self):
+        x01 = np.array([0.2, 0.5, 0.9], np.float32)
+        b_ours, b_ref = Beta(2.0, 3.0), torch.distributions.Beta(2.0, 3.0)
+        np.testing.assert_allclose(
+            _np(b_ours.log_prob(paddle.to_tensor(x01))),
+            b_ref.log_prob(torch.from_numpy(x01)).numpy(), rtol=1e-5)
+        g_ours = Gamma(paddle.to_tensor(np.float32(2.5)),
+                       paddle.to_tensor(np.float32(1.5)))
+        g_ref = torch.distributions.Gamma(2.5, 1.5)
+        xp = np.array([0.5, 1.0, 4.0], np.float32)
+        np.testing.assert_allclose(
+            _np(g_ours.log_prob(paddle.to_tensor(xp))),
+            g_ref.log_prob(torch.from_numpy(xp)).numpy(), rtol=1e-5)
+        conc = np.array([1.5, 2.0, 3.0], np.float32)
+        d_ours = Dirichlet(paddle.to_tensor(conc))
+        d_ref = torch.distributions.Dirichlet(torch.from_numpy(conc))
+        p = np.array([0.2, 0.3, 0.5], np.float32)
+        np.testing.assert_allclose(
+            _np(d_ours.log_prob(paddle.to_tensor(p))),
+            d_ref.log_prob(torch.from_numpy(p)).numpy(), rtol=1e-5)
+        np.testing.assert_allclose(_np(d_ours.entropy()),
+                                   d_ref.entropy().numpy(), rtol=1e-5)
+
+    def test_uniform_geometric(self):
+        u_ours = Uniform(-1.0, 3.0)
+        u_ref = torch.distributions.Uniform(-1.0, 3.0)
+        x = np.array([-0.5, 0.0, 2.9], np.float32)
+        np.testing.assert_allclose(
+            _np(u_ours.log_prob(paddle.to_tensor(x))),
+            u_ref.log_prob(torch.from_numpy(x)).numpy(), rtol=1e-6)
+        g_ours = Geometric(0.3)
+        g_ref = torch.distributions.Geometric(0.3)
+        k = np.array([0.0, 1.0, 5.0], np.float32)
+        np.testing.assert_allclose(
+            _np(g_ours.log_prob(paddle.to_tensor(k))),
+            g_ref.log_prob(torch.from_numpy(k)).numpy(), rtol=1e-5)
+
+
+class TestKL:
+    def test_normal_kl(self):
+        ours = kl_divergence(Normal(0.0, 1.0), Normal(1.0, 2.0))
+        ref = torch.distributions.kl_divergence(
+            torch.distributions.Normal(0.0, 1.0),
+            torch.distributions.Normal(1.0, 2.0))
+        np.testing.assert_allclose(float(_np(ours)), float(ref), rtol=1e-5)
+
+    def test_beta_dirichlet_kl(self):
+        ours = kl_divergence(Beta(2.0, 3.0), Beta(4.0, 1.5))
+        ref = torch.distributions.kl_divergence(
+            torch.distributions.Beta(2.0, 3.0),
+            torch.distributions.Beta(4.0, 1.5))
+        np.testing.assert_allclose(float(_np(ours)), float(ref), rtol=1e-5)
+        a = np.array([1.0, 2.0, 3.0], np.float32)
+        b = np.array([2.0, 2.0, 2.0], np.float32)
+        ours = kl_divergence(Dirichlet(paddle.to_tensor(a)),
+                             Dirichlet(paddle.to_tensor(b)))
+        ref = torch.distributions.kl_divergence(
+            torch.distributions.Dirichlet(torch.from_numpy(a)),
+            torch.distributions.Dirichlet(torch.from_numpy(b)))
+        np.testing.assert_allclose(float(_np(ours)), float(ref), rtol=1e-5)
+
+    def test_categorical_split_semantics(self):
+        """The reference Categorical is internally inconsistent:
+        probs/log_prob sum-normalize (categorical.py:116) while
+        entropy/KL/sample softmax (:165, :214, :258). Pin both halves."""
+        w1 = np.array([1.0, 2.0, 3.0], np.float32)
+        w2 = np.array([3.0, 2.0, 1.0], np.float32)
+        c1 = Categorical(paddle.to_tensor(w1))
+        c2 = Categorical(paddle.to_tensor(w2))
+        # probs/log_prob: sum-normalized == torch probs=w/sum(w)
+        t_probs = torch.distributions.Categorical(
+            probs=torch.from_numpy(w1 / w1.sum()))
+        idx = np.array([0, 1, 2], np.int64)
+        np.testing.assert_allclose(
+            _np(c1.log_prob(paddle.to_tensor(idx))),
+            t_probs.log_prob(torch.from_numpy(idx)).numpy(), rtol=1e-5)
+        # entropy/KL: softmax == torch logits=w
+        t1 = torch.distributions.Categorical(logits=torch.from_numpy(w1))
+        t2 = torch.distributions.Categorical(logits=torch.from_numpy(w2))
+        np.testing.assert_allclose(float(_np(c1.entropy())),
+                                   float(t1.entropy()), rtol=1e-5)
+        np.testing.assert_allclose(
+            float(_np(kl_divergence(c1, c2))),
+            float(torch.distributions.kl_divergence(t1, t2)), rtol=1e-5)
+
+
+class TestFFT:
+    def test_fft_family(self):
+        import paddle_tpu.fft as pfft
+        v = np.random.RandomState(0).randn(4, 16).astype(np.float32)
+        np.testing.assert_allclose(
+            _np(pfft.fft(paddle.to_tensor(v))),
+            torch.fft.fft(torch.from_numpy(v)).numpy(), rtol=1e-4,
+            atol=1e-5)
+        np.testing.assert_allclose(
+            _np(pfft.rfft(paddle.to_tensor(v))),
+            torch.fft.rfft(torch.from_numpy(v)).numpy(), rtol=1e-4,
+            atol=1e-5)
+        r = np.random.RandomState(1).randn(4, 9).astype(np.complex64)
+        np.testing.assert_allclose(
+            _np(pfft.irfft(paddle.to_tensor(r))),
+            torch.fft.irfft(torch.from_numpy(r)).numpy(), rtol=1e-4,
+            atol=1e-5)
+        m = np.random.RandomState(2).randn(6, 8).astype(np.float32)
+        np.testing.assert_allclose(
+            _np(pfft.fft2(paddle.to_tensor(m))),
+            torch.fft.fft2(torch.from_numpy(m)).numpy(), rtol=1e-4,
+            atol=1e-4)
+        np.testing.assert_allclose(
+            _np(pfft.fftshift(paddle.to_tensor(m))),
+            torch.fft.fftshift(torch.from_numpy(m)).numpy())
