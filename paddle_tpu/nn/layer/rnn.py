@@ -283,8 +283,8 @@ class BiRNN(Layer):
     def forward(self, inputs, initial_states=None, sequence_length=None):
         states_fw, states_bw = (initial_states if initial_states is not None
                                 else (None, None))
-        out_fw, st_fw = self.rnn_fw(inputs, states_fw)
-        out_bw, st_bw = self.rnn_bw(inputs, states_bw)
+        out_fw, st_fw = self.rnn_fw(inputs, states_fw, sequence_length)
+        out_bw, st_bw = self.rnn_bw(inputs, states_bw, sequence_length)
         from ...tensor.manipulation import concat
         return concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)
 
@@ -322,17 +322,57 @@ class _RNNBase(Layer):
                 self.rnns.append(RNN(make_cell(isize),
                                      direction == "backward", time_major))
 
+    def _split_initial(self, initial_states):
+        """Accept the reference's stacked layout — LSTM: (h, c) each
+        [L*D, B, H]; GRU/RNN: h [L*D, B, H] — and split it into the
+        per-layer(-direction) cell states the inner RNNs consume. A
+        plain per-layer list passes through unchanged."""
+        if initial_states is None:
+            return None
+        if isinstance(initial_states, list):
+            # a list of per-layer cell states passes through; but the
+            # reference also allows LSTM states as the LIST [h0, c0] of
+            # stacked tensors — detect that (two rank-3 tensors, not
+            # per-layer tuples) and fall through to the split below
+            if not (self.mode == "LSTM" and len(initial_states) == 2
+                    and all(getattr(st, "ndim", 0) == 3
+                            for st in initial_states)):
+                return initial_states
+            initial_states = tuple(initial_states)
+        D = self.num_directions
+        if self.mode == "LSTM":
+            h, c = initial_states
+            per = [(h[i], c[i]) for i in range(self.num_layers * D)]
+        else:
+            per = [initial_states[i]
+                   for i in range(self.num_layers * D)]
+        if D == 2:
+            return [(per[2 * i], per[2 * i + 1])
+                    for i in range(self.num_layers)]
+        return per
+
     def forward(self, inputs, initial_states=None, sequence_length=None):
         out = inputs
+        initial_states = self._split_initial(initial_states)
         final_states = []
         for i, rnn in enumerate(self.rnns):
             st = None if initial_states is None else initial_states[i]
-            out, state = rnn(out, st)
+            out, state = rnn(out, st, sequence_length)
             final_states.append(state)
             if self.dropout > 0 and i < self.num_layers - 1:
                 from .. import functional as F
                 out = F.dropout(out, self.dropout, training=self.training)
-        return out, final_states
+        # reference layout (rnn.py RNNBase): LSTM -> (h, c) each
+        # [num_layers*num_directions, B, H]; GRU/RNN -> h alone
+        from ...tensor.manipulation import stack
+        flat = []
+        for state in final_states:
+            flat.extend(state if self.num_directions == 2 else [state])
+        if self.mode == "LSTM":
+            h = stack([s[0] for s in flat], axis=0)
+            c = stack([s[1] for s in flat], axis=0)
+            return out, (h, c)
+        return out, stack(flat, axis=0)
 
 
 class SimpleRNN(_RNNBase):

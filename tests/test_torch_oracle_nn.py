@@ -184,3 +184,78 @@ class TestActivations:
         y = _x((1, 8, 3, 3), 14)
         _close(F.pixel_shuffle(paddle.to_tensor(y), 2).numpy(),
                tF.pixel_shuffle(torch.from_numpy(y), 2).numpy())
+
+
+def _copy_rnn_weights(ours, theirs):
+    """Copy torch layer-0 RNN weights into ours by suffix match."""
+    tsd = dict(theirs.named_parameters())
+    mapped = 0
+    for k, p in dict(ours.named_parameters()).items():
+        for suffix, t_name in (("weight_ih", "weight_ih_l0"),
+                               ("weight_hh", "weight_hh_l0"),
+                               ("bias_ih", "bias_ih_l0"),
+                               ("bias_hh", "bias_hh_l0")):
+            if k.endswith(suffix):
+                p.set_value(tsd[t_name].detach().numpy())
+                mapped += 1
+    assert mapped == 4, mapped
+
+
+class TestRNNFamilyMatchesTorch:
+    """Gate order and bias conventions are the classic RNN divergence:
+    paddle and torch both use i,f,g,o (LSTM) and r,z,n (GRU with
+    separate bias_hh inside the candidate gate). Weights are copied
+    from torch into ours and outputs compared step-exactly."""
+
+    def test_lstm_forward_matches(self):
+        import paddle_tpu.nn as nn
+        T, B, I, H = 5, 3, 4, 6
+        ours = nn.LSTM(I, H)
+        theirs = torch.nn.LSTM(I, H, batch_first=True)
+        _copy_rnn_weights(ours, theirs)
+        x = _x((B, T, I), 21)
+        got, (h, c) = ours(paddle.to_tensor(x))
+        want, (th, tc) = theirs(torch.from_numpy(x))
+        _close(got.numpy(), want.detach().numpy(), rtol=1e-4, atol=1e-5)
+        _close(h.numpy(), th.detach().numpy(), rtol=1e-4, atol=1e-5)
+        _close(c.numpy(), tc.detach().numpy(), rtol=1e-4, atol=1e-5)
+        # list-form [h0, c0] initial state == tuple form (reference API)
+        o1, _ = ours(paddle.to_tensor(x), (h, c))
+        o2, _ = ours(paddle.to_tensor(x), [h, c])
+        _close(o1.numpy(), o2.numpy())
+
+    def test_gru_forward_matches(self):
+        import paddle_tpu.nn as nn
+        T, B, I, H = 4, 2, 3, 5
+        ours = nn.GRU(I, H)
+        theirs = torch.nn.GRU(I, H, batch_first=True)
+        _copy_rnn_weights(ours, theirs)
+        x = _x((B, T, I), 22)
+        got, h = ours(paddle.to_tensor(x))
+        want, th = theirs(torch.from_numpy(x))
+        _close(got.numpy(), want.detach().numpy(), rtol=1e-4, atol=1e-5)
+        _close(h.numpy(), th.detach().numpy(), rtol=1e-4, atol=1e-5)
+
+    def test_simple_rnn_matches(self):
+        import paddle_tpu.nn as nn
+        T, B, I, H = 4, 2, 3, 5
+        ours = nn.SimpleRNN(I, H)
+        theirs = torch.nn.RNN(I, H, batch_first=True, nonlinearity="tanh")
+        _copy_rnn_weights(ours, theirs)
+        x = _x((B, T, I), 23)
+        got, h = ours(paddle.to_tensor(x))
+        want, th = theirs(torch.from_numpy(x))
+        _close(got.numpy(), want.detach().numpy(), rtol=1e-4, atol=1e-5)
+
+    def test_sequence_length_masks_padding(self):
+        import paddle_tpu.nn as nn
+        T, B, I, H = 6, 2, 3, 4
+        gru = nn.GRU(I, H)
+        x = _x((B, T, I), 24)
+        sl = np.array([6, 3], np.int64)
+        out, h = gru(paddle.to_tensor(x),
+                     sequence_length=paddle.to_tensor(sl))
+        # padded timesteps of row 1 are zeroed; final h equals step-3 h
+        assert np.abs(out.numpy()[1, 3:]).max() == 0.0
+        out_cut, h_cut = gru(paddle.to_tensor(x[1:2, :3]))
+        _close(h.numpy()[0, 1], h_cut.numpy()[0, 0], rtol=1e-5, atol=1e-6)
