@@ -176,6 +176,11 @@ class RNN(Layer):
         is_lstm = isinstance(cell, LSTMCell)
         builtin = isinstance(cell, (LSTMCell, GRUCell, SimpleRNNCell))
         if not builtin:
+            if sequence_length is not None:
+                raise NotImplementedError(
+                    "sequence_length masking is implemented for the "
+                    "builtin LSTM/GRU/SimpleRNN cells' scan path; mask "
+                    "a custom cell's outputs explicitly")
             return self._generic_loop(inputs, initial_states, sequence_length)
         # fast path: one lax.scan over time; weights are scan-invariant args
         params = [cell.weight_ih, cell.weight_hh, cell.bias_ih, cell.bias_hh]
@@ -329,23 +334,30 @@ class _RNNBase(Layer):
         plain per-layer list passes through unchanged."""
         if initial_states is None:
             return None
-        if isinstance(initial_states, list):
-            # a list of per-layer cell states passes through; but the
-            # reference also allows LSTM states as the LIST [h0, c0] of
-            # stacked tensors — detect that (two rank-3 tensors, not
-            # per-layer tuples) and fall through to the split below
+        if isinstance(initial_states, (list, tuple)):
+            # per-layer cell states pass through; the reference also
+            # allows LSTM states as the PAIR [h0, c0] (list or tuple) of
+            # stacked rank-3 tensors — only that exact shape splits
             if not (self.mode == "LSTM" and len(initial_states) == 2
                     and all(getattr(st, "ndim", 0) == 3
                             for st in initial_states)):
-                return initial_states
-            initial_states = tuple(initial_states)
+                return list(initial_states)
         D = self.num_directions
+        want = self.num_layers * D
         if self.mode == "LSTM":
             h, c = initial_states
-            per = [(h[i], c[i]) for i in range(self.num_layers * D)]
+            if h.shape[0] != want:
+                raise ValueError(
+                    f"initial_states leading dim {h.shape[0]} != "
+                    f"num_layers*num_directions = {want}")
+            per = [(h[i], c[i]) for i in range(want)]
         else:
-            per = [initial_states[i]
-                   for i in range(self.num_layers * D)]
+            if initial_states.shape[0] != want:
+                raise ValueError(
+                    f"initial_states leading dim "
+                    f"{initial_states.shape[0]} != "
+                    f"num_layers*num_directions = {want}")
+            per = [initial_states[i] for i in range(want)]
         if D == 2:
             return [(per[2 * i], per[2 * i + 1])
                     for i in range(self.num_layers)]

@@ -259,3 +259,76 @@ class TestRNNFamilyMatchesTorch:
         assert np.abs(out.numpy()[1, 3:]).max() == 0.0
         out_cut, h_cut = gru(paddle.to_tensor(x[1:2, :3]))
         _close(h.numpy()[0, 1], h_cut.numpy()[0, 0], rtol=1e-5, atol=1e-6)
+
+
+class TestTransformerMatchesTorch:
+    """MHA + encoder layer vs torch with copied weights. torch packs
+    q/k/v rows into in_proj_weight [3E, E] (out, in layout); paddle uses
+    separate [E, E] (in, out) projections — rows split + transpose."""
+
+    def _copy_mha(self, ours, theirs, E):
+        ipw = theirs.in_proj_weight.detach().numpy()    # [3E, E]
+        ipb = theirs.in_proj_bias.detach().numpy()      # [3E]
+        ps = dict(ours.named_parameters())
+        for i, name in enumerate(("q_proj", "k_proj", "v_proj")):
+            ps[f"{name}.weight"].set_value(ipw[i * E:(i + 1) * E].T.copy())
+            ps[f"{name}.bias"].set_value(ipb[i * E:(i + 1) * E].copy())
+        ps["out_proj.weight"].set_value(
+            theirs.out_proj.weight.detach().numpy().T.copy())
+        ps["out_proj.bias"].set_value(
+            theirs.out_proj.bias.detach().numpy().copy())
+
+    def test_multi_head_attention(self):
+        import paddle_tpu.nn as nn
+        B, S, E, H = 2, 5, 8, 2
+        ours = nn.MultiHeadAttention(E, H)
+        theirs = torch.nn.MultiheadAttention(E, H, batch_first=True)
+        self._copy_mha(ours, theirs, E)
+        x = _x((B, S, E), 31)
+        got = ours(paddle.to_tensor(x))
+        want, _ = theirs(torch.from_numpy(x), torch.from_numpy(x),
+                         torch.from_numpy(x))
+        _close(got.numpy(), want.detach().numpy(), rtol=1e-4, atol=1e-5)
+
+    def test_mha_with_causal_mask(self):
+        import paddle_tpu.nn as nn
+        B, S, E, H = 2, 4, 8, 2
+        ours = nn.MultiHeadAttention(E, H)
+        theirs = torch.nn.MultiheadAttention(E, H, batch_first=True)
+        self._copy_mha(ours, theirs, E)
+        x = _x((B, S, E), 32)
+        causal_bool = np.triu(np.ones((S, S), bool), 1)   # True = masked
+        # paddle mask convention: additive float mask (0 keep, -inf drop)
+        add_mask = np.where(causal_bool, -1e9, 0.0).astype(np.float32)
+        got = ours(paddle.to_tensor(x),
+                   attn_mask=paddle.to_tensor(add_mask))
+        want, _ = theirs(torch.from_numpy(x), torch.from_numpy(x),
+                         torch.from_numpy(x),
+                         attn_mask=torch.from_numpy(causal_bool))
+        _close(got.numpy(), want.detach().numpy(), rtol=1e-4, atol=1e-5)
+
+    def test_encoder_layer(self):
+        import paddle_tpu.nn as nn
+        B, S, E, H, FF = 2, 5, 8, 2, 16
+        ours = nn.TransformerEncoderLayer(E, H, FF, dropout=0.0,
+                                          activation="relu")
+        theirs = torch.nn.TransformerEncoderLayer(
+            E, H, FF, dropout=0.0, activation="relu", batch_first=True)
+        ours.eval()
+        theirs.eval()
+        self._copy_mha(ours.self_attn, theirs.self_attn, E)
+        ps = dict(ours.named_parameters())
+        for o_name, t_param in (
+                ("linear1.weight", theirs.linear1.weight.T),
+                ("linear1.bias", theirs.linear1.bias),
+                ("linear2.weight", theirs.linear2.weight.T),
+                ("linear2.bias", theirs.linear2.bias),
+                ("norm1.weight", theirs.norm1.weight),
+                ("norm1.bias", theirs.norm1.bias),
+                ("norm2.weight", theirs.norm2.weight),
+                ("norm2.bias", theirs.norm2.bias)):
+            ps[o_name].set_value(t_param.detach().numpy().copy())
+        x = _x((B, S, E), 33)
+        got = ours(paddle.to_tensor(x))
+        want = theirs(torch.from_numpy(x))
+        _close(got.numpy(), want.detach().numpy(), rtol=1e-4, atol=1e-5)
