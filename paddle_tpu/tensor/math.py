@@ -220,20 +220,45 @@ def cumprod(x, dim=None, dtype=None, name=None):
     return apply(f, x, _op_name="cumprod")
 
 
+def _cum_extreme(x, axis, dtype, name, is_max):
+    """Running max/min WITH the running index of each extremum (torch/
+    paddle cummax contract: same shape as input; ties keep the LATEST
+    index). One associative scan over (value, index) pairs — the
+    latest-wins max combine is associative, so XLA parallelizes it."""
+    flatten = axis is None
+    ax = -1 if flatten else int(axis)
+
+    def f(v):
+        if flatten:
+            v = v.reshape(-1)
+        n = v.shape[ax]
+        iota_shape = [1] * v.ndim
+        iota_shape[ax] = n
+        idx0 = jnp.broadcast_to(
+            jnp.arange(n).reshape(iota_shape), v.shape)
+
+        def combine(a, b):
+            av, ai = a
+            bv, bi = b
+            # NaN must propagate like jnp.maximum/torch: once the later
+            # operand is NaN it wins; comparisons alone would drop it
+            take_b = (bv >= av) if is_max else (bv <= av)
+            take_b = take_b | jnp.isnan(bv)
+            return (jnp.where(take_b, bv, av),
+                    jnp.where(take_b, bi, ai))
+
+        vals, inds = jax.lax.associative_scan(combine, (v, idx0), axis=ax)
+        return vals, inds.astype(convert_dtype(dtype))
+
+    return apply(f, x, _op_name=name)
+
+
 def cummax(x, axis=None, dtype="int64", name=None):
-    ax = -1 if axis is None else int(axis)
-    v = jax.lax.associative_scan(jnp.maximum, x.value, axis=ax)
-    idx = jnp.argmax(jnp.cumsum((x.value == v).astype(jnp.int32), axis=ax) *
-                     (x.value == v), axis=ax)
-    return apply(lambda t: jax.lax.associative_scan(jnp.maximum, t, axis=ax),
-                 x, _op_name="cummax"), Tensor(idx.astype(convert_dtype(dtype)))
+    return _cum_extreme(x, axis, dtype, "cummax", True)
 
 
 def cummin(x, axis=None, dtype="int64", name=None):
-    ax = -1 if axis is None else int(axis)
-    idx = jnp.argmin(x.value, axis=ax)
-    return apply(lambda t: jax.lax.associative_scan(jnp.minimum, t, axis=ax),
-                 x, _op_name="cummin"), Tensor(idx.astype(convert_dtype(dtype)))
+    return _cum_extreme(x, axis, dtype, "cummin", False)
 
 
 def logsumexp(x, axis=None, keepdim=False, name=None):

@@ -135,3 +135,81 @@ class TestLinalgMatchesTorch:
         got = paddle.linalg.pinv(paddle.to_tensor(M)).numpy()
         want = torch.linalg.pinv(torch.from_numpy(M)).numpy()
         np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+class TestEinsumManipulationMatchTorch:
+    def test_einsum_patterns(self):
+        a = _x((3, 4), 30)
+        b = _x((4, 5), 31)
+        c = _x((2, 3, 4), 32)
+        d = _x((2, 4, 6), 33)
+        cases = [
+            ("ij,jk->ik", (a, b)),
+            ("bij,bjk->bik", (c, d)),
+            ("ij->ji", (a,)),
+            ("ij->", (a,)),
+            ("bij->bi", (c,)),
+            ("ij,ij->", (a, _x((3, 4), 34))),
+        ]
+        for eq, ops in cases:
+            got = paddle.einsum(eq, *[paddle.to_tensor(o) for o in ops])
+            want = torch.einsum(eq, *[torch.from_numpy(o) for o in ops])
+            np.testing.assert_allclose(np.asarray(got.numpy()),
+                                       want.numpy(), rtol=1e-4, atol=1e-5,
+                                       err_msg=eq)
+
+    def test_sort_topk_stability_and_values(self):
+        v = _x((4, 9), 35)
+        gv, gi = paddle.topk(paddle.to_tensor(v), 3, axis=1)
+        tv, ti = torch.topk(torch.from_numpy(v), 3, dim=1)
+        np.testing.assert_allclose(gv.numpy(), tv.numpy())
+        np.testing.assert_array_equal(gi.numpy(), ti.numpy())
+        gs = paddle.sort(paddle.to_tensor(v), axis=1, descending=True)
+        ts, _ = torch.sort(torch.from_numpy(v), dim=1, descending=True)
+        np.testing.assert_allclose(gs.numpy(), ts.numpy())
+
+    def test_cummax_roll_rot90(self):
+        v = _x((3, 6), 36)
+        gv, gi = paddle.cummax(paddle.to_tensor(v), axis=1)
+        tv, ti = torch.cummax(torch.from_numpy(v), dim=1)
+        np.testing.assert_allclose(gv.numpy(), tv.numpy())
+        np.testing.assert_array_equal(gi.numpy(), ti.numpy())
+        gv, gi = paddle.cummin(paddle.to_tensor(v), axis=0)
+        tv, ti = torch.cummin(torch.from_numpy(v), dim=0)
+        np.testing.assert_allclose(gv.numpy(), tv.numpy())
+        np.testing.assert_array_equal(gi.numpy(), ti.numpy())
+        # tie semantics: the LATEST index wins (torch contract)
+        t = np.array([[1.0, 1.0, 0.5, 1.0]], np.float32)
+        _, gi = paddle.cummax(paddle.to_tensor(t), axis=1)
+        _, ti = torch.cummax(torch.from_numpy(t), dim=1)
+        np.testing.assert_array_equal(gi.numpy(), ti.numpy())
+        # NaN propagates like torch (values and indices)
+        nt = np.array([1.0, np.nan, 0.5, 2.0], np.float32)
+        gv, gi = paddle.cummax(paddle.to_tensor(nt), axis=0)
+        tv, ti = torch.cummax(torch.from_numpy(nt), dim=0)
+        np.testing.assert_allclose(gv.numpy(), tv.numpy(), equal_nan=True)
+        np.testing.assert_array_equal(gi.numpy(), ti.numpy())
+        np.testing.assert_allclose(
+            paddle.roll(paddle.to_tensor(v), 2, axis=1).numpy(),
+            torch.roll(torch.from_numpy(v), 2, dims=1).numpy())
+        m = _x((3, 4), 37)
+        np.testing.assert_allclose(
+            paddle.rot90(paddle.to_tensor(m), 1, [0, 1]).numpy(),
+            torch.rot90(torch.from_numpy(m), 1, [0, 1]).numpy())
+
+    def test_repeat_interleave_tile_takealong(self):
+        v = _x((2, 3), 38)
+        np.testing.assert_allclose(
+            paddle.repeat_interleave(paddle.to_tensor(v), 2,
+                                     axis=1).numpy(),
+            torch.repeat_interleave(torch.from_numpy(v), 2, dim=1).numpy())
+        np.testing.assert_allclose(
+            paddle.tile(paddle.to_tensor(v), [2, 2]).numpy(),
+            torch.tile(torch.from_numpy(v), (2, 2)).numpy())
+        idx = np.random.RandomState(39).randint(0, 3, (2, 5)).astype(
+            np.int64)
+        np.testing.assert_allclose(
+            paddle.take_along_axis(paddle.to_tensor(v),
+                                   paddle.to_tensor(idx), 1).numpy(),
+            torch.take_along_dim(torch.from_numpy(v),
+                                 torch.from_numpy(idx), 1).numpy())
