@@ -203,12 +203,21 @@ def main():
 
     # warmup (compile + 2 steady steps). First axon compile of the full
     # donated step is 1-3 min; cached recompiles are seconds.
-    # Budget override for slow remote-compile paths (the axon tunnel's
-    # compile helper can serialize compiles behind other clients; the
-    # round-4 1.3B first-compile exceeded 1500s through it).
-    dog.stage("compiling",
-              int(os.environ.get("PADDLE_TPU_BENCH_COMPILE_BUDGET",
-                                 1500 if _MODEL_SEL == "gpt1.3b" else 900)))
+    # Budget override for slow remote-compile paths. The 1.3B default is
+    # deliberately generous: aborting bench.py mid-remote-compile WEDGES
+    # the axon tunnel for every later client (observed round 4 — the
+    # 1500s kill at 04:29 made the whole rest of the suite UNAVAILABLE),
+    # so for non-driver configs waiting out a slow compile is strictly
+    # cheaper than killing it. The driver metric (125M, ~3 min measured)
+    # keeps the tight budget.
+    default_budget = 3600 if _MODEL_SEL == "gpt1.3b" else 900
+    try:
+        budget = int(os.environ.get("PADDLE_TPU_BENCH_COMPILE_BUDGET",
+                                    default_budget))
+    except ValueError:
+        _log("bad PADDLE_TPU_BENCH_COMPILE_BUDGET, using default")
+        budget = default_budget
+    dog.stage("compiling", budget)
     loss = step(ids, ids)
     float(loss)
     dog.stage("warmup", 120)

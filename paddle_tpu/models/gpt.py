@@ -234,6 +234,11 @@ class GPTScannedBlocks(Layer):
         with LazyGuard():
             self._template = [GPTBlock(cfg)]
         tmpl = self._template[0]
+        if list(tmpl.named_buffers()):
+            raise NotImplementedError(
+                "scan_layers with buffered blocks: buffers are not "
+                "stacked across layers (same restriction as "
+                "PipelineLayer body blocks)")
         L = cfg.num_layers
         w_init = I.Normal(0.0, cfg.initializer_range)
         self._names = []
@@ -274,8 +279,14 @@ class GPTScannedBlocks(Layer):
                 f"num_layers={self.cfg.num_layers} model")
         per_layer = [dict(b.named_parameters()) for b in blocks]
         for name in self._names:
-            stacked = jnp.stack([d[name].value for d in per_layer])
-            self._parameters[self._mangle(name)].value = stacked
+            vals = [d[name].value for d in per_layer]
+            if any(isinstance(v, jax.ShapeDtypeStruct) for v in vals):
+                raise ValueError(
+                    "load_from_blocks: source blocks hold abstract "
+                    "(LazyGuard) parameters — materialize them first")
+            target = self._parameters[self._mangle(name)]
+            # keep the scanned model's precision (e.g. after .bfloat16())
+            target.value = jnp.stack(vals).astype(target.value.dtype)
 
     def forward(self, x):
         from ..autograd import tape as _tape

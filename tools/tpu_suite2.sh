@@ -1,0 +1,49 @@
+#!/bin/bash
+# Round-4 phase-2 TPU suite: the measurements the 04:29 tunnel wedge ate.
+# Run AFTER tpu_suite.sh's first pass; safe to re-run — each step skips
+# itself if its result JSON already has a non-error payload.
+# Most-important-first; generous budgets; NO outer kills around anything
+# that might be mid-compile (kills wedge the tunnel — see bench.py note).
+set -u
+cd /root/repo || exit 1
+R=tpu_results
+mkdir -p "$R"
+log() { echo "[suite2] $(date -u +%FT%TZ) $*" >> "$R/suite2.log"; }
+
+have() {  # have <json> — 0 if the file holds a non-error result
+  python - "$1" <<'EOF'
+import json, sys
+try:
+    d = json.load(open(sys.argv[1]))
+except Exception:
+    sys.exit(1)
+ok = isinstance(d, dict) and d.get("value", 0) and "error" not in d
+sys.exit(0 if ok else 1)
+EOF
+}
+
+run() {  # run <name> <outfile> <cmd...>
+  local name=$1 out=$2; shift 2
+  if have "$R/$out"; then log "$name: already have result, skip"; return 0; fi
+  log "$name: $*"
+  "$@" > "$R/$out" 2> "$R/$name.log"
+  local rc=$?
+  log "$name rc=$rc"
+}
+
+log "start"
+# 1. 1.3B with scan-over-layers (depth-independent compile) + 3600s budget
+run bench_1p3b bench_1p3b.json env PADDLE_TPU_BENCH_MODEL=gpt1.3b python bench.py
+# 2. step profile -> MFU attack input (no outer timeout: mid-compile kills wedge)
+log "profile_step"
+python tools/profile_step.py > "$R/profile_step.txt" 2> "$R/profile_step.log"
+log "profile_step rc=$?"
+# 3. fused ring kernel vs XLA merge
+log "bench_ring"
+python tools/bench_ring.py > "$R/bench_ring.json" 2> "$R/bench_ring.log"
+log "bench_ring rc=$?"
+# 4. serving latency (BASELINE config 5)
+log "bench_serving"
+python tools/bench_serving.py > "$R/bench_serving.json" 2> "$R/bench_serving.log"
+log "bench_serving rc=$?"
+log "done"

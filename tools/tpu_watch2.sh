@@ -1,0 +1,25 @@
+#!/bin/bash
+# Probe the axon backend every 10 min; on success run tpu_suite2.sh once.
+# Probe kills are safe: no TPU step or compile ever runs in the probe.
+cd /root/repo || exit 1
+LOG=/root/repo/tpu_results/watch2.log
+echo "[watch2] start $(date -u +%FT%TZ) pid=$$" >> "$LOG"
+A=0
+while true; do
+  A=$((A + 1))
+  echo "[watch2] $(date -u +%FT%TZ) probe attempt=$A" >> "$LOG"
+  if timeout 120 python - >> "$LOG" 2>&1 <<'PY'
+import jax, sys
+d = jax.devices()
+if getattr(d[0], "platform", "") == "cpu":
+    sys.exit(3)
+print("device_kind=%s" % getattr(d[0], "device_kind", "?"))
+PY
+  then
+    echo "[watch2] $(date -u +%FT%TZ) probe OK -> tpu_suite2" >> "$LOG"
+    bash /root/repo/tools/tpu_suite2.sh
+    echo "[watch2] suite2 exited rc=$?" >> "$LOG"
+    exit 0
+  fi
+  sleep 600
+done
