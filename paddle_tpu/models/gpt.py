@@ -248,10 +248,10 @@ class GPTScannedBlocks(Layer):
                 # matmul weights: L independent Normal draws == one draw
                 # of the stacked shape
                 value = w_init(shape, "float32")
-            elif name.endswith(".weight"):
-                value = jnp.ones(shape, jnp.float32)  # LayerNorm scales
-            else:
-                value = jnp.zeros(shape, jnp.float32)  # biases
+            elif name.endswith(".weight"):  # LayerNorm scales
+                value = I.Constant(1.0)(shape, "float32")
+            else:  # biases
+                value = I.Constant(0.0)(shape, "float32")
             sp = type(p)(value)
             # stacked leaf keeps the block's TP annotation with the layer
             # axis unsharded (same pattern as PipelineLayer._stack_params,
