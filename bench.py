@@ -183,7 +183,9 @@ def main():
         cfg = GPTConfig(vocab_size=50304, hidden_size=2048, num_layers=24,
                         num_heads=16, max_seq_len=seq, recompute=True,
                         scan_layers=os.environ.get(
-                            "PADDLE_TPU_BENCH_SCAN", "1") != "0")
+                            "PADDLE_TPU_BENCH_SCAN", "1") != "0",
+                        fused_loss_chunk=int(os.environ.get(
+                            "PADDLE_TPU_BENCH_FUSED_CE", "2048")))
         multi_precision = False
     else:
         cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
@@ -195,7 +197,7 @@ def main():
     opt = paddle.optimizer.AdamW(learning_rate=1e-4,
                                  multi_precision=multi_precision,
                                  parameters=model.parameters())
-    step = TrainStep(model, GPTForCausalLM.loss_fn, opt)
+    step = TrainStep(model, model.make_loss_fn(), opt)
 
     rng = np.random.RandomState(0)
     ids = paddle.to_tensor(

@@ -85,10 +85,16 @@ def functional_call(layer, params, buffers, *args, training=None, **kwargs):
                 out = layer(*args, **kwargs)
             new_buffers = {n: bmap[n].value for n in (buffers or {})
                            if n in bmap}
+            # unwrap INSIDE the swap: a forward that returns a Parameter
+            # or buffer Tensor (e.g. a tied LM weight handed to a fused
+            # loss) must yield the traced value — after the swap restores
+            # originals, .value would silently be the stale concrete
+            # array, freezing that leaf in the compiled program
+            out = _unwrap(out)
     finally:
         if training is not None:
             layer.train() if prev_training else layer.eval()
-    return _unwrap(out), new_buffers
+    return out, new_buffers
 
 
 def _unwrap(out):

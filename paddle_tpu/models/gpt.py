@@ -64,6 +64,11 @@ class GPTConfig:
     # compile the block stack as ONE lax.scan over [L, ...]-stacked params
     # instead of L unrolled copies — O(1) HLO in depth (GPTScannedBlocks)
     scan_layers: bool = False
+    # when >0, forward (no-cache path) returns (hidden, lm_weight) instead
+    # of logits and training uses fused_loss_fn — the LM-head projection
+    # streams through F.fused_linear_cross_entropy in chunks of this many
+    # tokens, so the [tokens, vocab] logits never materialize in HBM
+    fused_loss_chunk: int = 0
 
 
 def gpt_tiny(**kw):
@@ -408,7 +413,19 @@ class GPTForCausalLM(Layer):
         if caches is not None:
             x, caches = self.gpt(ids, caches, pos)
             return self._logits(x), caches
-        return self._logits(self.gpt(ids))
+        x = self.gpt(ids)
+        if self.cfg.fused_loss_chunk:
+            # training-perf contract (cfg.fused_loss_chunk): hand the
+            # hidden states + LM weight to fused_loss_fn so the logits
+            # never materialize; decode/caches path above still returns
+            # logits for generate()
+            return x, self._lm_weight()
+        return self._logits(x)
+
+    def _lm_weight(self):
+        if self.cfg.tie_embeddings:
+            return self.gpt.embeddings.word_embeddings.weight  # [V, H]
+        return self.lm_head.weight                             # [H, V]
 
     def _logits(self, x):
         if self.cfg.tie_embeddings:
@@ -439,6 +456,29 @@ class GPTForCausalLM(Layer):
         return T.mean(F.cross_entropy(
             T.reshape(shifted_logits, [-1, V]),
             T.reshape(shifted_labels, [-1])))
+
+    def make_loss_fn(self):
+        """The loss composition this config trains with: fused_loss_fn
+        bound to cfg.fused_loss_chunk when set, else plain loss_fn —
+        call sites never re-encode the contract."""
+        if self.cfg.fused_loss_chunk:
+            import functools
+            return functools.partial(self.fused_loss_fn,
+                                     chunk_size=self.cfg.fused_loss_chunk)
+        return self.loss_fn
+
+    @staticmethod
+    def fused_loss_fn(outputs, labels, chunk_size=512):
+        """loss_fn counterpart for cfg.fused_loss_chunk models: outputs is
+        (hidden, lm_weight) from forward; the shifted tokens stream
+        through F.fused_linear_cross_entropy so [tokens, vocab] logits
+        never materialize."""
+        hidden, w = outputs
+        S = hidden.shape[1]
+        h_s = T.slice(hidden, [1], [0], [S - 1])
+        l_s = T.slice(labels, [1], [1], [S])
+        return F.fused_linear_cross_entropy(h_s, w, l_s,
+                                            chunk_size=chunk_size)
 
 
 class _EmbedStage(Layer):

@@ -20,6 +20,7 @@ __all__ = [
     "sigmoid_focal_loss", "ctc_loss", "poisson_nll_loss", "multi_label_soft_margin_loss",
     "soft_margin_loss", "gaussian_nll_loss", "multi_margin_loss",
     "triplet_margin_with_distance_loss", "hsigmoid_loss", "rnnt_loss",
+    "fused_linear_cross_entropy",
 ]
 
 
@@ -525,3 +526,72 @@ def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
 
     return apply(f, input, label, input_lengths, label_lengths,
                  _op_name="rnnt_loss")
+
+
+def fused_linear_cross_entropy(hidden, weight, label, chunk_size=512,
+                               ignore_index=-100, transpose_weight=None,
+                               name=None):
+    """LM-head projection + softmax cross-entropy WITHOUT materializing
+    the [N, vocab] logits.
+
+    The reference composes a matmul with its fused CE kernel
+    (cross_entropy_kernel.cu), so the full logits tensor lives in HBM in
+    both passes — at GPT geometry (8k tokens x 50k vocab) that is ~824 MB
+    bf16 forward plus the same again for dlogits in backward. Here tokens
+    stream through the projection in chunks under a rematerialized
+    `lax.map`: each chunk's logits exist only transiently, backward
+    recomputes them chunk-wise (jax.checkpoint), and dW accumulates
+    across chunks inside the scan transpose. Peak extra memory is
+    O(chunk_size x vocab) instead of O(N x vocab) — the lever that turns
+    LM-head memory from batch-bound into a constant.
+
+    hidden: [N, H] or [B, S, H]; label: int [N] or [B, S];
+    weight: [V, H] (embedding/tied layout) or [H, V]
+    (``transpose_weight=False``). ``transpose_weight=None`` infers: a
+    square weight is ambiguous and raises. Mean reduction over
+    non-ignored tokens (the LM-training contract).
+    """
+    lbl = label.value if isinstance(label, Tensor) else jnp.asarray(label)
+
+    def f(x, w):
+        H = x.shape[-1]
+        tw = transpose_weight
+        if tw is None:
+            if w.shape[0] == w.shape[1]:
+                raise ValueError(
+                    "fused_linear_cross_entropy: square weight is "
+                    "ambiguous — pass transpose_weight explicitly")
+            tw = w.shape[-1] == H          # [V, H] -> project with w.T
+        V = w.shape[0] if tw else w.shape[-1]
+        xf = x.reshape(-1, H)
+        idx = lbl.reshape(-1)
+        N = xf.shape[0]
+        C = max(1, min(int(chunk_size), N))
+        pad = (-N) % C
+        if pad:
+            xf = jnp.concatenate(
+                [xf, jnp.zeros((pad, H), xf.dtype)], axis=0)
+            idx = jnp.concatenate(
+                [idx, jnp.full((pad,), ignore_index, idx.dtype)], axis=0)
+        xc = xf.reshape(-1, C, H)
+        ic = idx.reshape(-1, C)
+
+        def body(args):
+            xi, ii = args
+            wm = w.T if tw else w
+            lg = jnp.matmul(xi, wm,
+                            preferred_element_type=jnp.float32)  # [C, V]
+            m = jnp.max(lg, axis=-1)
+            s = jnp.sum(jnp.exp(lg - m[:, None]), axis=-1)
+            safe = jnp.clip(ii, 0, V - 1).astype(jnp.int32)
+            gold = jnp.take_along_axis(lg, safe[:, None], axis=-1)[:, 0]
+            per = jnp.log(s) + m - gold
+            valid = ii != ignore_index
+            return (jnp.sum(jnp.where(valid, per, 0.0)),
+                    jnp.sum(valid.astype(jnp.int32)))
+
+        sums, counts = jax.lax.map(jax.checkpoint(body), (xc, ic))
+        total = jnp.sum(counts)
+        return jnp.sum(sums) / jnp.maximum(total, 1).astype(jnp.float32)
+
+    return apply(f, hidden, weight, _op_name="fused_linear_cross_entropy")

@@ -58,8 +58,6 @@ def test_lazy_guard_abstract_params():
         step(paddle.to_tensor(np.zeros((8, 32), "int64")))
 
 
-@pytest.mark.slow
-@pytest.mark.timeout(600)
 def _gpt67_aot_argument_bytes(scan_layers: bool) -> int:
     """BASELINE config 3: GPT-6.7B, dp2 x sharding4, ZeRO-3, remat,
     bf16 params + fp32 master — AOT-compile and return per-device
@@ -87,6 +85,8 @@ def _assert_gpt67_memory(args: int) -> None:
         f"{GPT67_ARGS_RECORDED}")
 
 
+@pytest.mark.slow
+@pytest.mark.timeout(600)
 def test_gpt_6_7b_zero3_remat_aot_fits_v5p():
     """Unrolled variant: must compile and fit v5p HBM."""
     _assert_gpt67_memory(_gpt67_aot_argument_bytes(scan_layers=False))

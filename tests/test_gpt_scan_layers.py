@@ -106,6 +106,60 @@ class TestScanLayersTraining:
         assert n_u == n_s
 
 
+class TestFusedLoss:
+    def test_trajectory_matches_plain(self):
+        # cfg.fused_loss_chunk changes only the loss composition, not
+        # param creation — same seed must give the IDENTICAL trajectory
+        import functools
+        ids = _ids()
+        traj = {}
+        for tag, kw, lf in (
+            ("plain", {}, GPTForCausalLM.loss_fn),
+            ("fused", {"fused_loss_chunk": 32},
+             functools.partial(GPTForCausalLM.fused_loss_fn,
+                               chunk_size=32)),
+        ):
+            paddle.seed(0)
+            m = GPTForCausalLM(gpt_tiny(**kw))
+            opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                         parameters=m.parameters())
+            step = TrainStep(m, lf, opt)
+            traj[tag] = [float(step(ids, ids)) for _ in range(4)]
+        np.testing.assert_allclose(traj["plain"], traj["fused"],
+                                   rtol=1e-5)
+
+    def test_functional_parity_with_ignore_index(self):
+        import paddle_tpu.nn.functional as F
+        import paddle_tpu.tensor as T
+        rng = np.random.RandomState(0)
+        N, H, V = 70, 16, 37  # non-multiple of chunk -> padding path
+        x = paddle.to_tensor(rng.randn(N, H).astype("float32"))
+        w = paddle.to_tensor(rng.randn(V, H).astype("float32"))
+        lbl = rng.randint(0, V, (N,))
+        lbl[::7] = -100
+        lt = paddle.to_tensor(lbl.astype("int64"))
+        x.stop_gradient = False
+        w.stop_gradient = False
+        loss_f = F.fused_linear_cross_entropy(x, w, lt, chunk_size=16)
+        loss_f.backward()
+        gx, gw = np.asarray(x.grad), np.asarray(w.grad)
+        x.clear_grad(), w.clear_grad()
+        logits = paddle.matmul(x, T.transpose(w, [1, 0]))
+        loss_r = F.cross_entropy(logits, lt, ignore_index=-100)
+        loss_r.backward()
+        assert abs(float(loss_f) - float(loss_r)) < 1e-5
+        np.testing.assert_allclose(gx, np.asarray(x.grad), atol=1e-6)
+        np.testing.assert_allclose(gw, np.asarray(w.grad), atol=1e-6)
+
+    def test_square_weight_raises(self):
+        import paddle_tpu.nn.functional as F
+        x = paddle.to_tensor(np.zeros((4, 8), "float32"))
+        w = paddle.to_tensor(np.eye(8, dtype="float32"))
+        lbl = paddle.to_tensor(np.zeros((4,), "int64"))
+        with pytest.raises(ValueError, match="ambiguous"):
+            F.fused_linear_cross_entropy(x, w, lbl)
+
+
 class TestScanLayersDistributed:
     def test_dp_mp_step_matches_unrolled(self):
         # the stacked leaves carry (None,)+inner sharding annotations —

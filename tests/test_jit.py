@@ -42,6 +42,36 @@ def test_functional_call_matches_eager():
     np.testing.assert_allclose(np.asarray(out), eager, rtol=1e-6)
 
 
+def test_functional_call_returned_parameter_is_traced():
+    """A forward that RETURNS a Parameter (e.g. a tied LM weight handed
+    to a fused loss) must yield the swapped-in value, not the stale
+    concrete array — regression for the unwrap-after-restore bug that
+    silently froze such leaves in compiled programs (grads through the
+    returned leaf were zero)."""
+    import jax
+
+    class ReturnsWeight(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            return self.fc(x), self.fc.weight
+
+    m = ReturnsWeight()
+    params, buffers = raw_state(m)
+    x = np.random.randn(2, 4).astype("float32")
+
+    def loss(p):
+        (out, w), _ = functional_call(m, p, buffers, paddle.to_tensor(x))
+        return jax.numpy.sum(out * 0.0) + jax.numpy.sum(w ** 2)
+
+    g = jax.grad(loss)(params)["fc.weight"]
+    expect = 2 * params["fc.weight"]
+    np.testing.assert_allclose(np.asarray(g), np.asarray(expect),
+                               rtol=1e-6)
+
+
 def test_to_static_forward_and_backward():
     m = MLP()
     x = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
