@@ -68,6 +68,16 @@ def _peak_flops(kind: str) -> float:
 
 def _log(msg: str) -> None:
     sys.stderr.write("[bench] %s\n" % msg)
+
+
+def _int_env(name: str, default: int) -> int:
+    """Guarded env parse: a typo'd value must never abort the bench
+    before it prints its JSON line (the driver contract)."""
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        _log("bad %s, using default %d" % (name, default))
+        return default
     sys.stderr.flush()
 
 
@@ -184,12 +194,14 @@ def main():
                         num_heads=16, max_seq_len=seq, recompute=True,
                         scan_layers=os.environ.get(
                             "PADDLE_TPU_BENCH_SCAN", "1") != "0",
-                        fused_loss_chunk=int(os.environ.get(
-                            "PADDLE_TPU_BENCH_FUSED_CE", "2048")))
+                        fused_loss_chunk=_int_env(
+                            "PADDLE_TPU_BENCH_FUSED_CE", 2048))
         multi_precision = False
     else:
         cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
-                        num_heads=12, max_seq_len=seq)
+                        num_heads=12, max_seq_len=seq,
+                        fused_loss_chunk=_int_env(
+                            "PADDLE_TPU_BENCH_FUSED_CE", 0))
 
     paddle.seed(0)
     model = GPTForCausalLM(cfg)
@@ -212,14 +224,9 @@ def main():
     # so for non-driver configs waiting out a slow compile is strictly
     # cheaper than killing it. The driver metric (125M, ~3 min measured)
     # keeps the tight budget.
-    default_budget = 3600 if _MODEL_SEL == "gpt1.3b" else 900
-    try:
-        budget = int(os.environ.get("PADDLE_TPU_BENCH_COMPILE_BUDGET",
-                                    default_budget))
-    except ValueError:
-        _log("bad PADDLE_TPU_BENCH_COMPILE_BUDGET, using default")
-        budget = default_budget
-    dog.stage("compiling", budget)
+    dog.stage("compiling",
+              _int_env("PADDLE_TPU_BENCH_COMPILE_BUDGET",
+                       3600 if _MODEL_SEL == "gpt1.3b" else 900))
     loss = step(ids, ids)
     float(loss)
     dog.stage("warmup", 120)

@@ -11,9 +11,11 @@ Caches are donated so XLA updates them in place in HBM.
 
 Works with any model exposing:
   forward(ids, caches, pos) -> (logits, caches)   (cache-threaded forward)
-  new_cache(batch, max_len, dtype) -> [(k, v), ...]
-GPTForCausalLM and LlamaForCausalLM both do; `model.generate(...)`
-delegates here.
+  new_cache(batch, max_len, dtype) -> caches
+where `caches` is ANY pytree the model's forward threads through —
+per-layer [(k, v), ...] for unrolled stacks, a stacked
+(k_stack, v_stack) pair for scan_layers models. GPTForCausalLM and
+LlamaForCausalLM both do; `model.generate(...)` delegates here.
 """
 from __future__ import annotations
 

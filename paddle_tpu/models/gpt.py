@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import jax
 
 from .. import tensor as T
+from ..core.tensor import Tensor
 from ..jit.functional import functional_call
 from ..distributed import mesh as mesh_mod
 from ..distributed.meta_parallel import (ColumnParallelLinear, LayerDesc,
@@ -213,10 +214,13 @@ class GPTScannedBlocks(Layer):
     under TrainStep the stacked leaves are ordinary donated parameters
     (Adam slots stack with them).
 
+    KV-cache decode works too: caches live stacked `[L, B, max_len, nh,
+    hd]` and rotate through the same scan (``forward_cached``), so a
+    scanned model serves `generate()` directly.
+
     Restrictions (loud): no MoE (aux-loss side channel would cross the
     scan/checkpoint boundary), no dropout (the traced-once body would
-    reuse one RNG draw for every layer), no KV-cache decode (serving
-    uses the unrolled model; `jit.save` artifacts are unaffected).
+    reuse one RNG draw for every layer).
     """
 
     def __init__(self, cfg: GPTConfig):
@@ -293,11 +297,15 @@ class GPTScannedBlocks(Layer):
             # keep the scanned model's precision (e.g. after .bfloat16())
             target.value = jnp.stack(vals).astype(target.value.dtype)
 
+    def _scan_leaves(self):
+        """(template, names, stacked leaves) — the ONE definition of the
+        leaf ordering fed to lax.scan; train and decode must agree."""
+        return (self._template[0], self._names,
+                [self._parameters[self._mangle(n)] for n in self._names])
+
     def forward(self, x):
         from ..autograd import tape as _tape
-        tmpl = self._template[0]
-        names = self._names
-        leaves = [self._parameters[self._mangle(n)] for n in names]
+        tmpl, names, leaves = self._scan_leaves()
         training = self.training
         recompute = self.cfg.recompute and training
 
@@ -316,6 +324,31 @@ class GPTScannedBlocks(Layer):
             return out
 
         return _tape.apply(run, x, *leaves, _op_name="gpt_scanned_blocks")
+
+    def forward_cached(self, x, caches, pos):
+        """Decode step: caches is (k_stack, v_stack), each [L, B, M, nh,
+        hd]; every layer's slice rotates through the same scan body."""
+        from ..autograd import tape as _tape
+        tmpl, names, leaves = self._scan_leaves()
+        k_stack, v_stack = caches
+        pos_raw = pos.value if isinstance(pos, Tensor) else pos
+
+        def run(h, kst, vst, *stacked):
+            def body(carry, xs):
+                psl_leaves, kc, vc = xs
+                psl = dict(zip(names, psl_leaves))
+                out, _ = functional_call(tmpl, psl, {}, carry, (kc, vc),
+                                         pos_raw, training=False)
+                h2, (kc2, vc2) = out
+                return h2, (kc2, vc2)
+
+            h2, (knew, vnew) = jax.lax.scan(
+                body, h, (list(stacked), kst, vst))
+            return h2, knew, vnew
+
+        h_t, k_t, v_t = _tape.apply(run, x, k_stack, v_stack, *leaves,
+                                    _op_name="gpt_scanned_decode")
+        return h_t, (k_t, v_t)
 
 
 class GPTEmbeddings(Layer):
@@ -361,12 +394,10 @@ class GPTModel(Layer):
 
     def forward(self, ids, caches=None, pos=None):
         if caches is not None:
-            if self.cfg.scan_layers:
-                raise NotImplementedError(
-                    "KV-cache decode with scan_layers: serving uses the "
-                    "unrolled model (convert via "
-                    "GPTScannedBlocks.load_from_blocks' inverse layout)")
             x = self.embeddings(ids, pos)
+            if self.cfg.scan_layers:
+                x, new_caches = self.blocks.forward_cached(x, caches, pos)
+                return self.ln_f(x), new_caches
             new_caches = []
             for blk, c in zip(self.blocks, caches):
                 x, c = blk(x, c, pos)
@@ -439,6 +470,9 @@ class GPTForCausalLM(Layer):
         cfg = self.cfg
         hd = cfg.hidden_size // cfg.num_heads
         shape = (batch_size, max_len, cfg.num_heads, hd)
+        if cfg.scan_layers:  # stacked layout for forward_cached's scan
+            sshape = (cfg.num_layers,) + shape
+            return (jnp.zeros(sshape, dtype), jnp.zeros(sshape, dtype))
         return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
                 for _ in range(cfg.num_layers)]
 

@@ -200,9 +200,15 @@ class TestScanLayersGuards:
         with pytest.raises(NotImplementedError, match="dropout"):
             GPTForCausalLM(gpt_tiny(scan_layers=True, dropout=0.1))
 
-    def test_cache_decode_raises(self):
-        m = GPTForCausalLM(gpt_tiny(scan_layers=True))
-        ids = _ids(seq=8)
-        caches = m.new_cache(2, 16)
-        with pytest.raises(NotImplementedError, match="unrolled"):
-            m(ids, caches, paddle.to_tensor(np.int32(0)))
+    def test_greedy_decode_matches_unrolled(self):
+        # stacked-cache decode: same params -> same greedy continuation
+        m_u, m_s = _scanned_pair()
+        prompt = paddle.to_tensor(
+            np.random.RandomState(3).randint(0, 256, (2, 12)).astype(
+                "int64"))
+        out_u = m_u.generate(prompt, max_new_tokens=8, do_sample=False,
+                             cache_dtype="float32")
+        out_s = m_s.generate(prompt, max_new_tokens=8, do_sample=False,
+                             cache_dtype="float32")
+        np.testing.assert_array_equal(np.asarray(out_u),
+                                      np.asarray(out_s))
