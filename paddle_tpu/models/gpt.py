@@ -40,6 +40,7 @@ from ..nn import functional as F
 from ..nn import initializer as I
 from ..nn.layer_base import Layer
 from ..nn import Dropout, Embedding, LayerNorm, Linear
+from .scanned import ScannedStack
 
 __all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM",
            "GPTPipelineForCausalLM", "gpt_tiny", "gpt_125m", "gpt_1p3b",
@@ -192,39 +193,13 @@ class GPTBlock(Layer):
         return x
 
 
-class GPTScannedBlocks(Layer):
-    """The whole decoder stack as ONE set of stacked parameters + lax.scan.
-
-    TPU-first compile-time scaling (``cfg.scan_layers``): the unrolled
-    block list emits O(num_layers) copies of identical HLO, so XLA
-    compile time grows linearly with depth — the round-4 1.3B (24-layer)
-    whole-step program exceeded a 25-minute compile budget through the
-    remote-compile tunnel. Here every block parameter lives as a single
-    ``[L, ...]``-stacked leaf and the stack is applied with
-    ``jax.lax.scan``, so XLA compiles the block body ONCE regardless of
-    depth (the idiom flax calls scan-over-layers; the reference has no
-    analog — its executor re-dispatches per-op per-layer anyway, see
-    SURVEY.md §3.3).
-
-    Semantics are identical to the unrolled stack: the scan body swaps
-    the i-th parameter slice into a template GPTBlock and runs its
-    ordinary ``forward``. Per-block rematerialisation (``cfg.recompute``)
-    becomes ``jax.checkpoint`` on the scan body. Eager autograd works —
-    the scan is recorded on the tape as one op via ``tape.apply`` — and
-    under TrainStep the stacked leaves are ordinary donated parameters
-    (Adam slots stack with them).
-
-    KV-cache decode works too: caches live stacked `[L, B, max_len, nh,
-    hd]` and rotate through the same scan (``forward_cached``), so a
-    scanned model serves `generate()` directly.
-
-    Restrictions (loud): no MoE (aux-loss side channel would cross the
-    scan/checkpoint boundary), no dropout (the traced-once body would
-    reuse one RNG draw for every layer).
-    """
+class GPTScannedBlocks(ScannedStack):
+    """GPT decoder stack as one lax.scan (``cfg.scan_layers``) — see
+    models/scanned.py for the full design. GPT-specific guards: no MoE
+    (aux-loss side channel cannot cross the scan body), no dropout
+    (traced-once body would reuse one RNG draw per layer)."""
 
     def __init__(self, cfg: GPTConfig):
-        super().__init__()
         if cfg.use_moe:
             raise NotImplementedError(
                 "scan_layers with use_moe: the MoE aux-loss side channel "
@@ -235,120 +210,9 @@ class GPTScannedBlocks(Layer):
                 "scan_layers requires dropout=0.0: the scan body is "
                 "traced once, so every layer would reuse the same "
                 "dropout mask")
+        super().__init__(lambda: GPTBlock(cfg), cfg.num_layers,
+                         cfg.initializer_range, recompute=cfg.recompute)
         self.cfg = cfg
-        # plain-list attribute: provides structure + forward only — built
-        # abstract (LazyGuard) so its parameters are ShapeDtypeStructs,
-        # not ~200 MB of resident f32 draws that compute never touches
-        from ..framework.lazy_init import LazyGuard
-        with LazyGuard():
-            self._template = [GPTBlock(cfg)]
-        tmpl = self._template[0]
-        if list(tmpl.named_buffers()):
-            raise NotImplementedError(
-                "scan_layers with buffered blocks: buffers are not "
-                "stacked across layers (same restriction as "
-                "PipelineLayer body blocks)")
-        L = cfg.num_layers
-        w_init = I.Normal(0.0, cfg.initializer_range)
-        self._names = []
-        for name, p in tmpl.named_parameters():
-            shape = [L] + list(p.shape)
-            if len(p.shape) >= 2:
-                # matmul weights: L independent Normal draws == one draw
-                # of the stacked shape
-                value = w_init(shape, "float32")
-            elif name.endswith(".weight"):  # LayerNorm scales
-                value = I.Constant(1.0)(shape, "float32")
-            else:  # biases
-                value = I.Constant(0.0)(shape, "float32")
-            sp = type(p)(value)
-            # stacked leaf keeps the block's TP annotation with the layer
-            # axis unsharded (same pattern as PipelineLayer._stack_params,
-            # which prepends "pp"); scan runs every layer on every chip
-            inner = p.sharding_axes
-            if inner is not None:
-                sp.sharding_axes = (None,) + tuple(inner)
-            sp.is_distributed = p.is_distributed
-            self.add_parameter(self._mangle(name), sp)
-            self._names.append(name)
-
-    @staticmethod
-    def _mangle(name: str) -> str:
-        # parameter-dict keys must not contain "." (named_parameters
-        # joins hierarchy with "."); keep a reversible encoding
-        return name.replace(".", "__")
-
-    def load_from_blocks(self, blocks) -> None:
-        """Stack per-layer params from an unrolled block list (checkpoint
-        interop: unrolled state_dicts convert mechanically)."""
-        blocks = list(blocks)
-        if len(blocks) != self.cfg.num_layers:
-            raise ValueError(
-                f"load_from_blocks: got {len(blocks)} blocks for a "
-                f"num_layers={self.cfg.num_layers} model")
-        per_layer = [dict(b.named_parameters()) for b in blocks]
-        for name in self._names:
-            vals = [d[name].value for d in per_layer]
-            if any(isinstance(v, jax.ShapeDtypeStruct) for v in vals):
-                raise ValueError(
-                    "load_from_blocks: source blocks hold abstract "
-                    "(LazyGuard) parameters — materialize them first")
-            target = self._parameters[self._mangle(name)]
-            # keep the scanned model's precision (e.g. after .bfloat16())
-            target.value = jnp.stack(vals).astype(target.value.dtype)
-
-    def _scan_leaves(self):
-        """(template, names, stacked leaves) — the ONE definition of the
-        leaf ordering fed to lax.scan; train and decode must agree."""
-        return (self._template[0], self._names,
-                [self._parameters[self._mangle(n)] for n in self._names])
-
-    def forward(self, x):
-        from ..autograd import tape as _tape
-        tmpl, names, leaves = self._scan_leaves()
-        training = self.training
-        recompute = self.cfg.recompute and training
-
-        def run(h, *stacked):
-            def body(h, psl):
-                out, _ = functional_call(tmpl, dict(zip(names, psl)), {},
-                                         h, training=training)
-                return out
-            if recompute:
-                body = jax.checkpoint(body)
-
-            def scan_body(h, psl):
-                return body(h, psl), None
-
-            out, _ = jax.lax.scan(scan_body, h, list(stacked))
-            return out
-
-        return _tape.apply(run, x, *leaves, _op_name="gpt_scanned_blocks")
-
-    def forward_cached(self, x, caches, pos):
-        """Decode step: caches is (k_stack, v_stack), each [L, B, M, nh,
-        hd]; every layer's slice rotates through the same scan body."""
-        from ..autograd import tape as _tape
-        tmpl, names, leaves = self._scan_leaves()
-        k_stack, v_stack = caches
-        pos_raw = pos.value if isinstance(pos, Tensor) else pos
-
-        def run(h, kst, vst, *stacked):
-            def body(carry, xs):
-                psl_leaves, kc, vc = xs
-                psl = dict(zip(names, psl_leaves))
-                out, _ = functional_call(tmpl, psl, {}, carry, (kc, vc),
-                                         pos_raw, training=False)
-                h2, (kc2, vc2) = out
-                return h2, (kc2, vc2)
-
-            h2, (knew, vnew) = jax.lax.scan(
-                body, h, (list(stacked), kst, vst))
-            return h2, knew, vnew
-
-        h_t, k_t, v_t = _tape.apply(run, x, k_stack, v_stack, *leaves,
-                                    _op_name="gpt_scanned_decode")
-        return h_t, (k_t, v_t)
 
 
 class GPTEmbeddings(Layer):

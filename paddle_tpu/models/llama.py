@@ -51,6 +51,14 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     rms_eps: float = 1e-6
     initializer_range: float = 0.02
+    # rematerialize each block in backward (jax.checkpoint) — scan path
+    recompute: bool = False
+    # compile the block stack as ONE lax.scan over [L, ...]-stacked params
+    # (models/scanned.py ScannedStack) — depth-independent HLO
+    scan_layers: bool = False
+    # when >0, forward (no-cache path) returns (hidden, lm_weight) and
+    # training uses fused_loss_fn (F.fused_linear_cross_entropy)
+    fused_loss_chunk: int = 0
 
     @property
     def kv_heads(self) -> int:
@@ -212,11 +220,18 @@ class LlamaModel(Layer):
         self.embed_tokens = VocabParallelEmbedding(
             cfg.vocab_size, cfg.hidden_size,
             weight_attr=I.Normal(0.0, cfg.initializer_range))
-        self.blocks = []
-        for i in range(cfg.num_layers):
-            blk = LlamaBlock(cfg)
-            self.add_sublayer(f"block_{i}", blk)
-            self.blocks.append(blk)
+        if cfg.scan_layers:
+            from .scanned import ScannedStack
+            self.blocks = ScannedStack(lambda: LlamaBlock(cfg),
+                                       cfg.num_layers,
+                                       cfg.initializer_range,
+                                       recompute=cfg.recompute)
+        else:
+            self.blocks = []
+            for i in range(cfg.num_layers):
+                blk = LlamaBlock(cfg)
+                self.add_sublayer(f"block_{i}", blk)
+                self.blocks.append(blk)
         self.norm = RMSNorm(cfg.hidden_size, cfg.rms_eps)
 
     def forward(self, ids, caches=None, pos=None):
@@ -226,13 +241,23 @@ class LlamaModel(Layer):
                 f"{self.cfg.max_seq_len}")
         x = self.embed_tokens(ids)
         if caches is not None:
+            if self.cfg.scan_layers:
+                x, new_caches = self.blocks.forward_cached(x, caches, pos)
+                return self.norm(x), new_caches
             new_caches = []
             for blk, c in zip(self.blocks, caches):
                 x, c = blk(x, c, pos)
                 new_caches.append(c)
             return self.norm(x), new_caches
-        for blk in self.blocks:
-            x = blk(x)
+        if self.cfg.scan_layers:
+            return self.norm(self.blocks(x))
+        if self.cfg.recompute and self.training:
+            from ..distributed.recompute import recompute as _rc
+            for blk in self.blocks:
+                x = _rc(blk, x)
+        else:
+            for blk in self.blocks:
+                x = blk(x)
         return self.norm(x)
 
 
@@ -250,13 +275,26 @@ class LlamaForCausalLM(Layer):
         if caches is not None:
             x, caches = self.llama(ids, caches, pos)
             return self.lm_head(x), caches
-        return self.lm_head(self.llama(ids))
+        x = self.llama(ids)
+        if self.cfg.fused_loss_chunk:
+            # training-perf contract: hand (hidden, lm_weight [H, V]) to
+            # fused_loss_fn so the logits never materialize
+            return x, self.lm_head.weight
+        return self.lm_head(x)
+
+    def make_loss_fn(self):
+        from .gpt import GPTForCausalLM
+        return GPTForCausalLM.make_loss_fn(self)
 
     def new_cache(self, batch_size: int, max_len: int, dtype="bfloat16"):
-        """Per-layer (k, v) caches [B, max_len, n_kv_heads, hd]."""
+        """Per-layer (k, v) caches [B, max_len, n_kv_heads, hd];
+        stacked (k_stack, v_stack) for scan_layers models."""
         cfg = self.cfg
         hd = cfg.hidden_size // cfg.num_heads
         shape = (batch_size, max_len, cfg.kv_heads, hd)
+        if cfg.scan_layers:
+            sshape = (cfg.num_layers,) + shape
+            return (jnp.zeros(sshape, dtype), jnp.zeros(sshape, dtype))
         return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
                 for _ in range(cfg.num_layers)]
 
@@ -269,6 +307,12 @@ class LlamaForCausalLM(Layer):
     def loss_fn(logits, labels):
         from .gpt import GPTForCausalLM
         return GPTForCausalLM.loss_fn(logits, labels)
+
+    @staticmethod
+    def fused_loss_fn(outputs, labels, chunk_size=512):
+        from .gpt import GPTForCausalLM
+        return GPTForCausalLM.fused_loss_fn(outputs, labels,
+                                            chunk_size=chunk_size)
 
 
 class _EmbedStage(Layer):
