@@ -32,6 +32,7 @@ V5P_HBM = 95 * 2 ** 30          # public v5p HBM per chip
 # temps are informational only.
 GPT67_ARGS_RECORDED = 24_026_312_712      # dp2 x sharding4, ZeRO-3, bf16
 LLAMA13_ARGS_RECORDED = 27_350_000_000    # mp2 x pp2 x dp2, ZeRO-2, f32
+LLAMA13_SCAN_ARGS_RECORDED = 45_555_590_664  # dp2 x sharding4, ZeRO-3, bf16+master
 
 
 @pytest.fixture(autouse=True)
@@ -101,6 +102,33 @@ def test_gpt_6_7b_scan_layers_aot_fast():
     to run in every CI profile — depth-independent compile is the
     feature; this guards it at north-star scale."""
     _assert_gpt67_memory(_gpt67_aot_argument_bytes(scan_layers=True))
+
+
+@pytest.mark.timeout(300)
+def test_llama_13b_scan_zero3_aot_fast():
+    """BASELINE config 4 through the non-pipeline lens: LLaMA-13B
+    (40 layers), ZeRO-3 + remat + scan_layers + fused CE, bf16 params.
+    Depth-independent compile makes the full 13B step AOT-compile in
+    seconds, so the config is guarded in every CI profile (the pipeline
+    variant remains the slow-marked test below)."""
+    dist.init_mesh({"dp": 2, "sharding": 4})
+    with paddle.LazyGuard():
+        # step-level remat only (like the GPT counterpart); cfg.recompute
+        # would nest a second jax.checkpoint inside the scan body
+        model = LlamaForCausalLM(llama_13b(scan_layers=True,
+                                           fused_loss_chunk=2048))
+        model.bfloat16()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4, multi_precision=True,
+                                 parameters=model.parameters())
+    step = dist.ParallelTrainStep(model, model.make_loss_fn(), opt,
+                                  zero_stage=3, remat=True)
+    ids = jax.ShapeDtypeStruct((8, 2048), jnp.int64)
+    compiled = step.aot_compile(ids, ids)
+    args = compiled.memory_analysis().argument_size_in_bytes
+    assert args < 0.9 * V5P_HBM, f"13B scan step needs {args/2**30:.1f}GiB"
+    assert args < 1.1 * LLAMA13_SCAN_ARGS_RECORDED, (
+        f"per-device argument memory regressed: {args} vs recorded "
+        f"{LLAMA13_SCAN_ARGS_RECORDED}")
 
 
 def test_bf16_pipeline_lowers_for_tpu():

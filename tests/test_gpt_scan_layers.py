@@ -22,12 +22,13 @@ def _ids(batch=2, seq=64, vocab=256):
         rng.randint(0, vocab, (batch, seq)).astype("int64"))
 
 
-def _scanned_pair():
-    """(unrolled, scanned) models with identical parameters."""
+def _scanned_pair(**scan_cfg_kw):
+    """(unrolled, scanned) GPT models with identical parameters;
+    scan_cfg_kw adds config fields to the scanned model only."""
     paddle.seed(0)
     m_u = GPTForCausalLM(gpt_tiny())
     paddle.seed(1)  # different init seed: copy must erase the difference
-    m_s = GPTForCausalLM(gpt_tiny(scan_layers=True))
+    m_s = GPTForCausalLM(gpt_tiny(scan_layers=True, **scan_cfg_kw))
     m_s.gpt.blocks.load_from_blocks(m_u.gpt.blocks)
     sd_u = dict(m_u.named_parameters())
     for n, p in m_s.named_parameters():
@@ -226,6 +227,28 @@ class TestLlamaScanLayers:
                              cache_dtype="float32")
         np.testing.assert_array_equal(np.asarray(out_u),
                                       np.asarray(out_s))
+
+
+class TestFusedScanDistributed:
+    def test_dp_mp_fused_scan_matches_plain(self):
+        # the full composition: scanned TP blocks + fused CE over the
+        # vocab-sharded tied weight, under the hybrid engine — GSPMD must
+        # insert the cross-shard collectives for the chunked logsumexp
+        import paddle_tpu.distributed as dist
+        dist.init_mesh({"dp": 2, "mp": 2})
+        try:
+            ids = _ids(batch=4, seq=48)
+            m_plain, m_fused = _scanned_pair(fused_loss_chunk=32)
+            traj = {}
+            for tag, m in (("plain", m_plain), ("fused+scan", m_fused)):
+                opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                             parameters=m.parameters())
+                step = dist.ParallelTrainStep(m, m.make_loss_fn(), opt)
+                traj[tag] = [float(step(ids, ids)) for _ in range(3)]
+            np.testing.assert_allclose(traj["plain"], traj["fused+scan"],
+                                       rtol=2e-4)
+        finally:
+            dist.set_mesh(None)
 
 
 class TestScanLayersGuards:
