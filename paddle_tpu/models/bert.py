@@ -47,6 +47,9 @@ class BertConfig:
     dropout: float = 0.1
     initializer_range: float = 0.02
     layer_norm_eps: float = 1e-12
+    # compile the encoder stack as ONE lax.scan over stacked params
+    # (models/scanned.py) — depth-independent HLO; requires dropout=0.0
+    scan_layers: bool = False
 
 
 def bert_tiny(**kw):
@@ -178,12 +181,21 @@ class BertModel(Layer):
     def __init__(self, cfg: BertConfig):
         super().__init__()
         self.cfg = cfg
+        if cfg.scan_layers:  # guard before any submodule allocates
+            from .scanned import ScannedStack
+            ScannedStack.reject_dropout(cfg.dropout)
         self.embeddings = BertEmbeddings(cfg)
-        self.layers = []
-        for i in range(cfg.num_layers):
-            layer = BertLayer(cfg)
-            self.add_sublayer(f"layer_{i}", layer)
-            self.layers.append(layer)
+        if cfg.scan_layers:
+            from .scanned import ScannedStack
+            self.layers = ScannedStack(lambda: BertLayer(cfg),
+                                       cfg.num_layers,
+                                       cfg.initializer_range)
+        else:
+            self.layers = []
+            for i in range(cfg.num_layers):
+                layer = BertLayer(cfg)
+                self.add_sublayer(f"layer_{i}", layer)
+                self.layers.append(layer)
         self.pooler = BertPooler(cfg)
 
     def forward(self, ids, token_type_ids=None, attention_mask=None):
@@ -194,6 +206,10 @@ class BertModel(Layer):
             mask = T.reshape((m - 1.0) * 1e30,
                              [m.shape[0], 1, 1, m.shape[1]])
         x = self.embeddings(ids, token_type_ids)
+        if self.cfg.scan_layers:
+            x = self.layers(x, mask) if mask is not None \
+                else self.layers(x)
+            return x, self.pooler(x)
         for layer in self.layers:
             x = layer(x, mask)
         return x, self.pooler(x)

@@ -205,11 +205,7 @@ class GPTScannedBlocks(ScannedStack):
                 "scan_layers with use_moe: the MoE aux-loss side channel "
                 "cannot cross the lax.scan body; use the unrolled stack "
                 "or GPTPipelineForCausalLM")
-        if cfg.dropout:
-            raise NotImplementedError(
-                "scan_layers requires dropout=0.0: the scan body is "
-                "traced once, so every layer would reuse the same "
-                "dropout mask")
+        ScannedStack.reject_dropout(cfg.dropout)
         super().__init__(lambda: GPTBlock(cfg), cfg.num_layers,
                          cfg.initializer_range, recompute=cfg.recompute)
         self.cfg = cfg

@@ -91,6 +91,16 @@ class ScannedStack(Layer):
             self._names.append(name)
 
     @staticmethod
+    def reject_dropout(p: float) -> None:
+        """Caller-side guard: stochastic blocks cannot scan — the body is
+        traced once, so every layer would reuse one RNG draw."""
+        if p:
+            raise NotImplementedError(
+                "scan_layers requires dropout=0.0: the scan body is "
+                "traced once, so every layer would reuse the same "
+                "dropout mask")
+
+    @staticmethod
     def _mangle(name: str) -> str:
         # parameter-dict keys must not contain "." (named_parameters
         # joins hierarchy with "."); keep a reversible encoding
@@ -121,16 +131,22 @@ class ScannedStack(Layer):
             # keep the scanned model's precision (e.g. after .bfloat16())
             target.value = jnp.stack(vals).astype(target.value.dtype)
 
-    def forward(self, x):
+    def forward(self, x, *extra):
+        """Apply the stack to x. ``extra`` are layer-INVARIANT positional
+        args handed to every block unchanged (e.g. an attention mask for
+        encoder blocks) — they ride along as differentiable inputs."""
         from ..autograd import tape as _tape
         tmpl, names, leaves = self._scan_leaves()
         training = self.training
         recompute = self.recompute and training
+        n_extra = len(extra)
 
-        def run(h, *stacked):
+        def run(h, *rest):
+            ex, stacked = rest[:n_extra], rest[n_extra:]
+
             def body(h, psl):
                 out, _ = functional_call(tmpl, dict(zip(names, psl)), {},
-                                         h, training=training)
+                                         h, *ex, training=training)
                 return out
             if recompute:
                 body = jax.checkpoint(body)
@@ -141,7 +157,8 @@ class ScannedStack(Layer):
             out, _ = jax.lax.scan(scan_body, h, list(stacked))
             return out
 
-        return _tape.apply(run, x, *leaves, _op_name="scanned_stack")
+        return _tape.apply(run, x, *extra, *leaves,
+                           _op_name="scanned_stack")
 
     def forward_cached(self, x, caches, pos):
         """Decode step: caches is (k_stack, v_stack), each [L, B, M,

@@ -251,6 +251,65 @@ class TestFusedScanDistributed:
             dist.set_mesh(None)
 
 
+class TestBertScanLayers:
+    """ScannedStack with a layer-invariant extra arg (the additive
+    attention mask) — the encoder-family wiring."""
+
+    def test_masked_forward_matches_unrolled(self):
+        from paddle_tpu.models.bert import BertModel, bert_tiny
+        rng = np.random.RandomState(0)
+        ids = paddle.to_tensor(rng.randint(0, 512, (3, 24)).astype(
+            "int64"))
+        mask_np = np.ones((3, 24), "int64")
+        mask_np[:, 18:] = 0
+        mask = paddle.to_tensor(mask_np)
+        paddle.seed(0)
+        m_u = BertModel(bert_tiny())
+        m_s = BertModel(bert_tiny(scan_layers=True))
+        m_s.layers.load_from_blocks(m_u.layers)
+        sd = dict(m_u.named_parameters())
+        for n, p in m_s.named_parameters():
+            if not n.startswith("layers."):
+                p.value = sd[n].value
+        seq_u, pool_u = m_u(ids, attention_mask=mask)
+        seq_s, pool_s = m_s(ids, attention_mask=mask)
+        np.testing.assert_allclose(np.asarray(seq_u.value),
+                                   np.asarray(seq_s.value), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(pool_u.value),
+                                   np.asarray(pool_s.value), atol=1e-5)
+
+    def test_finetune_trains_through_mask(self):
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.models.bert import (BertForSequenceClassification,
+                                            bert_tiny)
+        rng = np.random.RandomState(1)
+        ids = paddle.to_tensor(rng.randint(0, 512, (3, 24)).astype(
+            "int64"))
+        mask_np = np.ones((3, 24), "int64")
+        mask_np[:, 20:] = 0  # real padding: grads flow past -1e30 masks
+        mask = paddle.to_tensor(mask_np)
+        y = paddle.to_tensor(rng.randint(0, 3, (3,)).astype("int64"))
+        paddle.seed(1)
+        clf = BertForSequenceClassification(bert_tiny(scan_layers=True),
+                                            num_classes=3)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=clf.parameters())
+        losses = []
+        for _ in range(4):
+            loss = F.cross_entropy(clf(ids, attention_mask=mask), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0], losses
+
+    def test_dropout_raises(self):
+        # bert_base keeps the real default dropout=0.1
+        from paddle_tpu.models.bert import BertModel, bert_base
+        with pytest.raises(NotImplementedError, match="dropout"):
+            BertModel(bert_base(scan_layers=True))
+
+
 class TestScanLayersGuards:
     def test_moe_raises(self):
         with pytest.raises(NotImplementedError, match="use_moe"):
