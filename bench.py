@@ -195,7 +195,9 @@ def main():
                         scan_layers=os.environ.get(
                             "PADDLE_TPU_BENCH_SCAN", "1") != "0",
                         fused_loss_chunk=_int_env(
-                            "PADDLE_TPU_BENCH_FUSED_CE", 2048))
+                            "PADDLE_TPU_BENCH_FUSED_CE", 2048),
+                        recompute_policy=os.environ.get(
+                            "PADDLE_TPU_BENCH_REMAT_POLICY", "full"))
         multi_precision = False
     else:
         cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,

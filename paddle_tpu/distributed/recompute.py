@@ -11,6 +11,7 @@ _split_activation/_merge_activation partitioning (recompute_hybrid.py:31,55).
 """
 from __future__ import annotations
 
+import functools
 from typing import Callable, Sequence
 
 import jax
@@ -23,6 +24,26 @@ from ..nn.layer_base import Layer
 __all__ = ["recompute", "recompute_sequential"]
 
 
+# named remat policies: "full" saves nothing (minimum memory, recomputes
+# the whole block); "dots" saves matmul outputs (recomputes only
+# elementwise/norm ops — trades HBM for a ~1/3 cut in recompute FLOPs)
+_POLICIES = {"full": None, "dots": "dots_with_no_batch_dims_saveable"}
+
+
+def resolve_checkpoint_policy(policy):
+    """Resolve a policy name ("full"/"dots"), a jax.checkpoint_policies
+    callable, or None into the `policy=` argument for jax.checkpoint."""
+    if policy is None or callable(policy):
+        return policy
+    try:
+        name = _POLICIES[policy]
+    except KeyError:
+        raise ValueError(
+            f"recompute policy {policy!r} not in {sorted(_POLICIES)} "
+            "(or pass a jax.checkpoint_policies callable)") from None
+    return getattr(jax.checkpoint_policies, name) if name else None
+
+
 def recompute(function, *args, **kwargs):
     """Parity: paddle.distributed.fleet.utils.recompute.
 
@@ -30,9 +51,12 @@ def recompute(function, *args, **kwargs):
     during backward instead of saving activations. Extra kwargs
     (use_reentrant, preserve_rng_state) are accepted for API parity —
     rematerialization on XLA is always "non-reentrant" and RNG-correct.
+    TPU extension: `policy=` ("full"/"dots" or a jax.checkpoint_policies
+    callable) selects what the remat saves.
     """
     kwargs.pop("use_reentrant", None)
     kwargs.pop("preserve_rng_state", None)
+    ckpt_policy = resolve_checkpoint_policy(kwargs.pop("policy", None))
     layer = function
     if not isinstance(layer, Layer):
         layer = getattr(function, "__self__", None)
@@ -52,7 +76,7 @@ def recompute(function, *args, **kwargs):
     static_kwargs = {k: v for k, v in kwargs.items()
                      if k not in kw_tensor_keys}
 
-    @jax.checkpoint
+    @functools.partial(jax.checkpoint, policy=ckpt_policy)
     def rematted(flat_params, *arr_args):
         p = dict(zip(pnames, flat_params))
         n_kw = len(kw_tensor_keys)

@@ -181,16 +181,16 @@ class BertModel(Layer):
     def __init__(self, cfg: BertConfig):
         super().__init__()
         self.cfg = cfg
-        if cfg.scan_layers:  # guard before any submodule allocates
-            from .scanned import ScannedStack
-            ScannedStack.reject_dropout(cfg.dropout)
-        self.embeddings = BertEmbeddings(cfg)
         if cfg.scan_layers:
             from .scanned import ScannedStack
+            # guard before any submodule allocates
+            ScannedStack.reject_dropout(cfg.dropout)
+            self.embeddings = BertEmbeddings(cfg)
             self.layers = ScannedStack(lambda: BertLayer(cfg),
                                        cfg.num_layers,
                                        cfg.initializer_range)
         else:
+            self.embeddings = BertEmbeddings(cfg)
             self.layers = []
             for i in range(cfg.num_layers):
                 layer = BertLayer(cfg)
@@ -207,8 +207,7 @@ class BertModel(Layer):
                              [m.shape[0], 1, 1, m.shape[1]])
         x = self.embeddings(ids, token_type_ids)
         if self.cfg.scan_layers:
-            x = self.layers(x, mask) if mask is not None \
-                else self.layers(x)
+            x = self.layers(x, mask)  # None mask passes through safely
             return x, self.pooler(x)
         for layer in self.layers:
             x = layer(x, mask)

@@ -63,6 +63,9 @@ class GPTConfig:
     # rematerialize each block's activations in backward (jax.checkpoint;
     # parity: fleet recompute_interval=1 over the decoder stack)
     recompute: bool = False
+    # remat policy for the scanned stack: "full" (save nothing) or
+    # "dots" (save matmul outputs, recompute only elementwise)
+    recompute_policy: str = "full"
     # compile the block stack as ONE lax.scan over [L, ...]-stacked params
     # instead of L unrolled copies — O(1) HLO in depth (GPTScannedBlocks)
     scan_layers: bool = False
@@ -207,7 +210,8 @@ class GPTScannedBlocks(ScannedStack):
                 "or GPTPipelineForCausalLM")
         ScannedStack.reject_dropout(cfg.dropout)
         super().__init__(lambda: GPTBlock(cfg), cfg.num_layers,
-                         cfg.initializer_range, recompute=cfg.recompute)
+                         cfg.initializer_range, recompute=cfg.recompute,
+                         recompute_policy=cfg.recompute_policy)
         self.cfg = cfg
 
 
@@ -275,7 +279,7 @@ class GPTModel(Layer):
                     "recompute_interval for MoE models")
             from ..distributed.recompute import recompute as _rc
             for blk in self.blocks:
-                x = _rc(blk, x)
+                x = _rc(blk, x, policy=self.cfg.recompute_policy)
         else:
             for blk in self.blocks:
                 x = blk(x)

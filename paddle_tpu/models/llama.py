@@ -53,6 +53,9 @@ class LlamaConfig:
     initializer_range: float = 0.02
     # rematerialize each block in backward (jax.checkpoint) — scan path
     recompute: bool = False
+    # remat policy for the scanned stack: "full" (save nothing) or
+    # "dots" (save matmul outputs, recompute only elementwise)
+    recompute_policy: str = "full"
     # compile the block stack as ONE lax.scan over [L, ...]-stacked params
     # (models/scanned.py ScannedStack) — depth-independent HLO
     scan_layers: bool = False
@@ -225,7 +228,8 @@ class LlamaModel(Layer):
             self.blocks = ScannedStack(lambda: LlamaBlock(cfg),
                                        cfg.num_layers,
                                        cfg.initializer_range,
-                                       recompute=cfg.recompute)
+                                       recompute=cfg.recompute,
+                                       recompute_policy=cfg.recompute_policy)
         else:
             self.blocks = []
             for i in range(cfg.num_layers):
@@ -254,7 +258,7 @@ class LlamaModel(Layer):
         if self.cfg.recompute and self.training:
             from ..distributed.recompute import recompute as _rc
             for blk in self.blocks:
-                x = _rc(blk, x)
+                x = _rc(blk, x, policy=self.cfg.recompute_policy)
         else:
             for blk in self.blocks:
                 x = blk(x)

@@ -53,10 +53,14 @@ class ScannedStack(Layer):
     """
 
     def __init__(self, block_factory, num_layers: int,
-                 initializer_range: float, recompute: bool = False):
+                 initializer_range: float, recompute: bool = False,
+                 recompute_policy: str = "full"):
         super().__init__()
+        from ..distributed.recompute import resolve_checkpoint_policy
         self.num_layers = num_layers
         self.recompute = recompute
+        # resolve eagerly: a typo'd policy fails at construction
+        self._ckpt_policy = resolve_checkpoint_policy(recompute_policy)
         # plain-list attribute: provides structure + forward only — built
         # abstract (LazyGuard) so its parameters are ShapeDtypeStructs,
         # not resident arrays that compute never touches
@@ -149,7 +153,7 @@ class ScannedStack(Layer):
                                          h, *ex, training=training)
                 return out
             if recompute:
-                body = jax.checkpoint(body)
+                body = jax.checkpoint(body, policy=self._ckpt_policy)
 
             def scan_body(h, psl):
                 return body(h, psl), None

@@ -67,6 +67,41 @@ class TestScanLayersParity:
             np.asarray(sd_s["gpt.embeddings.word_embeddings.weight"].grad),
             rtol=1e-4, atol=1e-6)
 
+    def test_recompute_policy_dots_matches_full(self):
+        # "dots" saves matmul outputs instead of recomputing everything —
+        # gradients must be identical either way
+        ids = _ids(seq=32)
+        gs = {}
+        for pol in ("full", "dots"):
+            paddle.seed(0)
+            m = GPTForCausalLM(gpt_tiny(scan_layers=True, recompute=True,
+                                        recompute_policy=pol))
+            m.train()
+            GPTForCausalLM.loss_fn(m(ids), ids).backward()
+            gs[pol] = np.asarray(dict(m.named_parameters())
+                                 ["gpt.blocks.attn__qkv__weight"].grad)
+        np.testing.assert_allclose(gs["full"], gs["dots"], atol=1e-6)
+
+    def test_bad_recompute_policy_raises(self):
+        with pytest.raises(ValueError, match="recompute policy"):
+            GPTForCausalLM(gpt_tiny(scan_layers=True,
+                                    recompute_policy="bogus"))
+
+    def test_jit_save_load_roundtrip(self, tmp_path):
+        # scanned models must export (lax.scan -> StableHLO) and serve
+        import paddle_tpu.jit as jit
+        paddle.seed(0)
+        m = GPTForCausalLM(gpt_tiny(scan_layers=True))
+        m.eval()
+        ids = _ids(seq=16)
+        ref = np.asarray(m(ids).value)
+        prefix = str(tmp_path / "gpt_scan")
+        jit.save(m, prefix, input_spec=[ids])
+        loaded = jit.load(prefix)
+        out = loaded(ids)
+        out = out[0] if isinstance(out, (list, tuple)) else out
+        np.testing.assert_allclose(np.asarray(out), ref, atol=2e-5)
+
     def test_recompute_matches(self):
         paddle.seed(0)
         m_plain = GPTForCausalLM(gpt_tiny(scan_layers=True))
