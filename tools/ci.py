@@ -45,7 +45,7 @@ QUICK_FILES = [
     "tests/test_flash_kernel.py", "tests/test_multihost.py",
     "tests/test_zero_accumulation.py", "tests/test_api_surface.py",
     "tests/test_op_numerics.py", "tests/test_functional_numerics.py",
-    "tests/test_incubate_geometric.py",
+    "tests/test_incubate_geometric.py", "tests/test_gpt_scan_layers.py",
 ]
 
 
