@@ -198,16 +198,11 @@ class GPTBlock(Layer):
 
 class GPTScannedBlocks(ScannedStack):
     """GPT decoder stack as one lax.scan (``cfg.scan_layers``) — see
-    models/scanned.py for the full design. GPT-specific guards: no MoE
-    (aux-loss side channel cannot cross the scan body), no dropout
-    (traced-once body would reuse one RNG draw per layer)."""
+    models/scanned.py for the full design. MoE blocks work (per-layer
+    aux losses ride the scan outputs); dropout is rejected (traced-once
+    body would reuse one RNG draw per layer)."""
 
     def __init__(self, cfg: GPTConfig):
-        if cfg.use_moe:
-            raise NotImplementedError(
-                "scan_layers with use_moe: the MoE aux-loss side channel "
-                "cannot cross the lax.scan body; use the unrolled stack "
-                "or GPTPipelineForCausalLM")
         ScannedStack.reject_dropout(cfg.dropout)
         super().__init__(lambda: GPTBlock(cfg), cfg.num_layers,
                          cfg.initializer_range, recompute=cfg.recompute,

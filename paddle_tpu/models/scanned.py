@@ -46,10 +46,11 @@ class ScannedStack(Layer):
     draws == one draw of the stacked shape; rank-1 ``*.weight`` leaves
     are norm scales (ones); everything else is a bias (zeros).
 
-    Restrictions (loud): blocks with buffers are rejected (buffers are
-    not stacked, same rule as PipelineLayer body blocks). Stochastic
-    blocks (dropout>0) must be rejected by the CALLER — the scan body is
-    traced once, so every layer would reuse one RNG draw.
+    Blocks that report auxiliary losses (MoE) are supported — see
+    ``forward``. Restrictions (loud): blocks with buffers are rejected
+    (buffers are not stacked, same rule as PipelineLayer body blocks).
+    Stochastic blocks (dropout>0) must be rejected by the CALLER — the
+    scan body is traced once, so every layer would reuse one RNG draw.
     """
 
     def __init__(self, block_factory, num_layers: int,
@@ -73,6 +74,10 @@ class ScannedStack(Layer):
                 "scan_layers with buffered blocks: buffers are not "
                 "stacked across layers (same restriction as "
                 "PipelineLayer body blocks)")
+        # static: does any sublayer report aux losses (MoE gates)?
+        # decided here so aux-free stacks keep the single-output path
+        self._has_aux = any(hasattr(l, "aux_loss_weight")
+                            for l in tmpl.sublayers(include_self=True))
         w_init = I.Normal(0.0, initializer_range)
         self._names = []
         for name, p in tmpl.named_parameters():
@@ -138,36 +143,63 @@ class ScannedStack(Layer):
     def forward(self, x, *extra):
         """Apply the stack to x. ``extra`` are layer-INVARIANT positional
         args handed to every block unchanged (e.g. an attention mask for
-        encoder blocks) — they ride along as differentiable inputs."""
+        encoder blocks) — they ride along as differentiable inputs.
+
+        Blocks that report auxiliary losses (MoE load balancing) work:
+        each scan iteration collects its block's aux losses in a private
+        scope and returns their sum as a scan output; the per-layer sums
+        are re-reported ONCE to the active outer scope after the tape op
+        (the report-after-apply pattern MoELayer itself uses), so the
+        training engines add them to the objective and gate gradients
+        flow through the scan."""
         from ..autograd import tape as _tape
+        from ..framework.aux_loss import (add_aux_loss, aux_loss_scope,
+                                          total)
         tmpl, names, leaves = self._scan_leaves()
         training = self.training
         recompute = self.recompute and training
         n_extra = len(extra)
+        has_aux = self._has_aux  # static (decided at construction)
 
         def run(h, *rest):
             ex, stacked = rest[:n_extra], rest[n_extra:]
 
             def body(h, psl):
-                out, _ = functional_call(tmpl, dict(zip(names, psl)), {},
-                                         h, *ex, training=training)
-                return out
+                # private scope even when has_aux is False: an aux report
+                # from inside the scan trace must never reach an outer
+                # bucket (tracer leak)
+                with aux_loss_scope() as bucket:
+                    out, _ = functional_call(tmpl, dict(zip(names, psl)),
+                                             {}, h, *ex,
+                                             training=training)
+                if not has_aux:
+                    return out
+                return out, jnp.asarray(total(bucket), jnp.float32)
             if recompute:
                 body = jax.checkpoint(body, policy=self._ckpt_policy)
 
-            def scan_body(h, psl):
-                return body(h, psl), None
+            if not has_aux:
+                def scan_body(h, psl):
+                    return body(h, psl), None
+                out, _ = jax.lax.scan(scan_body, h, list(stacked))
+                return out
+            out, auxs = jax.lax.scan(body, h, list(stacked))
+            return out, jnp.sum(auxs)
 
-            out, _ = jax.lax.scan(scan_body, h, list(stacked))
-            return out
-
-        return _tape.apply(run, x, *extra, *leaves,
-                           _op_name="scanned_stack")
+        if not has_aux:
+            return _tape.apply(run, x, *extra, *leaves,
+                               _op_name="scanned_stack")
+        out, aux_sum = _tape.apply(run, x, *extra, *leaves,
+                                   _op_name="scanned_stack")
+        add_aux_loss(aux_sum.value if hasattr(aux_sum, "value")
+                     else aux_sum)
+        return out
 
     def forward_cached(self, x, caches, pos):
         """Decode step: caches is (k_stack, v_stack), each [L, B, M,
         heads, hd]; every layer's slice rotates through the scan body."""
         from ..autograd import tape as _tape
+        from ..framework.aux_loss import aux_loss_scope
         tmpl, names, leaves = self._scan_leaves()
         k_stack, v_stack = caches
         pos_raw = pos.value if isinstance(pos, Tensor) else pos
@@ -176,8 +208,13 @@ class ScannedStack(Layer):
             def body(carry, xs):
                 psl_leaves, kc, vc = xs
                 psl = dict(zip(names, psl_leaves))
-                out, _ = functional_call(tmpl, psl, {}, carry, (kc, vc),
-                                         pos_raw, training=False)
+                # private scope: a decode-time aux report (MoE gates fire
+                # regardless of training mode) must not leak scan-trace
+                # tracers into an outer bucket; decode discards aux
+                with aux_loss_scope():
+                    out, _ = functional_call(tmpl, psl, {}, carry,
+                                             (kc, vc), pos_raw,
+                                             training=False)
                 h2, (kc2, vc2) = out
                 return h2, (kc2, vc2)
 

@@ -345,10 +345,45 @@ class TestBertScanLayers:
             BertModel(bert_base(scan_layers=True))
 
 
+class TestMoEScan:
+    """MoE blocks through the scan: per-layer aux losses ride the scan
+    outputs and are re-reported once to the outer scope."""
+
+    def test_aux_loss_matches_unrolled(self):
+        from paddle_tpu.framework.aux_loss import aux_loss_scope, total
+        paddle.seed(0)
+        m_u = GPTForCausalLM(gpt_tiny(use_moe=True, moe_experts=4))
+        m_s = GPTForCausalLM(gpt_tiny(use_moe=True, moe_experts=4,
+                                      scan_layers=True))
+        m_s.gpt.blocks.load_from_blocks(m_u.gpt.blocks)
+        sd = dict(m_u.named_parameters())
+        for n, p in m_s.named_parameters():
+            if not n.startswith("gpt.blocks."):
+                p.value = sd[n].value
+        ids = _ids(seq=32)
+        with aux_loss_scope() as b_u:
+            out_u = m_u(ids)
+        with aux_loss_scope() as b_s:
+            out_s = m_s(ids)
+        np.testing.assert_allclose(np.asarray(out_u.value),
+                                   np.asarray(out_s.value), atol=1e-5)
+        assert float(total(b_u)) > 0
+        np.testing.assert_allclose(float(total(b_u)), float(total(b_s)),
+                                   rtol=1e-6)
+
+    def test_moe_scan_remat_trains(self):
+        paddle.seed(0)
+        m = GPTForCausalLM(gpt_tiny(use_moe=True, moe_experts=4,
+                                    scan_layers=True, recompute=True))
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=m.parameters())
+        step = TrainStep(m, GPTForCausalLM.loss_fn, opt)
+        ids = _ids(seq=32)
+        losses = [float(step(ids, ids)) for _ in range(4)]
+        assert losses[-1] < losses[0], losses
+
+
 class TestScanLayersGuards:
-    def test_moe_raises(self):
-        with pytest.raises(NotImplementedError, match="use_moe"):
-            GPTForCausalLM(gpt_tiny(scan_layers=True, use_moe=True))
 
     def test_dropout_raises(self):
         with pytest.raises(NotImplementedError, match="dropout"):
