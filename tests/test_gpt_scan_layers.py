@@ -264,6 +264,26 @@ class TestLlamaScanLayers:
                                       np.asarray(out_s))
 
 
+class TestScanSequenceParallel:
+    def test_scan_with_ring_attention_trains(self):
+        # ring attention's shard_map runs INSIDE the scan body under the
+        # sp axis — the full long-context composition
+        import paddle_tpu.distributed as dist
+        dist.init_mesh({"sp": 2, "mp": 2, "dp": 2})
+        try:
+            paddle.seed(0)
+            m = GPTForCausalLM(gpt_tiny(scan_layers=True))
+            opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                         parameters=m.parameters())
+            step = dist.ParallelTrainStep(m, GPTForCausalLM.loss_fn, opt)
+            ids = _ids(batch=4)
+            losses = [float(step(ids, ids)) for _ in range(3)]
+            assert all(np.isfinite(losses)) and losses[-1] < losses[0], \
+                losses
+        finally:
+            dist.set_mesh(None)
+
+
 class TestFusedScanDistributed:
     def test_dp_mp_fused_scan_matches_plain(self):
         # the full composition: scanned TP blocks + fused CE over the
