@@ -267,19 +267,30 @@ class TestLlamaScanLayers:
 class TestScanSequenceParallel:
     def test_scan_with_ring_attention_trains(self):
         # ring attention's shard_map runs INSIDE the scan body under the
-        # sp axis — the full long-context composition
+        # sp axis — the full long-context composition. Ring attention is
+        # exact, so the trajectory must MATCH the same scanned model
+        # trained without sp, and the ring dispatch must actually fire.
         import paddle_tpu.distributed as dist
-        dist.init_mesh({"sp": 2, "mp": 2, "dp": 2})
+        from paddle_tpu.distributed.sequence_parallel import \
+            last_ring_dispatch
+        ids = _ids(batch=4)
+        traj = {}
         try:
-            paddle.seed(0)
-            m = GPTForCausalLM(gpt_tiny(scan_layers=True))
-            opt = paddle.optimizer.AdamW(learning_rate=1e-3,
-                                         parameters=m.parameters())
-            step = dist.ParallelTrainStep(m, GPTForCausalLM.loss_fn, opt)
-            ids = _ids(batch=4)
-            losses = [float(step(ids, ids)) for _ in range(3)]
-            assert all(np.isfinite(losses)) and losses[-1] < losses[0], \
-                losses
+            for tag, degrees in (("no_sp", {"dp": 8}),
+                                 ("sp", {"sp": 2, "mp": 2, "dp": 2})):
+                dist.set_mesh(None)
+                dist.init_mesh(degrees)
+                paddle.seed(0)
+                m = GPTForCausalLM(gpt_tiny(scan_layers=True))
+                opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                             parameters=m.parameters())
+                step = dist.ParallelTrainStep(
+                    m, GPTForCausalLM.loss_fn, opt)
+                traj[tag] = [float(step(ids, ids)) for _ in range(3)]
+            assert last_ring_dispatch(), \
+                "ring attention never dispatched under the sp mesh"
+            np.testing.assert_allclose(traj["no_sp"], traj["sp"],
+                                       rtol=2e-4)
         finally:
             dist.set_mesh(None)
 
