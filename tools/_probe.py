@@ -1,0 +1,55 @@
+"""Shared TPU-backend probe for the measurement tools.
+
+A wedged axon tunnel hangs jax backend init IN-PROCESS for 25+ minutes
+(no timeout can interrupt it) — round 4 lost a bench_ring slot exactly
+this way. Every TPU tool therefore resolves the backend from a
+throwaway SUBPROCESS first (kill-safe: the probe only inits the
+backend, never runs a step or compile):
+
+- TPU reachable      -> returns its device_kind, tool proceeds
+- backend is CPU     -> returns "cpu" (healthy fallback: the tools'
+                        own smoke/interpret paths handle it)
+- init hangs/fails   -> prints a JSON error line and exits 4 fast
+
+Call ``probe_backend()`` unconditionally — the gate logic lives HERE,
+not at the call sites.
+"""
+from __future__ import annotations
+
+import subprocess
+import sys
+
+PROBE_SRC = """
+import jax, sys
+d = jax.devices()
+p = getattr(d[0], "platform", "")
+if p == "cpu":
+    sys.exit(3)
+sys.stdout.write(getattr(d[0], "device_kind", "unknown"))
+"""
+
+
+def probe_backend(budget: int = 180) -> str:
+    """Resolve the backend from a subprocess. Returns device_kind, or
+    "cpu" for a healthy CPU backend; exits 4 with a JSON error line when
+    backend init hangs or fails (wedged tunnel)."""
+    try:
+        r = subprocess.run([sys.executable, "-c", PROBE_SRC],
+                           capture_output=True, text=True, timeout=budget)
+    except subprocess.TimeoutExpired:
+        _unavailable("probe subprocess hung >%ds (tunnel wedged?)"
+                     % budget)
+    if r.returncode == 0 and r.stdout.strip():
+        return r.stdout.strip()
+    if r.returncode == 3:
+        return "cpu"
+    _unavailable((r.stderr or "").strip()[-300:]
+                 or "probe rc=%d" % r.returncode)
+    raise AssertionError  # unreachable
+
+
+def _unavailable(detail: str) -> None:
+    import json
+    print(json.dumps({"error": "backend_unavailable", "detail": detail}))
+    sys.stderr.write("[probe] backend unavailable: %s\n" % detail)
+    raise SystemExit(4)

@@ -15,6 +15,7 @@ Prints one JSON line with ms per variant and the fused/xla speedup.
 import argparse
 import functools
 import json
+import os
 import sys
 import time
 
@@ -45,6 +46,10 @@ def main():
     ap.add_argument("--blocks", type=int, default=4,
                     help="number of kv blocks (emulates sp ring steps)")
     args = ap.parse_args()
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from _probe import probe_backend
+    probe_backend()  # cpu is a healthy result; exits 4 if tunnel wedged
 
     import jax
     import jax.numpy as jnp

@@ -17,6 +17,7 @@ Prints ONE BENCH-style JSON line.
 """
 import argparse
 import json
+import os
 import sys
 import tempfile
 import time
@@ -102,6 +103,10 @@ def main():
     ap.add_argument("--iters", type=int, default=50)
     ap.add_argument("--tokens", type=int, default=64)
     args = ap.parse_args()
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from _probe import probe_backend
+    probe_backend()  # cpu is a healthy result; exits 4 if tunnel wedged
 
     iters = 8 if args.smoke else args.iters
     tokens = 8 if args.smoke else args.tokens

@@ -94,6 +94,10 @@ def main():
     ap.add_argument("--top", type=int, default=15)
     args = ap.parse_args()
 
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from _probe import probe_backend
+    probe_backend()  # cpu is a healthy result; exits 4 if tunnel wedged
+
     import jax
 
     import paddle_tpu as paddle
