@@ -46,4 +46,11 @@ log "bench_ring rc=$?"
 log "bench_serving"
 python tools/bench_serving.py > "$R/bench_serving.json" 2> "$R/bench_serving.log"
 log "bench_serving rc=$?"
+# 5. A/Bs (cheap after the compile caches warm): 125M fused-CE, 1.3B
+#    dots remat policy — the 33->40% MFU candidates
+run bench_125m_fused bench_125m_fused.json \
+    env PADDLE_TPU_BENCH_FUSED_CE=1024 python bench.py
+run bench_1p3b_dots bench_1p3b_dots.json \
+    env PADDLE_TPU_BENCH_MODEL=gpt1.3b PADDLE_TPU_BENCH_REMAT_POLICY=dots \
+    python bench.py
 log "done"
