@@ -310,6 +310,11 @@ def _index_to_raw(idx):
     return idx
 
 
+def as_raw(t):
+    """Unwrap a Tensor to its jax array; pass arrays/scalars through."""
+    return t.value if isinstance(t, Tensor) else jnp.asarray(t)
+
+
 def _wrap_single(value):
     return Tensor(value, stop_gradient=True)
 

@@ -29,7 +29,30 @@ from jax import lax
 from ..core.tensor import Tensor
 from ..jit.functional import functional_call, raw_state
 
-__all__ = ["generate"]
+__all__ = ["generate", "new_kv_caches"]
+
+
+def new_kv_caches(num_layers, batch, max_len, kv_heads, head_dim, dtype,
+                  scan_layers):
+    """KV caches for generate(): per-layer [(k, v), ...] (unrolled) or a
+    stacked (k_stack, v_stack) pair (scan_layers models). dtype "int8"
+    selects the dynamically-quantized cache (quantized_kv_cache) — the
+    TPU-native role of the reference's int8 CacheKV
+    (fused_multi_transformer_op.cu)."""
+    from ..nn.functional.flash_attention import quantized_kv_cache
+    if dtype == "int8":
+        def one():
+            return quantized_kv_cache(batch, max_len, kv_heads, head_dim)
+    else:
+        def one():
+            return jnp.zeros((batch, max_len, kv_heads, head_dim), dtype)
+    if scan_layers:
+        def stack(trees):
+            return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
+                                          *trees)
+        return (stack([one() for _ in range(num_layers)]),
+                stack([one() for _ in range(num_layers)]))
+    return [(one(), one()) for _ in range(num_layers)]
 
 
 def _select_token(logits, key, do_sample, temperature, top_k, top_p):

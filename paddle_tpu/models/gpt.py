@@ -103,6 +103,7 @@ def _sp_active() -> bool:
 # re-export: incremental-decode attention now lives beside the flash
 # kernel (generic serving infrastructure, not GPT-specific)
 from ..nn.functional.flash_attention import cached_attention  # noqa: E402
+from .generation import new_kv_caches as _new_cache  # noqa: E402
 
 
 class GPTAttention(Layer):
@@ -328,12 +329,8 @@ class GPTForCausalLM(Layer):
         generate()."""
         cfg = self.cfg
         hd = cfg.hidden_size // cfg.num_heads
-        shape = (batch_size, max_len, cfg.num_heads, hd)
-        if cfg.scan_layers:  # stacked layout for forward_cached's scan
-            sshape = (cfg.num_layers,) + shape
-            return (jnp.zeros(sshape, dtype), jnp.zeros(sshape, dtype))
-        return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
-                for _ in range(cfg.num_layers)]
+        return _new_cache(cfg.num_layers, batch_size, max_len,
+                          cfg.num_heads, hd, dtype, cfg.scan_layers)
 
     def generate(self, input_ids, max_new_tokens=32, **kw):
         from .generation import generate

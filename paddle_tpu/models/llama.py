@@ -291,16 +291,14 @@ class LlamaForCausalLM(Layer):
         return GPTForCausalLM.make_loss_fn(self)
 
     def new_cache(self, batch_size: int, max_len: int, dtype="bfloat16"):
-        """Per-layer (k, v) caches [B, max_len, n_kv_heads, hd];
-        stacked (k_stack, v_stack) for scan_layers models."""
+        """Per-layer (k, v) caches [B, max_len, n_kv_heads, hd]; stacked
+        (k_stack, v_stack) for scan_layers models; dtype "int8" selects
+        the dynamically-quantized cache (quantized_kv_cache)."""
+        from .generation import new_kv_caches
         cfg = self.cfg
         hd = cfg.hidden_size // cfg.num_heads
-        shape = (batch_size, max_len, cfg.kv_heads, hd)
-        if cfg.scan_layers:
-            sshape = (cfg.num_layers,) + shape
-            return (jnp.zeros(sshape, dtype), jnp.zeros(sshape, dtype))
-        return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
-                for _ in range(cfg.num_layers)]
+        return new_kv_caches(cfg.num_layers, batch_size, max_len,
+                             cfg.kv_heads, hd, dtype, cfg.scan_layers)
 
     def generate(self, input_ids, max_new_tokens=32, **kw):
         from .generation import generate

@@ -222,6 +222,13 @@ class ScannedStack(Layer):
                 body, h, (list(stacked), kst, vst))
             return h2, knew, vnew
 
+        if isinstance(k_stack, dict) or isinstance(v_stack, dict):
+            # int8 (dict-pytree) caches: the tape cannot wrap dicts and
+            # quantized writes are not differentiable — run raw
+            from ..core.tensor import as_raw
+            h2, k2, v2 = run(as_raw(x), k_stack, v_stack,
+                             *[l.value for l in leaves])
+            return Tensor(h2, stop_gradient=True), (k2, v2)
         h_t, k_t, v_t = _tape.apply(run, x, k_stack, v_stack, *leaves,
                                     _op_name="scanned_stack_decode")
         return h_t, (k_t, v_t)

@@ -241,6 +241,48 @@ class sdp_kernel:
         return False
 
 
+def quantized_kv_cache(batch, max_len, kv_heads, head_dim):
+    """Allocate an int8 KV-cache half: values stored int8 with ONE
+    dynamic scale per (batch, position, head) row. Halves (vs bf16) or
+    quarters (vs f32) decode-cache HBM — the TPU-native role of the
+    reference's int8 CacheKV in fused_multi_transformer_op.cu."""
+    return {"data": jnp.zeros((batch, max_len, kv_heads, head_dim),
+                              jnp.int8),
+            "scale": jnp.zeros((batch, max_len, kv_heads), jnp.float32)}
+
+
+def _quant_rows(x):
+    """Per-(b, s, head) symmetric int8 quantization of [B, S, nkv, hd]."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = amax / 127.0
+    q = jnp.round(x.astype(jnp.float32)
+                  / jnp.maximum(scale, 1e-12)[..., None])
+    return q.astype(jnp.int8), scale
+
+
+def _cache_write(cache, rows, pos):
+    """Write [B, S, nkv, hd] rows into a cache at [pos, pos+S)."""
+    if isinstance(cache, dict):  # int8 + scales
+        qrows, scale = _quant_rows(rows)
+        return {
+            "data": lax.dynamic_update_slice(cache["data"], qrows,
+                                             (0, pos, 0, 0)),
+            "scale": lax.dynamic_update_slice(cache["scale"], scale,
+                                              (0, pos, 0)),
+        }
+    return lax.dynamic_update_slice(cache, rows.astype(cache.dtype),
+                                    (0, pos, 0, 0))
+
+
+def _cache_read(cache):
+    """[B, L, nkv, hd] view of a cache: int8 dicts dequantize to f32;
+    array caches return UNCHANGED (their dtype drives the PV einsum)."""
+    if isinstance(cache, dict):
+        return (cache["data"].astype(jnp.float32)
+                * cache["scale"][..., None])
+    return cache
+
+
 def cached_attention(q, k, v, k_cache, v_cache, pos):
     """Incremental attention for autoregressive decode (serving path).
 
@@ -251,17 +293,18 @@ def cached_attention(q, k, v, k_cache, v_cache, pos):
     in one jitted step, static shapes throughout. Caches may hold fewer
     kv heads than q heads (GQA) — they are broadcast at use.
 
-    q/k/v: [B, S, nh|nkv, hd]; caches: [B, L, nkv, hd]; pos: scalar.
+    q/k/v: [B, S, nh|nkv, hd]; caches: [B, L, nkv, hd] arrays, or the
+    int8 dict form from quantized_kv_cache (write path quantizes each
+    new row dynamically; read path dequantizes — ~0.4% relative logit
+    noise at N(0,1) scale for half/quarter the cache HBM); pos: scalar.
     Returns (ctx [B, S, nh, hd], k_cache', v_cache').
     """
     def f(q, k, v, kc, vc, pos):
         pos = jnp.asarray(pos, jnp.int32)
-        kc = lax.dynamic_update_slice(kc, k.astype(kc.dtype),
-                                      (0, pos, 0, 0))
-        vc = lax.dynamic_update_slice(vc, v.astype(vc.dtype),
-                                      (0, pos, 0, 0))
-        nh, nkv = q.shape[2], kc.shape[2]
-        ka, va = kc, vc
+        kc = _cache_write(kc, k, pos)
+        vc = _cache_write(vc, v, pos)
+        ka, va = _cache_read(kc), _cache_read(vc)
+        nh, nkv = q.shape[2], ka.shape[2]
         if nkv != nh:
             ka = jnp.repeat(ka, nh // nkv, axis=2)
             va = jnp.repeat(va, nh // nkv, axis=2)
@@ -273,8 +316,18 @@ def cached_attention(q, k, v, k_cache, v_cache, pos):
                 <= pos + jnp.arange(S)[:, None])        # [S, L]
         logits = jnp.where(mask[None, None], logits, -1e30)
         probs = jax.nn.softmax(logits, axis=-1)
-        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(va.dtype), va)
+        # PV runs at the cache dtype (bf16 caches keep the bf16 MXU
+        # path; dequantized int8 runs f32), output at the query dtype
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(va.dtype),
+                         va).astype(q.dtype)
         return ctx, kc, vc
 
+    if isinstance(k_cache, dict) or isinstance(v_cache, dict):
+        # int8 caches are pytrees the tape cannot wrap (and the write
+        # quantization is not differentiable): run raw, wrap only ctx
+        from ...core.tensor import as_raw
+        ctx, kc, vc = f(as_raw(q), as_raw(k), as_raw(v), k_cache,
+                        v_cache, as_raw(pos))
+        return Tensor(ctx, stop_gradient=True), kc, vc
     return apply(f, q, k, v, k_cache, v_cache, pos,
                  _op_name="cached_attention")
