@@ -68,7 +68,10 @@ def bench_encoder(smoke: bool, iters: int):
     return _percentiles(lat)
 
 
-def bench_decode(smoke: bool, new_tokens: int):
+def bench_decode(smoke: bool, new_tokens: int,
+                 cache_dtypes=("bfloat16", "int8")):
+    """{cache_dtype: decode ms/token} — ONE model build, measured per
+    cache dtype (each dtype keys its own compiled program)."""
     import paddle_tpu as paddle
     from paddle_tpu.models import GPTForCausalLM, gpt_125m, gpt_tiny
 
@@ -80,20 +83,26 @@ def bench_decode(smoke: bool, new_tokens: int):
         model.bfloat16()
     prompt = paddle.to_tensor(np.random.RandomState(1).randint(
         0, cfg.vocab_size, (1, 16)).astype("int64"))
-    # warmup with the SAME shapes: the cache length (prompt + new tokens)
-    # keys the compiled decode program, so a different token budget would
-    # compile a different program and the measurement would time XLA
-    model.generate(prompt, max_new_tokens=new_tokens)
-    model.generate(prompt, max_new_tokens=1)
-    t0 = time.perf_counter()
-    model.generate(prompt, max_new_tokens=new_tokens)
-    dt_full = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    model.generate(prompt, max_new_tokens=1)
-    dt_one = time.perf_counter() - t0
-    # subtract the prefill (the 1-token call is prefill + one select) so
-    # the number reports pure per-token DECODE cost
-    return max(dt_full - dt_one, 0.0) * 1e3 / max(new_tokens - 1, 1)
+    out = {}
+    for dtype in cache_dtypes:
+        kw = {"cache_dtype": dtype}
+        # warmup with the SAME shapes: the cache length (prompt + new
+        # tokens) keys the compiled decode program, so a different token
+        # budget would compile a different program and the measurement
+        # would time XLA
+        model.generate(prompt, max_new_tokens=new_tokens, **kw)
+        model.generate(prompt, max_new_tokens=1, **kw)
+        t0 = time.perf_counter()
+        model.generate(prompt, max_new_tokens=new_tokens, **kw)
+        dt_full = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        model.generate(prompt, max_new_tokens=1, **kw)
+        dt_one = time.perf_counter() - t0
+        # subtract the prefill (the 1-token call is prefill + one
+        # select) so the number reports pure per-token DECODE cost
+        out[dtype] = (max(dt_full - dt_one, 0.0) * 1e3
+                      / max(new_tokens - 1, 1))
+    return out
 
 
 def main():
@@ -111,7 +120,9 @@ def main():
     iters = 8 if args.smoke else args.iters
     tokens = 8 if args.smoke else args.tokens
     p50, p90, p99 = bench_encoder(args.smoke, iters)
-    ms_tok = bench_decode(args.smoke, tokens)
+    decode = bench_decode(args.smoke, tokens)
+    ms_tok = decode["bfloat16"]
+    ms_tok_i8 = decode["int8"]
 
     import jax
     print(json.dumps({
@@ -122,6 +133,7 @@ def main():
         "p90_ms": round(p90, 2),
         "p99_ms": round(p99, 2),
         "decode_ms_per_token": round(ms_tok, 2),
+        "decode_ms_per_token_int8_cache": round(ms_tok_i8, 2),
         "iters": iters,
         "device_kind": getattr(jax.devices()[0], "device_kind", "cpu"),
         "smoke": bool(args.smoke),
