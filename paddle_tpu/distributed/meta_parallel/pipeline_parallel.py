@@ -85,13 +85,16 @@ def interleave_perm(num_blocks: int, num_stages: int, interleave: int):
 
 def pipeline_apply(template: Layer, stacked: Dict[str, "Tensor"], x,
                    num_stages: int, num_micro: int = None,
-                   interleave: int = 1, recompute: bool = False):
+                   interleave: int = 1, recompute: bool = False,
+                   recompute_policy: str = "full"):
     """Run x through L stacked blocks pipelined over the "pp" axis.
 
     stacked: dict name -> Parameter of shape [L, ...] (dim 0 sharded "pp",
     rows in interleave_perm order when interleave > 1).
     x: Tensor [B, ...]; B must divide into num_micro microbatches.
     """
+    from ..recompute import resolve_checkpoint_policy
+    ckpt_policy = resolve_checkpoint_policy(recompute_policy)
     names = list(stacked)
     mesh = mesh_mod.get_mesh(create_default=False)
     pp = mesh.shape.get("pp", 1) if mesh is not None else 1
@@ -113,7 +116,8 @@ def pipeline_apply(template: Layer, stacked: Dict[str, "Tensor"], x,
 
     if pp <= 1:
         # no pipeline axis: plain scan over the blocks in logical order
-        key = (None, tuple(names), 1, 0, v, bool(recompute))
+        key = (None, tuple(names), 1, 0, v, bool(recompute),
+               recompute_policy if recompute else None)
         fn = cache.get(key)
         if fn is None:
             perm = interleave_perm(L, num_stages, v) if v > 1 else None
@@ -135,7 +139,7 @@ def pipeline_apply(template: Layer, stacked: Dict[str, "Tensor"], x,
                     c, aux = carry
                     body = lambda bp, c: _apply_block(template, bp, c)
                     if recompute:
-                        body = jax.checkpoint(body)
+                        body = jax.checkpoint(body, policy=ckpt_policy)
                     out, a = body(bparams, c)
                     return (out, aux + a), None
 
@@ -162,7 +166,8 @@ def pipeline_apply(template: Layer, stacked: Dict[str, "Tensor"], x,
                          f"pp*interleave={pp}*{v}")
     per_chunk = L // (pp * v)
 
-    cache_key = (mesh, tuple(names), pp, M, v, bool(recompute))
+    cache_key = (mesh, tuple(names), pp, M, v, bool(recompute),
+                 recompute_policy if recompute else None)
     cached = cache.get(cache_key)
     if cached is not None:
         return _finish(_tape.apply(cached, *[stacked[n] for n in names], x,
@@ -187,7 +192,7 @@ def pipeline_apply(template: Layer, stacked: Dict[str, "Tensor"], x,
             return out, aux
 
         if recompute:
-            chunk_apply = jax.checkpoint(chunk_apply)
+            chunk_apply = jax.checkpoint(chunk_apply, policy=ckpt_policy)
 
         def one_pass(local_chunk, xs, idx):
             """Fill-drain ring over M microbatches for one chunk round.

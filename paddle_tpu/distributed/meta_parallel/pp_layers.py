@@ -80,10 +80,16 @@ class PipelineLayer(Layer):
     def __init__(self, layers: Sequence, num_stages: Optional[int] = None,
                  topology=None, loss_fn=None, seg_method="uniform",
                  recompute_interval=0, num_micro: Optional[int] = None,
-                 interleave: int = 1, **kwargs):
+                 interleave: int = 1, recompute_policy: str = "full",
+                 **kwargs):
         super().__init__()
         self._loss_fn = loss_fn
         self.recompute_interval = recompute_interval
+        # resolve eagerly: a typo'd policy fails at construction (same
+        # convention as ScannedStack)
+        from ..recompute import resolve_checkpoint_policy
+        resolve_checkpoint_policy(recompute_policy)
+        self.recompute_policy = recompute_policy
         if num_stages is None:
             num_stages = mesh_mod.mesh_axis_size("pp")
         self.num_stages = num_stages
@@ -200,7 +206,8 @@ class PipelineLayer(Layer):
             x = pipeline_apply(self._template, self._stacked, x,
                                self.num_stages, num_micro=self.num_micro,
                                interleave=self.interleave,
-                               recompute=self.recompute_interval > 0)
+                               recompute=self.recompute_interval > 0,
+                               recompute_policy=self.recompute_policy)
         for l in self._epilogue:
             x = l(x)
         return x

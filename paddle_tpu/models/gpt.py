@@ -414,4 +414,5 @@ class GPTPipelineForCausalLM(PipelineLayer):
             num_stages=num_stages,
             loss_fn=GPTForCausalLM.loss_fn,
             recompute_interval=recompute_interval,
+            recompute_policy=cfg.recompute_policy,
             num_micro=num_micro, interleave=interleave)

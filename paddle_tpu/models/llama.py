@@ -359,4 +359,5 @@ class LlamaPipelineForCausalLM(PipelineLayer):
             num_stages=num_stages,
             loss_fn=LlamaForCausalLM.loss_fn,
             recompute_interval=recompute_interval,
+            recompute_policy=cfg.recompute_policy,
             num_micro=num_micro, interleave=interleave)
