@@ -68,6 +68,7 @@ def _peak_flops(kind: str) -> float:
 
 def _log(msg: str) -> None:
     sys.stderr.write("[bench] %s\n" % msg)
+    sys.stderr.flush()
 
 
 def _int_env(name: str, default: int) -> int:
@@ -78,7 +79,6 @@ def _int_env(name: str, default: int) -> int:
     except ValueError:
         _log("bad %s, using default %d" % (name, default))
         return default
-    sys.stderr.flush()
 
 
 def _fail(stage: str, detail: str, code: int = 1) -> None:
@@ -158,11 +158,35 @@ class _Watchdog:
 
 def main():
     on_cpu = os.environ.get("JAX_PLATFORMS", "") == "cpu"
+    lock = None
     if not on_cpu:
+        # Single-flight: only one process may touch the tunnel at a
+        # time (tools/_single_flight.py). Waiting out a long-running
+        # holder is strictly cheaper than overlapping with (or killing)
+        # a remote compile — overlap wedges the tunnel for hours.
+        sys.path.insert(0, os.path.join(_HERE, "tools"))
+        from _single_flight import BusyTimeout, maybe_acquire
+        try:
+            lock = maybe_acquire("bench:%s" % _MODEL_SEL, log=_log)
+        except BusyTimeout as e:
+            _fail("tpu_busy", str(e))
+        # (_fail's os._exit skips maybe_acquire's atexit release: the
+        # kernel drops the flock when the process's fds close, so that
+        # path still releases the lock)
+        lock.stage("probe")
         kind = _probe_backend()
         _log("stage=probe_ok device_kind=%s" % kind)
 
     dog = _Watchdog()
+    if lock is not None:
+        # keep the lock's stage note in sync with the watchdog stages so
+        # a waiter can see where this run is without touching the tunnel
+        _orig_stage = dog.stage
+
+        def _stage(name, budget_s, _orig=_orig_stage, _lock=lock):
+            _lock.stage(name)
+            _orig(name, budget_s)
+        dog.stage = _stage
     import jax
 
     import paddle_tpu as paddle

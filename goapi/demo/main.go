@@ -1,0 +1,56 @@
+// Minimal Go consumer of the paddle_tpu C inference ABI — the cgo
+// proof the reference covers with paddle/fluid/inference/goapi/demo.
+//
+// Usage: demo <model.pdmodel> <rows> <cols>
+// Feeds a deterministic ramp input, prints the output shape and the
+// first few values (one line, parseable by the test harness).
+package main
+
+import (
+	"fmt"
+	"os"
+	"strconv"
+
+	"paddle_tpu_goapi/paddle"
+)
+
+func main() {
+	if len(os.Args) != 4 {
+		fmt.Fprintln(os.Stderr, "usage: demo <model.pdmodel> <rows> <cols>")
+		os.Exit(2)
+	}
+	rows, _ := strconv.Atoi(os.Args[2])
+	cols, _ := strconv.Atoi(os.Args[3])
+
+	p, err := paddle.NewPredictor(os.Args[1])
+	if err != nil {
+		fmt.Fprintln(os.Stderr, err)
+		os.Exit(1)
+	}
+	defer p.Destroy()
+
+	data := make([]float32, rows*cols)
+	for i := range data {
+		data[i] = 0.01 * float32(i)
+	}
+	in := paddle.NewFloat32Tensor(data, []int64{int64(rows), int64(cols)})
+	if err := p.Run(in); err != nil {
+		fmt.Fprintln(os.Stderr, err)
+		os.Exit(1)
+	}
+	shape, err := p.OutputShape(0)
+	if err != nil {
+		fmt.Fprintln(os.Stderr, err)
+		os.Exit(1)
+	}
+	out, err := p.OutputData(0)
+	if err != nil {
+		fmt.Fprintln(os.Stderr, err)
+		os.Exit(1)
+	}
+	head := out
+	if len(head) > 4 {
+		head = head[:4]
+	}
+	fmt.Printf("GOAPI_OK shape=%v head=%v\n", shape, head)
+}

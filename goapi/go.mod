@@ -1,0 +1,3 @@
+module paddle_tpu_goapi
+
+go 1.21

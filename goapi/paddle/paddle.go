@@ -1,0 +1,194 @@
+// Package paddle is the Go inference client for paddle_tpu.
+//
+// Reference role: paddle/fluid/inference/goapi/ (the reference's Go
+// predictor over its C API). This package wraps libpaddle_capi.so
+// (native/c_api.cc) via cgo; the library embeds CPython and runs
+// StableHLO artifacts through the same XLA/PJRT runtime the Python API
+// uses, so a Go service gets the identical serving path.
+//
+// Build:
+//
+//	export CGO_LDFLAGS="-L$HOME/.cache/paddle_tpu -lpaddle_capi \
+//	    -Wl,-rpath,$HOME/.cache/paddle_tpu"
+//	go build ./...
+//
+// (libpaddle_capi.so is produced by
+// `python -c "from paddle_tpu.inference.c_api import build_c_api; print(build_c_api())"`.)
+package paddle
+
+/*
+#cgo LDFLAGS: -lpaddle_capi
+#include <stdint.h>
+#include <stdlib.h>
+#include "paddle_c.h"
+*/
+import "C"
+
+import (
+	"errors"
+	"fmt"
+	"runtime"
+	"unsafe"
+)
+
+// DType enumerates the tensor element types the C ABI accepts.
+type DType int
+
+const (
+	Float32 DType = iota
+	Int64
+	Int32
+)
+
+func (d DType) size() int {
+	if d == Int64 {
+		return 8
+	}
+	return 4
+}
+
+// Tensor is one dense, row-major input.
+type Tensor struct {
+	Data  []byte // raw little-endian element bytes, len = prod(Shape)*size
+	Shape []int64
+	DType DType
+}
+
+// NewFloat32Tensor packs a []float32 into a Tensor.
+func NewFloat32Tensor(data []float32, shape []int64) Tensor {
+	b := unsafe.Slice((*byte)(unsafe.Pointer(&data[0])), len(data)*4)
+	return Tensor{Data: b, Shape: shape, DType: Float32}
+}
+
+// NewInt64Tensor packs a []int64 into a Tensor.
+func NewInt64Tensor(data []int64, shape []int64) Tensor {
+	b := unsafe.Slice((*byte)(unsafe.Pointer(&data[0])), len(data)*8)
+	return Tensor{Data: b, Shape: shape, DType: Int64}
+}
+
+// Predictor wraps one PD_Predictor handle.
+type Predictor struct {
+	h *C.PD_Predictor
+}
+
+func lastError(where string) error {
+	return fmt.Errorf("%s: %s", where, C.GoString(C.PD_GetLastError()))
+}
+
+// NewPredictor loads a saved model (paddle_tpu .pdmodel artifact, the
+// jit.save output) and returns a ready predictor.
+func NewPredictor(modelPath string) (*Predictor, error) {
+	cs := C.CString(modelPath)
+	defer C.free(unsafe.Pointer(cs))
+	h := C.PD_PredictorCreate(cs)
+	if h == nil {
+		return nil, lastError("PD_PredictorCreate")
+	}
+	p := &Predictor{h: h}
+	runtime.SetFinalizer(p, func(p *Predictor) { p.Destroy() })
+	return p, nil
+}
+
+// Destroy releases the native handle. Safe to call twice.
+func (p *Predictor) Destroy() {
+	if p.h != nil {
+		C.PD_PredictorDestroy(p.h)
+		p.h = nil
+	}
+}
+
+// InputNum returns the model's input arity.
+func (p *Predictor) InputNum() int {
+	return int(C.PD_PredictorGetInputNum(p.h))
+}
+
+// OutputNum returns the model's output arity.
+func (p *Predictor) OutputNum() int {
+	return int(C.PD_PredictorGetOutputNum(p.h))
+}
+
+// Name returns the i-th input (isInput) or output name.
+func (p *Predictor) Name(isInput bool, i int) (string, error) {
+	buf := make([]C.char, 256)
+	flag := C.int(0)
+	if isInput {
+		flag = 1
+	}
+	n := C.PD_PredictorGetName(p.h, flag, C.int(i), &buf[0],
+		C.int(len(buf)))
+	if n < 0 {
+		return "", lastError("PD_PredictorGetName")
+	}
+	return C.GoString(&buf[0]), nil
+}
+
+// Run executes the model on the given inputs. Outputs stay owned by the
+// predictor until the next Run; fetch them with OutputShape/OutputData.
+func (p *Predictor) Run(inputs ...Tensor) error {
+	if p.h == nil {
+		return errors.New("predictor destroyed")
+	}
+	n := len(inputs)
+	ptrs := make([]unsafe.Pointer, n)
+	shapes := make([]*C.int64_t, n)
+	ndims := make([]C.int, n)
+	dtypes := make([]C.int, n)
+	// the C side copies inputs before returning, so stack pins via
+	// cgo's argument rules are sufficient — no manual C allocation
+	for i, t := range inputs {
+		want := int64(t.DType.size())
+		for _, d := range t.Shape {
+			want *= d
+		}
+		if int64(len(t.Data)) != want {
+			return fmt.Errorf("input %d: %d data bytes for shape %v",
+				i, len(t.Data), t.Shape)
+		}
+		ptrs[i] = unsafe.Pointer(&t.Data[0])
+		shapes[i] = (*C.int64_t)(unsafe.Pointer(&t.Shape[0]))
+		ndims[i] = C.int(len(t.Shape))
+		dtypes[i] = C.int(t.DType)
+	}
+	rc := C.PD_PredictorRun(p.h, &ptrs[0], &shapes[0], &ndims[0],
+		&dtypes[0], C.int(n))
+	runtime.KeepAlive(inputs)
+	if rc != 0 {
+		return lastError("PD_PredictorRun")
+	}
+	return nil
+}
+
+// OutputShape returns the shape of output i of the last Run.
+func (p *Predictor) OutputShape(i int) ([]int64, error) {
+	var buf [8]C.int64_t
+	var ndim C.int
+	if C.PD_PredictorGetOutputShape(p.h, C.int(i), &buf[0], &ndim,
+		C.int(len(buf))) != 0 {
+		return nil, lastError("PD_PredictorGetOutputShape")
+	}
+	out := make([]int64, int(ndim))
+	for d := range out {
+		out[d] = int64(buf[d])
+	}
+	return out, nil
+}
+
+// OutputData returns output i of the last Run as float32 (the C ABI
+// converts; matches the reference goapi's copy-to-host contract).
+func (p *Predictor) OutputData(i int) ([]float32, error) {
+	shape, err := p.OutputShape(i)
+	if err != nil {
+		return nil, err
+	}
+	elems := int64(1)
+	for _, d := range shape {
+		elems *= d
+	}
+	buf := make([]float32, elems)
+	got := C.PD_PredictorGetOutputData(p.h, C.int(i),
+		(*C.float)(unsafe.Pointer(&buf[0])), C.int64_t(elems))
+	if got < 0 {
+		return nil, lastError("PD_PredictorGetOutputData")
+	}
+	return buf[:got], nil
+}

@@ -1,0 +1,46 @@
+/* C declarations for libpaddle_capi.so (native/c_api.cc).
+ *
+ * Reference role: paddle/fluid/inference/goapi/ — the Go inference
+ * client. The reference ships a .h alongside its C API
+ * (paddle/fluid/inference/capi_exp/pd_inference_api.h); this header is
+ * the equivalent surface for the TPU-native library, consumed by the
+ * cgo package in paddle.go.
+ */
+#ifndef PADDLE_TPU_GOAPI_PADDLE_C_H_
+#define PADDLE_TPU_GOAPI_PADDLE_C_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+enum PD_DType { PD_DTYPE_FLOAT32 = 0, PD_DTYPE_INT64 = 1,
+                PD_DTYPE_INT32 = 2 };
+
+typedef struct PD_Predictor PD_Predictor;
+
+const char* PD_GetLastError(void);
+
+PD_Predictor* PD_PredictorCreate(const char* model_path);
+void PD_PredictorDestroy(PD_Predictor* h);
+
+int PD_PredictorGetInputNum(PD_Predictor* h);
+int PD_PredictorGetOutputNum(PD_Predictor* h);
+int PD_PredictorGetName(PD_Predictor* h, int is_input, int i, char* buf,
+                        int capacity);
+
+int PD_PredictorRun(PD_Predictor* h, const void** inputs,
+                    const int64_t** shapes, const int* ndims,
+                    const int* dtypes, int n_inputs);
+
+int PD_PredictorGetOutputShape(PD_Predictor* h, int i, int64_t* shape,
+                               int* ndim, int capacity);
+int64_t PD_PredictorGetOutputData(PD_Predictor* h, int i, float* buf,
+                                  int64_t capacity);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* PADDLE_TPU_GOAPI_PADDLE_C_H_ */

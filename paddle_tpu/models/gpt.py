@@ -305,11 +305,12 @@ class GPTForCausalLM(Layer):
             x, caches = self.gpt(ids, caches, pos)
             return self._logits(x), caches
         x = self.gpt(ids)
-        if self.cfg.fused_loss_chunk:
+        if self.cfg.fused_loss_chunk and self.training:
             # training-perf contract (cfg.fused_loss_chunk): hand the
             # hidden states + LM weight to fused_loss_fn so the logits
-            # never materialize; decode/caches path above still returns
-            # logits for generate()
+            # never materialize. Gated on self.training so eval()/
+            # perplexity callers always get logits; decode/caches path
+            # above returns logits for generate() either way.
             return x, self._lm_weight()
         return self._logits(x)
 
@@ -360,9 +361,15 @@ class GPTForCausalLM(Layer):
     @staticmethod
     def fused_loss_fn(outputs, labels, chunk_size=512):
         """loss_fn counterpart for cfg.fused_loss_chunk models: outputs is
-        (hidden, lm_weight) from forward; the shifted tokens stream
-        through F.fused_linear_cross_entropy so [tokens, vocab] logits
-        never materialize."""
+        (hidden, lm_weight) from a training-mode forward; the shifted
+        tokens stream through F.fused_linear_cross_entropy so
+        [tokens, vocab] logits never materialize.
+
+        An eval()-mode forward returns plain logits (the fused return is
+        gated on self.training), so make_loss_fn's output stays correct
+        in both modes: logits fall through to loss_fn here."""
+        if not isinstance(outputs, tuple):
+            return GPTForCausalLM.loss_fn(outputs, labels)
         hidden, w = outputs
         S = hidden.shape[1]
         h_s = T.slice(hidden, [1], [0], [S - 1])

@@ -280,9 +280,10 @@ class LlamaForCausalLM(Layer):
             x, caches = self.llama(ids, caches, pos)
             return self.lm_head(x), caches
         x = self.llama(ids)
-        if self.cfg.fused_loss_chunk:
+        if self.cfg.fused_loss_chunk and self.training:
             # training-perf contract: hand (hidden, lm_weight [H, V]) to
-            # fused_loss_fn so the logits never materialize
+            # fused_loss_fn so the logits never materialize (gated on
+            # self.training so eval() callers always get logits)
             return x, self.lm_head.weight
         return self.lm_head(x)
 

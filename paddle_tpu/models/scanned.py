@@ -51,6 +51,15 @@ class ScannedStack(Layer):
     (buffers are not stacked, same rule as PipelineLayer body blocks).
     Stochastic blocks (dropout>0) must be rejected by the CALLER — the
     scan body is traced once, so every layer would reuse one RNG draw.
+
+    Initializer restriction: the rule above REPLACES the template
+    block's own initializers (a LazyGuard template holds no values to
+    stack). A block with a custom ``weight_attr`` (scaled residual
+    init, non-Normal draws) or a rank-1 parameter not named
+    ``*.weight``/bias would initialize differently from its unrolled
+    counterpart — such blocks must either use ``load_from_blocks`` to
+    import real values, or extend the rule here. Today's GPT/LLaMA/BERT
+    blocks all follow the rule exactly.
     """
 
     def __init__(self, block_factory, num_layers: int,

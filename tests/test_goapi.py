@@ -1,0 +1,84 @@
+"""Go inference API (goapi/) — reference goapi role.
+
+When a Go toolchain is present: build libpaddle_capi.so, save a tiny
+model, `go run` the demo consumer, and check its output against the
+Python predictor. Without Go (this build image), the run test records
+an explicit skip — and the static checks below still keep the package
+honest (files present, cgo preamble binds only symbols the C ABI
+actually exports, demo stays in sync with the header).
+"""
+import os
+import re
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOAPI = os.path.join(ROOT, "goapi")
+
+_GO = shutil.which("go")
+
+
+def test_goapi_package_is_complete():
+    for rel in ("go.mod", "README.md", "paddle/paddle.go",
+                "paddle/paddle_c.h", "demo/main.go"):
+        assert os.path.exists(os.path.join(GOAPI, rel)), rel
+
+
+def test_header_matches_c_library_exports():
+    """Every PD_* the header declares must exist in native/c_api.cc —
+    a drifted header would fail any consumer at link time."""
+    with open(os.path.join(GOAPI, "paddle", "paddle_c.h")) as f:
+        header_syms = set(re.findall(r"\b(PD_\w+)\s*\(", f.read()))
+    with open(os.path.join(ROOT, "native", "c_api.cc")) as f:
+        impl = f.read()
+    missing = {s for s in header_syms if s + "(" not in impl.replace(" ", "")}
+    assert not missing, f"header declares unimplemented symbols: {missing}"
+    assert "PD_PredictorRun" in header_syms  # the surface is non-trivial
+
+
+def test_go_binds_only_declared_symbols():
+    with open(os.path.join(GOAPI, "paddle", "paddle_c.h")) as f:
+        header_syms = set(re.findall(r"\b(PD_\w+)\s*\(", f.read()))
+    with open(os.path.join(GOAPI, "paddle", "paddle.go")) as f:
+        used = set(re.findall(r"C\.(PD_\w+)\(", f.read()))
+    assert used <= header_syms, used - header_syms
+
+
+@pytest.mark.skipif(_GO is None, reason="no Go toolchain in this image "
+                    "(recorded skip — see goapi/README.md CI status)")
+def test_goapi_end_to_end(tmp_path):
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.inference.c_api import build_c_api
+
+    so = build_c_api()
+    assert so, "C API failed to build"
+
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.Linear(16, 4))
+    m.eval()
+    path = str(tmp_path / "model")
+    paddle.jit.save(m, path, input_spec=[paddle.jit.InputSpec([None, 8])])
+
+    rows, cols = 3, 8
+    x = (0.01 * np.arange(rows * cols, dtype=np.float32)).reshape(rows,
+                                                                  cols)
+    ref = m(paddle.to_tensor(x)).numpy()
+
+    env = dict(os.environ)
+    lib_dir = os.path.dirname(so)
+    env["CGO_LDFLAGS"] = (f"-L{lib_dir} -lpaddle_capi "
+                          f"-Wl,-rpath,{lib_dir}")
+    r = subprocess.run(
+        [_GO, "run", "./demo", path + ".pdmodel", str(rows), str(cols)],
+        cwd=GOAPI, env=env, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+    mline = [ln for ln in r.stdout.splitlines() if "GOAPI_OK" in ln]
+    assert mline, r.stdout
+    head = [float(v) for v in
+            re.search(r"head=\[([^\]]*)\]", mline[0]).group(1).split()]
+    np.testing.assert_allclose(head, ref.ravel()[:4], rtol=1e-4,
+                               atol=1e-5)

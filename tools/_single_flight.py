@@ -1,0 +1,204 @@
+"""Single-flight lock for the one-chip axon tunnel.
+
+The same failure mode ate parts of rounds 2-4: a second tool (or a
+watchdog kill) touching the tunnel while a remote compile was in flight
+wedges the backend for EVERY later client, for hours. The fix is
+structural, not behavioral: every TPU-touching tool takes this lock
+before its first backend contact and holds it until exit, so a second
+tool can only WAIT (never overlap, never kill).
+
+Design — kernel flock, not pidfiles:
+  * the lock is ``fcntl.flock(LOCK_EX)`` on ``tpu_results/
+    .tpu_inflight/lock``. Mutual exclusion and release-on-death are the
+    KERNEL's, so there is no stale-lock reclaim logic to race on: a
+    SIGKILLed holder (the round-4 watchdog-kill shape) drops the lock
+    the instant the process dies, and the next waiter's poll acquires
+    it. Hand-rolled pid-liveness reclaim was tried first and has an
+    unfixable check-then-act window (two waiters both observe a dead
+    owner; the slower one deletes the lock the faster one just took).
+  * ``owner.json`` next to the lock file is ADVISORY ONLY: the holder
+    records (pid, tool, stage) so a waiter — or a postmortem — can see
+    WHO holds it and WHERE it is (probe/compile/measure) without
+    touching the tunnel. It plays no part in mutual exclusion, so
+    stale owner info after a kill is harmless (overwritten by the next
+    holder).
+  * a LIVE holder is never broken, no matter how long it holds: a 1.3B
+    remote compile legitimately runs >25 min, and killing it is exactly
+    the wedge this module exists to prevent. ``acquire`` polls
+    (LOCK_NB, 2 s) and raises ``BusyTimeout`` after ``wait`` seconds;
+    callers decide whether that is fatal (driver bench emits its JSON
+    error record) or skippable (watcher probe).
+
+Reference analog: the reference serializes device access per stream at
+the framework layer (SURVEY.md §3.3 executor dispatch); with one chip
+behind a shared tunnel the serialization point has to live host-side,
+which is this file.
+
+Env:
+  PADDLE_TPU_LOCK_DIR   override lock location (tests use a tmpdir)
+  PADDLE_TPU_LOCK_WAIT  default wait seconds for acquire() (1800)
+"""
+from __future__ import annotations
+
+import fcntl
+import json
+import os
+import sys
+import time
+
+_DEF_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tpu_results", ".tpu_inflight")
+
+
+class BusyTimeout(RuntimeError):
+    """Lock still held by a live process after the wait budget."""
+
+
+def _lock_dir() -> str:
+    return os.environ.get("PADDLE_TPU_LOCK_DIR", _DEF_DIR)
+
+
+def _lock_path() -> str:
+    return os.path.join(_lock_dir(), "lock")
+
+
+def _owner_path() -> str:
+    return os.path.join(_lock_dir(), "owner.json")
+
+
+def read_owner():
+    """Advisory owner record, or None. Never touches the tunnel. May be
+    stale after a holder was killed — trust ``holder_alive`` (the
+    kernel) for liveness, this only for who/where context."""
+    try:
+        with open(_owner_path()) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def holder_alive() -> bool:
+    """True when some live process holds the lock (kernel's answer:
+    try-acquire non-blocking and release immediately on success)."""
+    try:
+        fd = os.open(_lock_path(), os.O_RDWR)
+    except OSError:
+        return False  # lock file never created -> never held
+    try:
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            return True
+        fcntl.flock(fd, fcntl.LOCK_UN)
+        return False
+    finally:
+        os.close(fd)
+
+
+class SingleFlight:
+    """Context manager: hold the tunnel single-flight lock.
+
+    with SingleFlight("bench:gpt1.3b") as lock:
+        ...probe...
+        lock.stage("compile")   # visible to waiters
+        ...compile/measure...
+    """
+
+    def __init__(self, tool: str, wait: float | None = None, log=None):
+        self.tool = tool
+        self.wait = (float(os.environ.get("PADDLE_TPU_LOCK_WAIT", 1800))
+                     if wait is None else wait)
+        self._log = log or (lambda m: sys.stderr.write(m + "\n"))
+        self._fd = None
+        self._held = False
+
+    def __enter__(self):
+        os.makedirs(_lock_dir(), exist_ok=True)
+        # O_CREAT once; the fd (not the path) carries the flock, so the
+        # file itself is permanent and shared by all contenders
+        self._fd = os.open(_lock_path(), os.O_CREAT | os.O_RDWR, 0o644)
+        deadline = time.time() + self.wait
+        announced = False
+        while True:
+            try:
+                fcntl.flock(self._fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                break
+            except OSError:
+                pass
+            o = read_owner() or {}
+            if not announced:
+                self._log("[tpu-lock] busy: %s pid=%s stage=%s — waiting "
+                          "(never killing; wait budget %ds)"
+                          % (o.get("tool"), o.get("pid"),
+                             o.get("stage"), int(self.wait)))
+                announced = True
+            if time.time() >= deadline:
+                os.close(self._fd)
+                self._fd = None
+                raise BusyTimeout(
+                    "tunnel lock held by %s pid=%s stage=%s after %ds"
+                    % (o.get("tool"), o.get("pid"), o.get("stage"),
+                       int(self.wait)))
+            time.sleep(2)
+        self._held = True
+        self.stage("start")
+        return self
+
+    def stage(self, stage: str) -> None:
+        """Record where the holder is (probe/compile/measure/...)."""
+        if not self._held:
+            return
+        tmp = "%s.%d.tmp" % (_owner_path(), os.getpid())
+        try:
+            with open(tmp, "w") as f:
+                json.dump({"pid": os.getpid(), "tool": self.tool,
+                           "stage": stage, "t": time.time()}, f)
+            os.replace(tmp, _owner_path())
+        except OSError:
+            pass  # advisory only — never let it break a measurement
+
+    def __exit__(self, *exc):
+        if self._held:
+            self._held = False
+            try:
+                os.unlink(_owner_path())  # advisory cleanup, best-effort
+            except OSError:
+                pass
+        if self._fd is not None:
+            try:
+                fcntl.flock(self._fd, fcntl.LOCK_UN)
+            except OSError:
+                pass
+            os.close(self._fd)
+            self._fd = None
+        return False
+
+
+def maybe_acquire(tool: str, log=None):
+    """Tool-side entry: take the lock unless this process is pinned to
+    the CPU backend (JAX_PLATFORMS=cpu — tests/smoke runs never touch
+    the tunnel). Releases via atexit; any death releases via the
+    kernel. Returns the lock or None.
+
+    BusyTimeout propagates: the caller decides whether busy is fatal
+    (bench.py emits its driver-metric error record) — tools with the
+    plain JSON-error contract use acquire_or_die instead."""
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        return None
+    lock = SingleFlight(tool, log=log)
+    lock.__enter__()
+    import atexit
+    atexit.register(lock.__exit__, None, None, None)
+    return lock
+
+
+def acquire_or_die(tool: str, log=None):
+    """maybe_acquire, but a BusyTimeout emits the measurement tools'
+    standard JSON error line (same contract as _probe._unavailable) and
+    exits 4 — never a raw traceback on a driver-parsed stdout."""
+    try:
+        return maybe_acquire(tool, log=log)
+    except BusyTimeout as e:
+        print(json.dumps({"error": "tpu_busy", "detail": str(e)}))
+        sys.stderr.write("[tpu-lock] %s\n" % e)
+        raise SystemExit(4)

@@ -49,7 +49,11 @@ def main():
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from _probe import probe_backend
+    from _single_flight import acquire_or_die
+    lock = acquire_or_die("bench_ring")  # before first tunnel contact
     probe_backend()  # cpu is a healthy result; exits 4 if tunnel wedged
+    if lock is not None:
+        lock.stage("compile+measure")
 
     import jax
     import jax.numpy as jnp

@@ -1,25 +1,58 @@
 #!/bin/bash
 # Probe the axon backend every 10 min; on success run tpu_suite2.sh once.
 # Probe kills are safe: no TPU step or compile ever runs in the probe.
+# Single-flight aware: while tpu_results/.tpu_inflight is held by a live
+# process, SKIP probing entirely — a held lock means the tunnel is in
+# use (ipso facto alive), and an extra backend-init alongside a remote
+# compile is exactly the overlap the lock exists to prevent.
 cd /root/repo || exit 1
 LOG=/root/repo/tpu_results/watch2.log
+
+# one watcher at a time: kernel flock on fd 9 — released on ANY death
+# (no stale state, no pid reuse, no check-then-act reclaim races). The
+# pid written into the file is advisory, for humans reading the dir.
+WD=/root/repo/tpu_results/.watch2_pid
+exec 9>>"$WD"   # append-open: a losing contender must not truncate
+if ! flock -n 9; then
+  echo "[watch2] another watcher alive (pid $(cat "$WD" 2>/dev/null)), exiting" >> "$LOG"
+  exit 0
+fi
+echo $$ > "$WD"
+
 echo "[watch2] start $(date -u +%FT%TZ) pid=$$" >> "$LOG"
 A=0
 while true; do
   A=$((A + 1))
   echo "[watch2] $(date -u +%FT%TZ) probe attempt=$A" >> "$LOG"
-  if timeout 120 python - >> "$LOG" 2>&1 <<'PY'
-import jax, sys
-d = jax.devices()
-if getattr(d[0], "platform", "") == "cpu":
-    sys.exit(3)
-print("device_kind=%s" % getattr(d[0], "device_kind", "?"))
+  # The probe itself holds the single-flight lock (no check-then-probe
+  # TOCTOU): wait=5 means a busy tunnel -> rc=5 skip, not a 120s init
+  # alongside someone's compile. probe_backend's hang kill is its own
+  # subprocess (safe); outer timeout is belt-and-braces only.
+  timeout 180 python - >> "$LOG" 2>&1 9>&- <<'PY'
+import sys
+sys.path.insert(0, "/root/repo/tools")
+from _single_flight import BusyTimeout, SingleFlight
+try:
+    lk = SingleFlight("watch2-probe", wait=5).__enter__()
+except BusyTimeout:
+    print("[watch2-probe] lock held (tunnel in use) - skip")
+    sys.exit(5)
+try:
+    from _probe import probe_backend   # exits 4 on wedge/hang
+    kind = probe_backend(budget=120)
+    if kind == "cpu":
+        sys.exit(3)
+    print("device_kind=%s" % kind)
+finally:
+    lk.__exit__(None, None, None)
 PY
-  then
+  RC=$?
+  if [ "$RC" = 0 ]; then
     echo "[watch2] $(date -u +%FT%TZ) probe OK -> tpu_suite2" >> "$LOG"
-    bash /root/repo/tools/tpu_suite2.sh
+    bash /root/repo/tools/tpu_suite2.sh 9>&-
     echo "[watch2] suite2 exited rc=$?" >> "$LOG"
     exit 0
   fi
-  sleep 600
+  echo "[watch2] $(date -u +%FT%TZ) probe rc=$RC" >> "$LOG"
+  sleep 600 9>&-
 done
