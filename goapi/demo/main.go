@@ -19,8 +19,12 @@ func main() {
 		fmt.Fprintln(os.Stderr, "usage: demo <model.pdmodel> <rows> <cols>")
 		os.Exit(2)
 	}
-	rows, _ := strconv.Atoi(os.Args[2])
-	cols, _ := strconv.Atoi(os.Args[3])
+	rows, errR := strconv.Atoi(os.Args[2])
+	cols, errC := strconv.Atoi(os.Args[3])
+	if errR != nil || errC != nil || rows < 1 || cols < 1 {
+		fmt.Fprintln(os.Stderr, "rows/cols must be positive integers")
+		os.Exit(2)
+	}
 
 	p, err := paddle.NewPredictor(os.Args[1])
 	if err != nil {

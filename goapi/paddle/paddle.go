@@ -124,17 +124,37 @@ func (p *Predictor) Name(isInput bool, i int) (string, error) {
 
 // Run executes the model on the given inputs. Outputs stay owned by the
 // predictor until the next Run; fetch them with OutputShape/OutputData.
+//
+// Inputs are copied into C memory before the call: cgo's pointer rules
+// forbid passing Go slices that contain Go pointers (the pointer tables
+// below), and the C side copies anyway (np.frombuffer(...).copy()), so
+// the extra copy is the price of rule-compliance, not a new cost class.
 func (p *Predictor) Run(inputs ...Tensor) error {
 	if p.h == nil {
 		return errors.New("predictor destroyed")
 	}
 	n := len(inputs)
-	ptrs := make([]unsafe.Pointer, n)
-	shapes := make([]*C.int64_t, n)
-	ndims := make([]C.int, n)
-	dtypes := make([]C.int, n)
-	// the C side copies inputs before returning, so stack pins via
-	// cgo's argument rules are sufficient — no manual C allocation
+	if n == 0 {
+		return errors.New("Run needs at least one input")
+	}
+	ptrSize := C.size_t(unsafe.Sizeof(unsafe.Pointer(nil)))
+	intSize := C.size_t(unsafe.Sizeof(C.int(0)))
+	cPtrs := (*unsafe.Pointer)(C.malloc(C.size_t(n) * ptrSize))
+	cShapes := (**C.int64_t)(C.malloc(C.size_t(n) * ptrSize))
+	cNdims := (*C.int)(C.malloc(C.size_t(n) * intSize))
+	cDtypes := (*C.int)(C.malloc(C.size_t(n) * intSize))
+	var owned []unsafe.Pointer // every C allocation to free on return
+	owned = append(owned, unsafe.Pointer(cPtrs), unsafe.Pointer(cShapes),
+		unsafe.Pointer(cNdims), unsafe.Pointer(cDtypes))
+	defer func() {
+		for _, q := range owned {
+			C.free(q)
+		}
+	}()
+	ptrs := unsafe.Slice(cPtrs, n)
+	shapes := unsafe.Slice(cShapes, n)
+	ndims := unsafe.Slice(cNdims, n)
+	dtypes := unsafe.Slice(cDtypes, n)
 	for i, t := range inputs {
 		want := int64(t.DType.size())
 		for _, d := range t.Shape {
@@ -144,13 +164,21 @@ func (p *Predictor) Run(inputs ...Tensor) error {
 			return fmt.Errorf("input %d: %d data bytes for shape %v",
 				i, len(t.Data), t.Shape)
 		}
-		ptrs[i] = unsafe.Pointer(&t.Data[0])
-		shapes[i] = (*C.int64_t)(unsafe.Pointer(&t.Shape[0]))
+		cData := C.CBytes(t.Data)
+		owned = append(owned, cData)
+		shapeBytes := C.malloc(C.size_t(len(t.Shape)) * 8)
+		owned = append(owned, shapeBytes)
+		cshape := unsafe.Slice((*C.int64_t)(shapeBytes), len(t.Shape))
+		for d, v := range t.Shape {
+			cshape[d] = C.int64_t(v)
+		}
+		ptrs[i] = cData
+		shapes[i] = (*C.int64_t)(shapeBytes)
 		ndims[i] = C.int(len(t.Shape))
 		dtypes[i] = C.int(t.DType)
 	}
-	rc := C.PD_PredictorRun(p.h, &ptrs[0], &shapes[0], &ndims[0],
-		&dtypes[0], C.int(n))
+	rc := C.PD_PredictorRun(p.h, cPtrs, cShapes, cNdims, cDtypes,
+		C.int(n))
 	runtime.KeepAlive(inputs)
 	if rc != 0 {
 		return lastError("PD_PredictorRun")

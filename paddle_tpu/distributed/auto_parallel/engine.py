@@ -35,6 +35,11 @@ class Engine:
         self._trained_forward = None
         self._n_inputs = 1
         self._history = None
+        # the training-step plan — distributed.passes pipelines mutate
+        # THIS (Pass.apply(engine) targets engine.plan), and prepare()
+        # folds the strategy on top before building the step
+        from ..passes import new_step_plan
+        self.plan = new_step_plan()
 
     # ------------------------------------------------------------------
     def _mesh(self):
@@ -55,23 +60,27 @@ class Engine:
         if mode == "train":
             assert self._loss is not None and self._optimizer is not None, (
                 "Engine.prepare(mode='train') needs loss and optimizer")
-            zero_stage = 0
-            accumulate = 1
-            remat = False
+            plan = dict(self.plan)  # pass-pipeline output (passes.py)
             st = self._strategy
-            if st is not None:
+            if st is not None:  # strategy folds over the plan
                 sh = getattr(st, "sharding_configs", None)
                 if getattr(st, "sharding", False) and sh is not None:
-                    zero_stage = sh.stage
+                    plan["zero_stage"] = sh.stage
                 pp = getattr(st, "pipeline_configs", None)
                 if pp is not None:
-                    accumulate = max(1, pp.accumulate_steps)
-                remat = bool(getattr(st, "recompute", False))
+                    plan["accumulate_steps"] = max(1, pp.accumulate_steps)
+                if getattr(st, "recompute", False):
+                    plan["remat"] = True
+            if plan.get("amp_level") == "O2":
+                # pure-bf16 params (the reference's pure-fp16 pass
+                # outcome; O1 is the default autocast behavior here)
+                self._model.bfloat16()
             self._train_step = ParallelTrainStep(
                 self._model, self._loss, self._optimizer,
                 n_inputs=self._n_inputs, mesh=self._mesh(),
-                zero_stage=zero_stage, remat=remat,
-                accumulate_steps=accumulate)
+                zero_stage=plan["zero_stage"], remat=plan["remat"],
+                accumulate_steps=plan["accumulate_steps"],
+                remat_policy=plan.get("remat_policy", "full"))
             self._trained_forward = None
         self._mode = mode
         return self

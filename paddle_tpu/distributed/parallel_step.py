@@ -105,13 +105,16 @@ class ParallelTrainStep:
 
     loss_fn contract matches jit.TrainStep: loss_fn(outputs, *labels).
     `batch_specs`: optional PartitionSpec per batch arg (default: dim 0
-    over "dp" and — if the arg is rank>=2 and "sp" exists — dim 1 over
-    "sp" for sequence parallelism).
+    over every data axis — ("dp", "sharding") jointly when both exist
+    and divide the batch, ZeRO groups being sub-groups of data
+    parallelism — and, if the arg is rank>=2 and "sp" exists, dim 1
+    over "sp" for sequence parallelism).
     """
 
     def __init__(self, model, loss_fn, optimizer, n_inputs: int = 1,
                  zero_stage: int = 0, batch_specs=None, mesh=None,
-                 remat: bool = False, accumulate_steps: int = 1):
+                 remat: bool = False, accumulate_steps: int = 1,
+                 remat_policy: str = "full"):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -122,6 +125,10 @@ class ParallelTrainStep:
             zero_stage = getattr(optimizer, "_group_sharded_level", 0)
         self.zero_stage = zero_stage
         self.remat = remat
+        # resolve eagerly: a typo'd policy fails at construction (same
+        # contract as models/scanned.py)
+        from .recompute import resolve_checkpoint_policy
+        self._remat_policy = resolve_checkpoint_policy(remat_policy)
         self.mesh = mesh or mesh_mod.get_mesh()
         self.batch_specs = batch_specs
         if accumulate_steps < 1:
@@ -153,8 +160,23 @@ class ParallelTrainStep:
                                  _zero_spec(v.shape, self.mesh, ax,
                                             base_specs[n]))
                 for n, v in params.items()}
+            # stage-3 FSDP contract, made explicit: weights are
+            # all-gathered back to their mp layout ONCE per fwd (and
+            # re-gathered in the remat'd bwd), not resolved ad-hoc at
+            # every matmul. Without this use-site constraint the SPMD
+            # partitioner sees the zero axis on BOTH matmul operands
+            # (batch rows of x, contraction dim of W) and can resolve
+            # the conflict by un-sharding the ACTIVATIONS — measured
+            # on the 6.7B step: ~2.7 TiB/step of activation all-gathers
+            # vs ~40 GiB/step of weight gathers with the constraint
+            # (tools/northstar_model.py). Reference semantics:
+            # group_sharded_stage3.py:194 forward all-gather hooks.
+            self._use_shardings = {n: NamedSharding(self.mesh,
+                                                    base_specs[n])
+                                   for n in params}
         else:
             self.param_shardings = {n: shardings[n] for n in params}
+            self._use_shardings = None
         # Abstract mode (framework/lazy_init.LazyGuard): params are
         # ShapeDtypeStruct avals — nothing is materialized; the step can
         # only be aot_compile()d (north-star-scale validation without the
@@ -227,9 +249,24 @@ class ParallelTrainStep:
                 out.append(NamedSharding(mesh, self.batch_specs[i]))
                 continue
             spec = [None] * b.ndim
-            if b.ndim >= 1 and mesh.shape.get("dp", 1) > 1 \
-                    and b.shape[0] % mesh.shape["dp"] == 0:
-                spec[0] = "dp"
+            if b.ndim >= 1:
+                # The batch axis splits over EVERY data axis: dp AND
+                # sharding. ZeRO's sharding groups live INSIDE data
+                # parallelism (reference GroupSharded: world = dp x
+                # shard group, every rank holds a DIFFERENT batch
+                # shard) — replicating the batch across "sharding"
+                # would redundantly compute identical microbatches on
+                # every group member (caught by the r5 north-star
+                # analytic model: 8x wasted FLOPs at dp8 x sharding8).
+                axes = []
+                width = 1
+                for ax in ("dp", "sharding"):
+                    n = mesh.shape.get(ax, 1)
+                    if n > 1 and b.shape[0] % (width * n) == 0:
+                        axes.append(ax)
+                        width *= n
+                if axes:
+                    spec[0] = tuple(axes) if len(axes) > 1 else axes[0]
             if b.ndim >= 2 and mesh.shape.get("sp", 1) > 1 \
                     and b.shape[1] % mesh.shape["sp"] == 0:
                 spec[1] = "sp"
@@ -245,11 +282,19 @@ class ParallelTrainStep:
         grad_shardings = self.grad_shardings if self.zero_stage >= 1 else None
         remat = self.remat
 
+        use_shardings = self._use_shardings
+
         def fwd_bwd(params, buffers, lr, step_no, rng_key, *batch):
             inputs, labels = batch[:n_in], batch[n_in:]
 
             def loss_of(p):
                 from ..framework.aux_loss import aux_loss_scope, total
+                if use_shardings is not None:
+                    # inside the checkpoint boundary: the gathered
+                    # weights are recomputed (re-gathered) in bwd, not
+                    # saved — stage-3 memory stays sharded between uses
+                    p = {n: lax.with_sharding_constraint(
+                        v, use_shardings[n]) for n, v in p.items()}
                 with _rng.rng_guard(rng_key), aux_loss_scope() as auxes:
                     out, new_bufs = functional_call(model, p, buffers,
                                                     *inputs, training=True)
@@ -262,7 +307,8 @@ class ParallelTrainStep:
                 return loss_v, new_bufs
 
             if remat:
-                loss_of = jax.checkpoint(loss_of)
+                loss_of = jax.checkpoint(loss_of,
+                                         policy=self._remat_policy)
             (loss, new_bufs), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(params)
             if zero_grads:

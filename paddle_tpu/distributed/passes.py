@@ -2,20 +2,79 @@
 python/paddle/distributed/passes/__init__.py — pass_base.py PassManager).
 
 The reference rewrites static Programs through a registered pass
-pipeline (AMP/recompute/sharding passes). Here those transforms are
-ParallelTrainStep engine options and GSPMD's job, so passes resolve to
-recorded no-ops: the names are kept so ported auto-parallel configs
-construct, and `applied_passes` shows what the engine equivalent is.
+pipeline (AMP/recompute/sharding/gradient-merge passes applied by
+PassManager.apply before execution). Here the "program" a pass rewrites
+is the TRAINING-STEP PLAN: the kwargs ParallelTrainStep is built from.
+Each registered pass REALLY mutates that plan — apply a PassManager to
+a plan (or an auto_parallel Engine before prepare()) and the resulting
+compiled step differs accordingly; `applied_passes` records what ran.
+GSPMD/XLA remain the mechanism (there is no Program IR to edit — one
+traced jaxpr per step), which is why passes target the plan layer: it
+is the exact place the reference's pass OUTCOMES (remat on, ZeRO stage
+set, grads merged, AMP level chosen) live in this design.
+
+Registered passes (reference pass names):
+  auto_parallel_recompute      -> plan["remat"] = True (+ policy attr)
+  auto_parallel_sharding       -> plan["zero_stage"] = attrs["stage"]
+  auto_parallel_gradient_merge -> plan["accumulate_steps"] = attrs["k_steps"]
+  auto_parallel_amp / fp16     -> plan["amp_level"] ("O1"/"O2" — the
+                                  engine maps it to bf16 casts)
+Unknown names still construct (ported configs must not crash) but
+apply() raises loudly rather than silently no-opping.
 """
 from __future__ import annotations
 
-__all__ = ["new_pass", "PassManager", "PassContext"]
+__all__ = ["new_pass", "PassManager", "PassContext", "Pass",
+           "new_step_plan"]
 
-_ENGINE_EQUIV = {
-    "auto_parallel_amp": "ParallelTrainStep(amp_level=...)",
-    "auto_parallel_recompute": "ParallelTrainStep(remat=True)",
-    "auto_parallel_sharding": "ParallelTrainStep(zero_stage=...)",
-    "auto_parallel_gradient_merge": "accumulate_steps=...",
+
+def new_step_plan(**overrides):
+    """A mutable training-step plan — the pass pipeline's 'program'.
+    Keys mirror ParallelTrainStep's kwargs; Engine.prepare consumes the
+    plan after passes ran."""
+    plan = {"zero_stage": 0, "remat": False, "remat_policy": "full",
+            "accumulate_steps": 1, "amp_level": None}
+    plan.update(overrides)
+    return plan
+
+
+def _apply_recompute(plan, attrs):
+    plan["remat"] = True
+    if attrs.get("policy"):
+        plan["remat_policy"] = attrs["policy"]
+
+
+def _apply_sharding(plan, attrs):
+    stage = int(attrs.get("stage", 1))
+    if stage not in (1, 2, 3):
+        raise ValueError(f"auto_parallel_sharding: stage must be 1|2|3, "
+                         f"got {stage}")
+    plan["zero_stage"] = stage
+
+
+def _apply_gradient_merge(plan, attrs):
+    k = int(attrs.get("k_steps", 1))
+    if k < 1:
+        raise ValueError("auto_parallel_gradient_merge: k_steps >= 1")
+    plan["accumulate_steps"] = k
+
+
+def _apply_amp(plan, attrs):
+    level = attrs.get("level")
+    if level is None:
+        level = "O2" if attrs.get("use_pure_fp16") else "O1"
+    level = str(level).upper()
+    if level not in ("O1", "O2"):
+        raise ValueError(f"amp pass: level must be O1|O2, got {level}")
+    plan["amp_level"] = level
+
+
+_REGISTRY = {
+    "auto_parallel_recompute": _apply_recompute,
+    "auto_parallel_sharding": _apply_sharding,
+    "auto_parallel_gradient_merge": _apply_gradient_merge,
+    "auto_parallel_amp": _apply_amp,
+    "auto_parallel_fp16": _apply_amp,
 }
 
 
@@ -24,15 +83,23 @@ class Pass:
         self.name = name
         self.attrs = dict(attrs or {})
 
-    def apply(self, main_programs, startup_programs=None, context=None):
+    def apply(self, plan, startup_programs=None, context=None):
+        """Mutate the step plan (dict from new_step_plan, or an object
+        with a .plan dict, e.g. auto_parallel Engine). Returns the plan
+        for chaining."""
+        target = plan.plan if hasattr(plan, "plan") else plan
+        fn = _REGISTRY.get(self.name)
+        if fn is None:
+            raise NotImplementedError(
+                f"pass {self.name!r} has no step-plan rewrite here; "
+                f"registered: {sorted(_REGISTRY)}")
+        fn(target, self.attrs)
         if context is not None:
             context.applied_passes.append(self)
-        return main_programs
+        return plan
 
     def __repr__(self):
-        equiv = _ENGINE_EQUIV.get(self.name)
-        return (f"Pass({self.name!r})" +
-                (f" -> engine option {equiv}" if equiv else ""))
+        return f"Pass({self.name!r}, {self.attrs!r})"
 
 
 def new_pass(name, pass_attrs=None) -> Pass:
@@ -47,12 +114,18 @@ class PassContext:
 class PassManager:
     def __init__(self, passes):
         self._passes = list(passes)
+        self.context = PassContext()
 
-    def apply(self, main_programs, startup_programs=None):
-        ctx = PassContext()
+    def apply(self, plan, startup_programs=None):
+        """Run the pipeline over a step plan (reference
+        PassManager.apply over main_programs). Each apply() records
+        into a FRESH context — `self.context` reflects the latest
+        application only, so reusing one manager on two plans never
+        conflates what ran where."""
+        self.context = PassContext()
         for p in self._passes:
-            p.apply(main_programs, startup_programs, ctx)
-        return main_programs, startup_programs
+            p.apply(plan, startup_programs, self.context)
+        return plan, startup_programs
 
     @property
     def names(self):

@@ -255,3 +255,42 @@ class TestEngine:
         # ZeRO-3: params sharded over the axis
         p = engine._train_step.params["0.weight"]
         assert p.sharding.shard_shape(p.shape) != tuple(p.shape)
+
+
+class TestPassPipeline:
+    """distributed.passes really rewrites the Engine's step plan
+    (reference: pass_base.py PassManager over Programs; here the plan
+    IS the program surface — see passes.py docstring)."""
+
+    def test_passes_change_the_built_step(self):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.auto_parallel import Engine, ProcessMesh
+
+        pm_mesh = ProcessMesh(list(range(8)), dim_names=["dp"])
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(16, 16), nn.GELU(),
+                              nn.Linear(16, 16))
+        engine = Engine(model=model, loss=nn.MSELoss(),
+                        optimizer=paddle.optimizer.AdamW(
+                            learning_rate=1e-3,
+                            parameters=model.parameters()),
+                        process_mesh=pm_mesh)
+        pipeline = dist.passes.PassManager([
+            dist.passes.new_pass("auto_parallel_sharding", {"stage": 2}),
+            dist.passes.new_pass("auto_parallel_recompute"),
+            dist.passes.new_pass("auto_parallel_gradient_merge",
+                                 {"k_steps": 2}),
+        ])
+        pipeline.apply(engine)
+        engine.prepare(mode="train")
+        step = engine._train_step
+        assert step.zero_stage == 2          # sharding pass took effect
+        assert step.remat                    # recompute pass took effect
+        assert step.accumulate_steps == 2    # gradient merge took effect
+        # and the step still trains
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(8, 16).astype("float32"))
+        for _ in range(2):                   # k=2 -> one full update
+            loss = step(x, x)
+        assert np.isfinite(float(loss))
+        assert step.update_count == 1

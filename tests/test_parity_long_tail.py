@@ -54,11 +54,31 @@ class TestDistributedCompat:
         step = dist.ParallelTrainStep(model, GPTForCausalLM.loss_fn, opt)
         assert step.zero_stage == 3
 
-    def test_passes_and_stream_namespace(self):
-        pm = dist.passes.PassManager(
-            [dist.passes.new_pass("auto_parallel_recompute")])
-        mains, _ = pm.apply(["prog"])
-        assert mains == ["prog"] and pm.names == ["auto_parallel_recompute"]
+    def test_passes_rewrite_the_step_plan(self):
+        # the pass pipeline REALLY mutates the training-step plan
+        # (reference PassManager.apply rewrites Programs; the plan is
+        # this design's program surface — see passes.py docstring)
+        plan = dist.passes.new_step_plan()
+        pm = dist.passes.PassManager([
+            dist.passes.new_pass("auto_parallel_recompute",
+                                 {"policy": "dots"}),
+            dist.passes.new_pass("auto_parallel_sharding", {"stage": 3}),
+            dist.passes.new_pass("auto_parallel_gradient_merge",
+                                 {"k_steps": 4}),
+            dist.passes.new_pass("auto_parallel_amp", {"level": "O2"}),
+        ])
+        plan, _ = pm.apply(plan)
+        assert plan["remat"] and plan["remat_policy"] == "dots"
+        assert plan["zero_stage"] == 3
+        assert plan["accumulate_steps"] == 4
+        assert plan["amp_level"] == "O2"
+        assert len(pm.context.applied_passes) == 4
+        assert pm.names[0] == "auto_parallel_recompute"
+        # unknown passes construct (ported configs) but refuse to no-op
+        bogus = dist.passes.new_pass("fuse_all_reduce_ops")
+        import pytest as _pytest
+        with _pytest.raises(NotImplementedError):
+            bogus.apply(plan)
         assert dist.communication.stream.all_reduce is dist.all_reduce
 
 

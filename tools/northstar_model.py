@@ -52,8 +52,11 @@ L, H, V, S = 32, 4096, 50304, 2048
 N_PARAMS = 12 * L * H * H + 2 * V * H  # untied in/out embeddings
 FLOPS_TOK = 6 * N_PARAMS + 6 * L * H * S   # bench.py's accounting
 MESH = {"dp": 8, "sharding": 8}
+N_CHIPS = MESH["dp"] * MESH["sharding"]
 BATCH_PER_CHIP = 16                        # microbatch rows per chip
-TOKENS_CHIP = BATCH_PER_CHIP * S
+TOKENS_CHIP = BATCH_PER_CHIP * S           # batch splits over dp AND
+                                           # sharding (ZeRO groups are
+                                           # data-parallel sub-groups)
 REMAT_FACTOR = 4 / 3                       # full remat: fwd replayed in bwd
 
 
@@ -80,7 +83,7 @@ opt = paddle.optimizer.AdamW(learning_rate=1e-4, multi_precision=True,
                              parameters=model.parameters())
 step = dist.ParallelTrainStep(model, model.make_loss_fn(), opt,
                               zero_stage=3, remat=True)
-ids = jax.ShapeDtypeStruct((8 * %(BPC)d, %(S)d), jnp.int64)
+ids = jax.ShapeDtypeStruct((%(NCHIPS)d * %(BPC)d, %(S)d), jnp.int64)
 compiled = step.aot_compile(ids, ids)
 hlo = compiled.as_text()
 
@@ -113,7 +116,7 @@ print(json.dumps({"collectives": out,
                   "arg_bytes": mem.argument_size_in_bytes,
                   "temp_bytes": mem.temp_size_in_bytes}))
 """ % {"root": _ROOT, "mesh": MESH, "H": H, "L": n_layers, "V": V,
-       "S": S, "BPC": BATCH_PER_CHIP}
+       "S": S, "BPC": BATCH_PER_CHIP, "NCHIPS": N_CHIPS}
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "").replace(
@@ -160,11 +163,22 @@ def main():
         base[k] = ca[1] - pl * la
     comm_32 = {k: base[k] + per_layer[k] * L for k in kinds}
 
-    # group-size ring factors: ZeRO collectives ride "sharding" (8),
-    # grad sync rides "dp" (8); both are (n-1)/n rings at RING_BW
-    nshard = MESH["sharding"]
-    ring = (nshard - 1) / nshard
-    t_comm = sum(v for v in comm_32.values()) * ring / (RING_BW * 1e9)
+    # Transferred-bytes model per collective kind (ring algorithms over
+    # an n=8 group — ZeRO rides "sharding", grad sync rides "dp", both
+    # 8-wide here). The parsed bytes are the HLO RESULT signature, so:
+    #   all-gather:    result = full gathered tensor -> (n-1)/n of it moves
+    #   reduce-scatter: result = the 1/n shard -> (n-1)/n of the FULL
+    #                  tensor moves = (n-1) x result bytes
+    #   all-reduce:    ring AR = reduce-scatter + all-gather phases
+    #                  -> 2(n-1)/n x result bytes
+    #   collective-permute: one hop -> result bytes
+    #   all-to-all:    (n-1)/n x result bytes
+    n = MESH["sharding"]
+    xfer = {"all-gather": (n - 1) / n, "reduce-scatter": float(n - 1),
+            "all-reduce": 2 * (n - 1) / n, "collective-permute": 1.0,
+            "all-to-all": (n - 1) / n}
+    t_comm = sum(xfer.get(k, 1.0) * v
+                 for k, v in comm_32.items()) / (RING_BW * 1e9)
 
     flops_chip = TOKENS_CHIP * FLOPS_TOK
     anchors = _measured_anchor()
