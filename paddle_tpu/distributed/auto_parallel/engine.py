@@ -67,7 +67,11 @@ class Engine:
                 if getattr(st, "sharding", False) and sh is not None:
                     plan["zero_stage"] = sh.stage
                 pp = getattr(st, "pipeline_configs", None)
-                if pp is not None:
+                # fold only when the strategy actually sets a non-default
+                # cadence — DistributedStrategy default-constructs
+                # pipeline_configs, and an unconditional overwrite would
+                # silently negate the gradient-merge pass (plan value)
+                if pp is not None and max(1, pp.accumulate_steps) != 1:
                     plan["accumulate_steps"] = max(1, pp.accumulate_steps)
                 if getattr(st, "recompute", False):
                     plan["remat"] = True

@@ -88,6 +88,13 @@ class Pass:
         with a .plan dict, e.g. auto_parallel Engine). Returns the plan
         for chaining."""
         target = plan.plan if hasattr(plan, "plan") else plan
+        if not isinstance(target, dict):
+            raise TypeError(
+                f"Pass.apply target must be a step plan dict "
+                f"(passes.new_step_plan()) or an object with a .plan "
+                f"dict (auto_parallel Engine); got {type(plan).__name__}"
+                " — the reference's Program-list targets have no Program"
+                " IR here (see passes.py docstring)")
         fn = _REGISTRY.get(self.name)
         if fn is None:
             raise NotImplementedError(

@@ -294,3 +294,32 @@ class TestPassPipeline:
             loss = step(x, x)
         assert np.isfinite(float(loss))
         assert step.update_count == 1
+
+    def test_pass_survives_default_strategy_fold(self):
+        """A default-constructed DistributedStrategy must not silently
+        reset plan values set by passes (its pipeline_configs exists by
+        default_factory; only a non-default cadence may override)."""
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.auto_parallel import Engine, ProcessMesh
+
+        pm_mesh = ProcessMesh(list(range(8)), dim_names=["dp"])
+        paddle.seed(3)
+        model = nn.Sequential(nn.Linear(8, 8))
+        engine = Engine(model=model, loss=nn.MSELoss(),
+                        optimizer=paddle.optimizer.SGD(
+                            learning_rate=0.1,
+                            parameters=model.parameters()),
+                        strategy=dist.fleet.DistributedStrategy(),
+                        process_mesh=pm_mesh)
+        dist.passes.PassManager([
+            dist.passes.new_pass("auto_parallel_gradient_merge",
+                                 {"k_steps": 4}),
+        ]).apply(engine)
+        engine.prepare(mode="train")
+        assert engine._train_step.accumulate_steps == 4
+
+    def test_pass_apply_rejects_non_plan_targets(self):
+        import paddle_tpu.distributed as dist
+        import pytest as _pytest
+        with _pytest.raises(TypeError, match="new_step_plan"):
+            dist.passes.new_pass("auto_parallel_recompute").apply(["prog"])

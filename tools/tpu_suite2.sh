@@ -56,6 +56,8 @@ run bench_125m_fused bench_125m_fused.json \
 run bench_1p3b_dots bench_1p3b_dots.json \
     env PADDLE_TPU_BENCH_MODEL=gpt1.3b PADDLE_TPU_BENCH_REMAT_POLICY=dots \
     python bench.py
+run bench_125m_bf16opt bench_125m_bf16opt.json \
+    env PADDLE_TPU_BENCH_PURE_BF16=1 python bench.py
 # 6. int8 KV cache quality at 125M with bf16 weights (VERDICT r4 item 7;
 #    CPU/f32 numbers exist — this is the on-hardware confirmation row)
 run kv_quality kv_quality.json python tools/kv_cache_quality.py
