@@ -59,7 +59,8 @@ def test_lazy_guard_abstract_params():
         step(paddle.to_tensor(np.zeros((8, 32), "int64")))
 
 
-def _gpt67_aot_argument_bytes(scan_layers: bool) -> int:
+def _gpt67_aot_argument_bytes(scan_layers: bool,
+                              check_no_activation_gather=False) -> int:
     """BASELINE config 3: GPT-6.7B, dp2 x sharding4, ZeRO-3, remat,
     bf16 params + fp32 master — AOT-compile and return per-device
     argument bytes."""
@@ -76,7 +77,48 @@ def _gpt67_aot_argument_bytes(scan_layers: bool) -> int:
                                   zero_stage=3, remat=True)
     ids = jax.ShapeDtypeStruct((8, 2048), jnp.int64)
     compiled = step.aot_compile(ids, ids)      # raises if lowering breaks
+    if check_no_activation_gather:
+        _assert_no_activation_sized_gathers(compiled.as_text())
     return compiled.memory_analysis().argument_size_in_bytes
+
+
+def _assert_no_activation_sized_gathers(hlo: str) -> None:
+    """Regression gate for the r5 ZeRO-3 pathology: with the zero axis
+    on both matmul operands, the SPMD partitioner can resolve the
+    conflict by un-sharding ACTIVATIONS instead of weights (measured
+    2.7 TiB/step before the use-site gather fix). Discriminator: every
+    activation tensor carries the sequence dim (2048) and is large;
+    no weight at this geometry has a 2048 dim except the [2048, H]
+    position table (33 MB f32 — under the size floor). Flag any
+    all-gather whose result has a 2048 dim AND exceeds 64 MB."""
+    import re
+    width = {"f32": 4, "bf16": 2, "s32": 4, "u32": 4, "s64": 8}
+    matched = 0
+    for m in re.finditer(
+            r"^\s*(?:ROOT\s+)?(?:[%\w.\-]+|\([^)]*\)) = "
+            r"(\([^)]*\)|[\w\[\],{}\s/]+?) "
+            r"all-gather(?:-start|-done)?\(", hlo, re.M):
+        matched += 1
+        # judge each tensor in the signature on its own (an async
+        # -start result is an (operand, result) tuple — summing would
+        # double-count; the full gathered tensor judges itself)
+        for dt, dims in re.findall(r"(\w+)\[([\d,]*)\]", m.group(1)):
+            if dt not in width:
+                continue
+            n = 1
+            has_seq_dim = False
+            for d in dims.split(","):
+                if d:
+                    n *= int(d)
+                    if int(d) == 2048:
+                        has_seq_dim = True
+            nbytes = n * width[dt]
+            assert not (has_seq_dim and nbytes > 64 * 2**20), (
+                f"activation-sized all-gather ({nbytes/2**20:.0f} MiB) "
+                f"in the ZeRO-3 step — the use-site weight gather "
+                f"regressed: {m.group(1).strip()[:90]}")
+    # the gate must never be vacuous: ZeRO-3 always gathers weights
+    assert matched > 0, "no all-gather matched — gate regex is broken"
 
 
 def _assert_gpt67_memory(args: int) -> None:
@@ -100,8 +142,10 @@ def test_gpt_6_7b_scan_layers_aot_fast():
     AOT-compiles in seconds (measured 7.4s vs 209s unrolled on this
     host, 28x) with IDENTICAL per-device argument memory. Fast enough
     to run in every CI profile — depth-independent compile is the
-    feature; this guards it at north-star scale."""
-    _assert_gpt67_memory(_gpt67_aot_argument_bytes(scan_layers=True))
+    feature; this guards it at north-star scale, plus the r5
+    no-activation-sized-gathers pathology gate."""
+    _assert_gpt67_memory(_gpt67_aot_argument_bytes(
+        scan_layers=True, check_no_activation_gather=True))
 
 
 @pytest.mark.timeout(300)
