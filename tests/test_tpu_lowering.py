@@ -66,13 +66,27 @@ def test_fused_ring_lowers_for_tpu():
     assert mlir.count("collective_permute") >= 2   # the k/v rotation ring
 
 
+def _export_train_step_for_tpu(step, batch=(2, 256)):
+    """Cross-lower a built TrainStep's whole donated program for the TPU
+    target (the one export recipe both bench-shaped gates share)."""
+    import paddle_tpu.framework.random as _rng
+    step._build()
+    aval = lambda t: jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), t)
+    scalar = jax.ShapeDtypeStruct((), jnp.float32)
+    key = jax.eval_shape(lambda: _rng.default_generator().fold_in(1))
+    ids = jax.ShapeDtypeStruct(batch, jnp.int64)
+    return jax.export.export(step._jitted, platforms=["tpu"])(
+        aval(step.params), aval(step.buffers), aval(step.opt_state),
+        scalar, scalar, key, ids, ids)
+
+
 def test_gpt_train_step_with_pallas_attention_lowers_for_tpu(monkeypatch):
     """The exact bench path: full donated GPT train step with the library
     pallas flash attention (dispatch forced as on a real TPU backend),
     cross-lowered for the TPU target — fwd + dq + dkv Mosaic payloads."""
     import importlib
     import paddle_tpu as paddle
-    import paddle_tpu.framework.random as _rng
     from paddle_tpu.jit import TrainStep
     from paddle_tpu.models import GPTConfig, GPTForCausalLM
     fa = importlib.import_module("paddle_tpu.nn.functional.flash_attention")
@@ -86,14 +100,39 @@ def test_gpt_train_step_with_pallas_attention_lowers_for_tpu(monkeypatch):
     opt = paddle.optimizer.AdamW(learning_rate=1e-4, multi_precision=True,
                                  parameters=model.parameters())
     step = TrainStep(model, GPTForCausalLM.loss_fn, opt)
-    step._build()
-    aval = lambda t: jax.tree_util.tree_map(
-        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), t)
-    scalar = jax.ShapeDtypeStruct((), jnp.float32)
-    key = jax.eval_shape(lambda: _rng.default_generator().fold_in(1))
-    ids = jax.ShapeDtypeStruct((2, 256), jnp.int64)
-    exp = jax.export.export(step._jitted, platforms=["tpu"])(
-        aval(step.params), aval(step.buffers), aval(step.opt_state),
-        scalar, scalar, key, ids, ids)
+    exp = _export_train_step_for_tpu(step)
     assert exp.mlir_module().count("tpu_custom_call") == 3
+    assert fa.last_attention_dispatch()["backend"] == "pallas"
+
+
+@pytest.mark.parametrize("policy", ["full", "dots"])
+def test_gpt_1p3b_shaped_step_lowers_for_tpu(monkeypatch, policy):
+    """The exact gpt1.3b bench composition (bench.py PADDLE_TPU_BENCH_
+    MODEL=gpt1.3b) at tiny geometry: scan-over-layers + per-block remat
+    (both recompute_policy values) + fused linear-CE + pure-bf16 Adam,
+    with pallas attention dispatch forced — cross-lowered for the TPU
+    target so a Mosaic/lowering blocker is caught HERE, not an hour
+    into the remote-compile slot (r4 lost its 1.3B run to compile)."""
+    import importlib
+    import paddle_tpu as paddle
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    fa = importlib.import_module("paddle_tpu.nn.functional.flash_attention")
+    monkeypatch.setattr(fa, "_on_tpu", lambda: True)
+
+    cfg = GPTConfig(vocab_size=512, hidden_size=256, num_layers=3,
+                    num_heads=4, max_seq_len=256, scan_layers=True,
+                    recompute=True, recompute_policy=policy,
+                    fused_loss_chunk=64)
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    model.bfloat16()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 multi_precision=False,  # 1.3b bench mode
+                                 parameters=model.parameters())
+    step = TrainStep(model, model.make_loss_fn(), opt)
+    exp = _export_train_step_for_tpu(step)
+    # scan body compiles ONCE (depth-independent): fwd + dq + dkv, plus
+    # the remat'd bwd replaying the fwd kernel = 4 Mosaic payloads
+    assert exp.mlir_module().count("tpu_custom_call") == 4
     assert fa.last_attention_dispatch()["backend"] == "pallas"
