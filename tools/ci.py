@@ -46,6 +46,7 @@ QUICK_FILES = [
     "tests/test_zero_accumulation.py", "tests/test_api_surface.py",
     "tests/test_op_numerics.py", "tests/test_functional_numerics.py",
     "tests/test_incubate_geometric.py", "tests/test_gpt_scan_layers.py",
+    "tests/test_tpu_lowering.py", "tests/test_single_flight.py",
 ]
 
 
