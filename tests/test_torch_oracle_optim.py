@@ -39,7 +39,8 @@ def _torch_net_from(pnet):
     with torch.no_grad():
         for t, p in zip((tnet[0], tnet[2]), (pnet[0], pnet[2])):
             # paddle Linear weight is [in, out]; torch is [out, in]
-            t.weight.copy_(torch.from_numpy(p.weight.numpy().T))
+            # (.copy() — from_numpy on the transposed view warns)
+            t.weight.copy_(torch.from_numpy(p.weight.numpy().T.copy()))
             t.bias.copy_(torch.from_numpy(p.bias.numpy()))
     return tnet
 
@@ -57,13 +58,15 @@ def _run_paddle(pnet, opt, X, Y, steps):
     return traj
 
 
-def _run_torch(tnet, topt, X, Y, steps):
+def _run_torch(tnet, topt, X, Y, steps, post_backward=None):
     traj = []
     loss_fn = torch.nn.MSELoss()
     for _ in range(steps):
         topt.zero_grad()
         loss = loss_fn(tnet(torch.from_numpy(X)), torch.from_numpy(Y))
         loss.backward()
+        if post_backward is not None:   # e.g. grad clipping
+            post_backward(tnet)
         topt.step()
         # flatten in paddle's parameter order (weightT, bias per layer)
         flat = []
@@ -162,19 +165,10 @@ def test_global_norm_clip_matches_torch():
                                 grad_clip=clip)
     topt = torch.optim.SGD(tnet.parameters(), lr=0.5)
     pt = _run_paddle(pnet, popt, X, Y, 8)
-
-    traj = []
-    loss_fn = torch.nn.MSELoss()
-    for _ in range(8):
-        topt.zero_grad()
-        loss_fn(tnet(torch.from_numpy(X)), torch.from_numpy(Y)).backward()
-        torch.nn.utils.clip_grad_norm_(tnet.parameters(), 0.1)
-        topt.step()
-        flat = []
-        for t in (tnet[0], tnet[2]):
-            flat.append(t.weight.detach().numpy().T.ravel())
-            flat.append(t.bias.detach().numpy().ravel())
-        traj.append(np.concatenate(flat))
+    traj = _run_torch(
+        tnet, topt, X, Y, 8,
+        post_backward=lambda net: torch.nn.utils.clip_grad_norm_(
+            net.parameters(), 0.1))
     for s, (a, b) in enumerate(zip(pt, traj)):
         np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5,
                                    err_msg=f"clip diverged at step {s}")
