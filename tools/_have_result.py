@@ -1,0 +1,44 @@
+"""Shared "did this measurement land?" predicate.
+
+ONE definition used by tpu_suite2.sh's skip logic AND tpu_watch2.sh's
+exit decision — the skip/exit protocol only works if both sides agree
+on what a good record is (they had already diverged once: bench_ring's
+payload has no "value"/"metric" key, so a key-based check deadlocked
+the watcher loop).
+
+A JSON record is good when it parses to a non-empty dict WITHOUT an
+"error" key (every tool's failure path writes {"error": ...}; empty or
+truncated files fail json parsing). A .txt artifact (profile output) is
+good when it holds more than a bare error line (>100 chars).
+
+CLI: python tools/_have_result.py <path...> -> exit 0 iff ALL good,
+printing the first missing one.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def have(path: str) -> bool:
+    try:
+        if path.endswith(".txt"):
+            return os.path.getsize(path) > 100
+        with open(path) as f:
+            d = json.load(f)
+        return bool(isinstance(d, dict) and d and "error" not in d)
+    except (OSError, ValueError):
+        return False
+
+
+def main(argv) -> int:
+    for p in argv:
+        if not have(p):
+            print("missing:", os.path.basename(p))
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

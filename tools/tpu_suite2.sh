@@ -1,7 +1,8 @@
 #!/bin/bash
 # Round-4 phase-2 TPU suite: the measurements the 04:29 tunnel wedge ate.
-# Run AFTER tpu_suite.sh's first pass; safe to re-run — each step skips
-# itself if its result JSON already has a non-error payload.
+# Safe to re-run — EVERY step skips itself once its result landed (the
+# shared tools/_have_result.py predicate; tpu_watch2.sh uses the same
+# one to decide when to stop re-firing, so the two never disagree).
 # Most-important-first; generous budgets; NO outer kills around anything
 # that might be mid-compile (kills wedge the tunnel — see bench.py note).
 set -u
@@ -10,27 +11,17 @@ R=tpu_results
 mkdir -p "$R"
 log() { echo "[suite2] $(date -u +%FT%TZ) $*" >> "$R/suite2.log"; }
 
-have() {  # have <json> — 0 if the file holds a non-error result
-  python - "$1" <<'EOF'
-import json, sys
-try:
-    d = json.load(open(sys.argv[1]))
-except Exception:
-    sys.exit(1)
-# good = a real record: no error, and either a driver-style "value" or
-# a metric record (kv_quality has no "value" key)
-ok = (isinstance(d, dict) and "error" not in d
-      and (d.get("value", 0) or d.get("metric")))
-sys.exit(0 if ok else 1)
-EOF
-}
+have() { python tools/_have_result.py "$1" >/dev/null; }
 
 run() {  # run <name> <outfile> <cmd...>
   local name=$1 out=$2; shift 2
   if have "$R/$out"; then log "$name: already have result, skip"; return 0; fi
   log "$name: $*"
-  "$@" > "$R/$out" 2> "$R/$name.log"
+  # write to .part then move: a re-wedge mid-run must never truncate a
+  # previously landed record, and half-written output never looks landed
+  "$@" > "$R/$out.part" 2> "$R/$name.log"
   local rc=$?
+  mv -f "$R/$out.part" "$R/$out"
   log "$name rc=$rc"
 }
 
@@ -38,19 +29,13 @@ log "start"
 # 1. 1.3B with scan-over-layers (depth-independent compile) + 3600s budget
 run bench_1p3b bench_1p3b.json env PADDLE_TPU_BENCH_MODEL=gpt1.3b python bench.py
 # 2. step profile -> MFU attack input (no outer timeout: mid-compile kills wedge)
-log "profile_step"
-python tools/profile_step.py > "$R/profile_step.txt" 2> "$R/profile_step.log"
-log "profile_step rc=$?"
+run profile_step profile_step.txt python tools/profile_step.py
 # 3. fused ring kernel vs XLA merge
-log "bench_ring"
-python tools/bench_ring.py > "$R/bench_ring.json" 2> "$R/bench_ring.log"
-log "bench_ring rc=$?"
+run bench_ring bench_ring.json python tools/bench_ring.py
 # 4. serving latency (BASELINE config 5)
-log "bench_serving"
-python tools/bench_serving.py > "$R/bench_serving.json" 2> "$R/bench_serving.log"
-log "bench_serving rc=$?"
+run bench_serving bench_serving.json python tools/bench_serving.py
 # 5. A/Bs (cheap after the compile caches warm): 125M fused-CE, 1.3B
-#    dots remat policy — the 33->40% MFU candidates
+#    dots remat policy, pure-bf16 optimizer — the 33->40% MFU candidates
 run bench_125m_fused bench_125m_fused.json \
     env PADDLE_TPU_BENCH_FUSED_CE=1024 python bench.py
 run bench_1p3b_dots bench_1p3b_dots.json \

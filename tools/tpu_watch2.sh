@@ -51,8 +51,28 @@ PY
     echo "[watch2] $(date -u +%FT%TZ) probe OK -> tpu_suite2" >> "$LOG"
     bash /root/repo/tools/tpu_suite2.sh 9>&-
     echo "[watch2] suite2 exited rc=$?" >> "$LOG"
-    exit 0
+    # Exit only when every queued measurement actually landed (same
+    # predicate the suite's skip logic uses — tools/_have_result.py —
+    # so suite and watcher can never disagree). A mid-suite re-wedge
+    # leaves error records; keep probing and re-firing, and each landed
+    # step skips itself, so no queued measurement is ever lost to a
+    # partial recovery.
+    if python /root/repo/tools/_have_result.py 9>&- \
+        /root/repo/tpu_results/bench_1p3b.json \
+        /root/repo/tpu_results/profile_step.txt \
+        /root/repo/tpu_results/bench_ring.json \
+        /root/repo/tpu_results/bench_serving.json \
+        /root/repo/tpu_results/bench_125m_fused.json \
+        /root/repo/tpu_results/bench_1p3b_dots.json \
+        /root/repo/tpu_results/bench_125m_bf16opt.json \
+        /root/repo/tpu_results/kv_quality.json >> "$LOG"
+    then
+      echo "[watch2] $(date -u +%FT%TZ) all measurements landed — done" >> "$LOG"
+      exit 0
+    fi
+    echo "[watch2] $(date -u +%FT%TZ) suite incomplete — continue probing" >> "$LOG"
+  else
+    echo "[watch2] $(date -u +%FT%TZ) probe rc=$RC" >> "$LOG"
   fi
-  echo "[watch2] $(date -u +%FT%TZ) probe rc=$RC" >> "$LOG"
   sleep 600 9>&-
 done
