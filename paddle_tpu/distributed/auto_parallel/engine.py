@@ -9,8 +9,6 @@ parameter its layout, one jitted SPMD program per mode does the rest.
 """
 from __future__ import annotations
 
-from typing import Optional
-
 import numpy as np
 
 from ...core.tensor import Tensor

@@ -23,7 +23,7 @@ Buffers are donated: params/slots update in place in HBM.
 """
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Optional, Sequence
+from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp
