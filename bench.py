@@ -82,12 +82,43 @@ def _int_env(name: str, default: int) -> int:
 
 
 def _fail(stage: str, detail: str, code: int = 1) -> None:
-    """Emit a parseable error record on stdout and exit immediately."""
-    sys.stdout.write(json.dumps({
+    """Emit a parseable error record on stdout and exit immediately.
+
+    The record stays honest (value 0) but carries the last LANDED
+    measurement of this same metric when one exists in tpu_results/ —
+    a wedged-tunnel round end then still points the reader at the real
+    number instead of leaving only a failure marker."""
+    rec = {
         "metric": _METRIC, "value": 0, "unit": "tokens/s/chip",
         "vs_baseline": 0,
         "error": "%s: %s" % (stage, detail.strip()[-400:]),
-    }) + "\n")
+    }
+    # Only the PLAIN config may claim the landed record — a variant run
+    # (fused-CE / pure-bf16 / dots-remat / scan-off A/Bs) must not pass
+    # off the baseline config's number as its own measurement.
+    variant = bool(
+        os.environ.get("PADDLE_TPU_BENCH_PURE_BF16", "0") != "0"
+        or os.environ.get("PADDLE_TPU_BENCH_REMAT_POLICY", "full") != "full"
+        or os.environ.get("PADDLE_TPU_BENCH_SCAN", "1") == "0"
+        or (_MODEL_SEL == "gpt125m"
+            and os.environ.get("PADDLE_TPU_BENCH_FUSED_CE", "0") != "0")
+        or (_MODEL_SEL == "gpt1.3b"
+            and os.environ.get("PADDLE_TPU_BENCH_FUSED_CE", "2048")
+            != "2048"))
+    landed = os.path.join(
+        _HERE, "tpu_results",
+        "bench_1p3b.json" if _MODEL_SEL == "gpt1.3b" else "bench_125m.json")
+    try:
+        with open(landed) as f:
+            prev = json.load(f)
+        if (not variant and isinstance(prev, dict) and prev.get("value")
+                and "error" not in prev):
+            rec["last_landed"] = {k: prev[k] for k in
+                                  ("value", "vs_baseline", "mfu_pct",
+                                   "device_kind") if k in prev}
+    except (OSError, ValueError):
+        pass
+    sys.stdout.write(json.dumps(rec) + "\n")
     sys.stdout.flush()
     os._exit(code)
 

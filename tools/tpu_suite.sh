@@ -1,25 +1,31 @@
 #!/bin/bash
-# Full TPU measurement suite — run ONCE on tunnel recovery (tpu_watch.sh
-# invokes it). Ordered most-important-first so a re-wedge mid-suite still
-# leaves the driver metric on disk. bench.py self-watchdogs and exits
-# cleanly; the profiler/ring/serving tools get a generous outer backstop
-# (30 min) — by then the tunnel is wedged anyway and the kill changes
-# nothing (init-phase and post-step kills are the safe kind; the budget
-# is sized so no healthy step is ever killed mid-flight).
+# First-pass TPU measurement suite (tpu_watch.sh invokes it on tunnel
+# recovery). Ordered most-important-first so a re-wedge mid-suite still
+# leaves the driver metric on disk. Same discipline as tpu_suite2.sh:
+# every step skips itself once its result landed (shared
+# tools/_have_result.py), writes via .part-then-rename so a re-wedge
+# never truncates a landed record, and NOTHING gets an outer kill —
+# the tools fail fast on their own (probe subprocess + stage watchdog),
+# and killing a healthy run mid-remote-compile wedges the tunnel.
 set -u
 cd /root/repo || exit 1
 R=tpu_results
 mkdir -p "$R"
-echo "[suite] start $(date -u +%FT%TZ)" >> "$R/suite.log"
+log() { echo "[suite] $(date -u +%FT%TZ) $*" >> "$R/suite.log"; }
+
+have() { python tools/_have_result.py "$1" >/dev/null; }
 
 run() {  # run <name> <outfile> <cmd...>
   local name=$1 out=$2; shift 2
-  echo "[suite] $(date -u +%FT%TZ) $name: $*" >> "$R/suite.log"
-  "$@" > "$R/$out" 2> "$R/$name.log"
+  if have "$R/$out"; then log "$name: already have result, skip"; return 0; fi
+  log "$name: $*"
+  "$@" > "$R/$out.part" 2> "$R/$name.log"
   local rc=$?   # capture BEFORE the next $(date) clobbers $?
-  echo "[suite] $(date -u +%FT%TZ) $name rc=$rc" >> "$R/suite.log"
+  mv -f "$R/$out.part" "$R/$out"
+  log "$name rc=$rc"
 }
 
+log "start"
 # 1. driver metric (125M) — bench.py has its own probe + stage watchdog
 run bench_125m bench_125m.json python bench.py
 # 2. prove the Pallas kernel fires at the bench geometry, and sweep
@@ -31,13 +37,9 @@ run bench_125m_pallas bench_125m_pallas.json \
 run bench_1p3b bench_1p3b.json \
     env PADDLE_TPU_BENCH_MODEL=gpt1.3b python bench.py
 # 4. step profile -> the 33%->40% MFU loop input
-run profile_step profile_step.txt timeout -k 60 1800 \
-    python tools/profile_step.py
+run profile_step profile_step.txt python tools/profile_step.py
 # 5. fused ring kernel vs XLA ring on hardware
-run bench_ring bench_ring.json timeout -k 60 1800 \
-    python tools/bench_ring.py
+run bench_ring bench_ring.json python tools/bench_ring.py
 # 6. serving latency (BASELINE config 5)
-run bench_serving bench_serving.json timeout -k 60 1800 \
-    python tools/bench_serving.py
-
-echo "[suite] done $(date -u +%FT%TZ)" >> "$R/suite.log"
+run bench_serving bench_serving.json python tools/bench_serving.py
+log "done"
