@@ -26,24 +26,25 @@ run() {  # run <name> <outfile> <cmd...>
 }
 
 log "start"
-# 1. 1.3B with scan-over-layers (depth-independent compile) + 3600s budget
-run bench_1p3b bench_1p3b.json env PADDLE_TPU_BENCH_MODEL=gpt1.3b python bench.py
-# 2. step profile -> MFU attack input (no outer timeout: mid-compile kills wedge)
+# ORDER IS RISK-ADJUSTED, cheap-and-fast first: round 4 ran the long
+# 1.3B compile first, it wedged the tunnel, and every cheaper
+# measurement was lost with it. The ~3-10 min-compile steps bank their
+# results up front; the 1.3B runs (scan-layers = depth-independent
+# compile, 3600s budget, much lower risk than r4's unrolled program)
+# go last so a worst-case wedge costs only them.
+# 1-4: fast compiles, high information
 run profile_step profile_step.txt python tools/profile_step.py
-# 3. fused ring kernel vs XLA merge
 run bench_ring bench_ring.json python tools/bench_ring.py
-# 4. serving latency (BASELINE config 5)
 run bench_serving bench_serving.json python tools/bench_serving.py
-# 5. A/Bs (cheap after the compile caches warm): 125M fused-CE, 1.3B
-#    dots remat policy, pure-bf16 optimizer — the 33->40% MFU candidates
+run kv_quality kv_quality.json python tools/kv_cache_quality.py
+# 5. 125M A/Bs (re-use the warm compile cache): fused-CE, pure-bf16 opt
 run bench_125m_fused bench_125m_fused.json \
     env PADDLE_TPU_BENCH_FUSED_CE=1024 python bench.py
+run bench_125m_bf16opt bench_125m_bf16opt.json \
+    env PADDLE_TPU_BENCH_PURE_BF16=1 python bench.py
+# 6. the north-star-scale 1.3B runs (both remat policies)
+run bench_1p3b bench_1p3b.json env PADDLE_TPU_BENCH_MODEL=gpt1.3b python bench.py
 run bench_1p3b_dots bench_1p3b_dots.json \
     env PADDLE_TPU_BENCH_MODEL=gpt1.3b PADDLE_TPU_BENCH_REMAT_POLICY=dots \
     python bench.py
-run bench_125m_bf16opt bench_125m_bf16opt.json \
-    env PADDLE_TPU_BENCH_PURE_BF16=1 python bench.py
-# 6. int8 KV cache quality at 125M with bf16 weights (VERDICT r4 item 7;
-#    CPU/f32 numbers exist — this is the on-hardware confirmation row)
-run kv_quality kv_quality.json python tools/kv_cache_quality.py
 log "done"
