@@ -1,0 +1,78 @@
+"""Measurement-suite mechanics (tools/_suite_lib.sh) — the skip/landed
+protocol the hardware recovery loop depends on.
+
+Three load-bearing properties, each of which has silently broken once:
+  1. a landed record is SKIPPED on re-run (never re-spent, never
+     truncated — round-4 suites used truncating redirects);
+  2. a failed/error record is retried on the next fire;
+  3. output goes through .part-then-rename, so a crash mid-write
+     leaves no half-written file that looks landed.
+No jax, no tunnel — pure harness logic against a temp results dir.
+"""
+import json
+import os
+import subprocess
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+
+
+def _mini_suite(results_dir, body):
+    script = f"""#!/bin/bash
+set -u
+R={results_dir}
+mkdir -p "$R"
+SUITE_LOG_TAG=minisuite
+. {TOOLS}/_suite_lib.sh
+{body}
+"""
+    path = os.path.join(results_dir, "mini.sh")
+    with open(path, "w") as f:
+        f.write(script)
+    return subprocess.run(["bash", path], capture_output=True, text=True,
+                          timeout=60)
+
+
+def test_landed_record_skipped_and_never_truncated(tmp_path):
+    d = str(tmp_path)
+    body = 'run ok ok.json echo \'{"metric": "m", "value": 42}\'\n'
+    r = _mini_suite(d, body)
+    assert r.returncode == 0, r.stderr
+    out = os.path.join(d, "ok.json")
+    assert json.load(open(out))["value"] == 42
+    mtime = os.path.getmtime(out)
+
+    # re-fire: must skip (log says so), not rewrite
+    r = _mini_suite(d, body)
+    assert os.path.getmtime(out) == mtime
+    log = open(os.path.join(d, "minisuite.log")).read()
+    assert "already have result, skip" in log
+
+
+def test_error_record_is_retried(tmp_path):
+    d = str(tmp_path)
+    flag = os.path.join(d, "second_try")
+    # first run emits an error record; once the flag exists it succeeds
+    body = (f'run flaky flaky.json sh -c '
+            f'\'if [ -f {flag} ]; then echo "{{\\"value\\": 7}}"; '
+            f'else echo "{{\\"error\\": \\"wedged\\"}}"; exit 1; fi\'\n')
+    _mini_suite(d, body)
+    assert "error" in json.load(open(os.path.join(d, "flaky.json")))
+    open(flag, "w").close()
+    _mini_suite(d, body)             # retried, not skipped
+    assert json.load(open(os.path.join(d, "flaky.json")))["value"] == 7
+
+
+def test_crash_mid_write_leaves_no_landed_looking_file(tmp_path):
+    d = str(tmp_path)
+    # tool writes half a JSON object then dies
+    body = ('run crash crash.json sh -c '
+            '\'printf "{\\"value\\": 4"; kill -9 $$\'\n')
+    _mini_suite(d, body)
+    # the .part was renamed over by run() after the crash, but the
+    # half-written payload must NOT satisfy the landed predicate
+    r = subprocess.run(["python", os.path.join(TOOLS, "_have_result.py"),
+                        os.path.join(d, "crash.json")],
+                       capture_output=True, text=True)
+    assert r.returncode == 1
+    assert not os.path.exists(os.path.join(d, "crash.json.part"))

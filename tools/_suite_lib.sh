@@ -1,0 +1,26 @@
+# Shared measurement-suite helpers, sourced by tpu_suite.sh and
+# tpu_suite2.sh (and unit-tested by tests/test_suite_mechanics.py).
+# Contract:
+#   * a step SKIPS itself once its result landed (tools/_have_result.py
+#     — the same predicate tpu_watch2.sh uses to decide when to stop
+#     re-firing, so suite and watcher can never disagree);
+#   * output is written to <out>.part then renamed, so a re-wedge
+#     mid-run never truncates a landed record and half-written output
+#     never looks landed;
+#   * NO outer kills — the tools fail fast on their own, and killing a
+#     healthy run mid-remote-compile wedges the tunnel.
+# Callers must set: R (results dir) and SUITE_LOG_TAG (log prefix).
+
+log() { echo "[$SUITE_LOG_TAG] $(date -u +%FT%TZ) $*" >> "$R/$SUITE_LOG_TAG.log"; }
+
+have() { python "$(dirname "${BASH_SOURCE[0]}")/_have_result.py" "$1" >/dev/null; }
+
+run() {  # run <name> <outfile> <cmd...>
+  local name=$1 out=$2; shift 2
+  if have "$R/$out"; then log "$name: already have result, skip"; return 0; fi
+  log "$name: $*"
+  "$@" > "$R/$out.part" 2> "$R/$name.log"
+  local rc=$?   # capture BEFORE the next $(date) clobbers $?
+  mv -f "$R/$out.part" "$R/$out"
+  log "$name rc=$rc"
+}

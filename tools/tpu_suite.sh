@@ -11,19 +11,8 @@ set -u
 cd /root/repo || exit 1
 R=tpu_results
 mkdir -p "$R"
-log() { echo "[suite] $(date -u +%FT%TZ) $*" >> "$R/suite.log"; }
-
-have() { python tools/_have_result.py "$1" >/dev/null; }
-
-run() {  # run <name> <outfile> <cmd...>
-  local name=$1 out=$2; shift 2
-  if have "$R/$out"; then log "$name: already have result, skip"; return 0; fi
-  log "$name: $*"
-  "$@" > "$R/$out.part" 2> "$R/$name.log"
-  local rc=$?   # capture BEFORE the next $(date) clobbers $?
-  mv -f "$R/$out.part" "$R/$out"
-  log "$name rc=$rc"
-}
+SUITE_LOG_TAG=suite
+. tools/_suite_lib.sh || { echo "FATAL: tools/_suite_lib.sh missing" >&2; exit 1; }
 
 log "start"
 # 1. driver metric (125M) — bench.py has its own probe + stage watchdog

@@ -9,21 +9,8 @@ set -u
 cd /root/repo || exit 1
 R=tpu_results
 mkdir -p "$R"
-log() { echo "[suite2] $(date -u +%FT%TZ) $*" >> "$R/suite2.log"; }
-
-have() { python tools/_have_result.py "$1" >/dev/null; }
-
-run() {  # run <name> <outfile> <cmd...>
-  local name=$1 out=$2; shift 2
-  if have "$R/$out"; then log "$name: already have result, skip"; return 0; fi
-  log "$name: $*"
-  # write to .part then move: a re-wedge mid-run must never truncate a
-  # previously landed record, and half-written output never looks landed
-  "$@" > "$R/$out.part" 2> "$R/$name.log"
-  local rc=$?
-  mv -f "$R/$out.part" "$R/$out"
-  log "$name rc=$rc"
-}
+SUITE_LOG_TAG=suite2
+. tools/_suite_lib.sh || { echo "FATAL: tools/_suite_lib.sh missing" >&2; exit 1; }
 
 log "start"
 # ORDER IS RISK-ADJUSTED, cheap-and-fast first: round 4 ran the long
