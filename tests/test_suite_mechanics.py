@@ -76,3 +76,20 @@ def test_crash_mid_write_leaves_no_landed_looking_file(tmp_path):
                        capture_output=True, text=True)
     assert r.returncode == 1
     assert not os.path.exists(os.path.join(d, "crash.json.part"))
+
+
+def test_watcher_landed_list_tracks_suite_outputs():
+    """tpu_watch2.sh exits only when its landed-file list is all good;
+    that list must contain exactly tpu_suite2.sh's step outputs, or the
+    loop either exits early (missing entry) or never exits (stale
+    entry for a step the suite no longer runs)."""
+    import re
+    with open(os.path.join(TOOLS, "tpu_suite2.sh")) as f:
+        suite_outs = set(re.findall(r"^run\s+\S+\s+(\S+)", f.read(),
+                                    re.M))
+    with open(os.path.join(TOOLS, "tpu_watch2.sh")) as f:
+        watch_outs = set(re.findall(
+            r"tpu_results/([\w.]+\.(?:json|txt))", f.read()))
+    assert suite_outs == watch_outs, (
+        f"suite-only: {suite_outs - watch_outs}; "
+        f"watcher-only: {watch_outs - suite_outs}")
