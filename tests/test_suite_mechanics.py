@@ -88,8 +88,13 @@ def test_watcher_landed_list_tracks_suite_outputs():
         suite_outs = set(re.findall(r"^run\s+\S+\s+(\S+)", f.read(),
                                     re.M))
     with open(os.path.join(TOOLS, "tpu_watch2.sh")) as f:
-        watch_outs = set(re.findall(
-            r"tpu_results/([\w.]+\.(?:json|txt))", f.read()))
+        src = f.read()
+    # anchor to the _have_result.py invocation block so comments
+    # elsewhere can't leak in, and accept any non-slash filename chars
+    # (the suite-side \S+ accepts hyphens etc. — classes must agree)
+    block = re.search(r"_have_result\.py(.*?)(?:>>|\n\s*then)", src,
+                      re.S).group(1)
+    watch_outs = set(re.findall(r"tpu_results/([^/\s\\]+)", block))
     assert suite_outs == watch_outs, (
         f"suite-only: {suite_outs - watch_outs}; "
         f"watcher-only: {watch_outs - suite_outs}")
