@@ -1,0 +1,143 @@
+"""First-touch quickstart flows a switching reference user runs.
+
+tests/test_api_surface.py proves the NAMES exist; this file proves the
+first code a migrating user writes BEHAVES: the canonical tensor ops,
+the define-a-Layer-and-train loop, save/load round-trips, the dataset/
+dataloader/hapi path, AMP decorator use, and the deploy hop (jit.save
+-> inference predictor). Each block is written the way the reference's
+own docs teach the API (guide-level idioms, not this repo's internals).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_tensor_quickstart():
+    # the canonical first lines of any reference tutorial
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    y = paddle.ones([2, 2])
+    z = paddle.matmul(x, y) + x * 2 - paddle.full([2, 2], 0.5)
+    assert z.shape == [2, 2]
+    assert float(paddle.sum(z).numpy()) == pytest.approx(
+        float((x.numpy() @ y.numpy() + x.numpy() * 2 - 0.5).sum()))
+    # reshape/transpose/slice chain
+    a = paddle.arange(24, dtype="float32").reshape([2, 3, 4])
+    b = paddle.transpose(a, [1, 0, 2])[:, :, 1:3]
+    assert b.shape == [3, 2, 2]
+    # autograd one-liner
+    t = paddle.to_tensor(2.0, stop_gradient=False)
+    (t * t * 3).backward()
+    assert float(t.grad.numpy()) == pytest.approx(12.0)
+
+
+def test_subclass_layer_train_eval_save_load(tmp_path):
+    class MLP(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(8, 16)
+            self.drop = nn.Dropout(0.5)
+            self.fc2 = nn.Linear(16, 2)
+
+        def forward(self, x):
+            return self.fc2(self.drop(F.relu(self.fc1(x))))
+
+    paddle.seed(0)
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 8).astype("float32")
+    y = (X.sum(1) > 0).astype("int64")
+    model = MLP()
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=model.parameters())
+    loss_fn = nn.CrossEntropyLoss()
+    first = last = None
+    for _ in range(30):
+        loss = loss_fn(model(paddle.to_tensor(X)), paddle.to_tensor(y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        first = first if first is not None else float(loss)
+        last = float(loss)
+    assert last < first - 0.1
+
+    # eval() makes dropout deterministic
+    model.eval()
+    o1 = model(paddle.to_tensor(X)).numpy()
+    o2 = model(paddle.to_tensor(X)).numpy()
+    np.testing.assert_array_equal(o1, o2)
+
+    # the reference's save/load idiom
+    path = str(tmp_path / "mlp.pdparams")
+    paddle.save(model.state_dict(), path)
+    model2 = MLP()
+    model2.set_state_dict(paddle.load(path))
+    model2.eval()
+    np.testing.assert_allclose(model2(paddle.to_tensor(X)).numpy(), o1,
+                               rtol=1e-6)
+
+
+def test_dataset_dataloader_hapi_fit():
+    from paddle_tpu.io import DataLoader, Dataset
+
+    class Spiral(Dataset):
+        def __init__(self, n=64):
+            rng = np.random.RandomState(1)
+            self.x = rng.randn(n, 4).astype("float32")
+            self.y = (self.x[:, 0] > 0).astype("int64")
+
+        def __getitem__(self, i):
+            return self.x[i], self.y[i]
+
+        def __len__(self):
+            return len(self.x)
+
+    paddle.seed(1)
+    net = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 2))
+    model = paddle.Model(net)
+    model.prepare(paddle.optimizer.Adam(learning_rate=0.01,
+                                        parameters=net.parameters()),
+                  nn.CrossEntropyLoss(),
+                  paddle.metric.Accuracy())
+    model.fit(Spiral(), epochs=3, batch_size=16, verbose=0)
+    ev = model.evaluate(Spiral(), batch_size=16, verbose=0)
+    assert ev["acc"] > 0.8
+    loader = DataLoader(Spiral(), batch_size=16, shuffle=False)
+    xb, yb = next(iter(loader))
+    assert list(xb.shape) == [16, 4] and list(yb.shape) == [16]
+
+
+def test_amp_auto_cast_idiom():
+    paddle.seed(2)
+    net = nn.Linear(8, 8)
+    x = paddle.to_tensor(np.random.RandomState(2).randn(4, 8)
+                         .astype("float32"))
+    with paddle.amp.auto_cast():
+        out = net(x)
+    loss = paddle.mean(out)
+    loss.backward()
+    assert net.weight.grad is not None
+
+
+def test_deploy_hop_jit_save_to_predictor(tmp_path):
+    from paddle_tpu.inference import Config, create_predictor
+
+    paddle.seed(3)
+    net = nn.Sequential(nn.Linear(6, 12), nn.GELU(), nn.Linear(12, 3))
+    net.eval()
+    prefix = str(tmp_path / "deploy")
+    paddle.jit.save(net, prefix,
+                    input_spec=[paddle.jit.InputSpec([None, 6],
+                                                     dtype="float32")])
+    assert os.path.exists(prefix + ".pdmodel")
+    pred = create_predictor(Config(prefix + ".pdmodel"))
+    x = np.random.RandomState(3).randn(2, 6).astype("float32")
+    h = pred.get_input_handle(pred.get_input_names()[0])
+    h.copy_from_cpu(x)
+    pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, net(paddle.to_tensor(x)).numpy(),
+                               rtol=1e-4, atol=1e-5)
