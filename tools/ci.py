@@ -48,6 +48,7 @@ QUICK_FILES = [
     "tests/test_incubate_geometric.py", "tests/test_gpt_scan_layers.py",
     "tests/test_tpu_lowering.py", "tests/test_single_flight.py",
     "tests/test_suite_mechanics.py", "tests/test_checkpoint_resume_zero3.py",
+    "tests/test_quickstart_parity.py",
 ]
 
 
