@@ -94,12 +94,17 @@ def _fail(stage: str, detail: str, code: int = 1) -> None:
         "error": "%s: %s" % (stage, detail.strip()[-400:]),
     }
     # Only the PLAIN config may claim the landed record — a variant run
-    # (fused-CE / pure-bf16 / dots-remat / scan-off A/Bs) must not pass
-    # off the baseline config's number as its own measurement.
+    # (fused-CE / pure-bf16 / dots-remat / scan-off A/Bs, a sweep run,
+    # or a pallas-required run) must not pass off the baseline config's
+    # number as its own measurement (ADVICE round 5: ANY non-default
+    # bench env disqualifies the failure record from carrying
+    # last_landed).
     variant = bool(
         os.environ.get("PADDLE_TPU_BENCH_PURE_BF16", "0") != "0"
         or os.environ.get("PADDLE_TPU_BENCH_REMAT_POLICY", "full") != "full"
         or os.environ.get("PADDLE_TPU_BENCH_SCAN", "1") == "0"
+        or os.environ.get("PADDLE_TPU_BENCH_SWEEP", "") != ""
+        or os.environ.get("PADDLE_TPU_REQUIRE_PALLAS", "0") != "0"
         or (_MODEL_SEL == "gpt125m"
             and os.environ.get("PADDLE_TPU_BENCH_FUSED_CE", "0") != "0")
         or (_MODEL_SEL == "gpt1.3b"
@@ -141,7 +146,29 @@ def _probe_backend() -> str:
     300s — healthy device init is seconds, but a cold tunnel's first
     contact has been observed over a minute). Killing the probe child is
     safe: it never runs a TPU step, only backend init.
+
+    The inter-attempt backoff is the resilience layer's shared schedule
+    (paddle_tpu.distributed.resilience.RetryPolicy — the same semantics
+    tools/tpu_watch2.sh mirrors). resilience.py is loaded DIRECTLY off
+    disk, never via `import paddle_tpu...`: a package import would run
+    paddle_tpu/__init__ (jax init, multi-host formation) in the probe
+    PARENT before any probe succeeded — exactly the in-process hang
+    this function exists to avoid. A local fallback keeps the probe
+    alive even if the module is broken (the probe must be able to
+    report THAT failure too).
     """
+    try:
+        import importlib.util as _ilu
+        spec = _ilu.spec_from_file_location(
+            "_bench_resilience", os.path.join(
+                _HERE, "paddle_tpu", "distributed", "resilience.py"))
+        mod = _ilu.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        delays = mod.RetryPolicy(max_attempts=3, base_delay=10.0,
+                                 multiplier=2.0, max_delay=60.0,
+                                 jitter=0.0).schedule()
+    except Exception:
+        delays = (10.0, 20.0)
     last = ""
     budgets = (120, 240, 300)
     for attempt, budget in enumerate(budgets, 1):
@@ -157,7 +184,7 @@ def _probe_backend() -> str:
             last = "probe subprocess hung >%ds (tunnel wedged?)" % budget
         _log("stage=probe attempt=%d failed: %s" % (attempt, last[-160:]))
         if attempt < len(budgets):
-            time.sleep(10 * attempt)
+            time.sleep(delays[attempt - 1])
     _fail("backend_unavailable", last)
     raise AssertionError  # unreachable
 

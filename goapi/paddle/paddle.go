@@ -54,14 +54,24 @@ type Tensor struct {
 	DType DType
 }
 
-// NewFloat32Tensor packs a []float32 into a Tensor.
+// NewFloat32Tensor packs a []float32 into a Tensor. An empty (or nil)
+// slice yields a Tensor with empty Data — taking &data[0] on an empty
+// slice would panic; Run still validates len(Data) against Shape, so a
+// zero-element tensor with a non-empty shape errors there, not here.
 func NewFloat32Tensor(data []float32, shape []int64) Tensor {
+	if len(data) == 0 {
+		return Tensor{Data: []byte{}, Shape: shape, DType: Float32}
+	}
 	b := unsafe.Slice((*byte)(unsafe.Pointer(&data[0])), len(data)*4)
 	return Tensor{Data: b, Shape: shape, DType: Float32}
 }
 
-// NewInt64Tensor packs a []int64 into a Tensor.
+// NewInt64Tensor packs a []int64 into a Tensor. Empty/nil slices are
+// handled as in NewFloat32Tensor.
 func NewInt64Tensor(data []int64, shape []int64) Tensor {
+	if len(data) == 0 {
+		return Tensor{Data: []byte{}, Shape: shape, DType: Int64}
+	}
 	b := unsafe.Slice((*byte)(unsafe.Pointer(&data[0])), len(data)*8)
 	return Tensor{Data: b, Shape: shape, DType: Int64}
 }

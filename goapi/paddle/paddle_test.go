@@ -1,0 +1,42 @@
+package paddle
+
+import "testing"
+
+// Empty and nil slices must build zero-value tensors, not panic on
+// &data[0] (the historical failure mode).
+func TestNewTensorEmptySlices(t *testing.T) {
+	cases := []struct {
+		name string
+		mk   func() Tensor
+		dt   DType
+	}{
+		{"float32 nil", func() Tensor { return NewFloat32Tensor(nil, []int64{0}) }, Float32},
+		{"float32 empty", func() Tensor { return NewFloat32Tensor([]float32{}, []int64{0, 4}) }, Float32},
+		{"int64 nil", func() Tensor { return NewInt64Tensor(nil, []int64{0}) }, Int64},
+		{"int64 empty", func() Tensor { return NewInt64Tensor([]int64{}, []int64{0}) }, Int64},
+	}
+	for _, c := range cases {
+		tens := c.mk() // must not panic
+		if len(tens.Data) != 0 {
+			t.Errorf("%s: want empty Data, got %d bytes", c.name, len(tens.Data))
+		}
+		if tens.DType != c.dt {
+			t.Errorf("%s: dtype %v, want %v", c.name, tens.DType, c.dt)
+		}
+	}
+}
+
+// Non-empty slices still pack bytes densely (little-endian, row-major).
+func TestNewTensorPacksBytes(t *testing.T) {
+	f := NewFloat32Tensor([]float32{1, 2, 3}, []int64{3})
+	if len(f.Data) != 12 {
+		t.Fatalf("float32 x3: want 12 bytes, got %d", len(f.Data))
+	}
+	i := NewInt64Tensor([]int64{7}, []int64{1})
+	if len(i.Data) != 8 {
+		t.Fatalf("int64 x1: want 8 bytes, got %d", len(i.Data))
+	}
+	if i.Data[0] != 7 {
+		t.Fatalf("int64 little-endian first byte: want 7, got %d", i.Data[0])
+	}
+}

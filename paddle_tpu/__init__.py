@@ -18,7 +18,9 @@ import jax as _jax
 # so when the launcher declared a multi-process world via the JAX_* env
 # contract, form it now — before the imports below initialize XLA.
 from ._bootstrap import maybe_init_jax_distributed as _mijd
+from ._bootstrap import shim_jax_compat as _sjc
 
+_sjc()
 _mijd()
 
 from .framework import flags as _flags

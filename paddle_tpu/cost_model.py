@@ -33,6 +33,9 @@ class CostModel:
         lowered = jitted.lower(*raw)
         compiled = lowered.compile()
         cost = compiled.cost_analysis() or {}
+        if isinstance(cost, (list, tuple)):
+            # jax 0.4.x returns [per-partition dict]; newer returns dict
+            cost = cost[0] if cost else {}
         t0 = time.perf_counter()
         out = compiled(*raw)
         jax.block_until_ready(out)

@@ -24,7 +24,11 @@ from .moe import GShardGate, MoELayer, NaiveGate, SwitchGate
 from .recompute import recompute, recompute_sequential
 from .sequence_parallel import (ring_attention, shard_sequence,
                                 ulysses_attention)
-from .checkpoint import load_state_dict, save_state_dict
+from .checkpoint import load_state_dict, save_state_dict, verify_checkpoint
+from .resilience import (FaultInjected, FaultInjector, NanInfStorm,
+                         RetryPolicy, StepTimeout, StepWatchdog,
+                         restore_train_state, save_train_state,
+                         with_retries)
 from .store import TCPStore
 from .strategy import DistributedStrategy
 from .topology import (CommunicateTopology, HybridCommunicateGroup,
@@ -75,6 +79,9 @@ __all__ = [
     "ParallelTrainStep", "param_sharding", "shard_params", "fleet",
     "MoELayer", "SwitchGate", "GShardGate", "NaiveGate",
     "recompute", "recompute_sequential",
-    "save_state_dict", "load_state_dict", "TCPStore",
+    "save_state_dict", "load_state_dict", "verify_checkpoint", "TCPStore",
+    "RetryPolicy", "with_retries", "StepWatchdog", "StepTimeout",
+    "NanInfStorm", "FaultInjector", "FaultInjected",
+    "save_train_state", "restore_train_state",
     "ring_attention", "ulysses_attention", "shard_sequence",
 ]

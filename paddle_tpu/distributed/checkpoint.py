@@ -12,12 +12,22 @@ dp8 mesh restores onto dp2xmp4 without a gather step.
 from __future__ import annotations
 
 import os
+import shutil
 from typing import Any, Dict, Optional
 
 import jax
 import numpy as np
 
-__all__ = ["save_state_dict", "load_state_dict"]
+from . import resilience as _resil
+
+__all__ = ["save_state_dict", "load_state_dict", "verify_checkpoint"]
+
+# Commit marker written inside the checkpoint dir BEFORE the atomic
+# rename publishes it: a directory without the marker is by definition
+# incomplete (kill mid-save) or tampered-with (corrupt shard path) and
+# load refuses it. The marker rides the rename, so publish is all-or-
+# nothing — the crash-safety contract tests/test_resilience.py locks.
+_COMMIT_MARKER = "_PTPU_COMMIT"
 
 
 def _checkpointer():
@@ -39,10 +49,132 @@ def _to_arrays(tree):
 
 def save_state_dict(state_dict: Dict[str, Any], path: str):
     """Save a (possibly sharded) state tree. Parity:
-    paddle.distributed.save_state_dict / dist_saver."""
+    paddle.distributed.save_state_dict / dist_saver.
+
+    Crash-safe: shards are written to ``<path>.tmp`` and published with
+    one atomic rename, so a kill at any instant leaves either the
+    previous complete checkpoint or none — never a partial directory.
+    This is the sink StepWatchdog's checkpoint-on-failure uses.
+    """
     path = os.path.abspath(path)
+    tmp = path + ".tmp"
+    # directory surgery (recovery, cleanup, marker, publish) is
+    # PRIMARY-ONLY on a multi-process job: every process participates
+    # in the collective ocp.save below, but two processes renaming the
+    # same shared-storage dirs is a race (reference: rank-0-writes
+    # convention, TrainEpochRange._save_snapshot)
+    primary = _is_primary()
+    if primary:
+        # a previous save may have died mid-publish; land its committed
+        # state as "the previous checkpoint" before overwriting anything
+        _finish_interrupted_publish(path)
+        if os.path.exists(tmp):
+            # stale UNCOMMITTED tmp (killed mid-shard-write, no marker
+            # — committed tmps were just published above)
+            shutil.rmtree(tmp, ignore_errors=True)
+    _barrier("pre_save", path)
     ckpt = _checkpointer()
-    ckpt.save(path, _to_arrays(state_dict), force=True)
+    ckpt.save(tmp, _to_arrays(state_dict), force=True)
+    if primary:
+        with open(os.path.join(tmp, _COMMIT_MARKER), "w") as f:
+            f.write("committed\n")
+        # fault site: die AFTER the shard bytes exist but BEFORE
+        # publish — the window tmp+rename exists to make survivable
+        _resil.maybe_inject("ckpt_crash")
+        _publish(path)
+        # fault site: corrupt the just-published checkpoint (torn
+        # shard / bad object store write) — load must refuse it loudly
+        if _resil.should_fire("ckpt_shard"):
+            _corrupt_checkpoint(path)
+    # nobody proceeds (e.g. straight into load) until the publish landed
+    _barrier("post_save", path)
+
+
+def _is_primary() -> bool:
+    try:
+        return jax.process_index() == 0
+    except Exception:
+        return True
+
+
+def _barrier(tag: str, path: str) -> None:
+    """Cross-process sync around the publish protocol; no-op on
+    single-process jobs (the common CPU/test path)."""
+    try:
+        if jax.process_count() <= 1:
+            return
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(f"ptpu_ckpt_{tag}:{path}")
+    except Exception:
+        pass
+
+
+def _committed(d: str) -> bool:
+    return os.path.isdir(d) and \
+        os.path.exists(os.path.join(d, _COMMIT_MARKER))
+
+
+def _publish(path: str) -> None:
+    """Move a committed <path>.tmp into place. Two renames, each
+    atomic; every intermediate state is repaired by
+    _finish_interrupted_publish on the next save/verify/load."""
+    tmp, old = path + ".tmp", path + ".old"
+    if os.path.exists(path):
+        shutil.rmtree(old, ignore_errors=True)
+        os.rename(path, old)
+    os.rename(tmp, path)
+    shutil.rmtree(old, ignore_errors=True)
+
+
+def _finish_interrupted_publish(path: str) -> None:
+    """Repair the publish protocol's crash windows so no committed
+    state is ever stranded: a committed .tmp (killed between marker
+    write and publish) is published now; a lone committed .old (killed
+    between the two publish renames) is moved back into place."""
+    tmp, old = path + ".tmp", path + ".old"
+    if _committed(tmp):
+        _publish(path)
+    elif not os.path.exists(path) and _committed(old):
+        os.rename(old, path)
+
+
+def _corrupt_checkpoint(path: str) -> None:
+    """Simulate shard corruption: drop the commit marker and truncate
+    the first data file found (FaultInjector 'ckpt_shard' site)."""
+    marker = os.path.join(path, _COMMIT_MARKER)
+    if os.path.exists(marker):
+        os.remove(marker)
+    for root, _dirs, files in os.walk(path):
+        for fn in sorted(files):
+            full = os.path.join(root, fn)
+            if os.path.getsize(full) > 0:
+                with open(full, "r+b") as f:
+                    f.truncate(os.path.getsize(full) // 2)
+                return
+
+
+def verify_checkpoint(path: str) -> None:
+    """Raise CheckpointCorrupt unless ``path`` is a committed
+    checkpoint directory (marker present). First repairs any
+    interrupted publish (WAL-style): committed-but-unpublished state is
+    moved into place rather than reported missing (primary-only on
+    multi-process jobs; peers wait at the barrier)."""
+    path = os.path.abspath(path)
+    if _is_primary():
+        _finish_interrupted_publish(path)
+    _barrier("verify", path)
+    if not os.path.isdir(path):
+        hint = ""
+        if os.path.isdir(path + ".tmp"):
+            hint = (" (an uncommitted .tmp does — a save was killed "
+                    "mid-write before publish)")
+        raise _resil.CheckpointCorrupt(
+            f"checkpoint {path!r} does not exist{hint}")
+    if not os.path.exists(os.path.join(path, _COMMIT_MARKER)):
+        raise _resil.CheckpointCorrupt(
+            f"checkpoint {path!r} has no commit marker "
+            f"({_COMMIT_MARKER}) — it was killed mid-save or a shard "
+            "was corrupted; refusing to restore from it")
 
 
 def load_state_dict(path: str,
@@ -54,6 +186,7 @@ def load_state_dict(path: str,
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
+    verify_checkpoint(path)
     ckpt = _checkpointer()
     if target is None:
         return ckpt.restore(path)

@@ -29,6 +29,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core.tensor import Tensor
 from . import mesh as mesh_mod
+from . import resilience as _resil
 
 __all__ = ["ReduceOp", "Group", "new_group", "get_group", "all_reduce",
            "all_gather", "all_gather_object", "broadcast", "reduce",
@@ -288,6 +289,9 @@ def all_reduce(tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
     """Every rank-slice becomes the elementwise reduction over the group.
     Parity: paddle.distributed.all_reduce."""
     group = group or _default_group()
+    # fault site: a wedged collective (dead ICI link / hung peer) never
+    # returns — simulated here so StepWatchdog's hang path is testable
+    _resil.maybe_inject("collective")
     x = _raw(tensor)
     prog = _collective_program("all_reduce", group.axis, group.mesh, op)
     out = _to_local(prog(_to_stacked(group, x)), group)

@@ -459,6 +459,15 @@ class ParallelTrainStep:
         lr_sched = getattr(self.optimizer, "_learning_rate", None)
         if hasattr(lr_sched, "step"):
             lr_sched.step()
+        # FLAGS_check_nan_inf wiring (framework/nan_inf.py): scan the
+        # step loss — the one concrete value the fused program yields —
+        # so a divergence aborts (level 0) or warns (level>=1) at the
+        # step boundary instead of poisoning the next N steps. Costs a
+        # device sync, so it only runs when the flag is armed.
+        from ..framework import flags as _flags
+        if _flags.flag_value("check_nan_inf"):
+            from ..framework.nan_inf import check_numerics
+            check_numerics(loss, "ParallelTrainStep.step")
         return Tensor(loss)
 
     # ------------------------------------------------------------------

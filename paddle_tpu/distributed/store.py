@@ -19,6 +19,8 @@ import subprocess
 import threading
 from typing import Optional
 
+from . import resilience as _resil
+
 __all__ = ["TCPStore", "build_native_store"]
 
 _NATIVE_SRC = os.path.join(os.path.dirname(os.path.dirname(
@@ -163,11 +165,26 @@ class TCPStore:
             self.port = out_port.value
         else:
             self.port = port
-        self._client = lib.pts_client_connect(
-            host.encode(), self.port, int(timeout * 1000))
-        if not self._client:
-            raise RuntimeError(
-                f"TCPStore connect to {host}:{self.port} failed")
+
+        # Rendezvous retry (resilience.RetryPolicy): workers routinely
+        # race the master's bind — a refused connect is retried under
+        # exponential backoff within the store's own timeout budget,
+        # instead of failing the whole process formation on attempt 1.
+        def _connect():
+            c = lib.pts_client_connect(
+                host.encode(), self.port, int(timeout * 1000))
+            if not c:
+                raise ConnectionError(
+                    f"TCPStore connect to {host}:{self.port} failed")
+            return c
+        policy = _resil.RetryPolicy.from_env(
+            "PADDLE_TPU_RENDEZVOUS", max_attempts=4, base_delay=0.25,
+            max_delay=5.0, deadline=timeout,
+            retry_on=(ConnectionError,))
+        try:
+            self._client = policy.run(_connect)
+        except ConnectionError as e:
+            raise RuntimeError(str(e)) from e
 
     def _conn(self):
         if self._client is None:
@@ -186,6 +203,9 @@ class TCPStore:
             raise RuntimeError("TCPStore.set failed")
 
     def get(self, key: str) -> bytes:
+        # fault site: a peer host dropping out of the job manifests as
+        # a get/wait timing out on a key the dead rank never set
+        _resil.maybe_inject("host_drop")
         if self._py is not None:
             return self._py.get(key, self.timeout)
         k = key.encode()
@@ -216,6 +236,7 @@ class TCPStore:
         return int(val)
 
     def wait(self, key: str) -> None:
+        _resil.maybe_inject("host_drop")
         if self._py is not None:
             return self._py.wait(key, self.timeout)
         k = key.encode()

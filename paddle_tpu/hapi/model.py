@@ -146,8 +146,36 @@ class Model:
         if self._train_step is not None:
             self._train_step.auto_lr_step = self._auto_lr_step
         self.stop_training = False
+        # Resilience (distributed/resilience.py): with
+        # PADDLE_TPU_STEP_TIMEOUT set (or FLAGS_check_nan_inf armed)
+        # every train step runs under a StepWatchdog — a wedged step
+        # raises StepTimeout instead of hanging fit() forever, a NaN
+        # storm raises NanInfStorm, and both write an atomic
+        # checkpoint-on-failure into save_dir first.
+        from ..distributed.resilience import StepWatchdog
+        watchdog = None
+        if StepWatchdog.enabled_by_env():
+            watchdog = StepWatchdog(
+                on_failure=lambda kind, exc: self._emergency_save(kind))
         for cb in cbs:
             cb.on_train_begin()
+        try:
+            self._fit_epochs(loader, eval_data, batch_size, epochs,
+                             eval_freq, num_workers, num_iters, cbs,
+                             watchdog)
+        finally:
+            if watchdog is not None:
+                watchdog.close()
+        if self._train_step is not None:
+            # apply a trailing partial accumulation window so its grads
+            # are not silently carried into a later fit/evaluate
+            self._train_step.flush_accumulation()
+        for cb in cbs:
+            cb.on_train_end()
+        return self
+
+    def _fit_epochs(self, loader, eval_data, batch_size, epochs,
+                    eval_freq, num_workers, num_iters, cbs, watchdog):
         it_count = 0
         for epoch in range(epochs):
             try:
@@ -161,7 +189,10 @@ class Model:
                 for cb in cbs:
                     cb.on_train_batch_begin(step_i)
                 x, y = self._split_batch(data)
-                (loss,) = self.train_batch(x, y)
+                if watchdog is not None:
+                    (loss,) = watchdog.run(self.train_batch, x, y)
+                else:
+                    (loss,) = self.train_batch(x, y)
                 logs = {"loss": loss}
                 for cb in cbs:
                     cb.on_train_batch_end(step_i, logs)
@@ -182,13 +213,28 @@ class Model:
                 break
             if num_iters is not None and it_count >= num_iters:
                 break
-        if self._train_step is not None:
-            # apply a trailing partial accumulation window so its grads
-            # are not silently carried into a later fit/evaluate
-            self._train_step.flush_accumulation()
-        for cb in cbs:
-            cb.on_train_end()
-        return self
+
+    def _emergency_save(self, kind: str):
+        """Checkpoint-on-failure for the fit loop: atomic tmp+rename of
+        the usual .pdparams/.pdopt pair under save_dir. Best-effort by
+        contract (StepWatchdog swallows exceptions here so the original
+        failure surfaces) — a hang may leave device state unreachable,
+        in which case the last synced host copy is what gets saved."""
+        if getattr(self, "_save_dir", None) is None:
+            return
+        os.makedirs(self._save_dir, exist_ok=True)
+        prefix = os.path.join(self._save_dir, "on_failure")
+        if kind != "hang":
+            # on a hang the device may be wedged — syncing step state
+            # from it would block THIS thread too, turning the
+            # StepTimeout escape hatch back into a hang; save the last
+            # host-synced copy instead
+            self._sync()
+        _save(self.network.state_dict(), prefix + ".pdparams.tmp")
+        os.replace(prefix + ".pdparams.tmp", prefix + ".pdparams")
+        if self._optimizer is not None:
+            _save(self._optimizer.state_dict(), prefix + ".pdopt.tmp")
+            os.replace(prefix + ".pdopt.tmp", prefix + ".pdopt")
 
     # -- eval / predict --------------------------------------------------
     def _sync(self):

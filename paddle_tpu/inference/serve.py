@@ -8,10 +8,20 @@ predictor (inference/predictor.py) behind a threaded stdlib HTTP server —
 zero extra dependencies, JSON tensors in/out.
 
 Endpoints:
-  GET  /health    -> {"status": "ok"}
+  GET  /health    -> {"status": "ok"} (liveness — the process answers)
+  GET  /healthz   -> readiness: 200 once the predictor can serve, 503
+                     with a reason while degraded (failure streak,
+                     saturated queue)
   GET  /metadata  -> input/output names (+ dtypes/shapes once known)
   POST /predict   -> {"inputs": {name: nested-list | {"data": ...,
                       "dtype": "float32"}}} -> {"outputs": {name: ...}}
+
+Graceful degradation (resilience subsystem, distributed/resilience.py):
+every /predict carries a deadline (PADDLE_TPU_SERVE_DEADLINE, default
+30s) — a wedged backend yields a fast 503, never a hung client; when
+more than PADDLE_TPU_SERVE_MAX_QUEUE requests are already waiting the
+server sheds load with an immediate 503 instead of queueing into its
+own deadline.
 
 CLI: python -m paddle_tpu.inference.serve --model m.pdmodel --port 8866
 """
@@ -19,14 +29,26 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FutureTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from ..distributed import resilience as _resil
 from .predictor import Config, create_predictor
 
 __all__ = ["PredictorServer", "main"]
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
 
 
 class PredictorServer:
@@ -38,12 +60,29 @@ class PredictorServer:
     """
 
     def __init__(self, model_path_or_config, host: str = "127.0.0.1",
-                 port: int = 8866):
+                 port: int = 8866, deadline_s: float = None,
+                 max_queue: int = None):
         cfg = (model_path_or_config
                if isinstance(model_path_or_config, Config)
                else Config(model_path_or_config))
         self.predictor = create_predictor(cfg)
         self._lock = threading.Lock()
+        self.deadline_s = (deadline_s if deadline_s is not None
+                           else _env_float("PADDLE_TPU_SERVE_DEADLINE",
+                                           30.0))
+        self.max_queue = int(max_queue if max_queue is not None
+                             else _env_float("PADDLE_TPU_SERVE_MAX_QUEUE",
+                                             8))
+        # ONE predict worker: the predictor serializes anyway (zero-copy
+        # handles are shared state); running it in a dedicated thread is
+        # what lets a handler ABANDON a wedged call at its deadline —
+        # the handler thread is never the one stuck in the runtime
+        self._pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="predict")
+        self._depth = 0                 # requests submitted, not done
+        self._depth_lock = threading.Lock()
+        self._failure_streak = 0        # consecutive 5xx-class outcomes
+        self._started = time.monotonic()
         self.httpd = ThreadingHTTPServer((host, port),
                                          self._make_handler())
         self.host, self.port = self.httpd.server_address[:2]
@@ -54,7 +93,32 @@ class PredictorServer:
         return {"inputs": self.predictor.get_input_names(),
                 "outputs": self.predictor.get_output_names()}
 
+    def _readiness(self):
+        """(ready, body) for /healthz. Degraded conditions are reported
+        with a reason so an orchestrator can tell shed-load from dead."""
+        body = {"status": "ready",
+                "uptime_s": round(time.monotonic() - self._started, 1),
+                "queue_depth": self._depth,
+                "max_queue": self.max_queue,
+                "failure_streak": self._failure_streak}
+        if self.predictor is None:
+            body.update(status="unready", reason="no predictor loaded")
+            return False, body
+        if self._failure_streak >= 3:
+            body.update(status="unready",
+                        reason=f"{self._failure_streak} consecutive "
+                               "predict failures (backend unavailable?)")
+            return False, body
+        if self._depth >= self.max_queue:
+            body.update(status="unready", reason="request queue saturated")
+            return False, body
+        return True, body
+
     def _predict(self, payload):
+        # fault sites: a wedged backend (hangs until the request
+        # deadline trips) and an unavailable one (raises; mapped to 503)
+        _resil.maybe_inject("serve_hang")
+        _resil.maybe_inject("serve_backend")
         inputs = payload.get("inputs")
         if not isinstance(inputs, dict):
             raise ValueError('body must be {"inputs": {name: tensor}}')
@@ -105,6 +169,9 @@ class PredictorServer:
             def do_GET(self):
                 if self.path == "/health":
                     self._send(200, {"status": "ok"})
+                elif self.path == "/healthz":
+                    ready, body = server._readiness()
+                    self._send(200 if ready else 503, body)
                 elif self.path == "/metadata":
                     self._send(200, server._metadata())
                 else:
@@ -114,14 +181,68 @@ class PredictorServer:
                 if self.path != "/predict":
                     self._send(404, {"error": f"no route {self.path}"})
                     return
+                # load shedding BEFORE reading the body into the queue:
+                # a saturated predict worker means every queued request
+                # would blow its deadline anyway — 503 now is cheaper
+                # for the client than 503 in deadline_s seconds
+                with server._depth_lock:
+                    if server._depth >= server.max_queue:
+                        self._send(503, {"error": "overloaded",
+                                         "queue_depth": server._depth})
+                        return
+                    server._depth += 1
+
+                def release():
+                    with server._depth_lock:
+                        server._depth -= 1
+
+                # depth is released by whoever last holds the work: the
+                # WORKER once the call actually finishes (a wedged call
+                # abandoned at its deadline keeps occupying depth, so
+                # the gate above sheds followers immediately), or this
+                # handler if the work never reached the worker
+                def run_and_release(payload):
+                    try:
+                        return server._predict(payload)
+                    finally:
+                        release()
+
+                submitted = False
                 try:
                     n = int(self.headers.get("Content-Length", "0"))
                     payload = json.loads(self.rfile.read(n) or b"{}")
-                    self._send(200, server._predict(payload))
+                    fut = server._pool.submit(run_and_release, payload)
+                    submitted = True
+                    try:
+                        out = fut.result(timeout=server.deadline_s)
+                    except FutureTimeout:
+                        # abandon the call: if still queued the cancel
+                        # wins (release here); if running, the worker
+                        # stays wedged holding its depth slot and THIS
+                        # client gets its 503 now
+                        if fut.cancel():
+                            release()
+                        server._failure_streak += 1
+                        self._send(503, {
+                            "error": "deadline_exceeded",
+                            "deadline_s": server.deadline_s})
+                        return
+                    server._failure_streak = 0
+                    self._send(200, out)
+                except (_resil.FaultInjected, ConnectionError) as e:
+                    server._failure_streak += 1
+                    self._send(503, {"error":
+                                     f"backend_unavailable: {e}"})
                 except (ValueError, KeyError) as e:
                     self._send(400, {"error": str(e)})
                 except Exception as e:   # noqa: BLE001 — report, keep serving
-                    self._send(500, {"error": f"{type(e).__name__}: {e}"})
+                    server._failure_streak += 1
+                    code = 503 if "unavailable" in str(e).lower() else 500
+                    self._send(code,
+                               {"error": f"{type(e).__name__}: {e}"})
+                finally:
+                    if not submitted:
+                        release()
 
         return Handler
 
@@ -138,6 +259,8 @@ class PredictorServer:
     def stop(self):
         self.httpd.shutdown()
         self.httpd.server_close()
+        # don't wait for a possibly-wedged predict call to drain
+        self._pool.shutdown(wait=False, cancel_futures=True)
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None

@@ -329,12 +329,26 @@ class DataLoader:
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
-                 persistent_workers=False, worker_mode="thread"):
+                 persistent_workers=False, worker_mode="thread",
+                 worker_restarts=None):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = int(num_workers)
         self.prefetch_factor = max(2, int(prefetch_factor))
         self.worker_init_fn = worker_init_fn
+        # Resilience (distributed/resilience.py): budget of worker
+        # respawns/batch retries per epoch. 0 (the default) keeps the
+        # historical fail-fast contract: any worker death or fetch
+        # error aborts iteration. Positive values make the loader
+        # elastic — dead forked workers are respawned and their
+        # in-flight batches re-enqueued, with RetryPolicy backoff.
+        if worker_restarts is None:
+            try:
+                worker_restarts = int(os.environ.get(
+                    "PADDLE_TPU_WORKER_RESTARTS", 0))
+            except ValueError:
+                worker_restarts = 0
+        self.worker_restarts = max(0, int(worker_restarts))
         if worker_mode not in ("thread", "process"):
             raise ValueError(
                 f"worker_mode must be 'thread' or 'process', got "
@@ -422,6 +436,19 @@ class DataLoader:
             def fetch(indices):
                 return collate([dataset[i] for i in indices])
 
+            if self.worker_restarts:
+                # same restart budget as process mode, same backoff
+                # schedule (resilience.RetryPolicy) — a transient fetch
+                # failure retries instead of killing the epoch
+                from ..distributed.resilience import RetryPolicy
+                policy = RetryPolicy(
+                    max_attempts=self.worker_restarts + 1,
+                    base_delay=0.05, max_delay=2.0)
+                plain_fetch = fetch
+
+                def fetch(indices):  # noqa: F811 — deliberate rebind
+                    return policy.run(plain_fetch, indices)
+
             with ThreadPoolExecutor(self.num_workers) as pool:
                 pending = []
                 it = iter(self.batch_sampler)
@@ -457,14 +484,22 @@ class DataLoader:
                     rings = None
         user_collate = None if self.collate_fn is default_collate_fn \
             else self.collate_fn
-        procs = [ctx.Process(
-            target=_mp_worker_loop,
-            args=(self.dataset, index_q, result_q, w, self.num_workers,
-                  self.worker_init_fn, user_collate,
-                  rings[w] if rings else None), daemon=True)
-            for w in range(self.num_workers)]
-        for p in procs:
+
+        def spawn(w):
+            # fault arming happens HERE in the parent (the injection
+            # counter is consumed once per configured count, so a
+            # respawned worker comes back healthy — like a real crash)
+            from ..distributed import resilience as _resil
+            crash = _resil.should_fire("dataloader_worker")
+            p = ctx.Process(
+                target=_mp_worker_loop,
+                args=(self.dataset, index_q, result_q, w,
+                      self.num_workers, self.worker_init_fn, user_collate,
+                      rings[w] if rings else None, crash), daemon=True)
             p.start()
+            return p
+
+        procs = [spawn(w) for w in range(self.num_workers)]
         guard = _MultiprocessGuard(procs, index_q, rings)
 
         def get_result(timeout):
@@ -484,22 +519,42 @@ class DataLoader:
                         return _pickle.loads(msg)
                 if _time.monotonic() >= end:
                     raise _queue.Empty
+        restarts_left = self.worker_restarts
+        restart_policy = None
+        if restarts_left:
+            from ..distributed.resilience import RetryPolicy
+            restart_policy = RetryPolicy(
+                max_attempts=restarts_left + 1, base_delay=0.05,
+                max_delay=2.0)
+
+        def recover(outstanding, attempt):
+            """Respawn dead workers and re-enqueue every submitted-but-
+            unreceived batch. Live workers may still deliver some of
+            those ids — duplicates are dropped at receive time (only
+            ids still in `outstanding` are consumed)."""
+            for w, p in enumerate(procs):
+                if not p.is_alive():
+                    procs[w] = spawn(w)
+            for bid, indices in outstanding.items():
+                index_q.put((bid, indices))
+            restart_policy.sleep(attempt)
+
         try:
             it = enumerate(iter(self.batch_sampler))
             depth = self.num_workers * self.prefetch_factor
-            in_flight = 0
+            outstanding = {}        # batch_id -> indices (for re-enqueue)
             for _ in range(depth):
                 nxt = next(it, None)
                 if nxt is None:
                     break
                 index_q.put(nxt)
-                in_flight += 1
+                outstanding[nxt[0]] = nxt[1]
             reorder = {}
             next_id = 0
             deadline = self.timeout or None
             import queue as _queue
             import time as _time
-            while in_flight:
+            while outstanding:
                 while next_id in reorder:
                     data = reorder.pop(next_id)
                     next_id += 1
@@ -518,21 +573,48 @@ class DataLoader:
                                 f"DataLoader timed out after "
                                 f"{self.timeout}s waiting for a worker "
                                 f"batch")
-                        if not any(p.is_alive() for p in procs):
+                        dead = [p for p in procs if not p.is_alive()]
+                        if dead and restarts_left > 0:
+                            # elastic path: a crashed worker (injected
+                            # via 'dataloader_worker', or a real OOM
+                            # kill) is respawned and its lost batches
+                            # re-fed — the epoch completes instead of
+                            # deadlocking on a batch nobody holds
+                            restarts_left -= 1
+                            recover(outstanding,
+                                    self.worker_restarts - restarts_left)
+                        elif dead and self.worker_restarts:
+                            raise RuntimeError(
+                                f"DataLoader worker died and the "
+                                f"restart budget "
+                                f"(worker_restarts="
+                                f"{self.worker_restarts}) is exhausted")
+                        elif len(dead) == len(procs):
                             raise RuntimeError(
                                 "all DataLoader workers exited "
-                                "unexpectedly (see worker stderr)")
+                                "unexpectedly (see worker stderr; set "
+                                "worker_restarts>0 or "
+                                "PADDLE_TPU_WORKER_RESTARTS to respawn "
+                                "crashed workers)")
                 if batch_id == -1:
                     raise RuntimeError(err)
-                in_flight -= 1
+                if batch_id not in outstanding:
+                    continue        # duplicate from a re-enqueued batch
                 if err is not None:
+                    if restarts_left > 0:
+                        restarts_left -= 1
+                        index_q.put((batch_id, outstanding[batch_id]))
+                        restart_policy.sleep(
+                            self.worker_restarts - restarts_left)
+                        continue
                     raise RuntimeError(
                         f"DataLoader worker failed on batch {batch_id}: "
                         f"{err}")
+                del outstanding[batch_id]
                 nxt = next(it, None)
                 if nxt is not None:
                     index_q.put(nxt)
-                    in_flight += 1
+                    outstanding[nxt[0]] = nxt[1]
                 reorder[batch_id] = data
             while next_id in reorder:
                 data = reorder.pop(next_id)
@@ -574,7 +656,7 @@ def _tensorize(obj):
 
 
 def _mp_worker_loop(dataset, index_q, result_q, worker_id, num_workers,
-                    init_fn, collate_fn, ring=None):
+                    init_fn, collate_fn, ring=None, inject_crash=False):
     """Runs in the forked child. Exits with os._exit so inherited jax/
     atexit state is never touched. With a shm ring (fork-inherited
     mapping) results bypass the mp.Queue pipe entirely."""
@@ -605,6 +687,13 @@ def _mp_worker_loop(dataset, index_q, result_q, worker_id, num_workers,
             if item is None:
                 break
             batch_id, indices = item
+            # fault site 'dataloader_worker' (armed by the parent at
+            # spawn): hard worker death (segfault/OOM-kill class) —
+            # os._exit skips the finally below, exactly like a real
+            # kill; the parent's liveness check + respawn path handles
+            # it, and the batch this worker took dies with it.
+            if inject_crash:
+                _os._exit(13)
             try:
                 samples = [dataset[i] for i in indices]
                 data = (collate_fn(samples) if collate_fn is not None

@@ -81,6 +81,59 @@ def test_disabled_without_dir():
     assert list(TrainEpochRange(3)) == [0, 1, 2]   # stateless re-iteration
 
 
+def test_crash_mid_epoch_roundtrips_optimizer_and_rng(tmp_path):
+    """Satellite: kill the training loop mid-epoch via FaultInjector,
+    relaunch, and assert the epoch counter, optimizer state, AND the
+    global RNG key round-trip through the committed snapshot."""
+    import jax
+    import pytest
+
+    from paddle_tpu.distributed import resilience as resil
+    from paddle_tpu.distributed.resilience import (FaultInjected,
+                                                   FaultInjector, RngState)
+    from paddle_tpu.framework.random import get_rng_state, next_key
+
+    ck = str(tmp_path)
+    m, opt, x, y = _setup(5)
+    r = TrainEpochRange(4, checkpoint_dir=ck, name="jobF").attach(
+        model=m, optimizer=opt, rng=RngState())
+    key_after_epoch1 = None
+    vel_after_epoch1 = None
+    with FaultInjector({"train_crash": 1}):
+        with pytest.raises(FaultInjected):
+            for epoch in r:
+                _one_epoch(m, opt, x, y)
+                next_key()   # the epoch consumed randomness
+                if epoch == 1:
+                    key_after_epoch1 = np.asarray(
+                        jax.random.key_data(get_rng_state()))
+                    vel_after_epoch1 = {
+                        k: np.asarray(v).copy() for k, v in
+                        opt._accumulators["velocity"].items()}
+                if epoch == 2:
+                    # mid-epoch kill: epoch 2's snapshot never commits
+                    resil.maybe_inject("train_crash")
+
+    # relaunch: fresh objects, same dir/name — resumes AT epoch 2
+    m2, opt2, x2, y2 = _setup(5)
+    next_key()   # perturb the fresh process's RNG; restore must win
+    r2 = TrainEpochRange(4, checkpoint_dir=ck, name="jobF").attach(
+        model=m2, optimizer=opt2, rng=RngState())
+    it = iter(r2)
+    assert next(it) == 2
+    # RNG key restored to the end-of-epoch-1 commit, bitwise
+    np.testing.assert_array_equal(
+        np.asarray(jax.random.key_data(get_rng_state())),
+        key_after_epoch1)
+    # optimizer velocity restored bitwise (same accumulator names —
+    # parameter names are pinned in _setup)
+    vel2 = opt2._accumulators["velocity"]
+    assert set(vel2) == set(vel_after_epoch1)
+    for k in vel2:
+        np.testing.assert_array_equal(np.asarray(vel2[k]),
+                                      vel_after_epoch1[k])
+
+
 def test_save_interval(tmp_path):
     ck = str(tmp_path)
     m, opt, x, y = _setup(3)

@@ -47,6 +47,41 @@ def test_go_binds_only_declared_symbols():
     assert used <= header_syms, used - header_syms
 
 
+def test_tensor_constructors_guard_empty_slices():
+    """NewFloat32Tensor/NewInt64Tensor used to panic on empty slices via
+    &data[0]; every constructor that touches &data[0] must carry the
+    len-zero guard (unit-tested in paddle/paddle_test.go where a Go
+    toolchain exists; this static check keeps the guard from regressing
+    in images without one)."""
+    with open(os.path.join(GOAPI, "paddle", "paddle.go")) as f:
+        src = f.read()
+    funcs = re.findall(r"func New\w+Tensor\([^)]*\) Tensor \{.*?\n\}",
+                       src, re.S)
+    assert len(funcs) >= 2, "tensor constructors not found"
+    for fn in funcs:
+        if "&data[0]" in fn:
+            assert "len(data) == 0" in fn, \
+                f"missing empty-slice guard in:\n{fn}"
+    assert os.path.exists(os.path.join(GOAPI, "paddle", "paddle_test.go"))
+
+
+@pytest.mark.skipif(_GO is None, reason="no Go toolchain in this image "
+                    "(recorded skip — see goapi/README.md CI status)")
+def test_goapi_unit_tests(tmp_path):
+    """`go test` over the package's pure-Go surface (tensor packing,
+    empty-slice guards). Needs the C library only for linking."""
+    from paddle_tpu.inference.c_api import build_c_api
+    so = build_c_api()
+    assert so, "C API failed to build"
+    env = dict(os.environ)
+    lib_dir = os.path.dirname(so)
+    env["CGO_LDFLAGS"] = (f"-L{lib_dir} -lpaddle_capi "
+                          f"-Wl,-rpath,{lib_dir}")
+    r = subprocess.run([_GO, "test", "./paddle/..."], cwd=GOAPI, env=env,
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
 @pytest.mark.skipif(_GO is None, reason="no Go toolchain in this image "
                     "(recorded skip — see goapi/README.md CI status)")
 def test_goapi_end_to_end(tmp_path):

@@ -68,3 +68,87 @@ def test_static_data_and_program_guard():
     assert spec.shape == [None, 3, 32, 32]
     with static.program_guard(static.default_main_program()):
         pass
+
+
+# ---------------------------------------------------------------------------
+# graceful degradation (resilience subsystem): the serving front-end
+# must answer 503 — never hang — when the backend is unavailable or a
+# request exceeds its deadline, and /healthz must report readiness.
+# ---------------------------------------------------------------------------
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+from paddle_tpu.distributed.resilience import FaultInjector
+from paddle_tpu.inference.serve import PredictorServer
+
+
+@pytest.fixture
+def resilient_server(saved_model):
+    path, x, ref = saved_model
+    srv = PredictorServer(path + ".pdmodel", port=0, deadline_s=0.6,
+                          max_queue=2).start()
+    yield srv, x
+    srv.stop()
+
+
+def _req(srv, path, payload=None, timeout=30):
+    url = f"http://{srv.host}:{srv.port}{path}"
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(
+        url, data=data,
+        headers={"Content-Type": "application/json"} if data else {})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _predict_payload(srv, x):
+    name = srv.predictor.get_input_names()[0]
+    return {"inputs": {name: {"data": x.tolist(), "dtype": "float32"}}}
+
+
+def test_healthz_reports_ready(resilient_server):
+    srv, _ = resilient_server
+    code, body = _req(srv, "/healthz")
+    assert code == 200
+    assert body["status"] == "ready"
+    assert body["max_queue"] == 2 and body["failure_streak"] == 0
+
+
+def test_deadline_exceeded_returns_503_not_a_hang(resilient_server):
+    srv, x = resilient_server
+    payload = _predict_payload(srv, x)
+    with FaultInjector({"serve_hang": 1}, wedge_s=1.5):
+        t0 = time.monotonic()
+        code, body = _req(srv, "/predict", payload)
+        took = time.monotonic() - t0
+    assert code == 503, body
+    assert body["error"] == "deadline_exceeded"
+    assert took < 1.4, f"client waited {took:.2f}s — that is a hang"
+    time.sleep(1.2)  # let the wedged worker drain
+    code, body = _req(srv, "/predict", payload)
+    assert code == 200, body  # server recovered
+
+
+def test_backend_unavailable_returns_503_and_healthz_degrades(
+        resilient_server):
+    srv, x = resilient_server
+    payload = _predict_payload(srv, x)
+    with FaultInjector({"serve_backend": 3}):
+        for _ in range(3):
+            code, body = _req(srv, "/predict", payload)
+            assert code == 503, body
+            assert "backend_unavailable" in body["error"]
+    code, body = _req(srv, "/healthz")
+    assert code == 503 and body["status"] == "unready"
+    assert "consecutive" in body["reason"]
+    # one healthy predict clears the streak and readiness returns
+    code, _ = _req(srv, "/predict", payload)
+    assert code == 200
+    code, body = _req(srv, "/healthz")
+    assert code == 200 and body["status"] == "ready"

@@ -78,6 +78,43 @@ def test_crash_mid_write_leaves_no_landed_looking_file(tmp_path):
     assert not os.path.exists(os.path.join(d, "crash.json.part"))
 
 
+def test_txt_artifact_requires_terminal_json_record(tmp_path):
+    """A .txt artifact lands only when its LAST non-empty line is a
+    good JSON record — raw size must not qualify (a mid-print kill
+    leaves >100 bytes of prose but no terminal record)."""
+    def rc(path):
+        return subprocess.run(
+            ["python", os.path.join(TOOLS, "_have_result.py"), path],
+            capture_output=True).returncode
+
+    filler = "== top ops ==\n" + ("  fusion.1   12.3 ms\n" * 20)
+
+    good = os.path.join(str(tmp_path), "good.txt")
+    with open(good, "w") as f:
+        f.write(filler + json.dumps({"metric": "gpt_step_profile",
+                                     "ms_per_step_wall": 1.0}) + "\n")
+    assert rc(good) == 0
+
+    # mid-print kill: plenty of bytes, record truncated mid-JSON
+    cut = os.path.join(str(tmp_path), "cut.txt")
+    with open(cut, "w") as f:
+        f.write(filler + '{"metric": "gpt_step_profile", "ms_per')
+    assert os.path.getsize(cut) > 100 and rc(cut) == 1
+
+    # error-record tail (probe's backend_unavailable line) is not landed
+    err = os.path.join(str(tmp_path), "err.txt")
+    with open(err, "w") as f:
+        f.write(filler + json.dumps({"error": "backend_unavailable"})
+                + "\n")
+    assert rc(err) == 1
+
+    # no terminal record at all
+    prose = os.path.join(str(tmp_path), "prose.txt")
+    with open(prose, "w") as f:
+        f.write(filler)
+    assert os.path.getsize(prose) > 100 and rc(prose) == 1
+
+
 def test_watcher_landed_list_tracks_suite_outputs():
     """tpu_watch2.sh exits only when its landed-file list is all good;
     that list must contain exactly tpu_suite2.sh's step outputs, or the

@@ -1,5 +1,6 @@
 """vision.transforms tests (reference: test_transforms.py patterns —
 identity checks, involutions, numeric formulas, surface parity)."""
+import os
 import re
 
 import numpy as np
@@ -14,9 +15,14 @@ def img():
         0, 255, (24, 32, 3)).astype(np.uint8)
 
 
+_REF_TRANSFORMS = ("/root/reference/python/paddle/vision/transforms/"
+                   "__init__.py")
+
+
+@pytest.mark.skipif(not os.path.exists(_REF_TRANSFORMS),
+                    reason="reference tree not mounted")
 def test_surface_matches_reference():
-    ref = open("/root/reference/python/paddle/vision/transforms/"
-               "__init__.py").read()
+    ref = open(_REF_TRANSFORMS).read()
     names = {a or b for a, b in re.findall(
         r"'(\w+)'|\"(\w+)\"",
         re.search(r"__all__ = \[(.*?)\]", ref, re.S).group(1))}

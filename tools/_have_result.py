@@ -9,7 +9,11 @@ the watcher loop).
 A JSON record is good when it parses to a non-empty dict WITHOUT an
 "error" key (every tool's failure path writes {"error": ...}; empty or
 truncated files fail json parsing). A .txt artifact (profile output) is
-good when it holds more than a bare error line (>100 chars).
+good when its LAST non-empty line is such a JSON record — every
+measurement tool ends its stdout with one json.dumps line
+(profile_step.py's gpt_step_profile record), so a mid-print kill
+(truncated record, or none at all) and an error-line-only run both
+fail the predicate instead of counting as landed on byte size.
 
 CLI: python tools/_have_result.py <path...> -> exit 0 iff ALL good,
 printing the first missing one.
@@ -21,13 +25,24 @@ import os
 import sys
 
 
+def _record_ok(d) -> bool:
+    return bool(isinstance(d, dict) and d and "error" not in d)
+
+
 def have(path: str) -> bool:
     try:
         if path.endswith(".txt"):
-            return os.path.getsize(path) > 100
+            with open(path, errors="replace") as f:
+                lines = [ln.strip() for ln in f if ln.strip()]
+            if not lines:
+                return False
+            try:
+                return _record_ok(json.loads(lines[-1]))
+            except ValueError:
+                return False
         with open(path) as f:
             d = json.load(f)
-        return bool(isinstance(d, dict) and d and "error" not in d)
+        return _record_ok(d)
     except (OSError, ValueError):
         return False
 

@@ -21,6 +21,20 @@ echo $$ > "$WD"
 
 echo "[watch2] start $(date -u +%FT%TZ) pid=$$" >> "$LOG"
 A=0
+# Escalation state (reference semantics: paddle_tpu.distributed.
+# resilience.RetryPolicy — exponential backoff, multiplier 2, capped,
+# attempt cap): when a probe SUCCEEDS but the suite then leaves the
+# SAME artifact missing again, the failure is not the tunnel — it is
+# that measurement itself (e.g. an OOM that re-fires forever). Retrying
+# it every 10 min burns the tunnel for nothing: back off 10→20→40→80
+# min (cap) and give up entirely after $STUCK_MAX identical failures.
+# Any change in the first-missing artifact (progress!) resets both.
+SLEEP_BASE=600
+SLEEP_CAP=4800
+STUCK_MAX=6
+STUCK_COUNT=0
+LAST_MISS=""
+SLEEP_S=$SLEEP_BASE
 while true; do
   A=$((A + 1))
   echo "[watch2] $(date -u +%FT%TZ) probe attempt=$A" >> "$LOG"
@@ -57,7 +71,7 @@ PY
     # leaves error records; keep probing and re-firing, and each landed
     # step skips itself, so no queued measurement is ever lost to a
     # partial recovery.
-    if python /root/repo/tools/_have_result.py 9>&- \
+    MISS=$(python /root/repo/tools/_have_result.py 9>&- \
         /root/repo/tpu_results/bench_1p3b.json \
         /root/repo/tpu_results/profile_step.txt \
         /root/repo/tpu_results/bench_ring.json \
@@ -65,14 +79,40 @@ PY
         /root/repo/tpu_results/bench_125m_fused.json \
         /root/repo/tpu_results/bench_1p3b_dots.json \
         /root/repo/tpu_results/bench_125m_bf16opt.json \
-        /root/repo/tpu_results/kv_quality.json >> "$LOG"
-    then
+        /root/repo/tpu_results/kv_quality.json \
+    )
+    HAVE_RC=$?
+    # landed is decided by the EXIT CODE (rc=0), never by empty stdout:
+    # a crashed predicate (no python, OOM kill) prints nothing to stdout
+    # and must read as "not landed", not as success
+    if [ "$HAVE_RC" = 0 ]; then
       echo "[watch2] $(date -u +%FT%TZ) all measurements landed — done" >> "$LOG"
       exit 0
     fi
-    echo "[watch2] $(date -u +%FT%TZ) suite incomplete — continue probing" >> "$LOG"
+    if [ -z "$MISS" ]; then
+      echo "[watch2] $(date -u +%FT%TZ) _have_result.py itself failed rc=$HAVE_RC — keep probing" >> "$LOG"
+      MISS="(predicate failed rc=$HAVE_RC)"
+    fi
+    echo "[watch2] $(date -u +%FT%TZ) suite incomplete ($MISS)" >> "$LOG"
+    if [ "$MISS" = "$LAST_MISS" ]; then
+      STUCK_COUNT=$((STUCK_COUNT + 1))
+      SLEEP_S=$((SLEEP_S * 2))
+      [ "$SLEEP_S" -gt "$SLEEP_CAP" ] && SLEEP_S=$SLEEP_CAP
+      echo "[watch2] same artifact failed ${STUCK_COUNT}x — backoff ${SLEEP_S}s" >> "$LOG"
+      if [ "$STUCK_COUNT" -ge "$STUCK_MAX" ]; then
+        echo "[watch2] $(date -u +%FT%TZ) giving up: $MISS failed $STUCK_COUNT probe-OK rounds (needs a human/code fix, not retries)" >> "$LOG"
+        exit 2
+      fi
+    else
+      STUCK_COUNT=0
+      SLEEP_S=$SLEEP_BASE
+    fi
+    LAST_MISS="$MISS"
   else
     echo "[watch2] $(date -u +%FT%TZ) probe rc=$RC" >> "$LOG"
+    # a failed PROBE is the tunnel's problem, not a measurement's —
+    # keep the base cadence and leave the escalation state alone
+    SLEEP_S=$SLEEP_BASE
   fi
-  sleep 600 9>&-
+  sleep "$SLEEP_S" 9>&-
 done
