@@ -11,6 +11,8 @@ TRT subgraphs" collapse into XLA compilation at load (AOT — first run
 pays no trace). The Config/Predictor/Tensor-handle API surface matches the
 reference so serving code ports directly.
 """
+from .engine import (ContinuousBatchingEngine, EngineOverloaded,
+                     GenerationPredictor)
 from .predictor import (Config, DataType, PlaceType, PrecisionType,
                         Predictor, PredictorPool, Tensor,
                         _get_phi_kernel_name,
@@ -21,6 +23,8 @@ from .predictor import (Config, DataType, PlaceType, PrecisionType,
 
 __all__ = ["Config", "Predictor", "create_predictor", "Tensor",
            "PlaceType", "DataType", "PrecisionType", "PredictorPool",
+           "ContinuousBatchingEngine", "EngineOverloaded",
+           "GenerationPredictor",
            "get_version", "get_num_bytes_of_data_type",
            "get_trt_compile_version", "get_trt_runtime_version",
            "convert_to_mixed_precision", "_get_phi_kernel_name"]

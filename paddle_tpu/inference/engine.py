@@ -1,0 +1,536 @@
+"""Continuous-batching serving engine with a slot-based KV cache.
+
+Serving north star (ROADMAP: "heavy traffic from millions of users, as
+fast as the hardware allows"): `models/generation.py::generate()` decodes
+ONE stream per compiled program, so chip utilization collapses to
+batch=1 the moment traffic is concurrent. This engine multiplexes many
+requests through a CONSTANT set of compiled programs:
+
+- a fixed pool of N decode slots backed by one pre-allocated slot-based
+  KV cache (`model.new_cache(N, max_len, dtype)` — per-layer
+  [B=N, max_len, kv_heads, head_dim] arrays, bf16/f32 or the int8
+  quantized dict form), donated through every step so XLA updates it in
+  place in HBM;
+- ONE jitted batched decode program per engine: each tick runs
+  `tick_tokens` micro-steps for ALL slots (dead slots ride along under
+  an active mask — fixed shapes, no recompiles, one host sync per tick
+  for the emitted [N, tick_tokens] block);
+- a small set of bucketed prefill programs: a queued request's prompt is
+  right-padded to the nearest bucket, prefilled into a FRESH zeroed
+  cache inside the program, and the whole slot row range is overwritten
+  at admission (so a retired slot's stale rows — including int8
+  quantization scales — can never leak into the next request);
+- admission and retirement happen at tick boundaries only: queued
+  requests enter free slots, finished ones (per-request EOS / token
+  budget) resolve their futures. No head-of-line blocking: a long
+  request never stalls short ones sharing the batch.
+
+Why right-padded bucketed prefill is exact: causal attention means the
+garbage rows a padded prompt writes at [P, bucket) are never attended
+by positions < P, and decode overwrites position p before the mask can
+reach it — so greedy outputs are token-identical to sequential
+`generate()` per request (asserted in tests/test_engine.py).
+
+Fusion-preserving, recompile-free regime per "Operator Fusion in XLA"
+and MPK (PAPERS.md): the decode step stays one fixed-shape compiled
+program; concurrency is multiplexed through it, never traced into it.
+
+Env knobs: PADDLE_TPU_SERVE_SLOTS (default 8),
+PADDLE_TPU_SERVE_PREFILL_BUCKETS (comma list, default powers of two),
+PADDLE_TPU_SERVE_TICK_TOKENS (default 8),
+PADDLE_TPU_SERVE_MAX_QUEUE (default 32).
+"""
+from __future__ import annotations
+
+import os
+import threading
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..distributed import resilience as _resil
+from ..jit.functional import functional_call, raw_state
+from ..models.generation import _select_token
+
+__all__ = ["ContinuousBatchingEngine", "EngineOverloaded",
+           "GenerationPredictor", "create_engine_predictor"]
+
+
+class EngineOverloaded(RuntimeError):
+    """Raised by submit() when the request queue is at capacity — the
+    serving layer maps this to the 503 `overloaded` record (same
+    load-shedding contract as the PR-1 predictor path)."""
+
+    def __init__(self, queue_depth: int, max_queue: int):
+        super().__init__(
+            f"engine queue saturated ({queue_depth}/{max_queue})")
+        self.queue_depth = queue_depth
+        self.max_queue = max_queue
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _default_buckets(max_len: int) -> tuple:
+    """Powers of two up to AND INCLUDING max_len (a long prompt with a
+    small token budget legitimately prefills near the full cache)."""
+    out, b = [], 8
+    while b < max_len:
+        out.append(b)
+        b *= 2
+    out.append(max_len)
+    return tuple(sorted(set(out)))
+
+
+@dataclass
+class _Request:
+    prompt: np.ndarray           # [P] int64
+    max_new_tokens: int
+    eos_token_id: Optional[int]
+    seed: int
+    future: Future = field(default_factory=Future)
+
+
+class _Slot:
+    """Host-side mirror of one decode slot's in-program state."""
+
+    __slots__ = ("req", "pos", "tok", "alive", "remaining", "emitted",
+                 "key")
+
+    def __init__(self):
+        self.req: Optional[_Request] = None
+        self.pos = 0
+        self.tok = 0
+        self.alive = False
+        self.remaining = 0
+        self.emitted: List[int] = []
+        self.key = np.zeros(2, np.uint32)
+
+    @property
+    def free(self) -> bool:
+        return self.req is None
+
+
+class ContinuousBatchingEngine:
+    """Serve arbitrary concurrent mixed-length generate requests through
+    a constant set of compiled programs (see module docstring).
+
+    `model` must expose the cache-threaded forward contract of
+    models/generation.py (GPTForCausalLM, LlamaForCausalLM do). Greedy
+    outputs are token-identical to sequential `generate()`; sampling is
+    reproducible per request (slot-position-keyed PRNG) but draws a
+    different stream than the sequential scan.
+    """
+
+    def __init__(self, model, slots: Optional[int] = None,
+                 max_len: Optional[int] = None,
+                 cache_dtype: str = "bfloat16",
+                 prefill_buckets: Optional[tuple] = None,
+                 tick_tokens: Optional[int] = None,
+                 max_queue: Optional[int] = None,
+                 do_sample: bool = False, temperature: float = 1.0,
+                 top_k: int = 0, top_p: float = 1.0):
+        self.model = model
+        self.slots = int(slots if slots is not None
+                         else _env_int("PADDLE_TPU_SERVE_SLOTS", 8))
+        if self.slots < 2:
+            raise ValueError("engine needs >= 2 slots (batch-axis "
+                             "detection and batching both require it)")
+        model_max = getattr(getattr(model, "cfg", None), "max_seq_len",
+                            None)
+        self.max_len = int(max_len if max_len is not None
+                           else (model_max or 1024))
+        if model_max is not None and self.max_len > model_max:
+            raise ValueError(
+                f"max_len {self.max_len} exceeds the model's "
+                f"max_seq_len {model_max}")
+        if prefill_buckets is None:
+            spec = os.environ.get("PADDLE_TPU_SERVE_PREFILL_BUCKETS", "")
+            prefill_buckets = (tuple(int(x) for x in spec.split(",") if
+                                     x.strip())
+                               if spec else _default_buckets(self.max_len))
+        self.prefill_buckets = tuple(sorted(
+            b for b in prefill_buckets if b <= self.max_len))
+        if not self.prefill_buckets:
+            raise ValueError("no prefill bucket fits max_len")
+        self.tick_tokens = int(
+            tick_tokens if tick_tokens is not None
+            else _env_int("PADDLE_TPU_SERVE_TICK_TOKENS", 8))
+        if self.tick_tokens < 1:
+            raise ValueError("tick_tokens must be >= 1")
+        self.max_queue = int(
+            max_queue if max_queue is not None
+            else _env_int("PADDLE_TPU_SERVE_MAX_QUEUE", 32))
+        self.cache_dtype = cache_dtype
+        self._sampling = (bool(do_sample), float(temperature),
+                          int(top_k), float(top_p))
+
+        was_training = model.training
+        model.eval()
+        self._params, self._buffers = raw_state(model)
+        if was_training:
+            model.train()
+        self._caches = model.new_cache(self.slots, self.max_len,
+                                       cache_dtype)
+        self._slots = [_Slot() for _ in range(self.slots)]
+        self._queue: List[_Request] = []
+        self._cv = threading.Condition()
+        self._stop_flag = False
+        self._broken: Optional[BaseException] = None
+
+        # compiled-program accounting: the counters tick inside the
+        # TRACED bodies, so they move only when XLA actually (re)traces
+        # — tests assert they stay constant after warmup no matter how
+        # many distinct (prompt-len, max-new-tokens) pairs are served
+        self._trace_count = 0
+        self._admit_progs = {}        # bucket -> jitted admit program
+        self._decode_prog = None
+        self.ticks = 0
+        self.admitted = 0
+        self.completed = 0
+
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="cb-engine")
+        self._thread.start()
+
+    # -- public API ------------------------------------------------------
+    def submit(self, input_ids, max_new_tokens: int = 32,
+               eos_token_id: Optional[int] = None,
+               seed: int = 0) -> Future:
+        """Queue one request; returns a Future resolving to an int64
+        [prompt_len + max_new_tokens] array, eos-padded after finish —
+        the same shape/padding contract as one row of generate()."""
+        _resil.maybe_inject("serve_backend")   # dead-backend fault site
+        prompt = np.asarray(input_ids).astype(np.int64).reshape(-1)
+        P = prompt.shape[0]
+        if P < 1:
+            raise ValueError("empty prompt")
+        if P > self.prefill_buckets[-1]:
+            raise ValueError(
+                f"prompt length {P} exceeds the largest prefill bucket "
+                f"{self.prefill_buckets[-1]}")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        # worst-case decode overshoot is one tick past the budget (a
+        # row is only retired at a tick boundary)
+        if P + max_new_tokens + self.tick_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({P}) + max_new_tokens ({max_new_tokens}) + "
+                f"tick overshoot ({self.tick_tokens}) exceeds the "
+                f"engine cache length {self.max_len}")
+        req = _Request(prompt, int(max_new_tokens),
+                       None if eos_token_id is None else int(eos_token_id),
+                       int(seed))
+        with self._cv:
+            if self._broken is not None:
+                raise RuntimeError("engine is broken") from self._broken
+            if self._stop_flag:
+                # after stop() no thread will ever drain the queue — a
+                # silently-enqueued request would hang its caller forever
+                raise RuntimeError("engine stopped")
+            if len(self._queue) >= self.max_queue:
+                raise EngineOverloaded(len(self._queue), self.max_queue)
+            self._queue.append(req)
+            self._cv.notify()
+        return req.future
+
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 eos_token_id: Optional[int] = None, seed: int = 0,
+                 timeout: Optional[float] = None) -> np.ndarray:
+        """Blocking convenience wrapper over submit()."""
+        return self.submit(input_ids, max_new_tokens, eos_token_id,
+                           seed).result(timeout)
+
+    def stats(self) -> dict:
+        with self._cv:
+            active = sum(1 for s in self._slots if not s.free)
+            queued = len(self._queue)
+        return {"slots": self.slots, "active": active,
+                "free": self.slots - active, "queued": queued,
+                "max_queue": self.max_queue, "ticks": self.ticks,
+                "admitted": self.admitted, "completed": self.completed,
+                "compiled_programs": self.compiled_program_count,
+                "tick_tokens": self.tick_tokens,
+                "prefill_buckets": list(self.prefill_buckets),
+                "max_len": self.max_len,
+                "cache_dtype": self.cache_dtype}
+
+    @property
+    def compiled_program_count(self) -> int:
+        """How many times XLA traced an engine program — constant after
+        warmup is the no-recompile serving guarantee."""
+        return self._trace_count
+
+    def stop(self):
+        with self._cv:
+            self._stop_flag = True
+            self._cv.notify()
+        self._thread.join(timeout=30)
+        self._fail_all(RuntimeError("engine stopped"))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.stop()
+        return False
+
+    # -- compiled programs ----------------------------------------------
+    def _bucket_for(self, P: int) -> int:
+        for b in self.prefill_buckets:
+            if P <= b:
+                return b
+        raise ValueError(f"prompt length {P} exceeds largest bucket")
+
+    def _get_admit_prog(self, bucket: int):
+        prog = self._admit_progs.get(bucket)
+        if prog is not None:
+            return prog
+        model, engine = self.model, self
+        do_sample, temperature, top_k, top_p = self._sampling
+
+        def admit(params, buffers, ids, last_idx, key, caches, slot):
+            engine._trace_count += 1      # fires at trace time only
+            # fresh zeroed cache built INSIDE the program: inserting its
+            # full row range below is what resets a retired slot's stale
+            # rows (incl. int8 scales) before re-admission
+            temp = model.new_cache(1, engine.max_len, engine.cache_dtype)
+            (logits, temp), _ = functional_call(
+                model, params, buffers, ids, temp, jnp.int32(0),
+                training=False)
+            last = lax.dynamic_index_in_dim(logits, last_idx, axis=1,
+                                            keepdims=False)   # [1, V]
+            tok0 = _select_token(last, key, do_sample, temperature,
+                                 top_k, top_p)
+
+            def insert(slot_leaf, temp_leaf):
+                # batch axis = the one where the N-slot leaf and the
+                # batch-1 temp leaf disagree (works for unrolled
+                # [B, L, ...] and scanned [layers, B, L, ...] layouts)
+                ax = next(i for i, (a, c) in enumerate(
+                    zip(slot_leaf.shape, temp_leaf.shape)) if a != c)
+                start = [0] * slot_leaf.ndim
+                start[ax] = slot
+                return lax.dynamic_update_slice(
+                    slot_leaf, temp_leaf.astype(slot_leaf.dtype),
+                    tuple(start))
+
+            caches = jax.tree_util.tree_map(insert, caches, temp)
+            return tok0[0].astype(jnp.int32), caches
+
+        prog = jax.jit(admit, donate_argnums=(5,))
+        self._admit_progs[bucket] = prog
+        return prog
+
+    def _get_decode_prog(self):
+        if self._decode_prog is not None:
+            return self._decode_prog
+        model, engine = self.model, self
+        do_sample, temperature, top_k, top_p = self._sampling
+        T = self.tick_tokens
+
+        def decode_tick(params, buffers, caches, tok, pos, live,
+                        eos_ids, keys):
+            engine._trace_count += 1      # fires at trace time only
+
+            def body(carry, _):
+                tok, caches, pos, live = carry
+                (logits, caches), _ = functional_call(
+                    model, params, buffers, tok[:, None], caches, pos,
+                    training=False)
+                last = logits[:, -1, :]
+                if do_sample:
+                    subs = jax.vmap(jax.random.fold_in)(keys, pos)
+                    nxt = jax.vmap(
+                        lambda lg, k: _select_token(
+                            lg[None], k, True, temperature, top_k,
+                            top_p)[0])(last, subs)
+                else:
+                    nxt = jnp.argmax(last, axis=-1)
+                nxt = jnp.where(live, nxt.astype(jnp.int32),
+                                jnp.int32(0))
+                new_live = live & (nxt != eos_ids)
+                pos = pos + live.astype(jnp.int32)
+                tok = jnp.where(live, nxt, tok)
+                return (tok, caches, pos, new_live), nxt
+
+            (tok, caches, pos, live), toks = lax.scan(
+                body, (tok, caches, pos, live), None, length=T)
+            return toks.T, caches    # toks: [N, T]
+
+        self._decode_prog = jax.jit(decode_tick, donate_argnums=(2,))
+        return self._decode_prog
+
+    # -- engine loop -----------------------------------------------------
+    def _loop(self):
+        while True:
+            with self._cv:
+                while (not self._stop_flag and not self._queue
+                       and all(s.free for s in self._slots)):
+                    self._cv.wait(timeout=1.0)
+                if self._stop_flag:
+                    return
+            try:
+                self._admit_ready()
+                if any(not s.free for s in self._slots):
+                    self._tick_decode()
+            except BaseException as e:   # noqa: BLE001 — fail loudly
+                with self._cv:
+                    self._broken = e
+                self._fail_all(e)
+                return
+
+    def _fail_all(self, exc: BaseException):
+        with self._cv:
+            pending = list(self._queue)
+            self._queue.clear()
+            actives = [s for s in self._slots if not s.free]
+            for s in actives:
+                req, s.req = s.req, None
+                s.alive = False
+                pending.append(req)
+        for req in pending:
+            if req is not None and not req.future.done():
+                req.future.set_exception(exc)
+
+    def _admit_ready(self):
+        while True:
+            with self._cv:
+                slot_idx = next((i for i, s in enumerate(self._slots)
+                                 if s.free), None)
+                if slot_idx is None or not self._queue:
+                    return
+                req = self._queue.pop(0)
+            self._admit(req, slot_idx)
+
+    def _admit(self, req: _Request, b: int):
+        P = req.prompt.shape[0]
+        bucket = self._bucket_for(P)
+        ids = np.zeros((1, bucket), np.int64)
+        ids[0, :P] = req.prompt
+        key = np.asarray(jax.random.PRNGKey(req.seed), np.uint32)
+        prog = self._get_admit_prog(bucket)
+        tok0_dev, self._caches = prog(
+            self._params, self._buffers, ids, np.int32(P - 1), key,
+            self._caches, np.int32(b))
+        tok0 = int(tok0_dev)
+        slot = self._slots[b]
+        slot.req = req
+        slot.pos = P
+        slot.tok = tok0
+        slot.key = key
+        slot.emitted = [tok0]
+        slot.remaining = req.max_new_tokens - 1
+        slot.alive = (req.eos_token_id is None
+                      or tok0 != req.eos_token_id)
+        self.admitted += 1
+        if slot.remaining <= 0 or not slot.alive:
+            self._retire(b)
+
+    def _tick_decode(self):
+        N = self.slots
+        tok = np.zeros(N, np.int32)
+        pos = np.zeros(N, np.int32)
+        live = np.zeros(N, bool)
+        eos = np.full(N, -1, np.int32)
+        keys = np.zeros((N, 2), np.uint32)
+        for i, s in enumerate(self._slots):
+            if s.free:
+                continue
+            tok[i] = s.tok
+            pos[i] = s.pos
+            live[i] = s.alive and s.remaining > 0
+            if s.req.eos_token_id is not None:
+                eos[i] = s.req.eos_token_id
+            keys[i] = s.key
+        prog = self._get_decode_prog()
+        toks_dev, self._caches = prog(self._params, self._buffers,
+                                      self._caches, tok, pos, live, eos,
+                                      keys)
+        toks = np.asarray(toks_dev)       # the ONE host sync per tick
+        self.ticks += 1
+        for i, s in enumerate(self._slots):
+            if s.free or not live[i]:
+                continue
+            n = 0
+            for t in range(self.tick_tokens):
+                if s.remaining <= 0 or not s.alive:
+                    break
+                token = int(toks[i, t])
+                s.emitted.append(token)
+                s.remaining -= 1
+                n += 1
+                if (s.req.eos_token_id is not None
+                        and token == s.req.eos_token_id):
+                    s.alive = False
+            # host mirror of the in-program advance: continuing rows
+            # consumed exactly tick_tokens live steps; retired rows'
+            # in-program overshoot is irrelevant (slot is reset at the
+            # next admission)
+            s.pos += n
+            s.tok = s.emitted[-1]
+            if s.remaining <= 0 or not s.alive:
+                self._retire(i)
+
+    def _retire(self, b: int):
+        slot = self._slots[b]
+        req, slot.req = slot.req, None
+        slot.alive = False
+        out = list(slot.emitted)
+        if len(out) < req.max_new_tokens:
+            # finished early on eos: pad with eos — generate()'s contract
+            out += [req.eos_token_id] * (req.max_new_tokens - len(out))
+        result = np.concatenate(
+            [req.prompt, np.asarray(out, np.int64)])
+        self.completed += 1
+        if not req.future.done():
+            req.future.set_result(result)
+
+
+# ---------------------------------------------------------------------------
+# Config -> create_predictor surface (inference/predictor.py delegates
+# here when Config.enable_continuous_batching was called)
+# ---------------------------------------------------------------------------
+
+class GenerationPredictor:
+    """Predictor-shaped facade over a ContinuousBatchingEngine so
+    serving code written against the Config -> create_predictor surface
+    (reference: multi-stream AnalysisPredictor usage) drives the engine
+    unchanged: one named int64 input, one named tokens output."""
+
+    def __init__(self, engine: ContinuousBatchingEngine):
+        self.engine = engine
+
+    def generate(self, input_ids, max_new_tokens: int = 32, **kw):
+        return self.engine.generate(input_ids, max_new_tokens, **kw)
+
+    def get_input_names(self):
+        return ["input_ids"]
+
+    def get_output_names(self):
+        return ["tokens"]
+
+    def close(self):
+        self.engine.stop()
+
+
+def create_engine_predictor(config) -> GenerationPredictor:
+    opts = dict(config._engine_opts)
+    model = opts.pop("model", None)
+    if model is None:
+        raise ValueError(
+            "Config.enable_continuous_batching needs a live model: the "
+            "generation loop (cache-threaded forward + new_cache) cannot "
+            "be reconstructed from an exported StableHLO program — pass "
+            "enable_continuous_batching(model=the_causal_lm)")
+    return GenerationPredictor(ContinuousBatchingEngine(model, **opts))

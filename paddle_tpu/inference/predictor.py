@@ -41,6 +41,28 @@ class Config:
         self._glog_info = True
         self._int8 = False
         self._flags: Dict[str, object] = {}
+        self._engine_opts: Optional[Dict[str, object]] = None
+
+    # -- continuous-batching serving engine ------------------------------
+    def enable_continuous_batching(self, model=None, slots=None,
+                                   max_len=None, cache_dtype="bfloat16",
+                                   prefill_buckets=None, tick_tokens=None,
+                                   max_queue=None, do_sample=False,
+                                   temperature=1.0, top_k=0, top_p=1.0):
+        """Serve generate() traffic through the continuous-batching
+        engine (inference/engine.py): create_predictor() then returns a
+        GenerationPredictor multiplexing concurrent requests over a
+        fixed slot pool with one compiled decode program. `model` must
+        be the live causal-LM Layer (the decode loop cannot be rebuilt
+        from an exported StableHLO program)."""
+        self._engine_opts = {
+            "model": model, "slots": slots, "max_len": max_len,
+            "cache_dtype": cache_dtype,
+            "prefill_buckets": prefill_buckets,
+            "tick_tokens": tick_tokens, "max_queue": max_queue,
+            "do_sample": do_sample, "temperature": temperature,
+            "top_k": top_k, "top_p": top_p,
+        }
 
     # -- model location (reference: SetModel/SetProgFile/SetParamsFile) --
     def set_model(self, prog_file: str, params_file: Optional[str] = None):
@@ -269,8 +291,13 @@ class Predictor:
         self._outputs.clear()
 
 
-def create_predictor(config: Config) -> Predictor:
-    """Parity: paddle_infer.create_predictor."""
+def create_predictor(config: Config):
+    """Parity: paddle_infer.create_predictor. With
+    Config.enable_continuous_batching this returns the engine-backed
+    GenerationPredictor instead of a StableHLO Predictor."""
+    if getattr(config, "_engine_opts", None):
+        from .engine import create_engine_predictor
+        return create_engine_predictor(config)
     return Predictor(config)
 
 

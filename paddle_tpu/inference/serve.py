@@ -11,10 +11,17 @@ Endpoints:
   GET  /health    -> {"status": "ok"} (liveness — the process answers)
   GET  /healthz   -> readiness: 200 once the predictor can serve, 503
                      with a reason while degraded (failure streak,
-                     saturated queue)
+                     saturated queue); with an engine attached the body
+                     carries slot occupancy + queue depth
   GET  /metadata  -> input/output names (+ dtypes/shapes once known)
   POST /predict   -> {"inputs": {name: nested-list | {"data": ...,
                       "dtype": "float32"}}} -> {"outputs": {name: ...}}
+  POST /generate  -> {"input_ids": [...], "max_new_tokens": n,
+                      "eos_token_id": opt, "seed": opt} -> {"tokens":
+                      [...]} — served by the continuous-batching engine
+                      (inference/engine.py): requests from concurrent
+                      clients multiplex through ONE compiled batched
+                      decode program, each resolved by its own future
 
 Graceful degradation (resilience subsystem, distributed/resilience.py):
 every /predict carries a deadline (PADDLE_TPU_SERVE_DEADLINE, default
@@ -59,13 +66,33 @@ class PredictorServer:
     XLA program itself, which is where the time goes.
     """
 
-    def __init__(self, model_path_or_config, host: str = "127.0.0.1",
+    def __init__(self, model_path_or_config=None, host: str = "127.0.0.1",
                  port: int = 8866, deadline_s: float = None,
-                 max_queue: int = None):
-        cfg = (model_path_or_config
-               if isinstance(model_path_or_config, Config)
-               else Config(model_path_or_config))
-        self.predictor = create_predictor(cfg)
+                 max_queue: int = None, engine=None):
+        if model_path_or_config is None and engine is None:
+            raise ValueError(
+                "need a model path/Config (predict path), an engine "
+                "(generate path), or both")
+        self.engine = engine             # ContinuousBatchingEngine|None
+        self._owned_predictor = None     # engine whose lifecycle is OURS
+        if model_path_or_config is not None:
+            cfg = (model_path_or_config
+                   if isinstance(model_path_or_config, Config)
+                   else Config(model_path_or_config))
+            self.predictor = create_predictor(cfg)
+            from .engine import GenerationPredictor
+            if isinstance(self.predictor, GenerationPredictor):
+                # a Config with enable_continuous_batching() serves the
+                # GENERATE path: wire its engine in, there is no tensor
+                # predictor behind /predict. We created this engine, so
+                # stop() must also shut it down (an explicitly-passed
+                # `engine=` stays caller-owned)
+                if self.engine is None:
+                    self.engine = self.predictor.engine
+                    self._owned_predictor = self.predictor
+                self.predictor = None
+        else:
+            self.predictor = None
         self._lock = threading.Lock()
         self.deadline_s = (deadline_s if deadline_s is not None
                            else _env_float("PADDLE_TPU_SERVE_DEADLINE",
@@ -90,18 +117,32 @@ class PredictorServer:
 
     # ------------------------------------------------------------------
     def _metadata(self):
-        return {"inputs": self.predictor.get_input_names(),
-                "outputs": self.predictor.get_output_names()}
+        if self.predictor is not None:
+            return {"inputs": self.predictor.get_input_names(),
+                    "outputs": self.predictor.get_output_names()}
+        return {"inputs": ["input_ids"], "outputs": ["tokens"]}
 
     def _readiness(self):
         """(ready, body) for /healthz. Degraded conditions are reported
-        with a reason so an orchestrator can tell shed-load from dead."""
+        with a reason so an orchestrator can tell shed-load from dead.
+        With an engine attached the body carries slot occupancy and
+        generate-queue depth so an autoscaler can see saturation."""
         body = {"status": "ready",
                 "uptime_s": round(time.monotonic() - self._started, 1),
                 "queue_depth": self._depth,
                 "max_queue": self.max_queue,
                 "failure_streak": self._failure_streak}
-        if self.predictor is None:
+        if self.engine is not None:
+            st = self.engine.stats()
+            body["engine"] = {k: st[k] for k in
+                              ("slots", "active", "free", "queued",
+                               "max_queue", "ticks",
+                               "compiled_programs")}
+            if st["queued"] >= st["max_queue"]:
+                body.update(status="unready",
+                            reason="engine request queue saturated")
+                return False, body
+        if self.predictor is None and self.engine is None:
             body.update(status="unready", reason="no predictor loaded")
             return False, body
         if self._failure_streak >= 3:
@@ -119,6 +160,10 @@ class PredictorServer:
         # deadline trips) and an unavailable one (raises; mapped to 503)
         _resil.maybe_inject("serve_hang")
         _resil.maybe_inject("serve_backend")
+        if self.predictor is None:
+            raise ValueError(
+                "no predictor loaded (this server only has a generation "
+                "engine — POST /generate)")
         inputs = payload.get("inputs")
         if not isinstance(inputs, dict):
             raise ValueError('body must be {"inputs": {name: tensor}}')
@@ -178,8 +223,19 @@ class PredictorServer:
                     self._send(404, {"error": f"no route {self.path}"})
 
             def do_POST(self):
+                if self.path == "/generate":
+                    self._do_generate()
+                    return
                 if self.path != "/predict":
                     self._send(404, {"error": f"no route {self.path}"})
+                    return
+                if server.predictor is None:
+                    # mirror of /generate on an engine-less server: the
+                    # route does not exist HERE (404), it is not the
+                    # client's request that is malformed (400)
+                    self._send(404, {"error": "no predictor loaded "
+                                              "(engine-only server — "
+                                              "POST /generate)"})
                     return
                 # load shedding BEFORE reading the body into the queue:
                 # a saturated predict worker means every queued request
@@ -244,6 +300,65 @@ class PredictorServer:
                     if not submitted:
                         release()
 
+            def _do_generate(self):
+                """Generate through the continuous-batching engine.
+                Load shedding is the ENGINE's queue cap (its tick loop
+                is the one worker); each request parks on its own
+                future until its slot retires it."""
+                if server.engine is None:
+                    self._send(404, {"error": "no generation engine "
+                                              "attached to this server"})
+                    return
+                from .engine import EngineOverloaded
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    payload = json.loads(self.rfile.read(n) or b"{}")
+                    ids = payload["input_ids"]
+                    fut = server.engine.submit(
+                        ids,
+                        int(payload.get("max_new_tokens", 32)),
+                        payload.get("eos_token_id"),
+                        int(payload.get("seed", 0)))
+                except EngineOverloaded as e:
+                    # identical record shape to the predictor path's
+                    # load shedding — orchestrators see ONE contract
+                    self._send(503, {"error": "overloaded",
+                                     "queue_depth": e.queue_depth})
+                    return
+                except (_resil.FaultInjected, ConnectionError) as e:
+                    server._failure_streak += 1
+                    self._send(503, {"error":
+                                     f"backend_unavailable: {e}"})
+                    return
+                except (ValueError, KeyError, TypeError) as e:
+                    self._send(400, {"error": str(e)})
+                    return
+                except Exception as e:   # noqa: BLE001 — broken engine
+                    # e.g. submit() on a broken/stopped engine raises
+                    # RuntimeError; the client still gets its 503, not
+                    # a dropped socket
+                    server._failure_streak += 1
+                    self._send(503, {"error":
+                                     f"backend_unavailable: {e}"})
+                    return
+                try:
+                    out = fut.result(timeout=server.deadline_s)
+                except FutureTimeout:
+                    server._failure_streak += 1
+                    self._send(503, {"error": "deadline_exceeded",
+                                     "deadline_s": server.deadline_s})
+                    return
+                except Exception as e:   # noqa: BLE001 — engine fault
+                    server._failure_streak += 1
+                    self._send(503, {"error":
+                                     f"backend_unavailable: {e}"})
+                    return
+                server._failure_streak = 0
+                prompt_len = len(np.asarray(ids).reshape(-1))
+                self._send(200, {"tokens": out.tolist(),
+                                 "prompt_len": prompt_len,
+                                 "new_tokens": len(out) - prompt_len})
+
         return Handler
 
     # ------------------------------------------------------------------
@@ -264,6 +379,13 @@ class PredictorServer:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+        if self._owned_predictor is not None:
+            # engine built from OUR Config: stop its tick thread and
+            # release the slot cache (an explicitly-passed engine is
+            # the caller's to stop)
+            self._owned_predictor.close()
+            self._owned_predictor = None
+            self.engine = None
 
 
 def main(argv=None):

@@ -19,6 +19,8 @@ LlamaForCausalLM both do; `model.generate(...)` delegates here.
 """
 from __future__ import annotations
 
+import os
+import threading
 from typing import Optional
 
 import jax
@@ -30,6 +32,45 @@ from ..core.tensor import Tensor
 from ..jit.functional import functional_call, raw_state
 
 __all__ = ["generate", "new_kv_caches"]
+
+
+def _prog_cache_size() -> int:
+    """Bounded-LRU size for the per-model compiled-program cache. A
+    long-lived server with drifting prompt lengths must not pin
+    executables forever; bucket prompt lengths server-side (the
+    continuous-batching engine does) to hit this cache reliably."""
+    try:
+        return max(1, int(os.environ.get("PADDLE_TPU_GEN_PROG_CACHE",
+                                         16)))
+    except ValueError:
+        return 16
+
+
+def _prog_cache_for(model):
+    """(OrderedDict, Lock) compiled-program LRU attached to `model`.
+
+    The lock matters: server threads call generate() concurrently, and
+    OrderedDict get/move_to_end/popitem are NOT safe under concurrent
+    mutation (observed: KeyError out of move_to_end racing popitem).
+    Creation is double-checked so two first-callers agree on one dict.
+    """
+    cache = getattr(model, "_gen_prog_cache", None)
+    lock = getattr(model, "_gen_prog_lock", None)
+    if cache is None or lock is None:
+        with _PROG_CACHE_INIT_LOCK:
+            cache = getattr(model, "_gen_prog_cache", None)
+            lock = getattr(model, "_gen_prog_lock", None)
+            if cache is None:
+                import collections
+                cache = collections.OrderedDict()
+                object.__setattr__(model, "_gen_prog_cache", cache)
+            if lock is None:
+                lock = threading.Lock()
+                object.__setattr__(model, "_gen_prog_lock", lock)
+    return cache, lock
+
+
+_PROG_CACHE_INIT_LOCK = threading.Lock()
 
 
 def new_kv_caches(num_layers, batch, max_len, kv_heads, head_dim, dtype,
@@ -111,11 +152,7 @@ def generate(model, input_ids, max_new_tokens: int = 32,
         # generate() call would re-trace and re-compile every request
         # (measured: ~1.5 s per call at GPT-tiny scale, dwarfing the
         # actual decode), which is fatal for the serving path.
-        prog_cache = getattr(model, "_gen_prog_cache", None)
-        if prog_cache is None:
-            import collections
-            prog_cache = collections.OrderedDict()
-            object.__setattr__(model, "_gen_prog_cache", prog_cache)
+        prog_cache, prog_lock = _prog_cache_for(model)
         # greedy ignores the sampling knobs — don't let them split the key
         sampling = ((float(temperature), int(top_k), float(top_p))
                     if do_sample else None)
@@ -124,9 +161,10 @@ def generate(model, input_ids, max_new_tokens: int = 32,
         prog_key = (B, P, total, str(cache_dtype), sampling,
                     None if eos_token_id is None else int(eos_token_id))
         eos = eos_token_id
-        progs = prog_cache.get(prog_key)
-        if progs is not None:
-            prog_cache.move_to_end(prog_key)
+        with prog_lock:
+            progs = prog_cache.get(prog_key)
+            if progs is not None:
+                prog_cache.move_to_end(prog_key)
         if progs is None:
             def prefill(params, buffers, ids, caches, key):
                 (logits, caches), _ = functional_call(
@@ -168,12 +206,18 @@ def generate(model, input_ids, max_new_tokens: int = 32,
 
             progs = (jax.jit(prefill, donate_argnums=(3,)),
                      jax.jit(decode_all, donate_argnums=(3,)))
-            prog_cache[prog_key] = progs
-            # bounded LRU: a long-lived server with drifting prompt
-            # lengths must not pin executables forever (bucket prompt
-            # lengths server-side to hit this cache reliably)
-            while len(prog_cache) > 16:
-                prog_cache.popitem(last=False)
+            # jit wrapper creation is cheap (compilation happens at the
+            # first call, outside the lock); insertion races resolve in
+            # favor of the first writer so every thread runs ONE program
+            with prog_lock:
+                existing = prog_cache.get(prog_key)
+                if existing is not None:
+                    progs = existing
+                    prog_cache.move_to_end(prog_key)
+                else:
+                    prog_cache[prog_key] = progs
+                    while len(prog_cache) > _prog_cache_size():
+                        prog_cache.popitem(last=False)
         prefill_c, decode_c = progs
 
         key = jax.random.PRNGKey(seed)

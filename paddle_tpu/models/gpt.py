@@ -229,7 +229,14 @@ class GPTEmbeddings(Layer):
                 f"sequence length {S} exceeds max_seq_len {max_len}")
         positions = T.arange(0, S, dtype="int64")
         if pos is not None:                     # decode offset
-            positions = positions + T.cast(pos, "int64")
+            p = T.cast(pos, "int64")
+            if len(tuple(p.shape)) == 1:
+                # per-row offsets [B] (continuous-batching slots, each
+                # at its own decode position) -> positions [B, S]
+                positions = (T.reshape(positions, [1, S])
+                             + T.reshape(p, [-1, 1]))
+            else:
+                positions = positions + p
         x = self.word_embeddings(ids) + self.position_embeddings(positions)
         return self.dropout(x)
 

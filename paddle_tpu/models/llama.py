@@ -89,7 +89,8 @@ from .gpt import _sp_active, cached_attention
 
 def _rope(q, k, theta: float, offset=None):
     """Apply rotary position embedding to q/k ([B, S, H, D]); `offset`
-    shifts the absolute positions (decode with KV cache)."""
+    shifts the absolute positions (decode with KV cache) — a scalar, or
+    a [B] vector of per-row offsets (continuous-batching slots)."""
     def f(qv, kv, *off):
         D = qv.shape[-1]
         S = qv.shape[1]
@@ -97,10 +98,18 @@ def _rope(q, k, theta: float, offset=None):
         freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
         pos = jnp.arange(S, dtype=jnp.float32)
         if off:
-            pos = pos + jnp.asarray(off[0], jnp.float32)
-        ang = pos[:, None] * freqs[None, :]
-        cos = jnp.cos(ang)[None, :, None, :]   # [1, S, 1, half]
-        sin = jnp.sin(ang)[None, :, None, :]
+            o = jnp.asarray(off[0], jnp.float32)
+            if o.ndim == 1:                     # per-row -> [B, S]
+                pos = pos[None, :] + o[:, None]
+            else:
+                pos = pos + o
+        ang = pos[..., None] * freqs            # [S, half] or [B, S, half]
+        if ang.ndim == 2:
+            cos = jnp.cos(ang)[None, :, None, :]   # [1, S, 1, half]
+            sin = jnp.sin(ang)[None, :, None, :]
+        else:
+            cos = jnp.cos(ang)[:, :, None, :]      # [B, S, 1, half]
+            sin = jnp.sin(ang)[:, :, None, :]
 
         def rot(x):
             # interleaved-pairs convention: (x0, x1) -> (x0 c - x1 s,

@@ -261,15 +261,51 @@ def _quant_rows(x):
 
 
 def _cache_write(cache, rows, pos):
-    """Write [B, S, nkv, hd] rows into a cache at [pos, pos+S)."""
+    """Write [B, S, nkv, hd] rows into a cache at [pos, pos+S).
+
+    ``pos`` may be a scalar (every batch row writes at the same offset —
+    the single-stream generate() path) or a [B] vector of PER-ROW
+    offsets (the continuous-batching engine: each slot is at its own
+    decode position, so row b writes at pos[b]).
+    """
+    per_row = getattr(pos, "ndim", 0) == 1
+    if per_row and rows.shape[1] == 1:
+        # decode hot path (S=1): one-hot masked write — a dense select
+        # over the cache instead of a scatter (measured 2.5x faster on
+        # CPU, and the standard TPU idiom: no scatter lowering)
+        L = (cache["data"] if isinstance(cache, dict) else cache).shape[1]
+        hit = jnp.arange(L)[None, :] == pos[:, None]        # [B, L]
+        if isinstance(cache, dict):
+            qrows, scale = _quant_rows(rows)
+            return {
+                "data": jnp.where(hit[:, :, None, None], qrows,
+                                  cache["data"]),
+                "scale": jnp.where(hit[:, :, None], scale,
+                                   cache["scale"]),
+            }
+        return jnp.where(hit[:, :, None, None], rows.astype(cache.dtype),
+                         cache)
     if isinstance(cache, dict):  # int8 + scales
         qrows, scale = _quant_rows(rows)
+        if per_row:
+            return {
+                "data": jax.vmap(
+                    lambda c, r, p: lax.dynamic_update_slice(
+                        c, r, (p, 0, 0)))(cache["data"], qrows, pos),
+                "scale": jax.vmap(
+                    lambda c, r, p: lax.dynamic_update_slice(
+                        c, r, (p, 0)))(cache["scale"], scale, pos),
+            }
         return {
             "data": lax.dynamic_update_slice(cache["data"], qrows,
                                              (0, pos, 0, 0)),
             "scale": lax.dynamic_update_slice(cache["scale"], scale,
                                               (0, pos, 0)),
         }
+    if per_row:
+        return jax.vmap(
+            lambda c, r, p: lax.dynamic_update_slice(
+                c, r.astype(c.dtype), (p, 0, 0)))(cache, rows, pos)
     return lax.dynamic_update_slice(cache, rows.astype(cache.dtype),
                                     (0, pos, 0, 0))
 
@@ -296,7 +332,9 @@ def cached_attention(q, k, v, k_cache, v_cache, pos):
     q/k/v: [B, S, nh|nkv, hd]; caches: [B, L, nkv, hd] arrays, or the
     int8 dict form from quantized_kv_cache (write path quantizes each
     new row dynamically; read path dequantizes — ~0.4% relative logit
-    noise at N(0,1) scale for half/quarter the cache HBM); pos: scalar.
+    noise at N(0,1) scale for half/quarter the cache HBM); pos: scalar,
+    or a [B] vector of per-row positions (continuous-batching decode:
+    every slot sits at its own offset in its cache rows).
     Returns (ctx [B, S, nh, hd], k_cache', v_cache').
     """
     def f(q, k, v, kc, vc, pos):
@@ -312,9 +350,15 @@ def cached_attention(q, k, v, k_cache, v_cache, pos):
         logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                             ka.astype(jnp.float32)) / jnp.sqrt(
                                 jnp.float32(hd))
-        mask = (jnp.arange(L)[None, :]
-                <= pos + jnp.arange(S)[:, None])        # [S, L]
-        logits = jnp.where(mask[None, None], logits, -1e30)
+        if pos.ndim == 1:       # per-row positions -> [B, S, L] mask
+            mask = (jnp.arange(L)[None, None, :]
+                    <= pos[:, None, None]
+                    + jnp.arange(S)[None, :, None])
+            logits = jnp.where(mask[:, None], logits, -1e30)
+        else:
+            mask = (jnp.arange(L)[None, :]
+                    <= pos + jnp.arange(S)[:, None])    # [S, L]
+            logits = jnp.where(mask[None, None], logits, -1e30)
         probs = jax.nn.softmax(logits, axis=-1)
         # PV runs at the cache dtype (bf16 caches keep the bf16 MXU
         # path; dequantized int8 runs f32), output at the query dtype

@@ -121,6 +121,11 @@ def pytest_configure(config):
     env.pop("PALLAS_AXON_POOL_IPS", None)
     # Exact fp32 matmuls for numeric checks (prod keeps fast MXU default).
     env.setdefault("JAX_DEFAULT_MATMUL_PRECISION", "highest")
+    # Deliberately NO persistent XLA compile cache here: reloading a
+    # cached MULTI-DEVICE CPU program segfaulted the ZeRO-3 resume test
+    # (measured 2026-08-03 — exactly the cpu_aot_loader hazard
+    # paddle_tpu/__init__.py documents). tools/ci.py opts in for its
+    # own runs; the raw pytest path stays cache-free and crash-free.
     capman = config.pluginmanager.getplugin("capturemanager")
     if capman is not None:
         capman.stop_global_capturing()

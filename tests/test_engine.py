@@ -1,0 +1,257 @@
+"""Continuous-batching serving engine tests (inference/engine.py).
+
+Key invariants:
+- greedy outputs are TOKEN-IDENTICAL to sequential generate() per
+  request, across staggered arrivals and mixed lengths (the bucketed
+  right-padded prefill and the batched vector-pos decode are pure
+  multiplexing, never a numerics change);
+- a retired slot's cache rows — including the int8 quantized-cache
+  scales — are reset before re-admission;
+- the compiled-program count stays constant after warmup no matter how
+  many distinct (prompt-len, max-new-tokens) pairs are served;
+- the serving layer keeps the PR-1 degradation contract through the
+  engine path: 503 `overloaded` on queue saturation, 503
+  `backend_unavailable` on the injected dead backend.
+"""
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.engine import (ContinuousBatchingEngine,
+                                         EngineOverloaded)
+from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(7)
+    m = GPTForCausalLM(gpt_tiny())
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def engine(model):
+    eng = ContinuousBatchingEngine(
+        model, slots=4, max_len=64, cache_dtype="float32",
+        prefill_buckets=(8, 16), tick_tokens=4)
+    yield eng
+    eng.stop()
+
+
+def _prompt(seed, n):
+    return np.random.RandomState(seed).randint(
+        0, 250, (n,)).astype("int64")
+
+
+def test_greedy_identity_staggered_mixed_lengths(model, engine):
+    """Mixed-length requests submitted at staggered times through 4
+    slots come back token-identical to one-at-a-time generate()."""
+    import time
+    # 8 requests over 4 distinct (P, max_new) pairs: DISTINCT prompts
+    # per request (the identity check is per-request content), but the
+    # sequential reference compiles only 4 program pairs
+    shapes = [(5, 6), (8, 9), (12, 4), (3, 12)] * 2
+    prompts = [_prompt(i, p) for i, (p, _) in enumerate(shapes)]
+    futs = []
+    for (p, n), ids in zip(shapes, prompts):
+        futs.append(engine.submit(ids, max_new_tokens=n))
+        time.sleep(0.01)          # arrivals land across tick boundaries
+    outs = [f.result(timeout=300) for f in futs]
+    for (p, n), ids, got in zip(shapes, prompts, outs):
+        want = model.generate(ids[None], max_new_tokens=n,
+                              cache_dtype="float32")[0]
+        np.testing.assert_array_equal(got, want)
+
+
+def test_greedy_identity_with_eos(model, engine):
+    """EOS retirement + eos padding matches generate()'s contract."""
+    ids = _prompt(0, 6)
+    # eos = whatever greedy emits first, so it fires mid-stream
+    first = model.generate(ids[None], max_new_tokens=1,
+                           cache_dtype="float32")[0, -1]
+    eos = int(first)
+    want = model.generate(ids[None], max_new_tokens=10,
+                          eos_token_id=eos, cache_dtype="float32")[0]
+    got = engine.generate(ids, max_new_tokens=10, eos_token_id=eos,
+                          timeout=300)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_program_count_constant_under_shape_drift(model, engine):
+    """Workloads whose distinct (prompt-len, max-new-tokens) pairs
+    exceed generate()'s program-cache size (16) serve with ZERO
+    recompilation after warmup: the trace counters inside the engine's
+    jitted bodies must not move."""
+    # warmup: every bucket + the decode program
+    for p in (4, 12):
+        engine.generate(_prompt(p, p), max_new_tokens=3, timeout=300)
+    warm = engine.compiled_program_count
+    pairs = [(p, n) for p in range(3, 12) for n in (2, 3)]   # 18 > 16
+    assert len(pairs) > 16
+    futs = [engine.submit(_prompt(i, p), max_new_tokens=n)
+            for i, (p, n) in enumerate(pairs)]
+    for f in futs:
+        f.result(timeout=300)
+    assert engine.compiled_program_count == warm, \
+        "engine recompiled under shape drift"
+    # the sequential path's per-shape LRU was never involved
+    assert engine.ticks > 0 and engine.completed >= len(pairs)
+
+
+def test_slot_reuse_resets_cache_rows_int8(model):
+    """A finished slot's cache rows (data AND int8 quantization scales)
+    are fully reset before re-admission: after a long request retires
+    and a short one reuses the slot, rows past the short request's
+    bucket are zero again."""
+    eng = ContinuousBatchingEngine(
+        model, slots=2, max_len=64, cache_dtype="int8",
+        prefill_buckets=(8, 16), tick_tokens=4)
+    try:
+        # int8 identity vs sequential int8 generate (same quantizer)
+        ids_long = _prompt(1, 12)
+        want = model.generate(ids_long[None], max_new_tokens=8,
+                              cache_dtype="int8")[0]
+        got = eng.generate(ids_long, max_new_tokens=8, timeout=300)
+        np.testing.assert_array_equal(got, want)
+        # the long request dirtied rows well past bucket 8 on some slot;
+        # drain, then admit short requests into EVERY slot
+        shorts = [_prompt(2, 4), _prompt(3, 5)]
+        futs = [eng.submit(s, max_new_tokens=2) for s in shorts]
+        for f in futs:
+            f.result(timeout=300)
+        k_cache, v_cache = eng._caches[0]
+        for cache in (k_cache, v_cache):
+            data = np.asarray(cache["data"])      # [slots, L, nkv, hd]
+            scale = np.asarray(cache["scale"])    # [slots, L, nkv]
+            # rows the short requests never touched (past bucket 8 +
+            # 2 new tokens + tick overshoot) must be zeroed by the
+            # admission-time full-row reset — stale int8 payload OR
+            # scales from the long request may not survive
+            assert (data[:, 16:] == 0).all()
+            assert (scale[:, 16:] == 0).all()
+    finally:
+        eng.stop()
+
+
+def test_submit_validation(engine):
+    with pytest.raises(ValueError):
+        engine.submit(np.zeros((0,), np.int64), max_new_tokens=2)
+    with pytest.raises(ValueError):
+        engine.submit(_prompt(0, 40), max_new_tokens=2)   # > max bucket
+    with pytest.raises(ValueError):
+        engine.submit(_prompt(0, 4), max_new_tokens=0)
+    with pytest.raises(ValueError):
+        # prompt + budget + tick overshoot exceeds cache length
+        engine.submit(_prompt(0, 16), max_new_tokens=60)
+
+
+# ---------------------------------------------------------------------------
+# serving layer: /generate through the engine
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def gen_server(engine):
+    from paddle_tpu.inference.serve import PredictorServer
+    srv = PredictorServer(engine=engine, port=0).start()
+    yield srv
+    srv.stop()
+
+
+def _req(srv, path, payload=None):
+    url = f"http://{srv.host}:{srv.port}{path}"
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(
+        url, data=data,
+        headers={"Content-Type": "application/json"} if data else {})
+    try:
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_generate_route_matches_generate(model, gen_server):
+    srv = gen_server
+    ids = _prompt(5, 9)
+    code, body = _req(srv, "/generate", {"input_ids": ids.tolist(),
+                                         "max_new_tokens": 6})
+    assert code == 200, body
+    want = model.generate(ids[None], max_new_tokens=6,
+                          cache_dtype="float32")[0]
+    assert body["tokens"] == want.tolist()
+    assert body["prompt_len"] == 9 and body["new_tokens"] == 6
+
+
+def test_healthz_reports_slot_occupancy(gen_server):
+    code, body = _req(gen_server, "/healthz")
+    assert code == 200, body
+    eng = body["engine"]
+    assert eng["slots"] == 4
+    assert {"active", "free", "queued", "max_queue",
+            "compiled_programs"} <= set(eng)
+
+
+def test_queue_overflow_returns_503_overloaded(gen_server):
+    """The PR-1 load-shedding record shape survives the engine path."""
+    srv = gen_server
+    eng = srv.engine
+    old = eng.max_queue
+    eng.max_queue = 0
+    try:
+        code, body = _req(srv, "/generate",
+                          {"input_ids": [1, 2, 3],
+                           "max_new_tokens": 4})
+        assert code == 503, body
+        assert body["error"] == "overloaded"
+        assert "queue_depth" in body
+        # direct submit sees the typed exception
+        with pytest.raises(EngineOverloaded):
+            eng.submit([1, 2, 3], max_new_tokens=4)
+    finally:
+        eng.max_queue = old
+
+
+def test_dead_backend_surfaces_through_engine_path(gen_server):
+    from paddle_tpu.distributed.resilience import FaultInjector
+    srv = gen_server
+    with FaultInjector({"serve_backend": 1}):
+        code, body = _req(srv, "/generate",
+                          {"input_ids": [1, 2, 3],
+                           "max_new_tokens": 4})
+    assert code == 503, body
+    assert "backend_unavailable" in body["error"]
+    # engine recovered: the next request serves normally
+    code, body = _req(srv, "/generate",
+                      {"input_ids": [1, 2, 3], "max_new_tokens": 4})
+    assert code == 200, body
+
+
+def test_config_create_predictor_surface(model):
+    """Config.enable_continuous_batching -> create_predictor returns the
+    engine-backed predictor (the reference's multi-stream Predictor
+    usage ports to this surface, MIGRATING.md)."""
+    from paddle_tpu.inference import Config, create_predictor
+    cfg = Config()
+    cfg.enable_continuous_batching(model=model, slots=2, max_len=64,
+                                   cache_dtype="float32",
+                                   prefill_buckets=(8,), tick_tokens=4)
+    pred = create_predictor(cfg)
+    try:
+        assert pred.get_input_names() == ["input_ids"]
+        ids = _prompt(8, 6)
+        got = pred.generate(ids, max_new_tokens=4, timeout=300)
+        want = model.generate(ids[None], max_new_tokens=4,
+                              cache_dtype="float32")[0]
+        np.testing.assert_array_equal(got, want)
+    finally:
+        pred.close()
+
+    cfg2 = Config()
+    cfg2.enable_continuous_batching(model=None)
+    with pytest.raises(ValueError):
+        create_predictor(cfg2)

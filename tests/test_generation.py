@@ -96,6 +96,53 @@ def test_generate_under_mesh_bf16():
         dist.set_mesh(None)
 
 
+def test_gen_prog_cache_thread_safety(monkeypatch):
+    """Regression: the per-model compiled-program LRU is mutated by
+    concurrent server threads (get/move_to_end/popitem). Unlocked
+    OrderedDict mutation corrupts or KeyErrors under this hammer; the
+    lock in models/generation.py must keep every call correct. The
+    cache bound is shrunk below the working set so eviction + reinsert
+    churn concurrently with lookups."""
+    import threading
+
+    paddle.seed(44)
+    model = GPTForCausalLM(gpt_tiny())
+    model.eval()
+    lens = [4, 5, 6, 7]
+    prompts = {p: np.random.RandomState(p).randint(
+        0, 250, (1, p)).astype("int64") for p in lens}
+    # warm every program first (compiles serialize on jax internals and
+    # would hide the race behind compile walls)
+    for p in lens:
+        model.generate(prompts[p], max_new_tokens=1,
+                       cache_dtype="float32")
+    # shrink the LRU below the working set: every miss now evicts and
+    # reinserts while other threads move_to_end — the reported race
+    monkeypatch.setenv("PADDLE_TPU_GEN_PROG_CACHE", "3")
+
+    errors = []
+
+    def hammer(seed):
+        rng = np.random.RandomState(seed)
+        try:
+            for _ in range(12):
+                p = lens[rng.randint(len(lens))]
+                out = model.generate(prompts[p], max_new_tokens=1,
+                                     cache_dtype="float32")
+                assert out.shape == (1, p + 1)
+                assert (out[:, :p] == prompts[p]).all()
+        except Exception as e:   # noqa: BLE001 — surface to main thread
+            errors.append(e)
+
+    threads = [threading.Thread(target=hammer, args=(i,))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+
+
 def test_gqa_cache_shape():
     cfg = llama_tiny()
     model = LlamaForCausalLM(cfg)

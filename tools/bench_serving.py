@@ -13,9 +13,16 @@ tools/ci_model_benchmark.sh):
   2. KV-cache autoregressive decode: ms/token through models.generate
      (greedy, cached_attention path).
 
-Run on TPU:  python tools/bench_serving.py
+Concurrent mode (--concurrent): K closed-loop clients with mixed
+prompt/output lengths hammer the continuous-batching engine
+(inference/engine.py), reported against the sequential generate() loop
+over the identical request set — aggregate tokens/s + p50/p90/p99
+per-request latency + the speedup. Both sides are compile-warmed first
+so the number is steady-state serving, not XLA.
+
+Run on TPU:  python tools/bench_serving.py [--concurrent]
 CPU smoke:   env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
-                 python tools/bench_serving.py --smoke
+                 python tools/bench_serving.py --smoke [--concurrent]
 Prints ONE BENCH-style JSON line.
 """
 import argparse
@@ -23,6 +30,7 @@ import json
 import os
 import sys
 import tempfile
+import threading
 import time
 
 import numpy as np
@@ -108,12 +116,147 @@ def bench_decode(smoke: bool, new_tokens: int,
     return out
 
 
+def bench_concurrent(smoke: bool, clients: int, per_client: int,
+                     cache_dtype: str = "bfloat16"):
+    """Engine vs sequential generate() loop over the SAME mixed-length
+    request stream.
+
+    Closed-loop clients: each thread issues its next request only after
+    the previous one resolved — the steady-state pressure pattern of a
+    fleet of synchronous callers.
+
+    The headline workload DRIFTS: its distinct (prompt-len,
+    max-new-tokens) pairs exceed generate()'s compiled-program LRU
+    (PADDLE_TPU_GEN_PROG_CACHE, 16), the regime of real mixed traffic.
+    Sequential generate() keys one compiled program per exact pair, so
+    the working set thrashes its LRU and re-jits continuously — even a
+    full warm epoch cannot help (the measured epoch is epoch 2). The
+    engine serves the identical stream through a CONSTANT program set
+    (bucketed prefill + one batched decode), asserted via
+    `programs_recompiled_after_warmup`. A secondary bucket-ALIGNED
+    measurement (both paths fully warm, zero re-jit anywhere) isolates
+    pure decode-multiplexing so the record shows where the win comes
+    from on this backend.
+    """
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.engine import ContinuousBatchingEngine
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+
+    paddle.seed(0)
+    model = GPTForCausalLM(gpt_tiny())
+    model.eval()
+    rng = np.random.RandomState(0)
+
+    # drifting mixed stream: >16 distinct (P, max_new) pairs
+    if smoke:
+        p_vals = list(range(4, 24, 2))            # 10 prompt lengths
+        n_vals = [6, 10]
+        max_len, buckets, tick = 64, (8, 16, 32), 8
+    else:
+        p_vals = list(range(4, 32, 2))            # 14 prompt lengths
+        n_vals = [16, 24, 32]
+        max_len, buckets, tick = 80, (8, 16, 32), 8
+    combos = [(p, n) for n in n_vals for p in p_vals]
+    prompts = {p: rng.randint(0, 250, (p,)).astype("int64")
+               for p in {c[0] for c in combos}}
+    reqs = [combos[(c * per_client + i) % len(combos)]
+            for c in range(clients) for i in range(per_client)]
+
+    engine = ContinuousBatchingEngine(
+        model, slots=clients, max_len=max_len, cache_dtype=cache_dtype,
+        prefill_buckets=buckets, tick_tokens=tick,
+        max_queue=max(32, clients * per_client))
+
+    def run_engine(request_list):
+        lat_ms, lock = [], threading.Lock()
+
+        def client(c):
+            for i in range(per_client):
+                P, n = request_list[c * per_client + i]
+                t0 = time.perf_counter()
+                engine.generate(prompts[P], max_new_tokens=n,
+                                timeout=600)
+                dt = (time.perf_counter() - t0) * 1e3
+                with lock:
+                    lat_ms.append(dt)
+
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return time.perf_counter() - t0, lat_ms
+
+    def run_sequential(request_list):
+        t0 = time.perf_counter()
+        for P, n in request_list:
+            model.generate(prompts[P][None], max_new_tokens=n,
+                           cache_dtype=cache_dtype)
+        return time.perf_counter() - t0
+
+    total_new = sum(n for _, n in reqs)
+
+    # -- warm epoch for BOTH paths (engine compiles its constant set;
+    # sequential fills — and already thrashes — its per-pair LRU)
+    run_engine(reqs)
+    progs_after_warmup = engine.compiled_program_count
+    run_sequential(reqs)
+
+    # -- measured epoch 2
+    wall_engine, lat_ms = run_engine(reqs)
+    wall_seq = run_sequential(reqs)
+    engine_tps = total_new / wall_engine
+    seq_tps = total_new / wall_seq
+    p50, p90, p99 = _percentiles(lat_ms)
+    recompiled = engine.compiled_program_count - progs_after_warmup
+
+    # -- secondary: bucket-aligned steady state, everything warm
+    aligned = [(8, 8), (16, 12), (32, 8), (8, 12)] if smoke else \
+        [(8, 24), (16, 32), (32, 16), (8, 32), (16, 16), (32, 24)]
+    a_reqs = [aligned[(c * per_client + i) % len(aligned)]
+              for c in range(clients) for i in range(per_client)]
+    a_total = sum(n for _, n in a_reqs)
+    for p, _ in aligned:
+        prompts.setdefault(p, rng.randint(0, 250, (p,)).astype("int64"))
+    run_engine(a_reqs)                    # warm
+    run_sequential(a_reqs)                # warm
+    a_wall_engine, _ = run_engine(a_reqs)
+    a_wall_seq = run_sequential(a_reqs)
+
+    engine.stop()
+    return {
+        "engine_tokens_per_s": round(engine_tps, 1),
+        "sequential_tokens_per_s": round(seq_tps, 1),
+        "speedup": round(engine_tps / seq_tps, 2),
+        "p50_ms": round(p50, 2), "p90_ms": round(p90, 2),
+        "p99_ms": round(p99, 2),
+        "clients": clients, "requests": len(reqs),
+        "distinct_shape_pairs": len(combos),
+        "new_tokens_total": total_new,
+        "slots": engine.slots, "tick_tokens": engine.tick_tokens,
+        "cache_dtype": cache_dtype,
+        "programs_recompiled_after_warmup": recompiled,
+        "aligned_engine_tokens_per_s": round(a_total / a_wall_engine, 1),
+        "aligned_sequential_tokens_per_s": round(a_total / a_wall_seq, 1),
+        "aligned_speedup": round(a_wall_seq / a_wall_engine, 2),
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true",
                     help="tiny models, few iters (CPU)")
     ap.add_argument("--iters", type=int, default=50)
     ap.add_argument("--tokens", type=int, default=64)
+    ap.add_argument("--concurrent", action="store_true",
+                    help="concurrent-client engine vs sequential "
+                         "generate() throughput comparison")
+    ap.add_argument("--clients", type=int, default=8,
+                    help="closed-loop clients (engine slots follow)")
+    ap.add_argument("--per-client", type=int, default=None,
+                    help="requests per client (default 6; smoke 3)")
     args = ap.parse_args()
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -123,6 +266,25 @@ def main():
     probe_backend()  # cpu is a healthy result; exits 4 if tunnel wedged
     if lock is not None:
         lock.stage("compile+measure")
+
+    if args.concurrent:
+        if args.clients < 2:
+            ap.error("--clients must be >= 2 (engine slots follow the "
+                     "client count and the engine needs >= 2 slots)")
+        per_client = (args.per_client if args.per_client is not None
+                      else (3 if args.smoke else 6))
+        rec = bench_concurrent(args.smoke, args.clients, per_client)
+        import jax
+        rec.update({
+            "metric": "serving_concurrent_throughput",
+            "value": rec["speedup"],
+            "unit": "x_vs_sequential_generate",
+            "device_kind": getattr(jax.devices()[0], "device_kind",
+                                   "cpu"),
+            "smoke": bool(args.smoke),
+        })
+        print(json.dumps(rec))
+        return 0
 
     iters = 8 if args.smoke else args.iters
     tokens = 8 if args.smoke else args.tokens

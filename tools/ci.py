@@ -49,6 +49,9 @@ QUICK_FILES = [
     "tests/test_tpu_lowering.py", "tests/test_single_flight.py",
     "tests/test_suite_mechanics.py", "tests/test_checkpoint_resume_zero3.py",
     "tests/test_quickstart_parity.py",
+    # serving engine: continuous batching is a core-correctness surface
+    # (greedy token-identity + the no-recompile guarantee)
+    "tests/test_engine.py",
 ]
 
 

@@ -23,6 +23,10 @@ log "start"
 run profile_step profile_step.txt python tools/profile_step.py
 run bench_ring bench_ring.json python tools/bench_ring.py
 run bench_serving bench_serving.json python tools/bench_serving.py
+# continuous-batching engine vs sequential generate() loop (PR 2);
+# self-skips once landed like every other step
+run bench_serving_concurrent bench_serving_concurrent.json \
+    python tools/bench_serving.py --concurrent
 run kv_quality kv_quality.json python tools/kv_cache_quality.py
 # 5. 125M A/Bs (re-use the warm compile cache): fused-CE, pure-bf16 opt
 run bench_125m_fused bench_125m_fused.json \
