@@ -1,0 +1,43 @@
+"""tpulint — static analysis over the programs this framework compiles.
+
+The reference stack ships analysis/verification layers over its graph
+IR (the pass framework under paddle/fluid/framework/ir/,
+FLAGS_check_nan_inf, memory-reuse checkers). Our IR is the jaxpr and
+lowered StableHLO of every jitted program; this package is the
+systematic way to inspect it BEFORE it reaches hardware:
+
+- program_lint:  walk a program's ClosedJaxpr + StableHLO — dtype
+  promotions, scatter/gather, host callbacks, un-donated buffers,
+  baked RNG keys, collective inventory.
+- recompile:     statically diff abstract call signatures — which arg
+  dims will force re-tracing (PR 2's recompile storms, decided without
+  compiling anything).
+- codebase_lint: AST pass over the tree — retrace-per-call jit idioms,
+  traced attribute mutation in Layer.forward (the aux_loss.py class of
+  bug), numpy on traced values, stale quarantine entries.
+- manifest:      the real serving/training programs (engine decode,
+  generate prefill, TrainStep, ParallelTrainStep on a fake 4-device
+  mesh) rebuilt and linted; `tools/tpulint.py` gates CI on the diff
+  against tools/tpulint_baseline.json.
+
+CLI: python tools/tpulint.py [--manifest default] [--update-baseline]
+"""
+from .findings import (Finding, Severity, count_findings,
+                       diff_against_baseline, findings_to_json,
+                       load_baseline)
+from .program_lint import collective_inventory_from_hlo, lint_program
+from .recompile import abstract_signature, recompile_report
+from .codebase_lint import (HOT_JIT_FILES, lint_file, lint_quarantine,
+                            lint_tree)
+from .manifest import (MANIFEST_PROGRAMS, ProgramSpec, default_manifest,
+                       run_manifest)
+
+__all__ = [
+    "Finding", "Severity", "count_findings", "diff_against_baseline",
+    "findings_to_json", "load_baseline",
+    "lint_program", "collective_inventory_from_hlo",
+    "abstract_signature", "recompile_report",
+    "lint_tree", "lint_file", "lint_quarantine", "HOT_JIT_FILES",
+    "ProgramSpec", "default_manifest", "run_manifest",
+    "MANIFEST_PROGRAMS",
+]

@@ -1,0 +1,31 @@
+"""Shared helpers for the analyzers.
+
+Leaf labels are part of finding SITE identity (baseline keys must stay
+stable across analyzers and releases), so there is exactly one
+implementation: ``argN`` plus jax's keystr path inside that argument.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+
+__all__ = ["leaf_labels"]
+
+
+def leaf_labels(args: Tuple, kwargs: Optional[dict] = None,
+                static_argnums: Sequence[int] = ()) -> List[str]:
+    """Stable labels for the flattened (args, kwargs) leaves, in jax
+    tree_flatten order: positional args first (static ones skipped),
+    then kwargs sorted by key."""
+    static = set(static_argnums)
+    labels: List[str] = []
+    for i, a in enumerate(args):
+        if i in static:
+            continue
+        for path, _ in jax.tree_util.tree_flatten_with_path(a)[0]:
+            labels.append(f"arg{i}{jax.tree_util.keystr(path)}")
+    for k, v in sorted((kwargs or {}).items()):
+        for path, _ in jax.tree_util.tree_flatten_with_path(v)[0]:
+            labels.append(f"kw:{k}{jax.tree_util.keystr(path)}")
+    return labels

@@ -1,0 +1,291 @@
+"""Codebase lint: AST pass over the tree for trace-hostile idioms.
+
+The program linter sees one compiled program at a time; this pass sees
+the SOURCE patterns that produce bad programs — the hazards PR 1 and
+PR 2 each burned wall-clock discovering at runtime:
+
+- jit-in-call: ``jax.jit(f, ...)(args)`` — a fresh function object per
+  call means a jit cache miss per call: full re-trace + re-compile
+  every time (the sequential-generate() recompile storm, PR 2).
+- jit-no-donation: a ``jax.jit`` on a known-hot wrapper file with
+  neither donate_argnums nor static_argnames/nums — informational; the
+  baseline pins accepted sites.
+- traced-attr-mutation: ``self.x = <expr>`` inside a Layer ``forward``
+  — under whole-step tracing the attribute captures a tracer and leaks
+  across steps (the aux_loss.py class of bug; layers must report into
+  scopes instead).
+- numpy-in-trace: ``np.*(...)`` inside ``forward`` — numpy calls force
+  concretization of traced values (TracerArrayConversionError at best,
+  silent host constant at worst).
+- stale-quarantine: an entry in tools/flaky_quarantine.txt (nodeid or
+  -k substring) that no longer matches any test — known failures must
+  stay tracked, not rot silently.
+
+Suppression: append ``# tpulint: disable=<code>`` (or a bare
+``# tpulint: disable``) on the flagged line.
+
+Sites are (path, qualified symbol) — never line numbers, so baselines
+survive unrelated edits.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import List, Optional, Set
+
+from .findings import (JIT_IN_CALL, JIT_NO_DONATION, NUMPY_IN_TRACE,
+                       STALE_QUARANTINE, TRACED_ATTR_MUTATION, Finding,
+                       Severity)
+
+__all__ = ["lint_tree", "lint_file", "lint_quarantine", "HOT_JIT_FILES"]
+
+# wrappers on the jit hot path: a jax.jit here without donation/static
+# knobs deserves a look (informational — baseline pins accepted sites)
+HOT_JIT_FILES = {
+    "paddle_tpu/jit/training.py",
+    "paddle_tpu/distributed/parallel_step.py",
+    "paddle_tpu/inference/engine.py",
+    "paddle_tpu/models/generation.py",
+}
+
+_DISABLE_RE = re.compile(r"#\s*tpulint:\s*disable(?:=([\w,-]+))?")
+
+_JIT_KNOBS = {"donate_argnums", "donate_argnames", "static_argnums",
+              "static_argnames", "in_shardings", "out_shardings"}
+
+
+def _disabled_codes(line: str) -> Optional[Set[str]]:
+    m = _DISABLE_RE.search(line)
+    if not m:
+        return None
+    if not m.group(1):
+        return set()          # bare disable: every code
+    return {c.strip() for c in m.group(1).split(",")}
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Attribute) and node.attr == "jit"
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "jax")
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, relpath: str, lines: List[str]):
+        self.relpath = relpath
+        self.lines = lines
+        self.findings: List[Finding] = []
+        self._scope: List[str] = []       # qualname stack
+        self._class_stack: List[ast.ClassDef] = []
+        self._in_forward = 0
+        self._fn_depth = 0
+
+    # -- helpers -----------------------------------------------------------
+    def _qual(self) -> str:
+        return ".".join(self._scope) or "<module>"
+
+    def _suppressed(self, node: ast.AST, code: str) -> bool:
+        ln = getattr(node, "lineno", 0)
+        if 1 <= ln <= len(self.lines):
+            dis = _disabled_codes(self.lines[ln - 1])
+            if dis is not None and (not dis or code in dis):
+                return True
+        return False
+
+    def _emit(self, node, code, severity, site, message, data=None):
+        if self._suppressed(node, code):
+            return
+        self.findings.append(Finding(
+            code, severity, self.relpath, site, message,
+            dict(data or {}, line=getattr(node, "lineno", 0))))
+
+    # -- scope tracking ----------------------------------------------------
+    @staticmethod
+    def _layer_like(node: ast.ClassDef) -> bool:
+        """Only Layer subclasses run under whole-step tracing — host-side
+        helpers (Initializer, BaseTransform, ...) mutate state eagerly
+        by design and must not be flagged."""
+        names = [node.name]
+        for b in node.bases:
+            if isinstance(b, ast.Attribute):      # nn.Layer
+                names.append(b.attr)
+            elif isinstance(b, ast.Name):         # Layer
+                names.append(b.id)
+        return any("Layer" in n for n in names)
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self._scope.append(node.name)
+        self._class_stack.append(node)
+        self.generic_visit(node)
+        self._class_stack.pop()
+        self._scope.pop()
+
+    def _visit_fn(self, node):
+        is_forward = (bool(self._class_stack) and self._fn_depth == 0
+                      and node.name in ("forward", "__call__")
+                      and self._layer_like(self._class_stack[-1]))
+        self._scope.append(node.name)
+        self._fn_depth += 1
+        if is_forward:
+            self._in_forward += 1
+        self.generic_visit(node)
+        if is_forward:
+            self._in_forward -= 1
+        self._fn_depth -= 1
+        self._scope.pop()
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    # -- checks ------------------------------------------------------------
+    def visit_Call(self, node: ast.Call):
+        # jax.jit(...)(...) — immediate invocation: retrace per call
+        if isinstance(node.func, ast.Call) and _is_jax_jit(node.func.func):
+            self._emit(
+                node, JIT_IN_CALL, Severity.WARN,
+                f"{self._qual()}",
+                "jax.jit(...)(...) builds a fresh jitted function per "
+                "call — jit's cache keys on function identity, so every "
+                "call re-traces AND re-compiles; hoist/cache the jitted "
+                "program")
+        if _is_jax_jit(node.func):
+            rel = self.relpath.replace(os.sep, "/")
+            if rel in HOT_JIT_FILES and not (
+                    {kw.arg for kw in node.keywords} & _JIT_KNOBS):
+                self._emit(
+                    node, JIT_NO_DONATION, Severity.INFO,
+                    f"{self._qual()}",
+                    "jax.jit on a hot wrapper without donation/static "
+                    "knobs — confirm nothing here is donatable or "
+                    "shape-polymorphic")
+        # numpy on traced values inside forward
+        if (self._in_forward and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in ("np", "numpy")):
+            self._emit(
+                node, NUMPY_IN_TRACE, Severity.WARN,
+                f"{self._qual()}.np.{node.func.attr}",
+                f"numpy call np.{node.func.attr}(...) inside forward() "
+                "— concretizes traced values (TracerArrayConversion "
+                "error under jit, silent trace-time constant otherwise)")
+        self.generic_visit(node)
+
+    def _check_self_assign(self, node, target):
+        if (isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"):
+            value = getattr(node, "value", None)
+            if isinstance(value, ast.Constant):
+                return     # plain flag flips are trace-safe
+            cls = self._class_stack[-1].name if self._class_stack else "?"
+            self._emit(
+                node, TRACED_ATTR_MUTATION, Severity.WARN,
+                f"{cls}.forward.{target.attr}",
+                f"self.{target.attr} assigned inside forward() — under "
+                "whole-step jit this captures a tracer on the layer and "
+                "leaks it across steps (the aux_loss.py class of bug); "
+                "report through a scope or return it instead")
+
+    def visit_Assign(self, node: ast.Assign):
+        if self._in_forward:
+            for t in node.targets:
+                self._check_self_assign(node, t)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        if self._in_forward:
+            self._check_self_assign(node, node.target)
+        self.generic_visit(node)
+
+
+def lint_file(path: str, root: str) -> List[Finding]:
+    relpath = os.path.relpath(path, root).replace(os.sep, "/")
+    with open(path, encoding="utf-8") as fh:
+        src = fh.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Finding("lint-error", Severity.ERROR, relpath,
+                        "parse", f"syntax error: {e}", {})]
+    v = _Visitor(relpath, src.splitlines())
+    v.visit(tree)
+    return v.findings
+
+
+def lint_tree(root: str, package: str = "paddle_tpu") -> List[Finding]:
+    """Lint every .py under <root>/<package>."""
+    findings: List[Finding] = []
+    pkg_root = os.path.join(root, package)
+    for dirpath, dirnames, filenames in os.walk(pkg_root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fname in sorted(filenames):
+            if fname.endswith(".py"):
+                findings.extend(lint_file(os.path.join(dirpath, fname),
+                                          root))
+    return findings
+
+
+# -- quarantine / known-failure registry check -----------------------------
+
+_TEST_DEF_RE = re.compile(r"^\s*(?:def|class)\s+((?:test|Test)\w+)",
+                          re.MULTILINE)
+
+
+def _collect_test_names(tests_dir: str):
+    names = {}     # test function OR Test class name -> file
+    for fname in sorted(os.listdir(tests_dir)):
+        if not (fname.startswith("test_") and fname.endswith(".py")):
+            continue
+        with open(os.path.join(tests_dir, fname),
+                  encoding="utf-8") as fh:
+            for m in _TEST_DEF_RE.finditer(fh.read()):
+                names[m.group(1)] = fname
+    return names
+
+
+def lint_quarantine(root: str,
+                    quarantine_path: Optional[str] = None,
+                    tests_dir: Optional[str] = None) -> List[Finding]:
+    """Machine-check tools/flaky_quarantine.txt: every entry (pytest
+    nodeid or -k substring) must still resolve to a live test, so a
+    renamed/deleted known-failure can't silently drop off the books."""
+    qpath = quarantine_path or os.path.join(root, "tools",
+                                            "flaky_quarantine.txt")
+    tdir = tests_dir or os.path.join(root, "tests")
+    if not os.path.exists(qpath):
+        return []
+    findings: List[Finding] = []
+    test_names = _collect_test_names(tdir) if os.path.isdir(tdir) else {}
+    relq = os.path.relpath(qpath, root).replace(os.sep, "/")
+    for raw in open(qpath, encoding="utf-8"):
+        entry = raw.split("#", 1)[0].strip()
+        if not entry:
+            continue
+        ok = False
+        if "::" in entry or entry.endswith(".py"):
+            # nodeid: path::test_fn, or class-based path::TestCls::test_fn
+            path_part, _, name_part = entry.partition("::")
+            fpath = os.path.join(root, path_part)
+            if os.path.exists(fpath):
+                if not name_part:
+                    ok = True
+                else:
+                    # the terminal component (param brackets stripped)
+                    # must exist as a def/class in the file
+                    name = name_part.split("::")[-1].split("[", 1)[0]
+                    with open(fpath, encoding="utf-8") as fh:
+                        ok = re.search(
+                            r"\b(?:def|class)\s+%s\b" % re.escape(name),
+                            fh.read()) is not None
+        else:
+            # -k substring: pytest keyword-matches module names too, so
+            # "flash_kernel" (whole-module deselect) must resolve
+            ok = (any(entry in n for n in test_names)
+                  or any(entry in f for f in test_names.values()))
+        if not ok:
+            findings.append(Finding(
+                STALE_QUARANTINE, Severity.WARN, relq, entry,
+                f"quarantine entry {entry!r} matches no existing test — "
+                "the known failure it tracked was renamed or removed; "
+                "update or delete the entry", {}))
+    return findings

@@ -1,0 +1,164 @@
+"""tpulint finding model + baseline gate.
+
+The reference ships whole analysis layers over its graph IR (pass
+framework, FLAGS_check_nan_inf, memory-reuse checkers under
+paddle/fluid/framework/ir/). Our IR is the jaxpr / lowered StableHLO of
+each jitted program; tpulint findings are the structured output of
+walking it. This module is the shared vocabulary: a `Finding` is a
+(code, program, site) identity plus human message and machine `data`;
+the baseline JSON records how many of each identity the tree is KNOWN
+to contain, and the gate fails on anything beyond that — the same
+ratchet policy as the reference's disabled-op lists, but machine-diffed.
+
+Baseline JSON shape (tools/tpulint_baseline.json):
+
+    {"version": 1,
+     "counts": {"<code>::<program>::<site>": n, ...},
+     "must_stay_clean": ["<key or key prefix>", ...],
+     "notes": {"<key prefix>": "why this is pinned", ...}}
+
+`counts` tolerates up to n occurrences of a key (existing, accepted
+hazards — e.g. the embedding gather every causal LM contains).
+`must_stay_clean` entries are regression anchors for hazards that were
+FIXED: any produced finding whose key starts with such a prefix fails
+the gate even if someone also bumps `counts` — reintroducing a fixed
+hazard requires editing the anchor itself, which is the point.
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+__all__ = [
+    "Finding", "Severity",
+    "DTYPE_PROMOTION", "SCATTER_OP", "GATHER_OP", "HOST_CALLBACK",
+    "UNDONATED_BUFFER", "BAKED_RNG_KEY", "COLLECTIVE",
+    "RECOMPILE_DIM", "RECOMPILE_STRUCTURE",
+    "JIT_IN_CALL", "JIT_NO_DONATION", "TRACED_ATTR_MUTATION",
+    "NUMPY_IN_TRACE", "STALE_QUARANTINE",
+    "count_findings", "diff_against_baseline", "load_baseline",
+    "findings_to_json", "GATE_SEVERITIES",
+]
+
+# -- finding codes ---------------------------------------------------------
+# program linter (jaxpr / StableHLO level)
+DTYPE_PROMOTION = "dtype-promotion"      # silent widening convert on arrays
+SCATTER_OP = "scatter-op"                # scatter in a compiled program
+GATHER_OP = "gather-op"                  # gather (informational inventory)
+HOST_CALLBACK = "host-callback"          # io/pure/debug callback in program
+UNDONATED_BUFFER = "undonated-buffer"    # donatable input left undonated
+BAKED_RNG_KEY = "baked-rng-key"          # PRNG key constant-folded at trace
+COLLECTIVE = "collective"                # collective inventory entry (info)
+# recompile-hazard analyzer
+RECOMPILE_DIM = "recompile-dim"          # arg dim varies across call specs
+RECOMPILE_STRUCTURE = "recompile-structure"  # pytree structure varies
+# codebase (AST) lint
+JIT_IN_CALL = "jit-in-call"              # jax.jit(...)(...) retrace-per-call
+JIT_NO_DONATION = "jit-no-donation"      # hot-wrapper jit without knobs
+TRACED_ATTR_MUTATION = "traced-attr-mutation"  # self.x = <expr> in forward
+NUMPY_IN_TRACE = "numpy-in-trace"        # numpy call on traced values
+STALE_QUARANTINE = "stale-quarantine"    # quarantine entry matches no test
+
+
+class Severity:
+    """Display/triage tiers. Severity does NOT exempt a finding from
+    the gate: every key's count ratchets against the baseline — the
+    whole point of pinning the gather/collective inventory is that a
+    regression in an 'info' count (e.g. a broken sharding annotation
+    doubling the step's all-gathers) still fails CI."""
+    ERROR = "error"
+    WARN = "warn"
+    INFO = "info"
+
+
+# kept for introspection/compat: severities are display tiers only
+GATE_SEVERITIES = (Severity.ERROR, Severity.WARN, Severity.INFO)
+
+
+@dataclass
+class Finding:
+    code: str
+    severity: str
+    program: str        # program name, or repo-relative path for AST lint
+    site: str           # stable location id (primitive, arg, symbol) —
+                        # never a line number: lines shift, baselines rot
+    message: str
+    data: dict = field(default_factory=dict)
+
+    @property
+    def key(self) -> str:
+        return f"{self.code}::{self.program}::{self.site}"
+
+    def to_dict(self) -> dict:
+        return {"code": self.code, "severity": self.severity,
+                "program": self.program, "site": self.site,
+                "message": self.message, "data": self.data,
+                "key": self.key}
+
+
+def _weight(f: "Finding") -> int:
+    """Aggregated findings (e.g. '2 scatter op(s)') carry their op count
+    in data['count']; the baseline ratchet counts OPS, not finding
+    records, so 2 scatters growing to 3 still trips the gate."""
+    try:
+        return max(1, int(f.data.get("count", 1)))
+    except (TypeError, ValueError):
+        return 1
+
+
+def count_findings(findings: List[Finding]) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for f in findings:
+        out[f.key] = out.get(f.key, 0) + _weight(f)
+    return out
+
+
+def load_baseline(path: str) -> dict:
+    with open(path) as fh:
+        base = json.load(fh)
+    if not isinstance(base, dict) or "counts" not in base:
+        raise ValueError(f"malformed baseline {path!r}: needs a 'counts' "
+                         "dict (see analysis/findings.py docstring)")
+    return base
+
+
+def diff_against_baseline(findings: List[Finding],
+                          baseline: Optional[dict]) -> List[dict]:
+    """Return the gate-relevant NEW findings: occurrences of any key
+    (every severity — info inventories are count-pinned too) beyond the
+    baseline's tolerated count, plus ANY hit on a must_stay_clean
+    anchor. Empty list == gate passes."""
+    baseline = baseline or {"counts": {}}
+    counts = baseline.get("counts", {})
+    anchors = tuple(baseline.get("must_stay_clean", []))
+    seen: Dict[str, int] = {}
+    new: List[dict] = []
+    for f in findings:
+        # '::'-boundary prefix match: anchor "x::train_step" must not
+        # capture a future program named "train_step_acc"
+        anchored = any(f.key == a or f.key.startswith(a + "::")
+                       for a in anchors)
+        seen[f.key] = seen.get(f.key, 0) + _weight(f)
+        if anchored:
+            d = f.to_dict()
+            d["reason"] = "must_stay_clean regression anchor"
+            new.append(d)
+        elif seen[f.key] > int(counts.get(f.key, 0)):
+            d = f.to_dict()
+            d["reason"] = (f"count {seen[f.key]} exceeds baseline "
+                           f"{int(counts.get(f.key, 0))}")
+            new.append(d)
+    return new
+
+
+def findings_to_json(findings: List[Finding], new: List[dict],
+                     programs: List[str]) -> dict:
+    return {
+        "version": 1,
+        "programs": sorted(programs),
+        "counts": count_findings(findings),
+        "findings": [f.to_dict() for f in findings],
+        "new": new,
+        "gate": "fail" if new else "pass",
+    }

@@ -1,0 +1,204 @@
+"""tpulint default manifest: the real programs every perf PR rides on.
+
+Four production programs are rebuilt exactly as their owners build them
+and handed to the program linter — trace + lower only (the parallel
+step additionally compiles for its collective inventory):
+
+- gpt_decode:     the continuous-batching engine's ONE batched decode
+                  program (inference/engine.py) over GPT-tiny — the
+                  program whose scatter-free one-hot cache writes and
+                  cache donation PR 2's speedups depend on.
+- llama_prefill:  the generate() prefill program (models/generation.py
+                  build_generate_programs) over LLaMA-tiny.
+- train_step:     jit.training.TrainStep's fused whole-step program
+                  (donated params/buffers/opt state) over GPT-tiny.
+- parallel_train_step: distributed.ParallelTrainStep under a fake
+                  4-device mesh (dp2 x sharding2, ZeRO-2) — compiled,
+                  so the GSPMD-inserted collectives are inventoried.
+
+Plus one static recompile-hazard report: the sequential generate()
+path's per-(prompt-len) program key, the hazard the engine's prefill
+buckets exist to close (PR 2).
+
+Everything is tiny-config and CPU-safe; no program is executed.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .findings import Finding
+from .program_lint import lint_program
+from .recompile import recompile_report
+
+__all__ = ["ProgramSpec", "default_manifest", "run_manifest",
+           "MANIFEST_PROGRAMS"]
+
+MANIFEST_PROGRAMS = ("gpt_decode", "llama_prefill", "train_step",
+                     "parallel_train_step", "generate_prompt_drift")
+
+
+@dataclass
+class ProgramSpec:
+    name: str
+    build: Callable[[], Tuple[Any, tuple, Optional[Callable]]]
+    compile_collectives: bool = False
+
+
+def _gpt_tiny_model():
+    from ..models.gpt import GPTConfig, GPTForCausalLM
+    from ..framework import random as _rng
+    _rng.seed(0)
+    return GPTForCausalLM(GPTConfig(vocab_size=256, hidden_size=64,
+                                    num_layers=2, num_heads=4,
+                                    max_seq_len=128))
+
+
+def _build_gpt_decode():
+    from ..inference.engine import ContinuousBatchingEngine
+    model = _gpt_tiny_model()
+    eng = ContinuousBatchingEngine(model, slots=4, max_len=64,
+                                   cache_dtype="float32", tick_tokens=4)
+    prog = eng._get_decode_prog()
+    N = eng.slots
+    args = (eng._params, eng._buffers, eng._caches,
+            np.zeros(N, np.int32), np.zeros(N, np.int32),
+            np.ones(N, bool), np.full(N, -1, np.int32),
+            np.zeros((N, 2), np.uint32))
+    return prog, args, eng.stop
+
+
+def _build_llama_prefill():
+    from ..models.llama import LlamaConfig, LlamaForCausalLM
+    from ..models.generation import build_generate_programs
+    from ..jit.functional import raw_state
+    from ..framework import random as _rng
+    _rng.seed(0)
+    model = LlamaForCausalLM(LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=176,
+        num_layers=2, num_heads=4, num_kv_heads=2, max_seq_len=128))
+    model.eval()
+    P, new = 16, 8
+    prefill, _ = build_generate_programs(model, P, new, eos=None,
+                                         do_sample=False,
+                                         temperature=1.0, top_k=0,
+                                         top_p=1.0)
+    params, buffers = raw_state(model)
+    caches = model.new_cache(1, P + new, "float32")
+    args = (params, buffers, np.zeros((1, P), np.int64), caches,
+            jax.random.PRNGKey(0))
+    return prefill, args, None
+
+
+def _train_step_parts(model):
+    from ..optimizer import AdamW
+    from ..models.gpt import GPTForCausalLM
+    from ..framework import random as _rng
+    opt = AdamW(learning_rate=1e-3, parameters=model.parameters())
+    return GPTForCausalLM.loss_fn, opt, _rng
+
+
+def _build_train_step():
+    from ..jit.training import TrainStep
+    model = _gpt_tiny_model()
+    loss_fn, opt, _rng = _train_step_parts(model)
+    step = TrainStep(model, loss_fn, opt)
+    step._build()
+    ids = np.zeros((2, 32), np.int64)
+    args = (step.params, step.buffers, step.opt_state,
+            jnp.asarray(1e-3, jnp.float32), jnp.asarray(1, jnp.float32),
+            _rng.default_generator().fold_in(1), ids, ids)
+    return step._jitted, args, None
+
+
+def _build_parallel_train_step():
+    from ..distributed import mesh as mesh_mod
+    from ..distributed.parallel_step import ParallelTrainStep
+    prev = mesh_mod.get_mesh(create_default=False)
+    devs = jax.devices()
+    if len(devs) < 4:
+        raise RuntimeError(
+            f"parallel_train_step needs >= 4 devices, have {len(devs)} "
+            "(run under XLA_FLAGS=--xla_force_host_platform_device_"
+            "count=8; tools/tpulint.py sets this up itself)")
+
+    def cleanup():
+        mesh_mod.set_mesh(prev)
+
+    try:
+        mesh_mod.init_mesh({"dp": 2, "sharding": 2}, devices=devs[:4])
+        model = _gpt_tiny_model()
+        loss_fn, opt, _rng = _train_step_parts(model)
+        step = ParallelTrainStep(model, loss_fn, opt, zero_stage=2)
+        ids = np.zeros((4, 32), np.int64)
+        raw_batch = (ids, ids)
+        step._build(raw_batch)
+        args = (step.params, step.buffers, step.opt_state,
+                jnp.asarray(1e-3, jnp.float32),
+                jnp.asarray(1, jnp.float32),
+                _rng.default_generator().fold_in(1)) + raw_batch
+    except BaseException:
+        # build raised after the global mesh was swapped: restore it
+        # here — run_manifest never receives the cleanup on this path
+        cleanup()
+        raise
+    return step._jitted, args, cleanup
+
+
+def default_manifest() -> List[ProgramSpec]:
+    return [
+        ProgramSpec("gpt_decode", _build_gpt_decode),
+        ProgramSpec("llama_prefill", _build_llama_prefill),
+        ProgramSpec("train_step", _build_train_step),
+        ProgramSpec("parallel_train_step", _build_parallel_train_step,
+                    compile_collectives=True),
+    ]
+
+
+def _generate_prompt_drift_report() -> List[Finding]:
+    """Static restatement of PR 2's recompile storm: sequential
+    generate() keys one compiled program per exact prompt length, so
+    drifting traffic re-traces per request. The engine's bucketed
+    prefill is the fix; this report keeps the hazard visible (and the
+    analyzer honest) in the baseline."""
+    specs = [(np.zeros((1, p), np.int64),) for p in (7, 9, 13)]
+    return recompile_report("generate_prompt_drift", specs)
+
+
+def run_manifest(programs: Optional[List[str]] = None,
+                 compile_collectives: bool = True
+                 ) -> Tuple[List[Finding], List[str]]:
+    """Build + lint the manifest. Returns (findings, program names run).
+    `programs` filters by name; `compile_collectives=False` skips the
+    compile-requiring inventory (trace/lower only — faster gate)."""
+    wanted = set(programs) if programs else None
+    if wanted is not None:
+        unknown = wanted - set(MANIFEST_PROGRAMS)
+        if unknown:
+            raise ValueError(
+                f"unknown manifest program(s) {sorted(unknown)}; "
+                f"valid: {list(MANIFEST_PROGRAMS)}")
+    findings: List[Finding] = []
+    ran: List[str] = []
+    for spec in default_manifest():
+        if wanted is not None and spec.name not in wanted:
+            continue
+        fn, args, cleanup = spec.build()
+        try:
+            findings.extend(lint_program(
+                spec.name, fn, args,
+                compile_collectives=(spec.compile_collectives
+                                     and compile_collectives)))
+            ran.append(spec.name)
+        finally:
+            if cleanup is not None:
+                cleanup()
+    if wanted is None or "generate_prompt_drift" in wanted:
+        findings.extend(_generate_prompt_drift_report())
+        ran.append("generate_prompt_drift")
+    return findings, ran

@@ -1,0 +1,113 @@
+"""Recompile-hazard analyzer: statically diff abstract call signatures.
+
+`jax.jit` keys its executable cache on the abstract signature of every
+argument — (pytree structure, leaf shapes, dtypes, weak-types) — plus
+static-arg values. Any drift re-traces and re-compiles (~1.5 s even at
+GPT-tiny scale on this host; minutes at real scale). PR 2's engine
+closes the serving side with trace counters asserting ZERO recompiles;
+this module closes the loop statically: given the argument specs a
+caller intends to pass over time, report exactly which leaves (and
+which dims) will force re-tracing, BEFORE anything is compiled.
+
+Usage:
+
+    findings = recompile_report(
+        "generate.prefill",
+        call_specs=[(params, buffers, ids_7, caches, key),
+                    (params, buffers, ids_9, caches, key)])
+    # -> [recompile-dim finding: arg2 dim 1 varies {7, 9} -> 2 programs]
+
+Specs may be real arrays, jax.ShapeDtypeStruct avals, or pytrees
+thereof — only shapes/dtypes are read, nothing is traced.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+
+from ._util import leaf_labels
+from .findings import (RECOMPILE_DIM, RECOMPILE_STRUCTURE, Finding,
+                       Severity)
+
+__all__ = ["abstract_signature", "recompile_report"]
+
+
+def _leaf_sig(leaf) -> Tuple:
+    shape = tuple(getattr(leaf, "shape", ()) or ())
+    dtype = str(getattr(leaf, "dtype", type(leaf).__name__))
+    weak = bool(getattr(leaf, "weak_type", False))
+    # python scalars are weak-typed literals — every distinct VALUE of a
+    # bool/int static-like arg is fine (same aval), but float/int python
+    # scalars passed positionally become weak arrays of one signature
+    return (shape, dtype, weak)
+
+
+def abstract_signature(args: Tuple, static_argnums: Sequence[int] = ()):
+    """(treedef_repr, leaf signatures) of one call's dynamic args."""
+    dyn = tuple(a for i, a in enumerate(args)
+                if i not in set(static_argnums))
+    leaves, treedef = jax.tree_util.tree_flatten(dyn)
+    return repr(treedef), tuple(_leaf_sig(l) for l in leaves)
+
+
+def recompile_report(name: str, call_specs: Sequence[Tuple],
+                     static_argnums: Sequence[int] = ()) -> List[Finding]:
+    """Diff the abstract signatures of `call_specs` (each one the arg
+    tuple of an intended call) and report every leaf whose signature is
+    unstable — each distinct overall signature is one compilation."""
+    if len(call_specs) < 2:
+        return []
+    sigs = [abstract_signature(args, static_argnums)
+            for args in call_specs]
+    findings: List[Finding] = []
+
+    treedefs = {s[0] for s in sigs}
+    if len(treedefs) > 1:
+        findings.append(Finding(
+            RECOMPILE_STRUCTURE, Severity.WARN, name, "pytree",
+            f"{len(treedefs)} distinct argument pytree structures "
+            f"across {len(call_specs)} calls — every structure is a "
+            "separate trace", {"structures": len(treedefs)}))
+        return findings  # leaf alignment is meaningless across structures
+
+    labels = leaf_labels(call_specs[0], static_argnums=static_argnums)
+    n_progs = len({s[1] for s in sigs})
+    leaf_cols = list(zip(*[s[1] for s in sigs])) if sigs[0][1] else []
+    for idx, col in enumerate(leaf_cols):
+        distinct = sorted(set(col), key=repr)
+        if len(distinct) == 1:
+            continue
+        label = labels[idx] if idx < len(labels) else f"leaf{idx}"
+        shapes = [d[0] for d in distinct]
+        ranks = {len(s) for s in shapes}
+        varying_dims: List[int] = []
+        if len(ranks) == 1:
+            r = ranks.pop()
+            varying_dims = [d for d in range(r)
+                            if len({s[d] for s in shapes}) > 1]
+        dtypes = sorted({d[1] for d in distinct})
+        detail = []
+        if varying_dims:
+            detail.append(
+                "dim(s) %s vary: %s" % (
+                    varying_dims,
+                    sorted({tuple(s[d] for d in varying_dims)
+                            for s in shapes})))
+        elif len(ranks) > 1:
+            detail.append(f"rank varies: {sorted(ranks)}")
+        if len(dtypes) > 1:
+            detail.append(f"dtype varies: {dtypes}")
+        if len({d[2] for d in distinct}) > 1:
+            detail.append("weak_type varies (mix of python literals "
+                          "and arrays)")
+        findings.append(Finding(
+            RECOMPILE_DIM, Severity.WARN, name, label,
+            f"{label} has {len(distinct)} abstract signatures across "
+            f"{len(call_specs)} calls ({'; '.join(detail)}) — pad or "
+            f"bucket it, or mark it static; this call pattern compiles "
+            f"{n_progs} distinct programs",
+            {"signatures": [repr(d) for d in distinct],
+             "varying_dims": varying_dims,
+             "distinct_programs": n_progs}))
+    return findings

@@ -180,5 +180,5 @@ class MoELayer(Layer):
         from ..framework.aux_loss import add_aux_loss
         add_aux_loss(self.aux_loss_weight * (
             aux.value if hasattr(aux, "value") else aux))
-        self._l_aux = aux
+        self._l_aux = aux   # tpulint: disable=traced-attr-mutation
         return out

@@ -82,6 +82,9 @@ class TrainStep:
         self.auto_lr_step = True
         self._jitted = None
         self._jitted_acc = None
+        # flush_accumulation programs keyed by remainder r (tpulint
+        # jit-in-call: a fresh jax.jit per flush re-traced every time)
+        self._flush_progs = {}
 
     # ------------------------------------------------------------------
     def _build(self):
@@ -198,15 +201,19 @@ class TrainStep:
         step_no = jnp.asarray(self.update_count, jnp.float32)
         optimizer = self.optimizer
 
-        def apply_only(params, opt_state, acc, lr, step_no):
-            mean = jax.tree_util.tree_map(lambda a: a / r, acc)
-            new_p, new_o = optimizer.apply_gradients(
-                params, mean, opt_state, lr=lr, step=step_no)
-            zeros = jax.tree_util.tree_map(jnp.zeros_like, acc)
-            return new_p, new_o, zeros
+        prog = self._flush_progs.get(r)
+        if prog is None:
+            def apply_only(params, opt_state, acc, lr, step_no):
+                mean = jax.tree_util.tree_map(lambda a: a / r, acc)
+                new_p, new_o = optimizer.apply_gradients(
+                    params, mean, opt_state, lr=lr, step=step_no)
+                zeros = jax.tree_util.tree_map(jnp.zeros_like, acc)
+                return new_p, new_o, zeros
 
-        self.params, self.opt_state, self.acc_grads = jax.jit(
-            apply_only, donate_argnums=(0, 1, 2))(
+            prog = jax.jit(apply_only, donate_argnums=(0, 1, 2))
+            self._flush_progs[r] = prog
+
+        self.params, self.opt_state, self.acc_grads = prog(
             self.params, self.opt_state, self.acc_grads, lr, step_no)
         # realign the cadence so the next call starts a fresh window
         self.step_count += k - r

@@ -31,7 +31,7 @@ from jax import lax
 from ..core.tensor import Tensor
 from ..jit.functional import functional_call, raw_state
 
-__all__ = ["generate", "new_kv_caches"]
+__all__ = ["generate", "new_kv_caches", "build_generate_programs"]
 
 
 def _prog_cache_size() -> int:
@@ -116,6 +116,64 @@ def _select_token(logits, key, do_sample, temperature, top_k, top_p):
     return jax.random.categorical(key, logits, axis=-1)
 
 
+def build_generate_programs(model, P: int, max_new_tokens: int,
+                            eos: Optional[int], do_sample: bool,
+                            temperature: float, top_k: int,
+                            top_p: float):
+    """(prefill, decode_all) jitted programs for one generate()
+    configuration — the exact programs generate() caches per prog_key.
+
+    Module-level (not a generate() closure) so the static analyzer
+    (paddle_tpu.analysis) lints the REAL serving programs by lowering
+    them directly, without executing a token. Signatures:
+
+        prefill(params, buffers, ids[B,P]i64, caches, key) -> (tok, caches)
+        decode_all(params, buffers, tok0[B]i, caches, key) -> (toks, caches)
+
+    Caches are donated (argument 3 of both).
+    """
+    def prefill(params, buffers, ids, caches, key):
+        (logits, caches), _ = functional_call(
+            model, params, buffers, ids, caches,
+            jnp.int32(0), training=False)
+        nxt = _select_token(logits[:, -1, :], key, do_sample,
+                            temperature, top_k, top_p)
+        return nxt, caches
+
+    def decode_all(params, buffers, tok0, caches, key):
+        """The whole token loop as one scan: emits tok0 then
+        max_new_tokens-1 successors, eos rows frozen."""
+        fin0 = (tok0 == eos) if eos is not None \
+            else jnp.zeros(tok0.shape, bool)
+
+        def body(carry, i):
+            tok, caches, fin, key = carry
+            key, sub = jax.random.split(key)
+            (logits, caches), _ = functional_call(
+                model, params, buffers, tok[:, None], caches,
+                (P + i).astype(jnp.int32), training=False)
+            nxt = _select_token(logits[:, -1, :], sub, do_sample,
+                                temperature, top_k, top_p)
+            if eos is not None:
+                nxt = jnp.where(fin, eos, nxt)
+                fin = fin | (nxt == eos)
+            return (nxt, caches, fin, key), nxt
+
+        (_, caches, _, _), toks = lax.scan(
+            body, (tok0, caches, fin0, key),
+            jnp.arange(max_new_tokens - 1))
+        # [B, max_new_tokens]: the prefill token + scan
+        # emissions (int32 in-program; the host widens to int64).
+        # caches are returned solely so the donated inputs have
+        # an output to alias — callers discard them.
+        out = jnp.concatenate(
+            [tok0[:, None], toks.T.astype(tok0.dtype)], axis=1)
+        return out, caches
+
+    return (jax.jit(prefill, donate_argnums=(3,)),
+            jax.jit(decode_all, donate_argnums=(3,)))
+
+
 def generate(model, input_ids, max_new_tokens: int = 32,
              do_sample: bool = False, temperature: float = 1.0,
              top_k: int = 0, top_p: float = 1.0,
@@ -166,46 +224,9 @@ def generate(model, input_ids, max_new_tokens: int = 32,
             if progs is not None:
                 prog_cache.move_to_end(prog_key)
         if progs is None:
-            def prefill(params, buffers, ids, caches, key):
-                (logits, caches), _ = functional_call(
-                    model, params, buffers, ids, caches,
-                    jnp.int32(0), training=False)
-                nxt = _select_token(logits[:, -1, :], key, do_sample,
-                                    temperature, top_k, top_p)
-                return nxt, caches
-
-            def decode_all(params, buffers, tok0, caches, key):
-                """The whole token loop as one scan: emits tok0 then
-                max_new_tokens-1 successors, eos rows frozen."""
-                fin0 = (tok0 == eos) if eos is not None \
-                    else jnp.zeros(tok0.shape, bool)
-
-                def body(carry, i):
-                    tok, caches, fin, key = carry
-                    key, sub = jax.random.split(key)
-                    (logits, caches), _ = functional_call(
-                        model, params, buffers, tok[:, None], caches,
-                        (P + i).astype(jnp.int32), training=False)
-                    nxt = _select_token(logits[:, -1, :], sub, do_sample,
-                                        temperature, top_k, top_p)
-                    if eos is not None:
-                        nxt = jnp.where(fin, eos, nxt)
-                        fin = fin | (nxt == eos)
-                    return (nxt, caches, fin, key), nxt
-
-                (_, caches, _, _), toks = lax.scan(
-                    body, (tok0, caches, fin0, key),
-                    jnp.arange(max_new_tokens - 1))
-                # [B, max_new_tokens]: the prefill token + scan
-                # emissions (int32 in-program; the host widens to int64).
-                # caches are returned solely so the donated inputs have
-                # an output to alias — callers discard them.
-                out = jnp.concatenate(
-                    [tok0[:, None], toks.T.astype(tok0.dtype)], axis=1)
-                return out, caches
-
-            progs = (jax.jit(prefill, donate_argnums=(3,)),
-                     jax.jit(decode_all, donate_argnums=(3,)))
+            progs = build_generate_programs(
+                model, P, max_new_tokens, eos, do_sample, temperature,
+                top_k, top_p)
             # jit wrapper creation is cheap (compilation happens at the
             # first call, outside the lock); insertion races resolve in
             # favor of the first writer so every thread runs ONE program

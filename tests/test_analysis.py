@@ -1,0 +1,339 @@
+"""tpulint static analyzer (paddle_tpu/analysis): every hazard class
+must be detected with its exact finding code, the baseline gate must
+ratchet, and the real engine decode program must stay clean (the PR-2
+scatter-free + donated-cache regime, now machine-locked).
+
+Registered in tools/ci.py --quick. No test here executes a compiled
+program — analysis is trace/lower only.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.analysis import (
+    diff_against_baseline, lint_file, lint_program, lint_quarantine,
+    load_baseline, recompile_report)
+from paddle_tpu.analysis.findings import (
+    BAKED_RNG_KEY, DTYPE_PROMOTION, HOST_CALLBACK, JIT_IN_CALL,
+    NUMPY_IN_TRACE, RECOMPILE_DIM, RECOMPILE_STRUCTURE, SCATTER_OP,
+    STALE_QUARANTINE, TRACED_ATTR_MUTATION, UNDONATED_BUFFER, Finding,
+    count_findings)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _codes(findings):
+    return {f.code for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# program linter: one synthetic program per hazard class, exact codes
+# ---------------------------------------------------------------------------
+
+def test_dtype_promotion_detected():
+    def f(x):
+        return x.astype(jnp.float32) * 2          # bf16 -> f32 widening
+
+    fs = lint_program("p", f, (jnp.ones(256, jnp.bfloat16),))
+    promo = [f_ for f_ in fs if f_.code == DTYPE_PROMOTION]
+    assert len(promo) == 1 and promo[0].site == "bfloat16->float32"
+    # scalar / tiny converts don't fire (promotion_min_elems)
+    fs2 = lint_program("p2", f, (jnp.ones(4, jnp.bfloat16),))
+    assert DTYPE_PROMOTION not in _codes(fs2)
+
+
+def test_scatter_detected_including_nested_scan():
+    def f(cache, idx, v):
+        def body(c, i):
+            return c.at[idx].set(v), i
+        out, _ = jax.lax.scan(body, cache, jnp.arange(3))
+        return out
+
+    fs = lint_program("p", f, (jnp.zeros((8, 8)), jnp.int32(1),
+                               jnp.ones(8)))
+    sc = [f_ for f_ in fs if f_.code == SCATTER_OP]
+    assert sc and sc[0].site == "scatter"
+
+
+def test_host_callback_detected():
+    def f(x):
+        return jax.pure_callback(
+            lambda v: np.asarray(v), jax.ShapeDtypeStruct(x.shape,
+                                                          x.dtype), x)
+
+    fs = lint_program("p", f, (jnp.ones(8),))
+    assert HOST_CALLBACK in _codes(fs)
+
+
+def test_baked_rng_key_detected_and_threaded_key_clean():
+    baked = jax.random.PRNGKey(7)
+
+    def bad(x):
+        return x + jax.random.normal(baked, x.shape)
+
+    def good(x, key):
+        return x + jax.random.normal(key, x.shape)
+
+    assert BAKED_RNG_KEY in _codes(lint_program("b", bad, (jnp.ones(8),)))
+    assert BAKED_RNG_KEY not in _codes(
+        lint_program("g", good, (jnp.ones(8), jax.random.PRNGKey(0))))
+
+
+def test_undonated_buffer_detected_and_donation_clears_it():
+    def f(cache, x):
+        return cache + x, x.sum()
+
+    cache = jnp.zeros((64, 256), jnp.float32)    # 64 KiB >= threshold
+    x = jnp.ones((64, 256), jnp.float32)
+    fs = lint_program("p", jax.jit(f), (cache, x))
+    assert UNDONATED_BUFFER in _codes(fs)
+    fs2 = lint_program("p", jax.jit(f, donate_argnums=(0, 1)), (cache, x))
+    assert UNDONATED_BUFFER not in _codes(fs2)
+
+
+# ---------------------------------------------------------------------------
+# recompile-hazard analyzer
+# ---------------------------------------------------------------------------
+
+def test_recompile_dim_exact():
+    specs = [(np.zeros((1, p), np.int64), np.zeros((4,), np.float32))
+             for p in (7, 9, 13)]
+    fs = recompile_report("gen", specs)
+    assert len(fs) == 1 and fs[0].code == RECOMPILE_DIM
+    assert fs[0].site == "arg0"
+    assert fs[0].data["varying_dims"] == [1]
+    assert fs[0].data["distinct_programs"] == 3
+
+
+def test_recompile_stable_specs_clean_and_structure_drift():
+    stable = [(np.zeros((1, 8)),)] * 3
+    assert recompile_report("gen", stable) == []
+    drift = [({"a": np.zeros(3)},), ({"a": np.zeros(3),
+                                      "b": np.zeros(3)},)]
+    fs = recompile_report("gen", drift)
+    assert [f.code for f in fs] == [RECOMPILE_STRUCTURE]
+
+
+def test_recompile_dtype_drift_flagged():
+    fs = recompile_report("gen", [(np.zeros(8, np.float32),),
+                                  (np.zeros(8, np.float64),)])
+    assert fs and fs[0].code == RECOMPILE_DIM
+    assert "dtype varies" in fs[0].message
+
+
+# ---------------------------------------------------------------------------
+# codebase (AST) lint
+# ---------------------------------------------------------------------------
+
+_SNIPPET = '''
+import jax
+import numpy as np
+from paddle_tpu.nn import Layer
+
+
+def hot(x):
+    return jax.jit(lambda v: v * 2)(x)            # retrace per call
+
+
+class Gate(Layer):
+    def forward(self, x):
+        stats = np.asarray(x)                     # concretizes tracer
+        self._last = x * 2                        # tracer on the layer
+        self._ok = x.sum()   # tpulint: disable=traced-attr-mutation
+        self.training = True                      # constant: trace-safe
+        return x
+
+
+class HostSide:                                   # not a Layer: exempt
+    def forward(self, x):
+        self.cache = np.asarray(x)
+        return x
+'''
+
+
+def test_codebase_lint_synthetic(tmp_path):
+    p = tmp_path / "snippet.py"
+    p.write_text(_SNIPPET)
+    fs = lint_file(str(p), str(tmp_path))
+    by_code = {}
+    for f in fs:
+        by_code.setdefault(f.code, []).append(f)
+    assert [f.site for f in by_code[JIT_IN_CALL]] == ["hot"]
+    assert [f.site for f in by_code[TRACED_ATTR_MUTATION]] == \
+        ["Gate.forward._last"]          # _ok disabled, constants exempt
+    assert [f.site for f in by_code[NUMPY_IN_TRACE]] == \
+        ["Gate.forward.np.asarray"]     # HostSide is not layer-like
+
+
+def test_jit_no_donation_on_hot_wrapper_files(tmp_path):
+    """HOT_JIT_FILES membership is by repo-relative path: the same
+    knob-less jax.jit is info-flagged inside jit/training.py and silent
+    elsewhere."""
+    from paddle_tpu.analysis.findings import JIT_NO_DONATION
+    hot = tmp_path / "paddle_tpu" / "jit" / "training.py"
+    hot.parent.mkdir(parents=True)
+    hot.write_text("import jax\n\ndef build(f):\n    return jax.jit(f)\n")
+    fs = lint_file(str(hot), str(tmp_path))
+    assert [f.code for f in fs] == [JIT_NO_DONATION]
+    cold = tmp_path / "paddle_tpu" / "other.py"
+    cold.write_text("import jax\n\ndef build(f):\n    return jax.jit(f)\n")
+    assert lint_file(str(cold), str(tmp_path)) == []
+
+
+def test_quarantine_machine_check(tmp_path):
+    q = tmp_path / "flaky_quarantine.txt"
+    q.write_text(
+        "# comment\n"
+        "tests/test_analysis.py::test_quarantine_machine_check\n"
+        "tests/no_such_file.py::test_gone\n"
+        "name_that_matches_no_test\n")
+    fs = lint_quarantine(ROOT, quarantine_path=str(q))
+    stale = sorted(f.site for f in fs)
+    assert all(f.code == STALE_QUARANTINE for f in fs)
+    assert stale == ["name_that_matches_no_test",
+                     "tests/no_such_file.py::test_gone"]
+
+
+def test_quarantine_class_based_nodeids_and_substrings_resolve(tmp_path):
+    """Class-based nodeids (path::TestCls::test_fn) and Test-class -k
+    substrings are valid quarantine entries and must not read as stale
+    (ci.py's own _quarantine() accepts them; the policies must agree)."""
+    q = tmp_path / "q.txt"
+    q.write_text(
+        "tests/test_analysis.py::TestGateAnchors::test_anchor_is_"
+        "segment_bounded\n"
+        "TestGateAnchors\n"
+        "flash_kernel\n")     # -k also matches MODULE names (whole-file)
+    assert lint_quarantine(ROOT, quarantine_path=str(q)) == []
+
+
+def test_run_manifest_rejects_unknown_program_names():
+    from paddle_tpu.analysis import run_manifest
+    with pytest.raises(ValueError, match="unknown manifest program"):
+        run_manifest(["gpt_deocde"])      # typo must not silently pass
+
+
+def test_repo_quarantine_entries_all_resolve():
+    """The checked-in registry must be clean — known failures stay
+    tracked, not rotted (satellite: machine-checked annotations)."""
+    assert lint_quarantine(ROOT) == []
+
+
+# ---------------------------------------------------------------------------
+# baseline gate semantics
+# ---------------------------------------------------------------------------
+
+def _mk(code, program, site, sev="warn", count=1):
+    return Finding(code, sev, program, site, "m",
+                   {"count": count} if count != 1 else {})
+
+
+def test_gate_ratchets_on_counts_and_weights():
+    base = {"counts": {"scatter-op::p::scatter": 2}}
+    ok = [_mk("scatter-op", "p", "scatter", count=2)]
+    assert diff_against_baseline(ok, base) == []
+    worse = [_mk("scatter-op", "p", "scatter", count=3)]
+    new = diff_against_baseline(worse, base)
+    assert len(new) == 1 and "exceeds baseline" in new[0]["reason"]
+    # info inventories are count-pinned too: a gather/collective count
+    # regression gates exactly like a warn (the documented contract)
+    info = [_mk("gather-op", "p", "gather", sev="info", count=3)]
+    assert diff_against_baseline(
+        info, {"counts": {"gather-op::p::gather": 3}}) == []
+    assert diff_against_baseline(
+        info, {"counts": {"gather-op::p::gather": 2}})
+
+
+class TestGateAnchors:
+    def test_anchor_beats_counts(self):
+        base = {"counts": {"scatter-op::p::scatter": 5},
+                "must_stay_clean": ["scatter-op::p"]}
+        new = diff_against_baseline([_mk("scatter-op", "p", "scatter")],
+                                    base)
+        assert len(new) == 1 and "must_stay_clean" in new[0]["reason"]
+
+    def test_anchor_is_segment_bounded(self):
+        """Anchor 'x::train_step' must not capture a future program
+        named 'train_step_acc' (prefix match is '::'-bounded)."""
+        base = {"counts": {"scatter-op::train_step_acc::scatter": 1},
+                "must_stay_clean": ["scatter-op::train_step"]}
+        ok = [_mk("scatter-op", "train_step_acc", "scatter")]
+        assert diff_against_baseline(ok, base) == []
+        hit = [_mk("scatter-op", "train_step", "scatter")]
+        assert diff_against_baseline(hit, base)
+
+
+def test_count_findings_weights_op_counts():
+    counts = count_findings([_mk("scatter-op", "p", "scatter", count=2),
+                             _mk("scatter-op", "p", "scatter")])
+    assert counts == {"scatter-op::p::scatter": 3}
+
+
+# ---------------------------------------------------------------------------
+# the acceptance demonstration: a seeded hazard fails the CHECKED-IN
+# baseline, and the real engine decode program stays clean
+# ---------------------------------------------------------------------------
+
+def test_seeded_scatter_cache_write_fails_checked_in_baseline():
+    """Reintroducing a scatter cache write into the decode program (the
+    exact PR-2 hazard) must fail the CI gate against the committed
+    baseline — the must_stay_clean anchor fires even if counts were
+    bumped."""
+    def bad_decode(cache, tok, pos):
+        # the regression tpulint exists to catch: per-row scatter write
+        return cache.at[jnp.arange(cache.shape[0]), pos].set(
+            tok.astype(cache.dtype))
+
+    cache = jnp.zeros((4, 64, 8), jnp.float32)
+    fs = lint_program(
+        "gpt_decode", jax.jit(bad_decode, donate_argnums=(0,)),
+        (cache, jnp.zeros((4, 8), jnp.int32), jnp.zeros(4, jnp.int32)))
+    base = load_baseline(os.path.join(ROOT, "tools",
+                                      "tpulint_baseline.json"))
+    new = diff_against_baseline(fs, base)
+    assert any(n["code"] == SCATTER_OP and n["program"] == "gpt_decode"
+               for n in new), new
+
+
+def test_real_engine_decode_program_is_clean():
+    """The engine's batched decode program: no scatter (one-hot masked
+    cache writes), KV cache donated, no baked keys, no host callbacks —
+    the donation satellite + PR-2 write regime, asserted on the REAL
+    program via the same manifest builder the CLI uses."""
+    from paddle_tpu.analysis.manifest import _build_gpt_decode
+    prog, args, cleanup = _build_gpt_decode()
+    try:
+        fs = lint_program("gpt_decode", prog, args)
+    finally:
+        cleanup()
+    codes = _codes(fs)
+    assert SCATTER_OP not in codes
+    assert UNDONATED_BUFFER not in codes      # cache donation wired
+    assert BAKED_RNG_KEY not in codes
+    assert HOST_CALLBACK not in codes
+    # and the committed baseline accepts the program as-is
+    base = load_baseline(os.path.join(ROOT, "tools",
+                                      "tpulint_baseline.json"))
+    assert diff_against_baseline(fs, base) == []
+
+
+def test_tpulint_cli_codebase_only_gate_passes(capsys, monkeypatch):
+    """The CLI contract tpu_suite2.sh relies on: last stdout line is a
+    good JSON record (tools/_have_result.py), gate passes on HEAD.
+    Run in-process (runpy) — a subprocess would pay a cold paddle_tpu
+    import (~10 s) for nothing on the 1-core tier-1 budget."""
+    import runpy
+    monkeypatch.setattr(sys, "argv", ["tpulint.py", "--codebase-only"])
+    with pytest.raises(SystemExit) as exc:
+        runpy.run_path(os.path.join(ROOT, "tools", "tpulint.py"),
+                       run_name="__main__")
+    assert exc.value.code == 0
+    rec = json.loads(
+        capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["gate"] == "pass" and "error" not in rec

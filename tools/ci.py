@@ -52,7 +52,22 @@ QUICK_FILES = [
     # serving engine: continuous batching is a core-correctness surface
     # (greedy token-identity + the no-recompile guarantee)
     "tests/test_engine.py",
+    # static analyzer: hazard-class detection must stay exact
+    "tests/test_analysis.py",
 ]
+
+
+def _run_tpulint(env) -> int:
+    """tpulint gate: static analysis of the real compiled programs +
+    codebase vs tools/tpulint_baseline.json (PR 3). Nonzero when a NEW
+    hazard (scatter on the decode path, dropped donation, retrace-per-
+    call jit, ...) appears — same ratchet policy as the quarantine
+    list, but machine-diffed. Accept an intentional finding with
+    `python tools/tpulint.py --update-baseline` after review."""
+    print("\n=== tpulint static-analysis gate ===")
+    return subprocess.run(
+        [sys.executable, os.path.join("tools", "tpulint.py")],
+        cwd=ROOT, env=env).returncode
 
 
 def _quarantine():
@@ -86,6 +101,11 @@ def main():
                          "by sharding")
     ap.add_argument("--quick", action="store_true",
                     help="core-correctness subset only (<5 min target)")
+    ap.add_argument("--tpulint", action="store_true",
+                    help="run ONLY the tpulint static-analysis gate")
+    ap.add_argument("--no-tpulint", action="store_true",
+                    help="skip the tpulint gate that --quick/--full "
+                         "append after the tests")
     ap.add_argument("-k", default=None)
     args = ap.parse_args()
     if args.full and args.quick:
@@ -120,6 +140,9 @@ def main():
                    os.path.expanduser("~/.cache/paddle_tpu_ci_xla"))
     env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 
+    if args.tpulint:
+        return _run_tpulint(env)
+
     # --quick keeps its file scope through retries: an empty last-failed
     # cache (collection error) must not balloon a retry into the full
     # fast suite on this 1-core machine
@@ -146,6 +169,12 @@ def main():
                                env, default_target=False) not in (0, 5)
         if bad:
             print("quarantined tests still failing (non-fatal)")
+
+    # static-analysis gate rides after the test gates in the blocking
+    # profiles (warm-cache cost ~15 s; the analyzers only trace/lower)
+    if (args.quick or args.full) and not args.no_tpulint:
+        lint_rc = _run_tpulint(env)
+        rc = rc or lint_rc
     return rc
 
 

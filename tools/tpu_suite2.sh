@@ -28,6 +28,11 @@ run bench_serving bench_serving.json python tools/bench_serving.py
 run bench_serving_concurrent bench_serving_concurrent.json \
     python tools/bench_serving.py --concurrent
 run kv_quality kv_quality.json python tools/kv_cache_quality.py
+# static-analysis gate (PR 3): lints the real decode/prefill/train-step
+# programs vs tools/tpulint_baseline.json; self-skips once landed (the
+# terminal stdout line is a _have_result-good JSON record even when the
+# gate FAILS — a failing gate is a landed measurement, check "gate")
+run tpulint tpulint.json python tools/tpulint.py
 # 5. 125M A/Bs (re-use the warm compile cache): fused-CE, pure-bf16 opt
 run bench_125m_fused bench_125m_fused.json \
     env PADDLE_TPU_BENCH_FUSED_CE=1024 python bench.py
