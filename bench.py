@@ -385,6 +385,37 @@ def main():
             sweep[str(b2)] = "error: %s" % str(e)[:120]
             _log("stage=sweep b=%d FAILED: %s" % (b2, str(e)[:160]))
 
+    # optional fused-loop A/B (PADDLE_TPU_BENCH_SCAN_STEPS=K, PR 4):
+    # the SAME donated step program dispatched as K-step scanned windows
+    # (TrainStep.scan_steps) instead of per-step calls — extras-only,
+    # the driver metric keeps per-step dispatch so its geometry stays
+    # comparable across rounds. tools/bench_train_loop.py is the
+    # dedicated dispatch-overhead bench; this lever shows the effect at
+    # bench geometry. Watchdog stays disarmed (extras contract above).
+    scan_extra = {}
+    scan_k = _int_env("PADDLE_TPU_BENCH_SCAN_STEPS", 0)
+    if scan_k > 1:
+        try:
+            sb = np.stack([np.asarray(ids.value)] * scan_k)
+            _log("stage=scan_compile k=%d" % scan_k)
+            step.scan_steps(scan_k, sb, sb)          # compile + warm
+            n_win = max(1, iters // 2)
+            t0 = time.perf_counter()
+            for _ in range(n_win):
+                last = step.scan_steps(scan_k, sb, sb)
+            np.asarray(last.value)                    # terminal sync
+            dt_scan = time.perf_counter() - t0
+            scan_extra = {
+                "scan_steps_k": scan_k,
+                "scan_tokens_per_sec": round(
+                    batch * seq * scan_k * n_win / dt_scan, 2),
+            }
+            _log("stage=scan_steps k=%d tok/s=%s"
+                 % (scan_k, scan_extra["scan_tokens_per_sec"]))
+        except Exception as e:  # noqa: BLE001 — extras-only
+            scan_extra = {"scan_steps_error": str(e)[:120]}
+            _log("stage=scan_steps FAILED: %s" % str(e)[:160])
+
     # MFU estimate: 6N per token (fwd+bwd matmuls) + attention
     # 12*L*H*S (PaLM appendix B accounting, causal halved)
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
@@ -400,6 +431,7 @@ def main():
             "value": round(tokens_per_sec, 2),
             "unit": "tokens/s/chip",
             "vs_baseline": 1.0,
+            **scan_extra,
         }))
         return 0
 
@@ -460,6 +492,8 @@ def main():
     }
     if sweep:
         rec["batch_sweep_tok_s"] = sweep
+    if scan_extra:
+        rec.update(scan_extra)
     if mismatch:
         rec["chip_mismatch"] = True
         rec["baseline_device_kind"] = base_kind

@@ -12,13 +12,19 @@ step additionally compiles for its collective inventory):
                   build_generate_programs) over LLaMA-tiny.
 - train_step:     jit.training.TrainStep's fused whole-step program
                   (donated params/buffers/opt state) over GPT-tiny.
+- train_step_scan: the fused K-STEP training window (PR 4,
+                  TrainStep.scan_steps: lax.scan over a stacked
+                  [K, B, S] super-batch, K optimizer steps in one
+                  donated program, per-step PRNG keys folded in-program
+                  from an argument base key) at K=4 over GPT-tiny.
 - parallel_train_step: distributed.ParallelTrainStep under a fake
                   4-device mesh (dp2 x sharding2, ZeRO-2) — compiled,
                   so the GSPMD-inserted collectives are inventoried.
 
-Plus one static recompile-hazard report: the sequential generate()
+Plus two static recompile-hazard reports: the sequential generate()
 path's per-(prompt-len) program key, the hazard the engine's prefill
-buckets exist to close (PR 2).
+buckets exist to close (PR 2), and the fused train loop's pinned
+2-program signature (scanned window + trailing per-step, PR 4).
 
 Everything is tiny-config and CPU-safe; no program is executed.
 """
@@ -40,7 +46,8 @@ __all__ = ["ProgramSpec", "default_manifest", "run_manifest",
            "MANIFEST_PROGRAMS"]
 
 MANIFEST_PROGRAMS = ("gpt_decode", "llama_prefill", "train_step",
-                     "parallel_train_step", "generate_prompt_drift")
+                     "train_step_scan", "parallel_train_step",
+                     "generate_prompt_drift", "train_scan_window_drift")
 
 
 @dataclass
@@ -116,6 +123,26 @@ def _build_train_step():
     return step._jitted, args, None
 
 
+def _build_train_step_scan():
+    """The fused K-step window exactly as Model.fit dispatches it:
+    TrainStep.scan_steps' jitted program at K=4 — super-batch + state
+    donated, the PRNG base key an ARGUMENT (per-step keys fold in-
+    program), no host callback anywhere in the window."""
+    from ..jit.training import TrainStep
+    model = _gpt_tiny_model()
+    loss_fn, opt, _rng = _train_step_parts(model)
+    step = TrainStep(model, loss_fn, opt)
+    K = 4
+    prog = step._get_scan_prog(K, 2)
+    ids = np.zeros((K, 2, 32), np.int64)
+    args = (step.params, step.buffers, step.opt_state,
+            _rng.get_rng_state(),
+            np.full((K,), 1e-3, np.float32),
+            np.arange(1, K + 1, dtype=np.float32),
+            np.arange(1, K + 1, dtype=np.int32), ids, ids)
+    return prog, args, None
+
+
 def _build_parallel_train_step():
     from ..distributed import mesh as mesh_mod
     from ..distributed.parallel_step import ParallelTrainStep
@@ -155,6 +182,7 @@ def default_manifest() -> List[ProgramSpec]:
         ProgramSpec("gpt_decode", _build_gpt_decode),
         ProgramSpec("llama_prefill", _build_llama_prefill),
         ProgramSpec("train_step", _build_train_step),
+        ProgramSpec("train_step_scan", _build_train_step_scan),
         ProgramSpec("parallel_train_step", _build_parallel_train_step,
                     compile_collectives=True),
     ]
@@ -168,6 +196,20 @@ def _generate_prompt_drift_report() -> List[Finding]:
     analyzer honest) in the baseline."""
     specs = [(np.zeros((1, p), np.int64),) for p in (7, 9, 13)]
     return recompile_report("generate_prompt_drift", specs)
+
+
+def _train_scan_window_drift_report() -> List[Finding]:
+    """The fused train loop's PINNED recompile signature: one drifting-
+    length epoch dispatches exactly TWO abstract call shapes — the
+    scanned [K, B, S] super-batch window and the trailing per-step
+    [B, S] batch (Model._run_epoch_fused's fallback). The baseline pins
+    this at 2 programs; a third signature appearing here means the
+    fused driver started re-tracing per window length (the hazard
+    tests/test_scan_train.py's trace counter also guards at runtime)."""
+    specs = [(np.zeros((4, 2, 32), np.int64),
+              np.zeros((4, 2, 32), np.int64)),
+             (np.zeros((2, 32), np.int64), np.zeros((2, 32), np.int64))]
+    return recompile_report("train_scan_window_drift", specs)
 
 
 def run_manifest(programs: Optional[List[str]] = None,
@@ -201,4 +243,7 @@ def run_manifest(programs: Optional[List[str]] = None,
     if wanted is None or "generate_prompt_drift" in wanted:
         findings.extend(_generate_prompt_drift_report())
         ran.append("generate_prompt_drift")
+    if wanted is None or "train_scan_window_drift" in wanted:
+        findings.extend(_train_scan_window_drift_report())
+        ran.append("train_scan_window_drift")
     return findings, ran

@@ -270,6 +270,10 @@ class Tensor:
             yield self[i]
 
     def __float__(self):
+        # THE scalar device->host sync; counted so the fused train loop's
+        # zero-mid-window-sync guarantee is assertable (framework.syncs)
+        from ..framework import syncs
+        syncs.record_sync()
         return float(self.value)
 
     def __int__(self):

@@ -141,6 +141,14 @@ class ParallelTrainStep:
         # flush_accumulation programs keyed by remainder r (tpulint
         # jit-in-call: a fresh jax.jit per flush re-traced every time)
         self._flush_progs = {}
+        # scanned K-step fused programs keyed by (k_steps, batch avals)
+        self._scan_progs = {}
+        # trace-time program counter (same contract as jit.TrainStep)
+        self._trace_count = 0
+        # LR-scheduler ownership knob, honored by BOTH __call__ and
+        # scan_steps (same contract as jit.TrainStep.auto_lr_step):
+        # False = an external owner steps the schedule between calls
+        self.auto_lr_step = True
 
         shardings = param_sharding(model, self.mesh)
         params, buffers = raw_state(model)
@@ -276,8 +284,10 @@ class ParallelTrainStep:
             out.append(NamedSharding(mesh, P(*spec)))
         return tuple(out)
 
-    def _build(self, raw_batch):
-        model, loss_fn, optimizer = self.model, self.loss_fn, self.optimizer
+    def _make_fwd_bwd(self):
+        """fwd+loss+bwd closure shared by the per-step and scanned
+        programs (same graph -> bitwise-equal trajectories)."""
+        model, loss_fn = self.model, self.loss_fn
         n_in = self.n_inputs
         # stage >= 2: gradients reduce-scattered into the ZeRO layout
         # (stage 1 shards only the optimizer state, reference stage1/2 split)
@@ -321,6 +331,13 @@ class ParallelTrainStep:
                     g, grad_shardings[n]) for n, g in grads.items()}
             return loss, new_bufs, grads
 
+        return fwd_bwd
+
+    def _build(self, raw_batch):
+        optimizer = self.optimizer
+        fwd_bwd = self._make_fwd_bwd()
+        step_self = self
+
         in_batch = self._batch_sharding(raw_batch)
         buf_shardings = {n: NamedSharding(self.mesh, P())
                          for n in self.buffers}
@@ -330,6 +347,7 @@ class ParallelTrainStep:
         if k == 1:
             def full_step(params, buffers, opt_state, lr, step_no, rng_key,
                           *batch):
+                step_self._trace_count += 1   # fires at trace time only
                 loss, new_bufs, grads = fwd_bwd(params, buffers, lr, step_no,
                                                 rng_key, *batch)
                 new_params, new_opt = optimizer.apply_gradients(
@@ -352,6 +370,7 @@ class ParallelTrainStep:
 
         def acc_step(params, buffers, opt_state, acc, lr, step_no, rng_key,
                      *batch):
+            step_self._trace_count += 1       # fires at trace time only
             loss, new_bufs, grads = fwd_bwd(params, buffers, lr, step_no,
                                             rng_key, *batch)
             new_acc = {n: acc[n] + grads[n] for n in acc}
@@ -359,6 +378,7 @@ class ParallelTrainStep:
 
         def apply_step(params, buffers, opt_state, acc, lr, step_no, rng_key,
                        *batch):
+            step_self._trace_count += 1       # fires at trace time only
             loss, new_bufs, grads = fwd_bwd(params, buffers, lr, step_no,
                                             rng_key, *batch)
             mean = {n: (acc[n] + grads[n]) / k for n in acc}
@@ -460,7 +480,7 @@ class ParallelTrainStep:
                 self.params, self.buffers, self.opt_state, lr, step_no,
                 rng_key, *raw_batch)
         lr_sched = getattr(self.optimizer, "_learning_rate", None)
-        if hasattr(lr_sched, "step"):
+        if self.auto_lr_step and hasattr(lr_sched, "step"):
             lr_sched.step()
         # FLAGS_check_nan_inf wiring (framework/nan_inf.py): scan the
         # step loss — the one concrete value the fused program yields —
@@ -472,6 +492,117 @@ class ParallelTrainStep:
             from ..framework.nan_inf import check_numerics
             check_numerics(loss, "ParallelTrainStep.step")
         return Tensor(loss)
+
+    # ------------------------------------------------------------------
+    # fused K-step window (lax.scan under the mesh)
+    # ------------------------------------------------------------------
+    def _scan_batch_sharding(self, raw_batch):
+        """Stacked super-batch shardings: the single-batch spec shifted
+        one dim right (the leading K window dim is never sharded — the
+        scan walks it)."""
+        singles = self._batch_sharding(tuple(
+            jax.ShapeDtypeStruct(b.shape[1:], b.dtype) for b in raw_batch))
+        return tuple(NamedSharding(self.mesh, P(None, *s.spec))
+                     for s in singles)
+
+    def _get_scan_prog(self, k_steps: int, raw_batch):
+        """The jitted K-step fused program over the mesh — same
+        signature/semantics as jit.TrainStep._get_scan_prog, with the
+        per-step batch sharded exactly as the per-step program shards
+        it (the window dim replicated, scan slices it locally)."""
+        key_sig = (int(k_steps),
+                   tuple((tuple(b.shape), str(b.dtype)) for b in raw_batch))
+        prog = self._scan_progs.get(key_sig)
+        if prog is not None:
+            return prog
+        from ..jit.training import make_scan_window
+        fwd_bwd = self._make_fwd_bwd()
+
+        def fwd(params, buffers, opt_state, lr, step_no, rng_key, *batch):
+            # adapt to the shared window builder's fwd contract —
+            # fwd_bwd doesn't consume opt_state
+            return fwd_bwd(params, buffers, lr, step_no, rng_key, *batch)
+
+        k = self.accumulate_steps
+        n_batch = len(raw_batch)
+        scan_window = make_scan_window(fwd, self.optimizer, k,
+                                       self._count_trace)
+
+        in_batch = self._scan_batch_sharding(raw_batch)
+        buf_shardings = {n: NamedSharding(self.mesh, P())
+                         for n in self.buffers}
+        scalar_sh = NamedSharding(self.mesh, P())
+
+        if k == 1:
+            prog = jax.jit(
+                scan_window,
+                in_shardings=(self.param_shardings, buf_shardings,
+                              self.opt_shardings, None, None, None, None)
+                + in_batch,
+                out_shardings=(scalar_sh, self.param_shardings,
+                               buf_shardings, self.opt_shardings),
+                donate_argnums=(0, 1, 2) + tuple(range(7, 7 + n_batch)))
+        else:
+            acc_sh = self.acc_grad_shardings
+            prog = jax.jit(
+                scan_window,
+                in_shardings=(self.param_shardings, buf_shardings,
+                              self.opt_shardings, acc_sh, None, None,
+                              None, None, None) + in_batch,
+                out_shardings=(scalar_sh, self.param_shardings,
+                               buf_shardings, self.opt_shardings, acc_sh),
+                donate_argnums=(0, 1, 2, 3) + tuple(
+                    range(9, 9 + n_batch)))
+        self._scan_progs[key_sig] = prog
+        return prog
+
+    def _count_trace(self):
+        self._trace_count += 1    # fires at trace time only
+
+    def scan_steps(self, k_steps: int, *batch) -> Tensor:
+        """K fused (micro-)steps in ONE compiled program over the mesh —
+        see jit.TrainStep.scan_steps for the full contract (stacked
+        ``[k_steps, ...]`` leaves, donated super-batch, device-resident
+        stacked losses, bitwise sequential-equivalence)."""
+        if self._abstract:
+            raise RuntimeError(
+                "this ParallelTrainStep was built from a LazyGuard "
+                "(abstract) model — only aot_compile() is available; "
+                "construct the model outside LazyGuard to train")
+        if k_steps < 1:
+            raise ValueError("k_steps must be >= 1")
+        raw_batch = _raw_tuple(batch)
+        for b in raw_batch:
+            if b.ndim < 1 or b.shape[0] != k_steps:
+                raise ValueError(
+                    f"scan_steps batch leaves must be stacked "
+                    f"[{k_steps}, ...]; got shape {b.shape}")
+        prog = self._get_scan_prog(k_steps, raw_batch)
+        base_key = _rng.get_rng_state()
+        from ..jit.training import (_quiet_unused_donation,
+                                    window_rollback, window_schedule)
+        with window_rollback(self):
+            lrs, step_nos, counts, upd = window_schedule(self, k_steps)
+            with _quiet_unused_donation():
+                if self.accumulate_steps > 1:
+                    (losses, self.params, self.buffers, self.opt_state,
+                     self.acc_grads) = prog(
+                        self.params, self.buffers, self.opt_state,
+                        self.acc_grads, base_key, lrs, step_nos, counts,
+                        upd, *raw_batch)
+                else:
+                    (losses, self.params, self.buffers,
+                     self.opt_state) = prog(
+                        self.params, self.buffers, self.opt_state,
+                        base_key, lrs, step_nos, counts, *raw_batch)
+        # one stacked-loss scan per WINDOW when the nan flag is armed —
+        # the fused loop's supervision cost is 1 sync / K steps
+        # (check_numerics takes the raw jax array, same as __call__)
+        from ..framework import flags as _flags
+        if _flags.flag_value("check_nan_inf"):
+            from ..framework.nan_inf import check_numerics
+            check_numerics(losses, "ParallelTrainStep.scan_steps")
+        return Tensor(losses)
 
     # ------------------------------------------------------------------
     def flush_accumulation(self):

@@ -455,43 +455,83 @@ class StepWatchdog:
         raise exc
 
     @staticmethod
-    def _loss_is_finite(result) -> bool:
-        """Scan a step result for non-finite loss. Accepts Tensor /
-        jax array / float / anything float()-able; non-numeric results
-        count as finite (nothing to scan)."""
+    def _loss_finite_seq(result):
+        """Per-step finiteness of a step result's loss(es), in step
+        order. Handles a scalar (float / Tensor / lazy loss — anything
+        float()-able or numpy-coercible) AND a fused K-step window's
+        STACKED losses (a [K] array: one entry per optimizer step, so a
+        storm inside a window is still counted step-by-step). Reading
+        the values is the fused loop's one sync per supervised window
+        (a LossWindow result shares its fetch with the training loop's
+        lazy losses). A non-numeric result counts as ONE finite step —
+        the pre-fused watchdog's contract: nothing to scan means the
+        consecutive-NaN streak is broken, not paused."""
+        v = result
+        if isinstance(v, (tuple, list)) and v:
+            v = v[0]
+        if v is None:
+            return (True,)
         try:
-            v = result
-            # Tensor and jax arrays both support float() on scalars
-            if isinstance(v, (tuple, list)) and v:
-                v = v[0]
-            return math.isfinite(float(v))
-        except (TypeError, ValueError):
-            return True
+            import numpy as np  # lazy: module contract is stdlib-only
+            arr = np.asarray(v, dtype=np.float64).reshape(-1)
+            return [bool(np.isfinite(x)) for x in arr]
+        except Exception:
+            try:
+                return (math.isfinite(float(v)),)
+            except (TypeError, ValueError):
+                return (True,)
 
     # -- API -------------------------------------------------------------
-    def run(self, step_fn: Callable, *args, **kwargs):
-        """Execute one supervised step; returns its result."""
+    def run(self, step_fn: Callable, *args, deadline_scale: int = 1,
+            **kwargs):
+        """Execute one supervised step (or one fused K-step window —
+        pass ``deadline_scale=K`` so the single dispatch gets K per-step
+        budgets); returns its result."""
         self.steps_run += 1
-        if self.deadline is None:
+        deadline = self.deadline
+        if deadline is not None:
+            deadline = deadline * max(1, int(deadline_scale))
+        if deadline is None:
             result = step_fn(*args, **kwargs)
+            finite_seq = self._loss_finite_seq(result)
         else:
             self._ensure_worker()
             box: list = []
             done = threading.Event()
-            self._work.put((step_fn, args, kwargs, box, done))
-            if not done.wait(self.deadline):
+
+            def supervised():
+                # jax dispatch is ASYNC and the loop's losses are lazy:
+                # step_fn returns in microseconds whatever the device is
+                # doing. The loss scan below is the step's first (and
+                # only) blocking device read, so it must run HERE, in
+                # the deadline-covered worker — a wedged collective
+                # hangs THIS fetch, trips done.wait, and raises
+                # StepTimeout instead of hanging the caller. The fetch
+                # lands in the step's shared LazyLoss/LossWindow cache,
+                # so it is still the one counted sync per supervised
+                # step/window.
+                res = step_fn(*args, **kwargs)
+                return res, self._loss_finite_seq(res)
+
+            self._work.put((supervised, (), {}, box, done))
+            if not done.wait(deadline):
                 self._dead = True   # worker is wedged; abandon it
                 self._fail("hang", StepTimeout(
-                    f"train step exceeded its {self.deadline:.1f}s "
+                    f"train step exceeded its {deadline:.1f}s "
                     "deadline (wedged collective / hung device "
                     "dispatch?) — state checkpointed on failure"))
-            ok, result = box[0]
+            ok, payload = box[0]
             if not ok:
-                raise result
-        # nan/inf storm accounting on the (synced) loss
-        if self._loss_is_finite(result):
-            self.nonfinite_streak = 0
-        else:
+                raise payload
+            result, finite_seq = payload
+        # nan/inf storm accounting on the (synced) loss(es) — a fused
+        # window contributes its K stacked losses one by one, so the
+        # consecutive-step streak spans window boundaries exactly as it
+        # would in the per-step loop
+        for finite in finite_seq:
+            if finite:
+                self.nonfinite_streak = 0
+                continue
             self.nonfinite_streak += 1
             if self.nonfinite_streak >= self.nan_limit:
                 streak = self.nonfinite_streak

@@ -1,0 +1,24 @@
+"""Shared integer env-knob parsing.
+
+One definition for the idiom every tuning knob repeats (serving-engine
+slot counts, fused-loop window size, prefetch depth, bench levers):
+read the variable, fall back to the default on garbage, optionally
+clamp to a floor.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["int_env"]
+
+
+def int_env(name: str, default: int, minimum: int | None = None) -> int:
+    """``int(os.environ[name])`` with ``default`` on missing/unparseable
+    values; clamped to ``minimum`` when given."""
+    try:
+        value = int(os.environ.get(name, default))
+    except ValueError:
+        return default
+    if minimum is not None and value < minimum:
+        return minimum
+    return value

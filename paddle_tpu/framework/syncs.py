@@ -1,0 +1,45 @@
+"""Host-sync accounting for the training/eval hot paths.
+
+A device->host materialization (``float(loss)``, a lazy-loss window
+fetch, evaluate's batched loss fetch) is the blocking round-trip the
+fused K-step training loop exists to amortize — so the loop's tools
+need to COUNT them. `tools/bench_train_loop.py` asserts zero mid-window
+syncs through this counter, and tests pin the per-window fetch count.
+
+Deliberately tiny: a process-global counter bumped from
+``Tensor.__float__`` and ``hapi.lazy.LossWindow.fetch``. A plain int
+under the GIL is plenty for accounting (the consumers read deltas
+between phases on one thread); no locks on the hot path.
+"""
+from __future__ import annotations
+
+__all__ = ["record_sync", "sync_count", "SyncTracker"]
+
+_count = 0
+
+
+def record_sync(n: int = 1) -> None:
+    """Note that a device->host materialization happened."""
+    global _count
+    _count += n
+
+
+def sync_count() -> int:
+    """Total host syncs recorded since process start."""
+    return _count
+
+
+class SyncTracker:
+    """Delta reader: ``with SyncTracker() as t: ...; t.delta``."""
+
+    def __enter__(self):
+        self.start = sync_count()
+        return self
+
+    def __exit__(self, *exc):
+        self.delta = sync_count() - self.start
+        return False
+
+    @property
+    def so_far(self) -> int:
+        return sync_count() - self.start

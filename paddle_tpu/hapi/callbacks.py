@@ -1,9 +1,24 @@
 """hapi callbacks. Parity: python/paddle/hapi/callbacks.py (Callback
-protocol, ProgBarLogger, EarlyStopping, LRScheduler)."""
+protocol, ProgBarLogger, EarlyStopping, LRScheduler).
+
+Loss values in `logs` may be LAZY (hapi.lazy.LazyLoss, a numbers.Real):
+the fused train loop defers the device->host fetch until a callback
+actually reads/formats the value — ProgBarLogger therefore only touches
+losses at its log_freq boundaries, which is exactly when the fused
+window is materialized (one sync per window)."""
 from __future__ import annotations
 
+import numbers
 import sys
 import time
+
+
+def _fmt_logs(logs) -> str:
+    # numbers.Real covers float/int AND LazyLoss — formatting a lazy
+    # loss here is the (intended) materialization point
+    return ", ".join(f"{k}: {v:.4f}" if isinstance(v, numbers.Real)
+                     and not isinstance(v, bool) else f"{k}: {v}"
+                     for k, v in (logs or {}).items())
 
 __all__ = ["Callback", "ProgBarLogger", "EarlyStopping", "LRScheduler",
            "ModelCheckpoint", "ReduceLROnPlateau", "VisualDL",
@@ -61,18 +76,12 @@ class ProgBarLogger(Callback):
 
     def on_train_batch_end(self, step, logs=None):
         if self.verbose and step % self.log_freq == 0:
-            items = ", ".join(f"{k}: {v:.4f}" if isinstance(v, float)
-                              else f"{k}: {v}"
-                              for k, v in (logs or {}).items())
-            print(f"  step {step}: {items}", file=sys.stderr)
+            print(f"  step {step}: {_fmt_logs(logs)}", file=sys.stderr)
 
     def on_epoch_end(self, epoch, logs=None):
         if self.verbose:
             dur = time.time() - self._start
-            items = ", ".join(f"{k}: {v:.4f}" if isinstance(v, float)
-                              else f"{k}: {v}"
-                              for k, v in (logs or {}).items())
-            print(f"Epoch {epoch + 1} done ({dur:.1f}s) {items}",
+            print(f"Epoch {epoch + 1} done ({dur:.1f}s) {_fmt_logs(logs)}",
                   file=sys.stderr)
 
 

@@ -15,11 +15,13 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 from ..core.tensor import Tensor
+from ..framework.env import int_env
 from ..io.state import load as _load, save as _save
 from ..jit.training import TrainStep
 from ..metric import Metric
 from ..nn.layer_base import Layer
 from .callbacks import EarlyStopping, config_callbacks
+from .lazy import LazyLoss, LossWindow
 
 __all__ = ["Model"]
 
@@ -103,19 +105,41 @@ class Model:
 
     # -- train -----------------------------------------------------------
     def train_batch(self, inputs, labels=None):
-        """Parity: Model.train_batch."""
+        """Parity: Model.train_batch. The returned loss is a LAZY float
+        (hapi.lazy.LazyLoss): the compiled step is dispatched but the
+        device->host sync happens only when the caller actually reads
+        the value — the hot loop never blocks on `float(loss)`."""
         inputs = _as_list(inputs)
         labels = _as_list(labels)
         step = self._ensure_train_step(len(inputs))
         loss = step(*inputs, *labels)
-        return [float(loss)]
+        return [LazyLoss(LossWindow(loss.value))]
 
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
             verbose=1, drop_last=False, shuffle=True, num_workers=0,
-            callbacks=None, accumulate_grad_batches=1, num_iters=None):
+            callbacks=None, accumulate_grad_batches=1, num_iters=None,
+            scan_steps=None):
         """Parity: Model.fit (hapi/model.py:1045). train_data may be a
-        DataLoader or a Dataset (a loader is built with batch_size)."""
+        DataLoader or a Dataset (a loader is built with batch_size).
+
+        ``scan_steps`` (default: PADDLE_TPU_SCAN_STEPS env, else 1):
+        with K>1 the loop runs K optimizer steps per dispatch inside ONE
+        donated compiled program (TrainStep.scan_steps) fed by a
+        double-buffered host->device super-batch pipeline
+        (io.dataloader.prefetch_to_device) — no host sync inside the
+        window; losses reach callbacks as lazy objects that materialize
+        at log_freq/epoch boundaries. Because the K steps execute as
+        one uninterruptible program, per-step callbacks fire POST-HOC:
+        each window's K on_train_batch_begin/end pairs are emitted
+        after the window completes (step indices and losses are exact;
+        wall-clock between begin and end is not, and a begin-callback
+        cannot veto a step inside the window). Trailing partial windows
+        fall back to the per-step program, so step counts, LR schedule,
+        and gradient-accumulation cadence are bitwise those of the
+        per-step loop. When an LRScheduler callback owns schedule
+        stepping the loop stays per-step (the callback steps between
+        batches)."""
         from ..io.dataloader import DataLoader, Dataset
         if accumulate_grad_batches != self._accumulate:
             # gradient merge happens inside the compiled step
@@ -157,12 +181,15 @@ class Model:
         if StepWatchdog.enabled_by_env():
             watchdog = StepWatchdog(
                 on_failure=lambda kind, exc: self._emergency_save(kind))
+        if scan_steps is None:
+            scan_steps = int_env("PADDLE_TPU_SCAN_STEPS", 1, minimum=1)
+        scan_steps = max(1, int(scan_steps))
         for cb in cbs:
             cb.on_train_begin()
         try:
             self._fit_epochs(loader, eval_data, batch_size, epochs,
                              eval_freq, num_workers, num_iters, cbs,
-                             watchdog)
+                             watchdog, scan_steps)
         finally:
             if watchdog is not None:
                 watchdog.close()
@@ -175,7 +202,12 @@ class Model:
         return self
 
     def _fit_epochs(self, loader, eval_data, batch_size, epochs,
-                    eval_freq, num_workers, num_iters, cbs, watchdog):
+                    eval_freq, num_workers, num_iters, cbs, watchdog,
+                    scan_steps=1):
+        # The fused path needs the step to own LR stepping: an external
+        # LRScheduler callback steps BETWEEN batches, which a K-step
+        # window cannot replay mid-program.
+        fused = scan_steps > 1 and self._auto_lr_step
         it_count = 0
         for epoch in range(epochs):
             try:
@@ -184,21 +216,18 @@ class Model:
                 steps = None
             for cb in cbs:
                 cb.on_epoch_begin(epoch, {"steps": steps})
-            logs = {}
-            for step_i, data in enumerate(loader):
-                for cb in cbs:
-                    cb.on_train_batch_begin(step_i)
-                x, y = self._split_batch(data)
-                if watchdog is not None:
-                    (loss,) = watchdog.run(self.train_batch, x, y)
-                else:
-                    (loss,) = self.train_batch(x, y)
-                logs = {"loss": loss}
-                for cb in cbs:
-                    cb.on_train_batch_end(step_i, logs)
-                it_count += 1
-                if num_iters is not None and it_count >= num_iters:
-                    break
+            if fused:
+                logs, it_count = self._run_epoch_fused(
+                    loader, scan_steps, cbs, watchdog, it_count,
+                    num_iters)
+            else:
+                logs, it_count, _ = self._run_epoch_steps(
+                    loader, cbs, watchdog, it_count, num_iters)
+            # epoch boundary: materialize lazy losses (ONE window fetch)
+            # so epoch-end consumers (VisualDL scalars, checkpoints
+            # keyed on loss) see plain floats
+            logs = {k: float(v) if isinstance(v, LazyLoss) else v
+                    for k, v in logs.items()}
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
                 eval_logs = self.evaluate(eval_data, batch_size=batch_size,
                                           verbose=0,
@@ -213,6 +242,83 @@ class Model:
                 break
             if num_iters is not None and it_count >= num_iters:
                 break
+
+    def _run_epoch_steps(self, loader, cbs, watchdog, it_count, num_iters,
+                         step_i=0, batches=None):
+        """The per-step dispatch loop (also the fused loop's trailing-
+        window fallback, via `batches`/`step_i`). Returns
+        ``(logs, it_count, step_i)``."""
+        logs = {}
+        for data in (batches if batches is not None else loader):
+            for cb in cbs:
+                cb.on_train_batch_begin(step_i)
+            x, y = self._split_batch(data)
+            if watchdog is not None:
+                (loss,) = watchdog.run(self.train_batch, x, y)
+            else:
+                (loss,) = self.train_batch(x, y)
+            logs = {"loss": loss}
+            for cb in cbs:
+                cb.on_train_batch_end(step_i, logs)
+            step_i += 1
+            it_count += 1
+            if num_iters is not None and it_count >= num_iters:
+                break
+        return logs, it_count, step_i
+
+    def _run_epoch_fused(self, loader, k, cbs, watchdog, it_count,
+                         num_iters):
+        """One epoch as K-step fused windows: scan_steps programs over
+        prefetched super-batches; callbacks fire per step with LAZY
+        losses (one device fetch per window, at most — and only when
+        something reads them). The window executes BEFORE its K
+        begin/end callback pairs are emitted (see fit's docstring).
+        Trailing partial windows and num_iters caps run the per-step
+        program so step semantics are identical to the sequential
+        loop."""
+        from ..io.dataloader import prefetch_to_device
+        depth = int_env("PADDLE_TPU_PREFETCH_DEPTH", 2, minimum=1)
+        logs = {}
+        step_i = 0
+        for win in prefetch_to_device(loader, k, depth=depth):
+            remaining = None if num_iters is None else num_iters - it_count
+            if win.full and (remaining is None or remaining >= k):
+                x, y = self._split_batch(win.data)
+                step = self._ensure_train_step(len(x))
+
+                def run_window(x=x, y=y):
+                    return LossWindow(step.scan_steps(k, *x, *y).value)
+
+                if watchdog is not None:
+                    # the K-step window is ONE dispatch: its deadline is
+                    # K per-step budgets; the NaN scan coerces the
+                    # returned LossWindow, so supervision shares the
+                    # window's single counted fetch with the lazy
+                    # losses below instead of paying its own transfer
+                    window = watchdog.run(run_window, deadline_scale=k)
+                else:
+                    window = run_window()
+                for j in range(k):
+                    for cb in cbs:
+                        cb.on_train_batch_begin(step_i)
+                    logs = {"loss": LazyLoss(window, j)}
+                    for cb in cbs:
+                        cb.on_train_batch_end(step_i, logs)
+                    step_i += 1
+                    it_count += 1
+            else:
+                # trailing partial window / num_iters cap: per-step
+                # program over the window's rows
+                tail = list(win.rows())
+                if remaining is not None:
+                    tail = tail[:remaining]
+                logs2, it_count, step_i = self._run_epoch_steps(
+                    None, cbs, watchdog, it_count, num_iters,
+                    step_i=step_i, batches=tail)
+                logs = logs2 or logs
+            if num_iters is not None and it_count >= num_iters:
+                break
+        return logs, it_count
 
     def _emergency_save(self, kind: str):
         """Checkpoint-on-failure for the fit loop: atomic tmp+rename of
@@ -241,7 +347,10 @@ class Model:
         if self._train_step is not None:
             self._train_step.sync_to_model()
 
-    def _forward_eval(self, inputs, labels=None):
+    def _forward_eval(self, inputs, labels=None, lazy=False):
+        """Eager eval forward. With ``lazy`` the loss comes back as the
+        raw DEVICE scalar (no host sync) — evaluate() batches the fetch
+        over the whole pass instead of blocking per batch."""
         was_training = self.network.training
         self.network.eval()
         try:
@@ -249,7 +358,10 @@ class Model:
             labels = _as_list(labels)
             loss = self._loss_value(out, labels) \
                 if (self._loss is not None and labels) else None
-            return out, (float(loss) if loss is not None else None)
+            if loss is None:
+                return out, None
+            dev = loss.value if isinstance(loss, Tensor) else loss
+            return out, (dev if lazy else float(loss))
         finally:
             if was_training:
                 self.network.train()
@@ -282,6 +394,9 @@ class Model:
         infer = self._infer_fn()
         if infer is None:
             self._sync()
+        # per-batch losses stay ON DEVICE; the whole pass is fetched in
+        # ONE batched device_get at the end (the per-batch float() here
+        # used to cost a device->host round-trip every batch)
         losses, weights = [], []
         seen = 0
         for step_i, data in enumerate(loader):
@@ -289,10 +404,13 @@ class Model:
             if infer is not None:
                 out = infer(*x)
                 with_loss = self._loss is not None and y
-                loss = float(self._loss_value(out, y)) if with_loss \
-                    else None
+                if with_loss:
+                    lt = self._loss_value(out, y)
+                    loss = lt.value if isinstance(lt, Tensor) else lt
+                else:
+                    loss = None
             else:
-                out, loss = self._forward_eval(x, y)
+                out, loss = self._forward_eval(x, y, lazy=True)
             n = int(x[0].shape[0]) if hasattr(x[0], "shape") else 1
             seen += n
             if loss is not None:
@@ -304,12 +422,18 @@ class Model:
                 else:
                     m.update(out, *y)
             for cb in cbs:
-                cb.on_eval_batch_end(step_i, {"loss": loss})
+                cb.on_eval_batch_end(
+                    step_i, {"loss": None if loss is None
+                             else LazyLoss(LossWindow(loss))})
             if num_samples is not None and seen >= num_samples:
                 break
         logs = {}
         if losses:
-            logs["loss"] = float(np.average(losses, weights=weights))
+            import jax
+            from ..framework import syncs
+            syncs.record_sync()
+            vals = [float(v) for v in jax.device_get(losses)]
+            logs["loss"] = float(np.average(vals, weights=weights))
         for m in self._metrics:
             names = m.name()
             vals = m.accumulate()

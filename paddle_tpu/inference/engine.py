@@ -74,11 +74,8 @@ class EngineOverloaded(RuntimeError):
         self.max_queue = max_queue
 
 
-def _env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, default))
-    except ValueError:
-        return default
+# shared env-knob parser (framework/env.py), aliased to keep call sites
+from ..framework.env import int_env as _env_int
 
 
 def _default_buckets(max_len: int) -> tuple:

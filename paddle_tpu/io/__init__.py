@@ -1,14 +1,18 @@
 """paddle.save/load + paddle.io data pipeline (SURVEY.md §2.8 DataLoader
 row, §5.4 checkpointing)."""
 from .dataloader import (BatchSampler, ChainDataset, ConcatDataset,
-                         DataLoader, Dataset, DistributedBatchSampler,
-                         IterableDataset, RandomSampler, Sampler,
-                         SequenceSampler, Subset, TensorDataset,
-                         default_collate_fn, get_worker_info, random_split, ComposeDataset, WeightedRandomSampler)
+                         DataLoader, Dataset, DeviceWindow,
+                         DistributedBatchSampler, IterableDataset,
+                         RandomSampler, Sampler, SequenceSampler, Subset,
+                         TensorDataset, default_collate_fn,
+                         get_worker_info, prefetch_to_device,
+                         random_split, ComposeDataset,
+                         WeightedRandomSampler)
 from .state import load, save
 
 __all__ = ["save", "load", "Dataset", "IterableDataset", "TensorDataset",
            "ConcatDataset", "ChainDataset", "Subset", "random_split",
            "Sampler", "SequenceSampler", "RandomSampler", "BatchSampler",
            "DistributedBatchSampler", "DataLoader", "default_collate_fn",
-           "get_worker_info", "ComposeDataset", "WeightedRandomSampler"]
+           "get_worker_info", "ComposeDataset", "WeightedRandomSampler",
+           "prefetch_to_device", "DeviceWindow"]

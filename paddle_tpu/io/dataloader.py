@@ -27,7 +27,7 @@ __all__ = ["Dataset", "IterableDataset", "TensorDataset", "ConcatDataset",
            "ChainDataset", "Subset", "random_split", "Sampler",
            "SequenceSampler", "RandomSampler", "BatchSampler",
            "DistributedBatchSampler", "DataLoader", "default_collate_fn",
-           "get_worker_info"]
+           "get_worker_info", "prefetch_to_device", "DeviceWindow"]
 
 
 # ---------------------------------------------------------------------------
@@ -786,3 +786,155 @@ class WeightedRandomSampler(Sampler):
 
     def __len__(self):
         return self.num_samples
+
+
+# ---------------------------------------------------------------------------
+# K-step super-batch prefetch (the fused train loop's input pipeline)
+# ---------------------------------------------------------------------------
+
+def _stack_tree(batches):
+    """Stack a list of structurally-identical batches leaf-wise into one
+    super-batch with a leading [K] window dim. Tensor/ndarray leaves are
+    stacked on HOST with numpy (the feeder thread does this work, numpy
+    releases the GIL); already-device jax leaves stack device-side."""
+    sample = batches[0]
+    if isinstance(sample, Tensor):
+        return np.stack([np.asarray(b.value) for b in batches])
+    if isinstance(sample, np.ndarray):
+        return np.stack(batches)
+    if isinstance(sample, jax.Array):
+        import jax.numpy as jnp
+        return jnp.stack(batches)
+    if isinstance(sample, dict):
+        return {k: _stack_tree([b[k] for b in batches]) for k in sample}
+    if isinstance(sample, (tuple, list)):
+        return type(sample)(_stack_tree(list(items))
+                            for items in zip(*batches))
+    return np.asarray(batches)
+
+
+def _batch_signature(batch):
+    """Leaf (shape, dtype) signature — stackability predicate. A batch
+    whose signature differs from the window under construction (the
+    smaller drop_last=False trailer, length drift) flushes as a tail."""
+    if isinstance(batch, (Tensor, np.ndarray, jax.Array)):
+        v = batch.value if isinstance(batch, Tensor) else batch
+        return (tuple(v.shape), str(v.dtype))
+    if isinstance(batch, dict):
+        return tuple((k, _batch_signature(batch[k])) for k in batch)
+    if isinstance(batch, (tuple, list)):
+        return tuple(_batch_signature(b) for b in batch)
+    return (type(batch).__name__,)
+
+
+class DeviceWindow:
+    """One unit of the prefetch stream: either a FULL stacked super-batch
+    already resident on device (``data``: the batch structure with every
+    leaf ``[k_steps, ...]``) or a TAIL of raw per-step batches
+    (``batches``) that did not fill / could not join a window — the
+    consumer runs those through the per-step program."""
+
+    __slots__ = ("data", "batches")
+
+    def __init__(self, data=None, batches=None):
+        self.data = data
+        self.batches = batches
+
+    @property
+    def full(self) -> bool:
+        return self.data is not None
+
+    def __len__(self):
+        if self.data is not None:
+            leaves = jax.tree_util.tree_leaves(self.data)
+            return int(leaves[0].shape[0]) if leaves else 0
+        return len(self.batches)
+
+    def rows(self):
+        """Per-step batches: slices of the stacked window (device-side
+        row views) or the raw tail batches."""
+        if self.data is None:
+            yield from self.batches
+            return
+        for i in range(len(self)):
+            yield jax.tree_util.tree_map(lambda a: a[i], self.data)
+
+
+def prefetch_to_device(loader, k_steps: int, depth: int = 2, device=None):
+    """Double-buffered host->device super-batch pipeline.
+
+    A feeder thread pulls batches from ``loader``, stacks every
+    ``k_steps`` of them into one ``[k_steps, ...]`` super-batch on host,
+    and ``jax.device_put``s it — so while the consumer trains on window
+    i, window i+1 (up to ``depth`` windows) is already collating and
+    transferring. This is the training-side twin of the serving
+    engine's admission pipeline: the device never waits for input, and
+    the fused K-step program gets its super-batch as ready device
+    buffers (which it then donates).
+
+    Yields :class:`DeviceWindow`; the final partial window (and any
+    batch whose shapes drift mid-stream, e.g. a smaller drop_last=False
+    trailer) comes out as a ``batches`` tail for the per-step fallback.
+    Exceptions in ``loader`` propagate to the consumer. Default depth 2
+    = classic double buffering (PADDLE_TPU_PREFETCH_DEPTH in
+    Model.fit).
+    """
+    if k_steps < 1:
+        raise ValueError("k_steps must be >= 1")
+    q: "queue.Queue" = queue.Queue(maxsize=max(1, int(depth)))
+    stop = threading.Event()
+    DONE = object()
+
+    def put(obj) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(obj, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def feeder():
+        try:
+            buf, sig = [], None
+            for b in loader:
+                s = _batch_signature(b)
+                if buf and s != sig:
+                    # shape drift: flush the unstackable prefix as a tail
+                    if not put(DeviceWindow(batches=buf)):
+                        return
+                    buf = []
+                sig = s
+                buf.append(b)
+                if len(buf) == k_steps:
+                    stacked = jax.device_put(_stack_tree(buf), device)
+                    if not put(DeviceWindow(data=stacked)):
+                        return
+                    buf = []
+            if buf:
+                if not put(DeviceWindow(batches=buf)):
+                    return
+            put(DONE)
+        except BaseException as e:  # propagate to the consumer
+            put(e)
+
+    t = threading.Thread(target=feeder, daemon=True,
+                         name="paddle-tpu-prefetch")
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is DONE:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
+        # unblock a feeder stuck in put() so the thread exits promptly
+        try:
+            while True:
+                q.get_nowait()
+        except queue.Empty:
+            pass
+        t.join(timeout=5)

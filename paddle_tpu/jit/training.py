@@ -12,10 +12,15 @@ over a Mesh.
 """
 from __future__ import annotations
 
+import contextlib
+import warnings
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from ..autograd.tape import no_grad
 from ..core.tensor import Tensor
@@ -36,6 +41,159 @@ def _as_tuple(x):
 def _raw_tuple(xs):
     return tuple(x.value if isinstance(x, Tensor) else jnp.asarray(x)
                  for x in _as_tuple(xs))
+
+
+@contextlib.contextmanager
+def _quiet_unused_donation():
+    """The scanned window donates its super-batch: the buffers are
+    consumed, but scan xs can never alias an output so jax warns the
+    donation was "not usable" on every compile. The donation is still
+    wanted (the input super-batch dies with the call instead of pinning
+    HBM until GC) and tpulint's undonated-buffer anchors guard the
+    donations that DO alias — silence just this message, just here."""
+    with warnings.catch_warnings():
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable")
+        yield
+
+
+@contextlib.contextmanager
+def window_rollback(step):
+    """Undo ``window_schedule``'s K steps of host schedule state if the
+    fused window fails to DISPATCH. The schedule (counters + LR
+    scheduler) is precomputed before the program call, so a trace or
+    compile error — e.g. a K-wide program that OOMs where the per-step
+    one fits — would otherwise leave the schedule up to K steps ahead
+    of the params, poisoning emergency checkpoints and any per-step
+    fallback (the sequential path only ever skews by the 1 in-flight
+    step). A post-dispatch device hang is out of scope: dispatch
+    succeeded, and the sequential loop has the same in-flight skew."""
+    lr_sched = getattr(step.optimizer, "_learning_rate", None)
+    sched_state = (lr_sched.state_dict()
+                   if hasattr(lr_sched, "state_dict") else None)
+    prev_step, prev_update = step.step_count, step.update_count
+    try:
+        yield
+    except BaseException:
+        step.step_count, step.update_count = prev_step, prev_update
+        if sched_state is not None:
+            lr_sched.set_state_dict(sched_state)
+        raise
+
+
+def window_schedule(step, k_steps: int):
+    """Host-side precompute of a fused window's per-step lr / step_no /
+    fold-in count vectors (+ update mask), advancing ``step``'s
+    counters and the LR scheduler in EXACTLY the order the sequential
+    path would: get_lr() is read before each step, the scheduler steps
+    after each optimizer update.
+
+    Shared by :class:`TrainStep` and ``distributed.ParallelTrainStep``
+    — ``step`` is either one; the contract is the attributes both
+    expose: ``accumulate_steps``, ``optimizer``, ``step_count``,
+    ``update_count``, ``auto_lr_step``."""
+    k = step.accumulate_steps
+    lr_sched = getattr(step.optimizer, "_learning_rate", None)
+    lrs, step_nos, counts, upd = [], [], [], []
+    for _ in range(k_steps):
+        step.step_count += 1
+        counts.append(step.step_count)
+        lrs.append(step.optimizer.get_lr())
+        is_upd = k == 1 or step.step_count % k == 0
+        upd.append(is_upd)
+        if is_upd:
+            step.update_count += 1
+            step_nos.append(step.update_count)
+            if step.auto_lr_step and hasattr(lr_sched, "step"):
+                lr_sched.step()
+        else:
+            step_nos.append(step.update_count + 1)
+    return (np.asarray(lrs, np.float32),
+            np.asarray(step_nos, np.float32),
+            np.asarray(counts, np.int32),
+            np.asarray(upd, bool))
+
+
+def make_scan_window(fwd, optimizer, k, on_trace):
+    """Build the (un-jitted) K-step fused window function shared by
+    :class:`TrainStep` and ``distributed.ParallelTrainStep`` — the ONE
+    place the scanned-window contract lives (per-step key
+    ``fold_in(base_key, count)``, the ``(acc+grads)/k`` gradient-merge
+    mean, zero reset, carry ordering). Callers wrap the result in
+    ``jax.jit`` with their own donation/sharding.
+
+    ``fwd(params, buffers, opt_state, lr, step_no, rng_key, *batch) ->
+    (loss, new_buffers, grads)`` is the per-step fwd+loss+bwd closure
+    (ParallelTrainStep's opt_state-free fwd_bwd is adapted by its
+    caller); ``k`` is accumulate_steps; ``on_trace`` fires inside the
+    traced body, so it ticks once per actual XLA (re)trace.
+
+    Signature of the returned function:
+      k == 1:  (params, buffers, opt, key, lrs, steps, counts, *sb)
+               -> (losses[K], params, buffers, opt)
+      k > 1:   (params, buffers, opt, acc, key, lrs, steps, counts,
+                upd_mask, *sb)
+               -> (losses[K], params, buffers, opt, acc)
+    """
+    if k == 1:
+        def scan_window(params, buffers, opt_state, base_key, lrs,
+                        step_nos, counts, *superbatch):
+            on_trace()
+
+            def body(carry, xs):
+                params, buffers, opt_state = carry
+                lr, step_no, count = xs[0], xs[1], xs[2]
+                batch = xs[3:]
+                rng_key = jax.random.fold_in(base_key, count)
+                loss, new_bufs, grads = fwd(
+                    params, buffers, opt_state, lr, step_no, rng_key,
+                    *batch)
+                new_params, new_opt = optimizer.apply_gradients(
+                    params, grads, opt_state, lr=lr, step=step_no)
+                return (new_params, new_bufs, new_opt), loss
+
+            (params, buffers, opt_state), losses = lax.scan(
+                body, (params, buffers, opt_state),
+                (lrs, step_nos, counts) + superbatch)
+            return losses, params, buffers, opt_state
+
+        return scan_window
+
+    def scan_window(params, buffers, opt_state, acc, base_key,
+                    lrs, step_nos, counts, upd_mask, *superbatch):
+        on_trace()
+
+        def body(carry, xs):
+            params, buffers, opt_state, acc = carry
+            lr, step_no, count, is_upd = xs[0], xs[1], xs[2], xs[3]
+            batch = xs[4:]
+            rng_key = jax.random.fold_in(base_key, count)
+            loss, new_bufs, grads = fwd(
+                params, buffers, opt_state, lr, step_no, rng_key,
+                *batch)
+
+            def apply_br(_):
+                mean = jax.tree_util.tree_map(
+                    lambda a, g: (a + g) / k, acc, grads)
+                new_p, new_o = optimizer.apply_gradients(
+                    params, mean, opt_state, lr=lr, step=step_no)
+                zeros = jax.tree_util.tree_map(jnp.zeros_like, acc)
+                return new_p, new_o, zeros
+
+            def acc_br(_):
+                new_acc = jax.tree_util.tree_map(jnp.add, acc, grads)
+                return params, opt_state, new_acc
+
+            new_p, new_o, new_acc = lax.cond(
+                is_upd, apply_br, acc_br, None)
+            return (new_p, new_bufs, new_o, new_acc), loss
+
+        (params, buffers, opt_state, acc), losses = lax.scan(
+            body, (params, buffers, opt_state, acc),
+            (lrs, step_nos, counts, upd_mask) + superbatch)
+        return losses, params, buffers, opt_state, acc
+
+    return scan_window
 
 
 class TrainStep:
@@ -85,10 +243,23 @@ class TrainStep:
         # flush_accumulation programs keyed by remainder r (tpulint
         # jit-in-call: a fresh jax.jit per flush re-traced every time)
         self._flush_progs = {}
+        # scanned K-step fused programs keyed by (k_steps, n_batch_args)
+        self._scan_progs = {}
+        # engine-style compiled-program accounting: ticks inside the
+        # TRACED bodies, so it moves only when XLA actually (re)traces —
+        # tests assert a drifting-length fused epoch compiles exactly 2
+        # programs (scanned window + trailing per-step)
+        self._trace_count = 0
 
     # ------------------------------------------------------------------
-    def _build(self):
-        model, loss_fn, optimizer = self.model, self.loss_fn, self.optimizer
+    def _make_step_fn(self):
+        """fwd+loss+bwd closure shared VERBATIM by the per-step programs
+        and the scanned K-step program — same graph, same training
+        semantics, and bitwise-equal trajectories at the tier-1 tested
+        geometries (identical jaxprs don't force identical machine
+        code: XLA may vectorize a reduction differently inside a scan
+        body, which can drift the last ulp at other shapes)."""
+        model, loss_fn = self.model, self.loss_fn
         n_in = self.n_inputs
 
         def step_fn(params, buffers, opt_state, lr, step_no, rng_key, *batch):
@@ -114,11 +285,19 @@ class TrainStep:
                 loss_of, has_aux=True)(params)
             return loss, new_bufs, grads
 
+        return step_fn
+
+    def _build(self):
+        optimizer = self.optimizer
+        step_fn = self._make_step_fn()
+        step_self = self
+
         k = self.accumulate_steps
 
         if k == 1:
             def full_step(params, buffers, opt_state, lr, step_no, rng_key,
                           *batch):
+                step_self._trace_count += 1   # fires at trace time only
                 loss, new_bufs, grads = step_fn(params, buffers, opt_state,
                                                 lr, step_no, rng_key, *batch)
                 new_params, new_opt = optimizer.apply_gradients(
@@ -133,6 +312,7 @@ class TrainStep:
         # (call_count % k), so no in-program branch is needed
         def acc_step(params, buffers, opt_state, acc, lr, step_no, rng_key,
                      *batch):
+            step_self._trace_count += 1       # fires at trace time only
             loss, new_bufs, grads = step_fn(params, buffers, opt_state,
                                             lr, step_no, rng_key, *batch)
             new_acc = jax.tree_util.tree_map(jnp.add, acc, grads)
@@ -140,6 +320,7 @@ class TrainStep:
 
         def apply_step(params, buffers, opt_state, acc, lr, step_no, rng_key,
                        *batch):
+            step_self._trace_count += 1       # fires at trace time only
             loss, new_bufs, grads = step_fn(params, buffers, opt_state,
                                             lr, step_no, rng_key, *batch)
             mean = jax.tree_util.tree_map(
@@ -184,6 +365,101 @@ class TrainStep:
             if hasattr(lr_sched, "step"):
                 lr_sched.step()
         return Tensor(loss)
+
+    # ------------------------------------------------------------------
+    # fused K-step window (lax.scan over a stacked super-batch)
+    # ------------------------------------------------------------------
+    def _get_scan_prog(self, k_steps: int, n_batch: int):
+        """The jitted K-step fused program: `k_steps` consecutive
+        (micro-)steps as ONE donated XLA program — `lax.scan` over the
+        stacked super-batch, per-step lr/step_no/fold-in count vectors
+        as scan xs, the PRNG base key as a program argument (fold_in
+        happens IN-program, so the per-step keys match the eager
+        `default_generator().fold_in(step_count)` exactly). With
+        gradient merge (accumulate_steps k>1) the update cadence rides
+        in as a boolean mask and a `lax.cond` applies/accumulates —
+        both branches the same arithmetic as the sequential two-program
+        split, so the update cadence and training semantics match the
+        sequential loop exactly (and the bits do too at the tier-1
+        tested geometries; see `_make_step_fn`).
+
+        Signature (k == accumulate_steps):
+          k == 1:  (params, buffers, opt, key, lrs, steps, counts, *sb)
+                   -> (losses[K], params, buffers, opt)
+          k > 1:   (params, buffers, opt, acc, key, lrs, steps, counts,
+                    upd_mask, *sb)
+                   -> (losses[K], params, buffers, opt, acc)
+
+        The super-batch buffers are donated (consumed) along with the
+        state — no host callback, no mid-window sync.
+        """
+        key_sig = (int(k_steps), int(n_batch))
+        prog = self._scan_progs.get(key_sig)
+        if prog is not None:
+            return prog
+        k = self.accumulate_steps
+        scan_window = make_scan_window(
+            self._make_step_fn(), self.optimizer, k, self._count_trace)
+        if k == 1:
+            prog = jax.jit(
+                scan_window,
+                donate_argnums=(0, 1, 2) + tuple(
+                    range(7, 7 + n_batch)))
+        else:
+            prog = jax.jit(
+                scan_window,
+                donate_argnums=(0, 1, 2, 3) + tuple(
+                    range(9, 9 + n_batch)))
+        self._scan_progs[key_sig] = prog
+        return prog
+
+    def _count_trace(self):
+        self._trace_count += 1    # fires at trace time only
+
+    def scan_steps(self, k_steps: int, *batch) -> Tensor:
+        """Run ``k_steps`` consecutive (micro-)steps inside ONE donated
+        compiled program. Every leaf of ``batch`` is stacked
+        ``[k_steps, ...]`` (io.dataloader.prefetch_to_device builds
+        these). Returns the stacked per-step losses as a ``[k_steps]``
+        Tensor that stays ON DEVICE — reading it (float()/numpy()) is
+        the only host sync, so drivers fetch at log/epoch boundaries
+        instead of every step. The super-batch buffers are donated
+        (consumed by the program).
+
+        Counter/LR/RNG semantics are bitwise those of ``k_steps``
+        sequential ``__call__``s, including the gradient-accumulation
+        cadence at any window phase; trailing partial windows should
+        use ``__call__`` per step (Model.fit does). With
+        ``auto_lr_step=False`` the LR is frozen across the window — an
+        external scheduler owner must step between windows, so
+        Model.fit keeps the per-step path when an LRScheduler callback
+        is active.
+        """
+        if k_steps < 1:
+            raise ValueError("k_steps must be >= 1")
+        raw_batch = _raw_tuple(batch)
+        for b in raw_batch:
+            if b.ndim < 1 or b.shape[0] != k_steps:
+                raise ValueError(
+                    f"scan_steps batch leaves must be stacked "
+                    f"[{k_steps}, ...]; got shape {b.shape}")
+        prog = self._get_scan_prog(k_steps, len(raw_batch))
+        base_key = _rng.get_rng_state()
+        with window_rollback(self):
+            lrs, step_nos, counts, upd = window_schedule(self, k_steps)
+            with _quiet_unused_donation():
+                if self.accumulate_steps > 1:
+                    (losses, self.params, self.buffers, self.opt_state,
+                     self.acc_grads) = prog(
+                        self.params, self.buffers, self.opt_state,
+                        self.acc_grads, base_key, lrs, step_nos, counts,
+                        upd, *raw_batch)
+                else:
+                    (losses, self.params, self.buffers,
+                     self.opt_state) = prog(
+                        self.params, self.buffers, self.opt_state,
+                        base_key, lrs, step_nos, counts, *raw_batch)
+        return Tensor(losses)
 
     # ------------------------------------------------------------------
     def flush_accumulation(self):

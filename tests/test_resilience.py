@@ -166,6 +166,35 @@ def test_watchdog_detects_wedged_collective():
         dist.set_mesh(None)
 
 
+def test_watchdog_deadline_covers_lazy_loss_fetch():
+    """PR-4 regression guard: jax dispatch is async and the fused
+    loop's losses are LAZY, so the supervised callable itself returns
+    in microseconds — the deadline must cover the loss FETCH (the
+    step's real completion point), which the watchdog runs inside the
+    supervised worker. A result whose coercion wedges (= wedged device)
+    must raise StepTimeout, not hang the caller."""
+    import time
+
+    class WedgedLoss:
+        def __array__(self, dtype=None):
+            time.sleep(3.0)
+            return np.zeros(1, dtype or np.float64)
+
+    dog = StepWatchdog(deadline=0.4)
+    t0 = time.monotonic()
+    with pytest.raises(StepTimeout):
+        dog.run(lambda: WedgedLoss())
+    assert time.monotonic() - t0 < 2.5
+    # non-numeric results count as ONE finite step: the NaN streak is
+    # broken, not paused (pre-fused-loop watchdog contract)
+    dog2 = StepWatchdog(deadline=None, nan_limit=2)
+    dog2.run(lambda: float("nan"))
+    dog2.run(lambda: {"status": "ok"})
+    dog2.run(lambda: float("nan"))   # streak is 1, not 2 -> no storm
+    assert dog2.nonfinite_streak == 1
+    dog.close()
+
+
 def test_watchdog_nan_storm_and_recovery():
     failures = []
     dog = StepWatchdog(deadline=None, nan_limit=3,

@@ -1,0 +1,409 @@
+"""Fused K-step training loop (PR 4): scan_steps bitwise identity,
+lazy losses, double-buffered prefetch, watchdog scaling, and the
+2-programs-per-drifting-epoch trace-counter guarantee.
+
+The identity tests are BITWISE (np.array_equal, not allclose): the
+scanned window reuses the per-step program's fwd/bwd closure verbatim,
+and at these geometries the trajectories match to the last ulp — drift
+HERE means the fused path changed training semantics (counter/LR/RNG
+cadence or update math). NB the bitwise property is geometry-pinned,
+not universal: identical jaxprs can still compile to differently-
+vectorized reductions inside a scan body (observed: last-ulp CE-loss
+drift at batch 32, 16->64->2 on CPU from identical params+data), which
+is why these tests pin exact shapes rather than sampling.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.jit import TrainStep
+
+
+def _net():
+    paddle.seed(7)
+    return nn.Sequential(nn.Linear(16, 32), nn.GELU(), nn.Linear(32, 16))
+
+
+def _opt(m, sched=False):
+    lr = paddle.optimizer.lr.StepDecay(learning_rate=0.05, step_size=2,
+                                       gamma=0.5) if sched else 0.05
+    return paddle.optimizer.AdamW(learning_rate=lr,
+                                  parameters=m.parameters())
+
+
+def _data(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return (rng.randn(n, 8, 16).astype("float32"),
+            rng.randn(n, 8, 16).astype("float32"))
+
+
+def _params_bitwise(a, b):
+    return all(np.array_equal(np.asarray(a.params[n]),
+                              np.asarray(b.params[n])) for n in a.params)
+
+
+def _loss(o, y):
+    return F.mse_loss(o, y)
+
+
+# ---------------------------------------------------------------------------
+# scanned-vs-sequential identity
+# ---------------------------------------------------------------------------
+
+def test_scan_matches_sequential_bitwise_with_trailing_window():
+    """10 steps, K=4 (K does not divide 10): two fused windows + two
+    per-step trailing calls must be bitwise the 10-step sequential run
+    — losses AND parameters."""
+    xs, ys = _data(10)
+
+    paddle.seed(123)
+    m1 = _net()
+    s1 = TrainStep(m1, _loss, _opt(m1))
+    seq = [float(s1(xs[i], ys[i])) for i in range(10)]
+
+    paddle.seed(123)
+    m2 = _net()
+    s2 = TrainStep(m2, _loss, _opt(m2))
+    fused = []
+    for w in range(2):
+        win = s2.scan_steps(4, xs[w * 4:(w + 1) * 4], ys[w * 4:(w + 1) * 4])
+        assert tuple(win.shape) == (4,)
+        fused.extend(np.asarray(win.value).tolist())
+    for i in (8, 9):
+        fused.append(float(s2(xs[i], ys[i])))
+
+    assert np.array_equal(np.asarray(seq), np.asarray(fused))
+    assert _params_bitwise(s1, s2)
+    assert s2.step_count == 10 and s2.update_count == 10
+
+
+def test_scan_accumulation_and_lr_schedule_bitwise():
+    """Gradient merge (accumulate_steps=2) + a per-update LR schedule:
+    the in-window lax.cond cadence, the host-precomputed lr vector, and
+    a trailing UNFLUSHED micro-step + flush must all be bitwise the
+    sequential run's."""
+    xs, ys = _data(9, seed=3)
+
+    paddle.seed(11)
+    m1 = _net()
+    s1 = TrainStep(m1, _loss, _opt(m1, sched=True), accumulate_steps=2)
+    seq = [float(s1(xs[i], ys[i])) for i in range(9)]
+    s1.flush_accumulation()
+
+    paddle.seed(11)
+    m2 = _net()
+    s2 = TrainStep(m2, _loss, _opt(m2, sched=True), accumulate_steps=2)
+    fused = []
+    for w in range(2):
+        win = s2.scan_steps(4, xs[w * 4:(w + 1) * 4], ys[w * 4:(w + 1) * 4])
+        fused.extend(np.asarray(win.value).tolist())
+    fused.append(float(s2(xs[8], ys[8])))   # trailing micro-step
+    s2.flush_accumulation()
+
+    assert np.array_equal(np.asarray(seq), np.asarray(fused))
+    assert _params_bitwise(s1, s2)
+    assert s1.update_count == s2.update_count == 5
+    # LR schedules advanced identically
+    assert float(s1.optimizer.get_lr()) == float(s2.optimizer.get_lr())
+
+
+def test_parallel_scan_matches_sequential_bitwise():
+    """ParallelTrainStep.scan_steps under dp8 / ZeRO-2: the GSPMD
+    program inside the scan must reproduce the per-step trajectory."""
+    dist.set_mesh(None)
+    dist.init_mesh({"dp": 8})
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 16).astype("float32")
+
+    paddle.seed(5)
+    m1 = _net()
+    p1 = dist.ParallelTrainStep(m1, _loss, _opt(m1), zero_stage=2)
+    seq = [float(p1(x, x)) for _ in range(8)]
+
+    paddle.seed(5)
+    m2 = _net()
+    p2 = dist.ParallelTrainStep(m2, _loss, _opt(m2), zero_stage=2)
+    stacked = np.stack([x] * 4)
+    fused = []
+    for _ in range(2):
+        fused.extend(np.asarray(
+            p2.scan_steps(4, stacked, stacked).value).tolist())
+
+    assert np.array_equal(np.asarray(seq), np.asarray(fused))
+    assert _params_bitwise(p1, p2)
+
+
+def test_scan_steps_rejects_bad_window():
+    m = _net()
+    s = TrainStep(m, _loss, _opt(m))
+    xs, ys = _data(4)
+    with pytest.raises(ValueError):
+        s.scan_steps(0, xs, ys)
+    with pytest.raises(ValueError):
+        s.scan_steps(3, xs, ys)    # leading dim 4 != K=3
+
+
+def test_parallel_scan_check_nan_inf_wiring():
+    """FLAGS_check_nan_inf armed: a finite window passes through (the
+    check takes the raw stacked-loss array, not the Tensor wrapper) and
+    a diverged window raises at the window boundary."""
+    from paddle_tpu.framework import flags as fw_flags
+    dist.set_mesh(None)
+    dist.init_mesh({"dp": 8})
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 16).astype("float32")
+    paddle.seed(5)
+    m = _net()
+    p = dist.ParallelTrainStep(m, _loss, _opt(m), zero_stage=2)
+    stacked = np.stack([x] * 4)
+    fw_flags.set_flags({"FLAGS_check_nan_inf": 1})
+    try:
+        losses = p.scan_steps(4, stacked, stacked)   # finite: no raise
+        assert np.isfinite(np.asarray(losses.value)).all()
+        bad = stacked.copy()
+        bad[1] = np.nan
+        with pytest.raises(FloatingPointError):
+            p.scan_steps(4, bad, bad)
+    finally:
+        fw_flags.set_flags({"FLAGS_check_nan_inf": 0})
+
+
+# ---------------------------------------------------------------------------
+# watchdog: deadline scales to the window, NaN storm from stacked losses
+# ---------------------------------------------------------------------------
+
+def test_watchdog_deadline_scales_to_window():
+    from paddle_tpu.distributed.resilience import StepTimeout, StepWatchdog
+    dog = StepWatchdog(deadline=0.15)
+
+    def slow_window():
+        time.sleep(0.4)
+        return [0.5]
+
+    # one per-step budget: hangs
+    with pytest.raises(StepTimeout):
+        dog.run(slow_window)
+    # the K-step window gets K budgets: passes
+    assert dog.run(slow_window, deadline_scale=4) == [0.5]
+    dog.close()
+
+
+def test_watchdog_nan_storm_from_stacked_losses():
+    from paddle_tpu.distributed.resilience import NanInfStorm, StepWatchdog
+    dog = StepWatchdog(deadline=None, nan_limit=3)
+    # a storm INSIDE one stacked window fires
+    with pytest.raises(NanInfStorm):
+        dog.run(lambda: paddle.to_tensor(
+            np.array([1.0, np.nan, np.nan, np.nan], np.float32)))
+    # ...and the consecutive streak spans window boundaries
+    dog2 = StepWatchdog(deadline=None, nan_limit=3)
+    dog2.run(lambda: paddle.to_tensor(
+        np.array([1.0, 2.0, np.nan, np.nan], np.float32)))
+    with pytest.raises(NanInfStorm):
+        dog2.run(lambda: paddle.to_tensor(
+            np.array([np.nan, 1.0], np.float32)))
+    # a finite step in between resets the streak
+    dog3 = StepWatchdog(deadline=None, nan_limit=3)
+    dog3.run(lambda: paddle.to_tensor(
+        np.array([np.nan, 1.0, np.nan, np.nan], np.float32)))
+    dog3.run(lambda: [0.25])
+    dog3.close()
+
+
+# ---------------------------------------------------------------------------
+# prefetch pipeline
+# ---------------------------------------------------------------------------
+
+def _ds(n):
+    from paddle_tpu.io.dataloader import Dataset
+
+    class DS(Dataset):
+        def __init__(self):
+            rng = np.random.RandomState(0)
+            self.x = rng.randn(n, 8).astype("float32")
+            self.y = rng.randn(n, 4).astype("float32")
+
+        def __len__(self):
+            return len(self.x)
+
+        def __getitem__(self, i):
+            return self.x[i], self.y[i]
+
+    return DS()
+
+
+def test_prefetch_to_device_windows_and_tail():
+    import jax
+    from paddle_tpu.io.dataloader import DataLoader, prefetch_to_device
+    ds = _ds(60)   # 8 batches of 8 except a 4-sample trailer
+    loader = DataLoader(ds, batch_size=8, shuffle=False)
+    wins = list(prefetch_to_device(loader, 3, depth=2))
+    # 7 full-size batches -> 2 windows of 3 + tail [1 batch of 8] ...
+    # the size-4 trailer can't stack with the size-8 prefix
+    assert [w.full for w in wins] == [True, True, False, False]
+    full = wins[0]
+    assert isinstance(full.data[0], jax.Array)
+    assert full.data[0].shape == (3, 8, 8)
+    assert len(wins[2]) == 1 and len(wins[3]) == 1
+    # order is preserved: rows of window 0 are batches 0..2
+    row0 = next(iter(full.rows()))
+    assert np.array_equal(np.asarray(row0[0]), ds.x[:8])
+
+    # loader errors propagate to the consumer
+    class Boom:
+        def __iter__(self):
+            yield (np.zeros((2, 4), np.float32),)
+            raise RuntimeError("boom")
+
+    with pytest.raises(RuntimeError, match="boom"):
+        list(prefetch_to_device(Boom(), 4))
+
+
+# ---------------------------------------------------------------------------
+# hapi driver: lazy losses, callback alignment, 2-program epochs
+# ---------------------------------------------------------------------------
+
+class _Rec:
+    """Records (step, loss-object) per batch without coercing."""
+
+    def __init__(self):
+        self.steps, self.losses = [], []
+
+    def make(self):
+        from paddle_tpu.hapi.callbacks import Callback
+        rec = self
+
+        class CB(Callback):
+            def on_train_batch_end(self, step, logs=None):
+                rec.steps.append(step)
+                rec.losses.append(logs["loss"])
+
+        return CB()
+
+
+def _model():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    from paddle_tpu.hapi import Model
+    model = Model(net)
+    model.prepare(paddle.optimizer.Adam(learning_rate=0.01,
+                                        parameters=net.parameters()),
+                  loss=_loss)
+    return model
+
+
+def test_fit_fused_matches_per_step_and_log_alignment():
+    """Model.fit(scan_steps=4) over a drifting-length epoch: callbacks
+    see the same step indices, the same (bitwise) losses as the
+    per-step loop, losses arrive LAZY, and exactly 2 programs compile
+    (scanned window + trailing per-step)."""
+    from paddle_tpu.hapi.lazy import LazyLoss
+    r1, r2 = _Rec(), _Rec()
+    m1 = _model()
+    m1.fit(_ds(80), batch_size=8, epochs=1, shuffle=False, verbose=0,
+           callbacks=[r1.make()], scan_steps=1)
+    m2 = _model()
+    m2.fit(_ds(80), batch_size=8, epochs=1, shuffle=False, verbose=0,
+           callbacks=[r2.make()], scan_steps=4)
+
+    assert r1.steps == r2.steps == list(range(10))
+    assert all(isinstance(v, LazyLoss) for v in r2.losses)
+    # lazy losses format like floats (ProgBarLogger's log_freq path)
+    assert f"{r2.losses[0]:.4f}" == f"{float(r2.losses[0]):.4f}"
+    l1 = np.asarray([float(v) for v in r1.losses])
+    l2 = np.asarray([float(v) for v in r2.losses])
+    assert np.array_equal(l1, l2)
+    assert _params_bitwise(m1._train_step, m2._train_step)
+
+    # trace counter: the drifting-length epoch (2 windows of 4 + 2
+    # trailing) compiled exactly TWO programs; a second epoch adds none
+    assert m2._train_step._trace_count == 2
+    m2.fit(_ds(80), batch_size=8, epochs=1, shuffle=False, verbose=0,
+           scan_steps=4)
+    assert m2._train_step._trace_count == 2
+
+
+def test_fit_fused_respects_num_iters_and_accumulation():
+    """num_iters capping mid-window falls back to per-step rows;
+    accumulate_grad_batches>1 keeps its update cadence through fused
+    windows."""
+    r = _Rec()
+    m = _model()
+    m.fit(_ds(80), batch_size=8, epochs=1, shuffle=False, verbose=0,
+          callbacks=[r.make()], scan_steps=4, num_iters=6)
+    assert r.steps == list(range(6))
+    assert m._train_step.step_count == 6
+
+    m2 = _model()
+    m2.fit(_ds(80), batch_size=8, epochs=1, shuffle=False, verbose=0,
+           scan_steps=4, accumulate_grad_batches=2)
+    assert m2._train_step.accumulate_steps == 2
+    assert m2._train_step.update_count == 5    # 10 batches / k=2
+
+    # bitwise vs the per-step accumulation loop
+    m3 = _model()
+    m3.fit(_ds(80), batch_size=8, epochs=1, shuffle=False, verbose=0,
+           scan_steps=1, accumulate_grad_batches=2)
+    assert _params_bitwise(m2._train_step, m3._train_step)
+
+
+def test_train_batch_lazy_and_sync_counter():
+    """train_batch keeps its [scalar] contract but defers the
+    device->host sync to the read; the sync counter sees exactly one
+    fetch per window."""
+    from paddle_tpu.framework import syncs
+    from paddle_tpu.hapi.lazy import LazyLoss
+    m = _model()
+    x = np.random.RandomState(0).randn(8, 8).astype("float32")
+    y = np.random.RandomState(1).randn(8, 4).astype("float32")
+    m.train_batch([paddle.to_tensor(x)], [paddle.to_tensor(y)])  # compile
+    before = syncs.sync_count()
+    (loss,) = m.train_batch([paddle.to_tensor(x)], [paddle.to_tensor(y)])
+    assert isinstance(loss, LazyLoss)
+    assert syncs.sync_count() == before          # dispatch only, no sync
+    v1 = float(loss)
+    assert syncs.sync_count() == before + 1      # the read is the sync
+    v2 = float(loss)
+    assert v1 == v2
+    assert syncs.sync_count() == before + 1      # cached thereafter
+
+
+def test_evaluate_batches_the_loss_fetch():
+    """evaluate() syncs ONCE for all per-batch losses instead of once
+    per batch."""
+    from paddle_tpu.framework import syncs
+    m = _model()
+    m.fit(_ds(16), batch_size=8, epochs=1, verbose=0)   # warm infer path
+    m.evaluate(_ds(40), batch_size=8, verbose=0)        # warm eval prog
+    before = syncs.sync_count()
+    logs = m.evaluate(_ds(40), batch_size=8, verbose=0)
+    assert np.isfinite(logs["loss"])
+    assert syncs.sync_count() - before == 1
+
+
+def test_fit_fused_under_watchdog_nan_storm(tmp_path, monkeypatch):
+    """A NaN-poisoned dataset under the armed watchdog raises
+    NanInfStorm out of the FUSED loop (stacked-loss scan) and leaves
+    the checkpoint-on-failure artifact."""
+    from paddle_tpu.distributed.resilience import NanInfStorm
+    monkeypatch.setenv("PADDLE_TPU_STEP_TIMEOUT", "60")
+    from paddle_tpu.io.dataloader import Dataset
+
+    class BadDS(Dataset):
+        def __len__(self):
+            return 64
+
+        def __getitem__(self, i):
+            x = np.full((8,), np.nan, np.float32)
+            return x, np.zeros((4,), np.float32)
+
+    m = _model()
+    with pytest.raises(NanInfStorm):
+        m.fit(BadDS(), batch_size=8, epochs=1, verbose=0, scan_steps=4,
+              save_dir=str(tmp_path))
+    assert (tmp_path / "on_failure.pdparams").exists()

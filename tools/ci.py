@@ -52,6 +52,9 @@ QUICK_FILES = [
     # serving engine: continuous batching is a core-correctness surface
     # (greedy token-identity + the no-recompile guarantee)
     "tests/test_engine.py",
+    # fused K-step train loop: scanned-vs-sequential bitwise identity +
+    # the 2-programs-per-epoch trace-counter bound
+    "tests/test_scan_train.py",
     # static analyzer: hazard-class detection must stay exact
     "tests/test_analysis.py",
 ]

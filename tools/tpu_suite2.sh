@@ -28,6 +28,10 @@ run bench_serving bench_serving.json python tools/bench_serving.py
 run bench_serving_concurrent bench_serving_concurrent.json \
     python tools/bench_serving.py --concurrent
 run kv_quality kv_quality.json python tools/kv_cache_quality.py
+# fused K-step train loop vs per-step dispatch (PR 4): steps/s for
+# K in {4,16} scanned windows + the zero-mid-window-sync assertion;
+# self-skips once landed like every other step
+run bench_train_loop bench_train_loop.json python tools/bench_train_loop.py
 # static-analysis gate (PR 3): lints the real decode/prefill/train-step
 # programs vs tools/tpulint_baseline.json; self-skips once landed (the
 # terminal stdout line is a _have_result-good JSON record even when the

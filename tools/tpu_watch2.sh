@@ -82,6 +82,7 @@ PY
         /root/repo/tpu_results/bench_1p3b_dots.json \
         /root/repo/tpu_results/bench_125m_bf16opt.json \
         /root/repo/tpu_results/kv_quality.json \
+        /root/repo/tpu_results/bench_train_loop.json \
     )
     HAVE_RC=$?
     # landed is decided by the EXIT CODE (rc=0), never by empty stdout:
