@@ -30,7 +30,7 @@ from .recompile import abstract_signature, recompile_report
 from .codebase_lint import (HOT_JIT_FILES, lint_file, lint_quarantine,
                             lint_tree)
 from .manifest import (MANIFEST_PROGRAMS, ProgramSpec, default_manifest,
-                       run_manifest)
+                       manifest_names, run_manifest)
 
 __all__ = [
     "Finding", "Severity", "count_findings", "diff_against_baseline",
@@ -39,5 +39,5 @@ __all__ = [
     "abstract_signature", "recompile_report",
     "lint_tree", "lint_file", "lint_quarantine", "HOT_JIT_FILES",
     "ProgramSpec", "default_manifest", "run_manifest",
-    "MANIFEST_PROGRAMS",
+    "MANIFEST_PROGRAMS", "manifest_names",
 ]

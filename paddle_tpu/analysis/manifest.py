@@ -1,30 +1,27 @@
 """tpulint default manifest: the real programs every perf PR rides on.
 
-Four production programs are rebuilt exactly as their owners build them
-and handed to the program linter — trace + lower only (the parallel
-step additionally compiles for its collective inventory):
+The program set IS the ProgramRegistry (paddle_tpu.compilation): every
+site registered with the "manifest" tag is rebuilt exactly as its owner
+builds it (the builders live in compilation/sites.py) and handed to the
+program linter — trace + lower only (collective-tagged programs
+additionally compile for their collective inventory). One table serves
+every consumer: tpulint lints it, `compilation.warmup` prebuilds it,
+`tools/warmup.py` persists it to the executable store, and
+`tools/bench_cold_start.py` measures it — so a newly registered program
+is lint-covered, warmable, and store-cacheable BY DEFAULT, and the
+baseline keys (code::program::site) are the registry names.
 
-- gpt_decode:     the continuous-batching engine's ONE batched decode
-                  program (inference/engine.py) over GPT-tiny — the
-                  program whose scatter-free one-hot cache writes and
-                  cache donation PR 2's speedups depend on.
-- llama_prefill:  the generate() prefill program (models/generation.py
-                  build_generate_programs) over LLaMA-tiny.
-- train_step:     jit.training.TrainStep's fused whole-step program
-                  (donated params/buffers/opt state) over GPT-tiny.
-- train_step_scan: the fused K-STEP training window (PR 4,
-                  TrainStep.scan_steps: lax.scan over a stacked
-                  [K, B, S] super-batch, K optimizer steps in one
-                  donated program, per-step PRNG keys folded in-program
-                  from an argument base key) at K=4 over GPT-tiny.
-- parallel_train_step: distributed.ParallelTrainStep under a fake
-                  4-device mesh (dp2 x sharding2, ZeRO-2) — compiled,
-                  so the GSPMD-inserted collectives are inventoried.
+Current registry population (see compilation/sites.py for each):
+gpt_decode, llama_prefill, train_step, train_step_scan,
+parallel_train_step (the pre-registry five, order preserved so baseline
+keys stay stable), gpt_admit and llama_decode (newly covered by landing
+in the registry).
 
-Plus two static recompile-hazard reports: the sequential generate()
-path's per-(prompt-len) program key, the hazard the engine's prefill
-buckets exist to close (PR 2), and the fused train loop's pinned
-2-program signature (scanned window + trailing per-step, PR 4).
+Plus two static recompile-hazard reports that are not program sites:
+the sequential generate() path's per-(prompt-len) program key — the
+hazard the engine's prefill buckets exist to close (PR 2) — and the
+fused train loop's pinned 2-program signature (scanned window +
+trailing per-step, PR 4).
 
 Everything is tiny-config and CPU-safe; no program is executed.
 """
@@ -35,19 +32,30 @@ from typing import Any, Callable, List, Optional, Tuple
 
 import numpy as np
 
-import jax
-import jax.numpy as jnp
-
+from ..compilation import registry as _registry
 from .findings import Finding
 from .program_lint import lint_program
 from .recompile import recompile_report
 
 __all__ = ["ProgramSpec", "default_manifest", "run_manifest",
-           "MANIFEST_PROGRAMS"]
+           "MANIFEST_PROGRAMS", "manifest_names"]
 
-MANIFEST_PROGRAMS = ("gpt_decode", "llama_prefill", "train_step",
-                     "train_step_scan", "parallel_train_step",
-                     "generate_prompt_drift", "train_scan_window_drift")
+# static analyses that are reports over abstract call specs, not
+# registered program sites
+STATIC_REPORTS = ("generate_prompt_drift", "train_scan_window_drift")
+
+
+def manifest_names() -> Tuple[str, ...]:
+    """The current program set: registry sites tagged "manifest" (in
+    registration order — baseline keys depend on the names only) plus
+    the static reports. Computed from the live registry so a program
+    registered after import is still covered."""
+    return tuple(_registry.names(tag="manifest")) + STATIC_REPORTS
+
+
+# import-time snapshot for CLI help/validation messages; gate logic
+# uses manifest_names() so late registrations are linted by default
+MANIFEST_PROGRAMS = manifest_names()
 
 
 @dataclass
@@ -57,135 +65,19 @@ class ProgramSpec:
     compile_collectives: bool = False
 
 
-def _gpt_tiny_model():
-    from ..models.gpt import GPTConfig, GPTForCausalLM
-    from ..framework import random as _rng
-    _rng.seed(0)
-    return GPTForCausalLM(GPTConfig(vocab_size=256, hidden_size=64,
-                                    num_layers=2, num_heads=4,
-                                    max_seq_len=128))
-
-
-def _build_gpt_decode():
-    from ..inference.engine import ContinuousBatchingEngine
-    model = _gpt_tiny_model()
-    eng = ContinuousBatchingEngine(model, slots=4, max_len=64,
-                                   cache_dtype="float32", tick_tokens=4)
-    prog = eng._get_decode_prog()
-    N = eng.slots
-    args = (eng._params, eng._buffers, eng._caches,
-            np.zeros(N, np.int32), np.zeros(N, np.int32),
-            np.ones(N, bool), np.full(N, -1, np.int32),
-            np.zeros((N, 2), np.uint32))
-    return prog, args, eng.stop
-
-
-def _build_llama_prefill():
-    from ..models.llama import LlamaConfig, LlamaForCausalLM
-    from ..models.generation import build_generate_programs
-    from ..jit.functional import raw_state
-    from ..framework import random as _rng
-    _rng.seed(0)
-    model = LlamaForCausalLM(LlamaConfig(
-        vocab_size=256, hidden_size=64, intermediate_size=176,
-        num_layers=2, num_heads=4, num_kv_heads=2, max_seq_len=128))
-    model.eval()
-    P, new = 16, 8
-    prefill, _ = build_generate_programs(model, P, new, eos=None,
-                                         do_sample=False,
-                                         temperature=1.0, top_k=0,
-                                         top_p=1.0)
-    params, buffers = raw_state(model)
-    caches = model.new_cache(1, P + new, "float32")
-    args = (params, buffers, np.zeros((1, P), np.int64), caches,
-            jax.random.PRNGKey(0))
-    return prefill, args, None
-
-
-def _train_step_parts(model):
-    from ..optimizer import AdamW
-    from ..models.gpt import GPTForCausalLM
-    from ..framework import random as _rng
-    opt = AdamW(learning_rate=1e-3, parameters=model.parameters())
-    return GPTForCausalLM.loss_fn, opt, _rng
-
-
-def _build_train_step():
-    from ..jit.training import TrainStep
-    model = _gpt_tiny_model()
-    loss_fn, opt, _rng = _train_step_parts(model)
-    step = TrainStep(model, loss_fn, opt)
-    step._build()
-    ids = np.zeros((2, 32), np.int64)
-    args = (step.params, step.buffers, step.opt_state,
-            jnp.asarray(1e-3, jnp.float32), jnp.asarray(1, jnp.float32),
-            _rng.default_generator().fold_in(1), ids, ids)
-    return step._jitted, args, None
-
-
-def _build_train_step_scan():
-    """The fused K-step window exactly as Model.fit dispatches it:
-    TrainStep.scan_steps' jitted program at K=4 — super-batch + state
-    donated, the PRNG base key an ARGUMENT (per-step keys fold in-
-    program), no host callback anywhere in the window."""
-    from ..jit.training import TrainStep
-    model = _gpt_tiny_model()
-    loss_fn, opt, _rng = _train_step_parts(model)
-    step = TrainStep(model, loss_fn, opt)
-    K = 4
-    prog = step._get_scan_prog(K, 2)
-    ids = np.zeros((K, 2, 32), np.int64)
-    args = (step.params, step.buffers, step.opt_state,
-            _rng.get_rng_state(),
-            np.full((K,), 1e-3, np.float32),
-            np.arange(1, K + 1, dtype=np.float32),
-            np.arange(1, K + 1, dtype=np.int32), ids, ids)
-    return prog, args, None
-
-
-def _build_parallel_train_step():
-    from ..distributed import mesh as mesh_mod
-    from ..distributed.parallel_step import ParallelTrainStep
-    prev = mesh_mod.get_mesh(create_default=False)
-    devs = jax.devices()
-    if len(devs) < 4:
-        raise RuntimeError(
-            f"parallel_train_step needs >= 4 devices, have {len(devs)} "
-            "(run under XLA_FLAGS=--xla_force_host_platform_device_"
-            "count=8; tools/tpulint.py sets this up itself)")
-
-    def cleanup():
-        mesh_mod.set_mesh(prev)
-
-    try:
-        mesh_mod.init_mesh({"dp": 2, "sharding": 2}, devices=devs[:4])
-        model = _gpt_tiny_model()
-        loss_fn, opt, _rng = _train_step_parts(model)
-        step = ParallelTrainStep(model, loss_fn, opt, zero_stage=2)
-        ids = np.zeros((4, 32), np.int64)
-        raw_batch = (ids, ids)
-        step._build(raw_batch)
-        args = (step.params, step.buffers, step.opt_state,
-                jnp.asarray(1e-3, jnp.float32),
-                jnp.asarray(1, jnp.float32),
-                _rng.default_generator().fold_in(1)) + raw_batch
-    except BaseException:
-        # build raised after the global mesh was swapped: restore it
-        # here — run_manifest never receives the cleanup on this path
-        cleanup()
-        raise
-    return step._jitted, args, cleanup
+def _adapt(prog: "_registry.RegisteredProgram"):
+    """Registry builder (-> BuildResult) to the linter's
+    (fn, args, cleanup) triple."""
+    def build():
+        r = prog.builder()
+        return r.fn, r.args, r.cleanup
+    return build
 
 
 def default_manifest() -> List[ProgramSpec]:
-    return [
-        ProgramSpec("gpt_decode", _build_gpt_decode),
-        ProgramSpec("llama_prefill", _build_llama_prefill),
-        ProgramSpec("train_step", _build_train_step),
-        ProgramSpec("train_step_scan", _build_train_step_scan),
-        ProgramSpec("parallel_train_step", _build_parallel_train_step,
-                    compile_collectives=True),
-    ]
+    return [ProgramSpec(name, _adapt(_registry.get(name)),
+                        _registry.get(name).compile_collectives)
+            for name in _registry.names(tag="manifest")]
 
 
 def _generate_prompt_drift_report() -> List[Finding]:
@@ -218,13 +110,14 @@ def run_manifest(programs: Optional[List[str]] = None,
     """Build + lint the manifest. Returns (findings, program names run).
     `programs` filters by name; `compile_collectives=False` skips the
     compile-requiring inventory (trace/lower only — faster gate)."""
+    valid = manifest_names()
     wanted = set(programs) if programs else None
     if wanted is not None:
-        unknown = wanted - set(MANIFEST_PROGRAMS)
+        unknown = wanted - set(valid)
         if unknown:
             raise ValueError(
                 f"unknown manifest program(s) {sorted(unknown)}; "
-                f"valid: {list(MANIFEST_PROGRAMS)}")
+                f"valid: {list(valid)}")
     findings: List[Finding] = []
     ran: List[str] = []
     for spec in default_manifest():

@@ -1,0 +1,51 @@
+"""Program lifecycle subsystem — registry, AOT warmup, executable store.
+
+Every hot path in this framework wins by staying in a no-recompile
+regime (the continuous-batching engine's one decode program, the fused
+K-step train window), yet until this subsystem the programs themselves
+were jit side-effects: traced and XLA-compiled lazily at first
+traffic, so a fresh process paid multi-second stalls on its first
+request. Here compiled programs are managed, persistable runtime
+artifacts (the MPK direction — PAPERS.md) with a lifecycle of their
+own:
+
+- ``registry``   — ProgramRegistry: ONE table of named jitted program
+                   sites (engine decode/admit, generate() prefill/
+                   decode, TrainStep per-step + scanned windows,
+                   ParallelTrainStep); tpulint's manifest, warmup, and
+                   the benches all enumerate it.
+- ``warmup``     — trace->lower->compile registered programs ahead of
+                   traffic; wired into serve.py startup (healthz
+                   warming->ready) and Model.fit(warm_start=True).
+- ``store``      — persistent executable store: jax AOT executables
+                   serialized to disk keyed by (jax version, backend,
+                   signature + computation hash, donation spec); a
+                   store-warm fresh
+                   process reaches first token without XLA compiling
+                   anything. `tools/warmup.py` prebuilds/inspects/
+                   evicts it.
+- ``counters``   — jax.monitoring-fed compile accounting (the
+                   framework/syncs.py idiom, for compiles).
+- ``log``        — per-program compile log surfaced via /healthz and
+                   bench output.
+
+Env knobs (one place — COMPONENTS.md "Program registry & warmup"):
+PADDLE_TPU_EXEC_STORE, PADDLE_TPU_EXEC_STORE_DIR,
+PADDLE_TPU_COMPILE_LOG, PADDLE_TPU_SERVE_WARMUP, PADDLE_TPU_WARM_START.
+"""
+from . import counters, log, registry  # noqa: F401
+from .registry import (BuildResult, RegisteredProgram,  # noqa: F401
+                       abstract_signature, register, signature_hash)
+from .store import (AotProgram, ExecutableStore,  # noqa: F401
+                    aot_compile, default_store)
+from .warmup import WarmupReport, prime_helper_ops, warmup  # noqa: F401
+
+counters.install()
+
+__all__ = [
+    "registry", "counters", "log",
+    "BuildResult", "RegisteredProgram", "register",
+    "abstract_signature", "signature_hash",
+    "ExecutableStore", "AotProgram", "aot_compile", "default_store",
+    "warmup", "WarmupReport", "prime_helper_ops",
+]

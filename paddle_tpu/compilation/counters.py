@@ -1,0 +1,130 @@
+"""XLA compile accounting — the compilation subsystem's syncs.py.
+
+`framework/syncs.py` counts device->host round-trips because the fused
+train loop's whole point is amortizing them; this module counts XLA
+backend compiles because the warmup/store subsystem's whole point is
+eliminating them. One process-global set of counters fed by
+`jax.monitoring` events:
+
+- ``backend_compiles``: every invocation of the backend compile path
+  (`/jax/core/compile/backend_compile_duration`). NOTE: a persistent
+  jax-compilation-cache HIT still routes through this path (the event
+  wraps compile-or-load), so this alone over-counts real compiles.
+- ``persistent_cache_hits``: `/jax/compilation_cache/cache_hits` — the
+  loads that did NOT actually run XLA.
+- ``xla_compiles()`` = backend_compiles - persistent_cache_hits: the
+  truthful "XLA actually compiled a program" count. An executable
+  deserialized from the paddle_tpu executable store fires NOTHING here
+  (it never enters jax's compile path at all) — which is exactly the
+  cold-start claim tools/bench_cold_start.py asserts.
+- ``compile_secs``: wall time spent inside the backend compile path.
+
+Writers (the listeners) fire on whatever thread is compiling —
+parallel warmup means concurrent increments, so they serialize on a
+lock (compiles are rare; the cost is nil). Readers stay the syncs.py
+idiom: plain delta reads on one consumer thread between phases.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["backend_compiles", "persistent_cache_hits", "xla_compiles",
+           "compile_secs", "traces", "CompileTracker", "install"]
+
+_BACKEND_COMPILE_EVT = "/jax/core/compile/backend_compile_duration"
+_TRACE_EVT = "/jax/core/compile/jaxpr_trace_duration"
+_CACHE_HIT_EVT = "/jax/compilation_cache/cache_hits"
+
+_backend_compiles = 0
+_cache_hits = 0
+_traces = 0
+_compile_secs = 0.0
+_installed = False
+_install_lock = threading.Lock()
+_count_lock = threading.Lock()
+
+
+def _on_duration(event: str, duration_secs: float, **kw) -> None:
+    global _backend_compiles, _traces, _compile_secs
+    if event == _BACKEND_COMPILE_EVT:
+        with _count_lock:
+            _backend_compiles += 1
+            _compile_secs += duration_secs
+    elif event == _TRACE_EVT:
+        with _count_lock:
+            _traces += 1
+
+
+def _on_event(event: str, **kw) -> None:
+    global _cache_hits
+    if event == _CACHE_HIT_EVT:
+        with _count_lock:
+            _cache_hits += 1
+
+
+def install() -> None:
+    """Register the monitoring listeners (idempotent). Importing
+    paddle_tpu.compilation does this; events before that are unseen —
+    counters are for DELTAS, not process totals."""
+    global _installed
+    with _install_lock:
+        if _installed:
+            return
+        import jax.monitoring as monitoring
+        monitoring.register_event_duration_secs_listener(_on_duration)
+        monitoring.register_event_listener(_on_event)
+        _installed = True
+
+
+def backend_compiles() -> int:
+    """Backend compile-path invocations (includes persistent-cache
+    loads — see module docstring)."""
+    return _backend_compiles
+
+
+def persistent_cache_hits() -> int:
+    return _cache_hits
+
+
+def xla_compiles() -> int:
+    """Programs XLA actually compiled (compile-path invocations minus
+    persistent-cache loads)."""
+    return _backend_compiles - _cache_hits
+
+
+def traces() -> int:
+    return _traces
+
+
+def compile_secs() -> float:
+    return _compile_secs
+
+
+class CompileTracker:
+    """Delta reader over one phase, the ``syncs.SyncTracker`` idiom::
+
+        with CompileTracker() as t:
+            ...
+        assert t.xla_compiles == 0
+    """
+
+    def __enter__(self):
+        install()
+        self._c0 = _backend_compiles
+        self._h0 = _cache_hits
+        self._t0 = _traces
+        self._s0 = _compile_secs
+        return self
+
+    def __exit__(self, *exc):
+        self.backend_compiles = _backend_compiles - self._c0
+        self.persistent_cache_hits = _cache_hits - self._h0
+        self.traces = _traces - self._t0
+        self.compile_secs = _compile_secs - self._s0
+        self.xla_compiles = self.backend_compiles - \
+            self.persistent_cache_hits
+        return False
+
+    @property
+    def so_far(self) -> int:
+        return (_backend_compiles - self._c0) - (_cache_hits - self._h0)

@@ -1,0 +1,233 @@
+"""Canonical program sites — the registry's default population.
+
+These builders were born in `analysis/manifest.py` (PR 3) as tpulint's
+private "rebuild the real programs" list; they now live here so ONE
+table serves every consumer: tpulint lints them, `compilation.warmup`
+prebuilds them, `tools/warmup.py` stores them, and
+`tools/bench_cold_start.py` measures them. Each builds the tiny-config
+variant of a production program exactly as its owner builds it:
+
+- gpt_decode:      the continuous-batching engine's batched decode tick
+- gpt_admit:       the engine's bucketed prefill/admission program
+- llama_prefill:   generate()'s prefill program over LLaMA-tiny
+- llama_decode:    generate()'s whole-decode-scan program (newly
+                   lint-covered by landing in the registry)
+- train_step:      TrainStep's fused whole-step program
+- train_step_scan: the K=4 fused training window
+- parallel_train_step: ParallelTrainStep on a fake 4-device
+                   dp2 x sharding2 ZeRO-2 mesh (compiled for the
+                   collective inventory)
+
+Everything is tiny-config and CPU-safe; no program is executed. Live
+sites (a real serving engine, a real fit loop) don't go through these
+fixtures — they warm THEIR OWN programs via `engine.warmup()` /
+`TrainStep.warm()`; the fixtures' value is priming the persistent
+caches for CI/tier-1 (the same programs tpulint and the quick tests
+compile) and giving lint/warmup a hardware-free stand-in.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .registry import BuildResult, register
+
+__all__ = ["ensure_registered"]
+
+
+def _gpt_tiny_model():
+    from ..models.gpt import GPTConfig, GPTForCausalLM
+    from ..framework import random as _rng
+    _rng.seed(0)
+    return GPTForCausalLM(GPTConfig(vocab_size=256, hidden_size=64,
+                                    num_layers=2, num_heads=4,
+                                    max_seq_len=128))
+
+
+def _tiny_engine():
+    from ..inference.engine import ContinuousBatchingEngine
+    model = _gpt_tiny_model()
+    return ContinuousBatchingEngine(model, slots=4, max_len=64,
+                                    cache_dtype="float32", tick_tokens=4)
+
+
+def build_gpt_decode() -> BuildResult:
+    import jax
+    eng = _tiny_engine()
+    prog = eng._get_decode_prog()
+    N = eng.slots
+    args = (eng._params, eng._buffers, eng._caches,
+            np.zeros(N, np.int32), np.zeros(N, np.int32),
+            np.ones(N, bool), np.full(N, -1, np.int32),
+            np.zeros((N, 2), np.uint32))
+    return BuildResult(prog, args, cleanup=eng.stop)
+
+
+def build_gpt_admit() -> BuildResult:
+    eng = _tiny_engine()
+    bucket = eng.prefill_buckets[0]
+    prog = eng._get_admit_prog(bucket)
+    args = eng._admit_example_args(bucket)
+    return BuildResult(prog, args, cleanup=eng.stop)
+
+
+def _llama_tiny_programs():
+    import jax
+    from ..models.llama import LlamaConfig, LlamaForCausalLM
+    from ..models.generation import build_generate_programs
+    from ..jit.functional import raw_state
+    from ..framework import random as _rng
+    _rng.seed(0)
+    model = LlamaForCausalLM(LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=176,
+        num_layers=2, num_heads=4, num_kv_heads=2, max_seq_len=128))
+    model.eval()
+    P, new = 16, 8
+    prefill, decode = build_generate_programs(
+        model, P, new, eos=None, do_sample=False, temperature=1.0,
+        top_k=0, top_p=1.0)
+    params, buffers = raw_state(model)
+    caches = model.new_cache(1, P + new, "float32")
+    return prefill, decode, params, buffers, caches, P
+
+
+def build_llama_prefill() -> BuildResult:
+    import jax
+    prefill, _, params, buffers, caches, P = _llama_tiny_programs()
+    args = (params, buffers, np.zeros((1, P), np.int64), caches,
+            jax.random.PRNGKey(0))
+    return BuildResult(prefill, args)
+
+
+def build_llama_decode() -> BuildResult:
+    import jax
+    _, decode, params, buffers, caches, _ = _llama_tiny_programs()
+    tok0 = np.zeros((1,), np.int32)
+    args = (params, buffers, tok0, caches, jax.random.PRNGKey(0))
+    return BuildResult(decode, args)
+
+
+def _train_step_parts(model):
+    from ..optimizer import AdamW
+    from ..models.gpt import GPTForCausalLM
+    from ..framework import random as _rng
+    opt = AdamW(learning_rate=1e-3, parameters=model.parameters())
+    return GPTForCausalLM.loss_fn, opt, _rng
+
+
+def build_train_step() -> BuildResult:
+    import jax.numpy as jnp
+    from ..jit.training import TrainStep
+    model = _gpt_tiny_model()
+    loss_fn, opt, _rng = _train_step_parts(model)
+    step = TrainStep(model, loss_fn, opt)
+    step._build()
+    ids = np.zeros((2, 32), np.int64)
+    args = (step.params, step.buffers, step.opt_state,
+            jnp.asarray(1e-3, jnp.float32), jnp.asarray(1, jnp.float32),
+            _rng.default_generator().fold_in(1), ids, ids)
+    return BuildResult(step._jitted, args)
+
+
+def build_train_step_scan() -> BuildResult:
+    """The fused K-step window exactly as Model.fit dispatches it:
+    TrainStep.scan_steps' jitted program at K=4 — super-batch + state
+    donated, the PRNG base key an ARGUMENT (per-step keys fold in-
+    program), no host callback anywhere in the window."""
+    from ..jit.training import TrainStep
+    from ..framework import random as _rng
+    model = _gpt_tiny_model()
+    loss_fn, opt, _rng2 = _train_step_parts(model)
+    step = TrainStep(model, loss_fn, opt)
+    K = 4
+    prog = step._get_scan_prog(K, 2)
+    ids = np.zeros((K, 2, 32), np.int64)
+    args = (step.params, step.buffers, step.opt_state,
+            _rng.get_rng_state(),
+            np.full((K,), 1e-3, np.float32),
+            np.arange(1, K + 1, dtype=np.float32),
+            np.arange(1, K + 1, dtype=np.int32), ids, ids)
+    return BuildResult(prog, args)
+
+
+def build_parallel_train_step() -> BuildResult:
+    import jax
+    import jax.numpy as jnp
+    from ..distributed import mesh as mesh_mod
+    from ..distributed.parallel_step import ParallelTrainStep
+    prev = mesh_mod.get_mesh(create_default=False)
+    devs = jax.devices()
+    if len(devs) < 4:
+        raise RuntimeError(
+            f"parallel_train_step needs >= 4 devices, have {len(devs)} "
+            "(run under XLA_FLAGS=--xla_force_host_platform_device_"
+            "count=8; tools/tpulint.py and tools/warmup.py set this up "
+            "themselves)")
+
+    def cleanup():
+        mesh_mod.set_mesh(prev)
+
+    try:
+        mesh_mod.init_mesh({"dp": 2, "sharding": 2}, devices=devs[:4])
+        model = _gpt_tiny_model()
+        loss_fn, opt, _rng = _train_step_parts(model)
+        step = ParallelTrainStep(model, loss_fn, opt, zero_stage=2)
+        ids = np.zeros((4, 32), np.int64)
+        raw_batch = (ids, ids)
+        step._build(raw_batch)
+        args = (step.params, step.buffers, step.opt_state,
+                jnp.asarray(1e-3, jnp.float32),
+                jnp.asarray(1, jnp.float32),
+                _rng.default_generator().fold_in(1)) + raw_batch
+    except BaseException:
+        # build raised after the global mesh was swapped: restore it
+        # here — consumers never receive the cleanup on this path
+        cleanup()
+        raise
+    return BuildResult(step._jitted, args, cleanup=cleanup)
+
+
+_registered = False
+
+
+def ensure_registered() -> None:
+    """Populate the registry with the canonical sites (idempotent —
+    registry.py calls this lazily on first lookup)."""
+    global _registered
+    if _registered:
+        return
+    # ORDER MATTERS for tpulint: the first five names reproduce the
+    # pre-registry MANIFEST_PROGRAMS order so baseline keys and
+    # reports stay stable; newly covered programs append after.
+    register("gpt_decode", build_gpt_decode,
+             tags=("manifest", "serving"),
+             description="engine batched decode tick (GPT-tiny)")
+    register("llama_prefill", build_llama_prefill,
+             tags=("manifest", "serving"),
+             description="generate() prefill program (LLaMA-tiny)")
+    register("train_step", build_train_step,
+             tags=("manifest", "training"),
+             description="TrainStep fused whole-step program")
+    register("train_step_scan", build_train_step_scan,
+             tags=("manifest", "training"),
+             description="fused K=4 training window")
+    register("parallel_train_step", build_parallel_train_step,
+             tags=("manifest", "training", "collectives"),
+             compile_collectives=True, min_devices=4,
+             description="ParallelTrainStep on dp2 x sharding2 ZeRO-2")
+    register("gpt_admit", build_gpt_admit,
+             tags=("manifest", "serving"),
+             description="engine bucketed prefill/admission program")
+    register("llama_decode", build_llama_decode,
+             tags=("manifest", "serving"),
+             description="generate() whole-decode scan (LLaMA-tiny)")
+    # only now: a failure above (e.g. a consumer squatting a canonical
+    # name) must stay loud on every retry, not flip the flag and leave
+    # the registry silently half-populated for the rest of the process
+    _registered = True
+
+
+# registry.py imports this module lazily and expects registration as a
+# side effect of that import
+ensure_registered()

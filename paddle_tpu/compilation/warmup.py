@@ -1,0 +1,147 @@
+"""Warmup engine — trace, lower, and compile registered programs ahead
+of traffic.
+
+`compilation.warmup(names)` drives the ProgramRegistry: build each
+site, then compile-or-load through the executable store
+(`store.aot_compile`). On a store-warm machine the whole pass is
+trace-only (zero XLA compiles — the idempotence contract
+tests/test_compilation.py counter-asserts); on a cold one it pays the
+compiles ONCE, publishes the executables, and primes the jax
+persistent compilation cache as a side effect (the same programs
+tpulint and the quick tests compile — `tools/ci.py --warmup` exists
+for exactly that).
+
+Builds run serially (builders seed the global RNG and may swap the
+global mesh); with ``parallel=K`` the trace+lower+compile stage runs in
+a K-thread pool (XLA compiles release the GIL). Programs whose build
+touched global state (a cleanup is registered) compile inside their
+build's critical section instead.
+
+Live sites (a serving engine, an in-flight fit) warm their OWN
+programs — `engine.warmup()`, `TrainStep.warm()` — through the same
+store; this module is the fixture/CLI/CI path.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import List, Optional, Sequence
+
+from . import counters, log as compile_log, registry
+from .store import ExecutableStore, aot_compile, default_store
+
+__all__ = ["warmup", "prime_helper_ops", "WarmupReport"]
+
+
+class WarmupReport(dict):
+    """Plain dict with convenience accessors (JSON-ready as-is)."""
+
+    @property
+    def ok(self) -> bool:
+        return not any(r.get("source") == "error"
+                       for r in self.get("programs", []))
+
+    @property
+    def compiled(self) -> int:
+        return sum(1 for r in self.get("programs", [])
+                   if str(r.get("source", "")).startswith("compiled"))
+
+    @property
+    def from_store(self) -> int:
+        return sum(1 for r in self.get("programs", [])
+                   if r.get("source") == "store")
+
+
+def _warm_one(name: str, store: ExecutableStore, build_lock) -> dict:
+    rec: dict = {"name": name}
+    try:
+        prog = registry.get(name)
+        import jax
+        if prog.min_devices > len(jax.devices()):
+            rec["source"] = "skipped"
+            rec["reason"] = (f"needs >= {prog.min_devices} devices, "
+                            f"have {len(jax.devices())}")
+            return rec
+        with build_lock:
+            built = registry.build(name)
+            if built.cleanup is not None:
+                # build swapped global state (mesh): lower+compile must
+                # happen before cleanup restores it
+                try:
+                    aot = aot_compile(name, built.fn, built.args,
+                                      store=store, log_record=rec,
+                                      static_key=built.static_key)
+                finally:
+                    built.cleanup()
+                if built.install is not None:
+                    built.install(aot)
+                return rec
+        aot = aot_compile(name, built.fn, built.args, store=store,
+                          log_record=rec, static_key=built.static_key)
+        if built.install is not None:
+            built.install(aot)
+    except Exception as e:   # noqa: BLE001 — one bad site must not
+        rec["source"] = "error"            # abort the whole warmup
+        rec["error"] = f"{type(e).__name__}: {e}"
+    return rec
+
+
+def warmup(names: Optional[Sequence[str]] = None, parallel: int = 1,
+           store: Optional[ExecutableStore] = None) -> WarmupReport:
+    """Warm the named registered programs (None/"all" = every one).
+    Returns a :class:`WarmupReport`; every program also lands one
+    record in the process compile log."""
+    if names is None or names == "all":
+        names = registry.names()
+    else:
+        names = list(names)
+        unknown = set(names) - set(registry.names())
+        if unknown:
+            raise ValueError(
+                f"unknown program(s) {sorted(unknown)}; "
+                f"registered: {registry.names()}")
+    store = store if store is not None else default_store()
+    counters.install()
+    build_lock = threading.Lock()
+    t0 = time.perf_counter()
+    with counters.CompileTracker() as trk:
+        if parallel > 1 and len(names) > 1:
+            with ThreadPoolExecutor(max_workers=parallel) as pool:
+                recs = list(pool.map(
+                    lambda n: _warm_one(n, store, build_lock), names))
+        else:
+            recs = [_warm_one(n, store, build_lock) for n in names]
+    for rec in recs:
+        compile_log.record(rec)
+    return WarmupReport(
+        programs=recs,
+        wall_s=round(time.perf_counter() - t0, 3),
+        xla_compiles=trk.xla_compiles,
+        backend_compiles=trk.backend_compiles,
+        persistent_cache_hits=trk.persistent_cache_hits,
+        store_dir=store.root if store.enabled else None)
+
+
+_helpers_primed = False
+
+
+def prime_helper_ops() -> None:
+    """Compile the tiny eager ops the serving/training HOST paths run
+    per request/step (PRNGKey construction, fold_in/split, scalar
+    casts). They are jit-cached per process by shape — one call here
+    moves their first-compile cost into warmup, which is what lets a
+    store-warm process reach first token with zero compiles. Idempotent
+    and cheap (sub-second even cold)."""
+    global _helpers_primed
+    if _helpers_primed:
+        return
+    import jax
+    import jax.numpy as jnp
+    key = jax.random.PRNGKey(0)
+    jax.random.split(key)
+    jax.random.fold_in(key, 1)
+    jnp.asarray(0.0, jnp.float32)
+    jnp.asarray(1, jnp.float32)
+    jnp.asarray(1, jnp.int32)
+    _helpers_primed = True

@@ -1,15 +1,24 @@
-"""Shared integer env-knob parsing.
+"""Shared env-knob parsing.
 
-One definition for the idiom every tuning knob repeats (serving-engine
-slot counts, fused-loop window size, prefetch depth, bench levers):
+One definition for the idioms every tuning knob repeats (serving-engine
+slot counts, fused-loop window size, warmup switches, bench levers):
 read the variable, fall back to the default on garbage, optionally
-clamp to a floor.
+clamp to a floor; one truthiness rule for on/off switches.
 """
 from __future__ import annotations
 
 import os
 
-__all__ = ["int_env"]
+__all__ = ["int_env", "bool_env"]
+
+
+def bool_env(name: str, default: bool) -> bool:
+    """Boolean env knob: unset -> ``default``; otherwise anything but
+    (case-insensitive) ``0``/``false``/``off``/empty counts as on."""
+    value = os.environ.get(name)
+    if value is None:
+        return default
+    return value.strip().lower() not in ("0", "false", "off", "")
 
 
 def int_env(name: str, default: int, minimum: int | None = None) -> int:

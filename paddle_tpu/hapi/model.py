@@ -15,7 +15,7 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 from ..core.tensor import Tensor
-from ..framework.env import int_env
+from ..framework.env import bool_env, int_env
 from ..io.state import load as _load, save as _save
 from ..jit.training import TrainStep
 from ..metric import Metric
@@ -119,7 +119,7 @@ class Model:
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
             verbose=1, drop_last=False, shuffle=True, num_workers=0,
             callbacks=None, accumulate_grad_batches=1, num_iters=None,
-            scan_steps=None):
+            scan_steps=None, warm_start=None):
         """Parity: Model.fit (hapi/model.py:1045). train_data may be a
         DataLoader or a Dataset (a loader is built with batch_size).
 
@@ -184,6 +184,16 @@ class Model:
         if scan_steps is None:
             scan_steps = int_env("PADDLE_TPU_SCAN_STEPS", 1, minimum=1)
         scan_steps = max(1, int(scan_steps))
+        # AOT warmup (paddle_tpu.compilation): compile-or-load the
+        # training program(s) through the persistent executable store
+        # BEFORE the first step — a store-warm fresh process reaches
+        # its first train step with zero XLA compiles. Default from
+        # PADDLE_TPU_WARM_START (off: warming peeks one batch from a
+        # fresh loader iterator, which assumes a re-iterable loader).
+        if warm_start is None:
+            warm_start = bool_env("PADDLE_TPU_WARM_START", False)
+        if warm_start:
+            self._warm_start(loader, scan_steps)
         for cb in cbs:
             cb.on_train_begin()
         try:
@@ -200,6 +210,25 @@ class Model:
         for cb in cbs:
             cb.on_train_end()
         return self
+
+    def _warm_start(self, loader, scan_steps):
+        """fit(warm_start=True): peek ONE batch from a fresh loader
+        iterator for shapes only and compile-or-load the training
+        program(s) through the persistent executable store
+        (TrainStep.warm) — including the fused K-step window when the
+        fused path will run — so time-to-first-step stops paying the
+        compile. The peeked batch is never trained on here: epoch
+        iteration restarts from its own iterator."""
+        try:
+            batch = next(iter(loader))
+        except (StopIteration, TypeError):
+            return
+        inputs, labels = self._split_batch(batch)
+        step = self._ensure_train_step(len(inputs))
+        fused = scan_steps > 1 and self._auto_lr_step
+        step.warm(*inputs, *labels,
+                  scan_k=scan_steps if fused else None,
+                  static_extra=type(self._loss).__name__)
 
     def _fit_epochs(self, loader, eval_data, batch_size, epochs,
                     eval_freq, num_workers, num_iters, cbs, watchdog,

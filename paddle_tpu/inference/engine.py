@@ -192,6 +192,7 @@ class ContinuousBatchingEngine:
         self._trace_count = 0
         self._admit_progs = {}        # bucket -> jitted admit program
         self._decode_prog = None
+        self._warmed = False          # warmup() completed
         self.ticks = 0
         self.admitted = 0
         self.completed = 0
@@ -267,6 +268,73 @@ class ContinuousBatchingEngine:
         """How many times XLA traced an engine program — constant after
         warmup is the no-recompile serving guarantee."""
         return self._trace_count
+
+    @property
+    def warm(self) -> bool:
+        """True once the batched decode program is actually COMPILED —
+        either warmup() finished (compiled or loaded from the
+        executable store) or the first lazy tick completed. The raw jit
+        wrapper existing is not enough: readiness claimed mid-compile
+        would stall the first routed request, the exact lie the
+        serving layer's warming->ready /healthz transition exists to
+        prevent."""
+        return self._warmed or self.ticks > 0
+
+    # -- AOT warmup ------------------------------------------------------
+    def _static_key(self) -> str:
+        """Trace-time constants of this engine's programs that never
+        appear in an argument aval — part of the executable-store key
+        (two engines over the same weights but different sampling
+        config must not collide)."""
+        return repr((type(self.model).__name__, self._sampling,
+                     self.tick_tokens, self.max_len, self.cache_dtype))
+
+    def _decode_example_args(self) -> tuple:
+        N = self.slots
+        return (self._params, self._buffers, self._caches,
+                np.zeros(N, np.int32), np.zeros(N, np.int32),
+                np.ones(N, bool), np.full(N, -1, np.int32),
+                np.zeros((N, 2), np.uint32))
+
+    def _admit_example_args(self, bucket: int) -> tuple:
+        return (self._params, self._buffers,
+                np.zeros((1, bucket), np.int64), np.int32(0),
+                np.zeros(2, np.uint32), self._caches, np.int32(0))
+
+    def warmup(self, buckets: Optional[tuple] = None, store=None) -> list:
+        """Compile-or-load THIS engine's programs ahead of traffic: the
+        batched decode tick plus one admission program per prefill
+        bucket, through the persistent executable store
+        (paddle_tpu.compilation) — a store-warm fresh process reaches
+        its first token without XLA compiling anything. Also primes the
+        tiny eager helper ops the admission path runs per request
+        (PRNGKey construction). Returns the compile-log records."""
+        from ..compilation import log as _clog
+        from ..compilation import prime_helper_ops
+        from ..compilation.store import AotProgram, aot_compile
+        prime_helper_ops()
+        static = self._static_key()
+        recs = []
+        if not isinstance(self._decode_prog, AotProgram):
+            rec: dict = {"site": "engine_decode"}
+            self._decode_prog = aot_compile(
+                "engine_decode", self._get_decode_prog(),
+                self._decode_example_args(), store=store, log_record=rec,
+                static_key=static)
+            recs.append(_clog.record(rec))
+        for bucket in (buckets if buckets is not None
+                       else self.prefill_buckets):
+            bucket = self._bucket_for(int(bucket))
+            if isinstance(self._admit_progs.get(bucket), AotProgram):
+                continue
+            rec = {"site": f"engine_admit_b{bucket}"}
+            self._admit_progs[bucket] = aot_compile(
+                f"engine_admit_b{bucket}", self._get_admit_prog(bucket),
+                self._admit_example_args(bucket), store=store,
+                log_record=rec, static_key=static)
+            recs.append(_clog.record(rec))
+        self._warmed = True
+        return recs
 
     def stop(self):
         with self._cv:

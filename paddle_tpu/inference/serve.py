@@ -68,7 +68,7 @@ class PredictorServer:
 
     def __init__(self, model_path_or_config=None, host: str = "127.0.0.1",
                  port: int = 8866, deadline_s: float = None,
-                 max_queue: int = None, engine=None):
+                 max_queue: int = None, engine=None, warmup: bool = None):
         if model_path_or_config is None and engine is None:
             raise ValueError(
                 "need a model path/Config (predict path), an engine "
@@ -109,11 +109,44 @@ class PredictorServer:
         self._depth = 0                 # requests submitted, not done
         self._depth_lock = threading.Lock()
         self._failure_streak = 0        # consecutive 5xx-class outcomes
+        # AOT warmup (paddle_tpu.compilation): compile-or-load the
+        # engine's programs BEFORE the first request instead of on it.
+        # /healthz reports "warming" (503) until done and /generate
+        # sheds with the 503 contract — an orchestrator keeps traffic
+        # off a process that would stall it on a compile.
+        if warmup is None:
+            from ..framework.env import bool_env
+            warmup = bool_env("PADDLE_TPU_SERVE_WARMUP", False)
+        self._warmup_requested = bool(warmup)
+        self._warm_state = "warming" if self._warmup_requested else "ready"
+        self._warm_error = None
+        self._warmup_thread = None
         self._started = time.monotonic()
         self.httpd = ThreadingHTTPServer((host, port),
                                          self._make_handler())
         self.host, self.port = self.httpd.server_address[:2]
         self._thread = None
+        if self._warmup_requested:
+            # warm on a side thread so the listener binds (and answers
+            # /health + a truthful warming /healthz) immediately —
+            # readiness flips, liveness never blocks on a compile
+            self._warmup_thread = threading.Thread(
+                target=self._run_warmup, daemon=True,
+                name="serve-warmup")
+            self._warmup_thread.start()
+
+    def _run_warmup(self):
+        try:
+            from ..compilation import prime_helper_ops
+            prime_helper_ops()
+            if self.engine is not None and hasattr(self.engine, "warmup"):
+                self.engine.warmup()
+        except Exception as e:   # noqa: BLE001 — a failed warmup must
+            # not brick the server: first traffic falls back to the
+            # lazy-jit compile it would have paid anyway
+            self._warm_error = f"{type(e).__name__}: {e}"
+        finally:
+            self._warm_state = "ready"
 
     # ------------------------------------------------------------------
     def _metadata(self):
@@ -132,16 +165,34 @@ class PredictorServer:
                 "queue_depth": self._depth,
                 "max_queue": self.max_queue,
                 "failure_streak": self._failure_streak}
+        try:
+            from ..compilation import log as _clog
+            body["compilation"] = _clog.summary()
+        except Exception:
+            pass
+        st = None
         if self.engine is not None:
             st = self.engine.stats()
             body["engine"] = {k: st[k] for k in
                               ("slots", "active", "free", "queued",
                                "max_queue", "ticks",
                                "compiled_programs")}
-            if st["queued"] >= st["max_queue"]:
-                body.update(status="unready",
-                            reason="engine request queue saturated")
-                return False, body
+            body["engine"]["warm"] = getattr(self.engine, "warm", True)
+        if self._warm_state == "warming":
+            # truthful readiness: programs are still compiling (or
+            # loading from the executable store); traffic sent now
+            # would stall behind the compile
+            body.update(status="warming", reason="warmup in progress")
+            return False, body
+        if self._warm_error is not None:
+            # warmup failed — the server still serves (lazy compile on
+            # first request is the degraded-but-correct fallback), the
+            # orchestrator just gets to see why readiness was late
+            body["warmup_error"] = self._warm_error
+        if st is not None and st["queued"] >= st["max_queue"]:
+            body.update(status="unready",
+                        reason="engine request queue saturated")
+            return False, body
         if self.predictor is None and self.engine is None:
             body.update(status="unready", reason="no predictor loaded")
             return False, body
@@ -309,6 +360,21 @@ class PredictorServer:
                     self._send(404, {"error": "no generation engine "
                                               "attached to this server"})
                     return
+                if server._warm_state == "warming":
+                    # shed with the load-shedding 503 contract instead
+                    # of queueing the request behind the compile — an
+                    # orchestrator retries against a ready replica.
+                    # Drain the request body first: responding with
+                    # unread bytes on the socket resets the connection
+                    # instead of delivering the 503
+                    try:
+                        self.rfile.read(
+                            int(self.headers.get("Content-Length", "0")))
+                    except (ValueError, OSError):
+                        pass
+                    self._send(503, {"error": "warming_up",
+                                     "queue_depth": 0})
+                    return
                 from .engine import EngineOverloaded
                 try:
                     n = int(self.headers.get("Content-Length", "0"))
@@ -379,6 +445,11 @@ class PredictorServer:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+        if self._warmup_thread is not None:
+            # a mid-compile warmup thread is daemon + side-effect-free
+            # past this point; don't block shutdown on it
+            self._warmup_thread.join(timeout=1)
+            self._warmup_thread = None
         if self._owned_predictor is not None:
             # engine built from OUR Config: stop its tick thread and
             # release the slot cache (an explicitly-passed engine is
@@ -395,8 +466,14 @@ def main(argv=None):
                     help="path to the saved .pdmodel")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=8866)
+    ap.add_argument("--warmup", action="store_true",
+                    help="AOT-warm the engine's programs before "
+                         "accepting /generate traffic (healthz reports "
+                         "warming until done); default from "
+                         "PADDLE_TPU_SERVE_WARMUP")
     args = ap.parse_args(argv)
-    srv = PredictorServer(args.model, args.host, args.port)
+    srv = PredictorServer(args.model, args.host, args.port,
+                          warmup=args.warmup or None)
     print(f"serving {args.model} on http://{srv.host}:{srv.port}",
           flush=True)
     srv.start(background=False)

@@ -462,6 +462,96 @@ class TrainStep:
         return Tensor(losses)
 
     # ------------------------------------------------------------------
+    # AOT warmup (paddle_tpu.compilation)
+    # ------------------------------------------------------------------
+    def _static_key(self, extra: str = "") -> str:
+        """Trace-time constants of this step's programs that never
+        appear in an argument aval: the loss/optimizer code baked into
+        the graph (betas, eps, weight decay are trace constants — the
+        LR is the only hyperparameter passed as an argument) and the
+        accumulation cadence. Part of the executable-store key so two
+        models with identical parameter geometry but different baked
+        config cannot collide. ``extra`` lets the owner add what it
+        alone can see (hapi passes its loss object's type — TrainStep
+        only sees an anonymous closure)."""
+        opt = self.optimizer
+        hypers = sorted((k, v) for k, v in vars(opt).items()
+                        if isinstance(v, (bool, int, float, str)))
+        return repr((type(self.model).__name__, type(opt).__name__,
+                     hypers, getattr(self.loss_fn, "__qualname__",
+                                     repr(self.loss_fn)),
+                     self.accumulate_steps, self.n_inputs, extra))
+
+    def warm(self, *example_batch, scan_k: Optional[int] = None,
+             store=None, static_extra: str = "") -> list:
+        """Compile-or-load this step's programs through the persistent
+        executable store (paddle_tpu.compilation) BEFORE the first
+        step: the per-step program(s) — both cadence programs with
+        gradient merge — and, with ``scan_k``, the fused K-step window.
+        ``example_batch`` is one real (or shape-identical) batch; it is
+        only lowered, never executed, and no counter/LR/RNG state
+        moves. On a store-warm machine the first `fit` step then
+        dispatches a deserialized executable with ZERO XLA compiles
+        (tools/bench_cold_start.py asserts exactly this). Returns the
+        compile-log records."""
+        from ..compilation import log as _clog
+        from ..compilation import prime_helper_ops
+        from ..compilation.store import AotProgram, aot_compile
+        prime_helper_ops()
+        static = self._static_key(static_extra)
+        if self._jitted is None:
+            self._build()
+        raw_batch = _raw_tuple(example_batch)
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        step_no = jnp.asarray(1, jnp.float32)
+        key = _rng.default_generator().fold_in(1)
+        recs = []
+        k = self.accumulate_steps
+
+        def _warm_site(name, prog, args):
+            rec = {"site": name}
+            aot = aot_compile(name, prog, args, store=store,
+                              log_record=rec, static_key=static)
+            recs.append(_clog.record(rec))
+            return aot
+
+        if not isinstance(self._jitted, AotProgram):
+            if k == 1:
+                args = (self.params, self.buffers, self.opt_state,
+                        lr, step_no, key) + raw_batch
+                self._jitted = _warm_site("train_step", self._jitted,
+                                          args)
+            else:
+                acc_args = (self.params, self.buffers, self.opt_state,
+                            self.acc_grads, lr, step_no, key) + raw_batch
+                self._jitted_acc = _warm_site(
+                    "train_step_acc", self._jitted_acc, acc_args)
+                self._jitted = _warm_site(
+                    "train_step_apply", self._jitted, acc_args)
+        if scan_k is not None and scan_k > 1:
+            prog = self._get_scan_prog(scan_k, len(raw_batch))
+            if not isinstance(prog, AotProgram):
+                sb = tuple(np.stack([b] * scan_k) for b in raw_batch)
+                lrs = np.full((scan_k,), self.optimizer.get_lr(),
+                              np.float32)
+                step_nos = np.arange(1, scan_k + 1, dtype=np.float32)
+                counts = np.arange(1, scan_k + 1, dtype=np.int32)
+                base_key = _rng.get_rng_state()
+                if k > 1:
+                    upd = (counts % k) == 0
+                    args = (self.params, self.buffers, self.opt_state,
+                            self.acc_grads, base_key, lrs, step_nos,
+                            counts, upd) + sb
+                else:
+                    args = (self.params, self.buffers, self.opt_state,
+                            base_key, lrs, step_nos, counts) + sb
+                with _quiet_unused_donation():
+                    aot = _warm_site(f"train_step_scan_k{scan_k}",
+                                     prog, args)
+                self._scan_progs[(int(scan_k), len(raw_batch))] = aot
+        return recs
+
+    # ------------------------------------------------------------------
     def flush_accumulation(self):
         """Apply any pending partial accumulation (mean over the
         micro-steps seen so far). No-op when the cadence is aligned.

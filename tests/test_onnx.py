@@ -138,8 +138,12 @@ def _run_onnx(model_bytes: bytes, feeds: dict) -> list:
     return [env[o["name"]] for o in g["outputs"]]
 
 
-def _check_export(layer, specs, feeds, rtol=2e-5, atol=2e-5):
-    path = export(layer, "_tmp_onnx_model", input_spec=specs)
+def _check_export(layer, specs, feeds, rtol=2e-5, atol=2e-5,
+                  out_dir="."):
+    # export under the test's tmp_path, never the repo root (.gitignore
+    # guards _tmp_* as a second line of defense against strays)
+    path = export(layer, str(out_dir) + "/_tmp_onnx_model",
+                  input_spec=specs)
     with open(path, "rb") as f:
         data = f.read()
     m = P.parse_model(data)
@@ -154,13 +158,13 @@ def _check_export(layer, specs, feeds, rtol=2e-5, atol=2e-5):
 
 
 class TestOnnxExport:
-    def test_mlp_gelu(self):
+    def test_mlp_gelu(self, tmp_path):
         paddle.seed(0)
         layer = nn.Sequential(nn.Linear(16, 32), nn.GELU(),
                               nn.Linear(32, 4))
         x = np.random.RandomState(0).randn(8, 16).astype(np.float32)
         m = _check_export(layer, [InputSpec([8, 16], "float32", "x")],
-                          {"x": x})
+                          {"x": x}, out_dir=tmp_path)
         ops = {n["op_type"] for n in m["graph"]["nodes"]}
         assert "MatMul" in ops
         # weights became initializers, input stayed a graph input
@@ -168,39 +172,42 @@ class TestOnnxExport:
         assert m["graph"]["inputs"][0]["name"] == "x"
         assert len(m["graph"]["initializers"]) >= 4
 
-    def test_layernorm_softmax(self):
+    def test_layernorm_softmax(self, tmp_path):
         paddle.seed(1)
         layer = nn.Sequential(nn.Linear(10, 10), nn.LayerNorm(10),
                               nn.Softmax())
         x = np.random.RandomState(1).randn(4, 10).astype(np.float32)
-        _check_export(layer, [InputSpec([4, 10], "float32", "x")], {"x": x})
+        _check_export(layer, [InputSpec([4, 10], "float32", "x")], {"x": x},
+                      out_dir=tmp_path)
 
-    def test_conv_relu(self):
+    def test_conv_relu(self, tmp_path):
         paddle.seed(2)
         layer = nn.Sequential(nn.Conv2D(3, 6, 3, padding=1), nn.ReLU())
         x = np.random.RandomState(2).randn(2, 3, 8, 8).astype(np.float32)
         m = _check_export(layer, [InputSpec([2, 3, 8, 8], "float32", "img")],
-                          {"img": x}, rtol=1e-4, atol=1e-4)
+                          {"img": x}, rtol=1e-4, atol=1e-4,
+                          out_dir=tmp_path)
         conv = [n for n in m["graph"]["nodes"] if n["op_type"] == "Conv"]
         assert conv and conv[0]["attrs"]["pads"] == [1, 1, 1, 1]
 
-    def test_cnn_with_pooling(self):
+    def test_cnn_with_pooling(self, tmp_path):
         paddle.seed(3)
         layer = nn.Sequential(nn.Conv2D(3, 4, 3, padding=1), nn.ReLU(),
                               nn.MaxPool2D(2), nn.AvgPool2D(2))
         x = np.random.RandomState(3).randn(2, 3, 8, 8).astype(np.float32)
         m = _check_export(layer, [InputSpec([2, 3, 8, 8], "float32", "img")],
-                          {"img": x}, rtol=1e-4, atol=1e-4)
+                          {"img": x}, rtol=1e-4, atol=1e-4,
+                          out_dir=tmp_path)
         ops = [n["op_type"] for n in m["graph"]["nodes"]]
         assert "MaxPool" in ops and "AveragePool" in ops
 
-    def test_unmapped_primitive_raises_with_guidance(self):
+    def test_unmapped_primitive_raises_with_guidance(self, tmp_path):
         class Sorter(nn.Layer):
             def forward(self, x):
                 return paddle.sort(x, axis=-1)
 
         with pytest.raises(OnnxExportError, match="jit.save"):
-            export(nn.Sequential(Sorter()), "_tmp_onnx_bad",
+            export(nn.Sequential(Sorter()), str(tmp_path / "_tmp_onnx_bad"),
                    input_spec=[InputSpec([4, 8], "float32")])
 
     def test_varint_negative_roundtrip(self):

@@ -32,6 +32,15 @@ run kv_quality kv_quality.json python tools/kv_cache_quality.py
 # K in {4,16} scanned windows + the zero-mid-window-sync assertion;
 # self-skips once landed like every other step
 run bench_train_loop bench_train_loop.json python tools/bench_train_loop.py
+# program warmup (PR 5): prime the executable store + jax persistent
+# cache from the ProgramRegistry — every later compile-heavy step
+# (125M/1.3B excepted: different geometry) and any tier-1 re-run then
+# loads instead of compiling; self-skips once landed
+run warmup warmup.json python tools/warmup.py
+# cold-start bench (PR 5): fresh-process cold vs store-warm
+# time-to-first-token (serve) / first-step (fit); ASSERTS the warm
+# pass ran ZERO XLA compiles; self-skips once landed
+run bench_cold_start bench_cold_start.json python tools/bench_cold_start.py
 # static-analysis gate (PR 3): lints the real decode/prefill/train-step
 # programs vs tools/tpulint_baseline.json; self-skips once landed (the
 # terminal stdout line is a _have_result-good JSON record even when the
