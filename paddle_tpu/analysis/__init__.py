@@ -19,8 +19,15 @@ systematic way to inspect it BEFORE it reaches hardware:
   generate prefill, TrainStep, ParallelTrainStep on a fake 4-device
   mesh) rebuilt and linted; `tools/tpulint.py` gates CI on the diff
   against tools/tpulint_baseline.json.
+- hlo_cost + fusion: the tpucost pass — compiled HLO parsed into a
+  per-program FLOP/HBM/roofline inventory with fusion histogram and
+  the ranked unfused-chain report; `tools/tpucost.py` gates CI on
+  ratcheted budgets + anchors in tools/tpucost_baseline.json.
+- report:        the shared --json artifact + terminal-record contract
+  both CLIs emit (tools/_have_result.py predicate).
 
-CLI: python tools/tpulint.py [--manifest default] [--update-baseline]
+CLIs: python tools/tpulint.py [--update-baseline] [--json out.json]
+      python tools/tpucost.py [--update-baseline] [--json out.json]
 """
 from .findings import (Finding, Severity, count_findings,
                        diff_against_baseline, findings_to_json,
@@ -31,6 +38,13 @@ from .codebase_lint import (HOT_JIT_FILES, lint_file, lint_quarantine,
                             lint_tree)
 from .manifest import (MANIFEST_PROGRAMS, ProgramSpec, default_manifest,
                        manifest_names, run_manifest)
+from .hlo_cost import (CHIP_SPECS, DEFAULT_CHIP, ChipSpec,
+                       analytic_decode_hbm_bytes, check_cost_baseline,
+                       collect_kernels, load_cost_baseline,
+                       parse_hlo_module, program_cost,
+                       updated_cost_baseline)
+from .fusion import fusion_histogram, unfused_chains
+from .report import terminal_record, write_report_artifact
 
 __all__ = [
     "Finding", "Severity", "count_findings", "diff_against_baseline",
@@ -40,4 +54,9 @@ __all__ = [
     "lint_tree", "lint_file", "lint_quarantine", "HOT_JIT_FILES",
     "ProgramSpec", "default_manifest", "run_manifest",
     "MANIFEST_PROGRAMS", "manifest_names",
+    "ChipSpec", "CHIP_SPECS", "DEFAULT_CHIP", "parse_hlo_module",
+    "program_cost", "collect_kernels", "analytic_decode_hbm_bytes",
+    "check_cost_baseline", "load_cost_baseline",
+    "updated_cost_baseline", "fusion_histogram", "unfused_chains",
+    "write_report_artifact", "terminal_record",
 ]

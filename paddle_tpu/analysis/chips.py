@@ -1,0 +1,38 @@
+"""Accelerator roofline constants — the ONE table.
+
+Deliberately dependency-free (stdlib dataclasses only) so tools that
+need three numbers — `tools/northstar_model.py` is a pure-arithmetic
+planning script that must run on machines without jax — can load this
+file standalone via importlib without paying (or requiring) the full
+paddle_tpu/jax import. Everything else imports it through
+`paddle_tpu.analysis.hlo_cost`, which re-exports the table for the
+tpucost roofline.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict
+
+__all__ = ["ChipSpec", "CHIP_SPECS", "DEFAULT_CHIP"]
+
+
+@dataclass(frozen=True)
+class ChipSpec:
+    """Roofline constants for one accelerator generation (public specs).
+    `peak_flops` is bf16; `hbm_bandwidth` is bytes/s."""
+    name: str
+    peak_flops: float
+    hbm_bandwidth: float
+    hbm_capacity: float
+    ici_gbps: float = 0.0    # aggregate inter-chip Gbit/s (0 = n/a)
+
+
+CHIP_SPECS: Dict[str, ChipSpec] = {
+    # v5-lite (v5e): the chip the landed 33.6%-MFU 125M anchor ran on
+    "v5lite": ChipSpec("v5lite", peak_flops=197e12, hbm_bandwidth=819e9,
+                       hbm_capacity=16 * 2**30, ici_gbps=1600),
+    # v5p: the north-star pod chip (tools/northstar_model.py)
+    "v5p": ChipSpec("v5p", peak_flops=459e12, hbm_bandwidth=2765e9,
+                    hbm_capacity=95 * 2**30, ici_gbps=4800),
+}
+DEFAULT_CHIP = "v5lite"

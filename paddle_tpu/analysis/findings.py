@@ -37,6 +37,7 @@ __all__ = [
     "RECOMPILE_DIM", "RECOMPILE_STRUCTURE",
     "JIT_IN_CALL", "JIT_NO_DONATION", "TRACED_ATTR_MUTATION",
     "NUMPY_IN_TRACE", "STALE_QUARANTINE",
+    "COST_BUDGET", "COST_ANCHOR", "STALE_COST_PROGRAM",
     "count_findings", "diff_against_baseline", "load_baseline",
     "findings_to_json", "GATE_SEVERITIES",
 ]
@@ -59,6 +60,10 @@ JIT_NO_DONATION = "jit-no-donation"      # hot-wrapper jit without knobs
 TRACED_ATTR_MUTATION = "traced-attr-mutation"  # self.x = <expr> in forward
 NUMPY_IN_TRACE = "numpy-in-trace"        # numpy call on traced values
 STALE_QUARANTINE = "stale-quarantine"    # quarantine entry matches no test
+# tpucost (hlo_cost.py) roofline gate
+COST_BUDGET = "cost-budget"              # ratcheted budget exceeded
+COST_ANCHOR = "cost-anchor"              # hand-set cost invariant broken
+STALE_COST_PROGRAM = "stale-cost-program"  # baseline names a gone program
 
 
 class Severity:

@@ -1,0 +1,127 @@
+"""Fusion inventory: what XLA fused, and what it left on the table.
+
+"Operator Fusion in XLA" (PAPERS.md 2301.13062) observes that XLA's
+fusion decisions are recoverable from the optimized HLO and frequently
+leave adjacent elementwise work in separate kernels — each such
+boundary pays a full write + re-read of the intermediate through HBM.
+This module consumes the kernel list `hlo_cost.collect_kernels`
+produces and answers two questions per program:
+
+- `fusion_histogram`: how many kernels of each class (loop/input/
+  output/custom fusions, standalone dots, collectives, custom calls,
+  unfused elementwise, scalar glue) — the kernel_count budget in
+  tools/tpucost_baseline.json ratchets on the non-scalar total;
+- `unfused_chains`: the ranked "top unfused HBM traffic" report —
+  connected chains of fusable kernels (elementwise ops and kLoop
+  fusions) that consume each other's outputs yet were compiled as
+  separate kernels. `intermediate_bytes` is the traffic crossing the
+  chain's internal boundaries once; fusing the chain deletes up to
+  2x that (the producer's write and the consumer's re-read). These
+  chains are the candidate list every later Pallas-kernel /
+  mega-kernelization PR starts from.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+from .hlo_cost import KernelCost, _DATA_MOVEMENT, _ELEMWISE
+
+__all__ = ["fusion_histogram", "unfused_chains", "FUSABLE_CLASSES"]
+
+# kernel classes that a loop fusion could in principle absorb
+FUSABLE_CLASSES = ("loop", "unfused")
+
+
+def fusion_histogram(kernels: List[KernelCost]) -> Dict[str, int]:
+    """Kernel count per class. Classes: loop/input/output/custom
+    (fusion kinds), dot, collective, custom-call, unfused (standalone
+    elementwise/data-movement big enough to matter), scalar (glue)."""
+    hist: Dict[str, int] = {}
+    for k in kernels:
+        hist[k.klass] = hist.get(k.klass, 0) + 1
+    return hist
+
+
+def _fusable(k: KernelCost) -> bool:
+    if k.klass not in FUSABLE_CLASSES:
+        return False
+    if k.klass == "loop":
+        return True
+    # class "unfused": only elementwise-shaped ops join a chain
+    return (k.opcode in _ELEMWISE or k.opcode in _DATA_MOVEMENT
+            or k.opcode == "reduce")
+
+
+def unfused_chains(kernels: List[KernelCost], limit: int = 5
+                   ) -> List[dict]:
+    """Rank producer->consumer chains of fusable kernels left unfused.
+
+    Kernels are grouped by (path, trip) — a chain never crosses a loop
+    boundary (XLA could not fuse across it either). Within a group,
+    every edge where a fusable kernel reads a fusable kernel's output
+    is an avoidable HBM round-trip; connected components with >= 2
+    kernels are chains, ranked by the bytes crossing their internal
+    edges (already trip-multiplied by collect_kernels)."""
+    # nodes are keyed (path, trip, name): XLA deduplicates identical
+    # computations, so two loops can emit kernels with the SAME
+    # instruction names — bare-name keys would merge chains across the
+    # loop boundaries the grouping exists to respect
+    by_key: Dict[tuple, KernelCost] = {}
+    groups: Dict[tuple, List[KernelCost]] = {}
+    for k in kernels:
+        if _fusable(k):
+            by_key[(k.path, k.trip, k.name)] = k
+            groups.setdefault((k.path, k.trip), []).append(k)
+
+    parent: Dict[tuple, tuple] = {}
+
+    def find(x: tuple) -> tuple:
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    edge_list: List[tuple] = []
+    for (path, trip), ks in groups.items():
+        names = {k.name for k in ks}
+        for k in ks:
+            kk = (path, trip, k.name)
+            parent.setdefault(kk, kk)
+            for opn in set(k.operands):
+                if opn in names and opn != k.name:
+                    ok = (path, trip, opn)
+                    parent.setdefault(ok, ok)
+                    edge_list.append((ok, kk))
+
+    for a, b in edge_list:
+        ra, rb = find(a), find(b)
+        if ra != rb:
+            parent[ra] = rb
+
+    comps: Dict[tuple, List[tuple]] = {}
+    for key in parent:
+        comps.setdefault(find(key), []).append(key)
+    # one write per DISTINCT producer: a fan-out intermediate (one
+    # producer, two chain consumers) crosses HBM once, not per edge
+    boundary: Dict[tuple, int] = {}
+    for a in {a for a, _ in edge_list}:
+        r = find(a)
+        boundary[r] = boundary.get(r, 0) + by_key[a].bytes_written
+
+    chains = []
+    for root, members in comps.items():
+        if len(members) < 2:
+            continue
+        ks = [by_key[m] for m in members]
+        ops = sorted({k.op_name for k in ks if k.op_name})
+        chains.append({
+            "kernels": sorted(m[2] for m in members),
+            "kernel_count": len(members),
+            "ops": ops,
+            "path": ks[0].path,
+            "trip": ks[0].trip,
+            "intermediate_bytes": boundary.get(root, 0),
+            "savable_bytes": 2 * boundary.get(root, 0),
+        })
+    chains.sort(key=lambda c: c["intermediate_bytes"], reverse=True)
+    return chains[:limit]

@@ -41,6 +41,7 @@ from ._util import leaf_labels
 from .findings import (BAKED_RNG_KEY, COLLECTIVE, DTYPE_PROMOTION,
                        GATHER_OP, HOST_CALLBACK, SCATTER_OP,
                        UNDONATED_BUFFER, Finding, Severity)
+from .hlo_cost import HLO_DTYPE_BYTES as _HLO_DTYPE_BYTES
 
 __all__ = ["lint_program", "collective_inventory_from_hlo"]
 
@@ -66,12 +67,46 @@ _HLO_COLLECTIVE_RE = re.compile(
 
 _HLO_SHAPE_RE = re.compile(r"(?P<dt>[a-z]+\d+|pred)\[(?P<dims>[0-9,]*)\]")
 
-_HLO_DTYPE_BYTES = {
-    "pred": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1,
-    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
-    "s32": 4, "u32": 4, "f32": 4,
-    "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
-}
+# {{0,1},{2,3}} explicit form, the iota form [groups,size]<=[n], or the
+# EMPTY form {} (HLO for "all replicas in one group")
+_REPLICA_GROUPS_RE = re.compile(
+    r"replica_groups=(?:\{\{(?P<first>[0-9, ]*)\}"
+    r"|\[(?P<ng>[0-9]+),(?P<gs>[0-9]+)\]<="
+    r"|(?P<all>\{\}))")
+_NUM_PARTITIONS_RE = re.compile(
+    r"\b(?:num_partitions|replica_count)=(\d+)")
+
+def _replica_group_size(line: str, all_devices: int = 1) -> int:
+    """Devices per replica group on one collective's HLO line.
+    `replica_groups={}` means ALL replicas form one group — the caller
+    passes the module's partition/replica count for that case; no
+    annotation at all reads as a degenerate single-device group."""
+    m = _REPLICA_GROUPS_RE.search(line)
+    if m is None:
+        return 1
+    if m.group("all") is not None:
+        return max(all_devices, 1)
+    if m.group("gs") is not None:
+        return max(int(m.group("gs")), 1)
+    first = [x for x in m.group("first").split(",") if x.strip()]
+    return max(len(first), 1)
+
+
+# per-chip transferred fraction of the RESULT bytes for a ring
+# algorithm over an n-wide group (the northstar_model.py accounting):
+# all-gather's result is the full gathered tensor -> (n-1)/n of it
+# moves; reduce-scatter's result is the 1/n shard -> (n-1) x result;
+# ring all-reduce = reduce-scatter + all-gather phases; a permute is
+# one hop; all-to-all keeps (n-1)/n.
+def _xfer_factor(op: str, n: int) -> float:
+    if op == "collective-permute":
+        return 1.0      # one hop; pairs, not replica groups
+    if n <= 1:
+        return 0.0      # degenerate self-group: nothing crosses ICI
+    return {"all-gather": (n - 1) / n,
+            "reduce-scatter": float(n - 1),
+            "all-reduce": 2 * (n - 1) / n,
+            "all-to-all": (n - 1) / n}.get(op, 1.0)
 
 
 def _subjaxprs(params: dict):
@@ -122,10 +157,26 @@ def _aval_nbytes(aval) -> int:
 
 
 def collective_inventory_from_hlo(hlo_text: str) -> Dict[str, dict]:
-    """Parse compiled-HLO text into {collective-kind: {count, bytes}}.
-    Byte estimate = sum over ops of the op's result shapes (tuple
-    results of -start forms included)."""
+    """Parse compiled-HLO text into {collective-kind: {count, bytes,
+    result_bytes, group_size}}. `result_bytes` sums each op's result
+    shapes (tuple results of -start forms included); `bytes` is the
+    PER-CHIP transferred estimate — result bytes scaled by the ring
+    transfer factor for the op's replica-group size (counting groups:
+    an 8-wide all-gather moves (n-1)/n of the gathered tensor per chip,
+    not the whole result — the ZeRO-2 inventory was overstating every
+    entry before groups were counted). `group_size` is the max group
+    width seen for the kind (mixed widths keep per-op scaling)."""
     inv: Dict[str, dict] = {}
+    # module-wide device count, for empty replica_groups={} (= one
+    # all-replica group): max over the HloModule header line's
+    # num_partitions / replica_count annotations — the whole first
+    # line, since a real-size entry_computation_layout pushes the
+    # attribute thousands of chars in
+    header = hlo_text[:hlo_text.find("\n")] if "\n" in hlo_text \
+        else hlo_text
+    all_devices = max((int(n) for n in
+                       _NUM_PARTITIONS_RE.findall(header)),
+                      default=1)
     for line in hlo_text.splitlines():
         m = _HLO_COLLECTIVE_RE.search(line)
         if m is None:
@@ -139,9 +190,13 @@ def collective_inventory_from_hlo(hlo_text: str) -> Dict[str, dict]:
                 if d:
                     n *= int(d)
             nbytes += n * _HLO_DTYPE_BYTES.get(sm.group("dt"), 4)
-        rec = inv.setdefault(op, {"count": 0, "bytes": 0})
+        group = _replica_group_size(line, all_devices)
+        rec = inv.setdefault(op, {"count": 0, "bytes": 0,
+                                  "result_bytes": 0, "group_size": 1})
         rec["count"] += 1
-        rec["bytes"] += nbytes
+        rec["result_bytes"] += nbytes
+        rec["bytes"] += int(nbytes * _xfer_factor(op, group))
+        rec["group_size"] = max(rec["group_size"], group)
     return inv
 
 
@@ -281,5 +336,6 @@ def lint_program(name: str, fn, args: Tuple = (), kwargs: Optional[dict]
             findings.append(Finding(
                 COLLECTIVE, Severity.INFO, name, op,
                 f"{rec['count']} {op} op(s), ~{rec['bytes']} bytes "
-                "per step", dict(rec)))
+                f"transferred per chip per step (group size "
+                f"{rec['group_size']})", dict(rec)))
     return findings

@@ -56,6 +56,11 @@ class BuildResult:
     # trace-time constants not visible in the arg avals (see
     # signature_hash) — fixture builders with one fixed config leave ""
     static_key: str = ""
+    # per-site batch/seq/byte geometry for the tpucost pass (FLOPs per
+    # token, the decode-tick HBM anchor): builders fill what applies —
+    # tokens_per_exec, batch, seq, param_bytes, kv_cache_bytes,
+    # tick_tokens, ... (analysis/hlo_cost.py documents the consumers)
+    geometry: dict = field(default_factory=dict)
 
 
 @dataclass

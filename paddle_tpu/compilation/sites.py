@@ -36,6 +36,21 @@ from .registry import BuildResult, register
 __all__ = ["ensure_registered"]
 
 
+def _tree_nbytes(tree) -> int:
+    """Total leaf bytes of a pytree (params/caches) — the geometry
+    inputs the tpucost decode anchor computes its analytic bound from."""
+    import jax
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        n = 1
+        for d in shape:
+            n *= int(d)
+        dt = getattr(leaf, "dtype", None)
+        total += n * (np.dtype(dt).itemsize if dt is not None else 4)
+    return total
+
+
 def _gpt_tiny_model():
     from ..models.gpt import GPTConfig, GPTForCausalLM
     from ..framework import random as _rng
@@ -61,7 +76,14 @@ def build_gpt_decode() -> BuildResult:
             np.zeros(N, np.int32), np.zeros(N, np.int32),
             np.ones(N, bool), np.full(N, -1, np.int32),
             np.zeros((N, 2), np.uint32))
-    return BuildResult(prog, args, cleanup=eng.stop)
+    geometry = {
+        "kind": "decode", "slots": N, "max_len": eng.max_len,
+        "tick_tokens": eng.tick_tokens,
+        "tokens_per_exec": N * eng.tick_tokens,
+        "param_bytes": _tree_nbytes((eng._params, eng._buffers)),
+        "kv_cache_bytes": _tree_nbytes(eng._caches),
+    }
+    return BuildResult(prog, args, cleanup=eng.stop, geometry=geometry)
 
 
 def build_gpt_admit() -> BuildResult:
@@ -69,7 +91,13 @@ def build_gpt_admit() -> BuildResult:
     bucket = eng.prefill_buckets[0]
     prog = eng._get_admit_prog(bucket)
     args = eng._admit_example_args(bucket)
-    return BuildResult(prog, args, cleanup=eng.stop)
+    geometry = {
+        "kind": "prefill", "batch": 1, "seq": bucket,
+        "tokens_per_exec": bucket,
+        "param_bytes": _tree_nbytes((eng._params, eng._buffers)),
+        "kv_cache_bytes": _tree_nbytes(eng._caches),
+    }
+    return BuildResult(prog, args, cleanup=eng.stop, geometry=geometry)
 
 
 def _llama_tiny_programs():
@@ -97,7 +125,12 @@ def build_llama_prefill() -> BuildResult:
     prefill, _, params, buffers, caches, P = _llama_tiny_programs()
     args = (params, buffers, np.zeros((1, P), np.int64), caches,
             jax.random.PRNGKey(0))
-    return BuildResult(prefill, args)
+    geometry = {
+        "kind": "prefill", "batch": 1, "seq": P, "tokens_per_exec": P,
+        "param_bytes": _tree_nbytes((params, buffers)),
+        "kv_cache_bytes": _tree_nbytes(caches),
+    }
+    return BuildResult(prefill, args, geometry=geometry)
 
 
 def build_llama_decode() -> BuildResult:
@@ -105,7 +138,13 @@ def build_llama_decode() -> BuildResult:
     _, decode, params, buffers, caches, _ = _llama_tiny_programs()
     tok0 = np.zeros((1,), np.int32)
     args = (params, buffers, tok0, caches, jax.random.PRNGKey(0))
-    return BuildResult(decode, args)
+    geometry = {
+        "kind": "decode", "batch": 1, "new_tokens": 8,
+        "tokens_per_exec": 8,
+        "param_bytes": _tree_nbytes((params, buffers)),
+        "kv_cache_bytes": _tree_nbytes(caches),
+    }
+    return BuildResult(decode, args, geometry=geometry)
 
 
 def _train_step_parts(model):
@@ -127,7 +166,11 @@ def build_train_step() -> BuildResult:
     args = (step.params, step.buffers, step.opt_state,
             jnp.asarray(1e-3, jnp.float32), jnp.asarray(1, jnp.float32),
             _rng.default_generator().fold_in(1), ids, ids)
-    return BuildResult(step._jitted, args)
+    geometry = {
+        "kind": "train", "batch": 2, "seq": 32, "tokens_per_exec": 64,
+        "param_bytes": _tree_nbytes((step.params, step.buffers)),
+    }
+    return BuildResult(step._jitted, args, geometry=geometry)
 
 
 def build_train_step_scan() -> BuildResult:
@@ -148,7 +191,12 @@ def build_train_step_scan() -> BuildResult:
             np.full((K,), 1e-3, np.float32),
             np.arange(1, K + 1, dtype=np.float32),
             np.arange(1, K + 1, dtype=np.int32), ids, ids)
-    return BuildResult(prog, args)
+    geometry = {
+        "kind": "train", "scan_steps": K, "batch": 2, "seq": 32,
+        "tokens_per_exec": K * 2 * 32,
+        "param_bytes": _tree_nbytes((step.params, step.buffers)),
+    }
+    return BuildResult(prog, args, geometry=geometry)
 
 
 def build_parallel_train_step() -> BuildResult:
@@ -180,12 +228,18 @@ def build_parallel_train_step() -> BuildResult:
                 jnp.asarray(1e-3, jnp.float32),
                 jnp.asarray(1, jnp.float32),
                 _rng.default_generator().fold_in(1)) + raw_batch
+        geometry = {
+            "kind": "train", "batch": 4, "seq": 32,
+            "tokens_per_exec": 128,
+            "param_bytes": _tree_nbytes((step.params, step.buffers)),
+        }
     except BaseException:
         # build raised after the global mesh was swapped: restore it
         # here — consumers never receive the cleanup on this path
         cleanup()
         raise
-    return BuildResult(step._jitted, args, cleanup=cleanup)
+    return BuildResult(step._jitted, args, cleanup=cleanup,
+                       geometry=geometry)
 
 
 _registered = False

@@ -63,28 +63,44 @@ QUICK_FILES = [
 ]
 
 
-def _run_tpulint(env) -> int:
+def _run_tpulint(env, update_baseline=False) -> int:
     """tpulint gate: static analysis of the real compiled programs +
     codebase vs tools/tpulint_baseline.json (PR 3). Nonzero when a NEW
     hazard (scatter on the decode path, dropped donation, retrace-per-
     call jit, ...) appears — same ratchet policy as the quarantine
     list, but machine-diffed. Accept an intentional finding with
-    `python tools/tpulint.py --update-baseline` after review."""
+    `python tools/ci.py --tpulint --update-baseline` after review."""
     print("\n=== tpulint static-analysis gate ===")
-    return subprocess.run(
-        [sys.executable, os.path.join("tools", "tpulint.py")],
-        cwd=ROOT, env=env).returncode
+    cmd = [sys.executable, os.path.join("tools", "tpulint.py")]
+    if update_baseline:
+        cmd.append("--update-baseline")
+    return subprocess.run(cmd, cwd=ROOT, env=env).returncode
+
+
+def _run_tpucost(env, update_baseline=False) -> int:
+    """tpucost gate: static fusion/HBM roofline inventory of the real
+    compiled programs vs tools/tpucost_baseline.json (PR 6). Nonzero
+    when a ratcheted budget (HBM bytes, kernel count, matmul-FLOP
+    share) or a hand-set anchor (decode-tick HBM bound, train-step
+    matmul floor) regresses. Re-pin after review with
+    `python tools/ci.py --tpucost --update-baseline`."""
+    print("\n=== tpucost fusion/HBM roofline gate ===")
+    cmd = [sys.executable, os.path.join("tools", "tpucost.py")]
+    if update_baseline:
+        cmd.append("--update-baseline")
+    return subprocess.run(cmd, cwd=ROOT, env=env).returncode
 
 
 def _run_warmup(env) -> int:
     """Prime the persistent executable store + the warm jax compile
     cache from the ProgramRegistry (tools/warmup.py) BEFORE the test
-    profiles run. The tier-1 gate only fits its 870s budget with a
-    warm XLA cache; this step makes that dependency SELF-SERVICED: one
-    `ci.py --warmup --quick` on a fresh machine compiles the real
-    programs once (the same set tpulint lints — they share the
-    registry), and every later run loads them. Warmup failures are
-    non-fatal: tests lazily compile whatever is missing."""
+    profiles run: one `ci.py --warmup --quick` on a fresh machine
+    compiles the real programs once (the same set the tpulint/tpucost
+    gates rebuild — they share the registry), and every later GATE and
+    warm-start serving run loads them. The pytest runs themselves stay
+    off the persistent cache (multi-device reload hazard — see the
+    cache_env note in main). Warmup failures are non-fatal: tests
+    lazily compile whatever is missing."""
     print("=== program warmup (registry -> executable store) ===")
     return subprocess.run(
         [sys.executable, os.path.join("tools", "warmup.py")],
@@ -124,6 +140,13 @@ def main():
                     help="core-correctness subset only (<5 min target)")
     ap.add_argument("--tpulint", action="store_true",
                     help="run ONLY the tpulint static-analysis gate")
+    ap.add_argument("--tpucost", action="store_true",
+                    help="run ONLY the tpucost fusion/HBM roofline gate")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="with --tpucost/--tpulint: re-pin that gate's "
+                         "baseline from this run (tpucost anchors and "
+                         "tpulint must_stay_clean entries preserved) — "
+                         "the review-then-accept ratchet flow")
     ap.add_argument("--warmup", action="store_true",
                     help="prime the executable store + warm jax cache "
                          "(tools/warmup.py) before the tests — "
@@ -131,6 +154,9 @@ def main():
                          "tier-1 budget assumes; alone = ONLY warm up")
     ap.add_argument("--no-tpulint", action="store_true",
                     help="skip the tpulint gate that --quick/--full "
+                         "append after the tests")
+    ap.add_argument("--no-tpucost", action="store_true",
+                    help="skip the tpucost gate that --quick/--full "
                          "append after the tests")
     ap.add_argument("-k", default=None)
     args = ap.parse_args()
@@ -158,18 +184,30 @@ def main():
         # trace-based coverage collected by tests/conftest.py (no
         # external deps in this image); report written at session end
         env["PADDLE_TPU_COVERAGE"] = "1"
-    # Warm persistent XLA compile cache for repeat CI runs (measured ~2x
-    # on compile-heavy files). Scoped to CI via this env var so ad-hoc
-    # pytest runs and the driver dryrun keep the no-CPU-cache default
-    # (paddle_tpu/__init__.py rationale: foreign-host AOT artifacts).
-    env.setdefault("JAX_COMPILATION_CACHE_DIR",
-                   os.path.expanduser("~/.cache/paddle_tpu_ci_xla"))
-    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+    # Warm persistent XLA compile cache for the TOOL subprocesses only
+    # (warmup + the tpulint/tpucost gates — compile-heavy, measured ~2x
+    # warm). The PYTEST runs stay cache-free like tests/conftest.py's
+    # raw path: reloading a cached MULTI-DEVICE CPU program aborts the
+    # process (the cpu_aot_loader hazard paddle_tpu/__init__.py
+    # documents — measured 2026-08-03 on the ZeRO-3/pipeline tests once
+    # the shared dir held multi-device entries from earlier runs), and
+    # a crashed suite costs more than the recompiles it saves.
+    cache_env = dict(env)
+    cache_env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                         os.path.expanduser("~/.cache/paddle_tpu_ci_xla"))
+    cache_env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                         "1")
 
     if args.tpulint:
-        return _run_tpulint(env)
+        return _run_tpulint(cache_env, args.update_baseline)
+    if args.tpucost:
+        return _run_tpucost(cache_env, args.update_baseline)
+    if args.update_baseline:
+        ap.error("--update-baseline only applies with --tpulint or "
+                 "--tpucost (a full test run must never silently "
+                 "re-pin a gate baseline)")
     if args.warmup:
-        warm_rc = _run_warmup(env)
+        warm_rc = _run_warmup(cache_env)
         if not (args.quick or args.full or args.k or args.coverage):
             return warm_rc       # --warmup alone: just prime and exit
         if warm_rc != 0:
@@ -202,11 +240,16 @@ def main():
         if bad:
             print("quarantined tests still failing (non-fatal)")
 
-    # static-analysis gate rides after the test gates in the blocking
-    # profiles (warm-cache cost ~15 s; the analyzers only trace/lower)
+    # static-analysis gates ride after the test gates in the blocking
+    # profiles (tpulint ~15 s warm — trace/lower only; tpucost
+    # additionally compiles every registry program, which the warm
+    # persistent cache turns into loads)
     if (args.quick or args.full) and not args.no_tpulint:
-        lint_rc = _run_tpulint(env)
+        lint_rc = _run_tpulint(cache_env)
         rc = rc or lint_rc
+    if (args.quick or args.full) and not args.no_tpucost:
+        cost_rc = _run_tpucost(cache_env)
+        rc = rc or cost_rc
     return rc
 
 

@@ -40,12 +40,27 @@ import sys
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _ROOT = os.path.dirname(_HERE)
 
-# ---- v5p public constants -------------------------------------------------
-PEAK = 459e12            # bf16 FLOP/s per chip
-ICI_GBPS = 4800 / 8      # 600 GB/s aggregate ICI per chip (public spec)
+# ---- v5p public constants (ONE table shared with tools/tpucost.py's
+# roofline). chips.py is dependency-free and loaded STANDALONE so this
+# pure-arithmetic planner never pays — or requires — the jax import.
+import importlib.util as _ilu  # noqa: E402
+
+_spec = _ilu.spec_from_file_location(
+    "_paddle_tpu_chips",
+    os.path.join(_ROOT, "paddle_tpu", "analysis", "chips.py"))
+_chips = _ilu.module_from_spec(_spec)
+# dataclasses resolves cls.__module__ through sys.modules at class
+# creation — register before exec or the standalone load AttributeErrors
+sys.modules[_spec.name] = _chips
+_spec.loader.exec_module(_chips)
+CHIP_SPECS = _chips.CHIP_SPECS
+
+_V5P = CHIP_SPECS["v5p"]
+PEAK = _V5P.peak_flops   # bf16 FLOP/s per chip
+ICI_GBPS = _V5P.ici_gbps / 8   # 600 GB/s aggregate ICI per chip
 # a ring over one mesh axis of a 3D torus uses 2 of the 6 links:
 RING_BW = ICI_GBPS / 3   # 200 GB/s effective per-axis ring bandwidth
-HBM_GB = 95
+HBM_GB = _V5P.hbm_capacity / 2**30
 
 # ---- GPT-6.7B geometry (BASELINE config 3) --------------------------------
 L, H, V, S = 32, 4096, 50304, 2048

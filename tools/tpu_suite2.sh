@@ -46,6 +46,12 @@ run bench_cold_start bench_cold_start.json python tools/bench_cold_start.py
 # terminal stdout line is a _have_result-good JSON record even when the
 # gate FAILS — a failing gate is a landed measurement, check "gate")
 run tpulint tpulint.json python tools/tpulint.py
+# fusion/HBM roofline inventory (PR 6): per-program FLOPs/HBM/roofline
+# vs tools/tpucost_baseline.json; the full report (per-kernel detail +
+# top unfused chains) uploads alongside the terminal record, and the
+# step self-skips once landed like every other one
+run tpucost tpucost.json python tools/tpucost.py \
+    --detail --json "$R/tpucost_report.json"
 # 5. 125M A/Bs (re-use the warm compile cache): fused-CE, pure-bf16 opt
 run bench_125m_fused bench_125m_fused.json \
     env PADDLE_TPU_BENCH_FUSED_CE=1024 python bench.py

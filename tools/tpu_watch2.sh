@@ -83,6 +83,9 @@ PY
         /root/repo/tpu_results/bench_125m_bf16opt.json \
         /root/repo/tpu_results/kv_quality.json \
         /root/repo/tpu_results/bench_train_loop.json \
+        /root/repo/tpu_results/warmup.json \
+        /root/repo/tpu_results/bench_cold_start.json \
+        /root/repo/tpu_results/tpucost.json \
     )
     HAVE_RC=$?
     # landed is decided by the EXIT CODE (rc=0), never by empty stdout:

@@ -99,7 +99,9 @@ def main() -> int:
     sys.path.insert(0, ROOT)
     from paddle_tpu.analysis import (count_findings, diff_against_baseline,
                                      findings_to_json, lint_quarantine,
-                                     lint_tree, load_baseline)
+                                     lint_tree, load_baseline,
+                                     terminal_record,
+                                     write_report_artifact)
 
     findings = []
     programs = []
@@ -167,10 +169,10 @@ def main() -> int:
     new = diff_against_baseline(findings, baseline)
     record = findings_to_json(findings, new, programs)
     record["baseline"] = os.path.relpath(args.baseline, ROOT)
-    if args.json:
-        with open(args.json + ".part", "w") as fh:
-            json.dump(record, fh, indent=1)
-        os.replace(args.json + ".part", args.json)
+    # shared report-artifact contract with tools/tpucost.py
+    # (analysis/report.py): atomic full-record write + the terminal
+    # stdout JSON below
+    write_report_artifact(args.json, record)
 
     for f in record["findings"]:
         flag = " NEW" if any(n["key"] == f["key"] for n in new) else ""
@@ -181,9 +183,8 @@ def main() -> int:
               f"baseline — fix them, or review + --update-baseline",
               file=sys.stderr)
     # terminal JSON record (tools/_have_result.py contract)
-    print(json.dumps({k: record[k] for k in
-                      ("version", "programs", "counts", "new", "gate",
-                       "baseline")}))
+    print(terminal_record(record, ("version", "programs", "counts",
+                                   "new", "gate", "baseline")))
     return 1 if new else 0
 
 
