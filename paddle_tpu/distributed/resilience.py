@@ -101,15 +101,33 @@ class RetryPolicy:
     schedule (tests, the shell watcher mirroring these semantics) can
     read it.
 
-    ``deadline`` caps the TOTAL budget across attempts and sleeps: once
-    exceeded, ``run`` re-raises instead of sleeping again — an attempt
-    cap bounds tries, the deadline bounds wall-clock.
+    ``deadline`` is the TOTAL retry-time budget across attempts and
+    sleeps: sleeps are capped to the remaining budget and once it is
+    exhausted ``run`` re-raises instead of sleeping again — an attempt
+    cap bounds tries, the deadline bounds wall-clock. A retry storm
+    against a dead tier therefore gives up within the caller's
+    deadline, never after attempts x max_delay. ``run(...,
+    deadline=...)`` overrides per call so one shared policy can honor
+    each request's own remaining budget.
+
+    ``full_jitter=True`` switches the jittered sleep to the AWS
+    full-jitter scheme — ``uniform(0, delay(attempt))`` — which
+    decorrelates a thundering herd of retriers far better than the
+    default +/-``jitter`` band around the deterministic schedule.
+    ``delay``/``schedule`` stay deterministic either way.
+
+    ``clock``/``sleep_fn`` are injectable for tests (fake clock): they
+    default to ``time.monotonic``/``time.sleep`` and are the ONLY
+    time sources ``run`` consults.
     """
 
     def __init__(self, max_attempts: int = 3, base_delay: float = 0.5,
                  max_delay: float = 60.0, multiplier: float = 2.0,
                  jitter: float = 0.1, deadline: Optional[float] = None,
-                 retry_on: Tuple[type, ...] = (Exception,)):
+                 retry_on: Tuple[type, ...] = (Exception,),
+                 full_jitter: bool = False,
+                 clock: Optional[Callable[[], float]] = None,
+                 sleep_fn: Optional[Callable[[float], None]] = None):
         if max_attempts < 1:
             raise ValueError("max_attempts must be >= 1")
         if multiplier < 1.0:
@@ -123,6 +141,9 @@ class RetryPolicy:
         self.jitter = float(jitter)
         self.deadline = deadline
         self.retry_on = retry_on
+        self.full_jitter = bool(full_jitter)
+        self._clock = clock if clock is not None else time.monotonic
+        self._sleep = sleep_fn if sleep_fn is not None else time.sleep
 
     @classmethod
     def from_env(cls, prefix: str = "PADDLE_TPU_RETRY", **defaults):
@@ -161,32 +182,42 @@ class RetryPolicy:
 
     def sleep(self, attempt: int, budget: Optional[float] = None) -> float:
         """Sleep the (jittered) post-attempt delay; returns the time
-        slept. ``budget`` caps the sleep (remaining deadline)."""
+        slept. ``budget`` caps the sleep (remaining deadline). With
+        ``full_jitter`` the sleep is drawn uniform from
+        [0, delay(attempt)] instead of a +/-jitter band."""
         d = self.delay(attempt)
-        if self.jitter:
+        if self.full_jitter:
+            d = random.uniform(0.0, d)
+        elif self.jitter:
             d *= 1.0 + random.uniform(-self.jitter, self.jitter)
         if budget is not None:
             d = max(0.0, min(d, budget))
         if d > 0:
-            time.sleep(d)
+            self._sleep(d)
         return d
 
     # -- execution -------------------------------------------------------
     def run(self, fn: Callable, *args,
             on_retry: Optional[Callable[[int, BaseException], None]] = None,
+            deadline: Optional[float] = None,
             **kwargs):
         """Call ``fn`` under this policy. ``on_retry(attempt, exc)`` is
-        invoked before each backoff sleep (logging hook)."""
-        start = time.monotonic()
+        invoked before each backoff sleep (logging hook). ``deadline``
+        overrides the policy's total retry-time budget for THIS call
+        (a router passes each request's remaining forward budget)."""
+        total = self.deadline if deadline is None else deadline
+        start = self._clock()
         for attempt in range(1, self.max_attempts + 1):
             try:
                 return fn(*args, **kwargs)
             except self.retry_on as e:
                 if attempt >= self.max_attempts:
                     raise
-                if self.deadline is not None:
-                    remaining = self.deadline - (time.monotonic() - start)
+                if total is not None:
+                    remaining = total - (self._clock() - start)
                     if remaining <= 0:
+                        # budget exhausted: give up NOW — within the
+                        # caller's deadline, not attempts x max_delay
                         raise
                 else:
                     remaining = None
@@ -219,10 +250,18 @@ def with_retries(fn: Callable, *args,
 #   train_crash         the training process dies mid-epoch (raises)
 #   serve_backend       predictor backend unavailable (raises)
 #   serve_hang          predictor wedges (sleeps)
+#   router_forward      a router->replica forward attempt fails (raises;
+#                       the router treats it like a connection failure
+#                       and retries on a DIFFERENT replica)
+#   replica_spawn       spawning a serving-tier replica fails (raises;
+#                       the tier control loop retries on its next pass)
+#   replica_health      a replica health poll fails (raises; counts
+#                       toward the router's unhealthy streak)
 _KNOWN_SITES = frozenset([
     "collective", "host_drop", "ckpt_shard", "ckpt_crash",
     "dataloader_worker", "step_hang", "step_nan", "train_crash",
     "serve_backend", "serve_hang",
+    "router_forward", "replica_spawn", "replica_health",
 ])
 
 _inject_lock = threading.Lock()

@@ -13,6 +13,7 @@ reference so serving code ports directly.
 """
 from .engine import (ContinuousBatchingEngine, EngineOverloaded,
                      GenerationPredictor)
+from .router import Replica, ReplicaSpec, Router
 from .predictor import (Config, DataType, PlaceType, PrecisionType,
                         Predictor, PredictorPool, Tensor,
                         _get_phi_kernel_name,
@@ -25,6 +26,7 @@ __all__ = ["Config", "Predictor", "create_predictor", "Tensor",
            "PlaceType", "DataType", "PrecisionType", "PredictorPool",
            "ContinuousBatchingEngine", "EngineOverloaded",
            "GenerationPredictor",
+           "Router", "ReplicaSpec", "Replica",
            "get_version", "get_num_bytes_of_data_type",
            "get_trt_compile_version", "get_trt_runtime_version",
            "convert_to_mixed_precision", "_get_phi_kernel_name"]

@@ -1,0 +1,1016 @@
+"""Multi-replica serving tier: a health-aware router over N replicas.
+
+One ``PredictorServer`` is one process; the millions-of-users north
+star needs a fleet (ROADMAP item 5 — the reference's predictor-pool /
+FleetExecutor DistModel fleet-serving role, MIGRATING.md). This module
+composes the per-process robustness primitives PRs 1/2/5 already
+provide into a tier that stays up, sheds truthfully, and rides through
+replica death:
+
+* **Replicas are subprocesses** the router spawns and supervises: each
+  runs ``python -m paddle_tpu.inference.router --replica-child`` — a
+  model built from a JSON :class:`ReplicaSpec`, a
+  ``ContinuousBatchingEngine``, and a ``PredictorServer`` that AOT-warms
+  through the shared executable store (``PADDLE_TPU_EXEC_STORE_DIR``):
+  once one replica has compiled-and-stored, every successor reaches
+  ready with ZERO XLA compiles (bench_cold_start-proven, asserted again
+  by the rolling-restart test).
+* **Health-aware admission**: a control loop polls every replica's
+  ``/healthz`` (slot occupancy, queue depth, warming/draining state).
+  ``/generate`` routes to the least-loaded READY replica — never to a
+  warming, draining, ejected, unreachable, or dead one.
+* **Failure handling**: each forward carries a deadline; connect
+  failures / 5xx / injected ``router_forward`` faults retry on a
+  DIFFERENT replica under ``resilience.RetryPolicy`` (full-jitter, the
+  request's remaining budget as the retry-time budget). A replica with
+  a failure streak is circuit-breaker-ejected for a cooldown. When no
+  replica can admit, the tier answers a truthful 503 with
+  ``Retry-After`` — zero hangs, zero connection resets, zero silent
+  drops.
+* **Self-healing + rolling restarts**: a replica that dies (kill -9, a
+  wedged backend) is detected by the control loop and respawned.
+  ``rolling_restart()`` replaces replicas one at a time: the successor
+  warms from the store and joins the rotation BEFORE the predecessor
+  drains (``POST /drain`` + ``stop(drain_s)``) and exits.
+* **Queue-driven autoscaling**: when aggregate queue depth stays above
+  the scale-up watermark the tier grows toward ``max_replicas``; when
+  it sits idle it shrinks (drain-then-retire) toward ``min_replicas``,
+  with a cooldown between actions. Both directions reuse the one spawn
+  / retire path the rolling restart uses.
+
+Greedy tokens through the tier are engine-identical to a direct
+engine call: the router never touches payloads, and a retried request
+re-runs the same deterministic greedy program on another replica over
+identical weights (every replica seeds the same ``ReplicaSpec.seed``
+before building the model).
+
+CLI (tools/serve_tier.py wraps this): the module itself only exposes
+the ``--replica-child`` entry point used by the spawner.
+
+Env knobs (documented in COMPONENTS.md "Serving tier"):
+  PADDLE_TPU_TIER_DEADLINE     per-request forward deadline (60 s)
+  PADDLE_TPU_TIER_RETRIES      retry budget per request (2 retries)
+  PADDLE_TPU_TIER_POLL_S       health-poll interval (0.5 s)
+  PADDLE_TPU_TIER_EJECT_S      circuit-breaker ejection cooldown (5 s)
+  PADDLE_TPU_EXEC_STORE_DIR    shared executable store (successors load)
+"""
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from ..distributed import resilience as _resil
+from .serve import RETRY_AFTER_S, _env_float, send_json
+
+__all__ = ["ReplicaSpec", "Replica", "Router", "main",
+           "single_device_child_env"]
+
+# tier-level 503 reasons extend the per-replica contract
+TIER_RETRY_AFTER_S = dict(RETRY_AFTER_S)
+TIER_RETRY_AFTER_S["no_replica_ready"] = 1.0
+
+# what a dying replica can throw at a reader besides the URLError
+# family: a SIGKILL mid-response-write surfaces as IncompleteRead /
+# BadStatusLine (http.client.HTTPException), and a truncated JSON body
+# as ValueError — all must read as "that replica failed", never as an
+# unhandled handler crash
+_REPLICA_IO_ERRORS = (urllib.error.URLError, ConnectionError, OSError,
+                      socket.timeout, http.client.HTTPException,
+                      ValueError)
+
+
+def single_device_child_env(platform: str = "cpu") -> Dict[str, str]:
+    """Env overrides for replica children, which are SINGLE-DEVICE
+    serving processes: force the platform (N processes cannot share one
+    TPU chip) and drop the test harness's virtual-mesh flag if it
+    leaked into the parent env. The one scrub shared by
+    tools/serve_tier.py, tools/bench_serving.py --tier, and the
+    tests."""
+    return {"JAX_PLATFORMS": platform, "XLA_FLAGS": " ".join(
+        f for f in os.environ.get("XLA_FLAGS", "").split()
+        if not f.startswith("--xla_force_host_platform_device_count"))}
+
+
+# ---------------------------------------------------------------------------
+# ReplicaSpec — everything a replica child needs, JSON-serializable
+# ---------------------------------------------------------------------------
+
+class ReplicaSpec:
+    """Recipe for one replica process.
+
+    ``model`` is a dict: ``{"kind": "gpt", **GPTConfig kwargs}`` or
+    ``{"kind": "factory", "path": "pkg.mod:callable"}`` (the callable
+    returns a built causal-LM). ``engine`` holds
+    ``ContinuousBatchingEngine`` kwargs (slots, max_len, cache_dtype,
+    prefill_buckets, tick_tokens, ...). Every replica seeds ``seed``
+    BEFORE building the model so the whole tier holds bitwise-identical
+    weights — the token-identity oracle depends on it.
+
+    ``env`` overrides the child environment on top of the router's own
+    (the shared ``PADDLE_TPU_EXEC_STORE_DIR`` normally rides here or on
+    the router).
+    """
+
+    def __init__(self, model: dict, engine: Optional[dict] = None,
+                 warmup: bool = True, drain_s: float = 5.0,
+                 seed: int = 0, host: str = "127.0.0.1",
+                 env: Optional[Dict[str, str]] = None):
+        self.model = dict(model)
+        self.engine = dict(engine or {})
+        self.warmup = bool(warmup)
+        self.drain_s = float(drain_s)
+        self.seed = int(seed)
+        self.host = host
+        self.env = dict(env or {})
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "model": self.model, "engine": self.engine,
+            "warmup": self.warmup, "drain_s": self.drain_s,
+            "seed": self.seed, "host": self.host})
+
+    def argv(self, port_file: str) -> List[str]:
+        return [sys.executable, "-m", "paddle_tpu.inference.router",
+                "--replica-child", "--spec", self.to_json(),
+                "--port-file", port_file]
+
+
+def _build_model(model_spec: dict):
+    spec = dict(model_spec)
+    kind = spec.pop("kind", "gpt")
+    if kind == "gpt":
+        from ..models.gpt import GPTConfig, GPTForCausalLM
+        return GPTForCausalLM(GPTConfig(**spec))
+    if kind == "llama":
+        from ..models.llama import LlamaConfig, LlamaForCausalLM
+        return LlamaForCausalLM(LlamaConfig(**spec))
+    if kind == "factory":
+        import importlib
+        mod, _, attr = spec["path"].partition(":")
+        fn = getattr(importlib.import_module(mod), attr)
+        return fn(**spec.get("kwargs", {}))
+    raise ValueError(f"unknown model kind {kind!r}")
+
+
+def _replica_child_main(args) -> int:
+    """Entry point of one replica process: build, serve, drain on
+    SIGTERM, die with the parent (orphan watchdog)."""
+    spec = json.loads(args.spec)
+    from ..framework import random as _rng
+    _rng.seed(spec.get("seed", 0))           # identical weights tier-wide
+    model = _build_model(spec["model"])
+    from .engine import ContinuousBatchingEngine
+    from .serve import PredictorServer
+    engine = ContinuousBatchingEngine(model, **spec.get("engine", {}))
+    srv = PredictorServer(engine=engine, host=spec.get("host", "127.0.0.1"),
+                          port=0, warmup=spec.get("warmup", True)).start()
+    # publish the kernel-assigned port atomically — the router polls for
+    # this file; a half-written port number must be unobservable
+    tmp = args.port_file + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(str(srv.port))
+    os.replace(tmp, args.port_file)
+
+    stop_evt = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *a: stop_evt.set())
+    ppid = os.getppid()
+    while not stop_evt.wait(0.25):
+        if os.getppid() != ppid:
+            break                      # router died: don't leak orphans
+    # graceful exit: bounded drain of in-flight requests, then down
+    srv.stop(drain_s=float(spec.get("drain_s", 5.0)))
+    engine.stop()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Replica — the router's handle on one subprocess
+# ---------------------------------------------------------------------------
+
+class Replica:
+    """Router-side state for one replica process. All mutation happens
+    under the router's lock or on the control-loop thread."""
+
+    def __init__(self, name: str, proc: subprocess.Popen,
+                 port_file: str, log_path: str, host: str):
+        self.name = name
+        self.proc = proc
+        self.port_file = port_file
+        self.log_path = log_path
+        self.host = host
+        self.port: Optional[int] = None
+        self.state = "starting"     # starting|warming|ready|unready|
+        #                             draining|unreachable|dead
+        self.draining = False
+        self.inflight = 0           # router-side forwards in flight
+        self.failure_streak = 0     # forward failures (circuit breaker)
+        self.health_fail_streak = 0  # consecutive failed health polls
+        self.ejected_until = 0.0
+        self.health: dict = {}
+        self.spawned_at = time.monotonic()
+
+    @property
+    def base_url(self) -> Optional[str]:
+        if self.port is None:
+            return None
+        return f"http://{self.host}:{self.port}"
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def routable(self, now: float) -> bool:
+        return (self.state == "ready" and not self.draining
+                and self.port is not None and now >= self.ejected_until
+                and self.alive())
+
+    def load_score(self) -> tuple:
+        """Least-loaded ordering: router-side in-flight first (freshest
+        signal), then the replica's own reported queue + occupancy from
+        the last health poll; name breaks ties deterministically."""
+        eng = self.health.get("engine", {}) if self.health else {}
+        return (self.inflight,
+                int(eng.get("queued", 0)) + int(eng.get("active", 0)),
+                self.name)
+
+    def snapshot(self) -> dict:
+        eng = self.health.get("engine", {}) if self.health else {}
+        return {"name": self.name, "state": self.state,
+                "pid": self.proc.pid, "port": self.port,
+                "draining": self.draining, "inflight": self.inflight,
+                "failure_streak": self.failure_streak,
+                "queued": int(eng.get("queued", 0)),
+                "active": int(eng.get("active", 0)),
+                "ejected": time.monotonic() < self.ejected_until}
+
+
+# internal retryable forward outcomes -------------------------------------
+
+class _RetryableForward(Exception):
+    pass
+
+
+class _ForwardFailed(_RetryableForward):
+    """Connect failure / 5xx / injected fault against one replica —
+    retry on a different one."""
+
+    def __init__(self, replica: Replica, why: str):
+        super().__init__(why)
+        self.replica = replica
+
+
+class _ShedByReplica(_RetryableForward):
+    """A truthful 503 shed (overloaded/warming/draining) — the replica
+    is healthy, just not admitting; retry elsewhere, no breaker hit."""
+
+    def __init__(self, replica: Replica, body: dict):
+        super().__init__(str(body.get("error", "shed")))
+        self.replica = replica
+        self.body = body
+
+
+class _NoReplica(Exception):
+    pass
+
+
+class _DeadlineExceeded(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Router
+# ---------------------------------------------------------------------------
+
+class Router:
+    """Health-aware load balancer + supervisor over N replica
+    subprocesses (module docstring has the full story).
+
+    ``replicas`` is the starting count; ``min_replicas``/
+    ``max_replicas`` bound the autoscaler (equal min/max = autoscaling
+    off). ``exec_store_dir`` (or the inherited
+    ``PADDLE_TPU_EXEC_STORE_DIR``) is the shared executable store every
+    replica warms from.
+    """
+
+    def __init__(self, spec: ReplicaSpec, replicas: int = 2,
+                 min_replicas: Optional[int] = None,
+                 max_replicas: Optional[int] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 deadline_s: Optional[float] = None,
+                 retries: Optional[int] = None,
+                 poll_s: Optional[float] = None,
+                 eject_s: Optional[float] = None,
+                 breaker_threshold: int = 3,
+                 unreachable_after: int = 3,
+                 restart_unreachable_after: int = 10,
+                 respawn: bool = True,
+                 scale_up_queued: Optional[int] = None,
+                 scale_cycles: int = 3,
+                 scale_cooldown_s: float = 30.0,
+                 exec_store_dir: Optional[str] = None,
+                 jax_cache_dir: Optional[str] = None,
+                 workdir: Optional[str] = None):
+        if replicas < 1:
+            raise ValueError("need at least one replica")
+        self.spec = spec
+        self.min_replicas = int(min_replicas if min_replicas is not None
+                                else replicas)
+        self.max_replicas = int(max_replicas if max_replicas is not None
+                                else replicas)
+        if not (1 <= self.min_replicas <= replicas <= self.max_replicas):
+            raise ValueError("need 1 <= min <= replicas <= max")
+        self._initial = int(replicas)
+        self.deadline_s = (deadline_s if deadline_s is not None
+                           else _env_float("PADDLE_TPU_TIER_DEADLINE",
+                                           60.0))
+        retries = int(retries if retries is not None
+                      else _env_float("PADDLE_TPU_TIER_RETRIES", 2))
+        # the ONE retry schedule (resilience.RetryPolicy): full-jitter
+        # backoff decorrelates concurrent retriers; each run() gets the
+        # request's remaining budget as its retry-time deadline
+        self.retry_policy = _resil.RetryPolicy(
+            max_attempts=max(1, retries + 1), base_delay=0.05,
+            max_delay=0.5, full_jitter=True,
+            retry_on=(_RetryableForward,))
+        self.poll_s = (poll_s if poll_s is not None
+                       else _env_float("PADDLE_TPU_TIER_POLL_S", 0.5))
+        self.eject_s = (eject_s if eject_s is not None
+                        else _env_float("PADDLE_TPU_TIER_EJECT_S", 5.0))
+        self.breaker_threshold = int(breaker_threshold)
+        self.unreachable_after = int(unreachable_after)
+        self.restart_unreachable_after = int(restart_unreachable_after)
+        self.respawn = bool(respawn)
+        # autoscaler watermarks: scale up when aggregate queued tokens
+        # requests exceed this for scale_cycles consecutive polls
+        slots = int(self.spec.engine.get("slots", 8))
+        self.scale_up_queued = (int(scale_up_queued)
+                                if scale_up_queued is not None
+                                else max(1, slots // 2))
+        self.scale_cycles = int(scale_cycles)
+        self.scale_cooldown_s = float(scale_cooldown_s)
+        self.exec_store_dir = (exec_store_dir
+                               or os.environ.get("PADDLE_TPU_EXEC_STORE_DIR"))
+
+        self._owns_workdir = workdir is None
+        self.workdir = workdir or tempfile.mkdtemp(prefix="paddle_tpu_tier_")
+        os.makedirs(self.workdir, exist_ok=True)
+        # the executable store covers the big engine programs; the jax
+        # persistent cache covers the tiny eager helper ops — BOTH are
+        # needed for a successor to reach ready with zero XLA compiles.
+        # Tier-private by default (only this tier's own single-device
+        # entries can ever land in it — the multi-device reload hazard
+        # tests/conftest.py documents cannot arise); "" disables.
+        self.jax_cache_dir = (jax_cache_dir if jax_cache_dir is not None
+                              else os.path.join(self.workdir,
+                                                "xla_cache"))
+
+        self._lock = threading.RLock()
+        self._replicas: List[Replica] = []
+        self._seq = 0
+        self._stopping = False
+        self._started = time.monotonic()
+        self._rolling_lock = threading.Lock()
+        self._rolling = False
+        self._control_thread: Optional[threading.Thread] = None
+        self._up_streak = 0          # autoscaler pressure counters
+        self._idle_streak = 0
+        self._last_scale = 0.0
+        self.stats_counters = {
+            "forwards": 0, "retries": 0, "tier_unavailable_503": 0,
+            "deadline_503": 0, "relayed_503": 0, "backend_503": 0,
+            "respawns": 0, "ejections": 0, "rolling_restarts": 0,
+            "scale_ups": 0, "scale_downs": 0, "spawn_failures": 0,
+        }
+
+        self.httpd = ThreadingHTTPServer((host, port),
+                                         self._make_handler())
+        self.host, self.port = self.httpd.server_address[:2]
+        self._http_thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self):
+        """Spawn the initial replicas (in parallel; they become
+        routable as their health flips), start the control loop and the
+        HTTP front. Non-blocking — use wait_ready() to gate traffic."""
+        for _ in range(self._initial):
+            try:
+                self._spawn_replica()
+            except Exception:
+                self.stats_counters["spawn_failures"] += 1
+                # the control loop keeps trying to reach min_replicas
+        self._control_thread = threading.Thread(
+            target=self._control_loop, daemon=True, name="tier-control")
+        self._control_thread.start()
+        self._http_thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True,
+            name="tier-http")
+        self._http_thread.start()
+        return self
+
+    def wait_ready(self, count: Optional[int] = None,
+                   timeout: float = 300.0) -> bool:
+        """Block until ``count`` (default min_replicas) replicas are
+        routable, or the timeout passes (False)."""
+        want = self.min_replicas if count is None else int(count)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.ready_count() >= want:
+                return True
+            time.sleep(0.05)
+        return False
+
+    def ready_count(self) -> int:
+        now = time.monotonic()
+        with self._lock:
+            return sum(1 for r in self._replicas if r.routable(now))
+
+    def replicas(self) -> List[dict]:
+        with self._lock:
+            return [r.snapshot() for r in self._replicas]
+
+    def stop(self, drain_s: float = 0.0):
+        """Tear the tier down: stop routing, retire every replica
+        (graceful when ``drain_s`` > 0), stop the HTTP front."""
+        with self._lock:
+            self._stopping = True
+            reps = list(self._replicas)
+        for r in reps:
+            self._terminate(r, drain_timeout=drain_s)
+        with self._lock:
+            self._replicas.clear()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._http_thread is not None:
+            self._http_thread.join(timeout=5)
+        if self._control_thread is not None:
+            self._control_thread.join(timeout=self.poll_s * 4 + 1)
+        if self._owns_workdir:
+            shutil.rmtree(self.workdir, ignore_errors=True)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.stop()
+        return False
+
+    # -- spawn / retire (the ONE path restarts + autoscaling share) ------
+    def _spawn_replica(self) -> Replica:
+        _resil.maybe_inject("replica_spawn")
+        with self._lock:
+            self._seq += 1
+            name = f"r{self._seq}"
+        port_file = os.path.join(self.workdir, f"{name}.port")
+        log_path = os.path.join(self.workdir, f"{name}.log")
+        env = dict(os.environ)
+        if self.exec_store_dir:
+            env["PADDLE_TPU_EXEC_STORE_DIR"] = self.exec_store_dir
+        if self.jax_cache_dir:
+            env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                           self.jax_cache_dir)
+            env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                           "0")
+        # children must resolve `-m paddle_tpu.inference.router`
+        # wherever the router process happens to run from
+        pkg_parent = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env["PYTHONPATH"] = (pkg_parent + os.pathsep + env["PYTHONPATH"]
+                             if env.get("PYTHONPATH") else pkg_parent)
+        env.update(self.spec.env)
+        log_f = open(log_path, "ab")
+        try:
+            proc = subprocess.Popen(
+                self.spec.argv(port_file), env=env,
+                stdout=log_f, stderr=subprocess.STDOUT,
+                cwd=os.getcwd())
+        finally:
+            log_f.close()        # child holds its own fd now
+        rep = Replica(name, proc, port_file, log_path, self.spec.host)
+        with self._lock:
+            self._replicas.append(rep)
+        return rep
+
+    @staticmethod
+    def _read_port(rep: Replica) -> bool:
+        """Pick up the port the child published (atomic file); True
+        once known."""
+        if rep.port is not None:
+            return True
+        try:
+            with open(rep.port_file) as f:
+                rep.port = int(f.read().strip())
+            return True
+        except (OSError, ValueError):
+            return False
+
+    def _wait_replica_ready(self, rep: Replica, timeout: float) -> bool:
+        """Poll the port file, then /healthz, until the replica reports
+        ready. Runs health updates inline so a caller (rolling restart)
+        does not depend on control-loop timing."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if not rep.alive():
+                return False
+            if not self._read_port(rep):
+                time.sleep(0.05)
+                continue
+            self._poll_health(rep)
+            if rep.state == "ready":
+                return True
+            time.sleep(0.1)
+        return False
+
+    def _terminate(self, rep: Replica, drain_timeout: float = 0.0):
+        """Retire one replica: pull it from rotation, ask it to drain,
+        wait (bounded) for in-flight work, then SIGTERM -> SIGKILL."""
+        rep.draining = True                 # out of rotation NOW
+        if drain_timeout and drain_timeout > 0 and rep.base_url \
+                and rep.alive():
+            try:
+                req = urllib.request.Request(rep.base_url + "/drain",
+                                             b"{}")
+                with urllib.request.urlopen(req, timeout=2.0):
+                    pass
+            except (urllib.error.URLError, OSError, ValueError):
+                pass                        # dead/wedged: just kill it
+            deadline = time.monotonic() + drain_timeout
+            while time.monotonic() < deadline and rep.alive():
+                if rep.inflight <= 0 and self._polled_inflight(rep) == 0:
+                    break
+                time.sleep(0.05)
+        if rep.alive():
+            # SIGTERM runs the child's stop(drain_s) path — a second,
+            # in-process bounded drain — then a clean exit
+            try:
+                rep.proc.terminate()
+                rep.proc.wait(timeout=max(5.0, drain_timeout + 5.0))
+            except (subprocess.TimeoutExpired, OSError):
+                try:
+                    rep.proc.kill()
+                    rep.proc.wait(timeout=5.0)
+                except OSError:
+                    pass
+        rep.state = "dead"
+        with self._lock:
+            if rep in self._replicas:
+                self._replicas.remove(rep)
+        for p in (rep.port_file,):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+
+    def _polled_inflight(self, rep: Replica) -> int:
+        """One direct /healthz read of the replica's in-flight count
+        (drain progress); unreachable reads as drained."""
+        if rep.base_url is None:
+            return 0
+        try:
+            with urllib.request.urlopen(rep.base_url + "/healthz",
+                                        timeout=1.0) as r:
+                return int(json.loads(r.read()).get("inflight", 0))
+        except urllib.error.HTTPError as e:
+            try:
+                return int(json.loads(e.read()).get("inflight", 0))
+            except (ValueError, OSError, http.client.HTTPException):
+                return 0
+        except _REPLICA_IO_ERRORS:
+            return 0
+
+    # -- health polling / supervision ------------------------------------
+    def _poll_health(self, rep: Replica):
+        if rep.base_url is None:
+            return
+        try:
+            _resil.maybe_inject("replica_health")
+            with urllib.request.urlopen(rep.base_url + "/healthz",
+                                        timeout=max(1.0, self.poll_s * 2)
+                                        ) as r:
+                body = json.loads(r.read())
+            rep.health = body
+            rep.health_fail_streak = 0
+            rep.state = "ready"
+        except urllib.error.HTTPError as e:
+            try:
+                body = json.loads(e.read())
+            except (ValueError, OSError, http.client.HTTPException):
+                body = {}
+            rep.health = body
+            rep.health_fail_streak = 0
+            status = body.get("status", "unready")
+            rep.state = status if status in ("warming", "draining") \
+                else "unready"
+        except (_resil.FaultInjected,) + _REPLICA_IO_ERRORS:
+            rep.health_fail_streak += 1
+            if rep.health_fail_streak >= self.unreachable_after:
+                # a wedged replica answers nothing but its process
+                # lives: it must leave the rotation just like a dead one
+                rep.state = "unreachable"
+
+    def _control_loop(self):
+        while True:
+            time.sleep(self.poll_s)
+            with self._lock:
+                if self._stopping:
+                    return
+                reps = list(self._replicas)
+            dead = []
+            for rep in reps:
+                if rep.draining:
+                    continue
+                if not rep.alive():
+                    rep.state = "dead"
+                    dead.append(rep)
+                    continue
+                if not self._read_port(rep):
+                    continue            # still binding its listener
+                self._poll_health(rep)
+                if (rep.state == "unreachable"
+                        and rep.health_fail_streak
+                        >= self.restart_unreachable_after):
+                    # wedged beyond hope: treat as dead (kill + respawn)
+                    try:
+                        rep.proc.kill()
+                    except OSError:
+                        pass
+                    dead.append(rep)
+            for rep in dead:
+                with self._lock:
+                    if rep in self._replicas:
+                        self._replicas.remove(rep)
+                    stopping = self._stopping
+                if stopping or not self.respawn:
+                    continue
+                try:
+                    self._spawn_replica()
+                    self.stats_counters["respawns"] += 1
+                except Exception:
+                    self.stats_counters["spawn_failures"] += 1
+            if not self._stopping:
+                self._autoscale()
+                self._trim_surplus()
+
+    def _trim_surplus(self):
+        """Keep the replica count <= max_replicas. A rare race (a
+        replica dying exactly as a rolling restart snapshots it) can
+        leave one extra; retire the newest, drained, on the next
+        pass."""
+        with self._lock:
+            if self._rolling or self._stopping:
+                return
+            reps = [r for r in self._replicas if not r.draining]
+            if len(reps) <= self.max_replicas:
+                return
+            victim = max(reps, key=lambda r: r.spawned_at)
+        threading.Thread(
+            target=self._terminate, args=(victim,),
+            kwargs={"drain_timeout": self.spec.drain_s},
+            daemon=True, name="tier-trim").start()
+
+    def _autoscale(self):
+        if self.max_replicas <= self.min_replicas:
+            return
+        now = time.monotonic()
+        with self._lock:
+            if self._rolling:            # restarts own the spawn path
+                return
+            # draining replicas are leaving: they neither count toward
+            # capacity (a drainer must not block a needed scale-up) nor
+            # qualify as a scale-down victim (no double-terminate)
+            reps = [r for r in self._replicas if not r.draining]
+        n = len(reps)
+        queued = inflight = active = 0
+        for r in reps:
+            eng = r.health.get("engine", {}) if r.health else {}
+            queued += int(eng.get("queued", 0))
+            active += int(eng.get("active", 0))
+            inflight += r.inflight
+        if queued >= self.scale_up_queued:
+            self._up_streak += 1
+            self._idle_streak = 0
+        elif queued == 0 and active == 0 and inflight == 0:
+            self._idle_streak += 1
+            self._up_streak = 0
+        else:
+            self._up_streak = self._idle_streak = 0
+        if now - self._last_scale < self.scale_cooldown_s:
+            return
+        if self._up_streak >= self.scale_cycles and n < self.max_replicas:
+            try:
+                self._spawn_replica()
+                self.stats_counters["scale_ups"] += 1
+            except Exception:
+                self.stats_counters["spawn_failures"] += 1
+            self._last_scale = now
+            self._up_streak = 0
+        elif (self._idle_streak >= self.scale_cycles
+              and n > self.min_replicas):
+            # retire the newest replica (oldest have the warmest OS
+            # caches); drain first — scale-down must never drop work
+            victim = max(reps, key=lambda r: r.spawned_at)
+            self.stats_counters["scale_downs"] += 1
+            self._last_scale = now
+            self._idle_streak = 0
+            threading.Thread(
+                target=self._terminate, args=(victim,),
+                kwargs={"drain_timeout": self.spec.drain_s},
+                daemon=True, name="tier-scale-down").start()
+
+    # -- rolling restart -------------------------------------------------
+    def rolling_restart(self, ready_timeout: float = 300.0,
+                        drain_timeout: Optional[float] = None) -> dict:
+        """Replace every replica, one at a time: spawn the successor
+        (store-warm — ZERO XLA compiles when the shared executable
+        store is primed), wait until it is routable, then drain and
+        retire the predecessor. The tier keeps serving throughout —
+        capacity never drops below the pre-restart count."""
+        if drain_timeout is None:
+            drain_timeout = self.spec.drain_s
+        if not self._rolling_lock.acquire(blocking=False):
+            raise RuntimeError("rolling restart already in progress")
+        replaced, failed = [], []
+        try:
+            with self._lock:
+                self._rolling = True
+                olds = list(self._replicas)
+            for old in olds:
+                with self._lock:
+                    if self._stopping:
+                        break
+                    if old not in self._replicas or not old.alive():
+                        # died (and the control loop owns its respawn):
+                        # replacing it HERE too would double the slot
+                        continue
+                try:
+                    new = self._spawn_replica()
+                except Exception as e:
+                    self.stats_counters["spawn_failures"] += 1
+                    failed.append(f"spawn: {e}")
+                    break
+                if not self._wait_replica_ready(new, ready_timeout):
+                    # successor never came up: keep the predecessor —
+                    # a rolling restart must not shrink the tier
+                    failed.append(f"{new.name} not ready in "
+                                  f"{ready_timeout}s")
+                    self._terminate(new, drain_timeout=0.0)
+                    break
+                self._terminate(old, drain_timeout=drain_timeout)
+                replaced.append((old.name, new.name))
+            self.stats_counters["rolling_restarts"] += 1
+        finally:
+            with self._lock:
+                self._rolling = False
+            self._rolling_lock.release()
+        return {"replaced": replaced, "failed": failed,
+                "ok": not failed}
+
+    # -- forwarding ------------------------------------------------------
+    def _pick(self, exclude: set) -> Optional[Replica]:
+        now = time.monotonic()
+        with self._lock:
+            cands = [r for r in self._replicas
+                     if r.name not in exclude and r.routable(now)]
+            if not cands:
+                return None
+            return min(cands, key=Replica.load_score)
+
+    def _note_failure(self, rep: Replica):
+        rep.failure_streak += 1
+        if rep.failure_streak >= self.breaker_threshold:
+            # circuit breaker: eject for a cooldown; health polls keep
+            # running, so a recovered replica rejoins after the window
+            rep.ejected_until = time.monotonic() + self.eject_s
+            rep.failure_streak = 0
+            self.stats_counters["ejections"] += 1
+
+    def forward_generate(self, payload: bytes,
+                         deadline_s: Optional[float] = None):
+        """Forward one /generate body. Returns ``(code, body_dict,
+        retry_after_or_None)`` — every outcome is a clean JSON
+        response, never an exception to the HTTP handler."""
+        deadline_s = (self.deadline_s if deadline_s is None
+                      else float(deadline_s))
+        t0 = time.monotonic()
+        tried: set = set()
+        self.stats_counters["forwards"] += 1
+        first_attempt = True
+
+        def attempt():
+            nonlocal first_attempt
+            if not first_attempt:
+                self.stats_counters["retries"] += 1
+            first_attempt = False
+            remaining = deadline_s - (time.monotonic() - t0)
+            if remaining <= 0:
+                raise _DeadlineExceeded()
+            rep = self._pick(tried)
+            if rep is None and tried:
+                # every replica tried once: a retry may still land (a
+                # shed clears, an ejection lapses) — reopen the field
+                # rather than fail inside the remaining budget
+                tried.clear()
+                rep = self._pick(tried)
+            if rep is None:
+                raise _NoReplica()
+            tried.add(rep.name)
+            with self._lock:
+                rep.inflight += 1
+            try:
+                _resil.maybe_inject("router_forward")
+                req = urllib.request.Request(
+                    rep.base_url + "/generate", payload,
+                    {"Content-Type": "application/json"})
+                with urllib.request.urlopen(req,
+                                            timeout=remaining) as r:
+                    body = json.loads(r.read())
+                rep.failure_streak = 0
+                body["served_by"] = rep.name
+                return 200, body, None
+            except urllib.error.HTTPError as e:
+                try:
+                    body = json.loads(e.read())
+                except (ValueError, OSError):
+                    body = {"error": f"http_{e.code}"}
+                if e.code == 503:
+                    # truthful shed from a live server — not a breaker
+                    # hit; retry on a different replica
+                    raise _ShedByReplica(rep, body)
+                if e.code >= 500:
+                    self._note_failure(rep)
+                    raise _ForwardFailed(
+                        rep, body.get("error", f"http {e.code}"))
+                body["served_by"] = rep.name
+                return e.code, body, None    # 4xx: the client's problem
+            except _resil.FaultInjected as e:
+                self._note_failure(rep)
+                raise _ForwardFailed(rep, str(e))
+            except _REPLICA_IO_ERRORS as e:
+                reason = getattr(e, "reason", e)
+                if isinstance(reason, (socket.timeout, TimeoutError)) \
+                        or "timed out" in str(e).lower():
+                    # the forward burned the request's whole remaining
+                    # budget inside one replica: no budget left to retry
+                    self._note_failure(rep)
+                    raise _DeadlineExceeded()
+                self._note_failure(rep)
+                raise _ForwardFailed(rep, str(e))
+            finally:
+                with self._lock:
+                    rep.inflight -= 1
+
+        try:
+            remaining = deadline_s - (time.monotonic() - t0)
+            return self.retry_policy.run(attempt, deadline=remaining)
+        except _NoReplica:
+            self.stats_counters["tier_unavailable_503"] += 1
+            with self._lock:
+                n = len(self._replicas)
+            return (503,
+                    {"error": "no_replica_ready", "replicas": n,
+                     "ready": self.ready_count()},
+                    TIER_RETRY_AFTER_S["no_replica_ready"]
+                    + self.poll_s)
+        except _DeadlineExceeded:
+            self.stats_counters["deadline_503"] += 1
+            return (503, {"error": "deadline_exceeded",
+                          "deadline_s": deadline_s},
+                    TIER_RETRY_AFTER_S["deadline_exceeded"])
+        except _ShedByReplica as e:
+            # retries exhausted and the last word was a truthful shed:
+            # relay it (it already carries the replica's retry hint)
+            self.stats_counters["relayed_503"] += 1
+            body = dict(e.body)
+            body["served_by"] = e.replica.name
+            return (503, body,
+                    body.get("retry_after_s",
+                             TIER_RETRY_AFTER_S["overloaded"]))
+        except _ForwardFailed as e:
+            self.stats_counters["backend_503"] += 1
+            return (503, {"error": f"backend_unavailable: {e}"},
+                    TIER_RETRY_AFTER_S["backend_unavailable"])
+
+    # -- introspection ---------------------------------------------------
+    def _readiness(self):
+        reps = self.replicas()
+        ready = sum(1 for r in reps
+                    if r["state"] == "ready" and not r["draining"]
+                    and not r["ejected"])
+        body = {"status": "ready" if ready else "unready",
+                "tier": True,
+                "uptime_s": round(time.monotonic() - self._started, 1),
+                "replicas_total": len(reps), "ready_replicas": ready,
+                "min_replicas": self.min_replicas,
+                "max_replicas": self.max_replicas,
+                "rolling_restart_in_progress": self._rolling,
+                "queued_total": sum(r["queued"] for r in reps),
+                "active_total": sum(r["active"] for r in reps),
+                "inflight_total": sum(r["inflight"] for r in reps),
+                "replicas": reps,
+                "stats": dict(self.stats_counters)}
+        if not ready:
+            body["reason"] = "no replica ready"
+        return ready > 0, body
+
+    def stats(self) -> dict:
+        _, body = self._readiness()
+        return body
+
+    # -- HTTP front ------------------------------------------------------
+    def _make_handler(self):
+        router = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code, obj, retry_after=None):
+                # serve.send_json is the ONE Retry-After writer; the
+                # tier only widens the reason table (no_replica_ready)
+                send_json(self, code, obj, retry_after=retry_after,
+                          retry_after_table=TIER_RETRY_AFTER_S)
+
+            def do_GET(self):
+                if self.path == "/health":
+                    self._send(200, {"status": "ok"})
+                elif self.path == "/healthz":
+                    ready, body = router._readiness()
+                    self._send(200 if ready else 503, body)
+                elif self.path == "/metadata":
+                    self._send(200, {"inputs": ["input_ids"],
+                                     "outputs": ["tokens"]})
+                else:
+                    self._send(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    payload = self.rfile.read(n)
+                except (ValueError, OSError):
+                    payload = b""
+                if self.path == "/generate":
+                    code, body, ra = router.forward_generate(payload)
+                    self._send(code, body, retry_after=ra)
+                elif self.path == "/admin/rolling_restart":
+                    # answer 409 from the HANDLER: Thread.start() never
+                    # raises the in-progress error, the restart itself
+                    # does (inside the daemon thread). The pre-check
+                    # races a concurrent POST by a hair, so the thread
+                    # target still swallows a lost race instead of
+                    # dumping an uncaught exception to stderr
+                    if router._rolling_lock.locked():
+                        self._send(409, {"error": "rolling restart "
+                                                  "already in progress"})
+                        return
+
+                    def _roll():
+                        try:
+                            router.rolling_restart()
+                        except RuntimeError:
+                            pass          # lost the race: one restart
+                            #               is already running
+                    threading.Thread(target=_roll, daemon=True,
+                                     name="tier-rolling").start()
+                    self._send(202, {"status": "rolling"})
+                else:
+                    self._send(404, {"error": f"no route {self.path}"})
+
+        return Handler
+
+
+# ---------------------------------------------------------------------------
+# module entry: the replica-child hook the spawner uses
+# ---------------------------------------------------------------------------
+
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="serving-tier internals (replica child entry; the "
+                    "operator CLI is tools/serve_tier.py)")
+    ap.add_argument("--replica-child", action="store_true")
+    ap.add_argument("--spec", help="ReplicaSpec JSON")
+    ap.add_argument("--port-file", help="where the child publishes its "
+                                        "bound port")
+    args = ap.parse_args(argv)
+    if not args.replica_child:
+        ap.error("this entry point only serves --replica-child; use "
+                 "tools/serve_tier.py to launch a tier")
+    if not args.spec or not args.port_file:
+        ap.error("--replica-child needs --spec and --port-file")
+    return _replica_child_main(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

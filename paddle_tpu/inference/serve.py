@@ -30,6 +30,18 @@ more than PADDLE_TPU_SERVE_MAX_QUEUE requests are already waiting the
 server sheds load with an immediate 503 instead of queueing into its
 own deadline.
 
+Every 503 carries a ``Retry-After`` header (and a ``retry_after_s``
+body field) so routers and external clients back off on the server's
+word instead of guessing — the contract the serving-tier router
+(inference/router.py) builds its retry schedule on.
+
+Draining (rolling restarts): POST /drain flips the server into a
+draining state — /healthz goes unready (reason "draining"), new
+/predict + /generate admissions shed 503 "draining", in-flight
+requests run to completion. ``stop(drain_s=K)`` waits (bounded) for
+in-flight work before shutting the listener down; the default
+``drain_s=0`` keeps the historical fast-stop behavior.
+
 CLI: python -m paddle_tpu.inference.serve --model m.pdmodel --port 8866
 """
 from __future__ import annotations
@@ -56,6 +68,48 @@ def _env_float(name: str, default: float) -> float:
         return float(os.environ.get(name, default))
     except ValueError:
         return default
+
+
+# How long a client should wait before retrying each 503 reason. The
+# values are advisory backoff hints, not promises: "overloaded" clears
+# as soon as a slot frees (fast), "warming_up" waits on an XLA compile
+# or store load (slow). Routers treat any 503 carrying one of these as
+# retryable-on-another-replica.
+RETRY_AFTER_S = {
+    "overloaded": 1.0,
+    "warming_up": 5.0,
+    "deadline_exceeded": 2.0,
+    "backend_unavailable": 2.0,
+    "draining": 2.0,
+    "unready": 1.0,
+}
+
+
+def send_json(handler, code, obj, retry_after=None,
+              retry_after_table=None):
+    """The ONE json-response writer for serving handlers (this server
+    AND the router tier front-end — the Retry-After contract must not
+    fork). ``retry_after`` (seconds) rides any 503 as both the HTTP
+    ``Retry-After`` header (integer, per spec) and a ``retry_after_s``
+    body field (exact float); when omitted on a 503 it is derived from
+    the body's ``error`` reason via ``retry_after_table`` so no shed
+    response can ship without one."""
+    table = RETRY_AFTER_S if retry_after_table is None \
+        else retry_after_table
+    if code == 503 and retry_after is None:
+        reason = str(obj.get("error", "")).split(":")[0]
+        retry_after = table.get(reason, table["unready"])
+    if retry_after is not None:
+        obj.setdefault("retry_after_s", float(retry_after))
+    body = json.dumps(obj).encode()
+    handler.send_response(code)
+    handler.send_header("Content-Type", "application/json")
+    if retry_after is not None:
+        handler.send_header("Retry-After",
+                            str(max(1, int(-(-retry_after // 1)))))
+    handler.send_header("Content-Length", str(len(body)))
+    handler.end_headers()
+    handler.wfile.write(body)
 
 
 class PredictorServer:
@@ -108,6 +162,11 @@ class PredictorServer:
             max_workers=1, thread_name_prefix="predict")
         self._depth = 0                 # requests submitted, not done
         self._depth_lock = threading.Lock()
+        self._resp_inflight = 0         # admitted requests whose
+        #                                 response is not yet written
+        #                                 (BOTH paths — what a drain
+        #                                 actually waits on)
+        self._draining = False          # /drain flips; stop() waits
         self._failure_streak = 0        # consecutive 5xx-class outcomes
         # AOT warmup (paddle_tpu.compilation): compile-or-load the
         # engine's programs BEFORE the first request instead of on it.
@@ -149,6 +208,28 @@ class PredictorServer:
             self._warm_state = "ready"
 
     # ------------------------------------------------------------------
+    def inflight(self) -> int:
+        """Requests admitted but not yet responded to (both paths) —
+        what a drain waits on."""
+        with self._depth_lock:
+            return self._resp_inflight + self._depth
+
+    def begin_drain(self) -> int:
+        """Stop admitting new requests; in-flight ones run to
+        completion. /healthz goes unready (reason "draining") so a
+        router pulls this replica out of rotation immediately; the
+        listener stays up so health polls and in-flight responses still
+        flow. Returns the in-flight count at the moment of the flip.
+        Idempotent — a second /drain just re-reports. The flip happens
+        under the depth lock, atomically against the admission paths'
+        own locked check-and-increment — stop(drain_s)'s wait can never
+        observe inflight()==0 with an admitted request not yet
+        counted."""
+        with self._depth_lock:
+            self._draining = True
+            return self._resp_inflight + self._depth
+
+    # ------------------------------------------------------------------
     def _metadata(self):
         if self.predictor is not None:
             return {"inputs": self.predictor.get_input_names(),
@@ -163,6 +244,8 @@ class PredictorServer:
         body = {"status": "ready",
                 "uptime_s": round(time.monotonic() - self._started, 1),
                 "queue_depth": self._depth,
+                "inflight": self.inflight(),
+                "draining": self._draining,
                 "max_queue": self.max_queue,
                 "failure_streak": self._failure_streak}
         try:
@@ -178,6 +261,11 @@ class PredictorServer:
                                "max_queue", "ticks",
                                "compiled_programs")}
             body["engine"]["warm"] = getattr(self.engine, "warm", True)
+        if self._draining:
+            # draining dominates every other state: in-flight requests
+            # are finishing, nothing new may be routed here
+            body.update(status="draining", reason="draining for restart")
+            return False, body
         if self._warm_state == "warming":
             # truthful readiness: programs are still compiling (or
             # loading from the executable store); traffic sent now
@@ -254,26 +342,50 @@ class PredictorServer:
             def log_message(self, *a):        # quiet by default
                 pass
 
-            def _send(self, code, obj):
-                body = json.dumps(obj).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+            def _send(self, code, obj, retry_after=None):
+                send_json(self, code, obj, retry_after=retry_after)
 
             def do_GET(self):
                 if self.path == "/health":
                     self._send(200, {"status": "ok"})
                 elif self.path == "/healthz":
                     ready, body = server._readiness()
-                    self._send(200 if ready else 503, body)
+                    ra = None
+                    if not ready:
+                        ra = (RETRY_AFTER_S["warming_up"]
+                              if body.get("status") == "warming"
+                              else RETRY_AFTER_S["draining"]
+                              if body.get("status") == "draining"
+                              else RETRY_AFTER_S["unready"])
+                    self._send(200 if ready else 503, body,
+                               retry_after=ra)
                 elif self.path == "/metadata":
                     self._send(200, server._metadata())
                 else:
                     self._send(404, {"error": f"no route {self.path}"})
 
+            def _drain_body(self):
+                """Read (and discard) any unread request body —
+                responding with unread POST bytes on the socket resets
+                the connection instead of delivering the response."""
+                try:
+                    self.rfile.read(
+                        int(self.headers.get("Content-Length", "0")))
+                except (ValueError, OSError):
+                    pass
+
             def do_POST(self):
+                if self.path == "/drain":
+                    # admin: flip into draining (idempotent). The
+                    # caller (router rolling restart / serve_tier)
+                    # polls /healthz "inflight" to watch it empty, then
+                    # terminates the process, whose SIGTERM path runs
+                    # stop(drain_s) as a belt-and-braces second wait
+                    self._drain_body()
+                    n = server.begin_drain()
+                    self._send(200, {"status": "draining",
+                                     "inflight": n})
+                    return
                 if self.path == "/generate":
                     self._do_generate()
                     return
@@ -291,13 +403,32 @@ class PredictorServer:
                 # load shedding BEFORE reading the body into the queue:
                 # a saturated predict worker means every queued request
                 # would blow its deadline anyway — 503 now is cheaper
-                # for the client than 503 in deadline_s seconds
+                # for the client than 503 in deadline_s seconds. The
+                # draining check lives in the SAME locked block as the
+                # depth increment (atomic against begin_drain's flip),
+                # and every shed drains the unread body first — a 503
+                # on unread POST bytes is a connection reset, not a
+                # delivered response
                 with server._depth_lock:
-                    if server._depth >= server.max_queue:
-                        self._send(503, {"error": "overloaded",
-                                         "queue_depth": server._depth})
-                        return
-                    server._depth += 1
+                    if server._draining:
+                        shed, depth = "draining", server._depth
+                    elif server._depth >= server.max_queue:
+                        shed, depth = "overloaded", server._depth
+                    else:
+                        shed = None
+                        server._depth += 1
+                        # depth alone is NOT the drain signal: the
+                        # worker releases it when the predict call
+                        # finishes, which can be BEFORE this handler
+                        # writes the response — the response counter
+                        # keeps the drain waiting until the bytes are
+                        # actually out
+                        server._resp_inflight += 1
+                if shed is not None:
+                    self._drain_body()
+                    self._send(503, {"error": shed,
+                                     "queue_depth": depth})
+                    return
 
                 def release():
                     with server._depth_lock:
@@ -348,6 +479,8 @@ class PredictorServer:
                     self._send(code,
                                {"error": f"{type(e).__name__}: {e}"})
                 finally:
+                    with server._depth_lock:
+                        server._resp_inflight -= 1
                     if not submitted:
                         release()
 
@@ -367,14 +500,32 @@ class PredictorServer:
                     # Drain the request body first: responding with
                     # unread bytes on the socket resets the connection
                     # instead of delivering the 503
-                    try:
-                        self.rfile.read(
-                            int(self.headers.get("Content-Length", "0")))
-                    except (ValueError, OSError):
-                        pass
+                    self._drain_body()
                     self._send(503, {"error": "warming_up",
                                      "queue_depth": 0})
                     return
+                # draining check + in-flight increment are ONE atomic
+                # step against begin_drain's locked flip: either this
+                # request is counted before the drain waiter can read
+                # inflight()==0, or it sheds — an admitted request is
+                # never abandoned by a graceful shutdown
+                with server._depth_lock:
+                    draining = server._draining
+                    if not draining:
+                        server._resp_inflight += 1
+                if draining:
+                    # rolling restart in progress: nothing new may be
+                    # admitted; the router already saw /healthz flip
+                    self._drain_body()
+                    self._send(503, {"error": "draining"})
+                    return
+                try:
+                    self._generate_admitted()
+                finally:
+                    with server._depth_lock:
+                        server._resp_inflight -= 1
+
+            def _generate_admitted(self):
                 from .engine import EngineOverloaded
                 try:
                     n = int(self.headers.get("Content-Length", "0"))
@@ -437,10 +588,24 @@ class PredictorServer:
             self.httpd.serve_forever()
         return self
 
-    def stop(self):
+    def stop(self, drain_s: float = 0.0):
+        """Shut the server down. ``drain_s > 0`` is the graceful path:
+        flip into draining (new admissions shed 503 "draining", the
+        listener keeps answering so in-flight responses and health
+        polls still flow), wait — bounded by ``drain_s`` — for every
+        admitted request to finish, THEN tear the listener down. The
+        default 0 keeps the historical fast stop: shut down now and
+        abandon whatever is in flight (a wedged predict call must not
+        be able to hold shutdown hostage)."""
+        if drain_s and drain_s > 0:
+            self.begin_drain()
+            deadline = time.monotonic() + float(drain_s)
+            while self.inflight() > 0 and time.monotonic() < deadline:
+                time.sleep(0.02)
         self.httpd.shutdown()
         self.httpd.server_close()
-        # don't wait for a possibly-wedged predict call to drain
+        # past the (bounded) drain: don't wait for a possibly-wedged
+        # predict call — abandon it
         self._pool.shutdown(wait=False, cancel_futures=True)
         if self._thread is not None:
             self._thread.join(timeout=5)

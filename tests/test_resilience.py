@@ -86,6 +86,94 @@ def test_retry_policy_from_env(monkeypatch):
     assert p.max_delay == 9.0  # malformed env falls back, never crashes
 
 
+class _FakeClock:
+    """Deterministic clock + sleep pair for RetryPolicy tests: sleeps
+    advance the clock, nothing waits on the wall."""
+
+    def __init__(self):
+        self.now = 0.0
+        self.sleeps = []
+
+    def monotonic(self):
+        return self.now
+
+    def sleep(self, s):
+        self.sleeps.append(s)
+        self.now += s
+
+
+def test_retry_budget_gives_up_within_deadline_fake_clock():
+    """The retry-time budget bounds TOTAL retry time: a storm against a
+    dead tier stops within the caller's deadline, not after
+    attempts x max_delay (which here would be 50 x 10 = 500 s)."""
+    clk = _FakeClock()
+    p = RetryPolicy(max_attempts=50, base_delay=2.0, multiplier=2.0,
+                    max_delay=10.0, jitter=0.0, deadline=5.0,
+                    clock=clk.monotonic, sleep_fn=clk.sleep)
+    calls = []
+
+    def dead():
+        calls.append(1)
+        clk.now += 0.5              # each attempt costs fake wall time
+        raise OSError("tier down")
+
+    with pytest.raises(OSError):
+        p.run(dead)
+    # every sleep was capped to the remaining budget, and the run gave
+    # up as soon as the budget was spent — total fake time <= deadline
+    # plus the one attempt that discovered the exhaustion
+    assert clk.now <= 5.0 + 0.5
+    assert 1 < len(calls) < 50
+
+
+def test_retry_budget_per_run_override_fake_clock():
+    clk = _FakeClock()
+    p = RetryPolicy(max_attempts=50, base_delay=1.0, multiplier=1.0,
+                    jitter=0.0, clock=clk.monotonic, sleep_fn=clk.sleep)
+
+    def dead():
+        clk.now += 0.1
+        raise OSError("x")
+
+    with pytest.raises(OSError):
+        p.run(dead, deadline=2.0)   # caller's remaining budget
+    assert clk.now <= 2.0 + 0.1
+
+
+def test_full_jitter_draws_uniform_below_schedule():
+    """Full-jitter sleeps land in [0, delay(attempt)]; the
+    deterministic schedule() is unchanged."""
+    import random as _random
+    clk = _FakeClock()
+    p = RetryPolicy(max_attempts=6, base_delay=1.0, multiplier=2.0,
+                    max_delay=8.0, full_jitter=True,
+                    clock=clk.monotonic, sleep_fn=clk.sleep)
+    assert p.schedule() == (1.0, 2.0, 4.0, 8.0, 8.0)
+    _random.seed(0)
+
+    def dead():
+        raise OSError("x")
+
+    with pytest.raises(OSError):
+        p.run(dead)
+    assert len(clk.sleeps) == 5
+    for slept, ceiling in zip(clk.sleeps, p.schedule()):
+        assert 0.0 <= slept <= ceiling
+    # across the whole run the draws are not all pinned at the ceiling
+    # (the old +/-jitter mode would keep them within 10% of it)
+    assert any(s < 0.9 * c for s, c in zip(clk.sleeps, p.schedule()))
+
+
+def test_router_fault_sites_are_known():
+    """The serving-tier sites exist (a typo'd site raises — the
+    injection harness's own contract) and fire as crash-type."""
+    from paddle_tpu.distributed import resilience as resil
+    for site in ("router_forward", "replica_spawn", "replica_health"):
+        with FaultInjector({site: 1}):
+            with pytest.raises(FaultInjected):
+                resil.maybe_inject(site)
+
+
 # ---------------------------------------------------------------------------
 # FaultInjector
 # ---------------------------------------------------------------------------

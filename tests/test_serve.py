@@ -1,6 +1,7 @@
 """HTTP predictor-server tests (serving north star: model served
 end-to-end; reference role: DistModel service / embedded predictor)."""
 import json
+import time
 import urllib.request
 
 import numpy as np
@@ -25,6 +26,12 @@ def server(tmp_path_factory):
 
 
 def _req(srv, path, payload=None):
+    code, body, _ = _req_h(srv, path, payload)
+    return code, body
+
+
+def _req_h(srv, path, payload=None):
+    """Like _req but also returns the response headers (Retry-After)."""
     url = f"http://{srv.host}:{srv.port}{path}"
     data = json.dumps(payload).encode() if payload is not None else None
     req = urllib.request.Request(
@@ -32,9 +39,9 @@ def _req(srv, path, payload=None):
         headers={"Content-Type": "application/json"} if data else {})
     try:
         with urllib.request.urlopen(req, timeout=30) as r:
-            return r.status, json.loads(r.read())
+            return r.status, json.loads(r.read()), dict(r.headers)
     except urllib.error.HTTPError as e:
-        return e.code, json.loads(e.read())
+        return e.code, json.loads(e.read()), dict(e.headers)
 
 
 def test_health_and_metadata(server):
@@ -69,6 +76,164 @@ def test_predict_error_paths(server):
     assert code == 400
     code, body = _req(srv, "/nothing")
     assert code == 404
+
+
+# ---------------------------------------------------------------------------
+# Retry-After contract: every 503 names its reason AND carries a
+# Retry-After header + retry_after_s body field — the router tier and
+# external clients back off on the server's word, never by guessing.
+# ---------------------------------------------------------------------------
+
+def _assert_retry_after(code, body, headers, reason):
+    assert code == 503, body
+    assert body["error"].split(":")[0] == reason, body
+    assert float(body["retry_after_s"]) > 0, body
+    assert int(headers["Retry-After"]) >= 1, headers
+
+
+@pytest.fixture()
+def saved_model_path(tmp_path):
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.Linear(16, 4))
+    m.eval()
+    path = str(tmp_path / "model")
+    paddle.jit.save(m, path,
+                    input_spec=[paddle.jit.InputSpec([None, 8])])
+    return path + ".pdmodel"
+
+
+def test_503_overloaded_carries_retry_after(saved_model_path):
+    srv = PredictorServer(saved_model_path, port=0, max_queue=0).start()
+    try:
+        code, body, hdr = _req_h(srv, "/predict", {"inputs": {"x": [[1.0]]}})
+        _assert_retry_after(code, body, hdr, "overloaded")
+    finally:
+        srv.stop()
+
+
+def test_503_deadline_and_backend_carry_retry_after(saved_model_path):
+    from paddle_tpu.distributed.resilience import FaultInjector
+    srv = PredictorServer(saved_model_path, port=0,
+                          deadline_s=0.3).start()
+    try:
+        _, meta = _req(srv, "/metadata")
+        x = np.zeros((1, 8), "float32")
+        payload = {"inputs": {meta["inputs"][0]: {"data": x.tolist(),
+                                                  "dtype": "float32"}}}
+        with FaultInjector({"serve_hang": 1}, wedge_s=1.0):
+            code, body, hdr = _req_h(srv, "/predict", payload)
+        _assert_retry_after(code, body, hdr, "deadline_exceeded")
+        # the abandoned worker is still inside its 1 s wedge and holds
+        # its depth slot; wait for it to clear so the next request is
+        # admitted and reaches the injected backend fault
+        deadline = time.monotonic() + 10
+        while srv.inflight() > 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        with FaultInjector({"serve_backend": 1}):
+            code, body, hdr = _req_h(srv, "/predict", payload)
+        _assert_retry_after(code, body, hdr, "backend_unavailable")
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# engine-backed server: warming 503, drain semantics, graceful stop
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def engine_server():
+    from paddle_tpu.framework import random as _rng
+    from paddle_tpu.inference.engine import ContinuousBatchingEngine
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    _rng.seed(0)
+    model = GPTForCausalLM(GPTConfig(vocab_size=96, hidden_size=32,
+                                     num_layers=1, num_heads=2,
+                                     max_seq_len=128))
+    eng = ContinuousBatchingEngine(model, slots=2, max_len=96,
+                                   cache_dtype="float32", tick_tokens=2,
+                                   prefill_buckets=(8,))
+    srv = PredictorServer(engine=eng, port=0).start()
+    yield srv
+    srv.stop()
+    eng.stop()
+
+
+def test_503_warming_carries_retry_after(engine_server):
+    srv = engine_server
+    srv._warm_state = "warming"     # white-box: deterministic warming
+    try:
+        code, body, hdr = _req_h(srv, "/generate",
+                                 {"input_ids": [1], "max_new_tokens": 2})
+        _assert_retry_after(code, body, hdr, "warming_up")
+        code, body, hdr = _req_h(srv, "/healthz")
+        assert code == 503 and body["status"] == "warming"
+        assert int(hdr["Retry-After"]) >= 1
+    finally:
+        srv._warm_state = "ready"
+
+
+def test_stop_drain_completes_inflight_and_sheds_new(engine_server):
+    """The drain regression (ISSUE 7 satellite): an in-flight
+    /generate completes across stop(drain_s=...) while new admissions
+    get a 503 "draining" — the serve.py:443 fast-stop abandonment is
+    now opt-in (drain_s=0), not the only behavior."""
+    import threading
+    from paddle_tpu.framework import random as _rng
+    from paddle_tpu.inference.engine import ContinuousBatchingEngine
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    _rng.seed(0)
+    model = GPTForCausalLM(GPTConfig(vocab_size=96, hidden_size=32,
+                                     num_layers=1, num_heads=2,
+                                     max_seq_len=128))
+    eng = ContinuousBatchingEngine(model, slots=2, max_len=96,
+                                   cache_dtype="float32", tick_tokens=2,
+                                   prefill_buckets=(8,))
+    srv = PredictorServer(engine=eng, port=0).start()
+    results = {}
+
+    def long_request():
+        # max_new=60 at tick_tokens=2 is ~30 ticks (plus the first
+        # request's compile): reliably in flight when stop() begins
+        results["long"] = _req_h(srv, "/generate",
+                                 {"input_ids": [3, 1, 4],
+                                  "max_new_tokens": 60})
+
+    t = threading.Thread(target=long_request)
+    t.start()
+    deadline = time.monotonic() + 30
+    while srv._resp_inflight < 1 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert srv._resp_inflight >= 1, "long request never became in-flight"
+
+    stopper = threading.Thread(target=srv.stop, kwargs={"drain_s": 60.0})
+    stopper.start()
+    while not srv._draining and stopper.is_alive():
+        time.sleep(0.005)
+    # new admission during the drain: clean 503 "draining" + Retry-After
+    code, body, hdr = _req_h(srv, "/generate",
+                             {"input_ids": [1], "max_new_tokens": 2})
+    _assert_retry_after(code, body, hdr, "draining")
+    # /healthz tells the router why this replica left the rotation
+    code, body, _ = _req_h(srv, "/healthz")
+    assert code == 503 and body["status"] == "draining"
+
+    t.join(timeout=90)
+    stopper.join(timeout=90)
+    assert not t.is_alive() and not stopper.is_alive()
+    code, body, _ = results["long"]
+    assert code == 200, body
+    assert len(body["tokens"]) == 3 + 60     # completed, not abandoned
+    eng.stop()
+
+
+def test_fast_stop_default_unchanged(engine_server):
+    """drain_s=0 (the default) must keep today's behavior: stop()
+    returns promptly even with nothing special done about in-flight
+    work (the wedged-backend shutdown guarantee)."""
+    srv = PredictorServer(engine=engine_server.engine, port=0).start()
+    t0 = time.monotonic()
+    srv.stop()
+    assert time.monotonic() - t0 < 10.0
 
 
 @pytest.mark.slow

@@ -20,6 +20,16 @@ over the identical request set — aggregate tokens/s + p50/p90/p99
 per-request latency + the speedup. Both sides are compile-warmed first
 so the number is steady-state serving, not XLA.
 
+Tier mode (--tier): closed-loop clients through the multi-replica
+serving tier (inference/router.py — replica subprocesses behind the
+health-aware router) across three phases: steady state, a kill -9 of a
+live replica mid-traffic, and a rolling restart mid-traffic. The
+REPORTED GATES are p99 latency and error rate per phase — NOT
+throughput (this host has one CPU core; replica processes time-slice
+it). Hard asserts: zero hung requests, zero connection resets, greedy
+tokens identical for identical requests across all phases/replicas,
+and zero XLA compiles in the rolling-restart successors (store-warm).
+
 Run on TPU:  python tools/bench_serving.py [--concurrent]
 CPU smoke:   env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
                  python tools/bench_serving.py --smoke [--concurrent]
@@ -244,6 +254,184 @@ def bench_concurrent(smoke: bool, clients: int, per_client: int,
     }
 
 
+def bench_tier(smoke: bool, clients: int, per_client: int):
+    """Closed-loop clients through the router tier across chaos phases.
+
+    Every client retries a 503 after the response's own
+    ``retry_after_s`` hint (the Retry-After contract) and counts it as
+    an error; a connection reset or a request that exceeds the client
+    timeout is UNCLEAN (the tier's zero-hangs / zero-resets claim) and
+    fails the bench. Greedy determinism is asserted for free: all
+    replicas hold identical weights, so every 200 for the same
+    (prompt, max_new) pair must carry identical tokens — across
+    replicas, kills, and the rolling restart.
+    """
+    import os
+    import signal
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from paddle_tpu.inference.router import (ReplicaSpec, Router,
+                                             single_device_child_env)
+
+    model = {"kind": "gpt", "vocab_size": 192, "hidden_size": 32,
+             "num_layers": 1, "num_heads": 2, "max_seq_len": 96}
+    engine = {"slots": 4, "max_len": 80, "cache_dtype": "float32",
+              "prefill_buckets": (8, 16), "tick_tokens": 4}
+    # replicas are separate processes: force cpu + a single-device mesh
+    # into the children whatever harness env the bench inherited
+    child_env = single_device_child_env("cpu")
+    store = tempfile.mkdtemp(prefix="bench_tier_store_")
+    spec = ReplicaSpec(model, engine, warmup=True, drain_s=20.0, seed=0,
+                       env=child_env)
+    router = Router(spec, replicas=2, poll_s=0.3, deadline_s=120.0,
+                    exec_store_dir=store).start()
+    if not router.wait_ready(2, timeout=300):
+        router.stop()
+        raise RuntimeError(f"tier never ready: {router.replicas()}")
+    base = f"http://{router.host}:{router.port}/generate"
+
+    rng = np.random.RandomState(0)
+    combos = [(4, 4), (7, 6), (12, 4), (6, 8)]
+    prompts = {p: rng.randint(0, 150, (p,)).tolist()
+               for p, _ in combos}
+    tokens_seen = {}      # (P, n) -> first 200's tokens (identity oracle)
+    lock = threading.Lock()
+
+    def run_phase(name, chaos=None):
+        lat_ms, errors = [], []
+        resets = hangs = mismatches = gave_up = 0
+
+        def client(c):
+            nonlocal resets, hangs, mismatches, gave_up
+            for i in range(per_client):
+                P, n = combos[(c + i) % len(combos)]
+                payload = json.dumps(
+                    {"input_ids": prompts[P],
+                     "max_new_tokens": n}).encode()
+                t0 = time.perf_counter()
+                for _ in range(12):          # closed-loop with backoff
+                    try:
+                        req = urllib.request.Request(
+                            base, payload,
+                            {"Content-Type": "application/json"})
+                        with urllib.request.urlopen(
+                                req, timeout=180) as r:
+                            body = json.loads(r.read())
+                        with lock:
+                            lat_ms.append(
+                                (time.perf_counter() - t0) * 1e3)
+                            want = tokens_seen.setdefault(
+                                (P, n), body["tokens"])
+                            if want != body["tokens"]:
+                                mismatches += 1
+                        break
+                    except urllib.error.HTTPError as e:
+                        try:
+                            body = json.loads(e.read())
+                        except ValueError:
+                            body = {}
+                        with lock:
+                            errors.append(body.get("error", e.code))
+                        time.sleep(min(
+                            float(body.get("retry_after_s", 1.0)), 2.0))
+                    except (TimeoutError, OSError) as e:
+                        with lock:
+                            if "timed out" in str(e).lower():
+                                hangs += 1
+                            else:
+                                resets += 1
+                        break
+                else:
+                    # all retry attempts returned 503: this request
+                    # never completed — it MUST count against the
+                    # no-silent-drops gate, not vanish
+                    with lock:
+                        gave_up += 1
+
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        chaos_result = chaos() if chaos is not None else None
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        p50, p90, p99 = _percentiles(lat_ms) if lat_ms else (0, 0, 0)
+        # every issued request must be accounted: ok, hung, reset, or
+        # retry-exhausted — total is the ISSUED count, not a sum of
+        # the outcomes we happened to observe
+        total = clients * per_client
+        failed = total - len(lat_ms)
+        return {
+            "phase": name, "wall_s": round(wall, 1),
+            "requests_issued": total,
+            "requests_ok": len(lat_ms),
+            "errors_503_retried": len(errors),
+            "error_rate": round(len(errors) / max(
+                len(lat_ms) + len(errors), 1), 3),
+            "resets": resets, "hangs": hangs,
+            "retry_exhausted": gave_up,
+            "token_mismatches": mismatches,
+            "failed_requests": failed,
+            "p50_ms": round(p50, 1), "p99_ms": round(p99, 1),
+            "chaos": chaos_result,
+        }
+
+    def kill_one():
+        time.sleep(0.3)                 # let traffic land first
+        victim = router.replicas()[0]
+        os.kill(victim["pid"], signal.SIGKILL)
+        return {"killed": victim["name"]}
+
+    def rolling():
+        res = router.rolling_restart(ready_timeout=300)
+        return {"rolling_ok": res["ok"],
+                "replaced": len(res["replaced"])}
+
+    phases = [run_phase("steady"),
+              run_phase("replica_kill", chaos=kill_one),
+              run_phase("rolling_restart", chaos=rolling)]
+    router.wait_ready(2, timeout=120)
+    successor_compiles = []
+    # skip replicas mid-drain (a trim/retire may still be finishing):
+    # the store-warm claim is about the replicas actually serving
+    for r in [x for x in router.replicas() if not x["draining"]]:
+        try:
+            with urllib.request.urlopen(
+                    f"http://{router.host}:{r['port']}/healthz",
+                    timeout=5) as resp:
+                h = json.loads(resp.read())
+            successor_compiles.append(
+                h.get("compilation", {}).get("xla_compiles", -1))
+        except (urllib.error.URLError, OSError, ValueError):
+            successor_compiles.append(-1)
+    stats = dict(router.stats_counters)
+    router.stop()
+    import shutil
+    shutil.rmtree(store, ignore_errors=True)
+
+    all_lat_p99 = max(p["p99_ms"] for p in phases)
+    clean = (all(p["resets"] == 0 and p["hangs"] == 0
+                 and p["token_mismatches"] == 0
+                 and p["failed_requests"] == 0 for p in phases)
+             and all(c == 0 for c in successor_compiles))
+    return {
+        "phases": phases,
+        "p99_ms_worst_phase": round(all_lat_p99, 1),
+        "error_rate_overall": round(
+            sum(p["errors_503_retried"] for p in phases) / max(
+                sum(p["requests_ok"] + p["errors_503_retried"]
+                    for p in phases), 1), 3),
+        "successor_xla_compiles": successor_compiles,
+        "router_stats": stats,
+        "clients": clients, "per_client_per_phase": per_client,
+        "clean": clean,
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true",
@@ -253,6 +441,10 @@ def main():
     ap.add_argument("--concurrent", action="store_true",
                     help="concurrent-client engine vs sequential "
                          "generate() throughput comparison")
+    ap.add_argument("--tier", action="store_true",
+                    help="multi-replica tier chaos bench: closed-loop "
+                         "clients through replica kills + one rolling "
+                         "restart; gates are p99 + error-rate")
     ap.add_argument("--clients", type=int, default=8,
                     help="closed-loop clients (engine slots follow)")
     ap.add_argument("--per-client", type=int, default=None,
@@ -266,6 +458,22 @@ def main():
     probe_backend()  # cpu is a healthy result; exits 4 if tunnel wedged
     if lock is not None:
         lock.stage("compile+measure")
+
+    if args.tier:
+        per_client = (args.per_client if args.per_client is not None
+                      else (3 if args.smoke else 5))
+        clients = min(args.clients, 4) if args.smoke else args.clients
+        rec = bench_tier(args.smoke, clients, per_client)
+        rec.update({
+            "metric": "serving_tier_chaos",
+            "value": rec["p99_ms_worst_phase"],
+            "unit": "p99_ms_worst_phase",
+            "smoke": bool(args.smoke),
+        })
+        print(json.dumps(rec))
+        # the zero-hangs / zero-resets / token-identity / store-warm
+        # claims are ASSERTED, not just reported
+        return 0 if rec["clean"] else 1
 
     if args.concurrent:
         if args.clients < 2:

@@ -60,6 +60,9 @@ QUICK_FILES = [
     # program registry / AOT warmup / executable store: warmup
     # idempotence + store invalidation + the warming->ready contract
     "tests/test_compilation.py",
+    # serving tier: health-aware routing, kill -9 recovery, store-warm
+    # rolling restart (0-compile successors), truthful tier 503s
+    "tests/test_router.py",
 ]
 
 

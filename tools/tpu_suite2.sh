@@ -27,6 +27,12 @@ run bench_serving bench_serving.json python tools/bench_serving.py
 # self-skips once landed like every other step
 run bench_serving_concurrent bench_serving_concurrent.json \
     python tools/bench_serving.py --concurrent
+# multi-replica serving tier chaos bench (PR 7): closed-loop clients
+# through a replica kill + one rolling restart; p99 + error-rate are
+# the gates (replica children force JAX_PLATFORMS=cpu — N processes
+# cannot share one chip); self-skips once landed
+run bench_serving_tier bench_serving_tier.json \
+    python tools/bench_serving.py --tier
 run kv_quality kv_quality.json python tools/kv_cache_quality.py
 # fused K-step train loop vs per-step dispatch (PR 4): steps/s for
 # K in {4,16} scanned windows + the zero-mid-window-sync assertion;

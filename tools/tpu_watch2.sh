@@ -77,6 +77,7 @@ PY
         /root/repo/tpu_results/bench_ring.json \
         /root/repo/tpu_results/bench_serving.json \
         /root/repo/tpu_results/bench_serving_concurrent.json \
+        /root/repo/tpu_results/bench_serving_tier.json \
         /root/repo/tpu_results/tpulint.json \
         /root/repo/tpu_results/bench_125m_fused.json \
         /root/repo/tpu_results/bench_1p3b_dots.json \
