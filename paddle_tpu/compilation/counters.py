@@ -42,6 +42,33 @@ _compile_secs = 0.0
 _installed = False
 _install_lock = threading.Lock()
 _count_lock = threading.Lock()
+_obs_metrics = None      # lazy (compiles, secs, hits) counters; False=off
+
+
+def _obs() -> tuple:
+    """Mirror every compile event into the obs registry (exported on
+    /metrics) — same listener, second face, like framework/syncs."""
+    global _obs_metrics
+    if _obs_metrics is None:
+        try:
+            from .. import obs
+            if not obs.enabled():
+                # live read, not cached: obs.set_enabled is tri-state
+                # and a later re-enable must start mirroring again
+                return (None, None, None)
+            reg = obs.metrics.registry
+            _obs_metrics = (
+                reg.counter("ptpu_xla_backend_compiles_total",
+                            "backend compile-path invocations "
+                            "(includes persistent-cache loads)"),
+                reg.counter("ptpu_xla_compile_seconds_total",
+                            "wall seconds inside the backend "
+                            "compile path"),
+                reg.counter("ptpu_xla_cache_hits_total",
+                            "persistent compilation-cache hits"))
+        except Exception:    # noqa: BLE001 — accounting must not crash
+            _obs_metrics = False
+    return _obs_metrics or (None, None, None)
 
 
 def _on_duration(event: str, duration_secs: float, **kw) -> None:
@@ -50,6 +77,10 @@ def _on_duration(event: str, duration_secs: float, **kw) -> None:
         with _count_lock:
             _backend_compiles += 1
             _compile_secs += duration_secs
+        compiles, secs, _ = _obs()
+        if compiles is not None:
+            compiles.inc()
+            secs.inc(duration_secs)
     elif event == _TRACE_EVT:
         with _count_lock:
             _traces += 1
@@ -60,6 +91,9 @@ def _on_event(event: str, **kw) -> None:
     if event == _CACHE_HIT_EVT:
         with _count_lock:
             _cache_hits += 1
+        _, _, hits = _obs()
+        if hits is not None:
+            hits.inc()
 
 
 def install() -> None:

@@ -484,6 +484,19 @@ class StepWatchdog:
 
     # -- failure path ----------------------------------------------------
     def _fail(self, kind: str, exc: BaseException):
+        try:
+            # postmortem first (paddle_tpu.obs): dump the flight
+            # recorder — what the process was doing in the seconds
+            # before the hang/storm — to a timestamped artifact BEFORE
+            # any rescue path can wedge. obs is stdlib-only, imported
+            # lazily to keep this module's stdlib-at-module-scope
+            # contract; best-effort like the checkpoint below.
+            from ..obs.trace import dump_flight
+            dump_flight(f"watchdog_{kind}",
+                        extra={"deadline_s": self.deadline,
+                               "steps_run": self.steps_run})
+        except Exception:
+            pass
         if self.on_failure is not None:
             try:
                 self.on_failure(kind, exc)

@@ -10,18 +10,44 @@ Deliberately tiny: a process-global counter bumped from
 ``Tensor.__float__`` and ``hapi.lazy.LossWindow.fetch``. A plain int
 under the GIL is plenty for accounting (the consumers read deltas
 between phases on one thread); no locks on the hot path.
+
+The same signal feeds the obs metrics registry
+(``ptpu_host_syncs_total`` — paddle_tpu.obs, exported on /metrics) so
+the fleet view and the in-process delta readers can never disagree:
+ONE record site, two faces.
 """
 from __future__ import annotations
 
 __all__ = ["record_sync", "sync_count", "SyncTracker"]
 
 _count = 0
+_obs_counter = None      # lazy: obs Counter, or False when obs is off
+
+
+def _obs_record(n: int) -> None:
+    global _obs_counter
+    if _obs_counter is False:
+        return
+    try:
+        if _obs_counter is None:
+            from .. import obs
+            if not obs.enabled():
+                # disabled is a LIVE read (obs.set_enabled is
+                # tri-state): don't cache, the next sync re-checks
+                return
+            _obs_counter = obs.metrics.registry.counter(
+                "ptpu_host_syncs_total",
+                "device->host materializations (framework/syncs)")
+        _obs_counter.inc(n)
+    except Exception:          # noqa: BLE001 — accounting must not crash
+        _obs_counter = False
 
 
 def record_sync(n: int = 1) -> None:
     """Note that a device->host materialization happened."""
     global _count
     _count += n
+    _obs_record(n)
 
 
 def sync_count() -> int:

@@ -44,8 +44,14 @@ class LossWindow:
     def fetch(self) -> np.ndarray:
         if self._np is None:
             from ..framework import syncs
+            from ..obs.trace import span as _span
             syncs.record_sync()
-            self._np = np.asarray(self._dev, dtype=np.float64).reshape(-1)
+            # the window's ONE blocking device read — the "fetch" leg
+            # of the per-window span triplet (prefetch-wait / dispatch
+            # live in hapi.Model's fused loop)
+            with _span("train.fetch", cat="train"):
+                self._np = np.asarray(self._dev,
+                                      dtype=np.float64).reshape(-1)
             self._dev = None
         return self._np
 

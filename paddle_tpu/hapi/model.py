@@ -10,6 +10,7 @@ function — the static-graph speed with the dygraph API.
 from __future__ import annotations
 
 import os
+import time
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -30,6 +31,16 @@ def _as_list(x):
     if x is None:
         return []
     return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _obs_hist(name, help_):
+    """Registry histogram when ambient obs is on, else None — the
+    training loop's instrumentation collapses to one ``is not None``
+    branch per site when disabled (paddle_tpu.obs)."""
+    from .. import obs
+    if not obs.enabled():
+        return None
+    return obs.metrics.registry.histogram(name, help_)
 
 
 class Model:
@@ -278,7 +289,10 @@ class Model:
         window fallback, via `batches`/`step_i`). Returns
         ``(logs, it_count, step_i)``."""
         logs = {}
+        h_step = _obs_hist("ptpu_train_step_ms",
+                           "per-step dispatch wall time")
         for data in (batches if batches is not None else loader):
+            t_step = time.perf_counter() if h_step is not None else 0.0
             for cb in cbs:
                 cb.on_train_batch_begin(step_i)
             x, y = self._split_batch(data)
@@ -289,6 +303,8 @@ class Model:
             logs = {"loss": loss}
             for cb in cbs:
                 cb.on_train_batch_end(step_i, logs)
+            if h_step is not None:
+                h_step.observe((time.perf_counter() - t_step) * 1e3)
             step_i += 1
             it_count += 1
             if num_iters is not None and it_count >= num_iters:
@@ -305,18 +321,47 @@ class Model:
         Trailing partial windows and num_iters caps run the per-step
         program so step semantics are identical to the sequential
         loop."""
+        from .. import obs as _obs
         from ..io.dataloader import prefetch_to_device
         depth = int_env("PADDLE_TPU_PREFETCH_DEPTH", 2, minimum=1)
+        # per-window training telemetry (paddle_tpu.obs): prefetch-wait
+        # (the host starved waiting for the super-batch pipeline),
+        # dispatch (handing the window to the device), and the window's
+        # wall time — the measured step-phase times the MFU campaign
+        # pairs with tpucost's static model. The fetch span lives where
+        # the fetch does (hapi.lazy.LossWindow).
+        obs_on = _obs.enabled()
+        h_wait = _obs_hist("ptpu_train_prefetch_wait_ms",
+                           "host wait for the next super-batch") \
+            if obs_on else None
+        h_window = _obs_hist("ptpu_train_window_ms",
+                             "fused K-step window wall time") \
+            if obs_on else None
         logs = {}
         step_i = 0
-        for win in prefetch_to_device(loader, k, depth=depth):
+        win_iter = iter(prefetch_to_device(loader, k, depth=depth))
+        while True:
+            t_wait = time.perf_counter() if obs_on else 0.0
+            try:
+                win = next(win_iter)
+            except StopIteration:
+                break
+            if obs_on:
+                now = time.perf_counter()
+                h_wait.observe((now - t_wait) * 1e3)
+                _obs.record_span("train.prefetch_wait", t_wait, now,
+                                 cat="train")
+            t_win = time.perf_counter() if obs_on else 0.0
             remaining = None if num_iters is None else num_iters - it_count
             if win.full and (remaining is None or remaining >= k):
                 x, y = self._split_batch(win.data)
                 step = self._ensure_train_step(len(x))
 
                 def run_window(x=x, y=y):
-                    return LossWindow(step.scan_steps(k, *x, *y).value)
+                    with _obs.span("train.dispatch", cat="train",
+                                   k=k):
+                        return LossWindow(
+                            step.scan_steps(k, *x, *y).value)
 
                 if watchdog is not None:
                     # the K-step window is ONE dispatch: its deadline is
@@ -345,6 +390,8 @@ class Model:
                     None, cbs, watchdog, it_count, num_iters,
                     step_i=step_i, batches=tail)
                 logs = logs2 or logs
+            if obs_on:
+                h_window.observe((time.perf_counter() - t_win) * 1e3)
             if num_iters is not None and it_count >= num_iters:
                 break
         return logs, it_count

@@ -44,6 +44,8 @@ from __future__ import annotations
 
 import os
 import threading
+import time
+import uuid
 from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import List, Optional
@@ -54,6 +56,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .. import obs as _obs
 from ..distributed import resilience as _resil
 from ..jit.functional import functional_call, raw_state
 from ..models.generation import _select_token
@@ -96,13 +99,15 @@ class _Request:
     eos_token_id: Optional[int]
     seed: int
     future: Future = field(default_factory=Future)
+    rid: str = ""                # request id (obs span correlation)
+    t_submit: float = 0.0        # perf_counter at submit (obs only)
 
 
 class _Slot:
     """Host-side mirror of one decode slot's in-program state."""
 
     __slots__ = ("req", "pos", "tok", "alive", "remaining", "emitted",
-                 "key")
+                 "key", "t_dec0")
 
     def __init__(self):
         self.req: Optional[_Request] = None
@@ -112,6 +117,7 @@ class _Slot:
         self.remaining = 0
         self.emitted: List[int] = []
         self.key = np.zeros(2, np.uint32)
+        self.t_dec0 = 0.0        # decode-phase start (obs only)
 
     @property
     def free(self) -> bool:
@@ -197,6 +203,38 @@ class ContinuousBatchingEngine:
         self.admitted = 0
         self.completed = 0
 
+        # observability (paddle_tpu.obs): per-request phase spans into
+        # the flight recorder + registry series on /metrics. The flag
+        # is snapshotted ONCE so the disabled hot path is a single
+        # attribute test per site — no spans, no histogram touches, no
+        # allocations per tick (counter-asserted in tests/test_obs.py;
+        # tools/bench_obs_overhead.py pins the enabled cost <= 2%).
+        self._obs = _obs.enabled()
+        if self._obs:
+            reg = _obs.metrics.registry
+            self._m_ticks = reg.counter(
+                "ptpu_engine_ticks_total", "batched decode ticks")
+            self._m_admits = reg.counter(
+                "ptpu_engine_admits_total", "requests admitted to slots")
+            self._m_retires = reg.counter(
+                "ptpu_engine_retires_total", "requests retired")
+            self._m_occupancy = reg.histogram(
+                "ptpu_engine_batch_occupancy",
+                "live slots per decode tick",
+                buckets=(0, 1, 2, 4, 8, 16, 32, 64, 128))
+            self._m_queue_wait = reg.histogram(
+                "ptpu_engine_queue_wait_ms",
+                "submit -> admission start")
+            self._m_prefill = reg.histogram(
+                "ptpu_engine_prefill_ms",
+                "admission program incl. first-token sync")
+            self._m_decode = reg.histogram(
+                "ptpu_engine_decode_ms", "first token -> retirement")
+            self._m_ttft = reg.histogram(
+                "ptpu_engine_ttft_ms", "submit -> first token")
+            self._m_e2e = reg.histogram(
+                "ptpu_engine_e2e_ms", "submit -> retirement")
+
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="cb-engine")
         self._thread.start()
@@ -204,10 +242,13 @@ class ContinuousBatchingEngine:
     # -- public API ------------------------------------------------------
     def submit(self, input_ids, max_new_tokens: int = 32,
                eos_token_id: Optional[int] = None,
-               seed: int = 0) -> Future:
+               seed: int = 0, request_id: Optional[str] = None) -> Future:
         """Queue one request; returns a Future resolving to an int64
         [prompt_len + max_new_tokens] array, eos-padded after finish —
-        the same shape/padding contract as one row of generate()."""
+        the same shape/padding contract as one row of generate().
+        ``request_id`` correlates this request's obs spans (the serving
+        layer forwards the X-PTPU-Request-Id header here; absent, one
+        is minted when tracing is on)."""
         _resil.maybe_inject("serve_backend")   # dead-backend fault site
         prompt = np.asarray(input_ids).astype(np.int64).reshape(-1)
         P = prompt.shape[0]
@@ -229,6 +270,12 @@ class ContinuousBatchingEngine:
         req = _Request(prompt, int(max_new_tokens),
                        None if eos_token_id is None else int(eos_token_id),
                        int(seed))
+        if self._obs:
+            req.rid = (str(request_id) if request_id
+                       else uuid.uuid4().hex[:16])
+            req.t_submit = time.perf_counter()
+        elif request_id:
+            req.rid = str(request_id)
         with self._cv:
             if self._broken is not None:
                 raise RuntimeError("engine is broken") from self._broken
@@ -485,10 +532,11 @@ class ContinuousBatchingEngine:
         ids[0, :P] = req.prompt
         key = np.asarray(jax.random.PRNGKey(req.seed), np.uint32)
         prog = self._get_admit_prog(bucket)
+        t_adm = time.perf_counter() if self._obs else 0.0
         tok0_dev, self._caches = prog(
             self._params, self._buffers, ids, np.int32(P - 1), key,
             self._caches, np.int32(b))
-        tok0 = int(tok0_dev)
+        tok0 = int(tok0_dev)       # first-token host sync
         slot = self._slots[b]
         slot.req = req
         slot.pos = P
@@ -499,6 +547,28 @@ class ContinuousBatchingEngine:
         slot.alive = (req.eos_token_id is None
                       or tok0 != req.eos_token_id)
         self.admitted += 1
+        if self._obs:
+            # the request's contiguous phase timeline: queue-wait
+            # (submit -> admission), prefill (admission program + the
+            # first-token sync), then decode (below, -> retirement);
+            # their sum is the engine-side end-to-end latency
+            now = time.perf_counter()
+            slot.t_dec0 = now
+            self._m_admits.inc()
+            self._m_queue_wait.observe((t_adm - req.t_submit) * 1e3)
+            self._m_prefill.observe((now - t_adm) * 1e3)
+            self._m_ttft.observe((now - req.t_submit) * 1e3)
+            _obs.record_span("engine.queue_wait", req.t_submit, t_adm,
+                             cat="engine", request_id=req.rid)
+            # no separate TTFT span: its interval is exactly
+            # queue_wait + prefill (a viewer derives it; the
+            # histogram above carries the aggregate) — one less ring
+            # event per request keeps the postmortem window long
+            _obs.record_span("engine.prefill", t_adm, now, cat="engine",
+                             request_id=req.rid, bucket=bucket,
+                             prompt_len=P,
+                             ttft_ms=round((now - req.t_submit) * 1e3,
+                                           3))
         if slot.remaining <= 0 or not slot.alive:
             self._retire(b)
 
@@ -509,21 +579,31 @@ class ContinuousBatchingEngine:
         live = np.zeros(N, bool)
         eos = np.full(N, -1, np.int32)
         keys = np.zeros((N, 2), np.uint32)
+        n_live = 0
         for i, s in enumerate(self._slots):
             if s.free:
                 continue
             tok[i] = s.tok
             pos[i] = s.pos
-            live[i] = s.alive and s.remaining > 0
+            if s.alive and s.remaining > 0:
+                live[i] = True
+                n_live += 1
             if s.req.eos_token_id is not None:
                 eos[i] = s.req.eos_token_id
             keys[i] = s.key
         prog = self._get_decode_prog()
+        t_tick = time.perf_counter() if self._obs else 0.0
         toks_dev, self._caches = prog(self._params, self._buffers,
                                       self._caches, tok, pos, live, eos,
                                       keys)
         toks = np.asarray(toks_dev)       # the ONE host sync per tick
         self.ticks += 1
+        if self._obs:
+            now = time.perf_counter()
+            self._m_ticks.inc()
+            self._m_occupancy.observe(n_live)
+            _obs.record_span("engine.tick", t_tick, now, cat="engine",
+                             active=n_live, tick=self.ticks)
         for i, s in enumerate(self._slots):
             if s.free or not live[i]:
                 continue
@@ -551,6 +631,14 @@ class ContinuousBatchingEngine:
         slot = self._slots[b]
         req, slot.req = slot.req, None
         slot.alive = False
+        if self._obs:
+            now = time.perf_counter()
+            self._m_retires.inc()
+            self._m_decode.observe((now - slot.t_dec0) * 1e3)
+            self._m_e2e.observe((now - req.t_submit) * 1e3)
+            _obs.record_span("engine.decode", slot.t_dec0, now,
+                             cat="engine", request_id=req.rid,
+                             tokens=len(slot.emitted))
         out = list(slot.emitted)
         if len(out) < req.max_new_tokens:
             # finished early on eos: pad with eos — generate()'s contract

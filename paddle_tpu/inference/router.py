@@ -69,11 +69,14 @@ import threading
 import time
 import urllib.error
 import urllib.request
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
+from .. import obs as _obs
 from ..distributed import resilience as _resil
-from .serve import RETRY_AFTER_S, _env_float, send_json
+from .serve import (REQUEST_ID_HEADER, RETRY_AFTER_S, _env_float,
+                    handle_admin_trace, send_json, send_text)
 
 __all__ = ["ReplicaSpec", "Replica", "Router", "main",
            "single_device_child_env"]
@@ -222,6 +225,7 @@ class Replica:
         self.ejected_until = 0.0
         self.health: dict = {}
         self.spawned_at = time.monotonic()
+        self.last_health_at: Optional[float] = None  # last ANSWERED poll
 
     @property
     def base_url(self) -> Optional[str]:
@@ -248,13 +252,22 @@ class Replica:
 
     def snapshot(self) -> dict:
         eng = self.health.get("engine", {}) if self.health else {}
+        now = time.monotonic()
         return {"name": self.name, "state": self.state,
                 "pid": self.proc.pid, "port": self.port,
                 "draining": self.draining, "inflight": self.inflight,
                 "failure_streak": self.failure_streak,
                 "queued": int(eng.get("queued", 0)),
                 "active": int(eng.get("active", 0)),
-                "ejected": time.monotonic() < self.ejected_until}
+                "ejected": now < self.ejected_until,
+                # how old the queued/active numbers above are: None =
+                # never answered a poll; a large age means the stats
+                # are STALE (wedged/unreachable replica), not live
+                "last_scrape_age_s": (
+                    None if self.last_health_at is None
+                    else round(now - self.last_health_at, 2)),
+                "metrics_seq": int(self.health.get("metrics_seq", 0))
+                if self.health else 0}
 
 
 # internal retryable forward outcomes -------------------------------------
@@ -394,6 +407,34 @@ class Router:
             "respawns": 0, "ejections": 0, "rolling_restarts": 0,
             "scale_ups": 0, "scale_downs": 0, "spawn_failures": 0,
         }
+        # observability (paddle_tpu.obs): the stats above keep their
+        # dict face (/healthz, tests); the registry carries the
+        # exported view — per-replica forward latency (BOUNDED label
+        # set: replica names grow r1..rN over months of restarts, the
+        # histogram folds overflow into one _other series), retry and
+        # ejection counters, breaker state. /metrics additionally
+        # scrapes every replica and aggregates ptpu_tier_* series.
+        self._obs = _obs.enabled()
+        if self._obs:
+            reg = _obs.metrics.registry
+            self._m_forward = reg.histogram(
+                "ptpu_router_forward_ms",
+                "router->replica forward latency (successes)",
+                labels=("replica",), max_series=32)
+            self._m_forwards = reg.counter(
+                "ptpu_router_forwards_total", "forwarded requests")
+            self._m_retries = reg.counter(
+                "ptpu_router_retries_total",
+                "forward attempts retried on another replica")
+            self._m_ejections = reg.counter(
+                "ptpu_router_ejections_total",
+                "circuit-breaker ejections")
+            self._m_breaker = reg.gauge(
+                "ptpu_router_breaker_open",
+                "1 while the replica is breaker-ejected",
+                labels=("replica",), max_series=32)
+            self._m_ready = reg.gauge(
+                "ptpu_router_ready_replicas", "routable replicas")
 
         self.httpd = ThreadingHTTPServer((host, port),
                                          self._make_handler())
@@ -567,11 +608,19 @@ class Router:
         with self._lock:
             if rep in self._replicas:
                 self._replicas.remove(rep)
+        self._drop_replica_series(rep)
         for p in (rep.port_file,):
             try:
                 os.unlink(p)
             except OSError:
                 pass
+
+    def _drop_replica_series(self, rep: Replica):
+        """A retired/dead replica's breaker gauge must not export 1
+        forever (its name never comes back — respawns mint fresh ones)
+        nor hold a slot against the family's series cap."""
+        if self._obs:
+            self._m_breaker.remove(replica=rep.name)
 
     def _polled_inflight(self, rep: Replica) -> int:
         """One direct /healthz read of the replica's in-flight count
@@ -602,7 +651,12 @@ class Router:
                 body = json.loads(r.read())
             rep.health = body
             rep.health_fail_streak = 0
+            rep.last_health_at = time.monotonic()
             rep.state = "ready"
+            if self._obs:
+                self._m_breaker.set(
+                    1.0 if time.monotonic() < rep.ejected_until else 0.0,
+                    replica=rep.name)
         except urllib.error.HTTPError as e:
             try:
                 body = json.loads(e.read())
@@ -610,6 +664,7 @@ class Router:
                 body = {}
             rep.health = body
             rep.health_fail_streak = 0
+            rep.last_health_at = time.monotonic()  # answered, just 503
             status = body.get("status", "unready")
             rep.state = status if status in ("warming", "draining") \
                 else "unready"
@@ -647,11 +702,26 @@ class Router:
                     except OSError:
                         pass
                     dead.append(rep)
+            if dead and not self._stopping:
+                # postmortem: dump the flight recorder BEFORE the
+                # respawn path erases the scene — the artifact carries
+                # the ring (recent forwards, health polls) plus every
+                # span still open, i.e. the request ids in flight when
+                # the replica died. Best-effort: forensics must never
+                # take the tier down with it.
+                try:
+                    _obs.dump_flight(
+                        "replica_death",
+                        extra={"replicas": [r.name for r in dead],
+                               "pids": [r.proc.pid for r in dead]})
+                except Exception:   # noqa: BLE001
+                    pass
             for rep in dead:
                 with self._lock:
                     if rep in self._replicas:
                         self._replicas.remove(rep)
                     stopping = self._stopping
+                self._drop_replica_series(rep)
                 if stopping or not self.respawn:
                     continue
                 try:
@@ -660,6 +730,8 @@ class Router:
                 except Exception:
                     self.stats_counters["spawn_failures"] += 1
             if not self._stopping:
+                if self._obs:
+                    self._m_ready.set(self.ready_count())
                 self._autoscale()
                 self._trim_surplus()
 
@@ -795,23 +867,35 @@ class Router:
             rep.ejected_until = time.monotonic() + self.eject_s
             rep.failure_streak = 0
             self.stats_counters["ejections"] += 1
+            if self._obs:
+                self._m_ejections.inc()
+                self._m_breaker.set(1.0, replica=rep.name)
 
     def forward_generate(self, payload: bytes,
-                         deadline_s: Optional[float] = None):
+                         deadline_s: Optional[float] = None,
+                         request_id: Optional[str] = None):
         """Forward one /generate body. Returns ``(code, body_dict,
         retry_after_or_None)`` — every outcome is a clean JSON
-        response, never an exception to the HTTP handler."""
+        response, never an exception to the HTTP handler.
+        ``request_id`` rides the X-PTPU-Request-Id header on every
+        attempt, so the tier's spans (router forward) and the serving
+        replica's (engine queue-wait/prefill/decode) correlate under
+        one id."""
         deadline_s = (self.deadline_s if deadline_s is None
                       else float(deadline_s))
         t0 = time.monotonic()
         tried: set = set()
         self.stats_counters["forwards"] += 1
+        if self._obs:
+            self._m_forwards.inc()
         first_attempt = True
 
         def attempt():
             nonlocal first_attempt
             if not first_attempt:
                 self.stats_counters["retries"] += 1
+                if self._obs:
+                    self._m_retries.inc()
             first_attempt = False
             remaining = deadline_s - (time.monotonic() - t0)
             if remaining <= 0:
@@ -828,15 +912,25 @@ class Router:
             tried.add(rep.name)
             with self._lock:
                 rep.inflight += 1
+            fwd_token = (_obs.trace.begin_span(
+                "router.forward", cat="router", replica=rep.name,
+                request_id=request_id) if self._obs else None)
+            t_fwd = time.perf_counter()
             try:
                 _resil.maybe_inject("router_forward")
+                headers = {"Content-Type": "application/json"}
+                if request_id:
+                    headers[REQUEST_ID_HEADER] = request_id
                 req = urllib.request.Request(
-                    rep.base_url + "/generate", payload,
-                    {"Content-Type": "application/json"})
+                    rep.base_url + "/generate", payload, headers)
                 with urllib.request.urlopen(req,
                                             timeout=remaining) as r:
                     body = json.loads(r.read())
                 rep.failure_streak = 0
+                if self._obs:
+                    self._m_forward.observe(
+                        (time.perf_counter() - t_fwd) * 1e3,
+                        replica=rep.name)
                 body["served_by"] = rep.name
                 return 200, body, None
             except urllib.error.HTTPError as e:
@@ -868,6 +962,8 @@ class Router:
                 self._note_failure(rep)
                 raise _ForwardFailed(rep, str(e))
             finally:
+                if fwd_token is not None:
+                    _obs.trace.end_span(fwd_token)
                 with self._lock:
                     rep.inflight -= 1
 
@@ -911,6 +1007,7 @@ class Router:
         body = {"status": "ready" if ready else "unready",
                 "tier": True,
                 "uptime_s": round(time.monotonic() - self._started, 1),
+                "metrics_seq": _obs.metrics.registry.seq(),
                 "replicas_total": len(reps), "ready_replicas": ready,
                 "min_replicas": self.min_replicas,
                 "max_replicas": self.max_replicas,
@@ -928,6 +1025,38 @@ class Router:
         _, body = self._readiness()
         return body
 
+    def render_metrics(self) -> str:
+        """The tier /metrics body: the router's own registry, every
+        reachable replica's scrape re-labeled ``replica="rN"``, and
+        ``ptpu_tier_*`` aggregates summed across replicas (counters
+        and cumulative histogram buckets sum exactly — tier-level
+        phase percentiles come straight out of the summed buckets)."""
+        with self._lock:
+            reps = [(r.name, r.base_url) for r in self._replicas
+                    if r.base_url is not None and not r.draining]
+        # scrape CONCURRENTLY with one bounded join: tier scrape
+        # latency must not grow linearly with replica count, and one
+        # wedged replica (socket accepts, never answers) must cost the
+        # scrape its own 2s budget at most, not 2s x N serialized
+        scraped: Dict[str, str] = {}
+
+        def pull(name, base):
+            try:
+                with urllib.request.urlopen(base + "/metrics",
+                                            timeout=2.0) as r:
+                    scraped[name] = r.read().decode()
+            except _REPLICA_IO_ERRORS:
+                pass            # a dead replica just drops out
+        threads = [threading.Thread(target=pull, args=rb, daemon=True)
+                   for rb in reps]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 2.5
+        for t in threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+        return _obs.metrics.render_tier(
+            _obs.metrics.registry.render(), dict(scraped))
+
     # -- HTTP front ------------------------------------------------------
     def _make_handler(self):
         router = self
@@ -942,12 +1071,21 @@ class Router:
                 send_json(self, code, obj, retry_after=retry_after,
                           retry_after_table=TIER_RETRY_AFTER_S)
 
+            def _drain_body(self):
+                try:
+                    self.rfile.read(
+                        int(self.headers.get("Content-Length", "0")))
+                except (ValueError, OSError):
+                    pass
+
             def do_GET(self):
                 if self.path == "/health":
                     self._send(200, {"status": "ok"})
                 elif self.path == "/healthz":
                     ready, body = router._readiness()
                     self._send(200 if ready else 503, body)
+                elif self.path == "/metrics":
+                    send_text(self, 200, router.render_metrics())
                 elif self.path == "/metadata":
                     self._send(200, {"inputs": ["input_ids"],
                                      "outputs": ["tokens"]})
@@ -955,13 +1093,25 @@ class Router:
                     self._send(404, {"error": f"no route {self.path}"})
 
             def do_POST(self):
+                if self.path.startswith("/admin/trace"):
+                    handle_admin_trace(self, self._drain_body)
+                    return
                 try:
                     n = int(self.headers.get("Content-Length", "0"))
                     payload = self.rfile.read(n)
                 except (ValueError, OSError):
                     payload = b""
                 if self.path == "/generate":
-                    code, body, ra = router.forward_generate(payload)
+                    # the tier is where a request id is BORN (unless
+                    # the client brought one): it rides the header to
+                    # the replica and comes back in the body, so a
+                    # client can resolve its own phase spans later
+                    rid = self.headers.get(REQUEST_ID_HEADER) or (
+                        uuid.uuid4().hex[:16] if router._obs else None)
+                    code, body, ra = router.forward_generate(
+                        payload, request_id=rid)
+                    if rid and isinstance(body, dict):
+                        body.setdefault("request_id", rid)
                     self._send(code, body, retry_after=ra)
                 elif self.path == "/admin/rolling_restart":
                     # answer 409 from the HANDLER: Thread.start() never

@@ -12,7 +12,18 @@ Endpoints:
   GET  /healthz   -> readiness: 200 once the predictor can serve, 503
                      with a reason while degraded (failure streak,
                      saturated queue); with an engine attached the body
-                     carries slot occupancy + queue depth
+                     carries slot occupancy + queue depth; always
+                     carries uptime_s + metrics_seq (the obs registry's
+                     mutation sequence — stale stats are tellable from
+                     live ones)
+  GET  /metrics   -> Prometheus-style text from the obs registry
+                     (paddle_tpu.obs): engine tick/occupancy/phase
+                     histograms, host syncs, XLA compiles, ...
+  POST /admin/trace?duration_s=S[&profile=1]
+                  -> capture the obs flight recorder for S seconds
+                     (0 = snapshot the whole ring now) and return
+                     Chrome/Perfetto trace JSON; profile=1 also runs a
+                     programmatic jax.profiler capture over the window
   GET  /metadata  -> input/output names (+ dtypes/shapes once known)
   POST /predict   -> {"inputs": {name: nested-list | {"data": ...,
                       "dtype": "float32"}}} -> {"outputs": {name: ...}}
@@ -51,16 +62,23 @@ import json
 import os
 import threading
 import time
+import urllib.parse
+import uuid
 from concurrent.futures import ThreadPoolExecutor
 from concurrent.futures import TimeoutError as FutureTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from .. import obs as _obs
 from ..distributed import resilience as _resil
 from .predictor import Config, create_predictor
 
 __all__ = ["PredictorServer", "main"]
+
+#: request-id propagation header (router -> replica -> engine): one
+#: request's spans correlate across the whole tier under this id
+REQUEST_ID_HEADER = "X-PTPU-Request-Id"
 
 
 def _env_float(name: str, default: float) -> float:
@@ -110,6 +128,37 @@ def send_json(handler, code, obj, retry_after=None,
     handler.send_header("Content-Length", str(len(body)))
     handler.end_headers()
     handler.wfile.write(body)
+
+
+def send_text(handler, code, text,
+              content_type="text/plain; version=0.0.4; charset=utf-8"):
+    """Plain-text response writer (the /metrics exposition body — the
+    Prometheus text format's conventional content type). Shared with
+    the router tier front-end."""
+    body = text.encode()
+    handler.send_response(code)
+    handler.send_header("Content-Type", content_type)
+    handler.send_header("Content-Length", str(len(body)))
+    handler.end_headers()
+    handler.wfile.write(body)
+
+
+def handle_admin_trace(handler, drain_body_fn):
+    """POST /admin/trace?duration_s=S[&profile=1] — shared by the
+    replica server and the router front-end: capture the obs flight
+    recorder over the window and answer Chrome-trace JSON."""
+    drain_body_fn()
+    q = urllib.parse.parse_qs(
+        urllib.parse.urlsplit(handler.path).query)
+    try:
+        duration = float(q.get("duration_s", ["0"])[0])
+    except ValueError:
+        send_json(handler, 400, {"error": "bad duration_s"})
+        return
+    profile = q.get("profile", ["0"])[0] not in ("0", "", "false")
+    doc = _obs.trace.capture(min(max(duration, 0.0), 60.0),
+                             jax_profile=profile)
+    send_json(handler, 200, doc)
 
 
 class PredictorServer:
@@ -243,6 +292,11 @@ class PredictorServer:
         generate-queue depth so an autoscaler can see saturation."""
         body = {"status": "ready",
                 "uptime_s": round(time.monotonic() - self._started, 1),
+                # obs-registry mutation sequence: moves whenever any
+                # metric moves, so a scraper (the router's per-replica
+                # view) can tell live stats from a wedged process
+                # re-serving stale ones
+                "metrics_seq": _obs.metrics.registry.seq(),
                 "queue_depth": self._depth,
                 "inflight": self.inflight(),
                 "draining": self._draining,
@@ -359,6 +413,8 @@ class PredictorServer:
                               else RETRY_AFTER_S["unready"])
                     self._send(200 if ready else 503, body,
                                retry_after=ra)
+                elif self.path == "/metrics":
+                    send_text(self, 200, _obs.metrics.registry.render())
                 elif self.path == "/metadata":
                     self._send(200, server._metadata())
                 else:
@@ -385,6 +441,9 @@ class PredictorServer:
                     n = server.begin_drain()
                     self._send(200, {"status": "draining",
                                      "inflight": n})
+                    return
+                if self.path.startswith("/admin/trace"):
+                    handle_admin_trace(self, self._drain_body)
                     return
                 if self.path == "/generate":
                     self._do_generate()
@@ -526,6 +585,19 @@ class PredictorServer:
                         server._resp_inflight -= 1
 
             def _generate_admitted(self):
+                # request-id propagation: honor the router's header,
+                # mint one otherwise — every response can be resolved
+                # to its engine spans (queue-wait/prefill/decode)
+                rid = self.headers.get(REQUEST_ID_HEADER) or (
+                    uuid.uuid4().hex[:16] if _obs.enabled() else None)
+                # the handler-wall span: what the engine phases don't
+                # cover (json parse, future wait wakeup, response
+                # write) is visible as serve.generate minus their sum
+                with _obs.span("serve.generate", cat="serve",
+                               request_id=rid):
+                    self._generate_traced(rid)
+
+            def _generate_traced(self, rid):
                 from .engine import EngineOverloaded
                 try:
                     n = int(self.headers.get("Content-Length", "0"))
@@ -535,7 +607,8 @@ class PredictorServer:
                         ids,
                         int(payload.get("max_new_tokens", 32)),
                         payload.get("eos_token_id"),
-                        int(payload.get("seed", 0)))
+                        int(payload.get("seed", 0)),
+                        request_id=rid)
                 except EngineOverloaded as e:
                     # identical record shape to the predictor path's
                     # load shedding — orchestrators see ONE contract
@@ -572,9 +645,16 @@ class PredictorServer:
                     return
                 server._failure_streak = 0
                 prompt_len = len(np.asarray(ids).reshape(-1))
-                self._send(200, {"tokens": out.tolist(),
-                                 "prompt_len": prompt_len,
-                                 "new_tokens": len(out) - prompt_len})
+                # detokenize/respond phase: array -> JSON body (the
+                # closest thing this token server has to detokenizing)
+                with _obs.span("serve.detokenize", cat="serve",
+                               request_id=rid):
+                    body = {"tokens": out.tolist(),
+                            "prompt_len": prompt_len,
+                            "new_tokens": len(out) - prompt_len}
+                    if rid:
+                        body["request_id"] = rid
+                self._send(200, body)
 
         return Handler
 

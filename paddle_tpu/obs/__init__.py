@@ -1,0 +1,71 @@
+"""Unified observability: metrics registry, span tracer, flight recorder.
+
+The stack spans a fused training loop, a continuous-batching engine,
+and a multi-replica router; before this package their telemetry was
+fragmented one-off counters (framework/syncs.py, compilation/
+counters.py, the engine's private ints, the router's stats dict) and
+point-in-time ``/healthz`` snapshots. ``paddle_tpu.obs`` is the ONE
+measurement layer they all feed:
+
+* :mod:`.metrics` — process-wide registry of counters/gauges/
+  histograms (bounded label sets, lock-guarded, ~zero-cost when
+  untouched), exported as Prometheus-style text on ``/metrics``
+  (PredictorServer and the router; the router additionally scrapes and
+  aggregates replica metrics into ``ptpu_tier_*`` series).
+* :mod:`.trace` — request-scoped span tracer (request ids propagate
+  router -> replica -> engine via the ``X-PTPU-Request-Id`` header)
+  buffering into a fixed-size ring-buffer **flight recorder**, with
+  Chrome/Perfetto JSON export (``tools/trace_tool.py``), a
+  ``POST /admin/trace?duration_s=`` capture endpoint, and crash dumps
+  wired into ``StepWatchdog`` and the router's replica-death path.
+
+Env knobs (COMPONENTS.md "Observability" has the full table):
+  PADDLE_TPU_OBS        ambient instrumentation on/off (default on)
+  PADDLE_TPU_OBS_RING   flight-recorder capacity in events (4096)
+  PADDLE_TPU_OBS_DIR    artifact/trace directory (obs_artifacts)
+
+This package imports ONLY the stdlib (the analysis/chips.py rule):
+crash-path consumers (distributed/resilience.py keeps its stdlib-only
+module contract) and tools must be able to reach the recorder without
+pulling jax — so the env parsing below mirrors framework/env.py
+instead of importing it.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["enabled", "set_enabled", "metrics", "trace", "registry",
+           "recorder", "span", "record_span", "dump_flight"]
+
+_enabled_override = None     # set_enabled() tri-state; None -> env
+_enabled_env = None          # cached env read
+
+
+def enabled() -> bool:
+    """Is ambient instrumentation on? One env read
+    (``PADDLE_TPU_OBS``, default on — mirrors framework/env.bool_env's
+    truthiness rule), cached; ``set_enabled`` overrides for tests and
+    the overhead bench."""
+    global _enabled_env
+    if _enabled_override is not None:
+        return _enabled_override
+    if _enabled_env is None:
+        raw = os.environ.get("PADDLE_TPU_OBS")
+        _enabled_env = (True if raw is None else
+                        raw.strip().lower() not in ("0", "false", "off",
+                                                    ""))
+    return _enabled_env
+
+
+def set_enabled(on) -> None:
+    """Force instrumentation on/off (``None`` re-reads the env).
+    Affects gated sites built AFTER the call (the engine snapshots the
+    flag at construction)."""
+    global _enabled_override, _enabled_env
+    _enabled_override = None if on is None else bool(on)
+    _enabled_env = None
+
+
+from . import metrics, trace                              # noqa: E402
+from .metrics import registry                             # noqa: E402
+from .trace import dump_flight, record_span, recorder, span  # noqa: E402

@@ -10,8 +10,11 @@ states (python/paddle/profiler/profiler.py:344,79) and summary tables
 TPU-native: device-side tracing is the XLA/TPU profiler (jax.profiler →
 xplane, viewable in TensorBoard/XProf); host-side RecordEvent maps to
 jax.profiler.TraceAnnotation so host scopes land in the SAME xplane
-timeline. A lightweight host recorder additionally captures events for
-chrome-trace export and summary() without TensorBoard.
+timeline. Host events record into the obs flight recorder
+(paddle_tpu.obs — ONE event format shared with the serving/training
+spans, ONE Chrome-trace exporter); this package is the
+reference-parity face over it (MIGRATING.md "paddle.profiler /
+VisualDL telemetry -> the obs subsystem").
 """
 from .profiler import (Profiler, ProfilerState, ProfilerTarget,
                        RecordEvent, export_chrome_tracing, load_profiler_result,

@@ -1,4 +1,17 @@
-"""Profiler implementation (see package docstring for the reference map)."""
+"""Profiler implementation (see package docstring for the reference map).
+
+Re-seated on the obs subsystem (paddle_tpu.obs, ISSUE 8): RecordEvent
+scopes land in the SAME ring-buffer flight recorder as the engine's
+request spans and the training loop's window spans (cat="profiler"),
+and export goes through the ONE Chrome/Perfetto writer
+(obs.trace.export_chrome). This class remains the reference-parity
+FACE — scheduler states, on_trace_ready, summary tables — over that
+single event stream; a Profiler session is just a time window
+[start mark, now) onto the shared ring (so a profiled window also
+shows whatever the serving/training instrumentation recorded inside
+it). MIGRATING.md maps the paddle.profiler surface onto the obs
+primitives.
+"""
 from __future__ import annotations
 
 import contextlib
@@ -10,6 +23,8 @@ from enum import Enum
 from typing import Callable, Iterable, List, Optional
 
 import jax
+
+from ..obs import trace as _obs_trace
 
 __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
            "make_scheduler", "export_chrome_tracing",
@@ -31,34 +46,15 @@ class ProfilerTarget(Enum):
     TPU = 3
 
 
-class _HostRecorder:
-    """Host event sink (role of HostEventRecorder — a plain list suffices;
-    the reference needs lock-free buffers because it records per-op C++
-    events, while here per-op cost lives inside XLA programs)."""
-
-    def __init__(self):
-        self.events: List[dict] = []
-        self.enabled = False
-        self._lock = threading.Lock()
-
-    def add(self, name, start_ns, end_ns, tid):
-        if not self.enabled:
-            return
-        with self._lock:
-            self.events.append({"name": name, "ts": start_ns / 1e3,
-                                "dur": (end_ns - start_ns) / 1e3,
-                                "ph": "X", "pid": os.getpid(), "tid": tid})
-
-
-_recorder = _HostRecorder()
-
-
 class RecordEvent:
     """Host annotation scope.
 
     Parity: paddle.profiler.RecordEvent (event_tracing.h:43). Doubles as a
     jax.profiler.TraceAnnotation so the scope shows up inside the XLA
-    xplane trace too.
+    xplane trace too. The host side records straight into the obs
+    flight recorder (cat="profiler") — an explicit annotation is its
+    own opt-in, so it records even with ambient telemetry
+    (PADDLE_TPU_OBS) off.
     """
 
     def __init__(self, name: str, event_type=None):
@@ -67,7 +63,7 @@ class RecordEvent:
         self._start = None
 
     def begin(self):
-        self._start = time.perf_counter_ns()
+        self._start = time.perf_counter()
         self._ann = jax.profiler.TraceAnnotation(self.name)
         self._ann.__enter__()
 
@@ -76,8 +72,8 @@ class RecordEvent:
             self._ann.__exit__(None, None, None)
             self._ann = None
         if self._start is not None:
-            _recorder.add(self.name, self._start, time.perf_counter_ns(),
-                          threading.get_ident())
+            _obs_trace.record_span(self.name, self._start,
+                                   time.perf_counter(), cat="profiler")
             self._start = None
 
     def __enter__(self):
@@ -156,6 +152,10 @@ class Profiler:
         self._state = ProfilerState.CLOSED
         self._xplane_dir = None
         self._xprof_active = False
+        # the obs-ring window this session owns: [mark, end_mark] on
+        # the perf_counter clock; end_mark stays None while recording
+        self._mark = None
+        self._end_mark = None
         self._step_times: List[float] = []
         self._last_step_t = None
 
@@ -173,7 +173,6 @@ class Profiler:
             self._end_record()
             if self._on_trace_ready:
                 self._on_trace_ready(self)
-        _recorder.enabled = False
         self._state = ProfilerState.CLOSED
 
     def step(self, num_samples: Optional[int] = None):
@@ -207,8 +206,10 @@ class Profiler:
 
     # -- recording -------------------------------------------------------
     def _begin_record(self):
-        _recorder.events.clear()
-        _recorder.enabled = True
+        # a recording session is a WINDOW onto the always-on obs ring:
+        # mark its start; export/summary read events inside the window
+        self._mark = time.perf_counter()
+        self._end_mark = None
         if not self.timer_only:
             import tempfile
             self._xplane_dir = tempfile.mkdtemp(prefix="paddle_tpu_prof_")
@@ -226,17 +227,31 @@ class Profiler:
                 jax.profiler.stop_trace()
             except Exception:
                 pass
-        _recorder.enabled = False
         self._xprof_active = False
+        self._end_mark = time.perf_counter()
 
     # -- export ----------------------------------------------------------
+    def _window_events(self):
+        """Ring events inside THIS session's window. Both ends are
+        bounded: events recorded after stop() must not leak into
+        summary()/export() (the old recorder froze at stop), and a
+        never-started Profiler owns no window at all — not the whole
+        process ring."""
+        if self._mark is None:
+            return []
+        evs = _obs_trace.recorder.events(since_s=self._mark)
+        if self._end_mark is not None:
+            cutoff = self._end_mark * 1e6
+            evs = [e for e in evs if e["ts"] <= cutoff]
+        return evs
+
     def _export_chrome(self, path: str):
-        trace = {"traceEvents": list(_recorder.events),
-                 "metadata": {"xplane_dir": self._xplane_dir,
-                              "format": "paddle_tpu chrome trace"}}
-        with open(path, "w") as f:
-            json.dump(trace, f)
-        return path
+        # the ONE Chrome-trace writer (obs.trace) — the legacy format's
+        # traceEvents/metadata shape is exactly what it emits
+        return _obs_trace.export_chrome(
+            path, events=self._window_events(),
+            metadata={"xplane_dir": self._xplane_dir,
+                      "format": "paddle_tpu chrome trace (obs)"})
 
     def export(self, path: str, format: str = "json"):
         """Parity: Profiler.export — chrome trace json (the xplane protobuf
@@ -249,7 +264,7 @@ class Profiler:
         Device-side op breakdown lives in the xplane viewed via
         TensorBoard; host RecordEvent scopes are aggregated here."""
         agg = {}
-        for e in _recorder.events:
+        for e in self._window_events():
             a = agg.setdefault(e["name"], [0, 0.0])
             a[0] += 1
             a[1] += e["dur"] / 1e3  # ms

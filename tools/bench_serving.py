@@ -54,6 +54,40 @@ def _percentiles(ms):
             float(np.percentile(a, 99)))
 
 
+# request phases the obs engine histograms break a request into
+# (ISSUE 8): where did this request's latency go?
+_PHASES = ("queue_wait", "prefill", "decode", "ttft")
+
+
+def _phase_snaps():
+    """Snapshot the engine phase histograms (obs registry) so a later
+    delta covers exactly one measured epoch; {} when obs is off."""
+    from paddle_tpu import obs
+    if not obs.enabled():
+        return {}
+    out = {}
+    for ph in _PHASES:
+        h = obs.metrics.registry.get(f"ptpu_engine_{ph}_ms")
+        if h is not None:
+            out[ph] = (h, h.snap())
+    return out
+
+
+def _phase_percentiles(snaps):
+    """p50/p90/p99 per phase since the snapshot (bucket-interpolated,
+    obs.metrics.HistSnap)."""
+    out = {}
+    for ph, (h, before) in snaps.items():
+        d = h.snap().minus(before)
+        if d.count <= 0:
+            continue
+        out[ph] = {"p50_ms": round(d.percentile(0.50), 2),
+                   "p90_ms": round(d.percentile(0.90), 2),
+                   "p99_ms": round(d.percentile(0.99), 2),
+                   "count": d.count}
+    return out
+
+
 def bench_encoder(smoke: bool, iters: int):
     import paddle_tpu as paddle
     from paddle_tpu.inference import Config, create_predictor
@@ -214,8 +248,13 @@ def bench_concurrent(smoke: bool, clients: int, per_client: int,
     progs_after_warmup = engine.compiled_program_count
     run_sequential(reqs)
 
+    # obs phase histograms (paddle_tpu.obs): snapshot after the warm
+    # epoch so the reported percentiles cover EXACTLY the measured one
+    phase_snaps = _phase_snaps()
+
     # -- measured epoch 2
     wall_engine, lat_ms = run_engine(reqs)
+    phase_ms = _phase_percentiles(phase_snaps)
     wall_seq = run_sequential(reqs)
     engine_tps = total_new / wall_engine
     seq_tps = total_new / wall_seq
@@ -247,11 +286,44 @@ def bench_concurrent(smoke: bool, clients: int, per_client: int,
         "new_tokens_total": total_new,
         "slots": engine.slots, "tick_tokens": engine.tick_tokens,
         "cache_dtype": cache_dtype,
+        "phase_ms": phase_ms,
         "programs_recompiled_after_warmup": recompiled,
         "aligned_engine_tokens_per_s": round(a_total / a_wall_engine, 1),
         "aligned_sequential_tokens_per_s": round(a_total / a_wall_seq, 1),
         "aligned_speedup": round(a_wall_seq / a_wall_engine, 2),
     }
+
+
+def _scrape_tier_phases(router):
+    """One scrape of the router's aggregated /metrics (replica engine
+    histograms summed into ptpu_tier_* series) -> bucket-interpolated
+    p50/p90/p99 per request phase — where the tier's request time
+    went. Degrades to an {"error": ...} dict, never raises."""
+    import urllib.error
+    import urllib.request
+
+    from paddle_tpu import obs
+    out = {}
+    try:
+        with urllib.request.urlopen(
+                f"http://{router.host}:{router.port}/metrics",
+                timeout=10) as r:
+            samples = obs.metrics.parse_text(r.read().decode())
+        for ph in _PHASES:
+            edges, cum = obs.metrics.samples_to_hist(
+                samples, f"ptpu_tier_engine_{ph}_ms")
+            if cum and cum[-1] > 0:
+                out[ph] = {
+                    "p50_ms": round(obs.metrics.percentile_from_cum(
+                        edges, cum, 0.50), 2),
+                    "p90_ms": round(obs.metrics.percentile_from_cum(
+                        edges, cum, 0.90), 2),
+                    "p99_ms": round(obs.metrics.percentile_from_cum(
+                        edges, cum, 0.99), 2),
+                    "count": int(cum[-1])}
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+    return out
 
 
 def bench_tier(smoke: bool, clients: int, per_client: int):
@@ -391,9 +463,15 @@ def bench_tier(smoke: bool, clients: int, per_client: int):
         return {"rolling_ok": res["ok"],
                 "replaced": len(res["replaced"])}
 
-    phases = [run_phase("steady"),
-              run_phase("replica_kill", chaos=kill_one),
-              run_phase("rolling_restart", chaos=rolling)]
+    phases = [run_phase("steady")]
+    # tier-level phase percentiles: scrape the router's aggregated
+    # /metrics NOW, while the replicas that served the steady phase
+    # are still alive — replica histograms die with their process, so
+    # a post-chaos scrape would only see the freshly-rotated
+    # successors' (near-empty) series
+    tier_phase_ms = _scrape_tier_phases(router)
+    phases += [run_phase("replica_kill", chaos=kill_one),
+               run_phase("rolling_restart", chaos=rolling)]
     router.wait_ready(2, timeout=120)
     successor_compiles = []
     # skip replicas mid-drain (a trim/retire may still be finishing):
@@ -420,6 +498,7 @@ def bench_tier(smoke: bool, clients: int, per_client: int):
              and all(c == 0 for c in successor_compiles))
     return {
         "phases": phases,
+        "tier_phase_ms": tier_phase_ms,
         "p99_ms_worst_phase": round(all_lat_p99, 1),
         "error_rate_overall": round(
             sum(p["errors_503_retried"] for p in phases) / max(

@@ -63,7 +63,24 @@ QUICK_FILES = [
     # serving tier: health-aware routing, kill -9 recovery, store-warm
     # rolling restart (0-compile successors), truthful tier 503s
     "tests/test_router.py",
+    # observability: metrics registry semantics, request-id -> phase
+    # spans, flight-recorder crash dumps, tier metric aggregation
+    "tests/test_obs.py",
 ]
+
+
+def _run_obs_smoke(env) -> int:
+    """Obs smoke (ISSUE 8): tools/trace_tool.py --self-test drives a
+    LIVE tiny server — /metrics scraped twice and parsed (series must
+    be monotonic), /healthz freshness token, and POST /admin/trace
+    resolving a request id to its queue-wait/prefill/decode spans —
+    plus the span/ring/export and metrics render->parse round trips.
+    The quick-path guarantee that the telemetry surface stays up."""
+    print("\n=== obs smoke (metrics scrape + trace self-test) ===")
+    return subprocess.run(
+        [sys.executable, os.path.join("tools", "trace_tool.py"),
+         "--self-test"],
+        cwd=ROOT, env=env).returncode
 
 
 def _run_tpulint(env, update_baseline=False) -> int:
@@ -161,6 +178,10 @@ def main():
     ap.add_argument("--no-tpucost", action="store_true",
                     help="skip the tpucost gate that --quick/--full "
                          "append after the tests")
+    ap.add_argument("--no-obs-smoke", action="store_true",
+                    help="skip the obs /metrics + trace self-test "
+                         "smoke that --quick/--full append after the "
+                         "tests")
     ap.add_argument("-k", default=None)
     args = ap.parse_args()
     if args.full and args.quick:
@@ -253,6 +274,9 @@ def main():
     if (args.quick or args.full) and not args.no_tpucost:
         cost_rc = _run_tpucost(cache_env)
         rc = rc or cost_rc
+    if (args.quick or args.full) and not args.no_obs_smoke:
+        obs_rc = _run_obs_smoke(cache_env)
+        rc = rc or obs_rc
     return rc
 
 

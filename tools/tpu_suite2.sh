@@ -33,6 +33,17 @@ run bench_serving_concurrent bench_serving_concurrent.json \
 # cannot share one chip); self-skips once landed
 run bench_serving_tier bench_serving_tier.json \
     python tools/bench_serving.py --tier
+# obs decode-tick overhead gate (ISSUE 8): enabled-vs-disabled tick
+# time, paired-median on/off rounds; asserts the ratio <= 1.02 —
+# self-skips once landed like every other step
+run bench_obs_overhead bench_obs_overhead.json \
+    python tools/bench_obs_overhead.py
+# one captured tier trace (ISSUE 8): drives a tiny 2-replica tier and
+# uploads a merged Chrome/Perfetto trace — router forward spans + the
+# serving replicas' engine phase spans, correlated by request id
+# (replica children force cpu; safe next to the tunnel)
+run tier_trace tier_trace.json \
+    python tools/trace_tool.py --tier-capture "$R/tier_trace_full.json"
 run kv_quality kv_quality.json python tools/kv_cache_quality.py
 # fused K-step train loop vs per-step dispatch (PR 4): steps/s for
 # K in {4,16} scanned windows + the zero-mid-window-sync assertion;

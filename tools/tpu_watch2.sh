@@ -87,6 +87,8 @@ PY
         /root/repo/tpu_results/warmup.json \
         /root/repo/tpu_results/bench_cold_start.json \
         /root/repo/tpu_results/tpucost.json \
+        /root/repo/tpu_results/bench_obs_overhead.json \
+        /root/repo/tpu_results/tier_trace.json \
     )
     HAVE_RC=$?
     # landed is decided by the EXIT CODE (rc=0), never by empty stdout:
