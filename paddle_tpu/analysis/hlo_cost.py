@@ -55,7 +55,8 @@ from .findings import (COST_ANCHOR, COST_BUDGET, STALE_COST_PROGRAM,
 __all__ = [
     "ChipSpec", "CHIP_SPECS", "DEFAULT_CHIP", "HLO_DTYPE_BYTES",
     "parse_hlo_module", "program_cost", "collect_kernels", "KernelCost",
-    "analytic_decode_hbm_bytes", "check_cost_baseline",
+    "analytic_decode_hbm_bytes", "analytic_paged_decode_hbm_bytes",
+    "check_cost_baseline",
     "load_cost_baseline", "updated_cost_baseline",
 ]
 
@@ -593,6 +594,36 @@ def analytic_decode_hbm_bytes(geometry: dict) -> int:
                   + 7 * geometry["kv_cache_bytes"]))
 
 
+def analytic_paged_decode_hbm_bytes(geometry: dict) -> int:
+    """Analytic HBM bytes for one PAGED engine decode tick (ISSUE 9).
+
+    The paged tick swaps the dense slot rows for page pools plus a
+    per-micro-step GATHER into the [N, pages_per_slot * page] view
+    attention consumes, so the accounting splits in two:
+
+    - ``kv_cache_bytes`` (the POOL — what HBM actually stores) makes
+      FOUR passes: the one-hot page write's read + write and the
+      donated-carry copy's read + write. Pool bytes scale with LIVE
+      tokens admitted, not slots * max_len — at a pool sized below
+      slots * pages_per_slot this is where paging cuts tick traffic.
+    - ``kv_view_bytes`` (the gathered view, all layers, k + v) makes
+      THREE passes: the gather's write, the attention read, and the
+      gather's read side modeled at view size (the parser charges a
+      gather's operand at result scale).
+
+        tick_tokens * (param_bytes + 4*pool_bytes + 3*view_bytes)
+
+    The IDEAL regime fuses the gather into attention (1 view pass) and
+    writes pages in place (1 pool pass) — the same mega-kernelization
+    target the dense anchor documents. The anchor pins modeled <=
+    max_ratio of this bound so an extra full-view or full-pool pass
+    (a dropped fusion in the gather/write chain) fails CI."""
+    return int(geometry["tick_tokens"]
+               * (geometry["param_bytes"]
+                  + 4 * geometry["kv_cache_bytes"]
+                  + 3 * geometry["kv_view_bytes"]))
+
+
 # ---------------------------------------------------------------------------
 # baseline gate (tools/tpucost_baseline.json)
 # ---------------------------------------------------------------------------
@@ -751,6 +782,31 @@ def check_cost_baseline(inventories: Dict[str, dict],
                     "unfused activation traffic crept into the tick",
                     {"measured": inv["hbm_bytes"], "analytic": bound,
                      "ratio": round(ratio, 4)}))
+        elif kind == "decode_hbm_paged":
+            geom = geometries.get(name) or {}
+            try:
+                bound = analytic_paged_decode_hbm_bytes(geom)
+            except KeyError:
+                findings.append(Finding(
+                    COST_ANCHOR, Severity.ERROR, name,
+                    "decode_hbm_paged",
+                    "decode_hbm_paged anchor needs geometry metadata "
+                    "(param_bytes, kv_cache_bytes, kv_view_bytes, "
+                    "tick_tokens) on the registered site's "
+                    "BuildResult", {}))
+                continue
+            ratio = inv["hbm_bytes"] / bound if bound else float("inf")
+            if ratio > float(a.get("max_ratio", 1.15)):
+                findings.append(Finding(
+                    COST_ANCHOR, Severity.ERROR, name,
+                    "decode_hbm_paged",
+                    f"paged decode tick models {inv['hbm_bytes']} HBM "
+                    f"bytes = {ratio:.3f}x the analytic pool+view "
+                    f"bound {bound} (max {a.get('max_ratio', 1.15)}x) "
+                    "— an extra full-pool or full-view pass crept "
+                    "into the tick",
+                    {"measured": inv["hbm_bytes"], "analytic": bound,
+                     "ratio": round(ratio, 4)}))
         elif kind == "matmul_share_floor":
             floor = float(a.get("min_share", 0.0))
             if inv["matmul_flop_share"] < floor:
@@ -767,7 +823,8 @@ def check_cost_baseline(inventories: Dict[str, dict],
             findings.append(Finding(
                 COST_ANCHOR, Severity.ERROR, name, "unknown-kind",
                 f"anchor for {name!r} has unknown kind {kind!r} "
-                "(valid: decode_hbm, matmul_share_floor) — the "
+                "(valid: decode_hbm, decode_hbm_paged, "
+                "matmul_share_floor) — the "
                 "invariant was NOT evaluated; fix the baseline",
                 {"kind": kind}))
     return findings

@@ -67,6 +67,20 @@ def _tiny_engine():
                                     cache_dtype="float32", tick_tokens=4)
 
 
+def _tiny_paged_engine():
+    """Paged variant of the tiny engine, with a pool SMALLER than
+    slots * pages_per_slot (9 pages vs 16) — the fixture mirrors the
+    production claim that the pool, not the slot count, bounds cache
+    bytes, and the geometry below is what the tpucost
+    decode_hbm_paged anchor prices."""
+    from ..inference.engine import ContinuousBatchingEngine
+    model = _gpt_tiny_model()
+    return ContinuousBatchingEngine(model, slots=4, max_len=64,
+                                    cache_dtype="float32", tick_tokens=4,
+                                    paged=True, page_size=16,
+                                    num_pages=9)
+
+
 def build_gpt_decode() -> BuildResult:
     import jax
     eng = _tiny_engine()
@@ -93,6 +107,48 @@ def build_gpt_admit() -> BuildResult:
     args = eng._admit_example_args(bucket)
     geometry = {
         "kind": "prefill", "batch": 1, "seq": bucket,
+        "tokens_per_exec": bucket,
+        "param_bytes": _tree_nbytes((eng._params, eng._buffers)),
+        "kv_cache_bytes": _tree_nbytes(eng._caches),
+    }
+    return BuildResult(prog, args, cleanup=eng.stop, geometry=geometry)
+
+
+def build_gpt_decode_paged() -> BuildResult:
+    eng = _tiny_paged_engine()
+    prog = eng._get_decode_prog()
+    args = eng._decode_example_args()
+    # kv_cache_bytes is the page POOL (what HBM actually holds);
+    # kv_view_bytes is the gathered [N, pages_per_slot * page] view one
+    # micro-step materializes — the paged analytic anchor prices both
+    view_bytes = 0
+    for kc, vc in eng._caches:
+        for half in (kc, vc):
+            for leaf in half.values():
+                per_page = _tree_nbytes(leaf) // leaf.shape[0]
+                view_bytes += per_page * eng.pages_per_slot * eng.slots
+    geometry = {
+        "kind": "decode_paged", "slots": eng.slots,
+        "max_len": eng.max_len, "page_size": eng.page_size,
+        "num_pages": eng.num_pages,
+        "pages_per_slot": eng.pages_per_slot,
+        "tick_tokens": eng.tick_tokens,
+        "tokens_per_exec": eng.slots * eng.tick_tokens,
+        "param_bytes": _tree_nbytes((eng._params, eng._buffers)),
+        "kv_cache_bytes": _tree_nbytes(eng._caches),
+        "kv_view_bytes": view_bytes,
+    }
+    return BuildResult(prog, args, cleanup=eng.stop, geometry=geometry)
+
+
+def build_gpt_admit_paged() -> BuildResult:
+    eng = _tiny_paged_engine()
+    bucket = eng.prefill_buckets[0]
+    prog = eng._get_admit_prog(bucket)
+    args = eng._admit_example_args(bucket)
+    geometry = {
+        "kind": "prefill_paged", "batch": 1, "seq": bucket,
+        "page_size": eng.page_size, "num_pages": eng.num_pages,
         "tokens_per_exec": bucket,
         "param_bytes": _tree_nbytes((eng._params, eng._buffers)),
         "kv_cache_bytes": _tree_nbytes(eng._caches),
@@ -276,6 +332,14 @@ def ensure_registered() -> None:
     register("llama_decode", build_llama_decode,
              tags=("manifest", "serving"),
              description="generate() whole-decode scan (LLaMA-tiny)")
+    register("gpt_decode_paged", build_gpt_decode_paged,
+             tags=("manifest", "serving"),
+             description="paged-engine batched decode tick "
+                         "(gather-based block-table reads)")
+    register("gpt_admit_paged", build_gpt_admit_paged,
+             tags=("manifest", "serving"),
+             description="paged-engine suffix admission program "
+                         "(page-masked prefill append)")
     # only now: a failure above (e.g. a consumer squatting a canonical
     # name) must stay loud on every retry, not flip the flag and leave
     # the registry silently half-populated for the rest of the process

@@ -11,8 +11,8 @@ TRT subgraphs" collapse into XLA compilation at load (AOT — first run
 pays no trace). The Config/Predictor/Tensor-handle API surface matches the
 reference so serving code ports directly.
 """
-from .engine import (ContinuousBatchingEngine, EngineOverloaded,
-                     GenerationPredictor)
+from .engine import (CacheExhausted, ContinuousBatchingEngine,
+                     EngineOverloaded, GenerationPredictor)
 from .router import Replica, ReplicaSpec, Router
 from .predictor import (Config, DataType, PlaceType, PrecisionType,
                         Predictor, PredictorPool, Tensor,
@@ -25,7 +25,7 @@ from .predictor import (Config, DataType, PlaceType, PrecisionType,
 __all__ = ["Config", "Predictor", "create_predictor", "Tensor",
            "PlaceType", "DataType", "PrecisionType", "PredictorPool",
            "ContinuousBatchingEngine", "EngineOverloaded",
-           "GenerationPredictor",
+           "CacheExhausted", "GenerationPredictor",
            "Router", "ReplicaSpec", "Replica",
            "get_version", "get_num_bytes_of_data_type",
            "get_trt_compile_version", "get_trt_runtime_version",

@@ -35,10 +35,49 @@ Fusion-preserving, recompile-free regime per "Operator Fusion in XLA"
 and MPK (PAPERS.md): the decode step stays one fixed-shape compiled
 program; concurrency is multiplexed through it, never traced into it.
 
+Paged mode (``paged=True`` / PADDLE_TPU_SERVE_PAGED — ISSUE 9): the
+worst-case [N, max_len] slot rows above waste cache on the 99% of
+requests that are short — one long ``max_len`` caps concurrency for
+everyone, and tpucost's decode anchor shows the tick is KV-bandwidth
+bound, so every wasted byte is wasted HBM traffic too. Paged mode
+carves the cache into fixed ``page_size``-token PAGES shared by all
+slots (per-layer pools [num_pages, page_size, kv_heads, hd]); each slot
+holds a BLOCK TABLE of physical page indices:
+
+- the ONE batched decode program GATHERS each slot's pages by table
+  index into the contiguous view attention already understands (reads
+  stay gather-based — the scatter-free decode anchor holds) and writes
+  stay one-hot masked into the slot's current page, gated on the live
+  mask so a dead slot can never touch a page reallocated to another
+  request;
+- admission appends the VARIABLE-LENGTH prefill output page-by-page
+  (bucketed by suffix length, write-masked to the real rows) instead of
+  rebuilding a worst-case row — a request holds exactly
+  ceil((P + max_new + tick) / page_size) pages, so at equal cache bytes
+  the pool admits strictly more short requests than slot rows can;
+- a host-side page allocator (free list + refcounts, inference/paging)
+  lets concurrent requests SHARE the read-only pages of a common prompt
+  prefix: the prefix trie matches complete prompt pages at admission,
+  matched pages are increffed instead of recomputed (prefill work drops
+  to the un-matched suffix — for a fully-cached prompt, to ONE token),
+  and the only page a fully-matched prompt would write into is
+  copy-on-written first. Shared pages are read-only for life: complete
+  prompt pages end strictly below every decode write position.
+
+Why paged greedy output is token-identical to the slot engine: the
+gathered view has the same length the slot row had, the causal mask
+passes the same positions, and masked garbage (stale pages, bucket
+padding) contributes exact zeros through softmax(-1e30) — asserted in
+tests/test_paged_engine.py, including int8 pools and shared-prefix
+admissions.
+
 Env knobs: PADDLE_TPU_SERVE_SLOTS (default 8),
 PADDLE_TPU_SERVE_PREFILL_BUCKETS (comma list, default powers of two),
 PADDLE_TPU_SERVE_TICK_TOKENS (default 8),
-PADDLE_TPU_SERVE_MAX_QUEUE (default 32).
+PADDLE_TPU_SERVE_MAX_QUEUE (default 32),
+PADDLE_TPU_SERVE_PAGED (default 0), PADDLE_TPU_KV_PAGE (page size,
+default 16), PADDLE_TPU_SERVE_NUM_PAGES (default slots *
+ceil(max_len/page) — the slot engine's exact byte budget).
 """
 from __future__ import annotations
 
@@ -60,21 +99,67 @@ from .. import obs as _obs
 from ..distributed import resilience as _resil
 from ..jit.functional import functional_call, raw_state
 from ..models.generation import _select_token
+from .paging import pages_needed as _pages_needed
 
 __all__ = ["ContinuousBatchingEngine", "EngineOverloaded",
-           "GenerationPredictor", "create_engine_predictor"]
+           "CacheExhausted", "GenerationPredictor",
+           "create_engine_predictor"]
 
 
 class EngineOverloaded(RuntimeError):
     """Raised by submit() when the request queue is at capacity — the
     serving layer maps this to the 503 `overloaded` record (same
-    load-shedding contract as the PR-1 predictor path)."""
+    load-shedding contract as the PR-1 predictor path). ``reason`` is
+    the truthful shed record the serving layer forwards (a subclass
+    narrows it)."""
+
+    reason = "overloaded"
 
     def __init__(self, queue_depth: int, max_queue: int):
         super().__init__(
             f"engine queue saturated ({queue_depth}/{max_queue})")
         self.queue_depth = queue_depth
         self.max_queue = max_queue
+
+
+class CacheExhausted(EngineOverloaded):
+    """Queue saturated while the KV page pool — not slot count or
+    request rate — is the binding constraint (paged engines only). The
+    serving layer maps this to 503 `cache_exhausted` so operators can
+    tell "add cache pages / shrink page footprints" from plain
+    overload; retries clear when a request retires and frees pages."""
+
+    reason = "cache_exhausted"
+
+    def __init__(self, queue_depth: int, max_queue: int,
+                 free_pages: int, num_pages: int):
+        super().__init__(queue_depth, max_queue)
+        self.free_pages = free_pages
+        self.num_pages = num_pages
+
+
+def _attach_page_meta(caches, **meta):
+    """Return the cache pytree with block-table / write-gate metadata
+    merged into every paged dict (same traced arrays referenced
+    everywhere — XLA sees one value)."""
+    if isinstance(caches, dict):
+        return {**caches, **meta} if "pages" in caches else caches
+    if isinstance(caches, (list, tuple)):
+        return type(caches)(_attach_page_meta(c, **meta)
+                            for c in caches)
+    return caches
+
+
+def _strip_page_meta(caches):
+    """Inverse of _attach_page_meta: reduce paged dicts back to their
+    pool leaves so the engine-held pytree (and the donated program
+    output) is pools only."""
+    if isinstance(caches, dict):
+        return {k: v for k, v in caches.items()
+                if k in ("pages", "scale")}
+    if isinstance(caches, (list, tuple)):
+        return type(caches)(_strip_page_meta(c) for c in caches)
+    return caches
 
 
 # shared env-knob parser (framework/env.py), aliased to keep call sites
@@ -107,7 +192,7 @@ class _Slot:
     """Host-side mirror of one decode slot's in-program state."""
 
     __slots__ = ("req", "pos", "tok", "alive", "remaining", "emitted",
-                 "key", "t_dec0")
+                 "key", "t_dec0", "pages")
 
     def __init__(self):
         self.req: Optional[_Request] = None
@@ -118,6 +203,7 @@ class _Slot:
         self.emitted: List[int] = []
         self.key = np.zeros(2, np.uint32)
         self.t_dec0 = 0.0        # decode-phase start (obs only)
+        self.pages: List[int] = []   # paged mode: owned page refs
 
     @property
     def free(self) -> bool:
@@ -142,7 +228,11 @@ class ContinuousBatchingEngine:
                  tick_tokens: Optional[int] = None,
                  max_queue: Optional[int] = None,
                  do_sample: bool = False, temperature: float = 1.0,
-                 top_k: int = 0, top_p: float = 1.0):
+                 top_k: int = 0, top_p: float = 1.0,
+                 paged: Optional[bool] = None,
+                 page_size: Optional[int] = None,
+                 num_pages: Optional[int] = None,
+                 prefix_cache: bool = True):
         self.model = model
         self.slots = int(slots if slots is not None
                          else _env_int("PADDLE_TPU_SERVE_SLOTS", 8))
@@ -178,13 +268,52 @@ class ContinuousBatchingEngine:
         self._sampling = (bool(do_sample), float(temperature),
                           int(top_k), float(top_p))
 
+        # paged KV cache config (module docstring, ISSUE 9)
+        self.paged = bool(_env_int("PADDLE_TPU_SERVE_PAGED", 0)
+                          if paged is None else paged)
+        self.page_size = int(page_size if page_size is not None
+                             else _env_int("PADDLE_TPU_KV_PAGE", 16))
+        if self.page_size < 1:
+            raise ValueError("page_size must be >= 1")
+        # block-table width: enough logical pages to cover one
+        # max_len-token request — the per-REQUEST cap is unchanged,
+        # paging relaxes only the per-POOL sum
+        self.pages_per_slot = _pages_needed(self.max_len,
+                                            self.page_size)
+        if num_pages is None:
+            num_pages = _env_int("PADDLE_TPU_SERVE_NUM_PAGES", 0) or \
+                self.slots * self.pages_per_slot
+        self.num_pages = int(num_pages)
+        self.prefix_cache = bool(prefix_cache)
+        self._allocator = None
+        self._trie = None
+        self._pool_blocked = False    # last admission failed on pages
+        self.prefix_hits = 0          # admissions with >= 1 trie page
+        self.prefix_misses = 0
+        self.prefix_tokens_saved = 0  # prompt tokens NOT re-prefilled
+        self.prefill_tokens = 0       # suffix tokens actually prefilled
+
         was_training = model.training
         model.eval()
         self._params, self._buffers = raw_state(model)
         if was_training:
             model.train()
-        self._caches = model.new_cache(self.slots, self.max_len,
-                                       cache_dtype)
+        if self.paged:
+            if self.num_pages < self.pages_per_slot:
+                raise ValueError(
+                    f"num_pages {self.num_pages} cannot hold even one "
+                    f"max_len request ({self.pages_per_slot} pages)")
+            from .paging import PageAllocator, PrefixTrie
+            self._allocator = PageAllocator(self.num_pages)
+            self._trie = PrefixTrie(self._allocator)
+            self._caches = model.new_paged_cache(
+                self.num_pages, self.page_size, cache_dtype)
+            self._block_tables = np.zeros(
+                (self.slots, self.pages_per_slot), np.int32)
+        else:
+            self._caches = model.new_cache(self.slots, self.max_len,
+                                           cache_dtype)
+            self._block_tables = None
         self._slots = [_Slot() for _ in range(self.slots)]
         self._queue: List[_Request] = []
         self._cv = threading.Condition()
@@ -198,6 +327,7 @@ class ContinuousBatchingEngine:
         self._trace_count = 0
         self._admit_progs = {}        # bucket -> jitted admit program
         self._decode_prog = None
+        self._copy_prog = None        # paged: COW page-copy program
         self._warmed = False          # warmup() completed
         self.ticks = 0
         self.admitted = 0
@@ -234,6 +364,19 @@ class ContinuousBatchingEngine:
                 "ptpu_engine_ttft_ms", "submit -> first token")
             self._m_e2e = reg.histogram(
                 "ptpu_engine_e2e_ms", "submit -> retirement")
+            if self.paged:
+                self._g_pages_free = reg.gauge(
+                    "ptpu_engine_pages_free", "KV pool pages free")
+                self._g_pages_used = reg.gauge(
+                    "ptpu_engine_pages_used", "KV pool pages in use")
+                self._g_pages_free.set(self._allocator.free_pages)
+                self._g_pages_used.set(self._allocator.used_pages)
+                self._m_prefix_hits = reg.counter(
+                    "ptpu_engine_prefix_hits_total",
+                    "admissions reusing >=1 cached prefix page")
+                self._m_prefix_misses = reg.counter(
+                    "ptpu_engine_prefix_misses_total",
+                    "admissions with no cached prefix page")
 
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="cb-engine")
@@ -262,11 +405,18 @@ class ContinuousBatchingEngine:
             raise ValueError("max_new_tokens must be >= 1")
         # worst-case decode overshoot is one tick past the budget (a
         # row is only retired at a tick boundary)
-        if P + max_new_tokens + self.tick_tokens > self.max_len:
+        worst = P + max_new_tokens + self.tick_tokens
+        if worst > self.max_len:
             raise ValueError(
                 f"prompt ({P}) + max_new_tokens ({max_new_tokens}) + "
                 f"tick overshoot ({self.tick_tokens}) exceeds the "
                 f"engine cache length {self.max_len}")
+        # Paged engines need no extra static rejection here: worst <=
+        # max_len (above) bounds a request at pages_per_slot pages, and
+        # the constructor guarantees num_pages >= pages_per_slot — so
+        # any request passing the view-length check CAN fit once enough
+        # pages free up; transient shortage queues, and sheds as
+        # cache_exhausted below when the queue is also full.
         req = _Request(prompt, int(max_new_tokens),
                        None if eos_token_id is None else int(eos_token_id),
                        int(seed))
@@ -284,10 +434,36 @@ class ContinuousBatchingEngine:
                 # silently-enqueued request would hang its caller forever
                 raise RuntimeError("engine stopped")
             if len(self._queue) >= self.max_queue:
+                if self.paged and self._pool_is_binding():
+                    # the queue backed up because admission is waiting
+                    # on PAGES (a slot was free but the pool could not
+                    # cover the head request) — shed with the truthful
+                    # reason so operators size the pool, not the fleet
+                    raise CacheExhausted(
+                        len(self._queue), self.max_queue,
+                        self._allocator.free_pages, self.num_pages)
                 raise EngineOverloaded(len(self._queue), self.max_queue)
             self._queue.append(req)
             self._cv.notify()
         return req.future
+
+    def _pool_is_binding(self) -> bool:
+        """Is the page pool (not slots / request rate) what is blocking
+        the queue? True once an actual admission attempt failed on
+        pages, or — to close the window before the engine thread gets
+        to try — when a slot is free but the head request's worst-case
+        pages exceed everything the pool could produce (free pages plus
+        every trie-only page eviction could reclaim). Callers hold
+        self._cv."""
+        if self._pool_blocked:
+            return True
+        if not self._queue or not any(s.free for s in self._slots):
+            return False
+        head = self._queue[0]
+        need = _pages_needed(head.prompt.shape[0] + head.max_new_tokens
+                             + self.tick_tokens, self.page_size)
+        return need > (self._allocator.free_pages
+                       + self._trie.reclaimable())
 
     def generate(self, input_ids, max_new_tokens: int = 32,
                  eos_token_id: Optional[int] = None, seed: int = 0,
@@ -300,15 +476,35 @@ class ContinuousBatchingEngine:
         with self._cv:
             active = sum(1 for s in self._slots if not s.free)
             queued = len(self._queue)
-        return {"slots": self.slots, "active": active,
-                "free": self.slots - active, "queued": queued,
-                "max_queue": self.max_queue, "ticks": self.ticks,
-                "admitted": self.admitted, "completed": self.completed,
-                "compiled_programs": self.compiled_program_count,
-                "tick_tokens": self.tick_tokens,
-                "prefill_buckets": list(self.prefill_buckets),
-                "max_len": self.max_len,
-                "cache_dtype": self.cache_dtype}
+        out = {"slots": self.slots, "active": active,
+               "free": self.slots - active, "queued": queued,
+               "max_queue": self.max_queue, "ticks": self.ticks,
+               "admitted": self.admitted, "completed": self.completed,
+               "compiled_programs": self.compiled_program_count,
+               "tick_tokens": self.tick_tokens,
+               "prefill_buckets": list(self.prefill_buckets),
+               "max_len": self.max_len,
+               "cache_dtype": self.cache_dtype,
+               "paged": self.paged}
+        if self.paged:
+            free_p = self._allocator.free_pages
+            used_p = self._allocator.used_pages
+            lookups = self.prefix_hits + self.prefix_misses
+            out.update({
+                "page_size": self.page_size,
+                "pages_total": self.num_pages,
+                "pages_free": free_p,
+                "pages_used": used_p,
+                "pages_cached_prefix": self._trie.pages_cached,
+                "page_utilization": round(used_p / self.num_pages, 4),
+                "prefix_hits": self.prefix_hits,
+                "prefix_misses": self.prefix_misses,
+                "prefix_hit_rate": round(self.prefix_hits / lookups, 4)
+                if lookups else 0.0,
+                "prefix_tokens_saved": self.prefix_tokens_saved,
+                "prefill_tokens": self.prefill_tokens,
+            })
+        return out
 
     @property
     def compiled_program_count(self) -> int:
@@ -333,20 +529,38 @@ class ContinuousBatchingEngine:
         appear in an argument aval — part of the executable-store key
         (two engines over the same weights but different sampling
         config must not collide)."""
+        paged = ((self.page_size, self.num_pages, self.pages_per_slot)
+                 if self.paged else None)
         return repr((type(self.model).__name__, self._sampling,
-                     self.tick_tokens, self.max_len, self.cache_dtype))
+                     self.tick_tokens, self.max_len, self.cache_dtype,
+                     paged))
 
     def _decode_example_args(self) -> tuple:
         N = self.slots
+        if self.paged:
+            return (self._params, self._buffers, self._caches,
+                    np.zeros((N, self.pages_per_slot), np.int32),
+                    np.zeros(N, np.int32), np.zeros(N, np.int32),
+                    np.ones(N, bool), np.full(N, -1, np.int32),
+                    np.zeros((N, 2), np.uint32))
         return (self._params, self._buffers, self._caches,
                 np.zeros(N, np.int32), np.zeros(N, np.int32),
                 np.ones(N, bool), np.full(N, -1, np.int32),
                 np.zeros((N, 2), np.uint32))
 
     def _admit_example_args(self, bucket: int) -> tuple:
+        if self.paged:
+            return (self._params, self._buffers,
+                    np.zeros((1, bucket), np.int64), np.int32(0),
+                    np.int32(0), np.int32(bucket),
+                    np.zeros(2, np.uint32), self._caches,
+                    np.zeros((1, self.pages_per_slot), np.int32))
         return (self._params, self._buffers,
                 np.zeros((1, bucket), np.int64), np.int32(0),
                 np.zeros(2, np.uint32), self._caches, np.int32(0))
+
+    def _copy_example_args(self) -> tuple:
+        return (self._caches, np.int32(0), np.int32(0))
 
     def warmup(self, buckets: Optional[tuple] = None, store=None) -> list:
         """Compile-or-load THIS engine's programs ahead of traffic: the
@@ -380,6 +594,13 @@ class ContinuousBatchingEngine:
                 self._admit_example_args(bucket), store=store,
                 log_record=rec, static_key=static)
             recs.append(_clog.record(rec))
+        if self.paged and not isinstance(self._copy_prog, AotProgram):
+            rec = {"site": "engine_copy_page"}
+            self._copy_prog = aot_compile(
+                "engine_copy_page", self._get_copy_page_prog(),
+                self._copy_example_args(), store=store, log_record=rec,
+                static_key=static)
+            recs.append(_clog.record(rec))
         self._warmed = True
         return recs
 
@@ -408,6 +629,8 @@ class ContinuousBatchingEngine:
         prog = self._admit_progs.get(bucket)
         if prog is not None:
             return prog
+        if self.paged:
+            return self._get_paged_admit_prog(bucket)
         model, engine = self.model, self
         do_sample, temperature, top_k, top_p = self._sampling
 
@@ -444,9 +667,65 @@ class ContinuousBatchingEngine:
         self._admit_progs[bucket] = prog
         return prog
 
+    def _get_paged_admit_prog(self, bucket: int):
+        """ONE jitted program per suffix bucket: prefill the request's
+        un-cached suffix (tokens [M, M+wlen), right-padded to `bucket`)
+        straight INTO its block-table pages. The suffix attends over
+        the slot's gathered pages — shared prefix pages included, which
+        is exactly why matched prefixes never re-prefill — and the
+        write mask (wlen) keeps bucket padding out of the pool. M,
+        wlen, last_idx and the table are traced values: prompt-length
+        drift, prefix-hit depth and page placement never retrace."""
+        model, engine = self.model, self
+        do_sample, temperature, top_k, top_p = self._sampling
+
+        def admit(params, buffers, ids, last_idx, m_pos, wlen, key,
+                  caches, bt_row):
+            engine._trace_count += 1      # fires at trace time only
+            cm = _attach_page_meta(caches, bt=bt_row, wlen=wlen)
+            (logits, cm), _ = functional_call(
+                model, params, buffers, ids, cm, m_pos, training=False)
+            caches = _strip_page_meta(cm)
+            last = lax.dynamic_index_in_dim(logits, last_idx, axis=1,
+                                            keepdims=False)   # [1, V]
+            tok0 = _select_token(last, key, do_sample, temperature,
+                                 top_k, top_p)
+            return tok0[0].astype(jnp.int32), caches
+
+        prog = jax.jit(admit, donate_argnums=(7,))
+        self._admit_progs[bucket] = prog
+        return prog
+
+    def _get_copy_page_prog(self):
+        """Copy-on-write: duplicate one physical page (every layer's
+        k/v pool leaves, int8 scales included) into a freshly allocated
+        page — the only write path that may target content shared with
+        other requests, and it writes to the COPY. Gather + one-hot
+        select, scatter-free like everything else."""
+        if self._copy_prog is not None:
+            return self._copy_prog
+        engine = self
+
+        def copy_page(caches, src, dst):
+            engine._trace_count += 1      # fires at trace time only
+
+            def cp(leaf):
+                row = jnp.take(leaf, src[None], axis=0)   # [1, PS, ...]
+                hit = jnp.arange(leaf.shape[0]) == dst
+                return jnp.where(
+                    hit.reshape((-1,) + (1,) * (leaf.ndim - 1)),
+                    row, leaf)
+
+            return jax.tree_util.tree_map(cp, caches)
+
+        self._copy_prog = jax.jit(copy_page, donate_argnums=(0,))
+        return self._copy_prog
+
     def _get_decode_prog(self):
         if self._decode_prog is not None:
             return self._decode_prog
+        if self.paged:
+            return self._get_paged_decode_prog()
         model, engine = self.model, self
         do_sample, temperature, top_k, top_p = self._sampling
         T = self.tick_tokens
@@ -483,6 +762,53 @@ class ContinuousBatchingEngine:
         self._decode_prog = jax.jit(decode_tick, donate_argnums=(2,))
         return self._decode_prog
 
+    def _get_paged_decode_prog(self):
+        """The paged batched decode tick: identical token semantics to
+        the slot-cache tick (same scan, same masks, same sampling) —
+        the only difference is that each micro-step's cached_attention
+        GATHERS the slot's pages through the block table and one-hot
+        writes into the slot's current page, write-gated on the live
+        mask (a dead slot's table may point at pages since reallocated
+        to another request). Block tables ride as a [N, pages_per_slot]
+        int32 argument, so page placement drift never retraces."""
+        model, engine = self.model, self
+        do_sample, temperature, top_k, top_p = self._sampling
+        T = self.tick_tokens
+
+        def decode_tick(params, buffers, caches, bt, tok, pos, live,
+                        eos_ids, keys):
+            engine._trace_count += 1      # fires at trace time only
+
+            def body(carry, _):
+                tok, caches, pos, live = carry
+                cm = _attach_page_meta(caches, bt=bt, live=live)
+                (logits, cm), _ = functional_call(
+                    model, params, buffers, tok[:, None], cm, pos,
+                    training=False)
+                caches = _strip_page_meta(cm)
+                last = logits[:, -1, :]
+                if do_sample:
+                    subs = jax.vmap(jax.random.fold_in)(keys, pos)
+                    nxt = jax.vmap(
+                        lambda lg, k: _select_token(
+                            lg[None], k, True, temperature, top_k,
+                            top_p)[0])(last, subs)
+                else:
+                    nxt = jnp.argmax(last, axis=-1)
+                nxt = jnp.where(live, nxt.astype(jnp.int32),
+                                jnp.int32(0))
+                new_live = live & (nxt != eos_ids)
+                pos = pos + live.astype(jnp.int32)
+                tok = jnp.where(live, nxt, tok)
+                return (tok, caches, pos, new_live), nxt
+
+            (tok, caches, pos, live), toks = lax.scan(
+                body, (tok, caches, pos, live), None, length=T)
+            return toks.T, caches    # toks: [N, T]
+
+        self._decode_prog = jax.jit(decode_tick, donate_argnums=(2,))
+        return self._decode_prog
+
     # -- engine loop -----------------------------------------------------
     def _loop(self):
         while True:
@@ -496,6 +822,14 @@ class ContinuousBatchingEngine:
                 self._admit_ready()
                 if any(not s.free for s in self._slots):
                     self._tick_decode()
+                elif self._queue and self._pool_blocked:
+                    # nothing active to tick (and so nothing retiring
+                    # to free pages) while the head request waits on
+                    # the pool: only trie eviction can unblock, and
+                    # _admit_paged already tried it — yield briefly
+                    # instead of spinning the admission path hot
+                    with self._cv:
+                        self._cv.wait(timeout=0.05)
             except BaseException as e:   # noqa: BLE001 — fail loudly
                 with self._cv:
                     self._broken = e
@@ -523,20 +857,37 @@ class ContinuousBatchingEngine:
                 if slot_idx is None or not self._queue:
                     return
                 req = self._queue.pop(0)
-            self._admit(req, slot_idx)
+            if not self._admit(req, slot_idx):
+                # paged pool could not cover the head request right
+                # now: keep FIFO order (put it back at the front) and
+                # stop admitting — a retire or eviction re-opens the
+                # path; admitting AROUND the head would starve large
+                # requests forever under short-request pressure
+                with self._cv:
+                    self._queue.insert(0, req)
+                return
 
-    def _admit(self, req: _Request, b: int):
+    def _admit(self, req: _Request, b: int) -> bool:
+        """Admit one request into slot ``b``; False when the paged pool
+        cannot cover it right now (caller re-queues, nothing changed)."""
         P = req.prompt.shape[0]
-        bucket = self._bucket_for(P)
-        ids = np.zeros((1, bucket), np.int64)
-        ids[0, :P] = req.prompt
         key = np.asarray(jax.random.PRNGKey(req.seed), np.uint32)
-        prog = self._get_admit_prog(bucket)
         t_adm = time.perf_counter() if self._obs else 0.0
-        tok0_dev, self._caches = prog(
-            self._params, self._buffers, ids, np.int32(P - 1), key,
-            self._caches, np.int32(b))
-        tok0 = int(tok0_dev)       # first-token host sync
+        if self.paged:
+            res = self._admit_paged(req, b, key)
+            if res is None:
+                return False
+            tok0, bucket = res
+        else:
+            bucket = self._bucket_for(P)
+            ids = np.zeros((1, bucket), np.int64)
+            ids[0, :P] = req.prompt
+            prog = self._get_admit_prog(bucket)
+            tok0_dev, self._caches = prog(
+                self._params, self._buffers, ids, np.int32(P - 1), key,
+                self._caches, np.int32(b))
+            tok0 = int(tok0_dev)       # first-token host sync
+            self.prefill_tokens += P
         slot = self._slots[b]
         slot.req = req
         slot.pos = P
@@ -571,6 +922,83 @@ class ContinuousBatchingEngine:
                                            3))
         if slot.remaining <= 0 or not slot.alive:
             self._retire(b)
+        return True
+
+    def _admit_paged(self, req: _Request, b: int, key) -> Optional[tuple]:
+        """Paged admission: prefix-trie match, page allocation (with
+        LRU eviction under pressure), optional tail-page copy-on-write,
+        then ONE suffix-prefill program that writes the un-cached
+        tokens straight into the slot's pages. Returns (tok0, bucket)
+        or None when the pool cannot cover the request yet (pool state
+        is rolled back exactly)."""
+        prompt, ps = req.prompt, self.page_size
+        P = prompt.shape[0]
+        n_complete = P // ps          # prompt pages shareable read-only
+        page_keys = [tuple(int(t) for t in prompt[j * ps:(j + 1) * ps])
+                     for j in range(n_complete)]
+        matched = self._trie.match(page_keys) if self.prefix_cache \
+            else []
+        m = len(matched)
+        cow_src = None
+        if n_complete and m == n_complete and P % ps == 0:
+            # every prompt page is cached: skip prefill entirely except
+            # the LAST token (its logits seed decode) — copy-on-write
+            # the tail page so that one recompute-write (and nothing
+            # else, ever) lands in private memory
+            cow_src = matched[-1]
+            shared = matched[:-1]
+            M = P - 1
+        else:
+            shared = matched
+            M = m * ps
+        total = _pages_needed(P + req.max_new_tokens + self.tick_tokens,
+                              ps)
+        # incref BEFORE any eviction below so matched pages are pinned
+        self._allocator.incref(shared)
+        need_priv = total - len(shared)
+        priv = self._allocator.alloc(need_priv)
+        if priv is None:
+            self._trie.evict(need_priv - self._allocator.free_pages)
+            priv = self._allocator.alloc(need_priv)
+        if priv is None:
+            self._allocator.decref(shared)   # exact rollback
+            self._pool_blocked = True
+            return None
+        self._pool_blocked = False
+        pages = list(shared) + priv          # logical page j = pages[j]
+        bt_row = np.zeros(self.pages_per_slot, np.int32)
+        bt_row[:len(pages)] = pages
+        self._block_tables[b] = bt_row
+        if cow_src is not None:
+            self._caches = self._get_copy_page_prog()(
+                self._caches, np.int32(cow_src),
+                np.int32(pages[n_complete - 1]))
+        suffix = prompt[M:]
+        S = suffix.shape[0]
+        bucket = self._bucket_for(S)
+        ids = np.zeros((1, bucket), np.int64)
+        ids[0, :S] = suffix
+        prog = self._get_admit_prog(bucket)
+        tok0_dev, self._caches = prog(
+            self._params, self._buffers, ids, np.int32(S - 1),
+            np.int32(M), np.int32(S), key, self._caches, bt_row[None])
+        tok0 = int(tok0_dev)       # first-token host sync
+        self._slots[b].pages = pages
+        if self.prefix_cache:
+            # freshly computed complete pages become shareable; keys
+            # already cached are untouched (the COW copy never enters)
+            self._trie.insert(page_keys, pages[:n_complete])
+        if m:
+            self.prefix_hits += 1
+            self.prefix_tokens_saved += M
+        else:
+            self.prefix_misses += 1
+        self.prefill_tokens += S
+        if self._obs:
+            (self._m_prefix_hits if m else self._m_prefix_misses).inc()
+            self._g_pages_free.set(self._allocator.free_pages)
+            self._g_pages_used.set(self._allocator.used_pages)
+        return tok0, bucket
 
     def _tick_decode(self):
         N = self.slots
@@ -593,9 +1021,14 @@ class ContinuousBatchingEngine:
             keys[i] = s.key
         prog = self._get_decode_prog()
         t_tick = time.perf_counter() if self._obs else 0.0
-        toks_dev, self._caches = prog(self._params, self._buffers,
-                                      self._caches, tok, pos, live, eos,
-                                      keys)
+        if self.paged:
+            toks_dev, self._caches = prog(
+                self._params, self._buffers, self._caches,
+                self._block_tables, tok, pos, live, eos, keys)
+        else:
+            toks_dev, self._caches = prog(self._params, self._buffers,
+                                          self._caches, tok, pos, live,
+                                          eos, keys)
         toks = np.asarray(toks_dev)       # the ONE host sync per tick
         self.ticks += 1
         if self._obs:
@@ -631,6 +1064,19 @@ class ContinuousBatchingEngine:
         slot = self._slots[b]
         req, slot.req = slot.req, None
         slot.alive = False
+        if self.paged and slot.pages:
+            # drop this request's references; pages other requests (or
+            # the prefix trie) still hold survive, the rest free. The
+            # stale block-table row is harmless until reuse — dead
+            # slots are write-masked and their reads causally masked —
+            # but zero it anyway so state dumps read truthfully.
+            self._allocator.decref(slot.pages)
+            slot.pages = []
+            self._block_tables[b] = 0
+            self._pool_blocked = False    # freed pages: retry the head
+            if self._obs:
+                self._g_pages_free.set(self._allocator.free_pages)
+                self._g_pages_used.set(self._allocator.used_pages)
         if self._obs:
             now = time.perf_counter()
             self._m_retires.inc()

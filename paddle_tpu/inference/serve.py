@@ -95,6 +95,10 @@ def _env_float(name: str, default: float) -> float:
 # retryable-on-another-replica.
 RETRY_AFTER_S = {
     "overloaded": 1.0,
+    # paged engine's KV page pool is the binding constraint — clears
+    # when a request retires and frees its pages (slower than a bare
+    # slot freeing, the retiring request must finish decoding)
+    "cache_exhausted": 2.0,
     "warming_up": 5.0,
     "deadline_exceeded": 2.0,
     "backend_unavailable": 2.0,
@@ -315,6 +319,15 @@ class PredictorServer:
                                "max_queue", "ticks",
                                "compiled_programs")}
             body["engine"]["warm"] = getattr(self.engine, "warm", True)
+            if st.get("paged"):
+                # paged KV pool health: an autoscaler reads page
+                # pressure (pool near-full with slots free = grow
+                # cache, not replicas) and the prefix hit rate
+                body["engine"].update({
+                    k: st[k] for k in
+                    ("paged", "page_size", "pages_total", "pages_free",
+                     "pages_used", "page_utilization", "prefix_hits",
+                     "prefix_misses", "prefix_hit_rate")})
         if self._draining:
             # draining dominates every other state: in-flight requests
             # are finishing, nothing new may be routed here
@@ -611,9 +624,16 @@ class PredictorServer:
                         request_id=rid)
                 except EngineOverloaded as e:
                     # identical record shape to the predictor path's
-                    # load shedding — orchestrators see ONE contract
-                    self._send(503, {"error": "overloaded",
-                                     "queue_depth": e.queue_depth})
+                    # load shedding — orchestrators see ONE contract;
+                    # the reason is the engine's truthful verdict
+                    # ("cache_exhausted" when the paged KV pool, not
+                    # slot count, is what is binding)
+                    body = {"error": e.reason,
+                            "queue_depth": e.queue_depth}
+                    if getattr(e, "free_pages", None) is not None:
+                        body["free_pages"] = e.free_pages
+                        body["num_pages"] = e.num_pages
+                    self._send(503, body)
                     return
                 except (_resil.FaultInjected, ConnectionError) as e:
                     server._failure_streak += 1

@@ -31,7 +31,8 @@ from jax import lax
 from ..core.tensor import Tensor
 from ..jit.functional import functional_call, raw_state
 
-__all__ = ["generate", "new_kv_caches", "build_generate_programs"]
+__all__ = ["generate", "new_kv_caches", "new_paged_kv_caches",
+           "build_generate_programs"]
 
 
 def _prog_cache_size() -> int:
@@ -94,6 +95,32 @@ def new_kv_caches(num_layers, batch, max_len, kv_heads, head_dim, dtype,
         return (stack([one() for _ in range(num_layers)]),
                 stack([one() for _ in range(num_layers)]))
     return [(one(), one()) for _ in range(num_layers)]
+
+
+def new_paged_kv_caches(num_layers, num_pages, page_size, kv_heads,
+                        head_dim, dtype, scan_layers):
+    """Paged KV caches for the continuous-batching engine's paged mode:
+    per-layer (k_pool, v_pool) page pools (flash_attention.paged_kv_cache
+    dicts, dtype "int8" selects the quantized pool). A physical page id
+    means "that page in EVERY layer's pool" — one shared block table
+    indexes them all, so host-side page accounting stays per-request,
+    not per-layer. Block tables are per-request state the engine
+    attaches per program call; they are NOT part of this pytree."""
+    from ..nn.functional.flash_attention import paged_kv_cache
+    if scan_layers:
+        # ScannedStack.forward_cached slices every cache leaf along the
+        # layer axis inside its scan — the shared block table has no
+        # layer axis to slice. Unrolled stacks are the serving-engine
+        # default; reject loudly rather than mis-thread.
+        raise NotImplementedError(
+            "paged KV caches require an unrolled block stack "
+            "(cfg.scan_layers=False); the scanned stack's cache scan "
+            "cannot thread the shared block table")
+    return [(paged_kv_cache(num_pages, page_size, kv_heads, head_dim,
+                            dtype),
+             paged_kv_cache(num_pages, page_size, kv_heads, head_dim,
+                            dtype))
+            for _ in range(num_layers)]
 
 
 def _select_token(logits, key, do_sample, temperature, top_k, top_p):

@@ -340,6 +340,18 @@ class GPTForCausalLM(Layer):
         return _new_cache(cfg.num_layers, batch_size, max_len,
                           cfg.num_heads, hd, dtype, cfg.scan_layers)
 
+    def new_paged_cache(self, num_pages: int, page_size: int,
+                        dtype="bfloat16"):
+        """Per-layer (k, v) page POOLS for the paged serving engine —
+        [num_pages, page_size, nh, hd] each; block tables are engine
+        state, not part of this pytree."""
+        from .generation import new_paged_kv_caches
+        cfg = self.cfg
+        hd = cfg.hidden_size // cfg.num_heads
+        return new_paged_kv_caches(cfg.num_layers, num_pages, page_size,
+                                   cfg.num_heads, hd, dtype,
+                                   cfg.scan_layers)
+
     def generate(self, input_ids, max_new_tokens=32, **kw):
         from .generation import generate
         return generate(self, input_ids, max_new_tokens, **kw)

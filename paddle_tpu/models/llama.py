@@ -310,6 +310,17 @@ class LlamaForCausalLM(Layer):
         return new_kv_caches(cfg.num_layers, batch_size, max_len,
                              cfg.kv_heads, hd, dtype, cfg.scan_layers)
 
+    def new_paged_cache(self, num_pages: int, page_size: int,
+                        dtype="bfloat16"):
+        """Per-layer (k, v) page pools for the paged serving engine
+        (GQA: pools keep n_kv_heads; cached_attention broadcasts)."""
+        from .generation import new_paged_kv_caches
+        cfg = self.cfg
+        hd = cfg.hidden_size // cfg.num_heads
+        return new_paged_kv_caches(cfg.num_layers, num_pages, page_size,
+                                   cfg.kv_heads, hd, dtype,
+                                   cfg.scan_layers)
+
     def generate(self, input_ids, max_new_tokens=32, **kw):
         from .generation import generate
         return generate(self, input_ids, max_new_tokens, **kw)

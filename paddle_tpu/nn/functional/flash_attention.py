@@ -22,7 +22,8 @@ from ...autograd.tape import apply
 from ...core.tensor import Tensor
 
 __all__ = ["flash_attention", "scaled_dot_product_attention",
-           "flash_attn_unpadded", "sdp_kernel", "last_attention_dispatch"]
+           "flash_attn_unpadded", "sdp_kernel", "last_attention_dispatch",
+           "paged_kv_cache"]
 
 # most recent kernel-dispatch decision — observable, never silent
 # (VERDICT r2 weak #3). {"backend": "pallas"|"xla", "reason": str}
@@ -260,6 +261,130 @@ def _quant_rows(x):
     return q.astype(jnp.int8), scale
 
 
+# ---------------------------------------------------------------------------
+# paged KV cache (inference/engine.py paged=True; ISSUE 9)
+# ---------------------------------------------------------------------------
+#
+# A paged cache half is a dict pytree:
+#     {"pages": [num_pages, page_size, nkv, hd]  (bf16/f32, or int8 with
+#      "scale": [num_pages, page_size, nkv] f32 alongside),
+#      "bt":    [B, pages_per_seq] int32 block table — logical page j of
+#               row b lives at physical page bt[b, j]}
+# plus OPTIONAL write-gating metadata the caller attaches per program:
+#     "live": [B] bool  — rows allowed to write (batched decode: dead
+#             slots must never touch a page that may have been
+#             reallocated to another request),
+#     "wlen": scalar int32 — only the first wlen of the S incoming rows
+#             are written (bucketed admission: the right-padding garbage
+#             past the real suffix must not land in pages at all).
+#
+# Reads GATHER pages through the block table into the [B, L, nkv, hd]
+# contiguous view attention already understands (L = pages_per_seq *
+# page_size); writes are scatter-free: exclusive one-hot (page, offset)
+# masks + a writer-index gather, exactly the masked-select idiom the
+# tpulint scatter-free decode anchor pins.
+
+
+def paged_kv_cache(num_pages, page_size, kv_heads, head_dim,
+                   dtype="bfloat16"):
+    """Allocate one paged KV-cache half (the page POOL only — block
+    tables are per-request state the engine owns host-side and attaches
+    per program invocation)."""
+    if dtype == "int8":
+        return {"pages": jnp.zeros((num_pages, page_size, kv_heads,
+                                    head_dim), jnp.int8),
+                "scale": jnp.zeros((num_pages, page_size, kv_heads),
+                                   jnp.float32)}
+    return {"pages": jnp.zeros((num_pages, page_size, kv_heads,
+                                head_dim), dtype)}
+
+
+def _is_paged(cache) -> bool:
+    return isinstance(cache, dict) and "bt" in cache
+
+
+def _paged_cache_write(cache, rows, pos):
+    """Write [B, S, nkv, hd] rows into a paged cache at global positions
+    [pos, pos+S) (scalar pos) or per-row [pos[b], pos[b]+S) — each write
+    lands at (physical page bt[b, t//ps], offset t % ps).
+
+    Scatter-free: positions flatten to n = B*S candidate writes; page
+    and offset one-hots reduce (einsum — a matmul, not a scatter) to a
+    per-(page, offset) WRITER INDEX + write mask, the written values are
+    one gather of the incoming rows by that index, and the pool updates
+    through a dense select. Exclusivity holds by construction: every
+    valid write targets a distinct global position of a page the writing
+    row OWNS (shared prefix pages are read-only — the engine's
+    copy-on-write guarantees no admission or decode write ever lands in
+    one)."""
+    pages = cache["pages"]
+    bt = cache["bt"]
+    NP, PS = pages.shape[0], pages.shape[1]
+    B, S = rows.shape[0], rows.shape[1]
+    PM = bt.shape[1]
+
+    pos = jnp.asarray(pos, jnp.int32)
+    base = pos[:, None] if pos.ndim == 1 \
+        else jnp.broadcast_to(pos, (B,))[:, None]
+    t = base + jnp.arange(S, dtype=jnp.int32)[None, :]       # [B, S]
+    valid = t < PM * PS                   # never index past the table
+    if "live" in cache:
+        valid = valid & cache["live"][:, None]
+    if "wlen" in cache:
+        valid = valid & (jnp.arange(S, dtype=jnp.int32)[None, :]
+                         < cache["wlen"])
+
+    page_slot = jnp.clip(t // PS, 0, PM - 1)
+    phys = jnp.take_along_axis(bt, page_slot, axis=1)        # [B, S]
+    off = t % PS
+
+    n = B * S
+    phys_f = phys.reshape(n)
+    off_f = off.reshape(n)
+    valid_f = valid.reshape(n)
+    # [n, NP] / [n, PS] one-hots; int32 so the reductions below are
+    # exact index arithmetic (and lower to dots/reduces, never scatter)
+    hp = ((phys_f[:, None] == jnp.arange(NP)[None, :])
+          & valid_f[:, None]).astype(jnp.int32)
+    ho = (off_f[:, None] == jnp.arange(PS)[None, :]).astype(jnp.int32)
+    writer = jnp.einsum("np,no,n->po", hp, ho,
+                        jnp.arange(n, dtype=jnp.int32))      # [NP, PS]
+    mask = jnp.einsum("np,no->po", hp, ho) > 0               # [NP, PS]
+
+    if "scale" in cache:                   # int8 pool: quantize rows
+        qrows, scale = _quant_rows(rows)
+        vq = jnp.take(qrows.reshape((n,) + qrows.shape[2:]), writer,
+                      axis=0)              # [NP, PS, nkv, hd]
+        vs = jnp.take(scale.reshape((n,) + scale.shape[2:]), writer,
+                      axis=0)              # [NP, PS, nkv]
+        return {**cache,
+                "pages": jnp.where(mask[..., None, None], vq, pages),
+                "scale": jnp.where(mask[..., None], vs,
+                                   cache["scale"])}
+    vals = jnp.take(rows.astype(pages.dtype).reshape(
+        (n,) + rows.shape[2:]), writer, axis=0)
+    return {**cache, "pages": jnp.where(mask[..., None, None], vals,
+                                        pages)}
+
+
+def _paged_cache_read(cache):
+    """Gather a paged cache into the [B, L, nkv, hd] contiguous view
+    (L = pages_per_seq * page_size). Unallocated table entries gather
+    page 0 — whatever lives there is FINITE garbage the causal mask
+    zeroes exactly (softmax of -1e30 underflows to 0.0), so the view is
+    value-identical to the dense slot cache at every attended position.
+    int8 pools dequantize after the gather, like the dense int8 path."""
+    bt = cache["bt"]
+    B, PM = bt.shape
+    g = jnp.take(cache["pages"], bt, axis=0)     # [B, PM, PS, nkv, hd]
+    g = g.reshape((B, PM * g.shape[2]) + g.shape[3:])
+    if "scale" in cache:
+        s = jnp.take(cache["scale"], bt, axis=0)  # [B, PM, PS, nkv]
+        s = s.reshape((B, PM * s.shape[2]) + s.shape[3:])
+        return g.astype(jnp.float32) * s[..., None]
+    return g
+
+
 def _cache_write(cache, rows, pos):
     """Write [B, S, nkv, hd] rows into a cache at [pos, pos+S).
 
@@ -267,7 +392,12 @@ def _cache_write(cache, rows, pos):
     the single-stream generate() path) or a [B] vector of PER-ROW
     offsets (the continuous-batching engine: each slot is at its own
     decode position, so row b writes at pos[b]).
+
+    Paged caches (dict form with a block table, see paged_kv_cache)
+    dispatch to the page-indexed scatter-free write.
     """
+    if _is_paged(cache):
+        return _paged_cache_write(cache, rows, pos)
     per_row = getattr(pos, "ndim", 0) == 1
     if per_row and rows.shape[1] == 1:
         # decode hot path (S=1): one-hot masked write — a dense select
@@ -311,8 +441,11 @@ def _cache_write(cache, rows, pos):
 
 
 def _cache_read(cache):
-    """[B, L, nkv, hd] view of a cache: int8 dicts dequantize to f32;
-    array caches return UNCHANGED (their dtype drives the PV einsum)."""
+    """[B, L, nkv, hd] view of a cache: paged caches gather through
+    their block table; int8 dicts dequantize to f32; array caches
+    return UNCHANGED (their dtype drives the PV einsum)."""
+    if _is_paged(cache):
+        return _paged_cache_read(cache)
     if isinstance(cache, dict):
         return (cache["data"].astype(jnp.float32)
                 * cache["scale"][..., None])

@@ -1,0 +1,462 @@
+"""Paged KV cache + shared-prefix reuse (ISSUE 9).
+
+Host-side units (inference/paging.py — no jax, no model):
+- PageAllocator: all-or-nothing alloc, refcount sharing, exact free,
+  invariant survival under randomized admit/retire churn;
+- PrefixTrie: longest-chain match, first-writer-wins insert, LRU leaf
+  eviction that never touches a page a live slot references.
+
+Engine level (the serving guarantees):
+- greedy output TOKEN-IDENTICAL to the slot-cache engine's oracle
+  (sequential generate()) across staggered mixed-length traffic —
+  float32 AND int8 pools, shared-prefix admissions included;
+- prefix-cache hits SKIP prefill: a fully cached prompt re-prefills
+  exactly ONE token (copy-on-write tail page), a partial hit only its
+  un-cached suffix;
+- ZERO recompiles under (prompt-len, max-new, prefix-depth, page
+  placement) drift — the engine trace counters must not move;
+- no page leak: after every request retires, only trie-cached prefix
+  pages remain referenced, and evicting the trie empties the pool;
+- the queue sheds 503 `cache_exhausted` (typed + HTTP, Retry-After
+  carried) when the PAGE POOL, not slot count, is the binding
+  constraint.
+"""
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.engine import (CacheExhausted,
+                                         ContinuousBatchingEngine,
+                                         EngineOverloaded)
+from paddle_tpu.inference.paging import (PageAllocator, PrefixTrie,
+                                         pages_needed)
+from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+
+
+# ---------------------------------------------------------------------------
+# host-side units
+# ---------------------------------------------------------------------------
+
+def test_allocator_alloc_free_refcount():
+    a = PageAllocator(4)
+    got = a.alloc(3)
+    assert sorted(got) == [0, 1, 2] and a.free_pages == 1
+    assert a.alloc(2) is None        # all-or-nothing: pool untouched
+    assert a.free_pages == 1
+    a.incref([got[0]])               # shared with a second owner
+    assert a.decref([got[0]]) == 0   # still held
+    assert a.decref(got) == 3        # now everything frees
+    assert a.free_pages == 4
+    a.check()
+    with pytest.raises(AssertionError):
+        a.decref([0])                # double-free is loud
+
+
+def test_allocator_churn_no_leak():
+    rng = np.random.RandomState(0)
+    a = PageAllocator(16)
+    held = []
+    for _ in range(500):
+        if held and rng.rand() < 0.45:
+            a.decref(held.pop(rng.randint(len(held))))
+        else:
+            got = a.alloc(int(rng.randint(1, 5)))
+            if got is not None:
+                held.append(got)
+        a.check()
+    for pages in held:
+        a.decref(pages)
+    a.check()
+    assert a.free_pages == 16
+
+
+def test_trie_match_insert_evict():
+    a = PageAllocator(8)
+    t = PrefixTrie(a)
+    k1, k2 = tuple(range(4)), tuple(range(4, 8))
+    p = a.alloc(2)
+    t.insert([k1, k2], p)            # trie now co-owns both pages
+    assert t.match([k1, k2]) == p
+    assert t.match([k1, (9, 9, 9, 9)]) == p[:1]
+    assert t.match([(7, 7, 7, 7)]) == []
+    a.decref(p)                      # slot retires; trie refs remain
+    assert a.used_pages == 2
+    # eviction respects live references: pin the head page
+    a.incref([p[0]])
+    assert t.evict(2) == 1           # only the (leaf) tail page frees
+    assert a.refcount(p[0]) == 2 and a.free_pages == 7
+    a.decref([p[0]])
+    assert t.evict(1) == 1           # now the head drains too
+    assert a.free_pages == 8
+    a.check()
+
+
+def test_trie_first_writer_wins_and_lru_order():
+    a = PageAllocator(8)
+    t = PrefixTrie(a)
+    key = ((1, 2),)
+    pg1 = a.alloc(1)
+    t.insert([key[0]], pg1)
+    pg2 = a.alloc(1)
+    t.insert([key[0]], pg2)          # duplicate key: no-op
+    assert t.match([key[0]]) == pg1 and t.pages_cached == 1
+    a.decref(pg1), a.decref(pg2)
+    assert a.free_pages == 7         # pg2 freed, pg1 trie-held
+    # LRU: older unmatched chain evicts before the freshly matched one
+    other = a.alloc(1)
+    t.insert([(3, 4)], other)
+    a.decref(other)
+    t.match([key[0]])                # refresh pg1
+    assert t.evict(1) == 1
+    assert a.refcount(pg1[0]) == 1 and a.refcount(other[0]) == 0
+
+
+def test_pages_needed():
+    assert pages_needed(1, 16) == 1
+    assert pages_needed(16, 16) == 1
+    assert pages_needed(17, 16) == 2
+
+
+# ---------------------------------------------------------------------------
+# engine level
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(7)
+    m = GPTForCausalLM(gpt_tiny())
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def paged_engine(model):
+    eng = ContinuousBatchingEngine(
+        model, slots=4, max_len=64, cache_dtype="float32",
+        prefill_buckets=(8, 16), tick_tokens=4, paged=True,
+        page_size=8)
+    yield eng
+    eng.stop()
+
+
+def _prompt(seed, n):
+    return np.random.RandomState(seed).randint(
+        0, 250, (n,)).astype("int64")
+
+
+def test_paged_greedy_identity_staggered_mixed_lengths(model,
+                                                       paged_engine):
+    """Mixed-length staggered traffic through the paged engine is
+    token-identical to sequential generate() — the gathered page view,
+    live-masked page writes and suffix admission are pure cache
+    plumbing, never a numerics change."""
+    eng = paged_engine
+    shapes = [(5, 6), (8, 9), (12, 4), (3, 12), (16, 8)] * 2
+    prompts = [_prompt(i, p) for i, (p, _) in enumerate(shapes)]
+    futs = []
+    for (p, n), ids in zip(shapes, prompts):
+        futs.append(eng.submit(ids, max_new_tokens=n))
+        time.sleep(0.01)          # arrivals land across tick boundaries
+    outs = [f.result(timeout=300) for f in futs]
+    for (p, n), ids, got in zip(shapes, prompts, outs):
+        want = model.generate(ids[None], max_new_tokens=n,
+                              cache_dtype="float32")[0]
+        np.testing.assert_array_equal(got, want)
+    st = eng.stats()
+    assert st["paged"] and st["pages_used"] >= 0
+
+
+def test_paged_identity_with_eos(model, paged_engine):
+    ids = _prompt(0, 6)
+    first = model.generate(ids[None], max_new_tokens=1,
+                           cache_dtype="float32")[0, -1]
+    eos = int(first)
+    want = model.generate(ids[None], max_new_tokens=10,
+                          eos_token_id=eos, cache_dtype="float32")[0]
+    got = paged_engine.generate(ids, max_new_tokens=10,
+                                eos_token_id=eos, timeout=300)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_prefix_hit_skips_prefill_and_stays_identical(model,
+                                                      paged_engine):
+    """Shared-prefix admissions: a fully cached prompt re-prefills
+    exactly ONE token (COW tail page), a partial hit only its suffix —
+    and every output stays token-identical to the oracle."""
+    eng = paged_engine
+    # P=16 aligned to page_size=8: two complete, shareable pages
+    p16 = _prompt(50, 16)
+    before = eng.stats()
+    a = eng.generate(p16, max_new_tokens=6, timeout=300)
+    mid = eng.stats()
+    b = eng.generate(p16, max_new_tokens=6, timeout=300)
+    after = eng.stats()
+    want = model.generate(p16[None], max_new_tokens=6,
+                          cache_dtype="float32")[0]
+    np.testing.assert_array_equal(a, want)
+    np.testing.assert_array_equal(b, want)
+    # first admission prefilled the whole prompt, second only 1 token
+    assert mid["prefill_tokens"] - before["prefill_tokens"] == 16
+    assert after["prefill_tokens"] - mid["prefill_tokens"] == 1
+    assert after["prefix_hits"] == mid["prefix_hits"] + 1
+    assert after["prefix_tokens_saved"] - mid["prefix_tokens_saved"] \
+        == 15
+    # partial hit: shared 8-token head (one page), fresh tail
+    tail = np.concatenate([p16[:8], _prompt(51, 5)])
+    want_t = model.generate(tail[None], max_new_tokens=5,
+                            cache_dtype="float32")[0]
+    got_t = eng.generate(tail, max_new_tokens=5, timeout=300)
+    np.testing.assert_array_equal(got_t, want_t)
+    st = eng.stats()
+    assert st["prefix_hits"] == after["prefix_hits"] + 1
+    assert st["prefill_tokens"] - after["prefill_tokens"] == 5
+
+
+def test_paged_program_count_constant_under_drift(model, paged_engine):
+    """Prompt-length, max-new, prefix-depth AND page-placement drift
+    all ride the same compiled programs: the trace counters inside the
+    jitted bodies must not move after warmup."""
+    eng = paged_engine
+    for p in (4, 12):
+        eng.generate(_prompt(p, p), max_new_tokens=3, timeout=300)
+    warm = eng.compiled_program_count
+    pairs = [(p, n) for p in range(3, 12) for n in (2, 3)]
+    futs = [eng.submit(_prompt(i, p), max_new_tokens=n)
+            for i, (p, n) in enumerate(pairs)]
+    # plus prefix-hit and COW admissions (different code paths)
+    shared = _prompt(50, 16)
+    futs.append(eng.submit(shared, max_new_tokens=3))
+    futs.append(eng.submit(np.concatenate([shared[:8], _prompt(52, 3)]),
+                           max_new_tokens=3))
+    for f in futs:
+        f.result(timeout=300)
+    assert eng.compiled_program_count == warm, \
+        "paged engine recompiled under drift"
+
+
+def test_paged_int8_identity_and_slot_reuse(model):
+    """int8 page pools: identity vs sequential int8 generate, across
+    slot reuse (a retired request's pages, scales included, can never
+    leak — freshly admitted tokens overwrite before any masked read)."""
+    eng = ContinuousBatchingEngine(
+        model, slots=2, max_len=64, cache_dtype="int8",
+        prefill_buckets=(8, 16), tick_tokens=4, paged=True, page_size=8)
+    try:
+        for seed, (p, n) in enumerate([(12, 8), (5, 6), (16, 8),
+                                       (9, 10)]):
+            ids = _prompt(seed, p)
+            want = model.generate(ids[None], max_new_tokens=n,
+                                  cache_dtype="int8")[0]
+            got = eng.generate(ids, max_new_tokens=n, timeout=300)
+            np.testing.assert_array_equal(got, want)
+        # prefix reuse under int8 (quantized pages shared bit-exactly)
+        ids = _prompt(99, 16)
+        want = model.generate(ids[None], max_new_tokens=6,
+                              cache_dtype="int8")[0]
+        for _ in range(2):
+            got = eng.generate(ids, max_new_tokens=6, timeout=300)
+            np.testing.assert_array_equal(got, want)
+        assert eng.stats()["prefix_hits"] >= 1
+    finally:
+        eng.stop()
+
+
+def test_no_page_leak_after_retire_under_churn(model):
+    """Randomized admit/retire churn: once every request resolves, the
+    only referenced pages are the trie's cached prefixes, and draining
+    the trie returns the pool to fully free."""
+    eng = ContinuousBatchingEngine(
+        model, slots=3, max_len=64, cache_dtype="float32",
+        prefill_buckets=(8, 16), tick_tokens=4, paged=True, page_size=8,
+        max_queue=64)
+    rng = np.random.RandomState(3)
+    try:
+        shared = _prompt(77, 8)
+        futs = []
+        for i in range(16):
+            if rng.rand() < 0.4:    # prefix-sharing mix
+                ids = np.concatenate([shared,
+                                      _prompt(100 + i,
+                                              int(rng.randint(1, 6)))])
+            else:
+                ids = _prompt(200 + i, int(rng.randint(3, 17)))
+            futs.append(eng.submit(
+                ids, max_new_tokens=int(rng.randint(2, 8))))
+        for f in futs:
+            f.result(timeout=300)
+        # engine idle: only trie references remain
+        deadline = time.time() + 30
+        while eng.stats()["active"] and time.time() < deadline:
+            time.sleep(0.02)
+        st = eng.stats()
+        assert st["active"] == 0
+        assert st["pages_used"] == st["pages_cached_prefix"]
+        eng._allocator.check()
+        # drop the prefix cache: the pool must drain to fully free
+        eng._trie.evict_all()
+        assert eng._allocator.used_pages == 0
+        eng._allocator.check()
+    finally:
+        eng.stop()
+
+
+def test_submit_validation_paged(model):
+    eng = ContinuousBatchingEngine(
+        model, slots=2, max_len=32, cache_dtype="float32",
+        prefill_buckets=(8, 16), tick_tokens=4, paged=True, page_size=8,
+        num_pages=4)
+    try:
+        # the per-request view-length check rejects outright what could
+        # never fit (and, via the constructor's num_pages >=
+        # pages_per_slot invariant, anything passing it CAN fit once
+        # pages free up)
+        with pytest.raises(ValueError):
+            eng.submit(_prompt(0, 16), max_new_tokens=20)
+        with pytest.raises(ValueError):
+            eng.submit(_prompt(0, 16), max_new_tokens=16)
+        # a max-size request is statically admissible: it queues
+        fut = eng.submit(_prompt(0, 16), max_new_tokens=12)
+        fut.result(timeout=300)
+    finally:
+        eng.stop()
+
+
+def test_cache_exhausted_shed_typed_and_http(model):
+    """When the page pool (not slots) is what blocks admission, the
+    queue sheds CacheExhausted -> HTTP 503 `cache_exhausted` with
+    Retry-After; requests already queued still complete."""
+    from paddle_tpu.inference.serve import PredictorServer
+    eng = ContinuousBatchingEngine(
+        model, slots=4, max_len=32, cache_dtype="float32",
+        prefill_buckets=(8, 16), tick_tokens=4, paged=True, page_size=8,
+        num_pages=4, max_queue=2, prefix_cache=False)
+    srv = PredictorServer(engine=eng, port=0).start()
+    try:
+        # each request needs 3 of the 4 pages: one runs, rest queue
+        futs = [eng.submit(_prompt(i, 8), max_new_tokens=12)
+                for i in range(3)]
+        seen = None
+        for _ in range(500):
+            try:
+                futs.append(eng.submit(_prompt(9, 8),
+                                       max_new_tokens=12))
+                time.sleep(0.01)
+            except CacheExhausted as e:
+                seen = e
+                break
+            except EngineOverloaded:
+                time.sleep(0.01)
+        assert seen is not None, "pool-bound shed never surfaced"
+        assert seen.reason == "cache_exhausted"
+        assert seen.free_pages < 3 and seen.num_pages == 4
+        # HTTP face: same truthful reason + Retry-After header
+        url = f"http://{srv.host}:{srv.port}/generate"
+        data = json.dumps({"input_ids": _prompt(10, 8).tolist(),
+                           "max_new_tokens": 12}).encode()
+        code, body, headers = None, None, {}
+        for _ in range(500):
+            req = urllib.request.Request(
+                url, data=data,
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=60) as r:
+                    time.sleep(0.01)   # admitted: pressure not yet on
+            except urllib.error.HTTPError as e:
+                code, body = e.code, json.loads(e.read())
+                headers = dict(e.headers)
+                if body.get("error") == "cache_exhausted":
+                    break
+        assert code == 503 and body["error"] == "cache_exhausted", body
+        assert "Retry-After" in headers
+        assert body["retry_after_s"] > 0
+        assert body["free_pages"] < 3 and body["num_pages"] == 4
+        for f in futs:
+            f.result(timeout=300)
+    finally:
+        srv.stop()
+        eng.stop()
+
+
+def test_healthz_and_metrics_report_page_pool(model):
+    """/healthz page-pool fields + the obs registry gauges/counters —
+    ONE engine serves both faces (they are second views of the same
+    record sites, and each extra engine costs a cold compile set)."""
+    from paddle_tpu import obs
+    from paddle_tpu.inference.serve import PredictorServer
+    eng = ContinuousBatchingEngine(
+        model, slots=2, max_len=64, cache_dtype="float32",
+        prefill_buckets=(8, 16), tick_tokens=4, paged=True, page_size=8)
+    srv = PredictorServer(engine=eng, port=0).start()
+    try:
+        eng.generate(_prompt(1, 16), max_new_tokens=4, timeout=300)
+        eng.generate(_prompt(1, 16), max_new_tokens=4, timeout=300)
+        with urllib.request.urlopen(
+                f"http://{srv.host}:{srv.port}/healthz",
+                timeout=60) as r:
+            body = json.loads(r.read())
+        e = body["engine"]
+        assert e["paged"] is True
+        assert e["pages_total"] == eng.num_pages
+        assert e["pages_free"] + e["pages_used"] == e["pages_total"]
+        assert e["prefix_hits"] >= 1
+        assert 0.0 <= e["prefix_hit_rate"] <= 1.0
+        assert 0.0 <= e["page_utilization"] <= 1.0
+        if obs.enabled():
+            reg = obs.metrics.registry
+            free = reg.get("ptpu_engine_pages_free")
+            used = reg.get("ptpu_engine_pages_used")
+            hits = reg.get("ptpu_engine_prefix_hits_total")
+            misses = reg.get("ptpu_engine_prefix_misses_total")
+            assert free is not None and used is not None
+            assert free.value() + used.value() == eng.num_pages
+            assert hits is not None and hits.value() >= 1
+            assert misses is not None and misses.value() >= 1
+    finally:
+        srv.stop()
+        eng.stop()
+
+
+def test_llama_paged_identity_gqa():
+    """The paged cache works for any cache-threaded model: LLaMA-tiny
+    exercises GQA pools (nkv < nh broadcast at use) and RoPE per-row
+    offsets over the gathered page view."""
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    paddle.seed(11)
+    lm = LlamaForCausalLM(LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=176,
+        num_layers=2, num_heads=4, num_kv_heads=2, max_seq_len=128))
+    lm.eval()
+    eng = ContinuousBatchingEngine(
+        lm, slots=2, max_len=64, cache_dtype="float32",
+        prefill_buckets=(8, 16), tick_tokens=4, paged=True, page_size=8)
+    try:
+        for seed, (p, n) in enumerate([(9, 6), (16, 5)]):
+            ids = _prompt(seed, p)
+            want = lm.generate(ids[None], max_new_tokens=n,
+                               cache_dtype="float32")[0]
+            got = eng.generate(ids, max_new_tokens=n, timeout=300)
+            np.testing.assert_array_equal(got, want)
+        # prefix reuse across the GQA pool
+        ids = _prompt(42, 16)
+        want = lm.generate(ids[None], max_new_tokens=4,
+                           cache_dtype="float32")[0]
+        for _ in range(2):
+            np.testing.assert_array_equal(
+                eng.generate(ids, max_new_tokens=4, timeout=300), want)
+        assert eng.stats()["prefix_hits"] >= 1
+    finally:
+        eng.stop()
+
+
+def test_paged_rejects_scan_layers():
+    """The scanned stack cannot thread the shared block table — reject
+    loudly at cache construction, never mis-thread."""
+    paddle.seed(5)
+    m = GPTForCausalLM(gpt_tiny(scan_layers=True))
+    with pytest.raises(NotImplementedError):
+        m.new_paged_cache(8, 16, "float32")

@@ -52,6 +52,10 @@ QUICK_FILES = [
     # serving engine: continuous batching is a core-correctness surface
     # (greedy token-identity + the no-recompile guarantee)
     "tests/test_engine.py",
+    # paged KV cache + shared-prefix reuse (ISSUE 9): page allocator /
+    # prefix-trie units + paged-engine token-identity, prefix-skips-
+    # prefill, zero-recompile and cache_exhausted shed contract
+    "tests/test_paged_engine.py",
     # fused K-step train loop: scanned-vs-sequential bitwise identity +
     # the 2-programs-per-epoch trace-counter bound
     "tests/test_scan_train.py",

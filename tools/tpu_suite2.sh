@@ -33,6 +33,12 @@ run bench_serving_concurrent bench_serving_concurrent.json \
 # cannot share one chip); self-skips once landed
 run bench_serving_tier bench_serving_tier.json \
     python tools/bench_serving.py --tier
+# paged KV cache vs slot rows at equal cache bytes (ISSUE 9):
+# concurrency-at-fixed-memory (prefix-free + prefix-heavy bursts) +
+# prefix-hit admission latency; strictly-more-concurrency and
+# hit-cuts-admission are asserted in-tool; self-skips once landed
+run bench_serving_paged bench_serving_paged.json \
+    python tools/bench_serving.py --paged
 # obs decode-tick overhead gate (ISSUE 8): enabled-vs-disabled tick
 # time, paired-median on/off rounds; asserts the ratio <= 1.02 —
 # self-skips once landed like every other step
