@@ -25,10 +25,15 @@ from .recompute import recompute, recompute_sequential
 from .sequence_parallel import (ring_attention, shard_sequence,
                                 ulysses_attention)
 from .checkpoint import load_state_dict, save_state_dict, verify_checkpoint
-from .resilience import (FaultInjected, FaultInjector, NanInfStorm,
+from .resilience import (FaultInjected, FaultInjector, LossSpike,
+                         LossSpikeDetector, NanInfStorm,
                          RetryPolicy, StepTimeout, StepWatchdog,
                          restore_train_state, save_train_state,
                          with_retries)
+from .checkpoint import (gc_checkpoints, latest_checkpoint,
+                         list_checkpoints)
+from .supervisor import (REQUEUE_EXIT_CODE, SupervisorGaveUp,
+                         SupervisorResult, TrainSupervisor)
 from .store import TCPStore
 from .strategy import DistributedStrategy
 from .topology import (CommunicateTopology, HybridCommunicateGroup,
@@ -80,8 +85,12 @@ __all__ = [
     "MoELayer", "SwitchGate", "GShardGate", "NaiveGate",
     "recompute", "recompute_sequential",
     "save_state_dict", "load_state_dict", "verify_checkpoint", "TCPStore",
+    "list_checkpoints", "latest_checkpoint", "gc_checkpoints",
     "RetryPolicy", "with_retries", "StepWatchdog", "StepTimeout",
-    "NanInfStorm", "FaultInjector", "FaultInjected",
+    "NanInfStorm", "LossSpike", "LossSpikeDetector",
+    "FaultInjector", "FaultInjected",
     "save_train_state", "restore_train_state",
+    "TrainSupervisor", "SupervisorResult", "SupervisorGaveUp",
+    "REQUEUE_EXIT_CODE",
     "ring_attention", "ulysses_attention", "shard_sequence",
 ]

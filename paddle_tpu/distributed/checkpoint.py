@@ -13,14 +13,16 @@ from __future__ import annotations
 
 import os
 import shutil
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 import jax
 import numpy as np
 
 from . import resilience as _resil
 
-__all__ = ["save_state_dict", "load_state_dict", "verify_checkpoint"]
+__all__ = ["save_state_dict", "load_state_dict", "verify_checkpoint",
+           "list_checkpoints", "latest_checkpoint", "gc_checkpoints",
+           "CKPT_PREFIX"]
 
 # Commit marker written inside the checkpoint dir BEFORE the atomic
 # rename publishes it: a directory without the marker is by definition
@@ -175,6 +177,121 @@ def verify_checkpoint(path: str) -> None:
             f"checkpoint {path!r} has no commit marker "
             f"({_COMMIT_MARKER}) — it was killed mid-save or a shard "
             "was corrupted; refusing to restore from it")
+
+
+# ---------------------------------------------------------------------------
+# retention: enumerate / latest / GC over a directory of checkpoints
+# ---------------------------------------------------------------------------
+
+# The supervisor's periodic auto-checkpoints are ``<root>/ckpt-<step>``
+# directories published through the atomic path above. Everything below
+# only ever SEES committed entries: a ``.tmp`` mid-publish, a ``.old``
+# mid-rename, or a marker-less (killed/corrupt) directory is invisible
+# to enumeration and untouchable by GC.
+CKPT_PREFIX = "ckpt-"
+
+
+def list_checkpoints(root: str) -> List[Tuple[int, str]]:
+    """Committed ``ckpt-<step>`` entries under ``root`` as
+    ``[(step, abspath)]`` sorted ascending by step. Uncommitted
+    (mid-publish ``.tmp``/``.old``, marker-less after a kill or shard
+    corruption) and non-numeric entries are skipped — a caller can
+    restore from anything this returns."""
+    root = os.path.abspath(root)
+    out: List[Tuple[int, str]] = []
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return out
+    for name in names:
+        if not name.startswith(CKPT_PREFIX) or name.endswith(".tmp") \
+                or name.endswith(".old"):
+            continue
+        try:
+            step = int(name[len(CKPT_PREFIX):])
+        except ValueError:
+            continue
+        full = os.path.join(root, name)
+        if _committed(full):
+            out.append((step, full))
+    out.sort()
+    return out
+
+
+def latest_checkpoint(root: str) -> Optional[str]:
+    """Path of the newest committed checkpoint under ``root`` (highest
+    step), or None. First repairs any interrupted publish so a
+    committed-but-unpublished ``.tmp`` (killed between marker write and
+    rename) is found rather than lost — the flagless-auto-resume
+    entry point."""
+    root = os.path.abspath(root)
+    if _is_primary():
+        try:
+            for name in os.listdir(root):
+                if name.startswith(CKPT_PREFIX) and name.endswith(".tmp"):
+                    _finish_interrupted_publish(
+                        os.path.join(root, name[:-len(".tmp")]))
+                elif name.startswith(CKPT_PREFIX) and name.endswith(".old"):
+                    _finish_interrupted_publish(
+                        os.path.join(root, name[:-len(".old")]))
+        except OSError:
+            pass
+    ckpts = list_checkpoints(root)
+    return ckpts[-1][1] if ckpts else None
+
+
+def gc_checkpoints(root: str, max_to_keep: int,
+                   keep: Iterable[str] = ()) -> List[str]:
+    """Retention GC: delete committed checkpoints beyond the newest
+    ``max_to_keep``, never touching paths named in ``keep`` (the
+    supervisor passes its last-good and keep-best entries) and never
+    the newest committed one (``max_to_keep`` is clamped to >= 1 — GC
+    must not leave a directory with nothing restorable). Uncommitted
+    entries — including a ``.tmp`` mid-publish — are invisible here:
+    they neither count toward the quota nor get deleted.
+
+    Crash-safe: each victim loses its commit marker FIRST (one atomic
+    unlink flips it to "uncommitted", out of every enumeration), then
+    the tree is removed — a kill mid-GC strands marker-less garbage a
+    later GC pass sweeps, never a half-deleted directory that still
+    looks restorable. Returns the deleted paths.
+
+    Fault site ``ckpt_gc`` fires BEFORE anything is deleted: injected
+    GC failure proves retention is best-effort to its callers.
+    """
+    _resil.maybe_inject("ckpt_gc")
+    max_to_keep = max(1, int(max_to_keep))
+    protected = {os.path.abspath(p) for p in keep}
+    ckpts = list_checkpoints(root)
+    deleted: List[str] = []
+    for _step, path in ckpts[:-max_to_keep]:
+        if os.path.abspath(path) in protected:
+            continue
+        try:
+            os.remove(os.path.join(path, _COMMIT_MARKER))
+        except OSError:
+            continue            # racing saver/GC: leave it alone
+        shutil.rmtree(path, ignore_errors=True)
+        deleted.append(path)
+    # sweep marker-less strays a previous killed GC left behind (only
+    # ckpt-<int> shaped names: a foreign dir in root is not ours to rm)
+    try:
+        for name in os.listdir(root):
+            if not name.startswith(CKPT_PREFIX) or name.endswith(".tmp") \
+                    or name.endswith(".old"):
+                continue
+            try:
+                int(name[len(CKPT_PREFIX):])
+            except ValueError:
+                continue
+            full = os.path.join(root, name)
+            if os.path.isdir(full) and not _committed(full) \
+                    and not os.path.isdir(full + ".tmp"):
+                shutil.rmtree(full, ignore_errors=True)
+                deleted.append(full)
+    except OSError:
+        pass
+    return deleted
 
 
 def load_state_dict(path: str,

@@ -45,6 +45,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 __all__ = [
     "RetryPolicy", "with_retries",
     "StepWatchdog", "StepTimeout", "NanInfStorm",
+    "LossSpike", "LossSpikeDetector",
     "FaultInjector", "FaultInjected", "maybe_inject", "should_fire",
     "wedge_seconds",
     "CheckpointCorrupt",
@@ -71,6 +72,14 @@ class NanInfStorm(FloatingPointError, ResilienceError):
     """N consecutive steps produced a non-finite loss — the run has
     diverged; continuing only burns accelerator time (reference:
     FLAGS_check_nan_inf abort semantics, nan_inf_utils_detail.cc)."""
+
+
+class LossSpike(ResilienceError):
+    """The step loss jumped far outside its recent window (z-score
+    over the last W finite losses) — the run is diverging on FINITE
+    values a NaN scan can never see (poison batch, optimizer blow-up).
+    The supervisor treats it exactly like a NaN storm: roll back to
+    the last good checkpoint and escalate."""
 
 
 class CheckpointCorrupt(ResilienceError):
@@ -257,11 +266,24 @@ def with_retries(fn: Callable, *args,
 #                       the tier control loop retries on its next pass)
 #   replica_health      a replica health poll fails (raises; counts
 #                       toward the router's unhealthy streak)
+#   train_step_nan      hapi Model.train_batch reports a NaN loss for
+#                       one step (the real program still ran — a
+#                       transient divergence the supervisor's rollback
+#                       must survive; N firings under nan_limit=N make
+#                       a full storm)
+#   preempt_signal      the TrainSupervisor observes a synthetic
+#                       SIGTERM at the next step boundary (preemption
+#                       grace path without a real signal — drivable
+#                       from env in subprocess children)
+#   ckpt_gc             checkpoint retention GC fails before deleting
+#                       anything (distributed/checkpoint.gc_checkpoints
+#                       — GC failure must never take training down)
 _KNOWN_SITES = frozenset([
     "collective", "host_drop", "ckpt_shard", "ckpt_crash",
     "dataloader_worker", "step_hang", "step_nan", "train_crash",
     "serve_backend", "serve_hang",
     "router_forward", "replica_spawn", "replica_health",
+    "train_step_nan", "preempt_signal", "ckpt_gc",
 ])
 
 _inject_lock = threading.Lock()
@@ -600,6 +622,73 @@ class StepWatchdog:
                 and not self._dead:
             self._work.put(None)
         self._worker = None
+
+
+# ---------------------------------------------------------------------------
+# LossSpikeDetector — windowed z-score divergence scan (beside the NaN scan)
+# ---------------------------------------------------------------------------
+
+class LossSpikeDetector:
+    """Detect finite-loss divergence the NaN scan cannot: a loss that
+    jumps ``z`` standard deviations above the mean of the last
+    ``window`` finite losses raises :class:`LossSpike`.
+
+    The scan is one-sided (a loss *collapsing* is not an incident),
+    needs ``min_points`` history before it can fire (cold-start losses
+    swing legitimately), and never admits the spiking value into its
+    window — a poison batch must not teach the detector that poison is
+    normal. Non-finite losses are ignored entirely: the NaN-storm scan
+    (:class:`StepWatchdog`) owns those.
+
+    The deviation scale is ``max(std, rel_floor * |mean|)``: on a
+    converged plateau (or a window holding rollback-replay duplicates)
+    the raw std collapses toward zero and ordinary batch-to-batch
+    wobble would z-score as a spike — the relative floor means a real
+    incident must ALSO clear ``z * rel_floor`` of the mean (the
+    divergences this exists for are orders of magnitude, not percent).
+    ``abs_floor`` additionally requires the jump to exceed a fixed
+    value in absolute terms.
+    """
+
+    def __init__(self, window: int = 32, z: float = 8.0,
+                 min_points: int = 8, abs_floor: float = 0.0,
+                 rel_floor: float = 0.1):
+        if window < 2:
+            raise ValueError("window must be >= 2")
+        self.window = int(window)
+        self.z = float(z)
+        self.min_points = max(2, int(min_points))
+        self.abs_floor = float(abs_floor)
+        self.rel_floor = float(rel_floor)
+        self._values: list = []
+
+    def observe(self, loss) -> None:
+        """Feed one step loss; raises :class:`LossSpike` on divergence."""
+        try:
+            v = float(loss)
+        except (TypeError, ValueError):
+            return
+        if not math.isfinite(v):
+            return                       # the NaN-storm scan owns these
+        vals = self._values
+        if len(vals) >= self.min_points:
+            mean = sum(vals) / len(vals)
+            var = sum((x - mean) ** 2 for x in vals) / len(vals)
+            std = math.sqrt(var)
+            scale = max(std, self.rel_floor * abs(mean), 1e-12)
+            if (v - mean) > self.z * scale and (v - mean) > self.abs_floor:
+                raise LossSpike(
+                    f"step loss {v:.6g} is {(v - mean) / scale:.1f} "
+                    f"sigma above the last-{len(vals)}-step mean "
+                    f"{mean:.6g} — run is diverging; rolling back")
+        vals.append(v)
+        if len(vals) > self.window:
+            del vals[0]
+
+    def reset(self) -> None:
+        """Forget history (after a rollback the window restarts: the
+        replayed region must re-earn min_points before firing)."""
+        self._values.clear()
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,954 @@
+"""Self-healing training: the preemption-aware TrainSupervisor.
+
+PR 1 built the pieces — atomic bitwise-resume checkpoints
+(``save_train_state``/``restore_train_state``), ``StepWatchdog``
+hang/NaN-storm detection, ``FaultInjector`` — and the obs subsystem
+made failures observable (flight recorder, metrics). This module
+closes the loop: a ``TrainSupervisor`` runs ``Model.fit`` (directly,
+or in a subprocess for crash isolation) under a full self-healing
+policy, so a NaN storm, a wedged step, a loss spike, a SIGTERM
+preemption, or a ``kill -9`` no longer ends the run — the reference's
+``incubate/checkpoint/auto_checkpoint.py`` relaunch-resume and fleet
+elastic semantics, collapsed onto the sharded-train-state restore
+primitive this repo already has.
+
+Policy, end to end:
+
+* **Checkpoint retention** — every ``ckpt_every`` steps the full train
+  state (params / optimizer slots / step counters / RNG key, plus the
+  host LR-scheduler state in the manifest) publishes atomically as
+  ``<dir>/ckpt-<step>``, is ``verify_checkpoint``-gated and
+  loss-stamped into ``<dir>/supervisor_manifest.json``, then retention
+  GC (``checkpoint.gc_checkpoints``) prunes to ``max_to_keep`` newest
+  plus the keep-best entry. ``checkpoint.latest_checkpoint`` is the
+  flagless-resume entry point.
+* **Rollback on divergence** — ``NanInfStorm`` (watchdog storm scan),
+  ``StepTimeout`` (wedged step), or ``LossSpike`` (the windowed
+  z-score detector beside the NaN scan) dumps the flight ring, restores
+  the last-good checkpoint BITWISE, and resumes under an escalation
+  ladder: retry the window -> skip the poison data window (the
+  loader/RNG advance past it via ``fit(skip_windows=)``, recorded in
+  the manifest) -> give up loudly (``SupervisorGaveUp``) — all under a
+  bounded restart budget with escalating backoff.
+* **Preemption grace** — SIGTERM/SIGINT trigger checkpoint-now within
+  ``grace_s`` and ``run()`` returns/exits with the distinct requeue
+  code ``REQUEUE_EXIT_CODE`` (75, EX_TEMPFAIL — the "put me back on
+  the queue" convention); a fresh ``TrainSupervisor.run()`` on the
+  same directory auto-resumes without flags.
+* **Crash isolation** — in subprocess mode the trainer child (which
+  runs its own in-process supervisor) is respawned from the last
+  atomic checkpoint after a ``kill -9``, crash-loop-bounded by the
+  same restart budget.
+
+Determinism contract: resume replays the SAME data stream, so the
+loader must be deterministic and re-iterable (``shuffle=False`` or a
+seeded sampler). Under that contract a recovered run's final train
+state is bitwise-identical to an unfaulted run's whenever no data
+window was skipped — the chaos gate ``tools/chaos_train.py`` asserts
+exactly this.
+
+Env knobs (COMPONENTS.md "Self-healing training"):
+  PADDLE_TPU_CKPT_EVERY       auto-checkpoint period in steps (25)
+  PADDLE_TPU_CKPT_KEEP        retention max_to_keep (3)
+  PADDLE_TPU_PREEMPT_GRACE_S  checkpoint-now grace window (30)
+  PADDLE_TPU_RESTART_BUDGET   total rollback/respawn budget (5)
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from . import checkpoint as _ckpt
+from . import resilience as _resil
+
+__all__ = ["TrainSupervisor", "SupervisorResult", "SupervisorGaveUp",
+           "REQUEUE_EXIT_CODE", "MANIFEST_NAME", "load_manifest", "main"]
+
+# EX_TEMPFAIL: "transient failure, requeue me" — distinct from success
+# (0) and hard failure (1) so a scheduler can tell preemption apart
+REQUEUE_EXIT_CODE = 75
+
+MANIFEST_NAME = "supervisor_manifest.json"
+
+
+class SupervisorGaveUp(RuntimeError):
+    """The restart budget / escalation ladder is exhausted — the run
+    cannot self-heal. Raised LOUDLY (never an exit-0 path); carries
+    the incident history for the postmortem."""
+
+    def __init__(self, msg: str, incidents: Optional[List[dict]] = None):
+        super().__init__(msg)
+        self.incidents = list(incidents or [])
+
+
+class _Preempted(Exception):
+    """Internal: the grace checkpoint landed, unwind out of fit."""
+
+
+class SupervisorResult:
+    """What one ``run()`` produced. ``exit_code`` is what a CLI child
+    exits with: 0 completed, ``REQUEUE_EXIT_CODE`` preempted."""
+
+    __slots__ = ("outcome", "exit_code", "final_step", "restarts",
+                 "rollbacks", "respawns", "preemptions", "skipped_steps",
+                 "last_good")
+
+    def __init__(self, outcome: str, exit_code: int, final_step=None,
+                 restarts=0, rollbacks=0, respawns=0, preemptions=0,
+                 skipped_steps=0, last_good=None):
+        self.outcome = outcome
+        self.exit_code = int(exit_code)
+        self.final_step = final_step
+        self.restarts = int(restarts)
+        self.rollbacks = int(rollbacks)
+        self.respawns = int(respawns)
+        self.preemptions = int(preemptions)
+        self.skipped_steps = int(skipped_steps)
+        self.last_good = last_good
+
+    def as_dict(self) -> dict:
+        return {k: getattr(self, k) for k in self.__slots__}
+
+    def __repr__(self):
+        return f"SupervisorResult({self.as_dict()!r})"
+
+
+def load_manifest(directory: str) -> dict:
+    """Read a supervisor directory's manifest (fresh default when
+    absent/corrupt — a torn write must never wedge recovery)."""
+    path = os.path.join(directory, MANIFEST_NAME)
+    try:
+        with open(path) as f:
+            m = json.load(f)
+        if isinstance(m, dict):
+            m.setdefault("checkpoints", [])
+            m.setdefault("skipped_windows", [])
+            m.setdefault("incidents", [])
+            return m
+    except (OSError, ValueError):
+        pass
+    return {"version": 1, "checkpoints": [], "last_good": None,
+            "best": None, "skipped_windows": [], "incidents": [],
+            "restarts": 0, "rollbacks": 0, "respawns": 0,
+            "preemptions": 0, "skipped_steps": 0,
+            "done": False, "final_step": None}
+
+
+def _load_factory(spec: str) -> Callable:
+    """Resolve ``pkg.mod:fn`` or ``/path/to/file.py:fn`` to the trainer
+    factory: a zero-arg callable returning ``(model, train_data,
+    fit_kwargs)`` with the model already ``prepare()``d. File paths let
+    tests and tools ship their factory in the harness file itself."""
+    modpath, sep, attr = spec.partition(":")
+    if not sep or not attr:
+        raise ValueError(
+            f"factory spec {spec!r} must be 'module:callable' or "
+            "'/path/file.py:callable'")
+    if modpath.endswith(".py") or os.sep in modpath:
+        import importlib.util
+        name = "_ptpu_factory_" + os.path.basename(modpath)[:-3]
+        mod = sys.modules.get(name)
+        if mod is None:
+            ispec = importlib.util.spec_from_file_location(name, modpath)
+            if ispec is None or ispec.loader is None:
+                raise ImportError(f"cannot load factory file {modpath!r}")
+            mod = importlib.util.module_from_spec(ispec)
+            sys.modules[name] = mod
+            ispec.loader.exec_module(mod)
+    else:
+        import importlib
+        mod = importlib.import_module(modpath)
+    return getattr(mod, attr)
+
+
+def _metrics():
+    """ptpu_supervisor_* families (None when ambient obs is off)."""
+    from .. import obs
+    if not obs.enabled():
+        return None
+    reg = obs.metrics.registry
+    return {
+        "restarts": reg.counter(
+            "ptpu_supervisor_restarts_total",
+            "trainer restarts (in-process re-entries + child respawns)"),
+        "rollbacks": reg.counter(
+            "ptpu_supervisor_rollbacks_total",
+            "last-good checkpoint rollbacks", labels=("reason",),
+            max_series=8),
+        "preemptions": reg.counter(
+            "ptpu_supervisor_preemptions_total",
+            "grace-checkpoint preemption exits"),
+        "skipped": reg.counter(
+            "ptpu_supervisor_skipped_windows_total",
+            "poison data windows skipped by the escalation ladder"),
+        "ckpts": reg.counter(
+            "ptpu_supervisor_checkpoints_total",
+            "verified auto-checkpoints published"),
+        "last_good": reg.gauge(
+            "ptpu_supervisor_last_good_step",
+            "step of the newest verified last-good checkpoint"),
+    }
+
+
+class _SupervisorCallback:
+    """The fit-loop hook: per-step loss-spike scan, periodic verified
+    checkpoints, and the preemption grace exit. Duck-typed against
+    hapi's Callback surface (config_callbacks only needs set_model)."""
+
+    def __init__(self, sup: "TrainSupervisor", model):
+        self._sup = sup
+        self._model = model
+
+    # -- inert surface ---------------------------------------------------
+    def set_model(self, model):
+        self._model = model
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        # a preemption observed at an epoch boundary (e.g. during eval)
+        # must not wait a whole extra epoch for its grace checkpoint
+        self._sup._check_preempt(self._model, None)
+
+    def on_eval_begin(self, logs=None):
+        pass
+
+    def on_eval_end(self, logs=None):
+        pass
+
+    def on_eval_batch_end(self, step, logs=None):
+        pass
+
+    def on_train_batch_begin(self, step, logs=None):
+        pass
+
+    # -- the supervised step boundary ------------------------------------
+    def on_train_batch_end(self, step, logs=None):
+        sup = self._sup
+        # fault site: a synthetic preemption signal lands at this step
+        # boundary (SIGTERM semantics without a real signal — drivable
+        # from PADDLE_TPU_FAULT_INJECT in tests and the chaos harness)
+        if _resil.should_fire("preempt_signal"):
+            sup._note_preempt("injected_preempt_signal")
+        loss = (logs or {}).get("loss")
+        lval = None
+        if loss is not None:
+            try:
+                lval = float(loss)
+            except (TypeError, ValueError):
+                lval = None
+        sup._check_preempt(self._model, lval)
+        if lval is not None:
+            sup._last_loss = lval
+            # windowed z-score divergence scan (beside the watchdog's
+            # NaN scan — this one catches FINITE blow-ups); raises
+            # LossSpike out of fit into the rollback path
+            sup._detector.observe(lval)
+        ts = self._model._train_step
+        if ts is not None and sup.ckpt_every > 0 and \
+                ts.step_count > 0 and ts.step_count % sup.ckpt_every == 0:
+            sup._save_checkpoint(ts, loss=lval)
+
+
+class TrainSupervisor:
+    """Run a prepared hapi ``Model`` to completion under the
+    self-healing policy (module docstring).
+
+    In-process::
+
+        sup = TrainSupervisor(model, loader, directory=d,
+                              fit_kwargs={"epochs": 3})
+        result = sup.run()        # completed / preempted; raises
+                                  # SupervisorGaveUp when unhealable
+
+    Crash isolation (the trainer runs in a child process that the
+    supervisor respawns from the last atomic checkpoint after a
+    ``kill -9``)::
+
+        sup = TrainSupervisor(factory="pkg.mod:make_trainer",
+                              directory=d, subprocess_mode=True)
+
+    ``factory`` is a zero-arg callable (or its ``module:fn`` /
+    ``file.py:fn`` spec) returning ``(model, train_data, fit_kwargs)``;
+    subprocess mode requires the spec form (the child rebuilds from
+    it). A fresh ``run()`` on a directory holding checkpoints
+    auto-resumes from the newest verified one — no flags.
+    """
+
+    REQUEUE_EXIT_CODE = REQUEUE_EXIT_CODE
+
+    def __init__(self, model=None, train_data=None, *, directory: str,
+                 fit_kwargs: Optional[dict] = None,
+                 factory=None, subprocess_mode: bool = False,
+                 ckpt_every: Optional[int] = None,
+                 max_to_keep: Optional[int] = None,
+                 keep_best: bool = True,
+                 restart_budget: Optional[int] = None,
+                 retries_per_window: int = 1,
+                 grace_s: Optional[float] = None,
+                 step_timeout: Optional[float] = None,
+                 nan_limit: Optional[int] = None,
+                 spike_window: int = 32, spike_z: float = 8.0,
+                 spike_min_points: int = 8,
+                 backoff: Optional[_resil.RetryPolicy] = None,
+                 child_env: Optional[Dict[str, str]] = None):
+        from ..framework.env import float_env, int_env
+        self.model = model
+        self.train_data = train_data
+        self.fit_kwargs = dict(fit_kwargs or {})
+        self.factory = factory
+        self.subprocess_mode = bool(subprocess_mode)
+        if self.subprocess_mode and not isinstance(factory, str):
+            raise ValueError(
+                "subprocess_mode needs factory='module:callable' (the "
+                "child process rebuilds the trainer from the spec)")
+        if self.subprocess_mode and fit_kwargs:
+            # the child receives fit_kwargs through the JSON spec —
+            # non-serializable entries (callbacks, loaders) belong in
+            # the factory; failing HERE beats silently dropping them
+            try:
+                json.dumps(fit_kwargs)
+            except (TypeError, ValueError) as e:
+                raise ValueError(
+                    "subprocess_mode fit_kwargs must be "
+                    f"JSON-serializable (put the rest in the factory): "
+                    f"{e}") from e
+        if model is None and factory is None:
+            raise ValueError("need a model or a factory")
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.ckpt_every = int(ckpt_every if ckpt_every is not None
+                              else int_env("PADDLE_TPU_CKPT_EVERY", 25,
+                                           minimum=0))
+        self.max_to_keep = int(max_to_keep if max_to_keep is not None
+                               else int_env("PADDLE_TPU_CKPT_KEEP", 3,
+                                            minimum=1))
+        self.keep_best = bool(keep_best)
+        self.restart_budget = int(
+            restart_budget if restart_budget is not None
+            else int_env("PADDLE_TPU_RESTART_BUDGET", 5, minimum=0))
+        self.retries_per_window = max(0, int(retries_per_window))
+        self.grace_s = float(grace_s if grace_s is not None
+                             else float_env("PADDLE_TPU_PREEMPT_GRACE_S",
+                                            30.0))
+        self.step_timeout = step_timeout
+        self.nan_limit = nan_limit
+        self.spike_window = int(spike_window)
+        self.spike_z = float(spike_z)
+        self.spike_min_points = int(spike_min_points)
+        self.backoff = backoff if backoff is not None else \
+            _resil.RetryPolicy(max_attempts=64, base_delay=0.5,
+                               max_delay=30.0, jitter=0.1)
+        self.child_env = dict(child_env or {})
+
+        self._detector = _resil.LossSpikeDetector(
+            window=self.spike_window, z=self.spike_z,
+            min_points=self.spike_min_points)
+        self.manifest = load_manifest(self.directory)
+        self._m = _metrics()
+        self._last_loss: Optional[float] = None
+        self._preempt = threading.Event()
+        self._preempt_at: Optional[float] = None
+        self._preempt_reason: Optional[str] = None
+        self._grace_saved = False
+        self._old_handlers: Dict[int, Any] = {}
+        self._window_attempts: Dict[int, int] = {}
+        self.child_pid: Optional[int] = None
+
+    # -- manifest --------------------------------------------------------
+    def _write_manifest(self):
+        path = os.path.join(self.directory, MANIFEST_NAME)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.manifest, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+
+    def _ckpt_entry(self, name: str) -> Optional[dict]:
+        for e in self.manifest["checkpoints"]:
+            if e.get("name") == name:
+                return e
+        return None
+
+    def _ensure_entry(self, name: str) -> Optional[dict]:
+        """Manifest entry for ``name``, re-synthesized from the
+        committed on-disk checkpoint when the manifest lost it (torn/
+        deleted manifest — the state on disk outranks the book about
+        it; losing the book must not cost a restorable rollback)."""
+        entry = self._ckpt_entry(name)
+        if entry is not None:
+            return entry
+        path = os.path.join(self.directory, name)
+        if not _ckpt._committed(path):
+            return None
+        try:
+            step_n = int(name[len(_ckpt.CKPT_PREFIX):])
+        except ValueError:
+            return None
+        entry = {"name": name, "step": step_n, "verified": True,
+                 "time": time.time(), "kind": "resynthesized"}
+        self.manifest["checkpoints"].append(entry)
+        self.manifest["checkpoints"].sort(key=lambda e: e.get("step", 0))
+        return entry
+
+    # -- signals / preemption --------------------------------------------
+    def _install_signals(self):
+        if threading.current_thread() is not threading.main_thread():
+            return
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                self._old_handlers[sig] = signal.signal(
+                    sig, self._on_signal)
+            except (ValueError, OSError):
+                pass
+
+    def _restore_signals(self):
+        for sig, old in self._old_handlers.items():
+            try:
+                signal.signal(sig, old)
+            except (ValueError, OSError, TypeError):
+                pass
+        self._old_handlers.clear()
+
+    def _on_signal(self, signum, frame):
+        try:
+            name = signal.Signals(signum).name
+        except ValueError:
+            name = str(signum)
+        self._note_preempt(name)
+
+    def _note_preempt(self, reason: str):
+        if not self._preempt.is_set():
+            self._preempt_reason = reason
+            self._preempt_at = time.monotonic()
+            self._preempt.set()
+
+    def _check_preempt(self, model, loss):
+        """At a step/epoch boundary: if a preemption signal landed,
+        checkpoint NOW (inside the grace window) and unwind."""
+        if not self._preempt.is_set():
+            return
+        if not self._grace_saved:
+            within_grace = (self._preempt_at is None or
+                            time.monotonic() - self._preempt_at
+                            <= self.grace_s)
+            ts = model._train_step if model is not None else None
+            if within_grace and ts is not None:
+                # checkpoint-now: the requeue'd successor resumes from
+                # the exact preemption point, losing zero steps
+                self._save_checkpoint(ts, loss=loss
+                                      if loss is not None
+                                      else self._last_loss)
+            self._grace_saved = True
+        raise _Preempted()
+
+    # -- checkpoint / retention ------------------------------------------
+    def _sched_state(self, step_obj) -> Optional[dict]:
+        sched = getattr(step_obj.optimizer, "_learning_rate", None)
+        if hasattr(sched, "state_dict"):
+            try:
+                return dict(sched.state_dict())
+            except Exception:
+                return None
+        return None
+
+    def _save_checkpoint(self, step_obj, loss=None, kind="periodic"):
+        """Publish + verify + stamp + retain one checkpoint of the full
+        train state at the step's current count. Idempotent per step."""
+        step_n = int(step_obj.step_count)
+        name = f"{_ckpt.CKPT_PREFIX}{step_n}"
+        path = os.path.join(self.directory, name)
+        entry = self._ckpt_entry(name)
+        if entry is None or not os.path.isdir(path):
+            _resil.save_train_state(step_obj, path)
+            # verification gates last-good: un-verifiable state must
+            # never become the rollback target
+            _ckpt.verify_checkpoint(path)
+            entry = {"name": name, "step": step_n, "time": time.time()}
+            self.manifest["checkpoints"] = [
+                e for e in self.manifest["checkpoints"]
+                if e.get("name") != name] + [entry]
+            self.manifest["checkpoints"].sort(
+                key=lambda e: e.get("step", 0))
+            if self._m:
+                self._m["ckpts"].inc()
+        entry["verified"] = True
+        entry["kind"] = kind
+        if loss is not None:
+            entry["loss"] = float(loss)
+        sched = self._sched_state(step_obj)
+        if sched is not None:
+            entry["sched"] = sched
+        self.manifest["last_good"] = name
+        if self._m:
+            self._m["last_good"].set(step_n)
+        if self.keep_best and loss is not None:
+            best = self._ckpt_entry(self.manifest.get("best") or "")
+            if best is None or float(loss) <= best.get("loss",
+                                                       float("inf")):
+                self.manifest["best"] = name
+        self._write_manifest()
+        self._gc()
+        return path
+
+    def _gc(self):
+        """Retention GC — best-effort by contract: a GC failure
+        (including an injected ``ckpt_gc`` fault) must never take
+        training down, and the last verified + keep-best entries are
+        always protected."""
+        protect = set()
+        for key in ("last_good", "best"):
+            name = self.manifest.get(key)
+            if name:
+                protect.add(os.path.join(self.directory, name))
+        try:
+            deleted = _ckpt.gc_checkpoints(
+                self.directory, self.max_to_keep, keep=protect)
+        except Exception:
+            return
+        if deleted:
+            gone = {os.path.basename(p) for p in deleted}
+            self.manifest["checkpoints"] = [
+                e for e in self.manifest["checkpoints"]
+                if e.get("name") not in gone]
+            self._write_manifest()
+
+    # -- trainer materialization -----------------------------------------
+    def _materialize(self):
+        if self.model is not None:
+            model, data, kw = self.model, self.train_data, {}
+        else:
+            factory = self.factory if callable(self.factory) \
+                else _load_factory(self.factory)
+            model, data, kw = factory()
+        kw = dict(kw or {})
+        kw.update(self.fit_kwargs)
+        from ..io.dataloader import DataLoader, Dataset
+        if isinstance(data, Dataset):
+            # the determinism contract needs a re-iterable,
+            # stable-order loader — build it ONCE here (shuffle would
+            # re-deal the stream every life, breaking bitwise resume)
+            data = DataLoader(data, batch_size=kw.pop("batch_size", 1),
+                              shuffle=False,
+                              drop_last=kw.pop("drop_last", False))
+        return model, data, kw
+
+    def _ensure_step(self, model, loader):
+        """Build the model's TrainStep from one peeked batch (shape
+        only — epoch iteration restarts from its own iterator)."""
+        if model._train_step is None:
+            batch = next(iter(loader))
+            x, _ = model._split_batch(batch)
+            model._ensure_train_step(len(x))
+        return model._train_step
+
+    def _restore(self, model, loader, path: str):
+        step = self._ensure_step(model, loader)
+        _ckpt.verify_checkpoint(path)
+        _resil.restore_train_state(step, path)
+        entry = self._ckpt_entry(os.path.basename(path))
+        if entry and entry.get("sched") is not None:
+            sched = getattr(step.optimizer, "_learning_rate", None)
+            if hasattr(sched, "set_state_dict"):
+                try:
+                    sched.set_state_dict(dict(entry["sched"]))
+                except Exception:
+                    pass
+        return step
+
+    def _resume_or_anchor(self, model, loader):
+        """Flagless auto-resume from the newest restorable checkpoint;
+        on a fresh directory publish the step-0 anchor so the very
+        first incident already has a rollback target."""
+        tried = []
+        for _step_n, path in reversed(_ckpt.list_checkpoints(
+                self.directory)):
+            try:
+                self._restore(model, loader, path)
+                name = os.path.basename(path)
+                self.manifest["last_good"] = name
+                self._ensure_entry(name)   # torn manifest: re-book it
+                if self._m:
+                    self._m["last_good"].set(_step_n)
+                self._write_manifest()
+                return
+            except Exception as e:   # corrupt beyond the marker: older
+                tried.append(f"{os.path.basename(path)}: {e}")
+        if tried:
+            raise SupervisorGaveUp(
+                "no checkpoint in %r is restorable: %s"
+                % (self.directory, "; ".join(tried)),
+                self.manifest["incidents"])
+        step = self._ensure_step(model, loader)
+        self._save_checkpoint(step, loss=None, kind="anchor")
+
+    # -- incident handling ------------------------------------------------
+    def _incident(self, model, exc) -> None:
+        """One divergence incident: record + flight-dump, then climb
+        the escalation ladder (retry -> skip window -> give up) under
+        the restart budget."""
+        kind = {"NanInfStorm": "nan_storm", "StepTimeout": "hang",
+                "LossSpike": "loss_spike"}.get(type(exc).__name__,
+                                               type(exc).__name__)
+        ts = model._train_step
+        failure_step = int(ts.step_count) if ts is not None else 0
+        lg_name = self.manifest.get("last_good")
+        lg_entry = self._ensure_entry(lg_name) if lg_name else None
+        if lg_entry is None and lg_name is None:
+            # even the pointer is gone (fresh default manifest): the
+            # newest committed checkpoint on disk is still the truth
+            latest = _ckpt.latest_checkpoint(self.directory)
+            if latest is not None:
+                lg_name = os.path.basename(latest)
+                lg_entry = self._ensure_entry(lg_name)
+        if lg_entry is None:
+            raise SupervisorGaveUp(
+                f"{kind} at step {failure_step} with no last-good "
+                "checkpoint to roll back to", self.manifest["incidents"]) \
+                from exc
+        lg_step = int(lg_entry["step"])
+        # postmortem artifact per incident (the watchdog already dumped
+        # for hang/nan_storm; the spike path is ours). Best-effort.
+        flight = None
+        try:
+            from ..obs import trace as _trace
+            flight = _trace.dump_flight(
+                f"supervisor_{kind}",
+                extra={"failure_step": failure_step,
+                       "last_good_step": lg_step})
+        except Exception:
+            pass
+        att = self._window_attempts.get(lg_step, 0) + 1
+        self._window_attempts[lg_step] = att
+        incident = {"kind": kind, "step": failure_step,
+                    "last_good": lg_step, "attempt": att,
+                    "time": time.time(), "error": str(exc)}
+        if flight:
+            incident["flight"] = str(flight)
+        restarts = int(self.manifest.get("restarts", 0))
+        if restarts >= self.restart_budget:
+            incident["action"] = "give_up"
+            self.manifest["incidents"].append(incident)
+            self.manifest["outcome"] = "gave_up"
+            self._write_manifest()
+            raise SupervisorGaveUp(
+                f"restart budget ({self.restart_budget}) exhausted: "
+                f"{kind} at step {failure_step} "
+                f"(last good {lg_step})", self.manifest["incidents"]) \
+                from exc
+        if att <= self.retries_per_window:
+            incident["action"] = "retry"
+        elif att == self.retries_per_window + 1:
+            # the same window failed through its retries: the data in
+            # (last_good, failure] is poison — advance the loader/RNG
+            # past it and never train on it again (recorded forever)
+            lo, hi = lg_step, max(failure_step, lg_step + 1)
+            incident["action"] = "skip_window"
+            incident["window"] = [lo, hi]
+            self.manifest["skipped_windows"].append([lo, hi])
+            self.manifest["skipped_steps"] = int(
+                self.manifest.get("skipped_steps", 0)) + (hi - lo)
+            if self._m:
+                self._m["skipped"].inc()
+        else:
+            incident["action"] = "give_up"
+            self.manifest["incidents"].append(incident)
+            self.manifest["outcome"] = "gave_up"
+            self._write_manifest()
+            raise SupervisorGaveUp(
+                f"window after step {lg_step} still failing after "
+                f"retry and skip ({kind} at step {failure_step}) — "
+                "giving up", self.manifest["incidents"]) from exc
+        self.manifest["incidents"].append(incident)
+        self.manifest["restarts"] = restarts + 1
+        self.manifest["rollbacks"] = int(
+            self.manifest.get("rollbacks", 0)) + 1
+        self._write_manifest()
+        if self._m:
+            self._m["restarts"].inc()
+            self._m["rollbacks"].inc(reason=kind)
+        # bitwise rollback: params / opt slots / counters / RNG key.
+        # The spike detector's window is deliberately KEPT: it holds
+        # only pre-incident (good) losses, and the replay must be able
+        # to re-detect the same finite spike — a reset would leave it
+        # under min_points exactly where the poison batch recurs.
+        lg_path = os.path.join(self.directory, lg_name)
+        self._restore(model, None, lg_path)
+        # escalating backoff between restarts (deterministic schedule
+        # + jitter — the RetryPolicy the whole stack shares)
+        self.backoff.sleep(max(1, min(self.manifest["restarts"],
+                                      self.backoff.max_attempts - 1)))
+
+    # -- run (in-process) -------------------------------------------------
+    def run(self) -> SupervisorResult:
+        if self.subprocess_mode:
+            return self._run_subprocess()
+        return self._run_inprocess()
+
+    def _result(self, outcome: str, exit_code: int,
+                final_step=None) -> SupervisorResult:
+        m = self.manifest
+        return SupervisorResult(
+            outcome, exit_code, final_step=final_step,
+            restarts=m.get("restarts", 0), rollbacks=m.get("rollbacks", 0),
+            respawns=m.get("respawns", 0),
+            preemptions=m.get("preemptions", 0),
+            skipped_steps=m.get("skipped_steps", 0),
+            last_good=m.get("last_good"))
+
+    def _run_inprocess(self) -> SupervisorResult:
+        model, loader, fit_kw = self._materialize()
+        user_cbs = list(fit_kw.pop("callbacks", []) or [])
+        self._install_signals()
+        try:
+            self._resume_or_anchor(model, loader)
+            while True:
+                cb = _SupervisorCallback(self, model)
+                watchdog = _resil.StepWatchdog(
+                    deadline=self.step_timeout, nan_limit=self.nan_limit)
+                resume = int(model._train_step.step_count)
+                try:
+                    model.fit(loader,
+                              callbacks=user_cbs + [cb],
+                              watchdog=watchdog, resume_step=resume,
+                              skip_windows=[tuple(w) for w in
+                                            self.manifest[
+                                                "skipped_windows"]],
+                              **fit_kw)
+                except _Preempted:
+                    return self._finish_preempted(model)
+                except (_resil.NanInfStorm, _resil.StepTimeout,
+                        _resil.LossSpike) as e:
+                    self._incident(model, e)     # raises on give-up
+                    continue
+                return self._finish_completed(model)
+        finally:
+            self._restore_signals()
+
+    def _finish_completed(self, model) -> SupervisorResult:
+        ts = model._train_step
+        final_step = int(ts.step_count) if ts is not None else 0
+        if ts is not None:
+            # the terminal state IS a checkpoint: the chaos gate's
+            # bitwise comparison object, and what a later run() finds
+            # (resume of a done run trains zero steps)
+            self._save_checkpoint(ts, loss=self._last_loss, kind="final")
+        self.manifest["done"] = True
+        self.manifest["final_step"] = final_step
+        self.manifest["outcome"] = "completed"
+        self._write_manifest()
+        return self._result("completed", 0, final_step=final_step)
+
+    def _finish_preempted(self, model) -> SupervisorResult:
+        ts = model._train_step
+        self.manifest["preemptions"] = int(
+            self.manifest.get("preemptions", 0)) + 1
+        self.manifest["outcome"] = "preempted"
+        self.manifest["incidents"].append(
+            {"kind": "preemption", "reason": self._preempt_reason,
+             "step": int(ts.step_count) if ts is not None else None,
+             "time": time.time(), "action": "requeue"})
+        self._write_manifest()
+        if self._m:
+            self._m["preemptions"].inc()
+        return self._result(
+            "preempted", REQUEUE_EXIT_CODE,
+            final_step=int(ts.step_count) if ts is not None else None)
+
+    # -- run (subprocess crash isolation) ---------------------------------
+    def _policy_spec(self) -> dict:
+        return {"ckpt_every": self.ckpt_every,
+                "max_to_keep": self.max_to_keep,
+                "keep_best": self.keep_best,
+                "restart_budget": self.restart_budget,
+                "retries_per_window": self.retries_per_window,
+                "grace_s": self.grace_s,
+                "step_timeout": self.step_timeout,
+                "nan_limit": self.nan_limit,
+                "spike_window": self.spike_window,
+                "spike_z": self.spike_z,
+                "spike_min_points": self.spike_min_points}
+
+    def _run_subprocess(self) -> SupervisorResult:
+        """Crash isolation: the trainer (itself an in-process
+        supervisor, so rollback/preemption work identically) runs in a
+        child process; a ``kill -9``'d child is respawned from the last
+        atomic checkpoint, crash-loop-bounded by the restart budget."""
+        spec = {"factory": self.factory, "policy": self._policy_spec(),
+                "fit_kwargs": self.fit_kwargs}
+        argv = [sys.executable, "-m", "paddle_tpu.distributed.supervisor",
+                "--child", "--dir", self.directory,
+                "--spec", json.dumps(spec)]
+        self._install_signals()
+        log_path = os.path.join(self.directory, "trainer.log")
+        pid_path = os.path.join(self.directory, "trainer.pid")
+        crashes = 0
+        try:
+            while True:
+                env = dict(os.environ)
+                env.update(self.child_env)
+                with open(log_path, "ab") as logf:
+                    proc = subprocess.Popen(argv, env=env, stdout=logf,
+                                            stderr=subprocess.STDOUT)
+                self.child_pid = proc.pid
+                with open(pid_path, "w") as f:
+                    f.write(str(proc.pid))
+                rc = self._wait_child(proc)
+                self.manifest = load_manifest(self.directory)
+                if rc == 0 or self.manifest.get("done"):
+                    # the manifest outranks the exit code: a child that
+                    # finished training and took our forwarded TERM in
+                    # interpreter TEARDOWN (handlers already restored)
+                    # reports a raw signal death for a COMPLETED run
+                    return self._result(
+                        "completed", 0,
+                        final_step=self.manifest.get("final_step"))
+                if self._preempt.is_set():
+                    # WE are being preempted: never respawn under a
+                    # pending preemption (a fresh child would eat the
+                    # forwarded TERM mid-import and read as a crash
+                    # loop). Whatever the child's exit looked like —
+                    # grace 75, or a raw death from the forwarded TERM
+                    # — the state is checkpointed or resumable;
+                    # propagate the requeue.
+                    if rc != REQUEUE_EXIT_CODE:
+                        # the child died before recording it: book the
+                        # preemption parent-side for visibility
+                        self.manifest["preemptions"] = int(
+                            self.manifest.get("preemptions", 0)) + 1
+                        self.manifest["incidents"].append(
+                            {"kind": "preemption",
+                             "reason": self._preempt_reason, "rc": rc,
+                             "time": time.time(), "action": "requeue"})
+                        self._write_manifest()
+                        if self._m:
+                            self._m["preemptions"].inc()
+                    return self._result(
+                        "preempted", REQUEUE_EXIT_CODE, final_step=None)
+                if rc == REQUEUE_EXIT_CODE:
+                    # the child alone was preempted — requeue locally
+                    self._respawn_bookkeeping("child_preempted", rc)
+                    continue
+                # crash: kill -9 (negative rc), OOM, unhandled error
+                crashes += 1
+                self._respawn_bookkeeping("trainer_crash", rc)
+                if crashes > self.restart_budget:
+                    self.manifest["outcome"] = "gave_up"
+                    self._write_manifest()
+                    raise SupervisorGaveUp(
+                        f"trainer crash-loop: {crashes} crashes "
+                        f"exceeded the restart budget "
+                        f"({self.restart_budget}); last rc={rc}",
+                        self.manifest["incidents"])
+                self.backoff.sleep(
+                    max(1, min(crashes, self.backoff.max_attempts - 1)))
+        finally:
+            self._restore_signals()
+            try:
+                os.unlink(pid_path)
+            except OSError:
+                pass
+
+    def _respawn_bookkeeping(self, kind: str, rc: int):
+        self.manifest["incidents"].append(
+            {"kind": kind, "rc": rc, "time": time.time(),
+             "action": "respawn"})
+        self.manifest["respawns"] = int(
+            self.manifest.get("respawns", 0)) + 1
+        self.manifest["restarts"] = int(
+            self.manifest.get("restarts", 0)) + 1
+        self._write_manifest()
+        if self._m:
+            self._m["restarts"].inc()
+
+    def _wait_child(self, proc) -> int:
+        """Wait on the child, forwarding OUR preemption to it once:
+        SIGTERM -> child grace-checkpoints and exits requeue; a child
+        that overruns grace (+ margin) is killed — the bounded window
+        the external scheduler's kill -9 would enforce anyway."""
+        forwarded = False
+        kill_at = None
+        while True:
+            try:
+                return proc.wait(timeout=0.2)
+            except subprocess.TimeoutExpired:
+                pass
+            if self._preempt.is_set() and not forwarded:
+                try:
+                    proc.terminate()
+                except OSError:
+                    pass
+                forwarded = True
+                kill_at = time.monotonic() + self.grace_s + 5.0
+            if kill_at is not None and time.monotonic() > kill_at:
+                try:
+                    proc.kill()
+                except OSError:
+                    pass
+                kill_at = None
+
+
+# ---------------------------------------------------------------------------
+# CLI: the subprocess child entry + a thin operator launcher
+# ---------------------------------------------------------------------------
+
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="self-healing training supervisor (child entry + "
+                    "operator launcher)")
+    ap.add_argument("--child", action="store_true",
+                    help="internal: run the in-process supervisor from "
+                         "a JSON spec (what subprocess mode spawns)")
+    ap.add_argument("--dir", required=True,
+                    help="checkpoint/manifest directory (auto-resumes)")
+    ap.add_argument("--spec", help="child JSON: {factory, policy}")
+    ap.add_argument("--factory",
+                    help="operator mode: 'module:fn' or 'file.py:fn' "
+                         "returning (model, train_data, fit_kwargs)")
+    ap.add_argument("--subprocess", action="store_true",
+                    dest="subprocess_mode",
+                    help="operator mode: crash-isolate the trainer in "
+                         "a child process")
+    args = ap.parse_args(argv)
+    if not args.dir:
+        # abspath("") is the CWD — an empty --dir (unset shell var)
+        # would silently strew checkpoints into whatever directory the
+        # operator happens to stand in
+        ap.error("--dir must be a non-empty path")
+    if args.child:
+        if not args.spec:
+            ap.error("--child needs --spec")
+        spec = json.loads(args.spec)
+        policy = dict(spec.get("policy") or {})
+        sup = TrainSupervisor(factory=spec["factory"], directory=args.dir,
+                              fit_kwargs=spec.get("fit_kwargs") or {},
+                              **policy)
+    else:
+        if not args.factory:
+            ap.error("need --factory (or use --child)")
+        sup = TrainSupervisor(factory=args.factory, directory=args.dir,
+                              subprocess_mode=args.subprocess_mode)
+    try:
+        result = sup.run()
+    except SupervisorGaveUp as e:
+        print(f"supervisor gave up: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps({"supervisor": result.as_dict()}))
+    return result.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import os
 
-__all__ = ["int_env", "bool_env"]
+__all__ = ["int_env", "bool_env", "float_env"]
 
 
 def bool_env(name: str, default: bool) -> bool:
@@ -19,6 +19,16 @@ def bool_env(name: str, default: bool) -> bool:
     if value is None:
         return default
     return value.strip().lower() not in ("0", "false", "off", "")
+
+
+def float_env(name: str, default: float) -> float:
+    """``float(os.environ[name])`` with ``default`` on missing or
+    unparseable values (inference/serve.py's long-standing rule,
+    promoted here so new subsystems stop growing private copies)."""
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
 
 
 def int_env(name: str, default: int, minimum: int | None = None) -> int:

@@ -61,6 +61,11 @@ class Model:
         self._accumulate = 1
         self._carried_opt = None
         self.stop_training = False
+        # resume/skip hooks (distributed/supervisor.py drives these via
+        # fit(resume_step=, skip_windows=)): batches left to fast-forward
+        # and step-index windows to skip without training
+        self._ff_remaining = 0
+        self._skip_windows: tuple = ()
 
     # -- setup -----------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None,
@@ -124,13 +129,26 @@ class Model:
         labels = _as_list(labels)
         step = self._ensure_train_step(len(inputs))
         loss = step(*inputs, *labels)
+        from ..distributed import resilience as _resil
+        if _resil.should_fire("train_step_nan"):
+            # fault site: the step's REPORTED loss is non-finite while
+            # the real program ran and advanced state — the transient
+            # divergence the watchdog's storm counter and the
+            # supervisor's rollback absorb (N firings under nan_limit=N
+            # make one full storm)
+            return [LazyLoss(LossWindow(float("nan")))]
+        # fault site: the step wedges AFTER dispatch — the loss fetch
+        # hangs (wedged device/tunnel); under a StepWatchdog deadline
+        # this surfaces as StepTimeout, state already advanced
+        _resil.maybe_inject("step_hang")
         return [LazyLoss(LossWindow(loss.value))]
 
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
             verbose=1, drop_last=False, shuffle=True, num_workers=0,
             callbacks=None, accumulate_grad_batches=1, num_iters=None,
-            scan_steps=None, warm_start=None):
+            scan_steps=None, warm_start=None, resume_step=None,
+            skip_windows=None, watchdog=None):
         """Parity: Model.fit (hapi/model.py:1045). train_data may be a
         DataLoader or a Dataset (a loader is built with batch_size).
 
@@ -150,7 +168,20 @@ class Model:
         and gradient-accumulation cadence are bitwise those of the
         per-step loop. When an LRScheduler callback owns schedule
         stepping the loop stays per-step (the callback steps between
-        batches)."""
+        batches).
+
+        Self-healing hooks (distributed/supervisor.py drives these):
+        ``resume_step=N`` fast-forwards the first N batches — consumed
+        from the loader, never trained, no callbacks — so a run
+        restored from a step-N checkpoint lines its (deterministic)
+        data stream back up with its counters. ``skip_windows`` is a
+        sequence of ``(lo, hi)`` step-index ranges to SKIP: each
+        batch is consumed and the step counters/RNG-fold/LR schedule
+        advance (``TrainStep.skip_step``) but the program never runs —
+        the poison-data escape hatch, with documented bounded drift.
+        ``watchdog`` accepts a pre-armed ``StepWatchdog`` (the
+        supervisor's, so NaN-storm limits and deadlines follow its
+        policy); None keeps the env-gated arming."""
         from ..io.dataloader import DataLoader, Dataset
         if accumulate_grad_batches != self._accumulate:
             # gradient merge happens inside the compiled step
@@ -188,10 +219,13 @@ class Model:
         # storm raises NanInfStorm, and both write an atomic
         # checkpoint-on-failure into save_dir first.
         from ..distributed.resilience import StepWatchdog
-        watchdog = None
-        if StepWatchdog.enabled_by_env():
+        if watchdog is None and StepWatchdog.enabled_by_env():
             watchdog = StepWatchdog(
                 on_failure=lambda kind, exc: self._emergency_save(kind))
+        self._ff_remaining = max(0, int(resume_step or 0))
+        self._skip_windows = tuple(sorted(
+            (int(lo), int(hi)) for lo, hi in (skip_windows or ())
+            if int(hi) > int(lo)))
         if scan_steps is None:
             scan_steps = int_env("PADDLE_TPU_SCAN_STEPS", 1, minimum=1)
         scan_steps = max(1, int(scan_steps))
@@ -292,10 +326,23 @@ class Model:
         h_step = _obs_hist("ptpu_train_step_ms",
                            "per-step dispatch wall time")
         for data in (batches if batches is not None else loader):
+            if self._ff_remaining > 0:
+                # resume fast-forward: this batch was already trained
+                # before the restart; consume it (no callbacks, no
+                # counters) so the stream lines back up
+                self._ff_remaining -= 1
+                continue
+            x, y = self._split_batch(data)
+            if self._skip_windows:
+                step = self._ensure_train_step(len(x))
+                if self._skip_hit(step.step_count):
+                    # poison-window skip: batch consumed, counters/RNG/
+                    # LR advance, program never runs, no callbacks
+                    step.skip_step()
+                    continue
             t_step = time.perf_counter() if h_step is not None else 0.0
             for cb in cbs:
                 cb.on_train_batch_begin(step_i)
-            x, y = self._split_batch(data)
             if watchdog is not None:
                 (loss,) = watchdog.run(self.train_batch, x, y)
             else:
@@ -353,7 +400,15 @@ class Model:
                                  cat="train")
             t_win = time.perf_counter() if obs_on else 0.0
             remaining = None if num_iters is None else num_iters - it_count
-            if win.full and (remaining is None or remaining >= k):
+            # resume fast-forward / poison-window skip route through the
+            # per-step fallback (a K-step program is one uninterruptible
+            # dispatch — it cannot skip a step in its middle)
+            pos = (self._train_step.step_count
+                   if self._train_step is not None else 0)
+            healing = (self._ff_remaining > 0
+                       or self._skip_overlap(pos, pos + k))
+            if win.full and not healing and \
+                    (remaining is None or remaining >= k):
                 x, y = self._split_batch(win.data)
                 step = self._ensure_train_step(len(x))
 
@@ -395,6 +450,12 @@ class Model:
             if num_iters is not None and it_count >= num_iters:
                 break
         return logs, it_count
+
+    def _skip_hit(self, pos: int) -> bool:
+        return any(lo <= pos < hi for lo, hi in self._skip_windows)
+
+    def _skip_overlap(self, lo: int, hi: int) -> bool:
+        return any(a < hi and lo < b for a, b in self._skip_windows)
 
     def _emergency_save(self, kind: str):
         """Checkpoint-on-failure for the fit loop: atomic tmp+rename of

@@ -78,8 +78,8 @@ from ..distributed import resilience as _resil
 from .serve import (REQUEST_ID_HEADER, RETRY_AFTER_S, _env_float,
                     handle_admin_trace, send_json, send_text)
 
-__all__ = ["ReplicaSpec", "Replica", "Router", "main",
-           "single_device_child_env"]
+__all__ = ["ReplicaSpec", "Replica", "Router", "RespawnGovernor",
+           "main", "single_device_child_env"]
 
 # tier-level 503 reasons extend the per-replica contract
 TIER_RETRY_AFTER_S = dict(RETRY_AFTER_S)
@@ -226,6 +226,8 @@ class Replica:
         self.health: dict = {}
         self.spawned_at = time.monotonic()
         self.last_health_at: Optional[float] = None  # last ANSWERED poll
+        self.was_ready = False       # ever reached READY (not warming
+        #                              503s — crash-loop governance key)
 
     @property
     def base_url(self) -> Optional[str]:
@@ -268,6 +270,52 @@ class Replica:
                     else round(now - self.last_health_at, 2)),
                 "metrics_seq": int(self.health.get("metrics_seq", 0))
                 if self.health else 0}
+
+
+class RespawnGovernor:
+    """Escalating respawn backoff + give-up for crash-looping replicas.
+
+    A replica that dies at startup used to be respawned immediately and
+    forever — a broken spec (bad model kwargs, poisoned store entry)
+    hot-looped process churn. The governor watches each death: a
+    replica that never became ready, or died within ``window_s`` of its
+    spawn, extends a crash streak; each streak death pushes the next
+    respawn out on the shared ``RetryPolicy`` schedule (exponential,
+    capped), and past ``budget`` consecutive fast deaths the respawn is
+    ABANDONED (``note_death`` returns None — the give-up the router
+    counts as ``crash_loops`` and surfaces in stats//healthz). Any
+    replica surviving past the window resets the streak.
+    """
+
+    def __init__(self, budget: int = 5, window_s: float = 10.0,
+                 policy: Optional[_resil.RetryPolicy] = None,
+                 clock=time.monotonic):
+        self.budget = int(budget)
+        self.window_s = float(window_s)
+        self.policy = policy if policy is not None else _resil.RetryPolicy(
+            max_attempts=max(2, self.budget + 1), base_delay=0.5,
+            max_delay=30.0, jitter=0.0)
+        self._clock = clock
+        self.streak = 0
+
+    def note_death(self, lifetime_s: float,
+                   became_ready: bool) -> Optional[float]:
+        """One replica died. Returns the earliest monotonic time its
+        replacement may spawn, or None when the crash loop has burned
+        the budget and this respawn is abandoned."""
+        fast = (not became_ready) or lifetime_s < self.window_s
+        if not fast:
+            self.streak = 0
+            return self._clock()
+        self.streak += 1
+        if self.streak > self.budget:
+            return None
+        return self._clock() + self.policy.delay(
+            min(self.streak, self.policy.max_attempts - 1))
+
+    def note_stable(self) -> None:
+        """A replica proved healthy past the window: clear the streak."""
+        self.streak = 0
 
 
 # internal retryable forward outcomes -------------------------------------
@@ -333,6 +381,9 @@ class Router:
                  scale_up_queued: Optional[int] = None,
                  scale_cycles: int = 3,
                  scale_cooldown_s: float = 30.0,
+                 crash_loop_budget: Optional[int] = None,
+                 crash_loop_window_s: Optional[float] = None,
+                 respawn_policy: Optional[_resil.RetryPolicy] = None,
                  exec_store_dir: Optional[str] = None,
                  jax_cache_dir: Optional[str] = None,
                  workdir: Optional[str] = None):
@@ -366,6 +417,20 @@ class Router:
         self.unreachable_after = int(unreachable_after)
         self.restart_unreachable_after = int(restart_unreachable_after)
         self.respawn = bool(respawn)
+        # crash-loop governance: a replica dying at startup no longer
+        # respawns immediately and forever — escalating backoff, then
+        # give-up (counted as crash_loops in stats and /healthz)
+        self.respawn_governor = RespawnGovernor(
+            budget=int(crash_loop_budget if crash_loop_budget is not None
+                       else _env_float("PADDLE_TPU_TIER_CRASH_BUDGET", 5)),
+            window_s=(crash_loop_window_s
+                      if crash_loop_window_s is not None
+                      else _env_float("PADDLE_TPU_TIER_CRASH_WINDOW_S",
+                                      10.0)),
+            policy=respawn_policy)
+        self._pending_respawns = 0
+        self._respawn_at = 0.0
+        self._last_fast_death = 0.0
         # autoscaler watermarks: scale up when aggregate queued tokens
         # requests exceed this for scale_cycles consecutive polls
         slots = int(self.spec.engine.get("slots", 8))
@@ -406,6 +471,7 @@ class Router:
             "deadline_503": 0, "relayed_503": 0, "backend_503": 0,
             "respawns": 0, "ejections": 0, "rolling_restarts": 0,
             "scale_ups": 0, "scale_downs": 0, "spawn_failures": 0,
+            "crash_loops": 0,
         }
         # observability (paddle_tpu.obs): the stats above keep their
         # dict face (/healthz, tests); the registry carries the
@@ -653,6 +719,7 @@ class Router:
             rep.health_fail_streak = 0
             rep.last_health_at = time.monotonic()
             rep.state = "ready"
+            rep.was_ready = True
             if self._obs:
                 self._m_breaker.set(
                     1.0 if time.monotonic() < rep.ejected_until else 0.0,
@@ -716,6 +783,7 @@ class Router:
                                "pids": [r.proc.pid for r in dead]})
                 except Exception:   # noqa: BLE001
                     pass
+            now = time.monotonic()
             for rep in dead:
                 with self._lock:
                     if rep in self._replicas:
@@ -724,11 +792,47 @@ class Router:
                 self._drop_replica_series(rep)
                 if stopping or not self.respawn:
                     continue
+                # crash-loop governance: a fast death (never became
+                # ready, or died inside the window) escalates the next
+                # respawn on the backoff schedule; past the budget the
+                # respawn is abandoned and counted — no more hot-loop
+                prev_streak = self.respawn_governor.streak
+                spawn_at = self.respawn_governor.note_death(
+                    now - rep.spawned_at,
+                    became_ready=rep.was_ready)
+                if self.respawn_governor.streak > prev_streak:
+                    self._last_fast_death = now
+                if spawn_at is None:
+                    self.stats_counters["crash_loops"] += 1
+                    continue
+                self._pending_respawns += 1
+                self._respawn_at = max(self._respawn_at, spawn_at)
+            # a replica spawned after the latest fast death that
+            # reached READY and survived past the window proves the
+            # spec healthy again
+            if self.respawn_governor.streak:
+                for rep in reps:
+                    if (rep not in dead and rep.alive() and rep.was_ready
+                            and rep.spawned_at >= self._last_fast_death
+                            and now - rep.spawned_at
+                            > self.respawn_governor.window_s):
+                        self.respawn_governor.note_stable()
+                        break
+            while (self._pending_respawns > 0 and not self._stopping
+                   and time.monotonic() >= self._respawn_at):
+                self._pending_respawns -= 1
                 try:
                     self._spawn_replica()
                     self.stats_counters["respawns"] += 1
                 except Exception:
                     self.stats_counters["spawn_failures"] += 1
+                    # the slot is still owed a replica: keep the
+                    # pending respawn, retry on a later pass instead
+                    # of (a) hot-spinning now or (b) dropping it
+                    self._pending_respawns += 1
+                    self._respawn_at = time.monotonic() + \
+                        max(self.poll_s, 0.5)
+                    break
             if not self._stopping:
                 if self._obs:
                     self._m_ready.set(self.ready_count())

@@ -81,11 +81,9 @@ __all__ = ["PredictorServer", "main"]
 REQUEST_ID_HEADER = "X-PTPU-Request-Id"
 
 
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, default))
-    except ValueError:
-        return default
+# the ONE float-knob parser (framework/env.py); the old private name
+# stays as a face — router.py and tests import it from here
+from ..framework.env import float_env as _env_float  # noqa: E402
 
 
 # How long a client should wait before retrying each 503 reason. The

@@ -552,6 +552,28 @@ class TrainStep:
         return recs
 
     # ------------------------------------------------------------------
+    def skip_step(self):
+        """Advance the step/update counters — and with them the
+        per-step RNG fold position and (``auto_lr_step``) the LR
+        schedule — WITHOUT executing the program. The supervisor's
+        poison-window skip: the batch is consumed from the loader but
+        never trained on, and every step AFTER the window draws the
+        same fold-in key and schedule position an unfaulted run would
+        have at that step count. Parameters and optimizer slots are
+        untouched (the in-program step number they carry lags by the
+        skipped updates — the documented bounded-drift of a skipped
+        window). A skipped micro-step under gradient merge leaves the
+        accumulator as-is."""
+        self.step_count += 1
+        k = self.accumulate_steps
+        if k > 1 and self.step_count % k != 0:
+            return
+        self.update_count += 1
+        if self.auto_lr_step:
+            lr_sched = getattr(self.optimizer, "_learning_rate", None)
+            if hasattr(lr_sched, "step"):
+                lr_sched.step()
+
     def flush_accumulation(self):
         """Apply any pending partial accumulation (mean over the
         micro-steps seen so far). No-op when the cadence is aligned.

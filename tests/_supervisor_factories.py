@@ -1,0 +1,62 @@
+"""Trainer factories for the supervisor tests (and loadable by child
+processes through the supervisor's ``file.py:fn`` factory spec).
+
+The trainer is deliberately tiny and DETERMINISTIC: fixed seed, fixed
+data, ``shuffle=False`` — the contract that makes crash/preempt resume
+bitwise-comparable against an unfaulted run.
+"""
+import os
+import time
+
+import numpy as np
+
+
+class _Rows:
+    """Minimal deterministic map-style dataset."""
+
+    def __init__(self, xs, ys):
+        self.xs, self.ys = xs, ys
+
+    def __len__(self):
+        return len(self.xs)
+
+    def __getitem__(self, i):
+        return self.xs[i], self.ys[i]
+
+
+def make_trainer():
+    """(model, loader, fit_kwargs): 8 steps/epoch x 3 epochs of SGD on
+    a Linear(4,4) MSE problem. PTPU_TEST_STEP_SLEEP (seconds) slows
+    each step so tests can land signals mid-run."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.hapi.callbacks import Callback
+    from paddle_tpu.io.dataloader import DataLoader
+
+    paddle.seed(7)
+    net = nn.Linear(4, 4)
+    model = Model(net)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    model.prepare(optimizer=opt, loss=lambda o, y: F.mse_loss(o, y))
+    rng = np.random.RandomState(3)
+    xs = rng.randn(32, 4).astype("float32")
+    ys = rng.randn(32, 4).astype("float32")
+    loader = DataLoader(_Rows(xs, ys), batch_size=4, shuffle=False)
+
+    sleep_s = float(os.environ.get("PTPU_TEST_STEP_SLEEP", "0") or 0)
+
+    class SlowStep(Callback):
+        def on_train_batch_end(self, step, logs=None):
+            if sleep_s:
+                time.sleep(sleep_s)
+
+    return model, loader, {"epochs": 3, "verbose": 0,
+                           "callbacks": [SlowStep()]}
+
+
+def make_crashing_trainer():
+    """A trainer that cannot even build — the crash-loop fixture."""
+    raise RuntimeError("injected: trainer factory crashes at build")

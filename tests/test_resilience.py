@@ -587,3 +587,42 @@ def test_store_host_drop_injection():
             store.get("alive")
     # recovered after the injected drop
     assert store.get("alive") == b"1"
+
+
+# ---------------------------------------------------------------------------
+# LossSpikeDetector: windowed z-score divergence beside the NaN scan
+# ---------------------------------------------------------------------------
+
+def test_loss_spike_detector_fires_on_finite_divergence():
+    from paddle_tpu.distributed.resilience import (LossSpike,
+                                                   LossSpikeDetector)
+    det = LossSpikeDetector(window=8, z=4.0, min_points=4)
+    for v in (1.0, 1.1, 0.9, 1.0, 1.05):
+        det.observe(v)
+    with pytest.raises(LossSpike):
+        det.observe(50.0)
+    # the spiking value never entered the window: normal losses keep
+    # flowing, and a COLLAPSING loss is not an incident (one-sided)
+    det.observe(1.0)
+    det.observe(0.0)
+
+
+def test_loss_spike_detector_cold_start_and_nonfinite():
+    from paddle_tpu.distributed.resilience import LossSpikeDetector
+    det = LossSpikeDetector(window=8, z=4.0, min_points=4)
+    det.observe(float("nan"))      # the NaN-storm scan owns these
+    det.observe(float("inf"))
+    det.observe(1.0)
+    det.observe(1e9)               # under min_points: cold start swings
+    det2 = LossSpikeDetector(window=8, z=4.0, min_points=4)
+    for v in (2.0, 2.0, 2.0, 2.0):
+        det2.observe(v)
+    det2.reset()
+    det2.observe(1e9)              # reset forgot the baseline: no fire
+
+
+def test_new_fault_sites_are_known():
+    for site in ("train_step_nan", "preempt_signal", "ckpt_gc"):
+        assert site in resil._KNOWN_SITES
+    assert resil._parse_spec("train_step_nan:3, preempt_signal, ckpt_gc") \
+        == {"train_step_nan": 3, "preempt_signal": 1, "ckpt_gc": 1}

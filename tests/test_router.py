@@ -328,3 +328,55 @@ def test_tier_truthful_503_when_no_replica_admits(tier):
     assert tier.wait_ready(2, timeout=30)
     code, _, _ = _gen(tier, [1], n=2)
     assert code == 200
+
+
+# ---------------------------------------------------------------------------
+# crash-loop governance: escalating respawn backoff + give-up (ISSUE 11)
+# ---------------------------------------------------------------------------
+
+def test_respawn_governor_escalates_then_gives_up():
+    from paddle_tpu.distributed.resilience import RetryPolicy
+    from paddle_tpu.inference.router import RespawnGovernor
+    now = [100.0]
+    gov = RespawnGovernor(
+        budget=3, window_s=10.0,
+        policy=RetryPolicy(max_attempts=8, base_delay=1.0,
+                           multiplier=2.0, max_delay=8.0, jitter=0.0),
+        clock=lambda: now[0])
+    # deaths at startup escalate on the deterministic schedule
+    assert gov.note_death(0.5, became_ready=False) == 101.0
+    assert gov.note_death(0.5, became_ready=False) == 102.0
+    assert gov.note_death(0.5, became_ready=False) == 104.0
+    # budget burned: the respawn is abandoned (None = give up)
+    assert gov.note_death(0.5, became_ready=False) is None
+    assert gov.note_death(0.5, became_ready=False) is None
+    # a replica surviving past the window clears the streak
+    gov.note_stable()
+    assert gov.note_death(0.5, became_ready=False) == 101.0
+
+
+def test_respawn_governor_slow_death_resets_streak():
+    from paddle_tpu.inference.router import RespawnGovernor
+    gov = RespawnGovernor(budget=2, window_s=5.0, clock=lambda: 50.0)
+    gov.note_death(0.1, became_ready=False)
+    gov.note_death(0.1, became_ready=False)
+    assert gov.streak == 2
+    # a replica that became ready AND outlived the window is a normal
+    # death (rolling hardware, OOM after hours): immediate respawn
+    assert gov.note_death(3600.0, became_ready=True) == 50.0
+    assert gov.streak == 0
+
+
+def test_respawn_governor_never_ready_counts_fast_even_if_old():
+    from paddle_tpu.inference.router import RespawnGovernor
+    gov = RespawnGovernor(budget=1, window_s=5.0, clock=lambda: 0.0)
+    # wedged-at-startup replica killed by the unreachable path after
+    # minutes: it never served, so it still extends the crash streak
+    gov.note_death(600.0, became_ready=False)
+    assert gov.streak == 1
+
+
+def test_crash_loops_surfaced_in_stats_and_healthz(bare_router):
+    assert "crash_loops" in bare_router.stats_counters
+    body = bare_router.stats()
+    assert body["stats"]["crash_loops"] == 0

@@ -1,0 +1,410 @@
+#!/usr/bin/env python
+"""Chaos gate for the self-healing training supervisor (ISSUE 11).
+
+Drives ONE deterministic tiny trainer through every recovery path the
+TrainSupervisor promises and asserts the runs actually heal:
+
+  baseline   unfaulted supervised run (the bitwise comparison object)
+  nan_storm  injected train_step_nan x3 -> rollback -> final state
+             BITWISE-identical to baseline + flight artifact
+  wedge      injected step_hang under a step deadline -> StepTimeout
+             rollback -> bitwise + flight artifact
+  preempt    injected preempt_signal -> grace checkpoint + requeue
+             outcome, then flagless auto-resume -> bitwise
+  sigterm    REAL SIGTERM to a supervisor child process mid-epoch ->
+             requeue exit code 75, relaunch of the SAME command line
+             resumes flaglessly -> bitwise            (full run only)
+  kill9      kill -9 of the subprocess-mode trainer child mid-epoch ->
+             crash-loop-bounded respawn from the last atomic
+             checkpoint -> bitwise                    (full run only)
+  skip       a FINITE poison batch -> loss-spike rollback, retry,
+             then the poison window is skipped; final state equals a
+             clean run told to skip the same window (the
+             documented-bounded-drift case, pinned exactly)
+
+Every phase's recovery must be visible: manifest incident records +
+ptpu_supervisor_* counters + a flight-recorder artifact per
+watchdog-detected incident.
+
+Usage:
+    python tools/chaos_train.py            # full gate (spawns children)
+    python tools/chaos_train.py --smoke    # in-process phases only
+
+Terminal stdout line is a tools/_have_result.py-good JSON record
+({"error": ...} + nonzero exit on any unhealed run).
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+SELF = os.path.abspath(__file__)
+
+STEP_SLEEP = os.environ.get("PTPU_CHAOS_STEP_SLEEP", "0.2")
+
+
+# ---------------------------------------------------------------------------
+# the one trainer every phase runs (children load it as file.py:fn)
+# ---------------------------------------------------------------------------
+
+class _Rows:
+    def __init__(self, xs, ys):
+        self.xs, self.ys = xs, ys
+
+    def __len__(self):
+        return len(self.xs)
+
+    def __getitem__(self, i):
+        return self.xs[i], self.ys[i]
+
+
+def _build(poison_at=None):
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.hapi.callbacks import Callback
+    from paddle_tpu.io.dataloader import DataLoader
+
+    paddle.seed(11)
+    net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 8))
+    model = Model(net)
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=net.parameters())
+    model.prepare(optimizer=opt, loss=lambda o, y: F.mse_loss(o, y))
+    rng = np.random.RandomState(5)
+    xs = rng.randn(48, 8).astype("float32")
+    ys = rng.randn(48, 8).astype("float32")
+    if poison_at is not None:
+        ys[poison_at * 4:(poison_at + 1) * 4] = 1e6
+    loader = DataLoader(_Rows(xs, ys), batch_size=4, shuffle=False)
+
+    sleep_s = float(os.environ.get("PTPU_TEST_STEP_SLEEP", "0") or 0)
+
+    class SlowStep(Callback):
+        def on_train_batch_end(self, step, logs=None):
+            if sleep_s:
+                time.sleep(sleep_s)
+
+    return model, loader, {"epochs": 2, "verbose": 0,
+                           "callbacks": [SlowStep()]}
+
+
+def make_trainer():
+    return _build()
+
+
+def make_poisoned_trainer():
+    return _build(poison_at=5)
+
+
+TOTAL_STEPS = 24        # 12 batches x 2 epochs
+POLICY = {"ckpt_every": 5, "max_to_keep": 3}
+
+
+# ---------------------------------------------------------------------------
+# harness plumbing
+# ---------------------------------------------------------------------------
+
+def _fast_backoff():
+    from paddle_tpu.distributed.resilience import RetryPolicy
+    return RetryPolicy(max_attempts=16, base_delay=0.0, jitter=0.0)
+
+
+def _run_inprocess(d, factory=make_trainer, **policy):
+    from paddle_tpu.distributed.supervisor import TrainSupervisor
+    model, loader, kw = factory()
+    kw.pop("callbacks", None)        # no step sleep for in-process runs
+    sup = TrainSupervisor(model, loader, directory=d, fit_kwargs=kw,
+                          backoff=_fast_backoff(),
+                          **{**POLICY, **policy})
+    return sup, sup.run()
+
+
+def _final_tree(d):
+    from paddle_tpu.distributed import checkpoint as ckpt
+    path = ckpt.latest_checkpoint(d)
+    if path is None:
+        raise AssertionError(f"no checkpoint landed in {d}")
+    return ckpt.load_state_dict(path)
+
+
+def _bitwise(a, b):
+    import jax
+    import numpy as np
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(la, lb))
+
+
+def _assert(cond, what):
+    if not cond:
+        raise AssertionError(what)
+
+
+def _flight_artifacts(obs_dir, needle):
+    try:
+        return [f for f in os.listdir(obs_dir) if needle in f]
+    except OSError:
+        return []
+
+
+def _child_env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = ROOT + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    env["PTPU_TEST_STEP_SLEEP"] = STEP_SLEEP
+    return env
+
+
+def _child_argv(d, factory="make_trainer"):
+    spec = {"factory": f"{SELF}:{factory}", "policy": POLICY}
+    return [sys.executable, "-m", "paddle_tpu.distributed.supervisor",
+            "--child", "--dir", d, "--spec", json.dumps(spec)]
+
+
+def _wait_ckpt(d, min_step, timeout=120.0):
+    from paddle_tpu.distributed.checkpoint import list_checkpoints
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if any(s >= min_step for s, _ in list_checkpoints(d)):
+            return True
+        time.sleep(0.1)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# phases
+# ---------------------------------------------------------------------------
+
+def phase_baseline(work):
+    d = os.path.join(work, "baseline")
+    _sup, r = _run_inprocess(d)
+    _assert(r.outcome == "completed" and r.final_step == TOTAL_STEPS,
+            f"baseline did not complete: {r.as_dict()}")
+    return _final_tree(d), {"final_step": r.final_step}
+
+
+def phase_nan_storm(work, base, obs_dir):
+    from paddle_tpu.distributed.resilience import FaultInjector
+    from paddle_tpu.distributed.supervisor import load_manifest
+    d = os.path.join(work, "nan_storm")
+    with FaultInjector({"train_step_nan": 3}):
+        _sup, r = _run_inprocess(d, nan_limit=3)
+    _assert(r.outcome == "completed" and r.rollbacks == 1,
+            f"nan_storm not healed by one rollback: {r.as_dict()}")
+    tree = _final_tree(d)
+    _assert(_bitwise(tree["params"], base["params"]) and
+            _bitwise(tree["opt"], base["opt"]),
+            "nan_storm recovery drifted from the unfaulted run")
+    m = load_manifest(d)
+    _assert([i["kind"] for i in m["incidents"]] == ["nan_storm"],
+            f"unexpected incidents: {m['incidents']}")
+    flights = _flight_artifacts(obs_dir, "nan_storm")
+    _assert(flights, "no flight-recorder artifact for the NaN storm")
+    return {"rollbacks": r.rollbacks, "flight": flights[0]}
+
+
+def phase_wedge(work, base, obs_dir):
+    from paddle_tpu.distributed.resilience import FaultInjector
+    d = os.path.join(work, "wedge")
+    with FaultInjector({"step_hang": 1}, wedge_s=5.0):
+        _sup, r = _run_inprocess(d, step_timeout=1.0)
+    _assert(r.outcome == "completed" and r.rollbacks == 1,
+            f"wedge not healed by one rollback: {r.as_dict()}")
+    _assert(_bitwise(_final_tree(d)["params"], base["params"]),
+            "wedge recovery drifted from the unfaulted run")
+    flights = _flight_artifacts(obs_dir, "hang")
+    _assert(flights, "no flight-recorder artifact for the wedged step")
+    return {"rollbacks": r.rollbacks, "flight": flights[0]}
+
+
+def phase_preempt(work, base):
+    from paddle_tpu.distributed.resilience import FaultInjector
+    from paddle_tpu.distributed.supervisor import REQUEUE_EXIT_CODE
+    d = os.path.join(work, "preempt")
+    with FaultInjector({"preempt_signal": 1}):
+        _sup, r = _run_inprocess(d)
+    _assert(r.outcome == "preempted" and
+            r.exit_code == REQUEUE_EXIT_CODE,
+            f"injected preemption did not requeue: {r.as_dict()}")
+    _sup2, r2 = _run_inprocess(d)          # flagless auto-resume
+    _assert(r2.outcome == "completed" and r2.final_step == TOTAL_STEPS,
+            f"auto-resume did not complete: {r2.as_dict()}")
+    _assert(_bitwise(_final_tree(d)["params"], base["params"]),
+            "preempt-resume drifted from the unfaulted run")
+    return {"requeue_code": r.exit_code, "resumed_to": r2.final_step}
+
+
+def phase_skip_window(work):
+    """The documented-bounded-drift case, pinned exactly: the faulted
+    run's final state must equal a clean run that skipped the same
+    window a priori."""
+    from paddle_tpu.distributed.supervisor import load_manifest
+    d = os.path.join(work, "skip")
+    _sup, r = _run_inprocess(d, factory=make_poisoned_trainer,
+                             spike_window=8, spike_z=6.0,
+                             spike_min_points=4, retries_per_window=1)
+    _assert(r.outcome == "completed" and r.skipped_steps > 0,
+            f"poison run did not skip a window: {r.as_dict()}")
+    m = load_manifest(d)
+    windows = [tuple(w) for w in m["skipped_windows"]]
+    model, loader, kw = make_poisoned_trainer()
+    kw.pop("callbacks", None)
+    model.fit(loader, skip_windows=windows, **kw)
+    _assert(_bitwise(_final_tree(d)["params"], model._train_step.params),
+            "skip-window recovery does not match the clean skip run")
+    return {"skipped_windows": windows, "rollbacks": r.rollbacks}
+
+
+def phase_sigterm(work, factory_base):
+    from paddle_tpu.distributed.supervisor import (REQUEUE_EXIT_CODE,
+                                                   load_manifest)
+    d = os.path.join(work, "sigterm")
+    proc = subprocess.Popen(_child_argv(d), env=_child_env(), cwd=ROOT,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.STDOUT)
+    try:
+        _assert(_wait_ckpt(d, POLICY["ckpt_every"]),
+                "no checkpoint before SIGTERM")
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=90)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    _assert(rc == REQUEUE_EXIT_CODE,
+            f"SIGTERM exit code {rc} != requeue {REQUEUE_EXIT_CODE}")
+    # requeue: the SAME command, zero flags
+    rc2 = subprocess.run(_child_argv(d), env=_child_env(), cwd=ROOT,
+                         stdout=subprocess.DEVNULL,
+                         stderr=subprocess.STDOUT, timeout=300).returncode
+    _assert(rc2 == 0, f"flagless relaunch rc={rc2}")
+    m = load_manifest(d)
+    _assert(m["done"] and m["final_step"] == TOTAL_STEPS,
+            f"resume did not finish: {m.get('final_step')}")
+    _assert(_bitwise(_final_tree(d)["params"], factory_base["params"]),
+            "SIGTERM resume drifted from the unfaulted run")
+    return {"requeue_code": rc, "preemptions": m["preemptions"]}
+
+
+def phase_kill9(work, factory_base):
+    from paddle_tpu.distributed.supervisor import (TrainSupervisor,
+                                                   load_manifest)
+    d = os.path.join(work, "kill9")
+    env = _child_env()
+    sup = TrainSupervisor(
+        factory=f"{SELF}:make_trainer", directory=d,
+        subprocess_mode=True, restart_budget=3,
+        backoff=_fast_backoff(),
+        child_env={"JAX_PLATFORMS": "cpu",
+                   "PYTHONPATH": env["PYTHONPATH"],
+                   "PTPU_TEST_STEP_SLEEP": STEP_SLEEP},
+        **POLICY)
+    box = {}
+
+    def run():
+        try:
+            box["result"] = sup.run()
+        except BaseException as e:
+            box["error"] = e
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    _assert(_wait_ckpt(d, POLICY["ckpt_every"]),
+            "no checkpoint before kill -9")
+    _assert(sup.child_pid is not None, "no trainer child pid")
+    os.kill(sup.child_pid, signal.SIGKILL)
+    t.join(timeout=300)
+    _assert(not t.is_alive(), "supervisor wedged after kill -9")
+    _assert("error" not in box, f"supervisor raised: {box.get('error')}")
+    r = box["result"]
+    _assert(r.outcome == "completed" and r.respawns >= 1,
+            f"kill -9 not healed by respawn: {r.as_dict()}")
+    m = load_manifest(d)
+    _assert(_bitwise(_final_tree(d)["params"], factory_base["params"]) and
+            _bitwise(_final_tree(d)["opt"], factory_base["opt"]),
+            "kill -9 respawn drifted from the unfaulted run")
+    return {"respawns": r.respawns,
+            "crashes": [i["rc"] for i in m["incidents"]
+                        if i["kind"] == "trainer_crash"]}
+
+
+# ---------------------------------------------------------------------------
+# main
+# ---------------------------------------------------------------------------
+
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="in-process phases only (no child processes) — "
+                         "the ci.py --quick chaos smoke")
+    args = ap.parse_args(argv)
+
+    work = tempfile.mkdtemp(prefix="paddle_tpu_chaos_")
+    obs_dir = os.path.join(work, "obs")
+    os.environ["PADDLE_TPU_OBS_DIR"] = obs_dir
+    os.makedirs(obs_dir, exist_ok=True)
+
+    record = {"mode": "smoke" if args.smoke else "full", "phases": {}}
+    t0 = time.monotonic()
+    try:
+        base, info = phase_baseline(work)
+        record["phases"]["baseline"] = info
+        record["phases"]["nan_storm"] = phase_nan_storm(work, base,
+                                                        obs_dir)
+        record["phases"]["wedge"] = phase_wedge(work, base, obs_dir)
+        record["phases"]["preempt"] = phase_preempt(work, base)
+        record["phases"]["skip"] = phase_skip_window(work)
+        if not args.smoke:
+            record["phases"]["sigterm"] = phase_sigterm(work, base)
+            record["phases"]["kill9"] = phase_kill9(work, base)
+        # every recovery must be visible in the supervisor metrics
+        from paddle_tpu import obs
+        if obs.enabled():
+            reg = obs.metrics.registry
+            rb = reg.get("ptpu_supervisor_rollbacks_total")
+            record["metrics"] = {
+                "rollbacks_nan_storm": rb.value(reason="nan_storm"),
+                "rollbacks_hang": rb.value(reason="hang"),
+                "rollbacks_loss_spike": rb.value(reason="loss_spike"),
+                "preemptions": reg.get(
+                    "ptpu_supervisor_preemptions_total").value(),
+                "skipped_windows": reg.get(
+                    "ptpu_supervisor_skipped_windows_total").value(),
+                "checkpoints": reg.get(
+                    "ptpu_supervisor_checkpoints_total").value(),
+            }
+            _assert(record["metrics"]["rollbacks_nan_storm"] >= 1
+                    and record["metrics"]["rollbacks_hang"] >= 1
+                    and record["metrics"]["rollbacks_loss_spike"] >= 1
+                    and record["metrics"]["preemptions"] >= 1
+                    and record["metrics"]["skipped_windows"] >= 1,
+                    f"recovery not visible in ptpu_supervisor_* "
+                    f"metrics: {record['metrics']}")
+        record["elapsed_s"] = round(time.monotonic() - t0, 1)
+        record["ok"] = True
+        print(json.dumps(record))
+        return 0
+    except (AssertionError, Exception) as e:   # noqa: BLE001
+        import traceback
+        traceback.print_exc()
+        print(json.dumps({"error": f"{type(e).__name__}: {e}",
+                          "phases": record["phases"]}))
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

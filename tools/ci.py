@@ -70,7 +70,24 @@ QUICK_FILES = [
     # observability: metrics registry semantics, request-id -> phase
     # spans, flight-recorder crash dumps, tier metric aggregation
     "tests/test_obs.py",
+    # self-healing supervisor (ISSUE 11): rollback-on-divergence is
+    # bitwise, preemption requeues + resumes flaglessly, retention GC
+    # never touches the last verified checkpoint, kill -9 respawn
+    "tests/test_supervisor.py",
 ]
+
+
+def _run_chaos_smoke(env) -> int:
+    """Chaos smoke (ISSUE 11): tools/chaos_train.py --smoke drives a
+    supervised run through an injected NaN storm, a wedged step, a
+    synthetic preemption (+ flagless resume), and a poison-batch
+    loss spike with a skipped window — in-process only, asserting
+    bitwise recovery and ptpu_supervisor_* visibility."""
+    print("\n=== chaos smoke (self-healing supervisor) ===")
+    return subprocess.run(
+        [sys.executable, os.path.join("tools", "chaos_train.py"),
+         "--smoke"],
+        cwd=ROOT, env=env).returncode
 
 
 def _run_obs_smoke(env) -> int:
@@ -186,6 +203,10 @@ def main():
                     help="skip the obs /metrics + trace self-test "
                          "smoke that --quick/--full append after the "
                          "tests")
+    ap.add_argument("--no-chaos-smoke", action="store_true",
+                    help="skip the self-healing chaos smoke "
+                         "(tools/chaos_train.py --smoke) that "
+                         "--quick/--full append after the tests")
     ap.add_argument("-k", default=None)
     args = ap.parse_args()
     if args.full and args.quick:
@@ -281,6 +302,9 @@ def main():
     if (args.quick or args.full) and not args.no_obs_smoke:
         obs_rc = _run_obs_smoke(cache_env)
         rc = rc or obs_rc
+    if (args.quick or args.full) and not args.no_chaos_smoke:
+        chaos_rc = _run_chaos_smoke(cache_env)
+        rc = rc or chaos_rc
     return rc
 
 

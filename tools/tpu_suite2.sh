@@ -44,6 +44,13 @@ run bench_serving_paged bench_serving_paged.json \
 # self-skips once landed like every other step
 run bench_obs_overhead bench_obs_overhead.json \
     python tools/bench_obs_overhead.py
+# self-healing training chaos gate (ISSUE 11): one supervised run
+# through injected NaN storm / wedged step / loss-spike skip / real
+# SIGTERM requeue+flagless-resume / kill -9 respawn — final state
+# bitwise-identical to the unfaulted run where no window was skipped
+# (trainer children force cpu; safe next to the tunnel); self-skips
+# once landed
+run chaos_train chaos_train.json python tools/chaos_train.py
 # one captured tier trace (ISSUE 8): drives a tiny 2-replica tier and
 # uploads a merged Chrome/Perfetto trace — router forward spans + the
 # serving replicas' engine phase spans, correlated by request id

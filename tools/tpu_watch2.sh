@@ -90,6 +90,7 @@ PY
         /root/repo/tpu_results/tpucost.json \
         /root/repo/tpu_results/bench_obs_overhead.json \
         /root/repo/tpu_results/tier_trace.json \
+        /root/repo/tpu_results/chaos_train.json \
     )
     HAVE_RC=$?
     # landed is decided by the EXIT CODE (rc=0), never by empty stdout:
