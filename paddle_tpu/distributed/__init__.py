@@ -29,9 +29,11 @@ from .resilience import (FaultInjected, FaultInjector, LossSpike,
                          LossSpikeDetector, NanInfStorm,
                          RetryPolicy, StepTimeout, StepWatchdog,
                          restore_train_state, save_train_state,
-                         with_retries)
-from .checkpoint import (gc_checkpoints, latest_checkpoint,
-                         list_checkpoints)
+                         train_state_layout, with_retries)
+from .checkpoint import (describe_layout, gc_checkpoints,
+                         latest_checkpoint, layout_changes,
+                         list_checkpoints, read_layout,
+                         reshard_state_dict)
 from .supervisor import (REQUEUE_EXIT_CODE, SupervisorGaveUp,
                          SupervisorResult, TrainSupervisor)
 from .store import TCPStore
@@ -86,6 +88,8 @@ __all__ = [
     "recompute", "recompute_sequential",
     "save_state_dict", "load_state_dict", "verify_checkpoint", "TCPStore",
     "list_checkpoints", "latest_checkpoint", "gc_checkpoints",
+    "describe_layout", "read_layout", "layout_changes",
+    "reshard_state_dict", "train_state_layout",
     "RetryPolicy", "with_retries", "StepWatchdog", "StepTimeout",
     "NanInfStorm", "LossSpike", "LossSpikeDetector",
     "FaultInjector", "FaultInjected",

@@ -605,6 +605,24 @@ class ParallelTrainStep:
         return Tensor(losses)
 
     # ------------------------------------------------------------------
+    def skip_step(self):
+        """Advance the step/update counters — and with them the
+        per-step RNG fold position and (``auto_lr_step``) the LR
+        schedule — WITHOUT executing the program (the supervisor's
+        poison-window skip; contract identical to
+        ``jit.TrainStep.skip_step``, so ``Model.fit(skip_windows=)``
+        works unchanged on the hybrid-parallel path)."""
+        self.step_count += 1
+        k = self.accumulate_steps
+        if k > 1 and self.step_count % k != 0:
+            return
+        self.update_count += 1
+        if self.auto_lr_step:
+            lr_sched = getattr(self.optimizer, "_learning_rate", None)
+            if hasattr(lr_sched, "step"):
+                lr_sched.step()
+
+    # ------------------------------------------------------------------
     def flush_accumulation(self):
         """Apply a pending partial accumulation window (see
         jit.TrainStep.flush_accumulation). Shardings ride on the arrays."""

@@ -49,7 +49,8 @@ __all__ = [
     "FaultInjector", "FaultInjected", "maybe_inject", "should_fire",
     "wedge_seconds",
     "CheckpointCorrupt",
-    "save_train_state", "restore_train_state", "RngState",
+    "save_train_state", "restore_train_state", "train_state_layout",
+    "RngState",
 ]
 
 
@@ -278,12 +279,18 @@ def with_retries(fn: Callable, *args,
 #   ckpt_gc             checkpoint retention GC fails before deleting
 #                       anything (distributed/checkpoint.gc_checkpoints
 #                       — GC failure must never take training down)
+#   ckpt_reshard        a topology-elastic restore dies MID-reshard
+#                       (checkpoint.reshard_state_dict, after >= 1 leaf
+#                       landed) — restore is read-only, so the
+#                       checkpoint must survive untouched and the next
+#                       attempt must succeed; the supervisor books the
+#                       failure as one restart-budget strike
 _KNOWN_SITES = frozenset([
     "collective", "host_drop", "ckpt_shard", "ckpt_crash",
     "dataloader_worker", "step_hang", "step_nan", "train_crash",
     "serve_backend", "serve_hang",
     "router_forward", "replica_spawn", "replica_health",
-    "train_step_nan", "preempt_signal", "ckpt_gc",
+    "train_step_nan", "preempt_signal", "ckpt_gc", "ckpt_reshard",
 ])
 
 _inject_lock = threading.Lock()
@@ -715,36 +722,79 @@ def _train_state_tree(step) -> Dict[str, Any]:
     }
 
 
-def save_train_state(step, path: str) -> str:
+def train_state_layout(step, scan_steps: Optional[int] = None) -> dict:
+    """The layout manifest of a (Parallel)TrainStep's train state as
+    the live process would save it: mesh (ParallelTrainStep) or
+    single-device (TrainStep), ZeRO stage, fused-window K, and every
+    leaf's placement — what ``save_train_state`` stamps into each
+    checkpoint and ``restore_train_state`` diffs on resume."""
+    from .checkpoint import describe_layout
+    return describe_layout(
+        _train_state_tree(step), mesh=getattr(step, "mesh", None),
+        zero_stage=getattr(step, "zero_stage", None),
+        scan_steps=scan_steps)
+
+
+def save_train_state(step, path: str,
+                     scan_steps: Optional[int] = None) -> str:
     """Atomically checkpoint a (Parallel)TrainStep for crash-resume.
 
     Goes through distributed/checkpoint.py's tmp+rename publish: a kill
     at ANY point leaves either the previous complete checkpoint or none
-    — never a partial directory that looks restorable.
+    — never a partial directory that looks restorable. The layout
+    manifest (mesh/ZeRO/scan-K/per-leaf specs) rides the same commit,
+    making the checkpoint topology-neutral: it can restore onto a
+    DIFFERENT mesh, device count, or ZeRO stage (see
+    ``restore_train_state``).
     """
     from .checkpoint import save_state_dict
-    save_state_dict(_train_state_tree(step), path)
+    save_state_dict(_train_state_tree(step), path,
+                    layout=train_state_layout(step, scan_steps))
     return path
 
 
-def restore_train_state(step, path: str):
-    """Restore ``save_train_state`` output into a freshly-built step.
+def restore_train_state(step, path: str,
+                        scan_steps: Optional[int] = None,
+                        on_reshard: Optional[Callable] = None):
+    """Restore ``save_train_state`` output into a freshly-built step —
+    on ANY topology.
 
-    Params/slots land in the NEW step's shardings (re-shard on load,
-    distributed/checkpoint.py); counters and the host RNG key round-trip
-    so step N after resume draws the same fold_in key as an
-    uninterrupted step N — the contract that makes resume bitwise.
+    Same-layout restores take the whole-tree fast path. When the
+    stamped layout differs from the live step's — different mesh shape
+    (dp4xsharding2 -> dp2xsharding4), device count (8 -> 4 -> 8), ZeRO
+    stage (2 <-> 3) — the reshard path streams the checkpoint leaf by
+    leaf through canonical-layout assembly + re-placement
+    (``checkpoint.reshard_state_dict``), so peak host memory stays ~one
+    leaf; ``on_reshard(saved_layout, live_layout, changes)`` is called
+    after it succeeds (the supervisor's telemetry hook). A changed
+    fused-window ``scan_steps`` alone moves no shards (state is
+    identical either way) and stays on the fast path.
+
+    Counters and the host RNG key round-trip so step N after resume
+    draws the same fold_in key as an uninterrupted step N — the
+    contract that makes resume bitwise; the reshard path preserves it
+    exactly (re-placement moves bytes, never values).
     """
     import jax
     from ..framework import random as _rng
-    from .checkpoint import load_state_dict
+    from .checkpoint import (layout_changes, load_state_dict,
+                             read_layout, reshard_state_dict)
     # meta leaves are plain host scalars/arrays: int placeholders map to
-    # RestoreArgs() (restore-as-saved) in load_state_dict's target walk
-    restored = load_state_dict(
-        path, target={"params": step.params, "buffers": step.buffers,
-                      "opt": step.opt_state,
-                      "meta": {"step_count": 0, "update_count": 0,
-                               "rng_key_data": 0}})
+    # RestoreArgs() (restore-as-saved) in the restore-args target walk
+    target = {"params": step.params, "buffers": step.buffers,
+              "opt": step.opt_state,
+              "meta": {"step_count": 0, "update_count": 0,
+                       "rng_key_data": 0}}
+    saved = read_layout(path)
+    changes: list = []
+    if saved is not None:
+        changes = layout_changes(saved,
+                                 train_state_layout(step, scan_steps))
+    reshard = any(not c.startswith("scan_steps") for c in changes)
+    if reshard:
+        restored = reshard_state_dict(path, target)
+    else:
+        restored = load_state_dict(path, target=target)
     step.params = restored["params"]
     step.buffers = restored["buffers"]
     step.opt_state = restored["opt"]
@@ -753,6 +803,8 @@ def restore_train_state(step, path: str):
     step.update_count = int(meta["update_count"])
     _rng.set_rng_state(jax.random.wrap_key_data(
         jax.numpy.asarray(meta["rng_key_data"])))
+    if reshard and on_reshard is not None:
+        on_reshard(saved, train_state_layout(step, scan_steps), changes)
     return step
 
 

@@ -7,7 +7,11 @@ that hook gradient reduction; here ZeRO is a sharding layout inside the
 ONE compiled program (`ParallelTrainStep(zero_stage=...)`), so
 `group_sharded_parallel` records the requested level on the optimizer
 and returns the pieces unchanged — `ParallelTrainStep` picks the level
-up automatically when `zero_stage` is not passed explicitly.
+up automatically when `zero_stage` is not passed explicitly, including
+when hapi builds it via `Model.prepare(parallel=True)`. The stage also
+rides every train-state checkpoint's layout manifest, so a ZeRO-2
+checkpoint restores onto a ZeRO-3 run (and vice versa) through the
+topology-elastic reshard path (COMPONENTS.md "Elastic resume").
 """
 from __future__ import annotations
 

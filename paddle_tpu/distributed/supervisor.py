@@ -39,6 +39,18 @@ Policy, end to end:
   runs its own in-process supervisor) is respawned from the last
   atomic checkpoint after a ``kill -9``, crash-loop-bounded by the
   same restart budget.
+* **Topology-elastic resume** — every checkpoint carries a layout
+  manifest (mesh shape + axis names, ZeRO stage, scan ``K``, device
+  count, per-leaf sharding specs); on auto-resume the supervisor diffs
+  it against the live step and RESHARDS instead of crashing, so a
+  SIGTERM'd 8-device run genuinely continues on the 4-device slice a
+  preempted pod gets back — no flags. Each reshard is recorded
+  (``ptpu_supervisor_reshards_total``, a manifest incident, a
+  flight-recorder span); a FAILED reshard costs one restart-budget
+  strike and retries (a killed reshard is read-only — the checkpoint
+  survives untouched); a CORRUPT checkpoint (truncated/bit-flipped
+  shard, named per leaf) is discarded and the previous verified entry
+  restores instead.
 
 Determinism contract: resume replays the SAME data stream, so the
 loader must be deterministic and re-iterable (``shuffle=False`` or a
@@ -97,11 +109,11 @@ class SupervisorResult:
 
     __slots__ = ("outcome", "exit_code", "final_step", "restarts",
                  "rollbacks", "respawns", "preemptions", "skipped_steps",
-                 "last_good")
+                 "reshards", "last_good")
 
     def __init__(self, outcome: str, exit_code: int, final_step=None,
                  restarts=0, rollbacks=0, respawns=0, preemptions=0,
-                 skipped_steps=0, last_good=None):
+                 skipped_steps=0, reshards=0, last_good=None):
         self.outcome = outcome
         self.exit_code = int(exit_code)
         self.final_step = final_step
@@ -110,6 +122,7 @@ class SupervisorResult:
         self.respawns = int(respawns)
         self.preemptions = int(preemptions)
         self.skipped_steps = int(skipped_steps)
+        self.reshards = int(reshards)
         self.last_good = last_good
 
     def as_dict(self) -> dict:
@@ -136,7 +149,7 @@ def load_manifest(directory: str) -> dict:
     return {"version": 1, "checkpoints": [], "last_good": None,
             "best": None, "skipped_windows": [], "incidents": [],
             "restarts": 0, "rollbacks": 0, "respawns": 0,
-            "preemptions": 0, "skipped_steps": 0,
+            "preemptions": 0, "skipped_steps": 0, "reshards": 0,
             "done": False, "final_step": None}
 
 
@@ -187,6 +200,9 @@ def _metrics():
         "skipped": reg.counter(
             "ptpu_supervisor_skipped_windows_total",
             "poison data windows skipped by the escalation ladder"),
+        "reshards": reg.counter(
+            "ptpu_supervisor_reshards_total",
+            "topology-elastic checkpoint reshards on resume"),
         "ckpts": reg.counter(
             "ptpu_supervisor_checkpoints_total",
             "verified auto-checkpoints published"),
@@ -357,6 +373,12 @@ class TrainSupervisor:
         self._detector = _resil.LossSpikeDetector(
             window=self.spike_window, z=self.spike_z,
             min_points=self.spike_min_points)
+        # the fused-window K this run will train with — stamped into
+        # every checkpoint's layout manifest so a resume with a changed
+        # K is a visible (info-only) topology diff
+        self._scan_steps = int(self.fit_kwargs.get("scan_steps")
+                               or int_env("PADDLE_TPU_SCAN_STEPS", 1,
+                                          minimum=1))
         self.manifest = load_manifest(self.directory)
         self._m = _metrics()
         self._last_loss: Optional[float] = None
@@ -471,12 +493,23 @@ class TrainSupervisor:
         name = f"{_ckpt.CKPT_PREFIX}{step_n}"
         path = os.path.join(self.directory, name)
         entry = self._ckpt_entry(name)
-        if entry is None or not os.path.isdir(path):
-            _resil.save_train_state(step_obj, path)
+        if entry is None or not _ckpt._committed(path):
+            _resil.save_train_state(step_obj, path,
+                                    scan_steps=self._scan_steps)
             # verification gates last-good: un-verifiable state must
             # never become the rollback target
             _ckpt.verify_checkpoint(path)
-            entry = {"name": name, "step": step_n, "time": time.time()}
+            # topology is stamped ONLY when the bytes are written, and
+            # READ BACK from the dir's own layout manifest (one
+            # derivation — entry and checkpoint agree by construction):
+            # an idempotent re-visit of an existing entry (e.g. a grace
+            # save at an already-checkpointed step after a topology
+            # change) must not re-label state another mesh produced
+            lay = _ckpt.read_layout(path) or {}
+            entry = {"name": name, "step": step_n, "time": time.time(),
+                     "topology": {k: lay.get(k) for k in
+                                  ("mesh", "device_count",
+                                   "zero_stage", "scan_steps")}}
             self.manifest["checkpoints"] = [
                 e for e in self.manifest["checkpoints"]
                 if e.get("name") != name] + [entry]
@@ -535,6 +568,11 @@ class TrainSupervisor:
             model, data, kw = factory()
         kw = dict(kw or {})
         kw.update(self.fit_kwargs)
+        if kw.get("scan_steps"):
+            # a FACTORY may carry the fused-window K (subprocess-mode
+            # trainers ship their whole fit config that way) — the
+            # layout stamp must record what fit will actually run
+            self._scan_steps = int(kw["scan_steps"])
         from ..io.dataloader import DataLoader, Dataset
         if isinstance(data, Dataset):
             # the determinism contract needs a re-iterable,
@@ -557,7 +595,11 @@ class TrainSupervisor:
     def _restore(self, model, loader, path: str):
         step = self._ensure_step(model, loader)
         _ckpt.verify_checkpoint(path)
-        _resil.restore_train_state(step, path)
+        t0 = time.perf_counter()
+        _resil.restore_train_state(
+            step, path, scan_steps=self._scan_steps,
+            on_reshard=lambda saved, live, changes:
+                self._note_reshard(path, saved, live, changes, t0))
         entry = self._ckpt_entry(os.path.basename(path))
         if entry and entry.get("sched") is not None:
             sched = getattr(step.optimizer, "_learning_rate", None)
@@ -568,24 +610,122 @@ class TrainSupervisor:
                     pass
         return step
 
+    def _note_reshard(self, path: str, saved: dict, live: dict,
+                      changes, t0: float):
+        """Book one successful topology-elastic reshard: manifest
+        entry + ptpu_supervisor_reshards_total + flight-recorder span —
+        a resumed run that changed topology must never be silent about
+        it (the post-mortem needs to know which mesh trained what)."""
+        self.manifest["incidents"].append(
+            {"kind": "reshard", "name": os.path.basename(path),
+             "from": _ckpt._mesh_str(saved), "to": _ckpt._mesh_str(live),
+             "changes": list(changes), "time": time.time()})
+        self.manifest["reshards"] = int(
+            self.manifest.get("reshards", 0)) + 1
+        self._write_manifest()
+        if self._m:
+            self._m["reshards"].inc()
+        try:
+            from ..obs import trace as _trace
+            _trace.record_span(
+                "supervisor.reshard", t0, time.perf_counter(),
+                cat="supervisor", ckpt=os.path.basename(path),
+                changes="; ".join(changes))
+        except Exception:
+            pass
+
+    def _discard_corrupt(self, name: str, exc) -> None:
+        """A committed checkpoint whose shard DATA is corrupt (marker
+        intact, bytes truncated/flipped): strip its commit marker — one
+        atomic unlink flips it to "uncommitted", out of every
+        enumeration, so neither this resume nor a later rollback can
+        pick it again — drop it from the book, and record the incident.
+        The next GC pass sweeps the marker-less stray."""
+        path = os.path.join(self.directory, name)
+        try:
+            os.remove(os.path.join(path, _ckpt._COMMIT_MARKER))
+        except OSError:
+            pass
+        self.manifest["checkpoints"] = [
+            e for e in self.manifest["checkpoints"]
+            if e.get("name") != name]
+        for key in ("last_good", "best"):
+            if self.manifest.get(key) == name:
+                self.manifest[key] = None
+        self.manifest["incidents"].append(
+            {"kind": "restore_corrupt", "name": name, "error": str(exc),
+             "action": "fall_back", "time": time.time()})
+        self._write_manifest()
+
     def _resume_or_anchor(self, model, loader):
-        """Flagless auto-resume from the newest restorable checkpoint;
-        on a fresh directory publish the step-0 anchor so the very
-        first incident already has a rollback target."""
+        """Flagless auto-resume from the newest restorable checkpoint —
+        on WHATEVER topology this run has (a changed mesh / device
+        count / ZeRO stage reshards instead of crashing); on a fresh
+        directory publish the step-0 anchor so the very first incident
+        already has a rollback target.
+
+        Failure policy (chaos-gated): a corrupt checkpoint
+        (:class:`CheckpointCorrupt`, naming the offending leaf) is
+        discarded and the PREVIOUS verified entry restores instead; a
+        transient restore failure — e.g. a reshard killed mid-stream
+        (``ckpt_reshard``) — costs one restart-budget strike and
+        retries the SAME checkpoint, which a killed (read-only) reshard
+        is guaranteed to have left untouched; if the same entry fails
+        AGAIN it falls back to the next-older verified one (another
+        strike) instead of burning the whole budget in place."""
         tried = []
         for _step_n, path in reversed(_ckpt.list_checkpoints(
                 self.directory)):
-            try:
-                self._restore(model, loader, path)
-                name = os.path.basename(path)
+            name = os.path.basename(path)
+            attempts = 0
+            while True:
+                try:
+                    self._restore(model, loader, path)
+                except _resil.CheckpointCorrupt as e:
+                    tried.append(f"{name}: {e}")
+                    self._discard_corrupt(name, e)
+                    break                    # fall back to older entry
+                except Exception as e:
+                    attempts += 1
+                    restarts = int(self.manifest.get("restarts", 0))
+                    incident = {"kind": "restore_failed", "name": name,
+                                "step": int(_step_n), "error": str(e),
+                                "time": time.time()}
+                    if restarts >= self.restart_budget:
+                        incident["action"] = "give_up"
+                        self.manifest["incidents"].append(incident)
+                        self.manifest["outcome"] = "gave_up"
+                        self._write_manifest()
+                        raise SupervisorGaveUp(
+                            f"restart budget ({self.restart_budget}) "
+                            f"exhausted restoring {name}: {e}",
+                            self.manifest["incidents"]) from e
+                    # one retry of the SAME entry (a killed reshard is
+                    # read-only — the bytes are intact), then fall back
+                    # to the next-older verified one: a persistent
+                    # non-corrupt failure on the newest entry must not
+                    # burn the whole budget when an older checkpoint
+                    # restores fine. Every attempt costs one strike.
+                    incident["action"] = ("retry" if attempts <= 1
+                                          else "fall_back")
+                    self.manifest["incidents"].append(incident)
+                    self.manifest["restarts"] = restarts + 1
+                    self._write_manifest()
+                    if self._m:
+                        self._m["restarts"].inc()
+                    self.backoff.sleep(
+                        max(1, min(restarts + 1,
+                                   self.backoff.max_attempts - 1)))
+                    if attempts > 1:
+                        tried.append(f"{name}: {e}")
+                        break                # fall back to older entry
+                    continue                 # retry the SAME checkpoint
                 self.manifest["last_good"] = name
                 self._ensure_entry(name)   # torn manifest: re-book it
                 if self._m:
                     self._m["last_good"].set(_step_n)
                 self._write_manifest()
                 return
-            except Exception as e:   # corrupt beyond the marker: older
-                tried.append(f"{os.path.basename(path)}: {e}")
         if tried:
             raise SupervisorGaveUp(
                 "no checkpoint in %r is restorable: %s"
@@ -706,6 +846,7 @@ class TrainSupervisor:
             respawns=m.get("respawns", 0),
             preemptions=m.get("preemptions", 0),
             skipped_steps=m.get("skipped_steps", 0),
+            reshards=m.get("reshards", 0),
             last_good=m.get("last_good"))
 
     def _run_inprocess(self) -> SupervisorResult:

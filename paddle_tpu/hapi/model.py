@@ -57,6 +57,7 @@ class Model:
         self._loss = None
         self._metrics: List[Metric] = []
         self._train_step: Optional[TrainStep] = None
+        self._parallel = None
         self._auto_lr_step = True
         self._accumulate = 1
         self._carried_opt = None
@@ -69,10 +70,21 @@ class Model:
 
     # -- setup -----------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None,
-                amp_configs=None):
+                amp_configs=None, parallel=None):
+        """Parity: Model.prepare. ``parallel`` opts the training loop
+        into the hybrid-parallel engine: with a truthy value every fit
+        step runs through ``distributed.ParallelTrainStep`` over the
+        global mesh instead of the single-chip ``TrainStep`` — pass
+        ``True`` (ZeRO stage picked up from
+        ``sharding.group_sharded_parallel``'s mark on the optimizer) or
+        a kwargs dict forwarded verbatim (``{"zero_stage": 3,
+        "remat": True, ...}``). The supervisor/fit self-healing hooks
+        (resume fast-forward, skip windows, topology-elastic
+        checkpoint restore) work identically on both engines."""
         self._optimizer = optimizer
         self._loss = loss
         self._metrics = _as_list(metrics)
+        self._parallel = parallel
         self._train_step = None
         return self
 
@@ -104,10 +116,21 @@ class Model:
         if self._train_step is None:
             if self._optimizer is None or self._loss is None:
                 raise RuntimeError("call prepare(optimizer, loss) first")
-            self._train_step = TrainStep(
-                self.network, lambda out, *ys: self._loss_value(out, ys),
-                self._optimizer, n_inputs=n_inputs,
-                accumulate_steps=self._accumulate)
+            if self._parallel:
+                from ..distributed.parallel_step import ParallelTrainStep
+                pkw = dict(self._parallel) \
+                    if isinstance(self._parallel, dict) else {}
+                self._train_step = ParallelTrainStep(
+                    self.network,
+                    lambda out, *ys: self._loss_value(out, ys),
+                    self._optimizer, n_inputs=n_inputs,
+                    accumulate_steps=self._accumulate, **pkw)
+            else:
+                self._train_step = TrainStep(
+                    self.network,
+                    lambda out, *ys: self._loss_value(out, ys),
+                    self._optimizer, n_inputs=n_inputs,
+                    accumulate_steps=self._accumulate)
             self._train_step.auto_lr_step = self._auto_lr_step
             if self._carried_opt is not None:
                 import jax as _jax
@@ -270,6 +293,8 @@ class Model:
             return
         inputs, labels = self._split_batch(batch)
         step = self._ensure_train_step(len(inputs))
+        if not hasattr(step, "warm"):
+            return      # hybrid-parallel step: no AOT warmup site yet
         fused = scan_steps > 1 and self._auto_lr_step
         step.warm(*inputs, *labels,
                   scan_k=scan_steps if fused else None,

@@ -76,6 +76,25 @@ def test_zero3_checkpoint_resumes_on_different_topology(tmp_path):
     resumed = [float(b(ids, ids)) for _ in range(3)]
     np.testing.assert_allclose(resumed, ref_losses[3:], rtol=2e-4)
 
+    # run C: the elastic-fleet case (ISSUE 12) — the SAME checkpoint
+    # restored onto a 4-device SLICE via the streaming reshard path,
+    # bitwise against the 8-device source state
+    import jax
+    c = _build({"dp": 2, "sharding": 2}, 3)
+    restored_c = dist.reshard_state_dict(
+        path, target={"params": c.params, "opt": c.opt_state})
+    for n in a.params:
+        np.testing.assert_array_equal(
+            np.asarray(a.params[n]), np.asarray(restored_c["params"][n]))
+    la = jax.tree_util.tree_leaves(a.opt_state)
+    lc = jax.tree_util.tree_leaves(restored_c["opt"])
+    assert len(la) == len(lc) > 0
+    for x, y in zip(la, lc):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    wc = restored_c["params"]["gpt.block_0.mlp.fc_in.weight"]
+    assert wc.sharding.mesh.shape == {"dp": 2, "sharding": 2}
+    assert wc.sharding.mesh.devices.size == 4
+
 
 def test_zero3_crash_resume_bitwise_via_train_state(tmp_path):
     """Acceptance: a checkpoint-on-failure written by the resilience

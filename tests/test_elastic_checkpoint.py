@@ -1,0 +1,480 @@
+"""Topology-elastic checkpoints (ISSUE 12).
+
+Every train-state checkpoint carries a layout manifest (mesh shape +
+axis names, ZeRO stage, per-leaf sharding specs, scan K, device count)
+and restores onto a DIFFERENT topology — mesh reshape, 8->4->8 virtual
+devices, ZeRO-2<->3, changed fused-window K — via the streaming
+reshard path (canonical-layout assembly + re-placement, ~one leaf of
+peak host memory). A truncated/bit-flipped shard raises
+CheckpointCorrupt NAMING the offending leaf; the supervisor falls back
+to the previous verified entry; a reshard killed mid-stream leaves the
+checkpoint untouched and costs one restart-budget strike.
+"""
+import glob
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed import checkpoint as ckpt
+from paddle_tpu.distributed import resilience as resil
+from paddle_tpu.distributed.resilience import (CheckpointCorrupt,
+                                               FaultInjected,
+                                               FaultInjector)
+from paddle_tpu.hapi import Model
+from paddle_tpu.io.dataloader import DataLoader
+
+FAST_BACKOFF = resil.RetryPolicy(max_attempts=16, base_delay=0.0,
+                                 jitter=0.0)
+
+
+@pytest.fixture(autouse=True)
+def fresh_mesh():
+    dist.set_mesh(None)
+    yield
+    dist.set_mesh(None)
+
+
+def _build_step(degrees, zero_stage):
+    dist.set_mesh(None)
+    dist.init_mesh(degrees)
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 8))
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=net.parameters())
+    return dist.ParallelTrainStep(net, lambda o, y: F.mse_loss(o, y),
+                                  opt, zero_stage=zero_stage)
+
+
+def _batch(seed=5):
+    rng = np.random.RandomState(seed)
+    return (paddle.to_tensor(rng.randn(8, 8).astype("float32")),
+            paddle.to_tensor(rng.randn(8, 8).astype("float32")))
+
+
+def _state_bitwise(a, b):
+    import jax
+    for n in a.params:
+        if not np.array_equal(np.asarray(a.params[n]),
+                              np.asarray(b.params[n])):
+            return False
+    la = jax.tree_util.tree_leaves(a.opt_state)
+    lb = jax.tree_util.tree_leaves(b.opt_state)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(la, lb))
+
+
+def _truncate_shards(path):
+    """Corrupt the committed checkpoint's DATA (marker + layout kept):
+    the top-level OCDBT value files hold the array bytes."""
+    files = glob.glob(os.path.join(path, "d", "*"))
+    assert files, "no data files found to corrupt"
+    for f in files:
+        with open(f, "r+b") as fh:
+            fh.truncate(max(1, os.path.getsize(f) // 3))
+
+
+# ---------------------------------------------------------------------------
+# layout manifest
+# ---------------------------------------------------------------------------
+
+def test_layout_manifest_rides_the_commit(tmp_path):
+    x, y = _batch()
+    a = _build_step({"dp": 4, "sharding": 2}, 3)
+    a(x, y)
+    path = str(tmp_path / "ck")
+    dist.save_train_state(a, path, scan_steps=4)
+    lay = dist.read_layout(path)
+    assert lay["mesh"] == {"axes": ["dp", "sharding"], "shape": [4, 2]}
+    assert lay["zero_stage"] == 3 and lay["scan_steps"] == 4
+    assert lay["device_count"] == 8
+    # every leaf is booked with spec/shape/dtype
+    assert "params/0.weight" in lay["leaves"]
+    w = lay["leaves"]["params/0.weight"]
+    assert w["shape"] == [8, 16] and w["dtype"] == "float32"
+    assert isinstance(w["spec"], list)          # mesh-sharded leaf
+    assert lay["leaves"]["meta/step_count"]["spec"] == "host"
+    # the manifest is INSIDE the committed dir (rides the atomic publish)
+    assert os.path.exists(os.path.join(path, ckpt.LAYOUT_NAME))
+    # no changes against itself; info-only change for a different K
+    live = resil.train_state_layout(a, scan_steps=4)
+    assert ckpt.layout_changes(lay, live) == []
+    live1 = resil.train_state_layout(a, scan_steps=1)
+    assert ckpt.layout_changes(lay, live1) == ["scan_steps: 4 -> 1"]
+
+
+# ---------------------------------------------------------------------------
+# reshard-on-restore: mesh / device count / ZeRO stage
+# ---------------------------------------------------------------------------
+
+def test_zero3_8_to_4_to_8_roundtrip_bitwise(tmp_path):
+    """The satellite coverage item: ZeRO-3 state saved on 8 virtual
+    devices restores BITWISE onto the 4-device slice, trains nothing,
+    saves, and restores BITWISE back onto 8 — params, sharded optimizer
+    slots, counters, and RNG all round-trip through two reshards."""
+    import jax
+    x, y = _batch()
+    a = _build_step({"dp": 4, "sharding": 2}, 3)
+    for _ in range(3):
+        a(x, y)
+    rng_before = np.asarray(jax.random.key_data(
+        paddle.framework.random.get_rng_state()))
+    p8 = str(tmp_path / "ck8")
+    dist.save_train_state(a, p8)
+
+    b = _build_step({"dp": 2, "sharding": 2}, 3)   # 4-device slice
+    events = []
+    dist.restore_train_state(b, p8,
+                             on_reshard=lambda s, l, c: events.append(c))
+    assert len(events) == 1          # the reshard path actually ran
+    assert any(c.startswith("device_count: 8 -> 4")
+               for c in events[0])
+    assert _state_bitwise(a, b)
+    assert b.step_count == 3 and b.update_count == 3
+    w = list(b.params.values())[0]
+    assert dict(w.sharding.mesh.shape) == {"dp": 2, "sharding": 2}
+    assert np.array_equal(
+        np.asarray(jax.random.key_data(
+            paddle.framework.random.get_rng_state())), rng_before)
+
+    p4 = str(tmp_path / "ck4")
+    dist.save_train_state(b, p4)
+    assert dist.read_layout(p4)["device_count"] == 4
+
+    c = _build_step({"dp": 4, "sharding": 2}, 3)   # grow back to 8
+    dist.restore_train_state(c, p4,
+                             on_reshard=lambda s, l, ch: events.append(ch))
+    assert len(events) == 2
+    assert _state_bitwise(a, c)
+    assert c.step_count == 3 and c.update_count == 3
+
+
+def test_zero_stage_change_restores_bitwise(tmp_path):
+    """ZeRO-2 <-> ZeRO-3: same state tree, different placements — the
+    reshard path re-places, values identical."""
+    x, y = _batch()
+    a = _build_step({"dp": 4, "sharding": 2}, 3)
+    for _ in range(2):
+        a(x, y)
+    path = str(tmp_path / "ck")
+    dist.save_train_state(a, path)
+    b = _build_step({"dp": 4, "sharding": 2}, 2)
+    events = []
+    dist.restore_train_state(b, path,
+                             on_reshard=lambda s, l, c: events.append(c))
+    assert len(events) == 1
+    assert any(c == "zero_stage: 3 -> 2" for c in events[0])
+    assert _state_bitwise(a, b)
+    # and the resumed trajectory continues (stage change is a layout
+    # change only — the math is topology-independent on this geometry)
+    la = float(a(x, y))
+    lb = float(b(x, y))
+    np.testing.assert_allclose(lb, la, rtol=1e-5)
+
+
+def test_dp_only_reshard_is_bitwise_and_exact_restore_is_fast_path(
+        tmp_path):
+    x, y = _batch()
+    a = _build_step({"dp": 8}, 2)
+    for _ in range(2):
+        a(x, y)
+    path = str(tmp_path / "ck")
+    dist.save_train_state(a, path)
+    # same topology: the fast path (no reshard event)
+    b = _build_step({"dp": 8}, 2)
+    events = []
+    dist.restore_train_state(b, path,
+                             on_reshard=lambda *args: events.append(args))
+    assert events == []
+    assert _state_bitwise(a, b)
+    # dp-only shrink: 8 -> 4 devices, bitwise state
+    c = _build_step({"dp": 4}, 2)
+    dist.restore_train_state(c, path,
+                             on_reshard=lambda *args: events.append(args))
+    assert len(events) == 1
+    assert _state_bitwise(a, c)
+
+
+# ---------------------------------------------------------------------------
+# corrupt-shard diagnostics + killed reshard
+# ---------------------------------------------------------------------------
+
+def test_corrupt_shard_raises_named_checkpoint_corrupt(tmp_path):
+    x, y = _batch()
+    a = _build_step({"dp": 4, "sharding": 2}, 3)
+    a(x, y)
+    path = str(tmp_path / "ck")
+    dist.save_train_state(a, path)
+    _truncate_shards(path)
+    ckpt.verify_checkpoint(path)     # marker intact: "otherwise committed"
+    b = _build_step({"dp": 4, "sharding": 2}, 3)
+    with pytest.raises(CheckpointCorrupt) as ei:
+        dist.restore_train_state(b, path)
+    # the error NAMES a leaf path, not an opaque unpickle/reshape error
+    assert "leaf" in str(ei.value) and "/" in str(ei.value)
+    # the reshard path reports corruption identically
+    c = _build_step({"dp": 2, "sharding": 2}, 3)
+    with pytest.raises(CheckpointCorrupt) as ei2:
+        dist.restore_train_state(c, path)
+    assert "leaf" in str(ei2.value)
+
+
+def test_killed_reshard_leaves_checkpoint_untouched(tmp_path):
+    x, y = _batch()
+    a = _build_step({"dp": 4, "sharding": 2}, 3)
+    a(x, y)
+    path = str(tmp_path / "ck")
+    dist.save_train_state(a, path)
+    snap = sorted(
+        (os.path.relpath(p, path), os.path.getsize(p))
+        for p in glob.glob(os.path.join(path, "**"), recursive=True)
+        if os.path.isfile(p))
+    b = _build_step({"dp": 2, "sharding": 2}, 3)
+    with FaultInjector({"ckpt_reshard": 1}):
+        with pytest.raises(FaultInjected):
+            dist.restore_train_state(b, path)
+    after = sorted(
+        (os.path.relpath(p, path), os.path.getsize(p))
+        for p in glob.glob(os.path.join(path, "**"), recursive=True)
+        if os.path.isfile(p))
+    assert snap == after
+    dist.restore_train_state(b, path)      # next attempt succeeds
+    assert _state_bitwise(a, b)
+
+
+# ---------------------------------------------------------------------------
+# supervisor: elastic resume policy
+# ---------------------------------------------------------------------------
+
+class _Rows:
+    def __init__(self, xs, ys):
+        self.xs, self.ys = xs, ys
+
+    def __len__(self):
+        return len(self.xs)
+
+    def __getitem__(self, i):
+        return self.xs[i], self.ys[i]
+
+
+def _elastic_trainer(degrees, zero_stage=3, epochs=2):
+    dist.set_mesh(None)
+    dist.init_mesh(degrees)
+    paddle.seed(11)
+    net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 8))
+    model = Model(net)
+    opt = paddle.optimizer.AdamW(learning_rate=0.01,
+                                 parameters=net.parameters())
+    model.prepare(optimizer=opt, loss=lambda o, y: F.mse_loss(o, y),
+                  parallel={"zero_stage": zero_stage})
+    rng = np.random.RandomState(5)
+    xs = rng.randn(48, 8).astype("float32")
+    ys = rng.randn(48, 8).astype("float32")
+    loader = DataLoader(_Rows(xs, ys), batch_size=8, shuffle=False)
+    return model, loader, {"epochs": epochs, "verbose": 0}
+
+
+def _sup(model, loader, d, kw, **policy):
+    from paddle_tpu.distributed.supervisor import TrainSupervisor
+    policy.setdefault("ckpt_every", 4)
+    policy.setdefault("max_to_keep", 3)
+    return TrainSupervisor(model, loader, directory=str(d),
+                           fit_kwargs=kw, backoff=FAST_BACKOFF, **policy)
+
+
+def test_supervisor_elastic_resume_reshards_and_records(tmp_path):
+    """Preempt an 8-device ZeRO-3 supervised run; a fresh supervisor on
+    the SAME dir with a 4-device trainer reshards instead of crashing,
+    completes, and the event is visible (manifest incident + counter +
+    per-entry topology stamps)."""
+    from paddle_tpu.distributed.supervisor import load_manifest
+    d = tmp_path / "job"
+    model, loader, kw = _elastic_trainer({"dp": 4, "sharding": 2})
+    with FaultInjector({"preempt_signal": 1}):
+        r = _sup(model, loader, d, kw).run()
+    assert r.outcome == "preempted"
+
+    model2, loader2, kw2 = _elastic_trainer({"dp": 2, "sharding": 2})
+    r2 = _sup(model2, loader2, d, kw2).run()
+    assert r2.outcome == "completed" and r2.final_step == 12
+    assert r2.reshards == 1
+    m = load_manifest(str(d))
+    reshards = [i for i in m["incidents"] if i["kind"] == "reshard"]
+    assert len(reshards) == 1
+    assert reshards[0]["from"] == "dp4xsharding2"
+    assert reshards[0]["to"] == "dp2xsharding2"
+    assert int(m["reshards"]) == 1
+    # satellite bugfix: every entry records the topology that wrote it
+    topo_of = {e["name"]: e["topology"] for e in m["checkpoints"]}
+    assert all(t and t.get("mesh") for t in topo_of.values())
+    assert topo_of[m["last_good"]]["mesh"]["shape"] == [2, 2]
+
+
+def test_supervisor_falls_back_past_corrupt_entry(tmp_path):
+    """The corrupt-shard satellite end to end: the NEWEST checkpoint's
+    shard data is truncated post-commit; resume discards it (incident
+    recorded, marker stripped) and restores the previous verified
+    entry, then completes."""
+    from paddle_tpu.distributed.supervisor import load_manifest
+    d = tmp_path / "job"
+    model, loader, kw = _elastic_trainer({"dp": 4, "sharding": 2})
+    with FaultInjector({"preempt_signal": 1}):
+        r = _sup(model, loader, d, kw, ckpt_every=2).run()
+    assert r.outcome == "preempted"
+    steps = [s for s, _ in ckpt.list_checkpoints(str(d))]
+    assert len(steps) >= 2
+    newest = ckpt.latest_checkpoint(str(d))
+    _truncate_shards(newest)
+
+    model2, loader2, kw2 = _elastic_trainer({"dp": 4, "sharding": 2})
+    r2 = _sup(model2, loader2, d, kw2, ckpt_every=2).run()
+    assert r2.outcome == "completed" and r2.final_step == 12
+    m = load_manifest(str(d))
+    corrupt = [i for i in m["incidents"]
+               if i["kind"] == "restore_corrupt"]
+    assert corrupt and corrupt[0]["name"] == os.path.basename(newest)
+    assert "leaf" in corrupt[0]["error"]
+    # the corrupt entry lost its marker: out of every enumeration
+    assert os.path.basename(newest) not in {
+        os.path.basename(p) for _s, p in ckpt.list_checkpoints(str(d))} \
+        or ckpt._committed(newest)  # unless re-published at that step
+
+
+def test_supervisor_falls_back_after_persistent_restore_failure(
+        tmp_path):
+    """A non-corrupt restore failure on the newest entry is retried
+    ONCE (one strike), then the next-older verified entry restores —
+    the budget is never burned in place while an older checkpoint
+    would heal the run."""
+    from paddle_tpu.distributed.supervisor import load_manifest
+    d = tmp_path / "job"
+    model, loader, kw = _elastic_trainer({"dp": 4, "sharding": 2})
+    with FaultInjector({"preempt_signal": 1}):
+        r = _sup(model, loader, d, kw, ckpt_every=2).run()
+    assert r.outcome == "preempted"
+    assert len(ckpt.list_checkpoints(str(d))) >= 2
+
+    # both attempts on the NEWEST entry die mid-reshard; the fall-back
+    # restore of the older entry (third fire left unarmed) succeeds
+    model2, loader2, kw2 = _elastic_trainer({"dp": 2, "sharding": 2})
+    with FaultInjector({"ckpt_reshard": 2}):
+        r2 = _sup(model2, loader2, d, kw2, ckpt_every=2).run()
+    assert r2.outcome == "completed" and r2.final_step == 12
+    assert r2.restarts >= 2              # retry + fall_back strikes
+    m = load_manifest(str(d))
+    actions = [i["action"] for i in m["incidents"]
+               if i["kind"] == "restore_failed"]
+    assert actions[:2] == ["retry", "fall_back"]
+    names = [i["name"] for i in m["incidents"]
+             if i["kind"] == "restore_failed"]
+    assert names[0] == names[1]          # same (newest) entry twice
+    # fall-back never DISCARDS the failing entry (that is the corrupt
+    # path's move): no restore_corrupt incident, no stripped marker —
+    # the entry simply stops being the resume target (later retention
+    # GC may still prune it like any other superseded checkpoint)
+    assert not any(i["kind"] == "restore_corrupt"
+                   for i in m["incidents"])
+
+
+def test_supervisor_resume_with_changed_scan_steps(tmp_path):
+    """Resume with a different fused-window K (fused<->per-step): no
+    reshard (state is identical), the run completes at the same final
+    step, and the loss trajectory CONTINUES the unfaulted one — the
+    bounded-drift gate (fused windows are bitwise-equal to sequential
+    at tier-1 tested geometries; allclose pins the contract here)."""
+    from paddle_tpu.distributed.supervisor import load_manifest
+    d = tmp_path / "job"
+    model, loader, kw = _elastic_trainer({"dp": 4, "sharding": 2})
+    kw["scan_steps"] = 3
+    with FaultInjector({"preempt_signal": 1}):
+        r = _sup(model, loader, d, kw).run()
+    assert r.outcome == "preempted"
+    lay = dist.read_layout(ckpt.latest_checkpoint(str(d)))
+    assert lay["scan_steps"] == 3
+
+    model2, loader2, kw2 = _elastic_trainer({"dp": 4, "sharding": 2})
+    kw2["scan_steps"] = 1
+    r2 = _sup(model2, loader2, d, kw2).run()
+    assert r2.outcome == "completed" and r2.final_step == 12
+    assert r2.reshards == 0          # K change alone moves no shards
+    m = load_manifest(str(d))
+    assert not any(i["kind"] == "reshard" for i in m["incidents"])
+
+    # trajectory gate: the fused->per-step chain ends where a clean
+    # uninterrupted per-step run ends
+    ref_model, ref_loader, ref_kw = _elastic_trainer(
+        {"dp": 4, "sharding": 2})
+    ref_model.fit(ref_loader, **ref_kw)
+    final = _final_tree_of(d)
+    for n, ref in ref_model._train_step.params.items():
+        np.testing.assert_allclose(
+            np.asarray(final["params"][n]), np.asarray(ref),
+            rtol=1e-6, atol=1e-7)
+
+
+def _final_tree_of(d):
+    path = ckpt.latest_checkpoint(str(d))
+    assert path is not None
+    return ckpt.load_state_dict(path)
+
+
+def test_retention_handles_mixed_topology_entries(tmp_path):
+    """latest_checkpoint / gc_checkpoints over a directory whose
+    entries were saved from DIFFERENT topologies: enumeration is
+    layout-blind, GC never touches the last verified entry."""
+    x, y = _batch()
+    a = _build_step({"dp": 4, "sharding": 2}, 3)
+    a(x, y)
+    dist.save_train_state(a, str(tmp_path / "ckpt-2"))
+    b = _build_step({"dp": 2, "sharding": 2}, 2)
+    dist.restore_train_state(b, str(tmp_path / "ckpt-2"))
+    b(x, y)
+    dist.save_train_state(b, str(tmp_path / "ckpt-4"))
+    c = _build_step({"dp": 8}, 1)
+    dist.restore_train_state(c, str(tmp_path / "ckpt-4"))
+    c(x, y)
+    dist.save_train_state(c, str(tmp_path / "ckpt-6"))
+
+    assert [s for s, _ in ckpt.list_checkpoints(str(tmp_path))] == \
+        [2, 4, 6]
+    assert ckpt.latest_checkpoint(str(tmp_path)).endswith("ckpt-6")
+    layouts = [dist.read_layout(p)["mesh"]
+               for _s, p in ckpt.list_checkpoints(str(tmp_path))]
+    assert len({str(m) for m in layouts}) == 3   # three topologies
+    deleted = ckpt.gc_checkpoints(str(tmp_path), max_to_keep=1)
+    assert {os.path.basename(p) for p in deleted} == {"ckpt-2",
+                                                      "ckpt-4"}
+    # the last verified (newest) entry survives whatever its topology
+    assert ckpt.latest_checkpoint(str(tmp_path)).endswith("ckpt-6")
+    d = _build_step({"dp": 4, "sharding": 2}, 3)
+    dist.restore_train_state(d, str(tmp_path / "ckpt-6"))
+    assert _state_bitwise(c, d)
+
+
+# ---------------------------------------------------------------------------
+# hapi parallel engine under fit
+# ---------------------------------------------------------------------------
+
+def test_model_prepare_parallel_trains_on_mesh(tmp_path):
+    """Model.prepare(parallel=...) routes fit through
+    ParallelTrainStep; skip_windows works on the hybrid engine too."""
+    from paddle_tpu.distributed.parallel_step import ParallelTrainStep
+    model, loader, kw = _elastic_trainer({"dp": 4, "sharding": 2},
+                                         epochs=1)
+    model.fit(loader, **kw)
+    step = model._train_step
+    assert isinstance(step, ParallelTrainStep)
+    assert step.zero_stage == 3 and step.step_count == 6
+    w = step.params["0.weight"]
+    assert dict(w.sharding.mesh.shape) == {"dp": 4, "sharding": 2}
+
+    # skip_windows advances counters without training (TrainStep parity)
+    model2, loader2, kw2 = _elastic_trainer({"dp": 4, "sharding": 2},
+                                            epochs=1)
+    model2.fit(loader2, skip_windows=[(2, 4)], **kw2)
+    assert model2._train_step.step_count == 6

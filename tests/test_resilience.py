@@ -622,7 +622,13 @@ def test_loss_spike_detector_cold_start_and_nonfinite():
 
 
 def test_new_fault_sites_are_known():
-    for site in ("train_step_nan", "preempt_signal", "ckpt_gc"):
+    for site in ("train_step_nan", "preempt_signal", "ckpt_gc",
+                 "ckpt_reshard"):
         assert site in resil._KNOWN_SITES
     assert resil._parse_spec("train_step_nan:3, preempt_signal, ckpt_gc") \
         == {"train_step_nan": 3, "preempt_signal": 1, "ckpt_gc": 1}
+    # ckpt_reshard is a crash-type site: it raises, never sleeps
+    with resil.FaultInjector({"ckpt_reshard": 1}):
+        with pytest.raises(resil.FaultInjected):
+            resil.maybe_inject("ckpt_reshard")
+        resil.maybe_inject("ckpt_reshard")     # count consumed: no-op

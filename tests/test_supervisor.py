@@ -182,6 +182,27 @@ def test_ckpt_gc_fault_site_fires_before_deleting(tmp_path):
     assert [s for s, _ in list_checkpoints(str(tmp_path))] == [1, 2, 3]
 
 
+def test_manifest_entries_record_topology(tmp_path):
+    """ISSUE 12 satellite bugfix: every checkpoint entry carries the
+    topology that produced it (single-device here — the mesh case is
+    tests/test_elastic_checkpoint.py's), and the result surface counts
+    reshards (zero on a topology-stable run)."""
+    d = tmp_path / "job"
+    r = _sup(_make_model(), _make_loader(), d).run()
+    assert r.outcome == "completed" and r.reshards == 0
+    m = load_manifest(str(d))
+    assert m["checkpoints"]
+    for e in m["checkpoints"]:
+        topo = e.get("topology")
+        assert topo is not None
+        assert topo["mesh"] is None and topo["device_count"] == 1
+        assert topo["scan_steps"] == 1
+    # the checkpoint itself is stamped with a layout manifest
+    lay = ckpt.read_layout(latest_checkpoint(str(d)))
+    assert lay is not None and lay["mesh"] is None
+    assert "params/weight" in lay["leaves"]
+
+
 def test_supervised_run_prunes_to_policy(tmp_path, unfaulted):
     d = tmp_path / "job"
     r = _sup(_make_model(), _make_loader(), d, max_to_keep=2).run()

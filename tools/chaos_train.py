@@ -21,6 +21,17 @@ TrainSupervisor promises and asserts the runs actually heal:
              then the poison window is skipped; final state equals a
              clean run told to skip the same window (the
              documented-bounded-drift case, pinned exactly)
+  elastic    topology-elastic checkpoints (ISSUE 12): a ZeRO-3 run on
+             8 virtual devices (dp4 x sharding2) is preempted, resumes
+             on the 4-device slice (dp2 x sharding2, RESHARDING the
+             checkpoint), is preempted again, and grows back to 8 —
+             the shrink/grow chain ends BITWISE-identical to a clean
+             run executed at the new topology from the same step, and
+             every reshard is visible (manifest incident + counter)
+  reshard_kill  an injected ckpt_reshard fault kills the first resume
+             attempt MID-reshard: the checkpoint directory must be
+             byte-identical after the kill, the retry must succeed
+             (one restart-budget strike), and the run completes
 
 Every phase's recovery must be visible: manifest incident records +
 ptpu_supervisor_* counters + a flight-recorder artifact per
@@ -29,6 +40,7 @@ watchdog-detected incident.
 Usage:
     python tools/chaos_train.py            # full gate (spawns children)
     python tools/chaos_train.py --smoke    # in-process phases only
+    python tools/chaos_train.py --elastic  # ONLY the elastic phases
 
 Terminal stdout line is a tools/_have_result.py-good JSON record
 ({"error": ...} + nonzero exit on any unhealed run).
@@ -49,6 +61,41 @@ sys.path.insert(0, ROOT)
 SELF = os.path.abspath(__file__)
 
 STEP_SLEEP = os.environ.get("PTPU_CHAOS_STEP_SLEEP", "0.2")
+
+# The elastic phases need the 8-virtual-device CPU mesh; jax is
+# pre-imported at interpreter startup in this image, so the env must be
+# set BEFORE python starts — re-exec with it (tools/tpulint.py pattern)
+_WANT_FLAG = "--xla_force_host_platform_device_count=8"
+_REEXEC_MARK = "_PADDLE_TPU_CHAOS_REEXEC"
+
+
+def _env_ok() -> bool:
+    # a persistent compile cache also forces the re-exec (which strips
+    # it): reloading cached MULTI-device CPU programs hard-aborts
+    return (os.environ.get(_REEXEC_MARK) == "1"
+            or (os.environ.get("JAX_PLATFORMS") == "cpu"
+                and _WANT_FLAG in os.environ.get("XLA_FLAGS", "")
+                and not os.environ.get("PALLAS_AXON_POOL_IPS")
+                and not os.environ.get("JAX_COMPILATION_CACHE_DIR")))
+
+
+def _reexec():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " + _WANT_FLAG).strip()
+    # the axon sitecustomize registers the TPU backend whenever this
+    # var is set, overriding JAX_PLATFORMS=cpu (tests/conftest.py
+    # documents the hazard) — the chaos phases must stay on the
+    # virtual CPU mesh, never on the real chip next to the tunnel
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    # NO persistent compile cache here: the elastic phases compile
+    # MULTI-device CPU programs, and reloading those from a shared
+    # cache dir hard-aborts the process (the cpu_aot_loader hazard
+    # tests/conftest.py and ci.py document)
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    env[_REEXEC_MARK] = "1"
+    rc = subprocess.call([sys.executable] + sys.argv, env=env)
+    sys.exit(rc)
 
 
 # ---------------------------------------------------------------------------
@@ -108,8 +155,50 @@ def make_poisoned_trainer():
     return _build(poison_at=5)
 
 
+def _build_elastic(degrees, zero_stage=3):
+    """The elastic trainer: one deterministic hybrid-parallel (ZeRO)
+    hapi model on an explicit mesh over a SLICE of the 8 virtual
+    devices — the same weights train at every topology, so
+    preempt/reshard/resume chains can be compared bitwise."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.io.dataloader import DataLoader
+
+    dist.set_mesh(None)
+    dist.init_mesh(degrees)
+    paddle.seed(11)
+    net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 8))
+    model = Model(net)
+    opt = paddle.optimizer.AdamW(learning_rate=0.01,
+                                 parameters=net.parameters())
+    model.prepare(optimizer=opt, loss=lambda o, y: F.mse_loss(o, y),
+                  parallel={"zero_stage": zero_stage})
+    rng = np.random.RandomState(5)
+    xs = rng.randn(48, 8).astype("float32")
+    ys = rng.randn(48, 8).astype("float32")
+    loader = DataLoader(_Rows(xs, ys), batch_size=8, shuffle=False)
+    return model, loader, {"epochs": 3, "verbose": 0}
+
+
+def make_elastic_8():
+    """8 virtual devices: dp4 x sharding2, ZeRO-3."""
+    return _build_elastic({"dp": 4, "sharding": 2})
+
+
+def make_elastic_4():
+    """The 4-device slice a preempted pod gets back: dp2 x sharding2."""
+    return _build_elastic({"dp": 2, "sharding": 2})
+
+
 TOTAL_STEPS = 24        # 12 batches x 2 epochs
+ELASTIC_STEPS = 18      # 6 batches x 3 epochs
 POLICY = {"ckpt_every": 5, "max_to_keep": 3}
+ELASTIC_POLICY = {"ckpt_every": 4, "max_to_keep": 3}
 
 
 # ---------------------------------------------------------------------------
@@ -129,6 +218,48 @@ def _run_inprocess(d, factory=make_trainer, **policy):
                           backoff=_fast_backoff(),
                           **{**POLICY, **policy})
     return sup, sup.run()
+
+
+def _run_elastic(d, factory, preempt_at=None, **policy):
+    """One supervised life of the elastic trainer. ``preempt_at=N``
+    lands the preemption signal at the N-th trained batch of THIS life
+    (what a scheduler SIGTERM mid-run does, deterministically)."""
+    from paddle_tpu.distributed.supervisor import TrainSupervisor
+    from paddle_tpu.hapi.callbacks import Callback
+    model, loader, kw = factory()
+    kw = dict(kw)
+    box = {}
+    if preempt_at is not None:
+        class PreemptAt(Callback):
+            def __init__(self):
+                self.n = 0
+
+            def on_train_batch_end(self, step, logs=None):
+                self.n += 1
+                if self.n == preempt_at:
+                    box["sup"]._note_preempt("elastic_preempt")
+
+        kw["callbacks"] = [PreemptAt()]
+    sup = TrainSupervisor(model, loader, directory=d, fit_kwargs=kw,
+                          backoff=_fast_backoff(),
+                          **{**ELASTIC_POLICY, **policy})
+    box["sup"] = sup
+    return sup, sup.run()
+
+
+def _dir_snapshot(path):
+    """(relpath, content-hash) of every file under a checkpoint dir —
+    the "killed reshard left it BYTE-identical" comparison object
+    (size alone would miss same-length in-place corruption)."""
+    import hashlib
+    out = []
+    for root, _dirs, files in os.walk(path):
+        for fn in sorted(files):
+            full = os.path.join(root, fn)
+            with open(full, "rb") as f:
+                digest = hashlib.sha256(f.read()).hexdigest()
+            out.append((os.path.relpath(full, path), digest))
+    return sorted(out)
 
 
 def _final_tree(d):
@@ -341,6 +472,117 @@ def phase_kill9(work, factory_base):
                         if i["kind"] == "trainer_crash"]}
 
 
+def phase_elastic(work):
+    """Topology-elastic resume, the shrink/grow chain (ISSUE 12):
+    preempt an 8-device ZeRO-3 run, resume it on a 4-device slice
+    (reshard), preempt again, grow back to 8 (reshard) — and the whole
+    chaotic chain must end BITWISE-identical to a clean run executed at
+    the new topology from the same step."""
+    import shutil
+
+    from paddle_tpu.distributed import checkpoint as ckpt_mod
+    from paddle_tpu.distributed import resilience as resil_mod
+    from paddle_tpu.distributed.supervisor import (REQUEUE_EXIT_CODE,
+                                                   load_manifest)
+    d = os.path.join(work, "elastic")
+
+    # leg 1: 8 virtual devices (dp4 x sharding2), preempted mid-run
+    _s1, r1 = _run_elastic(d, make_elastic_8, preempt_at=6)
+    _assert(r1.outcome == "preempted"
+            and r1.exit_code == REQUEUE_EXIT_CODE,
+            f"elastic leg 1 did not requeue: {r1.as_dict()}")
+
+    # leg 2: flagless resume on the 4-device slice — reshards 8->4
+    _s2, r2 = _run_elastic(d, make_elastic_4, preempt_at=4)
+    _assert(r2.outcome == "preempted" and r2.reshards >= 1,
+            f"elastic leg 2 did not reshard+requeue: {r2.as_dict()}")
+
+    # the grow point: snapshot the directory for the clean comparator
+    d_cmp = os.path.join(work, "elastic_cmp")
+    shutil.copytree(d, d_cmp)
+    resume_path = ckpt_mod.latest_checkpoint(d_cmp)
+    _assert(resume_path is not None, "no checkpoint at the grow point")
+    saved_layout = ckpt_mod.read_layout(resume_path)
+    _assert(saved_layout and ckpt_mod._mesh_str(saved_layout)
+            == "dp2xsharding2",
+            f"grow-point checkpoint not stamped from the 4-device "
+            f"slice: {saved_layout and ckpt_mod._mesh_str(saved_layout)}")
+
+    # leg 3: grow back to 8 devices — reshards 4->8 and completes
+    _s3, r3 = _run_elastic(d, make_elastic_8)
+    _assert(r3.outcome == "completed"
+            and r3.final_step == ELASTIC_STEPS and r3.reshards >= 1,
+            f"elastic leg 3 did not reshard+complete: {r3.as_dict()}")
+    final = _final_tree(d)
+
+    # recovery must be visible: reshard incidents name the topologies,
+    # every checkpoint entry is stamped with the mesh that produced it
+    m = load_manifest(d)
+    reshards = [i for i in m["incidents"] if i["kind"] == "reshard"]
+    transitions = [(i["from"], i["to"]) for i in reshards]
+    _assert(("dp4xsharding2", "dp2xsharding2") in transitions
+            and ("dp2xsharding2", "dp4xsharding2") in transitions,
+            f"reshard incidents missing the 8->4->8 chain: {transitions}")
+    _assert(all(e.get("topology") for e in m["checkpoints"]),
+            f"manifest entries are topology-blind: {m['checkpoints']}")
+    last_good = next(e for e in m["checkpoints"]
+                     if e["name"] == m["last_good"])
+    _assert(last_good["topology"]["mesh"]["shape"] == [4, 2],
+            f"final entry not stamped with the grown 8-device mesh: "
+            f"{last_good['topology']}")
+
+    # clean comparator: the SAME grow-point checkpoint restored at the
+    # new topology WITHOUT the supervisor, trained to completion — the
+    # chaotic chain must match it bitwise (params AND opt slots)
+    model, loader, kw = make_elastic_8()
+    kw.pop("callbacks", None)
+    batch = next(iter(loader))
+    x, _y = model._split_batch(batch)
+    model._ensure_train_step(len(x))
+    resil_mod.restore_train_state(model._train_step, resume_path)
+    start = int(model._train_step.step_count)
+    model.fit(loader, resume_step=start, **kw)
+    _assert(int(model._train_step.step_count) == ELASTIC_STEPS,
+            "comparator did not reach the end")
+    _assert(_bitwise(final["params"], model._train_step.params) and
+            _bitwise(final["opt"], model._train_step.opt_state),
+            "elastic chain drifted from the clean run at the new "
+            "topology")
+    return {"transitions": transitions, "resumed_from": start,
+            "final_step": r3.final_step}
+
+
+def phase_reshard_kill(work):
+    """A reshard killed mid-stream must leave the checkpoint directory
+    untouched, cost ONE restart-budget strike, and succeed on retry."""
+    from paddle_tpu.distributed.resilience import FaultInjector
+    from paddle_tpu.distributed import checkpoint as ckpt_mod
+    from paddle_tpu.distributed.supervisor import load_manifest
+    d = os.path.join(work, "reshard_kill")
+    _s1, r1 = _run_elastic(d, make_elastic_8, preempt_at=5)
+    _assert(r1.outcome == "preempted",
+            f"reshard_kill setup did not preempt: {r1.as_dict()}")
+    path = ckpt_mod.latest_checkpoint(d)
+    before = _dir_snapshot(path)
+
+    with FaultInjector({"ckpt_reshard": 1}):
+        _s2, r2 = _run_elastic(d, make_elastic_4, max_to_keep=99)
+    _assert(r2.outcome == "completed"
+            and r2.final_step == ELASTIC_STEPS,
+            f"killed reshard did not heal: {r2.as_dict()}")
+    _assert(r2.restarts >= 1 and r2.reshards >= 1,
+            f"killed reshard cost no budget strike: {r2.as_dict()}")
+    _assert(_dir_snapshot(path) == before,
+            "killed reshard modified the checkpoint directory")
+    m = load_manifest(d)
+    fails = [i for i in m["incidents"] if i["kind"] == "restore_failed"]
+    _assert(fails and fails[0]["action"] == "retry"
+            and "ckpt_reshard" in fails[0]["error"],
+            f"restore_failed incident missing/wrong: {fails}")
+    return {"strikes": r2.restarts,
+            "failed_ckpt": fails[0]["name"]}
+
+
 # ---------------------------------------------------------------------------
 # main
 # ---------------------------------------------------------------------------
@@ -351,49 +593,77 @@ def main(argv=None) -> int:
     ap.add_argument("--smoke", action="store_true",
                     help="in-process phases only (no child processes) — "
                          "the ci.py --quick chaos smoke")
+    ap.add_argument("--elastic", action="store_true",
+                    help="ONLY the topology-elastic phases (8->4->8 "
+                         "reshard-on-resume + killed-reshard retry) — "
+                         "the ci.py --quick elastic smoke")
     args = ap.parse_args(argv)
+
+    if (args.elastic or not args.smoke) and not _env_ok():
+        _reexec()      # elastic phases need the 8-virtual-device mesh
 
     work = tempfile.mkdtemp(prefix="paddle_tpu_chaos_")
     obs_dir = os.path.join(work, "obs")
     os.environ["PADDLE_TPU_OBS_DIR"] = obs_dir
     os.makedirs(obs_dir, exist_ok=True)
 
-    record = {"mode": "smoke" if args.smoke else "full", "phases": {}}
+    mode = "elastic" if args.elastic else (
+        "smoke" if args.smoke else "full")
+    record = {"mode": mode, "phases": {}}
+    run_base = not args.elastic
+    run_elastic = args.elastic or not args.smoke
     t0 = time.monotonic()
     try:
-        base, info = phase_baseline(work)
-        record["phases"]["baseline"] = info
-        record["phases"]["nan_storm"] = phase_nan_storm(work, base,
-                                                        obs_dir)
-        record["phases"]["wedge"] = phase_wedge(work, base, obs_dir)
-        record["phases"]["preempt"] = phase_preempt(work, base)
-        record["phases"]["skip"] = phase_skip_window(work)
-        if not args.smoke:
-            record["phases"]["sigterm"] = phase_sigterm(work, base)
-            record["phases"]["kill9"] = phase_kill9(work, base)
+        if run_base:
+            base, info = phase_baseline(work)
+            record["phases"]["baseline"] = info
+            record["phases"]["nan_storm"] = phase_nan_storm(work, base,
+                                                            obs_dir)
+            record["phases"]["wedge"] = phase_wedge(work, base, obs_dir)
+            record["phases"]["preempt"] = phase_preempt(work, base)
+            record["phases"]["skip"] = phase_skip_window(work)
+            if not args.smoke:
+                record["phases"]["sigterm"] = phase_sigterm(work, base)
+                record["phases"]["kill9"] = phase_kill9(work, base)
+        if run_elastic:
+            record["phases"]["elastic"] = phase_elastic(work)
+            record["phases"]["reshard_kill"] = phase_reshard_kill(work)
         # every recovery must be visible in the supervisor metrics
         from paddle_tpu import obs
         if obs.enabled():
             reg = obs.metrics.registry
-            rb = reg.get("ptpu_supervisor_rollbacks_total")
-            record["metrics"] = {
-                "rollbacks_nan_storm": rb.value(reason="nan_storm"),
-                "rollbacks_hang": rb.value(reason="hang"),
-                "rollbacks_loss_spike": rb.value(reason="loss_spike"),
-                "preemptions": reg.get(
-                    "ptpu_supervisor_preemptions_total").value(),
-                "skipped_windows": reg.get(
-                    "ptpu_supervisor_skipped_windows_total").value(),
-                "checkpoints": reg.get(
-                    "ptpu_supervisor_checkpoints_total").value(),
-            }
-            _assert(record["metrics"]["rollbacks_nan_storm"] >= 1
-                    and record["metrics"]["rollbacks_hang"] >= 1
-                    and record["metrics"]["rollbacks_loss_spike"] >= 1
-                    and record["metrics"]["preemptions"] >= 1
-                    and record["metrics"]["skipped_windows"] >= 1,
-                    f"recovery not visible in ptpu_supervisor_* "
-                    f"metrics: {record['metrics']}")
+            record["metrics"] = {}
+            if run_base:
+                rb = reg.get("ptpu_supervisor_rollbacks_total")
+                record["metrics"].update({
+                    "rollbacks_nan_storm": rb.value(reason="nan_storm"),
+                    "rollbacks_hang": rb.value(reason="hang"),
+                    "rollbacks_loss_spike": rb.value(
+                        reason="loss_spike"),
+                    "preemptions": reg.get(
+                        "ptpu_supervisor_preemptions_total").value(),
+                    "skipped_windows": reg.get(
+                        "ptpu_supervisor_skipped_windows_total").value(),
+                    "checkpoints": reg.get(
+                        "ptpu_supervisor_checkpoints_total").value(),
+                })
+                _assert(record["metrics"]["rollbacks_nan_storm"] >= 1
+                        and record["metrics"]["rollbacks_hang"] >= 1
+                        and record["metrics"]["rollbacks_loss_spike"]
+                        >= 1
+                        and record["metrics"]["preemptions"] >= 1
+                        and record["metrics"]["skipped_windows"] >= 1,
+                        f"recovery not visible in ptpu_supervisor_* "
+                        f"metrics: {record['metrics']}")
+            if run_elastic:
+                record["metrics"]["reshards"] = reg.get(
+                    "ptpu_supervisor_reshards_total").value()
+                # 8->4 + 4->8 in phase_elastic, + the killed-reshard
+                # retry's successful 8->4 in phase_reshard_kill
+                _assert(record["metrics"]["reshards"] >= 3,
+                        f"reshards not visible in "
+                        f"ptpu_supervisor_reshards_total: "
+                        f"{record['metrics']}")
         record["elapsed_s"] = round(time.monotonic() - t0, 1)
         record["ok"] = True
         print(json.dumps(record))

@@ -74,6 +74,11 @@ QUICK_FILES = [
     # bitwise, preemption requeues + resumes flaglessly, retention GC
     # never touches the last verified checkpoint, kill -9 respawn
     "tests/test_supervisor.py",
+    # topology-elastic checkpoints (ISSUE 12): layout manifest stamped
+    # per checkpoint, 8->4->8 / ZeRO-stage / scan-K reshard-on-restore
+    # bitwise, corrupt shards NAMED per leaf + supervisor fall-back,
+    # killed reshard leaves the checkpoint untouched
+    "tests/test_elastic_checkpoint.py",
 ]
 
 
@@ -87,6 +92,21 @@ def _run_chaos_smoke(env) -> int:
     return subprocess.run(
         [sys.executable, os.path.join("tools", "chaos_train.py"),
          "--smoke"],
+        cwd=ROOT, env=env).returncode
+
+
+def _run_elastic_smoke(env) -> int:
+    """Elastic smoke (ISSUE 12): tools/chaos_train.py --elastic drives
+    a ZeRO-3 supervised run through an 8->4->8 virtual-device
+    preempt/reshard/resume chain (bitwise vs a clean run at the new
+    topology) plus a killed-reshard retry — the topology-elastic
+    checkpoint guarantee, in-process only. The tool re-execs itself
+    onto the 8-virtual-device CPU mesh WITHOUT the persistent compile
+    cache (multi-device reload hazard)."""
+    print("\n=== elastic smoke (topology-elastic checkpoints) ===")
+    return subprocess.run(
+        [sys.executable, os.path.join("tools", "chaos_train.py"),
+         "--elastic"],
         cwd=ROOT, env=env).returncode
 
 
@@ -207,6 +227,10 @@ def main():
                     help="skip the self-healing chaos smoke "
                          "(tools/chaos_train.py --smoke) that "
                          "--quick/--full append after the tests")
+    ap.add_argument("--no-elastic-smoke", action="store_true",
+                    help="skip the topology-elastic chaos smoke "
+                         "(tools/chaos_train.py --elastic) that "
+                         "--quick/--full append after the tests")
     ap.add_argument("-k", default=None)
     args = ap.parse_args()
     if args.full and args.quick:
@@ -305,6 +329,11 @@ def main():
     if (args.quick or args.full) and not args.no_chaos_smoke:
         chaos_rc = _run_chaos_smoke(cache_env)
         rc = rc or chaos_rc
+    if (args.quick or args.full) and not args.no_elastic_smoke:
+        # plain env (not cache_env): the tool strips the persistent
+        # cache itself, but don't even offer it the multi-device trap
+        elastic_rc = _run_elastic_smoke(env)
+        rc = rc or elastic_rc
     return rc
 
 

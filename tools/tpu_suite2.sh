@@ -51,6 +51,14 @@ run bench_obs_overhead bench_obs_overhead.json \
 # (trainer children force cpu; safe next to the tunnel); self-skips
 # once landed
 run chaos_train chaos_train.json python tools/chaos_train.py
+# topology-elastic checkpoints (ISSUE 12): 8->4->8 virtual-device
+# ZeRO-3 preempt/reshard/resume chain ends bitwise-identical to a
+# clean run at the new topology from the same step, and a reshard
+# killed mid-stream leaves the checkpoint untouched + retries under
+# the restart budget (the tool re-execs onto the 8-virtual-device
+# CPU mesh itself — safe next to the tunnel); self-skips once landed
+run chaos_train_elastic chaos_train_elastic.json \
+    python tools/chaos_train.py --elastic
 # one captured tier trace (ISSUE 8): drives a tiny 2-replica tier and
 # uploads a merged Chrome/Perfetto trace — router forward spans + the
 # serving replicas' engine phase spans, correlated by request id

@@ -91,6 +91,7 @@ PY
         /root/repo/tpu_results/bench_obs_overhead.json \
         /root/repo/tpu_results/tier_trace.json \
         /root/repo/tpu_results/chaos_train.json \
+        /root/repo/tpu_results/chaos_train_elastic.json \
     )
     HAVE_RC=$?
     # landed is decided by the EXIT CODE (rc=0), never by empty stdout:
