@@ -39,7 +39,8 @@ from .codebase_lint import (HOT_JIT_FILES, lint_file, lint_quarantine,
 from .manifest import (MANIFEST_PROGRAMS, ProgramSpec, default_manifest,
                        manifest_names, run_manifest)
 from .hlo_cost import (CHIP_SPECS, DEFAULT_CHIP, ChipSpec,
-                       analytic_decode_hbm_bytes, check_cost_baseline,
+                       analytic_decode_hbm_bytes,
+                       analytic_verify_hbm_bytes, check_cost_baseline,
                        collect_kernels, load_cost_baseline,
                        parse_hlo_module, program_cost,
                        updated_cost_baseline)
@@ -56,6 +57,7 @@ __all__ = [
     "MANIFEST_PROGRAMS", "manifest_names",
     "ChipSpec", "CHIP_SPECS", "DEFAULT_CHIP", "parse_hlo_module",
     "program_cost", "collect_kernels", "analytic_decode_hbm_bytes",
+    "analytic_verify_hbm_bytes",
     "check_cost_baseline", "load_cost_baseline",
     "updated_cost_baseline", "fusion_histogram", "unfused_chains",
     "write_report_artifact", "terminal_record",

@@ -56,6 +56,7 @@ __all__ = [
     "ChipSpec", "CHIP_SPECS", "DEFAULT_CHIP", "HLO_DTYPE_BYTES",
     "parse_hlo_module", "program_cost", "collect_kernels", "KernelCost",
     "analytic_decode_hbm_bytes", "analytic_paged_decode_hbm_bytes",
+    "analytic_verify_hbm_bytes",
     "check_cost_baseline",
     "load_cost_baseline", "updated_cost_baseline",
 ]
@@ -624,6 +625,30 @@ def analytic_paged_decode_hbm_bytes(geometry: dict) -> int:
                   + 3 * geometry["kv_view_bytes"]))
 
 
+def analytic_verify_hbm_bytes(geometry: dict) -> int:
+    """Analytic HBM bytes for one speculative VERIFY-K dispatch
+    (ISSUE 13) — the k-token bound that makes the multi-token tick a
+    bandwidth win. The verify program is ONE target forward over the
+    [tok, d1..dk] block for every slot: weights stream ONCE and the KV
+    cache makes the 7 passes the dense decode micro-step pays (masked
+    block write read+write, layout fusion read+write, donated-carry
+    copy read+write, attention read) ONCE —
+
+        param_bytes + 7 * kv_cache_bytes
+
+    versus the plain tick's ``tick_tokens * (param_bytes + 7 *
+    kv_cache_bytes)``: per EMITTED token the verify dispatch moves up
+    to (k+1)x fewer bytes (acceptance decides how much of the bound is
+    realized). The measured program sits ~1.27x above this bound: the
+    per-row BLOCK write (take_along_axis of the k+1 incoming rows per
+    cache position + dense select) materializes its gathered values at
+    cache scale — roughly two extra cache passes the S=1 one-hot write
+    doesn't pay; the anchor's max_ratio carries that headroom, so one
+    MORE full cache pass or weight stream (re-per-tokenizing the
+    block) still fails CI."""
+    return int(geometry["param_bytes"] + 7 * geometry["kv_cache_bytes"])
+
+
 # ---------------------------------------------------------------------------
 # baseline gate (tools/tpucost_baseline.json)
 # ---------------------------------------------------------------------------
@@ -807,6 +832,29 @@ def check_cost_baseline(inventories: Dict[str, dict],
                     "into the tick",
                     {"measured": inv["hbm_bytes"], "analytic": bound,
                      "ratio": round(ratio, 4)}))
+        elif kind == "verify_hbm":
+            geom = geometries.get(name) or {}
+            try:
+                bound = analytic_verify_hbm_bytes(geom)
+            except KeyError:
+                findings.append(Finding(
+                    COST_ANCHOR, Severity.ERROR, name, "verify_hbm",
+                    "verify_hbm anchor needs geometry metadata "
+                    "(param_bytes, kv_cache_bytes) on the registered "
+                    "site's BuildResult", {}))
+                continue
+            ratio = inv["hbm_bytes"] / bound if bound else float("inf")
+            if ratio > float(a.get("max_ratio", 1.15)):
+                findings.append(Finding(
+                    COST_ANCHOR, Severity.ERROR, name, "verify_hbm",
+                    f"verify-k dispatch models {inv['hbm_bytes']} HBM "
+                    f"bytes = {ratio:.3f}x the analytic single-pass "
+                    f"k-token bound {bound} (max "
+                    f"{a.get('max_ratio', 1.15)}x) — an extra weight "
+                    "stream or cache pass re-per-tokenized the verify "
+                    "block",
+                    {"measured": inv["hbm_bytes"], "analytic": bound,
+                     "ratio": round(ratio, 4)}))
         elif kind == "matmul_share_floor":
             floor = float(a.get("min_share", 0.0))
             if inv["matmul_flop_share"] < floor:
@@ -823,7 +871,7 @@ def check_cost_baseline(inventories: Dict[str, dict],
             findings.append(Finding(
                 COST_ANCHOR, Severity.ERROR, name, "unknown-kind",
                 f"anchor for {name!r} has unknown kind {kind!r} "
-                "(valid: decode_hbm, decode_hbm_paged, "
+                "(valid: decode_hbm, decode_hbm_paged, verify_hbm, "
                 "matmul_share_floor) — the "
                 "invariant was NOT evaluated; fix the baseline",
                 {"kind": kind}))

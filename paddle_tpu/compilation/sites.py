@@ -156,6 +156,57 @@ def build_gpt_admit_paged() -> BuildResult:
     return BuildResult(prog, args, cleanup=eng.stop, geometry=geometry)
 
 
+def _tiny_spec_engine():
+    """Speculative (n-gram) variant of the tiny engine — the fixture
+    behind the gpt_verify_k registry site. Slot cache: the verify
+    block's cache traffic, not paging, is what the verify anchor
+    prices."""
+    from ..inference.engine import ContinuousBatchingEngine
+    model = _gpt_tiny_model()
+    return ContinuousBatchingEngine(model, slots=4, max_len=64,
+                                    cache_dtype="float32", tick_tokens=4,
+                                    speculative="ngram", spec_k=4)
+
+
+def build_gpt_verify_k() -> BuildResult:
+    """The speculative engine's batched verify-k program: ONE target
+    forward scores k+1 positions for every slot (proposals, draft
+    lengths, positions and live mask all ride as arguments — the
+    zero-recompile contract tpulint pins)."""
+    eng = _tiny_spec_engine()
+    prog = eng._get_verify_prog()
+    args = eng._verify_example_args()
+    K = eng._spec.k
+    geometry = {
+        "kind": "verify", "slots": eng.slots, "max_len": eng.max_len,
+        "spec_k": K, "block_tokens": K + 1,
+        "tokens_per_exec": eng.slots * (K + 1),
+        "param_bytes": _tree_nbytes((eng._params, eng._buffers)),
+        "kv_cache_bytes": _tree_nbytes(eng._caches),
+    }
+    return BuildResult(prog, args, cleanup=eng.stop, geometry=geometry)
+
+
+def build_gpt_draft_decode() -> BuildResult:
+    """The draft-model proposer's batched decode program: the 2-token
+    sync block + a k-step greedy draft scan over the draft's own slot
+    cache — [N, k] proposals per dispatch."""
+    from ..inference.speculative import DraftModelProposer
+    model = _gpt_tiny_model()
+    prop = DraftModelProposer(model, slots=4, max_len=64, k=4,
+                              cache_dtype="float32")
+    prog = prop._get_decode_prog()
+    args = prop._decode_example_args()
+    geometry = {
+        "kind": "draft_decode", "slots": prop.slots,
+        "max_len": prop.max_len, "spec_k": prop.k,
+        "tokens_per_exec": prop.slots * prop.k,
+        "param_bytes": _tree_nbytes((prop._params, prop._buffers)),
+        "kv_cache_bytes": _tree_nbytes(prop._caches),
+    }
+    return BuildResult(prog, args, geometry=geometry)
+
+
 def _llama_tiny_programs():
     import jax
     from ..models.llama import LlamaConfig, LlamaForCausalLM
@@ -340,6 +391,14 @@ def ensure_registered() -> None:
              tags=("manifest", "serving"),
              description="paged-engine suffix admission program "
                          "(page-masked prefill append)")
+    register("gpt_verify_k", build_gpt_verify_k,
+             tags=("manifest", "serving"),
+             description="speculative batched verify-k program "
+                         "(one forward scores k+1 positions per slot)")
+    register("gpt_draft_decode", build_gpt_draft_decode,
+             tags=("manifest", "serving"),
+             description="draft-model proposer decode program "
+                         "(sync block + k-step greedy draft scan)")
     # only now: a failure above (e.g. a consumer squatting a canonical
     # name) must stay loud on every retry, not flip the flag and leave
     # the registry silently half-populated for the rest of the process

@@ -32,7 +32,10 @@ def enable_nan_inf_check(level: int = 0):
 
 
 def disable_nan_inf_check():
-    flags.set_flags({"check_nan_inf": False})
+    # reset the level too: a leftover log-only level would silently
+    # downgrade the NEXT arm-site's raise path to a warning (leaked
+    # across tests/processes that re-arm via FLAGS_check_nan_inf alone)
+    flags.set_flags({"check_nan_inf": False, "check_nan_inf_level": 0})
     try:
         jax.config.update("jax_debug_nans", False)
     except Exception:

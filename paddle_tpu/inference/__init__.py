@@ -13,6 +13,8 @@ reference so serving code ports directly.
 """
 from .engine import (CacheExhausted, ContinuousBatchingEngine,
                      EngineOverloaded, GenerationPredictor)
+from .speculative import (DraftModelProposer, NGramProposer,
+                          SpeculativeConfig)
 from .router import Replica, ReplicaSpec, Router
 from .predictor import (Config, DataType, PlaceType, PrecisionType,
                         Predictor, PredictorPool, Tensor,
@@ -26,6 +28,7 @@ __all__ = ["Config", "Predictor", "create_predictor", "Tensor",
            "PlaceType", "DataType", "PrecisionType", "PredictorPool",
            "ContinuousBatchingEngine", "EngineOverloaded",
            "CacheExhausted", "GenerationPredictor",
+           "SpeculativeConfig", "NGramProposer", "DraftModelProposer",
            "Router", "ReplicaSpec", "Replica",
            "get_version", "get_num_bytes_of_data_type",
            "get_trt_compile_version", "get_trt_runtime_version",

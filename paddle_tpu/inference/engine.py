@@ -71,13 +71,35 @@ padding) contributes exact zeros through softmax(-1e30) — asserted in
 tests/test_paged_engine.py, including int8 pools and shared-prefix
 admissions.
 
+Speculative mode (``speculative=`` / PADDLE_TPU_SERVE_SPEC — ISSUE 13,
+ROADMAP item 2): the decode tick above still pays one model forward
+per emitted token. With speculative decoding on, the tick loop swaps
+the plain tick for a DRAFT -> VERIFY pair (inference/speculative.py):
+a proposer drafts up to k candidate tokens per slot (host-side n-gram
+self-drafting, or a small draft model's own registered decode
+program), and ONE jitted batched verify program scores all k+1
+positions for every slot in a single target forward — per-slot
+proposal vectors, draft lengths, positions and live masks ride as
+int32/bool arguments, so k-drift / acceptance-pattern drift / prompt
+drift never recompile. The emitted block is the TARGET's own argmax at
+every position, so greedy speculative output is bitwise
+token-identical to plain decode (f32 and int8, slot and paged caches —
+tier-1 asserted); acceptance only decides how many tokens each tick
+consumes. Rejected positions need no KV rollback: their garbage KV
+sits above the row's true length behind the causal mask (and, paged,
+behind the live write gate in the slot's PRIVATE pages) until the true
+token overwrites it. Greedy only — ``do_sample`` rejects loudly.
+
 Env knobs: PADDLE_TPU_SERVE_SLOTS (default 8),
 PADDLE_TPU_SERVE_PREFILL_BUCKETS (comma list, default powers of two),
 PADDLE_TPU_SERVE_TICK_TOKENS (default 8),
 PADDLE_TPU_SERVE_MAX_QUEUE (default 32),
 PADDLE_TPU_SERVE_PAGED (default 0), PADDLE_TPU_KV_PAGE (page size,
 default 16), PADDLE_TPU_SERVE_NUM_PAGES (default slots *
-ceil(max_len/page) — the slot engine's exact byte budget).
+ceil(max_len/page) — the slot engine's exact byte budget),
+PADDLE_TPU_SERVE_SPEC ("ngram" to self-draft, default off),
+PADDLE_TPU_SERVE_SPEC_K (draft tokens per tick, default 4),
+PADDLE_TPU_SERVE_SPEC_NGRAM (max suffix n-gram, default 3).
 """
 from __future__ import annotations
 
@@ -186,6 +208,8 @@ class _Request:
     future: Future = field(default_factory=Future)
     rid: str = ""                # request id (obs span correlation)
     t_submit: float = 0.0        # perf_counter at submit (obs only)
+    drafted: int = 0             # speculative: tokens proposed for me
+    accepted: int = 0            # speculative: proposals accepted
 
 
 class _Slot:
@@ -232,7 +256,9 @@ class ContinuousBatchingEngine:
                  paged: Optional[bool] = None,
                  page_size: Optional[int] = None,
                  num_pages: Optional[int] = None,
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True,
+                 speculative=None, spec_k: Optional[int] = None,
+                 spec_ngram: Optional[int] = None, draft_model=None):
         self.model = model
         self.slots = int(slots if slots is not None
                          else _env_int("PADDLE_TPU_SERVE_SLOTS", 8))
@@ -267,6 +293,24 @@ class ContinuousBatchingEngine:
         self.cache_dtype = cache_dtype
         self._sampling = (bool(do_sample), float(temperature),
                           int(top_k), float(top_p))
+
+        # speculative decoding (inference/speculative.py, ISSUE 13)
+        from .speculative import (DraftModelProposer, NGramProposer,
+                                  resolve_speculative)
+        self._spec = resolve_speculative(speculative, spec_k,
+                                         spec_ngram, draft_model)
+        if self._spec is not None and do_sample:
+            raise ValueError(
+                "speculative decoding is greedy-only (acceptance is "
+                "exact token equality against the target argmax); "
+                "do_sample engines must run plain decode")
+        # worst-case tokens a slot can overshoot its budget by in one
+        # tick: tick_tokens plain, k+1 per verify dispatch — and the
+        # verify block WRITES cache positions up to pos + k, so the
+        # same bound sizes the cache-length check and page footprints
+        self._overshoot = (max(self.tick_tokens, self._spec.k + 1)
+                           if self._spec is not None
+                           else self.tick_tokens)
 
         # paged KV cache config (module docstring, ISSUE 9)
         self.paged = bool(_env_int("PADDLE_TPU_SERVE_PAGED", 0)
@@ -328,10 +372,30 @@ class ContinuousBatchingEngine:
         self._admit_progs = {}        # bucket -> jitted admit program
         self._decode_prog = None
         self._copy_prog = None        # paged: COW page-copy program
+        self._verify_prog = None      # speculative: batched verify-k
         self._warmed = False          # warmup() completed
         self.ticks = 0
         self.admitted = 0
         self.completed = 0
+
+        # speculative proposer + counters (always present so stats()
+        # reads uniformly; the proposer exists only when configured)
+        self._proposer = None
+        self.spec_ticks = 0           # verify dispatches
+        self.tokens_drafted = 0
+        self.tokens_accepted = 0      # drafted tokens that matched
+        self.tokens_rejected = 0
+        self.spec_tokens_emitted = 0  # tokens consumed off verify ticks
+        self.spec_slot_ticks = 0      # live (slot, verify-tick) pairs
+        if self._spec is not None:
+            if self._spec.kind == "draft":
+                self._proposer = DraftModelProposer(
+                    self._spec.draft_model, self.slots, self.max_len,
+                    self._spec.k, cache_dtype="float32")
+            else:
+                self._proposer = NGramProposer(
+                    self._spec.k, self._spec.ngram_max,
+                    self._spec.ngram_min)
 
         # observability (paddle_tpu.obs): per-request phase spans into
         # the flight recorder + registry series on /metrics. The flag
@@ -377,6 +441,24 @@ class ContinuousBatchingEngine:
                 self._m_prefix_misses = reg.counter(
                     "ptpu_engine_prefix_misses_total",
                     "admissions with no cached prefix page")
+            if self._spec is not None:
+                self._m_spec_ticks = reg.counter(
+                    "ptpu_engine_spec_ticks_total",
+                    "draft->verify tick dispatches")
+                self._m_spec_drafted = reg.counter(
+                    "ptpu_engine_spec_drafted_total",
+                    "draft tokens proposed to verify")
+                self._m_spec_accepted = reg.counter(
+                    "ptpu_engine_spec_accepted_total",
+                    "draft tokens accepted by the target")
+                self._m_spec_rejected = reg.counter(
+                    "ptpu_engine_spec_rejected_total",
+                    "draft tokens rejected by the target")
+                self._m_spec_per_tick = reg.histogram(
+                    "ptpu_engine_spec_accepted_per_tick",
+                    "tokens emitted per slot per verify tick "
+                    "(accepted prefix + correction)",
+                    buckets=tuple(range(0, self._spec.k + 2)))
 
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="cb-engine")
@@ -404,12 +486,13 @@ class ContinuousBatchingEngine:
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         # worst-case decode overshoot is one tick past the budget (a
-        # row is only retired at a tick boundary)
-        worst = P + max_new_tokens + self.tick_tokens
+        # row is only retired at a tick boundary; a speculative tick
+        # also WRITES cache rows up to k past the current position)
+        worst = P + max_new_tokens + self._overshoot
         if worst > self.max_len:
             raise ValueError(
                 f"prompt ({P}) + max_new_tokens ({max_new_tokens}) + "
-                f"tick overshoot ({self.tick_tokens}) exceeds the "
+                f"tick overshoot ({self._overshoot}) exceeds the "
                 f"engine cache length {self.max_len}")
         # Paged engines need no extra static rejection here: worst <=
         # max_len (above) bounds a request at pages_per_slot pages, and
@@ -461,7 +544,7 @@ class ContinuousBatchingEngine:
             return False
         head = self._queue[0]
         need = _pages_needed(head.prompt.shape[0] + head.max_new_tokens
-                             + self.tick_tokens, self.page_size)
+                             + self._overshoot, self.page_size)
         return need > (self._allocator.free_pages
                        + self._trie.reclaimable())
 
@@ -485,7 +568,26 @@ class ContinuousBatchingEngine:
                "prefill_buckets": list(self.prefill_buckets),
                "max_len": self.max_len,
                "cache_dtype": self.cache_dtype,
-               "paged": self.paged}
+               "paged": self.paged,
+               "speculative": (self._spec.kind if self._spec else None)}
+        if self._spec is not None:
+            drafted = self.tokens_drafted
+            out.update({
+                "spec_k": self._spec.k,
+                "spec_ticks": self.spec_ticks,
+                "tokens_drafted": drafted,
+                "tokens_accepted": self.tokens_accepted,
+                "tokens_rejected": self.tokens_rejected,
+                "acceptance_rate": round(
+                    self.tokens_accepted / drafted, 4) if drafted
+                else 0.0,
+                # tokens emitted per SLOT per verify forward — the
+                # multi-token-tick number (1.0 = no better than the
+                # plain one-token-per-forward regime)
+                "accepted_tokens_per_tick": round(
+                    self.spec_tokens_emitted / self.spec_slot_ticks, 4)
+                if self.spec_slot_ticks else 0.0,
+            })
         if self.paged:
             free_p = self._allocator.free_pages
             used_p = self._allocator.used_pages
@@ -509,8 +611,12 @@ class ContinuousBatchingEngine:
     @property
     def compiled_program_count(self) -> int:
         """How many times XLA traced an engine program — constant after
-        warmup is the no-recompile serving guarantee."""
-        return self._trace_count
+        warmup is the no-recompile serving guarantee. Includes the
+        draft proposer's programs (a re-tracing draft would pay the
+        same per-request compile tax as a re-tracing target)."""
+        return self._trace_count + (
+            self._proposer._trace_count
+            if getattr(self._proposer, "kind", None) == "draft" else 0)
 
     @property
     def warm(self) -> bool:
@@ -531,9 +637,11 @@ class ContinuousBatchingEngine:
         config must not collide)."""
         paged = ((self.page_size, self.num_pages, self.pages_per_slot)
                  if self.paged else None)
+        spec = ((self._spec.kind, self._spec.k)
+                if self._spec is not None else None)
         return repr((type(self.model).__name__, self._sampling,
                      self.tick_tokens, self.max_len, self.cache_dtype,
-                     paged))
+                     paged, spec))
 
     def _decode_example_args(self) -> tuple:
         N = self.slots
@@ -561,6 +669,15 @@ class ContinuousBatchingEngine:
 
     def _copy_example_args(self) -> tuple:
         return (self._caches, np.int32(0), np.int32(0))
+
+    def _verify_example_args(self) -> tuple:
+        N, K = self.slots, self._spec.k
+        head = (self._params, self._buffers, self._caches)
+        if self.paged:
+            head += (np.zeros((N, self.pages_per_slot), np.int32),)
+        return head + (np.zeros(N, np.int32), np.zeros(N, np.int32),
+                       np.ones(N, bool), np.zeros((N, K), np.int32),
+                       np.zeros(N, np.int32))
 
     def warmup(self, buckets: Optional[tuple] = None, store=None) -> list:
         """Compile-or-load THIS engine's programs ahead of traffic: the
@@ -601,6 +718,18 @@ class ContinuousBatchingEngine:
                 self._copy_example_args(), store=store, log_record=rec,
                 static_key=static)
             recs.append(_clog.record(rec))
+        if self._spec is not None:
+            if not isinstance(self._verify_prog, AotProgram):
+                rec = {"site": "engine_verify"}
+                self._verify_prog = aot_compile(
+                    "engine_verify", self._get_verify_prog(),
+                    self._verify_example_args(), store=store,
+                    log_record=rec, static_key=static)
+                recs.append(_clog.record(rec))
+            if self._spec.kind == "draft":
+                recs.extend(self._proposer.warmup(
+                    self.prefill_buckets, store=store,
+                    static_key=static))
         self._warmed = True
         return recs
 
@@ -809,6 +938,22 @@ class ContinuousBatchingEngine:
         self._decode_prog = jax.jit(decode_tick, donate_argnums=(2,))
         return self._decode_prog
 
+    def _get_verify_prog(self):
+        """The batched verify-k program (speculative.py builds it; the
+        trace hook is this engine's recompile counter, same contract as
+        every other engine program)."""
+        if self._verify_prog is not None:
+            return self._verify_prog
+        from .speculative import make_verify_program
+        engine = self
+
+        def hook():
+            engine._trace_count += 1      # fires at trace time only
+
+        self._verify_prog = make_verify_program(
+            self.model, self._spec.k, self.paged, trace_hook=hook)
+        return self._verify_prog
+
     # -- engine loop -----------------------------------------------------
     def _loop(self):
         while True:
@@ -821,7 +966,7 @@ class ContinuousBatchingEngine:
             try:
                 self._admit_ready()
                 if any(not s.free for s in self._slots):
-                    self._tick_decode()
+                    self._tick()
                 elif self._queue and self._pool_blocked:
                     # nothing active to tick (and so nothing retiring
                     # to free pages) while the head request waits on
@@ -888,6 +1033,12 @@ class ContinuousBatchingEngine:
                 self._caches, np.int32(b))
             tok0 = int(tok0_dev)       # first-token host sync
             self.prefill_tokens += P
+        if getattr(self._proposer, "kind", None) == "draft":
+            # prefill the draft model's own cache row for this slot —
+            # the prompt is the only context the draft ever needs ahead
+            # of time (each tick's [prev, tok] sync block covers the
+            # rest, speculative.py module docstring)
+            self._proposer.admit(b, req.prompt, self._bucket_for(P))
         slot = self._slots[b]
         slot.req = req
         slot.pos = P
@@ -951,7 +1102,7 @@ class ContinuousBatchingEngine:
         else:
             shared = matched
             M = m * ps
-        total = _pages_needed(P + req.max_new_tokens + self.tick_tokens,
+        total = _pages_needed(P + req.max_new_tokens + self._overshoot,
                               ps)
         # incref BEFORE any eviction below so matched pages are pinned
         self._allocator.incref(shared)
@@ -999,6 +1150,135 @@ class ContinuousBatchingEngine:
             self._g_pages_free.set(self._allocator.free_pages)
             self._g_pages_used.set(self._allocator.used_pages)
         return tok0, bucket
+
+    def _tick(self):
+        """One tick: plain decode, or — speculative — draft -> verify.
+        The swap is per tick, not per engine: an n-gram engine whose
+        contexts have nothing to match anywhere falls back to the plain
+        tick (tick_tokens per dispatch) instead of paying a verify
+        forward for one guaranteed token per slot."""
+        if self._spec is None:
+            self._tick_decode()
+            return
+        props, dlen = self._propose_all()
+        if dlen.any():
+            self._tick_verify(props, dlen)
+        else:
+            self._tick_decode()
+
+    def _prev_token(self, s: "_Slot") -> int:
+        """True token at index ``s.pos - 1`` (the draft sync block's
+        first element). pos >= prompt_len >= 1 always, so it exists."""
+        P = s.req.prompt.shape[0]
+        j = s.pos - 1
+        return int(s.req.prompt[j]) if j < P else s.emitted[j - P]
+
+    def _propose_all(self):
+        """(props [N, k] int32, dlen [N] int32) for every busy slot —
+        ONE draft-model dispatch, or per-slot host n-gram lookups."""
+        N, K = self.slots, self._spec.k
+        props = np.zeros((N, K), np.int32)
+        dlen = np.zeros(N, np.int32)
+        if self._proposer.kind == "draft":
+            prev = np.zeros(N, np.int32)
+            tok = np.zeros(N, np.int32)
+            pos = np.zeros(N, np.int32)
+            busy = False
+            for i, s in enumerate(self._slots):
+                if s.free:
+                    continue
+                prev[i] = self._prev_token(s)
+                tok[i] = s.tok
+                pos[i] = s.pos
+                dlen[i] = K
+                busy = True
+            if busy:
+                props = self._proposer.propose(prev, tok, pos)
+            return props, dlen
+        for i, s in enumerate(self._slots):
+            if s.free:
+                continue
+            ctx = np.concatenate([s.req.prompt,
+                                  np.asarray(s.emitted, np.int64)])
+            p, n = self._proposer.propose(ctx)
+            props[i] = p
+            dlen[i] = n
+        return props, dlen
+
+    def _tick_verify(self, props, dlen):
+        """One draft->verify tick: ONE target forward scores all k+1
+        positions for every slot; the host consumes the accepted prefix
+        plus the correction token per row (1..k+1 tokens each — the
+        multi-token tick). Every consumed token is the TARGET's argmax,
+        so this path is bitwise token-identical to plain decode."""
+        N = self.slots
+        tok = np.zeros(N, np.int32)
+        pos = np.zeros(N, np.int32)
+        live = np.zeros(N, bool)
+        n_live = 0
+        for i, s in enumerate(self._slots):
+            if s.free:
+                continue
+            tok[i] = s.tok
+            pos[i] = s.pos
+            if s.alive and s.remaining > 0:
+                live[i] = True
+                n_live += 1
+        prog = self._get_verify_prog()
+        t_tick = time.perf_counter() if self._obs else 0.0
+        if self.paged:
+            toks_dev, acc_dev, self._caches = prog(
+                self._params, self._buffers, self._caches,
+                self._block_tables, tok, pos, live, props, dlen)
+        else:
+            toks_dev, acc_dev, self._caches = prog(
+                self._params, self._buffers, self._caches, tok, pos,
+                live, props, dlen)
+        toks = np.asarray(toks_dev)       # the ONE host sync per tick
+        n_acc = np.asarray(acc_dev)
+        self.ticks += 1
+        self.spec_ticks += 1
+        if self._obs:
+            now = time.perf_counter()
+            self._m_ticks.inc()
+            self._m_spec_ticks.inc()
+            self._m_occupancy.observe(n_live)
+            _obs.record_span("engine.tick", t_tick, now, cat="engine",
+                             active=n_live, tick=self.ticks, spec=True)
+        for i, s in enumerate(self._slots):
+            if s.free or not live[i]:
+                continue
+            drafted, accepted = int(dlen[i]), int(n_acc[i])
+            self.tokens_drafted += drafted
+            self.tokens_accepted += accepted
+            self.tokens_rejected += drafted - accepted
+            s.req.drafted += drafted
+            s.req.accepted += accepted
+            n = 0
+            for t in range(accepted + 1):
+                if s.remaining <= 0 or not s.alive:
+                    break
+                token = int(toks[i, t])
+                s.emitted.append(token)
+                s.remaining -= 1
+                n += 1
+                if (s.req.eos_token_id is not None
+                        and token == s.req.eos_token_id):
+                    s.alive = False
+            # host mirror of the advance: rejected positions' in-cache
+            # garbage sits above pos and is overwritten by the next
+            # block before any query can attend it (no rollback)
+            s.pos += n
+            s.tok = s.emitted[-1]
+            self.spec_tokens_emitted += n
+            self.spec_slot_ticks += 1
+            if self._obs:
+                self._m_spec_drafted.inc(drafted)
+                self._m_spec_accepted.inc(accepted)
+                self._m_spec_rejected.inc(drafted - accepted)
+                self._m_spec_per_tick.observe(n)
+            if s.remaining <= 0 or not s.alive:
+                self._retire(i)
 
     def _tick_decode(self):
         N = self.slots
@@ -1086,6 +1366,14 @@ class ContinuousBatchingEngine:
                              cat="engine", request_id=req.rid,
                              tokens=len(slot.emitted))
         out = list(slot.emitted)
+        # per-request generation accounting, readable off the future by
+        # the serving layer AFTER result() resolves (set before
+        # set_result, so publication orders correctly)
+        info = {"tokens_generated": len(out)}
+        if self._spec is not None:
+            info["tokens_drafted"] = req.drafted
+            info["tokens_accepted"] = req.accepted
+        req.future._ptpu_gen_info = info
         if len(out) < req.max_new_tokens:
             # finished early on eos: pad with eos — generate()'s contract
             out += [req.eos_token_id] * (req.max_new_tokens - len(out))

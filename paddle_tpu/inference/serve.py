@@ -326,6 +326,16 @@ class PredictorServer:
                     ("paged", "page_size", "pages_total", "pages_free",
                      "pages_used", "page_utilization", "prefix_hits",
                      "prefix_misses", "prefix_hit_rate")})
+            if st.get("speculative"):
+                # speculative decoding health: acceptance rate and
+                # accepted-tokens-per-tick are the knobs an operator
+                # tunes k / the drafter against
+                body["engine"].update({
+                    k: st[k] for k in
+                    ("speculative", "spec_k", "spec_ticks",
+                     "tokens_drafted", "tokens_accepted",
+                     "tokens_rejected", "acceptance_rate",
+                     "accepted_tokens_per_tick")})
         if self._draining:
             # draining dominates every other state: in-flight requests
             # are finishing, nothing new may be routed here
@@ -670,6 +680,15 @@ class PredictorServer:
                     body = {"tokens": out.tolist(),
                             "prompt_len": prompt_len,
                             "new_tokens": len(out) - prompt_len}
+                    # per-request generation accounting the engine
+                    # published on the future at retirement:
+                    # tokens_generated (actual emissions, eos padding
+                    # excluded) always; drafted/accepted on
+                    # speculative engines. The router forwards these
+                    # body fields unchanged (test_router.py).
+                    info = getattr(fut, "_ptpu_gen_info", None)
+                    if info:
+                        body.update(info)
                     if rid:
                         body["request_id"] = rid
                 self._send(200, body)

@@ -1,0 +1,417 @@
+"""Speculative decoding — draft/verify program pairs for the engine.
+
+The serving engine (inference/engine.py) emits at most one token per
+model forward: each decode tick runs ``tick_tokens`` sequential
+micro-steps, and tpucost's decode anchor shows every micro-step is
+KV-bandwidth bound (7 cache passes + a full weight stream per token).
+Speculative decoding turns those one-token forwards into multi-token
+forwards (ROADMAP item 2, per the MPK per-tick overhead analysis,
+PAPERS.md 2512.22219): a cheap DRAFT proposes k candidate tokens per
+slot, and ONE batched VERIFY program scores all k+1 positions for every
+slot in a single target-model forward — weights stream once and the
+cache makes its passes once per up-to-(k+1) emitted tokens instead of
+once per token.
+
+Two proposers, one verify program:
+
+- :class:`NGramProposer` — host-side self-drafting ("prompt lookup"
+  decoding): match the longest recent n-gram suffix of a slot's context
+  (prompt + emitted tokens) against earlier occurrences and propose the
+  tokens that followed the most recent match. No extra model, no extra
+  programs, free on repetitive text (code, quoted context, template
+  continuations — and greedy loops, which tiny LMs love).
+
+- :class:`DraftModelProposer` — a small draft model running its OWN
+  registered decode program (``gpt_draft_decode``) over a second
+  slot-based KV cache: one jitted dispatch catches the draft cache up
+  on the tokens accepted last tick (always exactly the 2-token block
+  [prev, tok] — see the sync invariant below) and scans k greedy draft
+  steps, returning [N, k] proposals for every slot at once.
+
+- the VERIFY program (``gpt_verify_k``, built by
+  :func:`make_verify_program`) feeds every slot's [tok, d1..dk] block
+  through the target model at per-row position vectors — k-drift,
+  acceptance-pattern drift, prompt drift and page placement all ride as
+  int32/bool arguments, so nothing ever retraces (the PR 2/9
+  discipline). The greedy accept-longest-prefix AND the correction
+  token come out of the same forward: the emitted block is simply the
+  target's own argmax at every position (an accepted draft token equals
+  the target token by definition), so greedy speculative output is
+  BITWISE token-identical to plain decode no matter what the drafter
+  proposed — acceptance only decides how MANY tokens each tick may
+  consume (n_accepted + 1).
+
+Why rejected tokens need no KV rollback program: verify writes the
+block's KV at positions [pos, pos+k] — contiguous from the row's true
+length. After accepting n, the row's new true length is pos+n+1; the
+garbage KV the rejected tokens left at (pos+n+1, pos+k] sits strictly
+ABOVE every future query position until the token actually at that
+index overwrites it (causal masking — the same dead-row argument the
+engine's admission reset and paged live-mask rely on). In paged mode
+the write is live-mask gated and lands only in the slot's PRIVATE
+pages: shared prefix pages cover complete PROMPT pages, and every
+speculative write position is >= prompt_len (asserted bitwise in
+tests/test_paged_engine.py churn).
+
+Draft-cache sync invariant (draft-model mode): before each tick the
+draft cache holds true KV through position pos-1 and has never seen
+``tok`` (the engine's current token at position pos). The draft
+dispatch feeds [prev, tok] at positions [pos-1, pos] — re-writing
+pos-1 with the true token it already holds (idempotent: k/v rows are
+deterministic functions of the true prefix) covers the one case where
+full acceptance left position pos-1 unwritten — then drafts k tokens
+autoregressively. After verify accepts n of them, positions pos..pos+n
+hold true draft KV (accepted tokens ARE the true tokens), so the
+invariant holds again at pos' = pos+n+1 with no rollback either.
+
+Greedy only: acceptance-by-token-equality is exact for argmax; the
+engine rejects ``do_sample`` + speculative loudly rather than serve a
+subtly different sampling distribution.
+
+Env knobs (engine-resolved): PADDLE_TPU_SERVE_SPEC ("ngram" or unset),
+PADDLE_TPU_SERVE_SPEC_K (draft length k, default 4),
+PADDLE_TPU_SERVE_SPEC_NGRAM (max n-gram match length, default 3).
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..jit.functional import functional_call, raw_state
+
+__all__ = ["SpeculativeConfig", "resolve_speculative", "NGramProposer",
+           "DraftModelProposer", "make_verify_program"]
+
+
+@dataclass(frozen=True)
+class SpeculativeConfig:
+    """Resolved speculative-decoding configuration for one engine."""
+    kind: str                    # "ngram" | "draft"
+    k: int                       # draft tokens proposed per tick
+    ngram_max: int = 3           # longest suffix n-gram to match
+    ngram_min: int = 1           # shortest n-gram worth matching
+    draft_model: Optional[object] = None   # kind == "draft" only
+
+
+def resolve_speculative(speculative, spec_k=None, spec_ngram=None,
+                        draft_model=None) -> Optional[SpeculativeConfig]:
+    """Normalize the engine's ``speculative=`` knob (None reads
+    PADDLE_TPU_SERVE_SPEC, False forces off, True means "ngram") into a
+    SpeculativeConfig or None."""
+    if speculative is None:
+        speculative = os.environ.get("PADDLE_TPU_SERVE_SPEC", "").strip()
+    if speculative in (False, None, "", "0", "off", "none"):
+        return None
+    if speculative is True:
+        speculative = "ngram"
+    kind = str(speculative).lower()
+    if kind not in ("ngram", "draft"):
+        raise ValueError(f"unknown speculative mode {speculative!r} "
+                         "(valid: 'ngram', 'draft', None)")
+    if kind == "draft" and draft_model is None:
+        raise ValueError("speculative='draft' needs draft_model= (a "
+                         "small cache-threaded causal LM)")
+    from ..framework.env import int_env
+    k = int(spec_k if spec_k is not None
+            else int_env("PADDLE_TPU_SERVE_SPEC_K", 4))
+    if k < 1:
+        raise ValueError("spec_k must be >= 1")
+    ngram_max = int(spec_ngram if spec_ngram is not None
+                    else int_env("PADDLE_TPU_SERVE_SPEC_NGRAM", 3))
+    if ngram_max < 1:
+        raise ValueError("spec_ngram must be >= 1")
+    return SpeculativeConfig(kind, k, ngram_max, 1,
+                             draft_model if kind == "draft" else None)
+
+
+# ---------------------------------------------------------------------------
+# n-gram self-drafting (host-side — no model, no programs)
+# ---------------------------------------------------------------------------
+
+class NGramProposer:
+    """Propose the continuation of the most recent earlier occurrence
+    of the context's longest matching suffix n-gram ("prompt lookup"
+    decoding). Pure numpy over each slot's token history; wrong
+    proposals cost only rejected verify positions, never correctness.
+    """
+
+    kind = "ngram"
+
+    def __init__(self, k: int, ngram_max: int = 3, ngram_min: int = 1):
+        if ngram_min < 1 or ngram_max < ngram_min:
+            raise ValueError("need 1 <= ngram_min <= ngram_max")
+        self.k = int(k)
+        self.ngram_max = int(ngram_max)
+        self.ngram_min = int(ngram_min)
+
+    def propose(self, context: np.ndarray):
+        """(props[k] int32, draft_len) for one slot's full token
+        context. Longest suffix n-gram wins; among equal-length matches
+        the most recent one with a FULL k-token continuation wins, else
+        the EARLIEST hit (whose continuation to the context end is the
+        longest available). Both preferences matter on exactly the text
+        this drafter exists for: in periodic context the most recent
+        match sits near the context end and its continuation truncates
+        after one period, and inside a still-growing repeated run the
+        latest match's continuation is a single token while the
+        earliest covers the whole run so far."""
+        ctx = np.asarray(context).reshape(-1)
+        L = ctx.shape[0]
+        props = np.zeros(self.k, np.int32)
+        for g in range(min(self.ngram_max, L - 1), self.ngram_min - 1,
+                       -1):
+            pat = ctx[L - g:]
+            # candidate matches end strictly before the suffix itself
+            # and must leave >= 1 continuation token
+            hay = ctx[:L - 1]
+            if hay.shape[0] < g:
+                continue
+            win = np.lib.stride_tricks.sliding_window_view(hay, g)
+            hits = np.nonzero((win == pat).all(axis=1))[0]
+            if hits.shape[0] == 0:
+                continue
+            # continuation of hit h starts at h + g; full drafts need
+            # h + g + k <= L — absent one, the earliest hit maximizes
+            # the truncated continuation
+            full = hits[hits + g + self.k <= L]
+            j = int(full[-1] if full.shape[0] else hits[0]) + g
+            cont = ctx[j:j + self.k]
+            props[:cont.shape[0]] = cont.astype(np.int32)
+            return props, int(cont.shape[0])
+        return props, 0
+
+
+# ---------------------------------------------------------------------------
+# draft-model proposer (its own registered decode program + KV cache)
+# ---------------------------------------------------------------------------
+
+class DraftModelProposer:
+    """A small draft model with its own slot-based KV cache and two
+    jitted programs: a bucketed admission prefill (mirrors the engine's
+    slot admit, full-row reset included) and ONE batched draft-decode
+    program (``gpt_draft_decode``) that catches every slot up on the
+    [prev, tok] sync block and scans k greedy draft steps — proposals
+    for all N slots in a single dispatch, positions as int32 vectors so
+    nothing ever retraces."""
+
+    kind = "draft"
+
+    def __init__(self, model, slots: int, max_len: int, k: int,
+                 cache_dtype: str = "float32"):
+        self.model = model
+        self.slots = int(slots)
+        self.max_len = int(max_len)
+        self.k = int(k)
+        self.cache_dtype = cache_dtype
+        was_training = model.training
+        model.eval()
+        self._params, self._buffers = raw_state(model)
+        if was_training:
+            model.train()
+        self._caches = model.new_cache(self.slots, self.max_len,
+                                       cache_dtype)
+        self._admit_progs = {}
+        self._decode_prog = None
+        self._trace_count = 0      # ticks inside traced bodies only
+
+    # -- programs --------------------------------------------------------
+    def _get_admit_prog(self, bucket: int):
+        prog = self._admit_progs.get(bucket)
+        if prog is not None:
+            return prog
+        model, proposer = self.model, self
+
+        def admit(params, buffers, ids, caches, slot):
+            proposer._trace_count += 1    # fires at trace time only
+            # fresh zeroed row built in-program: inserting the full row
+            # range resets a retired slot's stale draft KV, exactly
+            # like the engine's own admission
+            temp = model.new_cache(1, proposer.max_len,
+                                   proposer.cache_dtype)
+            (_, temp), _ = functional_call(
+                model, params, buffers, ids, temp, jnp.int32(0),
+                training=False)
+
+            def insert(slot_leaf, temp_leaf):
+                ax = next(i for i, (a, c) in enumerate(
+                    zip(slot_leaf.shape, temp_leaf.shape)) if a != c)
+                start = [0] * slot_leaf.ndim
+                start[ax] = slot
+                return lax.dynamic_update_slice(
+                    slot_leaf, temp_leaf.astype(slot_leaf.dtype),
+                    tuple(start))
+
+            return jax.tree_util.tree_map(insert, caches, temp)
+
+        prog = jax.jit(admit, donate_argnums=(3,))
+        self._admit_progs[bucket] = prog
+        return prog
+
+    def _get_decode_prog(self):
+        """ONE batched draft program: sync block [prev, tok] at
+        positions [pos-1, pos] (see the module-docstring invariant),
+        then k greedy single-token draft steps — [N, k] proposals per
+        dispatch. The draft's own numerics never affect emitted tokens
+        (those are always the TARGET's argmax); draft drift only costs
+        acceptance."""
+        if self._decode_prog is not None:
+            return self._decode_prog
+        model, proposer = self.model, self
+        K = self.k
+
+        def draft_decode(params, buffers, caches, prev, tok, pos):
+            proposer._trace_count += 1    # fires at trace time only
+            ids = jnp.stack([prev, tok], axis=1)          # [N, 2]
+            (logits, caches), _ = functional_call(
+                model, params, buffers, ids, caches, pos - 1,
+                training=False)
+            d = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+
+            def body(carry, _):
+                d, caches, p = carry
+                (lg, caches), _ = functional_call(
+                    model, params, buffers, d[:, None], caches, p,
+                    training=False)
+                nd = jnp.argmax(lg[:, -1, :],
+                                axis=-1).astype(jnp.int32)
+                return (nd, caches, p + 1), nd
+
+            if K > 1:
+                (_, caches, _), rest = lax.scan(
+                    body, (d, caches, pos + 1), None, length=K - 1)
+                props = jnp.concatenate([d[:, None], rest.T], axis=1)
+            else:
+                props = d[:, None]
+            return props, caches                          # [N, K]
+
+        self._decode_prog = jax.jit(draft_decode, donate_argnums=(2,))
+        return self._decode_prog
+
+    def _decode_example_args(self) -> tuple:
+        N = self.slots
+        return (self._params, self._buffers, self._caches,
+                np.zeros(N, np.int32), np.zeros(N, np.int32),
+                np.ones(N, np.int32))
+
+    def _admit_example_args(self, bucket: int) -> tuple:
+        return (self._params, self._buffers,
+                np.zeros((1, bucket), np.int64), self._caches,
+                np.int32(0))
+
+    # -- host API --------------------------------------------------------
+    def admit(self, slot: int, prompt: np.ndarray, bucket: int) -> None:
+        """Prefill one slot's draft cache row with the full prompt
+        (right-padded to ``bucket`` — padding garbage lands above the
+        prompt and is overwritten before any query can attend it)."""
+        P = prompt.shape[0]
+        ids = np.zeros((1, bucket), np.int64)
+        ids[0, :P] = prompt
+        self._caches = self._get_admit_prog(bucket)(
+            self._params, self._buffers, ids, self._caches,
+            np.int32(slot))
+
+    def propose(self, prev: np.ndarray, tok: np.ndarray,
+                pos: np.ndarray) -> np.ndarray:
+        """[N, k] int32 proposals for every slot in one dispatch."""
+        props, self._caches = self._get_decode_prog()(
+            self._params, self._buffers, self._caches, prev, tok, pos)
+        return np.asarray(props)
+
+    def warmup(self, buckets, store=None, static_key: str = "") -> list:
+        """AOT compile-or-load the draft programs through the
+        persistent executable store (engine.warmup() forwards here)."""
+        from ..compilation import log as _clog
+        from ..compilation.store import AotProgram, aot_compile
+        static = static_key + "|draft:" + repr(
+            (type(self.model).__name__, self.k, self.max_len,
+             self.cache_dtype))
+        recs = []
+        if not isinstance(self._decode_prog, AotProgram):
+            rec: dict = {"site": "engine_draft_decode"}
+            self._decode_prog = aot_compile(
+                "engine_draft_decode", self._get_decode_prog(),
+                self._decode_example_args(), store=store,
+                log_record=rec, static_key=static)
+            recs.append(_clog.record(rec))
+        for bucket in buckets:
+            bucket = int(bucket)
+            if isinstance(self._admit_progs.get(bucket), AotProgram):
+                continue
+            rec = {"site": f"engine_draft_admit_b{bucket}"}
+            self._admit_progs[bucket] = aot_compile(
+                f"engine_draft_admit_b{bucket}",
+                self._get_admit_prog(bucket),
+                self._admit_example_args(bucket), store=store,
+                log_record=rec, static_key=static)
+            recs.append(_clog.record(rec))
+        return recs
+
+
+# ---------------------------------------------------------------------------
+# the batched verify-k program (the target-model half of the pair)
+# ---------------------------------------------------------------------------
+
+def make_verify_program(model, spec_k: int, paged: bool,
+                        trace_hook=None):
+    """Build the ONE jitted batched verify program for an engine.
+
+    Slot mode:
+        verify(params, buffers, caches, tok, pos, live, props, dlen)
+    Paged mode (block tables + live write gate attached per call):
+        verify(params, buffers, caches, bt, tok, pos, live, props, dlen)
+
+    Returns ``(toks [N, k+1] i32, n_acc [N] i32, caches)``:
+    ``toks[i, j]`` is the TARGET's greedy token for position
+    pos[i]+j+1 (context = the true prefix + tok + d1..dj, which is the
+    true context exactly for j <= n_acc[i]); the host consumes
+    ``n_acc[i] + 1`` of them — the accepted prefix plus the
+    correction/bonus token, all computed in-program from one forward.
+    Proposal values, draft lengths, positions and the live mask are all
+    ARGUMENTS: k-pattern drift never retraces.
+    """
+    from .engine import _attach_page_meta, _strip_page_meta
+    K = int(spec_k)
+
+    def _verify_body(params, buffers, caches, bt, tok, pos, live,
+                     props, dlen):
+        if trace_hook is not None:
+            trace_hook()                  # fires at trace time only
+        ids = jnp.concatenate([tok[:, None], props], axis=1)  # [N,K+1]
+        if paged:
+            cm = _attach_page_meta(caches, bt=bt, live=live)
+        else:
+            cm = caches
+        (logits, cm), _ = functional_call(
+            model, params, buffers, ids, cm, pos, training=False)
+        if paged:
+            caches = _strip_page_meta(cm)
+        else:
+            caches = cm
+        tgt = jnp.argmax(logits, axis=-1).astype(jnp.int32)   # [N,K+1]
+        j = jnp.arange(K, dtype=jnp.int32)[None, :]
+        match = ((props == tgt[:, :K])
+                 & (j < dlen[:, None])).astype(jnp.int32)
+        # leading-match count: cumprod zeroes everything after the
+        # first mismatch, the row sum is the accepted prefix length
+        n_acc = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+        return tgt, n_acc.astype(jnp.int32), caches
+
+    if paged:
+        def verify(params, buffers, caches, bt, tok, pos, live, props,
+                   dlen):
+            return _verify_body(params, buffers, caches, bt, tok, pos,
+                                live, props, dlen)
+    else:
+        def verify(params, buffers, caches, tok, pos, live, props,
+                   dlen):
+            return _verify_body(params, buffers, caches, None, tok,
+                                pos, live, props, dlen)
+
+    return jax.jit(verify, donate_argnums=(2,))

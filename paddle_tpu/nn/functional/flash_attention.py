@@ -415,27 +415,44 @@ def _cache_write(cache, rows, pos):
             }
         return jnp.where(hit[:, :, None, None], rows.astype(cache.dtype),
                          cache)
+    if per_row:
+        # multi-token block write at per-row offsets (S > 1: the
+        # speculative verify block / draft sync block). Scatter-free
+        # like the S=1 hot path: per cache position l compute which
+        # incoming block offset lands there (s_idx = l - pos[b]),
+        # gather the incoming rows by that index, dense-select into
+        # the cache — ONE pass; a vmap'd dynamic_update_slice with
+        # batched start indices would lower to scatter and break the
+        # engine's scatter-free write anchor.
+        S = rows.shape[1]
+        arr = cache["data"] if isinstance(cache, dict) else cache
+        L = arr.shape[1]
+        s_idx = (jnp.arange(L, dtype=jnp.int32)[None, :]
+                 - pos[:, None])                           # [B, L]
+        valid = (s_idx >= 0) & (s_idx < S)
+        idx = jnp.clip(s_idx, 0, S - 1)
+        if isinstance(cache, dict):
+            qrows, scale = _quant_rows(rows)
+            vq = jnp.take_along_axis(qrows, idx[:, :, None, None],
+                                     axis=1)    # [B, L, nkv, hd]
+            vs = jnp.take_along_axis(scale, idx[:, :, None], axis=1)
+            return {
+                "data": jnp.where(valid[:, :, None, None], vq,
+                                  cache["data"]),
+                "scale": jnp.where(valid[:, :, None], vs,
+                                   cache["scale"]),
+            }
+        vals = jnp.take_along_axis(rows.astype(cache.dtype),
+                                   idx[:, :, None, None], axis=1)
+        return jnp.where(valid[:, :, None, None], vals, cache)
     if isinstance(cache, dict):  # int8 + scales
         qrows, scale = _quant_rows(rows)
-        if per_row:
-            return {
-                "data": jax.vmap(
-                    lambda c, r, p: lax.dynamic_update_slice(
-                        c, r, (p, 0, 0)))(cache["data"], qrows, pos),
-                "scale": jax.vmap(
-                    lambda c, r, p: lax.dynamic_update_slice(
-                        c, r, (p, 0)))(cache["scale"], scale, pos),
-            }
         return {
             "data": lax.dynamic_update_slice(cache["data"], qrows,
                                              (0, pos, 0, 0)),
             "scale": lax.dynamic_update_slice(cache["scale"], scale,
                                               (0, pos, 0)),
         }
-    if per_row:
-        return jax.vmap(
-            lambda c, r, p: lax.dynamic_update_slice(
-                c, r.astype(c.dtype), (p, 0, 0)))(cache, rows, pos)
     return lax.dynamic_update_slice(cache, rows.astype(cache.dtype),
                                     (0, pos, 0, 0))
 

@@ -185,6 +185,11 @@ def test_generate_route_matches_generate(model, gen_server):
                           cache_dtype="float32")[0]
     assert body["tokens"] == want.tolist()
     assert body["prompt_len"] == 9 and body["new_tokens"] == 6
+    # generation accounting (ISSUE 13 satellite): the always-present
+    # field on a plain engine, with no speculative fields leaking in
+    assert body["tokens_generated"] == 6
+    assert "tokens_drafted" not in body
+    assert "tokens_accepted" not in body
 
 
 def test_healthz_reports_slot_occupancy(gen_server):

@@ -305,6 +305,96 @@ def test_no_page_leak_after_retire_under_churn(model):
         eng.stop()
 
 
+def test_spec_churn_never_touches_shared_pages_and_leak_free(model):
+    """ISSUE 13 satellite: randomized draft/verify churn over shared-
+    prefix slots. The verify block writes (and the rejected-token
+    garbage it leaves behind) land ONLY in a slot's private pages —
+    every speculative write position is >= prompt_len while shared
+    prefix pages hold complete PROMPT pages — so the trie's refcount>1
+    pages must end the churn BITWISE unchanged, and the allocator ends
+    leak-free. int8 pools (data AND quantization scales compared) so
+    the quantized paged path is churned too; spot requests assert
+    identity vs the int8 generate() oracle."""
+    eng = ContinuousBatchingEngine(
+        model, slots=3, max_len=64, cache_dtype="int8",
+        prefill_buckets=(8, 16), tick_tokens=4, paged=True, page_size=8,
+        max_queue=64, num_pages=48, speculative="ngram", spec_k=4)
+    rng = np.random.RandomState(9)
+    try:
+        # a shared 8-token prefix = one complete shareable page; the
+        # repeated 4-token pattern inside it makes the n-gram drafter
+        # fire (accepted AND rejected verify positions both occur)
+        pat = rng.randint(0, 250, (4,)).astype("int64")
+        shared = np.concatenate([pat, pat])
+        # seed the trie, then snapshot the shared pages' physical
+        # contents while a second holder keeps them refcount > 1
+        f0 = eng.submit(np.concatenate([shared, pat[:2]]),
+                        max_new_tokens=4)
+        f0.result(timeout=300)
+        trie_pages = []
+        stack = [eng._trie.root]
+        while stack:
+            node = stack.pop()
+            if node is not eng._trie.root:
+                trie_pages.append(node.page)
+            stack.extend(node.children.values())
+        assert trie_pages, "no shared pages cached"
+
+        def page_bytes(pages):
+            out = []
+            for kc, vc in eng._caches:
+                for half in (kc, vc):
+                    out.append(np.asarray(half["pages"])[pages].copy())
+                    if "scale" in half:
+                        out.append(
+                            np.asarray(half["scale"])[pages].copy())
+            return out
+
+        before = page_bytes(trie_pages)
+        futs, spot = [], []
+        for i in range(18):
+            if rng.rand() < 0.6:     # shared-prefix + repetitive tail
+                ids = np.concatenate(
+                    [shared, pat[:int(rng.randint(1, 4))]])
+            else:                    # fresh random traffic
+                ids = rng.randint(0, 250,
+                                  (int(rng.randint(3, 17)),)) \
+                    .astype("int64")
+            n = int(rng.randint(2, 10))
+            futs.append(eng.submit(ids, max_new_tokens=n))
+            if i == 0:               # one identity spot-check vs the
+                spot.append((ids, n, futs[-1]))   # int8 oracle
+        for f in futs:
+            f.result(timeout=300)
+        for ids, n, f in spot:
+            want = model.generate(ids[None], max_new_tokens=n,
+                                  cache_dtype="int8")[0]
+            np.testing.assert_array_equal(f.result(), want)
+        st = eng.stats()
+        assert st["spec_ticks"] > 0, "churn never took a verify tick"
+        assert st["tokens_rejected"] > 0, \
+            "churn never exercised rejection rollback"
+        assert st["prefix_hits"] >= 1
+        # shared pages bitwise untouched by all that draft/verify churn
+        after = page_bytes(trie_pages)
+        for b, a in zip(before, after):
+            np.testing.assert_array_equal(b, a)
+        # leak-free: engine idle -> only trie references remain; drain
+        # the trie -> pool fully free
+        deadline = time.time() + 30
+        while eng.stats()["active"] and time.time() < deadline:
+            time.sleep(0.02)
+        assert eng.stats()["active"] == 0
+        eng._allocator.check()
+        assert eng.stats()["pages_used"] \
+            == eng.stats()["pages_cached_prefix"]
+        eng._trie.evict_all()
+        assert eng._allocator.used_pages == 0
+        eng._allocator.check()
+    finally:
+        eng.stop()
+
+
 def test_submit_validation_paged(model):
     eng = ContinuousBatchingEngine(
         model, slots=2, max_len=32, cache_dtype="float32",

@@ -179,6 +179,12 @@ def test_tier_healthz_and_identity_vs_direct_engine(tier):
     code, body, _ = _gen(tier, [1, 2, 3, 4], n=8)
     assert code == 200, body
     assert body["served_by"] in {r["name"] for r in tier.replicas()}
+    # the replica's generation accounting rides the response body
+    # through the router UNCHANGED (ISSUE 13 satellite): no eos here,
+    # so every requested token was actually generated. Speculative
+    # engines add tokens_drafted/tokens_accepted the same way
+    # (tests/test_speculative.py covers those fields end-to-end).
+    assert body["tokens_generated"] == 8
 
     # tier healthz names every replica with occupancy detail
     with urllib.request.urlopen(

@@ -1,0 +1,418 @@
+"""Speculative decoding subsystem (ISSUE 13, inference/speculative.py).
+
+Host-side units:
+- NGramProposer: longest-suffix n-gram match, most-recent-occurrence
+  wins, end-of-context truncation, no-match -> empty draft;
+- resolve_speculative: env/knob normalization, loud rejections
+  (sampling engines, draft mode without a model).
+
+Engine level (the serving guarantees):
+- greedy speculative output is BITWISE token-identical to sequential
+  generate() — the engine's oracle — on f32 AND int8, slot AND paged
+  caches, across staggered mixed-length traffic, eos mid-block
+  included: the emitted block is always the TARGET's own argmax, so
+  acceptance can only change how many tokens a tick consumes, never
+  which tokens;
+- ZERO recompiles under prompt-length / k-pattern / acceptance-pattern
+  drift — proposals, draft lengths, positions and live masks ride as
+  arguments (trace counters must not move after warmup);
+- the draft-model proposer: a same-weights draft accepts ~everything
+  (bonus-token path), a differently-seeded draft accepts ~nothing
+  (rejection path) — both stay identical to the oracle, and the draft
+  programs share the engine's no-recompile guarantee;
+- multi-token ticks: on repetitive context the accepted-tokens-per-
+  tick (per slot per verify forward) exceeds 1.0 — the whole point;
+- acceptance counters surface in stats(), /healthz and the obs
+  registry (ptpu_engine_spec_*), and /generate bodies carry
+  tokens_generated (+ tokens_drafted/tokens_accepted) — the fields the
+  router forwards unchanged (test_router.py).
+"""
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.engine import ContinuousBatchingEngine
+from paddle_tpu.inference.speculative import (NGramProposer,
+                                              resolve_speculative)
+from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+
+
+# ---------------------------------------------------------------------------
+# host-side units
+# ---------------------------------------------------------------------------
+
+def test_ngram_proposer_matches_suffix_continuation():
+    p = NGramProposer(k=4, ngram_max=3)
+    # context ends in [7, 8]; the earlier [7, 8] is followed by 9, 1, 2
+    ctx = np.array([9, 7, 8, 9, 1, 2, 7, 8], np.int64)
+    props, n = p.propose(ctx)
+    assert n == 4
+    assert props.tolist() == [9, 1, 2, 7]
+
+
+def test_ngram_proposer_most_recent_full_match_wins():
+    p = NGramProposer(k=2, ngram_max=2)
+    # [5, 6] occurs twice with full 2-token continuations; the most
+    # recent of those is followed by [3, 3]
+    ctx = np.array([5, 6, 1, 1, 5, 6, 3, 3, 5, 6], np.int64)
+    props, n = p.propose(ctx)
+    assert n == 2 and props.tolist() == [3, 3]
+
+
+def test_ngram_proposer_truncates_at_context_end():
+    # the only earlier [1, 2] has a truncated continuation ([8, 1, 2]
+    # then the context ends): drafted length < k, zero-padded
+    p = NGramProposer(k=8, ngram_max=2)
+    ctx = np.array([1, 2, 8, 1, 2], np.int64)
+    props, n = p.propose(ctx)
+    assert n == 3 and props[:3].tolist() == [8, 1, 2]
+    assert (props[3:] == 0).all()
+
+
+def test_ngram_proposer_no_match_is_empty():
+    p = NGramProposer(k=4, ngram_max=3)
+    props, n = p.propose(np.array([1, 2, 3, 4, 5], np.int64))
+    assert n == 0 and (props == 0).all()
+
+
+def test_resolve_speculative_knobs(monkeypatch):
+    assert resolve_speculative(False) is None
+    assert resolve_speculative(None) is None          # env unset
+    cfg = resolve_speculative(True, spec_k=6, spec_ngram=2)
+    assert cfg.kind == "ngram" and cfg.k == 6 and cfg.ngram_max == 2
+    monkeypatch.setenv("PADDLE_TPU_SERVE_SPEC", "ngram")
+    monkeypatch.setenv("PADDLE_TPU_SERVE_SPEC_K", "3")
+    cfg = resolve_speculative(None)
+    assert cfg.kind == "ngram" and cfg.k == 3
+    with pytest.raises(ValueError):
+        resolve_speculative("draft")                  # needs a model
+    with pytest.raises(ValueError):
+        resolve_speculative("beam")                   # unknown mode
+    with pytest.raises(ValueError):
+        resolve_speculative(True, spec_k=0)
+
+
+# ---------------------------------------------------------------------------
+# engine level
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(7)
+    m = GPTForCausalLM(gpt_tiny())
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def spec_engine(model):
+    eng = ContinuousBatchingEngine(
+        model, slots=4, max_len=64, cache_dtype="float32",
+        prefill_buckets=(8, 16), tick_tokens=4, speculative="ngram",
+        spec_k=4)
+    yield eng
+    eng.stop()
+
+
+def _prompt(seed, n):
+    return np.random.RandomState(seed).randint(
+        0, 250, (n,)).astype("int64")
+
+
+def _rep_prompt(seed, period, reps):
+    pat = _prompt(seed, period)
+    return np.concatenate([pat] * reps)
+
+
+def test_spec_rejects_sampling(model):
+    with pytest.raises(ValueError, match="greedy-only"):
+        ContinuousBatchingEngine(
+            model, slots=2, max_len=64, cache_dtype="float32",
+            prefill_buckets=(8,), tick_tokens=4, do_sample=True,
+            speculative="ngram")
+
+
+def test_spec_greedy_identity_staggered_mixed(model, spec_engine):
+    """Repetitive AND non-repetitive prompts, staggered arrivals: the
+    speculative engine's output is token-identical to generate() no
+    matter what the drafter proposed or how much was accepted."""
+    eng = spec_engine
+    # oracle shapes deliberately repeat (P in {5, 9, 12, 16}, n = 8):
+    # generate()'s per-(P, n) program pairs come from the model's LRU,
+    # so the reference costs 4 compiles, not 6 — the ENGINE side has
+    # no shape keys at all (that is the point under test)
+    prompts = [_rep_prompt(0, 4, 3), _prompt(1, 5), _rep_prompt(2, 3, 4),
+               _prompt(3, 9), _prompt(4, 16), _rep_prompt(5, 2, 6)]
+    news = [8] * 6
+    futs = []
+    for ids, n in zip(prompts, news):
+        futs.append(eng.submit(ids, max_new_tokens=n))
+        time.sleep(0.01)          # arrivals land across tick boundaries
+    outs = [f.result(timeout=300) for f in futs]
+    for ids, n, got in zip(prompts, news, outs):
+        want = model.generate(ids[None], max_new_tokens=n,
+                              cache_dtype="float32")[0]
+        np.testing.assert_array_equal(got, want)
+    st = eng.stats()
+    assert st["speculative"] == "ngram" and st["spec_ticks"] > 0
+    assert st["tokens_drafted"] > 0
+
+
+def test_spec_identity_with_eos_mid_block(model, spec_engine):
+    """EOS landing INSIDE an accepted verify block truncates exactly
+    like plain decode: retirement + eos padding match generate()."""
+    ids = _rep_prompt(6, 3, 3)
+    # eos = the first greedy token, read off a shared-shape oracle run
+    # (P=9, n=8 rides the model's program-pair LRU), so it fires
+    # mid-stream — inside an accepted verify block
+    eos = int(model.generate(ids[None], max_new_tokens=8,
+                             cache_dtype="float32")[0, ids.shape[0]])
+    want = model.generate(ids[None], max_new_tokens=12,
+                          eos_token_id=eos, cache_dtype="float32")[0]
+    got = spec_engine.generate(ids, max_new_tokens=12, eos_token_id=eos,
+                               timeout=300)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_spec_multi_token_ticks_on_repetitive_context(model,
+                                                      spec_engine):
+    """The acceptance claim: on repetitive context a verify tick
+    consumes MORE than one token per slot per forward."""
+    eng = spec_engine
+    before = (eng.spec_tokens_emitted, eng.spec_slot_ticks)
+    futs = [eng.submit(_rep_prompt(50 + i, 4, 4), max_new_tokens=16)
+            for i in range(4)]
+    for f in futs:
+        f.result(timeout=300)
+    emitted = eng.spec_tokens_emitted - before[0]
+    slot_ticks = eng.spec_slot_ticks - before[1]
+    assert slot_ticks > 0
+    assert emitted / slot_ticks > 1.0, \
+        f"no multi-token ticks: {emitted} tokens / {slot_ticks} " \
+        "slot-ticks"
+    st = eng.stats()
+    assert st["acceptance_rate"] > 0.0
+    assert st["tokens_accepted"] + st["tokens_rejected"] \
+        == st["tokens_drafted"]
+
+
+def test_spec_zero_recompile_under_drift(model, spec_engine):
+    """Prompt-length, draft-length, acceptance-pattern and k-content
+    drift all ride the same compiled verify program — and the plain
+    fallback tick (no proposals anywhere) its own: the trace counters
+    must not move after both are warm."""
+    eng = spec_engine
+    # warm every path: both buckets, the verify program (repetitive
+    # prompts draft immediately), the plain fallback (random prompts
+    # with nothing to match)
+    for p in (4, 12):
+        eng.generate(_prompt(70 + p, p), max_new_tokens=3, timeout=300)
+    eng.generate(_rep_prompt(71, 4, 3), max_new_tokens=6, timeout=300)
+    warm = eng.compiled_program_count
+    futs = []
+    for i, (p, n) in enumerate([(p, n) for p in range(3, 12)
+                                for n in (2, 3)]):
+        futs.append(eng.submit(_prompt(100 + i, p), max_new_tokens=n))
+    # acceptance-pattern drift: different periods/phases of repetition
+    for i, (period, reps) in enumerate([(2, 6), (3, 4), (4, 3),
+                                        (5, 3)]):
+        futs.append(eng.submit(_rep_prompt(200 + i, period, reps),
+                               max_new_tokens=8))
+    for f in futs:
+        f.result(timeout=300)
+    assert eng.compiled_program_count == warm, \
+        "speculative engine recompiled under drift"
+
+
+def test_spec_identity_int8_slot_cache_warmed(model):
+    """int8 slot-cache identity — AND warmup coverage: engine.warmup()
+    AOT-covers the verify program (plus decode/admit), so the traffic
+    below runs with ZERO additional traces."""
+    eng = ContinuousBatchingEngine(
+        model, slots=2, max_len=64, cache_dtype="int8",
+        prefill_buckets=(16,), tick_tokens=4, speculative="ngram",
+        spec_k=4)
+    try:
+        eng.warmup()
+        warm = eng.compiled_program_count
+        for seed, (p, n) in enumerate([(12, 8), (9, 8)]):
+            ids = _rep_prompt(seed, 3, p // 3) if seed % 2 \
+                else _prompt(seed, p)
+            want = model.generate(ids[None], max_new_tokens=n,
+                                  cache_dtype="int8")[0]
+            got = eng.generate(ids, max_new_tokens=n, timeout=300)
+            np.testing.assert_array_equal(got, want)
+        assert eng.compiled_program_count == warm
+        assert eng.warm
+    finally:
+        eng.stop()
+
+
+def test_spec_identity_paged(model):
+    """Paged pools under speculative verify: block-table gathers,
+    live-gated block writes and shared-prefix admissions compose with
+    the verify program (int8 pools ride the churn test in
+    test_paged_engine.py — one engine each keeps tier-1's compile
+    budget honest)."""
+    eng = ContinuousBatchingEngine(
+        model, slots=4, max_len=64, cache_dtype="float32",
+        prefill_buckets=(16,), tick_tokens=4, paged=True,
+        page_size=8, speculative="ngram", spec_k=4)
+    try:
+        prompts = [_rep_prompt(20, 4, 3), _prompt(21, 9),
+                   _rep_prompt(22, 2, 6), _prompt(23, 16)]
+        futs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+        outs = [f.result(timeout=300) for f in futs]
+        for p, got in zip(prompts, outs):
+            want = model.generate(p[None], max_new_tokens=8,
+                                  cache_dtype="float32")[0]
+            np.testing.assert_array_equal(got, want)
+        # prefix reuse still composes: same prompt twice, second
+        # admission skips the cached pages
+        ids = _rep_prompt(24, 8, 2)          # 16 = two full pages
+        want = model.generate(ids[None], max_new_tokens=8,
+                              cache_dtype="float32")[0]
+        for _ in range(2):
+            got = eng.generate(ids, max_new_tokens=8, timeout=300)
+            np.testing.assert_array_equal(got, want)
+        assert eng.stats()["prefix_hits"] >= 1
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# draft-model proposer
+# ---------------------------------------------------------------------------
+
+def test_draft_model_same_weights_accepts_and_stays_identical(model):
+    """A draft sharing the target's weights accepts ~every proposal
+    (exercising the full-acceptance bonus-token path and the draft
+    sync-block invariant at n == k) — and output stays the oracle's."""
+    paddle.seed(7)
+    draft = GPTForCausalLM(gpt_tiny())
+    draft.eval()
+    eng = ContinuousBatchingEngine(
+        model, slots=2, max_len=64, cache_dtype="float32",
+        prefill_buckets=(16,), tick_tokens=4, speculative="draft",
+        draft_model=draft, spec_k=4)
+    try:
+        for seed, (p, n) in enumerate([(6, 12), (11, 8), (16, 8)]):
+            ids = _prompt(seed, p)
+            want = model.generate(ids[None], max_new_tokens=n,
+                                  cache_dtype="float32")[0]
+            got = eng.generate(ids, max_new_tokens=n, timeout=300)
+            np.testing.assert_array_equal(got, want)
+        st = eng.stats()
+        assert st["speculative"] == "draft"
+        assert st["acceptance_rate"] > 0.9, st
+        assert st["accepted_tokens_per_tick"] > 2.0, st
+        # draft drift never retraces: k proposals per slot every tick,
+        # positions/sync tokens as vectors
+        warm = eng.compiled_program_count
+        futs = [eng.submit(_prompt(30 + i, 3 + i), max_new_tokens=4)
+                for i in range(4)]
+        for f in futs:
+            f.result(timeout=300)
+        assert eng.compiled_program_count == warm
+    finally:
+        eng.stop()
+
+
+def test_draft_model_disagreeing_weights_still_identical(model):
+    """A differently-seeded draft proposes mostly-wrong tokens: near-
+    total rejection, one guaranteed token per tick — and STILL the
+    oracle's tokens (the drafter can only cost speed, never change
+    output)."""
+    paddle.seed(99)
+    draft = GPTForCausalLM(gpt_tiny())
+    draft.eval()
+    eng = ContinuousBatchingEngine(
+        model, slots=2, max_len=64, cache_dtype="float32",
+        prefill_buckets=(16,), tick_tokens=4, speculative="draft",
+        draft_model=draft, spec_k=4)
+    try:
+        for seed, (p, n) in enumerate([(5, 8), (9, 8)]):
+            ids = _prompt(40 + seed, p)
+            want = model.generate(ids[None], max_new_tokens=n,
+                                  cache_dtype="float32")[0]
+            got = eng.generate(ids, max_new_tokens=n, timeout=300)
+            np.testing.assert_array_equal(got, want)
+        st = eng.stats()
+        assert st["tokens_rejected"] > 0
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# serving layer: counters, /healthz, /generate accounting fields
+# ---------------------------------------------------------------------------
+
+def _req(srv, path, payload=None):
+    url = f"http://{srv.host}:{srv.port}{path}"
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(
+        url, data=data,
+        headers={"Content-Type": "application/json"} if data else {})
+    try:
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_serve_reports_spec_fields_and_healthz(model, spec_engine):
+    """/generate bodies carry tokens_generated always and
+    tokens_drafted/tokens_accepted on speculative engines; /healthz
+    surfaces the acceptance knobs; the obs registry exports
+    ptpu_engine_spec_* counters."""
+    from paddle_tpu import obs
+    from paddle_tpu.inference.serve import PredictorServer
+    srv = PredictorServer(engine=spec_engine, port=0).start()
+    try:
+        ids = _rep_prompt(60, 4, 3)
+        code, body = _req(srv, "/generate",
+                          {"input_ids": ids.tolist(),
+                           "max_new_tokens": 8})
+        assert code == 200, body
+        want = model.generate(ids[None], max_new_tokens=8,
+                              cache_dtype="float32")[0]
+        assert body["tokens"] == want.tolist()
+        assert body["tokens_generated"] == 8
+        assert body["tokens_drafted"] >= body["tokens_accepted"] >= 0
+        # eos padding keeps new_tokens at the budget but
+        # tokens_generated truthful
+        eos = int(want[-1])
+        code, body2 = _req(srv, "/generate",
+                           {"input_ids": ids.tolist(),
+                            "max_new_tokens": 8, "eos_token_id": eos})
+        assert code == 200, body2
+        assert body2["new_tokens"] == 8
+        assert body2["tokens_generated"] <= 8
+
+        code, h = _req(srv, "/healthz")
+        assert code == 200, h
+        e = h["engine"]
+        assert e["speculative"] == "ngram" and e["spec_k"] == 4
+        assert e["tokens_drafted"] >= e["tokens_accepted"]
+        assert 0.0 <= e["acceptance_rate"] <= 1.0
+        assert e["accepted_tokens_per_tick"] >= 0.0
+
+        if obs.enabled():
+            reg = obs.metrics.registry
+            for name in ("ptpu_engine_spec_ticks_total",
+                         "ptpu_engine_spec_drafted_total",
+                         "ptpu_engine_spec_accepted_total",
+                         "ptpu_engine_spec_rejected_total"):
+                m = reg.get(name)
+                assert m is not None and m.value() >= 0, name
+            assert reg.get("ptpu_engine_spec_drafted_total").value() \
+                >= reg.get("ptpu_engine_spec_accepted_total").value()
+    finally:
+        srv.stop()
+
+

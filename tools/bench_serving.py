@@ -483,6 +483,143 @@ def bench_paged(smoke: bool):
     }
 
 
+def bench_spec(smoke: bool):
+    """Speculative (n-gram self-drafting) vs plain decode on a
+    repetitive-text mix (ISSUE 13).
+
+    The claim being measured: with the n-gram drafter hitting, one
+    verify forward emits MULTIPLE tokens (accepted prefix + correction)
+    where the plain tick pays one forward per token — so end-to-end
+    ms/token drops on repetitive context at bitwise-identical greedy
+    output.
+
+    Workload honesty: "repetitive text" means text whose GREEDY
+    CONTINUATION is repetitive (templated continuations, quoted
+    context, code — the regime speculative decoding targets). A
+    random-weight tiny model doesn't speak English, so arbitrary
+    prompts produce arbitrary drift — the plain-decode regime, not the
+    one being measured. The bench therefore SCREENS candidate periodic
+    prompts through one plain generate() each and keeps those the
+    model actually continues repetitively (its attractors — the
+    tiny-model stand-in for real repetitive text); the screen is
+    reported in the record, not hidden.
+
+    Hard asserts (rec["clean"]): token identity spec vs plain for
+    every request, ZERO recompiles across the measured phase on BOTH
+    engines, accepted-tokens-per-tick (per slot per verify forward)
+    > 1, and a ms/token win for the speculative engine.
+    """
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.engine import ContinuousBatchingEngine
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+
+    paddle.seed(0)
+    model = GPTForCausalLM(gpt_tiny())
+    model.eval()
+    rng = np.random.RandomState(0)
+
+    # slots divide reqs: waves admit and retire ALIGNED (equal budgets
+    # through FIFO admission), so neither engine pays ragged-tail
+    # ticks where one live slot rides a full-batch dispatch
+    slots, tick, spec_k = 4, 4, 12
+    reqs = 4 if smoke else 8
+    max_new = 80
+    rounds = 2 if smoke else 3
+
+    def is_repetitive(out_new):
+        t = out_new[2:]
+        return any((t[:-g] == t[g:]).all() for g in range(1, 5))
+
+    prompts, screened = [], 0
+    while len(prompts) < reqs and screened < 32 * reqs:
+        period = 3 + (screened % 3)
+        pat = rng.randint(0, 250, (period,)).astype("int64")
+        cand = np.tile(pat, -(-16 // period))[:16]
+        screened += 1
+        out = model.generate(cand[None], max_new_tokens=max_new,
+                             cache_dtype="float32")[0][16:]
+        if is_repetitive(out):
+            prompts.append(cand)
+    assert len(prompts) == reqs, \
+        f"only {len(prompts)}/{reqs} repetitive prompts in " \
+        f"{screened} candidates"
+
+    def mk(spec):
+        return ContinuousBatchingEngine(
+            model, slots=slots, max_len=128, cache_dtype="float32",
+            prefill_buckets=(8, 16), tick_tokens=tick,
+            max_queue=4 * reqs,
+            speculative="ngram" if spec else False, spec_k=spec_k)
+
+    def drive(eng):
+        futs = [eng.submit(p, max_new_tokens=max_new) for p in prompts]
+        return [f.result(timeout=600) for f in futs]
+
+    # both engines live for the whole measurement; passes INTERLEAVE
+    # (plain, spec, plain, spec, ...) and each side keeps its best —
+    # this 1-core host's seconds-scale load jitter correlates across
+    # neighbors, so interleaved best-of-N beats per-side averaging
+    # (the bench_train_loop discipline)
+    engines = {"plain": mk(False), "spec": mk(True)}
+    results, walls = {}, {"plain": [], "spec": []}
+    warm_progs = {}
+    for name, eng in engines.items():
+        eng.warmup()
+        results[name] = drive(eng)       # warm pass: steady-state only
+        warm_progs[name] = eng.compiled_program_count
+    for _ in range(rounds):
+        for name, eng in engines.items():
+            t0 = time.perf_counter()
+            outs = drive(eng)
+            walls[name].append(time.perf_counter() - t0)
+            results[name] = outs
+    timing = {}
+    tokens = reqs * max_new
+    for name, eng in engines.items():
+        wall = min(walls[name])
+        timing[name] = {
+            "wall_s": round(wall, 3),
+            "ms_per_token": round(wall * 1e3 / tokens, 3),
+            "recompiles_measured_phase":
+                eng.compiled_program_count - warm_progs[name],
+            "stats": eng.stats(),
+        }
+        eng.stop()
+
+    identical = all(
+        np.array_equal(a, b)
+        for a, b in zip(results["plain"], results["spec"]))
+    st = timing["spec"]["stats"]
+    per_tick = st["accepted_tokens_per_tick"]
+    plain_ms = timing["plain"]["ms_per_token"]
+    spec_ms = timing["spec"]["ms_per_token"]
+    clean = (identical
+             and timing["plain"]["recompiles_measured_phase"] == 0
+             and timing["spec"]["recompiles_measured_phase"] == 0
+             and per_tick > 1.0
+             and spec_ms < plain_ms)
+    return {
+        "requests": reqs,
+        "prompts_screened": screened,
+        "max_new_tokens": max_new,
+        "spec_k": spec_k,
+        "tick_tokens": tick,
+        "tokens_identical": identical,
+        "plain_ms_per_token": plain_ms,
+        "spec_ms_per_token": spec_ms,
+        "speedup": round(plain_ms / max(spec_ms, 1e-9), 3),
+        "accepted_tokens_per_tick": per_tick,
+        "acceptance_rate": st["acceptance_rate"],
+        "tokens_drafted": st["tokens_drafted"],
+        "tokens_accepted": st["tokens_accepted"],
+        "spec_ticks": st["spec_ticks"],
+        "recompiles_measured_phase": [
+            timing["plain"]["recompiles_measured_phase"],
+            timing["spec"]["recompiles_measured_phase"]],
+        "clean": clean,
+    }
+
+
 def bench_tier(smoke: bool, clients: int, per_client: int):
     """Closed-loop clients through the router tier across chaos phases.
 
@@ -685,6 +822,11 @@ def main():
                     help="paged vs slot-row engine at equal cache "
                          "bytes: concurrency-at-fixed-memory + "
                          "prefix-hit admission latency (ISSUE 9)")
+    ap.add_argument("--spec", action="store_true",
+                    help="speculative (n-gram drafter) vs plain decode "
+                         "on a repetitive-text mix: accepted-tokens/"
+                         "tick + ms/token, identity and zero-recompile "
+                         "asserted (ISSUE 13)")
     ap.add_argument("--clients", type=int, default=8,
                     help="closed-loop clients (engine slots follow)")
     ap.add_argument("--per-client", type=int, default=None,
@@ -698,6 +840,22 @@ def main():
     probe_backend()  # cpu is a healthy result; exits 4 if tunnel wedged
     if lock is not None:
         lock.stage("compile+measure")
+
+    if args.spec:
+        rec = bench_spec(args.smoke)
+        import jax
+        rec.update({
+            "metric": "serving_speculative_decode",
+            "value": rec["accepted_tokens_per_tick"],
+            "unit": "accepted_tokens_per_verify_tick",
+            "device_kind": getattr(jax.devices()[0], "device_kind",
+                                   "cpu"),
+            "smoke": bool(args.smoke),
+        })
+        print(json.dumps(rec))
+        # identity / zero-recompile / multi-token-tick / ms-per-token
+        # win are ASSERTED (rec["clean"]), not just reported
+        return 0 if rec["clean"] else 1
 
     if args.paged:
         rec = bench_paged(args.smoke)

@@ -56,6 +56,11 @@ QUICK_FILES = [
     # prefix-trie units + paged-engine token-identity, prefix-skips-
     # prefill, zero-recompile and cache_exhausted shed contract
     "tests/test_paged_engine.py",
+    # speculative decoding (ISSUE 13): n-gram/draft proposers, the
+    # batched verify-k program's bitwise token identity (f32/int8,
+    # slot/paged), zero-recompile under k/acceptance drift, and the
+    # /generate accounting fields
+    "tests/test_speculative.py",
     # fused K-step train loop: scanned-vs-sequential bitwise identity +
     # the 2-programs-per-epoch trace-counter bound
     "tests/test_scan_train.py",

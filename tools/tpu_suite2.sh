@@ -39,6 +39,12 @@ run bench_serving_tier bench_serving_tier.json \
 # hit-cuts-admission are asserted in-tool; self-skips once landed
 run bench_serving_paged bench_serving_paged.json \
     python tools/bench_serving.py --paged
+# speculative decoding vs plain decode on a repetitive-text mix
+# (ISSUE 13): accepted-tokens/verify-tick + ms/token; token identity,
+# zero recompiles and the ms/token win are asserted in-tool;
+# self-skips once landed
+run bench_serving_spec bench_serving_spec.json \
+    python tools/bench_serving.py --spec
 # obs decode-tick overhead gate (ISSUE 8): enabled-vs-disabled tick
 # time, paired-median on/off rounds; asserts the ratio <= 1.02 —
 # self-skips once landed like every other step
