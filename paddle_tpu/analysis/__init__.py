@@ -23,11 +23,18 @@ systematic way to inspect it BEFORE it reaches hardware:
   per-program FLOP/HBM/roofline inventory with fusion histogram and
   the ranked unfused-chain report; `tools/tpucost.py` gates CI on
   ratcheted budgets + anchors in tools/tpucost_baseline.json.
+- runtime_profile: the tpuprof pass — measured per-kernel device time
+  (programmatic jax.profiler, stdlib chrome-trace parser) JOINED with
+  hlo_cost's modeled inventory: time-weighted fusion histogram,
+  measured-vs-roofline ratios, time-ranked unfused chains;
+  `tools/tpuprof.py` gates CI on a noise-tolerant dispatch-time
+  ratchet + measured anchors in tools/tpuprof_baseline.json.
 - report:        the shared --json artifact + terminal-record contract
-  both CLIs emit (tools/_have_result.py predicate).
+  the CLIs emit (tools/_have_result.py predicate).
 
 CLIs: python tools/tpulint.py [--update-baseline] [--json out.json]
       python tools/tpucost.py [--update-baseline] [--json out.json]
+      python tools/tpuprof.py [--update-baseline] [--json out.json]
 """
 from .findings import (Finding, Severity, count_findings,
                        diff_against_baseline, findings_to_json,
@@ -45,6 +52,11 @@ from .hlo_cost import (CHIP_SPECS, DEFAULT_CHIP, ChipSpec,
                        parse_hlo_module, program_cost,
                        updated_cost_baseline)
 from .fusion import fusion_histogram, unfused_chains
+from .runtime_profile import (check_profile_baseline, device_op_times,
+                              join_measured_modeled,
+                              load_profile_baseline, load_trace_events,
+                              profile_program, runtime_report,
+                              updated_profile_baseline)
 from .report import terminal_record, write_report_artifact
 
 __all__ = [
@@ -60,5 +72,8 @@ __all__ = [
     "analytic_verify_hbm_bytes",
     "check_cost_baseline", "load_cost_baseline",
     "updated_cost_baseline", "fusion_histogram", "unfused_chains",
+    "load_trace_events", "device_op_times", "join_measured_modeled",
+    "runtime_report", "profile_program", "check_profile_baseline",
+    "load_profile_baseline", "updated_profile_baseline",
     "write_report_artifact", "terminal_record",
 ]

@@ -38,6 +38,7 @@ __all__ = [
     "JIT_IN_CALL", "JIT_NO_DONATION", "TRACED_ATTR_MUTATION",
     "NUMPY_IN_TRACE", "STALE_QUARANTINE",
     "COST_BUDGET", "COST_ANCHOR", "STALE_COST_PROGRAM",
+    "PROF_BUDGET", "PROF_ANCHOR", "STALE_PROF_PROGRAM",
     "count_findings", "diff_against_baseline", "load_baseline",
     "findings_to_json", "GATE_SEVERITIES",
 ]
@@ -64,6 +65,10 @@ STALE_QUARANTINE = "stale-quarantine"    # quarantine entry matches no test
 COST_BUDGET = "cost-budget"              # ratcheted budget exceeded
 COST_ANCHOR = "cost-anchor"              # hand-set cost invariant broken
 STALE_COST_PROGRAM = "stale-cost-program"  # baseline names a gone program
+# tpuprof (runtime_profile.py) measured-runtime gate
+PROF_BUDGET = "prof-budget"              # measured dispatch-time ratchet
+PROF_ANCHOR = "prof-anchor"              # hand-set measured invariant
+STALE_PROF_PROGRAM = "stale-prof-program"  # baseline names a gone program
 
 
 class Severity:

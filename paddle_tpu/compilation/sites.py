@@ -38,17 +38,13 @@ __all__ = ["ensure_registered"]
 
 def _tree_nbytes(tree) -> int:
     """Total leaf bytes of a pytree (params/caches) — the geometry
-    inputs the tpucost decode anchor computes its analytic bound from."""
-    import jax
-    total = 0
-    for leaf in jax.tree_util.tree_leaves(tree):
-        shape = tuple(getattr(leaf, "shape", ()) or ())
-        n = 1
-        for d in shape:
-            n *= int(d)
-        dt = getattr(leaf, "dtype", None)
-        total += n * (np.dtype(dt).itemsize if dt is not None else 4)
-    return total
+    inputs the tpucost decode anchor computes its analytic bound from.
+    ONE implementation, shared with the live engine gauges
+    (obs/efficiency.py): the modeled bytes the anchors price and the
+    bytes the ptpu_engine_tick_model_eff gauge divides by must never
+    drift apart."""
+    from ..obs.efficiency import tree_nbytes
+    return tree_nbytes(tree)
 
 
 def _gpt_tiny_model():
@@ -121,12 +117,8 @@ def build_gpt_decode_paged() -> BuildResult:
     # kv_cache_bytes is the page POOL (what HBM actually holds);
     # kv_view_bytes is the gathered [N, pages_per_slot * page] view one
     # micro-step materializes — the paged analytic anchor prices both
-    view_bytes = 0
-    for kc, vc in eng._caches:
-        for half in (kc, vc):
-            for leaf in half.values():
-                per_page = _tree_nbytes(leaf) // leaf.shape[0]
-                view_bytes += per_page * eng.pages_per_slot * eng.slots
+    # (the engine's own gauge geometry computes the same number)
+    view_bytes = eng._kv_view_nbytes()
     geometry = {
         "kind": "decode_paged", "slots": eng.slots,
         "max_len": eng.max_len, "page_size": eng.page_size,

@@ -43,6 +43,14 @@ def _obs_hist(name, help_):
     return obs.metrics.registry.histogram(name, help_)
 
 
+def _obs_gauge(name, help_):
+    """Registry gauge under the same obs gate as _obs_hist."""
+    from .. import obs
+    if not obs.enabled():
+        return None
+    return obs.metrics.registry.gauge(name, help_)
+
+
 class Model:
     """Parity: paddle.Model(network, inputs=None, labels=None)."""
 
@@ -350,6 +358,12 @@ class Model:
         logs = {}
         h_step = _obs_hist("ptpu_train_step_ms",
                            "per-step dispatch wall time")
+        g_mfu = _obs_gauge("ptpu_train_mfu",
+                           "model-FLOPs-utilization of the last train "
+                           "dispatch (obs.efficiency, chip-relative)")
+        g_step_s = _obs_gauge("ptpu_train_step_seconds",
+                              "measured wall seconds per optimizer "
+                              "step (last dispatch)")
         for data in (batches if batches is not None else loader):
             if self._ff_remaining > 0:
                 # resume fast-forward: this batch was already trained
@@ -376,7 +390,10 @@ class Model:
             for cb in cbs:
                 cb.on_train_batch_end(step_i, logs)
             if h_step is not None:
-                h_step.observe((time.perf_counter() - t_step) * 1e3)
+                dt_step = time.perf_counter() - t_step
+                h_step.observe(dt_step * 1e3)
+                self._observe_train_eff(g_mfu, g_step_s, dt_step, 1,
+                                        x[0] if x else None)
             step_i += 1
             it_count += 1
             if num_iters is not None and it_count >= num_iters:
@@ -409,6 +426,14 @@ class Model:
         h_window = _obs_hist("ptpu_train_window_ms",
                              "fused K-step window wall time") \
             if obs_on else None
+        g_mfu = _obs_gauge("ptpu_train_mfu",
+                           "model-FLOPs-utilization of the last train "
+                           "dispatch (obs.efficiency, chip-relative)") \
+            if obs_on else None
+        g_step_s = _obs_gauge("ptpu_train_step_seconds",
+                              "measured wall seconds per optimizer "
+                              "step (last dispatch)") \
+            if obs_on else None
         logs = {}
         step_i = 0
         win_iter = iter(prefetch_to_device(loader, k, depth=depth))
@@ -432,10 +457,12 @@ class Model:
                    if self._train_step is not None else 0)
             healing = (self._ff_remaining > 0
                        or self._skip_overlap(pos, pos + k))
+            eff_x0 = None
             if win.full and not healing and \
                     (remaining is None or remaining >= k):
                 x, y = self._split_batch(win.data)
                 step = self._ensure_train_step(len(x))
+                eff_x0 = x[0] if x else None
 
                 def run_window(x=x, y=y):
                     with _obs.span("train.dispatch", cat="train",
@@ -471,10 +498,51 @@ class Model:
                     step_i=step_i, batches=tail)
                 logs = logs2 or logs
             if obs_on:
-                h_window.observe((time.perf_counter() - t_win) * 1e3)
+                dt_win = time.perf_counter() - t_win
+                h_window.observe(dt_win * 1e3)
+                if eff_x0 is not None:
+                    # full fused window: K steps, one dispatch (the
+                    # tail fallback exported per-step gauges itself)
+                    self._observe_train_eff(g_mfu, g_step_s, dt_win,
+                                            k, eff_x0)
             if num_iters is not None and it_count >= num_iters:
                 break
         return logs, it_count
+
+    def _observe_train_eff(self, g_mfu, g_step_s, dt_s, steps, x0):
+        """Export ``ptpu_train_mfu`` + ``ptpu_train_step_seconds`` for
+        one dispatch (a single step or a fused K-step window) — the
+        ONE shared formula in obs/efficiency.py over the measured wall
+        time (ISSUE 14: the bench records and these gauges must never
+        disagree). Token accounting: integer inputs are token ids so
+        every dim counts (a [K,B,S] super-batch is K*B*S tokens);
+        float inputs count batch dims only (trailing feature dim
+        excluded) — the nominal 6*N*T proxy efficiency.
+        train_step_flops documents."""
+        if g_mfu is None or dt_s <= 0 or self._train_step is None:
+            return
+        from ..obs import efficiency as eff
+        step = self._train_step
+        if getattr(self, "_eff_step", None) is not step:
+            # param count is per-built-step (a rebuild may follow an
+            # accumulate change); shapes only, no device sync
+            self._eff_step = step
+            self._eff_nparams = eff.tree_nelems(step.params)
+        shape = tuple(getattr(x0, "shape", ()) or ())
+        if not shape:
+            return
+        try:
+            is_int = np.issubdtype(np.dtype(getattr(x0, "dtype", None)),
+                                   np.integer)
+        except TypeError:
+            is_int = False
+        dims = shape if is_int or len(shape) == 1 else shape[:-1]
+        tokens = 1
+        for d in dims:
+            tokens *= int(d)
+        g_mfu.set(eff.mfu(
+            eff.train_step_flops(self._eff_nparams, tokens), dt_s))
+        g_step_s.set(dt_s / max(1, int(steps)))
 
     def _skip_hit(self, pos: int) -> bool:
         return any(lo <= pos < hi for lo, hi in self._skip_windows)

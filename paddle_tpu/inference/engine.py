@@ -118,6 +118,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .. import obs as _obs
+from ..obs import efficiency as _eff
 from ..distributed import resilience as _resil
 from ..jit.functional import functional_call, raw_state
 from ..models.generation import _select_token
@@ -377,6 +378,11 @@ class ContinuousBatchingEngine:
         self.ticks = 0
         self.admitted = 0
         self.completed = 0
+        # last tick's model efficiency (obs.efficiency): modeled HBM
+        # bytes over measured tick wall time as a fraction of the
+        # efficiency chip's bandwidth; 0.0 until a tick ran (or with
+        # obs off — stats() stays shape-uniform either way)
+        self.last_tick_model_eff = 0.0
 
         # speculative proposer + counters (always present so stats()
         # reads uniformly; the proposer exists only when configured)
@@ -459,6 +465,30 @@ class ContinuousBatchingEngine:
                     "tokens emitted per slot per verify tick "
                     "(accepted prefix + correction)",
                     buckets=tuple(range(0, self._spec.k + 2)))
+            # live model efficiency (obs.efficiency — ISSUE 14): the
+            # decode tick is bandwidth-bound (tpucost's anchor), so
+            # each tick exports modeled HBM bytes over its measured
+            # wall time as a fraction of the efficiency chip's
+            # bandwidth. The modeled-bytes constants are the SAME
+            # analytic bounds the tpucost anchors price (one formula,
+            # no drift); they are computed once here so the per-tick
+            # cost is one multiply + one gauge set.
+            geom = {"tick_tokens": self.tick_tokens,
+                    "param_bytes": _eff.tree_nbytes(
+                        (self._params, self._buffers)),
+                    "kv_cache_bytes": _eff.tree_nbytes(self._caches)}
+            if self.paged:
+                geom["kv_view_bytes"] = self._kv_view_nbytes()
+            self._tick_model_bytes = _eff.modeled_tick_bytes(
+                "decode_paged" if self.paged else "decode", geom)
+            self._verify_model_bytes = (
+                _eff.modeled_tick_bytes("verify", geom)
+                if self._spec is not None else 0)
+            self._eff_chip = _eff.chip_spec()
+            self._g_tick_eff = reg.gauge(
+                _eff.TICK_EFF_GAUGE,
+                "decode tick modeled-bytes/s over measured wall time, "
+                "as a fraction of the efficiency chip's HBM bandwidth")
 
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="cb-engine")
@@ -555,6 +585,20 @@ class ContinuousBatchingEngine:
         return self.submit(input_ids, max_new_tokens, eos_token_id,
                            seed).result(timeout)
 
+    def _kv_view_nbytes(self) -> int:
+        """Bytes of the gathered [N, pages_per_slot * page_size] KV
+        view one PAGED micro-step materializes (all layers, k + v) —
+        the geometry input the paged analytic HBM bound prices
+        alongside the pool itself (compilation/sites.py exports the
+        same number on the gpt_decode_paged registry geometry)."""
+        total = 0
+        for kc, vc in self._caches:
+            for half in (kc, vc):
+                for leaf in half.values():
+                    per_page = _eff.tree_nbytes(leaf) // leaf.shape[0]
+                    total += per_page * self.pages_per_slot * self.slots
+        return total
+
     def stats(self) -> dict:
         with self._cv:
             active = sum(1 for s in self._slots if not s.free)
@@ -569,7 +613,11 @@ class ContinuousBatchingEngine:
                "max_len": self.max_len,
                "cache_dtype": self.cache_dtype,
                "paged": self.paged,
-               "speculative": (self._spec.kind if self._spec else None)}
+               "speculative": (self._spec.kind if self._spec else None),
+               # obs.efficiency: last tick's modeled-bytes/s as a
+               # fraction of the efficiency chip's HBM bandwidth
+               # (0.0 before the first tick or with obs disabled)
+               "tick_model_eff": round(self.last_tick_model_eff, 6)}
         if self._spec is not None:
             drafted = self.tokens_drafted
             out.update({
@@ -1243,6 +1291,13 @@ class ContinuousBatchingEngine:
             self._m_ticks.inc()
             self._m_spec_ticks.inc()
             self._m_occupancy.observe(n_live)
+            if now > t_tick:
+                # the verify dispatch moves the single-pass k-token
+                # bound's bytes, not tick_tokens passes
+                self.last_tick_model_eff = _eff.model_bandwidth_eff(
+                    self._verify_model_bytes, now - t_tick,
+                    self._eff_chip)
+                self._g_tick_eff.set(self.last_tick_model_eff)
             _obs.record_span("engine.tick", t_tick, now, cat="engine",
                              active=n_live, tick=self.ticks, spec=True)
         for i, s in enumerate(self._slots):
@@ -1315,6 +1370,11 @@ class ContinuousBatchingEngine:
             now = time.perf_counter()
             self._m_ticks.inc()
             self._m_occupancy.observe(n_live)
+            if now > t_tick:
+                self.last_tick_model_eff = _eff.model_bandwidth_eff(
+                    self._tick_model_bytes, now - t_tick,
+                    self._eff_chip)
+                self._g_tick_eff.set(self.last_tick_model_eff)
             _obs.record_span("engine.tick", t_tick, now, cat="engine",
                              active=n_live, tick=self.ticks)
         for i, s in enumerate(self._slots):

@@ -315,7 +315,11 @@ class PredictorServer:
             body["engine"] = {k: st[k] for k in
                               ("slots", "active", "free", "queued",
                                "max_queue", "ticks",
-                               "compiled_programs")}
+                               "compiled_programs",
+                               # obs.efficiency live gauge mirror: last
+                               # tick's modeled-bytes/s fraction of the
+                               # efficiency chip's HBM bandwidth
+                               "tick_model_eff")}
             body["engine"]["warm"] = getattr(self.engine, "warm", True)
             if st.get("paged"):
                 # paged KV pool health: an autoscaler reads page

@@ -12,6 +12,11 @@ measurement layer they all feed:
   untouched), exported as Prometheus-style text on ``/metrics``
   (PredictorServer and the router; the router additionally scrapes and
   aggregates replica metrics into ``ptpu_tier_*`` series).
+* :mod:`.efficiency` — the ONE MFU / model-efficiency formula: model
+  FLOPs (training) or modeled HBM bytes (the bandwidth-bound decode
+  tick) over measured wall time, relative to one chip's peak —
+  exported live as ``ptpu_train_mfu`` / ``ptpu_engine_tick_model_eff``
+  and reused verbatim by the bench JSON records.
 * :mod:`.trace` — request-scoped span tracer (request ids propagate
   router -> replica -> engine via the ``X-PTPU-Request-Id`` header)
   buffering into a fixed-size ring-buffer **flight recorder**, with
@@ -34,8 +39,9 @@ from __future__ import annotations
 
 import os
 
-__all__ = ["enabled", "set_enabled", "metrics", "trace", "registry",
-           "recorder", "span", "record_span", "dump_flight"]
+__all__ = ["enabled", "set_enabled", "metrics", "trace", "efficiency",
+           "registry", "recorder", "span", "record_span",
+           "dump_flight"]
 
 _enabled_override = None     # set_enabled() tri-state; None -> env
 _enabled_env = None          # cached env read
@@ -66,6 +72,6 @@ def set_enabled(on) -> None:
     _enabled_env = None
 
 
-from . import metrics, trace                              # noqa: E402
+from . import efficiency, metrics, trace                  # noqa: E402
 from .metrics import registry                             # noqa: E402
 from .trace import dump_flight, record_span, recorder, span  # noqa: E402

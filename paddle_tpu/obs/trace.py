@@ -150,6 +150,25 @@ class FlightRecorder:
             self._open.clear()
 
 
+def _unique_dir(parent: str, base: str) -> str:
+    """Create and return a fresh directory ``parent/base`` — with a
+    ``.N`` suffix when the name is taken. The jax-profile capture dir
+    is stamped at SECOND granularity (time.strftime); two captures in
+    the same second (a tier poking every replica, a test loop) must
+    not interleave their xplane files in one directory."""
+    os.makedirs(parent, exist_ok=True)
+    path = os.path.join(parent, base)
+    for i in range(10000):
+        try:
+            os.makedirs(path if i == 0 else f"{path}.{i}",
+                        exist_ok=False)
+            return path if i == 0 else f"{path}.{i}"
+        except FileExistsError:
+            continue
+    raise OSError(f"could not create a unique capture dir under "
+                  f"{parent!r} (base {base!r})")
+
+
 def _ring_size() -> int:
     try:
         return int(os.environ.get("PADDLE_TPU_OBS_RING", 4096))
@@ -287,10 +306,9 @@ def capture(duration_s: float = 0.0, jax_profile: bool = False) -> dict:
     if jax_profile:
         try:
             import jax
-            prof_dir = os.path.join(
+            prof_dir = _unique_dir(
                 artifact_dir(),
                 "jax_profile_" + time.strftime("%Y%m%d_%H%M%S"))
-            os.makedirs(prof_dir, exist_ok=True)
             jax.profiler.start_trace(prof_dir)
         except Exception as e:   # noqa: BLE001 — degrade, don't 500
             meta["jax_profile_error"] = f"{type(e).__name__}: {e}"

@@ -400,6 +400,34 @@ def test_watchdog_nan_storm_dumps_flight_artifact(tmp_path, monkeypatch):
     assert doc["metadata"]["reason"] == "watchdog_nan_storm"
 
 
+@pytest.mark.timeout(120)
+def test_same_second_jax_profile_captures_get_distinct_dirs(
+        tmp_path, monkeypatch):
+    """capture(jax_profile=True) stamps its artifact dir at SECOND
+    granularity (time.strftime) — two captures inside one second (a
+    tier poking every replica, a test loop) must land in distinct
+    directories, not interleave their xplane files (ISSUE 14)."""
+    import jax
+    from paddle_tpu.obs import trace as trace_mod
+    monkeypatch.setenv("PADDLE_TPU_OBS_DIR", str(tmp_path))
+    # force the collision: both captures see the same wall-clock stamp
+    monkeypatch.setattr(trace_mod.time, "strftime",
+                        lambda *a, **k: "19990101_000000")
+    # stub the device profiler: the unit under test is the DIRECTORY
+    # uniquification, and a real jax.profiler session permanently
+    # slows every later XLA compile in this process ~1.5x (measured
+    # 2026-08-04) — the whole tier-1 tail would pay for it
+    monkeypatch.setattr(jax.profiler, "start_trace", lambda d: None)
+    monkeypatch.setattr(jax.profiler, "stop_trace", lambda: None)
+    m1 = trace_mod.capture(0, jax_profile=True)["metadata"]
+    m2 = trace_mod.capture(0, jax_profile=True)["metadata"]
+    assert "jax_profile_dir" in m1, m1
+    assert "jax_profile_dir" in m2, m2
+    assert m1["jax_profile_dir"] != m2["jax_profile_dir"]
+    assert os.path.isdir(m1["jax_profile_dir"])
+    assert os.path.isdir(m2["jax_profile_dir"])
+
+
 # ---------------------------------------------------------------------------
 # live 2-replica tier: acceptance criteria
 # ---------------------------------------------------------------------------

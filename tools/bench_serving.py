@@ -274,8 +274,19 @@ def bench_concurrent(smoke: bool, clients: int, per_client: int,
     a_wall_engine, _ = run_engine(a_reqs)
     a_wall_seq = run_sequential(a_reqs)
 
+    # model efficiency (ISSUE 14): the engine's OWN live gauge value —
+    # modeled tick HBM bytes over measured tick wall time as a fraction
+    # of the efficiency chip's bandwidth (obs/efficiency.py, the same
+    # formula ptpu_engine_tick_model_eff exports; chip-relative, so a
+    # CPU run reads as a tiny fraction of a TPU's bandwidth)
+    from paddle_tpu.obs import efficiency as _eff
+    tick_model_eff = engine.stats().get("tick_model_eff")
+
     engine.stop()
     return {
+        "tick_model_eff": tick_model_eff,
+        "eff_gauge": _eff.TICK_EFF_GAUGE,
+        "eff_chip": _eff.chip_spec().name,
         "engine_tokens_per_s": round(engine_tps, 1),
         "sequential_tokens_per_s": round(seq_tps, 1),
         "speedup": round(engine_tps / seq_tps, 2),

@@ -134,7 +134,22 @@ def bench(smoke: bool, steps: int, batch: int, seq: int):
     fused16 = results[16]["steps_per_s"]
     # dispatch+sync overhead amortized away by the K=16 window, per step
     overhead_ms = per_step_ms - 1e3 / fused16
+
+    # MFU via the ONE shared formula (obs/efficiency.py — the same
+    # arithmetic the live ptpu_train_mfu gauge exports per dispatch;
+    # ISSUE 14's "no third formula" rule). Chip-relative: on this CPU
+    # host it reads as a tiny fraction of a TPU's peak — the number
+    # becomes meaningful when the TPU suite runs this tool.
+    from paddle_tpu.obs import efficiency as eff
+    nparams = eff.tree_nelems(step.params)
+    k16_tokens = n_win[16] * 16 * batch * seq
+    train_mfu = eff.mfu(eff.train_step_flops(nparams, k16_tokens),
+                        best[16])
     return {
+        "train_mfu_k16": train_mfu,
+        "mfu_gauge": eff.MFU_GAUGE,
+        "eff_chip": eff.chip_spec().name,
+        "param_count": nparams,
         "metric": "train_loop_fused_speedup",
         "value": round(fused16 / steps_per_s, 3),
         "unit": "x_steps_per_s_K16_vs_per_step_dispatch",

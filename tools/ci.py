@@ -84,6 +84,11 @@ QUICK_FILES = [
     # bitwise, corrupt shards NAMED per leaf + supervisor fall-back,
     # killed reshard leaves the checkpoint untouched
     "tests/test_elastic_checkpoint.py",
+    # measured runtime profiling (ISSUE 14): trace parser + measured<->
+    # modeled join + CPU degrade from checked-in fixtures (zero
+    # compiles), the dispatch-ratchet/anchor gate semantics, one live
+    # profiled registry program, and the efficiency gauges
+    "tests/test_runtime_profile.py",
 ]
 
 
@@ -157,6 +162,25 @@ def _run_tpucost(env, update_baseline=False) -> int:
     return subprocess.run(cmd, cwd=ROOT, env=env).returncode
 
 
+def _run_tpuprof(env, update_baseline=False) -> int:
+    """tpuprof gate: MEASURED dispatch-time + kernel-attribution
+    inventory of the real compiled programs vs
+    tools/tpuprof_baseline.json (ISSUE 14). Nonzero when a program's
+    measured dispatch median blows past its pinned budget * tolerance,
+    or (on a device-plane backend) a measured anchor — train-step
+    matmul time share, decode measured-vs-roofline — breaks. Re-pin
+    after review with `python tools/ci.py --tpuprof
+    --update-baseline`. Not appended to --quick/--full automatically:
+    it EXECUTES every program under the profiler, and wall-time gates
+    belong where wall time is quiet (tpu_suite2.sh runs it; run it by
+    hand when touching a hot program)."""
+    print("\n=== tpuprof measured-runtime gate ===")
+    cmd = [sys.executable, os.path.join("tools", "tpuprof.py")]
+    if update_baseline:
+        cmd.append("--update-baseline")
+    return subprocess.run(cmd, cwd=ROOT, env=env).returncode
+
+
 def _run_warmup(env) -> int:
     """Prime the persistent executable store + the warm jax compile
     cache from the ProgramRegistry (tools/warmup.py) BEFORE the test
@@ -208,11 +232,17 @@ def main():
                     help="run ONLY the tpulint static-analysis gate")
     ap.add_argument("--tpucost", action="store_true",
                     help="run ONLY the tpucost fusion/HBM roofline gate")
+    ap.add_argument("--tpuprof", action="store_true",
+                    help="run ONLY the tpuprof measured-runtime gate "
+                         "(executes every registry program under the "
+                         "profiler — dispatch-time ratchet + measured "
+                         "anchors vs tools/tpuprof_baseline.json)")
     ap.add_argument("--update-baseline", action="store_true",
-                    help="with --tpucost/--tpulint: re-pin that gate's "
-                         "baseline from this run (tpucost anchors and "
-                         "tpulint must_stay_clean entries preserved) — "
-                         "the review-then-accept ratchet flow")
+                    help="with --tpucost/--tpulint/--tpuprof: re-pin "
+                         "that gate's baseline from this run (tpucost/"
+                         "tpuprof anchors and tpulint must_stay_clean "
+                         "entries preserved) — the review-then-accept "
+                         "ratchet flow")
     ap.add_argument("--warmup", action="store_true",
                     help="prime the executable store + warm jax cache "
                          "(tools/warmup.py) before the tests — "
@@ -280,10 +310,12 @@ def main():
         return _run_tpulint(cache_env, args.update_baseline)
     if args.tpucost:
         return _run_tpucost(cache_env, args.update_baseline)
+    if args.tpuprof:
+        return _run_tpuprof(cache_env, args.update_baseline)
     if args.update_baseline:
-        ap.error("--update-baseline only applies with --tpulint or "
-                 "--tpucost (a full test run must never silently "
-                 "re-pin a gate baseline)")
+        ap.error("--update-baseline only applies with --tpulint, "
+                 "--tpucost or --tpuprof (a full test run must never "
+                 "silently re-pin a gate baseline)")
     if args.warmup:
         warm_rc = _run_warmup(cache_env)
         if not (args.quick or args.full or args.k or args.coverage):

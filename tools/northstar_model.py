@@ -244,6 +244,11 @@ def main():
         "anchors_mfu_pct": anchors,
         "mesh": MESH,
         "tokens_per_chip": TOKENS_CHIP,
+        # the live measured counterpart of this analytic model: hapi's
+        # fit loop exports per-dispatch MFU on /metrics under this
+        # gauge name (paddle_tpu/obs/efficiency.py — ISSUE 14), and
+        # tools/bench_train_loop.py records the same formula's value
+        "measured_gauge": "ptpu_train_mfu",
     }))
     return 0
 

@@ -5,6 +5,12 @@ XLA profiler, parse the xplane trace, and report where the step time
 goes (matmul vs attention vs collectives vs elementwise) — the input to
 "attack the largest non-matmul slice".
 
+The trace parsing lives in paddle_tpu/analysis/runtime_profile.py (the
+tpuprof pass — ISSUE 14 folded the parser that used to be private here
+into the ONE implementation tools/tpuprof.py gates CI with); this tool
+keeps its CLI face, the category table, and the terminal JSON contract
+as a thin wrapper over it.
+
 Run on TPU:  python tools/profile_step.py
 CPU smoke:   env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
                  python tools/profile_step.py --smoke
@@ -12,7 +18,6 @@ Prints a category table + top ops, and one JSON summary line last.
 """
 import argparse
 import collections
-import glob
 import json
 import os
 import sys
@@ -22,69 +27,6 @@ import time
 import numpy as np
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
-
-
-def _device_plane_breakdown(logdir):
-    """Aggregate op durations from the device lanes of the chrome trace
-    jax.profiler writes (stdlib gzip+json — no tensorboard needed).
-
-    Returns (per_op_us Counter, op_category dict, had_device bool). On a
-    CPU backend there is no device plane; the caller degrades to a
-    wall-time-only report (the tool's breakdown is for TPU runs)."""
-    import gzip
-    per_op = collections.Counter()
-    op_cat = {}
-    had_device = False
-    for path in glob.glob(os.path.join(logdir, "**", "*.trace.json.gz"),
-                          recursive=True):
-        with gzip.open(path) as f:
-            evs = json.load(f).get("traceEvents", [])
-        device_pids = {
-            e["pid"] for e in evs
-            if e.get("ph") == "M" and e.get("name") == "process_name"
-            and "/device:" in str(e.get("args", {}).get("name", ""))}
-        if not device_pids:
-            continue
-        had_device = True
-        # Only the "XLA Ops" lane holds per-op events; the "Steps" and
-        # "XLA Modules" lanes carry whole-step spans that would double
-        # every total if summed alongside.
-        op_tids = {
-            (e["pid"], e.get("tid")) for e in evs
-            if e.get("ph") == "M" and e.get("name") == "thread_name"
-            and e.get("pid") in device_pids
-            and "XLA Ops" in str(e.get("args", {}).get("name", ""))}
-        for e in evs:
-            if e.get("ph") != "X" or e.get("pid") not in device_pids:
-                continue
-            if op_tids and (e["pid"], e.get("tid")) not in op_tids:
-                continue
-            name = e.get("name", "?")
-            per_op[name] += float(e.get("dur", 0.0))     # us
-            args = e.get("args") or {}
-            cat = args.get("hlo_category") or args.get("category")
-            if cat:
-                op_cat[name] = cat
-    return per_op, op_cat, had_device
-
-
-def _category_of(name, op_cat):
-    if name in op_cat and op_cat[name]:
-        return op_cat[name]
-    n = name.lower()
-    for pat, cat in (("dot", "matmul"), ("conv", "conv"),
-                     ("all-reduce", "collective"),
-                     ("all-gather", "collective"),
-                     ("reduce-scatter", "collective"),
-                     ("collective-permute", "collective"),
-                     ("custom-call", "custom-call (pallas/lib)"),
-                     ("fusion", "fusion"), ("copy", "copy"),
-                     ("scatter", "scatter/gather"),
-                     ("gather", "scatter/gather"),
-                     ("reduce", "reduce"), ("sort", "sort")):
-        if pat in n:
-            return cat
-    return "other"
 
 
 def main():
@@ -105,6 +47,9 @@ def main():
     import jax
 
     import paddle_tpu as paddle
+    from paddle_tpu.analysis.runtime_profile import (category_of,
+                                                     device_op_times,
+                                                     load_trace_events)
     from paddle_tpu.jit import TrainStep
     from paddle_tpu.models import GPTConfig, GPTForCausalLM
 
@@ -141,11 +86,13 @@ def main():
     wall = (time.perf_counter() - t0) / args.steps
     jax.profiler.stop_trace()
 
-    per_op, op_cat, had_device = _device_plane_breakdown(logdir)
+    prof = device_op_times(load_trace_events(logdir))
+    per_op = collections.Counter(prof.per_op)
+    op_cat, had_device = prof.op_category, prof.had_device
     total_us = sum(per_op.values())
     cats = collections.Counter()
     for name, us in per_op.items():
-        cats[_category_of(name, op_cat)] += us
+        cats[category_of(name, op_cat)] += us
 
     if had_device:
         print(f"\n== category breakdown ({args.steps} steps, device "
@@ -156,7 +103,7 @@ def main():
         print(f"\n== top {args.top} ops ==")
         for name, us in per_op.most_common(args.top):
             print(f"  {name[:64]:<64} {us/1e3:9.2f} ms "
-                  f"[{_category_of(name, op_cat)}]")
+                  f"[{category_of(name, op_cat)}]")
     else:
         print("\n(no device plane in trace — CPU backend records host "
               "events only; run on TPU for the op breakdown)")

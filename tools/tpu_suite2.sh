@@ -96,6 +96,15 @@ run tpulint tpulint.json python tools/tpulint.py
 # step self-skips once landed like every other one
 run tpucost tpucost.json python tools/tpucost.py \
     --detail --json "$R/tpucost_report.json"
+# measured runtime profiling gate (ISSUE 14): every registry program
+# executed under jax.profiler — the first HARDWARE-measured per-kernel
+# inventory (device lanes exist on TPU, so the measured<->modeled join
+# and both anchors — train-step matmul time share, decode
+# measured-vs-roofline — actually evaluate here, unlike the degraded
+# CPU run); the full report uploads alongside the terminal record and
+# the step self-skips once landed like every other one
+run tpuprof tpuprof.json python tools/tpuprof.py \
+    --json "$R/tpuprof_report.json"
 # 5. 125M A/Bs (re-use the warm compile cache): fused-CE, pure-bf16 opt
 run bench_125m_fused bench_125m_fused.json \
     env PADDLE_TPU_BENCH_FUSED_CE=1024 python bench.py
