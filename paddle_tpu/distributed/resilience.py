@@ -47,7 +47,7 @@ __all__ = [
     "StepWatchdog", "StepTimeout", "NanInfStorm",
     "LossSpike", "LossSpikeDetector",
     "FaultInjector", "FaultInjected", "maybe_inject", "should_fire",
-    "wedge_seconds",
+    "wedge_seconds", "arm_fault",
     "CheckpointCorrupt",
     "save_train_state", "restore_train_state", "train_state_layout",
     "RngState",
@@ -126,6 +126,14 @@ class RetryPolicy:
     default +/-``jitter`` band around the deterministic schedule.
     ``delay``/``schedule`` stay deterministic either way.
 
+    **Retry-After hints**: when a failed attempt's exception carries a
+    ``retry_after_s`` attribute (the serving layer attaches the 503
+    body's advisory backoff to every shed it relays), ``run`` sleeps
+    exactly that hint — capped by the remaining deadline — instead of
+    the policy schedule. The server's own word about when capacity
+    clears beats any client-side guess; the hint is used verbatim (no
+    jitter) so tests and the shell watcher can rely on it.
+
     ``clock``/``sleep_fn`` are injectable for tests (fake clock): they
     default to ``time.monotonic``/``time.sleep`` and are the ONLY
     time sources ``run`` consults.
@@ -190,16 +198,22 @@ class RetryPolicy:
         """The full inter-attempt delay schedule (len max_attempts-1)."""
         return tuple(self.delay(a) for a in range(1, self.max_attempts))
 
-    def sleep(self, attempt: int, budget: Optional[float] = None) -> float:
+    def sleep(self, attempt: int, budget: Optional[float] = None,
+              hint: Optional[float] = None) -> float:
         """Sleep the (jittered) post-attempt delay; returns the time
         slept. ``budget`` caps the sleep (remaining deadline). With
         ``full_jitter`` the sleep is drawn uniform from
-        [0, delay(attempt)] instead of a +/-jitter band."""
-        d = self.delay(attempt)
-        if self.full_jitter:
-            d = random.uniform(0.0, d)
-        elif self.jitter:
-            d *= 1.0 + random.uniform(-self.jitter, self.jitter)
+        [0, delay(attempt)] instead of a +/-jitter band. A ``hint``
+        (the server's Retry-After, in seconds) REPLACES the schedule
+        verbatim — still capped by ``budget``."""
+        if hint is not None:
+            d = max(0.0, float(hint))
+        else:
+            d = self.delay(attempt)
+            if self.full_jitter:
+                d = random.uniform(0.0, d)
+            elif self.jitter:
+                d *= 1.0 + random.uniform(-self.jitter, self.jitter)
         if budget is not None:
             d = max(0.0, min(d, budget))
         if d > 0:
@@ -233,7 +247,12 @@ class RetryPolicy:
                     remaining = None
                 if on_retry is not None:
                     on_retry(attempt, e)
-                self.sleep(attempt, budget=remaining)
+                hint = getattr(e, "retry_after_s", None)
+                try:
+                    hint = None if hint is None else float(hint)
+                except (TypeError, ValueError):
+                    hint = None
+                self.sleep(attempt, budget=remaining, hint=hint)
         raise AssertionError("unreachable")
 
 
@@ -267,6 +286,11 @@ def with_retries(fn: Callable, *args,
 #                       the tier control loop retries on its next pass)
 #   replica_health      a replica health poll fails (raises; counts
 #                       toward the router's unhealthy streak)
+#   replica_stall       a replica's engine decode loop WEDGES (sleeps —
+#                       latency injection, not death: the process stays
+#                       alive, /healthz keeps answering ready, only
+#                       token progress stops; the straggler scenario
+#                       the router's hedged decode exists for)
 #   train_step_nan      hapi Model.train_batch reports a NaN loss for
 #                       one step (the real program still ran — a
 #                       transient divergence the supervisor's rollback
@@ -290,6 +314,7 @@ _KNOWN_SITES = frozenset([
     "dataloader_worker", "step_hang", "step_nan", "train_crash",
     "serve_backend", "serve_hang",
     "router_forward", "replica_spawn", "replica_health",
+    "replica_stall",
     "train_step_nan", "preempt_signal", "ckpt_gc", "ckpt_reshard",
 ])
 
@@ -362,7 +387,8 @@ def maybe_inject(site: str) -> None:
     it does not error — that is the whole point)."""
     if not should_fire(site):
         return
-    if site in ("collective", "step_hang", "serve_hang"):
+    if site in ("collective", "step_hang", "serve_hang",
+                "replica_stall"):
         time.sleep(wedge_seconds())
         return
     if site == "host_drop":
@@ -370,6 +396,27 @@ def maybe_inject(site: str) -> None:
             "injected: peer host dropped out of rendezvous "
             "(PADDLE_TPU_FAULT_INJECT=host_drop)")
     raise FaultInjected(site)
+
+
+def arm_fault(site: str, count: int = 1,
+              wedge_s: Optional[float] = None) -> None:
+    """Programmatic (non-context) arming of an injection site — the
+    serving tier's chaos admin endpoint (``POST /admin/inject``, gated
+    on PADDLE_TPU_CHAOS_ADMIN) uses it to wedge/fail a LIVE replica
+    from outside the process. Counts add like nested FaultInjectors;
+    there is no paired disarm — an armed-but-unfired count stays armed
+    for the life of the process (chaos benches arm exactly what they
+    intend to fire)."""
+    global _wedge_s
+    if site not in _KNOWN_SITES:
+        raise ValueError(
+            f"unknown fault-injection site {site!r}; known: "
+            f"{sorted(_KNOWN_SITES)}")
+    _ensure_env_loaded()
+    with _inject_lock:
+        _active[site] = _active.get(site, 0) + int(count)
+        if wedge_s is not None:
+            _wedge_s = float(wedge_s)
 
 
 class FaultInjector:

@@ -12,7 +12,8 @@ pays no trace). The Config/Predictor/Tensor-handle API surface matches the
 reference so serving code ports directly.
 """
 from .engine import (CacheExhausted, ContinuousBatchingEngine,
-                     EngineOverloaded, GenerationPredictor)
+                     EngineOverloaded, GenerationPredictor,
+                     RequestCancelled)
 from .speculative import (DraftModelProposer, NGramProposer,
                           SpeculativeConfig)
 from .router import Replica, ReplicaSpec, Router
@@ -27,7 +28,7 @@ from .predictor import (Config, DataType, PlaceType, PrecisionType,
 __all__ = ["Config", "Predictor", "create_predictor", "Tensor",
            "PlaceType", "DataType", "PrecisionType", "PredictorPool",
            "ContinuousBatchingEngine", "EngineOverloaded",
-           "CacheExhausted", "GenerationPredictor",
+           "CacheExhausted", "RequestCancelled", "GenerationPredictor",
            "SpeculativeConfig", "NGramProposer", "DraftModelProposer",
            "Router", "ReplicaSpec", "Replica",
            "get_version", "get_num_bytes_of_data_type",

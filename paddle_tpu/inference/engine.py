@@ -125,7 +125,7 @@ from ..models.generation import _select_token
 from .paging import pages_needed as _pages_needed
 
 __all__ = ["ContinuousBatchingEngine", "EngineOverloaded",
-           "CacheExhausted", "GenerationPredictor",
+           "CacheExhausted", "RequestCancelled", "GenerationPredictor",
            "create_engine_predictor"]
 
 
@@ -159,6 +159,24 @@ class CacheExhausted(EngineOverloaded):
         super().__init__(queue_depth, max_queue)
         self.free_pages = free_pages
         self.num_pages = num_pages
+
+
+class RequestCancelled(RuntimeError):
+    """The request was cancelled (``engine.cancel`` — client
+    disconnect, a hedged duplicate losing its race, an operator
+    ``POST /cancel``). Raised out of the request's future; the partial
+    result — tokens generated before the cancel landed — rides the
+    future's ``_ptpu_gen_info`` (``tokens_generated`` +
+    ``partial_tokens``) so no work is silently discarded. Cancellation
+    applies at the next tick boundary: the slot retires, its KV pages
+    free — leak-free, counter-asserted in tests."""
+
+    def __init__(self, request_id: str, tokens_generated: int):
+        super().__init__(
+            f"request {request_id or '<anonymous>'} cancelled after "
+            f"{tokens_generated} generated token(s)")
+        self.request_id = request_id
+        self.tokens_generated = tokens_generated
 
 
 def _attach_page_meta(caches, **meta):
@@ -211,6 +229,9 @@ class _Request:
     t_submit: float = 0.0        # perf_counter at submit (obs only)
     drafted: int = 0             # speculative: tokens proposed for me
     accepted: int = 0            # speculative: proposals accepted
+    progress_cb: Optional[object] = None   # per-token progress hook
+    cancelled: bool = False      # cancel() flagged; retired at the
+    #                              next tick boundary
 
 
 class _Slot:
@@ -378,6 +399,8 @@ class ContinuousBatchingEngine:
         self.ticks = 0
         self.admitted = 0
         self.completed = 0
+        self.cancelled = 0            # requests cancelled (queued or
+        #                               slot-retired mid-decode)
         # last tick's model efficiency (obs.efficiency): modeled HBM
         # bytes over measured tick wall time as a fraction of the
         # efficiency chip's bandwidth; 0.0 until a tick ran (or with
@@ -418,6 +441,10 @@ class ContinuousBatchingEngine:
                 "ptpu_engine_admits_total", "requests admitted to slots")
             self._m_retires = reg.counter(
                 "ptpu_engine_retires_total", "requests retired")
+            self._m_cancels = reg.counter(
+                "ptpu_engine_cancels_total",
+                "requests cancelled (queued or mid-decode; slot and "
+                "pages reclaimed)")
             self._m_occupancy = reg.histogram(
                 "ptpu_engine_batch_occupancy",
                 "live slots per decode tick",
@@ -497,13 +524,20 @@ class ContinuousBatchingEngine:
     # -- public API ------------------------------------------------------
     def submit(self, input_ids, max_new_tokens: int = 32,
                eos_token_id: Optional[int] = None,
-               seed: int = 0, request_id: Optional[str] = None) -> Future:
+               seed: int = 0, request_id: Optional[str] = None,
+               progress_cb=None) -> Future:
         """Queue one request; returns a Future resolving to an int64
         [prompt_len + max_new_tokens] array, eos-padded after finish —
         the same shape/padding contract as one row of generate().
         ``request_id`` correlates this request's obs spans (the serving
         layer forwards the X-PTPU-Request-Id header here; absent, one
-        is minted when tracing is on)."""
+        is minted when tracing is on) and is the handle ``cancel``
+        takes. ``progress_cb(new_tokens)`` — when given — is invoked
+        from the engine thread with each newly emitted token block
+        (the first token at admission, then per tick): the streaming
+        side-channel the serving layer's incremental ``/generate`` and
+        the router's token journal ride. It must be fast and must not
+        raise; a raising callback is dropped, never the engine loop."""
         _resil.maybe_inject("serve_backend")   # dead-backend fault site
         prompt = np.asarray(input_ids).astype(np.int64).reshape(-1)
         P = prompt.shape[0]
@@ -533,6 +567,7 @@ class ContinuousBatchingEngine:
         req = _Request(prompt, int(max_new_tokens),
                        None if eos_token_id is None else int(eos_token_id),
                        int(seed))
+        req.progress_cb = progress_cb
         if self._obs:
             req.rid = (str(request_id) if request_id
                        else uuid.uuid4().hex[:16])
@@ -559,6 +594,58 @@ class ContinuousBatchingEngine:
             self._queue.append(req)
             self._cv.notify()
         return req.future
+
+    def cancel(self, request_id: Optional[str]) -> bool:
+        """Cancel the in-flight request carrying ``request_id`` (the id
+        given to submit). Returns True when a request was found. A
+        QUEUED request resolves immediately (its future raises
+        :class:`RequestCancelled`, zero tokens); an ADMITTED one is
+        flagged and retired by the engine thread at the next tick
+        boundary — the slot frees, its KV pages decref (leak-free),
+        and the future raises :class:`RequestCancelled` with the
+        partial result attached (``_ptpu_gen_info``: tokens_generated
+        + partial_tokens). Idempotent: a second cancel of the same id
+        returns False once the first resolved it."""
+        if not request_id:
+            return False
+        rid = str(request_id)
+        victim = None
+        with self._cv:
+            for i, req in enumerate(self._queue):
+                if req.rid == rid:
+                    victim = self._queue.pop(i)
+                    break
+            if victim is None:
+                for s in self._slots:
+                    if (s.req is not None and s.req.rid == rid
+                            and not s.req.cancelled):
+                        s.req.cancelled = True
+                        self._cv.notify()
+                        return True
+                return False
+            self.cancelled += 1
+        # queued request: resolve outside the lock (future callbacks
+        # must never run under the engine lock)
+        victim.future._ptpu_gen_info = {"tokens_generated": 0,
+                                        "partial_tokens": []}
+        if self._obs:
+            self._m_cancels.inc()
+        if not victim.future.done():
+            victim.future.set_exception(RequestCancelled(rid, 0))
+        return True
+
+    def _notify_progress(self, req: _Request, toks) -> None:
+        """Deliver newly emitted tokens to the request's progress
+        callback (streaming side-channel). Runs on the engine thread:
+        a raising callback is dropped so it can never take the loop —
+        and with it every other slot — down."""
+        cb = req.progress_cb
+        if cb is None:
+            return
+        try:
+            cb([int(t) for t in toks])
+        except Exception:   # noqa: BLE001 — a broken stream is the
+            req.progress_cb = None   # caller's problem, not the loop's
 
     def _pool_is_binding(self) -> bool:
         """Is the page pool (not slots / request rate) what is blocking
@@ -607,6 +694,7 @@ class ContinuousBatchingEngine:
                "free": self.slots - active, "queued": queued,
                "max_queue": self.max_queue, "ticks": self.ticks,
                "admitted": self.admitted, "completed": self.completed,
+               "cancelled": self.cancelled,
                "compiled_programs": self.compiled_program_count,
                "tick_tokens": self.tick_tokens,
                "prefill_buckets": list(self.prefill_buckets),
@@ -1012,6 +1100,7 @@ class ContinuousBatchingEngine:
                 if self._stop_flag:
                     return
             try:
+                self._sweep_cancelled()
                 self._admit_ready()
                 if any(not s.free for s in self._slots):
                     self._tick()
@@ -1029,18 +1118,35 @@ class ContinuousBatchingEngine:
                 self._fail_all(e)
                 return
 
+    def _sweep_cancelled(self):
+        """Retire every slot whose request was cancel()led since the
+        last tick boundary — the slot frees and (paged) its pages
+        decref before the next admission pass can want them."""
+        with self._cv:
+            idxs = [i for i, s in enumerate(self._slots)
+                    if s.req is not None and s.req.cancelled]
+        for i in idxs:
+            self._retire(i)
+
     def _fail_all(self, exc: BaseException):
         with self._cv:
-            pending = list(self._queue)
+            pending = [(req, []) for req in self._queue]
             self._queue.clear()
             actives = [s for s in self._slots if not s.free]
             for s in actives:
                 req, s.req = s.req, None
                 s.alive = False
-                pending.append(req)
-        for req in pending:
-            if req is not None and not req.future.done():
-                req.future.set_exception(exc)
+                pending.append((req, list(s.emitted)))
+        for req, emitted in pending:
+            if req is None or req.future.done():
+                continue
+            # surface the partial result on the error path too: the
+            # router's journal reconciles against this engine truth
+            # instead of silently losing whatever was generated
+            req.future._ptpu_gen_info = {
+                "tokens_generated": len(emitted),
+                "partial_tokens": [int(t) for t in emitted]}
+            req.future.set_exception(exc)
 
     def _admit_ready(self):
         while True:
@@ -1097,6 +1203,7 @@ class ContinuousBatchingEngine:
         slot.alive = (req.eos_token_id is None
                       or tok0 != req.eos_token_id)
         self.admitted += 1
+        self._notify_progress(req, [tok0])
         if self._obs:
             # the request's contiguous phase timeline: queue-wait
             # (submit -> admission), prefill (admission program + the
@@ -1205,6 +1312,11 @@ class ContinuousBatchingEngine:
         contexts have nothing to match anywhere falls back to the plain
         tick (tick_tokens per dispatch) instead of paying a verify
         forward for one guaranteed token per slot."""
+        # straggler fault site (latency injection, not death): wedges
+        # THIS loop — the process stays alive, /healthz keeps
+        # answering, only token progress stops. The router's hedged
+        # decode is the recovery path under test.
+        _resil.maybe_inject("replica_stall")
         if self._spec is None:
             self._tick_decode()
             return
@@ -1325,6 +1437,8 @@ class ContinuousBatchingEngine:
             # block before any query can attend it (no rollback)
             s.pos += n
             s.tok = s.emitted[-1]
+            if n:
+                self._notify_progress(s.req, s.emitted[-n:])
             self.spec_tokens_emitted += n
             self.spec_slot_ticks += 1
             if self._obs:
@@ -1397,6 +1511,8 @@ class ContinuousBatchingEngine:
             # next admission)
             s.pos += n
             s.tok = s.emitted[-1]
+            if n:
+                self._notify_progress(s.req, s.emitted[-n:])
             if s.remaining <= 0 or not s.alive:
                 self._retire(i)
 
@@ -1433,6 +1549,21 @@ class ContinuousBatchingEngine:
         if self._spec is not None:
             info["tokens_drafted"] = req.drafted
             info["tokens_accepted"] = req.accepted
+        if req.cancelled:
+            # cancelled mid-decode: the slot and pages above are
+            # already reclaimed; publish the PARTIAL result on the
+            # error path (no eos padding — these are exactly the
+            # tokens generated) so the caller's journal reconciles
+            # against engine truth instead of losing the work
+            info["partial_tokens"] = [int(t) for t in out]
+            req.future._ptpu_gen_info = info
+            self.cancelled += 1
+            if self._obs:
+                self._m_cancels.inc()
+            if not req.future.done():
+                req.future.set_exception(
+                    RequestCancelled(req.rid, len(out)))
+            return
         req.future._ptpu_gen_info = info
         if len(out) < req.max_new_tokens:
             # finished early on eos: pad with eos — generate()'s contract

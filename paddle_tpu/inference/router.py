@@ -37,6 +37,24 @@ replica death:
   it sits idle it shrinks (drain-then-retire) toward ``min_replicas``,
   with a cooldown between actions. Both directions reuse the one spawn
   / retire path the rolling restart uses.
+* **Work-conserving request recovery** (ISSUE 15): every journaled
+  ``/generate`` forwards in the replica's incremental (NDJSON) mode —
+  the router journals ``prompt + tokens_so_far`` per in-flight request
+  as token events stream back. A replica dying MID-DECODE (kill -9,
+  broken forward) no longer costs the client its generated tokens or
+  an error: the router re-admits ``prompt + journal`` on a healthy
+  replica and greedy determinism makes the continuation bitwise
+  identical to the undisturbed run — the paged prefix trie turns the
+  re-prefill into a page-table hit and the bucketed admit programs
+  mean zero new XLA compiles. A request whose token progress stalls
+  past the hedge budget (derived live from the inter-progress
+  histogram p99, or ``PADDLE_TPU_TIER_HEDGE_S``) launches a BACKUP
+  decode on a second replica; first to advance wins and the loser is
+  truly cancelled (``POST /cancel`` -> engine slot retire -> pages
+  freed, leak-free). Recoveries/hedges/cancels are counted
+  (``ptpu_router_{recoveries,hedges,hedge_wins,cancels}_total``) and
+  each recovery burst dumps a flight-recorder artifact naming the
+  migrated request ids.
 
 Greedy tokens through the tier are engine-identical to a direct
 engine call: the router never touches payloads, and a retried request
@@ -52,6 +70,20 @@ Env knobs (documented in COMPONENTS.md "Serving tier"):
   PADDLE_TPU_TIER_RETRIES      retry budget per request (2 retries)
   PADDLE_TPU_TIER_POLL_S       health-poll interval (0.5 s)
   PADDLE_TPU_TIER_EJECT_S      circuit-breaker ejection cooldown (5 s)
+  PADDLE_TPU_TIER_HEDGE_S      hedge budget: seconds of token-progress
+                               silence before a backup decode launches
+                               (0 disables; unset = derived live from
+                               the inter-progress histogram p99)
+  PADDLE_TPU_TIER_HEDGE_MULT   multiplier on the derived p99 (20)
+  PADDLE_TPU_TIER_HEDGE_FRAC   tier-wide hedge budget: backups may
+                               occupy at most this fraction of the
+                               live journaled requests (0.25, floor
+                               1) — a saturated tier must not hedge
+                               itself into double load
+  PADDLE_TPU_TIER_JOURNAL_REQS max concurrently journaled requests —
+                               the journal bound (128; overflow falls
+                               back to the single-shot forward path,
+                               0 disables recovery entirely)
   PADDLE_TPU_EXEC_STORE_DIR    shared executable store (successors load)
 """
 from __future__ import annotations
@@ -333,14 +365,31 @@ class _ForwardFailed(_RetryableForward):
         self.replica = replica
 
 
+def _retry_after_hint(body: dict) -> Optional[float]:
+    """The shed body's ``retry_after_s`` as a float, or None when it
+    is absent or unparseable — a malformed hint from a replica (or
+    from anything else answering on its port) must degrade to the
+    tier's own default, never crash the forward path (RetryPolicy
+    and send_json both arithmetic on the value)."""
+    try:
+        return (None if "retry_after_s" not in body
+                else float(body["retry_after_s"]))
+    except (TypeError, ValueError):
+        return None
+
+
 class _ShedByReplica(_RetryableForward):
     """A truthful 503 shed (overloaded/warming/draining) — the replica
-    is healthy, just not admitting; retry elsewhere, no breaker hit."""
+    is healthy, just not admitting; retry elsewhere, no breaker hit.
+    Carries the shed body's ``retry_after_s`` so the RetryPolicy
+    honors the replica's own Retry-After hint instead of guessing
+    with full-jitter (ISSUE 15 satellite)."""
 
     def __init__(self, replica: Replica, body: dict):
         super().__init__(str(body.get("error", "shed")))
         self.replica = replica
         self.body = body
+        self.retry_after_s = _retry_after_hint(body)
 
 
 class _NoReplica(Exception):
@@ -349,6 +398,341 @@ class _NoReplica(Exception):
 
 class _DeadlineExceeded(Exception):
     pass
+
+
+# ---------------------------------------------------------------------------
+# Work-conserving request recovery (ISSUE 15): journal + stream attempt
+# ---------------------------------------------------------------------------
+
+def _flatten_ids(v) -> Optional[List[int]]:
+    """Flatten a JSON ``input_ids`` value (flat or nested int lists)
+    into one token list; None when it isn't token-shaped (the opaque
+    payload then takes the single-shot forward path and the replica
+    judges it)."""
+    out: List[int] = []
+
+    def walk(x):
+        if isinstance(x, bool):
+            raise TypeError(x)
+        if isinstance(x, int):
+            out.append(x)
+        elif isinstance(x, (list, tuple)):
+            for y in x:
+                walk(y)
+        else:
+            raise TypeError(x)
+    try:
+        walk(v)
+    except TypeError:
+        return None
+    return out or None
+
+
+class _ReqJournal:
+    """Router-side token journal of ONE in-flight /generate — the
+    original request plus every token any replica has streamed back.
+
+    The journal IS the failover state: ``prompt + tokens`` re-admits
+    on any healthy replica, and greedy determinism guarantees the
+    continuation is bitwise identical to the undisturbed run. Extends
+    are reconciled first-writer-wins: positions already journaled are
+    VERIFIED against (a hedged duplicate must produce the same greedy
+    tokens), never overwritten — a conflict fails the offending
+    attempt, not the journal."""
+
+    def __init__(self, prompt: List[int], max_new: int, eos, seed: int,
+                 rid: Optional[str], hist=None):
+        self.prompt = [int(t) for t in prompt]
+        self.max_new = int(max_new)
+        self.eos = None if eos is None else int(eos)
+        self.seed = int(seed)
+        self.rid = rid
+        self.tokens: List[int] = []
+        self.cond = threading.Condition()
+        self.last_progress = time.monotonic()
+        self.mismatched = False
+        self.source: Optional[str] = None   # last replica to advance us
+        self._hist = hist                   # inter-progress-gap histogram
+
+    def extend(self, base: int, toks, source: str) -> bool:
+        """Merge a token block whose first element is journal position
+        ``base``; False on a greedy-determinism conflict or a gap."""
+        with self.cond:
+            n0 = len(self.tokens)
+            for i, t in enumerate(toks):
+                t = int(t)
+                j = base + i
+                if j < n0:
+                    if self.tokens[j] != t:
+                        self.mismatched = True
+                        self.cond.notify_all()
+                        return False
+                elif j == len(self.tokens):
+                    self.tokens.append(t)
+                else:            # a gap means events were lost: refuse
+                    self.mismatched = True
+                    self.cond.notify_all()
+                    return False
+            if len(self.tokens) > n0:
+                now = time.monotonic()
+                if self._hist is not None:
+                    self._hist.observe((now - self.last_progress) * 1e3)
+                self.last_progress = now
+                self.source = source
+            self.cond.notify_all()
+            return True
+
+    def size(self) -> int:
+        with self.cond:
+            return len(self.tokens)
+
+    def complete(self) -> bool:
+        """Does the journal alone already hold the full output (token
+        budget exhausted, or the eos landed)?"""
+        with self.cond:
+            return (len(self.tokens) >= self.max_new
+                    or (self.eos is not None and bool(self.tokens)
+                        and self.tokens[-1] == self.eos))
+
+    def synthesize_body(self) -> dict:
+        """The full client body from journal state alone — used when
+        the journal completed but the terminal record died with its
+        replica. Mirrors the engine's contract exactly: int64 row of
+        prompt + generated, eos-padded to max_new on early finish."""
+        with self.cond:
+            toks = list(self.tokens)
+        out = list(toks)
+        if len(out) < self.max_new:
+            out += [self.eos] * (self.max_new - len(out))
+        body = {"tokens": self.prompt + out,
+                "prompt_len": len(self.prompt),
+                "new_tokens": self.max_new,
+                "tokens_generated": len(toks)}
+        if self.rid:
+            body["request_id"] = self.rid
+        if self.source:
+            body["served_by"] = self.source
+        return body
+
+
+class _StreamAttempt(threading.Thread):
+    """One streaming forward of a journaled request's RESIDUAL
+    (prompt + journaled prefix, remaining token budget) to one
+    replica. Token events extend the shared journal as they arrive;
+    terminal state lands in ``status`` ("done" | "failed") with the
+    failure classified for the coordinator (io / shed / client_error /
+    cancelled). Cancellable from the coordinator: close the response
+    stream, then tell the replica to retire the engine request so its
+    slot and KV pages reclaim."""
+
+    def __init__(self, router: "Router", rep: Replica, j: _ReqJournal,
+                 base: int, deadline_at: float, is_hedge: bool,
+                 seq: int):
+        name = f"tier-attempt-{j.rid or 'anon'}.{seq}"
+        super().__init__(daemon=True, name=name)
+        self.router = router
+        self.rep = rep
+        self.j = j
+        self.base = int(base)
+        self.deadline_at = float(deadline_at)
+        self.is_hedge = bool(is_hedge)
+        # each attempt gets a DISTINCT request id derived from the
+        # client's: /cancel targets exactly one engine request, and
+        # the obs spans of a hedge pair stay tellable apart
+        self.rid = (f"{j.rid}.{seq}" if j.rid
+                    else uuid.uuid4().hex[:16])
+        self.status = "running"
+        self.reaped = False          # coordinator bookkeeping
+        self.kind: Optional[str] = None
+        self.reason = ""
+        self.code = 0
+        self.body: Optional[dict] = None
+        self.retry_after = None
+        self.done_body: Optional[dict] = None
+        self.streamed = False        # got a 200 head (mid-stream death
+        #                              => work-conserving recovery)
+        self.got = 0                 # tokens THIS attempt produced
+        self._resp = None
+        self._cancelled = threading.Event()
+
+    def run(self):
+        j, rep = self.j, self.rep
+        residual = j.prompt + j.tokens[:self.base]
+        payload: dict = {"input_ids": residual,
+                         "max_new_tokens": j.max_new - self.base,
+                         "seed": j.seed, "stream": True}
+        if j.eos is not None:
+            payload["eos_token_id"] = j.eos
+        data = json.dumps(payload).encode()
+        with self.router._lock:
+            rep.inflight += 1
+        span = (_obs.trace.begin_span(
+            "router.forward", cat="router", replica=rep.name,
+            request_id=self.rid, resumed_tokens=self.base,
+            hedge=self.is_hedge) if self.router._obs else None)
+        t0 = time.perf_counter()
+        try:
+            _resil.maybe_inject("router_forward")
+            remaining = self.deadline_at - time.monotonic()
+            if remaining <= 0:
+                self._fail("io", "deadline exhausted before forward")
+                return
+            req = urllib.request.Request(
+                rep.base_url + "/generate", data,
+                {"Content-Type": "application/json",
+                 REQUEST_ID_HEADER: self.rid})
+            resp = urllib.request.urlopen(req, timeout=remaining)
+            self._resp = resp
+            self.streamed = True
+            with resp:
+                for raw in resp:
+                    if self._cancelled.is_set():
+                        self._fail("cancelled", "cancelled by "
+                                                "coordinator")
+                        return
+                    raw = raw.strip()
+                    if not raw:
+                        continue
+                    ev = json.loads(raw)
+                    if "t" in ev:
+                        if not j.extend(self.base + self.got, ev["t"],
+                                        rep.name):
+                            # greedy determinism violated — defensive:
+                            # fail THIS attempt, keep the journal
+                            self.router.stats_counters[
+                                "recovery_mismatches"] += 1
+                            self._fail("mismatch", "token mismatch "
+                                                   "vs journal")
+                            return
+                        self.got += len(ev["t"])
+                    elif "done" in ev:
+                        body = ev["done"]
+                        toks = body.get("tokens") or []
+                        gen = int(body.get("tokens_generated", 0))
+                        # reconcile the terminal truth (authoritative)
+                        # into the journal before declaring victory —
+                        # a terminal body CONFLICTING with journaled
+                        # tokens is the same determinism violation as
+                        # a conflicting token event: fail the attempt,
+                        # never hand the client a divergent body
+                        if not j.extend(
+                                self.base,
+                                toks[len(residual):len(residual) + gen],
+                                rep.name):
+                            self.router.stats_counters[
+                                "recovery_mismatches"] += 1
+                            self._fail("mismatch", "terminal body "
+                                       "mismatches journal")
+                            return
+                        self.done_body = body
+                        rep.failure_streak = 0
+                        if self.router._obs:
+                            self.router._m_forward.observe(
+                                (time.perf_counter() - t0) * 1e3,
+                                replica=rep.name)
+                        self.status = "done"
+                        self._notify()
+                        return
+                    elif "err" in ev:
+                        rec = ev["err"]
+                        # engine-truth partial reconciliation: the
+                        # failure path surfaces tokens the stream may
+                        # not have delivered yet (ISSUE 15 satellite)
+                        part = rec.get("partial_tokens")
+                        if part:
+                            j.extend(self.base, part, rep.name)
+                        self.router._note_failure(rep)
+                        self._fail("io", str(rec.get("error", "err")))
+                        return
+            # EOF without a terminal record: the replica died mid-write
+            self.router._note_failure(rep)
+            self._fail("io", "stream truncated (replica died "
+                             "mid-decode)")
+        except urllib.error.HTTPError as e:
+            try:
+                body = json.loads(e.read())
+            except (ValueError, OSError, http.client.HTTPException):
+                body = {"error": f"http_{e.code}"}
+            if e.code == 503:
+                # truthful shed from a live server: retry elsewhere,
+                # honoring ITS Retry-After hint — no breaker hit
+                self.retry_after = _retry_after_hint(body)
+                self.body = body
+                self._fail("shed", str(body.get("error", "shed")))
+            elif e.code >= 500:
+                self.router._note_failure(rep)
+                self._fail("io", str(body.get("error", f"http {e.code}")))
+            else:
+                self.code, self.body = e.code, body
+                self._fail("client_error",
+                           str(body.get("error", e.code)))
+        except _resil.FaultInjected as e:
+            self.router._note_failure(rep)
+            self._fail("io", str(e))
+        except _REPLICA_IO_ERRORS as e:
+            if self._cancelled.is_set():
+                self._fail("cancelled", "cancelled by coordinator")
+            else:
+                self.router._note_failure(rep)
+                self._fail("io", str(e))
+        except Exception as e:   # noqa: BLE001 — an attempt thread
+            # must never die silently: every outcome is classified
+            self._fail("io", f"{type(e).__name__}: {e}")
+        finally:
+            if span is not None:
+                _obs.trace.end_span(span)
+            with self.router._lock:
+                rep.inflight -= 1
+            if self.is_hedge:
+                # pairs with the coordinator's _reserve_hedge: the
+                # budget slot frees when the backup terminates (win,
+                # loss, or cancellation)
+                self.router._release_hedge()
+
+    def _fail(self, kind: str, reason: str):
+        self.kind = kind
+        self.reason = str(reason)
+        self.status = "failed"
+        self._notify()
+
+    def _notify(self):
+        with self.j.cond:
+            self.j.cond.notify_all()
+
+    def cancel(self):
+        """Best-effort loser-side cancellation: stop reading, then
+        tell the replica to retire the engine request NOW (future
+        cancel -> slot retire -> pages freed) instead of letting the
+        duplicate decode to completion."""
+        self._cancelled.set()
+        resp = self._resp
+        if resp is not None:
+            # shut the raw SOCKET down, never resp.close(): the reader
+            # thread blocked in readline() holds the BufferedReader's
+            # internal lock, so close() from here would block until
+            # the (possibly wedged) replica sends bytes again —
+            # shutdown() needs no buffer lock and pops the blocked
+            # recv with EOF instead
+            try:
+                sock = getattr(getattr(resp, "fp", None), "raw", None)
+                sock = getattr(sock, "_sock", None)
+                if sock is not None:
+                    sock.shutdown(socket.SHUT_RDWR)
+            except (OSError, AttributeError, ValueError):
+                pass
+        if self.rep.base_url and self.streamed:
+            try:
+                req = urllib.request.Request(
+                    self.rep.base_url + "/cancel",
+                    json.dumps({"request_id": self.rid}).encode(),
+                    {"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=2.0):
+                    pass
+                self.router.stats_counters["cancels_sent"] += 1
+                if self.router._obs:
+                    self.router._m_cancels.inc()
+            except _REPLICA_IO_ERRORS:
+                pass             # dead replica: nothing left to cancel
 
 
 # ---------------------------------------------------------------------------
@@ -386,7 +770,12 @@ class Router:
                  respawn_policy: Optional[_resil.RetryPolicy] = None,
                  exec_store_dir: Optional[str] = None,
                  jax_cache_dir: Optional[str] = None,
-                 workdir: Optional[str] = None):
+                 workdir: Optional[str] = None,
+                 recovery: bool = True,
+                 hedge_s: Optional[float] = None,
+                 hedge_mult: Optional[float] = None,
+                 hedge_frac: Optional[float] = None,
+                 journal_max: Optional[int] = None):
         if replicas < 1:
             raise ValueError("need at least one replica")
         self.spec = spec
@@ -439,6 +828,31 @@ class Router:
                                 else max(1, slots // 2))
         self.scale_cycles = int(scale_cycles)
         self.scale_cooldown_s = float(scale_cooldown_s)
+        # work-conserving recovery + hedged decode (ISSUE 15)
+        self.recovery = bool(recovery)
+        self.hedge_s = (float(hedge_s) if hedge_s is not None
+                        else _env_float("PADDLE_TPU_TIER_HEDGE_S",
+                                        -1.0))
+        self.hedge_mult = (float(hedge_mult) if hedge_mult is not None
+                           else _env_float("PADDLE_TPU_TIER_HEDGE_MULT",
+                                           20.0))
+        # tier-wide hedge budget (Tail-at-Scale style): backups may
+        # occupy at most this fraction of the live journaled requests
+        # (floor 1, so a lone straggler always gets its backup). The
+        # per-request stall clock starts at submission, which under
+        # saturation makes EVERY queued request look silent — without
+        # this cap a loaded tier would hedge itself into double load
+        # exactly when it has no headroom.
+        self.hedge_frac = (float(hedge_frac) if hedge_frac is not None
+                           else _env_float("PADDLE_TPU_TIER_HEDGE_FRAC",
+                                           0.25))
+        self._hedges_live = 0        # concurrent backups, tier-wide
+        self.journal_max = int(
+            journal_max if journal_max is not None
+            else _env_float("PADDLE_TPU_TIER_JOURNAL_REQS", 128))
+        self._journaled = 0          # live journals (bounded)
+        self._recovered_rids: List[dict] = []   # since last flight dump
+        self._last_recovery_dump = 0.0
         self.exec_store_dir = (exec_store_dir
                                or os.environ.get("PADDLE_TPU_EXEC_STORE_DIR"))
 
@@ -472,6 +886,10 @@ class Router:
             "respawns": 0, "ejections": 0, "rolling_restarts": 0,
             "scale_ups": 0, "scale_downs": 0, "spawn_failures": 0,
             "crash_loops": 0,
+            # work-conserving recovery + hedging (ISSUE 15)
+            "recoveries": 0, "hedges": 0, "hedge_wins": 0,
+            "cancels_sent": 0, "resume_fallbacks": 0,
+            "recovery_mismatches": 0,
         }
         # observability (paddle_tpu.obs): the stats above keep their
         # dict face (/healthz, tests); the registry carries the
@@ -501,6 +919,27 @@ class Router:
                 labels=("replica",), max_series=32)
             self._m_ready = reg.gauge(
                 "ptpu_router_ready_replicas", "routable replicas")
+            self._m_recoveries = reg.counter(
+                "ptpu_router_recoveries_total",
+                "journaled requests resumed on another replica after "
+                "a mid-decode failure (work-conserving failover)")
+            self._m_hedges = reg.counter(
+                "ptpu_router_hedges_total",
+                "backup decodes launched for stalled requests")
+            self._m_hedge_wins = reg.counter(
+                "ptpu_router_hedge_wins_total",
+                "hedged backups that beat the stalled primary")
+            self._m_cancels = reg.counter(
+                "ptpu_router_cancels_total",
+                "loser-side /cancel requests sent to replicas")
+            # inter-progress gaps of streamed forwards: the LIVE
+            # decode-latency signal the hedge budget derives from
+            self._m_progress = reg.histogram(
+                "ptpu_router_token_progress_ms",
+                "gap between successive token-progress events across "
+                "journaled requests",
+                buckets=(1, 2, 5, 10, 25, 50, 100, 250, 500, 1000,
+                         2500, 5000, 10000))
 
         self.httpd = ThreadingHTTPServer((host, port),
                                          self._make_handler())
@@ -984,14 +1423,63 @@ class Router:
         ``request_id`` rides the X-PTPU-Request-Id header on every
         attempt, so the tier's spans (router forward) and the serving
         replica's (engine queue-wait/prefill/decode) correlate under
-        one id."""
+        one id.
+
+        Token-shaped payloads take the JOURNALED path (streamed
+        forward + work-conserving failover + hedged decode, module
+        docstring); opaque ones — and overflow past the journal bound
+        — fall back to the single-shot forward."""
         deadline_s = (self.deadline_s if deadline_s is None
                       else float(deadline_s))
         t0 = time.monotonic()
-        tried: set = set()
         self.stats_counters["forwards"] += 1
         if self._obs:
             self._m_forwards.inc()
+        parsed = None
+        try:
+            parsed = json.loads(payload or b"{}")
+        except ValueError:
+            parsed = None
+        if (self.recovery and self.journal_max > 0
+                and isinstance(parsed, dict) and "input_ids" in parsed):
+            prompt = _flatten_ids(parsed.get("input_ids"))
+            ok = prompt is not None
+            if ok:
+                try:
+                    max_new = int(parsed.get("max_new_tokens", 32))
+                    eos = parsed.get("eos_token_id")
+                    eos = None if eos is None else int(eos)
+                    seed = int(parsed.get("seed", 0))
+                except (TypeError, ValueError):
+                    ok = False
+            if ok and max_new >= 1:
+                with self._lock:
+                    admit = self._journaled < self.journal_max
+                    if admit:
+                        self._journaled += 1
+                if admit:
+                    try:
+                        return self._forward_recovering(
+                            prompt, max_new, eos, seed, deadline_s,
+                            request_id, t0)
+                    finally:
+                        with self._lock:
+                            self._journaled -= 1
+        if isinstance(parsed, dict) and parsed.get("stream"):
+            # the tier front is non-streaming to clients; never let a
+            # leaked stream flag make a replica answer the single-shot
+            # path with NDJSON it cannot parse
+            parsed = {k: v for k, v in parsed.items() if k != "stream"}
+            payload = json.dumps(parsed).encode()
+        return self._forward_plain(payload, deadline_s, request_id, t0)
+
+    def _forward_plain(self, payload: bytes, deadline_s: float,
+                       request_id: Optional[str], t0: float):
+        """The single-shot (pre-recovery) forward path: one whole
+        response per attempt, retry-on-a-different-replica under the
+        shared RetryPolicy (which honors each shed's Retry-After
+        hint). Kept for opaque payloads and journal-bound overflow."""
+        tried: set = set()
         first_attempt = True
 
         def attempt():
@@ -1045,7 +1533,17 @@ class Router:
                 if e.code == 503:
                     # truthful shed from a live server — not a breaker
                     # hit; retry on a different replica
-                    raise _ShedByReplica(rep, body)
+                    exc = _ShedByReplica(rep, body)
+                    if self._pick(tried) is not None:
+                        # an UNTRIED replica is routable: the hint
+                        # describes THIS replica's capacity, not the
+                        # tier's — retry elsewhere on the fast
+                        # jittered schedule instead of serving one
+                        # replica's Retry-After against another. The
+                        # hint still reaches the client on the relay
+                        # path (re-derived from the body).
+                        exc.retry_after_s = None
+                    raise exc
                 if e.code >= 500:
                     self._note_failure(rep)
                     raise _ForwardFailed(
@@ -1094,13 +1592,423 @@ class Router:
             self.stats_counters["relayed_503"] += 1
             body = dict(e.body)
             body["served_by"] = e.replica.name
+            # re-derive from the body: retry_after_s may have been
+            # nulled for SLEEP purposes (untried replica available),
+            # but the relay owes the client the replica's truth
+            ra = _retry_after_hint(e.body)
             return (503, body,
-                    body.get("retry_after_s",
-                             TIER_RETRY_AFTER_S["overloaded"]))
+                    ra if ra is not None
+                    else TIER_RETRY_AFTER_S["overloaded"])
         except _ForwardFailed as e:
             self.stats_counters["backend_503"] += 1
             return (503, {"error": f"backend_unavailable: {e}"},
                     TIER_RETRY_AFTER_S["backend_unavailable"])
+
+    # -- work-conserving recovery + hedged decode (ISSUE 15) -------------
+    def _hedge_budget(self) -> Optional[float]:
+        """Seconds of token-progress silence before a backup decode
+        launches. An explicit PADDLE_TPU_TIER_HEDGE_S wins (0 turns
+        hedging off); otherwise the budget derives from the LIVE
+        inter-progress histogram — hedge_mult x p99, clamped to
+        [0.25s, deadline/4] — so it tracks whatever the tier's real
+        decode cadence is. A cold tier (sparse histogram) uses a
+        conservative 2s default."""
+        if self.hedge_s == 0:
+            return None
+        if self.hedge_s > 0:
+            return float(self.hedge_s)
+        hi = max(0.5, self.deadline_s / 4.0)
+        if self._obs:
+            snap = self._m_progress.snap()
+            if snap.count >= 32:
+                b = snap.percentile(0.99) / 1e3 * self.hedge_mult
+                return min(max(b, 0.25), hi)
+        return min(2.0, hi)
+
+    def _reserve_hedge(self) -> bool:
+        """Atomically claim one slot of the tier-wide hedge budget:
+        at most ``hedge_frac`` of the live journaled requests (floor
+        1) may be running a backup at once. The stall clock starts at
+        submission, so on a saturated tier EVERY queued request looks
+        silent past the budget — uncapped, hedging would double the
+        tier's own load exactly when it has no headroom, amplifying
+        the overload it was meant to absorb. A lone straggler always
+        clears the floor."""
+        with self._lock:
+            cap = max(1, int(self._journaled * self.hedge_frac))
+            if self._hedges_live >= cap:
+                return False
+            self._hedges_live += 1
+            return True
+
+    def _release_hedge(self):
+        with self._lock:
+            self._hedges_live -= 1
+
+    def _note_recovery(self, rid, resumed_tokens: int, to_name: str):
+        """Book one work-conserving failover: counters, a recovery
+        span, and a flight-recorder artifact naming the migrated
+        request ids (throttled: bursts fold into one dump)."""
+        self.stats_counters["recoveries"] += 1
+        if self._obs:
+            self._m_recoveries.inc()
+            now = time.perf_counter()
+            _obs.record_span("router.recover", now, now, cat="router",
+                             request_id=rid,
+                             resumed_tokens=resumed_tokens,
+                             to_replica=to_name)
+        batch = None
+        with self._lock:
+            self._recovered_rids.append(
+                {"request_id": rid, "resumed_tokens": resumed_tokens,
+                 "to_replica": to_name})
+            if time.monotonic() - self._last_recovery_dump >= 2.0:
+                batch, self._recovered_rids = self._recovered_rids, []
+                self._last_recovery_dump = time.monotonic()
+        if batch:
+            try:
+                _obs.dump_flight("request_recovery",
+                                 extra={"migrated": batch})
+            except Exception:   # noqa: BLE001 — forensics best-effort
+                pass
+
+    def _forward_recovering(self, prompt: List[int], max_new: int,
+                            eos, seed: int, deadline_s: float,
+                            rid: Optional[str], t0: float):
+        """The per-request recovery state machine (module docstring).
+
+        One primary :class:`_StreamAttempt` streams the request; the
+        coordinator below watches the shared journal and reacts:
+
+        * attempt DONE -> compose the client body (rewriting
+          prompt_len / tokens_generated back to the client's original
+          frame — a resumed attempt's response is already the full
+          token sequence, only its accounting is shifted);
+        * journal COMPLETE but no terminal record (the replica died
+          after the last token, before ``done``) -> synthesize the
+          body from the journal alone;
+        * attempt FAILED mid-stream -> relaunch on another replica
+          from ``prompt + journal`` (a recovery — bitwise-exact by
+          greedy determinism, prefix-trie-cheap, zero new compiles);
+          consecutive no-progress launches are budgeted by the retry
+          policy (sheds honor the replica's Retry-After hint), but a
+          launch that ADVANCED the journal resets the budget — forward
+          progress is never punished as a retry storm;
+        * token progress STALLED past the hedge budget -> launch a
+          backup on a second replica; first to advance wins, the loser
+          is cancelled (engine slot + pages reclaimed) and a winning
+          hedge books a breaker strike against the straggler.
+        """
+        st = _ReqJournal(prompt, max_new, eos, seed, rid,
+                         hist=(self._m_progress if self._obs else None))
+        deadline_at = t0 + deadline_s
+        attempts: List[_StreamAttempt] = []
+        tried: set = set()
+        seq = 0
+        nprog = 0                # consecutive launches without progress
+        len_at_launch = -1
+        recovered = 0
+        hedges_launched = 0
+        need_launch = False      # a failed attempt awaits relaunch —
+        #                          persists across poll iterations so a
+        #                          momentarily replica-less tier (the
+        #                          survivor ejected, the respawn still
+        #                          warming) keeps retrying launch()
+        #                          instead of idling to the deadline
+        # Seeding a resume with journaled tokens is only deterministic
+        # for greedy decode: a sampling engine rolls tok0 from the raw
+        # key at admit but fold_in(key, pos) in the decode loop, so a
+        # resumed base would re-roll DIFFERENT tokens and mismatch the
+        # journal on its first block. A sampling tier still journals,
+        # recovers, and hedges — every relaunch just re-runs from
+        # scratch (same seed => same tokens) and the journal VERIFIES
+        # the regenerated prefix instead of seeding it: token-exact,
+        # not work-saving. Also set later on resume-reject / mismatch.
+        force_full = bool(self.spec.engine.get("do_sample", False))
+        pending_hint = None
+        last_shed: Optional[_StreamAttempt] = None
+        last_fail = "no attempt"
+
+        complete_since = None    # journal complete, waiting (briefly)
+        #                          for the live attempt's terminal line
+
+        def cancel_all(exclude=None, wait=True):
+            losers = [a for a in attempts
+                      if a is not exclude and a.status == "running"]
+            if not losers:
+                return
+            if wait:
+                for a in losers:
+                    a.cancel()
+                return
+            # winner path: don't make the winning client's response
+            # wait on loser-side /cancel round trips
+            threading.Thread(
+                target=lambda: [a.cancel() for a in losers],
+                daemon=True, name="tier-cancel-losers").start()
+
+        def launch(is_hedge=False):
+            nonlocal seq, nprog, len_at_launch, recovered, \
+                hedges_launched
+            live_names = {a.rep.name for a in attempts
+                          if a.status == "running"}
+            rep = self._pick(tried | live_names)
+            if rep is None and tried:
+                # every replica was tried once: a retry may still land
+                # (a shed clears, an ejection lapses) — reopen the
+                # field, same policy as the single-shot path
+                tried.clear()
+                rep = self._pick(set(live_names))
+            if rep is None:
+                return None
+            base = 0 if force_full else st.size()
+            if not is_hedge:
+                if seq > 0:
+                    self.stats_counters["retries"] += 1
+                    if self._obs:
+                        self._m_retries.inc()
+                    if st.size() > 0:
+                        recovered += 1
+                        self._note_recovery(rid, base, rep.name)
+                nprog = 1 if st.size() > len_at_launch else nprog + 1
+                len_at_launch = st.size()
+            else:
+                hedges_launched += 1
+                self.stats_counters["hedges"] += 1
+                if self._obs:
+                    self._m_hedges.inc()
+                    now = time.perf_counter()
+                    _obs.record_span("router.hedge", now, now,
+                                     cat="router", request_id=rid,
+                                     replica=rep.name,
+                                     journal_tokens=base)
+            a = _StreamAttempt(self, rep, st, base, deadline_at,
+                               is_hedge, seq)
+            seq += 1
+            attempts.append(a)
+            with st.cond:
+                # the stall clock measures token SILENCE, not failover
+                # latency: a fresh launch re-arms it, so the reap ->
+                # backoff -> relaunch window of a recovery doesn't
+                # read as a stall and hedge a healthy resumed attempt
+                # (a winning hedge would then strike the innocent
+                # primary's breaker)
+                st.last_progress = time.monotonic()
+            a.start()
+            return a
+
+        if launch() is None:
+            self.stats_counters["tier_unavailable_503"] += 1
+            with self._lock:
+                n = len(self._replicas)
+            return (503,
+                    {"error": "no_replica_ready", "replicas": n,
+                     "ready": self.ready_count()},
+                    TIER_RETRY_AFTER_S["no_replica_ready"]
+                    + self.poll_s)
+
+        while True:
+            now = time.monotonic()
+            if now >= deadline_at:
+                # wait=False on every response-returning path: a
+                # half-dead loser's /cancel round trip (2s timeout
+                # each) must never delay the client's answer
+                cancel_all(wait=False)
+                self.stats_counters["deadline_503"] += 1
+                return (503, {"error": "deadline_exceeded",
+                              "deadline_s": deadline_s},
+                        TIER_RETRY_AFTER_S["deadline_exceeded"])
+            winner = next((a for a in attempts if a.status == "done"),
+                          None)
+            if winner is not None:
+                if winner.is_hedge:
+                    self.stats_counters["hedge_wins"] += 1
+                    if self._obs:
+                        self._m_hedge_wins.inc()
+                    # the straggler earned a breaker strike: a replica
+                    # that keeps losing its own requests to hedges
+                    # must leave the rotation for a cooldown
+                    for a in attempts:
+                        if (a is not winner and not a.is_hedge
+                                and a.status == "running"):
+                            self._note_failure(a.rep)
+                cancel_all(exclude=winner, wait=False)
+                body = dict(winner.done_body or {})
+                toks = body.get("tokens") or []
+                body["served_by"] = winner.rep.name
+                # rewrite accounting into the CLIENT's frame: the
+                # resumed attempt saw prompt+journal as its prompt
+                body["prompt_len"] = len(prompt)
+                body["new_tokens"] = max(0, len(toks) - len(prompt))
+                body["tokens_generated"] = winner.base + int(
+                    body.get("tokens_generated", 0))
+                # ... and the request id: the replica echoed the
+                # ATTEMPT's derived id ("<rid>.<seq>") — correlation
+                # belongs to the client's original
+                if rid:
+                    body["request_id"] = rid
+                else:
+                    body.pop("request_id", None)
+                if recovered:
+                    body["recovered"] = recovered
+                if winner.is_hedge:
+                    body["hedged"] = True
+                return 200, body, None
+            live = [a for a in attempts if a.status == "running"]
+            if st.complete():
+                # the journal alone already holds the full output.
+                # Normally the live attempt's terminal record is
+                # microseconds behind its last token event — give it a
+                # short grace so the replica's own body wins; past the
+                # grace (or with no attempt left: the replica died
+                # between its last token and `done`) synthesize from
+                # the journal — greedy determinism + the engine's
+                # eos-padding contract make it exact.
+                if live and complete_since is None:
+                    complete_since = now
+                if not live or now - complete_since >= 1.0:
+                    cancel_all(wait=False)
+                    body = st.synthesize_body()
+                    if recovered:
+                        body["recovered"] = recovered
+                    return 200, body, None
+            else:
+                complete_since = None
+            relaunch = False
+            for a in attempts:
+                if a.status != "failed" or a.reaped:
+                    continue
+                a.reaped = True
+                if a.kind == "cancelled":
+                    continue
+                if a.kind == "client_error":
+                    if a.base > 0:
+                        # the replica 400'd a RESUMED prompt (outgrew
+                        # its prefill buckets): fall back to a
+                        # from-scratch re-run — the journal then
+                        # VERIFIES the regenerated prefix instead of
+                        # seeding it (token-exact, just not
+                        # work-saving)
+                        force_full = True
+                        self.stats_counters["resume_fallbacks"] += 1
+                        relaunch = not live
+                        continue
+                    cancel_all(wait=False)
+                    body = dict(a.body or {"error": "client error"})
+                    body["served_by"] = a.rep.name
+                    return a.code, body, None
+                if a.kind == "mismatch":
+                    # determinism violated against the journal (e.g. a
+                    # hedge pair diverging, or a resumed base on an
+                    # engine whose key path is position-dependent):
+                    # same verdict as the resume-reject path above —
+                    # fall back to a from-scratch re-run, which the
+                    # journal VERIFIES instead of seeds. Retrying the
+                    # resume at the same base would mismatch forever.
+                    force_full = True
+                    self.stats_counters["resume_fallbacks"] += 1
+                    last_fail = a.reason
+                    relaunch = not live
+                    continue
+                if a.kind == "shed":
+                    last_shed = a
+                    pending_hint = a.retry_after
+                    tried.add(a.rep.name)
+                    relaunch = not live
+                    continue
+                tried.add(a.rep.name)       # io-class failure
+                last_fail = a.reason
+                relaunch = not live
+            need_launch = need_launch or relaunch
+            if need_launch and not live:
+                if nprog >= self.retry_policy.max_attempts:
+                    # no forward progress across the whole budget:
+                    # same verdicts as the single-shot path
+                    if last_shed is not None:
+                        self.stats_counters["relayed_503"] += 1
+                        body = dict(last_shed.body or {})
+                        body["served_by"] = last_shed.rep.name
+                        return (503, body,
+                                last_shed.retry_after
+                                if last_shed.retry_after is not None
+                                else TIER_RETRY_AFTER_S["overloaded"])
+                    self.stats_counters["backend_503"] += 1
+                    return (503,
+                            {"error":
+                             f"backend_unavailable: {last_fail}"},
+                            TIER_RETRY_AFTER_S["backend_unavailable"])
+                if relaunch and st.size() <= len_at_launch:
+                    # no progress since the last launch: back off on
+                    # the shared schedule — honoring the replica's own
+                    # Retry-After hint when the failure was a shed. A
+                    # mid-stream death WITH progress relaunches
+                    # immediately: failover must be work-conserving in
+                    # time too. (Gated on `relaunch` — the freshly
+                    # reaped failure — so the waiting-for-a-respawn
+                    # path below doesn't re-pay the backoff on every
+                    # poll.)
+                    hint, pending_hint = pending_hint, None
+                    if hint is not None and self._pick(tried) is not None:
+                        # an untried replica is routable: the shed
+                        # hint is the SHED replica's capacity story —
+                        # relaunch elsewhere on the fast schedule
+                        hint = None
+                    budget = deadline_at - time.monotonic()
+                    if budget > 0:
+                        self.retry_policy.sleep(
+                            min(max(nprog, 1),
+                                max(1, self.retry_policy.max_attempts
+                                    - 1)),
+                            budget=budget, hint=hint)
+                if launch() is not None:
+                    need_launch = False
+                elif st.size() == 0:
+                    self.stats_counters["tier_unavailable_503"] += 1
+                    with self._lock:
+                        n = len(self._replicas)
+                    return (503,
+                            {"error": "no_replica_ready",
+                             "replicas": n,
+                             "ready": self.ready_count()},
+                            TIER_RETRY_AFTER_S["no_replica_ready"]
+                            + self.poll_s)
+                else:
+                    # journaled work exists: WAIT for a replica (a
+                    # respawn is usually poll_s away) instead of
+                    # throwing the tokens away — `need_launch` keeps
+                    # launch() retried on every pass until one lands,
+                    # bounded by the request deadline above
+                    time.sleep(min(self.poll_s,
+                                   max(0.05,
+                                       deadline_at - time.monotonic())))
+                continue
+            # live attempts exist: watch for stalls, then wait for
+            # journal/attempt events
+            hb = self._hedge_budget()
+            with st.cond:
+                silent = now - st.last_progress
+            if (hb is not None and len(live) == 1
+                    and silent >= hb and hedges_launched < 2
+                    and not st.complete()
+                    and self._reserve_hedge()):
+                if launch(is_hedge=True) is None:
+                    # no second replica yet: hand the budget slot back
+                    # and re-check on the next wake
+                    self._release_hedge()
+            with st.cond:
+                timeout = 0.25
+                if hb is not None and len(live) == 1:
+                    # wake exactly when the hedge budget expires — but
+                    # only while it HASN'T yet: once stalled with no
+                    # launchable backup (budget-blocked, or no second
+                    # replica), stay on the 0.25s cadence instead of
+                    # spinning at the 0.01s floor
+                    left = hb - (time.monotonic() - st.last_progress)
+                    if left > 0:
+                        timeout = min(timeout, max(0.01, left))
+                timeout = min(timeout,
+                              max(0.01, deadline_at - time.monotonic()))
+                st.cond.wait(timeout=timeout)
 
     # -- introspection ---------------------------------------------------
     def _readiness(self):

@@ -32,7 +32,30 @@ Endpoints:
                       [...]} — served by the continuous-batching engine
                       (inference/engine.py): requests from concurrent
                       clients multiplex through ONE compiled batched
-                      decode program, each resolved by its own future
+                      decode program, each resolved by its own future.
+                      With "stream": true the response is incremental
+                      NDJSON (read-until-close): one {"t": [tokens]}
+                      line per emitted block as the engine produces it
+                      (first token at admission, then per tick), then
+                      a terminal {"done": {...full body...}} line — or
+                      {"err": {"error": ..., "tokens_generated": n,
+                      "partial_tokens": [...]}} when the request dies
+                      or is cancelled mid-decode, carrying the partial
+                      result so a router's token journal can reconcile
+                      against engine truth. The router's
+                      work-conserving failover and hedged decode ride
+                      this side-channel (inference/router.py).
+  POST /cancel    -> {"request_id": rid} -> {"cancelled": bool} — real
+                      request cancellation: a queued request resolves
+                      immediately, an admitted one retires at the next
+                      tick boundary (slot freed, KV pages decref'd —
+                      leak-free); its waiter gets 409 "cancelled" (or
+                      the stream's err line) with the partial result
+  POST /admin/inject -> {"site": s, "count": n, "wedge_s": opt} — arm
+                      a resilience fault site in THIS live replica
+                      (e.g. replica_stall to wedge the decode loop);
+                      chaos tooling only, 403 unless the process runs
+                      with PADDLE_TPU_CHAOS_ADMIN=1
 
 Graceful degradation (resilience subsystem, distributed/resilience.py):
 every /predict carries a deadline (PADDLE_TPU_SERVE_DEADLINE, default
@@ -60,6 +83,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import queue as _queue
 import threading
 import time
 import urllib.parse
@@ -83,6 +107,7 @@ REQUEST_ID_HEADER = "X-PTPU-Request-Id"
 
 # the ONE float-knob parser (framework/env.py); the old private name
 # stays as a face — router.py and tests import it from here
+from ..framework.env import bool_env as _env_bool  # noqa: E402
 from ..framework.env import float_env as _env_float  # noqa: E402
 
 
@@ -455,6 +480,18 @@ class PredictorServer:
                 except (ValueError, OSError):
                     pass
 
+            def _read_json_body(self):
+                """Parsed JSON request body, or None when it is
+                unreadable or malformed — the ONE body-read idiom for
+                every POST route (each caller picks its own error
+                response; a half-sent or non-JSON body is the
+                client's fault, never a 500)."""
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    return json.loads(self.rfile.read(n) or b"{}")
+                except (ValueError, OSError):
+                    return None
+
             def do_POST(self):
                 if self.path == "/drain":
                     # admin: flip into draining (idempotent). The
@@ -469,6 +506,12 @@ class PredictorServer:
                     return
                 if self.path.startswith("/admin/trace"):
                     handle_admin_trace(self, self._drain_body)
+                    return
+                if self.path == "/cancel":
+                    self._do_cancel()
+                    return
+                if self.path.startswith("/admin/inject"):
+                    self._do_admin_inject()
                     return
                 if self.path == "/generate":
                     self._do_generate()
@@ -531,8 +574,10 @@ class PredictorServer:
 
                 submitted = False
                 try:
-                    n = int(self.headers.get("Content-Length", "0"))
-                    payload = json.loads(self.rfile.read(n) or b"{}")
+                    payload = self._read_json_body()
+                    if payload is None:
+                        self._send(400, {"error": "bad body"})
+                        return
                     fut = server._pool.submit(run_and_release, payload)
                     submitted = True
                     try:
@@ -609,6 +654,58 @@ class PredictorServer:
                     with server._depth_lock:
                         server._resp_inflight -= 1
 
+            def _do_cancel(self):
+                """POST /cancel {"request_id": rid} — real request
+                cancellation through the engine: queued requests
+                resolve now, admitted ones retire at the next tick
+                boundary (slot + KV pages reclaimed). The cancelled
+                request's own waiter gets its 409 / stream err line
+                with the partial result; THIS response only reports
+                whether a live request matched."""
+                payload = self._read_json_body() or {}
+                if server.engine is None:
+                    self._send(404, {"error": "no generation engine "
+                                              "attached to this server"})
+                    return
+                rid = (payload.get("request_id")
+                       or self.headers.get(REQUEST_ID_HEADER))
+                if not rid:
+                    self._send(400, {"error": "request_id required"})
+                    return
+                ok = server.engine.cancel(str(rid))
+                self._send(200, {"cancelled": bool(ok),
+                                 "request_id": str(rid)})
+
+            def _do_admin_inject(self):
+                """POST /admin/inject {"site": s, "count": n,
+                "wedge_s": opt} — arm a resilience fault site in this
+                LIVE process (chaos tooling: the tier bench wedges one
+                replica's decode loop with `replica_stall` to exercise
+                hedged decode). Refused unless the process was started
+                with PADDLE_TPU_CHAOS_ADMIN=1 — production replicas
+                must not expose a self-sabotage endpoint."""
+                payload = self._read_json_body()
+                if payload is None:
+                    self._send(400, {"error": "bad body"})
+                    return
+                if not _env_bool("PADDLE_TPU_CHAOS_ADMIN", False):
+                    self._send(403, {"error": "chaos admin disabled "
+                                              "(PADDLE_TPU_CHAOS_ADMIN)"})
+                    return
+                site = payload.get("site")
+                count = payload.get("count", 1)
+                wedge_s = payload.get("wedge_s")
+                try:
+                    _resil.arm_fault(str(site), int(count),
+                                     None if wedge_s is None
+                                     else float(wedge_s))
+                except (ValueError, TypeError) as e:
+                    self._send(400, {"error": str(e)})
+                    return
+                self._send(200, {"armed": str(site),
+                                 "count": int(count),
+                                 "wedge_s": wedge_s})
+
             def _generate_admitted(self):
                 # request-id propagation: honor the router's header,
                 # mint one otherwise — every response can be resolved
@@ -624,16 +721,30 @@ class PredictorServer:
 
             def _generate_traced(self, rid):
                 from .engine import EngineOverloaded
+                stream = False
+                evq = None
                 try:
-                    n = int(self.headers.get("Content-Length", "0"))
-                    payload = json.loads(self.rfile.read(n) or b"{}")
+                    payload = self._read_json_body()
+                    if payload is None:
+                        self._send(400, {"error": "bad body"})
+                        return
                     ids = payload["input_ids"]
+                    stream = bool(payload.get("stream"))
+                    progress = None
+                    if stream:
+                        # incremental mode: the engine's per-tick
+                        # progress callback feeds an event queue this
+                        # handler drains into NDJSON lines — the
+                        # token side-channel the router journals
+                        evq = _queue.Queue()
+                        progress = (lambda toks, q=evq:
+                                    q.put(("t", toks)))
                     fut = server.engine.submit(
                         ids,
                         int(payload.get("max_new_tokens", 32)),
                         payload.get("eos_token_id"),
                         int(payload.get("seed", 0)),
-                        request_id=rid)
+                        request_id=rid, progress_cb=progress)
                 except EngineOverloaded as e:
                     # identical record shape to the predictor path's
                     # load shedding — orchestrators see ONE contract;
@@ -663,20 +774,45 @@ class PredictorServer:
                     self._send(503, {"error":
                                      f"backend_unavailable: {e}"})
                     return
+                prompt_len = len(np.asarray(ids).reshape(-1))
+                if stream:
+                    self._generate_stream_body(fut, evq, rid,
+                                               prompt_len)
+                    return
+                from .engine import RequestCancelled
                 try:
                     out = fut.result(timeout=server.deadline_s)
                 except FutureTimeout:
                     server._failure_streak += 1
+                    if rid:
+                        # the waiter is giving up: stop decoding for a
+                        # client that will never read the result
+                        server.engine.cancel(rid)
                     self._send(503, {"error": "deadline_exceeded",
                                      "deadline_s": server.deadline_s})
                     return
+                except RequestCancelled:
+                    # cancelled via POST /cancel (hedge loser, client
+                    # disconnect elsewhere): 409 with the PARTIAL
+                    # result — tokens generated before the cancel are
+                    # surfaced, never discarded
+                    info = getattr(fut, "_ptpu_gen_info", None) or {}
+                    body = {"error": "cancelled"}
+                    body.update(info)
+                    if rid:
+                        body["request_id"] = rid
+                    self._send(409, body)
+                    return
                 except Exception as e:   # noqa: BLE001 — engine fault
                     server._failure_streak += 1
-                    self._send(503, {"error":
-                                     f"backend_unavailable: {e}"})
+                    body = {"error": f"backend_unavailable: {e}"}
+                    # partial-result accounting rides the error path
+                    # too (engine attaches it in _fail_all)
+                    body.update(getattr(fut, "_ptpu_gen_info", None)
+                                or {})
+                    self._send(503, body)
                     return
                 server._failure_streak = 0
-                prompt_len = len(np.asarray(ids).reshape(-1))
                 # detokenize/respond phase: array -> JSON body (the
                 # closest thing this token server has to detokenizing)
                 with _obs.span("serve.detokenize", cat="serve",
@@ -696,6 +832,89 @@ class PredictorServer:
                     if rid:
                         body["request_id"] = rid
                 self._send(200, body)
+
+            # -- incremental (streaming) generate ----------------------
+            def _write_event(self, obj):
+                self.wfile.write((json.dumps(obj) + "\n").encode())
+                self.wfile.flush()
+
+            def _generate_stream_body(self, fut, evq, rid, prompt_len):
+                """Write the NDJSON event stream for one admitted
+                request: {"t": [...]} per emitted block, then one
+                terminal {"done": body} / {"err": record} line, then
+                close (read-until-close framing — no chunked encoding
+                needed, and a dead replica is unmistakable: EOF
+                without a terminal line). The terminal body is
+                authoritative; token lines exist so the reader can
+                journal progress and detect stalls."""
+                from .engine import RequestCancelled
+                fut.add_done_callback(lambda f: evq.put(("fin", None)))
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "application/x-ndjson")
+                self.send_header("Connection", "close")
+                self.end_headers()
+                self.close_connection = True
+                deadline = time.monotonic() + server.deadline_s
+                sent = 0
+                try:
+                    while True:
+                        timeout = deadline - time.monotonic()
+                        if timeout <= 0:
+                            server._failure_streak += 1
+                            if rid:
+                                server.engine.cancel(rid)
+                            self._write_event({"err": {
+                                "error": "deadline_exceeded",
+                                "deadline_s": server.deadline_s,
+                                "tokens_generated": sent}})
+                            return
+                        try:
+                            kind, toks = evq.get(
+                                timeout=min(timeout, 0.5))
+                        except _queue.Empty:
+                            continue
+                        if kind == "t":
+                            self._write_event({"t": toks})
+                            sent += len(toks)
+                            continue
+                        break                    # fin: future resolved
+                    try:
+                        out = fut.result(timeout=0)
+                    except RequestCancelled:
+                        info = getattr(fut, "_ptpu_gen_info",
+                                       None) or {}
+                        rec = {"error": "cancelled"}
+                        rec.update(info)
+                        if rid:
+                            rec["request_id"] = rid
+                        self._write_event({"err": rec})
+                        return
+                    except Exception as e:   # noqa: BLE001 — engine
+                        server._failure_streak += 1
+                        rec = {"error": f"backend_unavailable: {e}"}
+                        rec.update(getattr(fut, "_ptpu_gen_info",
+                                           None) or {})
+                        self._write_event({"err": rec})
+                        return
+                    server._failure_streak = 0
+                    with _obs.span("serve.detokenize", cat="serve",
+                                   request_id=rid):
+                        body = {"tokens": out.tolist(),
+                                "prompt_len": prompt_len,
+                                "new_tokens": len(out) - prompt_len}
+                        info = getattr(fut, "_ptpu_gen_info", None)
+                        if info:
+                            body.update(info)
+                        if rid:
+                            body["request_id"] = rid
+                    self._write_event({"done": body})
+                except (BrokenPipeError, ConnectionError, OSError):
+                    # the reader (router/client) went away mid-stream:
+                    # stop generating for a stream nobody reads —
+                    # cancellation reclaims the slot and its pages
+                    if rid:
+                        server.engine.cancel(rid)
 
         return Handler
 

@@ -14,6 +14,8 @@ Key invariants:
   `backend_unavailable` on the injected dead backend.
 """
 import json
+import threading
+import time
 import urllib.error
 import urllib.request
 
@@ -22,7 +24,8 @@ import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu.inference.engine import (ContinuousBatchingEngine,
-                                         EngineOverloaded)
+                                         EngineOverloaded,
+                                         RequestCancelled)
 from paddle_tpu.models import GPTForCausalLM, gpt_tiny
 
 
@@ -148,6 +151,151 @@ def test_submit_validation(engine):
     with pytest.raises(ValueError):
         # prompt + budget + tick overshoot exceeds cache length
         engine.submit(_prompt(0, 16), max_new_tokens=60)
+
+
+# ---------------------------------------------------------------------------
+# cancellation + progress streaming + partial results (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+def test_cancel_queued_request_resolves_immediately(model, engine):
+    """A QUEUED request cancels without ever touching a slot: its
+    future raises RequestCancelled with zero tokens, the partial
+    record is present-but-empty, and a second cancel of the same id
+    is a no-op (idempotent). Rides the warm module engine — cancel
+    must add zero compiles."""
+    eng = engine
+    hogs = [eng.submit(_prompt(i, 6), max_new_tokens=40,
+                       request_id=f"hog{i}")
+            for i in range(eng.slots)]
+    victim = eng.submit(_prompt(9, 6), max_new_tokens=4,
+                        request_id="victim")
+    assert eng.cancel("victim") is True
+    with pytest.raises(RequestCancelled) as ei:
+        victim.result(timeout=60)
+    assert ei.value.tokens_generated == 0
+    assert victim._ptpu_gen_info == {"tokens_generated": 0,
+                                     "partial_tokens": []}
+    # idempotent + unknown/None ids are clean no-ops
+    assert eng.cancel("victim") is False
+    assert eng.cancel("nope") is False
+    assert eng.cancel(None) is False
+    # the engine is undisturbed: the slot-holders complete
+    for f in hogs:
+        assert f.result(timeout=300).shape[0] == 6 + 40
+    assert eng.stats()["cancelled"] >= 1
+
+
+def test_cancel_mid_decode_surfaces_greedy_exact_partial(model, engine):
+    """Cancelling an ADMITTED request retires it at the next tick
+    boundary: the future raises RequestCancelled carrying the partial
+    result, and the partial tokens are a bitwise prefix of the
+    undisturbed greedy run (the property the router's journal
+    reconciliation relies on). The slot frees for new work."""
+    eng = engine
+    ids = _prompt(2, 6)
+    want = model.generate(ids[None], max_new_tokens=48,
+                          cache_dtype="float32")[0]
+    seen = []
+    progressed = threading.Event()
+
+    def cb(toks):
+        seen.extend(toks)
+        if len(seen) >= 4:
+            progressed.set()
+
+    fut = eng.submit(ids, max_new_tokens=48, request_id="mid",
+                     progress_cb=cb)
+    assert progressed.wait(timeout=300), "no token progress"
+    assert eng.cancel("mid") is True
+    with pytest.raises(RequestCancelled):
+        fut.result(timeout=60)
+    info = fut._ptpu_gen_info
+    n = info["tokens_generated"]
+    assert 4 <= n < 48 + 1
+    assert info["partial_tokens"] == want[6:6 + n].tolist()
+    # the slot and its future work are reclaimed: engine drains to
+    # idle and serves the next request token-identically
+    deadline = time.monotonic() + 60
+    while eng.stats()["active"] and time.monotonic() < deadline:
+        time.sleep(0.02)
+    st = eng.stats()
+    assert st["active"] == 0 and st["cancelled"] >= 1
+    got = eng.generate(ids, max_new_tokens=5, timeout=300)
+    np.testing.assert_array_equal(
+        got, model.generate(ids[None], max_new_tokens=5,
+                            cache_dtype="float32")[0])
+
+
+def test_progress_cb_streams_exactly_the_generated_tokens(engine):
+    """The per-token progress side-channel (the router journal's
+    feed) delivers exactly the generated suffix, in order: first
+    token at admission, then per tick — concatenated, the blocks ARE
+    the new tokens of the final result."""
+    ids = _prompt(3, 7)
+    seen = []
+    fut = engine.submit(ids, max_new_tokens=12,
+                        progress_cb=seen.extend)
+    out = fut.result(timeout=300)
+    info = fut._ptpu_gen_info
+    assert info["tokens_generated"] == 12
+    assert seen == out[7:7 + 12].tolist()
+
+
+def test_raising_progress_cb_is_dropped_not_fatal(model, engine):
+    """A broken streaming callback is the caller's problem, never the
+    engine loop's: it is dropped after the first raise and the request
+    (and every other slot) still completes token-identically."""
+    calls = []
+
+    def bad(toks):
+        calls.append(list(toks))
+        raise RuntimeError("broken stream")
+
+    ids = _prompt(4, 6)
+    fut = engine.submit(ids, max_new_tokens=8, progress_cb=bad)
+    out = fut.result(timeout=300)
+    want = model.generate(ids[None], max_new_tokens=8,
+                          cache_dtype="float32")[0]
+    np.testing.assert_array_equal(out, want)
+    assert len(calls) == 1              # dropped after the first raise
+
+
+def test_engine_failure_path_surfaces_partial_results(model):
+    """ISSUE 15 satellite (bugfix): a mid-decode engine fault no
+    longer discards the generated tokens — the failing future carries
+    ``_ptpu_gen_info`` (tokens_generated + partial_tokens, a greedy-
+    exact prefix) so a router journal can reconcile against engine
+    truth."""
+    eng = ContinuousBatchingEngine(
+        model, slots=2, max_len=64, cache_dtype="float32",
+        prefill_buckets=(8,), tick_tokens=2)
+    try:
+        ids = _prompt(5, 6)
+        want = model.generate(ids[None], max_new_tokens=48,
+                              cache_dtype="float32")[0]
+        progressed = threading.Event()
+        seen = []
+
+        def cb(toks):
+            seen.extend(toks)
+            if len(seen) >= 3:
+                progressed.set()
+
+        fut = eng.submit(ids, max_new_tokens=48, progress_cb=cb)
+        assert progressed.wait(timeout=300), "no token progress"
+
+        def boom():
+            raise RuntimeError("injected mid-decode fault")
+
+        eng._tick = boom                 # the next loop pass dies
+        with pytest.raises(RuntimeError, match="mid-decode fault"):
+            fut.result(timeout=60)
+        info = fut._ptpu_gen_info
+        n = info["tokens_generated"]
+        assert n >= 3
+        assert info["partial_tokens"] == want[6:6 + n].tolist()
+    finally:
+        eng.stop()
 
 
 # ---------------------------------------------------------------------------

@@ -621,6 +621,95 @@ def test_loss_spike_detector_cold_start_and_nonfinite():
     det2.observe(1e9)              # reset forgot the baseline: no fire
 
 
+def test_replica_stall_site_wedges_not_raises():
+    """replica_stall (ISSUE 15) is a WEDGE-type site: the engine's
+    decode loop sleeps — latency injection, not death — which is the
+    straggler scenario hedged decode exists for. Site-coverage: known,
+    armable, sleeps the configured wedge, consumed after one fire."""
+    import time as _time
+    assert "replica_stall" in resil._KNOWN_SITES
+    with resil.FaultInjector({"replica_stall": 1}, wedge_s=0.15):
+        t0 = _time.monotonic()
+        resil.maybe_inject("replica_stall")     # sleeps, never raises
+        assert _time.monotonic() - t0 >= 0.14
+        t0 = _time.monotonic()
+        resil.maybe_inject("replica_stall")     # count consumed: no-op
+        assert _time.monotonic() - t0 < 0.1
+
+
+def test_arm_fault_programmatic():
+    """arm_fault is the /admin/inject face: arms without a context
+    manager (chaos tooling wedges LIVE replicas through it)."""
+    resil.arm_fault("step_nan", 2)
+    try:
+        assert resil.should_fire("step_nan")
+        assert resil.should_fire("step_nan")
+        assert not resil.should_fire("step_nan")
+    finally:
+        with resil._inject_lock:                # leave no armed residue
+            resil._active.pop("step_nan", None)
+    with pytest.raises(ValueError, match="unknown fault-injection"):
+        resil.arm_fault("replica_stal", 1)
+
+
+def test_retry_policy_honors_retry_after_hint():
+    """A failed attempt whose exception carries retry_after_s (the
+    serving tier's relayed Retry-After) makes run() sleep exactly the
+    hint — capped by the remaining deadline — instead of the
+    full-jitter schedule (ISSUE 15 satellite)."""
+    class _Clk:
+        def __init__(self):
+            self.t = 0.0
+            self.sleeps = []
+
+        def clock(self):
+            return self.t
+
+        def sleep(self, d):
+            self.sleeps.append(d)
+            self.t += d
+
+    class _Shed(RuntimeError):
+        retry_after_s = 1.75
+
+    clk = _Clk()
+    p = RetryPolicy(max_attempts=3, base_delay=0.05, max_delay=0.5,
+                    full_jitter=True, clock=clk.clock,
+                    sleep_fn=clk.sleep)
+    calls = []
+
+    def fn():
+        calls.append(1)
+        if len(calls) < 3:
+            raise _Shed("shed")
+        return "ok"
+
+    assert p.run(fn) == "ok"
+    # both backoffs slept the server's hint verbatim, not the
+    # full-jitter draw off the 0.05s-base schedule
+    assert clk.sleeps == [1.75, 1.75]
+    # the hint is still capped by the remaining deadline budget
+    clk2 = _Clk()
+    p2 = RetryPolicy(max_attempts=3, base_delay=0.05,
+                     clock=clk2.clock, sleep_fn=clk2.sleep)
+    calls2 = []
+
+    def fn2():
+        calls2.append(1)
+        raise _Shed("shed")
+
+    with pytest.raises(_Shed):
+        p2.run(fn2, deadline=1.0)
+    assert clk2.sleeps and max(clk2.sleeps) <= 1.0
+    # an unhinted exception keeps the plain schedule
+    clk3 = _Clk()
+    p3 = RetryPolicy(max_attempts=2, base_delay=0.25, jitter=0.0,
+                     clock=clk3.clock, sleep_fn=clk3.sleep)
+    with pytest.raises(ValueError):
+        p3.run(lambda: (_ for _ in ()).throw(ValueError("x")))
+    assert clk3.sleeps == [0.25]
+
+
 def test_new_fault_sites_are_known():
     for site in ("train_step_nan", "preempt_signal", "ckpt_gc",
                  "ckpt_reshard"):

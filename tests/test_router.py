@@ -225,6 +225,48 @@ def test_routing_skips_ejected_replica_live(tier):
         victim.ejected_until = 0.0
 
 
+def test_circuit_breaker_recovery_reenters_rotation(tier):
+    """ISSUE 15 satellite: the breaker's RECOVERY half. A streak-
+    ejected replica must re-enter the rotation once its
+    PADDLE_TPU_TIER_EJECT_S cooldown lapses — routable again with NO
+    reset or respawn — and serve output token-identical to the
+    pre-ejection tier (only ejection was covered until now)."""
+    code, oracle, _ = _gen(tier, [11, 3, 5], n=5)
+    assert code == 200, oracle
+    reps = tier._replicas
+    victim = next(r for r in reps if r.name == oracle["served_by"])
+    other = next(r for r in reps if r is not victim)
+    ejections = tier.stats_counters["ejections"]
+    # trip the REAL breaker (streak of io-class failures)
+    for _ in range(tier.breaker_threshold):
+        tier._note_failure(victim)
+    assert tier.stats_counters["ejections"] == ejections + 1
+    assert victim.ejected_until > time.monotonic()
+    assert not victim.routable(time.monotonic())
+    try:
+        # during the cooldown every request lands on the other replica
+        code, body, _ = _gen(tier, [11, 3, 5], n=5)
+        assert code == 200 and body["served_by"] == other.name
+        assert body["tokens"] == oracle["tokens"]
+        # shorten the breaker's own window rather than sleeping the
+        # full eject_s — the LAPSE semantics are what is under test
+        victim.ejected_until = time.monotonic() + 0.3
+        time.sleep(0.35)
+        assert victim.routable(time.monotonic())    # re-entered
+        # force the next pick to the recovered replica and prove it
+        # serves token-identical output (no reset happened: same
+        # process, same warm engine, same greedy tokens)
+        other.ejected_until = time.monotonic() + 30.0
+        code, body, _ = _gen(tier, [11, 3, 5], n=5)
+        assert code == 200, body
+        assert body["served_by"] == victim.name
+        assert body["tokens"] == oracle["tokens"]
+    finally:
+        other.ejected_until = 0.0
+        victim.ejected_until = 0.0
+        victim.failure_streak = 0
+
+
 def test_retry_on_different_replica_after_injected_fault(tier):
     before = tier.stats_counters["retries"]
     with FaultInjector({"router_forward": 1}):
@@ -386,3 +428,218 @@ def test_crash_loops_surfaced_in_stats_and_healthz(bare_router):
     assert "crash_loops" in bare_router.stats_counters
     body = bare_router.stats()
     assert body["stats"]["crash_loops"] == 0
+
+
+# ---------------------------------------------------------------------------
+# work-conserving recovery verdicts (scripted attempts, no processes)
+# ISSUE 15 hardening: the coordinator's reap/relaunch logic is pure
+# decision-making over attempt outcomes — drive it with scripted
+# stand-ins for _StreamAttempt instead of live replicas.
+# ---------------------------------------------------------------------------
+
+def test_retry_after_hint_malformed_degrades_to_none():
+    """A replica's retry_after_s hint flows into RetryPolicy.sleep and
+    send_json arithmetic — a malformed value (anything answering on
+    the replica's port can send one) must degrade to None, never
+    crash the forward path."""
+    from paddle_tpu.inference.router import _retry_after_hint
+    assert _retry_after_hint({"retry_after_s": 2.5}) == 2.5
+    assert _retry_after_hint({"retry_after_s": "3"}) == 3.0
+    assert _retry_after_hint({}) is None
+    assert _retry_after_hint({"retry_after_s": "soon"}) is None
+    assert _retry_after_hint({"retry_after_s": None}) is None
+    assert _retry_after_hint({"retry_after_s": [1]}) is None
+
+
+def test_hedge_budget_caps_concurrent_backups(bare_router):
+    """Tier-wide hedge budget: at most hedge_frac of the live
+    journaled requests (floor 1) may run a backup at once — a
+    saturated tier where every queued request looks silent must not
+    hedge itself into double load."""
+    r = bare_router
+    r.hedge_frac = 0.25
+    r._journaled = 20
+    grabbed = 0
+    while r._reserve_hedge():
+        grabbed += 1
+        assert grabbed <= 5, "cap must be frac * journaled"
+    assert grabbed == 5
+    r._release_hedge()
+    assert r._reserve_hedge()       # a freed slot is reusable
+    # floor: a lone straggler always clears the budget
+    r2 = bare_router
+    r2._hedges_live = 0
+    r2._journaled = 1
+    assert r2._reserve_hedge()
+    assert not r2._reserve_hedge()
+
+
+def _scripted_attempts(script):
+    """A _StreamAttempt stand-in running ``script[seq]`` in the
+    attempt thread (coordinator-visible attrs mirrored exactly). A
+    behavior that raises books an io-failure so the coordinator
+    terminates instead of waiting out the deadline."""
+    import threading as _threading
+
+    class _Scripted(_threading.Thread):
+        made = []
+
+        def __init__(self, router, rep, st, base, deadline_at,
+                     is_hedge, seq):
+            super().__init__(daemon=True)
+            self.router, self.rep, self.j = router, rep, st
+            self.base, self.is_hedge = int(base), bool(is_hedge)
+            self.rid = f"scripted.{seq}"
+            self.status = "running"
+            self.reaped = False
+            self.kind = None
+            self.reason = ""
+            self.code = 0
+            self.body = None
+            self.retry_after = None
+            self.done_body = None
+            self.streamed = True
+            self.got = 0
+            self._behave = script[min(seq, len(script) - 1)]
+            _Scripted.made.append(self)
+
+        def run(self):
+            try:
+                self._behave(self)
+            except Exception as e:   # noqa: BLE001 — surface to the
+                self.kind = "io"     # coordinator as a failure
+                self.reason = f"scripted: {e}"
+                self.status = "failed"
+            with self.j.cond:
+                self.j.cond.notify_all()
+
+        def cancel(self):
+            pass
+    return _Scripted
+
+
+def _finish(a, prompt, full_new):
+    """Terminal behavior: extend the journal past ``a.base`` and land
+    the done body in the replica's own frame (residual prompt)."""
+    a.j.extend(a.base, full_new[a.base:], a.rep.name)
+    a.got = len(full_new) - a.base
+    a.done_body = {"tokens": list(prompt) + list(full_new),
+                   "prompt_len": len(prompt) + a.base,
+                   "new_tokens": len(full_new) - a.base,
+                   "tokens_generated": len(full_new) - a.base,
+                   # the replica echoes the ATTEMPT's derived id —
+                   # the coordinator must restore the client's
+                   "request_id": a.rid}
+    a.status = "done"
+
+
+def test_coordinator_keeps_relaunching_until_a_replica_returns(
+        bare_router, monkeypatch):
+    """A journaled request whose replica died while NO other replica
+    is routable must keep retrying launch() and resume the moment the
+    respawn is pickable — not idle to the deadline (the relaunch
+    intent persists across poll iterations)."""
+    from paddle_tpu.inference import router as router_mod
+    r = bare_router
+    r.hedge_s = 0.0                  # hedging off: deterministic seqs
+    prompt, full = [1, 2, 3], [11, 12, 13, 14]
+    rep = _fake_replica("fr")
+    picks = {"n": 0}
+
+    def pick(exclude):
+        picks["n"] += 1
+        # launch 1 lands; then the tier is replica-less for 5 picks
+        # (the dead primary reaped, the respawn still warming); then
+        # the respawn is routable again
+        return None if 2 <= picks["n"] <= 6 else rep
+
+    monkeypatch.setattr(r, "_pick", pick)
+
+    def die_with_progress(a):
+        a.j.extend(0, full[:2], a.rep.name)
+        a.kind, a.reason = "io", "stream truncated"
+        a.status = "failed"
+
+    def resume(a):
+        assert a.base == 2, "resume must seed the journaled prefix"
+        _finish(a, prompt, full)
+
+    cls = _scripted_attempts([die_with_progress, resume])
+    monkeypatch.setattr(router_mod, "_StreamAttempt", cls)
+    t0 = time.monotonic()
+    code, body, _ = r._forward_recovering(prompt, 4, None, 0, 8.0,
+                                          "rid-gap", t0)
+    assert code == 200, body
+    assert body["tokens"] == prompt + full
+    assert body["prompt_len"] == len(prompt)
+    assert body["tokens_generated"] == 4
+    assert body["request_id"] == "rid-gap", \
+        "winner path must restore the client's request id"
+    assert body["recovered"] == 1
+    assert picks["n"] >= 7, "launch() must keep retrying the pick"
+    assert time.monotonic() - t0 < 6.0, "must beat the deadline"
+
+
+def test_token_mismatch_falls_back_to_from_scratch_rerun(
+        bare_router, monkeypatch):
+    """A resumed attempt that mismatches the journal must relaunch
+    from scratch (journal VERIFIES, not seeds) — retrying the resume
+    at the same base would mismatch forever and fail the request."""
+    from paddle_tpu.inference import router as router_mod
+    r = bare_router
+    r.hedge_s = 0.0
+    prompt, full = [7, 8], [21, 22, 23]
+    rep = _fake_replica("fr")
+    monkeypatch.setattr(r, "_pick", lambda exclude: rep)
+
+    def mismatch_after_progress(a):
+        a.j.extend(0, full[:2], a.rep.name)
+        a.kind, a.reason = "mismatch", "token mismatch vs journal"
+        a.status = "failed"
+
+    def rerun(a):
+        assert a.base == 0, "mismatch must force a from-scratch rerun"
+        _finish(a, prompt, full)
+
+    cls = _scripted_attempts([mismatch_after_progress, rerun])
+    monkeypatch.setattr(router_mod, "_StreamAttempt", cls)
+    code, body, _ = r._forward_recovering(prompt, 3, None, 0, 8.0,
+                                          "rid-mm", time.monotonic())
+    assert code == 200, body
+    assert body["tokens"] == prompt + full
+    assert r.stats_counters["resume_fallbacks"] >= 1
+
+
+def test_sampling_tier_never_seeds_a_resume(bare_router, monkeypatch):
+    """do_sample engines roll tok0 from the raw key at admit but
+    fold_in(key, pos) mid-decode, so a seeded resume re-rolls
+    different tokens — a sampling tier's relaunches must all run from
+    scratch (verify-only journal) from the start."""
+    from paddle_tpu.inference import router as router_mod
+    r = bare_router
+    r.hedge_s = 0.0
+    r.spec.engine["do_sample"] = True
+    prompt, full = [4, 5], [31, 32]
+    rep = _fake_replica("fr")
+    monkeypatch.setattr(r, "_pick", lambda exclude: rep)
+
+    def die_with_progress(a):
+        assert a.base == 0
+        a.j.extend(0, full[:1], a.rep.name)
+        a.kind, a.reason = "io", "stream truncated"
+        a.status = "failed"
+
+    def rerun(a):
+        assert a.base == 0, "sampling tier must never seed a resume"
+        _finish(a, prompt, full)
+
+    cls = _scripted_attempts([die_with_progress, rerun])
+    monkeypatch.setattr(router_mod, "_StreamAttempt", cls)
+    try:
+        code, body, _ = r._forward_recovering(prompt, 2, None, 0, 8.0,
+                                              "rid-samp",
+                                              time.monotonic())
+    finally:
+        r.spec.engine.pop("do_sample", None)
+    assert code == 200, body
+    assert body["tokens"] == prompt + full

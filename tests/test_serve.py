@@ -236,6 +236,124 @@ def test_fast_stop_default_unchanged(engine_server):
     assert time.monotonic() - t0 < 10.0
 
 
+# ---------------------------------------------------------------------------
+# incremental /generate + /cancel + /admin/inject (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+def test_generate_stream_ndjson_matches_single_shot(engine_server):
+    """"stream": true turns /generate into NDJSON read-until-close:
+    {"t": [...]} per emitted block then one terminal {"done": body} —
+    the concatenated token events ARE the generated suffix, and the
+    terminal body is identical to the single-shot response (the
+    contract the router's token journal rides)."""
+    srv = engine_server
+    payload = {"input_ids": [3, 1, 4, 1, 5], "max_new_tokens": 8}
+    _, oneshot, _ = _req_h(srv, "/generate", payload)
+    url = f"http://{srv.host}:{srv.port}/generate"
+    req = urllib.request.Request(
+        url, json.dumps(dict(payload, stream=True)).encode(),
+        {"Content-Type": "application/json"})
+    events = []
+    with urllib.request.urlopen(req, timeout=60) as r:
+        assert r.headers["Content-Type"] == "application/x-ndjson"
+        for raw in r:
+            raw = raw.strip()
+            if raw:
+                events.append(json.loads(raw))
+    assert "done" in events[-1]
+    streamed = [t for ev in events[:-1] for t in ev["t"]]
+    body = events[-1]["done"]
+    assert streamed == body["tokens"][5:5 + body["tokens_generated"]]
+    # the terminal body matches the single-shot contract bitwise
+    # (request_id differs per request; everything token-shaped equal)
+    for k in ("tokens", "prompt_len", "new_tokens", "tokens_generated"):
+        assert body[k] == oneshot[k]
+
+
+def test_cancel_endpoint_mid_decode_409_with_partial(engine_server):
+    """POST /cancel retires an admitted request at the next tick
+    boundary; its own waiter gets 409 "cancelled" WITH the partial
+    result (tokens_generated + partial_tokens) — work surfaced, not
+    discarded."""
+    import threading
+    from paddle_tpu.distributed import resilience as resil
+    srv = engine_server
+    # warm the decode program first so the wedge below can't be
+    # mistaken for compile time
+    code, _, _ = _req_h(srv, "/generate",
+                        {"input_ids": [2, 7], "max_new_tokens": 2})
+    assert code == 200
+    rid = "cancel-me-http"
+    result = {}
+
+    def waiter():
+        url = f"http://{srv.host}:{srv.port}/generate"
+        req = urllib.request.Request(
+            url, json.dumps({"input_ids": [2, 7, 1, 8],
+                             "max_new_tokens": 80}).encode(),
+            {"Content-Type": "application/json",
+             "X-PTPU-Request-Id": rid})
+        try:
+            with urllib.request.urlopen(req, timeout=120) as r:
+                result["resp"] = (r.status, json.loads(r.read()))
+        except urllib.error.HTTPError as e:
+            result["resp"] = (e.code, json.loads(e.read()))
+
+    # wedge ONE decode tick (replica_stall, the straggler site): the
+    # request is guaranteed mid-decode — admitted, first token out,
+    # loop asleep — when the cancel lands, however loaded the host is
+    resil.arm_fault("replica_stall", 1, wedge_s=1.5)
+    t = threading.Thread(target=waiter)
+    t.start()
+    # wait until the request is admitted and producing tokens
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        st = srv.engine.stats()
+        if st["active"] >= 1:
+            break
+        time.sleep(0.01)
+    code, body = _req(srv, "/cancel", {"request_id": rid})
+    assert code == 200 and body["cancelled"] is True, body
+    t.join(timeout=90)
+    code, body = result["resp"]
+    assert code == 409, body
+    assert body["error"] == "cancelled"
+    assert body["request_id"] == rid
+    assert body["tokens_generated"] == len(body["partial_tokens"])
+    # a second cancel of the resolved id is a truthful no-op
+    code, body = _req(srv, "/cancel", {"request_id": rid})
+    assert code == 200 and body["cancelled"] is False
+    # /cancel without a request id is a 400
+    code, body = _req(srv, "/cancel", {})
+    assert code == 400
+
+
+def test_admin_inject_gated_and_validated(engine_server, monkeypatch):
+    """/admin/inject is the chaos bench's way to wedge a LIVE replica
+    (replica_stall). It must be locked behind PADDLE_TPU_CHAOS_ADMIN
+    (403 otherwise) and reject unknown sites (400) so a typo'd chaos
+    script can't silently arm nothing."""
+    srv = engine_server
+    monkeypatch.delenv("PADDLE_TPU_CHAOS_ADMIN", raising=False)
+    code, body = _req(srv, "/admin/inject",
+                      {"site": "replica_stall", "count": 1})
+    assert code == 403 and "chaos admin" in body["error"]
+    monkeypatch.setenv("PADDLE_TPU_CHAOS_ADMIN", "1")
+    code, body = _req(srv, "/admin/inject",
+                      {"site": "replica_stal", "count": 1})
+    assert code == 400 and "unknown fault-injection" in body["error"]
+    # armed for real: the next decode tick sleeps the configured wedge
+    code, body = _req(srv, "/admin/inject",
+                      {"site": "replica_stall", "count": 1,
+                       "wedge_s": 0.3})
+    assert code == 200 and body["armed"] == "replica_stall"
+    t0 = time.monotonic()
+    code, body = _req(srv, "/generate",
+                      {"input_ids": [5, 3], "max_new_tokens": 2})
+    assert code == 200, body
+    assert time.monotonic() - t0 >= 0.3     # the wedge really fired
+
+
 @pytest.mark.slow
 def test_serving_latency_bench_smoke():
     """The north-star serving benchmark (tools/bench_serving.py,

@@ -120,6 +120,21 @@ def _run_elastic_smoke(env) -> int:
         cwd=ROOT, env=env).returncode
 
 
+def _run_recovery_smoke(env) -> int:
+    """Recovery smoke (ISSUE 15): tools/bench_serving.py --recovery
+    --smoke drives a live 2-replica tier through kill-mid-decode
+    (journaled failover: every client 200 with bitwise-identical
+    tokens, prefix-hit re-prefill, zero new compiles, recovery
+    counters + flight artifact) and an injected replica_stall
+    (hedged decode bounds p99, the loser is cancelled, allocator ends
+    leak-free)."""
+    print("\n=== recovery smoke (kill-mid-decode + stall-hedge) ===")
+    return subprocess.run(
+        [sys.executable, os.path.join("tools", "bench_serving.py"),
+         "--recovery", "--smoke"],
+        cwd=ROOT, env=env).returncode
+
+
 def _run_obs_smoke(env) -> int:
     """Obs smoke (ISSUE 8): tools/trace_tool.py --self-test drives a
     LIVE tiny server — /metrics scraped twice and parsed (series must
@@ -266,6 +281,11 @@ def main():
                     help="skip the topology-elastic chaos smoke "
                          "(tools/chaos_train.py --elastic) that "
                          "--quick/--full append after the tests")
+    ap.add_argument("--no-recovery-smoke", action="store_true",
+                    help="skip the serving recovery smoke "
+                         "(tools/bench_serving.py --recovery --smoke: "
+                         "kill-mid-decode + stall-hedge) that "
+                         "--quick/--full append after the tests")
     ap.add_argument("-k", default=None)
     args = ap.parse_args()
     if args.full and args.quick:
@@ -371,6 +391,11 @@ def main():
         # cache itself, but don't even offer it the multi-device trap
         elastic_rc = _run_elastic_smoke(env)
         rc = rc or elastic_rc
+    if (args.quick or args.full) and not args.no_recovery_smoke:
+        # cache_env: replica children warm through the shared store +
+        # single-device jax cache (no multi-device entries can arise)
+        recovery_rc = _run_recovery_smoke(cache_env)
+        rc = rc or recovery_rc
     return rc
 
 

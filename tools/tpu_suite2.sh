@@ -45,6 +45,14 @@ run bench_serving_paged bench_serving_paged.json \
 # self-skips once landed
 run bench_serving_spec bench_serving_spec.json \
     python tools/bench_serving.py --spec
+# work-conserving request recovery chaos gates (ISSUE 15):
+# kill-mid-decode -> journaled failover bitwise-identical with zero
+# client errors, prefix-hit re-prefill + zero new compiles asserted;
+# injected replica_stall -> hedged decode bounds p99, loser cancelled,
+# allocator leak-free (replica children force cpu); self-skips once
+# landed
+run bench_serving_recovery bench_serving_recovery.json \
+    python tools/bench_serving.py --recovery
 # obs decode-tick overhead gate (ISSUE 8): enabled-vs-disabled tick
 # time, paired-median on/off rounds; asserts the ratio <= 1.02 —
 # self-skips once landed like every other step
