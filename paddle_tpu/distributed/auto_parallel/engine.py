@@ -74,9 +74,17 @@ class Engine:
                 if getattr(st, "recompute", False):
                     plan["remat"] = True
             if plan.get("amp_level") == "O2":
-                # pure-bf16 params (the reference's pure-fp16 pass
-                # outcome; O1 is the default autocast behavior here)
-                self._model.bfloat16()
+                # pure-bf16 compute params (the reference's pure-fp16
+                # pass outcome; O1 is the default autocast behavior
+                # here) — EXCEPT normalization layers, whose scales/
+                # shifts/running stats stay fp32 (the reference O2
+                # pass keeps norms out of the low-precision cast: a
+                # bf16 running-variance accumulates visible drift).
+                # Master weights ride the optimizer's multi_precision
+                # path: updates accumulate in fp32 slots, the bf16
+                # param is a downcast view per step.
+                self._cast_amp_o2(self._model)
+                self._optimizer._multi_precision = True
             self._train_step = ParallelTrainStep(
                 self._model, self._loss, self._optimizer,
                 n_inputs=self._n_inputs, mesh=self._mesh(),
@@ -86,6 +94,36 @@ class Engine:
             self._trained_forward = None
         self._mode = mode
         return self
+
+    @staticmethod
+    def _cast_amp_o2(model):
+        """amp_level O2 cast: every float param/buffer to bfloat16
+        except those owned by normalization layers (batch/sync/
+        instance/layer/rms/group norm), which keep fp32."""
+        import jax as _jax
+
+        from ...framework.dtype import convert_dtype, is_inexact
+        from ...nn.layer.norm import (GroupNorm, LayerNorm, RMSNorm,
+                                      _BatchNormBase, _InstanceNormBase)
+        keep_fp32 = (_BatchNormBase, _InstanceNormBase, LayerNorm,
+                     RMSNorm, GroupNorm)
+        dt = convert_dtype("bfloat16")
+
+        def cast(v):
+            if isinstance(v, _jax.ShapeDtypeStruct):  # LazyGuard
+                return _jax.ShapeDtypeStruct(v.shape, dt)
+            return v.astype(dt)
+
+        for lyr in model.sublayers(include_self=True):
+            if isinstance(lyr, keep_fp32):
+                continue
+            # own params/buffers only — sublayers decide for themselves
+            for p in lyr._parameters.values():
+                if p is not None and is_inexact(p.value.dtype):
+                    p.value = cast(p.value)
+            for b in lyr._buffers.values():
+                if b is not None and is_inexact(b.value.dtype):
+                    b.value = cast(b.value)
 
     def _forward(self):
         """Eval/predict forward: the train step's params when training was

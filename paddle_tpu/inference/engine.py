@@ -742,6 +742,15 @@ class ContinuousBatchingEngine:
                 "prefix_tokens_saved": self.prefix_tokens_saved,
                 "prefill_tokens": self.prefill_tokens,
             })
+            # cross-process prefix identity for the router's affinity
+            # scoring (ISSUE 16): chained crc32 per cached trie node,
+            # bounded. The walk races the engine loop's inserts by
+            # design — a torn read only costs one poll's freshness,
+            # never correctness (hashes are compared, not dereferenced)
+            try:
+                out["prefix_fingerprints"] = self._trie.fingerprints()
+            except RuntimeError:
+                out["prefix_fingerprints"] = []
         return out
 
     @property

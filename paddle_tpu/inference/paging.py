@@ -35,14 +35,36 @@ any write).
 """
 from __future__ import annotations
 
+import zlib
 from typing import Dict, List, Optional, Tuple
 
-__all__ = ["PageAllocator", "PrefixTrie", "pages_needed"]
+__all__ = ["PageAllocator", "PrefixTrie", "pages_needed",
+           "chain_hashes"]
 
 
 def pages_needed(tokens: int, page_size: int) -> int:
     """Pages required to hold ``tokens`` cache positions."""
     return -(-int(tokens) // int(page_size))
+
+
+def chain_hashes(tokens, page_size: int) -> List[int]:
+    """crc32 chain hash of every COMPLETE page of ``tokens``: hash j
+    folds page j's exact token tuple into hash j-1. The same fold
+    :meth:`PrefixTrie.fingerprints` uses, so a router can hash an
+    incoming prompt and intersect with the fingerprint set a replica
+    reports — equal hashes <=> equal cached prefix chains, across
+    processes (Python ``hash()`` is per-process salted; crc32 is not),
+    without ever shipping token ids."""
+    ps = int(page_size)
+    if ps <= 0:
+        return []
+    out: List[int] = []
+    h = 0
+    for j in range(len(tokens) // ps):
+        key = tuple(int(t) for t in tokens[j * ps:(j + 1) * ps])
+        h = zlib.crc32(repr(key).encode(), h)
+        out.append(h)
+    return out
 
 
 class PageAllocator:
@@ -212,6 +234,25 @@ class PrefixTrie:
             freed += self.alloc.decref([victim.page])
             self.pages_cached -= 1
         return freed
+
+    def fingerprints(self, limit: int = 512) -> List[int]:
+        """Chained crc32 ids of the cached prefix chains (one per
+        node, bounded): node fingerprint = crc32(page key, parent
+        fingerprint) — the cross-process identity a replica exports
+        via /healthz for the router's prefix-affinity scoring (a
+        prompt whose :func:`chain_hashes` prefix lands in this set has
+        that many pages of KV already cached here)."""
+        out: List[int] = []
+        stack = [(self.root, 0)]
+        while stack and len(out) < limit:
+            node, h = stack.pop()
+            for key, child in list(node.children.items()):
+                ch = zlib.crc32(repr(key).encode(), h)
+                out.append(ch)
+                if len(out) >= limit:
+                    break
+                stack.append((child, ch))
+        return out
 
     def reclaimable(self) -> int:
         """How many cached pages eviction could actually free right now

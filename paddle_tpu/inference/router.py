@@ -55,6 +55,28 @@ replica death:
   (``ptpu_router_{recoveries,hedges,hedge_wins,cancels}_total``) and
   each recovery burst dumps a flight-recorder artifact naming the
   migrated request ids.
+* **Streaming-first QoS front** (ISSUE 16): ``"stream": true`` on a
+  journaled ``/generate`` relays incremental NDJSON token blocks to
+  the CLIENT straight from the journal feed — the journal IS the
+  stream, so a replica kill, a hedge win, or a rolling restart is an
+  invisible mid-stream failover (the relay's read frontier + the
+  journal's position-verified extends guarantee zero lost and zero
+  duplicated tokens); a client that disconnects mid-stream propagates
+  to real cancellation (engine slot retired, KV pages freed) on
+  whichever replica currently owns the request. Admission stalls — no
+  FIRST token past the live TTFT-histogram-derived budget — hedge
+  onto a second replica under the same tier-wide hedge budget decode
+  stalls use, and ``_pick`` blends load with prefix-trie affinity
+  (replicas export chained-crc32 trie fingerprints via /healthz; the
+  prompt's own chain hashes score how many pages of its KV each
+  candidate already holds). Requests carry a tenant id + priority
+  class (``X-PTPU-Tenant`` / ``X-PTPU-Class`` headers or ``tenant`` /
+  ``qos_class`` body fields); admission runs through a weighted-fair
+  scheduler — strict priority across classes, weighted round-robin by
+  journal-accounted token charge inside one, starvation-aged — and
+  overload degrades TRUTHFULLY per class: low classes shed first with
+  per-class 429s whose Retry-After derives from the observed queue
+  drain rate, never a blanket 503.
 
 Greedy tokens through the tier are engine-identical to a direct
 engine call: the router never touches payloads, and a retried request
@@ -84,6 +106,25 @@ Env knobs (documented in COMPONENTS.md "Serving tier"):
                                the journal bound (128; overflow falls
                                back to the single-shot forward path,
                                0 disables recovery entirely)
+  PADDLE_TPU_TIER_TTFT_HEDGE_S first-token hedge budget: seconds of
+                               admission silence (no first token)
+                               before a backup launches (0 disables;
+                               unset = derived live from the TTFT
+                               histogram p99)
+  PADDLE_TPU_TIER_TTFT_MULT    multiplier on the derived TTFT p99 (3)
+  PADDLE_TPU_TIER_AFFINITY_W   prefix-affinity weight blended into
+                               replica scoring — pages of cached
+                               prefix overlap each count this much
+                               load-equivalent (0.5; 0 = load-only)
+  PADDLE_TPU_TIER_QOS_CONCURRENCY admission capacity of the weighted-
+                               fair scheduler (unset = engine slots x
+                               max_replicas; 0 disables the gate)
+  PADDLE_TPU_TIER_QOS_QUEUE    per-class wait-queue base depth (8;
+                               cap = base x class weight, so low
+                               classes shed first under overload)
+  PADDLE_TPU_TIER_QOS_STARVATION_S age at which a waiter is served
+                               regardless of class (5 s) — the
+                               starvation-freedom bound
   PADDLE_TPU_EXEC_STORE_DIR    shared executable store (successors load)
 """
 from __future__ import annotations
@@ -107,15 +148,29 @@ from typing import Dict, List, Optional
 
 from .. import obs as _obs
 from ..distributed import resilience as _resil
+from .paging import chain_hashes
 from .serve import (REQUEST_ID_HEADER, RETRY_AFTER_S, _env_float,
                     handle_admin_trace, send_json, send_text)
 
 __all__ = ["ReplicaSpec", "Replica", "Router", "RespawnGovernor",
-           "main", "single_device_child_env"]
+           "main", "single_device_child_env", "QOS_CLASSES"]
 
 # tier-level 503 reasons extend the per-replica contract
 TIER_RETRY_AFTER_S = dict(RETRY_AFTER_S)
 TIER_RETRY_AFTER_S["no_replica_ready"] = 1.0
+
+# per-tenant QoS (ISSUE 16): class -> (strict priority, fair-share
+# weight). Priority orders classes absolutely (an interactive waiter
+# always beats a batch waiter, starvation aging aside); the weight
+# sets both the fair token share INSIDE a priority tier and the
+# class's wait-queue depth (base x weight) — so under overload the
+# batch queue fills and sheds first, interactive last.
+QOS_CLASSES = {"interactive": (0, 4.0),
+               "standard": (1, 2.0),
+               "batch": (2, 1.0)}
+QOS_DEFAULT = "standard"
+TENANT_HEADER = "X-PTPU-Tenant"
+CLASS_HEADER = "X-PTPU-Class"
 
 # what a dying replica can throw at a reader besides the URLError
 # family: a SIGKILL mid-response-write surfaces as IncompleteRead /
@@ -256,6 +311,9 @@ class Replica:
         self.health_fail_streak = 0  # consecutive failed health polls
         self.ejected_until = 0.0
         self.health: dict = {}
+        # chained-crc32 trie fingerprints from the last health poll —
+        # the prefix-affinity signal (empty = unknown / not paged)
+        self.prefix_fps: frozenset = frozenset()
         self.spawned_at = time.monotonic()
         self.last_health_at: Optional[float] = None  # last ANSWERED poll
         self.was_ready = False       # ever reached READY (not warming
@@ -441,7 +499,8 @@ class _ReqJournal:
     attempt, not the journal."""
 
     def __init__(self, prompt: List[int], max_new: int, eos, seed: int,
-                 rid: Optional[str], hist=None):
+                 rid: Optional[str], hist=None, ttft_cb=None,
+                 itl_cb=None):
         self.prompt = [int(t) for t in prompt]
         self.max_new = int(max_new)
         self.eos = None if eos is None else int(eos)
@@ -449,10 +508,13 @@ class _ReqJournal:
         self.rid = rid
         self.tokens: List[int] = []
         self.cond = threading.Condition()
-        self.last_progress = time.monotonic()
+        self.t0 = time.monotonic()          # submission (TTFT anchor)
+        self.last_progress = self.t0
         self.mismatched = False
         self.source: Optional[str] = None   # last replica to advance us
         self._hist = hist                   # inter-progress-gap histogram
+        self._ttft_cb = ttft_cb             # ms from submission to tok0
+        self._itl_cb = itl_cb               # per-class inter-token ms
 
     def extend(self, base: int, toks, source: str) -> bool:
         """Merge a token block whose first element is journal position
@@ -475,8 +537,16 @@ class _ReqJournal:
                     return False
             if len(self.tokens) > n0:
                 now = time.monotonic()
+                gap_ms = (now - self.last_progress) * 1e3
                 if self._hist is not None:
-                    self._hist.observe((now - self.last_progress) * 1e3)
+                    self._hist.observe(gap_ms)
+                if n0 == 0:
+                    # first token EVER for this request — TTFT, whoever
+                    # produced it (primary, TTFT hedge, or a recovery)
+                    if self._ttft_cb is not None:
+                        self._ttft_cb((now - self.t0) * 1e3)
+                elif self._itl_cb is not None:
+                    self._itl_cb(gap_ms)
                 self.last_progress = now
                 self.source = source
             self.cond.notify_all()
@@ -736,6 +806,279 @@ class _StreamAttempt(threading.Thread):
 
 
 # ---------------------------------------------------------------------------
+# Per-tenant QoS admission (ISSUE 16): weighted-fair scheduler
+# ---------------------------------------------------------------------------
+
+class _QosWaiter:
+    __slots__ = ("tenant", "qcls", "prio", "enq_at", "admitted")
+
+    def __init__(self, tenant: str, qcls: str, prio: int, enq_at: float):
+        self.tenant = tenant
+        self.qcls = qcls
+        self.prio = prio
+        self.enq_at = enq_at
+        self.admitted = False
+
+
+class _QosScheduler:
+    """Weighted-fair admission over the tier's serving capacity.
+
+    ``capacity`` requests run concurrently; everyone else waits in a
+    single ordered list and is dispatched strict-priority-first
+    (:data:`QOS_CLASSES`), weighted-fair inside one priority tier —
+    the tenant with the smallest weight-normalized token charge goes
+    next, FIFO within a tenant. Charges accrue at release from the
+    journal's own accounting (tokens actually generated), so a tenant
+    burning long generations yields to one sipping short ones even at
+    equal request rates. Starvation-freedom is explicit: any waiter
+    older than ``starvation_s`` is served next regardless of class.
+
+    Overload degrades truthfully per class: each class's wait queue is
+    bounded at ``queue_limit x weight`` (batch fills and sheds first),
+    and a shed's Retry-After derives from the OBSERVED drain rate —
+    requests ahead at this priority divided by the EWMA of recent
+    completions/second — never a made-up constant.
+
+    Standalone (no router reference, injectable clock) so fairness is
+    unit-testable without processes.
+    """
+
+    def __init__(self, capacity: int, queue_limit: int = 8,
+                 starvation_s: float = 5.0, clock=time.monotonic):
+        self.capacity = int(capacity)
+        self.queue_limit = int(queue_limit)
+        self.starvation_s = float(starvation_s)
+        self._clock = clock
+        self._cv = threading.Condition()
+        self._inflight = 0
+        self._waiting: List[_QosWaiter] = []     # enqueue order
+        self._charge: Dict[str, float] = {}      # weight-normalized
+        self._drain_ewma = 0.0                   # completions / second
+        self._last_done: Optional[float] = None
+        self.admitted_total = 0
+        self.shed_total = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.capacity > 0
+
+    @staticmethod
+    def class_of(qcls) -> str:
+        q = str(qcls or QOS_DEFAULT)
+        return q if q in QOS_CLASSES else QOS_DEFAULT
+
+    def try_acquire(self, tenant: str, qcls: str, timeout: float):
+        """Block until admitted or refused. Returns ``("admitted",
+        None)``, ``("shed", retry_after_s)`` (class queue full) or
+        ``("timeout", retry_after_s)`` (budget burned waiting)."""
+        if not self.enabled:
+            return "admitted", None
+        prio, weight = QOS_CLASSES[self.class_of(qcls)]
+        deadline = self._clock() + max(0.0, float(timeout))
+        with self._cv:
+            if self._inflight < self.capacity and not self._waiting:
+                self._admit_locked(tenant)
+                return "admitted", None
+            cap = max(1, int(self.queue_limit * weight))
+            if sum(1 for w in self._waiting if w.qcls == qcls) >= cap:
+                self.shed_total += 1
+                return "shed", self._retry_after_locked(prio)
+            w = _QosWaiter(tenant, self.class_of(qcls), prio,
+                           self._clock())
+            self._waiting.append(w)
+            while True:
+                if w.admitted:
+                    return "admitted", None
+                left = deadline - self._clock()
+                if left <= 0:
+                    self._waiting.remove(w)
+                    self.shed_total += 1
+                    return "timeout", self._retry_after_locked(prio)
+                self._cv.wait(timeout=min(left, 0.25))
+
+    def release(self, tenant: str, qcls: str, tokens: int = 0):
+        """One admitted request finished: charge its tenant the tokens
+        it actually generated (journal-accounted), fold the completion
+        into the drain-rate EWMA, dispatch the next waiter(s)."""
+        _, weight = QOS_CLASSES[self.class_of(qcls)]
+        with self._cv:
+            self._inflight = max(0, self._inflight - 1)
+            base = min(self._charge.values()) if self._charge else 0.0
+            cur = self._charge.get(tenant, base)
+            self._charge[tenant] = cur + max(0, int(tokens)) / weight
+            if len(self._charge) > 1024:
+                # bound the ledger: keep the busiest tenants, the rest
+                # re-enter at the floor (no fairness cliff)
+                top = sorted(self._charge.items(), key=lambda kv: -kv[1])
+                self._charge = dict(top[:512])
+            now = self._clock()
+            if self._last_done is not None:
+                inst = 1.0 / max(1e-3, now - self._last_done)
+                self._drain_ewma = (inst if self._drain_ewma <= 0
+                                    else 0.8 * self._drain_ewma
+                                    + 0.2 * inst)
+            self._last_done = now
+            self._dispatch_locked()
+
+    def _admit_locked(self, tenant: str):
+        self._inflight += 1
+        self.admitted_total += 1
+        # a tenant first seen now starts at the CURRENT floor, not 0 —
+        # otherwise arriving late would outrank every incumbent forever
+        if tenant not in self._charge and self._charge:
+            self._charge[tenant] = min(self._charge.values())
+
+    def _dispatch_locked(self):
+        while self._waiting and self._inflight < self.capacity:
+            w = self._pick_locked()
+            self._waiting.remove(w)
+            w.admitted = True
+            self._admit_locked(w.tenant)
+        self._cv.notify_all()
+
+    def _pick_locked(self) -> _QosWaiter:
+        now = self._clock()
+        aged = [w for w in self._waiting
+                if now - w.enq_at >= self.starvation_s]
+        if aged:
+            # starvation-freedom beats class policy: the oldest waiter
+            # goes, whatever its class
+            return min(aged, key=lambda w: w.enq_at)
+        top = min(w.prio for w in self._waiting)
+        return min((w for w in self._waiting if w.prio == top),
+                   key=lambda w: (self._charge.get(w.tenant, 0.0),
+                                  w.enq_at))
+
+    def _retry_after_locked(self, prio: int) -> float:
+        """Honest Retry-After: work that drains before a retry at this
+        priority could land (in-flight + same-or-higher-priority
+        waiters) over the observed drain rate. Cold start (no
+        completion observed yet) answers a conservative 1 s."""
+        ahead = self._inflight + sum(1 for w in self._waiting
+                                     if w.prio <= prio)
+        if self._drain_ewma <= 0:
+            return 1.0
+        return round(min(60.0, max(0.05, (ahead + 1)
+                                   / self._drain_ewma)), 3)
+
+    def snapshot(self) -> dict:
+        with self._cv:
+            by_cls: Dict[str, int] = {}
+            for w in self._waiting:
+                by_cls[w.qcls] = by_cls.get(w.qcls, 0) + 1
+            return {"capacity": self.capacity,
+                    "inflight": self._inflight,
+                    "waiting": len(self._waiting),
+                    "waiting_by_class": by_cls,
+                    "admitted_total": self.admitted_total,
+                    "shed_total": self.shed_total,
+                    "drain_per_s": round(self._drain_ewma, 3),
+                    "tenants_charged": len(self._charge)}
+
+
+# ---------------------------------------------------------------------------
+# Client-facing stream relay (ISSUE 16): the journal IS the stream
+# ---------------------------------------------------------------------------
+
+class _ClientRelay(threading.Thread):
+    """Streams one journaled request to the CLIENT as NDJSON — the
+    replica stream contract verbatim ({"t": [...]} blocks, one
+    terminal {"done": body} / {"err": record}, read-until-close), so
+    a tier client and a single-replica client parse identically.
+
+    The shared :class:`_ReqJournal` is the ONE token source. The relay
+    tails it from its own read frontier (``sent``) under the journal
+    condition, which is exactly what makes mid-stream failover
+    invisible: a replica kill, hedge win, or rolling restart swaps the
+    PRODUCER under the journal while position-verified extends refuse
+    conflicts and gaps — the relay can neither re-emit a position nor
+    skip one, so the client stream is zero-loss, zero-duplicate and
+    bitwise-identical to the undisturbed run by greedy determinism.
+
+    A write failing mid-stream means the client went away: ``dead``
+    flips, the journal cond wakes the coordinator, and the coordinator
+    cancels every live attempt — engine slot retired, KV pages freed
+    on whichever replica currently owns the request. The terminal line
+    is handed over by the coordinator via :meth:`finish` so error
+    bodies (deadline, backend-gone) reach a mid-stream client as a
+    truthful ``err`` record instead of a bare EOF."""
+
+    def __init__(self, handler, rid: Optional[str]):
+        super().__init__(daemon=True,
+                         name=f"tier-relay-{rid or 'anon'}")
+        self.handler = handler
+        self.rid = rid
+        self.started_http = False     # 200 + NDJSON head on the wire
+        self.dead = False             # client disconnected
+        self.sent = 0                 # relay frontier (tokens emitted)
+        self._st: Optional[_ReqJournal] = None
+        self._terminal = None         # ("done"|"err", body)
+        self._done = threading.Event()
+
+    def begin(self, st: _ReqJournal):
+        """Arm on the journal and start streaming. Called by the
+        coordinator once the request is committed to the journaled
+        path (first attempt launched) — every earlier failure stays a
+        plain JSON response."""
+        self._st = st
+        self.start()
+
+    def finish(self, kind: str, body: dict):
+        """Coordinator hands over the terminal line; blocks (bounded)
+        until the relay has flushed trailing tokens + terminal."""
+        st = self._st
+        if st is None:
+            return
+        with st.cond:
+            self._terminal = (kind, dict(body))
+            st.cond.notify_all()
+        self._done.wait(timeout=10.0)
+
+    def run(self):
+        st, h = self._st, self.handler
+        t0 = time.perf_counter()
+        try:
+            h.send_response(200)
+            h.send_header("Content-Type", "application/x-ndjson")
+            h.send_header("Connection", "close")
+            h.end_headers()
+            h.close_connection = True
+            self.started_http = True
+            while True:
+                with st.cond:
+                    while (len(st.tokens) <= self.sent
+                           and self._terminal is None):
+                        st.cond.wait(timeout=0.25)
+                    toks = list(st.tokens[self.sent:])
+                    term = self._terminal
+                if toks:
+                    self._write({"t": toks})
+                    self.sent += len(toks)
+                    continue      # terminal never jumps the token queue
+                if term is not None:
+                    kind, body = term
+                    self._write({kind: body})
+                    return
+        except (BrokenPipeError, ConnectionError, OSError):
+            self.dead = True
+            if st is not None:
+                with st.cond:
+                    st.cond.notify_all()   # wake the coordinator NOW
+        finally:
+            if _obs.enabled():
+                now = time.perf_counter()
+                _obs.record_span("router.stream_relay", t0, now,
+                                 cat="router", request_id=self.rid,
+                                 tokens=self.sent,
+                                 disconnected=self.dead)
+            self._done.set()
+
+    def _write(self, obj):
+        self.handler.wfile.write((json.dumps(obj) + "\n").encode())
+        self.handler.wfile.flush()
+
+
+# ---------------------------------------------------------------------------
 # Router
 # ---------------------------------------------------------------------------
 
@@ -775,7 +1118,13 @@ class Router:
                  hedge_s: Optional[float] = None,
                  hedge_mult: Optional[float] = None,
                  hedge_frac: Optional[float] = None,
-                 journal_max: Optional[int] = None):
+                 journal_max: Optional[int] = None,
+                 ttft_hedge_s: Optional[float] = None,
+                 ttft_hedge_mult: Optional[float] = None,
+                 affinity_w: Optional[float] = None,
+                 qos_concurrency: Optional[int] = None,
+                 qos_queue_limit: Optional[int] = None,
+                 qos_starvation_s: Optional[float] = None):
         if replicas < 1:
             raise ValueError("need at least one replica")
         self.spec = spec
@@ -853,6 +1202,34 @@ class Router:
         self._journaled = 0          # live journals (bounded)
         self._recovered_rids: List[dict] = []   # since last flight dump
         self._last_recovery_dump = 0.0
+        # streaming-first QoS front (ISSUE 16)
+        self.ttft_hedge_s = (
+            float(ttft_hedge_s) if ttft_hedge_s is not None
+            else _env_float("PADDLE_TPU_TIER_TTFT_HEDGE_S", -1.0))
+        self.ttft_hedge_mult = (
+            float(ttft_hedge_mult) if ttft_hedge_mult is not None
+            else _env_float("PADDLE_TPU_TIER_TTFT_MULT", 3.0))
+        self.affinity_w = (
+            float(affinity_w) if affinity_w is not None
+            else _env_float("PADDLE_TPU_TIER_AFFINITY_W", 0.5))
+        qos_cap = (int(qos_concurrency) if qos_concurrency is not None
+                   else int(_env_float(
+                       "PADDLE_TPU_TIER_QOS_CONCURRENCY", -1)))
+        if qos_cap < 0:
+            # derived default: what the tier can actually decode at
+            # once — engine slots per replica times the replica ceiling
+            qos_cap = max(4, int(self.spec.engine.get("slots", 8))
+                          * self.max_replicas)
+        self.qos = _QosScheduler(
+            capacity=qos_cap,
+            queue_limit=(int(qos_queue_limit)
+                         if qos_queue_limit is not None
+                         else int(_env_float("PADDLE_TPU_TIER_QOS_QUEUE",
+                                             8))),
+            starvation_s=(float(qos_starvation_s)
+                          if qos_starvation_s is not None
+                          else _env_float(
+                              "PADDLE_TPU_TIER_QOS_STARVATION_S", 5.0)))
         self.exec_store_dir = (exec_store_dir
                                or os.environ.get("PADDLE_TPU_EXEC_STORE_DIR"))
 
@@ -890,6 +1267,9 @@ class Router:
             "recoveries": 0, "hedges": 0, "hedge_wins": 0,
             "cancels_sent": 0, "resume_fallbacks": 0,
             "recovery_mismatches": 0,
+            # streaming-first QoS front (ISSUE 16)
+            "streams": 0, "client_disconnects": 0,
+            "ttft_hedges": 0, "qos_admitted": 0, "qos_shed": 0,
         }
         # observability (paddle_tpu.obs): the stats above keep their
         # dict face (/healthz, tests); the registry carries the
@@ -940,6 +1320,51 @@ class Router:
                 "journaled requests",
                 buckets=(1, 2, 5, 10, 25, 50, 100, 250, 500, 1000,
                          2500, 5000, 10000))
+            # streaming-first QoS front (ISSUE 16). The unlabeled TTFT
+            # histogram feeds the TTFT hedge budget (snap() on a
+            # labeled family needs exact labels — budget derivation
+            # must stay label-free); the ptpu_tier_* families are the
+            # per-class client-facing view, named in tier space
+            # directly since render_tier passes router-own series
+            # through verbatim (replica aggregates land under
+            # different names).
+            _lat_buckets = (1, 2, 5, 10, 25, 50, 100, 250, 500, 1000,
+                            2500, 5000, 10000, 30000)
+            self._m_ttft = reg.histogram(
+                "ptpu_router_ttft_ms",
+                "submission-to-first-token latency of journaled "
+                "requests (the TTFT hedge budget derives from its "
+                "p99)", buckets=_lat_buckets)
+            self._m_ttft_class = reg.histogram(
+                "ptpu_tier_ttft_ms",
+                "per-QoS-class submission-to-first-token latency",
+                labels=("qos_class",), max_series=8,
+                buckets=_lat_buckets)
+            self._m_itl_class = reg.histogram(
+                "ptpu_tier_itl_ms",
+                "per-QoS-class inter-token latency (journal progress "
+                "gaps past the first token)",
+                labels=("qos_class",), max_series=8,
+                buckets=_lat_buckets)
+            self._m_qos_admitted = reg.counter(
+                "ptpu_tier_qos_admitted_total",
+                "requests admitted by the weighted-fair scheduler",
+                labels=("qos_class",), max_series=8)
+            self._m_qos_shed = reg.counter(
+                "ptpu_tier_qos_shed_total",
+                "requests shed (429) or queue-timed-out by the "
+                "weighted-fair scheduler",
+                labels=("qos_class",), max_series=8)
+            self._m_streams = reg.counter(
+                "ptpu_router_streams_total",
+                "client-facing NDJSON stream relays started")
+            self._m_disconnects = reg.counter(
+                "ptpu_router_client_disconnects_total",
+                "mid-stream client disconnects propagated to "
+                "cancellation")
+            self._m_ttft_hedges = reg.counter(
+                "ptpu_router_ttft_hedges_total",
+                "backups launched for admission (first-token) stalls")
 
         self.httpd = ThreadingHTTPServer((host, port),
                                          self._make_handler())
@@ -1155,6 +1580,13 @@ class Router:
                                         ) as r:
                 body = json.loads(r.read())
             rep.health = body
+            try:
+                fps = (body.get("engine") or {}).get(
+                    "prefix_fingerprints")
+                rep.prefix_fps = (frozenset(int(h) for h in fps)
+                                  if fps else frozenset())
+            except (TypeError, ValueError):
+                rep.prefix_fps = frozenset()
             rep.health_fail_streak = 0
             rep.last_health_at = time.monotonic()
             rep.state = "ready"
@@ -1393,14 +1825,54 @@ class Router:
                 "ok": not failed}
 
     # -- forwarding ------------------------------------------------------
-    def _pick(self, exclude: set) -> Optional[Replica]:
+    def _tier_page_size(self) -> int:
+        """The paged engines' page size, read from live health (0 when
+        the tier is not paged / not yet polled) — what the router
+        hashes incoming prompts with for affinity scoring."""
+        with self._lock:
+            for r in self._replicas:
+                eng = r.health.get("engine", {}) if r.health else {}
+                if eng.get("paged") and eng.get("page_size"):
+                    try:
+                        return int(eng["page_size"])
+                    except (TypeError, ValueError):
+                        return 0
+        return 0
+
+    def _pick(self, exclude: set,
+              prompt_hashes: Optional[List[int]] = None
+              ) -> Optional[Replica]:
         now = time.monotonic()
         with self._lock:
             cands = [r for r in self._replicas
                      if r.name not in exclude and r.routable(now)]
             if not cands:
                 return None
-            return min(cands, key=Replica.load_score)
+            if not prompt_hashes or self.affinity_w <= 0:
+                return min(cands, key=Replica.load_score)
+
+            # prefix-affinity blend (ISSUE 16): score = load minus
+            # affinity_w per page of cached prefix overlap — a replica
+            # already holding the prompt's KV wins ties (and modest
+            # load gaps) because routing there turns the prefill into
+            # trie hits; load still dominates when the gap is real, so
+            # affinity can never pile every shared-prefix client onto
+            # one drowning replica. Overlap is the longest chain-hash
+            # prefix present in the replica's fingerprint set (chains
+            # fold parents in, so membership of hash j implies the
+            # whole j-page prefix is cached).
+            def score(r: Replica):
+                overlap = 0
+                if r.prefix_fps:
+                    for h in prompt_hashes:
+                        if h not in r.prefix_fps:
+                            break
+                        overlap += 1
+                eng = r.health.get("engine", {}) if r.health else {}
+                load = r.inflight + 0.5 * (int(eng.get("queued", 0))
+                                           + int(eng.get("active", 0)))
+                return (load - self.affinity_w * overlap, r.name)
+            return min(cands, key=score)
 
     def _note_failure(self, rep: Replica):
         rep.failure_streak += 1
@@ -1416,7 +1888,10 @@ class Router:
 
     def forward_generate(self, payload: bytes,
                          deadline_s: Optional[float] = None,
-                         request_id: Optional[str] = None):
+                         request_id: Optional[str] = None,
+                         tenant: Optional[str] = None,
+                         qos_class: Optional[str] = None,
+                         relay: Optional[_ClientRelay] = None):
         """Forward one /generate body. Returns ``(code, body_dict,
         retry_after_or_None)`` — every outcome is a clean JSON
         response, never an exception to the HTTP handler.
@@ -1428,7 +1903,16 @@ class Router:
         Token-shaped payloads take the JOURNALED path (streamed
         forward + work-conserving failover + hedged decode, module
         docstring); opaque ones — and overflow past the journal bound
-        — fall back to the single-shot forward."""
+        — fall back to the single-shot forward.
+
+        Every request passes the weighted-fair QoS gate first (tenant
+        + class from the caller or the body; queue wait burns the
+        request's own deadline, so admission latency is never hidden).
+        With ``relay`` set ("stream": true clients) the journaled path
+        streams the journal feed to the client as NDJSON; when the
+        payload cannot be journaled the stream request is REFUSED
+        up-front (400 / 503) rather than breaking the protocol with a
+        single-shot JSON body."""
         deadline_s = (self.deadline_s if deadline_s is None
                       else float(deadline_s))
         t0 = time.monotonic()
@@ -1440,8 +1924,62 @@ class Router:
             parsed = json.loads(payload or b"{}")
         except ValueError:
             parsed = None
-        if (self.recovery and self.journal_max > 0
-                and isinstance(parsed, dict) and "input_ids" in parsed):
+        if isinstance(parsed, dict):
+            tenant = parsed.get("tenant") or tenant
+            qos_class = parsed.get("qos_class") or qos_class
+        tenant = str(tenant or "anon")
+        qcls = _QosScheduler.class_of(qos_class)
+
+        # -- weighted-fair admission (ISSUE 16) ------------------------
+        in_qos = False
+        if self.qos.enabled:
+            state, ra = self.qos.try_acquire(tenant, qcls,
+                                             timeout=deadline_s)
+            if state != "admitted":
+                self.stats_counters["qos_shed"] += 1
+                if self._obs:
+                    self._m_qos_shed.inc(**{"qos_class": qcls})
+                if state == "timeout":
+                    # the whole deadline burned waiting in queue: the
+                    # 503 face the deadline contract already promises,
+                    # with the drain-truthful hint attached
+                    self.stats_counters["deadline_503"] += 1
+                    return (503, {"error": "deadline_exceeded",
+                                  "deadline_s": deadline_s,
+                                  "qos_class": qcls,
+                                  "tenant": tenant}, ra)
+                return (429, {"error": "qos_shed",
+                              "qos_class": qcls, "tenant": tenant}, ra)
+            in_qos = True
+            self.stats_counters["qos_admitted"] += 1
+            if self._obs:
+                self._m_qos_admitted.inc(**{"qos_class": qcls})
+
+        result = None
+        try:
+            result = self._dispatch_generate(
+                payload, parsed, deadline_s, request_id, t0, qcls,
+                relay)
+            return result
+        finally:
+            if in_qos:
+                toks = 0
+                if result is not None and isinstance(result[1], dict):
+                    try:
+                        toks = int(result[1].get("tokens_generated", 0))
+                    except (TypeError, ValueError):
+                        toks = 0
+                self.qos.release(tenant, qcls, toks)
+
+    def _dispatch_generate(self, payload: bytes, parsed, deadline_s,
+                           request_id, t0, qcls: str,
+                           relay: Optional[_ClientRelay]):
+        """Route one admitted request: journaled (streaming/recovering)
+        path when the payload is token-shaped and the journal has
+        room, single-shot otherwise."""
+        journal_on = self.recovery and self.journal_max > 0
+        if (journal_on and isinstance(parsed, dict)
+                and "input_ids" in parsed):
             prompt = _flatten_ids(parsed.get("input_ids"))
             ok = prompt is not None
             if ok:
@@ -1461,14 +1999,32 @@ class Router:
                     try:
                         return self._forward_recovering(
                             prompt, max_new, eos, seed, deadline_s,
-                            request_id, t0)
+                            request_id, t0, qcls=qcls, relay=relay)
                     finally:
                         with self._lock:
                             self._journaled -= 1
+                if relay is not None:
+                    # the journal IS the client stream — at capacity
+                    # the stream request sheds truthfully instead of
+                    # degrading to a protocol-breaking JSON body
+                    self.stats_counters["relayed_503"] += 1
+                    return (503, {"error": "overloaded",
+                                  "reason": "journal at capacity"},
+                            TIER_RETRY_AFTER_S["overloaded"])
+        if relay is not None:
+            # stream requested but unservable: not token-shaped, or
+            # journaling is off — refuse up-front, before any NDJSON
+            # head could be written
+            if not journal_on:
+                return (503, {"error": "stream_unavailable",
+                              "reason": "journaling disabled on this "
+                                        "tier"},
+                        TIER_RETRY_AFTER_S["overloaded"])
+            return (400, {"error": "stream_requires_token_ids"}, None)
         if isinstance(parsed, dict) and parsed.get("stream"):
-            # the tier front is non-streaming to clients; never let a
-            # leaked stream flag make a replica answer the single-shot
-            # path with NDJSON it cannot parse
+            # the single-shot fallback is non-streaming to replicas;
+            # never let a leaked stream flag make a replica answer the
+            # single-shot path with NDJSON it cannot parse
             parsed = {k: v for k, v in parsed.items() if k != "stream"}
             payload = json.dumps(parsed).encode()
         return self._forward_plain(payload, deadline_s, request_id, t0)
@@ -1625,6 +2181,28 @@ class Router:
                 return min(max(b, 0.25), hi)
         return min(2.0, hi)
 
+    def _ttft_budget(self) -> Optional[float]:
+        """Seconds of FIRST-token silence before an admission-stall
+        backup launches (ISSUE 16) — the decode-stall twin above only
+        watches requests that already produced a token, so a replica
+        wedging in prefill/queue used to stall the client until the
+        deadline. Same shape as the decode budget: an explicit
+        PADDLE_TPU_TIER_TTFT_HEDGE_S wins (0 disables), else
+        ttft_hedge_mult x the live TTFT histogram p99, clamped to
+        [0.25s, deadline/4]; a cold tier (sparse histogram) uses a
+        conservative 2s default."""
+        if self.ttft_hedge_s == 0:
+            return None
+        if self.ttft_hedge_s > 0:
+            return float(self.ttft_hedge_s)
+        hi = max(0.5, self.deadline_s / 4.0)
+        if self._obs:
+            snap = self._m_ttft.snap()
+            if snap.count >= 32:
+                b = snap.percentile(0.99) / 1e3 * self.ttft_hedge_mult
+                return min(max(b, 0.25), hi)
+        return min(2.0, hi)
+
     def _reserve_hedge(self) -> bool:
         """Atomically claim one slot of the tier-wide hedge budget:
         at most ``hedge_frac`` of the live journaled requests (floor
@@ -1674,7 +2252,9 @@ class Router:
 
     def _forward_recovering(self, prompt: List[int], max_new: int,
                             eos, seed: int, deadline_s: float,
-                            rid: Optional[str], t0: float):
+                            rid: Optional[str], t0: float,
+                            qcls: str = QOS_DEFAULT,
+                            relay: Optional[_ClientRelay] = None):
         """The per-request recovery state machine (module docstring).
 
         One primary :class:`_StreamAttempt` streams the request; the
@@ -1697,10 +2277,47 @@ class Router:
         * token progress STALLED past the hedge budget -> launch a
           backup on a second replica; first to advance wins, the loser
           is cancelled (engine slot + pages reclaimed) and a winning
-          hedge books a breaker strike against the straggler.
+          hedge books a breaker strike against the straggler. Before
+          the FIRST token the stall clock runs against the TTFT budget
+          instead (``_ttft_budget``) — admission stalls hedge too;
+        * with a client ``relay`` armed, every terminal outcome is
+          handed to the relay as the stream's terminal line, and a
+          relay reporting the client gone cancels all live attempts.
         """
+        ttft_cb = itl_cb = None
+        if self._obs:
+            def ttft_cb(ms, _c=qcls):
+                self._m_ttft.observe(ms)
+                self._m_ttft_class.observe(ms, **{"qos_class": _c})
+
+            def itl_cb(ms, _c=qcls):
+                self._m_itl_class.observe(ms, **{"qos_class": _c})
         st = _ReqJournal(prompt, max_new, eos, seed, rid,
-                         hist=(self._m_progress if self._obs else None))
+                         hist=(self._m_progress if self._obs else None),
+                         ttft_cb=ttft_cb, itl_cb=itl_cb)
+        # prefix-affinity: the prompt's chain hashes, computed once —
+        # launch() re-hashes prompt+journal on a resume so cutover
+        # lands on the replica whose trie the resumed prefill will
+        # warm/hit
+        _ps = self._tier_page_size() if self.affinity_w > 0 else 0
+        prompt_hashes = chain_hashes(prompt, _ps) if _ps else None
+
+        def respond(code, body, ra=None):
+            """Every terminal outcome funnels here: with a client
+            relay armed, the body becomes the stream's terminal line
+            (200 -> done, anything else -> a truthful err record with
+            the code + retry hint inlined, since a mid-stream client
+            can no longer see HTTP status)."""
+            if relay is not None and relay.started_http:
+                if code == 200:
+                    relay.finish("done", body)
+                else:
+                    err = dict(body)
+                    err["code"] = code
+                    if ra is not None:
+                        err.setdefault("retry_after_s", ra)
+                    relay.finish("err", err)
+            return code, body, ra
         deadline_at = t0 + deadline_s
         attempts: List[_StreamAttempt] = []
         tried: set = set()
@@ -1752,13 +2369,26 @@ class Router:
                 hedges_launched
             live_names = {a.rep.name for a in attempts
                           if a.status == "running"}
-            rep = self._pick(tried | live_names)
+            keys = prompt_hashes
+            if _ps and st.size() > 0:
+                # resuming mid-flight: score by prompt + journaled
+                # prefix — the residual prefill warms (or already
+                # hits) exactly those pages on the target, so the
+                # cutover lands where the work is cheapest
+                with st.cond:
+                    cur = list(st.tokens)
+                keys = chain_hashes(prompt + cur, _ps)
+            # keys=None keeps the legacy one-arg call shape (tests
+            # stub _pick with single-parameter callables)
+            rep = (self._pick(tried | live_names, keys) if keys
+                   else self._pick(tried | live_names))
             if rep is None and tried:
                 # every replica was tried once: a retry may still land
                 # (a shed clears, an ejection lapses) — reopen the
                 # field, same policy as the single-shot path
                 tried.clear()
-                rep = self._pick(set(live_names))
+                rep = (self._pick(set(live_names), keys) if keys
+                       else self._pick(set(live_names)))
             if rep is None:
                 return None
             base = 0 if force_full else st.size()
@@ -1798,6 +2428,8 @@ class Router:
             return a
 
         if launch() is None:
+            # pre-stream failure: the relay never began, so the client
+            # gets a plain JSON 503 (no NDJSON head on the wire yet)
             self.stats_counters["tier_unavailable_503"] += 1
             with self._lock:
                 n = len(self._replicas)
@@ -1806,18 +2438,37 @@ class Router:
                      "ready": self.ready_count()},
                     TIER_RETRY_AFTER_S["no_replica_ready"]
                     + self.poll_s)
+        if relay is not None:
+            # committed to the journaled path: from here on the
+            # journal feed IS the client's response stream
+            self.stats_counters["streams"] += 1
+            if self._obs:
+                self._m_streams.inc()
+            relay.begin(st)
 
         while True:
             now = time.monotonic()
+            if relay is not None and relay.dead:
+                # the client hung up mid-stream: cancel EVERY live
+                # attempt on whichever replica owns the request now —
+                # slot retired, pages freed — and account the tokens
+                # the journal actually produced
+                cancel_all(wait=False)
+                self.stats_counters["client_disconnects"] += 1
+                if self._obs:
+                    self._m_disconnects.inc()
+                return 499, {"error": "client_disconnected",
+                             "tokens_generated": st.size()}, None
             if now >= deadline_at:
                 # wait=False on every response-returning path: a
                 # half-dead loser's /cancel round trip (2s timeout
                 # each) must never delay the client's answer
                 cancel_all(wait=False)
                 self.stats_counters["deadline_503"] += 1
-                return (503, {"error": "deadline_exceeded",
-                              "deadline_s": deadline_s},
-                        TIER_RETRY_AFTER_S["deadline_exceeded"])
+                return respond(
+                    503, {"error": "deadline_exceeded",
+                          "deadline_s": deadline_s},
+                    TIER_RETRY_AFTER_S["deadline_exceeded"])
             winner = next((a for a in attempts if a.status == "done"),
                           None)
             if winner is not None:
@@ -1853,7 +2504,7 @@ class Router:
                     body["recovered"] = recovered
                 if winner.is_hedge:
                     body["hedged"] = True
-                return 200, body, None
+                return respond(200, body)
             live = [a for a in attempts if a.status == "running"]
             if st.complete():
                 # the journal alone already holds the full output.
@@ -1871,7 +2522,7 @@ class Router:
                     body = st.synthesize_body()
                     if recovered:
                         body["recovered"] = recovered
-                    return 200, body, None
+                    return respond(200, body)
             else:
                 complete_since = None
             relaunch = False
@@ -1896,7 +2547,7 @@ class Router:
                     cancel_all(wait=False)
                     body = dict(a.body or {"error": "client error"})
                     body["served_by"] = a.rep.name
-                    return a.code, body, None
+                    return respond(a.code, body)
                 if a.kind == "mismatch":
                     # determinism violated against the journal (e.g. a
                     # hedge pair diverging, or a resumed base on an
@@ -1928,15 +2579,17 @@ class Router:
                         self.stats_counters["relayed_503"] += 1
                         body = dict(last_shed.body or {})
                         body["served_by"] = last_shed.rep.name
-                        return (503, body,
-                                last_shed.retry_after
-                                if last_shed.retry_after is not None
-                                else TIER_RETRY_AFTER_S["overloaded"])
+                        return respond(
+                            503, body,
+                            last_shed.retry_after
+                            if last_shed.retry_after is not None
+                            else TIER_RETRY_AFTER_S["overloaded"])
                     self.stats_counters["backend_503"] += 1
-                    return (503,
-                            {"error":
-                             f"backend_unavailable: {last_fail}"},
-                            TIER_RETRY_AFTER_S["backend_unavailable"])
+                    return respond(
+                        503,
+                        {"error":
+                         f"backend_unavailable: {last_fail}"},
+                        TIER_RETRY_AFTER_S["backend_unavailable"])
                 if relaunch and st.size() <= len_at_launch:
                     # no progress since the last launch: back off on
                     # the shared schedule — honoring the replica's own
@@ -1966,12 +2619,13 @@ class Router:
                     self.stats_counters["tier_unavailable_503"] += 1
                     with self._lock:
                         n = len(self._replicas)
-                    return (503,
-                            {"error": "no_replica_ready",
-                             "replicas": n,
-                             "ready": self.ready_count()},
-                            TIER_RETRY_AFTER_S["no_replica_ready"]
-                            + self.poll_s)
+                    return respond(
+                        503,
+                        {"error": "no_replica_ready",
+                         "replicas": n,
+                         "ready": self.ready_count()},
+                        TIER_RETRY_AFTER_S["no_replica_ready"]
+                        + self.poll_s)
                 else:
                     # journaled work exists: WAIT for a replica (a
                     # respawn is usually poll_s away) instead of
@@ -1983,8 +2637,14 @@ class Router:
                                        deadline_at - time.monotonic())))
                 continue
             # live attempts exist: watch for stalls, then wait for
-            # journal/attempt events
-            hb = self._hedge_budget()
+            # journal/attempt events. Before the FIRST token the
+            # silence clock runs against the TTFT budget (admission
+            # stalls — wedged prefill, stuck queue); after it, the
+            # decode-progress budget. Both draw on the ONE tier-wide
+            # hedge reservation.
+            first_token_pending = st.size() == 0
+            hb = (self._ttft_budget() if first_token_pending
+                  else self._hedge_budget())
             with st.cond:
                 silent = now - st.last_progress
             if (hb is not None and len(live) == 1
@@ -1995,6 +2655,10 @@ class Router:
                     # no second replica yet: hand the budget slot back
                     # and re-check on the next wake
                     self._release_hedge()
+                elif first_token_pending:
+                    self.stats_counters["ttft_hedges"] += 1
+                    if self._obs:
+                        self._m_ttft_hedges.inc()
             with st.cond:
                 timeout = 0.25
                 if hb is not None and len(live) == 1:
@@ -2028,6 +2692,7 @@ class Router:
                 "active_total": sum(r["active"] for r in reps),
                 "inflight_total": sum(r["inflight"] for r in reps),
                 "replicas": reps,
+                "qos": self.qos.snapshot(),
                 "stats": dict(self.stats_counters)}
         if not ready:
             body["reason"] = "no replica ready"
@@ -2120,11 +2785,28 @@ class Router:
                     # client can resolve its own phase spans later
                     rid = self.headers.get(REQUEST_ID_HEADER) or (
                         uuid.uuid4().hex[:16] if router._obs else None)
+                    relay = None
+                    if b'"stream"' in payload:
+                        try:
+                            want = bool(json.loads(
+                                payload or b"{}").get("stream"))
+                        except (ValueError, AttributeError):
+                            want = False
+                        if want:
+                            relay = _ClientRelay(self, rid)
                     code, body, ra = router.forward_generate(
-                        payload, request_id=rid)
+                        payload, request_id=rid,
+                        tenant=self.headers.get(TENANT_HEADER),
+                        qos_class=self.headers.get(CLASS_HEADER),
+                        relay=relay)
+                    if relay is not None and relay.started_http:
+                        return    # the relay already answered NDJSON
                     if rid and isinstance(body, dict):
                         body.setdefault("request_id", rid)
-                    self._send(code, body, retry_after=ra)
+                    try:
+                        self._send(code, body, retry_after=ra)
+                    except (BrokenPipeError, ConnectionError, OSError):
+                        pass      # client gone before the JSON answer
                 elif self.path == "/admin/rolling_restart":
                     # answer 409 from the HANDLER: Thread.start() never
                     # raises the in-progress error, the restart itself

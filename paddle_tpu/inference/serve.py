@@ -354,7 +354,11 @@ class PredictorServer:
                     k: st[k] for k in
                     ("paged", "page_size", "pages_total", "pages_free",
                      "pages_used", "page_utilization", "prefix_hits",
-                     "prefix_misses", "prefix_hit_rate")})
+                     "prefix_misses", "prefix_hit_rate",
+                     # chained-crc32 trie node ids — the router's
+                     # prefix-affinity routing intersects a prompt's
+                     # own chain hashes with this set (ISSUE 16)
+                     "prefix_fingerprints") if k in st})
             if st.get("speculative"):
                 # speculative decoding health: acceptance rate and
                 # accepted-tokens-per-tick are the knobs an operator

@@ -323,3 +323,39 @@ class TestPassPipeline:
         import pytest as _pytest
         with _pytest.raises(TypeError, match="new_step_plan"):
             dist.passes.new_pass("auto_parallel_recompute").apply(["prog"])
+
+    def test_amp_o2_keeps_norm_fp32_and_engages_master_weights(self):
+        """ISSUE 16 satellite: the O2 amp pass must NOT blanket-cast —
+        normalization params/stats stay fp32 (a bf16 running-variance
+        drifts) while compute params go bfloat16, and the optimizer's
+        multi_precision master-weight path engages so updates
+        accumulate in fp32 slots."""
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.auto_parallel import (Engine,
+                                                          ProcessMesh)
+
+        pm_mesh = ProcessMesh(list(range(8)), dim_names=["dp"])
+        paddle.seed(5)
+        model = nn.Sequential(nn.Linear(16, 16), nn.LayerNorm(16),
+                              nn.GELU(), nn.Linear(16, 16))
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        assert not opt._multi_precision
+        engine = Engine(model=model, loss=nn.MSELoss(), optimizer=opt,
+                        process_mesh=pm_mesh)
+        dist.passes.PassManager([
+            dist.passes.new_pass("auto_parallel_amp", {"level": "O2"}),
+        ]).apply(engine)
+        engine.prepare(mode="train")
+        # compute params cast, norm params untouched
+        assert str(model[0].weight.value.dtype) == "bfloat16"
+        assert str(model[3].weight.value.dtype) == "bfloat16"
+        assert str(model[1].weight.value.dtype) == "float32"
+        assert str(model[1].bias.value.dtype) == "float32"
+        # master weights: the multi_precision path is armed
+        assert opt._multi_precision
+        # and the step still trains in bf16 without NaNs
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(8, 16).astype("float32"))
+        loss = engine._train_step(x, x)
+        assert np.isfinite(float(loss))

@@ -122,6 +122,34 @@ def test_pages_needed():
     assert pages_needed(17, 16) == 2
 
 
+def test_trie_fingerprints_match_prompt_chain_hashes():
+    """ISSUE 16: the trie's crc32-chained fingerprints and a prompt's
+    chain_hashes agree EXACTLY on cached prefixes — the cross-process
+    identity the router's affinity _pick intersects (Python hash() is
+    per-process salted; crc32 is not)."""
+    from paddle_tpu.inference.paging import chain_hashes
+    a = PageAllocator(8)
+    t = PrefixTrie(a)
+    prompt = [1, 2, 3, 4, 5, 6, 7, 8]
+    keys = [tuple(prompt[0:4]), tuple(prompt[4:8])]
+    pages = a.alloc(2)
+    t.insert(keys, pages)
+    fps = set(t.fingerprints())
+    assert len(fps) == 2
+    assert set(chain_hashes(prompt, 4)) <= fps
+    # incomplete tail pages never hash; degenerate page sizes are safe
+    assert chain_hashes(prompt[:7], 4) == chain_hashes(prompt, 4)[:1]
+    assert chain_hashes([], 4) == []
+    assert chain_hashes(prompt, 0) == []
+    # a DIFFERENT second page forks the chain: shared first hash,
+    # distinct second (parent folds in, so position matters)
+    other = chain_hashes([1, 2, 3, 4, 9, 9, 9, 9], 4)
+    mine = chain_hashes(prompt, 4)
+    assert other[0] == mine[0] and other[1] != mine[1]
+    # the walk is bounded: limit caps the exported set
+    assert len(t.fingerprints(limit=1)) == 1
+
+
 # ---------------------------------------------------------------------------
 # engine level
 # ---------------------------------------------------------------------------

@@ -8,6 +8,7 @@ against fake replicas — the live tests cover the chaos paths: injected
 forward faults, kill -9 mid-traffic, and the store-warm rolling
 restart (ZERO successor compiles, counter-asserted via /healthz).
 """
+import io
 import json
 import os
 import signal
@@ -643,3 +644,438 @@ def test_sampling_tier_never_seeds_a_resume(bare_router, monkeypatch):
         r.spec.engine.pop("do_sample", None)
     assert code == 200, body
     assert body["tokens"] == prompt + full
+
+
+# ---------------------------------------------------------------------------
+# streaming-first QoS front (ISSUE 16): weighted-fair admission with
+# truthful per-class degradation, the client NDJSON relay over the
+# journal, TTFT hedging, and prefix-affinity _pick
+# ---------------------------------------------------------------------------
+
+def test_qos_dispatch_strict_priority_then_class_order():
+    """Strict-priority dispatch: with the tier saturated, queued
+    waiters drain interactive -> standard -> batch regardless of
+    arrival order."""
+    from paddle_tpu.inference.router import _QosScheduler
+    s = _QosScheduler(capacity=1, queue_limit=8, starvation_s=60.0)
+    assert s.try_acquire("seed", "standard", 5.0) == ("admitted", None)
+    order, threads = [], []
+
+    def client(tenant, qcls):
+        state, _ = s.try_acquire(tenant, qcls, 30.0)
+        assert state == "admitted"
+        order.append(tenant)
+        s.release(tenant, qcls, tokens=0)
+
+    for tenant, qcls in [("tb", "batch"), ("ts", "standard"),
+                         ("ti", "interactive")]:
+        th = threading.Thread(target=client, args=(tenant, qcls))
+        th.start()
+        threads.append(th)
+        deadline = time.monotonic() + 5.0
+        while (s.snapshot()["waiting"] < len(threads)
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+    s.release("seed", "standard", tokens=0)      # cascade the queue
+    for th in threads:
+        th.join(timeout=10)
+    assert order == ["ti", "ts", "tb"]
+
+
+def test_qos_token_charge_prefers_the_lighter_tenant():
+    """Weighted-fair inside one class: the tenant that burned fewer
+    journal-accounted tokens dispatches first even when the heavy
+    tenant enqueued earlier (charge beats FIFO across tenants)."""
+    from paddle_tpu.inference.router import _QosScheduler
+    s = _QosScheduler(capacity=1, queue_limit=8, starvation_s=60.0)
+    # hog burned 1000 tokens at weight 2 -> charge 500
+    assert s.try_acquire("hog", "standard", 1.0)[0] == "admitted"
+    s.release("hog", "standard", tokens=1000)
+    assert s.try_acquire("seed", "standard", 1.0)[0] == "admitted"
+    order, threads = [], []
+
+    def client(tenant):
+        state, _ = s.try_acquire(tenant, "standard", 30.0)
+        assert state == "admitted"
+        order.append(tenant)
+        s.release(tenant, "standard", tokens=0)
+
+    for tenant in ["hog", "sipper"]:             # hog enqueues FIRST
+        th = threading.Thread(target=client, args=(tenant,))
+        th.start()
+        threads.append(th)
+        deadline = time.monotonic() + 5.0
+        while (s.snapshot()["waiting"] < len(threads)
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+    s.release("seed", "standard", tokens=0)
+    for th in threads:
+        th.join(timeout=10)
+    assert order == ["sipper", "hog"]
+
+
+def test_qos_starvation_aging_overrides_class_policy():
+    """A batch waiter older than starvation_s is served before a
+    fresher interactive one — no class is starvable forever."""
+    from paddle_tpu.inference.router import _QosScheduler
+    now = [0.0]
+    s = _QosScheduler(capacity=1, queue_limit=8, starvation_s=5.0,
+                      clock=lambda: now[0])
+    assert s.try_acquire("seed", "standard", 1.0)[0] == "admitted"
+    order, threads = [], []
+
+    def client(tenant, qcls):
+        state, _ = s.try_acquire(tenant, qcls, 9999.0)
+        assert state == "admitted"
+        order.append(tenant)
+        s.release(tenant, qcls, tokens=0)
+
+    th = threading.Thread(target=client, args=("old-batch", "batch"))
+    th.start()
+    threads.append(th)
+    deadline = time.monotonic() + 5.0
+    while s.snapshot()["waiting"] < 1 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    now[0] += 6.0                                # batch waiter ages out
+    th = threading.Thread(target=client, args=("fresh-i", "interactive"))
+    th.start()
+    threads.append(th)
+    deadline = time.monotonic() + 5.0
+    while s.snapshot()["waiting"] < 2 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    s.release("seed", "standard", tokens=0)
+    for th in threads:
+        th.join(timeout=10)
+    assert order == ["old-batch", "fresh-i"]
+
+
+def test_qos_retry_after_tracks_observed_drain_rate():
+    """Honest Retry-After: sheds answer (work ahead at this priority
+    + 1) / the drain-rate EWMA — per class, never a blanket constant
+    (a higher class sees LESS work ahead, so a smaller hint)."""
+    from paddle_tpu.inference.router import _QosScheduler
+    now = [0.0]
+    s = _QosScheduler(capacity=1, queue_limit=1, starvation_s=60.0,
+                      clock=lambda: now[0])
+    for _ in range(3):                           # teach a 2/s drain
+        assert s.try_acquire("t", "standard", 1.0)[0] == "admitted"
+        now[0] += 0.5
+        s.release("t", "standard", tokens=4)
+    assert s.snapshot()["drain_per_s"] == pytest.approx(2.0)
+    assert s.try_acquire("t", "standard", 1.0)[0] == "admitted"
+    done = threading.Event()
+
+    def blocked_batch():
+        s.try_acquire("b1", "batch", 9999.0)
+        s.release("b1", "batch", tokens=0)
+        done.set()
+
+    th = threading.Thread(target=blocked_batch)
+    th.start()
+    deadline = time.monotonic() + 5.0
+    while s.snapshot()["waiting"] < 1 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    # batch queue (cap queue_limit * weight = 1) is full: shed with
+    # ahead = 1 inflight + 1 same-priority waiter -> (2+1)/2 = 1.5s
+    state, ra = s.try_acquire("b2", "batch", 5.0)
+    assert state == "shed"
+    assert ra == pytest.approx(1.5)
+    # an interactive request queues (its class has room) and, burning
+    # a zero budget, times out with a SMALLER hint: only the inflight
+    # request is ahead of priority 0 -> (1+1)/2 = 1.0s
+    state, ra_i = s.try_acquire("i1", "interactive", 0.0)
+    assert state == "timeout"
+    assert ra_i == pytest.approx(1.0)
+    assert ra_i < ra
+    s.release("t", "standard", tokens=0)
+    assert done.wait(timeout=10)
+    th.join(timeout=10)
+
+
+def test_pick_prefix_affinity_blends_overlap_with_load(bare_router):
+    from paddle_tpu.inference.paging import chain_hashes
+    r = bare_router
+    prompt = list(range(12))
+    hashes = chain_hashes(prompt, 4)             # 3 complete pages
+    assert len(hashes) == 3
+    warm = _fake_replica("warm", inflight=1)
+    warm.prefix_fps = frozenset(hashes)
+    cold = _fake_replica("cold", inflight=0)
+    r._replicas = [cold, warm]
+    # load-only (no hashes): least-loaded wins
+    assert r._pick(set()) is cold
+    # affinity blend: 3 cached pages x 0.5 outweigh one inflight
+    assert r._pick(set(), hashes) is warm
+    # overlap is the longest chain PREFIX: holding only a later hash
+    # (parent missing) scores zero
+    broken = _fake_replica("broken", inflight=0)
+    broken.prefix_fps = frozenset(hashes[1:])
+    r._replicas = [broken, warm]
+    assert r._pick(set(), hashes) is warm
+    # affinity off: back to pure load
+    r.affinity_w = 0.0
+    r._replicas = [cold, warm]
+    assert r._pick(set(), hashes) is cold
+
+
+class _FakeStreamHandler:
+    """Just enough of BaseHTTPRequestHandler for _ClientRelay."""
+
+    def __init__(self, wfile=None):
+        self.wfile = wfile if wfile is not None else io.BytesIO()
+        self.status = None
+        self.sent_headers = {}
+        self.close_connection = False
+
+    def send_response(self, code):
+        self.status = code
+
+    def send_header(self, k, v):
+        self.sent_headers[k] = v
+
+    def end_headers(self):
+        pass
+
+
+class _ExplodingFile:
+    """A client that hung up: every write raises."""
+
+    def write(self, b):
+        raise BrokenPipeError("client went away")
+
+    def flush(self):
+        pass
+
+
+def test_stream_failover_splice_byte_exact(bare_router, monkeypatch):
+    """Mid-stream failover through the client relay: the primary dies
+    after streaming 3 tokens, the resume carries on from the journal
+    frontier — the client's NDJSON holds every token exactly once
+    (zero loss, zero duplicates) plus one terminal done body."""
+    from paddle_tpu.inference import router as router_mod
+    r = bare_router
+    r.hedge_s = 0.0
+    r.ttft_hedge_s = 0.0
+    prompt, full = [1, 2, 3], [41, 42, 43, 44, 45]
+    rep = _fake_replica("fr")
+    monkeypatch.setattr(r, "_pick", lambda exclude: rep)
+
+    def die_with_progress(a):
+        a.j.extend(0, full[:3], a.rep.name)
+        time.sleep(0.1)       # let the relay drain the first block
+        a.kind, a.reason = "io", "stream truncated"
+        a.status = "failed"
+
+    def resume(a):
+        assert a.base == 3, "resume must splice AT the journal frontier"
+        _finish(a, prompt, full)
+
+    cls = _scripted_attempts([die_with_progress, resume])
+    monkeypatch.setattr(router_mod, "_StreamAttempt", cls)
+    h = _FakeStreamHandler()
+    relay = router_mod._ClientRelay(h, "rid-stream")
+    code, body, _ = r._forward_recovering(prompt, 5, None, 0, 8.0,
+                                          "rid-stream",
+                                          time.monotonic(), relay=relay)
+    assert code == 200, body
+    assert h.status == 200
+    assert h.sent_headers["Content-Type"] == "application/x-ndjson"
+    lines = [json.loads(ln) for ln in h.wfile.getvalue().splitlines()]
+    streamed = [t for ln in lines if "t" in ln for t in ln["t"]]
+    assert streamed == full     # byte-exact splice across the failover
+    dones = [ln for ln in lines if "done" in ln]
+    assert len(dones) == 1 and "done" in lines[-1]
+    assert dones[0]["done"]["tokens"] == prompt + full
+    assert dones[0]["done"]["request_id"] == "rid-stream"
+    assert dones[0]["done"]["recovered"] == 1
+    assert dones[0]["done"]["tokens_generated"] == 5
+
+
+def test_stream_error_reaches_client_as_err_record(bare_router,
+                                                   monkeypatch):
+    """A mid-stream terminal failure must land on the NDJSON stream as
+    a truthful err record (code + retry hint), never a bare EOF."""
+    from paddle_tpu.inference import router as router_mod
+    r = bare_router
+    r.hedge_s = 0.0
+    r.ttft_hedge_s = 0.0
+    prompt, full = [5, 5], [71, 72, 73, 74]
+
+    def die_then_nothing(a):
+        a.j.extend(0, full[:2], a.rep.name)
+        a.kind, a.reason = "io", "stream truncated"
+        a.status = "failed"
+
+    rep = _fake_replica("fr")
+    picks = {"n": 0}
+
+    def pick(exclude):
+        picks["n"] += 1
+        return rep if picks["n"] == 1 else None   # no replica to resume
+
+    monkeypatch.setattr(r, "_pick", pick)
+    cls = _scripted_attempts([die_then_nothing])
+    monkeypatch.setattr(router_mod, "_StreamAttempt", cls)
+    h = _FakeStreamHandler()
+    relay = router_mod._ClientRelay(h, "rid-err")
+    code, body, ra = r._forward_recovering(prompt, 4, None, 0, 2.0,
+                                           "rid-err",
+                                           time.monotonic(), relay=relay)
+    assert code == 503
+    lines = [json.loads(ln) for ln in h.wfile.getvalue().splitlines()]
+    assert [t for ln in lines if "t" in ln for t in ln["t"]] == full[:2]
+    err = lines[-1]["err"]
+    assert err["code"] == 503
+    assert err["retry_after_s"] == ra
+
+
+def test_stream_client_disconnect_cancels_all_attempts(bare_router,
+                                                       monkeypatch):
+    """Client hangs up mid-stream: the coordinator cancels every live
+    attempt (slot retired on the owning replica), books the disconnect,
+    and accounts the tokens the journal actually produced."""
+    from paddle_tpu.inference import router as router_mod
+    r = bare_router
+    r.hedge_s = 0.0
+    r.ttft_hedge_s = 0.0
+    prompt, full = [9, 9], [51, 52, 53, 54]
+
+    def progress_then_linger(a):
+        a.j.extend(0, full[:2], a.rep.name)
+        # stays "running": only the disconnect can end this request
+
+    rep = _fake_replica("fr")
+    monkeypatch.setattr(r, "_pick", lambda exclude: rep)
+    cls = _scripted_attempts([progress_then_linger])
+    cancelled = []
+    cls.cancel = lambda self: cancelled.append(self.rid)
+    monkeypatch.setattr(router_mod, "_StreamAttempt", cls)
+    h = _FakeStreamHandler(wfile=_ExplodingFile())
+    relay = router_mod._ClientRelay(h, "rid-gone")
+    before = r.stats_counters["client_disconnects"]
+    code, body, ra = r._forward_recovering(prompt, 4, None, 0, 8.0,
+                                           "rid-gone",
+                                           time.monotonic(), relay=relay)
+    assert code == 499
+    assert body["error"] == "client_disconnected"
+    assert body["tokens_generated"] == 2
+    assert r.stats_counters["client_disconnects"] == before + 1
+    deadline = time.monotonic() + 5.0
+    while not cancelled and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert cancelled, "live attempt must be cancelled on disconnect"
+
+
+def test_stream_refusals_stay_plain_json(bare_router):
+    """A stream request the journal cannot serve is refused BEFORE any
+    NDJSON head is written — plain JSON 400/503, protocol intact."""
+    from paddle_tpu.inference import router as router_mod
+    r = bare_router
+    h = _FakeStreamHandler()
+    relay = router_mod._ClientRelay(h, None)
+    payload = json.dumps({"prompt": "opaque", "stream": True}).encode()
+    code, body, _ = r.forward_generate(payload, deadline_s=2.0,
+                                       relay=relay)
+    assert code == 400 and body["error"] == "stream_requires_token_ids"
+    assert not relay.started_http and h.status is None
+    r.recovery = False           # journaling off on this tier
+    h2 = _FakeStreamHandler()
+    relay2 = router_mod._ClientRelay(h2, None)
+    code, body, ra = r.forward_generate(payload, deadline_s=2.0,
+                                        relay=relay2)
+    assert code == 503 and body["error"] == "stream_unavailable"
+    assert ra is not None and not relay2.started_http
+
+
+def test_forward_generate_qos_gate_sheds_per_class(bare_router):
+    """The QoS gate on the real forward path: overload sheds the LOW
+    class with a per-class 429 + Retry-After while the high class
+    keeps its queue spot; admitted requests release their slot."""
+    from paddle_tpu.inference.router import _QosScheduler
+    r = bare_router
+    r.qos = _QosScheduler(capacity=1, queue_limit=1, starvation_s=60.0)
+    assert r.qos.try_acquire("seed", "standard", 1.0)[0] == "admitted"
+
+    def pay(tenant, qcls):
+        return json.dumps({"input_ids": [1], "max_new_tokens": 1,
+                           "tenant": tenant, "qos_class": qcls}).encode()
+
+    results = []
+    th = threading.Thread(target=lambda: results.append(
+        r.forward_generate(pay("t1", "batch"), deadline_s=30.0)))
+    th.start()
+    deadline = time.monotonic() + 5.0
+    while (r.qos.snapshot()["waiting"] < 1
+           and time.monotonic() < deadline):
+        time.sleep(0.01)
+    # batch queue full -> truthful per-class 429
+    code, body, ra = r.forward_generate(pay("t2", "batch"),
+                                        deadline_s=5.0)
+    assert code == 429 and body["error"] == "qos_shed"
+    assert body["qos_class"] == "batch" and body["tenant"] == "t2"
+    assert ra is not None and ra > 0
+    assert r.stats_counters["qos_shed"] >= 1
+    # interactive still has queue room: it QUEUES (timing out against
+    # its own zero budget with the deadline face), never a 429
+    code, body, ra_i = r.forward_generate(pay("t3", "interactive"),
+                                          deadline_s=0.0)
+    assert code == 503 and body["error"] == "deadline_exceeded"
+    assert body["qos_class"] == "interactive"
+    # release the seed slot: the queued batch request dispatches (no
+    # replicas on a bare router -> clean 503) and RELEASES its slot
+    r.qos.release("seed", "standard", tokens=0)
+    th.join(timeout=15)
+    assert results and results[0][0] == 503
+    snap = r.qos.snapshot()
+    assert snap["inflight"] == 0 and snap["waiting"] == 0
+    assert r.stats_counters["qos_admitted"] >= 1
+
+
+def test_ttft_budget_derivation(bare_router):
+    r = bare_router
+    r.ttft_hedge_s = 0
+    assert r._ttft_budget() is None              # explicit 0 disables
+    r.ttft_hedge_s = 1.5
+    assert r._ttft_budget() == 1.5               # explicit wins
+    r.ttft_hedge_s = -1.0
+    b = r._ttft_budget()                         # cold-tier default
+    assert b is not None and 0 < b <= max(2.0, r.deadline_s / 4.0)
+
+
+def test_ttft_hedge_fires_on_admission_stall(bare_router, monkeypatch):
+    """An admission stall (no FIRST token past the TTFT budget) hedges
+    onto a second replica under the tier-wide budget — today's decode
+    hedge only watches requests that already produced a token."""
+    from paddle_tpu.inference import router as router_mod
+    r = bare_router
+    r.hedge_s = 0.0              # decode-stall hedge off
+    r.ttft_hedge_s = 0.15        # tiny explicit TTFT budget
+    prompt, full = [2, 2], [61, 62]
+    reps = [_fake_replica("p"), _fake_replica("h")]
+
+    def pick(exclude, prompt_hashes=None):
+        for rep in reps:
+            if rep.name not in exclude:
+                return rep
+        return None
+
+    monkeypatch.setattr(r, "_pick", pick)
+
+    def wedged_prefill(a):
+        time.sleep(1.0)          # never produces a token
+
+    def hedged(a):
+        assert a.is_hedge and a.base == 0
+        _finish(a, prompt, full)
+
+    cls = _scripted_attempts([wedged_prefill, hedged])
+    monkeypatch.setattr(router_mod, "_StreamAttempt", cls)
+    t0 = time.monotonic()
+    code, body, _ = r._forward_recovering(prompt, 2, None, 0, 8.0,
+                                          "rid-ttft", t0)
+    assert code == 200, body
+    assert body["tokens"] == prompt + full
+    assert body.get("hedged") is True
+    assert r.stats_counters["ttft_hedges"] == 1
+    assert r.stats_counters["hedge_wins"] >= 1
+    assert time.monotonic() - t0 < 4.0, "hedge must beat the deadline"

@@ -1085,6 +1085,443 @@ def bench_recovery(smoke: bool):
     }
 
 
+def bench_stream(smoke: bool):
+    """Streaming-first QoS front chaos gates (ISSUE 16).
+
+    Many closed-loop STREAMING clients (NDJSON through the tier's
+    /generate, "stream": true) ride four disturbance phases, with an
+    undisturbed greedy oracle taken first:
+
+    - kill_mid_stream: a replica is kill -9'd while its requests are
+      streaming. The journal splice must be invisible: every client's
+      concatenated token blocks are BITWISE the oracle suffix — zero
+      token loss, zero duplicates — and the survivor compiles zero
+      new XLA programs.
+    - stall_hedge_stream: one replica's decode loop is wedged
+      (replica_stall via /admin/inject). The TTFT/decode hedge bounds
+      the stall: every stream completes token-identical with p99 well
+      under the wedge.
+    - rolling_restart_stream: every replica is replaced mid-traffic;
+      successors warm from the executable store with ZERO compiles
+      and streams stay bitwise-identical.
+    - overload_qos: the tier is saturated far past a deliberately
+      tiny QoS capacity with mixed tenants/classes. Degradation must
+      be truthful PER CLASS: interactive traffic all completes, batch
+      sheds with 429 + drain-derived Retry-After, and nothing hangs.
+
+    Plus an affinity A/B: concurrent shared-prefix groups routed with
+    prefix-affinity scoring vs load-only (affinity_w=0) — the tier
+    prefix_hit_rate must be measurably higher with affinity on.
+    """
+    import os
+    import signal
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from paddle_tpu import obs
+    from paddle_tpu.inference.router import (ReplicaSpec, Router,
+                                             _QosScheduler,
+                                             single_device_child_env)
+
+    model = {"kind": "gpt", "vocab_size": 160, "hidden_size": 32,
+             "num_layers": 1, "num_heads": 2, "max_seq_len": 160}
+    engine = {"slots": 4, "max_len": 128, "cache_dtype": "float32",
+              "prefill_buckets": (8, 16, 32, 64, 96), "tick_tokens": 2,
+              "paged": True, "page_size": 8}
+    wedge_s = 6.0 if smoke else 10.0
+    clients = 3 if smoke else 5
+    max_new = 40 if smoke else 80
+    child_env = single_device_child_env("cpu")
+    child_env["PADDLE_TPU_CHAOS_ADMIN"] = "1"
+    store = tempfile.mkdtemp(prefix="bench_stream_store_")
+    spec = ReplicaSpec(model, engine, warmup=True, drain_s=20.0, seed=0,
+                       env=child_env)
+    router = Router(spec, replicas=2, poll_s=0.25, deadline_s=120.0,
+                    exec_store_dir=store, hedge_s=1.0,
+                    ttft_hedge_s=1.5).start()
+    if not router.wait_ready(2, timeout=300):
+        router.stop()
+        raise RuntimeError(f"tier never ready: {router.replicas()}")
+    base = f"http://{router.host}:{router.port}"
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(0, 150, (32,)).tolist()   # 4 shared KV pages
+
+    def sgen(ids, n, tenant=None, qcls=None, timeout=110.0):
+        """One streaming request: returns code/body plus the streamed
+        token blocks, TTFT and inter-block gaps. Pre-stream refusals
+        (QoS 429/503) come back as plain JSON HTTPErrors."""
+        headers = {"Content-Type": "application/json"}
+        if tenant:
+            headers["X-PTPU-Tenant"] = tenant
+        if qcls:
+            headers["X-PTPU-Class"] = qcls
+        req = urllib.request.Request(
+            base + "/generate",
+            json.dumps({"input_ids": ids, "max_new_tokens": n,
+                        "stream": True}).encode(), headers)
+        t0 = time.perf_counter()
+        toks, gaps, ttft = [], [], None
+        last = t0
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                for raw in r:
+                    raw = raw.strip()
+                    if not raw:
+                        continue
+                    ev = json.loads(raw)
+                    now = time.perf_counter()
+                    if "t" in ev:
+                        if ttft is None:
+                            ttft = (now - t0) * 1e3
+                        else:
+                            gaps.append((now - last) * 1e3)
+                        last = now
+                        toks.extend(ev["t"])
+                        continue
+                    kind = "done" if "done" in ev else "err"
+                    body = ev[kind]
+                    return {"code": 200 if kind == "done"
+                            else int(body.get("code", 0)),
+                            "body": body, "streamed": toks,
+                            "ttft_ms": ttft, "gaps_ms": gaps,
+                            "wall_ms": (now - t0) * 1e3,
+                            "retry_after": body.get("retry_after_s")}
+            raise RuntimeError("stream ended without a terminal record")
+        except urllib.error.HTTPError as e:
+            body = json.loads(e.read())
+            return {"code": e.code, "body": body, "streamed": [],
+                    "ttft_ms": None, "gaps_ms": [],
+                    "wall_ms": (time.perf_counter() - t0) * 1e3,
+                    "retry_after": e.headers.get("Retry-After")}
+
+    def replica_healthz(rep_snapshot):
+        url = f"http://{router.host}:{rep_snapshot['port']}/healthz"
+        try:
+            with urllib.request.urlopen(url, timeout=5) as r:
+                return json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            try:
+                return json.loads(e.read())
+            except (ValueError, OSError):
+                return {}
+        except (urllib.error.URLError, OSError, ValueError):
+            return {}
+
+    def tier_prefix_counters():
+        hits = misses = 0
+        for r in router.replicas():
+            eng = replica_healthz(r).get("engine", {})
+            hits += int(eng.get("prefix_hits", 0))
+            misses += int(eng.get("prefix_misses", 0))
+        return hits, misses
+
+    # undisturbed oracle: a single-shot AND a streamed run must agree
+    one = sgen(prompt, max_new)
+    assert one["code"] == 200, one
+    oracle = one["body"]["tokens"]
+    assert one["streamed"] == oracle[len(prompt):]
+    two = sgen(prompt, max_new)
+    assert two["body"]["tokens"] == oracle
+
+    def run_phase(name, jobs, chaos=None):
+        """jobs: list of (ids, max_new, tenant, qcls, check_oracle)."""
+        results, errors = [], []
+        lock = threading.Lock()
+
+        def client(job):
+            ids, n, tenant, qcls, check = job
+            try:
+                res = sgen(ids, n, tenant, qcls)
+                res["job"] = job
+                with lock:
+                    results.append(res)
+            except Exception as e:  # noqa: BLE001 — a hang/reset
+                with lock:          # breaks the gate
+                    errors.append(repr(e))
+
+        threads = [threading.Thread(target=client, args=(j,))
+                   for j in jobs]
+        for t in threads:
+            t.start()
+        chaos_result = chaos() if chaos is not None else None
+        for t in threads:
+            t.join(timeout=240)
+        splice_breaks = 0
+        for res in results:
+            if res["code"] != 200:
+                continue
+            ids, n, _, _, check = res["job"]
+            b = res["body"]
+            # greedy prefix property: a shorter max_new is bitwise a
+            # prefix of the undisturbed oracle run
+            want_full = (oracle[:len(b["tokens"])] if check
+                         else b["tokens"])
+            # zero loss, zero duplicates, bitwise vs the oracle: the
+            # streamed blocks ARE the done body's suffix, which IS the
+            # undisturbed oracle's
+            if (b["tokens"] != want_full
+                    or res["streamed"]
+                    != b["tokens"][len(ids):len(ids)
+                                   + b["tokens_generated"]]):
+                splice_breaks += 1
+        oks = [r for r in results if r["code"] == 200]
+        gaps = [g for r in oks for g in r["gaps_ms"]]
+        ttfts = [r["ttft_ms"] for r in oks if r["ttft_ms"] is not None]
+        return {"phase": name, "requests": len(jobs),
+                "ok": len(oks), "client_errors": errors,
+                "non_200": sorted(r["code"] for r in results
+                                  if r["code"] != 200),
+                "splice_breaks": splice_breaks,
+                "recovered_responses": sum(
+                    1 for r in oks if r["body"].get("recovered")),
+                "hedged_responses": sum(
+                    1 for r in oks if r["body"].get("hedged")),
+                "p99_ttft_ms": round(_percentiles(ttfts)[2], 1)
+                if ttfts else 0.0,
+                "p99_itl_ms": round(_percentiles(gaps)[2], 1)
+                if gaps else 0.0,
+                "chaos": chaos_result,
+                "results": results}
+
+    shared_job = (prompt, max_new, None, None, True)
+
+    # ---- phase 1: kill -9 mid-stream ---------------------------------
+    pre = {r["name"]: replica_healthz(r) for r in router.replicas()}
+    killed = {}
+
+    def kill_busiest():
+        victim = None
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            snap = router.replicas()
+            busiest = max(snap, key=lambda r: r["inflight"])
+            if busiest["inflight"] >= 1:
+                victim = busiest
+                break
+            time.sleep(0.002)
+        if victim is None:
+            victim = router.replicas()[0]
+        time.sleep(0.03)          # a few ticks: tokens on the stream
+        os.kill(victim["pid"], signal.SIGKILL)
+        killed["name"] = victim["name"]
+        return {"killed": victim["name"],
+                "inflight_at_kill": victim["inflight"]}
+
+    kill_phase = run_phase("kill_mid_stream", [shared_job] * clients * 2,
+                           chaos=kill_busiest)
+    kill_phase.pop("results")
+    recoveries = router.stats_counters["recoveries"]
+    survivors = [r for r in router.replicas()
+                 if r["name"] in pre and r["name"] != killed.get("name")
+                 and r["state"] == "ready"]
+    surv_eng = (replica_healthz(survivors[0]).get("engine", {})
+                if survivors else {})
+    pre_eng = (pre.get(survivors[0]["name"], {}).get("engine", {})
+               if survivors else {})
+    compiles_delta = (int(surv_eng.get("compiled_programs", -1))
+                      - int(pre_eng.get("compiled_programs", -2)))
+
+    # ---- phase 2: stall -> hedge (TTFT + decode) ---------------------
+    if not router.wait_ready(2, timeout=180):
+        raise RuntimeError(f"tier not back to 2: {router.replicas()}")
+    # wedge the replica the affinity-scored _pick will actually route
+    # the shared-prefix clients to — wedging the other one would never
+    # stall anybody
+    from paddle_tpu.inference.paging import chain_hashes
+    victim = router._pick(set(), chain_hashes(
+        prompt, int(engine["page_size"])))
+    target = next(r for r in router.replicas()
+                  if r["name"] == victim.name)
+    req = urllib.request.Request(
+        f"http://{router.host}:{target['port']}/admin/inject",
+        json.dumps({"site": "replica_stall", "count": 1,
+                    "wedge_s": wedge_s}).encode(),
+        {"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=10):
+        pass
+    stall_phase = run_phase("stall_hedge_stream", [shared_job] * clients)
+    stall_phase.pop("results")
+    hedge_stats = {k: router.stats_counters[k] for k in
+                   ("hedges", "hedge_wins", "ttft_hedges")}
+    # let the wedge clear + losers cancel before the next phase
+    deadline = time.monotonic() + wedge_s * 2 + 10
+    while time.monotonic() < deadline:
+        engs = [replica_healthz(r).get("engine", {})
+                for r in router.replicas()]
+        if engs and all(e.get("active", 99) == 0 for e in engs):
+            break
+        time.sleep(0.5)
+
+    # ---- phase 3: rolling restart mid-stream -------------------------
+    roll = {}
+
+    def rolling():
+        roll.update(router.rolling_restart(ready_timeout=240))
+        return {"replaced": roll.get("replaced"), "ok": roll.get("ok")}
+
+    roll_phase = run_phase("rolling_restart_stream",
+                           [shared_job] * clients * 2, chaos=rolling)
+    roll_phase.pop("results")
+    successor_compiles = []
+    for r in router.replicas():
+        if r["draining"]:
+            continue
+        h = replica_healthz(r)
+        successor_compiles.append(
+            int(h.get("compilation", {}).get("xla_compiles", -1)))
+
+    # ---- phase 4: overload with per-class truthful degradation -------
+    saved_qos = router.qos
+    router.qos = _QosScheduler(capacity=2, queue_limit=1,
+                               starvation_s=3.0)
+    n_i = 3 if smoke else 5
+    over_jobs = []
+    for i in range(n_i):
+        over_jobs.append((prompt, 8, f"hi-{i % 2}", "interactive", True))
+    for i in range(2 if smoke else 4):
+        over_jobs.append((prompt, 8, f"mid-{i % 2}", "standard", True))
+    # batch queue cap is max(1, int(queue_limit * 1.0)) = 1: with more
+    # batch arrivals than capacity + that cap, at least one MUST shed
+    for i in range(4 if smoke else 6):
+        over_jobs.append((prompt, 8, f"lo-{i % 2}", "batch", True))
+    over_phase = run_phase("overload_qos", over_jobs)
+    over_results = over_phase.pop("results")
+    router.qos = saved_qos
+    by_class = {}
+    for res in over_results:
+        cls = res["job"][3]
+        d = by_class.setdefault(cls, {"ok": 0, "shed_429": 0,
+                                      "other": 0, "retry_after": [],
+                                      "ttft_ms": []})
+        if res["code"] == 200:
+            d["ok"] += 1
+            if res["ttft_ms"] is not None:
+                d["ttft_ms"].append(round(res["ttft_ms"], 1))
+        elif res["code"] == 429:
+            d["shed_429"] += 1
+            ra = res.get("retry_after")
+            d["retry_after"].append(float(ra) if ra is not None
+                                    else None)
+        else:
+            d["other"] += 1
+    interactive_clean = (by_class.get("interactive", {}).get("ok", 0)
+                         == n_i)
+    batch_shed = by_class.get("batch", {}).get("shed_429", 0)
+    sheds_truthful = all(
+        ra is not None and float(ra) > 0
+        for d in by_class.values() for ra in d["retry_after"])
+    # no tenant starved: every request either completed or was shed
+    # with a truthful hint — nothing hung or vanished
+    no_starvation = (over_phase["ok"]
+                     + sum(d["shed_429"] + d["other"]
+                           for d in by_class.values())
+                     == len(over_jobs)
+                     and not over_phase["client_errors"])
+
+    # ---- affinity A/B: prefix-affinity vs load-only _pick ------------
+    def affinity_arm(tag, groups, per_group):
+        # Seed each fresh LONG prefix (8 complete KV pages -> overlap
+        # bonus affinity_w*8 = 4.0, dominating transient load diffs)
+        # with one request per group, launched CONCURRENTLY so load-
+        # only routing spreads the prefixes across both replicas.
+        # After the router's health poll picks up the new trie
+        # fingerprints, fan each group's followers out concurrently:
+        # with affinity they co-locate on the replica that cached
+        # their prefix (hits); load-only routing places ~half of them
+        # on the other one (misses).
+        seeds, prefixes = [], []
+        for g in range(groups):
+            gp = rng.randint(0, 150, (64,)).tolist()  # 8 KV pages
+            prefixes.append(gp)
+            seeds.append((gp + rng.randint(0, 150, (4,)).tolist(),
+                          6, None, None, False))
+        sp = run_phase(f"affinity_{tag}_seed", seeds)
+        assert sp["ok"] == len(seeds), sp
+        followers = [(gp + rng.randint(0, 150, (4,)).tolist(),
+                      6, None, None, False)
+                     for gp in prefixes for _ in range(per_group)]
+        time.sleep(max(1.0, router.poll_s * 4))
+        h0, m0 = tier_prefix_counters()
+        ph = run_phase(f"affinity_{tag}", followers)
+        ph.pop("results")
+        h1, m1 = tier_prefix_counters()
+        dh, dm = h1 - h0, m1 - m0
+        ph["prefix_hits"] = dh
+        ph["prefix_misses"] = dm
+        ph["prefix_hit_rate"] = round(dh / max(1, dh + dm), 3)
+        return ph
+
+    groups, per_group = (4, 2) if smoke else (4, 3)
+    aff_on = affinity_arm("on", groups, per_group)
+    router.affinity_w = 0.0
+    aff_off = affinity_arm("off", groups, per_group)
+    router.affinity_w = 0.5
+
+    # ---- tier metrics: per-class QoS series really exported ----------
+    with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+        metrics_text = r.read().decode()
+    m_qos_admitted = m_ttft_count = 0.0
+    for name, labels, val in obs.metrics.parse_text(metrics_text):
+        if name == "ptpu_tier_qos_admitted_total":
+            m_qos_admitted += val
+        if (name == "ptpu_tier_ttft_ms_count"
+                or (name == "ptpu_tier_ttft_ms" and
+                    labels.get("le") is None and "count" in labels)):
+            m_ttft_count += val
+
+    stats = dict(router.stats_counters)
+    router.stop()
+    import shutil
+    shutil.rmtree(store, ignore_errors=True)
+
+    chaos_phases = [kill_phase, stall_phase, roll_phase]
+    itl_bound_ms = 15000.0
+    clean = (
+        all(not p["client_errors"] and p["splice_breaks"] == 0
+            and p["ok"] == p["requests"] for p in chaos_phases)
+        and recoveries >= 1
+        and compiles_delta == 0
+        and roll.get("ok") and len(roll.get("replaced", [])) == 2
+        and all(c == 0 for c in successor_compiles)
+        and hedge_stats["hedges"] >= 1
+        and hedge_stats["hedge_wins"] >= 1
+        # hedge slots are budgeted (hedge_frac), so stalled streams un-
+        # wedge serially: bound TTFT by the wedge plus hedge headroom,
+        # not by the unbounded original
+        and stall_phase["p99_ttft_ms"] < (wedge_s + 4.0) * 1e3
+        and all(p["p99_itl_ms"] < itl_bound_ms for p in chaos_phases)
+        and interactive_clean
+        and batch_shed >= 1
+        and sheds_truthful
+        and no_starvation
+        and over_phase["splice_breaks"] == 0
+        and aff_on["prefix_hit_rate"] > aff_off["prefix_hit_rate"]
+        and m_qos_admitted >= 1
+        and stats["streams"] >= 1)
+    return {
+        "phases": chaos_phases + [over_phase, aff_on, aff_off],
+        "p99_itl_ms_worst_phase": max(
+            p["p99_itl_ms"] for p in chaos_phases),
+        "itl_bound_ms": itl_bound_ms,
+        "recoveries": recoveries,
+        "survivor_compiles_delta": compiles_delta,
+        "successor_compiles": successor_compiles,
+        "hedge": hedge_stats,
+        "stall_wedge_s": wedge_s,
+        "overload_by_class": by_class,
+        "interactive_all_served": interactive_clean,
+        "batch_sheds": batch_shed,
+        "sheds_truthful_retry_after": sheds_truthful,
+        "no_starvation": no_starvation,
+        "affinity_hit_rate_on": aff_on["prefix_hit_rate"],
+        "affinity_hit_rate_off": aff_off["prefix_hit_rate"],
+        "metric_qos_admitted_total": m_qos_admitted,
+        "router_stats": stats,
+        "clean": clean,
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true",
@@ -1114,6 +1551,14 @@ def main():
                          "errors + prefix-hit re-prefill + zero new "
                          "compiles; replica_stall -> hedged decode "
                          "bounds p99, loser cancelled, leak-free")
+    ap.add_argument("--stream", action="store_true",
+                    help="streaming QoS front chaos gates (ISSUE 16): "
+                         "NDJSON client streams ride kill/stall/"
+                         "rolling-restart bitwise-identically (zero "
+                         "loss, zero dups, zero new compiles, bounded "
+                         "p99 ITL); overload degrades truthfully per "
+                         "class; prefix-affinity beats load-only "
+                         "routing on shared-prefix hit rate")
     ap.add_argument("--clients", type=int, default=8,
                     help="closed-loop clients (engine slots follow)")
     ap.add_argument("--per-client", type=int, default=None,
@@ -1140,6 +1585,21 @@ def main():
         # bitwise failover / zero-client-errors / prefix-hit /
         # zero-new-compiles / hedge-bounded-p99 / leak-free are all
         # ASSERTED (rec["clean"]), not just reported
+        return 0 if rec["clean"] else 1
+
+    if args.stream:
+        rec = bench_stream(args.smoke)
+        rec.update({
+            "metric": "serving_stream_qos_chaos",
+            "value": rec["p99_itl_ms_worst_phase"],
+            "unit": "p99_itl_ms_worst_chaos_phase",
+            "smoke": bool(args.smoke),
+        })
+        print(json.dumps(rec))
+        # bitwise splice / zero-loss-zero-dup / zero-new-compiles /
+        # hedge-bounded stall / per-class truthful shed / no
+        # starvation / affinity-beats-load-only are ASSERTED
+        # (rec["clean"]), not just reported
         return 0 if rec["clean"] else 1
 
     if args.spec:

@@ -135,6 +135,23 @@ def _run_recovery_smoke(env) -> int:
         cwd=ROOT, env=env).returncode
 
 
+def _run_stream_smoke(env) -> int:
+    """Streaming QoS smoke (ISSUE 16): tools/bench_serving.py --stream
+    --smoke drives NDJSON client streams through a live 2-replica tier
+    across kill -9, an injected decode stall (hedge-bounded), and a
+    rolling restart — every stream must splice bitwise-identically to
+    the undisturbed oracle (zero token loss, zero duplicates, zero new
+    compiles) — then saturates a tiny QoS capacity with mixed
+    tenant/class traffic (interactive all served, batch shed with
+    truthful Retry-After, nobody starved) and A/Bs prefix-affinity
+    routing against load-only _pick (hit rate must be higher)."""
+    print("\n=== stream smoke (mid-stream chaos + QoS + affinity) ===")
+    return subprocess.run(
+        [sys.executable, os.path.join("tools", "bench_serving.py"),
+         "--stream", "--smoke"],
+        cwd=ROOT, env=env).returncode
+
+
 def _run_obs_smoke(env) -> int:
     """Obs smoke (ISSUE 8): tools/trace_tool.py --self-test drives a
     LIVE tiny server — /metrics scraped twice and parsed (series must
@@ -286,6 +303,12 @@ def main():
                          "(tools/bench_serving.py --recovery --smoke: "
                          "kill-mid-decode + stall-hedge) that "
                          "--quick/--full append after the tests")
+    ap.add_argument("--no-stream-smoke", action="store_true",
+                    help="skip the streaming QoS smoke "
+                         "(tools/bench_serving.py --stream --smoke: "
+                         "mid-stream chaos + per-class degradation + "
+                         "affinity A/B) that --quick/--full append "
+                         "after the tests")
     ap.add_argument("-k", default=None)
     args = ap.parse_args()
     if args.full and args.quick:
@@ -396,6 +419,10 @@ def main():
         # single-device jax cache (no multi-device entries can arise)
         recovery_rc = _run_recovery_smoke(cache_env)
         rc = rc or recovery_rc
+    if (args.quick or args.full) and not args.no_stream_smoke:
+        # cache_env for the same reason as the recovery smoke
+        stream_rc = _run_stream_smoke(cache_env)
+        rc = rc or stream_rc
     return rc
 
 

@@ -53,6 +53,15 @@ run bench_serving_spec bench_serving_spec.json \
 # landed
 run bench_serving_recovery bench_serving_recovery.json \
     python tools/bench_serving.py --recovery
+# streaming QoS front chaos gates (ISSUE 16): NDJSON client streams
+# splice bitwise across kill -9 / stall-hedge / rolling restart (zero
+# loss, zero dups, zero new compiles, bounded p99 ITL); overload
+# degrades truthfully per class (batch shed w/ honest Retry-After,
+# interactive served); prefix-affinity beats load-only routing on
+# shared-prefix hit rate (replica children force cpu); self-skips
+# once landed
+run bench_serving_stream bench_serving_stream.json \
+    python tools/bench_serving.py --stream
 # obs decode-tick overhead gate (ISSUE 8): enabled-vs-disabled tick
 # time, paired-median on/off rounds; asserts the ratio <= 1.02 —
 # self-skips once landed like every other step

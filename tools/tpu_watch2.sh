@@ -81,6 +81,7 @@ PY
         /root/repo/tpu_results/bench_serving_paged.json \
         /root/repo/tpu_results/bench_serving_spec.json \
         /root/repo/tpu_results/bench_serving_recovery.json \
+        /root/repo/tpu_results/bench_serving_stream.json \
         /root/repo/tpu_results/tpulint.json \
         /root/repo/tpu_results/bench_125m_fused.json \
         /root/repo/tpu_results/bench_1p3b_dots.json \
