@@ -52,6 +52,9 @@ from .hlo_cost import (CHIP_SPECS, DEFAULT_CHIP, ChipSpec,
                        parse_hlo_module, program_cost,
                        updated_cost_baseline)
 from .fusion import fusion_histogram, unfused_chains
+from .collective_schedule import (diff_schedules, gather_chain_links,
+                                  gather_overlap_report,
+                                  schedule_events)
 from .runtime_profile import (check_profile_baseline, device_op_times,
                               join_measured_modeled,
                               load_profile_baseline, load_trace_events,
@@ -72,6 +75,8 @@ __all__ = [
     "analytic_verify_hbm_bytes",
     "check_cost_baseline", "load_cost_baseline",
     "updated_cost_baseline", "fusion_histogram", "unfused_chains",
+    "schedule_events", "gather_overlap_report", "gather_chain_links",
+    "diff_schedules",
     "load_trace_events", "device_op_times", "join_measured_modeled",
     "runtime_report", "profile_program", "check_profile_baseline",
     "load_profile_baseline", "updated_profile_baseline",

@@ -530,11 +530,14 @@ def program_cost(hlo_text: str, *, name: str = "program",
     the ranked top unfused elementwise chains. `detail=True` adds the
     full per-kernel list (big; the CLI's --json report includes it)."""
     from .fusion import fusion_histogram, unfused_chains
+    # lazy: program_lint imports HLO_DTYPE_BYTES from this module
+    from .program_lint import collective_inventory_from_hlo
     if isinstance(chip, str):
         chip = CHIP_SPECS[chip]
     notes: List[str] = []
     module = parse_hlo_module(hlo_text)
     kernels = collect_kernels(module, notes=notes)
+    coll = collective_inventory_from_hlo(hlo_text)
     flops = sum(k.flops for k in kernels)
     matmul = sum(k.matmul_flops for k in kernels)
     reads = sum(k.bytes_read for k in kernels)
@@ -558,6 +561,12 @@ def program_cost(hlo_text: str, *, name: str = "program",
         "bound": ("compute" if flops / chip.peak_flops
                   >= hbm / chip.hbm_bandwidth else "bandwidth"),
         "kernel_count": sum(1 for k in kernels if k.klass != "scalar"),
+        # per-chip transferred collective bytes (ring accounting,
+        # program_lint.collective_inventory_from_hlo) — the quantity
+        # the comm_bytes anchor and the collective_bytes budget ratchet
+        # gate (ISSUE 17: wire-precision wins must not silently revert)
+        "collectives": coll,
+        "collective_bytes": sum(v["bytes"] for v in coll.values()),
         "fusion_histogram": fusion_histogram(kernels),
         "top_unfused": chains,
         "notes": notes,
@@ -656,15 +665,19 @@ def analytic_verify_hbm_bytes(geometry: dict) -> int:
 # Baseline shape:
 #   {"version": 1, "chip": "v5lite",
 #    "budgets": {"<program>": {"hbm_bytes": N, "kernel_count": N,
-#                              "matmul_flop_share_min": 0.x}},
-#    "anchors": {"<program>": {"kind": "decode_hbm"|"matmul_share_floor",
-#                              "max_ratio": 1.15 | "min_share": 0.x}},
+#                              "matmul_flop_share_min": 0.x,
+#                              "collective_bytes": N}},
+#    "anchors": {"<program>": {"kind": "decode_hbm"|"matmul_share_floor"
+#                                      |"comm_bytes",
+#                              "max_ratio": 1.15 | "min_share": 0.x |
+#                              "baseline_program": "...",
+#                              "min_ratio": 3.5}},
 #    "notes": {...}}
 #
-# Budgets RATCHET (hbm_bytes/kernel_count may only stay or shrink,
-# matmul share may only stay or grow) and are rewritten wholesale by
-# --update-baseline; anchors are hand-set invariants that survive
-# updates — the must_stay_clean idiom, numeric.
+# Budgets RATCHET (hbm_bytes/kernel_count/collective_bytes may only
+# stay or shrink, matmul share may only stay or grow) and are rewritten
+# wholesale by --update-baseline; anchors are hand-set invariants that
+# survive updates — the must_stay_clean idiom, numeric.
 
 
 def load_cost_baseline(path: str) -> dict:
@@ -691,6 +704,12 @@ def updated_cost_baseline(base: Optional[dict],
             "matmul_flop_share_min": math.floor(
                 inv["matmul_flop_share"] * 1e4) / 1e4,
         }
+        # pin what the run measured: inventories always carry
+        # collective_bytes (0 for single-chip programs), but a summary
+        # from an older report without the field must not grow a gate
+        if "collective_bytes" in inv:
+            budgets[name]["collective_bytes"] = int(
+                inv["collective_bytes"])
     base["budgets"] = budgets
     base.setdefault("anchors", {})
     base.setdefault("notes", {})
@@ -770,6 +789,18 @@ def check_cost_baseline(inventories: Dict[str, dict],
                 "region (more launches, more HBM round-trips)",
                 {"measured": inv["kernel_count"],
                  "budget": kern_budget}))
+        coll_budget = b.get("collective_bytes")
+        if coll_budget is not None \
+                and inv.get("collective_bytes", 0) > int(coll_budget):
+            findings.append(Finding(
+                COST_BUDGET, Severity.WARN, name, "collective_bytes",
+                f"per-chip collective bytes "
+                f"{inv.get('collective_bytes', 0)} exceed the pinned "
+                f"budget {int(coll_budget)} — a collective regressed "
+                "to a wider wire dtype or new cross-chip traffic "
+                "appeared (review, fix, or --update-baseline)",
+                {"measured": inv.get("collective_bytes", 0),
+                 "budget": int(coll_budget)}))
         share_min = float(b.get("matmul_flop_share_min", 0.0))
         if inv["matmul_flop_share"] < share_min:
             findings.append(Finding(
@@ -855,6 +886,37 @@ def check_cost_baseline(inventories: Dict[str, dict],
                     "block",
                     {"measured": inv["hbm_bytes"], "analytic": bound,
                      "ratio": round(ratio, 4)}))
+        elif kind == "comm_bytes":
+            # wire-precision invariant (ISSUE 17): this program's
+            # per-chip collective bytes must stay at least min_ratio
+            # BELOW its full-precision twin's — int8/bf16 collectives
+            # silently reverting to f32 payloads is exactly the
+            # regression this anchor exists to catch
+            ref_name = a.get("baseline_program", "")
+            ref = inventories.get(ref_name)
+            if ref is None:
+                if ref_name in live:
+                    continue    # partial run; full runs flag missing
+                findings.append(Finding(
+                    COST_ANCHOR, Severity.ERROR, name, "comm_bytes",
+                    f"comm_bytes anchor references baseline_program "
+                    f"{ref_name!r} which the registry does not have — "
+                    "fix the baseline", {"baseline_program": ref_name}))
+                continue
+            mine = int(inv.get("collective_bytes", 0))
+            theirs = int(ref.get("collective_bytes", 0))
+            min_ratio = float(a.get("min_ratio", 1.0))
+            ratio = (theirs / mine) if mine else float("inf")
+            if ratio < min_ratio:
+                findings.append(Finding(
+                    COST_ANCHOR, Severity.ERROR, name, "comm_bytes",
+                    f"collective bytes {mine} vs {ref_name}'s {theirs} "
+                    f"= {ratio:.2f}x reduction, below the anchored "
+                    f"{min_ratio:.2f}x — the quantized collectives "
+                    "regressed toward full-precision wire bytes",
+                    {"measured": mine, "reference": theirs,
+                     "ratio": round(ratio, 4),
+                     "min_ratio": min_ratio}))
         elif kind == "matmul_share_floor":
             floor = float(a.get("min_share", 0.0))
             if inv["matmul_flop_share"] < floor:
@@ -872,7 +934,7 @@ def check_cost_baseline(inventories: Dict[str, dict],
                 COST_ANCHOR, Severity.ERROR, name, "unknown-kind",
                 f"anchor for {name!r} has unknown kind {kind!r} "
                 "(valid: decode_hbm, decode_hbm_paged, verify_hbm, "
-                "matmul_share_floor) — the "
+                "matmul_share_floor, comm_bytes) — the "
                 "invariant was NOT evaluated; fix the baseline",
                 {"kind": kind}))
     return findings

@@ -64,6 +64,8 @@ class Engine:
                 sh = getattr(st, "sharding_configs", None)
                 if getattr(st, "sharding", False) and sh is not None:
                     plan["zero_stage"] = sh.stage
+                    plan["comm_precision"] = getattr(
+                        sh, "comm_precision", "fp32")
                 pp = getattr(st, "pipeline_configs", None)
                 # fold only when the strategy actually sets a non-default
                 # cadence — DistributedStrategy default-constructs
@@ -90,7 +92,8 @@ class Engine:
                 n_inputs=self._n_inputs, mesh=self._mesh(),
                 zero_stage=plan["zero_stage"], remat=plan["remat"],
                 accumulate_steps=plan["accumulate_steps"],
-                remat_policy=plan.get("remat_policy", "full"))
+                remat_policy=plan.get("remat_policy", "full"),
+                comm_precision=plan.get("comm_precision"))
             self._trained_forward = None
         self._mode = mode
         return self

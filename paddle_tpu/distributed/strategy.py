@@ -52,6 +52,13 @@ class ShardingConfig:
     sharding_degree: int = 1
     stage: int = 1                       # ZeRO stage 1/2/3
     offload: bool = False
+    # Wire precision for the ZeRO collectives (gradient reduce-scatter,
+    # stage-3 weight all-gather): "fp32" keeps today's GSPMD
+    # collectives bitwise; "bf16"/"int8" route through the explicit
+    # block-quantized collectives (distributed/quantized.py). Maps the
+    # fleet reference's fp16_allreduce / GroupSharded comm dtype knobs
+    # (see MIGRATING.md). Env override: PADDLE_TPU_COMM_PRECISION.
+    comm_precision: str = "fp32"
 
 
 @dataclass

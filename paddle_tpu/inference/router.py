@@ -1122,6 +1122,7 @@ class Router:
                  ttft_hedge_s: Optional[float] = None,
                  ttft_hedge_mult: Optional[float] = None,
                  affinity_w: Optional[float] = None,
+                 prewarm: Optional[bool] = None,
                  qos_concurrency: Optional[int] = None,
                  qos_queue_limit: Optional[int] = None,
                  qos_starvation_s: Optional[float] = None):
@@ -1212,6 +1213,14 @@ class Router:
         self.affinity_w = (
             float(affinity_w) if affinity_w is not None
             else _env_float("PADDLE_TPU_TIER_AFFINITY_W", 0.5))
+        # standby prefix pre-warming (ISSUE 17): while a journaled
+        # stream runs, the router feeds the prompt+journal prefix to a
+        # standby replica's paged KV trie ahead of any failover, so a
+        # cutover's resumed prefill lands on trie hits instead of
+        # recomputing the prefix. PADDLE_TPU_TIER_PREWARM=0 disables.
+        self.prewarm = (bool(prewarm) if prewarm is not None
+                        else _env_float("PADDLE_TPU_TIER_PREWARM",
+                                        1.0) > 0)
         qos_cap = (int(qos_concurrency) if qos_concurrency is not None
                    else int(_env_float(
                        "PADDLE_TPU_TIER_QOS_CONCURRENCY", -1)))
@@ -1270,6 +1279,8 @@ class Router:
             # streaming-first QoS front (ISSUE 16)
             "streams": 0, "client_disconnects": 0,
             "ttft_hedges": 0, "qos_admitted": 0, "qos_shed": 0,
+            # standby prefix pre-warming (ISSUE 17)
+            "prewarms": 0, "prewarmed_resumes": 0,
         }
         # observability (paddle_tpu.obs): the stats above keep their
         # dict face (/healthz, tests); the registry carries the
@@ -1365,6 +1376,14 @@ class Router:
             self._m_ttft_hedges = reg.counter(
                 "ptpu_router_ttft_hedges_total",
                 "backups launched for admission (first-token) stalls")
+            # standby prefix pre-warming (ISSUE 17)
+            self._m_prewarms = reg.counter(
+                "ptpu_router_prewarms_total",
+                "journaled prefixes pre-warmed on standby replicas")
+            self._m_prewarmed_resumes = reg.counter(
+                "ptpu_router_prewarmed_resumes_total",
+                "resumes/hedges that landed on a replica whose trie "
+                "the router had pre-warmed for that request")
 
         self.httpd = ThreadingHTTPServer((host, port),
                                          self._make_handler())
@@ -2250,6 +2269,45 @@ class Router:
             except Exception:   # noqa: BLE001 — forensics best-effort
                 pass
 
+    def _prewarm_standby(self, rid, toks: List[int], exclude: set,
+                         page_size: int) -> Optional[str]:
+        """Push ``toks`` (prompt + journaled prefix) through a STANDBY
+        replica's /prewarm so its paged trie already holds the pages a
+        failover's resumed prefill would otherwise recompute (ISSUE
+        17). Best-effort and off the request's critical path (the
+        coordinator fires it on a daemon thread): a shed, a dead
+        standby, or no standby at all costs the stream nothing but the
+        head start. Returns the warmed replica's name, or None."""
+        rep = self._pick(exclude)
+        if rep is None:
+            return None
+        hdrs = {"Content-Type": "application/json"}
+        if rid:
+            hdrs[REQUEST_ID_HEADER] = f"{rid}.prewarm"
+        try:
+            req = urllib.request.Request(
+                rep.base_url + "/prewarm",
+                json.dumps({"input_ids": list(toks)}).encode(), hdrs)
+            with urllib.request.urlopen(req, timeout=30.0) as resp:
+                body = json.loads(resp.read() or b"{}")
+        except _REPLICA_IO_ERRORS:
+            return None
+        if not body.get("prewarmed"):
+            return None
+        self.stats_counters["prewarms"] += 1
+        if self._obs:
+            self._m_prewarms.inc()
+        # fold the warm pages into the standby's fingerprint view NOW:
+        # a cutover can beat the next health poll, and affinity scoring
+        # must already see the pre-warmed prefix for the resume to land
+        # there (the poll later replaces this with the replica's own
+        # healthz truth)
+        if page_size:
+            fps = frozenset(chain_hashes(list(toks), page_size))
+            with self._lock:
+                rep.prefix_fps = rep.prefix_fps | fps
+        return rep.name
+
     def _forward_recovering(self, prompt: List[int], max_new: int,
                             eos, seed: int, deadline_s: float,
                             rid: Optional[str], t0: float,
@@ -2349,6 +2407,41 @@ class Router:
         complete_since = None    # journal complete, waiting (briefly)
         #                          for the live attempt's terminal line
 
+        # standby prefix pre-warming (ISSUE 17): as the journal crosses
+        # page boundaries, a daemon thread pushes prompt+journal through
+        # a standby's /prewarm — the failover target's trie then already
+        # holds the resumed prefill's pages when a cutover happens
+        prewarmed: set = set()   # replicas warmed for THIS request
+        pw_busy = [False]        # one in-flight prewarm at a time
+        pw_pages = [0]           # page count already pushed
+
+        def maybe_prewarm(live_names: set):
+            if (not self.prewarm or not _ps or pw_busy[0]
+                    or st.complete()):
+                return
+            with st.cond:
+                cur = list(st.tokens)
+            pages = (len(prompt) + len(cur)) // _ps
+            if pages <= pw_pages[0]:
+                return
+            pw_pages[0] = pages
+            pw_busy[0] = True
+            toks = prompt + cur
+
+            # NOT excluding already-warmed standbys: re-picking the
+            # same one extends its trie with the grown prefix, which is
+            # exactly what keeps the failover target current
+            def _pw(toks=toks, ex=set(tried) | set(live_names)):
+                try:
+                    name = self._prewarm_standby(rid, toks, ex, _ps)
+                    if name:
+                        prewarmed.add(name)
+                finally:
+                    pw_busy[0] = False
+            threading.Thread(target=_pw, daemon=True,
+                             name=f"tier-prewarm-{rid or 'anon'}"
+                             ).start()
+
         def cancel_all(exclude=None, wait=True):
             losers = [a for a in attempts
                       if a is not exclude and a.status == "running"]
@@ -2391,6 +2484,12 @@ class Router:
                        else self._pick(set(live_names)))
             if rep is None:
                 return None
+            if seq > 0 and rep.name in prewarmed:
+                # the cutover landed where the router pre-warmed: the
+                # resumed prefill (or hedge re-run) starts on trie hits
+                self.stats_counters["prewarmed_resumes"] += 1
+                if self._obs:
+                    self._m_prewarmed_resumes.inc()
             base = 0 if force_full else st.size()
             if not is_hedge:
                 if seq > 0:
@@ -2506,6 +2605,7 @@ class Router:
                     body["hedged"] = True
                 return respond(200, body)
             live = [a for a in attempts if a.status == "running"]
+            maybe_prewarm({a.rep.name for a in live})
             if st.complete():
                 # the journal alone already holds the full output.
                 # Normally the live attempt's terminal record is

@@ -520,6 +520,9 @@ class PredictorServer:
                 if self.path == "/generate":
                     self._do_generate()
                     return
+                if self.path == "/prewarm":
+                    self._do_prewarm()
+                    return
                 if self.path != "/predict":
                     self._send(404, {"error": f"no route {self.path}"})
                     return
@@ -679,6 +682,59 @@ class PredictorServer:
                 ok = server.engine.cancel(str(rid))
                 self._send(200, {"cancelled": bool(ok),
                                  "request_id": str(rid)})
+
+            def _do_prewarm(self):
+                """POST /prewarm {"input_ids": [...]} — warm the paged
+                KV prefix cache with a prompt WITHOUT a client waiting
+                on the output: one-token generate through the normal
+                admission path (prefill writes the prompt's pages, the
+                trie keeps them as reusable prefix after the slot
+                retires), result discarded. The router fires this at a
+                STANDBY replica while a journaled stream runs elsewhere,
+                so a failover's resumed prefill lands on trie hits
+                instead of recomputing the whole prefix (ISSUE 17).
+                Best-effort by contract: a busy/warming/unpaged replica
+                sheds with the standard 503/200 truth — the caller loses
+                nothing but the head start."""
+                from .engine import EngineOverloaded
+                if server.engine is None:
+                    self._send(404, {"error": "no generation engine "
+                                              "attached to this server"})
+                    return
+                if server._warm_state == "warming" or server._draining:
+                    self._drain_body()
+                    self._send(503, {"error": "warming_up"
+                                     if server._warm_state == "warming"
+                                     else "draining"})
+                    return
+                payload = self._read_json_body()
+                if payload is None or "input_ids" not in payload:
+                    self._send(400, {"error": "input_ids required"})
+                    return
+                paged = bool(getattr(server.engine, "paged", False))
+                try:
+                    fut = server.engine.submit(payload["input_ids"], 1,
+                                               seed=0)
+                except EngineOverloaded as e:
+                    self._send(503, {"error": e.reason,
+                                     "queue_depth": e.queue_depth})
+                    return
+                except (ValueError, KeyError, TypeError) as e:
+                    self._send(400, {"error": str(e)})
+                    return
+                except Exception as e:   # noqa: BLE001 — broken engine
+                    self._send(503, {"error":
+                                     f"backend_unavailable: {e}"})
+                    return
+                try:
+                    fut.result(timeout=server.deadline_s)
+                except Exception as e:   # noqa: BLE001 — best-effort
+                    self._send(503, {"error":
+                                     f"prewarm_failed: {e}"})
+                    return
+                n = len(np.asarray(payload["input_ids"]).reshape(-1))
+                self._send(200, {"prewarmed": paged,
+                                 "prompt_len": n, "paged": paged})
 
             def _do_admin_inject(self):
                 """POST /admin/inject {"site": s, "count": n,

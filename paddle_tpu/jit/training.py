@@ -114,7 +114,7 @@ def window_schedule(step, k_steps: int):
             np.asarray(upd, bool))
 
 
-def make_scan_window(fwd, optimizer, k, on_trace):
+def make_scan_window(fwd, optimizer, k, on_trace, post_update=None):
     """Build the (un-jitted) K-step fused window function shared by
     :class:`TrainStep` and ``distributed.ParallelTrainStep`` — the ONE
     place the scanned-window contract lives (per-step key
@@ -127,6 +127,11 @@ def make_scan_window(fwd, optimizer, k, on_trace):
     (ParallelTrainStep's opt_state-free fwd_bwd is adapted by its
     caller); ``k`` is accumulate_steps; ``on_trace`` fires inside the
     traced body, so it ticks once per actual XLA (re)trace.
+    ``post_update`` (optional) maps the freshly-updated params pytree
+    right after ``optimizer.apply_gradients`` — ParallelTrainStep's
+    quantized stage-2 path uses it to constrain the weight update into
+    the ZeRO layout (sharded update, one gather at the end); ``None``
+    leaves the traced graph byte-identical to before the hook existed.
 
     Signature of the returned function:
       k == 1:  (params, buffers, opt, key, lrs, steps, counts, *sb)
@@ -150,6 +155,8 @@ def make_scan_window(fwd, optimizer, k, on_trace):
                     *batch)
                 new_params, new_opt = optimizer.apply_gradients(
                     params, grads, opt_state, lr=lr, step=step_no)
+                if post_update is not None:
+                    new_params = post_update(new_params)
                 return (new_params, new_bufs, new_opt), loss
 
             (params, buffers, opt_state), losses = lax.scan(
@@ -177,6 +184,8 @@ def make_scan_window(fwd, optimizer, k, on_trace):
                     lambda a, g: (a + g) / k, acc, grads)
                 new_p, new_o = optimizer.apply_gradients(
                     params, mean, opt_state, lr=lr, step=step_no)
+                if post_update is not None:
+                    new_p = post_update(new_p)
                 zeros = jax.tree_util.tree_map(jnp.zeros_like, acc)
                 return new_p, new_o, zeros
 

@@ -1,11 +1,15 @@
-"""EQuARX-style quantized all-reduce tests (PAPERS.md arXiv 2506.17615;
-SURVEY.md §5.8 quantized-allreduce option)."""
+"""EQuARX-style quantized collective tests (PAPERS.md arXiv 2506.17615;
+SURVEY.md §5.8 quantized-allreduce option): all-reduce plus the
+reduce-scatter / all-gather bodies the quantized ZeRO train step
+(ISSUE 17) is built from."""
 import numpy as np
 import pytest
 
 import paddle_tpu as paddle
 import paddle_tpu.distributed as dist
-from paddle_tpu.distributed.quantized import quantized_all_reduce
+from paddle_tpu.distributed.quantized import (_quantize, quantized_all_gather,
+                                              quantized_all_reduce,
+                                              quantized_reduce_scatter)
 
 
 @pytest.fixture(autouse=True)
@@ -69,3 +73,91 @@ def test_zero_blocks_stay_zero():
     x = np.zeros((4, 128), np.float32)
     got = quantized_all_reduce(paddle.to_tensor(x)).numpy()
     assert (got == 0).all()
+
+
+# ---------------------------------------------------------------------------
+# reduce-scatter / all-gather (the ZeRO train-step building blocks,
+# ISSUE 17): stacked [N, *S] convention like collective.all_reduce
+# ---------------------------------------------------------------------------
+
+def test_quantize_scale_shapes_and_roundtrip():
+    """The wire format itself: q is int8 with one f32 scale per block,
+    and integer payloads whose block max is exactly 127 round-trip
+    bitwise (scale 1)."""
+    import jax.numpy as jnp
+    from paddle_tpu.distributed.quantized import _dequantize
+    x = np.arange(-127, 385, dtype=np.float32)          # 512 elements
+    x = np.clip(x, -127, 127)
+    q, s = _quantize(jnp.asarray(x), 128, 127.0)
+    assert q.dtype == jnp.int8 and q.shape == (512,)
+    assert s.dtype == jnp.float32 and s.shape == (4,)   # 512 / 128
+    back = np.asarray(_dequantize(q, s, 128))
+    assert np.array_equal(back, x)                      # scale exactly 1
+
+
+def test_reduce_scatter_padded_tail():
+    """Chunk size 2*33=66 is not a multiple of block 64: the zero-padded
+    tail blocks must not perturb the real elements (error stays within
+    the single-rounding bound of the UNPADDED payload)."""
+    dist.init_mesh({"dp": 4})
+    rng = np.random.RandomState(3)
+    x = rng.randn(4, 8, 33).astype(np.float32)
+    got = quantized_reduce_scatter(paddle.to_tensor(x.copy()),
+                                   block=64, dim=0).numpy()
+    assert got.shape == (4, 2, 33)
+    want = x.sum(0)                                     # [8, 33]
+    n = 4
+    bound = n * np.abs(x).max() / 254 + 1e-5            # one hop, N terms
+    for k in range(n):
+        chunk = want[2 * k:2 * (k + 1)]
+        assert np.abs(got[k] - chunk).max() <= bound
+
+
+def test_reduce_scatter_integer_exact_at_block_edge():
+    """Integer partials with every block max pinned at 127 and the
+    per-rank chunk exactly one scale block: scale is 1, the single
+    rounding is exact, and the f32 accumulate makes the scattered sums
+    bitwise-equal to the true sums."""
+    dist.init_mesh({"dp": 4})
+    rng = np.random.RandomState(4)
+    x = rng.randint(-100, 101, (4, 1024)).astype(np.float32)
+    x[:, ::256] = 127.0                                 # pin block scales
+    got = quantized_reduce_scatter(paddle.to_tensor(x.copy()),
+                                   block=256, dim=0).numpy()
+    want = x.sum(0).reshape(4, 256)
+    assert np.array_equal(got, want)
+
+
+def test_reduce_scatter_rejects_indivisible_dim():
+    dist.init_mesh({"dp": 4})
+    x = np.zeros((4, 7, 8), np.float32)
+    with pytest.raises(ValueError):
+        quantized_reduce_scatter(paddle.to_tensor(x), dim=0)
+
+
+def test_all_gather_roundtrip_rows_identical():
+    """Each rank contributes a distinct shard; the gathered result must
+    concatenate them along dim with one bounded rounding per element,
+    and every output row must be the identical full tensor."""
+    dist.init_mesh({"dp": 4})
+    rng = np.random.RandomState(5)
+    x = rng.randn(4, 5, 7).astype(np.float32)
+    got = quantized_all_gather(paddle.to_tensor(x.copy()),
+                               block=32, dim=0).numpy()
+    assert got.shape == (4, 20, 7)
+    assert (got == got[0]).all()                        # AG semantics
+    want = x.reshape(20, 7)                             # concat along dim 0
+    bound = np.abs(x).max() / 254 + 1e-6                # one rounding
+    assert np.abs(got[0] - want).max() <= bound
+
+
+def test_all_gather_integer_exact():
+    """Block-edge shard (size == block) of ints with the scale pinned to
+    1: the gather must be bitwise."""
+    dist.init_mesh({"dp": 4})
+    rng = np.random.RandomState(6)
+    x = rng.randint(-127, 128, (4, 256)).astype(np.float32)
+    x[:, 0] = 127.0
+    got = quantized_all_gather(paddle.to_tensor(x.copy()),
+                               block=256, dim=0).numpy()
+    assert np.array_equal(got[0], x.reshape(-1))

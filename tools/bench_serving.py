@@ -832,7 +832,11 @@ def bench_recovery(smoke: bool):
     ptpu_router_recoveries_total and a flight_request_recovery
     artifact names the migrated request ids, and the survivor's
     compiled-program count is UNCHANGED (resume rides the registered
-    admit/decode programs — zero new XLA programs).
+    admit/decode programs — zero new XLA programs). The router also
+    pre-warms the journaled prefix on the standby as it grows
+    (ISSUE 17): prewarms >= 1 and prewarmed_resumes >= 1 are gated —
+    at least one cutover landed on a replica whose trie the router
+    had warmed for that request ahead of the splice.
 
     Phase 2 — stall-hedge: one replica's decode loop is wedged via
     the replica_stall fault site (latency injection through
@@ -1044,6 +1048,11 @@ def bench_recovery(smoke: bool):
     # counters only after the leak-free wait above gave them time
     hedge_stats = {k: router.stats_counters[k] for k in
                    ("hedges", "hedge_wins", "cancels_sent")}
+    # standby prefix pre-warming (ISSUE 17): the router pushed the
+    # journaled prefix to the standby BEFORE the kill, and at least one
+    # resume cut over onto a replica it had pre-warmed for that request
+    prewarm_stats = {k: router.stats_counters[k] for k in
+                     ("prewarms", "prewarmed_resumes")}
 
     stats = dict(router.stats_counters)
     router.stop()
@@ -1061,6 +1070,8 @@ def bench_recovery(smoke: bool):
         and hedge_stats["hedges"] >= 1
         and hedge_stats["hedge_wins"] >= 1
         and hedge_stats["cancels_sent"] >= 1
+        and prewarm_stats["prewarms"] >= 1
+        and prewarm_stats["prewarmed_resumes"] >= 1
         and stall_phase["p99_ms"] < wedge_s * 1e3
         and leak_free)
     return {
@@ -1073,6 +1084,7 @@ def bench_recovery(smoke: bool):
         "survivor_prefix_hits": prefix_hits_after,
         "survivor_compiles_delta": compiles_delta,
         "hedge": hedge_stats,
+        "prewarm": prewarm_stats,
         "stall_wedge_s": wedge_s,
         "stall_p99_vs_wedge": round(
             stall_phase["p99_ms"] / (wedge_s * 1e3), 3),

@@ -89,6 +89,12 @@ QUICK_FILES = [
     # compiles), the dispatch-ratchet/anchor gate semantics, one live
     # profiled registry program, and the efficiency gauges
     "tests/test_runtime_profile.py",
+    # quantized ZeRO collectives (ISSUE 17): RS/AG wire round-trips
+    # (padded tails, block edges, integer exactness) + the train-step
+    # knob — fp32 bitwise, bf16/int8 drift bounds, zero-recompile
+    # flips, stage-3 gather chain/schedule, sharded optimizer state
+    "tests/test_quantized_allreduce.py",
+    "tests/test_quantized_trainstep.py",
 ]
 
 
@@ -149,6 +155,22 @@ def _run_stream_smoke(env) -> int:
     return subprocess.run(
         [sys.executable, os.path.join("tools", "bench_serving.py"),
          "--stream", "--smoke"],
+        cwd=ROOT, env=env).returncode
+
+
+def _run_comm_smoke(env) -> int:
+    """Comm smoke (ISSUE 17): tools/bench_collectives.py --smoke A/Bs
+    the SAME GPT-tiny ParallelTrainStep (ZeRO-2 + ZeRO-3) at
+    comm_precision fp32/bf16/int8 on an 8-virtual-device dp2 x
+    sharding4 mesh — gating the per-chip collective-byte reduction
+    (>=1.8x bf16 / >=3.5x int8), the loss drift bounds vs fp32, and
+    the stage-3 gather chain + interleaved schedule. The tool re-execs
+    itself onto the virtual mesh and strips the persistent compile
+    cache (multi-device reload hazard + fresh-compile wall times)."""
+    print("\n=== comm smoke (quantized ZeRO collectives A/B) ===")
+    return subprocess.run(
+        [sys.executable, os.path.join("tools", "bench_collectives.py"),
+         "--smoke"],
         cwd=ROOT, env=env).returncode
 
 
@@ -309,6 +331,12 @@ def main():
                          "mid-stream chaos + per-class degradation + "
                          "affinity A/B) that --quick/--full append "
                          "after the tests")
+    ap.add_argument("--no-comm-smoke", action="store_true",
+                    help="skip the quantized-collectives smoke "
+                         "(tools/bench_collectives.py --smoke: "
+                         "fp32/bf16/int8 byte + drift + overlap gates "
+                         "on the 8-virtual-device mesh) that "
+                         "--quick/--full append after the tests")
     ap.add_argument("-k", default=None)
     args = ap.parse_args()
     if args.full and args.quick:
@@ -423,6 +451,11 @@ def main():
         # cache_env for the same reason as the recovery smoke
         stream_rc = _run_stream_smoke(cache_env)
         rc = rc or stream_rc
+    if (args.quick or args.full) and not args.no_comm_smoke:
+        # plain env: the tool strips the persistent cache itself
+        # (multi-device reload hazard + fresh-compile wall times)
+        comm_rc = _run_comm_smoke(env)
+        rc = rc or comm_rc
     return rc
 
 

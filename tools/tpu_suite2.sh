@@ -62,6 +62,15 @@ run bench_serving_recovery bench_serving_recovery.json \
 # once landed
 run bench_serving_stream bench_serving_stream.json \
     python tools/bench_serving.py --stream
+# quantized ZeRO collectives A/B (ISSUE 17): the SAME GPT-tiny
+# ParallelTrainStep (ZeRO-2 + ZeRO-3) at comm_precision fp32/bf16/int8
+# on a virtual 64-device dp8 x sharding8 mesh — per-chip collective
+# bytes (>=1.8x bf16 / >=3.5x int8 reduction gated), step wall time,
+# loss max-rel drift vs fp32, and the stage-3 gather/compute overlap
+# schedule (chain links + interleaving, analysis/collective_schedule);
+# re-execs onto the virtual mesh itself; self-skips once landed
+run bench_collectives bench_collectives.json \
+    python tools/bench_collectives.py
 # obs decode-tick overhead gate (ISSUE 8): enabled-vs-disabled tick
 # time, paired-median on/off rounds; asserts the ratio <= 1.02 —
 # self-skips once landed like every other step
