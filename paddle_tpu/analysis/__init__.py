@@ -15,6 +15,13 @@ systematic way to inspect it BEFORE it reaches hardware:
 - codebase_lint: AST pass over the tree — retrace-per-call jit idioms,
   traced attribute mutation in Layer.forward (the aux_loss.py class of
   bug), numpy on traced values, stale quarantine entries.
+- concurrency:   the tpurace pass — per-class guarded-attribute
+  inference over the same AST walk: guarded attrs touched outside
+  their lock, blocking calls under a lock, a cross-class static
+  lock-order graph with cycle detection, unlocked check-then-act,
+  orphan non-daemon threads; `tools/tpurace.py` gates CI on the diff
+  against tools/tpurace_baseline.json (runtime half: obs/locks.py +
+  tools/race_hunt.py).
 - manifest:      the real serving/training programs (engine decode,
   generate prefill, TrainStep, ParallelTrainStep on a fake 4-device
   mesh) rebuilt and linted; `tools/tpulint.py` gates CI on the diff
@@ -35,6 +42,7 @@ systematic way to inspect it BEFORE it reaches hardware:
 CLIs: python tools/tpulint.py [--update-baseline] [--json out.json]
       python tools/tpucost.py [--update-baseline] [--json out.json]
       python tools/tpuprof.py [--update-baseline] [--json out.json]
+      python tools/tpurace.py [--update-baseline] [--json out.json]
 """
 from .findings import (Finding, Severity, count_findings,
                        diff_against_baseline, findings_to_json,
@@ -43,6 +51,8 @@ from .program_lint import collective_inventory_from_hlo, lint_program
 from .recompile import abstract_signature, recompile_report
 from .codebase_lint import (HOT_JIT_FILES, lint_file, lint_quarantine,
                             lint_tree)
+from .concurrency import (collect_classes, lint_concurrency_file,
+                          lint_concurrency_paths, lint_concurrency_tree)
 from .manifest import (MANIFEST_PROGRAMS, ProgramSpec, default_manifest,
                        manifest_names, run_manifest)
 from .hlo_cost import (CHIP_SPECS, DEFAULT_CHIP, ChipSpec,
@@ -68,6 +78,8 @@ __all__ = [
     "lint_program", "collective_inventory_from_hlo",
     "abstract_signature", "recompile_report",
     "lint_tree", "lint_file", "lint_quarantine", "HOT_JIT_FILES",
+    "lint_concurrency_tree", "lint_concurrency_file",
+    "lint_concurrency_paths", "collect_classes",
     "ProgramSpec", "default_manifest", "run_manifest",
     "MANIFEST_PROGRAMS", "manifest_names",
     "ChipSpec", "CHIP_SPECS", "DEFAULT_CHIP", "parse_hlo_module",

@@ -1,0 +1,776 @@
+"""tpurace static lock-discipline lint: AST pass over the tree for the
+race/deadlock hazard classes the serving+training concurrency surface
+has hand-fixed one at a time (the registry ``get`` deadlock PR 5, the
+``_pool_is_binding`` engine-thread race PR 9, journal first-writer-wins
+conflicts PR 15).
+
+The model is guarded-attribute inference, per class:
+
+- **Lock attributes** are ``self.X = threading.Lock()/RLock()/
+  Condition()`` (or the ``paddle_tpu.obs.locks`` ``make_lock`` /
+  ``make_rlock`` / ``make_condition`` factories — the sanitizer
+  adoption must not blind the lint).
+- **Guarded attributes** are attributes WRITTEN at least once while a
+  ``with self.<lock>:`` is held, in any method other than
+  ``__init__``. Writes are plain/aug assignment, subscript assignment,
+  ``del``, and calls of known container mutators
+  (``append``/``pop``/``update``/...).
+- Findings:
+  * ``race-unguarded-attr`` — a guarded attr read or written outside
+    every lock of its class. Cross-class accesses count too: the lint
+    types ``self.j = j`` from annotated ``__init__`` params (and
+    simple local aliases), so ``j.tokens`` touched outside
+    ``j.cond`` in ANOTHER class is the same finding.
+  * ``race-blocking-under-lock`` — while a lock is held (a ``with``,
+    or a ``*_locked``-suffix method, the caller-holds-the-lock
+    convention): ``time.sleep``, ``urlopen``/socket connects,
+    ``subprocess`` calls, ``future.result()``, jax device fetch /
+    ``block_until_ready``. ``.wait()`` on a condition is exempt — it
+    RELEASES the lock.
+  * ``race-lock-order`` — edges of the static lock-order graph
+    (nested ``with``s, plus one-hop ``self.m()`` / typed ``obj.m()``
+    calls into lock-taking methods) that close a cycle.
+  * ``race-check-then-act`` (warn) — in a lock-owning class, an
+    ``if`` that reads ``self.X`` deciding a write of ``self.X``,
+    outside the lock.
+  * ``race-orphan-thread`` — ``threading.Thread`` created non-daemon
+    with no ``.join()`` path on the attribute it is stored to.
+
+Conventions the lint honors (they are load-bearing in this tree):
+``__init__``/``__del__`` are single-threaded by contract and exempt
+from guarded-attr/check-then-act flagging; a ``*_locked``-suffix
+method asserts "caller holds the lock" (the ``_QosScheduler`` idiom)
+and is exempt from unguarded-attr but TREATED AS LOCKED for
+blocking-under-lock.
+
+Suppression: ``# tpurace: disable=<code>`` (or a bare ``disable``) on
+the flagged line. Sites are ``Class::attr`` / ``Class::method`` —
+"::"-separated so baseline ``must_stay_clean`` anchors can pin a whole
+class (``race-unguarded-attr::<file>::<Class>``) at a prefix boundary.
+
+Gate: ``tools/tpurace.py`` vs ``tools/tpurace_baseline.json``.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from .findings import (RACE_BLOCKING_UNDER_LOCK, RACE_CHECK_THEN_ACT,
+                       RACE_LOCK_ORDER, RACE_ORPHAN_THREAD,
+                       RACE_UNGUARDED_ATTR, Finding, Severity)
+
+__all__ = ["lint_concurrency_tree", "lint_concurrency_paths",
+           "lint_concurrency_file", "collect_classes", "ClassInfo"]
+
+_DISABLE_RE = re.compile(r"#\s*tpurace:\s*disable(?:=([\w,-]+))?")
+
+# threading constructors / sanitizer factories that make self.X a lock
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+_LOCK_FACTORIES = {"make_lock", "make_rlock", "make_condition"}
+
+# container-mutator method names that count as WRITES of self.X for
+# guarded-attribute inference (self._queue.append(...) under the lock
+# is what marks _queue guarded)
+_MUTATORS = {"append", "extend", "insert", "remove", "pop", "clear",
+             "update", "setdefault", "popitem", "add", "discard",
+             "appendleft", "popleft", "sort", "reverse"}
+
+# callables that BLOCK while a lock is held (module.attr or bare name)
+_BLOCKING_CALLS = {
+    ("time", "sleep"), ("socket", "create_connection"),
+    ("subprocess", "run"), ("subprocess", "call"),
+    ("subprocess", "check_call"), ("subprocess", "check_output"),
+    ("subprocess", "Popen"), ("jax", "device_get"),
+}
+# attribute-call names that block regardless of receiver
+_BLOCKING_ATTRS = {"urlopen", "result", "block_until_ready"}
+
+
+def _disabled_codes(line: str) -> Optional[Set[str]]:
+    m = _DISABLE_RE.search(line)
+    if not m:
+        return None
+    if not m.group(1):
+        return set()               # bare disable: every code
+    return {c.strip() for c in m.group(1).split(",")}
+
+
+def _ann_name(ann) -> Optional[str]:
+    """Class name out of a parameter annotation: ``j: _ReqJournal``,
+    ``router: "Router"`` (string forward refs), ``rep: mod.Replica``.
+    Optional[...] and other generics are ignored — precise enough."""
+    if isinstance(ann, ast.Name):
+        return ann.id
+    if isinstance(ann, ast.Attribute):
+        return ann.attr
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        return ann.value.strip().split(".")[-1].strip("'\" ") or None
+    return None
+
+
+def _is_self_attr(node) -> Optional[str]:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _is_lock_ctor(value: ast.AST) -> bool:
+    """``threading.Lock()`` / ``Condition(...)`` / ``make_lock(...)``
+    (bare or via any module alias: ``locks.make_rlock``)."""
+    if not isinstance(value, ast.Call):
+        return False
+    f = value.func
+    name = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else None)
+    return name in _LOCK_CTORS or name in _LOCK_FACTORIES
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    relpath: str
+    lock_attrs: Set[str] = field(default_factory=set)
+    guarded: Set[str] = field(default_factory=set)
+    attr_types: Dict[str, str] = field(default_factory=dict)
+    # method -> lock attrs its body acquires via `with self.X`
+    method_locks: Dict[str, Set[str]] = field(default_factory=dict)
+    joined_attrs: Set[str] = field(default_factory=set)   # self.X.join(
+
+
+# ---------------------------------------------------------------------------
+# pass 1: per-class inventory
+# ---------------------------------------------------------------------------
+
+class _Collector(ast.NodeVisitor):
+    def __init__(self, relpath: str):
+        self.relpath = relpath
+        self.classes: Dict[str, ClassInfo] = {}
+        self._cls: List[ClassInfo] = []
+        self._fn: List[str] = []
+        self._held = 0                 # depth of self-lock withs
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        info = ClassInfo(node.name, self.relpath)
+        self.classes[node.name] = info
+        self._cls.append(info)
+        self.generic_visit(node)
+        self._cls.pop()
+
+    def _visit_fn(self, node):
+        self._fn.append(node.name)
+        cls = self._cls[-1] if self._cls else None
+        if cls is not None and len(self._fn) == 1:
+            cls.method_locks.setdefault(node.name, set())
+            if node.name == "__init__":
+                # annotated params give self.X = param its type
+                anns = {}
+                args = node.args
+                for a in (args.posonlyargs + args.args
+                          + args.kwonlyargs):
+                    t = _ann_name(a.annotation)
+                    if t:
+                        anns[a.arg] = t
+                for stmt in node.body:
+                    if (isinstance(stmt, ast.Assign)
+                            and len(stmt.targets) == 1):
+                        attr = _is_self_attr(stmt.targets[0])
+                        if not attr:
+                            continue
+                        v = stmt.value
+                        if (isinstance(v, ast.Name)
+                                and v.id in anns):
+                            cls.attr_types[attr] = anns[v.id]
+                        elif (isinstance(v, ast.Call)
+                              and isinstance(v.func, ast.Name)):
+                            cls.attr_types[attr] = v.func.id
+        self.generic_visit(node)
+        self._fn.pop()
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    def visit_With(self, node: ast.With):
+        cls = self._cls[-1] if self._cls else None
+        takes = []
+        for item in node.items:
+            attr = _is_self_attr(item.context_expr)
+            if cls is not None and attr and attr in cls.lock_attrs:
+                takes.append(attr)
+        if takes and cls is not None and self._fn:
+            cls.method_locks.setdefault(self._fn[0], set()).update(takes)
+        self._held += len(takes)
+        self.generic_visit(node)
+        self._held -= len(takes)
+
+    def _note_write(self, attr: str):
+        cls = self._cls[-1] if self._cls else None
+        if (cls is None or not self._fn or self._fn[0] == "__init__"
+                or attr in cls.lock_attrs):
+            return
+        if self._held > 0:
+            cls.guarded.add(attr)
+
+    def visit_Assign(self, node: ast.Assign):
+        cls = self._cls[-1] if self._cls else None
+        for t in node.targets:
+            attr = _is_self_attr(t)
+            if attr:
+                if cls is not None and _is_lock_ctor(node.value):
+                    cls.lock_attrs.add(attr)
+                    cls.guarded.discard(attr)
+                else:
+                    self._note_write(attr)
+            elif isinstance(t, ast.Subscript):
+                a2 = _is_self_attr(t.value)
+                if a2:
+                    self._note_write(a2)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        attr = _is_self_attr(node.target)
+        if attr:
+            self._note_write(attr)
+        elif isinstance(node.target, ast.Subscript):
+            a2 = _is_self_attr(node.target.value)
+            if a2:
+                self._note_write(a2)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete):
+        for t in node.targets:
+            if isinstance(t, ast.Subscript):
+                attr = _is_self_attr(t.value)
+                if attr:
+                    self._note_write(attr)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            base_attr = _is_self_attr(f.value)
+            if base_attr:
+                if f.attr in _MUTATORS:
+                    self._note_write(base_attr)
+                if f.attr == "join" and self._cls:
+                    self._cls[-1].joined_attrs.add(base_attr)
+        self.generic_visit(node)
+
+
+def collect_classes(paths: List[str], root: str) -> Dict[str, ClassInfo]:
+    """Pass 1 over ``paths``: per-class lock attrs, guarded attrs,
+    attribute types, method->locks map. Keyed by class NAME (the tree
+    keeps concurrency-bearing class names unique; a collision merges
+    conservatively toward more findings, never fewer)."""
+    out: Dict[str, ClassInfo] = {}
+    for path in paths:
+        relpath = os.path.relpath(path, root).replace(os.sep, "/")
+        try:
+            with open(path, encoding="utf-8") as fh:
+                tree = ast.parse(fh.read(), filename=path)
+        except (OSError, SyntaxError):
+            continue
+        c = _Collector(relpath)
+        c.visit(tree)
+        for name, info in c.classes.items():
+            prev = out.get(name)
+            if prev is None:
+                out[name] = info
+            else:
+                prev.lock_attrs |= info.lock_attrs
+                prev.guarded |= info.guarded
+                prev.attr_types.update(info.attr_types)
+                for m, ls in info.method_locks.items():
+                    prev.method_locks.setdefault(m, set()).update(ls)
+                prev.joined_attrs |= info.joined_attrs
+    return out
+
+
+# ---------------------------------------------------------------------------
+# pass 2: flagging
+# ---------------------------------------------------------------------------
+
+def _exempt_method(name: str) -> bool:
+    return name in ("__init__", "__del__") or name.endswith("_locked")
+
+
+class _Access:
+    __slots__ = ("line", "method", "write")
+
+    def __init__(self, line, method, write):
+        self.line = line
+        self.method = method
+        self.write = write
+
+
+class _Flagger(ast.NodeVisitor):
+    """One file's flagging walk. Shared mutable state across files:
+    ``order_edges`` (the static lock-order graph) and the aggregated
+    ``unguarded`` access map."""
+
+    def __init__(self, relpath: str, lines: List[str],
+                 classes: Dict[str, ClassInfo],
+                 unguarded: Dict[Tuple[str, str, str], List[_Access]],
+                 order_edges: Dict[Tuple[str, str], dict]):
+        self.relpath = relpath
+        self.lines = lines
+        self.classes = classes
+        self.unguarded = unguarded
+        self.order_edges = order_edges
+        self.findings: List[Finding] = []
+        self._cls: List[Optional[ClassInfo]] = []
+        self._fn: List[str] = []
+        # held locks: list of (base_key, ClassName, lockattr)
+        # base_key: ("self",) or ("local", varname) or
+        # ("selfattr", fieldname)
+        self._held: List[Tuple[tuple, str, str]] = []
+        self._local_types: List[Dict[str, str]] = []
+        self._blocking_seen: Set[Tuple[str, str]] = set()
+        self._cta_seen: Set[Tuple[str, str]] = set()
+
+    # -- plumbing ----------------------------------------------------------
+    def _suppressed(self, node, code) -> bool:
+        ln = getattr(node, "lineno", 0)
+        if 1 <= ln <= len(self.lines):
+            dis = _disabled_codes(self.lines[ln - 1])
+            if dis is not None and (not dis or code in dis):
+                return True
+        return False
+
+    def _emit(self, node, code, severity, site, message, data=None):
+        if self._suppressed(node, code):
+            return
+        self.findings.append(Finding(
+            code, severity, self.relpath, site, message,
+            dict(data or {}, line=getattr(node, "lineno", 0))))
+
+    def _cur_cls(self) -> Optional[ClassInfo]:
+        return self._cls[-1] if self._cls else None
+
+    def _cur_fn(self) -> str:
+        return self._fn[0] if self._fn else "<module>"
+
+    def _type_of(self, node) -> Optional[str]:
+        """Static type of an expression, best effort: a local alias /
+        annotated param, or ``self.field`` with a known field type."""
+        if isinstance(node, ast.Name):
+            for scope in reversed(self._local_types):
+                if node.id in scope:
+                    return scope[node.id]
+            return None
+        attr = _is_self_attr(node)
+        if attr is not None:
+            cls = self._cur_cls()
+            if cls is not None:
+                return cls.attr_types.get(attr)
+        return None
+
+    def _base_key(self, node) -> Optional[tuple]:
+        if isinstance(node, ast.Name):
+            return ("local", node.id)
+        attr = _is_self_attr(node)
+        if attr is not None:
+            return ("selfattr", attr)
+        return None
+
+    def _holds(self, base_key: tuple, cls_name: str) -> bool:
+        """Is ANY lock of ``cls_name`` held for this base object (or,
+        for self accesses, any self lock)?"""
+        return any(b == base_key and c == cls_name
+                   for b, c, _ in self._held)
+
+    # -- scope -------------------------------------------------------------
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self._cls.append(self.classes.get(node.name))
+        self.generic_visit(node)
+        self._cls.pop()
+
+    def _visit_fn(self, node):
+        self._fn.append(node.name)
+        scope: Dict[str, str] = {}
+        args = node.args
+        for a in (args.posonlyargs + args.args + args.kwonlyargs):
+            t = _ann_name(a.annotation)
+            if t and t in self.classes:
+                scope[a.arg] = t
+        self._local_types.append(scope)
+        # a *_locked method asserts the caller holds every lock of the
+        # class: model that for blocking-under-lock purposes
+        cls = self._cur_cls()
+        pushed = 0
+        if (cls is not None and len(self._fn) == 1
+                and node.name.endswith("_locked")):
+            for la in sorted(cls.lock_attrs):
+                self._held.append((("self",), cls.name, la))
+                pushed += 1
+        self.generic_visit(node)
+        for _ in range(pushed):
+            self._held.pop()
+        self._local_types.pop()
+        self._fn.pop()
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    # -- alias tracking ----------------------------------------------------
+    def _track_alias(self, target, value):
+        if not isinstance(target, ast.Name) or not self._local_types:
+            return
+        t = self._type_of(value)
+        if t:
+            self._local_types[-1][target.id] = t
+        else:
+            self._local_types[-1].pop(target.id, None)
+
+    # -- with: lock acquisition -------------------------------------------
+    def _lock_of(self, expr) -> Optional[Tuple[tuple, str, str]]:
+        """``with <expr>:`` — is expr a known lock? Returns
+        (base_key, ClassName, lockattr)."""
+        if not isinstance(expr, ast.Attribute):
+            return None
+        # self.X
+        attr = _is_self_attr(expr)
+        cls = self._cur_cls()
+        if attr is not None:
+            if cls is not None and attr in cls.lock_attrs:
+                return (("self",), cls.name, attr)
+            return None
+        # obj.X / self.field.X with typed base
+        t = self._type_of(expr.value)
+        if t and t in self.classes \
+                and expr.attr in self.classes[t].lock_attrs:
+            bk = self._base_key(expr.value)
+            if bk is not None:
+                return (bk, t, expr.attr)
+        return None
+
+    def _add_order_edge(self, src: Tuple[str, str], dst: Tuple[str, str],
+                        node):
+        if src == dst:
+            return        # reentrant same-lock: RLock territory
+        a = f"{src[0]}.{src[1]}"
+        b = f"{dst[0]}.{dst[1]}"
+        if a == b:
+            return
+        self.order_edges.setdefault((a, b), {
+            "file": self.relpath, "line": getattr(node, "lineno", 0),
+            "method": f"{self._cur_cls().name if self._cur_cls() else '<module>'}"
+                      f"::{self._cur_fn()}"})
+
+    def visit_With(self, node: ast.With):
+        taken = []
+        for item in node.items:
+            lk = self._lock_of(item.context_expr)
+            if lk is not None:
+                for _, hc, hl in self._held:
+                    self._add_order_edge((hc, hl), (lk[1], lk[2]),
+                                         item.context_expr)
+                self._held.append(lk)
+                taken.append(lk)
+        self.generic_visit(node)
+        for _ in taken:
+            self._held.pop()
+
+    # -- accesses ----------------------------------------------------------
+    def _flag_access(self, node: ast.Attribute, write: bool):
+        attr = node.attr
+        base_self = _is_self_attr(node)
+        if base_self is not None:
+            cls = self._cur_cls()
+            if (cls is None or attr not in cls.guarded
+                    or _exempt_method(self._cur_fn())
+                    or (len(self._fn) != 1
+                        and not self._fn)):
+                return
+            if self._holds(("self",), cls.name):
+                return
+            if self._suppressed(node, RACE_UNGUARDED_ATTR):
+                return
+            key = (self.relpath, cls.name, attr)
+            self.unguarded.setdefault(key, []).append(
+                _Access(node.lineno, self._cur_fn(), write))
+            return
+        # typed foreign object: obj.attr
+        t = self._type_of(node.value)
+        if not t or t not in self.classes:
+            return
+        info = self.classes[t]
+        if attr not in info.guarded:
+            return
+        bk = self._base_key(node.value)
+        if bk is None or self._holds(bk, t):
+            return
+        if self._suppressed(node, RACE_UNGUARDED_ATTR):
+            return
+        key = (self.relpath, t, attr)
+        self.unguarded.setdefault(key, []).append(
+            _Access(node.lineno, self._cur_fn(), write))
+
+    def visit_Attribute(self, node: ast.Attribute):
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            self._flag_access(node, write=True)
+        elif isinstance(node.ctx, ast.Load):
+            # loads that are just the base of a deeper attribute /
+            # call get visited naturally; flag the leaf access only
+            self._flag_access(node, write=False)
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign):
+        if len(node.targets) == 1:
+            self._track_alias(node.targets[0], node.value)
+            if isinstance(node.targets[0], ast.Tuple) \
+                    and isinstance(node.value, ast.Tuple) \
+                    and len(node.targets[0].elts) == len(node.value.elts):
+                for t, v in zip(node.targets[0].elts, node.value.elts):
+                    self._track_alias(t, v)
+        self.generic_visit(node)
+
+    # -- blocking under lock ----------------------------------------------
+    def _call_blocks(self, node: ast.Call) -> Optional[str]:
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if isinstance(f.value, ast.Name) \
+                    and (f.value.id, f.attr) in _BLOCKING_CALLS:
+                return f"{f.value.id}.{f.attr}"
+            if f.attr in _BLOCKING_ATTRS:
+                # cond.wait() releases the lock — but .wait is not in
+                # the list anyway; .result on a lock-ish receiver is
+                # still a future by convention here
+                return f".{f.attr}"
+        elif isinstance(f, ast.Name) and f.id in _BLOCKING_ATTRS:
+            return f.id
+        return None
+
+    def visit_Call(self, node: ast.Call):
+        # mutator calls count as writes of the receiver attr
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in _MUTATORS:
+            if isinstance(f.value, ast.Attribute):
+                self._flag_access(f.value, write=True)
+        if self._held:
+            what = self._call_blocks(node)
+            if what is not None:
+                cls = self._cur_cls()
+                site = (f"{cls.name if cls else '<module>'}"
+                        f"::{self._cur_fn()}::{what.lstrip('.')}")
+                dkey = (site, self.relpath)
+                if dkey not in self._blocking_seen:
+                    self._blocking_seen.add(dkey)
+                    held = ", ".join(f"{c}.{l}" for _, c, l in
+                                     self._held)
+                    self._emit(
+                        node, RACE_BLOCKING_UNDER_LOCK, Severity.WARN,
+                        site,
+                        f"blocking call {what} while holding {held} — "
+                        "every other thread contending on that lock "
+                        "stalls for the full duration; move the "
+                        "blocking work outside the critical section",
+                        {"held": held})
+        self.generic_visit(node)
+
+    # -- check-then-act ----------------------------------------------------
+    def _attrs_read(self, expr) -> Set[str]:
+        out = set()
+        for n in ast.walk(expr):
+            a = _is_self_attr(n)
+            if a is not None and isinstance(n.ctx, ast.Load):
+                out.add(a)
+        return out
+
+    def _attrs_written(self, stmts) -> Set[str]:
+        out = set()
+        for stmt in stmts:
+            for n in ast.walk(stmt):
+                if isinstance(n, ast.Attribute) \
+                        and isinstance(n.ctx, (ast.Store, ast.Del)):
+                    a = _is_self_attr(n)
+                    if a is not None:
+                        out.add(a)
+                elif isinstance(n, ast.Subscript) \
+                        and isinstance(n.ctx, (ast.Store, ast.Del)):
+                    a = _is_self_attr(n.value)
+                    if a is not None:
+                        out.add(a)
+                elif isinstance(n, ast.Call) \
+                        and isinstance(n.func, ast.Attribute) \
+                        and n.func.attr in _MUTATORS:
+                    a = _is_self_attr(n.func.value)
+                    if a is not None:
+                        out.add(a)
+        return out
+
+    def visit_If(self, node: ast.If):
+        cls = self._cur_cls()
+        if (cls is not None and cls.lock_attrs and not self._held
+                and self._fn and not _exempt_method(self._cur_fn())):
+            hot = ((self._attrs_read(node.test)
+                    & self._attrs_written(node.body))
+                   - cls.lock_attrs)
+            for attr in sorted(hot):
+                site = f"{cls.name}::{self._cur_fn()}::{attr}"
+                if (site, self.relpath) in self._cta_seen:
+                    continue
+                self._cta_seen.add((site, self.relpath))
+                self._emit(
+                    node, RACE_CHECK_THEN_ACT, Severity.WARN, site,
+                    f"check-then-act on self.{attr} outside "
+                    f"{'/'.join(sorted(cls.lock_attrs))} — the state "
+                    "tested can change between the test and the write; "
+                    "take the lock around the pair (or mark the method "
+                    "*_locked if the caller already holds it)")
+        self.generic_visit(node)
+
+    # -- orphan threads ----------------------------------------------------
+    def _is_thread_ctor(self, node: ast.Call) -> bool:
+        f = node.func
+        return ((isinstance(f, ast.Attribute) and f.attr == "Thread"
+                 and isinstance(f.value, ast.Name)
+                 and f.value.id == "threading")
+                or (isinstance(f, ast.Name) and f.id == "Thread"))
+
+    def generic_visit(self, node):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.value, ast.Call) \
+                and self._is_thread_ctor(node.value):
+            self._check_thread(node.value, _is_self_attr(node.targets[0]))
+        elif isinstance(node, ast.Expr) \
+                and isinstance(node.value, ast.Call):
+            call = node.value
+            # threading.Thread(...).start() chains
+            if isinstance(call.func, ast.Attribute) \
+                    and call.func.attr == "start" \
+                    and isinstance(call.func.value, ast.Call) \
+                    and self._is_thread_ctor(call.func.value):
+                self._check_thread(call.func.value, None)
+        super().generic_visit(node)
+
+    def _check_thread(self, ctor: ast.Call, stored_attr: Optional[str]):
+        for kw in ctor.keywords:
+            if kw.arg == "daemon" and isinstance(kw.value, ast.Constant) \
+                    and kw.value.value:
+                return
+        cls = self._cur_cls()
+        if stored_attr and cls is not None \
+                and stored_attr in cls.joined_attrs:
+            return                 # non-daemon but joined: a stop() path
+        site = (f"{cls.name if cls else '<module>'}::{self._cur_fn()}")
+        self._emit(
+            ctor, RACE_ORPHAN_THREAD, Severity.WARN, site,
+            "non-daemon Thread with no joining stop() path — it will "
+            "outlive (and hang) interpreter shutdown; pass daemon=True "
+            "or store it on self and join it in stop()/close()",
+            {"stored_as": stored_attr or ""})
+
+
+# ---------------------------------------------------------------------------
+# cycle detection + assembly
+# ---------------------------------------------------------------------------
+
+def _find_cycles(edges: Dict[Tuple[str, str], dict]) -> List[List[str]]:
+    """Elementary cycles in the lock-order graph, deduped by node set
+    (one finding per distinct cycle, whatever rotation found it)."""
+    graph: Dict[str, List[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, []).append(b)
+        graph.setdefault(b, [])
+    cycles: List[List[str]] = []
+    seen_sets: Set[frozenset] = set()
+
+    def dfs(start: str, node: str, path: List[str], visited: Set[str]):
+        for nxt in graph.get(node, ()):
+            if nxt == start and len(path) > 1:
+                key = frozenset(path)
+                if key not in seen_sets:
+                    seen_sets.add(key)
+                    lo = min(range(len(path)), key=lambda i: path[i])
+                    cycles.append(path[lo:] + path[:lo])
+            elif nxt not in visited and nxt > start:
+                # only walk nodes > start: each cycle is discovered
+                # from its smallest node exactly once
+                visited.add(nxt)
+                dfs(start, nxt, path + [nxt], visited)
+                visited.discard(nxt)
+
+    for n in sorted(graph):
+        dfs(n, n, [n], {n})
+    return sorted(cycles)
+
+
+def lint_concurrency_paths(paths: List[str], root: str) -> List[Finding]:
+    classes = collect_classes(paths, root)
+    unguarded: Dict[Tuple[str, str, str], List[_Access]] = {}
+    order_edges: Dict[Tuple[str, str], dict] = {}
+    findings: List[Finding] = []
+    for path in sorted(paths):
+        relpath = os.path.relpath(path, root).replace(os.sep, "/")
+        try:
+            with open(path, encoding="utf-8") as fh:
+                src = fh.read()
+            tree = ast.parse(src, filename=path)
+        except OSError:
+            continue
+        except SyntaxError as e:
+            findings.append(Finding("lint-error", Severity.ERROR,
+                                    relpath, "parse",
+                                    f"syntax error: {e}", {}))
+            continue
+        fl = _Flagger(relpath, src.splitlines(), classes,
+                      unguarded, order_edges)
+        fl.visit(tree)
+        findings.extend(fl.findings)
+    # aggregate unguarded accesses: one finding per (file, class, attr)
+    for (relpath, cls_name, attr) in sorted(unguarded):
+        accs = unguarded[(relpath, cls_name, attr)]
+        locks = "/".join(sorted(classes[cls_name].lock_attrs)) or "?"
+        kinds = ("writes" if all(a.write for a in accs) else
+                 "reads" if not any(a.write for a in accs) else
+                 "reads+writes")
+        findings.append(Finding(
+            RACE_UNGUARDED_ATTR, Severity.WARN, relpath,
+            f"{cls_name}::{attr}",
+            f"{cls_name}.{attr} is written under {locks} elsewhere but "
+            f"touched outside it here ({len(accs)} {kinds}: "
+            f"{', '.join(sorted({a.method for a in accs}))}) — a "
+            "torn/stale view races the locked writer",
+            {"count": len(accs),
+             "lines": sorted(a.line for a in accs),
+             "methods": sorted({a.method for a in accs})}))
+    for cyc in _find_cycles(order_edges):
+        ring = cyc + [cyc[0]]
+        detail = []
+        for a, b in zip(ring, ring[1:]):
+            e = order_edges.get((a, b))
+            if e:
+                detail.append(f"{a}->{b} at {e['file']}:{e['line']} "
+                              f"({e['method']})")
+        findings.append(Finding(
+            RACE_LOCK_ORDER, Severity.ERROR, "<lock-graph>",
+            "->".join(ring),
+            "static lock-order cycle: two threads taking these locks "
+            "in opposing orders deadlock; impose one global order "
+            f"({'; '.join(detail)})",
+            {"edges": detail}))
+    findings.sort(key=lambda f: f.key)
+    return findings
+
+
+def lint_concurrency_tree(root: str,
+                          package: str = "paddle_tpu") -> List[Finding]:
+    """The tpurace pass over every .py under <root>/<package>."""
+    paths: List[str] = []
+    pkg_root = os.path.join(root, package)
+    for dirpath, dirnames, filenames in os.walk(pkg_root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fname in sorted(filenames):
+            if fname.endswith(".py"):
+                paths.append(os.path.join(dirpath, fname))
+    return lint_concurrency_paths(paths, root)
+
+
+def lint_concurrency_file(path: str, root: str) -> List[Finding]:
+    """Two-pass lint over ONE file (test fixtures)."""
+    return lint_concurrency_paths([path], root)

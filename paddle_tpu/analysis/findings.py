@@ -37,6 +37,8 @@ __all__ = [
     "RECOMPILE_DIM", "RECOMPILE_STRUCTURE",
     "JIT_IN_CALL", "JIT_NO_DONATION", "TRACED_ATTR_MUTATION",
     "NUMPY_IN_TRACE", "STALE_QUARANTINE",
+    "RACE_UNGUARDED_ATTR", "RACE_BLOCKING_UNDER_LOCK",
+    "RACE_LOCK_ORDER", "RACE_CHECK_THEN_ACT", "RACE_ORPHAN_THREAD",
     "COST_BUDGET", "COST_ANCHOR", "STALE_COST_PROGRAM",
     "PROF_BUDGET", "PROF_ANCHOR", "STALE_PROF_PROGRAM",
     "count_findings", "diff_against_baseline", "load_baseline",
@@ -61,6 +63,14 @@ JIT_NO_DONATION = "jit-no-donation"      # hot-wrapper jit without knobs
 TRACED_ATTR_MUTATION = "traced-attr-mutation"  # self.x = <expr> in forward
 NUMPY_IN_TRACE = "numpy-in-trace"        # numpy call on traced values
 STALE_QUARANTINE = "stale-quarantine"    # quarantine entry matches no test
+# tpurace (concurrency.py) lock-discipline lint
+RACE_UNGUARDED_ATTR = "race-unguarded-attr"    # guarded attr touched
+#                                                outside its lock
+RACE_BLOCKING_UNDER_LOCK = "race-blocking-under-lock"  # sleep/IO/
+#                                                .result while locked
+RACE_LOCK_ORDER = "race-lock-order"            # static lock-order cycle
+RACE_CHECK_THEN_ACT = "race-check-then-act"    # unlocked test-then-set
+RACE_ORPHAN_THREAD = "race-orphan-thread"      # non-daemon, never joined
 # tpucost (hlo_cost.py) roofline gate
 COST_BUDGET = "cost-budget"              # ratcheted budget exceeded
 COST_ANCHOR = "cost-anchor"              # hand-set cost invariant broken

@@ -48,6 +48,8 @@ import warnings
 from dataclasses import dataclass
 from typing import Any, List, Optional, Tuple
 
+from ..obs import locks as _locks
+
 __all__ = ["ExecutableStore", "StoreEntry", "default_store",
            "AotProgram", "aot_compile"]
 
@@ -98,7 +100,7 @@ class ExecutableStore:
             from ..framework.env import bool_env
             enabled = bool_env("PADDLE_TPU_EXEC_STORE", True)
         self.enabled = enabled
-        self._lock = threading.Lock()
+        self._lock = _locks.make_lock("compilation.store")
 
     # -- keys -----------------------------------------------------------
     def _path(self, name: str, sig_hash: str) -> str:
@@ -243,7 +245,7 @@ class ExecutableStore:
 
 
 _default_store: Optional[ExecutableStore] = None
-_default_lock = threading.Lock()
+_default_lock = _locks.make_lock("compilation.store")
 
 
 def default_store() -> ExecutableStore:

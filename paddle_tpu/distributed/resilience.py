@@ -303,6 +303,11 @@ def with_retries(fn: Callable, *args,
 #   ckpt_gc             checkpoint retention GC fails before deleting
 #                       anything (distributed/checkpoint.gc_checkpoints
 #                       — GC failure must never take training down)
+#   lock_hold           an InstrumentedLock (obs/locks.py, the tpurace
+#                       sanitizer) holds its lock for wedge_seconds()
+#                       INSIDE release() — an artificial hold-time
+#                       spike that lights up ptpu_lock_wait_ms and the
+#                       deadlock watchdog without a real wedge
 #   ckpt_reshard        a topology-elastic restore dies MID-reshard
 #                       (checkpoint.reshard_state_dict, after >= 1 leaf
 #                       landed) — restore is read-only, so the
@@ -316,6 +321,7 @@ _KNOWN_SITES = frozenset([
     "router_forward", "replica_spawn", "replica_health",
     "replica_stall",
     "train_step_nan", "preempt_signal", "ckpt_gc", "ckpt_reshard",
+    "lock_hold",
 ])
 
 _inject_lock = threading.Lock()
@@ -388,7 +394,7 @@ def maybe_inject(site: str) -> None:
     if not should_fire(site):
         return
     if site in ("collective", "step_hang", "serve_hang",
-                "replica_stall"):
+                "replica_stall", "lock_hold"):
         time.sleep(wedge_seconds())
         return
     if site == "host_drop":

@@ -382,7 +382,7 @@ class ContinuousBatchingEngine:
             self._block_tables = None
         self._slots = [_Slot() for _ in range(self.slots)]
         self._queue: List[_Request] = []
-        self._cv = threading.Condition()
+        self._cv = _obs.make_condition("engine.cv")
         self._stop_flag = False
         self._broken: Optional[BaseException] = None
 
@@ -396,6 +396,11 @@ class ContinuousBatchingEngine:
         self._copy_prog = None        # paged: COW page-copy program
         self._verify_prog = None      # speculative: batched verify-k
         self._warmed = False          # warmup() completed
+        # serializes warmup(): two threads tracing the same program
+        # concurrently leak tracers into each other's jaxprs (found by
+        # tools/race_hunt.py warmup_concurrent) — one compiles, the
+        # rest wait and see AotPrograms already installed
+        self._warmup_lock = _obs.make_lock("engine.warmup")
         self.ticks = 0
         self.admitted = 0
         self.completed = 0
@@ -582,7 +587,7 @@ class ContinuousBatchingEngine:
                 # silently-enqueued request would hang its caller forever
                 raise RuntimeError("engine stopped")
             if len(self._queue) >= self.max_queue:
-                if self.paged and self._pool_is_binding():
+                if self.paged and self._pool_is_binding_locked():
                     # the queue backed up because admission is waiting
                     # on PAGES (a slot was free but the pool could not
                     # cover the head request) — shed with the truthful
@@ -647,7 +652,7 @@ class ContinuousBatchingEngine:
         except Exception:   # noqa: BLE001 — a broken stream is the
             req.progress_cb = None   # caller's problem, not the loop's
 
-    def _pool_is_binding(self) -> bool:
+    def _pool_is_binding_locked(self) -> bool:
         """Is the page pool (not slots / request rate) what is blocking
         the queue? True once an actual admission attempt failed on
         pages, or — to close the window before the engine thread gets
@@ -690,11 +695,12 @@ class ContinuousBatchingEngine:
         with self._cv:
             active = sum(1 for s in self._slots if not s.free)
             queued = len(self._queue)
+            cancelled = self.cancelled
         out = {"slots": self.slots, "active": active,
                "free": self.slots - active, "queued": queued,
                "max_queue": self.max_queue, "ticks": self.ticks,
                "admitted": self.admitted, "completed": self.completed,
-               "cancelled": self.cancelled,
+               "cancelled": cancelled,
                "compiled_programs": self.compiled_program_count,
                "tick_tokens": self.tick_tokens,
                "prefill_buckets": list(self.prefill_buckets),
@@ -837,6 +843,12 @@ class ContinuousBatchingEngine:
         from ..compilation.store import AotProgram, aot_compile
         prime_helper_ops()
         static = self._static_key()
+        with self._warmup_lock:
+            return self._warmup_locked(buckets, store, static,
+                                       AotProgram, aot_compile, _clog)
+
+    def _warmup_locked(self, buckets, store, static, AotProgram,
+                       aot_compile, _clog) -> list:
         recs = []
         if not isinstance(self._decode_prog, AotProgram):
             rec: dict = {"site": "engine_decode"}
@@ -1113,14 +1125,16 @@ class ContinuousBatchingEngine:
                 self._admit_ready()
                 if any(not s.free for s in self._slots):
                     self._tick()
-                elif self._queue and self._pool_blocked:
-                    # nothing active to tick (and so nothing retiring
-                    # to free pages) while the head request waits on
-                    # the pool: only trie eviction can unblock, and
-                    # _admit_paged already tried it — yield briefly
-                    # instead of spinning the admission path hot
+                else:
                     with self._cv:
-                        self._cv.wait(timeout=0.05)
+                        if self._queue and self._pool_blocked:
+                            # nothing active to tick (and so nothing
+                            # retiring to free pages) while the head
+                            # request waits on the pool: only trie
+                            # eviction can unblock, and _admit_paged
+                            # already tried it — yield briefly instead
+                            # of spinning the admission path hot
+                            self._cv.wait(timeout=0.05)
             except BaseException as e:   # noqa: BLE001 — fail loudly
                 with self._cv:
                     self._broken = e
@@ -1566,7 +1580,8 @@ class ContinuousBatchingEngine:
             # against engine truth instead of losing the work
             info["partial_tokens"] = [int(t) for t in out]
             req.future._ptpu_gen_info = info
-            self.cancelled += 1
+            with self._cv:
+                self.cancelled += 1
             if self._obs:
                 self._m_cancels.inc()
             if not req.future.done():

@@ -507,7 +507,7 @@ class _ReqJournal:
         self.seed = int(seed)
         self.rid = rid
         self.tokens: List[int] = []
-        self.cond = threading.Condition()
+        self.cond = _obs.make_condition("journal.cond")
         self.t0 = time.monotonic()          # submission (TTFT anchor)
         self.last_progress = self.t0
         self.mismatched = False
@@ -571,6 +571,7 @@ class _ReqJournal:
         prompt + generated, eos-padded to max_new on early finish."""
         with self.cond:
             toks = list(self.tokens)
+            source = self.source
         out = list(toks)
         if len(out) < self.max_new:
             out += [self.eos] * (self.max_new - len(out))
@@ -580,8 +581,8 @@ class _ReqJournal:
                 "tokens_generated": len(toks)}
         if self.rid:
             body["request_id"] = self.rid
-        if self.source:
-            body["served_by"] = self.source
+        if source:
+            body["served_by"] = source
         return body
 
 
@@ -627,7 +628,11 @@ class _StreamAttempt(threading.Thread):
 
     def run(self):
         j, rep = self.j, self.rep
-        residual = j.prompt + j.tokens[:self.base]
+        with j.cond:
+            # snapshot under the journal lock: the coordinator extends
+            # j.tokens concurrently, and a torn read here would splice
+            # a half-written prefix into the residual prompt
+            residual = j.prompt + j.tokens[:self.base]
         payload: dict = {"input_ids": residual,
                          "max_new_tokens": j.max_new - self.base,
                          "seed": j.seed, "stream": True}
@@ -849,7 +854,7 @@ class _QosScheduler:
         self.queue_limit = int(queue_limit)
         self.starvation_s = float(starvation_s)
         self._clock = clock
-        self._cv = threading.Condition()
+        self._cv = _obs.make_condition("qos.cv")
         self._inflight = 0
         self._waiting: List[_QosWaiter] = []     # enqueue order
         self._charge: Dict[str, float] = {}      # weight-normalized
@@ -1255,12 +1260,12 @@ class Router:
                               else os.path.join(self.workdir,
                                                 "xla_cache"))
 
-        self._lock = threading.RLock()
+        self._lock = _obs.make_rlock("router.lock")
         self._replicas: List[Replica] = []
         self._seq = 0
         self._stopping = False
         self._started = time.monotonic()
-        self._rolling_lock = threading.Lock()
+        self._rolling_lock = _obs.make_lock("router.rolling")
         self._rolling = False
         self._control_thread: Optional[threading.Thread] = None
         self._up_streak = 0          # autoscaler pressure counters
@@ -1456,6 +1461,14 @@ class Router:
     def __exit__(self, *a):
         self.stop()
         return False
+
+    def _stopping_flag(self) -> bool:
+        with self._lock:
+            return self._stopping
+
+    def _rolling_flag(self) -> bool:
+        with self._lock:
+            return self._rolling
 
     # -- spawn / retire (the ONE path restarts + autoscaling share) ------
     def _spawn_replica(self) -> Replica:
@@ -1659,7 +1672,7 @@ class Router:
                     except OSError:
                         pass
                     dead.append(rep)
-            if dead and not self._stopping:
+            if dead and not self._stopping_flag():
                 # postmortem: dump the flight recorder BEFORE the
                 # respawn path erases the scene — the artifact carries
                 # the ring (recent forwards, health polls) plus every
@@ -1708,7 +1721,8 @@ class Router:
                             > self.respawn_governor.window_s):
                         self.respawn_governor.note_stable()
                         break
-            while (self._pending_respawns > 0 and not self._stopping
+            while (self._pending_respawns > 0
+                   and not self._stopping_flag()
                    and time.monotonic() >= self._respawn_at):
                 self._pending_respawns -= 1
                 try:
@@ -1723,7 +1737,7 @@ class Router:
                     self._respawn_at = time.monotonic() + \
                         max(self.poll_s, 0.5)
                     break
-            if not self._stopping:
+            if not self._stopping_flag():
                 if self._obs:
                     self._m_ready.set(self.ready_count())
                 self._autoscale()
@@ -2787,7 +2801,7 @@ class Router:
                 "replicas_total": len(reps), "ready_replicas": ready,
                 "min_replicas": self.min_replicas,
                 "max_replicas": self.max_replicas,
-                "rolling_restart_in_progress": self._rolling,
+                "rolling_restart_in_progress": self._rolling_flag(),
                 "queued_total": sum(r["queued"] for r in reps),
                 "active_total": sum(r["active"] for r in reps),
                 "inflight_total": sum(r["inflight"] for r in reps),

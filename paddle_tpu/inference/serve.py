@@ -317,6 +317,8 @@ class PredictorServer:
         with a reason so an orchestrator can tell shed-load from dead.
         With an engine attached the body carries slot occupancy and
         generate-queue depth so an autoscaler can see saturation."""
+        with self._depth_lock:
+            draining = self._draining
         body = {"status": "ready",
                 "uptime_s": round(time.monotonic() - self._started, 1),
                 # obs-registry mutation sequence: moves whenever any
@@ -326,7 +328,7 @@ class PredictorServer:
                 "metrics_seq": _obs.metrics.registry.seq(),
                 "queue_depth": self._depth,
                 "inflight": self.inflight(),
-                "draining": self._draining,
+                "draining": draining,
                 "max_queue": self.max_queue,
                 "failure_streak": self._failure_streak}
         try:
@@ -369,7 +371,7 @@ class PredictorServer:
                      "tokens_drafted", "tokens_accepted",
                      "tokens_rejected", "acceptance_rate",
                      "accepted_tokens_per_tick")})
-        if self._draining:
+        if draining:
             # draining dominates every other state: in-flight requests
             # are finishing, nothing new may be routed here
             body.update(status="draining", reason="draining for restart")

@@ -28,6 +28,10 @@ Env knobs (COMPONENTS.md "Observability" has the full table):
   PADDLE_TPU_OBS        ambient instrumentation on/off (default on)
   PADDLE_TPU_OBS_RING   flight-recorder capacity in events (4096)
   PADDLE_TPU_OBS_DIR    artifact/trace directory (obs_artifacts)
+  PADDLE_TPU_LOCK_SAN   lock sanitizer on/off (default off) — the
+                        :mod:`.locks` factories return instrumented
+                        locks feeding ``ptpu_lock_{hold,wait}_ms``
+                        and the deadlock watchdog
 
 This package imports ONLY the stdlib (the analysis/chips.py rule):
 crash-path consumers (distributed/resilience.py keeps its stdlib-only
@@ -40,8 +44,9 @@ from __future__ import annotations
 import os
 
 __all__ = ["enabled", "set_enabled", "metrics", "trace", "efficiency",
-           "registry", "recorder", "span", "record_span",
-           "dump_flight"]
+           "locks", "registry", "recorder", "span", "record_span",
+           "dump_flight", "lock_san_enabled", "set_lock_san",
+           "make_lock", "make_rlock", "make_condition"]
 
 _enabled_override = None     # set_enabled() tri-state; None -> env
 _enabled_env = None          # cached env read
@@ -72,6 +77,8 @@ def set_enabled(on) -> None:
     _enabled_env = None
 
 
-from . import efficiency, metrics, trace                  # noqa: E402
+from . import efficiency, locks, metrics, trace           # noqa: E402
+from .locks import (lock_san_enabled, make_condition, make_lock,  # noqa: E402
+                    make_rlock, set_lock_san)
 from .metrics import registry                             # noqa: E402
 from .trace import dump_flight, record_span, recorder, span  # noqa: E402

@@ -40,6 +40,8 @@ import re
 import threading
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
+from . import locks as _locks
+
 __all__ = [
     "Counter", "Gauge", "Histogram", "HistSnap", "Registry", "registry",
     "DEFAULT_BUCKETS_MS", "OVERFLOW_LABEL",
@@ -83,7 +85,8 @@ class _Family:
         self.label_names = tuple(label_names)
         self.max_series = int(max_series)
         self._series: Dict[Tuple[str, ...], object] = {}
-        self._lock = threading.Lock()
+        # ONE shared site name for every family: bounded label set
+        self._lock = _locks.make_lock("metrics.family")
 
     def _key_of(self, labels: dict) -> Tuple[str, ...]:
         """Exact label-values key (validated). Readers use this raw —
@@ -99,7 +102,10 @@ class _Family:
     def _zero(self):
         raise NotImplementedError
 
-    def _get(self, labels: dict):
+    def _get_locked(self, labels: dict):
+        # *_locked convention (tpurace-checked): caller holds self._lock
+        # — the membership test + overflow fallback + insert below are
+        # one atomic step only under it
         key = self._key_of(labels)
         if key not in self._series and len(self._series) >= \
                 self.max_series:
@@ -131,7 +137,7 @@ class Counter(_Family):
 
     def inc(self, n: float = 1, **labels) -> None:
         with self._lock:
-            self._get(labels)[0] += n
+            self._get_locked(labels)[0] += n
         self._reg._bump()
 
     def value(self, **labels) -> float:
@@ -156,12 +162,12 @@ class Gauge(_Family):
 
     def set(self, v: float, **labels) -> None:
         with self._lock:
-            self._get(labels)[0] = float(v)
+            self._get_locked(labels)[0] = float(v)
         self._reg._bump()
 
     def inc(self, n: float = 1, **labels) -> None:
         with self._lock:
-            self._get(labels)[0] += n
+            self._get_locked(labels)[0] += n
         self._reg._bump()
 
     def value(self, **labels) -> float:
@@ -222,7 +228,7 @@ class Histogram(_Family):
     def observe(self, v: float, **labels) -> None:
         v = float(v)
         with self._lock:
-            s = self._get(labels)
+            s = self._get_locked(labels)
             i = len(self.buckets)
             for j, edge in enumerate(self.buckets):
                 if v <= edge:
@@ -272,7 +278,7 @@ class Registry:
     corruption, not a convenience)."""
 
     def __init__(self):
-        self._lock = threading.RLock()
+        self._lock = _locks.make_rlock("metrics.registry")
         self._families: Dict[str, _Family] = {}
         self._seq = 0
 

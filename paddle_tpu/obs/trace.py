@@ -74,7 +74,8 @@ class FlightRecorder:
 
     @property
     def size(self) -> int:
-        return self._ring.maxlen
+        # maxlen is immutable — no lock needed for this read
+        return self._ring.maxlen  # tpurace: disable=race-unguarded-attr
 
     # -- writing ---------------------------------------------------------
     def record(self, name: str, t0_s: float, t1_s: float,

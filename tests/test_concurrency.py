@@ -1,0 +1,566 @@
+"""tpurace concurrency tooling tests (ISSUE 18).
+
+Three layers, zero device work in any of them:
+
+* the static lock-discipline lint (paddle_tpu.analysis.concurrency) on
+  tmp_path fixture snippets — guarded-attribute inference, cross-class
+  typed accesses, suppression comments, the *_locked convention, the
+  static lock-order cycle, check-then-act, orphan threads, and the
+  lint-error path for unparseable files;
+* the runtime lock sanitizer (paddle_tpu.obs.locks) — plain primitives
+  when off, hold/wait histograms, the lock-order-cycle flight
+  artifact, the deadlock watchdog artifact, and the resilience
+  ``lock_hold`` fault site;
+* a host-only smoke of the schedule-fuzzing hammers
+  (tools/race_hunt.py) — the journal/QoS/metrics hammers must run
+  clean with the sanitizer on.
+
+Registered in tools/ci.py --quick.
+"""
+import glob
+import importlib.util
+import json
+import os
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.analysis.concurrency import (collect_classes,
+                                             lint_concurrency_file,
+                                             lint_concurrency_paths)
+from paddle_tpu.analysis.findings import (RACE_BLOCKING_UNDER_LOCK,
+                                          RACE_CHECK_THEN_ACT,
+                                          RACE_LOCK_ORDER,
+                                          RACE_ORPHAN_THREAD,
+                                          RACE_UNGUARDED_ATTR)
+from paddle_tpu.obs import locks as L
+from paddle_tpu.obs.metrics import registry
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _fixture(tmp_path, src: str) -> str:
+    p = tmp_path / "fix.py"
+    p.write_text(textwrap.dedent(src))
+    return str(p)
+
+
+def _by_code(findings, code):
+    return [f for f in findings if f.code == code]
+
+
+# ---------------------------------------------------------------------------
+# static lint: guarded-attribute inference
+# ---------------------------------------------------------------------------
+
+GUARDED_SRC = """
+    import threading
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.items = []
+            self.n = 0
+
+        def put(self, x):
+            with self._lock:
+                self.items.append(x)
+                self.n += 1
+
+        def peek(self):
+            return self.items[-1] if self.items else None
+"""
+
+
+def test_unguarded_access_flagged(tmp_path):
+    fs = lint_concurrency_file(_fixture(tmp_path, GUARDED_SRC), str(tmp_path))
+    hits = _by_code(fs, RACE_UNGUARDED_ATTR)
+    assert len(hits) == 1
+    f = hits[0]
+    assert f.site == "C::items"               # aggregated per attr
+    assert f.data["count"] == 2               # two reads in peek()
+    assert f.data["methods"] == ["peek"]
+    assert "written under _lock" in f.message
+
+
+def test_collect_classes_inventory(tmp_path):
+    p = _fixture(tmp_path, GUARDED_SRC)
+    classes = collect_classes([p], str(tmp_path))
+    c = classes["C"]
+    assert c.lock_attrs == {"_lock"}
+    assert c.guarded == {"items", "n"}        # append + += under lock
+    assert c.method_locks["put"] == {"_lock"}
+
+
+def test_locked_accesses_clean(tmp_path):
+    fs = lint_concurrency_file(_fixture(tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = []
+
+            def put(self, x):
+                with self._lock:
+                    self.items.append(x)
+
+            def peek(self):
+                with self._lock:
+                    return list(self.items)
+    """), str(tmp_path))
+    assert not fs
+
+
+def test_sanitizer_factory_counts_as_lock(tmp_path):
+    # make_lock adoption must not blind the lint to the lock attr
+    fs = lint_concurrency_file(_fixture(tmp_path, """
+        from paddle_tpu.obs import locks
+
+        class C:
+            def __init__(self):
+                self._lock = locks.make_lock("c.lock")
+                self.n = 0
+
+            def bump(self):
+                with self._lock:
+                    self.n += 1
+
+            def read(self):
+                return self.n
+    """), str(tmp_path))
+    assert [f.site for f in _by_code(fs, RACE_UNGUARDED_ATTR)] == ["C::n"]
+
+
+def test_cross_class_typed_access_flagged(tmp_path):
+    # j.tokens touched in ANOTHER class without j.cond: same finding,
+    # attributed to the owning class (the _StreamAttempt.run shape)
+    fs = lint_concurrency_file(_fixture(tmp_path, """
+        import threading
+
+        class J:
+            def __init__(self):
+                self.cond = threading.Condition()
+                self.tokens = []
+
+            def extend(self, t):
+                with self.cond:
+                    self.tokens.append(t)
+
+        class W:
+            def __init__(self, j: "J"):
+                self.j = j
+
+            def snap_bad(self):
+                return list(self.j.tokens)
+
+            def snap_good(self):
+                with self.j.cond:
+                    return list(self.j.tokens)
+    """), str(tmp_path))
+    hits = _by_code(fs, RACE_UNGUARDED_ATTR)
+    assert len(hits) == 1
+    assert hits[0].site == "J::tokens"
+    assert hits[0].data["methods"] == ["snap_bad"]
+
+
+def test_suppression_comment(tmp_path):
+    fs = lint_concurrency_file(_fixture(tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+
+            def bump(self):
+                with self._lock:
+                    self.n += 1
+
+            def read(self):
+                return self.n  # tpurace: disable=race-unguarded-attr
+
+            def read2(self):
+                return self.n  # tpurace: disable
+    """), str(tmp_path))
+    assert not _by_code(fs, RACE_UNGUARDED_ATTR)
+
+
+def test_locked_suffix_exempt_but_blocking_checked(tmp_path):
+    fs = lint_concurrency_file(_fixture(tmp_path, """
+        import threading
+        import time
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+
+            def bump(self):
+                with self._lock:
+                    self.n += 1
+
+            def _flush_locked(self):
+                self.n = 0          # caller holds the lock: exempt
+                time.sleep(0.1)     # ...but still blocking-under-lock
+    """), str(tmp_path))
+    assert not _by_code(fs, RACE_UNGUARDED_ATTR)
+    blocks = _by_code(fs, RACE_BLOCKING_UNDER_LOCK)
+    assert len(blocks) == 1
+    assert blocks[0].site == "C::_flush_locked::time.sleep"
+    assert "C._lock" in blocks[0].data["held"]
+
+
+def test_blocking_under_lock(tmp_path):
+    fs = lint_concurrency_file(_fixture(tmp_path, """
+        import threading
+        import time
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+
+            def slow(self, fut):
+                with self._lock:
+                    time.sleep(1.0)
+                    self.n = fut.result()
+    """), str(tmp_path))
+    sites = {f.site for f in _by_code(fs, RACE_BLOCKING_UNDER_LOCK)}
+    assert sites == {"C::slow::time.sleep", "C::slow::result"}
+
+
+LOCK_ORDER_CYCLE_SRC = """
+    import threading
+
+    class B:
+        def __init__(self, a: "A"):
+            self._lock = threading.Lock()
+            self.a = a
+
+        def hit(self):
+            with self._lock:
+                with self.a._lock:
+                    pass
+
+    class A:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.b = B(self)
+
+        def go(self):
+            with self._lock:
+                with self.b._lock:
+                    pass
+"""
+
+
+def test_lock_order_cycle_detected(tmp_path):
+    fs = lint_concurrency_file(_fixture(tmp_path, LOCK_ORDER_CYCLE_SRC),
+                               str(tmp_path))
+    cyc = _by_code(fs, RACE_LOCK_ORDER)
+    assert len(cyc) == 1
+    assert cyc[0].severity == "error"
+    assert "A._lock" in cyc[0].site and "B._lock" in cyc[0].site
+    # edge provenance names the method that took the second lock
+    assert any("A::go" in e or "B::hit" in e for e in cyc[0].data["edges"])
+
+
+def test_lock_order_acyclic_clean(tmp_path):
+    fs = lint_concurrency_file(_fixture(tmp_path, """
+        import threading
+
+        class B:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.b = B()
+
+            def go(self):
+                with self._lock:
+                    with self.b._lock:
+                        pass
+
+            def go2(self):
+                with self._lock:
+                    with self.b._lock:
+                        pass
+    """), str(tmp_path))
+    assert not _by_code(fs, RACE_LOCK_ORDER)
+
+
+def test_check_then_act(tmp_path):
+    fs = lint_concurrency_file(_fixture(tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.prog = None
+
+            def ensure(self):
+                if self.prog is None:
+                    self.prog = object()
+
+            def ensure_safe(self):
+                with self._lock:
+                    if self.prog is None:
+                        self.prog = object()
+    """), str(tmp_path))
+    hits = _by_code(fs, RACE_CHECK_THEN_ACT)
+    assert [f.site for f in hits] == ["C::ensure::prog"]
+
+
+def test_orphan_thread(tmp_path):
+    fs = lint_concurrency_file(_fixture(tmp_path, """
+        import threading
+
+        class Bad:
+            def start(self):
+                self._t = threading.Thread(target=print)
+                self._t.start()
+
+        class Joined:
+            def start(self):
+                self._t = threading.Thread(target=print)
+                self._t.start()
+
+            def stop(self):
+                self._t.join()
+
+        class Daemonic:
+            def start(self):
+                self._t = threading.Thread(target=print, daemon=True)
+                self._t.start()
+    """), str(tmp_path))
+    hits = _by_code(fs, RACE_ORPHAN_THREAD)
+    assert [f.site for f in hits] == ["Bad::start"]
+
+
+def test_syntax_error_is_lint_error(tmp_path):
+    p = tmp_path / "broken.py"
+    p.write_text("def f(:\n")
+    fs = lint_concurrency_paths([str(p)], str(tmp_path))
+    assert [f.code for f in fs] == ["lint-error"]
+
+
+def test_real_tree_engine_class_stays_clean():
+    # the baseline must_stay_clean anchors in miniature: the engine
+    # file alone must produce no unguarded-attr findings for the
+    # ContinuousBatchingEngine class (the races fixed in this PR)
+    path = os.path.join(ROOT, "paddle_tpu", "inference", "engine.py")
+    fs = lint_concurrency_file(path, ROOT)
+    bad = [f for f in _by_code(fs, RACE_UNGUARDED_ATTR)
+           if f.site.startswith("ContinuousBatchingEngine::")]
+    assert not bad, [f.key for f in bad]
+
+
+# ---------------------------------------------------------------------------
+# runtime sanitizer
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def san(tmp_path, monkeypatch):
+    """Sanitizer on, fresh state, artifacts into tmp_path; restored
+    (env-driven off + watchdog stopped) afterwards."""
+    monkeypatch.setenv("PADDLE_TPU_OBS_DIR", str(tmp_path))
+    L.set_lock_san(True)
+    s = L.reset_sanitizer()
+    s._watchdog_interval = 0.2
+    try:
+        yield s
+    finally:
+        L.set_lock_san(None)
+        s.stop_watchdog()
+
+
+def test_factories_plain_when_off(monkeypatch):
+    monkeypatch.delenv("PADDLE_TPU_LOCK_SAN", raising=False)
+    L.set_lock_san(False)
+    try:
+        assert not isinstance(L.make_lock("t.off"), L.InstrumentedLock)
+        assert not isinstance(L.make_rlock("t.off"), L.InstrumentedLock)
+        cv = L.make_condition("t.off")
+        assert isinstance(cv, threading.Condition)
+        assert not isinstance(cv._lock, L.InstrumentedLock)
+    finally:
+        L.set_lock_san(None)
+
+
+def test_env_knob_enables(monkeypatch, san):
+    monkeypatch.setenv("PADDLE_TPU_LOCK_SAN", "1")
+    L.set_lock_san(None)          # re-read the env
+    assert L.lock_san_enabled()
+    assert isinstance(L.make_lock("t.env"), L.InstrumentedLock)
+
+
+def test_hold_histogram_records(san):
+    lk = L.make_lock("t.hold")
+    with lk:
+        time.sleep(0.03)
+    s = registry.get("ptpu_lock_hold_ms").snap(lock="t.hold")
+    assert s.count == 1
+    assert s.sum >= 20.0          # ms
+
+
+def test_wait_histogram_records_contention(san):
+    lk = L.make_lock("t.wait")
+    released = threading.Event()
+
+    def holder():
+        with lk:
+            released.set()
+            time.sleep(0.05)
+
+    t = threading.Thread(target=holder, daemon=True)
+    t.start()
+    released.wait(timeout=5)
+    with lk:                       # contends ~50ms with the holder
+        pass
+    t.join(timeout=5)
+    s = registry.get("ptpu_lock_wait_ms").snap(lock="t.wait")
+    assert s.count >= 1
+    assert s.sum >= 20.0
+
+
+def test_condition_wrapping_and_reentry(san):
+    cv = L.make_condition("t.cv")
+    assert isinstance(cv._lock, L.InstrumentedLock)
+    with cv:
+        with cv:                   # reentrant (RLock-backed)
+            cv.notify_all()
+    hit = threading.Event()
+
+    def waiter():
+        with cv:
+            cv.wait(timeout=5)     # _release_save/_acquire_restore path
+            hit.set()
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    time.sleep(0.05)
+    with cv:
+        cv.notify_all()
+    t.join(timeout=5)
+    assert hit.is_set()
+
+
+def test_order_cycle_dumps_one_artifact(san, tmp_path):
+    a, b = L.make_lock("t.A"), L.make_lock("t.B")
+    with a:
+        with b:                    # edge A->B
+            pass
+    with b:
+        with a:                    # edge B->A: closes the cycle
+            pass
+    with b:
+        with a:                    # same cycle again: deduped
+            pass
+    arts = glob.glob(str(tmp_path / "flight_lock_order_cycle_*"))
+    assert len(arts) == 1
+    payload = json.load(open(arts[0]))
+    assert set(payload["metadata"]["locks"]) == {"t.A", "t.B"}
+    assert len(san.snapshot()["cycle_artifacts"]) == 1
+
+
+def test_same_name_instances_no_cycle(san, tmp_path):
+    # two journals locked in either order is NOT an order inversion
+    j1, j2 = L.make_lock("journalx.cond"), L.make_lock("journalx.cond")
+    with j1:
+        with j2:
+            pass
+    with j2:
+        with j1:
+            pass
+    assert not glob.glob(str(tmp_path / "flight_lock_order_cycle_*"))
+
+
+def test_deadlock_watchdog_dumps_artifact(san, tmp_path):
+    a, b = L.make_lock("t.dA"), L.make_lock("t.dB")
+    got_a, got_b = threading.Event(), threading.Event()
+
+    def t1():
+        with a:
+            got_a.set()
+            got_b.wait(timeout=5)
+            if b.acquire(timeout=4):   # blocks: t2 holds b
+                b.release()
+
+    def t2():
+        with b:
+            got_b.set()
+            got_a.wait(timeout=5)
+            if a.acquire(timeout=4):   # blocks: t1 holds a
+                a.release()
+
+    ts = [threading.Thread(target=f, daemon=True) for f in (t1, t2)]
+    for t in ts:
+        t.start()
+    deadline = time.monotonic() + 8
+    arts = []
+    while time.monotonic() < deadline and not arts:
+        # dump_flight writes *.tmp then renames — only the final name is
+        # safe to open (the .tmp vanishes under a concurrent json.load)
+        arts = [p for p in
+                glob.glob(str(tmp_path / "flight_lock_deadlock_*"))
+                if not p.endswith(".tmp")]
+        time.sleep(0.1)
+    for t in ts:
+        t.join(timeout=10)
+    assert len(arts) == 1, "watchdog did not dump (or dumped twice)"
+    payload = json.load(open(arts[0]))
+    meta = payload["metadata"]
+    assert set(meta["locks"]) == {"t.dA", "t.dB"}
+    assert meta["holder_stacks"]       # sys._current_frames captured
+    assert len(san.snapshot()["deadlock_artifacts"]) == 1
+
+
+def test_lock_hold_fault_site(san):
+    from paddle_tpu.distributed.resilience import FaultInjector
+    lk = L.make_lock("t.fault")
+    with FaultInjector({"lock_hold": 1}, wedge_s=0.08):
+        with lk:                   # the wedge fires while still held
+            pass
+    s = registry.get("ptpu_lock_hold_ms").snap(lock="t.fault")
+    assert s.count == 1
+    assert s.sum >= 60.0           # the injected 80ms dominates
+
+
+# ---------------------------------------------------------------------------
+# race_hunt host-only smoke
+# ---------------------------------------------------------------------------
+
+def _load_race_hunt():
+    spec = importlib.util.spec_from_file_location(
+        "race_hunt", os.path.join(ROOT, "tools", "race_hunt.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_race_hunt_host_hammers_clean(san):
+    rh = _load_race_hunt()
+    old = sys.getswitchinterval()
+    sys.setswitchinterval(1e-5)
+    try:
+        assert rh.hammer_journal_extend_reap(1) == []
+        assert rh.hammer_qos_admit_shed(1) == []
+        assert rh.hammer_metrics_scrape_record(1) == []
+    finally:
+        sys.setswitchinterval(old)
+    snap = san.snapshot()
+    assert snap["cycle_artifacts"] == []
+    assert snap["deadlock_artifacts"] == []
+
+
+def test_race_hunt_hammer_registry():
+    rh = _load_race_hunt()
+    for name in rh.ALL_HAMMERS:
+        assert callable(getattr(rh, f"hammer_{name}"))
+    assert set(rh.HOST_HAMMERS).isdisjoint(rh.JAX_HAMMERS)

@@ -95,6 +95,10 @@ QUICK_FILES = [
     # flips, stage-3 gather chain/schedule, sharded optimizer state
     "tests/test_quantized_allreduce.py",
     "tests/test_quantized_trainstep.py",
+    # tpurace concurrency tooling (ISSUE 18): lock-discipline lint on
+    # fixture snippets, lock-sanitizer histograms + cycle/deadlock
+    # artifacts, race_hunt host-hammer smoke — zero device work
+    "tests/test_concurrency.py",
 ]
 
 
@@ -202,6 +206,37 @@ def _run_tpulint(env, update_baseline=False) -> int:
     return subprocess.run(cmd, cwd=ROOT, env=env).returncode
 
 
+def _run_tpurace(env, update_baseline=False) -> int:
+    """tpurace gate: static lock-discipline lint of the tree vs
+    tools/tpurace_baseline.json (ISSUE 18). Nonzero when a NEW
+    concurrency hazard (guarded attr touched outside its lock, static
+    lock-order cycle, blocking call under a lock, ...) appears, or a
+    must_stay_clean anchor — the engine tick loop, the request
+    journal, the metrics registry, the compilation store, concurrent
+    warmup — regresses. Pure AST, no jax: runs in ~2 s. Accept an
+    intentional finding with `python tools/ci.py --tpurace
+    --update-baseline` after review."""
+    print("\n=== tpurace lock-discipline gate ===")
+    cmd = [sys.executable, os.path.join("tools", "tpurace.py")]
+    if update_baseline:
+        cmd.append("--update-baseline")
+    return subprocess.run(cmd, cwd=ROOT, env=env).returncode
+
+
+def _run_race_hunt(env) -> int:
+    """race_hunt smoke: the dynamic half of the tpurace gate —
+    schedule-fuzzed hammers (journal extend vs reap, QoS admit vs
+    shed, metrics scrape vs record, engine submit/cancel vs tick,
+    concurrent warmup) under a 10us switch interval with the lock
+    sanitizer on. Nonzero on any invariant violation or sanitizer
+    cycle/deadlock artifact."""
+    print("\n=== race_hunt schedule-fuzzing smoke ===")
+    return subprocess.run(
+        [sys.executable, os.path.join("tools", "race_hunt.py"),
+         "--iters", "2"],
+        cwd=ROOT, env=env).returncode
+
+
 def _run_tpucost(env, update_baseline=False) -> int:
     """tpucost gate: static fusion/HBM roofline inventory of the real
     compiled programs vs tools/tpucost_baseline.json (PR 6). Nonzero
@@ -286,17 +321,22 @@ def main():
                     help="run ONLY the tpulint static-analysis gate")
     ap.add_argument("--tpucost", action="store_true",
                     help="run ONLY the tpucost fusion/HBM roofline gate")
+    ap.add_argument("--tpurace", action="store_true",
+                    help="run ONLY the tpurace lock-discipline gate "
+                         "(static concurrency lint vs "
+                         "tools/tpurace_baseline.json)")
     ap.add_argument("--tpuprof", action="store_true",
                     help="run ONLY the tpuprof measured-runtime gate "
                          "(executes every registry program under the "
                          "profiler — dispatch-time ratchet + measured "
                          "anchors vs tools/tpuprof_baseline.json)")
     ap.add_argument("--update-baseline", action="store_true",
-                    help="with --tpucost/--tpulint/--tpuprof: re-pin "
-                         "that gate's baseline from this run (tpucost/"
-                         "tpuprof anchors and tpulint must_stay_clean "
-                         "entries preserved) — the review-then-accept "
-                         "ratchet flow")
+                    help="with --tpucost/--tpulint/--tpuprof/"
+                         "--tpurace: re-pin that gate's baseline from "
+                         "this run (tpucost/tpuprof anchors and "
+                         "tpulint/tpurace must_stay_clean entries "
+                         "preserved) — the review-then-accept ratchet "
+                         "flow")
     ap.add_argument("--warmup", action="store_true",
                     help="prime the executable store + warm jax cache "
                          "(tools/warmup.py) before the tests — "
@@ -308,6 +348,10 @@ def main():
     ap.add_argument("--no-tpucost", action="store_true",
                     help="skip the tpucost gate that --quick/--full "
                          "append after the tests")
+    ap.add_argument("--no-tpurace", action="store_true",
+                    help="skip the tpurace lock-discipline gate and "
+                         "the race_hunt schedule-fuzzing smoke that "
+                         "--quick/--full append after the tests")
     ap.add_argument("--no-obs-smoke", action="store_true",
                     help="skip the obs /metrics + trace self-test "
                          "smoke that --quick/--full append after the "
@@ -383,10 +427,13 @@ def main():
         return _run_tpucost(cache_env, args.update_baseline)
     if args.tpuprof:
         return _run_tpuprof(cache_env, args.update_baseline)
+    if args.tpurace:
+        # plain env: pure AST, never compiles (no cache dir to offer)
+        return _run_tpurace(env, args.update_baseline)
     if args.update_baseline:
         ap.error("--update-baseline only applies with --tpulint, "
-                 "--tpucost or --tpuprof (a full test run must never "
-                 "silently re-pin a gate baseline)")
+                 "--tpucost, --tpuprof or --tpurace (a full test run "
+                 "must never silently re-pin a gate baseline)")
     if args.warmup:
         warm_rc = _run_warmup(cache_env)
         if not (args.quick or args.full or args.k or args.coverage):
@@ -431,6 +478,14 @@ def main():
     if (args.quick or args.full) and not args.no_tpucost:
         cost_rc = _run_tpucost(cache_env)
         rc = rc or cost_rc
+    if (args.quick or args.full) and not args.no_tpurace:
+        # static half plain env (pure AST); dynamic half cache_env —
+        # the engine hammers compile the tiny-GPT programs and the
+        # single-device entries are safe to share
+        race_rc = _run_tpurace(env)
+        rc = rc or race_rc
+        hunt_rc = _run_race_hunt(cache_env)
+        rc = rc or hunt_rc
     if (args.quick or args.full) and not args.no_obs_smoke:
         obs_rc = _run_obs_smoke(cache_env)
         rc = rc or obs_rc

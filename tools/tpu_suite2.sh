@@ -116,6 +116,20 @@ run bench_cold_start bench_cold_start.json python tools/bench_cold_start.py
 # terminal stdout line is a _have_result-good JSON record even when the
 # gate FAILS — a failing gate is a landed measurement, check "gate")
 run tpulint tpulint.json python tools/tpulint.py
+# lock-discipline gate (ISSUE 18): static concurrency lint (guarded
+# attrs, lock-order cycles, blocking-under-lock) vs
+# tools/tpurace_baseline.json — pure AST, seconds; the full findings
+# report uploads alongside the terminal record; self-skips once landed
+run tpurace tpurace.json python tools/tpurace.py \
+    --json "$R/tpurace_report.json"
+# schedule-fuzzed race hammers (ISSUE 18): the dynamic half — journal
+# extend vs reap, QoS admit vs shed, metrics scrape vs record, engine
+# submit/cancel vs tick, concurrent warmup, all under a 10us switch
+# interval with the lock sanitizer on; any invariant violation or
+# sanitizer cycle/deadlock artifact fails the gate ("gate" in the
+# record); self-skips once landed
+run race_hunt race_hunt.json python tools/race_hunt.py \
+    --json "$R/race_hunt_report.json"
 # fusion/HBM roofline inventory (PR 6): per-program FLOPs/HBM/roofline
 # vs tools/tpucost_baseline.json; the full report (per-kernel detail +
 # top unfused chains) uploads alongside the terminal record, and the

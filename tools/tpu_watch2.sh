@@ -83,6 +83,8 @@ PY
         /root/repo/tpu_results/bench_serving_recovery.json \
         /root/repo/tpu_results/bench_serving_stream.json \
         /root/repo/tpu_results/tpulint.json \
+        /root/repo/tpu_results/tpurace.json \
+        /root/repo/tpu_results/race_hunt.json \
         /root/repo/tpu_results/bench_125m_fused.json \
         /root/repo/tpu_results/bench_1p3b_dots.json \
         /root/repo/tpu_results/bench_125m_bf16opt.json \
