@@ -668,10 +668,11 @@ def analytic_verify_hbm_bytes(geometry: dict) -> int:
 #                              "matmul_flop_share_min": 0.x,
 #                              "collective_bytes": N}},
 #    "anchors": {"<program>": {"kind": "decode_hbm"|"matmul_share_floor"
-#                                      |"comm_bytes",
+#                                      |"comm_bytes"|"fusion_hbm",
 #                              "max_ratio": 1.15 | "min_share": 0.x |
 #                              "baseline_program": "...",
-#                              "min_ratio": 3.5}},
+#                              "min_ratio": 3.5 |
+#                              "max_kernel_delta": -3}},
 #    "notes": {...}}
 #
 # Budgets RATCHET (hbm_bytes/kernel_count/collective_bytes may only
@@ -927,6 +928,57 @@ def check_cost_baseline(inventories: Dict[str, dict],
                     f"broke the hand-set anchor floor {floor:.4f}",
                     {"measured": inv["matmul_flop_share"],
                      "floor": floor}))
+        elif kind == "fusion_hbm":
+            # fused-kernel A/B invariant (ISSUE 19): this program is
+            # its baseline_program with a fusion knob ON — its modeled
+            # HBM bytes must stay at or below max_ratio of the unfused
+            # twin's (the measured win is PINNED, not aspirational),
+            # and, when max_kernel_delta is set, its kernel count must
+            # not creep back up past baseline + max_kernel_delta
+            ref_name = a.get("baseline_program", "")
+            ref = inventories.get(ref_name)
+            if ref is None:
+                if ref_name in live:
+                    continue    # partial run; full runs flag missing
+                findings.append(Finding(
+                    COST_ANCHOR, Severity.ERROR, name, "fusion_hbm",
+                    f"fusion_hbm anchor references baseline_program "
+                    f"{ref_name!r} which the registry does not have — "
+                    "fix the baseline", {"baseline_program": ref_name}))
+                continue
+            max_ratio = float(a.get("max_ratio", 1.0))
+            ratio = (inv["hbm_bytes"] / ref["hbm_bytes"]
+                     if ref["hbm_bytes"] else float("inf"))
+            if ratio > max_ratio:
+                findings.append(Finding(
+                    COST_ANCHOR, Severity.ERROR, name, "fusion_hbm",
+                    f"fused program models {inv['hbm_bytes']} HBM "
+                    f"bytes = {ratio:.4f}x its unfused twin "
+                    f"{ref_name}'s {ref['hbm_bytes']} (max "
+                    f"{max_ratio:.4f}x) — the fused-kernel win "
+                    "regressed",
+                    {"measured": inv["hbm_bytes"],
+                     "reference": ref["hbm_bytes"],
+                     "ratio": round(ratio, 4),
+                     "max_ratio": max_ratio}))
+            if "max_kernel_delta" in a:
+                delta = (int(inv["kernel_count"])
+                         - int(ref["kernel_count"]))
+                if delta > int(a["max_kernel_delta"]):
+                    findings.append(Finding(
+                        COST_ANCHOR, Severity.ERROR, name,
+                        "fusion_hbm",
+                        f"fused program launches {inv['kernel_count']} "
+                        f"kernels vs {ref_name}'s "
+                        f"{ref['kernel_count']} (delta {delta:+d}, max "
+                        f"{int(a['max_kernel_delta']):+d}) — the "
+                        "fused chain's kernel-count shrinkage "
+                        "regressed",
+                        {"measured": inv["kernel_count"],
+                         "reference": ref["kernel_count"],
+                         "delta": delta,
+                         "max_kernel_delta":
+                             int(a["max_kernel_delta"])}))
         else:
             # a typo while hand-editing the baseline must not silently
             # DISABLE an invariant — unknown kinds fail loudly
@@ -934,7 +986,7 @@ def check_cost_baseline(inventories: Dict[str, dict],
                 COST_ANCHOR, Severity.ERROR, name, "unknown-kind",
                 f"anchor for {name!r} has unknown kind {kind!r} "
                 "(valid: decode_hbm, decode_hbm_paged, verify_hbm, "
-                "matmul_share_floor, comm_bytes) — the "
+                "matmul_share_floor, comm_bytes, fusion_hbm) — the "
                 "invariant was NOT evaluated; fix the baseline",
                 {"kind": kind}))
     return findings

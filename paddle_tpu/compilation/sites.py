@@ -398,6 +398,61 @@ def build_parallel_train_step_q() -> BuildResult:
                                              "parallel_train_step_q")
 
 
+def _knob_variant(knob: str, base_builder, geom_key: str) -> BuildResult:
+    """A fusion-knob twin of an existing site: build the SAME program
+    with the env knob on for the whole build->lower->measure window
+    (the knobs are trace-time reads), restore the prior value in
+    cleanup. The twin gets its own registry name so tpucost budgets the
+    fused inventory separately and the fusion_hbm anchor can price it
+    against the unfused baseline_program."""
+    import os
+    prev = os.environ.get(knob)
+    os.environ[knob] = "1"
+    br = base_builder()
+
+    def cleanup(_prev=prev, _inner=br.cleanup):
+        if _prev is None:
+            os.environ.pop(knob, None)
+        else:
+            os.environ[knob] = _prev
+        if _inner:
+            _inner()
+
+    geometry = dict(br.geometry or {})
+    geometry[geom_key] = True
+    return BuildResult(br.fn, br.args, cleanup=cleanup,
+                       geometry=geometry)
+
+
+def build_gpt_decode_fused() -> BuildResult:
+    """gpt_decode with PADDLE_TPU_FUSED_CACHE_WRITE on: the S=1 slot
+    decode runs the fused write+attend chain (kernels/cache_write.py +
+    the restructured old-cache attention in flash_attention.py).
+    Greedy-token-identical to gpt_decode; the fusion_hbm anchor pins
+    the modeled HBM drop."""
+    return _knob_variant("PADDLE_TPU_FUSED_CACHE_WRITE",
+                         build_gpt_decode, "fused_cache_write")
+
+
+def build_gpt_decode_mega() -> BuildResult:
+    """gpt_decode with PADDLE_TPU_MEGA_DECODE on: each layer's decode
+    inner step (cache read -> attention -> cache write) is ONE Pallas
+    dispatch (kernels/mega_decode.py). Prototype site — budgets pin
+    whatever the mega kernel measures at, so regressions in its
+    CPU-modeled form stay visible."""
+    return _knob_variant("PADDLE_TPU_MEGA_DECODE",
+                         build_gpt_decode, "mega_decode")
+
+
+def build_train_step_fused_ce() -> BuildResult:
+    """train_step with PADDLE_TPU_FUSED_CE on: the loss functional
+    dispatches the online-LSE fused cross-entropy
+    (kernels/fused_ce.py). The fusion_hbm anchor pins the forward
+    LSE-chain collapse (kernel count AND bytes) against train_step."""
+    return _knob_variant("PADDLE_TPU_FUSED_CE",
+                         build_train_step, "fused_ce")
+
+
 _registered = False
 
 
@@ -458,6 +513,20 @@ def ensure_registered() -> None:
              compile_collectives=True, min_devices=4,
              description="ParallelTrainStep ZeRO-3 int8 quantized "
                          "collectives (same geometry as _z3)")
+    register("gpt_decode_fused", build_gpt_decode_fused,
+             tags=("manifest", "serving"),
+             description="engine decode tick with fused cache-write + "
+                         "write+attend chain (fusion_hbm A/B twin of "
+                         "gpt_decode)")
+    register("gpt_decode_mega", build_gpt_decode_mega,
+             tags=("manifest", "serving"),
+             description="engine decode tick with the mega-kernel "
+                         "per-layer inner step (Pallas prototype)")
+    register("train_step_fused_ce", build_train_step_fused_ce,
+             tags=("manifest", "training"),
+             description="TrainStep with the fused online-LSE "
+                         "cross-entropy (fusion_hbm A/B twin of "
+                         "train_step)")
     # only now: a failure above (e.g. a consumer squatting a canonical
     # name) must stay loud on every retry, not flip the flag and leave
     # the registry silently half-populated for the rest of the process

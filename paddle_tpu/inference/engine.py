@@ -790,9 +790,15 @@ class ContinuousBatchingEngine:
                  if self.paged else None)
         spec = ((self._spec.kind, self._spec.k)
                 if self._spec is not None else None)
+        # kernel-fusion knobs are trace-time constants too: a cached
+        # executable traced with the unfused chain must not be reused
+        # when the fused kernels are toggled on (ISSUE 19)
+        from ..nn.functional.flash_attention import (_fused_cache_write_on,
+                                                     _mega_decode_on)
+        fusion = (_fused_cache_write_on(), _mega_decode_on())
         return repr((type(self.model).__name__, self._sampling,
                      self.tick_tokens, self.max_len, self.cache_dtype,
-                     paged, spec))
+                     paged, spec, fusion))
 
     def _decode_example_args(self) -> tuple:
         N = self.slots

@@ -1,0 +1,222 @@
+"""Fused softmax cross-entropy kernels — logits -> loss + dlogits with
+an online log-sum-exp over the vocab axis (ISSUE 19 tentpole).
+
+Reference role: paddle/phi/kernels/gpu/cross_entropy_kernel.cu (the
+fused softmax-with-CE kernels). The naive composition materializes the
+[N, V] softmax and one-hot; the forward here is ONE streaming pass per
+row — the (max, sum-exp) pair carried through the classic logsumexp
+monoid
+
+    (m1, s1) + (m2, s2) = (M, s1*exp(m1-M) + s2*exp(m2-M)),
+    M = max(m1, m2)
+
+— and the backward is one streaming pass emitting
+``dlogits = (exp(logits - lse) - onehot) * g`` with the one-hot
+compare folded into the elementwise epilogue (never materialized).
+
+Three entry points:
+
+- ``ce_fwd`` / ``ce_bwd``: the Pallas kernels. TPU grid is one program
+  per row-block with a fori over vocab blocks running the monoid in
+  VMEM scratch; ``interpret=True`` runs the same bodies grid-free on
+  CPU (flash_block precedent; a gridded interpret kernel would lower
+  to a while loop the hlo_cost model charges at full-operand scale per
+  trip).
+- ``online_lse``: the monoid as ONE variadic ``lax.reduce`` — the
+  kernel's dataflow expressed for XLA. This is what the CPU dispatch
+  path (nn/functional/loss.py, ``PADDLE_TPU_FUSED_CE``) uses: on this
+  backend XLA compiles it to a single pass over the logits (measured:
+  the separate max pass and the materialized exp of the unfused chain
+  both disappear), which keeps the modeled train-step inventory honest
+  about what the Mosaic kernel does on-chip.
+
+Padded-vocab tails: ``valid_vocab`` masks columns >= the real vocab out
+of both the LSE and the backward (padded logits contribute exactly
+zero probability), so models padding V up to a lane multiple lose
+nothing. bf16 logits compute in f32 in-kernel and emit bf16 dlogits.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["ce_fwd", "ce_bwd", "online_lse"]
+
+_NEG_INF = float("-inf")
+
+
+# --------------------------------------------------- XLA (dispatch) form
+
+def online_lse(lg, valid_vocab=None):
+    """Row log-sum-exp in ONE pass: variadic reduce carrying the
+    (running max, running scaled sum) logsumexp monoid. lg: [..., V]
+    any float dtype; f32 result."""
+    lg = lg.astype(jnp.float32)
+    if valid_vocab is not None and valid_vocab != lg.shape[-1]:
+        ids = lax.broadcasted_iota(jnp.int32, lg.shape, lg.ndim - 1)
+        lg = jnp.where(ids < valid_vocab, lg, _NEG_INF)
+
+    def comb(a, b):
+        m1, s1 = a
+        m2, s2 = b
+        m = jnp.maximum(m1, m2)
+        # exp(-inf - -inf) = exp(nan) guard: a wholly-masked operand
+        # pair can only arise from padded columns, where s is 0 anyway
+        return m, (s1 * jnp.exp(jnp.minimum(m1 - m, 0.0))
+                   + s2 * jnp.exp(jnp.minimum(m2 - m, 0.0)))
+
+    m, s = lax.reduce((lg, jnp.ones_like(lg)),
+                      (jnp.float32(_NEG_INF), jnp.float32(0.0)),
+                      comb, (lg.ndim - 1,))
+    return jnp.log(s) + m
+
+
+# ------------------------------------------------------- Pallas kernels
+
+def _fwd_kernel_whole(labels_ref, lg_ref, per_ref, lse_ref, *,
+                      valid_vocab):
+    lg = lg_ref[...].astype(jnp.float32)                 # [N, V]
+    N, V = lg.shape
+    ids = lax.broadcasted_iota(jnp.int32, (N, V), 1)
+    if valid_vocab != V:
+        lg = jnp.where(ids < valid_vocab, lg, _NEG_INF)
+    m = jnp.max(lg, axis=-1)
+    s = jnp.sum(jnp.exp(lg - m[:, None]), axis=-1)
+    lse = jnp.log(s) + m
+    onehot = ids == labels_ref[:][:, None]
+    gold = jnp.sum(jnp.where(onehot, lg, 0.0), axis=-1)
+    per_ref[...] = lse - gold
+    lse_ref[...] = lse
+
+
+def _fwd_kernel_grid(labels_ref, lg_ref, per_ref, lse_ref, m_scr, s_scr,
+                     g_scr, *, valid_vocab, block_v):
+    """One program per (row-block, vocab-block): the monoid carried in
+    VMEM scratch across the vocab grid axis."""
+    iv, nv = pl.program_id(1), pl.num_programs(1)
+
+    @pl.when(iv == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        s_scr[:] = jnp.zeros_like(s_scr)
+        g_scr[:] = jnp.zeros_like(g_scr)
+
+    lg = lg_ref[...].astype(jnp.float32)                 # [bn, bv]
+    bn, bv = lg.shape
+    col = iv * block_v + lax.broadcasted_iota(jnp.int32, (bn, bv), 1)
+    lg = jnp.where(col < valid_vocab, lg, _NEG_INF)
+    m_blk = jnp.max(lg, axis=-1)
+    m_old = m_scr[:]
+    m_new = jnp.maximum(m_old, m_blk)
+    scale = jnp.exp(jnp.minimum(m_old - m_new, 0.0))
+    s_blk = jnp.sum(jnp.exp(lg - m_new[:, None]), axis=-1)
+    m_scr[:] = m_new
+    s_scr[:] = s_scr[:] * scale + s_blk
+    hit = col == labels_ref[:][:, None]
+    g_scr[:] = g_scr[:] + jnp.sum(jnp.where(hit, lg, 0.0), axis=-1)
+
+    @pl.when(iv == nv - 1)
+    def _():
+        lse = jnp.log(s_scr[:]) + m_scr[:]
+        per_ref[...] = lse - g_scr[:]
+        lse_ref[...] = lse
+
+
+def _bwd_kernel_whole(labels_ref, lg_ref, lse_ref, g_ref, dlg_ref, *,
+                      valid_vocab):
+    lg = lg_ref[...].astype(jnp.float32)
+    N, V = lg.shape
+    ids = lax.broadcasted_iota(jnp.int32, (N, V), 1)
+    p = jnp.exp(lg - lse_ref[:][:, None])
+    if valid_vocab != V:
+        p = jnp.where(ids < valid_vocab, p, 0.0)
+    onehot = (ids == labels_ref[:][:, None]).astype(jnp.float32)
+    dlg_ref[...] = ((p - onehot)
+                    * g_ref[:][:, None]).astype(dlg_ref.dtype)
+
+
+def _bwd_kernel_grid(labels_ref, lg_ref, lse_ref, g_ref, dlg_ref, *,
+                     valid_vocab, block_v):
+    iv = pl.program_id(1)
+    lg = lg_ref[...].astype(jnp.float32)                 # [bn, bv]
+    bn, bv = lg.shape
+    col = iv * block_v + lax.broadcasted_iota(jnp.int32, (bn, bv), 1)
+    p = jnp.exp(lg - lse_ref[:][:, None])
+    p = jnp.where(col < valid_vocab, p, 0.0)
+    onehot = (col == labels_ref[:][:, None]).astype(jnp.float32)
+    dlg_ref[...] = ((p - onehot)
+                    * g_ref[:][:, None]).astype(dlg_ref.dtype)
+
+
+def ce_fwd(lg, labels, valid_vocab=None, *, block_n: int = 128,
+           block_v: int = 512, interpret: bool = False):
+    """Fused CE forward: per-row loss + LSE residual, one streaming
+    pass. lg: [N, V]; labels: [N] int; returns (per [N] f32, lse [N]
+    f32)."""
+    N, V = lg.shape
+    vv = V if valid_vocab is None else int(valid_vocab)
+    labels = jnp.asarray(labels, jnp.int32)
+    if interpret:
+        return pl.pallas_call(
+            functools.partial(_fwd_kernel_whole, valid_vocab=vv),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1, grid=(),
+                in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+                out_specs=[pl.BlockSpec(memory_space=pltpu.ANY)] * 2),
+            out_shape=[jax.ShapeDtypeStruct((N,), jnp.float32)] * 2,
+            interpret=True,
+        )(labels, lg)
+    bn, bv = min(block_n, N), min(block_v, V)
+    grid = (pl.cdiv(N, bn), pl.cdiv(V, bv))
+    return pl.pallas_call(
+        functools.partial(_fwd_kernel_grid, valid_vocab=vv, block_v=bv),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1, grid=grid,
+            in_specs=[pl.BlockSpec((bn, bv), lambda i, j, *_: (i, j))],
+            out_specs=[pl.BlockSpec((bn,), lambda i, j, *_: (i,))] * 2),
+        out_shape=[jax.ShapeDtypeStruct((N,), jnp.float32)] * 2,
+        scratch_shapes=[pltpu.VMEM((bn,), jnp.float32)] * 3,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+    )(labels, lg)
+
+
+def ce_bwd(lg, labels, lse, g, valid_vocab=None, *, block_n: int = 128,
+           block_v: int = 512, interpret: bool = False):
+    """Fused CE backward: dlogits = (softmax - onehot) * g in one
+    streaming pass (one-hot folded into the epilogue). Returns dlogits
+    at lg's dtype."""
+    N, V = lg.shape
+    vv = V if valid_vocab is None else int(valid_vocab)
+    labels = jnp.asarray(labels, jnp.int32)
+    lse = jnp.asarray(lse, jnp.float32)
+    g = jnp.asarray(g, jnp.float32)
+    if interpret:
+        return pl.pallas_call(
+            functools.partial(_bwd_kernel_whole, valid_vocab=vv),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1, grid=(),
+                in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)] * 3,
+                out_specs=pl.BlockSpec(memory_space=pltpu.ANY)),
+            out_shape=jax.ShapeDtypeStruct((N, V), lg.dtype),
+            interpret=True,
+        )(labels, lg, lse, g)
+    bn, bv = min(block_n, N), min(block_v, V)
+    grid = (pl.cdiv(N, bn), pl.cdiv(V, bv))
+    return pl.pallas_call(
+        functools.partial(_bwd_kernel_grid, valid_vocab=vv, block_v=bv),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1, grid=grid,
+            in_specs=[pl.BlockSpec((bn, bv), lambda i, j, *_: (i, j)),
+                      pl.BlockSpec((bn,), lambda i, j, *_: (i,)),
+                      pl.BlockSpec((bn,), lambda i, j, *_: (i,))],
+            out_specs=pl.BlockSpec((bn, bv), lambda i, j, *_: (i, j))),
+        out_shape=jax.ShapeDtypeStruct((N, V), lg.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+    )(labels, lg, lse, g)

@@ -1,0 +1,157 @@
+"""Mega-kernel decode inner step — cache read -> attention -> cache
+write for one layer in ONE Pallas dispatch (ISSUE 19 tentpole,
+prototype).
+
+Reference role: fused_multi_transformer_op.cu (§2.4) fuses the whole
+per-layer serving step into one CUDA op; MPK-style mega-kernelization
+(PAPERS.md 2512.22219) makes the case for collapsing per-layer
+launch + HBM round-trips. This kernel is the slot-engine S=1 decode
+chain's analog: the three HBM round-trips per layer (read the written
+cache for attention, materialize it again for the carry, copy the
+donated buffer) become one — the cache streams through VMEM once,
+attention runs against it plus the incoming row held in registers, and
+the new row blends into the carry in place.
+
+Dataflow (the part that moves the modeled bytes, not just the launch
+count): attention reads the OLD cache under a STRICT ``< pos`` mask
+and handles the new k/v row explicitly — exp(logit_new) and its value
+contribution merge into the softmax normalizer directly — so the
+written cache has exactly ONE consumer (the carry) and the write can
+alias in place. The logits are broadcast-multiply-reduce over the head
+dim (an S=1 decode step is a matrix-vector product — VPU-bound on
+chip, and free of the layout-transpose duplication a dot would force
+on the carry).
+
+GQA: queries reshape to [nkv, groups, hd]; the cache is never
+repeated.
+
+``interpret=True`` runs grid-free on CPU (flash_block precedent);
+the TPU grid is one program per batch row. Dispatch lives in
+nn/functional/flash_attention.py behind ``PADDLE_TPU_MEGA_DECODE``.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["mega_decode_step"]
+
+_NEG_INF = -1e30
+
+
+def _attend(q, k, v, kc, vc, pos_col, scale):
+    """Shared math: q [B,nkv,g,hd], k/v [B,nkv,hd] (the new row),
+    kc/vc [B,L,nkv,hd] (the OLD cache), pos_col [B] int32. Returns
+    (ctx [B,nkv,g,hd] f32, hit [B,L] write mask)."""
+    B, L = kc.shape[0], kc.shape[1]
+    l_ids = lax.broadcasted_iota(jnp.int32, (B, L), 1)
+    strict = l_ids < pos_col[:, None]                    # [B, L]
+    logits = jnp.sum(kc.astype(jnp.float32)[:, :, :, None, :]
+                     * q[:, None], axis=-1) * scale      # [B,L,kv,g]
+    logits = jnp.where(strict[:, :, None, None], logits, _NEG_INF)
+    logit_new = jnp.sum(k[:, :, None, :] * q, axis=-1) * scale
+    m = jnp.maximum(jnp.max(logits, axis=1), logit_new)  # [B,kv,g]
+    p = jnp.exp(logits - m[:, None])
+    p_new = jnp.exp(logit_new - m)
+    den = jnp.sum(p, axis=1) + p_new
+    ctx = jnp.sum(p[..., None]
+                  * vc.astype(jnp.float32)[:, :, :, None, :], axis=1)
+    ctx = ctx + p_new[..., None] * v[:, :, None, :]
+    ctx = ctx / den[..., None]
+    hit = l_ids == pos_col[:, None]
+    return ctx, hit
+
+
+def _kernel_whole(pos_ref, q_ref, k_ref, v_ref, kc_ref, vc_ref,
+                  ctx_ref, kco_ref, vco_ref, *, scale):
+    B, L, nkv, hd = kc_ref.shape
+    g = q_ref.shape[2] // nkv
+    q = q_ref[...].astype(jnp.float32).reshape(B, nkv, g, hd)
+    k = k_ref[...].astype(jnp.float32)[:, 0]             # [B,nkv,hd]
+    v = v_ref[...].astype(jnp.float32)[:, 0]
+    ctx, hit = _attend(q, k, v, kc_ref[...], vc_ref[...],
+                       pos_ref[:], scale)
+    ctx_ref[...] = ctx.reshape(B, 1, nkv * g, hd).astype(ctx_ref.dtype)
+    kco_ref[...] = jnp.where(hit[:, :, None, None],
+                             k_ref[...].astype(kco_ref.dtype),
+                             kc_ref[...])
+    vco_ref[...] = jnp.where(hit[:, :, None, None],
+                             v_ref[...].astype(vco_ref.dtype),
+                             vc_ref[...])
+
+
+def _kernel_row(pos_ref, q_ref, k_ref, v_ref, kc_ref, vc_ref,
+                ctx_ref, kco_ref, vco_ref, *, scale):
+    b = pl.program_id(0)
+    _, L, nkv, hd = kc_ref.shape
+    g = q_ref.shape[2] // nkv
+    q = q_ref[...].astype(jnp.float32).reshape(1, nkv, g, hd)
+    k = k_ref[...].astype(jnp.float32)[:, 0]
+    v = v_ref[...].astype(jnp.float32)[:, 0]
+    ctx, hit = _attend(q, k, v, kc_ref[...], vc_ref[...],
+                       pos_ref[b][None], scale)
+    ctx_ref[...] = ctx.reshape(1, 1, nkv * g, hd).astype(ctx_ref.dtype)
+    kco_ref[...] = jnp.where(hit[:, :, None, None],
+                             k_ref[...].astype(kco_ref.dtype),
+                             kc_ref[...])
+    vco_ref[...] = jnp.where(hit[:, :, None, None],
+                             v_ref[...].astype(vco_ref.dtype),
+                             vc_ref[...])
+
+
+def mega_decode_step(q, k, v, kc, vc, pos, *, interpret: bool = False):
+    """One-dispatch S=1 decode layer step.
+
+    q: [B, 1, nh, hd]; k/v: [B, 1, nkv, hd]; kc/vc: [B, L, nkv, hd]
+    (plain array slot caches); pos: [B] int32. Returns
+    (ctx [B, 1, nh, hd], kc', vc') with both caches aliased in place.
+    Numerics: f32 accumulation; softmax reassociation drifts ~1e-7 vs
+    the unfused chain (greedy tokens bit-identical on the registry
+    fixture — PERF.md PR 19 documents the bound).
+    """
+    B, L, nkv, hd = kc.shape
+    nh = q.shape[2]
+    scale = 1.0 / float(hd) ** 0.5
+    pos = jnp.asarray(pos, jnp.int32)
+    out_shape = [
+        jax.ShapeDtypeStruct(q.shape, q.dtype),
+        jax.ShapeDtypeStruct(kc.shape, kc.dtype),
+        jax.ShapeDtypeStruct(vc.shape, vc.dtype),
+    ]
+    # operand indices count the scalar-prefetch arg: pos=0, q=1, k=2,
+    # v=3, kc=4, vc=5 -> caches alias outputs 1 and 2
+    aliases = {4: 1, 5: 2}
+    if interpret:
+        return pl.pallas_call(
+            functools.partial(_kernel_whole, scale=scale),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1, grid=(),
+                in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)] * 5,
+                out_specs=[pl.BlockSpec(memory_space=pltpu.ANY)] * 3),
+            out_shape=out_shape,
+            input_output_aliases=aliases,
+            interpret=True,
+        )(pos, q, k, v, kc, vc)
+    qblk = (1, 1, nh, hd)
+    rblk = (1, 1, nkv, hd)
+    cblk = (1, L, nkv, hd)
+    idx = lambda b, *_: (b, 0, 0, 0)  # noqa: E731
+    return pl.pallas_call(
+        functools.partial(_kernel_row, scale=scale),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1, grid=(B,),
+            in_specs=[pl.BlockSpec(qblk, idx), pl.BlockSpec(rblk, idx),
+                      pl.BlockSpec(rblk, idx), pl.BlockSpec(cblk, idx),
+                      pl.BlockSpec(cblk, idx)],
+            out_specs=[pl.BlockSpec(qblk, idx), pl.BlockSpec(cblk, idx),
+                       pl.BlockSpec(cblk, idx)]),
+        out_shape=out_shape,
+        input_output_aliases=aliases,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+    )(pos, q, k, v, kc, vc)

@@ -11,6 +11,8 @@ import jax.numpy as jnp
 
 from ...autograd.tape import apply
 from ...core.tensor import Tensor
+from ...framework.env import bool_env
+from ...kernels.fused_ce import ce_bwd, ce_fwd, online_lse
 
 __all__ = [
     "cross_entropy", "softmax_with_cross_entropy", "binary_cross_entropy",
@@ -69,6 +71,59 @@ def _fused_softmax_ce_bwd(res, g):
 _fused_softmax_ce.defvjp(_fused_softmax_ce_fwd, _fused_softmax_ce_bwd)
 
 
+def _fused_ce_on() -> bool:
+    """A/B knob for the Pallas fused-CE kernels (ISSUE 19). Trace-time
+    read, like the flash_attention fusion knobs."""
+    return bool_env("PADDLE_TPU_FUSED_CE", False)
+
+
+@jax.custom_vjp
+def _pallas_softmax_ce(lg, idx):
+    """kernels/fused_ce.py dispatch (PADDLE_TPU_FUSED_CE): forward is
+    ONE streaming pass per row — the (max, sum-exp) logsumexp monoid —
+    and backward one pass with the one-hot folded into the epilogue.
+    On TPU the passes are the Pallas kernels; on CPU the forward uses
+    ``online_lse`` (the monoid as one variadic ``lax.reduce``, which XLA
+    compiles to a single pass — measured: the separate max pass and the
+    materialized exp of ``_fused_softmax_ce`` both disappear from the
+    train-step inventory)."""
+    per, _ = _pallas_softmax_ce_fwd(lg, idx)
+    return per
+
+
+def _pallas_softmax_ce_fwd(lg, idx):
+    shp, V = lg.shape[:-1], lg.shape[-1]
+    lg2 = lg.reshape(-1, V)
+    idx2 = idx.reshape(-1).astype(jnp.int32)
+    from .flash_attention import _on_tpu
+    if _on_tpu():
+        per, lse = ce_fwd(lg2, idx2)
+    else:
+        lse = online_lse(lg2)
+        gold = jnp.take_along_axis(lg2, idx2[:, None], axis=-1)[:, 0]
+        per = lse - gold.astype(jnp.float32)
+    return per.reshape(shp), (lg, idx2, lse)
+
+
+def _pallas_softmax_ce_bwd(res, g):
+    lg, idx2, lse = res
+    V = lg.shape[-1]
+    lg2 = lg.reshape(-1, V)
+    g2 = g.reshape(-1).astype(jnp.float32)
+    from .flash_attention import _on_tpu
+    if _on_tpu():
+        dlg = ce_bwd(lg2, idx2, lse, g2)
+    else:
+        p = jnp.exp(lg2.astype(jnp.float32) - lse[:, None])
+        onehot = (jnp.arange(V, dtype=jnp.int32) == idx2[:, None])
+        dlg = ((p - onehot.astype(jnp.float32))
+               * g2[:, None]).astype(lg.dtype)
+    return dlg.reshape(lg.shape), None
+
+
+_pallas_softmax_ce.defvjp(_pallas_softmax_ce_fwd, _pallas_softmax_ce_bwd)
+
+
 def cross_entropy(input, label, weight=None, ignore_index=-100,
                   reduction="mean", soft_label=False, axis=-1,
                   use_softmax=True, label_smoothing=0.0, name=None):
@@ -86,7 +141,9 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
             if idx.ndim == logits.ndim:
                 idx = jnp.squeeze(idx, axis=-1)
             idx_c = jnp.clip(idx, 0, logits.shape[-1] - 1).astype(jnp.int32)
-            per = _fused_softmax_ce(logits, idx_c)
+            ce = (_pallas_softmax_ce if _fused_ce_on()
+                  else _fused_softmax_ce)
+            per = ce(logits, idx_c)
             mask = (idx != ignore_index)
             per = jnp.where(mask, per, 0.0)
             if reduction == "mean":

@@ -99,6 +99,11 @@ QUICK_FILES = [
     # fixture snippets, lock-sanitizer histograms + cycle/deadlock
     # artifacts, race_hunt host-hammer smoke — zero device work
     "tests/test_concurrency.py",
+    # fused Pallas kernel library (ISSUE 19): interpret-mode identity
+    # of fused CE / cache-write / mega-decode vs the unfused chains
+    # they replace, incl. bf16, padded-vocab tails, int8 dict caches,
+    # paged gating, GQA and pos corners — plus the env-knob dispatch
+    "tests/test_kernels.py",
 ]
 
 
@@ -189,6 +194,20 @@ def _run_obs_smoke(env) -> int:
     return subprocess.run(
         [sys.executable, os.path.join("tools", "trace_tool.py"),
          "--self-test"],
+        cwd=ROOT, env=env).returncode
+
+
+def _run_fusion_smoke(env) -> int:
+    """Fusion smoke (ISSUE 19): tools/bench_fusion.py --smoke A/Bs the
+    PADDLE_TPU_FUSED_CACHE_WRITE / _MEGA_DECODE / _FUSED_CE knobs
+    through the real dispatch — modeled decode-tick HBM drop >= 20%,
+    fused-CE kernel removal at no byte cost, live-engine greedy token
+    identity across knob states with ZERO new traces or compiles after
+    warmup, and bounded CE value+grad drift."""
+    print("\n=== fusion smoke (fused-kernel A/B + identity) ===")
+    return subprocess.run(
+        [sys.executable, os.path.join("tools", "bench_fusion.py"),
+         "--smoke"],
         cwd=ROOT, env=env).returncode
 
 
@@ -375,6 +394,12 @@ def main():
                          "mid-stream chaos + per-class degradation + "
                          "affinity A/B) that --quick/--full append "
                          "after the tests")
+    ap.add_argument("--no-fusion-smoke", action="store_true",
+                    help="skip the fused-kernel smoke "
+                         "(tools/bench_fusion.py --smoke: modeled HBM "
+                         "drop + engine token identity + zero-"
+                         "recompile knob flips) that --quick/--full "
+                         "append after the tests")
     ap.add_argument("--no-comm-smoke", action="store_true",
                     help="skip the quantized-collectives smoke "
                          "(tools/bench_collectives.py --smoke: "
@@ -506,6 +531,10 @@ def main():
         # cache_env for the same reason as the recovery smoke
         stream_rc = _run_stream_smoke(cache_env)
         rc = rc or stream_rc
+    if (args.quick or args.full) and not args.no_fusion_smoke:
+        # cache_env: single-device registry programs, safe to share
+        fusion_rc = _run_fusion_smoke(cache_env)
+        rc = rc or fusion_rc
     if (args.quick or args.full) and not args.no_comm_smoke:
         # plain env: the tool strips the persistent cache itself
         # (multi-device reload hazard + fresh-compile wall times)

@@ -71,6 +71,14 @@ run bench_serving_stream bench_serving_stream.json \
 # re-execs onto the virtual mesh itself; self-skips once landed
 run bench_collectives bench_collectives.json \
     python tools/bench_collectives.py
+# fused-kernel A/B + identity gates (ISSUE 19): the three
+# PADDLE_TPU_FUSED_* knobs through the real dispatch — on TPU the
+# gridded Pallas kernels (not the interpret fallback) carry the
+# modeled decode-HBM-drop >= 20% and CE-kernel-removal gates, the
+# interleaved best-of-3 wall pairs become real kernel timings, and
+# the live engine asserts greedy token identity + zero new
+# traces/compiles across knob flips; self-skips once landed
+run bench_fusion bench_fusion.json python tools/bench_fusion.py
 # obs decode-tick overhead gate (ISSUE 8): enabled-vs-disabled tick
 # time, paired-median on/off rounds; asserts the ratio <= 1.02 —
 # self-skips once landed like every other step

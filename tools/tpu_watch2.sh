@@ -95,6 +95,7 @@ PY
         /root/repo/tpu_results/tpucost.json \
         /root/repo/tpu_results/tpuprof.json \
         /root/repo/tpu_results/bench_obs_overhead.json \
+        /root/repo/tpu_results/bench_fusion.json \
         /root/repo/tpu_results/bench_collectives.json \
         /root/repo/tpu_results/tier_trace.json \
         /root/repo/tpu_results/chaos_train.json \
