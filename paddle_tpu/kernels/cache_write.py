@@ -161,8 +161,11 @@ def _paged_kernel_page(phys_ref, off_ref, valid_ref, pages_ref,
         return jnp.where(sel[(0, slice(None)) + extra][None],
                          row.astype(acc.dtype), acc)
 
-    out_ref[...] = lax.fori_loop(
-        0, n, body, pages_ref[...], unroll=True)
+    # rolled loop: unroll=True would replicate the body n times in
+    # EVERY one of the NP grid programs (n * NP code blow-up, Mosaic
+    # compile time + VMEM) even though each page matches at most a few
+    # of the candidates
+    out_ref[...] = lax.fori_loop(0, n, body, pages_ref[...])
 
 
 def fused_paged_write(pages, rows_flat, phys, off, valid, *,

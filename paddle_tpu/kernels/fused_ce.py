@@ -65,10 +65,16 @@ def online_lse(lg, valid_vocab=None):
         m1, s1 = a
         m2, s2 = b
         m = jnp.maximum(m1, m2)
-        # exp(-inf - -inf) = exp(nan) guard: a wholly-masked operand
-        # pair can only arise from padded columns, where s is 0 anyway
-        return m, (s1 * jnp.exp(jnp.minimum(m1 - m, 0.0))
-                   + s2 * jnp.exp(jnp.minimum(m2 - m, 0.0)))
+        # exp(-inf - -inf) = exp(nan) guard: reduce order is
+        # unspecified, so a tree/vectorized reduction may pair two
+        # padded lanes (m1 == m2 == -inf) even when the row has valid
+        # columns — the select forces that operand's weight to exactly
+        # 0 before the nan can reach s. (minimum(nan, 0) is nan, so
+        # clamping the exponent does NOT work.) A finite m_i needs no
+        # clamp: m_i - m <= 0 by construction.
+        w1 = jnp.where(m1 == _NEG_INF, 0.0, jnp.exp(m1 - m))
+        w2 = jnp.where(m2 == _NEG_INF, 0.0, jnp.exp(m2 - m))
+        return m, s1 * w1 + s2 * w2
 
     m, s = lax.reduce((lg, jnp.ones_like(lg)),
                       (jnp.float32(_NEG_INF), jnp.float32(0.0)),
@@ -97,7 +103,10 @@ def _fwd_kernel_whole(labels_ref, lg_ref, per_ref, lse_ref, *,
 def _fwd_kernel_grid(labels_ref, lg_ref, per_ref, lse_ref, m_scr, s_scr,
                      g_scr, *, valid_vocab, block_v):
     """One program per (row-block, vocab-block): the monoid carried in
-    VMEM scratch across the vocab grid axis."""
+    VMEM scratch across the vocab grid axis. labels_ref is the [bn]
+    row-block of labels (a blocked input, NOT the full [N] array — the
+    whole-array compare would broadcast [N, 1] against [bn, bv] and
+    fail at trace time for any N > block_n)."""
     iv, nv = pl.program_id(1), pl.num_programs(1)
 
     @pl.when(iv == 0)
@@ -113,11 +122,16 @@ def _fwd_kernel_grid(labels_ref, lg_ref, per_ref, lse_ref, m_scr, s_scr,
     m_blk = jnp.max(lg, axis=-1)
     m_old = m_scr[:]
     m_new = jnp.maximum(m_old, m_blk)
-    scale = jnp.exp(jnp.minimum(m_old - m_new, 0.0))
-    s_blk = jnp.sum(jnp.exp(lg - m_new[:, None]), axis=-1)
+    # -inf - -inf guards (same as online_lse's comb): a row whose
+    # running max is still -inf (all columns masked so far) must carry
+    # s = 0 exactly, not 0 * exp(nan) = nan
+    scale = jnp.where(m_old == _NEG_INF, 0.0, jnp.exp(m_old - m_new))
+    s_blk = jnp.where(
+        m_new == _NEG_INF, 0.0,
+        jnp.sum(jnp.exp(lg - m_new[:, None]), axis=-1))
     m_scr[:] = m_new
     s_scr[:] = s_scr[:] * scale + s_blk
-    hit = col == labels_ref[:][:, None]
+    hit = col == labels_ref[...][:, None]                # [bn, bv]
     g_scr[:] = g_scr[:] + jnp.sum(jnp.where(hit, lg, 0.0), axis=-1)
 
     @pl.when(iv == nv - 1)
@@ -142,26 +156,32 @@ def _bwd_kernel_whole(labels_ref, lg_ref, lse_ref, g_ref, dlg_ref, *,
 
 def _bwd_kernel_grid(labels_ref, lg_ref, lse_ref, g_ref, dlg_ref, *,
                      valid_vocab, block_v):
+    """labels_ref / lse_ref / g_ref are [bn] row-blocks (blocked
+    inputs; see _fwd_kernel_grid on why labels must be blocked)."""
     iv = pl.program_id(1)
     lg = lg_ref[...].astype(jnp.float32)                 # [bn, bv]
     bn, bv = lg.shape
     col = iv * block_v + lax.broadcasted_iota(jnp.int32, (bn, bv), 1)
-    p = jnp.exp(lg - lse_ref[:][:, None])
+    p = jnp.exp(lg - lse_ref[...][:, None])
     p = jnp.where(col < valid_vocab, p, 0.0)
-    onehot = (col == labels_ref[:][:, None]).astype(jnp.float32)
+    onehot = (col == labels_ref[...][:, None]).astype(jnp.float32)
     dlg_ref[...] = ((p - onehot)
-                    * g_ref[:][:, None]).astype(dlg_ref.dtype)
+                    * g_ref[...][:, None]).astype(dlg_ref.dtype)
 
 
 def ce_fwd(lg, labels, valid_vocab=None, *, block_n: int = 128,
-           block_v: int = 512, interpret: bool = False):
+           block_v: int = 512, interpret: bool = False,
+           force_grid: bool = False):
     """Fused CE forward: per-row loss + LSE residual, one streaming
     pass. lg: [N, V]; labels: [N] int; returns (per [N] f32, lse [N]
-    f32)."""
+    f32). ``force_grid`` runs the gridded (TPU) kernel body even under
+    ``interpret=True`` so tests cover the blocked path at N > block_n
+    (the dispatch path never sets it — grid-free interpret keeps the
+    hlo_cost model honest, see module docstring)."""
     N, V = lg.shape
     vv = V if valid_vocab is None else int(valid_vocab)
     labels = jnp.asarray(labels, jnp.int32)
-    if interpret:
+    if interpret and not force_grid:
         return pl.pallas_call(
             functools.partial(_fwd_kernel_whole, valid_vocab=vv),
             grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -175,28 +195,30 @@ def ce_fwd(lg, labels, valid_vocab=None, *, block_n: int = 128,
     grid = (pl.cdiv(N, bn), pl.cdiv(V, bv))
     return pl.pallas_call(
         functools.partial(_fwd_kernel_grid, valid_vocab=vv, block_v=bv),
-        grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1, grid=grid,
-            in_specs=[pl.BlockSpec((bn, bv), lambda i, j, *_: (i, j))],
-            out_specs=[pl.BlockSpec((bn,), lambda i, j, *_: (i,))] * 2),
+        grid=grid,
+        in_specs=[pl.BlockSpec((bn,), lambda i, j: (i,)),
+                  pl.BlockSpec((bn, bv), lambda i, j: (i, j))],
+        out_specs=[pl.BlockSpec((bn,), lambda i, j: (i,))] * 2,
         out_shape=[jax.ShapeDtypeStruct((N,), jnp.float32)] * 2,
         scratch_shapes=[pltpu.VMEM((bn,), jnp.float32)] * 3,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
     )(labels, lg)
 
 
 def ce_bwd(lg, labels, lse, g, valid_vocab=None, *, block_n: int = 128,
-           block_v: int = 512, interpret: bool = False):
+           block_v: int = 512, interpret: bool = False,
+           force_grid: bool = False):
     """Fused CE backward: dlogits = (softmax - onehot) * g in one
     streaming pass (one-hot folded into the epilogue). Returns dlogits
-    at lg's dtype."""
+    at lg's dtype. ``force_grid`` as in ce_fwd."""
     N, V = lg.shape
     vv = V if valid_vocab is None else int(valid_vocab)
     labels = jnp.asarray(labels, jnp.int32)
     lse = jnp.asarray(lse, jnp.float32)
     g = jnp.asarray(g, jnp.float32)
-    if interpret:
+    if interpret and not force_grid:
         return pl.pallas_call(
             functools.partial(_bwd_kernel_whole, valid_vocab=vv),
             grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -210,13 +232,14 @@ def ce_bwd(lg, labels, lse, g, valid_vocab=None, *, block_n: int = 128,
     grid = (pl.cdiv(N, bn), pl.cdiv(V, bv))
     return pl.pallas_call(
         functools.partial(_bwd_kernel_grid, valid_vocab=vv, block_v=bv),
-        grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1, grid=grid,
-            in_specs=[pl.BlockSpec((bn, bv), lambda i, j, *_: (i, j)),
-                      pl.BlockSpec((bn,), lambda i, j, *_: (i,)),
-                      pl.BlockSpec((bn,), lambda i, j, *_: (i,))],
-            out_specs=pl.BlockSpec((bn, bv), lambda i, j, *_: (i, j))),
+        grid=grid,
+        in_specs=[pl.BlockSpec((bn,), lambda i, j: (i,)),
+                  pl.BlockSpec((bn, bv), lambda i, j: (i, j)),
+                  pl.BlockSpec((bn,), lambda i, j: (i,)),
+                  pl.BlockSpec((bn,), lambda i, j: (i,))],
+        out_specs=pl.BlockSpec((bn, bv), lambda i, j: (i, j)),
         out_shape=jax.ShapeDtypeStruct((N, V), lg.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
     )(labels, lg, lse, g)

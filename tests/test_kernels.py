@@ -54,6 +54,23 @@ class TestFusedCE:
         np.testing.assert_allclose(online_lse(junk, valid_vocab=vv),
                                    ref, atol=1e-5)
 
+    def test_online_lse_inf_pairing_no_nan(self):
+        # reduce order is unspecified: a tree reduction can combine two
+        # -inf lanes even when the row has valid columns. Leading -inf
+        # entries force the sequential CPU fold through the same
+        # (-inf, -inf) monoid combine — must yield 0 weight, not nan.
+        lg, _ = self._fixture()
+        lg = lg.at[:, :2].set(-jnp.inf)
+        ref = jax.scipy.special.logsumexp(lg, axis=-1)
+        out = online_lse(lg)
+        assert bool(jnp.all(jnp.isfinite(out)))
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+    def test_online_lse_all_masked_row_is_neg_inf(self):
+        # a fully -inf row is an empty sum: LSE is -inf, never nan
+        out = online_lse(jnp.full((3, 16), -jnp.inf, jnp.float32))
+        assert bool(jnp.all(out == -jnp.inf))
+
     def test_ce_fwd_matches_reference(self):
         lg, labels = self._fixture()
         per, lse = ce_fwd(lg, labels, interpret=True)
@@ -101,6 +118,48 @@ class TestFusedCE:
         dlg = ce_bwd(junk, labels, lse, _rand(self.N, seed=1),
                      valid_vocab=vv, interpret=True)
         assert bool(jnp.all(dlg[:, vv:] == 0))
+
+    def test_ce_gridded_path_n_above_block(self):
+        # the TPU kernel body: N > block_n and V > block_v, neither a
+        # multiple of its block, so labels must be consumed per
+        # row-block (a whole-[N] compare fails to trace here)
+        N, V, bn, bv = 37, 200, 8, 64
+        rs = np.random.RandomState(5)
+        lg = jnp.asarray(rs.randn(N, V).astype("float32") * 3)
+        labels = jnp.asarray(rs.randint(0, V, N), jnp.int32)
+        per, lse = ce_fwd(lg, labels, block_n=bn, block_v=bv,
+                          interpret=True, force_grid=True)
+        ref_lse = jax.scipy.special.logsumexp(lg, axis=-1)
+        ref_per = ref_lse - jnp.take_along_axis(
+            lg, labels[:, None], 1)[:, 0]
+        np.testing.assert_allclose(per, ref_per, atol=1e-5)
+        np.testing.assert_allclose(lse, ref_lse, atol=1e-5)
+        g = _rand(N, seed=6)
+        dlg = ce_bwd(lg, labels, lse, g, block_n=bn, block_v=bv,
+                     interpret=True, force_grid=True)
+        ref = ((jax.nn.softmax(lg, axis=-1)
+                - jax.nn.one_hot(labels, V)) * g[:, None])
+        np.testing.assert_allclose(dlg, ref, atol=1e-5)
+
+    def test_ce_gridded_path_padded_vocab(self):
+        # gridded + valid_vocab: whole trailing vocab blocks are fully
+        # masked, exercising the in-kernel -inf monoid guards
+        N, V, vv, bn, bv = 20, 256, 100, 8, 64
+        rs = np.random.RandomState(7)
+        lg = jnp.asarray(rs.randn(N, V).astype("float32") * 3)
+        junk = lg.at[:, vv:].set(1e4)
+        labels = jnp.asarray(rs.randint(0, vv, N), jnp.int32)
+        per, lse = ce_fwd(junk, labels, valid_vocab=vv, block_n=bn,
+                          block_v=bv, interpret=True, force_grid=True)
+        ref_lse = jax.scipy.special.logsumexp(lg[:, :vv], axis=-1)
+        ref_per = ref_lse - jnp.take_along_axis(
+            lg, labels[:, None], 1)[:, 0]
+        np.testing.assert_allclose(per, ref_per, atol=1e-5)
+        dlg = ce_bwd(junk, labels, lse, _rand(N, seed=8),
+                     valid_vocab=vv, block_n=bn, block_v=bv,
+                     interpret=True, force_grid=True)
+        assert bool(jnp.all(dlg[:, vv:] == 0))
+        assert bool(jnp.all(jnp.isfinite(dlg)))
 
     def test_dispatch_value_and_grad_match_unfused(self, monkeypatch):
         lg, labels = self._fixture()
@@ -211,6 +270,58 @@ class TestFusedPagedWrite:
             if int(valid[i]):
                 ref = ref.at[int(phys[i]), int(off[i])].set(rows[i])
         assert bool(jnp.array_equal(out, ref))
+
+
+class TestGriddedKernelPaths:
+    """The interpret dispatch runs grid-free bodies, so the gridded
+    (TPU) bodies were invisible to tests — the fused-CE labels
+    broadcast bug hid exactly there. These force the gridded kernels
+    through the interpreter so their blocked index/broadcast logic is
+    trace-covered on CPU. (fused-CE's gridded path has its own
+    ``force_grid`` tests above.)"""
+
+    @pytest.fixture
+    def force_interpret(self, monkeypatch):
+        from jax.experimental import pallas as pl
+        orig = pl.pallas_call
+        monkeypatch.setattr(
+            pl, "pallas_call",
+            lambda *a, **kw: orig(*a, **{**kw, "interpret": True}))
+
+    def test_slot_write_gridded(self, force_interpret):
+        cache = _rand(3, 16, 2, 8, seed=20)
+        rows = _rand(3, 1, 2, 8, seed=21)
+        pos = jnp.asarray([0, 7, 15], jnp.int32)
+        out = fused_slot_write(cache, rows, pos, interpret=False)
+        ref = cache
+        for b in range(3):
+            ref = ref.at[b, int(pos[b])].set(rows[b, 0])
+        assert bool(jnp.array_equal(out, ref))
+
+    def test_paged_write_gridded(self, force_interpret):
+        pages = _rand(5, 3, 1, 2, seed=22)
+        rows = _rand(4, 1, 2, seed=23)
+        phys = jnp.asarray([4, 0, 2, 1], jnp.int32)
+        off = jnp.asarray([0, 2, 1, 2], jnp.int32)
+        valid = jnp.asarray([1, 0, 1, 1], jnp.int32)
+        out = fused_paged_write(pages, rows, phys, off, valid,
+                                interpret=False)
+        ref = pages
+        for i in range(4):
+            if int(valid[i]):
+                ref = ref.at[int(phys[i]), int(off[i])].set(rows[i])
+        assert bool(jnp.array_equal(out, ref))
+
+    def test_mega_decode_gridded(self, force_interpret):
+        q, k, v, kc, vc, pos = _decode_fixture(nh=4, nkv=2, L=8)
+        ctx_g, kc_g, vc_g = mega_decode_step(q, k, v, kc, vc, pos,
+                                             interpret=False)
+        ctx_w, kc_w, vc_w = mega_decode_step(q, k, v, kc, vc, pos,
+                                             interpret=True)
+        np.testing.assert_allclose(np.asarray(ctx_g), np.asarray(ctx_w),
+                                   atol=1e-6)
+        assert bool(jnp.array_equal(kc_g, kc_w))
+        assert bool(jnp.array_equal(vc_g, vc_w))
 
 
 # ------------------------------------------------- fused decode attention

@@ -480,10 +480,24 @@ def test_tier_request_id_spans_and_aggregated_metrics(obs_tier):
         code, doc = _post(f"http://{router.host}:{ports[served]}",
                           "/admin/trace?duration_s=0", {}, timeout=30)
         assert code == 200
-        spans = {e["name"]: e for e in doc["traceEvents"]
-                 if e.get("args", {}).get("request_id") == rid}
-        assert {"engine.queue_wait", "engine.prefill",
-                "engine.decode"} <= set(spans), (rid, sorted(spans))
+        # the journal relay (ISSUE 15) serves replicas ATTEMPT ids
+        # "<rid>.<seq>" and restores the client rid router-side — the
+        # replica ring is addressed per attempt, so resolve the client
+        # rid to its attempt spans (exact match kept for the
+        # single-shot fallback path)
+        by_attempt = {}
+        for e in doc["traceEvents"]:
+            arid = e.get("args", {}).get("request_id")
+            if arid == rid or (arid or "").startswith(rid + "."):
+                by_attempt.setdefault(arid, {})[e["name"]] = e
+        needed = {"engine.queue_wait", "engine.prefill",
+                  "engine.decode"}
+        complete = [s for s in by_attempt.values() if needed <= set(s)]
+        assert complete, (rid, {a: sorted(s)
+                                for a, s in by_attempt.items()})
+        # a quiet tier serves one attempt; under retries/hedges the
+        # winning (last) complete attempt carries the phase budget
+        spans = complete[-1]
         phase_ms = sum(spans[n]["dur"] for n in
                        ("engine.queue_wait", "engine.prefill",
                         "engine.decode")) / 1e3
@@ -492,9 +506,11 @@ def test_tier_request_id_spans_and_aggregated_metrics(obs_tier):
         assert 0 < phase_ms <= e2e_ms * 1.05, (phase_ms, e2e_ms)
         assert phase_ms >= 0.3 * e2e_ms, (phase_ms, e2e_ms)
     # the router's own ring has the forward spans under the same ids
+    # (attempt-derived "<rid>.<seq>" on the journaled path)
     rids_router = obs.recorder.request_ids(obs.recorder.events())
     for rid, _, _ in results:
-        assert rid in rids_router
+        assert any(r == rid or r.startswith(rid + ".")
+                   for r in rids_router), (rid, rids_router)
     # aggregated tier metrics: per-replica relabeled series + summed
     # ptpu_tier_* series + the router's own forward histogram
     with urllib.request.urlopen(base + "/metrics", timeout=15) as r:
