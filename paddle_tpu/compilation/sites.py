@@ -444,6 +444,134 @@ def build_gpt_decode_mega() -> BuildResult:
                          build_gpt_decode, "mega_decode")
 
 
+def _per_chip_nbytes(tree) -> int:
+    """One chip's bytes for a (possibly sharded) pytree: a sharded
+    leaf contributes its LOCAL shard, a replicated leaf its full size.
+    This is the geometry convention for the TP sites — the compiled
+    SPMD module tpucost measures is the per-chip partition, so the
+    decode_hbm analytic bound must be priced in per-chip bytes too
+    (÷tp for the sharded weights/caches, full for the replicated
+    remainder)."""
+    total = 0
+    for leaf in _jax_tree_leaves(tree):
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards:
+            total += shards[0].data.nbytes
+        else:
+            total += leaf.nbytes
+    return total
+
+
+def _jax_tree_leaves(tree):
+    import jax
+    return jax.tree_util.tree_leaves(tree)
+
+
+def _tp_engine(model, comm_precision: Optional[str] = None, tp: int = 2):
+    """A tp-sliced engine with its TP scope HELD ACTIVE past builder
+    return (the z3 lifetime pattern: consumers trace/lower AFTER the
+    builder returns, and the thread-local mesh + comm-precision must
+    still be live then). The returned cleanup closes the scope, then
+    stops the engine."""
+    import contextlib
+    from ..inference.engine import ContinuousBatchingEngine
+    eng = ContinuousBatchingEngine(model, slots=4, max_len=64,
+                                   cache_dtype="float32", tick_tokens=4,
+                                   tp=tp, comm_precision=comm_precision)
+    stack = contextlib.ExitStack()
+    stack.callback(eng.stop)
+    stack.enter_context(eng._tp_scope())
+    return eng, stack.close
+
+
+def build_gpt_decode_tp() -> BuildResult:
+    """The tp=2 sharded engine decode tick (ISSUE 20): same program
+    shape as gpt_decode, params/KV head-sharded over the "mp" slice,
+    one all-reduce pair per block. Geometry is PER-CHIP (what the SPMD
+    partition tpucost measures), so the decode_hbm anchor pins
+    per-chip tick HBM at ~1/tp of the single-chip pin; the exact-fp32
+    wire makes this the comm_bytes A/B reference for _tp_q."""
+    eng, cleanup = _tp_engine(_gpt_tiny_model())
+    prog = eng._get_decode_prog()
+    args = eng._decode_example_args()
+    geometry = {
+        "kind": "decode", "slots": eng.slots, "max_len": eng.max_len,
+        "tick_tokens": eng.tick_tokens, "tp": eng.tp,
+        "tokens_per_exec": eng.slots * eng.tick_tokens,
+        "param_bytes": _per_chip_nbytes((eng._params, eng._buffers)),
+        "kv_cache_bytes": _per_chip_nbytes(eng._caches),
+        "modeled_tick_comm_bytes": eng.tp_tick_comm_bytes,
+    }
+    return BuildResult(prog, args, cleanup=cleanup, geometry=geometry)
+
+
+def build_gpt_decode_tp_q() -> BuildResult:
+    """gpt_decode_tp with comm_precision="int8": the per-block TP
+    all-reduce routed through the PR 17 EQuARX wire bodies. Same
+    geometry as the fp32 twin; the comm_bytes anchor pins the per-chip
+    collective-byte reduction ratio so the quantized wire can't
+    silently revert to f32 payloads."""
+    eng, cleanup = _tp_engine(_gpt_tiny_model(), comm_precision="int8")
+    prog = eng._get_decode_prog()
+    args = eng._decode_example_args()
+    geometry = {
+        "kind": "decode", "slots": eng.slots, "max_len": eng.max_len,
+        "tick_tokens": eng.tick_tokens, "tp": eng.tp,
+        "comm_precision": "int8",
+        "tokens_per_exec": eng.slots * eng.tick_tokens,
+        "param_bytes": _per_chip_nbytes((eng._params, eng._buffers)),
+        "kv_cache_bytes": _per_chip_nbytes(eng._caches),
+        "modeled_tick_comm_bytes": eng.tp_tick_comm_bytes,
+    }
+    return BuildResult(prog, args, cleanup=cleanup, geometry=geometry)
+
+
+def build_gpt_admit_tp() -> BuildResult:
+    """The tp=2 engine's bucketed admission program — prefill over the
+    sharded weights writing head-sharded cache rows. In the registry so
+    the WHOLE sharded lifecycle (admit -> decode) is lint/cost covered,
+    not just the steady-state tick."""
+    eng, cleanup = _tp_engine(_gpt_tiny_model())
+    bucket = eng.prefill_buckets[0]
+    prog = eng._get_admit_prog(bucket)
+    args = eng._admit_example_args(bucket)
+    geometry = {
+        "kind": "prefill", "batch": 1, "seq": bucket, "tp": eng.tp,
+        "tokens_per_exec": bucket,
+        "param_bytes": _per_chip_nbytes((eng._params, eng._buffers)),
+        "kv_cache_bytes": _per_chip_nbytes(eng._caches),
+    }
+    return BuildResult(prog, args, cleanup=cleanup, geometry=geometry)
+
+
+def _llama_tiny_model():
+    from ..models.llama import LlamaConfig, LlamaForCausalLM
+    from ..framework import random as _rng
+    _rng.seed(0)
+    return LlamaForCausalLM(LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=176,
+        num_layers=2, num_heads=4, num_kv_heads=2, max_seq_len=128))
+
+
+def build_llama_decode_tp() -> BuildResult:
+    """The tp=2 engine decode tick over LLaMA-tiny — GQA coverage: the
+    num_kv_heads=2 pools shard one KV head per chip while the 4 query
+    heads shard 2-per-chip, exercising the uneven head-group split the
+    GPT site can't."""
+    eng, cleanup = _tp_engine(_llama_tiny_model())
+    prog = eng._get_decode_prog()
+    args = eng._decode_example_args()
+    geometry = {
+        "kind": "decode", "slots": eng.slots, "max_len": eng.max_len,
+        "tick_tokens": eng.tick_tokens, "tp": eng.tp,
+        "tokens_per_exec": eng.slots * eng.tick_tokens,
+        "param_bytes": _per_chip_nbytes((eng._params, eng._buffers)),
+        "kv_cache_bytes": _per_chip_nbytes(eng._caches),
+        "modeled_tick_comm_bytes": eng.tp_tick_comm_bytes,
+    }
+    return BuildResult(prog, args, cleanup=cleanup, geometry=geometry)
+
+
 def build_train_step_fused_ce() -> BuildResult:
     """train_step with PADDLE_TPU_FUSED_CE on: the loss functional
     dispatches the online-LSE fused cross-entropy
@@ -527,6 +655,28 @@ def ensure_registered() -> None:
              description="TrainStep with the fused online-LSE "
                          "cross-entropy (fusion_hbm A/B twin of "
                          "train_step)")
+    register("gpt_decode_tp", build_gpt_decode_tp,
+             tags=("manifest", "serving", "collectives"),
+             compile_collectives=True, min_devices=2,
+             description="TP-sharded engine decode tick (tp=2 slice; "
+                         "per-chip decode_hbm pin + comm_bytes fp32 "
+                         "reference)")
+    register("gpt_decode_tp_q", build_gpt_decode_tp_q,
+             tags=("manifest", "serving", "collectives"),
+             compile_collectives=True, min_devices=2,
+             description="TP decode tick with int8 quantized per-block "
+                         "all-reduce wire (comm_bytes A/B twin of "
+                         "gpt_decode_tp)")
+    register("gpt_admit_tp", build_gpt_admit_tp,
+             tags=("manifest", "serving", "collectives"),
+             compile_collectives=True, min_devices=2,
+             description="TP-sharded engine admission program (bucketed "
+                         "prefill writing head-sharded cache rows)")
+    register("llama_decode_tp", build_llama_decode_tp,
+             tags=("manifest", "serving", "collectives"),
+             compile_collectives=True, min_devices=2,
+             description="TP-sharded engine decode tick over LLaMA-tiny "
+                         "(GQA: one KV head per chip)")
     # only now: a failure above (e.g. a consumer squatting a canonical
     # name) must stay loud on every retry, not flip the flag and leave
     # the registry silently half-populated for the rest of the process

@@ -11,6 +11,8 @@ create_device_mesh.
 """
 from __future__ import annotations
 
+import contextlib
+import threading
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -20,9 +22,17 @@ from jax.experimental import mesh_utils
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 __all__ = ["init_mesh", "get_mesh", "set_mesh", "mesh_axis_size",
-           "named_sharding", "PartitionSpec", "Mesh"]
+           "named_sharding", "use_mesh", "PartitionSpec", "Mesh"]
 
 _global_mesh: Optional[Mesh] = None
+
+# Thread-local mesh override (inference/tp.py): a TP serving engine
+# activates its slice mesh around ITS program traces only — the engine
+# thread sees the TP mesh while a training thread (or a second,
+# single-chip engine) in the same process keeps seeing the global one.
+# A process-global swap here would leak "mp" constraints into every
+# concurrent trace.
+_thread_mesh = threading.local()
 
 # canonical axis order: outermost (slowest links, DCN-friendly) first,
 # innermost (tightest ICI coupling) last
@@ -76,10 +86,27 @@ def set_mesh(mesh: Mesh):
 
 
 def get_mesh(create_default: bool = True) -> Optional[Mesh]:
+    override = getattr(_thread_mesh, "mesh", None)
+    if override is not None:
+        return override
     global _global_mesh
     if _global_mesh is None and create_default:
         init_mesh()
     return _global_mesh
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Mesh):
+    """Thread-locally override the mesh ``get_mesh`` returns (and so
+    every sharding decision downstream of it — mp_layers constraints,
+    ``named_sharding`` defaults). Re-entrant; restores the previous
+    override on exit. The global mesh is untouched."""
+    prev = getattr(_thread_mesh, "mesh", None)
+    _thread_mesh.mesh = mesh
+    try:
+        yield mesh
+    finally:
+        _thread_mesh.mesh = prev
 
 
 def mesh_axis_size(axis: str) -> int:

@@ -16,6 +16,9 @@ use (and numeric tests against nn.Linear) need no special casing.
 """
 from __future__ import annotations
 
+import contextlib
+import threading
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -28,11 +31,45 @@ from ...nn.layer_base import Layer
 from .. import mesh as mesh_mod
 
 __all__ = ["VocabParallelEmbedding", "ColumnParallelLinear",
-           "RowParallelLinear", "ParallelCrossEntropy"]
+           "RowParallelLinear", "ParallelCrossEntropy",
+           "tp_comm_precision"]
 
 
 def _mp_size():
     return mesh_mod.mesh_axis_size("mp")
+
+
+# Wire precision for the per-block TP all-reduce (ISSUE 20, riding the
+# PR 17 EQuARX bodies). Default None/fp32: GSPMD derives the psum from
+# the replicated-output constraint in RowParallelLinear and the wire is
+# exact f32. Under ``tp_comm_precision("int8"|"bf16")`` — thread-local,
+# trace-time — RowParallelLinear instead runs its matmul + reduction
+# through an explicit shard_map whose wire payload is the quantized /
+# bf16-cast encoding of distributed/quantized.py. Inference-only: the
+# quantized path is no_grad (the serving engine's programs), training
+# keeps the exact GSPMD psum.
+_TP_COMM = threading.local()
+
+
+def _tp_comm_precision():
+    return getattr(_TP_COMM, "precision", None)
+
+
+@contextlib.contextmanager
+def tp_comm_precision(precision):
+    """Thread-locally route RowParallelLinear's TP all-reduce through
+    the quantized wire bodies ('int8'/'bf16'); None/'fp32' restores the
+    exact GSPMD psum. Takes effect at TRACE time — a program traced
+    under this context bakes the chosen wire format."""
+    if precision not in (None, "fp32", "bf16", "int8"):
+        raise ValueError(
+            f"tp comm precision {precision!r}: expected fp32|bf16|int8")
+    prev = getattr(_TP_COMM, "precision", None)
+    _TP_COMM.precision = None if precision == "fp32" else precision
+    try:
+        yield
+    finally:
+        _TP_COMM.precision = prev
 
 
 def _constrain(t: Tensor, *spec) -> Tensor:
@@ -138,6 +175,11 @@ class RowParallelLinear(Layer):
             [out_features], attr=None, is_bias=True) if has_bias else None
 
     def forward(self, x):
+        mesh = mesh_mod.get_mesh(create_default=False)
+        n = mesh.shape.get("mp", 1) if mesh is not None else 1
+        prec = _tp_comm_precision()
+        if prec is not None and n > 1:
+            return self._forward_quantized_comm(x, mesh, n, prec)
         if not self.input_is_parallel:
             x = _constrain(x, *([None] * (x.ndim - 1) + ["mp"]))
         y = F.linear(x, self.weight, None)
@@ -145,6 +187,38 @@ class RowParallelLinear(Layer):
         if self.bias is not None:
             y = y + self.bias
         return y
+
+    def _forward_quantized_comm(self, x, mesh, n: int, prec: str):
+        """The same row-parallel matmul with the partial-sum reduction
+        done EXPLICITLY inside a shard_map whose wire payload is the
+        EQuARX int8/bf16 encoding (distributed/quantized.body_all_reduce)
+        instead of the GSPMD-derived exact psum — accumulation stays
+        f32, only the bytes on the wire shrink. Bias lands after the
+        reduction, as in the exact path."""
+        from ...autograd.tape import apply
+        from ..quantized import body_all_reduce
+        if not self.input_is_parallel:
+            x = _constrain(x, *([None] * (x.ndim - 1) + ["mp"]))
+
+        def f(xr, wr, *maybe_b):
+            nd = xr.ndim
+
+            def body(xl, wl):
+                part = jnp.matmul(xl, wl)      # local partial product
+                return body_all_reduce(part, "mp", n, prec)
+
+            in_specs = (P(*([None] * (nd - 1) + ["mp"])), P("mp", None))
+            y = jax.shard_map(body, mesh=mesh, in_specs=in_specs,
+                              out_specs=P(*([None] * nd)),
+                              check_rep=False)(xr, wr)
+            if maybe_b:
+                y = y + maybe_b[0]
+            return y
+
+        if self.bias is not None:
+            return apply(f, x, self.weight, self.bias,
+                         _op_name="row_parallel_qcomm")
+        return apply(f, x, self.weight, _op_name="row_parallel_qcomm")
 
 
 class ParallelCrossEntropy(Layer):

@@ -103,6 +103,7 @@ PADDLE_TPU_SERVE_SPEC_NGRAM (max suffix n-gram, default 3).
 """
 from __future__ import annotations
 
+import contextlib
 import os
 import threading
 import time
@@ -182,9 +183,21 @@ class RequestCancelled(RuntimeError):
 def _attach_page_meta(caches, **meta):
     """Return the cache pytree with block-table / write-gate metadata
     merged into every paged dict (same traced arrays referenced
-    everywhere — XLA sees one value)."""
+    everywhere — XLA sees one value). Scan-stacked pools (leaves with a
+    leading layer axis — ``pages`` is 5-D) get the metadata broadcast
+    with that same leading L, so ScannedStack's layer scan slices ONE
+    host block table into identical per-layer [B, PM] views (the block
+    table's "layer axis", ISSUE 20 / the PR 9 follow-up) and each scan
+    step sees an ordinary per-layer paged dict."""
     if isinstance(caches, dict):
-        return {**caches, **meta} if "pages" in caches else caches
+        if "pages" not in caches:
+            return caches
+        if caches["pages"].ndim == 5:     # scan-stacked [L, NP, PS, ...]
+            L = caches["pages"].shape[0]
+            meta = {k: jnp.broadcast_to(jnp.asarray(v),
+                                        (L,) + tuple(jnp.shape(v)))
+                    for k, v in meta.items()}
+        return {**caches, **meta}
     if isinstance(caches, (list, tuple)):
         return type(caches)(_attach_page_meta(c, **meta)
                             for c in caches)
@@ -280,7 +293,9 @@ class ContinuousBatchingEngine:
                  num_pages: Optional[int] = None,
                  prefix_cache: bool = True,
                  speculative=None, spec_k: Optional[int] = None,
-                 spec_ngram: Optional[int] = None, draft_model=None):
+                 spec_ngram: Optional[int] = None, draft_model=None,
+                 tp: Optional[int] = None, mesh=None,
+                 comm_precision: Optional[str] = None):
         self.model = model
         self.slots = int(slots if slots is not None
                          else _env_int("PADDLE_TPU_SERVE_SLOTS", 8))
@@ -315,6 +330,41 @@ class ContinuousBatchingEngine:
         self.cache_dtype = cache_dtype
         self._sampling = (bool(do_sample), float(temperature),
                           int(top_k), float(top_p))
+
+        # tensor-parallel slice (inference/tp.py, ISSUE 20): tp > 1
+        # makes THIS engine an N-chip replica — params/KV head-sharded
+        # per the Megatron layout, programs pjit-partitioned over the
+        # slice mesh, block tables and all host-side control replicated.
+        # tp= / mesh= / PADDLE_TPU_SERVE_TP; comm_precision routes the
+        # per-block all-reduce through the PR 17 quantized wire bodies.
+        from .tp import TPContext, resolve_tp, validate_tp_model
+        if mesh is not None and tp is None:
+            tp = int(mesh.shape.get("mp", 1))
+        self.tp = resolve_tp(tp)
+        if self.tp > 1 or mesh is not None:
+            validate_tp_model(model, self.tp)
+            self._tp = TPContext(self.tp, comm_precision=comm_precision,
+                                 mesh=mesh)
+        else:
+            self._tp = None
+        # fused-kernel knobs × TP (ISSUE 20 satellite): knobs that are
+        # env-enabled but forced off under this engine's sharded mesh —
+        # the loud fallback fires HERE (once, at construction), and
+        # stats() carries the list so operators see the downgrade
+        self.fused_knobs_disabled_tp: List[str] = []
+        if self._tp is not None:
+            from ..framework.env import bool_env as _bool_env
+            from ..nn.functional.flash_attention import (
+                _fused_cache_write_on, _mega_decode_on)
+            with self._tp.activate():
+                if _bool_env("PADDLE_TPU_FUSED_CACHE_WRITE", False) \
+                        and not _fused_cache_write_on():
+                    self.fused_knobs_disabled_tp.append(
+                        "PADDLE_TPU_FUSED_CACHE_WRITE")
+                if _bool_env("PADDLE_TPU_MEGA_DECODE", False) \
+                        and not _mega_decode_on():
+                    self.fused_knobs_disabled_tp.append(
+                        "PADDLE_TPU_MEGA_DECODE")
 
         # speculative decoding (inference/speculative.py, ISSUE 13)
         from .speculative import (DraftModelProposer, NGramProposer,
@@ -380,6 +430,16 @@ class ContinuousBatchingEngine:
             self._caches = model.new_cache(self.slots, self.max_len,
                                            cache_dtype)
             self._block_tables = None
+        if self._tp is not None:
+            # land state in the Megatron layout BEFORE any program
+            # traces: params/buffers by their sharding_axes annotations,
+            # KV leaves head-sharded — pjit then propagates these input
+            # shardings through every engine program (block tables stay
+            # host numpy, replicated by jit's default for uncommitted
+            # arguments, so paging.py never changes)
+            self._params, self._buffers = self._tp.shard_state(
+                model, self._params, self._buffers)
+            self._caches = self._tp.shard_caches(self._caches)
         self._slots = [_Slot() for _ in range(self.slots)]
         self._queue: List[_Request] = []
         self._cv = _obs.make_condition("engine.cv")
@@ -437,9 +497,32 @@ class ContinuousBatchingEngine:
         # attribute test per site — no spans, no histogram touches, no
         # allocations per tick (counter-asserted in tests/test_obs.py;
         # tools/bench_obs_overhead.py pins the enabled cost <= 2%).
+        # modeled per-chip all-reduce bytes per tick / per verify
+        # dispatch (inference/tp.py formula; 0 single-chip) — reported
+        # on the tp_allreduce span, in stats(), and tabulated by
+        # tools/bench_tp_decode.py
+        cfg = getattr(model, "cfg", None)
+        if self._tp is not None and cfg is not None:
+            self.tp_tick_comm_bytes = self._tp.modeled_tick_comm_bytes(
+                cfg.num_layers, cfg.hidden_size, self.slots,
+                self.tick_tokens)
+            self.tp_verify_comm_bytes = (
+                self._tp.modeled_tick_comm_bytes(
+                    cfg.num_layers, cfg.hidden_size,
+                    self.slots * (self._spec.k + 1), 1)
+                if self._spec is not None else 0)
+        else:
+            self.tp_tick_comm_bytes = 0
+            self.tp_verify_comm_bytes = 0
+
         self._obs = _obs.enabled()
         if self._obs:
             reg = _obs.metrics.registry
+            self._g_mesh_devices = reg.gauge(
+                "ptpu_engine_mesh_devices",
+                "devices in this engine's mesh slice (1 = single-chip; "
+                "the tier sum over replicas is total serving chips)")
+            self._g_mesh_devices.set(self.tp)
             self._m_ticks = reg.counter(
                 "ptpu_engine_ticks_total", "batched decode ticks")
             self._m_admits = reg.counter(
@@ -505,12 +588,17 @@ class ContinuousBatchingEngine:
             # analytic bounds the tpucost anchors price (one formula,
             # no drift); they are computed once here so the per-tick
             # cost is one multiply + one gauge set.
+            # PER-CHIP geometry: a tp-sharded engine streams 1/tp of
+            # the (sharded) params and KV bytes per chip each tick —
+            # same convention as the tpucost gpt_decode_tp anchor
+            # (replicated norm scales/biases are noise at this scale)
             geom = {"tick_tokens": self.tick_tokens,
                     "param_bytes": _eff.tree_nbytes(
-                        (self._params, self._buffers)),
-                    "kv_cache_bytes": _eff.tree_nbytes(self._caches)}
+                        (self._params, self._buffers)) // self.tp,
+                    "kv_cache_bytes":
+                        _eff.tree_nbytes(self._caches) // self.tp}
             if self.paged:
-                geom["kv_view_bytes"] = self._kv_view_nbytes()
+                geom["kv_view_bytes"] = self._kv_view_nbytes() // self.tp
             self._tick_model_bytes = _eff.modeled_tick_bytes(
                 "decode_paged" if self.paged else "decode", geom)
             self._verify_model_bytes = (
@@ -684,6 +772,17 @@ class ContinuousBatchingEngine:
         alongside the pool itself (compilation/sites.py exports the
         same number on the gpt_decode_paged registry geometry)."""
         total = 0
+        if isinstance(self._caches, tuple):
+            # scan-stacked (k_stack, v_stack): leaves carry a leading
+            # layer axis, pages live on axis 1 — every layer gathers
+            # its own view
+            for half in self._caches:
+                for leaf in half.values():
+                    L, NP = leaf.shape[0], leaf.shape[1]
+                    per_page = _eff.tree_nbytes(leaf) // (L * NP)
+                    total += (per_page * self.pages_per_slot
+                              * self.slots * L)
+            return total
         for kc, vc in self._caches:
             for half in (kc, vc):
                 for leaf in half.values():
@@ -708,10 +807,23 @@ class ContinuousBatchingEngine:
                "cache_dtype": self.cache_dtype,
                "paged": self.paged,
                "speculative": (self._spec.kind if self._spec else None),
+               # tensor-parallel slice geometry (ISSUE 20): tp == 1 is
+               # the single-chip engine; fused_knobs_disabled_tp lists
+               # env-enabled Pallas knobs forced off under the sharded
+               # mesh (the loud fallback's machine-readable half)
+               "tp": self.tp,
+               "mesh_devices": self.tp,
+               "fused_knobs_disabled_tp":
+                   list(self.fused_knobs_disabled_tp),
                # obs.efficiency: last tick's modeled-bytes/s as a
                # fraction of the efficiency chip's HBM bandwidth
                # (0.0 before the first tick or with obs disabled)
                "tick_model_eff": round(self.last_tick_model_eff, 6)}
+        if self._tp is not None:
+            out["mesh"] = self._tp.describe()
+            out["tp_comm_precision"] = (self._tp.comm_precision
+                                        or "fp32")
+            out["tp_tick_comm_bytes"] = self.tp_tick_comm_bytes
         if self._spec is not None:
             drafted = self.tokens_drafted
             out.update({
@@ -780,6 +892,16 @@ class ContinuousBatchingEngine:
         prevent."""
         return self._warmed or self.ticks > 0
 
+    def _tp_scope(self):
+        """The trace/dispatch scope for this engine's programs: under
+        tp > 1 it thread-locally activates the slice mesh (so
+        mp_layers' constraints and the comm-precision routing take
+        effect at trace time) — a no-op context single-chip. Wraps
+        every site that may TRACE an engine program (warmup and the
+        lazy first call of each dispatch path)."""
+        return (self._tp.activate() if self._tp is not None
+                else contextlib.nullcontext())
+
     # -- AOT warmup ------------------------------------------------------
     def _static_key(self) -> str:
         """Trace-time constants of this engine's programs that never
@@ -795,10 +917,17 @@ class ContinuousBatchingEngine:
         # when the fused kernels are toggled on (ISSUE 19)
         from ..nn.functional.flash_attention import (_fused_cache_write_on,
                                                      _mega_decode_on)
-        fusion = (_fused_cache_write_on(), _mega_decode_on())
+        # evaluated under the engine's mesh scope: a tp engine's knobs
+        # read as OFF (the loud TP fallback), so its cache key matches
+        # what its traces actually contain — a single-chip fused
+        # executable can never be loaded for the sharded programs
+        with self._tp_scope():
+            fusion = (_fused_cache_write_on(), _mega_decode_on())
+        tp_key = ((self.tp, self._tp.comm_precision or "fp32")
+                  if self._tp is not None else None)
         return repr((type(self.model).__name__, self._sampling,
                      self.tick_tokens, self.max_len, self.cache_dtype,
-                     paged, spec, fusion))
+                     paged, spec, fusion, tp_key))
 
     def _decode_example_args(self) -> tuple:
         N = self.slots
@@ -856,43 +985,50 @@ class ContinuousBatchingEngine:
     def _warmup_locked(self, buckets, store, static, AotProgram,
                        aot_compile, _clog) -> list:
         recs = []
-        if not isinstance(self._decode_prog, AotProgram):
-            rec: dict = {"site": "engine_decode"}
-            self._decode_prog = aot_compile(
-                "engine_decode", self._get_decode_prog(),
-                self._decode_example_args(), store=store, log_record=rec,
-                static_key=static)
-            recs.append(_clog.record(rec))
-        for bucket in (buckets if buckets is not None
-                       else self.prefill_buckets):
-            bucket = self._bucket_for(int(bucket))
-            if isinstance(self._admit_progs.get(bucket), AotProgram):
-                continue
-            rec = {"site": f"engine_admit_b{bucket}"}
-            self._admit_progs[bucket] = aot_compile(
-                f"engine_admit_b{bucket}", self._get_admit_prog(bucket),
-                self._admit_example_args(bucket), store=store,
-                log_record=rec, static_key=static)
-            recs.append(_clog.record(rec))
-        if self.paged and not isinstance(self._copy_prog, AotProgram):
-            rec = {"site": "engine_copy_page"}
-            self._copy_prog = aot_compile(
-                "engine_copy_page", self._get_copy_page_prog(),
-                self._copy_example_args(), store=store, log_record=rec,
-                static_key=static)
-            recs.append(_clog.record(rec))
-        if self._spec is not None:
-            if not isinstance(self._verify_prog, AotProgram):
+        # every TARGET program traces inside the engine's mesh scope
+        # (sharded constraints + comm-precision routing are trace-time);
+        # the draft proposer warms OUTSIDE it below — the draft stays a
+        # single-device replicated model on purpose (its k-token
+        # proposals are checked by the sharded verify, never trusted)
+        with self._tp_scope():
+            if not isinstance(self._decode_prog, AotProgram):
+                rec: dict = {"site": "engine_decode"}
+                self._decode_prog = aot_compile(
+                    "engine_decode", self._get_decode_prog(),
+                    self._decode_example_args(), store=store,
+                    log_record=rec, static_key=static)
+                recs.append(_clog.record(rec))
+            for bucket in (buckets if buckets is not None
+                           else self.prefill_buckets):
+                bucket = self._bucket_for(int(bucket))
+                if isinstance(self._admit_progs.get(bucket), AotProgram):
+                    continue
+                rec = {"site": f"engine_admit_b{bucket}"}
+                self._admit_progs[bucket] = aot_compile(
+                    f"engine_admit_b{bucket}",
+                    self._get_admit_prog(bucket),
+                    self._admit_example_args(bucket), store=store,
+                    log_record=rec, static_key=static)
+                recs.append(_clog.record(rec))
+            if self.paged and not isinstance(self._copy_prog,
+                                             AotProgram):
+                rec = {"site": "engine_copy_page"}
+                self._copy_prog = aot_compile(
+                    "engine_copy_page", self._get_copy_page_prog(),
+                    self._copy_example_args(), store=store,
+                    log_record=rec, static_key=static)
+                recs.append(_clog.record(rec))
+            if self._spec is not None and not isinstance(
+                    self._verify_prog, AotProgram):
                 rec = {"site": "engine_verify"}
                 self._verify_prog = aot_compile(
                     "engine_verify", self._get_verify_prog(),
                     self._verify_example_args(), store=store,
                     log_record=rec, static_key=static)
                 recs.append(_clog.record(rec))
-            if self._spec.kind == "draft":
-                recs.extend(self._proposer.warmup(
-                    self.prefill_buckets, store=store,
-                    static_key=static))
+        if self._spec is not None and self._spec.kind == "draft":
+            recs.extend(self._proposer.warmup(
+                self.prefill_buckets, store=store, static_key=static))
         self._warmed = True
         return recs
 
@@ -997,16 +1133,20 @@ class ContinuousBatchingEngine:
         if self._copy_prog is not None:
             return self._copy_prog
         engine = self
+        # trace-time constant: scan-stacked pools put the page axis at
+        # 1 (behind the layer axis), unrolled pools at 0
+        stacked = isinstance(self._caches, tuple)
 
         def copy_page(caches, src, dst):
             engine._trace_count += 1      # fires at trace time only
 
             def cp(leaf):
-                row = jnp.take(leaf, src[None], axis=0)   # [1, PS, ...]
-                hit = jnp.arange(leaf.shape[0]) == dst
-                return jnp.where(
-                    hit.reshape((-1,) + (1,) * (leaf.ndim - 1)),
-                    row, leaf)
+                ax = 1 if stacked else 0
+                row = jnp.take(leaf, src[None], axis=ax)  # page row
+                hit = jnp.arange(leaf.shape[ax]) == dst
+                shape = [1] * leaf.ndim
+                shape[ax] = -1
+                return jnp.where(hit.reshape(shape), row, leaf)
 
             return jax.tree_util.tree_map(cp, caches)
 
@@ -1211,9 +1351,10 @@ class ContinuousBatchingEngine:
             ids = np.zeros((1, bucket), np.int64)
             ids[0, :P] = req.prompt
             prog = self._get_admit_prog(bucket)
-            tok0_dev, self._caches = prog(
-                self._params, self._buffers, ids, np.int32(P - 1), key,
-                self._caches, np.int32(b))
+            with self._tp_scope():     # lazy path may trace here
+                tok0_dev, self._caches = prog(
+                    self._params, self._buffers, ids, np.int32(P - 1),
+                    key, self._caches, np.int32(b))
             tok0 = int(tok0_dev)       # first-token host sync
             self.prefill_tokens += P
         if getattr(self._proposer, "kind", None) == "draft":
@@ -1305,18 +1446,21 @@ class ContinuousBatchingEngine:
         bt_row[:len(pages)] = pages
         self._block_tables[b] = bt_row
         if cow_src is not None:
-            self._caches = self._get_copy_page_prog()(
-                self._caches, np.int32(cow_src),
-                np.int32(pages[n_complete - 1]))
+            with self._tp_scope():     # lazy path may trace here
+                self._caches = self._get_copy_page_prog()(
+                    self._caches, np.int32(cow_src),
+                    np.int32(pages[n_complete - 1]))
         suffix = prompt[M:]
         S = suffix.shape[0]
         bucket = self._bucket_for(S)
         ids = np.zeros((1, bucket), np.int64)
         ids[0, :S] = suffix
         prog = self._get_admit_prog(bucket)
-        tok0_dev, self._caches = prog(
-            self._params, self._buffers, ids, np.int32(S - 1),
-            np.int32(M), np.int32(S), key, self._caches, bt_row[None])
+        with self._tp_scope():         # lazy path may trace here
+            tok0_dev, self._caches = prog(
+                self._params, self._buffers, ids, np.int32(S - 1),
+                np.int32(M), np.int32(S), key, self._caches,
+                bt_row[None])
         tok0 = int(tok0_dev)       # first-token host sync
         self._slots[b].pages = pages
         if self.prefix_cache:
@@ -1415,14 +1559,15 @@ class ContinuousBatchingEngine:
                 n_live += 1
         prog = self._get_verify_prog()
         t_tick = time.perf_counter() if self._obs else 0.0
-        if self.paged:
-            toks_dev, acc_dev, self._caches = prog(
-                self._params, self._buffers, self._caches,
-                self._block_tables, tok, pos, live, props, dlen)
-        else:
-            toks_dev, acc_dev, self._caches = prog(
-                self._params, self._buffers, self._caches, tok, pos,
-                live, props, dlen)
+        with self._tp_scope():         # lazy path may trace here
+            if self.paged:
+                toks_dev, acc_dev, self._caches = prog(
+                    self._params, self._buffers, self._caches,
+                    self._block_tables, tok, pos, live, props, dlen)
+            else:
+                toks_dev, acc_dev, self._caches = prog(
+                    self._params, self._buffers, self._caches, tok, pos,
+                    live, props, dlen)
         toks = np.asarray(toks_dev)       # the ONE host sync per tick
         n_acc = np.asarray(acc_dev)
         self.ticks += 1
@@ -1441,6 +1586,14 @@ class ContinuousBatchingEngine:
                 self._g_tick_eff.set(self.last_tick_model_eff)
             _obs.record_span("engine.tick", t_tick, now, cat="engine",
                              active=n_live, tick=self.ticks, spec=True)
+            if self._tp is not None:
+                # the per-block all-reduces run INSIDE the verify
+                # program; this span brackets the dispatch that moved
+                # them and carries the modeled per-chip wire bytes
+                _obs.record_span(
+                    "engine.tp_allreduce", t_tick, now, cat="engine",
+                    tp=self.tp, tick=self.ticks,
+                    modeled_comm_bytes=self.tp_verify_comm_bytes)
         for i, s in enumerate(self._slots):
             if s.free or not live[i]:
                 continue
@@ -1499,14 +1652,15 @@ class ContinuousBatchingEngine:
             keys[i] = s.key
         prog = self._get_decode_prog()
         t_tick = time.perf_counter() if self._obs else 0.0
-        if self.paged:
-            toks_dev, self._caches = prog(
-                self._params, self._buffers, self._caches,
-                self._block_tables, tok, pos, live, eos, keys)
-        else:
-            toks_dev, self._caches = prog(self._params, self._buffers,
-                                          self._caches, tok, pos, live,
-                                          eos, keys)
+        with self._tp_scope():         # lazy path may trace here
+            if self.paged:
+                toks_dev, self._caches = prog(
+                    self._params, self._buffers, self._caches,
+                    self._block_tables, tok, pos, live, eos, keys)
+            else:
+                toks_dev, self._caches = prog(
+                    self._params, self._buffers, self._caches, tok,
+                    pos, live, eos, keys)
         toks = np.asarray(toks_dev)       # the ONE host sync per tick
         self.ticks += 1
         if self._obs:
@@ -1520,6 +1674,14 @@ class ContinuousBatchingEngine:
                 self._g_tick_eff.set(self.last_tick_model_eff)
             _obs.record_span("engine.tick", t_tick, now, cat="engine",
                              active=n_live, tick=self.ticks)
+            if self._tp is not None:
+                # the per-block all-reduces run INSIDE the decode
+                # program; this span brackets the dispatch that moved
+                # them and carries the modeled per-chip wire bytes
+                _obs.record_span(
+                    "engine.tp_allreduce", t_tick, now, cat="engine",
+                    tp=self.tp, tick=self.ticks,
+                    modeled_comm_bytes=self.tp_tick_comm_bytes)
         for i, s in enumerate(self._slots):
             if s.free or not live[i]:
                 continue

@@ -182,16 +182,21 @@ _REPLICA_IO_ERRORS = (urllib.error.URLError, ConnectionError, OSError,
                       ValueError)
 
 
-def single_device_child_env(platform: str = "cpu") -> Dict[str, str]:
-    """Env overrides for replica children, which are SINGLE-DEVICE
-    serving processes: force the platform (N processes cannot share one
-    TPU chip) and drop the test harness's virtual-mesh flag if it
-    leaked into the parent env. The one scrub shared by
-    tools/serve_tier.py, tools/bench_serving.py --tier, and the
+def single_device_child_env(platform: str = "cpu",
+                            tp: int = 1) -> Dict[str, str]:
+    """Env overrides for replica children. tp=1 (the default): a
+    SINGLE-DEVICE serving process — force the platform (N processes
+    cannot share one TPU chip) and drop the test harness's virtual-mesh
+    flag if it leaked into the parent env. tp>1 (ISSUE 20): the replica
+    is an N-chip TP slice — give the child EXACTLY tp virtual devices
+    instead, so its engine mesh matches the spec. The one scrub shared
+    by tools/serve_tier.py, tools/bench_serving.py --tier, and the
     tests."""
-    return {"JAX_PLATFORMS": platform, "XLA_FLAGS": " ".join(
-        f for f in os.environ.get("XLA_FLAGS", "").split()
-        if not f.startswith("--xla_force_host_platform_device_count"))}
+    flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+             if not f.startswith("--xla_force_host_platform_device_count")]
+    if tp > 1:
+        flags.append(f"--xla_force_host_platform_device_count={tp}")
+    return {"JAX_PLATFORMS": platform, "XLA_FLAGS": " ".join(flags)}
 
 
 # ---------------------------------------------------------------------------
@@ -217,7 +222,7 @@ class ReplicaSpec:
     def __init__(self, model: dict, engine: Optional[dict] = None,
                  warmup: bool = True, drain_s: float = 5.0,
                  seed: int = 0, host: str = "127.0.0.1",
-                 env: Optional[Dict[str, str]] = None):
+                 env: Optional[Dict[str, str]] = None, tp: int = 1):
         self.model = dict(model)
         self.engine = dict(engine or {})
         self.warmup = bool(warmup)
@@ -225,12 +230,16 @@ class ReplicaSpec:
         self.seed = int(seed)
         self.host = host
         self.env = dict(env or {})
+        # tp>1: every replica spawned from this spec is an N-chip
+        # tensor-parallel slice (ISSUE 20) — the child engine gets
+        # tp= and the child env gets tp virtual devices
+        self.tp = int(tp)
 
     def to_json(self) -> str:
         return json.dumps({
             "model": self.model, "engine": self.engine,
             "warmup": self.warmup, "drain_s": self.drain_s,
-            "seed": self.seed, "host": self.host})
+            "seed": self.seed, "host": self.host, "tp": self.tp})
 
     def argv(self, port_file: str) -> List[str]:
         return [sys.executable, "-m", "paddle_tpu.inference.router",
@@ -264,7 +273,11 @@ def _replica_child_main(args) -> int:
     model = _build_model(spec["model"])
     from .engine import ContinuousBatchingEngine
     from .serve import PredictorServer
-    engine = ContinuousBatchingEngine(model, **spec.get("engine", {}))
+    eng_kw = dict(spec.get("engine", {}))
+    tp = int(spec.get("tp", 1))
+    if tp > 1:
+        eng_kw.setdefault("tp", tp)       # replica = N-chip slice
+    engine = ContinuousBatchingEngine(model, **eng_kw)
     srv = PredictorServer(engine=engine, host=spec.get("host", "127.0.0.1"),
                           port=0, warmup=spec.get("warmup", True)).start()
     # publish the kernel-assigned port atomically — the router polls for
@@ -351,6 +364,12 @@ class Replica:
                 "failure_streak": self.failure_streak,
                 "queued": int(eng.get("queued", 0)),
                 "active": int(eng.get("active", 0)),
+                # mesh geometry (ISSUE 20): how many chips this
+                # replica's slice occupies — 1 for the classic
+                # replica-per-chip tier
+                "tp": int(eng.get("tp", 1)),
+                "mesh_devices": int(eng.get("mesh_devices", 1)),
+                **({"mesh": eng["mesh"]} if "mesh" in eng else {}),
                 "ejected": now < self.ejected_until,
                 # how old the queued/active numbers above are: None =
                 # never answered a poll; a large age means the stats
@@ -1493,6 +1512,17 @@ class Router:
         env["PYTHONPATH"] = (pkg_parent + os.pathsep + env["PYTHONPATH"]
                              if env.get("PYTHONPATH") else pkg_parent)
         env.update(self.spec.env)
+        if getattr(self.spec, "tp", 1) > 1:
+            # a TP-slice replica needs its tp devices visible: on the
+            # cpu/virtual-mesh platform that means forcing the host
+            # device count (a scrubbed single-device env would make
+            # build_tp_mesh fail loudly in the child)
+            flags = [f for f in env.get("XLA_FLAGS", "").split()
+                     if not f.startswith(
+                         "--xla_force_host_platform_device_count")]
+            flags.append("--xla_force_host_platform_device_count="
+                         f"{self.spec.tp}")
+            env["XLA_FLAGS"] = " ".join(flags)
         log_f = open(log_path, "ab")
         try:
             proc = subprocess.Popen(

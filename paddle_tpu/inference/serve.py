@@ -348,6 +348,13 @@ class PredictorServer:
                                # efficiency chip's HBM bandwidth
                                "tick_model_eff")}
             body["engine"]["warm"] = getattr(self.engine, "warm", True)
+            # mesh geometry (ISSUE 20): a tier replica may be an N-chip
+            # TP slice, not a chip — the router's replica snapshot and
+            # any autoscaler need the real footprint
+            body["engine"]["tp"] = st.get("tp", 1)
+            body["engine"]["mesh_devices"] = st.get("mesh_devices", 1)
+            if "mesh" in st:
+                body["engine"]["mesh"] = st["mesh"]
             if st.get("paged"):
                 # paged KV pool health: an autoscaler reads page
                 # pressure (pool near-full with slots free = grow

@@ -101,21 +101,33 @@ def new_paged_kv_caches(num_layers, num_pages, page_size, kv_heads,
                         head_dim, dtype, scan_layers):
     """Paged KV caches for the continuous-batching engine's paged mode:
     per-layer (k_pool, v_pool) page pools (flash_attention.paged_kv_cache
-    dicts, dtype "int8" selects the quantized pool). A physical page id
-    means "that page in EVERY layer's pool" — one shared block table
-    indexes them all, so host-side page accounting stays per-request,
-    not per-layer. Block tables are per-request state the engine
-    attaches per program call; they are NOT part of this pytree."""
+    dicts, dtype "int8" selects the quantized pool), or — scan_layers —
+    ONE stacked (k_stack, v_stack) pair whose leaves carry a leading
+    layer axis. A physical page id means "that page in EVERY layer's
+    pool" — one shared block table indexes them all, so host-side page
+    accounting stays per-request, not per-layer. Block tables are
+    per-request state the engine attaches per program call; they are NOT
+    part of this pytree."""
     from ..nn.functional.flash_attention import paged_kv_cache
     if scan_layers:
-        # ScannedStack.forward_cached slices every cache leaf along the
-        # layer axis inside its scan — the shared block table has no
-        # layer axis to slice. Unrolled stacks are the serving-engine
-        # default; reject loudly rather than mis-thread.
-        raise NotImplementedError(
-            "paged KV caches require an unrolled block stack "
-            "(cfg.scan_layers=False); the scanned stack's cache scan "
-            "cannot thread the shared block table")
+        # Stacked pools [L, num_pages, page_size, ...]:
+        # ScannedStack.forward_cached slices every cache-dict leaf along
+        # the layer axis inside its scan, so each layer's body sees an
+        # ordinary per-layer pool dict. The shared block table has no
+        # layer axis of its own — the ENGINE broadcasts its per-program
+        # metadata (bt/live/wlen) with a leading L before attaching
+        # (ISSUE 20, the PR 9 follow-up), which gives the scan a
+        # per-layer [B, PM] slice of one host-side table; paging.py's
+        # allocator/trie/COW accounting stays per-request, layer-blind.
+        def stack(trees):
+            return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
+                                          *trees)
+        return (stack([paged_kv_cache(num_pages, page_size, kv_heads,
+                                      head_dim, dtype)
+                       for _ in range(num_layers)]),
+                stack([paged_kv_cache(num_pages, page_size, kv_heads,
+                                      head_dim, dtype)
+                       for _ in range(num_layers)]))
     return [(paged_kv_cache(num_pages, page_size, kv_heads, head_dim,
                             dtype),
              paged_kv_cache(num_pages, page_size, kv_heads, head_dim,

@@ -51,19 +51,52 @@ def _on_tpu():
         return False
 
 
+# fused knobs already warned about falling back under a sharded mesh —
+# one warning per knob per process, never one per trace (ISSUE 20)
+_TP_KNOB_WARNED = set()
+
+
+def _tp_blocks_fused_knob(knob: str) -> bool:
+    """The Pallas fusion kernels are single-device programs: under a
+    tp>1 mesh their dispatch inside a pjit-partitioned decode would
+    either fail to lower or silently compute on unsharded garbage
+    views. When the trace-time mesh carries a real "mp" axis the knobs
+    fall back to the unfused (GSPMD-partitionable) chain LOUDLY — one
+    warning per knob, and the TP engine surfaces it in stats()."""
+    from ...distributed import mesh as mesh_mod
+    mesh = mesh_mod.get_mesh(create_default=False)
+    if mesh is None or mesh.shape.get("mp", 1) == 1:
+        return False
+    if knob not in _TP_KNOB_WARNED:
+        _TP_KNOB_WARNED.add(knob)
+        warnings.warn(
+            f"{knob} is set but the active mesh shards tensor-parallel "
+            f"(mp={mesh.shape['mp']}): the fused Pallas kernels are "
+            "single-device and would be silently wrong under pjit — "
+            "falling back to the unfused path for sharded traces",
+            RuntimeWarning)
+    return True
+
+
 def _fused_cache_write_on() -> bool:
     """A/B knob for the fused cache-write kernels (ISSUE 19): collapses
     each 3-kernel one-hot write chain (and, on the S=1 slot decode path,
     the whole write+attend chain) into fused dispatches. Read at trace
-    time — the serving engine folds it into its compile cache key."""
-    return bool_env("PADDLE_TPU_FUSED_CACHE_WRITE", False)
+    time — the serving engine folds it into its compile cache key.
+    Forced off (loudly) when the trace-time mesh is tensor-parallel."""
+    if not bool_env("PADDLE_TPU_FUSED_CACHE_WRITE", False):
+        return False
+    return not _tp_blocks_fused_knob("PADDLE_TPU_FUSED_CACHE_WRITE")
 
 
 def _mega_decode_on() -> bool:
     """A/B knob for the mega-kernel decode inner step: the per-layer
     S=1 slot chain (cache read -> attention -> cache write) as ONE
-    Pallas dispatch. Prototype scope: plain array slot caches only."""
-    return bool_env("PADDLE_TPU_MEGA_DECODE", False)
+    Pallas dispatch. Prototype scope: plain array slot caches only.
+    Forced off (loudly) when the trace-time mesh is tensor-parallel."""
+    if not bool_env("PADDLE_TPU_MEGA_DECODE", False):
+        return False
+    return not _tp_blocks_fused_knob("PADDLE_TPU_MEGA_DECODE")
 
 
 def _pallas_geometry_ok(seq: int, d: int, drop: float) -> bool:

@@ -631,10 +631,15 @@ def test_llama_paged_identity_gqa():
         eng.stop()
 
 
-def test_paged_rejects_scan_layers():
-    """The scanned stack cannot thread the shared block table — reject
-    loudly at cache construction, never mis-thread."""
+def test_paged_scan_layers_builds_stacked_pools():
+    """Since PR 20 the scanned stack serves paged: per-layer pools
+    stack under a leading L axis and the shared block table broadcasts
+    onto it inside the engine (token identity vs the unrolled model is
+    asserted in tests/test_tp_engine.py). The old NotImplementedError
+    rejection is gone — construction must yield the stacked shape."""
     paddle.seed(5)
     m = GPTForCausalLM(gpt_tiny(scan_layers=True))
-    with pytest.raises(NotImplementedError):
-        m.new_paged_cache(8, 16, "float32")
+    k, v = m.new_paged_cache(8, 16, "float32")
+    L = m.cfg.num_layers
+    assert k["pages"].ndim == 5 and k["pages"].shape[:2] == (L, 8)
+    assert v["pages"].shape == k["pages"].shape

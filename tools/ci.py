@@ -104,6 +104,12 @@ QUICK_FILES = [
     # they replace, incl. bf16, padded-vocab tails, int8 dict caches,
     # paged gating, GQA and pos corners — plus the env-knob dispatch
     "tests/test_kernels.py",
+    # tensor-parallel serving slice (ISSUE 20): tp=2/4 greedy token
+    # identity vs the single-chip engine (slot/paged x f32/int8 x
+    # plain/speculative), zero-recompile drift, stacked paged block
+    # tables under scan_layers, fused-knob TP fallback, registry
+    # completeness, and a live 2-replica tier of tp=2 slices
+    "tests/test_tp_engine.py",
 ]
 
 
@@ -179,6 +185,21 @@ def _run_comm_smoke(env) -> int:
     print("\n=== comm smoke (quantized ZeRO collectives A/B) ===")
     return subprocess.run(
         [sys.executable, os.path.join("tools", "bench_collectives.py"),
+         "--smoke"],
+        cwd=ROOT, env=env).returncode
+
+
+def _run_tp_smoke(env) -> int:
+    """TP smoke (ISSUE 20): tools/bench_tp_decode.py --smoke decodes
+    the same greedy workload on a tp=1 and a tp=2 engine slice over
+    the virtual mesh — gating bitwise token identity, the
+    zero-recompile contract under prompt-length drift, and the
+    per-chip sharded-footprint fraction. The tool re-execs itself
+    onto the virtual mesh and strips the persistent executable store
+    (multi-device serialization is best-effort on CPU)."""
+    print("\n=== tp smoke (tensor-parallel decode A/B) ===")
+    return subprocess.run(
+        [sys.executable, os.path.join("tools", "bench_tp_decode.py"),
          "--smoke"],
         cwd=ROOT, env=env).returncode
 
@@ -406,6 +427,13 @@ def main():
                          "fp32/bf16/int8 byte + drift + overlap gates "
                          "on the 8-virtual-device mesh) that "
                          "--quick/--full append after the tests")
+    ap.add_argument("--no-tp-smoke", action="store_true",
+                    help="skip the tensor-parallel decode smoke "
+                         "(tools/bench_tp_decode.py --smoke: tp=1 vs "
+                         "tp=2 token identity + zero-recompile + "
+                         "per-chip footprint gates on the virtual "
+                         "mesh) that --quick/--full append after the "
+                         "tests")
     ap.add_argument("-k", default=None)
     args = ap.parse_args()
     if args.full and args.quick:
@@ -540,6 +568,11 @@ def main():
         # (multi-device reload hazard + fresh-compile wall times)
         comm_rc = _run_comm_smoke(env)
         rc = rc or comm_rc
+    if (args.quick or args.full) and not args.no_tp_smoke:
+        # plain env: the tool drops the executable store itself
+        # (multi-device serialization is best-effort on CPU)
+        tp_rc = _run_tp_smoke(env)
+        rc = rc or tp_rc
     return rc
 
 

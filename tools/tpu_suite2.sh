@@ -79,6 +79,13 @@ run bench_collectives bench_collectives.json \
 # the live engine asserts greedy token identity + zero new
 # traces/compiles across knob flips; self-skips once landed
 run bench_fusion bench_fusion.json python tools/bench_fusion.py
+# tensor-parallel decode A/B (ISSUE 20): the same greedy workload on
+# tp=1/2/4 engine slices — on TPU the mesh is real chips over ICI, so
+# alongside the bitwise token-identity and zero-recompile gates the
+# per-chip HBM fraction and the per-tick all-reduce become measured
+# wire, not just the modeled table; self-skips once landed
+run bench_tp_decode bench_tp_decode.json \
+    python tools/bench_tp_decode.py
 # obs decode-tick overhead gate (ISSUE 8): enabled-vs-disabled tick
 # time, paired-median on/off rounds; asserts the ratio <= 1.02 —
 # self-skips once landed like every other step

@@ -97,6 +97,7 @@ PY
         /root/repo/tpu_results/bench_obs_overhead.json \
         /root/repo/tpu_results/bench_fusion.json \
         /root/repo/tpu_results/bench_collectives.json \
+        /root/repo/tpu_results/bench_tp_decode.json \
         /root/repo/tpu_results/tier_trace.json \
         /root/repo/tpu_results/chaos_train.json \
         /root/repo/tpu_results/chaos_train_elastic.json \
